@@ -1,0 +1,51 @@
+"""Automatic mixed precision — the policy engine.
+
+TPU-native re-design of ``apex.amp`` (reference ``apex/amp/frontend.py``,
+``_initialize.py``, ``scaler.py``, ``handle.py``, ``amp.py``/``wrap.py``).
+
+The reference implements AMP by monkey-patching torch namespaces (O1), or by
+casting modules in place and patching ``forward``/``step`` (O2/O3). In JAX,
+parameters and activations are explicit pytrees and the program is traced
+functionally, so the same four opt-levels become *data*:
+
+======  ===========================  ==========================================
+level   reference semantics          apex_tpu policy
+======  ===========================  ==========================================
+O0      fp32 everything              params fp32, compute fp32
+O1      patched cast per-op          params fp32, compute bf16 with per-op
+                                     dtype rules (see :mod:`apex_tpu.amp.lists`)
+O2      fp16 model + fp32 masters    params bf16 at forward, fp32 master copy,
+                                     norms fp32, fp32 optimizer update
+O3      fp16 everything              params/compute bf16
+======  ===========================  ==========================================
+
+Loss scaling is optional (needed for fp16, usually unnecessary for bf16) and
+is a pure function of a :class:`LossScalerState` — the reference's
+"patch optimizer.step to skip" trick (``apex/amp/handle.py:128-154``) becomes
+a ``lax.cond`` inside the update step, with zero host round-trips.
+"""
+
+from apex_tpu.amp.policy import (  # noqa: F401
+    Policy,
+    O0,
+    O1,
+    O2,
+    O3,
+    get_policy,
+    with_policy,
+    current_policy,
+)
+from apex_tpu.amp.scaler import (  # noqa: F401
+    LossScalerState,
+    init_loss_scaler,
+    scale_loss,
+    unscale_grads,
+    update_loss_scaler,
+    scaled_value_and_grad,
+    all_finite,
+    apply_if_finite,
+    state_dict,
+    load_state_dict,
+)
+from apex_tpu.amp.master import MasterWeights, apply_updates_with_master  # noqa: F401
+from apex_tpu.amp.lists import op_cast_dtype, register_half_op, register_float_op, register_promote_op  # noqa: F401

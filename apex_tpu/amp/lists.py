@@ -1,0 +1,108 @@
+"""Declarative per-op cast policy tables (opt-level O1 semantics).
+
+The reference implements O1 by monkey-patching every listed function in
+``torch``/``torch.Tensor``/``torch.nn.functional`` with cast wrappers
+(``apex/amp/amp.py:68-177``, ``apex/amp/wrap.py``). The *policy* lives in
+tables (``apex/amp/lists/functional_overrides.py``, ``torch_overrides.py``,
+``tensor_overrides.py``). JAX has no mutable op namespace to patch — and XLA
+already promotes correctly — so we keep only the tables, expressed over
+abstract op families, and expose:
+
+* :func:`op_cast_dtype` — the dtype a policy-aware layer should compute a
+  given op family in. Layers in ``apex_tpu.ops`` consult this when the ambient
+  policy has ``per_op_rules=True``.
+* registries mirroring ``amp.register_half_function`` /
+  ``register_float_function`` / ``register_promote_function``
+  (``apex/amp/amp.py:30-64``) so user code can extend the tables.
+
+Op families (not individual functions — JAX composes from primitives):
+
+* HALF  (run in compute dtype): matmul-shaped ops — conv, dense, attention
+  (cf. FP16 lists: ``lists/functional_overrides.py:17-26``,
+  ``torch_overrides.py:7-27``).
+* FLOAT (run in fp32): softmax, normalization, losses, transcendentals,
+  reductions (cf. FP32 lists: ``functional_overrides.py:28-67``,
+  ``torch_overrides.py:29-60``).
+* PROMOTE (widest input dtype): multi-arg math, concat/stack
+  (``torch_overrides.py:81-111``) — this is XLA's native promotion; listed for
+  completeness and for the checker.
+* BANNED: ops numerically unsafe in half precision regardless
+  (``functional_overrides.py:69-80`` bans ``binary_cross_entropy``) —
+  :func:`check_banned` raises with the same guidance.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+HALF_OPS = {
+    # matmul/conv family → MXU, compute dtype
+    "conv", "conv1d", "conv2d", "conv3d", "conv_transpose",
+    "dense", "linear", "matmul", "bmm", "einsum", "attention", "mlp",
+}
+
+FLOAT_OPS = {
+    # numerically sensitive → fp32
+    "softmax", "log_softmax", "layer_norm", "rms_norm", "batch_norm",
+    "group_norm", "cross_entropy", "nll_loss", "mse_loss", "l1_loss",
+    "smooth_l1_loss", "kl_div", "cosine_similarity",
+    "exp", "log", "log1p", "pow", "erf", "erfinv", "softplus",
+    "sum", "prod", "cumsum", "cumprod", "norm", "mean", "var", "std",
+}
+
+PROMOTE_OPS = {
+    "add", "sub", "mul", "div", "addcmul", "addcdiv",
+    "cat", "stack", "concatenate", "where", "equal", "dot",
+}
+
+BANNED_OPS = {
+    # fp16-unsafe even with scaling; reference raises and points users at the
+    # fused fp32 alternative (functional_overrides.py:69-80)
+    "binary_cross_entropy": (
+        "binary_cross_entropy on half inputs is numerically unsafe; compute "
+        "the loss in fp32 (policy.cast_to_output) or use "
+        "sigmoid_cross_entropy_with_logits"
+    ),
+}
+
+
+def register_half_op(name: str) -> None:
+    """cf. ``amp.register_half_function`` / ``@amp.half_function``
+    (``apex/amp/amp.py:30-40``; used e.g. by ``apex/mlp/mlp.py:24``)."""
+    FLOAT_OPS.discard(name)
+    HALF_OPS.add(name)
+
+
+def register_float_op(name: str) -> None:
+    HALF_OPS.discard(name)
+    FLOAT_OPS.add(name)
+
+
+def register_promote_op(name: str) -> None:
+    HALF_OPS.discard(name)
+    FLOAT_OPS.discard(name)
+    PROMOTE_OPS.add(name)
+
+
+def check_banned(name: str) -> None:
+    if name in BANNED_OPS:
+        raise RuntimeError(f"amp: {BANNED_OPS[name]}")
+
+
+def op_cast_dtype(op: str, policy, *input_dtypes):
+    """Dtype an O1-style policy computes ``op`` in.
+
+    HALF → ``policy.compute_dtype``; FLOAT → fp32; PROMOTE/unknown → widest
+    input dtype (matching ``wrap.promote``'s ``maybe_float`` behavior,
+    ``apex/amp/wrap.py:65-90``).
+    """
+    check_banned(op)
+    if not getattr(policy, "per_op_rules", False):
+        return policy.compute_dtype
+    if op in HALF_OPS:
+        return policy.compute_dtype
+    if op in FLOAT_OPS:
+        return jnp.float32
+    if input_dtypes:
+        return jnp.result_type(*input_dtypes)
+    return policy.compute_dtype

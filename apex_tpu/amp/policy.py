@@ -1,0 +1,175 @@
+"""Precision policies: the functional O0-O3 engine.
+
+Replaces the reference's ``Properties`` / opt-level system
+(``apex/amp/frontend.py:7-191``) and the cast machinery of
+``apex/amp/_initialize.py`` (``convert_network`` at ``:176-182``, input/output
+cast patching at ``:194-201``) with an explicit, composable policy object
+applied to pytrees. ``keep_batchnorm_fp32`` (``frontend.py:134-144``)
+generalizes to ``keep_norm_f32`` — normalization layers read
+``current_policy().norm_dtype`` instead of being monkey-converted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.pytree import tree_cast
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """A mixed-precision policy (jmp-style: param/compute/output dtypes).
+
+    Attributes mirror the reference's opt-level ``Properties``
+    (``apex/amp/frontend.py:37-97``):
+
+    * ``cast_model_type``      → :attr:`compute_dtype`
+    * ``master_weights``       → :attr:`master_weights`
+    * ``keep_batchnorm_fp32``  → :attr:`keep_norm_f32`
+    * ``patch_torch_functions``→ :attr:`per_op_rules` (declarative, not patched)
+    * ``loss_scale``           → carried by the loss scaler, not the policy
+    """
+
+    name: str = "O0"
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    output_dtype: jnp.dtype = jnp.float32
+    master_weights: bool = False
+    keep_norm_f32: bool = True
+    per_op_rules: bool = False  # O1: consult apex_tpu.amp.lists per op family
+
+    # -- pytree casts ---------------------------------------------------------
+    def cast_to_param(self, tree: PyTree) -> PyTree:
+        return tree_cast(tree, self.param_dtype)
+
+    def cast_to_compute(self, tree: PyTree) -> PyTree:
+        """Cast params/inputs for the forward pass (the reference's patched
+        ``model.forward`` input cast, ``_initialize.py:194-201``)."""
+        return tree_cast(tree, self.compute_dtype)
+
+    def cast_to_output(self, tree: PyTree) -> PyTree:
+        """Cast network outputs (reference casts outputs back to fp32 so the
+        loss is computed in fp32, ``_initialize.py:39-61``)."""
+        return tree_cast(tree, self.output_dtype)
+
+    @property
+    def norm_dtype(self) -> jnp.dtype:
+        """Compute dtype for normalization statistics (BN/LN/RMSNorm)."""
+        return jnp.float32 if self.keep_norm_f32 else self.compute_dtype
+
+    def run(self, fn, params: PyTree, *args, **kwargs):
+        """Run ``fn(params, *args)`` under this policy: params+inputs cast to
+        compute dtype, outputs cast to output dtype. One-call equivalent of
+        ``amp.initialize`` + forward."""
+        out = fn(
+            self.cast_to_compute(params),
+            *self.cast_to_compute(args),
+            **self.cast_to_compute(kwargs),
+        )
+        return self.cast_to_output(out)
+
+
+def _make(name, param, compute, output, master, keep_norm, per_op=False) -> Policy:
+    return Policy(
+        name=name,
+        param_dtype=param,
+        compute_dtype=compute,
+        output_dtype=output,
+        master_weights=master,
+        keep_norm_f32=keep_norm,
+        per_op_rules=per_op,
+    )
+
+
+# Opt-level presets (reference defaults: frontend.py:102-191). bf16 replaces
+# fp16 as the TPU half dtype; pass half_dtype=jnp.float16 to get_policy for
+# strict fp16 semantics (then pair with the dynamic loss scaler).
+O0 = _make("O0", jnp.float32, jnp.float32, jnp.float32, master=False, keep_norm=True)
+O1 = _make("O1", jnp.float32, jnp.bfloat16, jnp.float32, master=False, keep_norm=True, per_op=True)
+O2 = _make("O2", jnp.bfloat16, jnp.bfloat16, jnp.float32, master=True, keep_norm=True)
+O3 = _make("O3", jnp.bfloat16, jnp.bfloat16, jnp.bfloat16, master=False, keep_norm=False)
+
+_LEVELS = {"O0": O0, "O1": O1, "O2": O2, "O3": O3}
+
+
+def get_policy(
+    opt_level: str = "O0",
+    *,
+    half_dtype: jnp.dtype = jnp.bfloat16,
+    keep_norm_f32: Optional[bool] = None,
+    master_weights: Optional[bool] = None,
+) -> Policy:
+    """Look up an opt-level preset with overrides.
+
+    Mirrors ``amp.initialize(opt_level=..., keep_batchnorm_fp32=...,
+    master_weights=...)`` (``apex/amp/frontend.py:195-358``) — overrides are
+    validated against the level exactly as ``Properties.__setattr__`` does.
+    """
+    if opt_level not in _LEVELS:
+        raise ValueError(f"unknown opt_level {opt_level!r}; expected one of {sorted(_LEVELS)}")
+    p = _LEVELS[opt_level]
+    sub = lambda d: half_dtype if d == jnp.bfloat16 else d  # noqa: E731
+    p = dataclasses.replace(
+        p,
+        param_dtype=sub(p.param_dtype),
+        compute_dtype=sub(p.compute_dtype),
+        output_dtype=sub(p.output_dtype),
+    )
+    if keep_norm_f32 is not None:
+        if opt_level == "O1" and not keep_norm_f32:
+            raise ValueError("O1 keeps norms in fp32 (cf. frontend.py:125-131)")
+        p = dataclasses.replace(p, keep_norm_f32=keep_norm_f32)
+    if master_weights is not None:
+        if opt_level == "O1" and master_weights:
+            raise ValueError("O1 does not use master weights (cf. frontend.py:118)")
+        p = dataclasses.replace(p, master_weights=master_weights)
+    return p
+
+
+# -- ambient policy context ---------------------------------------------------
+# Layers (normalization, dense, attention) consult the ambient policy for
+# their compute dtype, replacing the reference's module conversion walk.
+
+_tls = threading.local()
+
+
+class with_policy:
+    """Context manager installing an ambient policy for layer construction.
+
+    Also usable as a decorator. Equivalent role to ``amp.initialize`` making
+    the whole program run under an opt level; unlike the reference it patches
+    nothing — layers *read* the policy.
+    """
+
+    def __init__(self, policy: Policy):
+        self.policy = policy
+
+    def __enter__(self) -> Policy:
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self.policy)
+        return self.policy
+
+    def __exit__(self, *exc):
+        _tls.stack.pop()
+        return False
+
+    def __call__(self, fn):
+        def wrapped(*args, **kwargs):
+            with self:
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+
+def current_policy() -> Policy:
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else O0

@@ -1,0 +1,39 @@
+"""Distributed-training primitives over a JAX device mesh.
+
+TPU-native equivalent of ``apex.parallel`` (reference
+``apex/parallel/__init__.py:9-18``): data-parallel gradient synchronization
+(:mod:`apex_tpu.parallel.distributed`), synchronized batch-norm
+(:mod:`apex_tpu.parallel.sync_batchnorm`), LARC
+(:mod:`apex_tpu.parallel.larc`), plus the mesh bookkeeping that replaces the
+reference's NCCL process groups (:mod:`apex_tpu.parallel.mesh`).
+"""
+
+from apex_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    initialize_model_parallel,
+    destroy_model_parallel,
+    model_parallel_is_initialized,
+    get_mesh,
+    get_mesh_spec,
+    get_data_parallel_world_size,
+    get_tensor_model_parallel_world_size,
+    get_pipeline_model_parallel_world_size,
+    get_context_parallel_world_size,
+    get_expert_parallel_world_size,
+    get_virtual_pipeline_model_parallel_world_size,
+    get_rank_info,
+    DATA_AXIS,
+    TENSOR_AXIS,
+    PIPELINE_AXIS,
+    CONTEXT_AXIS,
+    EXPERT_AXIS,
+)
+from apex_tpu.parallel.distributed import (  # noqa: F401
+    DistributedGradients,
+    cross_replica_gradients,
+    all_reduce_gradients,
+    data_parallel_sharding,
+    replicate,
+)
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, BatchNormState  # noqa: F401
+from apex_tpu.parallel.larc import larc  # noqa: F401

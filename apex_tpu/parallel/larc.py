@@ -1,0 +1,71 @@
+"""LARC — layer-wise adaptive rate control/clipping.
+
+Re-design of ``apex.parallel.LARC`` (``apex/parallel/LARC.py:5``). The
+reference wraps an optimizer and rewrites ``p.grad`` in place before
+delegating (``LARC.py:78-107``); here it is an optax gradient transformation
+chained *before* the base optimizer, with identical arithmetic:
+
+    adaptive_lr = trust_coefficient * ||p|| / (||g|| + weight_decay * ||p|| + eps)
+
+clip mode:  scale grads by min(adaptive_lr / lr, 1)
+scale mode: scale grads by adaptive_lr
+
+Usage::
+
+    tx = optax.chain(apex_tpu.parallel.larc(learning_rate=0.1), optax.sgd(0.1))
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def larc(
+    learning_rate: Union[float, Callable[[jax.Array], jax.Array]] = 1.0,
+    trust_coefficient: float = 0.02,
+    clip: bool = True,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """Per-parameter trust-ratio grad scaling (``apex/parallel/LARC.py:78-107``).
+
+    ``learning_rate`` is needed in clip mode to reproduce
+    ``min(adaptive_lr/lr, 1)``; pass the same schedule you give the base
+    optimizer. Parameters with zero norm are left untouched, as in the
+    reference (``if param_norm != 0 and grad_norm != 0``).
+    """
+
+    def init_fn(params):
+        del params
+        return optax.ScaleByScheduleState(count=jnp.zeros((), jnp.int32))
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("larc requires params")
+        lr = learning_rate(state.count) if callable(learning_rate) else learning_rate
+
+        def scale_one(g, p):
+            p32 = jnp.asarray(p, jnp.float32)
+            g32 = jnp.asarray(g, jnp.float32)
+            param_norm = jnp.linalg.norm(p32.reshape(-1))
+            grad_norm = jnp.linalg.norm(g32.reshape(-1))
+            adaptive_lr = (
+                trust_coefficient * param_norm / (grad_norm + weight_decay * param_norm + eps)
+            )
+            if clip:
+                factor = jnp.minimum(adaptive_lr / lr, 1.0)
+            else:
+                factor = adaptive_lr
+            # untouched when either norm is zero, as the reference guards
+            factor = jnp.where((param_norm > 0) & (grad_norm > 0), factor, 1.0)
+            g32 = g32 + weight_decay * p32
+            return (g32 * factor).astype(g.dtype)
+
+        new_updates = jax.tree.map(scale_one, updates, params)
+        return new_updates, optax.ScaleByScheduleState(count=state.count + 1)
+
+    return optax.GradientTransformation(init_fn, update_fn)

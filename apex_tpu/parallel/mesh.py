@@ -1,0 +1,242 @@
+"""Global device-mesh bookkeeping for N-D parallelism.
+
+TPU-native replacement for the reference's ``apex/transformer/parallel_state.py``:
+where the reference builds a zoo of NCCL process groups for DP x TP x PP (+
+virtual PP + embedding groups, ``parallel_state.py:73-247``) and exposes ~40
+rank/world-size accessors (``:262-549``), a JAX SPMD program needs exactly one
+``jax.sharding.Mesh`` with named axes; collectives reference axes by name and
+XLA lowers them to ICI/DCN ring/tree ops.
+
+Axis layout (outer → inner): ``('dp', 'pp', 'cp', 'tp')``. ``tp`` is
+innermost so tensor-parallel collectives ride the fastest ICI links; ``dp``
+outermost so data-parallel all-reduces tolerate DCN between slices. Context
+parallelism (``cp``, for ring attention / long context) and expert parallelism
+(``ep``, folded over ``dp``) are first-class here even though the reference
+lacks them (SURVEY.md §2.3).
+
+The "rank" accessors come in two flavors:
+  * world sizes — module level, from the mesh shape (host-side);
+  * ranks — only meaningful per-device, i.e. *inside* ``shard_map``; use
+    ``jax.lax.axis_index(axis)``. Host-side code that needs "my rank" the way
+    the reference does (e.g. ``get_tensor_model_parallel_rank()``,
+    ``parallel_state.py:324``) should restructure to be rank-free — SPMD
+    programs are written once for all ranks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from apex_tpu.utils.logging import get_logger, set_rank_info
+
+logger = get_logger(__name__)
+
+# Canonical axis names. The reference's group getters (e.g.
+# get_tensor_model_parallel_group, parallel_state.py:262+) map to these names.
+DATA_AXIS = "dp"
+PIPELINE_AXIS = "pp"
+CONTEXT_AXIS = "cp"
+TENSOR_AXIS = "tp"
+EXPERT_AXIS = "ep"  # folded over dp when expert parallelism is enabled
+
+_MESH: Optional[Mesh] = None
+_SPEC: Optional["MeshSpec"] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Static description of the parallel decomposition.
+
+    Mirrors the arguments of the reference's ``initialize_model_parallel``
+    (``apex/transformer/parallel_state.py:73-110``) plus the TPU-first
+    extensions (context/expert parallelism).
+    """
+
+    data_parallel_size: int = 1
+    tensor_model_parallel_size: int = 1
+    pipeline_model_parallel_size: int = 1
+    context_parallel_size: int = 1
+    expert_parallel_size: int = 1
+    virtual_pipeline_model_parallel_size: Optional[int] = None
+
+    def __post_init__(self):
+        if self.virtual_pipeline_model_parallel_size is not None:
+            if self.pipeline_model_parallel_size < 2:
+                raise ValueError(
+                    "virtual pipeline parallelism requires pipeline_model_parallel_size >= 2"
+                )
+        if self.expert_parallel_size > 1 and self.data_parallel_size % self.expert_parallel_size:
+            raise ValueError("expert_parallel_size must divide data_parallel_size")
+
+    @property
+    def model_parallel_size(self) -> int:
+        return (
+            self.tensor_model_parallel_size
+            * self.pipeline_model_parallel_size
+            * self.context_parallel_size
+        )
+
+    @property
+    def world_size(self) -> int:
+        return self.data_parallel_size * self.model_parallel_size
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    *,
+    context_parallel_size: int = 1,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    expert_parallel_size: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build and install the global mesh.
+
+    Equivalent of ``parallel_state.initialize_model_parallel``
+    (``apex/transformer/parallel_state.py:73-247``): validates divisibility,
+    computes the data-parallel size from the device count, and constructs the
+    decomposition — but as ONE mesh rather than O(world_size) process groups.
+    The reference's rank-ordering convention (tp fastest-varying, then pp,
+    then dp) is preserved so the same global batch maps to the same devices.
+    """
+    global _MESH, _SPEC
+    if devices is None:
+        devices = jax.devices()
+    world_size = len(devices)
+    model_parallel = (
+        tensor_model_parallel_size * pipeline_model_parallel_size * context_parallel_size
+    )
+    if world_size % model_parallel != 0:
+        raise RuntimeError(
+            f"world size ({world_size}) is not divisible by "
+            f"tp ({tensor_model_parallel_size}) x pp ({pipeline_model_parallel_size}) "
+            f"x cp ({context_parallel_size})"
+        )
+    data_parallel_size = world_size // model_parallel
+    spec = MeshSpec(
+        data_parallel_size=data_parallel_size,
+        tensor_model_parallel_size=tensor_model_parallel_size,
+        pipeline_model_parallel_size=pipeline_model_parallel_size,
+        context_parallel_size=context_parallel_size,
+        expert_parallel_size=expert_parallel_size,
+        virtual_pipeline_model_parallel_size=virtual_pipeline_model_parallel_size,
+    )
+    device_array = np.asarray(devices).reshape(
+        data_parallel_size,
+        pipeline_model_parallel_size,
+        context_parallel_size,
+        tensor_model_parallel_size,
+    )
+    mesh = Mesh(device_array, (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS))
+    _MESH, _SPEC = mesh, spec
+    set_rank_info(get_rank_info())
+    logger.info("initialized model parallel: %s", spec)
+    return mesh
+
+
+def make_mesh(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    context_parallel_size: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh without installing it globally (for tests / local use)."""
+    if devices is None:
+        devices = jax.devices()
+    model_parallel = (
+        tensor_model_parallel_size * pipeline_model_parallel_size * context_parallel_size
+    )
+    dp = len(devices) // model_parallel
+    if dp == 0:
+        raise RuntimeError(
+            f"{len(devices)} device(s) cannot host tp ({tensor_model_parallel_size}) "
+            f"x pp ({pipeline_model_parallel_size}) x cp ({context_parallel_size})"
+        )
+    device_array = np.asarray(devices)[: dp * model_parallel].reshape(
+        dp, pipeline_model_parallel_size, context_parallel_size, tensor_model_parallel_size
+    )
+    return Mesh(device_array, (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS))
+
+
+def destroy_model_parallel() -> None:
+    """Tear down global state (cf. ``parallel_state.py:555-580``)."""
+    global _MESH, _SPEC
+    _MESH, _SPEC = None, None
+    set_rank_info("")
+
+
+def model_parallel_is_initialized() -> bool:
+    return _MESH is not None
+
+
+def get_mesh() -> Mesh:
+    if _MESH is None:
+        raise RuntimeError(
+            "model parallel mesh is not initialized; call "
+            "apex_tpu.parallel.initialize_model_parallel(...) first"
+        )
+    return _MESH
+
+
+def get_mesh_spec() -> MeshSpec:
+    if _SPEC is None:
+        raise RuntimeError("model parallel mesh is not initialized")
+    return _SPEC
+
+
+# --- world-size accessors (host-side; cf. parallel_state.py:262-549) ---------
+
+def get_data_parallel_world_size() -> int:
+    return get_mesh_spec().data_parallel_size
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    return get_mesh_spec().tensor_model_parallel_size
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return get_mesh_spec().pipeline_model_parallel_size
+
+
+def get_context_parallel_world_size() -> int:
+    return get_mesh_spec().context_parallel_size
+
+
+def get_expert_parallel_world_size() -> int:
+    return get_mesh_spec().expert_parallel_size
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return get_mesh_spec().virtual_pipeline_model_parallel_size
+
+
+def get_rank_info() -> str:
+    """Short mesh descriptor for log records (cf. ``parallel_state.py:250-259``)."""
+    if _SPEC is None:
+        return "uninitialized"
+    s = _SPEC
+    return (
+        f"dp{s.data_parallel_size}/pp{s.pipeline_model_parallel_size}"
+        f"/cp{s.context_parallel_size}/tp{s.tensor_model_parallel_size}"
+    )
+
+
+# --- in-shard_map rank helpers ----------------------------------------------
+
+def axis_rank(axis: str) -> jax.Array:
+    """Per-device rank along ``axis``; valid only inside shard_map/pjit with
+    that axis bound (replaces get_*_rank, ``parallel_state.py:324+``)."""
+    return jax.lax.axis_index(axis)
+
+
+def is_pipeline_first_stage() -> jax.Array:
+    return jax.lax.axis_index(PIPELINE_AXIS) == 0
+
+
+def is_pipeline_last_stage() -> jax.Array:
+    return jax.lax.axis_index(PIPELINE_AXIS) == jax.lax.axis_size(PIPELINE_AXIS) - 1

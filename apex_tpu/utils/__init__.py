@@ -1,0 +1,10 @@
+"""Shared utilities: logging, pytree helpers, dtype helpers."""
+
+from apex_tpu.utils.logging import get_logger, set_rank_info  # noqa: F401
+from apex_tpu.utils.pytree import (  # noqa: F401
+    tree_cast,
+    tree_size,
+    tree_norm,
+    tree_all_finite,
+    ravel_pytree_fast,
+)

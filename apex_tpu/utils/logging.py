@@ -1,0 +1,70 @@
+"""Rank-aware logging.
+
+TPU-native equivalent of the reference's root-logger setup with a
+``RankInfoFormatter`` that prints (dp, tp, pp, vpp) ranks on every record
+(reference: ``apex/__init__.py:27-38``, rank info from
+``apex/transformer/parallel_state.py:250-259``) and the per-module logger
+factory (``apex/transformer/log_util.py``).
+
+In a JAX SPMD program there is one Python process per *host*, not per device,
+so "rank" here is (process_index, mesh-rank-info-string). The mesh module
+registers its rank info via :func:`set_rank_info` when a global mesh is
+initialized.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_RANK_INFO: str = ""
+
+
+def set_rank_info(info: str) -> None:
+    """Record a short rank descriptor (e.g. ``"dp0/tp1/pp0"``) shown in logs."""
+    global _RANK_INFO
+    _RANK_INFO = info
+
+
+def get_rank_info() -> str:
+    return _RANK_INFO
+
+
+class RankInfoFilter(logging.Filter):
+    """Injects ``rank_info`` into every record (cf. RankInfoFormatter)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.rank_info = _RANK_INFO or f"p{os.environ.get('JAX_PROCESS_INDEX', 0)}"
+        return True
+
+
+_FORMAT = "%(asctime)s [%(rank_info)s] %(levelname)s %(name)s: %(message)s"
+
+
+def get_logger(name: str = "apex_tpu", level: int | None = None) -> logging.Logger:
+    """Per-module logger factory (cf. ``apex/transformer/log_util.py``)."""
+    logger = logging.getLogger(name)
+    if not getattr(logger, "_apex_tpu_configured", False):
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        handler.addFilter(RankInfoFilter())
+        logger.addHandler(handler)
+        logger.propagate = False
+        logger._apex_tpu_configured = True  # type: ignore[attr-defined]
+    env_level = os.environ.get("APEX_TPU_LOG_LEVEL")
+    if level is not None:
+        logger.setLevel(level)
+    elif env_level:
+        logger.setLevel(env_level.upper())
+    elif logger.level == logging.NOTSET:
+        logger.setLevel(logging.WARNING)
+    return logger
+
+
+def maybe_print(msg: str, *, rank0_only: bool = True) -> None:
+    """Print gated to process 0 (cf. ``apex/amp/_amp_state.py:38-51``)."""
+    import jax
+
+    if not rank0_only or jax.process_index() == 0:
+        print(msg, flush=True)

@@ -1,0 +1,98 @@
+"""Pytree helpers shared across the framework.
+
+These replace the reference's tensor-list plumbing (``apex_C.flatten`` /
+``unflatten``, ``csrc/flatten_unflatten.cpp:16-17``) and the grad inspection
+utilities (``apex/transformer/pipeline_parallel/utils.py:265-285``) with
+pytree-native equivalents. On TPU, flattening into one contiguous buffer is
+also the layout that makes fused-optimizer Pallas kernels efficient, so
+:func:`ravel_pytree_fast` is the backbone of ``apex_tpu.optimizers``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+def tree_cast(tree: PyTree, dtype) -> PyTree:
+    """Cast every floating-point leaf to ``dtype``; leave int/bool leaves alone.
+
+    Functional replacement for ``apex/fp16_utils/fp16util.py``'s
+    ``network_to_half`` / ``convert_network`` module walkers.
+    """
+    if dtype is None:
+        return tree
+
+    def _cast(x):
+        if isinstance(x, (jax.Array, np.ndarray)) and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(_cast, tree)
+
+
+def tree_size(tree: PyTree) -> int:
+    """Total number of elements across all leaves."""
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
+
+
+def tree_norm(tree: PyTree, ord: int = 2) -> jax.Array:
+    """Global norm over all leaves (cf. ``amp_C.multi_tensor_l2norm`` —
+    ``csrc/multi_tensor_l2norm_kernel.cu`` — which computes per-tensor and
+    global L2 norms in one launch; XLA fuses this reduction natively)."""
+    leaves = [jnp.asarray(x, jnp.float32) for x in jax.tree.leaves(tree)]
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    if ord == 2:
+        return jnp.sqrt(sum(jnp.sum(x * x) for x in leaves))
+    return sum(jnp.sum(jnp.abs(x) ** ord) for x in leaves) ** (1.0 / ord)
+
+
+def tree_all_finite(tree: PyTree) -> jax.Array:
+    """True iff every element of every leaf is finite.
+
+    The fused inf/nan detection that ``amp_C.multi_tensor_scale`` folds into
+    its copy kernel (``csrc/multi_tensor_scale_kernel.cu``); here it is a
+    reduction XLA fuses into the surrounding computation, and the result stays
+    on device (no D2H sync — cf. the single sync at ``apex/amp/scaler.py:200``).
+    """
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack([jnp.all(jnp.isfinite(x)) for x in leaves]).all()
+
+
+def ravel_pytree_fast(tree: PyTree) -> Tuple[jax.Array, Callable[[jax.Array], PyTree]]:
+    """Flatten a pytree of arrays into one 1-D buffer + an unravel closure.
+
+    Like ``jax.flatten_util.ravel_pytree`` but promotes nothing: all leaves
+    must share a dtype (callers group by dtype first, exactly as the reference
+    groups tensors with ``split_half_float_double``,
+    ``apex/parallel/distributed.py:51-58``).
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    dtypes = {jnp.asarray(x).dtype for x in leaves}
+    if len(dtypes) > 1:
+        raise TypeError(
+            f"ravel_pytree_fast requires uniform leaf dtype, got {sorted(map(str, dtypes))}; "
+            "group leaves by dtype first (cf. split_half_float_double, "
+            "apex/parallel/distributed.py:51-58)"
+        )
+    shapes = [x.shape for x in leaves]
+    sizes = [int(np.prod(s)) for s in shapes]
+    flat = jnp.concatenate([jnp.reshape(x, (-1,)) for x in leaves]) if leaves else jnp.zeros((0,))
+
+    def unravel(buf: jax.Array) -> PyTree:
+        chunks = []
+        offset = 0
+        for shape, size in zip(shapes, sizes):
+            chunks.append(jnp.reshape(buf[offset : offset + size], shape))
+            offset += size
+        return jax.tree.unflatten(treedef, chunks)
+
+    return flat, unravel

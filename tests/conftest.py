@@ -1,0 +1,60 @@
+"""Test harness: a virtual 8-device CPU mesh on a single host.
+
+This is the TPU-framework analog of the reference's ``DistributedTestBase``
+(``apex/transformer/testing/distributed_test_base.py:9-60``), which spawns one
+NCCL process per local GPU. JAX needs no processes: forcing 8 host-platform
+devices gives every test a real 8-way mesh with real collectives.
+
+Must set the env vars before jax initializes its backends, hence the
+module-level code in conftest (imported by pytest before test modules).
+"""
+
+import os
+
+# Force CPU regardless of ambient JAX_PLATFORMS (the dev box tunnels one real
+# TPU chip; tests need the 8-device virtual mesh). Set APEX_TPU_TEST_ON_TPU=1
+# to run the suite on real hardware instead.
+if not os.environ.get("APEX_TPU_TEST_ON_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+if not os.environ.get("APEX_TPU_TEST_ON_TPU"):
+    # The axon site config re-selects the TPU platform after import; the
+    # config update below wins over both it and JAX_PLATFORMS.
+    jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture
+def mesh8():
+    """A dp=8 mesh, the default decomposition for DP tests."""
+    from apex_tpu.parallel import mesh as mesh_lib
+
+    m = mesh_lib.initialize_model_parallel(1, 1)
+    yield m
+    mesh_lib.destroy_model_parallel()
+
+
+@pytest.fixture
+def mesh_tp4_dp2():
+    from apex_tpu.parallel import mesh as mesh_lib
+
+    m = mesh_lib.initialize_model_parallel(tensor_model_parallel_size=4)
+    yield m
+    mesh_lib.destroy_model_parallel()
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_mesh():
+    yield
+    from apex_tpu.parallel import mesh as mesh_lib
+
+    mesh_lib.destroy_model_parallel()
+
+
+def assert_devices(n: int = 8):
+    assert jax.device_count() >= n, f"expected >= {n} devices, got {jax.device_count()}"

@@ -1,0 +1,152 @@
+"""AMP policy-engine + loss-scaler tests.
+
+Coverage model: the reference's ``tests/L0/run_amp`` suite —
+``test_basic_casts.py`` (per-level cast behavior), ``test_promotion.py``
+(O1 per-op rules), ``test_checkpointing.py`` (scaler state dicts), plus the
+dynamic-scaler protocol from ``apex/amp/scaler.py:197-217``.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp import lists as amp_lists
+
+
+def params():
+    return {"w": jnp.ones((4, 4), jnp.float32), "b": jnp.zeros((4,), jnp.float32),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+class TestPolicy:
+    def test_levels(self):
+        assert amp.O0.compute_dtype == jnp.float32
+        assert amp.O1.compute_dtype == jnp.bfloat16 and amp.O1.param_dtype == jnp.float32
+        assert amp.O2.master_weights and amp.O2.param_dtype == jnp.bfloat16
+        assert amp.O3.compute_dtype == jnp.bfloat16 and not amp.O3.keep_norm_f32
+
+    def test_cast_skips_non_float(self):
+        p = amp.O2.cast_to_compute(params())
+        assert p["w"].dtype == jnp.bfloat16
+        assert p["step"].dtype == jnp.int32  # ints untouched
+
+    def test_get_policy_overrides(self):
+        p = amp.get_policy("O2", keep_norm_f32=False)
+        assert not p.keep_norm_f32
+        p16 = amp.get_policy("O3", half_dtype=jnp.float16)
+        assert p16.compute_dtype == jnp.float16
+        with pytest.raises(ValueError):
+            amp.get_policy("O1", master_weights=True)
+        with pytest.raises(ValueError):
+            amp.get_policy("O5")
+
+    def test_run_casts_output(self):
+        out = amp.O2.run(lambda p, x: x @ p["w"], params(), jnp.ones((2, 4)))
+        assert out.dtype == jnp.float32  # output cast back
+
+    def test_ambient_policy(self):
+        assert amp.current_policy().name == "O0"
+        with amp.with_policy(amp.O2):
+            assert amp.current_policy().name == "O2"
+        assert amp.current_policy().name == "O0"
+
+    def test_op_cast_rules(self):
+        assert amp_lists.op_cast_dtype("matmul", amp.O1) == jnp.bfloat16
+        assert amp_lists.op_cast_dtype("softmax", amp.O1) == jnp.float32
+        # promote: widest input wins
+        assert amp_lists.op_cast_dtype("add", amp.O1, jnp.bfloat16, jnp.float32) == jnp.float32
+        # non-per-op policy: everything in compute dtype
+        assert amp_lists.op_cast_dtype("softmax", amp.O2) == jnp.bfloat16
+        with pytest.raises(RuntimeError):
+            amp_lists.op_cast_dtype("binary_cross_entropy", amp.O1)
+
+
+class TestLossScaler:
+    def test_static_scale(self):
+        s = amp.init_loss_scaler(128.0)
+        assert not s.dynamic
+        assert float(s.loss_scale) == 128.0
+        s2 = amp.update_loss_scaler(s, jnp.asarray(False))
+        assert float(s2.loss_scale) == 128.0  # static never moves
+        assert int(s2.skipped_steps) == 1  # overflow still counted
+
+    def test_dynamic_backoff_and_growth(self):
+        s = amp.init_loss_scaler("dynamic", init_scale=2.0 ** 16, growth_interval=2)
+        s = amp.update_loss_scaler(s, jnp.asarray(False))
+        assert float(s.loss_scale) == 2.0 ** 15  # halved on overflow
+        assert int(s.skipped_steps) == 1
+        s = amp.update_loss_scaler(s, jnp.asarray(True))
+        s = amp.update_loss_scaler(s, jnp.asarray(True))
+        assert float(s.loss_scale) == 2.0 ** 16  # doubled after interval
+        assert int(s.growth_tracker) == 0
+
+    def test_bounds(self):
+        s = amp.init_loss_scaler("dynamic", init_scale=1.5, min_loss_scale=1.0)
+        s = amp.update_loss_scaler(s, jnp.asarray(False))
+        s = amp.update_loss_scaler(s, jnp.asarray(False))
+        assert float(s.loss_scale) == 1.0
+        s = dataclasses.replace(s, loss_scale=jnp.asarray(2.0 ** 24, jnp.float32),
+                                growth_tracker=jnp.asarray(1999, jnp.int32))
+        s = amp.update_loss_scaler(s, jnp.asarray(True))
+        assert float(s.loss_scale) == 2.0 ** 24  # clamped at max
+
+    def test_scaled_value_and_grad(self):
+        p = {"w": jnp.asarray([2.0, 3.0])}
+        loss_fn = lambda p, x: jnp.sum(p["w"] * x)  # noqa: E731
+        g = amp.scaled_value_and_grad(loss_fn)
+        scaler = amp.init_loss_scaler("dynamic", init_scale=1024.0)
+        x = jnp.asarray([1.0, 2.0])
+        loss, (grads, finite, new_scaler) = jax.jit(g)(scaler, p, x)
+        np.testing.assert_allclose(loss, 8.0)
+        np.testing.assert_allclose(grads["w"], [1.0, 2.0])  # unscaled
+        assert bool(finite)
+
+    def test_overflow_detection_and_skip(self):
+        p = {"w": jnp.asarray([2.0])}
+        loss_fn = lambda p, x: jnp.sum(p["w"] * x)  # noqa: E731
+        g = amp.scaled_value_and_grad(loss_fn)
+        scaler = amp.init_loss_scaler("dynamic", init_scale=2.0 ** 16)
+        x = jnp.asarray([jnp.inf])
+        _, (grads, finite, new_scaler) = g(scaler, p, x)
+        assert not bool(finite)
+        assert float(new_scaler.loss_scale) == 2.0 ** 15
+        stepped = amp.apply_if_finite(p, {"w": p["w"] - grads["w"]}, finite)
+        np.testing.assert_allclose(stepped["w"], p["w"])  # skipped
+
+    def test_state_dict_roundtrip(self):
+        s = amp.init_loss_scaler("dynamic")
+        s = amp.update_loss_scaler(s, jnp.asarray(False))
+        payload = amp.state_dict(s)
+        restored = amp.load_state_dict(amp.init_loss_scaler("dynamic"), payload)
+        assert float(restored.loss_scale) == float(s.loss_scale)
+        assert int(restored.skipped_steps) == 1
+
+    def test_scaler_state_jits(self):
+        s = amp.init_loss_scaler("dynamic")
+
+        @jax.jit
+        def step(s, finite):
+            return amp.update_loss_scaler(s, finite)
+
+        s2 = step(s, jnp.asarray(True))
+        assert int(s2.growth_tracker) == 1
+
+
+class TestMasterWeights:
+    def test_o2_roundtrip(self):
+        from apex_tpu.amp import MasterWeights, apply_updates_with_master
+
+        w = MasterWeights.create({"w": jnp.ones((4,), jnp.bfloat16)}, amp.O2)
+        assert w.master["w"].dtype == jnp.float32
+        assert w.model["w"].dtype == jnp.bfloat16
+        # tiny update visible in fp32 master but below bf16 resolution
+        w2 = apply_updates_with_master(w, {"w": jnp.full((4,), 1e-4)})
+        assert float(w2.master["w"][0]) == pytest.approx(1.0001)
+        # skip path
+        w3 = apply_updates_with_master(w, {"w": jnp.full((4,), 1.0)},
+                                       grads_finite=jnp.asarray(False))
+        np.testing.assert_allclose(np.asarray(w3.master["w"]), 1.0)

@@ -1,0 +1,57 @@
+"""Mesh/parallel-state tests, mirroring the reference's
+``tests/L0/run_transformer/test_parallel_state.py`` coverage: initialization,
+divisibility validation, accessor values, teardown."""
+
+import jax
+import numpy as np
+import pytest
+
+from apex_tpu.parallel import mesh as mesh_lib
+
+
+def test_initialize_and_accessors():
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=2,
+                                       pipeline_model_parallel_size=2)
+    assert mesh_lib.model_parallel_is_initialized()
+    assert mesh_lib.get_tensor_model_parallel_world_size() == 2
+    assert mesh_lib.get_pipeline_model_parallel_world_size() == 2
+    assert mesh_lib.get_data_parallel_world_size() == 2
+    assert mesh_lib.get_context_parallel_world_size() == 1
+    mesh = mesh_lib.get_mesh()
+    assert mesh.axis_names == ("dp", "pp", "cp", "tp")
+    assert mesh.shape["tp"] == 2 and mesh.shape["dp"] == 2
+
+
+def test_invalid_world_size():
+    with pytest.raises(RuntimeError):
+        mesh_lib.initialize_model_parallel(tensor_model_parallel_size=3)
+
+
+def test_virtual_pipeline_requires_pp():
+    with pytest.raises(ValueError):
+        mesh_lib.MeshSpec(pipeline_model_parallel_size=1,
+                          virtual_pipeline_model_parallel_size=2)
+
+
+def test_destroy():
+    mesh_lib.initialize_model_parallel()
+    mesh_lib.destroy_model_parallel()
+    assert not mesh_lib.model_parallel_is_initialized()
+    with pytest.raises(RuntimeError):
+        mesh_lib.get_mesh()
+
+
+def test_axis_rank_inside_shard_map():
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mesh_lib.initialize_model_parallel(tensor_model_parallel_size=4)
+
+    def f(x):
+        return x + jax.lax.axis_index("tp").astype(x.dtype)
+
+    x = np.zeros((8, 4), np.float32)
+    out = jax.jit(
+        shard_map(f, mesh=mesh, in_specs=P(None, "tp"), out_specs=P(None, "tp"))
+    )(x)
+    np.testing.assert_allclose(out[0], [0, 1, 2, 3])

@@ -1,0 +1,194 @@
+"""Data-parallel stack tests: grad all-reduce semantics, SyncBatchNorm vs
+reference batch-norm on the full batch, LARC arithmetic.
+
+Coverage model: ``tests/distributed/synced_batchnorm/`` (SyncBN vs single-GPU
+BN over the gathered batch) and ``tests/L0/run_amp/test_larc.py``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel import (
+    BatchNormState,
+    all_reduce_gradients,
+    larc,
+    sync_batchnorm,
+)
+from apex_tpu.parallel.sync_batchnorm import sync_batch_norm
+
+
+class TestAllReduceGradients:
+    def run_reduce(self, mesh, **kwargs):
+        grads = {"w": np.arange(8, dtype=np.float32).reshape(8, 1)}
+
+        def f(g):
+            return all_reduce_gradients(g, **kwargs)
+
+        return jax.jit(
+            shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+        )(grads)
+
+    def test_average(self, mesh8):
+        out = self.run_reduce(mesh8)
+        np.testing.assert_allclose(np.asarray(out["w"]).ravel(), np.full(8, 3.5))
+
+    def test_sum(self, mesh8):
+        out = self.run_reduce(mesh8, gradient_average=False)
+        np.testing.assert_allclose(np.asarray(out["w"]).ravel(), np.full(8, 28.0))
+
+    def test_predivide(self, mesh8):
+        out = self.run_reduce(mesh8, gradient_predivide_factor=2.0)
+        np.testing.assert_allclose(np.asarray(out["w"]).ravel(), np.full(8, 3.5),
+                                   rtol=1e-6)
+
+    def test_always_fp32_preserves_dtype(self, mesh8):
+        grads = {"w": np.ones((8, 1), np.float16)}
+
+        def f(g):
+            return all_reduce_gradients(g, allreduce_always_fp32=True)
+
+        out = jax.jit(
+            shard_map(f, mesh=mesh8, in_specs=P("dp"), out_specs=P("dp"))
+        )(grads)
+        assert out["w"].dtype == jnp.float16
+
+
+class TestSyncBatchNorm:
+    def test_matches_global_bn(self, mesh8):
+        """SyncBN over dp shards == plain BN over the gathered batch — the
+        core invariant of tests/distributed/synced_batchnorm."""
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 4, 4, 3).astype(np.float32)
+        scale = rng.rand(3).astype(np.float32) + 0.5
+        bias = rng.randn(3).astype(np.float32)
+        state = BatchNormState.create(3)
+
+        def f(x):
+            y, new_state = sync_batch_norm(
+                x, jnp.asarray(scale), jnp.asarray(bias), state, axis_name="dp"
+            )
+            return y, new_state
+
+        y, new_state = jax.jit(
+            shard_map(f, mesh=mesh8, in_specs=P("dp"), out_specs=(P("dp"), P()))
+        )(x)
+
+        # reference: plain batch norm over the whole batch
+        mean = x.reshape(-1, 3).mean(0)
+        var = x.reshape(-1, 3).var(0)
+        ref = (x - mean) / np.sqrt(var + 1e-5) * scale + bias
+        np.testing.assert_allclose(np.asarray(y), ref, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(new_state.running_mean), 0.1 * mean,
+                                   atol=1e-5)
+
+    def test_eval_uses_running_stats(self):
+        x = np.random.RandomState(1).randn(4, 3).astype(np.float32)
+        state = BatchNormState(
+            running_mean=jnp.asarray([1.0, 2.0, 3.0]),
+            running_var=jnp.asarray([4.0, 4.0, 4.0]),
+            num_batches_tracked=jnp.asarray(5, jnp.int32),
+        )
+        y, new_state = sync_batch_norm(jnp.asarray(x), None, None, state,
+                                       training=False, axis_name=None)
+        ref = (x - np.array([1, 2, 3])) / np.sqrt(4 + 1e-5)
+        np.testing.assert_allclose(np.asarray(y), ref, atol=1e-5)
+        assert int(new_state.num_batches_tracked) == 5
+
+    def test_fused_relu_residual(self):
+        x = jnp.asarray(np.random.RandomState(2).randn(8, 3), jnp.float32)
+        res = jnp.ones((8, 3), jnp.float32) * -10.0
+        state = BatchNormState.create(3)
+        y, _ = sync_batch_norm(x, None, None, state, axis_name=None,
+                               fuse_relu=True, residual=res)
+        assert float(jnp.min(y)) == 0.0  # relu clamped everything (res=-10)
+
+    def test_large_mean_small_std_stable(self):
+        """Centered variance: no catastrophic cancellation for mean>>std data,
+        even when the policy computes norms in half precision (the property
+        the reference's Welford kernels guarantee)."""
+        from apex_tpu import amp
+
+        x = jnp.asarray(
+            100.0 + 0.01 * np.random.RandomState(0).randn(64, 8), jnp.float32
+        )
+        with amp.with_policy(amp.O3):
+            y, _ = sync_batch_norm(x, None, None, BatchNormState.create(8),
+                                   axis_name=None)
+        assert y.dtype == jnp.bfloat16  # O3: output in compute dtype
+        std = float(jnp.std(y.astype(jnp.float32)))
+        assert 0.5 < std < 2.0 and np.isfinite(std)
+
+    def test_grad_matches_global_bn(self, mesh8):
+        """Backward reduction falls out of autodiff — cross-check vs the
+        single-device gradient (the reference hand-writes this path,
+        optimized_sync_batchnorm_kernel.py:74-119)."""
+        rng = np.random.RandomState(3)
+        x = rng.randn(16, 3).astype(np.float32)
+        state = BatchNormState.create(3)
+
+        def local_loss(x):
+            y, _ = sync_batch_norm(x, None, None, state, axis_name="dp")
+            return y
+
+        def sharded_loss(x):
+            y = local_loss(x)
+            return jax.lax.psum(jnp.sum(y ** 2), "dp")
+
+        grad_sharded = jax.jit(
+            shard_map(jax.grad(sharded_loss), mesh=mesh8,
+                      in_specs=P("dp"), out_specs=P("dp"))
+        )(x)
+
+        def global_loss(x):
+            y, _ = sync_batch_norm(x, None, None, state, axis_name=None)
+            return jnp.sum(y ** 2)
+
+        grad_global = jax.grad(global_loss)(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(grad_sharded),
+                                   np.asarray(grad_global), atol=1e-4)
+
+
+class TestLARC:
+    def test_clip_mode_scales_small_trust(self):
+        params = {"w": jnp.asarray([10.0, 0.0])}
+        grads = {"w": jnp.asarray([1.0, 1.0])}
+        tx = larc(learning_rate=1.0, trust_coefficient=0.02)
+        state = tx.init(params)
+        updates, _ = tx.update(grads, state, params)
+        # adaptive_lr = 0.02*10/sqrt(2) ≈ 0.1414 < lr=1 → grads scaled by it
+        expected = 0.02 * 10.0 / np.sqrt(2.0)
+        np.testing.assert_allclose(np.asarray(updates["w"]),
+                                   expected * np.ones(2), rtol=1e-5)
+
+    def test_clip_mode_caps_at_one(self):
+        params = {"w": jnp.asarray([1000.0])}
+        grads = {"w": jnp.asarray([0.001])}
+        tx = larc(learning_rate=1.0)
+        updates, _ = tx.update(grads, tx.init(params), params)
+        np.testing.assert_allclose(np.asarray(updates["w"]), [0.001])  # factor 1
+
+    def test_zero_param_untouched(self):
+        params = {"w": jnp.zeros((2,))}
+        grads = {"w": jnp.asarray([1.0, 2.0])}
+        tx = larc(learning_rate=1.0)
+        updates, _ = tx.update(grads, tx.init(params), params)
+        np.testing.assert_allclose(np.asarray(updates["w"]), [1.0, 2.0])
+
+    def test_chained_with_sgd(self):
+        params = {"w": jnp.asarray([10.0, 10.0])}
+        tx = optax.chain(larc(learning_rate=0.1), optax.sgd(0.1))
+        state = tx.init(params)
+        grads = {"w": jnp.asarray([1.0, 1.0])}
+        updates, state = tx.update(grads, state, params)
+        new_params = optax.apply_updates(params, updates)
+        assert np.all(np.asarray(new_params["w"]) < 10.0)
+
+    def test_requires_params(self):
+        tx = larc()
+        with pytest.raises(ValueError):
+            tx.update({"w": jnp.ones(2)}, tx.init({"w": jnp.ones(2)}), None)
