@@ -1,0 +1,32 @@
+"""Fused optimizers over the chunked multi-tensor layout.
+
+TPU-native equivalent of ``apex.optimizers``
+(``apex/optimizers/__init__.py:1-6``): FusedAdam, FusedLAMB, FusedSGD,
+FusedNovoGrad, FusedAdagrad, FusedMixedPrecisionLamb — each an
+optax-compatible ``GradientTransformation`` whose update is a single fused
+pass over a chunked flat parameter buffer (see
+:mod:`apex_tpu.optimizers.multi_tensor`).
+"""
+
+from apex_tpu.optimizers.multi_tensor import (  # noqa: F401
+    ChunkLayout,
+    make_layout,
+    flatten_to_chunks,
+    unflatten_from_chunks,
+    per_tensor_sqnorm,
+    per_tensor_maxnorm,
+    broadcast_per_tensor,
+    global_norm,
+    multi_tensor_scale,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+)
+from apex_tpu.optimizers.fused_adam import fused_adam, FusedAdam  # noqa: F401
+from apex_tpu.optimizers.fused_sgd import fused_sgd, FusedSGD  # noqa: F401
+from apex_tpu.optimizers.fused_lamb import fused_lamb, FusedLAMB  # noqa: F401
+from apex_tpu.optimizers.fused_novograd import fused_novograd, FusedNovoGrad  # noqa: F401
+from apex_tpu.optimizers.fused_adagrad import fused_adagrad, FusedAdagrad  # noqa: F401
+from apex_tpu.optimizers.fused_mixed_precision_lamb import (  # noqa: F401
+    fused_mixed_precision_lamb,
+    FusedMixedPrecisionLamb,
+)

@@ -1,0 +1,93 @@
+"""Shared scaffolding for the fused optimizers.
+
+Every fused optimizer follows the reference's shape
+(``apex/optimizers/fused_adam.py:98-171``): collect all params into flat
+lists, run ONE fused update over them, write results back. Here the flat list
+is the chunked buffer of :mod:`apex_tpu.optimizers.multi_tensor`, the fused
+update is a pure function ``(g2d, p2d, state2d..., count) -> (new_p2d,
+new_state2d...)`` that XLA compiles to a single fused loop, and the write-back
+is the unflatten. Each optimizer exposes an optax-compatible
+``GradientTransformation`` so it chains with schedules/clipping like any other.
+
+Math is fp32 regardless of param dtype (``MATH_T = float`` in every reference
+kernel, e.g. ``csrc/multi_tensor_adam.cu``); updates are cast back to each
+param's dtype at unflatten.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers import multi_tensor as mt
+
+PyTree = Any
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class FusedState:
+    """Optimizer state held in the chunked layout."""
+
+    count: jax.Array                 # i32 step counter
+    layout: mt.ChunkLayout
+    buffers: Dict[str, jax.Array]    # name -> (n_chunks, chunk) f32 buffers
+    scalars: Dict[str, jax.Array]    # name -> per-tensor f32 vectors (novograd)
+
+
+def schedule_value(lr, count):
+    """Evaluate a schedule at the optax convention (0-based step): ``count``
+    here is the post-increment 1-based counter kernels use for bias
+    correction, so schedules see ``count - 1``."""
+    return lr(count - 1) if callable(lr) else jnp.asarray(lr, jnp.float32)
+
+
+def make_fused_transform(
+    *,
+    state_buffers: tuple,
+    kernel: Callable[..., tuple],
+    state_scalars: tuple = (),
+    chunk_size: int = mt.DEFAULT_CHUNK,
+) -> optax.GradientTransformation:
+    """Build a GradientTransformation from a chunked update ``kernel``.
+
+    ``kernel(g2d, p2d, buffers, scalars, count, layout) -> (new_p2d,
+    new_buffers, new_scalars)``. The transformation's ``update`` returns
+    optax-style additive updates (``new_p - p``) in each param's dtype.
+    """
+
+    def init_fn(params):
+        layout = mt.make_layout(params, chunk_size)
+        n_chunks = int(layout.chunk_to_tensor.shape[0])
+        buffers = {
+            name: jnp.zeros((n_chunks, layout.chunk_size), jnp.float32)
+            for name in state_buffers
+        }
+        scalars = {
+            name: jnp.zeros((layout.n_tensors,), jnp.float32) for name in state_scalars
+        }
+        return FusedState(
+            count=jnp.zeros((), jnp.int32), layout=layout, buffers=buffers, scalars=scalars
+        )
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused optimizers require params")
+        layout = state.layout
+        g2d, _ = mt.flatten_to_chunks(grads, layout)
+        p2d, _ = mt.flatten_to_chunks(params, layout)
+        count = state.count + 1
+        new_p2d, new_buffers, new_scalars = kernel(
+            g2d, p2d, state.buffers, state.scalars, count, layout
+        )
+        updates = mt.unflatten_from_chunks(new_p2d - p2d, layout, like=params)
+        new_state = FusedState(
+            count=count, layout=layout, buffers=new_buffers, scalars=new_scalars
+        )
+        return updates, new_state
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,87 @@
+"""Fused mixed-precision LAMB.
+
+Re-design of ``apex.optimizers.FusedMixedPrecisionLamb``
+(``apex/optimizers/fused_mixed_precision_lamb.py``): LAMB that holds fp32
+master params + fp32 moments in *optimizer state* while the model trains in
+bf16/fp16. The reference keeps ``model_params`` and ``master_params`` lists
+and runs the kernel on the masters (``lamb_mp`` kernel,
+``csrc/multi_tensor_lamb_mp.cu``); the returned update here is
+``cast(new_master) - model_param``, so ``optax.apply_updates`` lands the model
+exactly on the re-cast master — no drift between the two copies.
+
+Also supports the reference's tensor-valued hyperparameters (lr/step as
+device scalars) simply because every hyperparameter is traced.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers import multi_tensor as mt
+from apex_tpu.optimizers.fused_lamb import lamb_chunked_update
+
+PyTree = Any
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MixedPrecisionLambState:
+    count: jax.Array
+    layout: mt.ChunkLayout
+    master: jax.Array              # fp32 master params, chunked
+    m: jax.Array
+    v: jax.Array
+
+
+def fused_mixed_precision_lamb(
+    learning_rate=1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+    bias_correction: bool = True,
+    grad_averaging: bool = True,
+    max_grad_norm: float = 1.0,
+    use_nvlamb: bool = False,
+    chunk_size: int = mt.DEFAULT_CHUNK,
+) -> optax.GradientTransformation:
+    def init_fn(params):
+        layout = mt.make_layout(params, chunk_size)
+        master, _ = mt.flatten_to_chunks(params, layout)  # fp32 copy
+        zeros = jnp.zeros_like(master)
+        return MixedPrecisionLambState(
+            count=jnp.zeros((), jnp.int32), layout=layout,
+            master=master, m=zeros, v=jnp.zeros_like(master),
+        )
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_mixed_precision_lamb requires params")
+        layout = state.layout
+        g, _ = mt.flatten_to_chunks(grads, layout)
+        count = state.count + 1
+        # identical math to fused_lamb, run on the fp32 masters
+        new_master, m, v = lamb_chunked_update(
+            g, state.master, state.m, state.v, count, layout,
+            learning_rate=learning_rate, b1=b1, b2=b2, eps=eps,
+            weight_decay=weight_decay, bias_correction=bias_correction,
+            grad_averaging=grad_averaging, max_grad_norm=max_grad_norm,
+            use_nvlamb=use_nvlamb,
+        )
+
+        # updates land the half-precision model exactly on cast(master)
+        new_model = mt.unflatten_from_chunks(new_master, layout, like=params)
+        updates = jax.tree.map(lambda n, o: (n - o).astype(o.dtype), new_model, params)
+        return updates, MixedPrecisionLambState(
+            count=count, layout=layout, master=new_master, m=m, v=v
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+FusedMixedPrecisionLamb = fused_mixed_precision_lamb

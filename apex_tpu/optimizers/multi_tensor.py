@@ -1,0 +1,174 @@
+"""The multi-tensor engine: chunked flat parameter layout.
+
+TPU-native re-design of ``apex.multi_tensor_apply`` + ``amp_C``
+(``apex/multi_tensor_apply/multi_tensor_apply.py:27-34``,
+``csrc/multi_tensor_apply.cuh:41-133``). The reference batches up to 110
+tensor pointers per kernel launch so one CUDA kernel updates every parameter.
+On TPU the equivalent is a *layout*, not a launcher: all tensors of one dtype
+are packed into a single 2-D buffer of shape ``(n_chunks, chunk_size)``,
+where every tensor owns an integer number of chunks (zero-padded tail). Then:
+
+* elementwise ops (scale/axpby/adam/sgd) are single fused XLA loops over one
+  contiguous buffer — no per-tensor dispatch at all;
+* per-tensor reductions (LAMB trust ratios, NovoGrad norms) become a chunk
+  reduction (axis 1) followed by a tiny ``segment_sum`` over the
+  chunk→tensor map — the same two-level reduction the CUDA kernels do with
+  per-block partials;
+* per-tensor scalars broadcast back via one gather over the chunk map.
+
+``chunk_size`` defaults to 1024 (lane-dim multiple of 128; the reference uses
+2048*32 elements per chunk, ``apex/multi_tensor_apply/__init__.py:3``).
+
+This layout is also the substrate for ZeRO-style sharding: the flat buffer
+partitions evenly over the ``dp`` axis (cf. ``DistributedFusedLAMB``'s
+block/chunk/shard scheme, ``apex/contrib/optimizers/distributed_fused_lamb.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+DEFAULT_CHUNK = 1024
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ChunkLayout:
+    """Static description of how a pytree packs into the chunked buffer."""
+
+    chunk_to_tensor: jax.Array  # i32[n_chunks] — which tensor owns each chunk
+    treedef: Any = dataclasses.field(metadata=dict(static=True), default=None)
+    shapes: Tuple[Tuple[int, ...], ...] = dataclasses.field(
+        metadata=dict(static=True), default=()
+    )
+    chunk_size: int = dataclasses.field(metadata=dict(static=True), default=DEFAULT_CHUNK)
+
+    @property
+    def n_tensors(self) -> int:
+        return len(self.shapes)
+
+    @property
+    def sizes(self) -> Tuple[int, ...]:
+        return tuple(int(np.prod(s)) for s in self.shapes)
+
+
+def make_layout(tree: PyTree, chunk_size: int = DEFAULT_CHUNK) -> ChunkLayout:
+    leaves, treedef = jax.tree.flatten(tree)
+    shapes = tuple(tuple(x.shape) for x in leaves)
+    chunk_counts = [max(1, -(-int(np.prod(s)) // chunk_size)) for s in shapes]
+    chunk_to_tensor = np.repeat(np.arange(len(shapes), dtype=np.int32), chunk_counts)
+    return ChunkLayout(
+        chunk_to_tensor=jnp.asarray(chunk_to_tensor),
+        treedef=treedef,
+        shapes=shapes,
+        chunk_size=chunk_size,
+    )
+
+
+def flatten_to_chunks(
+    tree: PyTree, layout: ChunkLayout | None = None, *, dtype=jnp.float32
+) -> Tuple[jax.Array, ChunkLayout]:
+    """Pack a pytree into the ``(n_chunks, chunk_size)`` buffer (math dtype
+    fp32 by default, matching the kernels' ``MATH_T = float``,
+    ``csrc/multi_tensor_lamb.cu:38``)."""
+    if layout is None:
+        layout = make_layout(tree)
+    leaves = jax.tree.leaves(tree)
+    c = layout.chunk_size
+    parts = []
+    for x in leaves:
+        flat = jnp.reshape(jnp.asarray(x, dtype), (-1,))
+        pad = (-flat.size) % c if flat.size else c
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        parts.append(flat)
+    buf = jnp.concatenate(parts).reshape(-1, c)
+    return buf, layout
+
+
+def unflatten_from_chunks(buf: jax.Array, layout: ChunkLayout, like: PyTree = None) -> PyTree:
+    """Unpack back to the original pytree structure; if ``like`` is given,
+    each leaf is cast to the corresponding leaf's dtype."""
+    flat = buf.reshape(-1)
+    c = layout.chunk_size
+    out = []
+    offset = 0
+    for shape, size in zip(layout.shapes, layout.sizes):
+        out.append(jnp.reshape(flat[offset : offset + size], shape))
+        offset += max(1, -(-size // c)) * c
+    tree = jax.tree.unflatten(layout.treedef, out)
+    if like is not None:
+        tree = jax.tree.map(lambda o, l: o.astype(l.dtype), tree, like)
+    return tree
+
+
+# --- per-tensor reductions over the chunked buffer ---------------------------
+
+def per_tensor_sqnorm(buf: jax.Array, layout: ChunkLayout) -> jax.Array:
+    """Squared L2 norm of every tensor in one pass: chunk partials + segment
+    combine (cf. two-stage reduction in ``multi_tensor_l2norm_kernel.cu``)."""
+    chunk_sq = jnp.sum(buf * buf, axis=1)
+    return jax.ops.segment_sum(
+        chunk_sq, layout.chunk_to_tensor, num_segments=layout.n_tensors
+    )
+
+
+def per_tensor_maxnorm(buf: jax.Array, layout: ChunkLayout) -> jax.Array:
+    """Per-tensor infinity norm (NovoGrad ``norm_type=0``)."""
+    chunk_max = jnp.max(jnp.abs(buf), axis=1)
+    return jax.ops.segment_max(
+        chunk_max, layout.chunk_to_tensor, num_segments=layout.n_tensors
+    )
+
+
+def broadcast_per_tensor(vals: jax.Array, layout: ChunkLayout) -> jax.Array:
+    """Expand per-tensor scalars to ``(n_chunks, 1)`` for elementwise use."""
+    return vals[layout.chunk_to_tensor][:, None]
+
+
+def global_norm(buf: jax.Array) -> jax.Array:
+    return jnp.sqrt(jnp.sum(buf * buf))
+
+
+# --- pytree-level multi-tensor ops (API parity with amp_C) -------------------
+
+def multi_tensor_scale(tree: PyTree, scale: jax.Array | float) -> Tuple[PyTree, jax.Array]:
+    """Scaled copy + fused non-finite detection — ``amp_C.multi_tensor_scale``
+    (``csrc/multi_tensor_scale_kernel.cu``). Returns (scaled, all_finite)."""
+    from apex_tpu.utils.pytree import tree_all_finite
+
+    scaled = jax.tree.map(lambda x: jnp.asarray(x, jnp.float32) * scale, tree)
+    return scaled, tree_all_finite(scaled)
+
+
+def multi_tensor_axpby(
+    x_tree: PyTree, y_tree: PyTree, a: float | jax.Array = 1.0, b: float | jax.Array = 1.0
+) -> Tuple[PyTree, jax.Array]:
+    """``out = a*x + b*y`` with non-finite detection —
+    ``amp_C.multi_tensor_axpby`` (``csrc/multi_tensor_axpby_kernel.cu``)."""
+    from apex_tpu.utils.pytree import tree_all_finite
+
+    out = jax.tree.map(
+        lambda x, y: a * jnp.asarray(x, jnp.float32) + b * jnp.asarray(y, jnp.float32),
+        x_tree,
+        y_tree,
+    )
+    return out, tree_all_finite(out)
+
+
+def multi_tensor_l2norm(tree: PyTree, *, per_tensor: bool = False):
+    """Global (and optionally per-tensor) L2 norm —
+    ``amp_C.multi_tensor_l2norm`` (``csrc/multi_tensor_l2norm_kernel.cu``)."""
+    buf, layout = flatten_to_chunks(tree)
+    sq = per_tensor_sqnorm(buf, layout)
+    total = jnp.sqrt(jnp.sum(sq))
+    if per_tensor:
+        return total, jnp.sqrt(sq)
+    return total

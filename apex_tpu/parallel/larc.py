@@ -60,10 +60,11 @@ def larc(
                 factor = jnp.minimum(adaptive_lr / lr, 1.0)
             else:
                 factor = adaptive_lr
-            # untouched when either norm is zero, as the reference guards
-            factor = jnp.where((param_norm > 0) & (grad_norm > 0), factor, 1.0)
-            g32 = g32 + weight_decay * p32
-            return (g32 * factor).astype(g.dtype)
+            # reference applies BOTH decay and scaling only when neither norm
+            # is zero (LARC.py:92-102); otherwise the grad passes untouched
+            mask = (param_norm > 0) & (grad_norm > 0)
+            adapted = (g32 + weight_decay * p32) * factor
+            return jnp.where(mask, adapted, g32).astype(g.dtype)
 
         new_updates = jax.tree.map(scale_one, updates, params)
         return new_updates, optax.ScaleByScheduleState(count=state.count + 1)

@@ -1,0 +1,304 @@
+"""Fused-optimizer tests.
+
+Coverage model: ``tests/L0/run_optimizers/test_fused_optimizer.py`` (fused vs
+torch.optim reference at tight tolerance), ``test_lamb.py`` (vs a Python
+reference LAMB), plus the multi-tensor chunk-layout machinery and the amp
+multi-tensor kernel tests (``tests/L0/run_amp/test_multi_tensor_*.py``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import optimizers as opt
+from apex_tpu.optimizers import multi_tensor as mt
+
+
+def make_params(seed=0, dtypes=(jnp.float32,)):
+    rng = np.random.RandomState(seed)
+    return {
+        f"layer{i}": {
+            "w": jnp.asarray(rng.randn(7, 13), dt),
+            "b": jnp.asarray(rng.randn(13), dt),
+        }
+        for i, dt in enumerate(dtypes * 2)
+    }
+
+
+def make_grads(params, seed=1):
+    rng = np.random.RandomState(seed)
+    return jax.tree.map(lambda p: jnp.asarray(rng.randn(*p.shape), p.dtype), params)
+
+
+class TestChunkLayout:
+    def test_roundtrip(self):
+        params = make_params()
+        buf, layout = mt.flatten_to_chunks(params)
+        assert buf.shape[1] == mt.DEFAULT_CHUNK
+        back = mt.unflatten_from_chunks(buf, layout, like=params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            params, back,
+        )
+
+    def test_multi_chunk_tensor(self):
+        params = {"big": jnp.arange(3000, dtype=jnp.float32), "small": jnp.ones((3,))}
+        buf, layout = mt.flatten_to_chunks(params)
+        assert buf.shape[0] == 4  # 3 chunks for big + 1 for small
+        np.testing.assert_array_equal(np.asarray(layout.chunk_to_tensor), [0, 0, 0, 1])
+        back = mt.unflatten_from_chunks(buf, layout)
+        np.testing.assert_array_equal(np.asarray(back["big"]), np.arange(3000))
+
+    def test_per_tensor_sqnorm(self):
+        params = {"a": jnp.full((2000,), 2.0), "b": jnp.full((10,), 3.0)}
+        buf, layout = mt.flatten_to_chunks(params)
+        sq = mt.per_tensor_sqnorm(buf, layout)
+        np.testing.assert_allclose(np.asarray(sq), [4.0 * 2000, 9.0 * 10])
+
+    def test_per_tensor_maxnorm(self):
+        params = {"a": jnp.asarray([-5.0, 1.0]), "b": jnp.asarray([0.5, -0.1])}
+        buf, layout = mt.flatten_to_chunks(params)
+        np.testing.assert_allclose(np.asarray(mt.per_tensor_maxnorm(buf, layout)),
+                                   [5.0, 0.5])
+
+    def test_mixed_dtype_cast_back(self):
+        params = {"h": jnp.ones((4,), jnp.bfloat16), "f": jnp.ones((4,), jnp.float32)}
+        buf, layout = mt.flatten_to_chunks(params)
+        assert buf.dtype == jnp.float32
+        back = mt.unflatten_from_chunks(buf, layout, like=params)
+        assert back["h"].dtype == jnp.bfloat16 and back["f"].dtype == jnp.float32
+
+
+class TestMultiTensorOps:
+    def test_scale_detects_inf(self):
+        tree = {"a": jnp.asarray([1.0, jnp.inf])}
+        scaled, finite = mt.multi_tensor_scale(tree, 0.5)
+        assert not bool(finite)
+        tree = {"a": jnp.asarray([1.0, 2.0])}
+        scaled, finite = mt.multi_tensor_scale(tree, 0.5)
+        assert bool(finite)
+        np.testing.assert_allclose(np.asarray(scaled["a"]), [0.5, 1.0])
+
+    def test_axpby(self):
+        out, finite = mt.multi_tensor_axpby(
+            {"a": jnp.asarray([1.0, 2.0])}, {"a": jnp.asarray([10.0, 20.0])}, 2.0, 0.5
+        )
+        np.testing.assert_allclose(np.asarray(out["a"]), [7.0, 14.0])
+        assert bool(finite)
+
+    def test_l2norm(self):
+        tree = {"a": jnp.full((4,), 3.0), "b": jnp.full((9,), 1.0)}
+        total, per = mt.multi_tensor_l2norm(tree, per_tensor=True)
+        np.testing.assert_allclose(float(total), np.sqrt(36 + 9))
+        np.testing.assert_allclose(np.asarray(per), [6.0, 3.0])
+
+
+def run_steps(tx, params, n=5, seed=10):
+    state = tx.init(params)
+    for i in range(n):
+        grads = make_grads(params, seed=seed + i)
+        updates, state = tx.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+    return params
+
+
+class TestFusedAdam:
+    @pytest.mark.parametrize("weight_decay", [0.0, 0.1])
+    def test_matches_optax_adamw(self, weight_decay):
+        params = make_params()
+        ours = run_steps(opt.fused_adam(1e-2, weight_decay=weight_decay), params)
+        ref = run_steps(optax.adamw(1e-2, weight_decay=weight_decay), params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6
+            ),
+            ours, ref,
+        )
+
+    def test_l2_mode_matches_optax_adam_on_l2_grads(self):
+        # adam_w_mode=False == adam on (g + wd*p)
+        params = make_params()
+        wd = 0.1
+        tx = opt.fused_adam(1e-2, weight_decay=wd, adam_w_mode=False)
+        state = tx.init(params)
+        ref_tx = optax.adam(1e-2)
+        ref_state = ref_tx.init(params)
+        ref_params = params
+        for i in range(3):
+            grads = make_grads(params, seed=20 + i)
+            updates, state = tx.update(grads, state, params)
+            params = optax.apply_updates(params, updates)
+            l2_grads = jax.tree.map(lambda g, p: g + wd * p, grads, ref_params)
+            ref_updates, ref_state = ref_tx.update(l2_grads, ref_state, ref_params)
+            ref_params = optax.apply_updates(ref_params, ref_updates)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6),
+            params, ref_params,
+        )
+
+    def test_jit_and_schedule(self):
+        params = make_params()
+        sched = optax.linear_schedule(1e-2, 1e-3, 10)
+        tx = opt.fused_adam(sched)
+        state = tx.init(params)
+        step = jax.jit(tx.update)
+        grads = make_grads(params)
+        updates, state = step(grads, state, params)
+        assert int(state.count) == 1
+
+    def test_schedule_zero_based_like_optax(self):
+        # first step evaluates sched(0), matching optax convention
+        sched = lambda c: jnp.where(c == 0, 1.0, 0.0)  # noqa: E731
+        params = {"w": jnp.zeros((2,))}
+        grads = {"w": jnp.ones((2,))}
+        ours = opt.fused_sgd(sched)
+        ref = optax.sgd(sched)
+        u_ours, _ = ours.update(grads, ours.init(params), params)
+        u_ref, _ = ref.update(grads, ref.init(params), params)
+        np.testing.assert_allclose(np.asarray(u_ours["w"]), np.asarray(u_ref["w"]))
+
+
+class TestFusedSGD:
+    def test_matches_optax_sgd_momentum(self):
+        params = make_params()
+        ours = run_steps(opt.fused_sgd(0.1, momentum=0.9), params)
+        ref = run_steps(optax.sgd(0.1, momentum=0.9), params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6),
+            ours, ref,
+        )
+
+    def test_nesterov(self):
+        params = make_params()
+        ours = run_steps(opt.fused_sgd(0.1, momentum=0.9, nesterov=True), params)
+        ref = run_steps(optax.sgd(0.1, momentum=0.9, nesterov=True), params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6),
+            ours, ref,
+        )
+
+    def test_nesterov_validation(self):
+        with pytest.raises(ValueError):
+            opt.fused_sgd(0.1, nesterov=True)
+
+    def test_fused_unscale(self):
+        params = {"w": jnp.ones((4,))}
+        grads = {"w": jnp.full((4,), 128.0)}
+        tx = opt.fused_sgd(1.0, grad_scale=128.0)
+        updates, _ = tx.update(grads, tx.init(params), params)
+        np.testing.assert_allclose(np.asarray(updates["w"]), -1.0)
+
+
+def reference_lamb_step(params, grads, m, v, step, lr, b1, b2, eps, wd, max_gn):
+    """Pure-numpy LAMB following multi_tensor_lamb.cu (test oracle, like the
+    reference's test_lamb.py RefLAMB)."""
+    flat = np.concatenate([np.asarray(g).ravel() for g in jax.tree.leaves(grads)])
+    gnorm = np.linalg.norm(flat)
+    clip = gnorm / max_gn if gnorm > max_gn else 1.0
+    new_params, new_m, new_v = {}, {}, {}
+    for k in params:
+        g = np.asarray(grads[k]) / clip
+        p = np.asarray(params[k])
+        m_t = b1 * m[k] + (1 - b1) * g
+        v_t = b2 * v[k] + (1 - b2) * g * g
+        m_hat = m_t / (1 - b1 ** step)
+        v_hat = v_t / (1 - b2 ** step)
+        update = m_hat / (np.sqrt(v_hat) + eps) + wd * p
+        p_norm = np.linalg.norm(p)
+        u_norm = np.linalg.norm(update)
+        ratio = lr * (p_norm / u_norm) if (p_norm > 0 and u_norm > 0) else lr
+        new_params[k] = p - ratio * update
+        new_m[k], new_v[k] = m_t, v_t
+    return new_params, new_m, new_v
+
+
+class TestFusedLAMB:
+    def test_matches_reference_lamb(self):
+        rng = np.random.RandomState(3)
+        params = {"w": jnp.asarray(rng.randn(11, 5), jnp.float32),
+                  "b": jnp.asarray(rng.randn(5), jnp.float32)}
+        lr, b1, b2, eps, wd, mgn = 0.01, 0.9, 0.999, 1e-6, 0.01, 1.0
+        tx = opt.fused_lamb(lr, b1, b2, eps, weight_decay=wd, max_grad_norm=mgn)
+        state = tx.init(params)
+        ref_p = {k: np.asarray(v) for k, v in params.items()}
+        ref_m = {k: np.zeros_like(v) for k, v in ref_p.items()}
+        ref_v = {k: np.zeros_like(v) for k, v in ref_p.items()}
+        for i in range(4):
+            grads = make_grads(params, seed=30 + i)
+            updates, state = tx.update(grads, state, params)
+            params = optax.apply_updates(params, updates)
+            ref_p, ref_m, ref_v = reference_lamb_step(
+                ref_p, grads, ref_m, ref_v, i + 1, lr, b1, b2, eps, wd, mgn)
+        for k in ref_p:
+            np.testing.assert_allclose(np.asarray(params[k]), ref_p[k], atol=1e-5)
+
+    def test_no_decay_no_nvlamb_plain_adam_ratio(self):
+        # wd=0, use_nvlamb=False → ratio == lr (lamb.cu:255-262)
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        grads = {"w": jnp.full((4,), 0.5)}
+        tx = opt.fused_lamb(0.1, weight_decay=0.0, max_grad_norm=1e9)
+        updates, _ = tx.update(grads, tx.init(params), params)
+        # first step: m_hat = g, v_hat = g^2 → update = 1/(1+eps)*sign
+        np.testing.assert_allclose(np.asarray(updates["w"]), -0.1, rtol=1e-4)
+
+
+class TestFusedNovoGrad:
+    def test_first_step_init_norm(self):
+        params = {"w": jnp.asarray([3.0, 4.0])}  # ||g||=5
+        grads = {"w": jnp.asarray([3.0, 4.0])}
+        tx = opt.fused_novograd(0.1, b1=0.0, grad_averaging=False, weight_decay=0.0)
+        updates, state = tx.update(grads, tx.init(params), params)
+        # v init to ||g||=5 (norm, not square: reference stores the norm,
+        # fused_novograd.py:160-177) → denom=5+eps; update = g/5 → -0.1*g/5
+        np.testing.assert_allclose(np.asarray(updates["w"]), [-0.06, -0.08], rtol=1e-5)
+        np.testing.assert_allclose(float(state.scalars["v"][0]), 5.0, rtol=1e-5)
+
+    def test_inf_norm(self):
+        params = {"w": jnp.asarray([3.0, -4.0])}
+        grads = {"w": jnp.asarray([3.0, -4.0])}
+        tx = opt.fused_novograd(0.1, b1=0.0, grad_averaging=False, norm_type=0)
+        _, state = tx.update(grads, tx.init(params), params)
+        np.testing.assert_allclose(float(state.scalars["v"][0]), 4.0, rtol=1e-5)
+
+    def test_ema_after_first_step(self):
+        params = {"w": jnp.asarray([1.0])}
+        tx = opt.fused_novograd(0.1, b2=0.5)
+        state = tx.init(params)
+        _, state = tx.update({"w": jnp.asarray([2.0])}, state, params)  # v=||g||=2
+        _, state = tx.update({"w": jnp.asarray([4.0])}, state, params)  # v=0.5*2+0.5*4
+        np.testing.assert_allclose(float(state.scalars["v"][0]), 3.0, rtol=1e-5)
+
+
+class TestFusedAdagrad:
+    def test_matches_manual(self):
+        params = {"w": jnp.asarray([1.0, 2.0])}
+        grads = {"w": jnp.asarray([0.5, 0.5])}
+        tx = opt.fused_adagrad(0.1, eps=0.0)
+        updates, _ = tx.update(grads, tx.init(params), params)
+        np.testing.assert_allclose(np.asarray(updates["w"]), -0.1, rtol=1e-6)
+
+
+class TestMixedPrecisionLamb:
+    def test_bf16_params_fp32_master(self):
+        params = {"w": jnp.ones((8,), jnp.bfloat16)}
+        tx = opt.fused_mixed_precision_lamb(1e-3, weight_decay=0.01)
+        state = tx.init(params)
+        assert state.master.dtype == jnp.float32
+        grads = {"w": jnp.full((8,), 0.1, jnp.bfloat16)}
+        updates, state = tx.update(grads, state, params)
+        assert updates["w"].dtype == jnp.bfloat16
+        new_params = optax.apply_updates(params, updates)
+        # model lands exactly on cast(master)
+        master_tree = mt.unflatten_from_chunks(state.master, state.layout, like=params)
+        np.testing.assert_array_equal(np.asarray(new_params["w"]),
+                                      np.asarray(master_tree["w"]))
+
+    def test_master_advances_below_bf16_resolution(self):
+        params = {"w": jnp.full((4,), 256.0, jnp.bfloat16)}
+        tx = opt.fused_mixed_precision_lamb(1e-5, weight_decay=0.0, max_grad_norm=1e9)
+        state = tx.init(params)
+        grads = {"w": jnp.full((4,), 1e-3, jnp.bfloat16)}
+        _, state = tx.update(grads, state, params)
+        assert float(state.master[0, 0]) != 256.0  # master moved

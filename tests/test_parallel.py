@@ -179,6 +179,15 @@ class TestLARC:
         updates, _ = tx.update(grads, tx.init(params), params)
         np.testing.assert_allclose(np.asarray(updates["w"]), [1.0, 2.0])
 
+    def test_zero_grad_gets_no_weight_decay(self):
+        # frozen layer: grad 0 stays 0 even with wd (reference applies decay
+        # only inside the nonzero-norm guard, LARC.py:92-102)
+        params = {"w": jnp.asarray([5.0, 5.0])}
+        grads = {"w": jnp.zeros((2,))}
+        tx = larc(learning_rate=1.0, weight_decay=0.1)
+        updates, _ = tx.update(grads, tx.init(params), params)
+        np.testing.assert_allclose(np.asarray(updates["w"]), 0.0)
+
     def test_chained_with_sgd(self):
         params = {"w": jnp.asarray([10.0, 10.0])}
         tx = optax.chain(larc(learning_rate=0.1), optax.sgd(0.1))
