@@ -1,0 +1,43 @@
+"""Fused ops: the TPU-native equivalent of the reference's ``csrc/`` tier.
+
+Every CUDA extension in the reference (SURVEY.md §2.2) maps here to either a
+Pallas TPU kernel (``apex_tpu/ops/pallas/``) or an XLA-fused composition, each
+wrapped in ``jax.custom_vjp`` where the reference's autograd Function saves
+non-trivial residuals:
+
+* ``fused_layer_norm_cuda``  → :mod:`apex_tpu.ops.layer_norm`
+* ``scaled_masked_softmax_cuda`` / ``scaled_upper_triang_masked_softmax_cuda``
+  → :mod:`apex_tpu.ops.softmax`
+* ``fused_dense_cuda`` / ``mlp_cuda`` → :mod:`apex_tpu.ops.fused_dense`,
+  :mod:`apex_tpu.ops.mlp`
+* ``xentropy_cuda`` → :mod:`apex_tpu.ops.xentropy`
+* ``focal_loss_cuda`` → :mod:`apex_tpu.ops.focal_loss`
+* ``fmhalib`` / ``fast_multihead_attn`` → :mod:`apex_tpu.ops.attention`
+  (blockwise flash attention; removes the reference's seq≤512 / sk≤2048 caps)
+* ``transducer_{joint,loss}_cuda`` → :mod:`apex_tpu.ops.transducer`
+
+Kernel selection: ``impl='auto'`` uses Pallas on TPU (interpret mode on CPU in
+tests), falling back to the jnp composition when shapes don't meet the tiling
+constraints — mirroring how the reference falls back to torch ops when a
+kernel's eligibility check fails (``fused_softmax.py:159-179``).
+"""
+
+from apex_tpu.ops.layer_norm import (  # noqa: F401
+    fused_layer_norm,
+    fused_rms_norm,
+    FusedLayerNorm,
+    FusedRMSNorm,
+)
+from apex_tpu.ops.softmax import (  # noqa: F401
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.ops.fused_dense import (  # noqa: F401
+    fused_dense,
+    fused_dense_gelu_dense,
+    FusedDense,
+    FusedDenseGeluDense,
+)
+from apex_tpu.ops.mlp import MLP, mlp  # noqa: F401
+from apex_tpu.ops.xentropy import softmax_cross_entropy_loss  # noqa: F401
+from apex_tpu.ops.focal_loss import focal_loss  # noqa: F401

@@ -1,0 +1,57 @@
+"""Kernel-dispatch policy: Pallas on TPU, interpret-mode Pallas in CPU tests,
+jnp fallback otherwise.
+
+The reference gates each CUDA kernel behind an import check and a shape
+eligibility check (e.g. ``FusedScaleMaskSoftmax.is_kernel_available``,
+``apex/transformer/functional/fused_softmax.py:159-179``). Here the same
+decision is a function of (a) the active JAX backend, (b) per-op tiling
+constraints, and (c) an override:
+
+* ``impl='pallas'`` — always use the Pallas kernel (interpret mode off-TPU);
+* ``impl='xla'``    — always use the jnp composition;
+* ``impl='auto'``   — Pallas when on TPU and shapes qualify, else jnp.
+
+``APEX_TPU_PALLAS=0`` disables Pallas globally (escape hatch);
+``APEX_TPU_PALLAS=interpret`` forces interpret-mode kernels everywhere, which
+is how the CPU test suite exercises the real kernel code paths.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_ENV = "APEX_TPU_PALLAS"
+
+
+def backend_platform() -> str:
+    return jax.default_backend()
+
+
+def interpret_mode() -> bool:
+    """Pallas interpret=True — needed anywhere but real TPU hardware."""
+    return backend_platform() != "tpu"
+
+
+def pallas_enabled() -> bool:
+    return os.environ.get(_ENV, "1") != "0"
+
+
+def choose_impl(impl: str, shapes_ok: bool) -> str:
+    """Resolve an ``impl`` argument to 'pallas' or 'xla'."""
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(f"impl must be auto|pallas|xla, got {impl!r}")
+    if impl == "xla" or not pallas_enabled():
+        return "xla"
+    if impl == "pallas":
+        if not shapes_ok:
+            raise ValueError("shapes do not satisfy the Pallas kernel's tiling constraints")
+        return "pallas"
+    # auto: kernels only pay off on real TPU; under interpret mode they are
+    # pure overhead, so auto==xla on CPU unless tests force interpret.
+    env = os.environ.get(_ENV, "")
+    on_tpu = backend_platform() == "tpu"
+    if shapes_ok and (on_tpu or env == "interpret"):
+        return "pallas"
+    return "xla"

@@ -1,0 +1,66 @@
+"""Fused focal loss.
+
+Re-design of ``apex.contrib.focal_loss``
+(``apex/contrib/focal_loss/focal_loss.py:6-60``; kernel
+``apex/contrib/csrc/focal_loss/focal_loss_cuda.cu``). The reference computes
+the focal loss over classification logits for detection (anchors with a
+label smoothing ε and per-example weighting) and stores a *partial gradient*
+in forward to make backward a single in-place multiply; here the same
+save-partial-grad trick is the ``custom_vjp`` residual.
+
+Focal loss (Lin et al. 2017): ``FL(p_t) = -α_t (1 - p_t)^γ log(p_t)``, with
+sigmoid logits over ``num_classes`` one-vs-all outputs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def focal_loss(
+    logits: jax.Array,
+    targets: jax.Array,
+    num_classes: int,
+    alpha: float = 0.25,
+    gamma: float = 2.0,
+    smoothing_factor: float = 0.0,
+) -> jax.Array:
+    """Summed sigmoid focal loss; ``targets`` are integer class ids (0 =
+    background, matching the reference's anchor labeling)."""
+    loss, _ = _fl_fwd(logits, targets, num_classes, alpha, gamma, smoothing_factor)
+    return loss
+
+
+def _fl_sum(lf, targets, num_classes, alpha, gamma, smoothing):
+    # one-vs-all targets: class c>0 maps to index c-1; background is all-zero
+    onehot = jax.nn.one_hot(targets - 1, num_classes, dtype=jnp.float32)
+    t = onehot * (1.0 - smoothing) + (1.0 - onehot) * smoothing
+    p = jax.nn.sigmoid(lf)
+    ce = jnp.logaddexp(0.0, lf) - t * lf  # BCE-with-logits against smoothed t
+    p_t = p * onehot + (1.0 - p) * (1.0 - onehot)
+    alpha_t = alpha * onehot + (1.0 - alpha) * (1.0 - onehot)
+    loss_el = alpha_t * (1.0 - p_t) ** gamma * ce
+    return jnp.sum(loss_el)
+
+
+def _fl_fwd(logits, targets, num_classes, alpha, gamma, smoothing):
+    # materialize the full partial gradient during forward (the reference's
+    # saved partial-grad buffer) so backward is a single scale
+    lf = logits.astype(jnp.float32)
+    loss, pullback = jax.vjp(
+        lambda l: _fl_sum(l, targets, num_classes, alpha, gamma, smoothing), lf
+    )
+    (dloss,) = pullback(jnp.ones((), jnp.float32))
+    return loss, (dloss.astype(logits.dtype),)
+
+
+def _fl_bwd(num_classes, alpha, gamma, smoothing, res, g):
+    (dloss,) = res
+    return ((g * dloss.astype(jnp.float32)).astype(dloss.dtype), None)
+
+
+focal_loss.defvjp(_fl_fwd, _fl_bwd)

@@ -1,0 +1,195 @@
+"""Fused dense layers: GEMM+bias and GEMM+bias+GELU+GEMM.
+
+Re-design of ``apex.fused_dense`` (``apex/fused_dense/fused_dense.py:7-86``;
+kernels ``csrc/fused_dense_cuda.cu``). The reference leans on cuBLASLt
+epilogues; on TPU the same fusion is either XLA's (which fuses bias+GELU into
+the matmul consumer natively — the ``impl='xla'`` path) or the explicit Pallas
+epilogue kernel (:func:`apex_tpu.ops.pallas.matmul.matmul_bias_act`).
+
+Backward follows the reference's autograd Functions
+(``fused_dense.py:7-52``): ``dX = dY Wᵀ``, ``dW = Xᵀ dY``, ``db = Σ dY``,
+with the GELU derivative applied from the *saved pre-activation* in the
+gelu-dense-dense case.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import _backend
+from apex_tpu.ops.pallas.matmul import matmul_bias_act
+
+
+def _mm(x, w, b=None, activation="none", use_pallas=False, out_dtype=None):
+    if use_pallas:
+        return matmul_bias_act(
+            x, w, b, activation=activation, out_dtype=out_dtype,
+            interpret=_backend.interpret_mode(),
+        )
+    r = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    if b is not None:
+        r = r + b
+    if activation == "gelu":
+        r = jax.nn.gelu(r, approximate=True)
+    elif activation == "relu":
+        r = jnp.maximum(r, 0.0)
+    elif activation == "sigmoid":
+        r = jax.nn.sigmoid(r)
+    return r.astype(out_dtype or x.dtype)
+
+
+def _gelu(x):
+    return jax.nn.gelu(x, approximate=True)
+
+
+def _dgelu(x):
+    # derivative of tanh-approximate GELU, matching the fwd approximation
+    c = jnp.sqrt(2.0 / jnp.pi)
+    inner = c * (x + 0.044715 * x**3)
+    t = jnp.tanh(inner)
+    return 0.5 * (1.0 + t) + 0.5 * x * (1.0 - t * t) * c * (1.0 + 3 * 0.044715 * x * x)
+
+
+# --- fused_dense: y = x @ w + b ----------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _dense_core(x, w, b, use_pallas):
+    return _mm(x, w, b, "none", use_pallas)
+
+
+def _dense_fwd(x, w, b, use_pallas):
+    return _mm(x, w, b, "none", use_pallas), (x, w, b is not None)
+
+
+def _dense_bwd(use_pallas, res, dy):
+    x, w, has_bias = res
+    dx = _mm(dy, w.T, use_pallas=use_pallas, out_dtype=x.dtype)
+    dw = _mm(x.T, dy, use_pallas=use_pallas, out_dtype=w.dtype)
+    db = jnp.sum(dy, axis=0).astype(w.dtype) if has_bias else None
+    return dx, dw, db
+
+
+_dense_core.defvjp(_dense_fwd, _dense_bwd)
+
+
+def fused_dense(
+    x: jax.Array, weight: jax.Array, bias: Optional[jax.Array] = None,
+    *, impl: str = "auto",
+) -> jax.Array:
+    """``fused_dense_function`` (``apex/fused_dense/fused_dense.py:48``):
+    ``x @ weightᵀ + bias`` (torch Linear weight layout (out, in))."""
+    use_pallas = _choose(impl, x, weight)
+    lead = x.shape[:-1]
+    x2d = x.reshape(-1, x.shape[-1])
+    y = _dense_core(x2d, weight.T, bias, use_pallas)
+    return y.reshape(*lead, weight.shape[0])
+
+
+# --- fused_dense_gelu_dense ---------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _dgd_core(x, w1, b1, w2, b2, use_pallas):
+    h_pre = _mm(x, w1, b1, "none", use_pallas)
+    return _mm(_gelu(h_pre), w2, b2, "none", use_pallas)
+
+
+def _dgd_fwd(x, w1, b1, w2, b2, use_pallas):
+    # save the pre-GELU activation, like fused_dense_cuda's
+    # linear_gelu_forward returns (output, gelu_in)
+    h_pre = _mm(x, w1, b1, "none", use_pallas)
+    h = _gelu(h_pre)
+    y = _mm(h, w2, b2, "none", use_pallas)
+    return y, (x, w1, w2, h_pre, h)
+
+
+def _dgd_bwd(use_pallas, res, dy):
+    x, w1, w2, h_pre, h = res
+    dh = _mm(dy, w2.T, use_pallas=use_pallas, out_dtype=h.dtype)
+    dw2 = _mm(h.T, dy, use_pallas=use_pallas, out_dtype=w2.dtype)
+    db2 = jnp.sum(dy, axis=0).astype(w2.dtype)
+    dh_pre = (dh * _dgelu(h_pre.astype(jnp.float32)).astype(dh.dtype))
+    dx = _mm(dh_pre, w1.T, use_pallas=use_pallas, out_dtype=x.dtype)
+    dw1 = _mm(x.T, dh_pre, use_pallas=use_pallas, out_dtype=w1.dtype)
+    db1 = jnp.sum(dh_pre, axis=0).astype(w1.dtype)
+    return dx, dw1, db1, dw2, db2
+
+
+_dgd_core.defvjp(_dgd_fwd, _dgd_bwd)
+
+
+def fused_dense_gelu_dense(
+    x: jax.Array, weight1: jax.Array, bias1: jax.Array,
+    weight2: jax.Array, bias2: jax.Array, *, impl: str = "auto",
+) -> jax.Array:
+    """``FusedDenseGeluDenseFunc`` (``fused_dense.py:27-46``): two Linears
+    with a GELU between, saving the pre-GELU for backward."""
+    use_pallas = _choose(impl, x, weight1)
+    lead = x.shape[:-1]
+    x2d = x.reshape(-1, x.shape[-1])
+    y = _dgd_core(x2d, weight1.T, bias1, weight2.T, bias2, use_pallas)
+    return y.reshape(*lead, weight2.shape[0])
+
+
+def _choose(impl: str, x, w) -> bool:
+    # pallas path needs lane-aligned contraction/output dims
+    ok = x.shape[-1] % 128 == 0 and w.shape[0] % 128 == 0
+    return _backend.choose_impl(impl, ok) == "pallas"
+
+
+# --- module wrappers ----------------------------------------------------------
+
+class FusedDense:
+    """``apex.fused_dense.FusedDense`` (``fused_dense.py:55``)."""
+
+    def __init__(self, in_features: int, out_features: int, bias: bool = True,
+                 impl: str = "auto"):
+        self.in_features, self.out_features = in_features, out_features
+        self.use_bias = bias
+        self.impl = impl
+
+    def init(self, key, dtype=jnp.float32) -> dict:
+        bound = 1.0 / jnp.sqrt(self.in_features)
+        w = jax.random.uniform(
+            key, (self.out_features, self.in_features), dtype, -bound, bound
+        )
+        params = {"weight": w}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.out_features,), dtype)
+        return params
+
+    def __call__(self, params: dict, x: jax.Array) -> jax.Array:
+        return fused_dense(x, params["weight"], params.get("bias"), impl=self.impl)
+
+
+class FusedDenseGeluDense:
+    """``apex.fused_dense.FusedDenseGeluDense`` (``fused_dense.py:72``)."""
+
+    def __init__(self, in_features: int, intermediate_features: int,
+                 out_features: int, impl: str = "auto"):
+        self.in_features = in_features
+        self.intermediate_features = intermediate_features
+        self.out_features = out_features
+        self.impl = impl
+
+    def init(self, key, dtype=jnp.float32) -> dict:
+        k1, k2 = jax.random.split(key)
+        b1 = 1.0 / jnp.sqrt(self.in_features)
+        b2 = 1.0 / jnp.sqrt(self.intermediate_features)
+        return {
+            "weight1": jax.random.uniform(
+                k1, (self.intermediate_features, self.in_features), dtype, -b1, b1),
+            "bias1": jnp.zeros((self.intermediate_features,), dtype),
+            "weight2": jax.random.uniform(
+                k2, (self.out_features, self.intermediate_features), dtype, -b2, b2),
+            "bias2": jnp.zeros((self.out_features,), dtype),
+        }
+
+    def __call__(self, params: dict, x: jax.Array) -> jax.Array:
+        return fused_dense_gelu_dense(
+            x, params["weight1"], params["bias1"],
+            params["weight2"], params["bias2"], impl=self.impl,
+        )

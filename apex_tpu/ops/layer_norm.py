@@ -1,0 +1,214 @@
+"""Fused LayerNorm / RMSNorm with saved-statistics backward.
+
+Re-design of ``apex.normalization.FusedLayerNorm`` / ``FusedRMSNorm``
+(``apex/normalization/fused_layer_norm.py:33-125,204+``). The reference's
+autograd Functions call ``fused_layer_norm_cuda`` and save (mean, rstd) for
+backward; here the same contract is a ``jax.custom_vjp`` over the Pallas
+kernels in :mod:`apex_tpu.ops.pallas.layer_norm`, with an XLA composition as
+the fallback path (analog of the reference's ``F.layer_norm`` fallback when
+the extension is missing, ``fused_layer_norm.py:16-30``).
+
+Mixed-dtype behavior (the reference's ``MixedFusedLayerNorm`` /
+``memory_efficient`` variants): statistics are always fp32; the output dtype
+follows the input; weights may be fp32 with bf16 inputs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import _backend
+from apex_tpu.ops.pallas import layer_norm as _k
+
+
+def _normalized_size(normalized_shape) -> int:
+    if isinstance(normalized_shape, int):
+        return normalized_shape
+    size = 1
+    for s in normalized_shape:
+        size *= int(s)
+    return size
+
+
+def _shapes_ok(hidden: int) -> bool:
+    return hidden % 128 == 0
+
+
+# --- XLA reference path -------------------------------------------------------
+
+def _xla_fwd(x2d, weight, bias, eps, rms):
+    xf = x2d.astype(jnp.float32)
+    if rms:
+        mean = jnp.zeros((xf.shape[0], 1), jnp.float32)
+        xc = xf
+    else:
+        mean = jnp.mean(xf, axis=1, keepdims=True)
+        xc = xf - mean
+    var = jnp.mean(xc * xc, axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = xc * rstd
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x2d.dtype), mean, rstd
+
+
+def _xla_bwd(dy2d, x2d, mean, rstd, weight, rms):
+    dy = dy2d.astype(jnp.float32)
+    xf = x2d.astype(jnp.float32)
+    xhat = (xf * rstd) if rms else ((xf - mean) * rstd)
+    if weight is not None:
+        dw = jnp.sum(dy * xhat, axis=0)
+        db = jnp.sum(dy, axis=0)
+        dyw = dy * weight.astype(jnp.float32)
+    else:
+        dw = db = None
+        dyw = dy
+    h = xf.shape[1]
+    c2 = jnp.sum(dyw * xhat, axis=1, keepdims=True) / h
+    if rms:
+        dx = (dyw - xhat * c2) * rstd
+    else:
+        c1 = jnp.sum(dyw, axis=1, keepdims=True) / h
+        dx = (dyw - c1 - xhat * c2) * rstd
+    return dx.astype(x2d.dtype), dw, db
+
+
+# --- custom_vjp core ----------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _norm_core(x2d, weight, bias, eps, rms, use_pallas):
+    y, _ = _norm_fwd(x2d, weight, bias, eps, rms, use_pallas)
+    return y
+
+
+def _norm_fwd(x2d, weight, bias, eps, rms, use_pallas):
+    if use_pallas:
+        y, mean, rstd = _k.ln_fwd(
+            x2d, weight, bias, eps=eps, rms=rms, interpret=_backend.interpret_mode()
+        )
+    else:
+        y, mean, rstd = _xla_fwd(x2d, weight, bias, eps, rms)
+    return y, (x2d, weight, bias, mean, rstd)
+
+
+def _norm_bwd(eps, rms, use_pallas, res, dy):
+    x2d, weight, bias, mean, rstd = res
+    if use_pallas:
+        dx, dw, db = _k.ln_bwd(
+            dy, x2d, mean, rstd, weight, rms=rms, interpret=_backend.interpret_mode()
+        )
+    else:
+        dx, dw, db = _xla_bwd(dy, x2d, mean, rstd, weight, rms)
+    dw = None if weight is None else dw.astype(weight.dtype)
+    db = None if bias is None else db.astype(bias.dtype)
+    return dx, dw, db
+
+
+_norm_core.defvjp(
+    lambda x2d, weight, bias, eps, rms, use_pallas: _norm_fwd(
+        x2d, weight, bias, eps, rms, use_pallas
+    ),
+    _norm_bwd,
+)
+
+
+# --- public functional API ----------------------------------------------------
+
+def fused_layer_norm(
+    x: jax.Array,
+    weight: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
+    normalized_shape: Optional[Sequence[int]] = None,
+    *,
+    eps: float = 1e-5,
+    impl: str = "auto",
+) -> jax.Array:
+    """LayerNorm over the trailing ``normalized_shape`` dims (default: last).
+
+    Equivalent of ``fused_layer_norm_affine`` / ``fused_layer_norm``
+    (``apex/normalization/fused_layer_norm.py:33-76``).
+    """
+    if normalized_shape is None:
+        normalized_shape = (x.shape[-1],) if weight is None else weight.shape
+    hidden = _normalized_size(normalized_shape)
+    x2d = x.reshape(-1, hidden)
+    w = None if weight is None else weight.reshape(hidden)
+    b = None if bias is None else bias.reshape(hidden)
+    use_pallas = _backend.choose_impl(impl, _shapes_ok(hidden)) == "pallas"
+    y = _norm_core(x2d, w, b, eps, False, use_pallas)
+    return y.reshape(x.shape)
+
+
+def fused_rms_norm(
+    x: jax.Array,
+    weight: Optional[jax.Array] = None,
+    normalized_shape: Optional[Sequence[int]] = None,
+    *,
+    eps: float = 1e-5,
+    impl: str = "auto",
+) -> jax.Array:
+    """RMSNorm (``fused_rms_norm_affine``, ``fused_layer_norm.py:78-125``)."""
+    if normalized_shape is None:
+        normalized_shape = (x.shape[-1],) if weight is None else weight.shape
+    hidden = _normalized_size(normalized_shape)
+    x2d = x.reshape(-1, hidden)
+    w = None if weight is None else weight.reshape(hidden)
+    use_pallas = _backend.choose_impl(impl, _shapes_ok(hidden)) == "pallas"
+    y = _norm_core(x2d, w, None, eps, True, use_pallas)
+    return y.reshape(x.shape)
+
+
+# --- module wrappers (constructor parity with the reference modules) ----------
+
+class FusedLayerNorm:
+    """``apex.normalization.FusedLayerNorm`` (``fused_layer_norm.py:204``):
+    holds (weight, bias) for ``normalized_shape``; functional call."""
+
+    rms = False
+
+    def __init__(self, normalized_shape, eps: float = 1e-5,
+                 elementwise_affine: bool = True, impl: str = "auto"):
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.eps = eps
+        self.elementwise_affine = elementwise_affine
+        self.impl = impl
+
+    def init(self, dtype=jnp.float32) -> dict:
+        if not self.elementwise_affine:
+            return {}
+        params = {"weight": jnp.ones(self.normalized_shape, dtype)}
+        if not self.rms:
+            params["bias"] = jnp.zeros(self.normalized_shape, dtype)
+        return params
+
+    def __call__(self, params: dict, x: jax.Array) -> jax.Array:
+        if self.rms:
+            return fused_rms_norm(
+                x, params.get("weight"), self.normalized_shape,
+                eps=self.eps, impl=self.impl,
+            )
+        return fused_layer_norm(
+            x, params.get("weight"), params.get("bias"), self.normalized_shape,
+            eps=self.eps, impl=self.impl,
+        )
+
+
+class FusedRMSNorm(FusedLayerNorm):
+    """``apex.normalization.FusedRMSNorm`` (``fused_layer_norm.py:300``)."""
+
+    rms = True
+
+
+# Mixed variants: in the reference these keep fp32 weights with fp16 inputs
+# (``MixedFusedLayerNorm`` ``fused_layer_norm.py:398,420``); here *all* norms
+# compute statistics in fp32 and respect param dtype, so these are aliases.
+MixedFusedLayerNorm = FusedLayerNorm
+MixedFusedRMSNorm = FusedRMSNorm

@@ -1,0 +1,136 @@
+"""Fused MLP: a whole stack of Linear+bias+activation in one call chain.
+
+Re-design of ``apex.mlp.MLP`` (``apex/mlp/mlp.py:8-80``; kernels
+``csrc/mlp_cuda.cu:47-200``). The reference fuses N layers' GEMMs with custom
+bias+relu/sigmoid epilogue kernels and hand-written backward; here each layer
+is the fused GEMM+bias+act primitive (Pallas epilogue kernel or the
+XLA-fused composition), and backward applies the activation derivative from
+saved pre-activations — the same residuals mlp_cuda stashes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import _backend
+from apex_tpu.ops.fused_dense import _mm
+
+
+def _act(h, activation):
+    if activation == "relu":
+        return jnp.maximum(h, 0.0)
+    if activation == "sigmoid":
+        return jax.nn.sigmoid(h)
+    if activation == "none":
+        return h
+    raise ValueError(f"mlp activation must be none|relu|sigmoid, got {activation!r}")
+
+
+def _dact(h_pre, h_post, activation):
+    if activation == "relu":
+        return (h_pre > 0).astype(h_pre.dtype)
+    if activation == "sigmoid":
+        return h_post * (1.0 - h_post)
+    return jnp.ones_like(h_pre)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _mlp_core(x, params, activation, use_pallas):
+    h = x
+    n = len(params) // 2
+    for i in range(n):
+        h = _mm(h, params[2 * i], params[2 * i + 1], activation, use_pallas)
+    return h
+
+
+def _mlp_fwd(x, params, activation, use_pallas):
+    n = len(params) // 2
+    h = x
+    pres: List[jax.Array] = []
+    posts: List[jax.Array] = [x]
+    for i in range(n):
+        pre = _mm(h, params[2 * i], params[2 * i + 1], "none", use_pallas)
+        h = _act(pre, activation)
+        pres.append(pre)
+        posts.append(h)
+    return h, (tuple(params), tuple(pres), tuple(posts))
+
+
+def _mlp_bwd(activation, use_pallas, res, dy):
+    params, pres, posts = res
+    n = len(pres)
+    dparams = [None] * (2 * n)
+    g = dy
+    for i in reversed(range(n)):
+        g = g * _dact(pres[i], posts[i + 1], activation)
+        w = params[2 * i]
+        dparams[2 * i] = _mm(posts[i].T, g, use_pallas=use_pallas, out_dtype=w.dtype)
+        dparams[2 * i + 1] = jnp.sum(g, axis=0).astype(w.dtype)
+        g = _mm(g, w.T, use_pallas=use_pallas, out_dtype=posts[i].dtype)
+    return g, tuple(dparams)
+
+
+_mlp_core.defvjp(_mlp_fwd, _mlp_bwd)
+
+
+def mlp(
+    x: jax.Array,
+    weights: Sequence[jax.Array],
+    biases: Sequence[jax.Array],
+    activation: str = "relu",
+    *,
+    impl: str = "auto",
+) -> jax.Array:
+    """Functional MLP; weights are torch-Linear layout (out, in), activation
+    after every layer including the last (matching ``mlp_cuda``'s semantics
+    where activation is applied uniformly, ``apex/mlp/mlp.py:13``)."""
+    ok = all(w.shape[1] % 128 == 0 and w.shape[0] % 128 == 0 for w in weights)
+    use_pallas = _backend.choose_impl(impl, ok and x.shape[-1] % 128 == 0) == "pallas"
+    lead = x.shape[:-1]
+    h = x.reshape(-1, x.shape[-1])
+    flat = []
+    for w, b in zip(weights, biases):
+        flat.extend([w.T, b])
+    y = _mlp_core(h, tuple(flat), activation, use_pallas)
+    return y.reshape(*lead, y.shape[-1])
+
+
+class MLP:
+    """``apex.mlp.MLP`` (``apex/mlp/mlp.py:26``): ``mlp_sizes`` is
+    [in, h1, ..., out]; bias + relu/sigmoid/none activation."""
+
+    def __init__(self, mlp_sizes: Sequence[int], bias: bool = True,
+                 activation: str = "relu", impl: str = "auto"):
+        if len(mlp_sizes) < 2:
+            raise ValueError("mlp_sizes must have at least 2 entries")
+        if not bias:
+            raise NotImplementedError(
+                "bias-less MLP: pass zero biases (kept for API parity; the "
+                "reference also requires bias for the fused path, mlp.py:35)"
+            )
+        self.mlp_sizes = tuple(mlp_sizes)
+        self.activation = activation
+        self.impl = impl
+
+    def init(self, key, dtype=jnp.float32) -> dict:
+        params = {}
+        keys = jax.random.split(key, len(self.mlp_sizes) - 1)
+        for i, (din, dout) in enumerate(zip(self.mlp_sizes[:-1], self.mlp_sizes[1:])):
+            # reference init: uniform(-1/sqrt(fan_in)) (mlp.py:43-49 resets
+            # with kaiming-style bounds)
+            bound = 1.0 / jnp.sqrt(din)
+            params[f"weight_{i}"] = jax.random.uniform(
+                keys[i], (dout, din), dtype, -bound, bound
+            )
+            params[f"bias_{i}"] = jnp.zeros((dout,), dtype)
+        return params
+
+    def __call__(self, params: dict, x: jax.Array) -> jax.Array:
+        n = len(self.mlp_sizes) - 1
+        ws = [params[f"weight_{i}"] for i in range(n)]
+        bs = [params[f"bias_{i}"] for i in range(n)]
+        return mlp(x, ws, bs, self.activation, impl=self.impl)

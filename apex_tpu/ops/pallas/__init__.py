@@ -1,0 +1,5 @@
+"""Pallas TPU kernels — the native tier, analog of the reference's ``csrc/``.
+
+Each module holds raw ``pallas_call`` kernels; the ``jax.custom_vjp`` wiring
+and eligibility checks live one level up in ``apex_tpu/ops/*.py``.
+"""

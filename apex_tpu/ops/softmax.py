@@ -1,0 +1,98 @@
+"""Scaled masked softmax (fused) — functional API with probs-saving backward.
+
+Re-design of ``apex.transformer.functional.fused_softmax``'s autograd wrappers
+(``apex/transformer/functional/fused_softmax.py:21-98``): the reference saves
+the softmax output and computes ``dx = scale * y * (dy - sum(dy*y))`` in its
+backward kernel; we reproduce exactly that contract via ``jax.custom_vjp``
+over the Pallas kernels in :mod:`apex_tpu.ops.pallas.softmax`.
+
+Shapes follow the reference:
+* ``scaled_masked_softmax(x, mask, scale)`` — x: (b, np, sq, sk),
+  mask: (b or 1, 1, sq, sk) boolean (True ⇒ masked out);
+* ``scaled_upper_triang_masked_softmax(x, scale)`` — x: (attn_batches, sq, sk)
+  with the causal triangle applied in-kernel.
+
+No ``16 < sk <= 2048`` cap (the CUDA kernels' limit,
+``fused_softmax.py:166``): blocks stream over rows, sk only needs to be a
+lane multiple for the Pallas path; anything else takes the XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import _backend
+from apex_tpu.ops.pallas import softmax as _k
+
+
+def _xla_fwd(x2d, mask2d, scale, causal, sq):
+    xf = x2d.astype(jnp.float32) * scale
+    if causal:
+        rows, sk = x2d.shape
+        q = (jnp.arange(rows) % sq)[:, None]
+        k = jnp.arange(sk)[None, :]
+        xf = jnp.where(k <= q, xf, _k.MASK_FILL)
+    elif mask2d is not None:
+        xf = jnp.where(mask2d != 0, _k.MASK_FILL, xf)
+    y = jax.nn.softmax(xf, axis=-1)
+    return y.astype(x2d.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _softmax_core(x2d, mask2d, scale, causal, sq, use_pallas):
+    y, _ = _softmax_fwd(x2d, mask2d, scale, causal, sq, use_pallas)
+    return y
+
+
+def _softmax_fwd(x2d, mask2d, scale, causal, sq, use_pallas):
+    if use_pallas:
+        y = _k.softmax_fwd(
+            x2d, mask2d, scale=scale, causal=causal, sq=sq,
+            interpret=_backend.interpret_mode(),
+        )
+    else:
+        y = _xla_fwd(x2d, mask2d, scale, causal, sq)
+    return y, y
+
+
+def _softmax_bwd(scale, causal, sq, use_pallas, y, dy):
+    if use_pallas:
+        dx = _k.softmax_bwd(dy, y, scale=scale, interpret=_backend.interpret_mode())
+    else:
+        yf = y.astype(jnp.float32)
+        dyf = dy.astype(jnp.float32)
+        dx = (scale * yf * (dyf - jnp.sum(dyf * yf, axis=-1, keepdims=True))).astype(y.dtype)
+    return dx, None
+
+
+_softmax_core.defvjp(_softmax_fwd, _softmax_bwd)
+
+
+def scaled_masked_softmax(
+    x: jax.Array, mask: jax.Array | None, scale: float = 1.0, *, impl: str = "auto"
+) -> jax.Array:
+    """``ScaledMaskedSoftmax`` (``fused_softmax.py:57-98``). ``mask`` is
+    boolean with True meaning *masked out*, broadcastable to ``x``."""
+    sk = x.shape[-1]
+    use_pallas = _backend.choose_impl(impl, sk % 128 == 0) == "pallas"
+    x2d = x.reshape(-1, sk)
+    mask2d = None
+    if mask is not None:
+        mask2d = jnp.broadcast_to(mask, x.shape).reshape(-1, sk).astype(jnp.int8)
+    y = _softmax_core(x2d, mask2d, float(scale), False, x.shape[-2], use_pallas)
+    return y.reshape(x.shape)
+
+
+def scaled_upper_triang_masked_softmax(
+    x: jax.Array, scale: float = 1.0, *, impl: str = "auto"
+) -> jax.Array:
+    """``ScaledUpperTriangMaskedSoftmax`` (``fused_softmax.py:21-54``):
+    causal softmax over (..., sq, sk) with the triangle built in-kernel."""
+    sq, sk = x.shape[-2], x.shape[-1]
+    use_pallas = _backend.choose_impl(impl, sk % 128 == 0) == "pallas"
+    x2d = x.reshape(-1, sk)
+    y = _softmax_core(x2d, None, float(scale), True, sq, use_pallas)
+    return y.reshape(x.shape)

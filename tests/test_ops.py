@@ -1,0 +1,301 @@
+"""Unit tests for the fused-op tier — kernel vs jnp reference.
+
+Mirrors the reference's L0 suites ``tests/L0/run_fused_layer_norm``,
+``run_mlp``, ``run_transformer/test_fused_softmax.py`` and the contrib
+xentropy/focal-loss tests: each fused op is compared against a plain jnp
+composition at tight tolerances, forward and backward.
+
+The XLA path runs for every op; the Pallas kernels additionally run in
+interpret mode on tiny shapes (interpret mode is slow, so these are minimal).
+"""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from apex_tpu import ops
+
+
+K = jr.PRNGKey(42)
+
+
+def ref_layer_norm(x, w, b, eps=1e-5):
+    m = x.mean(-1, keepdims=True)
+    v = ((x - m) ** 2).mean(-1, keepdims=True)
+    y = (x - m) / jnp.sqrt(v + eps)
+    if w is not None:
+        y = y * w
+    if b is not None:
+        y = y + b
+    return y
+
+
+def ref_rms_norm(x, w, eps=1e-5):
+    y = x / jnp.sqrt((x * x).mean(-1, keepdims=True) + eps)
+    return y * w if w is not None else y
+
+
+class TestLayerNorm:
+    def test_forward_matches_reference(self):
+        x = jr.normal(K, (4, 9, 256)) * 3 + 1
+        w = jr.normal(jr.fold_in(K, 1), (256,)) * 0.2 + 1
+        b = jr.normal(jr.fold_in(K, 2), (256,)) * 0.2
+        np.testing.assert_allclose(
+            ops.fused_layer_norm(x, w, b), ref_layer_norm(x, w, b), atol=2e-6
+        )
+
+    def test_grads_match_reference(self):
+        x = jr.normal(K, (6, 256)) * 2
+        w = jnp.ones((256,)) * 1.3
+        b = jnp.zeros((256,)) + 0.1
+        f1 = lambda x, w, b: jnp.sum(jnp.sin(ops.fused_layer_norm(x, w, b)))
+        f2 = lambda x, w, b: jnp.sum(jnp.sin(ref_layer_norm(x, w, b)))
+        g1 = jax.grad(f1, argnums=(0, 1, 2))(x, w, b)
+        g2 = jax.grad(f2, argnums=(0, 1, 2))(x, w, b)
+        for a, e in zip(g1, g2):
+            np.testing.assert_allclose(a, e, atol=1e-5)
+
+    def test_no_affine(self):
+        x = jr.normal(K, (5, 128))
+        np.testing.assert_allclose(
+            ops.fused_layer_norm(x), ref_layer_norm(x, None, None), atol=2e-6
+        )
+
+    def test_unaligned_hidden_falls_back(self):
+        # hidden=100 not a lane multiple: auto must still work (XLA path)
+        x = jr.normal(K, (4, 100))
+        w = jnp.ones((100,))
+        b = jnp.zeros((100,))
+        np.testing.assert_allclose(
+            ops.fused_layer_norm(x, w, b), ref_layer_norm(x, w, b), atol=2e-6
+        )
+
+    def test_pallas_explicit_raises_on_bad_shape(self):
+        x = jr.normal(K, (4, 100))
+        with pytest.raises(ValueError):
+            ops.fused_layer_norm(x, impl="pallas")
+
+    def test_module_wrapper(self):
+        m = ops.FusedLayerNorm(256)
+        params = m.init()
+        x = jr.normal(K, (3, 256))
+        np.testing.assert_allclose(
+            m(params, x), ref_layer_norm(x, params["weight"], params["bias"]), atol=2e-6
+        )
+
+    def test_bf16_input_fp32_stats(self):
+        # mixed-dtype variant: bf16 in, fp32 statistics
+        x = (jr.normal(K, (8, 256)) * 2 + 100).astype(jnp.bfloat16)
+        w = jnp.ones((256,), jnp.float32)
+        y = ops.fused_layer_norm(x, w, jnp.zeros((256,), jnp.float32))
+        assert y.dtype == jnp.bfloat16
+        ref = ref_layer_norm(x.astype(jnp.float32), w, None)
+        np.testing.assert_allclose(
+            y.astype(jnp.float32), ref, atol=0.1
+        )  # bf16 output tolerance
+
+
+class TestRMSNorm:
+    def test_forward_and_grad(self):
+        x = jr.normal(K, (4, 384)) * 2
+        w = jr.normal(jr.fold_in(K, 3), (384,)) * 0.1 + 1
+        np.testing.assert_allclose(ops.fused_rms_norm(x, w), ref_rms_norm(x, w), atol=2e-6)
+        g1 = jax.grad(lambda x, w: jnp.sum(jnp.cos(ops.fused_rms_norm(x, w))), (0, 1))(x, w)
+        g2 = jax.grad(lambda x, w: jnp.sum(jnp.cos(ref_rms_norm(x, w))), (0, 1))(x, w)
+        for a, e in zip(g1, g2):
+            np.testing.assert_allclose(a, e, atol=1e-5)
+
+
+class TestSoftmax:
+    def test_masked(self):
+        x = jr.normal(K, (2, 4, 8, 128))
+        mask = jr.bernoulli(jr.fold_in(K, 4), 0.3, (2, 1, 8, 128))
+        ref = jax.nn.softmax(jnp.where(mask, -10000.0, x * 0.5), -1)
+        np.testing.assert_allclose(
+            ops.scaled_masked_softmax(x, mask, 0.5), ref, atol=1e-6
+        )
+
+    def test_masked_grad(self):
+        x = jr.normal(K, (1, 2, 8, 128))
+        mask = jr.bernoulli(jr.fold_in(K, 5), 0.2, (1, 1, 8, 128))
+        g1 = jax.grad(lambda x: jnp.sum(jnp.sin(ops.scaled_masked_softmax(x, mask, 0.7))))(x)
+        g2 = jax.grad(
+            lambda x: jnp.sum(jnp.sin(jax.nn.softmax(jnp.where(mask, -10000.0, x * 0.7), -1)))
+        )(x)
+        np.testing.assert_allclose(g1, g2, atol=1e-6)
+
+    def test_causal(self):
+        x = jr.normal(K, (6, 16, 128))
+        q = jnp.arange(16)[:, None]
+        kk = jnp.arange(128)[None, :]
+        ref = jax.nn.softmax(jnp.where(kk <= q, x * 2.0, -10000.0), -1)
+        np.testing.assert_allclose(
+            ops.scaled_upper_triang_masked_softmax(x, 2.0), ref, atol=1e-6
+        )
+
+    def test_no_seq_cap(self):
+        # the CUDA kernels cap sk at 2048 (fused_softmax.py:166); we don't
+        x = jr.normal(K, (1, 1, 2, 4096))
+        ref = jax.nn.softmax(x, -1)
+        np.testing.assert_allclose(ops.scaled_masked_softmax(x, None, 1.0), ref, atol=1e-6)
+
+
+class TestFusedDense:
+    def test_dense(self):
+        x = jr.normal(K, (6, 256))
+        w = jr.normal(jr.fold_in(K, 6), (128, 256)) * 0.05
+        b = jr.normal(jr.fold_in(K, 7), (128,)) * 0.05
+        np.testing.assert_allclose(
+            ops.fused_dense(x, w, b), x @ w.T + b, atol=1e-5
+        )
+
+    def test_dense_grad(self):
+        x = jr.normal(K, (6, 256))
+        w = jr.normal(jr.fold_in(K, 8), (128, 256)) * 0.05
+        b = jnp.zeros((128,))
+        g1 = jax.grad(lambda x, w, b: jnp.sum(jnp.tanh(ops.fused_dense(x, w, b))), (0, 1, 2))(x, w, b)
+        g2 = jax.grad(lambda x, w, b: jnp.sum(jnp.tanh(x @ w.T + b)), (0, 1, 2))(x, w, b)
+        for a, e in zip(g1, g2):
+            np.testing.assert_allclose(a, e, atol=1e-5)
+
+    def test_dense_gelu_dense(self):
+        x = jr.normal(K, (4, 256))
+        w1 = jr.normal(jr.fold_in(K, 9), (512, 256)) * 0.05
+        b1 = jnp.zeros((512,))
+        w2 = jr.normal(jr.fold_in(K, 10), (256, 512)) * 0.05
+        b2 = jnp.zeros((256,))
+        ref = jax.nn.gelu(x @ w1.T + b1, approximate=True) @ w2.T + b2
+        np.testing.assert_allclose(
+            ops.fused_dense_gelu_dense(x, w1, b1, w2, b2), ref, atol=1e-5
+        )
+        f1 = lambda *a: jnp.sum(jnp.tanh(ops.fused_dense_gelu_dense(*a)))
+        f2 = lambda x, w1, b1, w2, b2: jnp.sum(
+            jnp.tanh(jax.nn.gelu(x @ w1.T + b1, approximate=True) @ w2.T + b2)
+        )
+        g1 = jax.grad(f1, argnums=tuple(range(5)))(x, w1, b1, w2, b2)
+        g2 = jax.grad(f2, argnums=tuple(range(5)))(x, w1, b1, w2, b2)
+        for a, e in zip(g1, g2):
+            np.testing.assert_allclose(a, e, atol=2e-5)
+
+    def test_module(self):
+        m = ops.FusedDense(64, 32)
+        params = m.init(jr.fold_in(K, 11))
+        x = jr.normal(K, (3, 64))
+        np.testing.assert_allclose(
+            m(params, x), x @ params["weight"].T + params["bias"], atol=1e-6
+        )
+
+
+class TestMLP:
+    def test_matches_reference_chain(self):
+        sizes = (256, 128, 64)
+        m = ops.MLP(sizes, activation="relu")
+        params = m.init(jr.fold_in(K, 12))
+        x = jr.normal(K, (5, 256))
+        h = x
+        for i in range(2):
+            h = jnp.maximum(h @ params[f"weight_{i}"].T + params[f"bias_{i}"], 0)
+        np.testing.assert_allclose(m(params, x), h, atol=1e-5)
+
+    def test_sigmoid_grads(self):
+        w = jr.normal(jr.fold_in(K, 13), (128, 128)) * 0.1
+        b = jnp.zeros((128,))
+        x = jr.normal(K, (4, 128))
+        f1 = lambda x, w, b: jnp.sum(ops.mlp(x, [w], [b], "sigmoid") ** 2)
+        f2 = lambda x, w, b: jnp.sum(jax.nn.sigmoid(x @ w.T + b) ** 2)
+        g1 = jax.grad(f1, argnums=(0, 1, 2))(x, w, b)
+        g2 = jax.grad(f2, argnums=(0, 1, 2))(x, w, b)
+        for a, e in zip(g1, g2):
+            np.testing.assert_allclose(a, e, atol=1e-5)
+
+
+class TestXentropy:
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_loss_and_grad(self, smoothing):
+        logits = jr.normal(K, (16, 512))
+        labels = jr.randint(jr.fold_in(K, 14), (16,), 0, 512)
+        loss = ops.softmax_cross_entropy_loss(logits, labels, smoothing)
+        lse = jax.nn.logsumexp(logits, -1)
+        nll = lse - jnp.take_along_axis(logits, labels[:, None], -1)[:, 0]
+        ref = (1 - smoothing) * nll + smoothing * jnp.mean(lse[:, None] - logits, -1)
+        np.testing.assert_allclose(loss, ref, atol=1e-5)
+
+        w = jnp.linspace(0.5, 2.0, 16)
+        g1 = jax.grad(
+            lambda lg: jnp.sum(ops.softmax_cross_entropy_loss(lg, labels, smoothing) * w)
+        )(logits)
+
+        def ref_fn(lg):
+            lse = jax.nn.logsumexp(lg, -1)
+            nll = lse - jnp.take_along_axis(lg, labels[:, None], -1)[:, 0]
+            return jnp.sum(((1 - smoothing) * nll + smoothing * jnp.mean(lse[:, None] - lg, -1)) * w)
+
+        np.testing.assert_allclose(g1, jax.grad(ref_fn)(logits), atol=1e-5)
+
+    def test_half_to_float(self):
+        logits = jr.normal(K, (8, 128)).astype(jnp.bfloat16)
+        labels = jr.randint(jr.fold_in(K, 15), (8,), 0, 128)
+        assert ops.softmax_cross_entropy_loss(logits, labels, 0.0, True).dtype == jnp.float32
+        assert ops.softmax_cross_entropy_loss(logits, labels, 0.0, False).dtype == jnp.bfloat16
+
+
+class TestFocalLoss:
+    def test_grad_matches_autodiff(self):
+        from apex_tpu.ops import focal_loss as fl_fn
+        from apex_tpu.ops.focal_loss import _fl_sum
+
+        logits = jr.normal(K, (32, 80))
+        targets = jr.randint(jr.fold_in(K, 16), (32,), 0, 81)
+        loss = fl_fn(logits, targets, 80)
+        assert jnp.isfinite(loss)
+        g1 = jax.grad(lambda lg: fl_fn(lg, targets, 80) * 3.0)(logits)
+        g2 = jax.grad(lambda lg: _fl_sum(lg, targets, 80, 0.25, 2.0, 0.0) * 3.0)(logits)
+        np.testing.assert_allclose(g1, g2, atol=1e-5)
+
+
+@pytest.mark.pallas
+class TestPallasKernels:
+    """Interpret-mode runs of the real kernels on tiny shapes."""
+
+    def test_ln_kernel(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        x = jr.normal(K, (8, 128)) * 2 + 1
+        w = jnp.ones((128,)) * 1.1
+        b = jnp.zeros((128,)) + 0.2
+        np.testing.assert_allclose(
+            ops.fused_layer_norm(x, w, b), ref_layer_norm(x, w, b), atol=2e-6
+        )
+        g1 = jax.grad(lambda x, w, b: jnp.sum(jnp.sin(ops.fused_layer_norm(x, w, b))), (0, 1, 2))(x, w, b)
+        g2 = jax.grad(lambda x, w, b: jnp.sum(jnp.sin(ref_layer_norm(x, w, b))), (0, 1, 2))(x, w, b)
+        for a, e in zip(g1, g2):
+            np.testing.assert_allclose(a, e, atol=1e-5)
+
+    def test_softmax_kernel(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        x = jr.normal(K, (1, 2, 8, 128))
+        mask = jr.bernoulli(jr.fold_in(K, 17), 0.3, (1, 1, 8, 128))
+        ref = jax.nn.softmax(jnp.where(mask, -10000.0, x * 0.5), -1)
+        np.testing.assert_allclose(ops.scaled_masked_softmax(x, mask, 0.5), ref, atol=1e-6)
+
+    def test_causal_softmax_kernel(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        x = jr.normal(K, (2, 8, 128))
+        q = jnp.arange(8)[:, None]
+        kk = jnp.arange(128)[None, :]
+        ref = jax.nn.softmax(jnp.where(kk <= q, x * 1.5, -10000.0), -1)
+        np.testing.assert_allclose(
+            ops.scaled_upper_triang_masked_softmax(x, 1.5), ref, atol=1e-6
+        )
+
+    def test_matmul_kernel(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        x = jr.normal(K, (8, 128))
+        w = jr.normal(jr.fold_in(K, 18), (128, 128)) * 0.1
+        b = jr.normal(jr.fold_in(K, 19), (128,)) * 0.1
+        from apex_tpu.ops.pallas.matmul import matmul_bias_act
+
+        y = matmul_bias_act(x, w, b, activation="gelu", interpret=True)
+        ref = jax.nn.gelu(x @ w + b, approximate=True)
+        np.testing.assert_allclose(y, ref, atol=1e-5)
