@@ -228,6 +228,29 @@ def get_rank_info() -> str:
 
 # --- in-shard_map rank helpers ----------------------------------------------
 
+def shard_map(f, mesh=None, *, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` bound to the global mesh, with the
+    varying-manual-axes check off by default: Megatron-style TP code is full
+    of rank-dependent slices whose replication (post all-gather) the static
+    checker cannot prove — the same reason the reference asserts its own
+    invariants at runtime instead (e.g. ``distributed.py:340-348``).
+
+    The global mesh is resolved at *call* time so wrappers may be built
+    before ``initialize_model_parallel()`` and survive re-initialization."""
+    if mesh is not None:
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+
+    def call(*args, **kwargs):
+        return jax.shard_map(
+            f, mesh=get_mesh(), in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )(*args, **kwargs)
+
+    return call
+
+
 def axis_rank(axis: str) -> jax.Array:
     """Per-device rank along ``axis``; valid only inside shard_map/pjit with
     that axis bound (replaces get_*_rank, ``parallel_state.py:324+``)."""

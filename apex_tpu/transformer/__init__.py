@@ -1,0 +1,12 @@
+"""Megatron-style model parallelism, TPU-native.
+
+Re-design of ``apex.transformer`` (``apex/transformer/__init__.py:1-23``):
+tensor + pipeline parallel layers and schedules built on one
+``jax.sharding.Mesh`` (``apex_tpu.parallel.mesh`` is re-exported here as
+``parallel_state`` for API parity) instead of NCCL process groups.
+"""
+
+from apex_tpu.parallel import mesh as parallel_state  # noqa: F401
+from apex_tpu.transformer import tensor_parallel  # noqa: F401
+from apex_tpu.transformer import pipeline_parallel  # noqa: F401
+from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType, ModelType  # noqa: F401

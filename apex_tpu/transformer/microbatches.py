@@ -1,0 +1,146 @@
+"""Microbatch calculators.
+
+Port-equivalent of ``apex/transformer/microbatches.py:26-195`` (host-side
+bookkeeping, no device code): constant and ramped-up numbers of microbatches
+from (global_batch_size, micro_batch_size, data_parallel_size).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def build_num_microbatches_calculator(
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+    rampup_batch_size: Optional[List[int]] = None,
+):
+    """``build_num_microbatches_calculator`` (``microbatches.py:26-64``)."""
+    if rampup_batch_size is None:
+        return ConstantNumMicroBatches(
+            global_batch_size, micro_batch_size, data_parallel_size
+        )
+    if len(rampup_batch_size) != 3:
+        raise ValueError(
+            "rampup_batch_size must be [start_global_batch_size, increment, samples]"
+        )
+    return RampupBatchsizeNumMicroBatches(
+        int(rampup_batch_size[0]),
+        int(rampup_batch_size[1]),
+        int(rampup_batch_size[2]),
+        global_batch_size,
+        micro_batch_size,
+        data_parallel_size,
+    )
+
+
+class NumMicroBatchesCalculator:
+    def __init__(self):
+        self.num_micro_batches = None
+        self.current_global_batch_size = None
+
+    def get(self) -> int:
+        return self.num_micro_batches
+
+    def get_current_global_batch_size(self) -> int:
+        return self.current_global_batch_size
+
+    def update(self, consumed_samples, consistency_check):
+        pass
+
+
+class ConstantNumMicroBatches(NumMicroBatchesCalculator):
+    """``microbatches.py:88-106``."""
+
+    def __init__(self, global_batch_size, micro_batch_size, data_parallel_size):
+        super().__init__()
+        micro_batch_times_dp = micro_batch_size * data_parallel_size
+        if global_batch_size % micro_batch_times_dp != 0:
+            raise ValueError(
+                f"global batch size ({global_batch_size}) is not divisible by "
+                f"micro batch size ({micro_batch_size}) x data parallel size "
+                f"({data_parallel_size})"
+            )
+        self.num_micro_batches = global_batch_size // micro_batch_times_dp
+        self.current_global_batch_size = global_batch_size
+
+
+class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
+    """Linear ramp of the global batch size (``microbatches.py:109-195``)."""
+
+    def __init__(self, start_batch_size, batch_size_increment, ramup_samples,
+                 global_batch_size, micro_batch_size, data_parallel_size):
+        super().__init__()
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_size = data_parallel_size
+        self.start_batch_size = start_batch_size
+        self.batch_size_increment = batch_size_increment
+        self.ramup_samples = ramup_samples
+        self.global_batch_size = global_batch_size
+        self.micro_batch_times_data_parallel_size = micro_batch_size * data_parallel_size
+
+        diff = global_batch_size - start_batch_size
+        if diff < 0 or diff % batch_size_increment != 0:
+            raise ValueError(
+                "expected global batch size to be reachable from the start "
+                "batch size by increments"
+            )
+        num_increments = diff // batch_size_increment
+        self.rampup_samples_per_increment = self.ramup_samples / max(num_increments, 1)
+        self.update(0, False)
+
+    def update(self, consumed_samples, consistency_check):
+        if consumed_samples > self.ramup_samples:
+            self.current_global_batch_size = self.global_batch_size
+        else:
+            steps = int(consumed_samples / self.rampup_samples_per_increment)
+            self.current_global_batch_size = (
+                self.start_batch_size + steps * self.batch_size_increment
+            )
+            self.current_global_batch_size = min(
+                self.current_global_batch_size, self.global_batch_size
+            )
+        if consistency_check:
+            if self.current_global_batch_size % self.micro_batch_times_data_parallel_size:
+                raise RuntimeError(
+                    f"current global batch size ({self.current_global_batch_size}) "
+                    "is not divisible by micro-batch-size x data-parallel-size"
+                )
+        self.num_micro_batches = (
+            self.current_global_batch_size // self.micro_batch_times_data_parallel_size
+        )
+
+
+# global-singleton accessors (parity with pipeline_parallel/utils.py:58-104)
+_CALCULATOR: Optional[NumMicroBatchesCalculator] = None
+
+
+def setup_microbatch_calculator(
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+    rampup_batch_size: Optional[List[int]] = None,
+) -> None:
+    global _CALCULATOR
+    _CALCULATOR = build_num_microbatches_calculator(
+        global_batch_size, micro_batch_size, data_parallel_size, rampup_batch_size
+    )
+
+
+def get_num_microbatches() -> int:
+    if _CALCULATOR is None:
+        raise RuntimeError("microbatch calculator is not set up")
+    return _CALCULATOR.get()
+
+
+def get_current_global_batch_size() -> int:
+    if _CALCULATOR is None:
+        raise RuntimeError("microbatch calculator is not set up")
+    return _CALCULATOR.get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples, consistency_check=True) -> None:
+    if _CALCULATOR is None:
+        raise RuntimeError("microbatch calculator is not set up")
+    _CALCULATOR.update(consumed_samples, consistency_check)

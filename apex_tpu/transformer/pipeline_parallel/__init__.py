@@ -1,0 +1,27 @@
+"""Pipeline parallelism over the mesh ``pp`` axis.
+
+Re-design of ``apex/transformer/pipeline_parallel/``: the reference drives
+stage-to-stage tensor exchange with ``batch_isend_irecv`` + CUDA syncs
+(``p2p_communication.py:29-67,166``) and hand-written 1F1B/interleaved
+schedules (``schedules/``); here stages are SPMD programs over the ``pp``
+mesh axis, exchange is ``lax.ppermute``, the schedule is a ``lax.scan`` over
+pipeline ticks, and the *backward* schedule falls out of ``jax.grad`` of the
+scanned forward (with ``jax.checkpoint`` controlling the memory/recompute
+trade-off that 1F1B exists to manage).
+"""
+
+from apex_tpu.transformer.pipeline_parallel.p2p_communication import (  # noqa: F401
+    recv_backward,
+    recv_forward,
+    send_backward,
+    send_forward,
+    send_backward_recv_forward,
+    send_forward_recv_backward,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving,
+    forward_backward_pipelining_with_interleaving,
+    get_forward_backward_func,
+    pipeline_spmd_forward,
+)

@@ -1,0 +1,66 @@
+"""Stage-to-stage exchange primitives.
+
+Re-design of ``apex/transformer/pipeline_parallel/p2p_communication.py``.
+The reference composes 8 helpers (``recv_forward`` …
+``send_forward_backward_recv_forward_backward``, ``:187-409``) over one
+``_communicate`` that batches isend/irecv, guards a race with
+``torch.cuda.synchronize()`` (``:166``), and scatter-gathers activations
+across TP ranks to cut P2P volume (``:120-123,155-182``).
+
+On TPU all of that is one primitive: ``lax.ppermute`` along the ``pp`` mesh
+axis — a compiled ICI collective with no race to guard (XLA orders it) and
+no need for the scatter-gather trick (ICI links are not shared with a TP
+NVLink domain the same way; and XLA already overlaps the permute with
+compute). The helpers keep the reference's names so schedule code reads the
+same. All run inside ``shard_map`` with ``axis_name`` bound.
+
+Note the SPMD difference: a ppermute *rotation* moves every stage's tensor
+simultaneously; "first/last stage" masking is the caller's job (the
+schedules mask by tick index), matching how the reference passes
+``recv_prev=False`` at the pipeline ends.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from apex_tpu.parallel import mesh as mesh_lib
+
+PyTree = Any
+
+
+def _rotate(x: PyTree, axis_name: str, shift: int) -> PyTree:
+    size = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % size) for i in range(size)]
+    return jax.tree.map(lambda a: jax.lax.ppermute(a, axis_name, perm), x)
+
+
+def send_forward(x: PyTree, axis_name: str = mesh_lib.PIPELINE_AXIS) -> PyTree:
+    """Rotate activations to the next stage (``send_forward`` ``:232-248``
+    fused with the matching ``recv_forward`` ``:187-207`` — in SPMD the send
+    and the receive are the same collective)."""
+    return _rotate(x, axis_name, +1)
+
+
+def send_backward(g: PyTree, axis_name: str = mesh_lib.PIPELINE_AXIS) -> PyTree:
+    """Rotate gradients to the previous stage (``send_backward`` ``:250-266``
+    + ``recv_backward`` ``:210-229``)."""
+    return _rotate(g, axis_name, -1)
+
+
+# aliases completing the reference's helper set; each pair is one rotation
+recv_forward = send_forward
+recv_backward = send_backward
+
+
+def send_forward_recv_backward(x: PyTree, g: PyTree, axis_name: str = mesh_lib.PIPELINE_AXIS):
+    """``:269-289``: both directions in one step (two independent permutes —
+    XLA runs them concurrently on opposite ring directions)."""
+    return _rotate(x, axis_name, +1), _rotate(g, axis_name, -1)
+
+
+def send_backward_recv_forward(g: PyTree, x: PyTree, axis_name: str = mesh_lib.PIPELINE_AXIS):
+    """``:292-312``."""
+    return _rotate(g, axis_name, -1), _rotate(x, axis_name, +1)

@@ -1,0 +1,246 @@
+"""Pipeline schedules: no-pipelining, 1F1B-equivalent, interleaved.
+
+Re-design of ``apex/transformer/pipeline_parallel/schedules/`` (dispatcher
+``schedules/__init__.py:22-35``; no-pipelining
+``fwd_bwd_no_pipelining.py:31``; 1F1B
+``fwd_bwd_pipelining_without_interleaving.py:155-345``; interleaved
+``fwd_bwd_pipelining_with_interleaving.py:25-375``).
+
+The reference hand-schedules warmup/steady/cooldown phases, because with
+eager CUDA + autograd the *order* of forward and backward microbatches
+determines peak memory (1F1B exists to bound live activations at
+``pp_size`` microbatches instead of ``num_microbatches``).
+
+The TPU-native design inverts this: the forward pipeline is a single
+``lax.scan`` over ticks inside ``shard_map`` — each tick every stage runs
+its layer block and a ``ppermute`` rotates activations one stage down the
+ring. ``jax.grad`` of that scan *is* the backward pipeline (cooldown order
+falls out of reverse-mode). The memory knob that 1F1B turns is here
+``jax.checkpoint`` on the stage function:
+
+* no remat           → GPipe-like memory (all ticks' residuals live);
+* remat per stage    → 1F1B-class memory (per-tick activations only,
+  recomputed during the backward sweep) — this is what
+  ``forward_backward_pipelining_without_interleaving`` applies;
+* remat + offload policies → beyond the reference.
+
+Utilization note: warmup/cooldown bubbles are identical to the reference's
+(pipeline theory doesn't change); the interleaved variant trades a longer
+fill (v·S−1 ticks vs S−1) for per-tick work that XLA can overlap across the
+v chunk computations — see ``pipeline_spmd_forward``'s ``virtual_chunks``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel import mesh as mesh_lib
+
+PyTree = Any
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _broadcast_from_first(x, axis_name):
+    """Replicate stage 0's value to all pp ranks. Forward is a masked psum;
+    the hand-written transpose masks the cotangent back to stage 0 — the
+    conservative psum-transpose (psum again) would scale gradients by
+    pp_size because every stage holds a replicated copy of the loss."""
+    rank = jax.lax.axis_index(axis_name)
+    return jax.lax.psum(jnp.where(rank == 0, x, 0.0), axis_name)
+
+
+def _bcast_fwd(x, axis_name):
+    return _broadcast_from_first(x, axis_name), None
+
+
+def _bcast_bwd(axis_name, _, g):
+    rank = jax.lax.axis_index(axis_name)
+    return (jnp.where(rank == 0, g, 0.0),)
+
+
+_broadcast_from_first.defvjp(_bcast_fwd, _bcast_bwd)
+
+
+def pipeline_spmd_forward(
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    stage_params: PyTree,
+    microbatches: jax.Array,
+    *,
+    axis_name: str = mesh_lib.PIPELINE_AXIS,
+    virtual_chunks: int = 1,
+    remat: bool = True,
+):
+    """Run the SPMD pipeline forward; returns per-microbatch outputs of the
+    final stage (shape = microbatches.shape with the feature dims of the
+    stage output), valid on the stage that holds them (masked elsewhere).
+
+    ``stage_fn(params, x) -> y`` must keep ``y.shape == x.shape`` (uniform
+    inter-stage activations — the reference has the same constraint via its
+    fixed ``tensor_shape``, ``fwd_bwd_pipelining_without_interleaving.py:187``).
+
+    ``microbatches``: (M, ...) — the *embedded* activations entering stage 0.
+    Embedding/loss heads run outside the pipelined middle (on TPU the
+    embedding is cheap to compute replicated; the reference instead gates
+    pre_process/post_process per stage, ``schedules/common.py:29-148``).
+
+    With ``virtual_chunks=v > 1``, ``stage_params`` must have a leading axis
+    of size v (this device's chunks, virtual stage k = c·S + rank for chunk
+    c) — the interleaved schedule (``parallel_state.py:135-145``).
+    """
+    S = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    v = virtual_chunks
+    mb_shape = microbatches.shape[1:]
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    total_stages = v * S
+    T = M + total_stages - 1
+
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        state, outputs = carry  # state: (v, *mb), outputs: (M, *mb)
+        # inject microbatch t on (stage 0, chunk 0)
+        inject = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False
+        )
+        x0 = jnp.where(rank == 0, inject, state[0])
+        state = state.at[0].set(x0)
+
+        if v == 1:
+            y = fn(stage_params, state[0])[None]
+        else:
+            y = jax.vmap(fn)(stage_params, state)
+
+        # rotate every chunk's output to the next device on the ring
+        sent = jax.lax.ppermute(y, axis_name, perm)
+
+        # device 0 receives: chunk c takes the wrap-around of chunk c-1;
+        # chunk v-1's wrap-around is the pipeline's final output
+        final = sent[v - 1]
+        shifted = jnp.roll(sent, 1, axis=0)
+        state_next = jnp.where(rank == 0, shifted, sent)
+
+        # collect final outputs: microbatch m exits at tick m + total-1,
+        # arriving (post-rotate) at device 0
+        out_idx = jnp.clip(t - (total_stages - 1), 0, M - 1)
+        valid = (t >= total_stages - 1) & (rank == 0)
+        updated = jax.lax.dynamic_update_index_in_dim(
+            outputs, final.astype(outputs.dtype), out_idx, 0
+        )
+        outputs = jnp.where(valid, updated, outputs)
+        return (state_next, outputs), None
+
+    state0 = jnp.zeros((v,) + mb_shape, microbatches.dtype)
+    outputs0 = jnp.zeros((M,) + mb_shape, microbatches.dtype)
+    (_, outputs), _ = jax.lax.scan(tick, (state0, outputs0), jnp.arange(T))
+    # replicate the collected outputs (they live on device 0 post-rotation)
+    return _broadcast_from_first(outputs, axis_name)
+
+
+def forward_backward_no_pipelining(
+    loss_fn: Callable[[PyTree, Any], jax.Array],
+    params: PyTree,
+    microbatches: Any,
+    *,
+    grad_reduce_axis: Optional[str] = None,
+):
+    """Grad accumulation over microbatches without pipelining
+    (``fwd_bwd_no_pipelining.py:31``): the reference defers the DDP grad
+    sync to the last microbatch; here grads accumulate in a scan and the
+    single ``psum`` (if ``grad_reduce_axis``) happens once at the end —
+    the same once-per-step communication.
+
+    ``loss_fn(params, microbatch) -> scalar mean loss``; returns
+    (mean loss, grads averaged over microbatches).
+    """
+    vg = jax.value_and_grad(loss_fn)
+
+    def step(acc, mb):
+        loss, g = vg(params, mb)
+        acc_loss, acc_g = acc
+        return (acc_loss + loss, jax.tree.map(jnp.add, acc_g, g)), None
+
+    zero = (jnp.zeros(()), jax.tree.map(jnp.zeros_like, params))
+    (loss_sum, grad_sum), _ = jax.lax.scan(step, zero, microbatches)
+    n = jax.tree.leaves(microbatches)[0].shape[0]
+    loss = loss_sum / n
+    grads = jax.tree.map(lambda g: g / n, grad_sum)
+    if grad_reduce_axis is not None:
+        loss = jax.lax.pmean(loss, grad_reduce_axis)
+        grads = jax.lax.pmean(grads, grad_reduce_axis)
+    return loss, grads
+
+
+def forward_backward_pipelining_without_interleaving(
+    stage_fn: Callable,
+    loss_head: Callable[[jax.Array, Any], jax.Array],
+    stage_params: PyTree,
+    microbatches: jax.Array,
+    targets: Any,
+    *,
+    axis_name: str = mesh_lib.PIPELINE_AXIS,
+):
+    """1F1B-equivalent schedule (``fwd_bwd_pipelining_without_interleaving.py:155``):
+    pipelined forward via scan+ppermute, backward from autodiff, stage remat
+    bounding live activations the way 1F1B's eager interleave does.
+
+    ``loss_head(outputs_m, targets_m) -> scalar`` maps a final-stage output
+    microbatch + its targets to a loss (the reference's last-stage
+    ``loss_func``, ``schedules/common.py:297-301``).
+    Returns (mean loss, grads wrt stage_params).
+    """
+
+    def full_loss(p):
+        outs = pipeline_spmd_forward(
+            stage_fn, p, microbatches, axis_name=axis_name, remat=True
+        )
+        losses = jax.vmap(loss_head)(outs, targets)
+        return jnp.mean(losses)
+
+    return jax.value_and_grad(full_loss)(stage_params)
+
+
+def forward_backward_pipelining_with_interleaving(
+    stage_fn: Callable,
+    loss_head: Callable,
+    stage_params_chunks: PyTree,
+    microbatches: jax.Array,
+    targets: Any,
+    *,
+    virtual_chunks: int,
+    axis_name: str = mesh_lib.PIPELINE_AXIS,
+):
+    """Interleaved (virtual-stage) schedule
+    (``fwd_bwd_pipelining_with_interleaving.py:25-375``): each device holds
+    ``virtual_chunks`` model chunks; activations make ``virtual_chunks``
+    loops around the device ring. ``stage_params_chunks`` leaves carry a
+    leading (virtual_chunks,) axis."""
+
+    def full_loss(p):
+        outs = pipeline_spmd_forward(
+            stage_fn, p, microbatches,
+            axis_name=axis_name, virtual_chunks=virtual_chunks, remat=True,
+        )
+        losses = jax.vmap(loss_head)(outs, targets)
+        return jnp.mean(losses)
+
+    return jax.value_and_grad(full_loss)(stage_params_chunks)
+
+
+def get_forward_backward_func(
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    pipeline_model_parallel_size: int = 1,
+):
+    """Dispatcher with the reference's selection logic
+    (``schedules/__init__.py:22-35``)."""
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            return forward_backward_pipelining_with_interleaving
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining

@@ -1,0 +1,91 @@
+"""Vocab-parallel softmax cross-entropy.
+
+Re-design of ``apex/transformer/tensor_parallel/cross_entropy.py:23-103``.
+The algorithm ports directly — it is three collectives over the tp axis:
+
+1. ``pmax`` of per-shard logit maxima (reference ``all_reduce(MAX)``, :29);
+2. ``psum`` of the target logit, where only the shard owning the target id
+   contributes (reference masked gather + all_reduce, :40-58);
+3. ``psum`` of per-shard ``sum(exp)`` (reference :60-66).
+
+Backward reproduces the reference's saved-softmax gradient
+(``:80-99``): ``d logits = (softmax - onehot_masked) * dloss`` on each
+shard, with label smoothing exactly as the reference's ``label_smoothing``
+branch computes it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel import mesh as mesh_lib
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def vocab_parallel_cross_entropy(
+    logits: jax.Array,
+    target: jax.Array,
+    label_smoothing: float = 0.0,
+    axis_name: str = mesh_lib.TENSOR_AXIS,
+) -> jax.Array:
+    """Per-token loss; ``logits`` are this shard's (..., V/tp) slice, target
+    is the *global* token id. Must run inside shard_map with ``axis_name``."""
+    loss, _ = _vce_fwd(logits, target, label_smoothing, axis_name)
+    return loss
+
+
+def _shard_info(logits, axis_name):
+    per = logits.shape[-1]
+    rank = jax.lax.axis_index(axis_name)
+    return per, rank * per
+
+
+def _vce_fwd(logits, target, label_smoothing, axis_name):
+    per, start = _shard_info(logits, axis_name)
+    lf = logits.astype(jnp.float32)
+
+    # 1. global max for stability
+    m = jax.lax.pmax(jnp.max(lf, axis=-1), axis_name)
+    lf = lf - m[..., None]
+
+    # 2. target logit: only the owning shard contributes
+    local_t = target - start
+    in_shard = (local_t >= 0) & (local_t < per)
+    t_idx = jnp.where(in_shard, local_t, 0)
+    t_logit = jnp.take_along_axis(lf, t_idx[..., None], axis=-1)[..., 0]
+    t_logit = jax.lax.psum(jnp.where(in_shard, t_logit, 0.0), axis_name)
+
+    # 3. global sum-exp
+    sum_exp = jax.lax.psum(jnp.sum(jnp.exp(lf), axis=-1), axis_name)
+    log_sum_exp = jnp.log(sum_exp)
+    loss = log_sum_exp - t_logit
+
+    if label_smoothing > 0:
+        # reference's smoothing branch (:68-77): loss = (1-ε)·nll + ε/V · Σ nll_i
+        vocab = per * jax.lax.axis_size(axis_name)
+        smooth = label_smoothing / vocab
+        sum_logits = jax.lax.psum(jnp.sum(lf, axis=-1), axis_name)
+        loss = (1.0 - label_smoothing) * loss + smooth * (
+            vocab * log_sum_exp - sum_logits
+        )
+
+    softmax = jnp.exp(lf) / sum_exp[..., None]
+    return loss, (softmax, in_shard, t_idx, logits.dtype == jnp.float32)
+
+
+def _vce_bwd(label_smoothing, axis_name, res, dloss):
+    softmax, in_shard, t_idx, _ = res
+    per = softmax.shape[-1]
+    onehot = jax.nn.one_hot(t_idx, per, dtype=jnp.float32) * in_shard[..., None]
+    if label_smoothing > 0:
+        vocab = per * jax.lax.axis_size(axis_name)
+        grad = softmax - (1.0 - label_smoothing) * onehot - label_smoothing / vocab
+    else:
+        grad = softmax - onehot
+    return grad * dloss[..., None], None
+
+
+vocab_parallel_cross_entropy.defvjp(_vce_fwd, _vce_bwd)

@@ -1,0 +1,43 @@
+"""TP data distribution.
+
+Re-design of ``apex/transformer/tensor_parallel/data.py``: the reference
+broadcasts the data batch from TP rank 0 to the other TP ranks of each model
+replica (``broadcast_data``, ``data.py:80``, with dtype/size checks) because
+each rank has its own dataloader process.
+
+Under SPMD there is one logical program: placing a batch with a sharding
+that is *replicated over tp* IS the broadcast — XLA materializes it on every
+tp rank of the replica. ``broadcast_data`` here therefore builds exactly that
+sharding and device_puts the host batch once per process.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu.parallel import mesh as mesh_lib
+
+PyTree = Any
+
+
+def data_sharding(mesh=None, batch_axes: Sequence[str] = (mesh_lib.DATA_AXIS,)):
+    """Sharding for an input batch: batch dim split over dp, replicated over
+    tp/pp — the SPMD form of 'rank 0 broadcasts to the TP group'."""
+    mesh = mesh or mesh_lib.get_mesh()
+    return NamedSharding(mesh, P(tuple(batch_axes)))
+
+
+def broadcast_data(keys: Sequence[str], data: Dict[str, Any], dtype=None, mesh=None) -> Dict[str, jax.Array]:
+    """Place ``data[k]`` for k in keys with batch-over-dp, replicated-over-tp
+    sharding (semantics of ``data.py:80``'s broadcast; the dtype check
+    mirrors its ``_check_data_types``)."""
+    sharding = data_sharding(mesh)
+    out = {}
+    for k in keys:
+        arr = jnp.asarray(data[k], dtype=dtype)
+        out[k] = jax.device_put(arr, sharding)
+    return out

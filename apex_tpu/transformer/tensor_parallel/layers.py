@@ -1,0 +1,185 @@
+"""Tensor-parallel linear and embedding layers.
+
+Re-design of ``apex/transformer/tensor_parallel/layers.py``:
+
+* ``ColumnParallelLinear`` (``layers.py:377-538``) — weight sharded along the
+  output dim; forward is a local matmul on a copied input; optional
+  all-gather of the output.
+* ``RowParallelLinear`` (``layers.py:541-663``) — weight sharded along the
+  input dim; forward is a local matmul followed by an all-reduce.
+* ``VocabParallelEmbedding`` (``layers.py:154-256``) — vocabulary sharded;
+  out-of-shard token ids are masked to zero locally, then psum combines.
+
+The reference's ``LinearWithGradAccumulationAndAsyncAllreduce``
+(``layers.py:259-315``) overlaps the input-grad all-reduce with the wgrad
+GEMM on a side stream; under XLA the same overlap comes from the
+latency-hiding scheduler — the ``copy_to``/``reduce_from`` mappings place
+the collectives, XLA schedules them. Its fused ``main_grad`` accumulation
+(``fused_weight_gradient_mlp_cuda``) corresponds to grad-accumulation buffer
+donation in the training step (see pipeline_parallel.schedules).
+
+Layers are plain param-pytree modules meant to be called inside
+``shard_map`` with the ``tp`` axis bound; ``init`` takes the *tp rank* so
+each shard initializes its own slice (the reference's
+``_initialize_affine_weight_gpu`` gives each rank a distinct RNG stream —
+here the key is folded with the rank, see random.py).
+
+Sequence parallelism (Megatron-style, absent in the reference — SURVEY.md
+§2.3): ``sequence_parallel=True`` on either linear switches the boundary
+collectives to all-gather/reduce-scatter over the sequence dim, the SP
+extension the survey calls out as new work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel import mesh as mesh_lib
+from apex_tpu.transformer.tensor_parallel import mappings
+from apex_tpu.transformer.tensor_parallel.utils import VocabUtility, divide
+
+
+def _default_init(key, shape, dtype):
+    fan_in = shape[-1] if len(shape) > 1 else shape[0]
+    bound = 1.0 / jnp.sqrt(fan_in)
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+# --- sequence-parallel collectives (SP extension) -----------------------------
+
+def _sp_all_gather_seq(x, axis_name):
+    """Gather the sequence dim (axis 0 of (s, b, h)) entering a TP matmul."""
+    return jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def _sp_reduce_scatter_seq(x, axis_name):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+
+
+@dataclasses.dataclass
+class ColumnParallelLinear:
+    """Y = X A^T + b with A sharded along output (rows of the torch-layout
+    weight). ``gather_output`` matches the reference flag (default True
+    there; Megatron uses False + downstream RowParallel)."""
+
+    input_size: int
+    output_size: int
+    bias: bool = True
+    gather_output: bool = False
+    sequence_parallel: bool = False
+    init_method: Callable = _default_init
+    axis_name: str = mesh_lib.TENSOR_AXIS
+    tp_size: int = 1
+
+    @property
+    def output_size_per_partition(self) -> int:
+        return divide(self.output_size, self.tp_size)
+
+    def init(self, key, rank: int = 0, dtype=jnp.float32) -> dict:
+        """Per-shard params; ``rank`` folds into the key so shards differ
+        (the reference's model-parallel RNG stream, random.py:200)."""
+        k = jax.random.fold_in(key, rank)
+        params = {
+            "weight": self.init_method(
+                k, (self.output_size_per_partition, self.input_size), dtype
+            )
+        }
+        if self.bias:
+            params["bias"] = jnp.zeros((self.output_size_per_partition,), dtype)
+        return params
+
+    def __call__(self, params: dict, x: jax.Array) -> jax.Array:
+        if self.sequence_parallel:
+            x = _sp_all_gather_seq(x, self.axis_name)
+        else:
+            x = mappings.copy_to_tensor_model_parallel_region(x, self.axis_name)
+        y = jnp.dot(x, params["weight"].T)
+        if self.bias:
+            y = y + params["bias"]
+        if self.gather_output:
+            y = mappings.gather_from_tensor_model_parallel_region(y, self.axis_name)
+        return y
+
+
+@dataclasses.dataclass
+class RowParallelLinear:
+    """Y = X A^T + b with A sharded along input; forward all-reduces the
+    partial products (``layers.py:541-663``)."""
+
+    input_size: int
+    output_size: int
+    bias: bool = True
+    input_is_parallel: bool = True
+    sequence_parallel: bool = False
+    init_method: Callable = _default_init
+    axis_name: str = mesh_lib.TENSOR_AXIS
+    tp_size: int = 1
+
+    @property
+    def input_size_per_partition(self) -> int:
+        return divide(self.input_size, self.tp_size)
+
+    def init(self, key, rank: int = 0, dtype=jnp.float32) -> dict:
+        k = jax.random.fold_in(key, rank)
+        params = {
+            "weight": self.init_method(
+                k, (self.output_size, self.input_size_per_partition), dtype
+            )
+        }
+        if self.bias:
+            # bias is replicated; added after the reduce (layers.py:663)
+            params["bias"] = jnp.zeros((self.output_size,), dtype)
+        return params
+
+    def __call__(self, params: dict, x: jax.Array) -> jax.Array:
+        if not self.input_is_parallel:
+            x = mappings.scatter_to_tensor_model_parallel_region(x, self.axis_name)
+        y = jnp.dot(x, params["weight"].T)
+        if self.sequence_parallel:
+            y = _sp_reduce_scatter_seq(y, self.axis_name)
+        else:
+            y = mappings.reduce_from_tensor_model_parallel_region(y, self.axis_name)
+        if self.bias:
+            y = y + params["bias"]
+        return y
+
+
+@dataclasses.dataclass
+class VocabParallelEmbedding:
+    """Embedding with the vocabulary dimension sharded
+    (``layers.py:154-256``): each shard holds rows
+    [rank·V/tp, (rank+1)·V/tp); out-of-range ids produce zeros locally and
+    the psum combines shards."""
+
+    num_embeddings: int
+    embedding_dim: int
+    init_method: Callable = _default_init
+    axis_name: str = mesh_lib.TENSOR_AXIS
+    tp_size: int = 1
+
+    @property
+    def num_embeddings_per_partition(self) -> int:
+        return divide(self.num_embeddings, self.tp_size)
+
+    def init(self, key, rank: int = 0, dtype=jnp.float32) -> dict:
+        k = jax.random.fold_in(key, rank)
+        return {
+            "weight": self.init_method(
+                k, (self.num_embeddings_per_partition, self.embedding_dim), dtype
+            )
+        }
+
+    def __call__(self, params: dict, token_ids: jax.Array) -> jax.Array:
+        rank = jax.lax.axis_index(self.axis_name)
+        per = self.num_embeddings_per_partition
+        start = rank * per
+        local = token_ids - start
+        in_shard = (local >= 0) & (local < per)
+        local = jnp.where(in_shard, local, 0)
+        emb = jnp.take(params["weight"], local, axis=0)
+        emb = jnp.where(in_shard[..., None], emb, 0.0)
+        return mappings.reduce_from_tensor_model_parallel_region(emb, self.axis_name)

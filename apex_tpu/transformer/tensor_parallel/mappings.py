@@ -1,0 +1,117 @@
+"""The four TP collectives as differentiable functions.
+
+Re-design of ``apex/transformer/tensor_parallel/mappings.py:23-141``, where
+each mapping is an autograd Function pairing a forward collective with its
+transpose in backward:
+
+| mapping  | forward            | backward           |
+|----------|--------------------|--------------------|
+| copy     | identity           | all-reduce         |
+| reduce   | all-reduce (psum)  | identity           |
+| scatter  | split last dim     | all-gather         |
+| gather   | all-gather last dim| split              |
+
+JAX's ``psum``/``all_gather``/dynamic-slice already have these transposes
+under autodiff, but *not* in matched pairs (e.g. ``psum``'s gradient is
+another psum, not identity — the ``psum(psum(x))`` trap). We pin the exact
+Megatron semantics with ``custom_vjp`` so gradients match the reference
+contract. All functions must run inside ``shard_map`` with ``axis`` bound.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel import mesh as mesh_lib
+
+
+def _split_local(x: jax.Array, axis_name: str) -> jax.Array:
+    """This rank's slice of the last dimension (mappings.py:79-90)."""
+    size = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    chunk = x.shape[-1] // size
+    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=x.ndim - 1)
+
+
+def _gather_last(x: jax.Array, axis_name: str) -> jax.Array:
+    """All-gather along the last dim (mappings.py:92-105)."""
+    return jax.lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_model_parallel_region(x, axis_name=mesh_lib.TENSOR_AXIS):
+    """Identity forward, all-reduce backward (``_CopyToModelParallelRegion``,
+    ``mappings.py:108-117``): marks the point where a replicated activation
+    enters the TP region."""
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    del axis_name
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (jax.lax.psum(g, axis_name),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(
+    lambda x, axis_name: _copy_fwd(x, axis_name), _copy_bwd
+)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_model_parallel_region(x, axis_name=mesh_lib.TENSOR_AXIS):
+    """All-reduce forward, identity backward (``_ReduceFromModelParallelRegion``,
+    ``mappings.py:119-128``)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_tensor_model_parallel_region(x, axis_name=mesh_lib.TENSOR_AXIS):
+    """Split last dim forward, all-gather backward
+    (``_ScatterToModelParallelRegion``, ``mappings.py:130-139``)."""
+    return _split_local(x, axis_name)
+
+
+def _scatter_fwd(x, axis_name):
+    return _split_local(x, axis_name), None
+
+
+def _scatter_bwd(axis_name, _, g):
+    return (_gather_last(g, axis_name),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_model_parallel_region(x, axis_name=mesh_lib.TENSOR_AXIS):
+    """All-gather last dim forward, split backward
+    (``_GatherFromModelParallelRegion``, ``mappings.py:141-150``)."""
+    return _gather_last(x, axis_name)
+
+
+def _gather_fwd(x, axis_name):
+    return _gather_last(x, axis_name), None
+
+
+def _gather_bwd(axis_name, _, g):
+    return (_split_local(g, axis_name),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)

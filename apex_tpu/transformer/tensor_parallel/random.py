@@ -1,0 +1,106 @@
+"""Model-parallel RNG streams + activation checkpointing.
+
+Re-design of ``apex/transformer/tensor_parallel/random.py``. The reference
+maintains named CUDA RNG states (``CudaRNGStatesTracker``, ``random.py:120``)
+so dropout inside TP regions differs per rank while data-parallel replicas
+agree, and an activation-checkpoint Function that saves/restores those states
+around recompute (``CheckpointFunction`` ``random.py:233``).
+
+In JAX both problems are key-plumbing:
+
+* a *named stream* is ``jax.random.fold_in`` of a base key with a stream id;
+* the model-parallel stream folds in ``axis_index('tp')`` so TP ranks draw
+  different bits (``model_parallel_cuda_manual_seed``'s
+  ``seed + 2718 + tp_rank`` offset, ``random.py:195-230``);
+* recompute with identical randomness is ``jax.checkpoint`` — keys are
+  explicit inputs, so the recomputed dropout is bitwise-identical by
+  construction; no state save/restore exists to get wrong.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel import mesh as mesh_lib
+
+_MODEL_PARALLEL_RNG = "model-parallel-rng"  # reference stream name (random.py:74)
+_DATA_PARALLEL_OFFSET = 0
+_MODEL_PARALLEL_OFFSET = 2718  # reference's tensor-model-parallel seed offset
+
+
+def model_parallel_rng_key(
+    key: jax.Array, axis_name: str = mesh_lib.TENSOR_AXIS
+) -> jax.Array:
+    """Key for the 'model-parallel-rng' stream: distinct per tp rank,
+    shared across dp replicas. Must run inside shard_map."""
+    key = jax.random.fold_in(key, _MODEL_PARALLEL_OFFSET)
+    return jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+
+
+class RngTracker:
+    """API-parity wrapper over key folding (``CudaRNGStatesTracker``,
+    ``random.py:120-193``): ``add`` registers named streams, ``fork``
+    yields the stream's key for a region."""
+
+    def __init__(self, base_key: Optional[jax.Array] = None):
+        self._streams: dict = {}
+        self._base = base_key
+
+    def reset(self):
+        self._streams.clear()
+
+    def get_states(self):
+        return dict(self._streams)
+
+    def set_states(self, states):
+        self._streams = dict(states)
+
+    def add(self, name: str, seed: int):
+        if name in self._streams:
+            raise RuntimeError(f"rng stream {name} already exists")
+        self._streams[name] = jax.random.PRNGKey(seed)
+
+    def key(self, name: str = _MODEL_PARALLEL_RNG, fold_axis: Optional[str] = None):
+        if name not in self._streams:
+            raise RuntimeError(f"rng stream {name} is not added")
+        k = self._streams[name]
+        if fold_axis is not None:
+            k = jax.random.fold_in(k, jax.lax.axis_index(fold_axis))
+        return k
+
+
+_TRACKER = RngTracker()
+
+
+def get_rng_tracker() -> RngTracker:
+    """``get_cuda_rng_tracker`` analog (``random.py:195-198``)."""
+    return _TRACKER
+
+
+def model_parallel_seed(seed: int, tracker: Optional[RngTracker] = None) -> None:
+    """``model_parallel_cuda_manual_seed`` (``random.py:200-230``): installs
+    the default + model-parallel streams."""
+    t = tracker or _TRACKER
+    t.reset()
+    t.add("data-parallel-rng", seed + _DATA_PARALLEL_OFFSET)
+    t.add(_MODEL_PARALLEL_RNG, seed + _MODEL_PARALLEL_OFFSET)
+
+
+def checkpoint(fn: Callable, *args, policy=None, prevent_cse: bool = True):
+    """Activation checkpointing (``CheckpointFunction``/``checkpoint()``,
+    ``random.py:233-320``): recompute ``fn`` in backward. RNG keys passed as
+    arguments are replayed exactly; ``policy`` is a
+    ``jax.checkpoint_policies`` entry (the analog of the reference's
+    ``distribute_saved_activations`` memory knob — what to keep vs
+    recompute)."""
+    wrapped = jax.checkpoint(fn, policy=policy, prevent_cse=prevent_cse)
+    return wrapped(*args)
+
+
+def checkpoint_wrapper(fn: Callable, policy=None) -> Callable:
+    """Decorator form, for wrapping transformer blocks."""
+    return jax.checkpoint(fn, policy=policy)

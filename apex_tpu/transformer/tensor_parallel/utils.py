@@ -1,0 +1,45 @@
+"""TP utility helpers — parity with ``apex/transformer/tensor_parallel/utils.py``."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(x: jax.Array, num_partitions: int) -> Tuple[jax.Array, ...]:
+    """Static split (``utils.py``'s helper of the same name)."""
+    chunk = divide(x.shape[-1], num_partitions)
+    return tuple(
+        jax.lax.slice_in_dim(x, i * chunk, (i + 1) * chunk, axis=x.ndim - 1)
+        for i in range(num_partitions)
+    )
+
+
+class VocabUtility:
+    """Vocab-shard index ranges (``tensor_parallel/utils.py`` VocabUtility)."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+        per_partition_vocab_size: int, rank, world_size: int
+    ):
+        first = rank * per_partition_vocab_size
+        return first, first + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(global_vocab_size: int, rank, world_size: int):
+        per_partition = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per_partition, rank, world_size
+        )

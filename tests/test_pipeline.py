@@ -1,0 +1,218 @@
+"""Pipeline-parallel schedule tests.
+
+Mirrors the reference's ``tests/L0/run_transformer/test_pipeline_parallel_fwd_bwd.py``:
+each schedule's loss and gradients are compared against the serial
+(unpipelined) execution of the same stages.
+"""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel import mesh as mesh_lib
+from apex_tpu.transformer import microbatches as mb_lib
+from apex_tpu.transformer.pipeline_parallel import schedules
+
+K = jr.PRNGKey(11)
+HID = 16
+
+
+def stage_fn(params, x):
+    """One pipeline stage: a residual MLP block (uniform activation shape)."""
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return x + h @ params["w2"]
+
+
+def make_stage_params(key, n_stages):
+    def one(k):
+        k1, k2 = jr.split(k)
+        return {
+            "w1": jr.normal(k1, (HID, HID)) * 0.3,
+            "b1": jnp.zeros((HID,)),
+            "w2": jr.normal(k2, (HID, HID)) * 0.3,
+        }
+    return [one(jr.fold_in(key, i)) for i in range(n_stages)]
+
+
+def serial_forward(stage_params_list, x):
+    for p in stage_params_list:
+        x = stage_fn(p, x)
+    return x
+
+
+def stack_params(plist):
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *plist)
+
+
+class TestMicrobatchCalculator:
+    def test_constant(self):
+        mb_lib.setup_microbatch_calculator(64, 4, 2)
+        assert mb_lib.get_num_microbatches() == 8
+        assert mb_lib.get_current_global_batch_size() == 64
+
+    def test_constant_divisibility_error(self):
+        with pytest.raises(ValueError):
+            mb_lib.build_num_microbatches_calculator(10, 4, 2)
+
+    def test_rampup(self):
+        c = mb_lib.build_num_microbatches_calculator(
+            64, 4, 2, rampup_batch_size=[16, 8, 600]
+        )
+        assert c.get_current_global_batch_size() == 16
+        c.update(300, False)
+        assert c.get_current_global_batch_size() == 40
+        c.update(601, False)
+        assert c.get_current_global_batch_size() == 64
+        assert c.get() == 8
+
+
+class TestPipelineSPMD:
+    def test_forward_matches_serial(self):
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=4)
+        plist = make_stage_params(K, 4)
+        stacked = stack_params(plist)
+        M = 6
+        mbs = jr.normal(jr.fold_in(K, 1), (M, 3, HID))
+
+        out = mesh_lib.shard_map(
+            lambda p, m: schedules.pipeline_spmd_forward(
+                stage_fn, jax.tree.map(lambda x: x[0], p), m, remat=False
+            ),
+            mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P("pp"), stacked), P()),
+            out_specs=P(),
+        )(stacked, mbs)
+
+        ref = jax.vmap(lambda m: serial_forward(plist, m))(mbs)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_1f1b_loss_and_grads_match_serial(self):
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=4)
+        plist = make_stage_params(jr.fold_in(K, 2), 4)
+        stacked = stack_params(plist)
+        M = 4
+        mbs = jr.normal(jr.fold_in(K, 3), (M, 2, HID))
+        tgts = jr.normal(jr.fold_in(K, 4), (M, 2, HID))
+
+        def loss_head(out, tgt):
+            return jnp.mean((out - tgt) ** 2)
+
+        def run(p, m, t):
+            loss, g = schedules.forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_head, jax.tree.map(lambda x: x[0], p), m, t
+            )
+            return loss, jax.tree.map(lambda x: x[None], g)
+
+        loss, grads = mesh_lib.shard_map(
+            run,
+            mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P("pp"), stacked), P(), P()),
+            out_specs=(P(), jax.tree.map(lambda _: P("pp"), stacked)),
+        )(stacked, mbs, tgts)
+
+        def serial_loss(stacked_p):
+            plist_l = [jax.tree.map(lambda x: x[i], stacked_p) for i in range(4)]
+            outs = jax.vmap(lambda m: serial_forward(plist_l, m))(mbs)
+            return jnp.mean(jax.vmap(loss_head)(outs, tgts))
+
+        ref_loss, ref_grads = jax.value_and_grad(serial_loss)(stacked)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-5, atol=1e-6)
+        for a, e in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads)):
+            np.testing.assert_allclose(a, e, rtol=1e-4, atol=1e-5)
+
+    def test_interleaved_matches_serial(self):
+        # pp=2 devices, 2 virtual chunks each → 4 virtual stages
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=2)
+        plist = make_stage_params(jr.fold_in(K, 5), 4)
+        M = 4
+        mbs = jr.normal(jr.fold_in(K, 6), (M, 2, HID))
+        tgts = jr.normal(jr.fold_in(K, 7), (M, 2, HID))
+
+        # device r holds chunks [virtual stage r, virtual stage r+S]:
+        # chunk axis first (v, ...) per device → stack as (v, S, ...) and
+        # shard axis 1 over pp
+        S, v = 2, 2
+        # virtual stage k = c*S + r → params_by_chunk[c][r] = plist[c*S + r]
+        chunks = [[plist[c * S + r] for r in range(S)] for c in range(v)]
+        stacked = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[jax.tree.map(lambda *ys: jnp.stack(ys), *row) for row in chunks],
+        )  # (v, S, ...)
+
+        def loss_head(out, tgt):
+            return jnp.mean((out - tgt) ** 2)
+
+        def run(p, m, t):
+            loss, g = schedules.forward_backward_pipelining_with_interleaving(
+                stage_fn, loss_head, jax.tree.map(lambda x: x[:, 0], p), m, t,
+                virtual_chunks=v,
+            )
+            return loss, jax.tree.map(lambda x: x[:, None], g)
+
+        loss, grads = mesh_lib.shard_map(
+            run,
+            mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P(None, "pp"), stacked), P(), P()),
+            out_specs=(P(), jax.tree.map(lambda _: P(None, "pp"), stacked)),
+        )(stacked, mbs, tgts)
+
+        def serial_loss(stacked_p):
+            plist_l = [
+                jax.tree.map(lambda x: x[k // S, k % S], stacked_p) for k in range(4)
+            ]
+            outs = jax.vmap(lambda m: serial_forward(plist_l, m))(mbs)
+            return jnp.mean(jax.vmap(loss_head)(outs, tgts))
+
+        ref_loss, ref_grads = jax.value_and_grad(serial_loss)(stacked)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-5, atol=1e-6)
+        for a, e in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads)):
+            np.testing.assert_allclose(a, e, rtol=1e-4, atol=1e-5)
+
+    def test_no_pipelining_grad_accumulation(self):
+        mesh = mesh_lib.make_mesh()  # dp=8
+        w = jr.normal(K, (HID, HID)) * 0.1
+        mbs = jr.normal(jr.fold_in(K, 8), (4, 2, HID))  # 4 microbatches
+
+        def loss_fn(w, mb):
+            return jnp.mean((mb @ w) ** 2)
+
+        loss, grads = mesh_lib.shard_map(
+            lambda w, m: schedules.forward_backward_no_pipelining(loss_fn, w, m),
+            mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+        )(w, mbs)
+
+        def ref(w):
+            return jnp.mean(jax.vmap(lambda m: loss_fn(w, m))(mbs))
+
+        ref_loss, ref_grad = jax.value_and_grad(ref)(w)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-6)
+        np.testing.assert_allclose(grads, ref_grad, rtol=1e-5, atol=1e-6)
+
+    def test_dispatcher(self):
+        f = schedules.get_forward_backward_func(None, 1)
+        assert f is schedules.forward_backward_no_pipelining
+        f = schedules.get_forward_backward_func(None, 4)
+        assert f is schedules.forward_backward_pipelining_without_interleaving
+        f = schedules.get_forward_backward_func(2, 4)
+        assert f is schedules.forward_backward_pipelining_with_interleaving
+
+
+class TestP2P:
+    def test_rotation_roundtrip(self):
+        from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
+
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=4)
+        x = jnp.arange(4.0)
+
+        def run(x):
+            fwd = p2p.send_forward(x)
+            back = p2p.send_backward(fwd)
+            return back
+
+        y = mesh_lib.shard_map(
+            run, mesh=mesh, in_specs=P("pp"), out_specs=P("pp")
+        )(x)
+        np.testing.assert_allclose(y, x)
