@@ -1,0 +1,151 @@
+"""BERT: bidirectional encoder with MLM head.
+
+Re-design of ``apex/transformer/testing/standalone_bert.py``: same TP block
+structure as GPT but padding-masked (bidirectional) attention via the fused
+``scaled_masked_softmax`` and an MLM head over the tied vocab-parallel
+embedding. Post-LN residuals (BERT convention), token-type embeddings, and a
+pooler for the NSP/classification head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import fused_layer_norm, scaled_masked_softmax
+from apex_tpu.transformer import tensor_parallel as tp_lib
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30592
+    max_seq_len: int = 512
+    hidden_size: int = 768
+    ffn_hidden_size: Optional[int] = None
+    num_layers: int = 12
+    num_heads: int = 12
+    num_token_types: int = 2
+    tp_size: int = 1
+    tp_axis: Optional[str] = "tp"
+    remat: bool = True
+    dtype: Any = jnp.float32
+
+    @property
+    def ffn(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        return divide(self.hidden_size, self.num_heads)
+
+    @property
+    def local_heads(self) -> int:
+        return divide(self.num_heads, self.tp_size)
+
+
+class BertModel:
+    def __init__(self, config: BertConfig):
+        c = self.config = config
+        axis = c.tp_axis if c.tp_size > 1 else None
+        self.axis = axis
+        self.embedding = tp_lib.VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size, tp_size=c.tp_size, axis_name=axis
+        )
+        self.qkv = tp_lib.ColumnParallelLinear(
+            c.hidden_size, 3 * c.hidden_size, tp_size=c.tp_size, axis_name=axis
+        )
+        self.attn_out = tp_lib.RowParallelLinear(
+            c.hidden_size, c.hidden_size, tp_size=c.tp_size, axis_name=axis
+        )
+        self.mlp_up = tp_lib.ColumnParallelLinear(
+            c.hidden_size, c.ffn, tp_size=c.tp_size, axis_name=axis
+        )
+        self.mlp_down = tp_lib.RowParallelLinear(
+            c.ffn, c.hidden_size, tp_size=c.tp_size, axis_name=axis
+        )
+
+    def init(self, key, rank: int = 0):
+        c = self.config
+        keys = jax.random.split(key, c.num_layers + 4)
+        layers = []
+        for i in range(c.num_layers):
+            k = jax.random.split(keys[i], 4)
+            layers.append({
+                "qkv": self.qkv.init(k[0], rank, c.dtype),
+                "attn_out": self.attn_out.init(k[1], rank, c.dtype),
+                "ln1_w": jnp.ones((c.hidden_size,), c.dtype),
+                "ln1_b": jnp.zeros((c.hidden_size,), c.dtype),
+                "mlp_up": self.mlp_up.init(k[2], rank, c.dtype),
+                "mlp_down": self.mlp_down.init(k[3], rank, c.dtype),
+                "ln2_w": jnp.ones((c.hidden_size,), c.dtype),
+                "ln2_b": jnp.zeros((c.hidden_size,), c.dtype),
+            })
+        return {
+            "embedding": self.embedding.init(keys[-4], rank, c.dtype),
+            "pos_embedding": jax.random.normal(
+                keys[-3], (c.max_seq_len, c.hidden_size), c.dtype) * 0.01,
+            "type_embedding": jax.random.normal(
+                keys[-2], (c.num_token_types, c.hidden_size), c.dtype) * 0.01,
+            "ln_emb_w": jnp.ones((c.hidden_size,), c.dtype),
+            "ln_emb_b": jnp.zeros((c.hidden_size,), c.dtype),
+            "layers": jax.tree.map(lambda *xs: jnp.stack(xs), *layers),
+            "pooler_w": jax.random.normal(
+                keys[-1], (c.hidden_size, c.hidden_size), c.dtype)
+            * (1.0 / c.hidden_size ** 0.5),
+            "pooler_b": jnp.zeros((c.hidden_size,), c.dtype),
+        }
+
+    def _attention(self, p, x, pad_mask):
+        c = self.config
+        b, s, _ = x.shape
+        h, d = c.local_heads, c.head_dim
+        qkv = self.qkv(p["qkv"], x).reshape(b, s, h, 3 * d)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+        # mask: (b, 1, 1, s) True = masked out (padding)
+        mask = None if pad_mask is None else pad_mask[:, None, None, :]
+        probs = scaled_masked_softmax(scores, mask, 1.0 / float(d) ** 0.5)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        return self.attn_out(p["attn_out"], ctx.transpose(0, 2, 1, 3).reshape(b, s, h * d))
+
+    def _block(self, p, x, pad_mask):
+        # post-LN (BERT): LN(x + sublayer(x))
+        x = fused_layer_norm(x + self._attention(p, x, pad_mask), p["ln1_w"], p["ln1_b"])
+        h = jax.nn.gelu(self.mlp_up(p["mlp_up"], x), approximate=True)
+        m = self.mlp_down(p["mlp_down"], h)
+        return fused_layer_norm(x + m, p["ln2_w"], p["ln2_b"])
+
+    def hidden_states(self, params, tokens, token_types=None, pad_mask=None):
+        c = self.config
+        s = tokens.shape[1]
+        x = self.embedding(params["embedding"], tokens)
+        x = x + params["pos_embedding"][:s]
+        if token_types is not None:
+            x = x + jnp.take(params["type_embedding"], token_types, axis=0)
+        x = fused_layer_norm(x, params["ln_emb_w"], params["ln_emb_b"])
+
+        block = self._block
+        if c.remat:
+            block = jax.checkpoint(block, static_argnums=())
+
+        def body(x, layer):
+            return block(layer, x, pad_mask), None
+
+        x, _ = jax.lax.scan(body, x, params["layers"])
+        return x
+
+    def pooled(self, params, hidden):
+        return jnp.tanh(hidden[:, 0] @ params["pooler_w"] + params["pooler_b"])
+
+    def mlm_loss(self, params, tokens, targets, loss_mask, token_types=None, pad_mask=None):
+        """Masked-LM loss over positions where loss_mask is 1."""
+        x = self.hidden_states(params, tokens, token_types, pad_mask)
+        logits = jnp.dot(x, params["embedding"]["weight"].T)
+        losses = tp_lib.vocab_parallel_cross_entropy(logits, targets, axis_name=self.axis)
+        denom = jnp.maximum(jnp.sum(loss_mask), 1.0)
+        return jnp.sum(losses * loss_mask) / denom

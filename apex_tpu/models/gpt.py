@@ -1,0 +1,279 @@
+"""GPT: the flagship model — a Megatron-style decoder-only transformer.
+
+Re-design of ``apex/transformer/testing/standalone_gpt.py`` (``ParallelMLP``
+:236, ``ParallelAttention`` :285, full GPT stack): vocab-parallel embedding,
+N pre-LN blocks of (fused LN → TP attention → residual → fused LN → TP MLP →
+residual), final LN, tied unembedding, vocab-parallel cross-entropy.
+
+TPU-first choices:
+* activations are (batch, seq, hidden) bf16-able; attention uses the fused
+  causal softmax kernel (no 2048 seq cap);
+* TP via Column/Row parallel linears (QKV column-sharded by head, output
+  row-sharded), runnable at tp_size=1 with zero collectives;
+* sequence parallelism optional on the linears (``sequence_parallel``);
+* activation remat per block via ``jax.checkpoint`` (``remat=True``);
+* dropout keys are explicit (``jax.random``), folded per (layer, op, tp rank).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import fused_layer_norm, scaled_upper_triang_masked_softmax
+from apex_tpu.transformer import tensor_parallel as tp_lib
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304
+    max_seq_len: int = 2048
+    hidden_size: int = 1024
+    ffn_hidden_size: Optional[int] = None  # default 4*hidden
+    num_layers: int = 12
+    num_heads: int = 16
+    tp_size: int = 1
+    tp_axis: Optional[str] = "tp"  # None → single-chip, no collectives
+    sequence_parallel: bool = False
+    dropout: float = 0.0
+    remat: bool = True
+    dtype: Any = jnp.float32  # param dtype; compute follows inputs/policy
+
+    @property
+    def ffn(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        return divide(self.hidden_size, self.num_heads)
+
+    @property
+    def local_heads(self) -> int:
+        return divide(self.num_heads, self.tp_size)
+
+
+class GPTModel:
+    """Functional GPT. ``init(key)`` → params pytree (per-TP-shard when
+    tp_size > 1 — build under ``shard_map`` or shard a replicated init);
+    ``loss_fn(params, tokens, targets, key)`` → mean LM loss."""
+
+    def __init__(self, config: GPTConfig):
+        c = self.config = config
+        axis = c.tp_axis if c.tp_size > 1 else None
+        self.axis = axis
+        sp = c.sequence_parallel and c.tp_size > 1
+        self.sp = sp
+        self.embedding = tp_lib.VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size, tp_size=c.tp_size, axis_name=axis
+        )
+        # activations are (batch, seq, hidden) → seq_dim=1 for the SP
+        # all-gather/reduce-scatter boundaries
+        self.qkv = tp_lib.ColumnParallelLinear(
+            c.hidden_size, 3 * c.hidden_size, tp_size=c.tp_size, axis_name=axis,
+            sequence_parallel=sp, seq_dim=1,
+        )
+        self.attn_out = tp_lib.RowParallelLinear(
+            c.hidden_size, c.hidden_size, tp_size=c.tp_size, axis_name=axis,
+            sequence_parallel=sp, seq_dim=1,
+        )
+        self.mlp_up = tp_lib.ColumnParallelLinear(
+            c.hidden_size, c.ffn, tp_size=c.tp_size, axis_name=axis,
+            sequence_parallel=sp, seq_dim=1,
+        )
+        self.mlp_down = tp_lib.RowParallelLinear(
+            c.ffn, c.hidden_size, tp_size=c.tp_size, axis_name=axis,
+            sequence_parallel=sp, seq_dim=1,
+        )
+
+    # --- params ---------------------------------------------------------------
+
+    def init(self, key, rank: int = 0):
+        c = self.config
+        keys = jax.random.split(key, c.num_layers + 2)
+        layers = []
+        for i in range(c.num_layers):
+            k = jax.random.split(keys[i], 4)
+            layers.append({
+                "ln1_w": jnp.ones((c.hidden_size,), c.dtype),
+                "ln1_b": jnp.zeros((c.hidden_size,), c.dtype),
+                "qkv": self.qkv.init(k[0], rank, c.dtype),
+                "attn_out": self.attn_out.init(k[1], rank, c.dtype),
+                "ln2_w": jnp.ones((c.hidden_size,), c.dtype),
+                "ln2_b": jnp.zeros((c.hidden_size,), c.dtype),
+                "mlp_up": self.mlp_up.init(k[2], rank, c.dtype),
+                "mlp_down": self.mlp_down.init(k[3], rank, c.dtype),
+            })
+        params = {
+            "embedding": self.embedding.init(keys[-2], rank, c.dtype),
+            "pos_embedding": jax.random.normal(
+                keys[-1], (c.max_seq_len, c.hidden_size), c.dtype
+            ) * 0.01,
+            "layers": jax.tree.map(lambda *xs: jnp.stack(xs), *layers),
+            "lnf_w": jnp.ones((c.hidden_size,), c.dtype),
+            "lnf_b": jnp.zeros((c.hidden_size,), c.dtype),
+        }
+        return params
+
+    # --- blocks ---------------------------------------------------------------
+
+    def _attention(self, p, x, key):
+        c = self.config
+        h, d = c.local_heads, c.head_dim
+        qkv = self.qkv(p["qkv"], x)  # (b, s_full, 3*h*d local) — SP gathers seq
+        b, s = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape(b, s, h, 3 * d)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # (b, h, s, d)
+        q = q.transpose(0, 2, 1, 3)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+        probs = scaled_upper_triang_masked_softmax(
+            scores.reshape(b * h, s, s), 1.0 / float(d) ** 0.5
+        ).reshape(b, h, s, s)
+        if c.dropout > 0 and key is not None:
+            probs = _dropout(probs, c.dropout, jax.random.fold_in(key, 0))
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        return self.attn_out(p["attn_out"], ctx)
+
+    def _mlp(self, p, x):
+        h = self.mlp_up(p["mlp_up"], x)
+        h = jax.nn.gelu(h, approximate=True)
+        return self.mlp_down(p["mlp_down"], h)
+
+    def _sp_scatter(self, x):
+        """Enter the SP region: this tp rank's seq slice. Backward
+        all-gathers the cotangent so upstream (embedding, pos) parameters
+        see every position's contribution (Megatron's
+        ``_ScatterToSequenceParallelRegion``)."""
+        return _sp_scatter_seq1(x, self.axis)
+
+    def _sp_gather(self, x):
+        """Leave the SP region: full sequence. Backward takes this rank's
+        slice of the (replicated) cotangent — the plain all_gather transpose
+        (psum_scatter) would multiply by tp_size."""
+        return _sp_gather_seq1(x, self.axis)
+
+    def sp_grad_sync(self, grads):
+        """All-reduce over tp the gradients of parameters applied to
+        seq-sharded activations (block LNs and row-linear biases) — each tp
+        rank only saw its sequence slice's contribution. The analog of
+        Megatron's sequence-parallel param-grad all-reduce hook. No-op when
+        SP is off."""
+        if not self.sp:
+            return grads
+        lay = dict(grads["layers"])
+        for name in ("ln1_w", "ln1_b", "ln2_w", "ln2_b"):
+            lay[name] = jax.lax.psum(lay[name], self.axis)
+        for mod in ("attn_out", "mlp_down"):
+            m = dict(lay[mod])
+            if "bias" in m:
+                m["bias"] = jax.lax.psum(m["bias"], self.axis)
+            lay[mod] = m
+        out = dict(grads)
+        out["layers"] = lay
+        return out
+
+    def _block(self, p, x, key):
+        c = self.config
+        a = self._attention(p, fused_layer_norm(x, p["ln1_w"], p["ln1_b"]), key)
+        if c.dropout > 0 and key is not None:
+            a = _dropout(a, c.dropout, jax.random.fold_in(key, 1))
+        x = x + a
+        m = self._mlp(p, fused_layer_norm(x, p["ln2_w"], p["ln2_b"]))
+        if c.dropout > 0 and key is not None:
+            m = _dropout(m, c.dropout, jax.random.fold_in(key, 2))
+        return x + m
+
+    # --- forward --------------------------------------------------------------
+
+    def hidden_states(self, params, tokens, key=None):
+        c = self.config
+        s = tokens.shape[1]
+        x = self.embedding(params["embedding"], tokens)
+        x = x + params["pos_embedding"][:s]
+        if self.sp:
+            x = self._sp_scatter(x)  # residual stream is seq-sharded
+
+        block = self._block
+        if c.remat:
+            block = jax.checkpoint(block)
+
+        def body(x, layer_and_key):
+            layer, i = layer_and_key
+            k = None if key is None else jax.random.fold_in(key, i)
+            return block(layer, x, k), None
+
+        x, _ = jax.lax.scan(
+            body, x, (params["layers"], jnp.arange(c.num_layers))
+        )
+        if self.sp:
+            x = self._sp_gather(x)  # full seq for the head
+        return fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
+
+    def logits(self, params, tokens, key=None):
+        """Tied unembedding: local shard logits (b, s, V/tp)."""
+        x = self.hidden_states(params, tokens, key)
+        return jnp.dot(x, params["embedding"]["weight"].T)
+
+    def loss_fn(self, params, tokens, targets, key=None):
+        """Mean LM loss via vocab-parallel CE (the reference's
+        ``vocab_parallel_cross_entropy`` on the last stage)."""
+        logits = self.logits(params, tokens, key)
+        losses = tp_lib.vocab_parallel_cross_entropy(
+            logits, targets, axis_name=self.axis
+        )
+        return jnp.mean(losses)
+
+
+def _dropout(x, rate, key):
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+# --- sequence-parallel boundary collectives (custom transposes) ---------------
+
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _sp_scatter_seq1(x, axis_name):
+    size = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    chunk = x.shape[1] // size
+    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=1)
+
+
+def _sp_scatter_fwd(x, axis_name):
+    return _sp_scatter_seq1(x, axis_name), None
+
+
+def _sp_scatter_bwd(axis_name, _, g):
+    return (jax.lax.all_gather(g, axis_name, axis=1, tiled=True),)
+
+
+_sp_scatter_seq1.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _sp_gather_seq1(x, axis_name):
+    return jax.lax.all_gather(x, axis_name, axis=1, tiled=True)
+
+
+def _sp_gather_fwd(x, axis_name):
+    return _sp_gather_seq1(x, axis_name), None
+
+
+def _sp_gather_bwd(axis_name, _, g):
+    size = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    chunk = g.shape[1] // size
+    return (jax.lax.dynamic_slice_in_dim(g, rank * chunk, chunk, axis=1),)
+
+
+_sp_gather_seq1.defvjp(_sp_gather_fwd, _sp_gather_bwd)

@@ -1,0 +1,145 @@
+"""ResNet-50, NHWC, with SyncBatchNorm — the north-star benchmark model.
+
+Analog of the reference's ``examples/imagenet/main_amp.py`` torchvision
+ResNet-50 under amp O2 + apex DDP + SyncBN (the L1 convergence config and
+the driver's ResNet-50 target). NHWC is the native TPU conv layout; batch
+norm is :func:`apex_tpu.parallel.sync_batchnorm.sync_batch_norm` reducing
+over the ``dp`` axis when ``bn_axis`` is set (= ``convert_syncbn_model``),
+local otherwise. The fused add+ReLU epilogue of the reference's
+``bottleneck``/``groupbn`` contrib kernels is the ``residual``/``fuse_relu``
+path of sync_batch_norm, which XLA fuses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import BatchNormState, sync_batch_norm
+
+Layers50 = (3, 4, 6, 3)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    width: int = 64
+    layers: Tuple[int, ...] = Layers50
+    bn_axis: Optional[str] = None  # 'dp' → SyncBatchNorm across data parallel
+    bn_momentum: float = 0.1
+    dtype: Any = jnp.float32
+
+
+def _conv_init(key, shape, dtype):
+    # kaiming-normal fan_out (torchvision's ResNet init)
+    fan_out = shape[0] * shape[1] * shape[3]
+    std = (2.0 / fan_out) ** 0.5
+    return jax.random.normal(key, shape, dtype) * std
+
+
+def _conv(x, w, stride=1, padding="SAME"):
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+class ResNet50:
+    """Functional ResNet-v1.5 (stride-2 in the 3x3, torchvision layout)."""
+
+    def __init__(self, config: ResNetConfig = ResNetConfig()):
+        self.config = config
+
+    # --- init -----------------------------------------------------------------
+
+    def _bn_init(self, ch):
+        return (
+            {"scale": jnp.ones((ch,), self.config.dtype),
+             "bias": jnp.zeros((ch,), self.config.dtype)},
+            BatchNormState.create(ch),
+        )
+
+    def init(self, key):
+        c = self.config
+        k = iter(jax.random.split(key, 200))
+        params, state = {}, {}
+        params["conv1"] = _conv_init(next(k), (7, 7, 3, c.width), c.dtype)
+        params["bn1"], state["bn1"] = self._bn_init(c.width)
+
+        in_ch = c.width
+        for si, (blocks, ch) in enumerate(zip(c.layers, (64, 128, 256, 512))):
+            for bi in range(blocks):
+                name = f"s{si}b{bi}"
+                stride = 2 if (bi == 0 and si > 0) else 1
+                out_ch = ch * 4
+                p = {
+                    "conv_a": _conv_init(next(k), (1, 1, in_ch, ch), c.dtype),
+                    "conv_b": _conv_init(next(k), (3, 3, ch, ch), c.dtype),
+                    "conv_c": _conv_init(next(k), (1, 1, ch, out_ch), c.dtype),
+                }
+                st = {}
+                p["bn_a"], st["bn_a"] = self._bn_init(ch)
+                p["bn_b"], st["bn_b"] = self._bn_init(ch)
+                p["bn_c"], st["bn_c"] = self._bn_init(out_ch)
+                if bi == 0:
+                    p["conv_proj"] = _conv_init(next(k), (1, 1, in_ch, out_ch), c.dtype)
+                    p["bn_proj"], st["bn_proj"] = self._bn_init(out_ch)
+                params[name], state[name] = p, st
+                in_ch = out_ch
+
+        params["fc_w"] = jax.random.normal(next(k), (in_ch, c.num_classes), c.dtype) * 0.01
+        params["fc_b"] = jnp.zeros((c.num_classes,), c.dtype)
+        return params, state
+
+    # --- forward --------------------------------------------------------------
+
+    def _bn(self, p, st, x, training, residual=None, relu=True):
+        c = self.config
+        return sync_batch_norm(
+            x, p["scale"], p["bias"], st,
+            training=training, momentum=c.bn_momentum,
+            axis_name=c.bn_axis, fuse_relu=relu, residual=residual,
+        )
+
+    def _bottleneck(self, p, st, x, stride, training):
+        """Bottleneck with the fused BN+add+ReLU epilogue
+        (cf. ``apex/contrib/bottleneck/bottleneck.py:112``)."""
+        new_st = {}
+        identity = x
+        h = _conv(x, p["conv_a"])
+        h, new_st["bn_a"] = self._bn(p["bn_a"], st["bn_a"], h, training)
+        h = _conv(h, p["conv_b"], stride)
+        h, new_st["bn_b"] = self._bn(p["bn_b"], st["bn_b"], h, training)
+        h = _conv(h, p["conv_c"])
+        if "conv_proj" in p:
+            identity = _conv(x, p["conv_proj"], stride)
+            identity, new_st["bn_proj"] = self._bn(
+                p["bn_proj"], st["bn_proj"], identity, training, relu=False
+            )
+        # fused: BN(h) + identity → ReLU
+        h, new_st["bn_c"] = self._bn(p["bn_c"], st["bn_c"], h, training,
+                                     residual=identity, relu=True)
+        return h, new_st
+
+    def apply(self, params, state, x, *, training: bool = True):
+        """x: (N, H, W, 3) NHWC. Returns (logits, new_state)."""
+        c = self.config
+        new_state = {}
+        h = _conv(x, params["conv1"], stride=2)
+        h, new_state["bn1"] = self._bn(params["bn1"], state["bn1"], h, training)
+        h = jax.lax.reduce_window(
+            h, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+        )
+        for si, blocks in enumerate(c.layers):
+            for bi in range(blocks):
+                name = f"s{si}b{bi}"
+                stride = 2 if (bi == 0 and si > 0) else 1
+                h, new_state[name] = self._bottleneck(
+                    params[name], state[name], h, stride, training
+                )
+        h = jnp.mean(h, axis=(1, 2))
+        logits = h @ params["fc_w"] + params["fc_b"]
+        return logits, new_state

@@ -3,10 +3,10 @@
 TPU-native equivalent of ``fused_layer_norm_cuda``
 (``csrc/layer_norm_cuda_kernel.cu``; exports ``csrc/layer_norm_cuda.cpp:429-441``).
 Same contract as the CUDA kernels: forward emits (y, mean, rstd) so backward
-never recomputes the reduction; backward emits dx plus *partial* per-block
-(dgamma, dbeta) sums that the caller reduces — the CUDA version does the same
-two-stage reduction with ``cuComputePartGradGammaBeta`` then
-``cuComputeGradGammaBeta``.
+never recomputes the reduction; backward emits dx plus fully reduced
+(dgamma, dbeta) — accumulated in-kernel across the sequential row-block grid
+into one revisited output block, replacing the CUDA version's two-stage
+``cuComputePartGradGammaBeta``/``cuComputeGradGammaBeta`` reduction.
 
 Layout: inputs are viewed as (rows, hidden); one grid step owns a
 (block_rows, hidden) tile, reductions run on the VPU along the lane axis.
@@ -120,7 +120,7 @@ def ln_fwd(x2d, weight, bias, *, eps: float, rms: bool, interpret: bool):
 
 def _ln_bwd_kernel(
     dy_ref, x_ref, mean_ref, rstd_ref, w_ref,
-    dx_ref, dw_part_ref, db_part_ref, *, rms, has_affine,
+    dx_ref, dw_ref, db_ref, *, rms, has_affine,
 ):
     dy = dy_ref[:].astype(jnp.float32)
     x = x_ref[:].astype(jnp.float32)
@@ -132,10 +132,16 @@ def _ln_bwd_kernel(
     if has_affine:
         w = w_ref[:].astype(jnp.float32)
         dyw = dy * w
-        # partial reductions over this row block (stage 1 of the CUDA
-        # two-stage gamma/beta reduction)
-        dw_part_ref[:] = jnp.sum(dy * xhat, axis=0, keepdims=True)
-        db_part_ref[:] = jnp.sum(dy, axis=0, keepdims=True)
+        # dgamma/dbeta accumulate across the sequential grid into one
+        # revisited output block (the CUDA version's two-stage
+        # cuComputePartGradGammaBeta/cuComputeGradGammaBeta reduction)
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            dw_ref[:] = jnp.zeros_like(dw_ref)
+            db_ref[:] = jnp.zeros_like(db_ref)
+
+        dw_ref[:] += jnp.sum(dy * xhat, axis=0)
+        db_ref[:] += jnp.sum(dy, axis=0)
     else:
         dyw = dy
     h = x.shape[1]
@@ -175,24 +181,25 @@ def ln_bwd(dy2d, x2d, mean, rstd, weight, *, rms: bool, interpret: bool):
             dy, x, m, r, None, dx, dwp, dbp
         )
 
-    dx, dw_part, db_part = pl.pallas_call(
+    dx, dw, db = pl.pallas_call(
         kernel,
         grid=(nblocks,),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((br, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((hidden,), lambda i: (0,)),
+            pl.BlockSpec((hidden,), lambda i: (0,)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows_p, hidden), x2d.dtype),
-            jax.ShapeDtypeStruct((nblocks, hidden), jnp.float32),
-            jax.ShapeDtypeStruct((nblocks, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((hidden,), jnp.float32),
+            jax.ShapeDtypeStruct((hidden,), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)
+        ),
         interpret=interpret,
     )(*args)
     if has_affine:
-        # stage-2 reduction (cuComputeGradGammaBeta): tiny, XLA handles it;
-        # zero-padded rows contribute dy=0 to the partials.
-        return dx[:rows], jnp.sum(dw_part, axis=0), jnp.sum(db_part, axis=0)
+        return dx[:rows], dw, db
     return dx[:rows], None, None

@@ -39,6 +39,8 @@ def vocab_parallel_cross_entropy(
 
 def _shard_info(logits, axis_name):
     per = logits.shape[-1]
+    if axis_name is None:
+        return per, jnp.zeros((), jnp.int32)
     rank = jax.lax.axis_index(axis_name)
     return per, rank * per
 
@@ -46,9 +48,11 @@ def _shard_info(logits, axis_name):
 def _vce_fwd(logits, target, label_smoothing, axis_name):
     per, start = _shard_info(logits, axis_name)
     lf = logits.astype(jnp.float32)
+    psum = (lambda v: v) if axis_name is None else (lambda v: jax.lax.psum(v, axis_name))
+    pmax = (lambda v: v) if axis_name is None else (lambda v: jax.lax.pmax(v, axis_name))
 
     # 1. global max for stability
-    m = jax.lax.pmax(jnp.max(lf, axis=-1), axis_name)
+    m = pmax(jnp.max(lf, axis=-1))
     lf = lf - m[..., None]
 
     # 2. target logit: only the owning shard contributes
@@ -56,36 +60,39 @@ def _vce_fwd(logits, target, label_smoothing, axis_name):
     in_shard = (local_t >= 0) & (local_t < per)
     t_idx = jnp.where(in_shard, local_t, 0)
     t_logit = jnp.take_along_axis(lf, t_idx[..., None], axis=-1)[..., 0]
-    t_logit = jax.lax.psum(jnp.where(in_shard, t_logit, 0.0), axis_name)
+    t_logit = psum(jnp.where(in_shard, t_logit, 0.0))
 
     # 3. global sum-exp
-    sum_exp = jax.lax.psum(jnp.sum(jnp.exp(lf), axis=-1), axis_name)
+    sum_exp = psum(jnp.sum(jnp.exp(lf), axis=-1))
     log_sum_exp = jnp.log(sum_exp)
     loss = log_sum_exp - t_logit
 
     if label_smoothing > 0:
         # reference's smoothing branch (:68-77): loss = (1-ε)·nll + ε/V · Σ nll_i
-        vocab = per * jax.lax.axis_size(axis_name)
+        vocab = per * (1 if axis_name is None else jax.lax.axis_size(axis_name))
         smooth = label_smoothing / vocab
-        sum_logits = jax.lax.psum(jnp.sum(lf, axis=-1), axis_name)
+        sum_logits = psum(jnp.sum(lf, axis=-1))
         loss = (1.0 - label_smoothing) * loss + smooth * (
             vocab * log_sum_exp - sum_logits
         )
 
     softmax = jnp.exp(lf) / sum_exp[..., None]
-    return loss, (softmax, in_shard, t_idx, logits.dtype == jnp.float32)
+    # dtype witness: backward casts the (large) logits cotangent back to the
+    # input dtype (bf16 logits must not get an fp32 gradient tensor)
+    witness = jnp.zeros((), logits.dtype)
+    return loss, (softmax, in_shard, t_idx, witness)
 
 
 def _vce_bwd(label_smoothing, axis_name, res, dloss):
-    softmax, in_shard, t_idx, _ = res
+    softmax, in_shard, t_idx, witness = res
     per = softmax.shape[-1]
     onehot = jax.nn.one_hot(t_idx, per, dtype=jnp.float32) * in_shard[..., None]
     if label_smoothing > 0:
-        vocab = per * jax.lax.axis_size(axis_name)
+        vocab = per * (1 if axis_name is None else jax.lax.axis_size(axis_name))
         grad = softmax - (1.0 - label_smoothing) * onehot - label_smoothing / vocab
     else:
         grad = softmax - onehot
-    return grad * dloss[..., None], None
+    return (grad * dloss[..., None]).astype(witness.dtype), None
 
 
 vocab_parallel_cross_entropy.defvjp(_vce_fwd, _vce_bwd)

@@ -51,13 +51,13 @@ def _default_init(key, shape, dtype):
 
 # --- sequence-parallel collectives (SP extension) -----------------------------
 
-def _sp_all_gather_seq(x, axis_name):
-    """Gather the sequence dim (axis 0 of (s, b, h)) entering a TP matmul."""
-    return jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
+def _sp_all_gather_seq(x, axis_name, seq_dim=0):
+    """Gather the sequence dim entering a TP matmul (Megatron-SP boundary)."""
+    return jax.lax.all_gather(x, axis_name, axis=seq_dim, tiled=True)
 
 
-def _sp_reduce_scatter_seq(x, axis_name):
-    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+def _sp_reduce_scatter_seq(x, axis_name, seq_dim=0):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=seq_dim, tiled=True)
 
 
 @dataclasses.dataclass
@@ -71,6 +71,7 @@ class ColumnParallelLinear:
     bias: bool = True
     gather_output: bool = False
     sequence_parallel: bool = False
+    seq_dim: int = 0  # which activation axis is sequence (0 for (s,b,h), 1 for (b,s,h))
     init_method: Callable = _default_init
     axis_name: str = mesh_lib.TENSOR_AXIS
     tp_size: int = 1
@@ -94,7 +95,7 @@ class ColumnParallelLinear:
 
     def __call__(self, params: dict, x: jax.Array) -> jax.Array:
         if self.sequence_parallel:
-            x = _sp_all_gather_seq(x, self.axis_name)
+            x = _sp_all_gather_seq(x, self.axis_name, self.seq_dim)
         else:
             x = mappings.copy_to_tensor_model_parallel_region(x, self.axis_name)
         y = jnp.dot(x, params["weight"].T)
@@ -115,6 +116,7 @@ class RowParallelLinear:
     bias: bool = True
     input_is_parallel: bool = True
     sequence_parallel: bool = False
+    seq_dim: int = 0
     init_method: Callable = _default_init
     axis_name: str = mesh_lib.TENSOR_AXIS
     tp_size: int = 1
@@ -140,7 +142,7 @@ class RowParallelLinear:
             x = mappings.scatter_to_tensor_model_parallel_region(x, self.axis_name)
         y = jnp.dot(x, params["weight"].T)
         if self.sequence_parallel:
-            y = _sp_reduce_scatter_seq(y, self.axis_name)
+            y = _sp_reduce_scatter_seq(y, self.axis_name, self.seq_dim)
         else:
             y = mappings.reduce_from_tensor_model_parallel_region(y, self.axis_name)
         if self.bias:
@@ -174,6 +176,12 @@ class VocabParallelEmbedding:
         }
 
     def __call__(self, params: dict, token_ids: jax.Array) -> jax.Array:
+        if self.axis_name is None or self.tp_size == 1:
+            # same out-of-range semantics as the sharded path: invalid ids
+            # yield zero vectors, never a clamped row
+            valid = (token_ids >= 0) & (token_ids < self.num_embeddings)
+            emb = jnp.take(params["weight"], jnp.where(valid, token_ids, 0), axis=0)
+            return jnp.where(valid[..., None], emb, 0.0)
         rank = jax.lax.axis_index(self.axis_name)
         per = self.num_embeddings_per_partition
         start = rank * per

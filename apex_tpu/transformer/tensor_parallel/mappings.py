@@ -42,10 +42,7 @@ def _gather_last(x: jax.Array, axis_name: str) -> jax.Array:
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def copy_to_tensor_model_parallel_region(x, axis_name=mesh_lib.TENSOR_AXIS):
-    """Identity forward, all-reduce backward (``_CopyToModelParallelRegion``,
-    ``mappings.py:108-117``): marks the point where a replicated activation
-    enters the TP region."""
+def _copy_core(x, axis_name):
     return x
 
 
@@ -58,15 +55,18 @@ def _copy_bwd(axis_name, _, g):
     return (jax.lax.psum(g, axis_name),)
 
 
-copy_to_tensor_model_parallel_region.defvjp(
-    lambda x, axis_name: _copy_fwd(x, axis_name), _copy_bwd
-)
+_copy_core.defvjp(lambda x, axis_name: _copy_fwd(x, axis_name), _copy_bwd)
+
+
+def copy_to_tensor_model_parallel_region(x, axis_name=mesh_lib.TENSOR_AXIS):
+    """Identity forward, all-reduce backward (``_CopyToModelParallelRegion``,
+    ``mappings.py:108-117``): marks the point where a replicated activation
+    enters the TP region. ``axis_name=None`` (tp=1) is the identity."""
+    return x if axis_name is None else _copy_core(x, axis_name)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def reduce_from_tensor_model_parallel_region(x, axis_name=mesh_lib.TENSOR_AXIS):
-    """All-reduce forward, identity backward (``_ReduceFromModelParallelRegion``,
-    ``mappings.py:119-128``)."""
+def _reduce_core(x, axis_name):
     return jax.lax.psum(x, axis_name)
 
 
@@ -78,13 +78,17 @@ def _reduce_bwd(axis_name, _, g):
     return (g,)
 
 
-reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+_reduce_core.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+def reduce_from_tensor_model_parallel_region(x, axis_name=mesh_lib.TENSOR_AXIS):
+    """All-reduce forward, identity backward (``_ReduceFromModelParallelRegion``,
+    ``mappings.py:119-128``). ``axis_name=None`` (tp=1) is the identity."""
+    return x if axis_name is None else _reduce_core(x, axis_name)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def scatter_to_tensor_model_parallel_region(x, axis_name=mesh_lib.TENSOR_AXIS):
-    """Split last dim forward, all-gather backward
-    (``_ScatterToModelParallelRegion``, ``mappings.py:130-139``)."""
+def _scatter_core(x, axis_name):
     return _split_local(x, axis_name)
 
 
@@ -96,13 +100,17 @@ def _scatter_bwd(axis_name, _, g):
     return (_gather_last(g, axis_name),)
 
 
-scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+_scatter_core.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+def scatter_to_tensor_model_parallel_region(x, axis_name=mesh_lib.TENSOR_AXIS):
+    """Split last dim forward, all-gather backward
+    (``_ScatterToModelParallelRegion``, ``mappings.py:130-139``)."""
+    return x if axis_name is None else _scatter_core(x, axis_name)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def gather_from_tensor_model_parallel_region(x, axis_name=mesh_lib.TENSOR_AXIS):
-    """All-gather last dim forward, split backward
-    (``_GatherFromModelParallelRegion``, ``mappings.py:141-150``)."""
+def _gather_core(x, axis_name):
     return _gather_last(x, axis_name)
 
 
@@ -114,4 +122,10 @@ def _gather_bwd(axis_name, _, g):
     return (_split_local(g, axis_name),)
 
 
-gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+_gather_core.defvjp(_gather_fwd, _gather_bwd)
+
+
+def gather_from_tensor_model_parallel_region(x, axis_name=mesh_lib.TENSOR_AXIS):
+    """All-gather last dim forward, split backward
+    (``_GatherFromModelParallelRegion``, ``mappings.py:141-150``)."""
+    return x if axis_name is None else _gather_core(x, axis_name)

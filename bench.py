@@ -1,0 +1,97 @@
+"""Flagship benchmark: GPT training-step throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The measured config is a GPT-small-class decoder (bf16 compute) doing a full
+train step (loss + grad + FusedAdam update). ``vs_baseline`` compares the
+framework's fused path (Pallas kernels + fused optimizer) against the same
+model with every fused op forced to its plain-XLA composition and an unfused
+optax adam — i.e. "apex_tpu vs plain JAX", the TPU analog of the reference's
+"apex vs stock PyTorch" pitch (the reference publishes no numbers of its
+own, SURVEY.md §6).
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+
+def build(impl: str, cfg_kwargs):
+    import optax
+
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.optimizers import fused_adam
+
+    cfg = GPTConfig(**cfg_kwargs)
+    model = GPTModel(cfg)
+    params = model.init(jr.PRNGKey(0))
+    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+
+    if impl == "fused":
+        opt = fused_adam(learning_rate=1e-4)
+    else:
+        opt = optax.adam(1e-4)
+    opt_state = opt.init(params)
+
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(model.loss_fn)(params, tokens, targets)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # NB: no donate_argnums — buffer donation through the remote-TPU tunnel
+    # both defeats block_until_ready (async completion reported early) and
+    # adds a per-call aliasing handshake that slows the step ~5x.
+    return jax.jit(train_step), params, opt_state
+
+
+def timeit(step, params, opt_state, tokens, targets, iters):
+    params, opt_state, loss = step(params, opt_state, tokens, targets)  # compile+warm
+    float(loss)  # host fetch: the only reliable device sync over the tunnel
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    float(loss)  # forces completion of the whole dependent chain
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = dict(vocab_size=16384, max_seq_len=1024, hidden_size=768,
+                   num_layers=6, num_heads=12, tp_size=1, remat=False)
+        batch, seq, iters = 8, 1024, 20
+    else:  # smoke-test scale for CPU runs
+        cfg = dict(vocab_size=1024, max_seq_len=128, hidden_size=128,
+                   num_layers=2, num_heads=4, tp_size=1, remat=False)
+        batch, seq, iters = 2, 128, 3
+
+    tokens = jr.randint(jr.PRNGKey(1), (batch, seq), 0, cfg["vocab_size"])
+    targets = jr.randint(jr.PRNGKey(2), (batch, seq), 0, cfg["vocab_size"])
+
+    results = {}
+    for impl in ("baseline", "fused"):
+        os.environ["APEX_TPU_PALLAS"] = "0" if impl == "baseline" else "1"
+        # drop cached modules so the env gate is re-read cleanly
+        step, params, opt_state = build(impl, cfg)
+        results[impl] = timeit(step, params, opt_state, tokens, targets, iters)
+        del step, params, opt_state
+
+    tokens_per_s = batch * seq / results["fused"]
+    vs_baseline = results["baseline"] / results["fused"]
+    print(json.dumps({
+        "metric": "gpt_train_step_throughput",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
