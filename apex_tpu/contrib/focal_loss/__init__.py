@@ -1,0 +1,4 @@
+"""Fused focal loss (contrib surface) — re-export of
+:mod:`apex_tpu.ops.focal_loss` (``apex/contrib/focal_loss/focal_loss.py:6-60``)."""
+
+from apex_tpu.ops.focal_loss import focal_loss  # noqa: F401

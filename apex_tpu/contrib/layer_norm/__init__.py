@@ -1,0 +1,13 @@
+"""FastLayerNorm — large-hidden LayerNorm.
+
+Re-design of ``apex.contrib.layer_norm.FastLayerNorm``
+(``apex/contrib/layer_norm/layer_norm.py:8-53``; kernels
+``apex/contrib/csrc/layer_norm/ln_fwd_cuda_kernel.cu``). The reference ships
+a second, hand-tuned LN for hidden sizes up to 65k; the Pallas LN already
+streams arbitrary hidden sizes by sizing its row blocks to VMEM
+(``_pick_block_rows``), so FastLayerNorm is the same kernel re-exported with
+the contrib constructor surface.
+"""
+
+from apex_tpu.ops.layer_norm import FusedLayerNorm as FastLayerNorm  # noqa: F401
+from apex_tpu.ops.layer_norm import fused_layer_norm as fast_layer_norm  # noqa: F401

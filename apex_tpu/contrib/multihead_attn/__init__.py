@@ -1,0 +1,176 @@
+"""Multi-head attention modules.
+
+Re-design of ``apex.contrib.multihead_attn``
+(``apex/contrib/multihead_attn/self_multihead_attn.py:27``,
+``encdec_multihead_attn.py``): self- and encoder-decoder attention with
+optional fused pre-LayerNorm + residual-add (the reference's
+``include_norm_add`` variants) and optional biases. The fused CUDA/CUTLASS
+cores become one call into the blockwise flash kernel; the
+``fast_mask_softmax_dropout`` path corresponds to the fused softmax +
+explicit-key dropout here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import fused_layer_norm
+from apex_tpu.ops.attention import flash_attention
+
+
+def _linear_init(key, shape, dtype):
+    bound = 1.0 / jnp.sqrt(shape[-1])
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+def _dropout(x, rate, key):
+    if rate <= 0 or key is None:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+@dataclasses.dataclass
+class SelfMultiheadAttn:
+    """``SelfMultiheadAttn`` (``self_multihead_attn.py:27``): fused QKV
+    projection, attention core, output projection; ``include_norm_add`` fuses
+    a pre-LN and returns (out + residual)."""
+
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    separate_qkv_params: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        assert self.embed_dim % self.num_heads == 0
+        return self.embed_dim // self.num_heads
+
+    def init(self, key, dtype=jnp.float32) -> dict:
+        k1, k2 = jax.random.split(key)
+        e = self.embed_dim
+        if self.separate_qkv_params:
+            kq, kk, kv = jax.random.split(k1, 3)
+            params = {
+                "q_weight": _linear_init(kq, (e, e), dtype),
+                "k_weight": _linear_init(kk, (e, e), dtype),
+                "v_weight": _linear_init(kv, (e, e), dtype),
+            }
+        else:
+            params = {"qkv_weight": _linear_init(k1, (3 * e, e), dtype)}
+        params["out_weight"] = _linear_init(k2, (e, e), dtype)
+        if self.bias:
+            if self.separate_qkv_params:
+                params.update(q_bias=jnp.zeros((e,), dtype),
+                              k_bias=jnp.zeros((e,), dtype),
+                              v_bias=jnp.zeros((e,), dtype))
+            else:
+                params["qkv_bias"] = jnp.zeros((3 * e,), dtype)
+            params["out_bias"] = jnp.zeros((e,), dtype)
+        if self.include_norm_add:
+            params["ln_weight"] = jnp.ones((e,), dtype)
+            params["ln_bias"] = jnp.zeros((e,), dtype)
+        return params
+
+    def __call__(self, params, x, *, causal: bool = False,
+                 key: Optional[jax.Array] = None, is_training: bool = True):
+        """x: (batch, seq, embed). Returns attention output (+ residual when
+        include_norm_add)."""
+        residual = x
+        if self.include_norm_add:
+            x = fused_layer_norm(x, params["ln_weight"], params["ln_bias"])
+        b, s, e = x.shape
+        h, d = self.num_heads, self.head_dim
+        if self.separate_qkv_params:
+            q = x @ params["q_weight"].T
+            kk = x @ params["k_weight"].T
+            v = x @ params["v_weight"].T
+            if self.bias:
+                q, kk, v = q + params["q_bias"], kk + params["k_bias"], v + params["v_bias"]
+        else:
+            qkv = x @ params["qkv_weight"].T
+            if self.bias:
+                qkv = qkv + params["qkv_bias"]
+            q, kk, v = jnp.split(qkv, 3, axis=-1)
+
+        def split_heads(t):
+            return t.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+
+        o = flash_attention(split_heads(q), split_heads(kk), split_heads(v),
+                            causal=causal)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, e)
+        o = o @ params["out_weight"].T
+        if self.bias:
+            o = o + params["out_bias"]
+        if is_training:
+            o = _dropout(o, self.dropout, key)
+        if self.include_norm_add:
+            o = o + residual
+        return o
+
+
+@dataclasses.dataclass
+class EncdecMultiheadAttn:
+    """``EncdecMultiheadAttn``: Q from the decoder stream, K/V from the
+    encoder memory (``encdec_multihead_attn.py``)."""
+
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    def init(self, key, dtype=jnp.float32) -> dict:
+        k1, k2, k3 = jax.random.split(key, 3)
+        e = self.embed_dim
+        params = {
+            "q_weight": _linear_init(k1, (e, e), dtype),
+            "kv_weight": _linear_init(k2, (2 * e, e), dtype),
+            "out_weight": _linear_init(k3, (e, e), dtype),
+        }
+        if self.bias:
+            params.update(q_bias=jnp.zeros((e,), dtype),
+                          kv_bias=jnp.zeros((2 * e,), dtype),
+                          out_bias=jnp.zeros((e,), dtype))
+        if self.include_norm_add:
+            params["ln_weight"] = jnp.ones((e,), dtype)
+            params["ln_bias"] = jnp.zeros((e,), dtype)
+        return params
+
+    def __call__(self, params, query, memory, *, key: Optional[jax.Array] = None,
+                 is_training: bool = True):
+        residual = query
+        if self.include_norm_add:
+            query = fused_layer_norm(query, params["ln_weight"], params["ln_bias"])
+        b, sq, e = query.shape
+        sk = memory.shape[1]
+        h, d = self.num_heads, self.head_dim
+        q = query @ params["q_weight"].T
+        kv = memory @ params["kv_weight"].T
+        if self.bias:
+            q = q + params["q_bias"]
+            kv = kv + params["kv_bias"]
+        kk, v = jnp.split(kv, 2, axis=-1)
+        q = q.reshape(b, sq, h, d).transpose(0, 2, 1, 3)
+        kk = kk.reshape(b, sk, h, d).transpose(0, 2, 1, 3)
+        v = v.reshape(b, sk, h, d).transpose(0, 2, 1, 3)
+        o = flash_attention(q, kk, v, causal=False)
+        o = o.transpose(0, 2, 1, 3).reshape(b, sq, e)
+        o = o @ params["out_weight"].T
+        if self.bias:
+            o = o + params["out_bias"]
+        if is_training:
+            o = _dropout(o, self.dropout, key)
+        if self.include_norm_add:
+            o = o + residual
+        return o

@@ -1,0 +1,13 @@
+"""ZeRO-style distributed optimizers.
+
+Re-design of ``apex.contrib.optimizers.DistributedFusedAdam`` /
+``DistributedFusedLAMB`` (``apex/contrib/optimizers/distributed_fused_adam.py:9``,
+``distributed_fused_lamb.py:10``).
+"""
+
+from apex_tpu.contrib.optimizers.distributed import (  # noqa: F401
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+    distributed_fused_adam,
+    distributed_fused_lamb,
+)

@@ -1,0 +1,201 @@
+"""Optimizer-state sharding over the data-parallel axis (ZeRO).
+
+Re-design of the reference's distributed optimizers
+(``apex/contrib/optimizers/distributed_fused_lamb.py:10`` — param flattening
+into blocks/chunks/shards, overlapped reduce-scatter during backward, shard
+update, (optionally compressed) all-gather; ``distributed_fused_adam.py:9``).
+
+TPU-native shape: the chunked mega-buffer of
+:mod:`apex_tpu.optimizers.multi_tensor` partitions its chunk axis evenly over
+``dp``. One step is exactly the reference's pipeline, as three XLA
+collectives instead of hand-scheduled NCCL groups:
+
+1. ``psum_scatter`` the flat gradient over dp → each device owns 1/dp of the
+   (averaged) gradient (the reference's reduce-scatter during backward —
+   overlap comes from the XLA scheduler);
+2. fused Adam/LAMB update on the local shard (optimizer state m/v lives
+   *only* sharded — the ZeRO memory saving);
+3. ``all_gather`` the updated parameter shards (the reference's
+   e5m2-compressed allgather becomes an optional bf16 cast).
+
+Functions must run inside ``shard_map`` with ``axis_name`` bound. The
+returned transformation is optax-shaped (init/update) so it slots into the
+same training steps as the single-device fused optimizers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers import multi_tensor as mt
+from apex_tpu.parallel import mesh as mesh_lib
+
+PyTree = Any
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ZeroState:
+    count: jax.Array
+    layout: mt.ChunkLayout
+    buffers: Dict[str, jax.Array]  # each (n_chunks/dp, chunk) — local shard
+
+
+class _ZeroOpt(NamedTuple):
+    init: Any
+    update: Any
+
+
+def _pad_chunks(buf, dp):
+    n = buf.shape[0]
+    pad = (-n) % dp
+    return jnp.pad(buf, ((0, pad), (0, 0))) if pad else buf
+
+
+def _local_shard(buf, axis_name):
+    """This rank's contiguous chunk-row shard (no comm; params are
+    replicated so slicing is free)."""
+    dp = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    per = buf.shape[0] // dp
+    return jax.lax.dynamic_slice_in_dim(buf, rank * per, per, axis=0)
+
+
+def _make_zero(kernel, state_buffers, *, axis_name, chunk_size, all_gather_dtype):
+    def init(params):
+        buf, layout = mt.flatten_to_chunks(params, mt.make_layout(params, chunk_size))
+        dp = jax.lax.axis_size(axis_name)
+        local = _local_shard(_pad_chunks(buf, dp), axis_name)
+        return ZeroState(
+            count=jnp.zeros((), jnp.int32),
+            layout=layout,
+            buffers={k: jnp.zeros_like(local) for k in state_buffers},
+        )
+
+    def update(grads, state, params):
+        layout = state.layout
+        dp = jax.lax.axis_size(axis_name)
+        gbuf, _ = mt.flatten_to_chunks(grads, layout)
+        pbuf, _ = mt.flatten_to_chunks(params, layout)
+        gbuf, pbuf = _pad_chunks(gbuf, dp), _pad_chunks(pbuf, dp)
+
+        # 1. reduce-scatter: mean gradient, sharded by chunk rows
+        g_local = jax.lax.psum_scatter(
+            gbuf, axis_name, scatter_dimension=0, tiled=True
+        ) / dp
+        p_local = _local_shard(pbuf, axis_name)
+
+        # 2. fused update on the local shard
+        count = state.count + 1
+        new_p_local, new_buffers = kernel(
+            g_local, p_local, state.buffers, count, layout, axis_name
+        )
+
+        # 3. all-gather updated shards (optionally reduced precision, the
+        # e5m2_allgather analog)
+        send = new_p_local.astype(all_gather_dtype) if all_gather_dtype else new_p_local
+        full = jax.lax.all_gather(send, axis_name, axis=0, tiled=True)
+        full = full.astype(jnp.float32)[: gbuf.shape[0]]
+
+        new_params = mt.unflatten_from_chunks(full, layout, like=params)
+        updates = jax.tree.map(lambda n, p: n - p.astype(n.dtype), new_params, params)
+        return updates, ZeroState(count=count, layout=layout, buffers=new_buffers)
+
+    return _ZeroOpt(init=init, update=update)
+
+
+def distributed_fused_adam(
+    learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
+    adam_w_mode: bool = True, *, axis_name: str = mesh_lib.DATA_AXIS,
+    chunk_size: int = mt.DEFAULT_CHUNK, all_gather_dtype=None,
+):
+    """ZeRO Adam (``DistributedFusedAdam``, ``distributed_fused_adam.py:9``):
+    m/v exist only as 1/dp shards."""
+
+    def kernel(g, p, buffers, count, layout, axis):
+        m, v = buffers["m"], buffers["v"]
+        step = count.astype(jnp.float32)
+        if not adam_w_mode and weight_decay:
+            g = g + weight_decay * p
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        m_hat = m / (1 - b1 ** step)
+        v_hat = v / (1 - b2 ** step)
+        upd = m_hat / (jnp.sqrt(v_hat) + eps)
+        if adam_w_mode and weight_decay:
+            upd = upd + weight_decay * p
+        lr = learning_rate(count - 1) if callable(learning_rate) else learning_rate
+        return p - lr * upd, {"m": m, "v": v}
+
+    return _make_zero(kernel, ("m", "v"), axis_name=axis_name,
+                      chunk_size=chunk_size, all_gather_dtype=all_gather_dtype)
+
+
+def distributed_fused_lamb(
+    learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-6, weight_decay=0.01,
+    max_grad_norm: Optional[float] = None, *, axis_name: str = mesh_lib.DATA_AXIS,
+    chunk_size: int = mt.DEFAULT_CHUNK, all_gather_dtype=None,
+):
+    """ZeRO LAMB (``DistributedFusedLAMB``, ``distributed_fused_lamb.py:10``):
+    per-tensor trust ratios from cross-shard psum'd norms, optional global
+    grad-norm clip (the reference's fused L2-norm clipping)."""
+
+    def kernel(g, p, buffers, count, layout, axis):
+        m, v = buffers["m"], buffers["v"]
+        step = count.astype(jnp.float32)
+
+        if max_grad_norm:
+            # global grad norm across every shard
+            gsq = jax.lax.psum(jnp.sum(g * g), axis)
+            gnorm = jnp.sqrt(gsq)
+            g = g * jnp.minimum(1.0, max_grad_norm / jnp.maximum(gnorm, 1e-12))
+
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        m_hat = m / (1 - b1 ** step)
+        v_hat = v / (1 - b2 ** step)
+        upd = m_hat / (jnp.sqrt(v_hat) + eps) + weight_decay * p
+
+        # per-tensor norms: local segment partials + psum (each tensor's
+        # chunks may live on several shards)
+        seg = _local_segment_ids(layout, g.shape[0], axis)
+        p_sq = jax.lax.psum(
+            jax.ops.segment_sum(jnp.sum(p * p, 1), seg, num_segments=layout.n_tensors + 1),
+            axis,
+        )
+        u_sq = jax.lax.psum(
+            jax.ops.segment_sum(jnp.sum(upd * upd, 1), seg, num_segments=layout.n_tensors + 1),
+            axis,
+        )
+        w_norm = jnp.sqrt(p_sq)
+        u_norm = jnp.sqrt(u_sq)
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        ratio = trust[seg][:, None]
+        lr = learning_rate(count - 1) if callable(learning_rate) else learning_rate
+        return p - lr * ratio * upd, {"m": m, "v": v}
+
+    return _make_zero(kernel, ("m", "v"), axis_name=axis_name,
+                      chunk_size=chunk_size, all_gather_dtype=all_gather_dtype)
+
+
+def _local_segment_ids(layout, local_rows, axis):
+    """chunk→tensor ids for this rank's shard; padding chunks map to the
+    sentinel segment n_tensors."""
+    dp = jax.lax.axis_size(axis)
+    rank = jax.lax.axis_index(axis)
+    full = layout.chunk_to_tensor
+    n = full.shape[0]
+    pad = (-n) % dp
+    padded = jnp.concatenate(
+        [full, jnp.full((pad,), layout.n_tensors, full.dtype)]
+    ) if pad else full
+    return jax.lax.dynamic_slice_in_dim(padded, rank * local_rows, local_rows, 0)
+
+
+# class-style aliases (reference constructor surface)
+DistributedFusedAdam = distributed_fused_adam
+DistributedFusedLAMB = distributed_fused_lamb

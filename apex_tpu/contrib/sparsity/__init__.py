@@ -1,0 +1,12 @@
+"""ASP — automatic structured (2:4) sparsity.
+
+Re-design of ``apex.contrib.sparsity.ASP``
+(``apex/contrib/sparsity/asp.py:28-312``, mask patterns
+``sparse_masklib.py``, channel-permutation search ``permutation_lib.py``).
+"""
+
+from apex_tpu.contrib.sparsity.asp import ASP  # noqa: F401
+from apex_tpu.contrib.sparsity.masklib import (  # noqa: F401
+    create_mask,
+    mask_2to4_best,
+)

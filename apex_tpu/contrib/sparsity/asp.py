@@ -1,0 +1,87 @@
+"""ASP: mask bookkeeping + optimizer integration.
+
+Re-design of ``apex.contrib.sparsity.ASP`` (``apex/contrib/sparsity/asp.py:28-312``).
+The reference walks module weights, allocates mask buffers, and patches
+``optimizer.step`` to re-apply masks after every update; functionally that
+is: (1) compute a mask pytree from the current weights, (2) wrap the
+optimizer so updated params are re-masked each step — the same
+"prune-and-keep-pruned" contract without monkey-patching.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.contrib.sparsity.masklib import create_mask
+
+PyTree = Any
+
+
+def _default_eligible(path: str, w) -> bool:
+    """Reference eligibility (asp.py:100-130): 2-D+ weights whose last dim
+    is a multiple of 4; biases/norms are left dense."""
+    return w.ndim >= 2 and w.shape[-1] % 4 == 0
+
+
+class ASP:
+    """Functional ASP.
+
+    Usage (mirrors init_model_for_pruning → compute_sparse_masks →
+    init_optimizer_for_pruning, asp.py:62-312)::
+
+        asp = ASP()
+        masks = asp.compute_sparse_masks(params)       # prune decision
+        params = asp.apply_masks(params, masks)        # prune weights
+        opt = asp.wrap_optimizer(optax.adam(1e-3), masks)  # keep pruned
+    """
+
+    def __init__(self, pattern: str = "m4n2_1d",
+                 eligible: Callable[[str, Any], bool] = _default_eligible):
+        self.pattern = pattern
+        self.eligible = eligible
+
+    def compute_sparse_masks(self, params: PyTree) -> PyTree:
+        """Mask pytree: boolean masks for eligible weights; ineligible
+        (dense) leaves get a scalar-True mask so the pytree structure stays
+        identical to params (``compute_sparse_masks`` asp.py:177-229)."""
+        def mk(path, w):
+            name = "/".join(str(p) for p in path)
+            if self.eligible(name, w):
+                return create_mask(w, self.pattern)
+            return jnp.ones((), bool)
+        return jax.tree_util.tree_map_with_path(mk, params)
+
+    def apply_masks(self, params: PyTree, masks: PyTree) -> PyTree:
+        return jax.tree.map(
+            lambda w, m: jnp.where(m, w, 0).astype(w.dtype), params, masks
+        )
+
+    def wrap_optimizer(
+        self, opt: optax.GradientTransformation, masks: PyTree
+    ) -> optax.GradientTransformation:
+        """Re-apply masks inside the update (the reference's patched
+        ``optimizer.step``, asp.py:231-259): masked weights stay exactly
+        zero — updates for them are zeroed so w + u keeps the pattern."""
+
+        def init(params):
+            return opt.init(params)
+
+        def update(grads, state, params=None):
+            updates, state = opt.update(grads, state, params)
+            if params is not None:
+                # masked slots: update = -w so the post-step weight is 0
+                updates = jax.tree.map(
+                    lambda u, w, m: jnp.where(m, u, -w).astype(u.dtype),
+                    updates, params, masks,
+                )
+            else:
+                updates = jax.tree.map(
+                    lambda u, m: jnp.where(m, u, 0).astype(u.dtype), updates, masks
+                )
+            return updates, state
+
+        return optax.GradientTransformation(init, update)

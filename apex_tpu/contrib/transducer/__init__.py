@@ -1,0 +1,12 @@
+"""RNN-T transducer joint + loss.
+
+Re-design of ``apex.contrib.transducer`` (``apex/contrib/transducer/transducer.py:5,68``;
+kernels ``transducer_joint_kernel.cu``, ``transducer_loss_kernel.cu``).
+"""
+
+from apex_tpu.contrib.transducer.transducer import (  # noqa: F401
+    TransducerJoint,
+    TransducerLoss,
+    transducer_joint,
+    transducer_loss,
+)

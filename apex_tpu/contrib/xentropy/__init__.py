@@ -1,0 +1,11 @@
+"""Fused softmax cross-entropy (contrib surface).
+
+Re-export of :mod:`apex_tpu.ops.xentropy`, matching
+``apex.contrib.xentropy.SoftmaxCrossEntropyLoss``
+(``apex/contrib/xentropy/softmax_xentropy.py:4-28``).
+"""
+
+from apex_tpu.ops.xentropy import (  # noqa: F401
+    SoftmaxCrossEntropyLoss,
+    softmax_cross_entropy_loss,
+)

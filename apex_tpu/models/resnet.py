@@ -40,7 +40,13 @@ def _conv_init(key, shape, dtype):
     return jax.random.normal(key, shape, dtype) * std
 
 
-def _conv(x, w, stride=1, padding="SAME"):
+def _conv(x, w, stride=1, padding=None):
+    """Symmetric explicit padding = (k-1)//2 per side, matching
+    torchvision's Conv2d(padding=k//2): XLA's "SAME" pads asymmetrically
+    ((0,1) for stride-2 3x3), which shifts every strided window by one."""
+    if padding is None:
+        kh, kw = w.shape[0], w.shape[1]
+        padding = ((kh // 2, kh // 2), (kw // 2, kw // 2))
     return jax.lax.conv_general_dilated(
         x, w, (stride, stride), padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
@@ -130,8 +136,10 @@ class ResNet50:
         new_state = {}
         h = _conv(x, params["conv1"], stride=2)
         h, new_state["bn1"] = self._bn(params["bn1"], state["bn1"], h, training)
+        # MaxPool2d(3, stride=2, padding=1): symmetric, like the convs
         h = jax.lax.reduce_window(
-            h, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+            h, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+            ((0, 0), (1, 1), (1, 1), (0, 0)),
         )
         for si, blocks in enumerate(c.layers):
             for bi in range(blocks):

@@ -1,0 +1,178 @@
+"""Flash attention (functional) + ring attention for context parallelism.
+
+``flash_attention`` supersedes the reference's ``apex.contrib.fmha``
+(``apex/contrib/fmha/fmha.py:33-76``: fp16, seq≤512 only) and the fused MHA
+cores of ``apex.contrib.multihead_attn``: one blockwise kernel, any length,
+causal or full, bf16/fp32.
+
+``ring_attention`` is the long-context capability the reference lacks
+entirely (SURVEY.md §5 "Long-context: not present"): Q/K/V are sharded over
+the ``cp`` mesh axis along sequence; KV shards rotate around the ring via
+``ppermute`` while each device folds incoming blocks into the online-softmax
+state. Communication hides behind the per-step attention compute (the
+ring-attention formulation of Liu et al.; blockwise core shared with flash).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import _backend
+from apex_tpu.ops.pallas import attention as _k
+from apex_tpu.parallel import mesh as mesh_lib
+
+
+# --- single-device flash attention -------------------------------------------
+
+def _xla_attention(q, k, v, scale, causal):
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None] + (sk - sq)
+        s = jnp.where(mask, s, _k.NEG_INF)
+    lse = jax.nn.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    o = jnp.einsum("bqk,bkd->bqd", p.astype(q.dtype), v)
+    return o, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_core(q, k, v, scale, causal, use_pallas):
+    o, _ = _flash_fwd_res(q, k, v, scale, causal, use_pallas)
+    return o
+
+
+def _flash_fwd_res(q, k, v, scale, causal, use_pallas):
+    if use_pallas:
+        o, lse = _k.flash_fwd(
+            q, k, v, scale=scale, causal=causal,
+            interpret=_backend.interpret_mode(),
+        )
+    else:
+        o, lse = _xla_attention(q, k, v, scale, causal)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_fwd(q, k, v, scale, causal, use_pallas):
+    o, res = _flash_fwd_res(q, k, v, scale, causal, use_pallas)
+    return o, res
+
+
+def _flash_bwd(scale, causal, use_pallas, res, do):
+    q, k, v, o, lse = res
+    if use_pallas:
+        dq, dk, dv = _k.flash_bwd(
+            q, k, v, o, lse, do, scale=scale, causal=causal,
+            interpret=_backend.interpret_mode(),
+        )
+    else:
+        s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+        if causal:
+            sq, sk = s.shape[-2], s.shape[-1]
+            mask = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None] + (sk - sq)
+            s = jnp.where(mask, s, _k.NEG_INF)
+        p = jnp.exp(s - lse[..., None])
+        dof = do.astype(jnp.float32)
+        dv = jnp.einsum("bqk,bqd->bkd", p, dof).astype(v.dtype)
+        dp = jnp.einsum("bqd,bkd->bqk", dof, v.astype(jnp.float32))
+        delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1, keepdims=True)
+        ds = p * (dp - delta) * scale
+        dq = jnp.einsum("bqk,bkd->bqd", ds, k.astype(jnp.float32)).astype(q.dtype)
+        dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32)).astype(k.dtype)
+    return dq, dk, dv
+
+
+_flash_core.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *, causal: bool = False, scale: Optional[float] = None, impl: str = "auto",
+) -> jax.Array:
+    """Blockwise attention over (..., seq, head_dim) with any number of
+    leading batch/head dims. No sequence-length cap (cf. fmha's 512)."""
+    d = q.shape[-1]
+    scale = float(scale if scale is not None else 1.0 / d ** 0.5)
+    lead = q.shape[:-2]
+    q3 = q.reshape(-1, q.shape[-2], d)
+    k3 = k.reshape(-1, k.shape[-2], d)
+    v3 = v.reshape(-1, v.shape[-2], d)
+    ok = (
+        q3.shape[-2] % 128 == 0 and k3.shape[-2] % 128 == 0
+        and (d % 128 == 0 or d == 64)
+    )
+    use_pallas = _backend.choose_impl(impl, ok) == "pallas"
+    o = _flash_core(q3, k3, v3, scale, causal, use_pallas)
+    return o.reshape(*lead, q.shape[-2], d)
+
+
+# --- ring attention (context parallel) ---------------------------------------
+
+def ring_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *, axis_name: str = mesh_lib.CONTEXT_AXIS, causal: bool = False,
+    scale: Optional[float] = None, impl: str = "auto",
+) -> jax.Array:
+    """Attention over a sequence sharded along ``axis_name``: q/k/v are this
+    device's (bh, s_local, d) shard; the full sequence is cp·s_local.
+
+    Must run inside shard_map with the axis bound. Per ring step the local
+    KV shard rotates to the next device and the blockwise state (m, l, acc)
+    folds the arriving shard in — identical math to flash attention's inner
+    loop, with the block loop distributed over devices. Causal masking uses
+    each shard's global offset, skipping fully-masked shards' compute is left
+    to XLA (the mask zeroes them).
+
+    Backward differentiates through the ``lax.scan`` of ring steps; each
+    step's attention is rematerialized (``jax.checkpoint``) so live memory
+    stays O(s_local) — the blockwise-parallel-transformer property.
+    """
+    cp = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    d = q.shape[-1]
+    scale = float(scale if scale is not None else 1.0 / d ** 0.5)
+    s_local = q.shape[-2]
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    qf = q.astype(jnp.float32)
+
+    @jax.checkpoint
+    def partial_scores(kv, kv_rank):
+        kk, vv = kv
+        s = jnp.einsum("bqd,bkd->bqk", qf, kk.astype(jnp.float32)) * scale
+        if causal:
+            q_pos = rank * s_local + jnp.arange(s_local)[:, None]
+            k_pos = kv_rank * s_local + jnp.arange(s_local)[None, :]
+            s = jnp.where(k_pos <= q_pos, s, _k.NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        o = jnp.einsum("bqk,bkd->bqd", p, vv.astype(jnp.float32))
+        return m, l, o
+
+    def step(carry, _):
+        m_acc, l_acc, o_acc, kv, kv_rank = carry
+        m, l, o = partial_scores(kv, kv_rank)
+        m_new = jnp.maximum(m_acc, m)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m - m_new)
+        l_new = l_acc * alpha + l * beta
+        o_new = o_acc * alpha + o * beta
+        kv = jax.tree.map(lambda x: jax.lax.ppermute(x, axis_name, perm), kv)
+        kv_rank = (kv_rank - 1) % cp
+        return (m_new, l_new, o_new, kv, kv_rank), None
+
+    bh = q.shape[0]
+    init = (
+        jnp.full((bh, s_local, 1), _k.NEG_INF, jnp.float32),
+        jnp.zeros((bh, s_local, 1), jnp.float32),
+        jnp.zeros((bh, s_local, d), jnp.float32),
+        (k, v),
+        rank,
+    )
+    (m_acc, l_acc, o_acc, _, _), _ = jax.lax.scan(step, init, None, length=cp)
+    return (o_acc / jnp.maximum(l_acc, 1e-30)).astype(q.dtype)

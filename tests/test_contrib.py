@@ -1,0 +1,272 @@
+"""Contrib-tier tests, mirroring ``apex/contrib/test/``'s per-module suites."""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel import mesh as mesh_lib
+
+K = jr.PRNGKey(55)
+
+
+class TestDistributedOptimizers:
+    def _setup(self):
+        mesh = mesh_lib.make_mesh()  # dp=8
+        params = {
+            "w1": jr.normal(K, (32, 48)),
+            "b1": jnp.zeros((48,)),
+            "w2": jr.normal(jr.fold_in(K, 1), (48, 8)),
+        }
+        grads = jax.tree.map(lambda x: jr.normal(jr.fold_in(K, 2), x.shape) * 0.1, params)
+        return mesh, params, grads
+
+    def test_zero_adam_matches_fused_adam(self):
+        from apex_tpu.contrib.optimizers import distributed_fused_adam
+        from apex_tpu.optimizers import fused_adam
+
+        mesh, params, grads = self._setup()
+        zopt = distributed_fused_adam(learning_rate=1e-2, weight_decay=0.01)
+
+        def run(params, grads):
+            state = zopt.init(params)
+            updates, state = zopt.update(grads, state, params)
+            # identical grads on every dp rank ⇒ reduce-scatter mean == grads
+            return optax.apply_updates(params, updates)
+
+        new_params = mesh_lib.shard_map(
+            run, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+        )(params, grads)
+
+        ref_opt = fused_adam(learning_rate=1e-2, weight_decay=0.01)
+        st = ref_opt.init(params)
+        up, _ = ref_opt.update(grads, st, params)
+        ref_params = optax.apply_updates(params, up)
+        for a, e in zip(jax.tree.leaves(new_params), jax.tree.leaves(ref_params)):
+            np.testing.assert_allclose(a, e, rtol=1e-5, atol=1e-6)
+
+    def test_zero_state_is_sharded(self):
+        from apex_tpu.contrib.optimizers import distributed_fused_adam
+        from apex_tpu.optimizers import multi_tensor as mt
+
+        mesh, params, grads = self._setup()
+        zopt = distributed_fused_adam()
+        full_buf, _ = mt.flatten_to_chunks(params)
+        n_chunks = full_buf.shape[0]
+
+        def state_rows(params):
+            st = zopt.init(params)
+            return jnp.asarray(st.buffers["m"].shape[0])
+
+        rows = mesh_lib.shard_map(
+            state_rows, mesh=mesh, in_specs=P(), out_specs=P(),
+        )(params)
+        padded = n_chunks + ((-n_chunks) % 8)
+        assert int(rows) == padded // 8  # 1/dp of the chunk rows
+
+    def test_zero_lamb_runs_and_differs_from_adam(self):
+        from apex_tpu.contrib.optimizers import distributed_fused_lamb
+
+        mesh, params, grads = self._setup()
+        zopt = distributed_fused_lamb(learning_rate=1e-2, max_grad_norm=1.0)
+
+        def run(params, grads):
+            state = zopt.init(params)
+            updates, _ = zopt.update(grads, state, params)
+            return optax.apply_updates(params, updates)
+
+        new_params = mesh_lib.shard_map(
+            run, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+        )(params, grads)
+        for a, p in zip(jax.tree.leaves(new_params), jax.tree.leaves(params)):
+            assert not np.allclose(a, p)
+            assert np.all(np.isfinite(a))
+
+
+class TestMultiheadAttn:
+    def test_self_attn_matches_manual(self):
+        from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+
+        m = SelfMultiheadAttn(embed_dim=32, num_heads=4, bias=True)
+        params = m.init(K)
+        x = jr.normal(jr.fold_in(K, 3), (2, 16, 32))
+        out = m(params, x, is_training=False)
+
+        qkv = x @ params["qkv_weight"].T + params["qkv_bias"]
+        q, k, v = jnp.split(qkv, 3, -1)
+        def heads(t):
+            return t.reshape(2, 16, 4, 8).transpose(0, 2, 1, 3)
+        s = jnp.einsum("bhqd,bhkd->bhqk", heads(q), heads(k)) / jnp.sqrt(8.0)
+        p = jax.nn.softmax(s, -1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, heads(v))
+        o = o.transpose(0, 2, 1, 3).reshape(2, 16, 32)
+        ref = o @ params["out_weight"].T + params["out_bias"]
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_norm_add_residual(self):
+        from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+
+        m = SelfMultiheadAttn(embed_dim=32, num_heads=4, include_norm_add=True)
+        params = m.init(K)
+        x = jr.normal(jr.fold_in(K, 4), (1, 8, 32))
+        out = m(params, x, is_training=False)
+        # zeroing the out projection must reduce to the residual
+        params2 = dict(params, out_weight=jnp.zeros_like(params["out_weight"]))
+        np.testing.assert_allclose(m(params2, x, is_training=False), x, atol=1e-6)
+
+    def test_encdec(self):
+        from apex_tpu.contrib.multihead_attn import EncdecMultiheadAttn
+
+        m = EncdecMultiheadAttn(embed_dim=32, num_heads=4, bias=True)
+        params = m.init(K)
+        q = jr.normal(jr.fold_in(K, 5), (2, 8, 32))
+        mem = jr.normal(jr.fold_in(K, 6), (2, 24, 32))
+        out = m(params, q, mem, is_training=False)
+        assert out.shape == (2, 8, 32)
+        assert bool(jnp.all(jnp.isfinite(out)))
+
+    def test_fmha_packed_layout(self):
+        from apex_tpu.contrib.fmha import fmha
+
+        qkv = jr.normal(K, (2, 16, 3, 4, 8))
+        o = fmha(qkv, causal=True)
+        assert o.shape == (2, 16, 4, 8)
+
+
+class TestTransducer:
+    def test_joint(self):
+        from apex_tpu.contrib.transducer import transducer_joint
+
+        f = jr.normal(K, (2, 5, 8))
+        g = jr.normal(jr.fold_in(K, 7), (2, 3, 8))
+        h = transducer_joint(f, g, relu=True)
+        ref = jnp.maximum(f[:, :, None, :] + g[:, None, :, :], 0)
+        np.testing.assert_allclose(h, ref, atol=1e-6)
+        # length masking
+        h2 = transducer_joint(f, g, f_len=jnp.array([5, 3]), g_len=jnp.array([3, 2]))
+        assert bool(jnp.all(h2[1, 3:] == 0)) and bool(jnp.all(h2[1, :, 2:] == 0))
+
+    def test_loss_matches_brute_force(self):
+        """Enumerate all monotone alignments on a tiny lattice."""
+        from apex_tpu.contrib.transducer import transducer_loss
+        import itertools
+
+        B, T, U, V = 1, 3, 2, 5
+        x = jr.normal(K, (B, T, U + 1, V))
+        labels = jnp.array([[1, 3]])
+        lp = jax.nn.log_softmax(x, -1)
+
+        # brute force: paths of T blanks and U labels
+        def path_logp(order):
+            # order: tuple of 'L'/'B' moves of length T-1+U... full RNN-T:
+            # T blank emissions total (one per frame advance incl. final)
+            t, u, acc = 0, 0, 0.0
+            for mv in order:
+                if mv == "B":
+                    acc += float(lp[0, t, u, 0])
+                    t += 1
+                else:
+                    acc += float(lp[0, t, u, int(labels[0, u])])
+                    u += 1
+            acc += float(lp[0, t, u, 0])  # final blank at (T-1, U)
+            return acc
+
+        import math
+        paths = []
+        # sequences of moves: T-1 blanks + U labels in any order
+        for order in set(itertools.permutations(["B"] * (T - 1) + ["L"] * U)):
+            paths.append(path_logp(order))
+        ref = -math.log(sum(math.exp(p) for p in paths))
+
+        loss = transducer_loss(x, labels, jnp.array([T]), jnp.array([U]))
+        np.testing.assert_allclose(float(loss[0]), ref, rtol=1e-5)
+
+    def test_loss_grad_finite(self):
+        from apex_tpu.contrib.transducer import transducer_loss
+
+        x = jr.normal(K, (2, 4, 3, 6))
+        labels = jnp.array([[1, 2], [3, 0]])
+        g = jax.grad(lambda x: jnp.sum(
+            transducer_loss(x, labels, jnp.array([4, 3]), jnp.array([2, 1]))
+        ))(x)
+        assert bool(jnp.all(jnp.isfinite(g)))
+
+
+class TestASP:
+    def test_mask_2to4(self):
+        from apex_tpu.contrib.sparsity import mask_2to4_best
+
+        w = jr.normal(K, (8, 16))
+        m = mask_2to4_best(w)
+        groups = m.reshape(8, 4, 4)
+        assert bool(jnp.all(groups.sum(-1) == 2))
+        # kept entries are the two largest |w| per group
+        wa = jnp.abs(w).reshape(8, 4, 4)
+        kept_min = jnp.min(jnp.where(groups, wa, jnp.inf), -1)
+        dropped_max = jnp.max(jnp.where(~groups, wa, -jnp.inf), -1)
+        assert bool(jnp.all(kept_min >= dropped_max))
+
+    def test_pruned_stays_pruned_through_training(self):
+        from apex_tpu.contrib.sparsity import ASP
+
+        asp = ASP()
+        params = {"w": jr.normal(K, (16, 32)), "b": jnp.zeros((7,))}
+        masks = asp.compute_sparse_masks(params)
+        params = asp.apply_masks(params, masks)
+        opt = asp.wrap_optimizer(optax.adam(1e-2), masks)
+        state = opt.init(params)
+        for i in range(3):
+            grads = jax.tree.map(
+                lambda x: jr.normal(jr.fold_in(K, i), x.shape), params)
+            updates, state = opt.update(grads, state, params)
+            params = optax.apply_updates(params, updates)
+        zeros = ~masks["w"]
+        assert bool(jnp.all(params["w"][zeros] == 0))
+        assert params["b"].shape == (7,)  # dense leaf untouched structurally
+
+
+class TestBottleneckConv:
+    def test_conv_bias_relu(self):
+        from apex_tpu.contrib.conv_bias_relu import conv_bias_relu
+
+        x = jr.normal(K, (2, 8, 8, 3))
+        w = jr.normal(jr.fold_in(K, 8), (3, 3, 3, 4)) * 0.2
+        b = jnp.ones((4,)) * 0.1
+        y = conv_bias_relu(x, w, b)
+        assert y.shape == (2, 8, 8, 4) and bool(jnp.all(y >= 0))
+
+    def test_bottleneck_block(self):
+        from apex_tpu.contrib.bottleneck import Bottleneck
+
+        blk = Bottleneck(16, 4, 16)
+        p, st = blk.init(K)
+        x = jr.normal(jr.fold_in(K, 9), (2, 8, 8, 16))
+        y, _ = blk(p, st, x)
+        assert y.shape == x.shape
+
+    def test_spatial_bottleneck_matches_unsharded(self):
+        from apex_tpu.contrib.bottleneck import Bottleneck, SpatialBottleneck
+
+        mesh = mesh_lib.make_mesh(context_parallel_size=4)
+        blk = Bottleneck(8, 4, 8)
+        sblk = SpatialBottleneck(8, 4, 8, spatial_axis="cp")
+        p, st = blk.init(K)
+        x = jr.normal(jr.fold_in(K, 10), (2, 16, 8, 8))
+
+        y_ref, _ = blk(p, st, x, training=False)
+        y, _ = mesh_lib.shard_map(
+            lambda p, st, x: sblk(p, st, x, training=False),
+            mesh=mesh, in_specs=(P(), P(), P(None, "cp")),
+            out_specs=(P(None, "cp"), P()),
+        )(p, st, x)
+        np.testing.assert_allclose(y, y_ref, rtol=2e-5, atol=2e-5)
+
+    def test_groupbn_axis_split(self):
+        from apex_tpu.contrib.groupbn import split_data_axis_for_bn
+
+        mesh = mesh_lib.make_mesh()  # dp=8
+        m2 = split_data_axis_for_bn(mesh, 4)
+        assert m2.shape["bn"] == 4 and m2.shape["dp_outer"] == 2
