@@ -1,0 +1,83 @@
+"""Checkpoint / resume.
+
+Re-design of the reference's checkpoint surface (SURVEY.md §5): the
+reference persists amp's per-loss scaler state (``amp.state_dict()``
+``frontend.py:361-400``), fp32 master weights regardless of cast
+(``O2StateDictHook`` ``_initialize.py:133-143``), and
+``FP16_Optimizer.state_dict`` (scaler + masters,
+``fp16_optimizer.py:209-270``), documenting a bitwise-accurate resume recipe
+(``README.md:60-100``).
+
+Here one ``TrainState`` pytree holds (master params, optimizer state, loss
+scaler state, step) and round-trips through orbax — saving the *fp32
+masters* (like the O2 hook) so resume is bitwise regardless of the compute
+dtype. ``save``/``restore`` are synchronous; pass an
+``orbax.checkpoint.CheckpointManager`` for async/rotation policies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+try:
+    import orbax.checkpoint as ocp
+    _HAS_ORBAX = True
+except Exception:  # pragma: no cover
+    _HAS_ORBAX = False
+
+PyTree = Any
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    """Everything a bitwise resume needs (cf. README.md:60-100 recipe)."""
+
+    step: jax.Array
+    params: PyTree              # fp32 masters (O2StateDictHook semantics)
+    opt_state: PyTree
+    scaler_state: Optional[PyTree] = None
+    extra: Optional[PyTree] = None  # e.g. BN running stats
+
+
+def save_checkpoint(path: str, state: TrainState) -> None:
+    if not _HAS_ORBAX:
+        raise RuntimeError("orbax is unavailable in this environment")
+    ckpt = ocp.StandardCheckpointer()
+    ckpt.save(path, state)
+    ckpt.wait_until_finished()
+
+
+def restore_checkpoint(path: str, template: TrainState) -> TrainState:
+    """Restore into the shapes/dtypes (and shardings) of ``template``."""
+    if not _HAS_ORBAX:
+        raise RuntimeError("orbax is unavailable in this environment")
+    ckpt = ocp.StandardCheckpointer()
+    return ckpt.restore(path, template)
+
+
+# --- amp state-dict parity (frontend.py:361-400) ------------------------------
+
+def amp_state_dict(scaler_states) -> dict:
+    """``amp.state_dict()``: {'loss_scaler0': {...}, ...} per loss."""
+    from apex_tpu.amp.scaler import state_dict as scaler_sd
+
+    if not isinstance(scaler_states, (list, tuple)):
+        scaler_states = [scaler_states]
+    return {f"loss_scaler{i}": scaler_sd(s) for i, s in enumerate(scaler_states)}
+
+
+def amp_load_state_dict(sd: dict, scaler_states):
+    """``amp.load_state_dict()`` — loads each payload into the matching
+    scaler state, returning the new states in order."""
+    from apex_tpu.amp.scaler import load_state_dict as scaler_ld
+
+    if not isinstance(scaler_states, (list, tuple)):
+        scaler_states = [scaler_states]
+    return [
+        scaler_ld(s, sd[f"loss_scaler{i}"]) for i, s in enumerate(scaler_states)
+    ]

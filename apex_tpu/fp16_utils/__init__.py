@@ -1,0 +1,21 @@
+"""Legacy manual mixed-precision API.
+
+Re-design of ``apex.fp16_utils`` (``apex/fp16_utils/__init__.py:1-16``,
+``fp16util.py``, ``fp16_optimizer.py``, ``loss_scaler.py``) — the pre-amp
+manual API kept for parity. In JAX, "convert the network" is a pytree cast
+and "master params" are a second pytree, so each reference entry point maps
+to a small pure function; ``FP16_Optimizer`` wraps an optax transformation
+with master-weight + loss-scaling bookkeeping.
+"""
+
+from apex_tpu.fp16_utils.fp16util import (  # noqa: F401
+    BN_CONVERT_EXEMPT,
+    convert_network,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    to_python_float,
+)
+from apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer  # noqa: F401
+from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler  # noqa: F401

@@ -1,0 +1,103 @@
+"""FP16_Optimizer — the legacy master-weights wrapper.
+
+Re-design of ``apex/fp16_utils/fp16_optimizer.py:13-450``: wraps an inner
+optimizer with fp32 master weights, (dynamic) loss scaling, overflow skip,
+and master-grad clipping. The reference mutates the wrapped torch optimizer's
+param groups; here the wrapper owns a state pytree and exposes
+``backward``-less functional stepping (loss scaling happens in the user's
+grad computation via ``scale_loss``) plus the reference's method surface for
+familiarity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.amp import scaler as _fscaler
+from apex_tpu.fp16_utils.fp16util import (
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    prep_param_lists,
+)
+from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler
+
+PyTree = Any
+
+
+class FP16_Optimizer:
+    """Stateful wrapper (``fp16_optimizer.py:13``): holds (model params,
+    fp32 masters, inner optax state, scaler); ``step(grads)`` unscales,
+    checks overflow, updates masters, copies back to model dtype."""
+
+    def __init__(self, optimizer: optax.GradientTransformation, params: PyTree,
+                 static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: Optional[dict] = None):
+        self.inner = optimizer
+        self.model_params, self.master_params = prep_param_lists(params)
+        self.opt_state = optimizer.init(self.master_params)
+        if dynamic_loss_scale:
+            self.loss_scaler = DynamicLossScaler(**(dynamic_loss_args or {}))
+        else:
+            self.loss_scaler = LossScaler(static_loss_scale)
+        self.overflow = False
+
+    @property
+    def loss_scale(self) -> float:
+        return self.loss_scaler.loss_scale
+
+    def scale_loss(self, loss):
+        """Multiply the loss before grad (`backward(loss)` analog)."""
+        return loss * self.loss_scale
+
+    def clip_master_grads(self, max_norm: float, grads: PyTree) -> PyTree:
+        """Global-norm clip on master grads (``clip_master_grads``
+        ``fp16_optimizer.py:373``)."""
+        gnorm = optax.global_norm(grads)
+        factor = jnp.minimum(1.0, max_norm / jnp.maximum(gnorm, 1e-12))
+        return jax.tree.map(lambda g: g * factor, grads)
+
+    def step(self, scaled_model_grads: PyTree, clip_grad_norm: Optional[float] = None):
+        """One update from *scaled half-precision* grads; skips on overflow
+        (the reference's skip-step patch, ``handle.py:128-154``)."""
+        master_grads = model_grads_to_master_grads(scaled_model_grads)
+        master_grads = jax.tree.map(lambda g: g / self.loss_scale, master_grads)
+        self.overflow = not bool(_fscaler.all_finite(master_grads))
+        self.loss_scaler.update_scale(self.overflow)
+        if self.overflow:
+            return self.model_params
+        if clip_grad_norm is not None:
+            master_grads = self.clip_master_grads(clip_grad_norm, master_grads)
+        updates, self.opt_state = self.inner.update(
+            master_grads, self.opt_state, self.master_params
+        )
+        self.master_params = optax.apply_updates(self.master_params, updates)
+        self.model_params = master_params_to_model_params(
+            self.model_params, self.master_params
+        )
+        return self.model_params
+
+    # --- checkpointing (``fp16_optimizer.py:209-270``) -----------------------
+
+    def state_dict(self) -> dict:
+        return {
+            "master_params": self.master_params,
+            "opt_state": self.opt_state,
+            "loss_scale": self.loss_scale,
+            "dynamic": isinstance(self.loss_scaler, DynamicLossScaler),
+            "unskipped": getattr(self.loss_scaler, "_unskipped", 0),
+        }
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.master_params = sd["master_params"]
+        self.opt_state = sd["opt_state"]
+        self.loss_scaler._scale = float(sd["loss_scale"])
+        if sd.get("dynamic") and isinstance(self.loss_scaler, DynamicLossScaler):
+            self.loss_scaler._unskipped = int(sd.get("unskipped", 0))
+        self.model_params = master_params_to_model_params(
+            self.model_params, self.master_params
+        )

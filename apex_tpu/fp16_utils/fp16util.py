@@ -1,0 +1,67 @@
+"""Pytree casting utilities.
+
+Re-design of ``apex/fp16_utils/fp16util.py``: ``network_to_half`` /
+``convert_network`` keep batch-norm-ish leaves fp32 (the reference walks
+modules and exempts ``torch.nn.modules.batchnorm._BatchNorm``); on a pytree
+the exemption is by key-path match.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+# key-path substrings treated as batch-norm/normalization params (kept fp32),
+# the pytree analog of the reference's isinstance(_BatchNorm) check
+BN_CONVERT_EXEMPT = ("bn", "batchnorm", "batch_norm", "ln", "layernorm", "norm", "scale")
+
+
+def _is_exempt(path: Tuple, exempt=BN_CONVERT_EXEMPT) -> bool:
+    name = "/".join(str(p) for p in path).lower()
+    return any(e in name for e in exempt)
+
+
+def network_to_half(params: PyTree, dtype=jnp.bfloat16) -> PyTree:
+    """Cast every floating leaf (``network_to_half``; the reference wraps
+    in ``tofp16`` modules — here it is one tree cast)."""
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        params,
+    )
+
+
+def convert_network(params: PyTree, dtype=jnp.bfloat16,
+                    exempt=BN_CONVERT_EXEMPT) -> PyTree:
+    """Half-cast except normalization params (``convert_network`` —
+    ``keep_batchnorm_fp32`` semantics, ``fp16util.py``)."""
+    def cast(path, x):
+        if not jnp.issubdtype(x.dtype, jnp.floating) or _is_exempt(path, exempt):
+            return x
+        return x.astype(dtype)
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def prep_param_lists(params: PyTree) -> Tuple[PyTree, PyTree]:
+    """(model_params, fp32 master copies) — ``prep_param_lists``
+    (``fp16util.py``; the reference also flattens, which the fused
+    optimizers' chunk layout does on demand)."""
+    master = jax.tree.map(lambda x: x.astype(jnp.float32), params)
+    return params, master
+
+
+def master_params_to_model_params(model: PyTree, master: PyTree) -> PyTree:
+    """Copy master values into the model dtype (``fp16util.py``)."""
+    return jax.tree.map(lambda mo, ma: ma.astype(mo.dtype), model, master)
+
+
+def model_grads_to_master_grads(model_grads: PyTree) -> PyTree:
+    """fp32 copies of (half) model grads (``fp16util.py``)."""
+    return jax.tree.map(lambda g: g.astype(jnp.float32), model_grads)
+
+
+def to_python_float(x) -> float:
+    return float(x)

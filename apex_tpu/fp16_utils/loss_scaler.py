@@ -1,0 +1,63 @@
+"""Legacy LossScaler / DynamicLossScaler.
+
+Re-design of ``apex/fp16_utils/loss_scaler.py``: stateful host-side objects
+(the legacy API contract) delegating the math to the functional scaler in
+:mod:`apex_tpu.amp.scaler` — same constants (init 2^16 dynamic, x2/2000
+growth, /2 backoff).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import scaler as _fscaler
+
+
+class LossScaler:
+    """Static scale (``loss_scaler.py`` LossScaler)."""
+
+    def __init__(self, scale: float = 1.0):
+        self._scale = float(scale)
+
+    @property
+    def loss_scale(self) -> float:
+        return self._scale
+
+    def scale_gradient(self, grads):
+        return jax.tree.map(lambda g: g * self._scale, grads)
+
+    def unscale(self, grads):
+        return jax.tree.map(lambda g: g / self._scale, grads)
+
+    def update_scale(self, overflow: bool) -> None:
+        pass
+
+    def has_overflow(self, grads) -> bool:
+        return False
+
+
+class DynamicLossScaler(LossScaler):
+    """Dynamic scale (``loss_scaler.py`` DynamicLossScaler): /2 on overflow,
+    x2 after ``scale_window`` clean steps."""
+
+    def __init__(self, init_scale: float = 2.0 ** 16, scale_factor: float = 2.0,
+                 scale_window: int = 2000):
+        super().__init__(init_scale)
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, grads) -> bool:
+        finite = _fscaler.all_finite(grads)
+        return not bool(finite)
+
+    def update_scale(self, overflow: bool) -> None:
+        if overflow:
+            self._scale = max(self._scale / self.scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self.scale_window:
+                self._scale *= self.scale_factor
+                self._unskipped = 0

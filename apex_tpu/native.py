@@ -1,0 +1,111 @@
+"""ctypes bindings for the native runtime components in ``csrc/``.
+
+The reference binds its C++/CUDA through pybind11 extension modules
+(``setup.py``); pybind11 is not available here, so the native tier uses a
+plain C ABI + ctypes (zero build-time Python deps). The library builds with
+``make -C csrc`` (g++ only); every caller has a pure-Python fallback, so the
+framework is fully functional without the build — the native path removes
+host-side Python overhead for very large models/traces.
+
+Components:
+* ``plan_layout`` — chunk-layout metadata (apex_C / multi_tensor_apply host
+  loop analog, ``csrc/layout_planner.cpp``);
+* ``aggregate_trace`` — profiler record aggregation (pyprof.prof analog,
+  ``csrc/trace_analyzer.cpp``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+_LIB_NAME = "libapex_tpu_native.so"
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "csrc")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    path = os.path.join(_CSRC, _LIB_NAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.plan_layout.restype = ctypes.c_int64
+        lib.plan_layout.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.aggregate_trace_json.restype = ctypes.c_int64
+        lib.aggregate_trace_json.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def build(verbose: bool = False) -> bool:
+    """Compile the native library (``make -C csrc``). Returns success."""
+    global _tried
+    try:
+        r = subprocess.run(
+            ["make", "-C", _CSRC], capture_output=not verbose, check=False
+        )
+        _tried = False  # force re-probe
+        return r.returncode == 0 and _load() is not None
+    except OSError:
+        return False
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def plan_layout(sizes, chunk_size: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(chunk_to_tensor i32[n_chunks], tensor_offsets i64[n_tensors]) —
+    native when built, numpy otherwise."""
+    sizes = np.asarray(sizes, np.int64)
+    lib = _load()
+    if lib is None:
+        chunk_counts = np.maximum(1, -(-sizes // chunk_size))
+        c2t = np.repeat(np.arange(len(sizes), dtype=np.int32), chunk_counts)
+        offsets = np.concatenate([[0], np.cumsum(chunk_counts)[:-1]]) * chunk_size
+        return c2t, offsets.astype(np.int64)
+    n = len(sizes)
+    total = lib.plan_layout(
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n, chunk_size,
+        None, None,
+    )
+    c2t = np.empty(total, np.int32)
+    offsets = np.empty(n, np.int64)
+    lib.plan_layout(
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n, chunk_size,
+        c2t.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return c2t, offsets
+
+
+def aggregate_trace(records_json: str) -> Dict[str, dict]:
+    """Aggregate op records (see ``analyzer.analyze_ops``); raises if the
+    native library is absent (callers check :func:`available`)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library not built; run apex_tpu.native.build()")
+    cap = max(1 << 16, len(records_json))
+    out = ctypes.create_string_buffer(cap)
+    n = lib.aggregate_trace_json(records_json.encode(), out, cap)
+    if n < 0:
+        raise ValueError("native trace aggregation failed")
+    return json.loads(out.value.decode())

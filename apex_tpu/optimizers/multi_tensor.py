@@ -60,10 +60,14 @@ class ChunkLayout:
 
 
 def make_layout(tree: PyTree, chunk_size: int = DEFAULT_CHUNK) -> ChunkLayout:
+    from apex_tpu import native
+
     leaves, treedef = jax.tree.flatten(tree)
     shapes = tuple(tuple(x.shape) for x in leaves)
-    chunk_counts = [max(1, -(-int(np.prod(s)) // chunk_size)) for s in shapes]
-    chunk_to_tensor = np.repeat(np.arange(len(shapes), dtype=np.int32), chunk_counts)
+    sizes = [int(np.prod(s)) for s in shapes]
+    # native planner (csrc/layout_planner.cpp — the apex_C/multi_tensor host
+    # loop) when built; identical numpy fallback otherwise
+    chunk_to_tensor, _ = native.plan_layout(sizes, chunk_size)
     return ChunkLayout(
         chunk_to_tensor=jnp.asarray(chunk_to_tensor),
         treedef=treedef,

@@ -1,0 +1,20 @@
+"""Profiling — re-design of ``apex.pyprof``.
+
+The reference's three stages (SURVEY.md §5) map to TPU-native equivalents:
+
+1. ``pyprof.nvtx`` monkey-patches torch to emit NVTX ranges
+   (``apex/pyprof/nvtx/nvmarker.py``) → :func:`annotate` /
+   :func:`init` wrap functions in ``jax.named_scope`` so ops carry names
+   into the XLA trace, and :func:`trace` drives ``jax.profiler``;
+2. ``pyprof.parse`` correlates kernels with markers from the nvprof DB →
+   unnecessary: XLA traces already carry scope names;
+3. ``pyprof.prof`` computes per-kernel FLOPs/bytes/efficiency
+   (``apex/pyprof/prof/``, one analyzer per op family) →
+   :func:`cost_analysis` reads XLA's own per-program cost model from the
+   compiled executable, and :mod:`apex_tpu.prof.analyzer` aggregates
+   per-op-family statistics and roofline classification (native C++ fast
+   path in ``csrc/trace_analyzer.cpp`` for large traces).
+"""
+
+from apex_tpu.prof.marker import annotate, init, trace  # noqa: F401
+from apex_tpu.prof.analyzer import OpStats, analyze_ops, cost_analysis  # noqa: F401

@@ -1,0 +1,116 @@
+"""Per-op cost analysis (pyprof.prof analog).
+
+The reference ships 26 analyzer classes computing FLOPs/bytes per kernel
+family from argument shapes (``apex/pyprof/prof/{blas,conv,pointwise,…}.py``).
+On TPU, XLA's compiler already carries an exact cost model per HLO — so the
+analyzer (a) extracts program-level cost from compiled executables
+(:func:`cost_analysis`) and (b) aggregates per-op records into family
+statistics with roofline classification (:func:`analyze_ops`), using the
+native C++ aggregator (``csrc/trace_analyzer.cpp``) when built, else numpy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+
+from apex_tpu import native as _native
+
+# op-name prefixes → family, the analog of pyprof's per-family analyzer
+# classes (blas.py, conv.py, pointwise.py, reduction.py, …)
+FAMILIES = {
+    "dot": "gemm", "conv": "conv", "fusion": "fusion",
+    "all-reduce": "collective", "all-gather": "collective",
+    "reduce-scatter": "collective", "collective-permute": "collective",
+    "reduce": "reduction", "scatter": "memory", "gather": "memory",
+    "copy": "memory", "transpose": "memory", "broadcast": "memory",
+    "custom-call": "custom",
+}
+
+
+@dataclasses.dataclass
+class OpStats:
+    family: str
+    count: int
+    flops: float
+    bytes_accessed: float
+    time_s: float
+
+    @property
+    def arithmetic_intensity(self) -> float:
+        return self.flops / self.bytes_accessed if self.bytes_accessed else 0.0
+
+    @property
+    def tflops_per_s(self) -> float:
+        return self.flops / self.time_s / 1e12 if self.time_s else 0.0
+
+
+def cost_analysis(fn, *args, **kwargs) -> Dict[str, float]:
+    """Compile ``fn`` and return XLA's cost analysis (flops, bytes accessed,
+    optimal seconds) — the whole-program version of pyprof's per-kernel
+    derivation from shapes."""
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca or {})
+
+
+def _family_of(name: str) -> str:
+    n = name.lower()
+    for prefix, fam in FAMILIES.items():
+        if n.startswith(prefix) or f".{prefix}" in n:
+            return fam
+    return "other"
+
+
+def analyze_ops(ops: Sequence[dict]) -> Dict[str, OpStats]:
+    """Aggregate op records ({'name', 'flops', 'bytes', 'time_s'}) into
+    per-family stats. Uses the C++ aggregator for large traces."""
+    ops = list(ops)
+    if _native.available() and len(ops) >= 1024:
+        agg = _native.aggregate_trace(
+            json.dumps([
+                {"f": _family_of(o.get("name", "")), "flops": float(o.get("flops", 0.0)),
+                 "bytes": float(o.get("bytes", 0.0)), "t": float(o.get("time_s", 0.0))}
+                for o in ops
+            ])
+        )
+        return {
+            k: OpStats(family=k, count=int(v["count"]), flops=v["flops"],
+                       bytes_accessed=v["bytes"], time_s=v["t"])
+            for k, v in agg.items()
+        }
+
+    acc: Dict[str, List[float]] = defaultdict(lambda: [0, 0.0, 0.0, 0.0])
+    for o in ops:
+        fam = _family_of(o.get("name", ""))
+        a = acc[fam]
+        a[0] += 1
+        a[1] += float(o.get("flops", 0.0))
+        a[2] += float(o.get("bytes", 0.0))
+        a[3] += float(o.get("time_s", 0.0))
+    return {
+        fam: OpStats(family=fam, count=int(c), flops=f, bytes_accessed=b, time_s=t)
+        for fam, (c, f, b, t) in acc.items()
+    }
+
+
+def report(stats: Dict[str, OpStats], peak_tflops: float = 197.0,
+           peak_gbs: float = 819.0) -> str:
+    """Roofline-style text report (pyprof.prof output analog); defaults are
+    v5e bf16 peak / HBM bandwidth."""
+    lines = [f"{'family':<12}{'count':>7}{'GFLOP':>10}{'GB':>9}{'ms':>9}"
+             f"{'TFLOP/s':>9}{'AI':>7}  bound"]
+    for fam, s in sorted(stats.items(), key=lambda kv: -kv[1].time_s):
+        ridge = peak_tflops * 1e12 / (peak_gbs * 1e9)
+        bound = "compute" if s.arithmetic_intensity > ridge else "memory"
+        lines.append(
+            f"{fam:<12}{s.count:>7}{s.flops/1e9:>10.2f}{s.bytes_accessed/1e9:>9.3f}"
+            f"{s.time_s*1e3:>9.3f}{s.tflops_per_s:>9.2f}{s.arithmetic_intensity:>7.1f}  {bound}"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,63 @@
+"""Weight reparameterization (weight norm).
+
+Re-design of ``apex.reparameterization`` (``apex/reparameterization/__init__.py``,
+``weight_norm.py`` — deprecated in the reference but part of its surface).
+The reference installs forward-pre hooks rewriting ``weight`` from (g, v);
+functionally that is a parameterization pair: ``decompose`` splits a weight
+into (g, v), ``compose`` rebuilds ``w = g * v / ||v||`` — applied to any
+pytree leaf selection before the forward.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+def weight_norm_decompose(w: jax.Array, dim: int = 0) -> Tuple[jax.Array, jax.Array]:
+    """w → (g, v) with g the per-slice norm along every axis but ``dim``
+    (``WeightNorm.compute_weight`` inverse)."""
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    g = jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+    return g, w
+
+
+def weight_norm_compose(g: jax.Array, v: jax.Array, dim: int = 0, eps: float = 1e-12) -> jax.Array:
+    """(g, v) → w = g · v/||v|| (``weight_norm.py`` compute_weight)."""
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    norm = jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+    return g * v / jnp.maximum(norm, eps)
+
+
+def apply_weight_norm(params: PyTree, select: Callable[[str], bool] = None,
+                      dim: int = 0) -> PyTree:
+    """Split selected weights into {'g','v'} sub-dicts
+    (``apply_weight_norm``; default: every leaf named 'weight')."""
+    select = select or (lambda name: name.endswith("weight"))
+
+    def walk(path, x):
+        name = "/".join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+        )
+        if select(name) and x.ndim >= 2:
+            g, v = weight_norm_decompose(x, dim)
+            return {"g": g, "v": v}
+        return x
+
+    return jax.tree_util.tree_map_with_path(walk, params)
+
+
+def remove_weight_norm(params: PyTree, dim: int = 0) -> PyTree:
+    """Recompose {'g','v'} sub-dicts into plain weights
+    (``remove_weight_norm``)."""
+    def walk(x):
+        if isinstance(x, dict) and set(x.keys()) == {"g", "v"}:
+            return weight_norm_compose(x["g"], x["v"], dim)
+        return x
+
+    return jax.tree.map(walk, params,
+                        is_leaf=lambda x: isinstance(x, dict) and set(x.keys()) == {"g", "v"})

@@ -1,0 +1,38 @@
+"""RNN cells and stacks.
+
+Re-design of ``apex.RNN`` (``apex/RNN/__init__.py:1``, ``RNNBackend.py:25``,
+``cells.py`` — deprecated in the reference but part of its surface): LSTM,
+GRU, ReLU/Tanh RNN, and mLSTM, as ``lax.scan``-driven functional cells. The
+reference's "fused" forgetgate-style cells map to XLA's elementwise fusion
+inside the scan body.
+"""
+
+from apex_tpu.rnn.cells import (  # noqa: F401
+    GRUCell,
+    LSTMCell,
+    RNNReLUCell,
+    RNNTanhCell,
+    mLSTMCell,
+)
+from apex_tpu.rnn.backend import RNN, bidirectional, stacked_rnn  # noqa: F401
+
+
+def LSTM(input_size, hidden_size, num_layers=1, **kw):
+    """``apex.RNN.LSTM`` factory (``apex/RNN/models.py``)."""
+    return RNN(LSTMCell(input_size, hidden_size), num_layers=num_layers, **kw)
+
+
+def GRU(input_size, hidden_size, num_layers=1, **kw):
+    return RNN(GRUCell(input_size, hidden_size), num_layers=num_layers, **kw)
+
+
+def ReLU(input_size, hidden_size, num_layers=1, **kw):
+    return RNN(RNNReLUCell(input_size, hidden_size), num_layers=num_layers, **kw)
+
+
+def Tanh(input_size, hidden_size, num_layers=1, **kw):
+    return RNN(RNNTanhCell(input_size, hidden_size), num_layers=num_layers, **kw)
+
+
+def mLSTM(input_size, hidden_size, num_layers=1, **kw):
+    return RNN(mLSTMCell(input_size, hidden_size), num_layers=num_layers, **kw)

@@ -1,0 +1,82 @@
+"""RNN driver: scan over time, layer stacking, bidirection.
+
+Re-design of ``apex/RNN/RNNBackend.py:25`` (``stackedRNN``/``bidirectionalRNN``):
+the time loop is ``lax.scan`` (compiled once, no per-step dispatch), layers
+stack by function composition, bidirection concatenates a reversed scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class RNN:
+    """Multi-layer unidirectional RNN over (batch, time, features)."""
+
+    cell: Any
+    num_layers: int = 1
+    dropout: float = 0.0
+
+    def init(self, key, dtype=jnp.float32) -> list:
+        return [
+            self._layer_cell(i).init(jax.random.fold_in(key, i), dtype)
+            for i in range(self.num_layers)
+        ]
+
+    def _layer_cell(self, i):
+        if i == 0:
+            return self.cell
+        return dataclasses.replace(self.cell, input_size=self.cell.hidden_size)
+
+    def __call__(self, params: list, x: jax.Array,
+                 initial_states: Optional[list] = None,
+                 key: Optional[jax.Array] = None):
+        """Returns (outputs (B, T, H), final_states list)."""
+        b = x.shape[0]
+        finals = []
+        h = x
+        for i, p in enumerate(params):
+            cell = self._layer_cell(i)
+            state0 = (initial_states[i] if initial_states is not None
+                      else cell.initial_state(b, x.dtype))
+
+            def step(state, xt, p=p, cell=cell):
+                state, y = cell.step(p, state, xt)
+                return state, y
+
+            final, ys = jax.lax.scan(step, state0, h.transpose(1, 0, 2))
+            h = ys.transpose(1, 0, 2)
+            if self.dropout > 0 and key is not None and i < len(params) - 1:
+                keep = jax.random.bernoulli(
+                    jax.random.fold_in(key, i), 1.0 - self.dropout, h.shape)
+                h = jnp.where(keep, h / (1.0 - self.dropout), 0.0).astype(h.dtype)
+            finals.append(final)
+        return h, finals
+
+
+def stacked_rnn(cell, num_layers: int, dropout: float = 0.0) -> RNN:
+    """``stackedRNN`` factory (``RNNBackend.py``)."""
+    return RNN(cell, num_layers=num_layers, dropout=dropout)
+
+
+def bidirectional(rnn: RNN):
+    """``bidirectionalRNN`` (``RNNBackend.py``): run forward and
+    time-reversed stacks, concat features."""
+
+    def init(key, dtype=jnp.float32):
+        return {"fwd": rnn.init(jax.random.fold_in(key, 0), dtype),
+                "bwd": rnn.init(jax.random.fold_in(key, 1), dtype)}
+
+    def apply(params, x, **kw):
+        yf, sf = rnn(params["fwd"], x, **kw)
+        yb, sb = rnn(params["bwd"], x[:, ::-1], **kw)
+        return jnp.concatenate([yf, yb[:, ::-1]], axis=-1), (sf, sb)
+
+    return init, apply

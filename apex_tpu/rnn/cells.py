@@ -1,0 +1,116 @@
+"""RNN cells — re-design of ``apex/RNN/cells.py``.
+
+Each cell is (init, step): ``init(key) -> params``; ``step(params, h, x) ->
+(h', y)``. Gate matmuls are fused into one GEMM per input/hidden (the
+reference's ``fusedBackend``-style packing); XLA fuses the elementwise gate
+math into the GEMM consumers inside the scan body.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _uniform(key, shape, dtype, bound):
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+@dataclasses.dataclass
+class _Cell:
+    input_size: int
+    hidden_size: int
+    n_gates: int = 1
+
+    def init(self, key, dtype=jnp.float32) -> dict:
+        b = 1.0 / self.hidden_size ** 0.5
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        g = self.n_gates * self.hidden_size
+        return {
+            "w_ih": _uniform(k1, (g, self.input_size), dtype, b),
+            "w_hh": _uniform(k2, (g, self.hidden_size), dtype, b),
+            "b_ih": _uniform(k3, (g,), dtype, b),
+            "b_hh": _uniform(k4, (g,), dtype, b),
+        }
+
+    def initial_state(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+
+@dataclasses.dataclass
+class RNNTanhCell(_Cell):
+    def step(self, p, h, x):
+        h = jnp.tanh(x @ p["w_ih"].T + p["b_ih"] + h @ p["w_hh"].T + p["b_hh"])
+        return h, h
+
+
+@dataclasses.dataclass
+class RNNReLUCell(_Cell):
+    def step(self, p, h, x):
+        h = jnp.maximum(x @ p["w_ih"].T + p["b_ih"] + h @ p["w_hh"].T + p["b_hh"], 0)
+        return h, h
+
+
+@dataclasses.dataclass
+class LSTMCell(_Cell):
+    n_gates: int = 4
+
+    def initial_state(self, batch, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.hidden_size), dtype),
+                jnp.zeros((batch, self.hidden_size), dtype))
+
+    def step(self, p, state, x):
+        h, c = state
+        gates = x @ p["w_ih"].T + p["b_ih"] + h @ p["w_hh"].T + p["b_hh"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+
+@dataclasses.dataclass
+class GRUCell(_Cell):
+    n_gates: int = 3
+
+    def step(self, p, h, x):
+        gi = x @ p["w_ih"].T + p["b_ih"]
+        gh = h @ p["w_hh"].T + p["b_hh"]
+        ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+        hr, hz, hn = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(in_ + r * hn)
+        h = (1 - z) * n + z * h
+        return h, h
+
+
+@dataclasses.dataclass
+class mLSTMCell(_Cell):
+    """Multiplicative LSTM (``apex/RNN/cells.py`` mLSTMRNNCell): hidden
+    state is modulated by m = (W_mx x) * (W_mh h) before the gates."""
+
+    n_gates: int = 4
+
+    def init(self, key, dtype=jnp.float32) -> dict:
+        b = 1.0 / self.hidden_size ** 0.5
+        params = super().init(key, dtype)
+        k1, k2 = jax.random.split(jax.random.fold_in(key, 99))
+        params["w_mx"] = _uniform(k1, (self.hidden_size, self.input_size), dtype, b)
+        params["w_mh"] = _uniform(k2, (self.hidden_size, self.hidden_size), dtype, b)
+        return params
+
+    def initial_state(self, batch, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.hidden_size), dtype),
+                jnp.zeros((batch, self.hidden_size), dtype))
+
+    def step(self, p, state, x):
+        h, c = state
+        m = (x @ p["w_mx"].T) * (h @ p["w_mh"].T)
+        gates = x @ p["w_ih"].T + p["b_ih"] + m @ p["w_hh"].T + p["b_hh"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h

@@ -1,0 +1,6 @@
+"""Megatron-style batch samplers — re-design of ``apex/transformer/_data/``."""
+
+from apex_tpu.transformer._data._batchsampler import (  # noqa: F401
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)

@@ -1,0 +1,99 @@
+"""Batch samplers for data-parallel pretraining.
+
+Re-design of ``apex/transformer/_data/_batchsampler.py:16-180``: yield index
+lists such that each data-parallel rank reads its own contiguous slice of
+every global batch, resumable from ``consumed_samples``. Pure host-side
+iterators (no torch DataLoader dependency — any indexable dataset works).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class MegatronPretrainingSampler:
+    """Sequential sampler (``_batchsampler.py:16-98``)."""
+
+    def __init__(self, total_samples: int, consumed_samples: int,
+                 micro_batch_size: int, data_parallel_rank: int,
+                 data_parallel_size: int, drop_last: bool = True):
+        self.total_samples = total_samples
+        self.consumed_samples = consumed_samples
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_rank = data_parallel_rank
+        self.data_parallel_size = data_parallel_size
+        self.micro_batch_times_data_parallel_size = (
+            micro_batch_size * data_parallel_size)
+        self.drop_last = drop_last
+        if total_samples <= 0:
+            raise ValueError("no sample to consume")
+        if consumed_samples >= total_samples:
+            raise ValueError("no samples left to consume")
+        if data_parallel_rank >= data_parallel_size:
+            raise ValueError("data_parallel_rank should be smaller than size")
+
+    def __len__(self):
+        return self.total_samples
+
+    def get_start_end_idx(self):
+        start = self.data_parallel_rank * self.micro_batch_size
+        return start, start + self.micro_batch_size
+
+    def __iter__(self):
+        batch = []
+        for idx in range(self.consumed_samples, self.total_samples):
+            batch.append(idx)
+            if len(batch) == self.micro_batch_times_data_parallel_size:
+                s, e = self.get_start_end_idx()
+                yield batch[s:e]
+                batch = []
+        if batch and not self.drop_last:
+            s, e = self.get_start_end_idx()
+            yield batch[s:e]
+
+
+class MegatronPretrainingRandomSampler:
+    """Shuffled epoch-bucketed sampler (``_batchsampler.py:100-180``):
+    shuffle within the current epoch's remaining pool, deterministic in
+    (epoch, seed)."""
+
+    def __init__(self, total_samples: int, consumed_samples: int,
+                 micro_batch_size: int, data_parallel_rank: int,
+                 data_parallel_size: int, seed: int = 0):
+        self.total_samples = total_samples
+        self.consumed_samples = consumed_samples
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_rank = data_parallel_rank
+        self.data_parallel_size = data_parallel_size
+        self.micro_batch_times_data_parallel_size = (
+            micro_batch_size * data_parallel_size)
+        self.last_batch_size = (
+            self.total_samples % self.micro_batch_times_data_parallel_size)
+        self.seed = seed
+
+    def __len__(self):
+        return self.total_samples
+
+    def __iter__(self):
+        active_total_samples = self.total_samples - self.last_batch_size
+        self.epoch = self.consumed_samples // active_total_samples
+        current_epoch_samples = self.consumed_samples % active_total_samples
+        assert current_epoch_samples % self.micro_batch_times_data_parallel_size == 0
+
+        # data sharded over dp ranks: contiguous bucket per rank, shuffled
+        bucket_size = (self.total_samples // self.micro_batch_times_data_parallel_size
+                       ) * self.micro_batch_size
+        bucket_offset = current_epoch_samples // self.data_parallel_size
+        start_idx = self.data_parallel_rank * bucket_size
+
+        rng = np.random.RandomState(self.seed + self.epoch)
+        random_idx = rng.permutation(bucket_size)
+        idx_range = [start_idx + int(x) for x in random_idx[bucket_offset:]]
+
+        batch = []
+        for idx in idx_range:
+            batch.append(idx)
+            if len(batch) == self.micro_batch_size:
+                self.consumed_samples += self.micro_batch_times_data_parallel_size
+                yield batch
+                batch = []

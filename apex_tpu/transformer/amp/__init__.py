@@ -1,0 +1,45 @@
+"""Model-parallel-aware gradient scaling.
+
+Re-design of ``apex.transformer.amp.GradScaler``
+(``apex/transformer/amp/grad_scaler.py:21-107``): the reference subclasses
+torch's GradScaler to all-reduce found-inf across the model-parallel group so
+every rank skips the same step. Here the functional scaler from
+:mod:`apex_tpu.amp.scaler` is extended with an any-reduce of the non-finite
+flag over the given mesh axes — the same "skip together" contract.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScalerState, update_loss_scaler
+from apex_tpu.parallel import mesh as mesh_lib
+
+
+def model_parallel_all_finite(
+    grads, axes: Sequence[str] = (mesh_lib.TENSOR_AXIS, mesh_lib.PIPELINE_AXIS)
+) -> jax.Array:
+    """All-finite flag agreed across model-parallel axes (``grad_scaler.py:38-49``):
+    a single non-finite grad anywhere makes every rank skip."""
+    from apex_tpu.amp.scaler import all_finite
+
+    finite = all_finite(grads).astype(jnp.float32)
+    for ax in axes:
+        finite = jax.lax.pmin(finite, ax)
+    return finite > 0
+
+
+def update_scaler_model_parallel(
+    state: LossScalerState, grads,
+    axes: Sequence[str] = (mesh_lib.TENSOR_AXIS, mesh_lib.PIPELINE_AXIS),
+) -> Tuple[LossScalerState, jax.Array]:
+    """update() with the cross-rank found-inf reduction
+    (``grad_scaler.py:96-107``). Returns (new_state, finite)."""
+    finite = model_parallel_all_finite(grads, axes)
+    return update_loss_scaler(state, finite), finite
+
+
+GradScaler = update_scaler_model_parallel  # reference class-name alias

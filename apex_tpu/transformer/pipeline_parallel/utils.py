@@ -1,0 +1,169 @@
+"""Pipeline/training utilities.
+
+Re-design of ``apex/transformer/pipeline_parallel/utils.py``: microbatch
+setup re-exports, LM mask/position helpers, DP loss averaging, memory
+reporting, and wall timers. The reference's CUDA-sync timers
+(``_timers.py:6-49``) become ``block_until_ready``-fenced timers.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel import mesh as mesh_lib
+from apex_tpu.transformer.microbatches import (  # noqa: F401  (re-exports)
+    get_current_global_batch_size,
+    get_num_microbatches,
+    setup_microbatch_calculator,
+    update_num_microbatches,
+)
+
+
+def listify_model(model):
+    """``listify_model`` (``utils.py``): virtual-pipeline models are lists."""
+    return model if isinstance(model, list) else [model]
+
+
+def unwrap_model(model, *_):
+    """API parity (``utils.py:185``): no wrapper modules exist here."""
+    return model
+
+
+def get_ltor_masks_and_position_ids(
+    tokens: jax.Array,
+    eod_token: Optional[int] = None,
+    reset_position_ids: bool = False,
+    reset_attention_mask: bool = False,
+    eod_mask_loss: bool = False,
+):
+    """Left-to-right (causal) masks + positions (``utils.py:303-374``).
+
+    Returns (attention_mask (b,1,s,s) bool — True means *masked out*, like
+    the fused-softmax convention; loss_mask (b,s) f32; position_ids (b,s)).
+    EOD resets are data-dependent; the reset variants keep the same shapes
+    (static under jit) by building masks with cumsum over EOD markers.
+    """
+    b, s = tokens.shape
+    causal = ~(jnp.arange(s)[None, :] <= jnp.arange(s)[:, None])  # True above diag
+    att = jnp.broadcast_to(causal, (b, 1, s, s))
+
+    loss_mask = jnp.ones((b, s), jnp.float32)
+    if eod_mask_loss and eod_token is not None:
+        loss_mask = jnp.where(tokens == eod_token, 0.0, loss_mask)
+
+    position_ids = jnp.broadcast_to(jnp.arange(s), (b, s))
+    if (reset_position_ids or reset_attention_mask) and eod_token is not None:
+        # document id = number of EODs strictly before each position
+        doc = jnp.cumsum((tokens == eod_token).astype(jnp.int32), axis=1)
+        doc = jnp.concatenate([jnp.zeros((b, 1), jnp.int32), doc[:, :-1]], axis=1)
+        if reset_position_ids:
+            # position within the document: index - start-of-document index
+            idx = jnp.arange(s)[None, :]
+            start = jnp.where(
+                doc[:, :, None] == doc[:, None, :], idx[:, None, :], s
+            ).min(axis=2)
+            position_ids = idx - start
+        if reset_attention_mask:
+            cross_doc = doc[:, :, None] != doc[:, None, :]
+            att = att | cross_doc[:, None, :, :]
+    return att, loss_mask, position_ids
+
+
+def average_losses_across_data_parallel_group(losses: List[jax.Array],
+                                              axis_name: str = mesh_lib.DATA_AXIS):
+    """``utils.py:242-250``: pmean of stacked losses over dp (inside
+    shard_map); outside a mapped context it is a plain mean."""
+    stacked = jnp.stack([jnp.asarray(l) for l in losses])
+    try:
+        return jax.lax.pmean(stacked, axis_name)
+    except NameError:
+        return stacked
+
+
+def report_memory(name: str = "") -> str:
+    """``report_memory`` (``utils.py:253-263``): per-device live-buffer
+    stats from the JAX runtime."""
+    lines = []
+    for d in jax.local_devices():
+        stats = d.memory_stats() or {}
+        used = stats.get("bytes_in_use", 0) / 2**20
+        peak = stats.get("peak_bytes_in_use", 0) / 2**20
+        lines.append(f"[{name}] {d}: in_use {used:.1f} MiB, peak {peak:.1f} MiB")
+    report = "\n".join(lines)
+    return report
+
+
+def param_norms(params) -> Dict[str, float]:
+    """min/max/norm dump (``utils.py:265-285``)."""
+    leaves = jax.tree.leaves(params)
+    if not leaves:
+        return {}
+    flat = jnp.concatenate([jnp.ravel(x).astype(jnp.float32) for x in leaves])
+    return {
+        "min": float(jnp.min(flat)),
+        "max": float(jnp.max(flat)),
+        "norm": float(jnp.linalg.norm(flat)),
+    }
+
+
+class _Timer:
+    """One named timer (``_timers.py:6-49``); device-fenced via
+    block_until_ready on a tracked array when provided."""
+
+    def __init__(self, name):
+        self.name = name
+        self.elapsed_ = 0.0
+        self.started = False
+        self.start_time = 0.0
+
+    def start(self, fence=None):
+        assert not self.started
+        if fence is not None:
+            jax.block_until_ready(fence)
+        self.start_time = time.perf_counter()
+        self.started = True
+
+    def stop(self, fence=None):
+        assert self.started
+        if fence is not None:
+            jax.block_until_ready(fence)
+        self.elapsed_ += time.perf_counter() - self.start_time
+        self.started = False
+
+    def elapsed(self, reset=True):
+        e = self.elapsed_
+        if reset:
+            self.elapsed_ = 0.0
+        return e
+
+
+class Timers:
+    """``get_timers()`` registry (``pipeline_parallel/utils.py:146-157``)."""
+
+    def __init__(self):
+        self._timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self._timers:
+            self._timers[name] = _Timer(name)
+        return self._timers[name]
+
+    def log(self, names=None, normalizer: float = 1.0) -> str:
+        names = names or list(self._timers)
+        parts = [f"{n}: {self._timers[n].elapsed(reset=True)/normalizer*1000:.2f}ms"
+                 for n in names if n in self._timers]
+        return " | ".join(parts)
+
+
+_GLOBAL_TIMERS: Optional[Timers] = None
+
+
+def get_timers() -> Timers:
+    global _GLOBAL_TIMERS
+    if _GLOBAL_TIMERS is None:
+        _GLOBAL_TIMERS = Timers()
+    return _GLOBAL_TIMERS

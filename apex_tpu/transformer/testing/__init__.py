@@ -1,0 +1,20 @@
+"""Testing utilities — re-design of ``apex/transformer/testing/``.
+
+* standalone GPT/BERT live in :mod:`apex_tpu.models` (the reference keeps
+  them here, ``standalone_gpt.py``/``standalone_bert.py``) — re-exported;
+* :mod:`apex_tpu.transformer.testing.arguments` — the Megatron-style global
+  argparse singleton (``arguments.py``, ``global_vars.py``);
+* :mod:`apex_tpu.transformer.testing.commons` — toy pipeline models
+  (``commons.py:34-72``);
+* the multi-device harness is the 8-device CPU mesh in ``tests/conftest.py``
+  (the DistributedTestBase analog — SURVEY.md §4).
+"""
+
+from apex_tpu.models.bert import BertConfig, BertModel  # noqa: F401
+from apex_tpu.models.gpt import GPTConfig, GPTModel  # noqa: F401
+from apex_tpu.transformer.testing.arguments import (  # noqa: F401
+    get_args,
+    parse_args,
+    set_args,
+)
+from apex_tpu.transformer.testing.commons import MyModel, model_provider_func  # noqa: F401

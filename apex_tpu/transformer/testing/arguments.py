@@ -1,0 +1,78 @@
+"""Megatron-style global argument parser.
+
+Re-design of ``apex/transformer/testing/arguments.py`` (808 LoC) +
+``global_vars.py:270``'s get/set singleton: the subset of arguments the
+transformer stack actually consumes, with the same names and defaults, plus
+the TPU-native extensions (context parallelism, sequence parallelism).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+_GLOBAL_ARGS = None
+
+
+def parse_args(extra_args_provider=None, args_list=None) -> argparse.Namespace:
+    """``parse_args`` (``arguments.py``): model/train/parallel argument
+    groups; unrecognized args error like the reference."""
+    parser = argparse.ArgumentParser(description="apex_tpu arguments")
+
+    g = parser.add_argument_group("model")
+    g.add_argument("--num-layers", type=int, default=2)
+    g.add_argument("--hidden-size", type=int, default=64)
+    g.add_argument("--num-attention-heads", type=int, default=4)
+    g.add_argument("--ffn-hidden-size", type=int, default=None)
+    g.add_argument("--max-position-embeddings", type=int, default=512)
+    g.add_argument("--seq-length", type=int, default=128)
+    g.add_argument("--vocab-size", type=int, default=1024)
+    g.add_argument("--padded-vocab-size", type=int, default=None)
+
+    g = parser.add_argument_group("train")
+    g.add_argument("--micro-batch-size", type=int, default=2)
+    g.add_argument("--global-batch-size", type=int, default=None)
+    g.add_argument("--rampup-batch-size", nargs="*", type=int, default=None)
+    g.add_argument("--lr", type=float, default=1e-4)
+    g.add_argument("--train-iters", type=int, default=10)
+    g.add_argument("--fp16", action="store_true")
+    g.add_argument("--bf16", action="store_true")
+    g.add_argument("--loss-scale", type=float, default=None)
+    g.add_argument("--initial-loss-scale", type=float, default=2**16)
+    g.add_argument("--seed", type=int, default=1234)
+
+    g = parser.add_argument_group("parallel")
+    g.add_argument("--tensor-model-parallel-size", type=int, default=1)
+    g.add_argument("--pipeline-model-parallel-size", type=int, default=1)
+    g.add_argument("--virtual-pipeline-model-parallel-size", type=int, default=None)
+    g.add_argument("--context-parallel-size", type=int, default=1)
+    g.add_argument("--sequence-parallel", action="store_true")
+    g.add_argument("--num-microbatches", type=int, default=None)
+
+    if extra_args_provider is not None:
+        parser = extra_args_provider(parser)
+    args = parser.parse_args(args_list)
+
+    if args.padded_vocab_size is None:
+        # pad vocab to a multiple of 128*tp (the reference pads to
+        # make-vocab-size-divisible-by x tp)
+        mult = 128 * args.tensor_model_parallel_size
+        args.padded_vocab_size = ((args.vocab_size + mult - 1) // mult) * mult
+    if args.global_batch_size is None:
+        args.global_batch_size = args.micro_batch_size
+    if args.ffn_hidden_size is None:
+        args.ffn_hidden_size = 4 * args.hidden_size
+    return args
+
+
+def set_args(args) -> None:
+    """``set_global_variables`` analog (``global_vars.py``)."""
+    global _GLOBAL_ARGS
+    _GLOBAL_ARGS = args
+
+
+def get_args():
+    """``get_args`` (``global_vars.py:270``)."""
+    if _GLOBAL_ARGS is None:
+        raise RuntimeError("arguments are not initialized; call set_args(parse_args())")
+    return _GLOBAL_ARGS

@@ -1,0 +1,34 @@
+"""Toy models for pipeline tests — re-design of
+``apex/transformer/testing/commons.py:34-72`` (``MyModel`` with
+``set_input_tensor``; here the stage function carries its input explicitly).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class MyModel:
+    """A square linear layer with optional activation: the reference's
+    pipeline test stand-in (``commons.py:34``)."""
+
+    def __init__(self, hidden_size: int, activation: bool = False):
+        self.hidden_size = hidden_size
+        self.activation = activation
+
+    def init(self, key, dtype=jnp.float32) -> dict:
+        return {
+            "weight": jax.random.normal(key, (self.hidden_size, self.hidden_size), dtype)
+            * (1.0 / self.hidden_size ** 0.5),
+            "bias": jnp.zeros((self.hidden_size,), dtype),
+        }
+
+    def __call__(self, params: dict, x: jax.Array) -> jax.Array:
+        y = x @ params["weight"] + params["bias"]
+        return jnp.tanh(y) if self.activation else y
+
+
+def model_provider_func(hidden_size: int, activation: bool = False) -> MyModel:
+    """``model_provider_func`` (``commons.py``)."""
+    return MyModel(hidden_size, activation)

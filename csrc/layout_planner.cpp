@@ -1,0 +1,44 @@
+// Chunk-layout planner — native host-side metadata construction.
+//
+// TPU-native equivalent of the host side of the reference's multi-tensor
+// machinery: apex_C's flatten bookkeeping (csrc/flatten_unflatten.cpp) and
+// the chunk-metadata packing loop of multi_tensor_apply
+// (csrc/multi_tensor_apply.cuh:41-133), which walks every tensor computing
+// per-chunk (tensor index, chunk offset) records before each launch. Here
+// the same walk produces the chunk->tensor map and per-tensor offsets that
+// apex_tpu.optimizers.multi_tensor uses to drive its fused XLA updates —
+// O(total_chunks) C with no Python-loop overhead for models with very many
+// parameter tensors.
+//
+// Exposed C ABI (ctypes):
+//   plan_layout(sizes, n_tensors, chunk_size, chunk_to_tensor_out,
+//               tensor_offset_out) -> total_chunks
+//   (chunk_to_tensor_out sized by a prior call with outputs null.)
+
+#include <cstdint>
+#include <cstddef>
+
+extern "C" {
+
+// Returns the number of chunks the layout needs; fills outputs when non-null.
+// sizes[i]: element count of tensor i. Every tensor owns >= 1 chunk
+// (zero-sized tensors still get a placeholder chunk, matching
+// multi_tensor.make_layout's max(1, ceil(size/chunk))).
+int64_t plan_layout(const int64_t* sizes, int64_t n_tensors, int64_t chunk_size,
+                    int32_t* chunk_to_tensor_out, int64_t* tensor_offset_out) {
+  int64_t total = 0;
+  for (int64_t i = 0; i < n_tensors; ++i) {
+    int64_t chunks = (sizes[i] + chunk_size - 1) / chunk_size;
+    if (chunks == 0) chunks = 1;
+    if (tensor_offset_out) tensor_offset_out[i] = total * chunk_size;
+    if (chunk_to_tensor_out) {
+      for (int64_t c = 0; c < chunks; ++c) chunk_to_tensor_out[total + c] = (int32_t)i;
+      total += chunks;
+    } else {
+      total += chunks;
+    }
+  }
+  return total;
+}
+
+}  // extern "C"

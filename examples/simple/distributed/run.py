@@ -1,0 +1,84 @@
+"""Toy distributed MLP — port of ``examples/simple/distributed/``.
+
+The reference's smallest end-to-end script: a tiny MLP under amp +
+DistributedDataParallel, one process per GPU via ``torch.distributed.launch``.
+Here the same run is a single SPMD program over the mesh's ``dp`` axis — run
+it on any host (CPU mesh via XLA_FLAGS, or a TPU slice) with no launcher:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/simple/distributed/run.py --opt-level O2
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import optax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import amp
+from apex_tpu.optimizers import fused_adam
+from apex_tpu.parallel import mesh as mesh_lib
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--opt-level", default="O0", choices=["O0", "O1", "O2", "O3"])
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--loss-scale", default=None)
+    args = p.parse_args()
+
+    mesh = mesh_lib.initialize_model_parallel()  # dp = all devices
+    dp = mesh_lib.get_data_parallel_world_size()
+    print(f"devices: {jax.device_count()} (dp={dp}), opt_level={args.opt_level}")
+
+    policy = amp.get_policy(args.opt_level)
+    key = jr.PRNGKey(0)
+    D, H = 64, 256
+    params = {
+        "w1": jr.normal(key, (D, H)) * 0.05, "b1": jnp.zeros((H,)),
+        "w2": jr.normal(jr.fold_in(key, 1), (H, D)) * 0.05, "b2": jnp.zeros((D,)),
+    }
+    master = amp.MasterWeights.create(params, policy)
+    opt = fused_adam(learning_rate=args.lr)
+    opt_state = opt.init(master.master)
+    scaler = amp.init_loss_scaler(args.loss_scale or "dynamic")
+
+    W_true = jr.normal(jr.fold_in(key, 2), (D, D))
+
+    def loss_fn(model_params, x, y):
+        h = jnp.maximum(x @ model_params["w1"] + model_params["b1"], 0)
+        out = h @ model_params["w2"] + model_params["b2"]
+        return jnp.mean((out - y) ** 2)
+
+    def train_step(master, opt_state, scaler, x, y):
+        def run(master, opt_state, scaler, x, y):
+            loss, (grads, finite, scaler) = amp.scaled_value_and_grad(loss_fn)(
+                scaler, master.model, x, y)
+            grads = jax.lax.pmean(grads, "dp")
+            loss = jax.lax.pmean(loss, "dp")
+            updates, opt_state = opt.update(grads, opt_state, master.master)
+            master = amp.apply_updates_with_master(
+                master, updates, grads_finite=finite)
+            return master, opt_state, scaler, loss
+
+        return mesh_lib.shard_map(
+            run, mesh=mesh,
+            in_specs=(P(), P(), P(), P("dp"), P("dp")),
+            out_specs=(P(), P(), P(), P()),
+        )(master, opt_state, scaler, x, y)
+
+    step = jax.jit(train_step)
+    for i in range(args.steps):
+        x = jr.normal(jr.fold_in(key, 100 + i), (8 * dp, D))
+        y = jnp.tanh(x @ W_true)
+        master, opt_state, scaler, loss = step(master, opt_state, scaler, x, y)
+        if i % 20 == 0 or i == args.steps - 1:
+            print(f"step {i:4d}  loss {float(loss):.5f}  "
+                  f"scale {float(scaler.loss_scale):.0f}")
+
+
+if __name__ == "__main__":
+    main()

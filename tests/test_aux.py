@@ -1,0 +1,266 @@
+"""Tests for the auxiliary tiers: fp16_utils, RNN, reparameterization,
+pipeline utils, batch samplers, arguments, checkpoint, model-parallel scaler.
+
+Mirrors the reference's ``tests/L0/run_fp16util``, RNN-cast tests,
+``test_batch_sampler.py``, ``test_microbatches.py``, and the checkpointing
+tests (``test_checkpointing.py``).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel import mesh as mesh_lib
+
+K = jr.PRNGKey(77)
+
+
+class TestFP16Utils:
+    def test_network_to_half_and_convert(self):
+        from apex_tpu.fp16_utils import convert_network, network_to_half
+
+        params = {"w": jnp.ones((4, 4)), "bn_scale": jnp.ones((4,)),
+                  "step": jnp.zeros((), jnp.int32)}
+        half = network_to_half(params)
+        assert half["w"].dtype == jnp.bfloat16
+        assert half["bn_scale"].dtype == jnp.bfloat16
+        assert half["step"].dtype == jnp.int32  # non-float untouched
+
+        conv = convert_network(params)
+        assert conv["w"].dtype == jnp.bfloat16
+        assert conv["bn_scale"].dtype == jnp.float32  # BN exempt
+
+    def test_fp16_optimizer_step_and_overflow_skip(self):
+        from apex_tpu.fp16_utils import FP16_Optimizer
+
+        params = {"w": jnp.ones((4,), jnp.bfloat16)}
+        opt = FP16_Optimizer(optax.sgd(0.1), params,
+                             dynamic_loss_scale=True,
+                             dynamic_loss_args=dict(init_scale=4.0, scale_window=2))
+        grads = {"w": jnp.full((4,), 2.0, jnp.bfloat16) * 4.0}  # scaled by 4
+        new = opt.step(grads)
+        np.testing.assert_allclose(np.asarray(new["w"], np.float32), 0.8, rtol=1e-2)
+        assert not opt.overflow
+
+        # overflow: inf grads → skip + scale halves
+        bad = {"w": jnp.array([jnp.inf] * 4, jnp.bfloat16)}
+        before = jax.tree.map(lambda x: x, opt.master_params)
+        new2 = opt.step(bad)
+        assert opt.overflow
+        assert opt.loss_scale == 2.0
+        np.testing.assert_array_equal(new2["w"], new["w"])
+        np.testing.assert_array_equal(opt.master_params["w"], before["w"])
+
+    def test_fp16_optimizer_state_dict_roundtrip(self):
+        from apex_tpu.fp16_utils import FP16_Optimizer
+
+        params = {"w": jnp.ones((4,), jnp.bfloat16)}
+        opt = FP16_Optimizer(optax.adam(0.1), params, static_loss_scale=8.0)
+        opt.step({"w": jnp.ones((4,), jnp.bfloat16) * 8.0})
+        sd = opt.state_dict()
+
+        opt2 = FP16_Optimizer(optax.adam(0.1), params, static_loss_scale=8.0)
+        opt2.load_state_dict(sd)
+        for a, e in zip(jax.tree.leaves(opt2.master_params),
+                        jax.tree.leaves(opt.master_params)):
+            np.testing.assert_array_equal(a, e)
+
+
+class TestRNN:
+    @pytest.mark.parametrize("factory", ["LSTM", "GRU", "ReLU", "Tanh", "mLSTM"])
+    def test_shapes_and_grads(self, factory):
+        import apex_tpu.rnn as rnn_lib
+
+        rnn = getattr(rnn_lib, factory)(8, 16, num_layers=2)
+        params = rnn.init(K)
+        x = jr.normal(jr.fold_in(K, 1), (3, 5, 8))
+        y, finals = rnn(params, x)
+        assert y.shape == (3, 5, 16)
+        g = jax.grad(lambda p: jnp.sum(rnn(p, x)[0] ** 2))(params)
+        assert all(bool(jnp.all(jnp.isfinite(l))) for l in jax.tree.leaves(g))
+
+    def test_lstm_matches_manual_single_step(self):
+        from apex_tpu.rnn import LSTMCell
+
+        cell = LSTMCell(4, 4)
+        p = cell.init(K)
+        x = jr.normal(jr.fold_in(K, 2), (1, 4))
+        (h, c), y = cell.step(p, cell.initial_state(1), x)
+        gates = x @ p["w_ih"].T + p["b_ih"] + p["b_hh"]
+        i, f, g, o = jnp.split(gates, 4, -1)
+        c_ref = jax.nn.sigmoid(i) * jnp.tanh(g)
+        h_ref = jax.nn.sigmoid(o) * jnp.tanh(c_ref)
+        np.testing.assert_allclose(h, h_ref, atol=1e-6)
+
+    def test_bidirectional(self):
+        from apex_tpu.rnn import GRU
+        from apex_tpu.rnn.backend import bidirectional
+
+        init, apply = bidirectional(GRU(8, 16))
+        params = init(K)
+        y, _ = apply(params, jr.normal(K, (2, 6, 8)))
+        assert y.shape == (2, 6, 32)
+
+
+class TestReparameterization:
+    def test_weight_norm_roundtrip(self):
+        from apex_tpu.reparameterization import (
+            apply_weight_norm, remove_weight_norm,
+        )
+
+        params = {"layer": {"weight": jr.normal(K, (8, 4)), "bias": jnp.zeros(8)}}
+        wn = apply_weight_norm(params)
+        assert set(wn["layer"]["weight"].keys()) == {"g", "v"}
+        back = remove_weight_norm(wn)
+        np.testing.assert_allclose(back["layer"]["weight"],
+                                   params["layer"]["weight"], rtol=1e-6)
+
+    def test_norm_is_g(self):
+        from apex_tpu.reparameterization import weight_norm_compose
+
+        v = jr.normal(K, (4, 6))
+        g = jnp.full((4, 1), 3.0)
+        w = weight_norm_compose(g, v)
+        np.testing.assert_allclose(jnp.linalg.norm(w, axis=1), 3.0, rtol=1e-5)
+
+
+class TestPipelineUtils:
+    def test_ltor_masks(self):
+        from apex_tpu.transformer.pipeline_parallel.utils import (
+            get_ltor_masks_and_position_ids,
+        )
+
+        tokens = jnp.array([[5, 1, 7, 9], [2, 2, 1, 3]])  # eod=1
+        att, loss_mask, pos = get_ltor_masks_and_position_ids(
+            tokens, eod_token=1, reset_position_ids=True,
+            reset_attention_mask=True, eod_mask_loss=True,
+        )
+        assert att.shape == (2, 1, 4, 4)
+        # loss masked at EODs
+        np.testing.assert_array_equal(loss_mask, [[1, 0, 1, 1], [1, 1, 0, 1]])
+        # positions reset after EOD
+        np.testing.assert_array_equal(pos[0], [0, 1, 0, 1])
+        # attention cannot cross document boundary: token 2 (doc 1) vs 0 (doc 0)
+        assert bool(att[0, 0, 2, 0]) and bool(att[0, 0, 2, 1])
+        assert not bool(att[0, 0, 3, 2])  # same doc, causal-visible
+
+    def test_timers(self):
+        from apex_tpu.transformer.pipeline_parallel.utils import get_timers
+
+        t = get_timers()
+        t("fwd").start()
+        t("fwd").stop()
+        log = t.log(["fwd"])
+        assert "fwd" in log
+
+    def test_report_memory_runs(self):
+        from apex_tpu.transformer.pipeline_parallel.utils import report_memory
+
+        assert isinstance(report_memory("test"), str)
+
+
+class TestBatchSamplers:
+    def test_sequential_rank_slices(self):
+        from apex_tpu.transformer._data import MegatronPretrainingSampler
+
+        batches_r0 = list(MegatronPretrainingSampler(
+            total_samples=16, consumed_samples=0, micro_batch_size=2,
+            data_parallel_rank=0, data_parallel_size=2))
+        batches_r1 = list(MegatronPretrainingSampler(
+            total_samples=16, consumed_samples=0, micro_batch_size=2,
+            data_parallel_rank=1, data_parallel_size=2))
+        assert batches_r0[0] == [0, 1] and batches_r1[0] == [2, 3]
+        assert len(batches_r0) == 4
+        # disjoint coverage
+        seen = sorted(i for b in batches_r0 + batches_r1 for i in b)
+        assert seen == list(range(16))
+
+    def test_resume_from_consumed(self):
+        from apex_tpu.transformer._data import MegatronPretrainingSampler
+
+        b = list(MegatronPretrainingSampler(
+            total_samples=16, consumed_samples=8, micro_batch_size=2,
+            data_parallel_rank=0, data_parallel_size=2))
+        assert b[0] == [8, 9]
+
+    def test_random_sampler_deterministic(self):
+        from apex_tpu.transformer._data import MegatronPretrainingRandomSampler
+
+        def run():
+            return list(MegatronPretrainingRandomSampler(
+                total_samples=32, consumed_samples=0, micro_batch_size=2,
+                data_parallel_rank=1, data_parallel_size=2, seed=7))
+        assert run() == run()
+        flat = [i for b in run() for i in b]
+        assert all(16 <= i < 32 for i in flat)  # rank-1 bucket
+
+
+class TestArguments:
+    def test_parse_and_singleton(self):
+        from apex_tpu.transformer.testing import get_args, parse_args, set_args
+
+        args = parse_args(args_list=[
+            "--num-layers", "4", "--tensor-model-parallel-size", "2",
+            "--vocab-size", "1000",
+        ])
+        assert args.num_layers == 4
+        assert args.padded_vocab_size == 1024  # padded to 128*tp
+        set_args(args)
+        assert get_args().num_layers == 4
+
+
+class TestCheckpoint:
+    def test_train_state_roundtrip(self, tmp_path):
+        from apex_tpu.checkpoint import TrainState, restore_checkpoint, save_checkpoint
+
+        params = {"w": jr.normal(K, (4, 4)), "b": jnp.zeros((4,))}
+        opt = optax.adam(1e-3)
+        state = TrainState(
+            step=jnp.asarray(7), params=params, opt_state=opt.init(params),
+        )
+        path = os.path.join(str(tmp_path), "ckpt")
+        save_checkpoint(path, state)
+        template = jax.tree.map(jnp.zeros_like, state)
+        restored = restore_checkpoint(path, template)
+        assert int(restored.step) == 7
+        for a, e in zip(jax.tree.leaves(restored.params), jax.tree.leaves(params)):
+            np.testing.assert_array_equal(a, e)  # bitwise
+
+    def test_amp_state_dict_parity(self):
+        from apex_tpu.amp.scaler import init_loss_scaler
+        from apex_tpu.checkpoint import amp_load_state_dict, amp_state_dict
+
+        s = init_loss_scaler(init_scale=1024.0)
+        sd = amp_state_dict([s, s])
+        assert set(sd) == {"loss_scaler0", "loss_scaler1"}
+        restored = amp_load_state_dict(sd, [init_loss_scaler(), init_loss_scaler()])
+        assert float(restored[0].loss_scale) == 1024.0
+
+
+class TestModelParallelScaler:
+    def test_skip_agreed_across_tp(self):
+        from apex_tpu.amp.scaler import init_loss_scaler
+        from apex_tpu.transformer.amp import update_scaler_model_parallel
+
+        mesh = mesh_lib.make_mesh(tensor_model_parallel_size=4)
+
+        def run(grads):
+            # only rank 0's shard has an inf — every rank must see finite=False
+            rank = jax.lax.axis_index("tp")
+            g = jnp.where(rank == 0, jnp.inf, 1.0) * grads
+            state = init_loss_scaler(init_scale=16.0)
+            new_state, finite = update_scaler_model_parallel(
+                state, {"g": g}, axes=("tp",))
+            return new_state.loss_scale, finite.astype(jnp.int32)
+
+        scale, finite = mesh_lib.shard_map(
+            run, mesh=mesh, in_specs=P(), out_specs=(P(), P()),
+        )(jnp.ones((4,)))
+        assert float(scale) == 8.0  # backed off on every rank
+        assert int(finite) == 0

@@ -1,0 +1,98 @@
+"""Profiler + native-tier tests (pyprof / apex_C analogs)."""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+
+class TestNative:
+    def test_layout_planner_matches_fallback(self):
+        from apex_tpu import native
+
+        sizes = [100, 2048, 5, 0, 1024, 3000]
+        c2t_a, off_a = native.plan_layout(sizes, 1024)
+        # force fallback
+        saved = (native._lib, native._tried)
+        native._lib, native._tried = None, True
+        try:
+            c2t_b, off_b = native.plan_layout(sizes, 1024)
+        finally:
+            native._lib, native._tried = saved
+        np.testing.assert_array_equal(c2t_a, c2t_b)
+        np.testing.assert_array_equal(off_a, off_b)
+
+    def test_make_layout_uses_planner(self):
+        from apex_tpu.optimizers import multi_tensor as mt
+
+        tree = {"a": jnp.zeros((100,)), "b": jnp.zeros((2048,)), "c": jnp.zeros(())}
+        layout = mt.make_layout(tree, 1024)
+        np.testing.assert_array_equal(
+            np.asarray(layout.chunk_to_tensor), [0, 1, 1, 2])
+
+    def test_trace_aggregator(self):
+        from apex_tpu import native
+
+        if not native.available():
+            assert native.build(), "native build failed"
+        agg = native.aggregate_trace(
+            '[{"f":"gemm","flops":1e9,"bytes":1e6,"t":0.001},'
+            '{"f":"gemm","flops":2e9,"bytes":2e6,"t":0.002},'
+            '{"f":"collective","flops":0,"bytes":5e6,"t":0.004}]'
+        )
+        assert agg["gemm"]["count"] == 2
+        np.testing.assert_allclose(agg["gemm"]["flops"], 3e9)
+        assert agg["collective"]["t"] == 0.004
+
+
+class TestProf:
+    def test_annotate_preserves_semantics(self):
+        from apex_tpu.prof import annotate
+
+        @annotate("my_op")
+        def f(x):
+            return x * 2 + 1
+
+        x = jnp.arange(4.0)
+        np.testing.assert_array_equal(jax.jit(f)(x), x * 2 + 1)
+
+    def test_cost_analysis_reports_flops(self):
+        from apex_tpu.prof import cost_analysis
+
+        def f(a, b):
+            return a @ b
+
+        a = jnp.zeros((128, 256))
+        b = jnp.zeros((256, 64))
+        ca = cost_analysis(f, a, b)
+        # 2*M*N*K flops
+        assert ca.get("flops", 0) >= 2 * 128 * 256 * 64 * 0.9
+
+    def test_analyze_ops_and_report(self):
+        from apex_tpu.prof import analyze_ops
+        from apex_tpu.prof.analyzer import report
+
+        ops = [
+            {"name": "dot_general.1", "flops": 1e9, "bytes": 1e6, "time_s": 1e-3},
+            {"name": "dot_general.2", "flops": 1e9, "bytes": 1e6, "time_s": 1e-3},
+            {"name": "all-reduce.0", "flops": 0, "bytes": 4e6, "time_s": 2e-3},
+            {"name": "copy.3", "flops": 0, "bytes": 1e7, "time_s": 5e-4},
+        ]
+        stats = analyze_ops(ops)
+        assert stats["gemm"].count == 2
+        assert stats["collective"].bytes_accessed == 4e6
+        txt = report(stats)
+        assert "gemm" in txt and "bound" in txt
+
+    def test_analyze_many_ops_native_path(self):
+        from apex_tpu import native
+        from apex_tpu.prof import analyze_ops
+
+        if not native.available():
+            pytest.skip("native lib not built")
+        ops = [{"name": "dot.x", "flops": 1.0, "bytes": 1.0, "time_s": 1e-6}
+               for _ in range(2000)]
+        stats = analyze_ops(ops)
+        assert stats["gemm"].count == 2000
+        np.testing.assert_allclose(stats["gemm"].flops, 2000.0)
