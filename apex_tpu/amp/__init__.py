@@ -44,8 +44,16 @@ from apex_tpu.amp.scaler import (  # noqa: F401
     scaled_value_and_grad,
     all_finite,
     apply_if_finite,
+    skip_step_if_nonfinite,
     state_dict,
     load_state_dict,
 )
 from apex_tpu.amp.master import MasterWeights, apply_updates_with_master  # noqa: F401
-from apex_tpu.amp.lists import op_cast_dtype, register_half_op, register_float_op, register_promote_op  # noqa: F401
+from apex_tpu.amp.lists import (  # noqa: F401
+    apply_op_rules,
+    check_banned,
+    op_cast_dtype,
+    register_float_op,
+    register_half_op,
+    register_promote_op,
+)

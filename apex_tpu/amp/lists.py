@@ -45,7 +45,7 @@ FLOAT_OPS = {
     # numerically sensitive → fp32
     "softmax", "log_softmax", "layer_norm", "rms_norm", "batch_norm",
     "group_norm", "cross_entropy", "nll_loss", "mse_loss", "l1_loss",
-    "smooth_l1_loss", "kl_div", "cosine_similarity",
+    "smooth_l1_loss", "kl_div", "cosine_similarity", "focal_loss",
     "exp", "log", "log1p", "pow", "erf", "erfinv", "softplus",
     "sum", "prod", "cumsum", "cumprod", "norm", "mean", "var", "std",
 }
@@ -84,8 +84,16 @@ def register_promote_op(name: str) -> None:
     PROMOTE_OPS.add(name)
 
 
-def check_banned(name: str) -> None:
-    if name in BANNED_OPS:
+_HALF_DTYPES = (jnp.float16, jnp.bfloat16)
+
+
+def check_banned(name: str, *input_dtypes) -> None:
+    """Raise for fp16-unsafe ops — only when half inputs are actually
+    present, matching ``wrap.err_if_any_half`` (``apex/amp/wrap.py:114-130``,
+    which runs the original op untouched when no arg is half)."""
+    if name in BANNED_OPS and (
+        not input_dtypes or any(dt in _HALF_DTYPES for dt in input_dtypes)
+    ):
         raise RuntimeError(f"amp: {BANNED_OPS[name]}")
 
 
@@ -96,9 +104,9 @@ def op_cast_dtype(op: str, policy, *input_dtypes):
     input dtype (matching ``wrap.promote``'s ``maybe_float`` behavior,
     ``apex/amp/wrap.py:65-90``).
     """
-    check_banned(op)
     if not getattr(policy, "per_op_rules", False):
         return policy.compute_dtype
+    check_banned(op, *input_dtypes)
     if op in HALF_OPS:
         return policy.compute_dtype
     if op in FLOAT_OPS:
@@ -106,3 +114,41 @@ def op_cast_dtype(op: str, policy, *input_dtypes):
     if input_dtypes:
         return jnp.result_type(*input_dtypes)
     return policy.compute_dtype
+
+
+def _is_float_array(a) -> bool:
+    return (
+        a is not None
+        and hasattr(a, "dtype")
+        and jnp.issubdtype(a.dtype, jnp.floating)
+    )
+
+
+def apply_op_rules(op: str, *arrays, policy=None):
+    """Cast ``arrays`` to the dtype the ambient O1 policy assigns ``op``.
+
+    This is the call-site half of the reference's cast wrappers
+    (``make_cast_wrapper`` ``apex/amp/wrap.py:10-29`` for HALF/FLOAT ops,
+    ``promote`` ``wrap.py:65-90``, ``err_if_any_half`` ``wrap.py:114-130``):
+    every ``apex_tpu.ops`` entry point routes its floating inputs through
+    here. Identity unless the ambient policy has ``per_op_rules`` (O1), so
+    O0/O2/O3 pay nothing. Non-float leaves (int labels/tokens) and ``None``
+    pass through untouched.
+
+    The reference's fp16 weight cache (``utils.cached_cast``, invalidated
+    per-iteration via ``_amp_state.handle._clear_cache``) has no analog here
+    by design: under ``jit`` the cast is a traced op that XLA CSEs, so
+    repeated casts of the same weight cost nothing at runtime.
+    """
+    if policy is None:
+        from apex_tpu.amp.policy import current_policy
+
+        policy = current_policy()
+    if not getattr(policy, "per_op_rules", False):
+        return arrays
+    in_dtypes = [a.dtype for a in arrays if _is_float_array(a)]
+    target = op_cast_dtype(op, policy, *in_dtypes)
+    return tuple(
+        a.astype(target) if _is_float_array(a) and a.dtype != target else a
+        for a in arrays
+    )

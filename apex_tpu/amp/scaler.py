@@ -176,6 +176,46 @@ def apply_if_finite(params: PyTree, new_params: PyTree, grads_finite: jax.Array)
     return jax.tree.map(lambda old, new: jnp.where(grads_finite, new, old), params, new_params)
 
 
+def skip_step_if_nonfinite(opt):
+    """Wrap an optax optimizer so an overflowed step is skipped *entirely* —
+    zero updates AND untouched inner state (momenta, step count).
+
+    The reference's skip patch replaces ``optimizer.step`` for the overflowed
+    iteration (``handle.py:128-154``), which implicitly protects the
+    optimizer's exp-avg buffers from inf/nan gradients. The functional
+    translation must guard both halves: ``apply_if_finite`` alone keeps
+    params clean, but running ``opt.update`` with inf grads still poisons
+    m/v forever. Use this wrapper whenever grads can overflow (fp16 +
+    loss scaling)::
+
+        opt = amp.skip_step_if_nonfinite(fused_adam(1e-3))
+        updates, opt_state = opt.update(grads, opt_state, params)  # safe
+    """
+    import optax
+
+    def init(params):
+        return opt.init(params)
+
+    def update(grads, state, params=None):
+        finite = all_finite(grads)
+        # sanitize before the inner update: where() keeps the old state, but
+        # inf * 0 inside the unselected branch would still produce nan that
+        # XLA must not see in the selected lanes
+        safe_grads = jax.tree.map(
+            lambda g: jnp.where(jnp.isfinite(g), g, 0).astype(g.dtype), grads
+        )
+        updates, new_state = opt.update(safe_grads, state, params)
+        updates = jax.tree.map(
+            lambda u: jnp.where(finite, u, jnp.zeros_like(u)), updates
+        )
+        new_state = jax.tree.map(
+            lambda old, new: jnp.where(finite, new, old), state, new_state
+        )
+        return updates, new_state
+
+    return optax.GradientTransformation(init, update)
+
+
 # -- state-dict parity (apex/amp/frontend.py:361-400) -------------------------
 
 def state_dict(state: LossScalerState) -> dict:

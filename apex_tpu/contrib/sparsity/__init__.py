@@ -10,3 +10,11 @@ from apex_tpu.contrib.sparsity.masklib import (  # noqa: F401
     create_mask,
     mask_2to4_best,
 )
+from apex_tpu.contrib.sparsity.permutation import (  # noqa: F401
+    apply_permutation,
+    exhaustive_search,
+    greedy_swap_search,
+    invert_permutation,
+    search_for_good_permutation,
+    sum_after_2_to_4,
+)

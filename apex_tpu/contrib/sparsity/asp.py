@@ -17,6 +17,11 @@ import jax.numpy as jnp
 import optax
 
 from apex_tpu.contrib.sparsity.masklib import create_mask
+from apex_tpu.contrib.sparsity.permutation import (
+    apply_permutation,
+    invert_permutation,
+    search_for_good_permutation,
+)
 
 PyTree = Any
 
@@ -58,6 +63,41 @@ class ASP:
     def apply_masks(self, params: PyTree, masks: PyTree) -> PyTree:
         return jax.tree.map(
             lambda w, m: jnp.where(m, w, 0).astype(w.dtype), params, masks
+        )
+
+    def search_permutations(self, params: PyTree) -> PyTree:
+        """Per-eligible-weight input-channel permutations improving 2:4
+        magnitude retention — the accuracy-preserving half of ASP
+        (``permutation_lib.py:1-925``; search in
+        ``permutation_search_kernels/``).
+
+        Returns a pytree of ``np.ndarray`` permutations (identity for
+        ineligible leaves, so the pytree structure matches ``params``). The
+        reference propagates permutations through the traced ``torch.fx``
+        graph so producer outputs and consumer inputs stay consistent; a
+        functional pytree has no graph, so wiring a weight's permutation to
+        its neighbors is the caller's job: permute this weight's *input*
+        channels with the returned ``perm`` and the producing layer's
+        *output* channels with ``invert_permutation(perm)`` (see
+        ``permute_params``).
+        """
+        import numpy as np
+
+        def search(path, w):
+            name = "/".join(str(p) for p in path)
+            if not self.eligible(name, w):
+                return np.arange(w.shape[-1]) if w.ndim else np.arange(1)
+            mat = jnp.reshape(w, (-1, w.shape[-1]))
+            perm, improvement = search_for_good_permutation(mat)
+            return perm if improvement > 0 else np.arange(w.shape[-1])
+
+        return jax.tree_util.tree_map_with_path(search, params)
+
+    def permute_params(self, params: PyTree, perms: PyTree) -> PyTree:
+        """Apply input-channel permutations from :meth:`search_permutations`."""
+        return jax.tree.map(
+            lambda w, p: apply_permutation(w, p, axis=-1) if w.ndim else w,
+            params, perms,
         )
 
     def wrap_optimizer(

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.ops import fused_layer_norm, scaled_upper_triang_masked_softmax
+from apex_tpu.ops.attention import flash_attention
 from apex_tpu.transformer import tensor_parallel as tp_lib
 from apex_tpu.transformer.tensor_parallel.utils import divide
 
@@ -41,7 +42,30 @@ class GPTConfig:
     sequence_parallel: bool = False
     dropout: float = 0.0
     remat: bool = True
+    # "full": recompute the whole block in backward (Megatron
+    # CheckpointFunction semantics, minimum memory); "save_attn": store each
+    # block's attention output (+3% activation memory) so the backward
+    # re-forward skips re-running attention; "save_attn_mlp": additionally
+    # store the post-GELU mlp hidden (+~15%) so the re-forward skips the
+    # up-projection too — fastest remat mode when memory allows.
+    remat_policy: str = "full"
     dtype: Any = jnp.float32  # param dtype; compute follows inputs/policy
+    # "softmax": materialized scores + fused causal softmax (the Megatron
+    # path, ``standalone_gpt.py``'s ParallelAttention); "flash": blockwise
+    # flash attention — O(s) memory, no seq cap, preferred at long seq;
+    # "naive": plain jnp softmax with autodiff-saved probabilities — the
+    # stock-JAX reference point benchmarks compare against, never preferred.
+    attention_impl: str = "softmax"
+
+    def __post_init__(self):
+        if self.attention_impl not in ("softmax", "flash", "naive"):
+            raise ValueError(
+                f"attention_impl must be softmax|flash|naive, got "
+                f"{self.attention_impl!r}")
+        if self.remat_policy not in ("full", "save_attn", "save_attn_mlp"):
+            raise ValueError(
+                f"remat_policy must be full|save_attn|save_attn_mlp, got "
+                f"{self.remat_policy!r}")
 
     @property
     def ffn(self) -> int:
@@ -131,19 +155,39 @@ class GPTModel:
         q = q.transpose(0, 2, 1, 3)
         k = k.transpose(0, 2, 1, 3)
         v = v.transpose(0, 2, 1, 3)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
-        probs = scaled_upper_triang_masked_softmax(
-            scores.reshape(b * h, s, s), 1.0 / float(d) ** 0.5
-        ).reshape(b, h, s, s)
-        if c.dropout > 0 and key is not None:
-            probs = _dropout(probs, c.dropout, jax.random.fold_in(key, 0))
-        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        use_flash = c.attention_impl == "flash" and not (
+            c.dropout > 0 and key is not None  # flash path has no probs dropout
+        )
+        if use_flash:
+            ctx = flash_attention(q, k, v, causal=True)
+        elif c.attention_impl == "naive":
+            # stock-JAX formulation: materialized scores, jnp softmax, probs
+            # saved by autodiff for backward — no framework ops
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / float(d) ** 0.5
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+            if c.dropout > 0 and key is not None:
+                probs = _dropout(probs, c.dropout, jax.random.fold_in(key, 0))
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        else:
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+            probs = scaled_upper_triang_masked_softmax(
+                scores.reshape(b * h, s, s), 1.0 / float(d) ** 0.5
+            ).reshape(b, h, s, s)
+            if c.dropout > 0 and key is not None:
+                probs = _dropout(probs, c.dropout, jax.random.fold_in(key, 0))
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h * d)
         return self.attn_out(p["attn_out"], ctx)
 
     def _mlp(self, p, x):
         h = self.mlp_up(p["mlp_up"], x)
         h = jax.nn.gelu(h, approximate=True)
+        if self.config.remat and self.config.remat_policy == "save_attn_mlp":
+            from jax.ad_checkpoint import checkpoint_name
+
+            h = checkpoint_name(h, "mlp_h")
         return self.mlp_down(p["mlp_down"], h)
 
     def _sp_scatter(self, x):
@@ -182,6 +226,10 @@ class GPTModel:
     def _block(self, p, x, key):
         c = self.config
         a = self._attention(p, fused_layer_norm(x, p["ln1_w"], p["ln1_b"]), key)
+        if c.remat and c.remat_policy in ("save_attn", "save_attn_mlp"):
+            from jax.ad_checkpoint import checkpoint_name
+
+            a = checkpoint_name(a, "attn_out")
         if c.dropout > 0 and key is not None:
             a = _dropout(a, c.dropout, jax.random.fold_in(key, 1))
         x = x + a
@@ -202,7 +250,20 @@ class GPTModel:
 
         block = self._block
         if c.remat:
-            block = jax.checkpoint(block)
+            if c.remat_policy == "save_attn":
+                block = jax.checkpoint(
+                    block,
+                    policy=jax.checkpoint_policies.save_only_these_names(
+                        "attn_out"),
+                )
+            elif c.remat_policy == "save_attn_mlp":
+                block = jax.checkpoint(
+                    block,
+                    policy=jax.checkpoint_policies.save_only_these_names(
+                        "attn_out", "mlp_h"),
+                )
+            else:
+                block = jax.checkpoint(block)
 
         def body(x, layer_and_key):
             layer, i = layer_and_key

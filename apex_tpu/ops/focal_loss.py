@@ -19,8 +19,15 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp.lists import apply_op_rules
+
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _fl_core(logits, targets, num_classes, alpha, gamma, smoothing_factor):
+    loss, _ = _fl_fwd(logits, targets, num_classes, alpha, gamma, smoothing_factor)
+    return loss
+
+
 def focal_loss(
     logits: jax.Array,
     targets: jax.Array,
@@ -30,9 +37,10 @@ def focal_loss(
     smoothing_factor: float = 0.0,
 ) -> jax.Array:
     """Summed sigmoid focal loss; ``targets`` are integer class ids (0 =
-    background, matching the reference's anchor labeling)."""
-    loss, _ = _fl_fwd(logits, targets, num_classes, alpha, gamma, smoothing_factor)
-    return loss
+    background, matching the reference's anchor labeling). Loss-class op:
+    computed in fp32 under an O1 per-op-rules policy."""
+    logits, = apply_op_rules("focal_loss", logits)
+    return _fl_core(logits, targets, num_classes, alpha, gamma, smoothing_factor)
 
 
 def _fl_sum(lf, targets, num_classes, alpha, gamma, smoothing):
@@ -63,4 +71,4 @@ def _fl_bwd(num_classes, alpha, gamma, smoothing, res, g):
     return ((g * dloss.astype(jnp.float32)).astype(dloss.dtype), None)
 
 
-focal_loss.defvjp(_fl_fwd, _fl_bwd)
+_fl_core.defvjp(_fl_fwd, _fl_bwd)

@@ -20,6 +20,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp.lists import apply_op_rules
 from apex_tpu.ops import _backend
 from apex_tpu.ops.pallas.matmul import matmul_bias_act
 
@@ -82,6 +83,7 @@ def fused_dense(
 ) -> jax.Array:
     """``fused_dense_function`` (``apex/fused_dense/fused_dense.py:48``):
     ``x @ weightᵀ + bias`` (torch Linear weight layout (out, in))."""
+    x, weight, bias = apply_op_rules("dense", x, weight, bias)
     use_pallas = _choose(impl, x, weight)
     lead = x.shape[:-1]
     x2d = x.reshape(-1, x.shape[-1])
@@ -127,6 +129,9 @@ def fused_dense_gelu_dense(
 ) -> jax.Array:
     """``FusedDenseGeluDenseFunc`` (``fused_dense.py:27-46``): two Linears
     with a GELU between, saving the pre-GELU for backward."""
+    x, weight1, bias1, weight2, bias2 = apply_op_rules(
+        "dense", x, weight1, bias1, weight2, bias2
+    )
     use_pallas = _choose(impl, x, weight1)
     lead = x.shape[:-1]
     x2d = x.reshape(-1, x.shape[-1])

@@ -21,6 +21,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp.lists import apply_op_rules
 from apex_tpu.ops import _backend
 from apex_tpu.ops.pallas import layer_norm as _k
 
@@ -132,8 +133,10 @@ def fused_layer_norm(
     """LayerNorm over the trailing ``normalized_shape`` dims (default: last).
 
     Equivalent of ``fused_layer_norm_affine`` / ``fused_layer_norm``
-    (``apex/normalization/fused_layer_norm.py:33-76``).
+    (``apex/normalization/fused_layer_norm.py:33-76``). FLOAT-class under an
+    O1 per-op-rules policy (norms stay fp32, ``lists/torch_overrides.py:29-60``).
     """
+    x, weight, bias = apply_op_rules("layer_norm", x, weight, bias)
     if normalized_shape is None:
         normalized_shape = (x.shape[-1],) if weight is None else weight.shape
     hidden = _normalized_size(normalized_shape)
@@ -153,7 +156,9 @@ def fused_rms_norm(
     eps: float = 1e-5,
     impl: str = "auto",
 ) -> jax.Array:
-    """RMSNorm (``fused_rms_norm_affine``, ``fused_layer_norm.py:78-125``)."""
+    """RMSNorm (``fused_rms_norm_affine``, ``fused_layer_norm.py:78-125``).
+    FLOAT-class under an O1 per-op-rules policy."""
+    x, weight = apply_op_rules("rms_norm", x, weight)
     if normalized_shape is None:
         normalized_shape = (x.shape[-1],) if weight is None else weight.shape
     hidden = _normalized_size(normalized_shape)

@@ -16,6 +16,7 @@ from typing import List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp.lists import apply_op_rules
 from apex_tpu.ops import _backend
 from apex_tpu.ops.fused_dense import _mm
 
@@ -87,7 +88,14 @@ def mlp(
 ) -> jax.Array:
     """Functional MLP; weights are torch-Linear layout (out, in), activation
     after every layer including the last (matching ``mlp_cuda``'s semantics
-    where activation is applied uniformly, ``apex/mlp/mlp.py:13``)."""
+    where activation is applied uniformly, ``apex/mlp/mlp.py:13``). The
+    reference registers MLP as a HALF op (``amp.half_function``,
+    ``apex/mlp/mlp.py:24``) — under O1 the whole chain runs in compute dtype.
+    """
+    cast = apply_op_rules("mlp", x, *weights, *biases)
+    x, weights, biases = (
+        cast[0], cast[1:1 + len(weights)], cast[1 + len(weights):]
+    )
     ok = all(w.shape[1] % 128 == 0 and w.shape[0] % 128 == 0 for w in weights)
     use_pallas = _backend.choose_impl(impl, ok and x.shape[-1] % 128 == 0) == "pallas"
     lead = x.shape[:-1]

@@ -12,7 +12,9 @@ reuses (the same residual the CUTLASS fmha saves). Backward is the standard
 two-kernel split: dq accumulates over KV blocks, dk/dv over Q blocks, with
 D = rowsum(do·o) precomputed by the caller.
 
-Block sizes default to 128 (MXU-shaped); d must equal the full head dim
+Block sizes default to 1024 (measured best on v5e at seq>=1024 — small
+blocks leave the head_dim-64 MXU contraction starved and grid overhead
+dominant); d must equal the full head dim
 (trailing-dim tiling rule). Causal masking skips whole KV blocks above the
 diagonal — the work saving that makes causal flash ~2x dense.
 """
@@ -31,6 +33,18 @@ NEG_INF = -1e30
 
 def _blocks(n, b):
     return pl.cdiv(n, b)
+
+
+def _fit_block(n, pref):
+    """Largest 128-multiple divisor of ``n`` that is <= ``pref``: blocks must
+    tile the sequence exactly — Pallas pads partial edge blocks with
+    *uninitialized* data, which would flow into the softmax accumulators
+    (fwd) and into dk/dv (bwd, padded rows pass the causal mask)."""
+    b = min(pref, n)
+    b -= b % 128
+    while b > 128 and n % b:
+        b -= 128
+    return max(b, 128) if n % 128 == 0 else n
 
 
 # --- forward ------------------------------------------------------------------
@@ -76,13 +90,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finish():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
+        # lse rides an (sq, 8) layout: TPU blocks must tile (8, 128) or match
+        # the array dim, so a flat (1, bq) row block won't lower — broadcast
+        # the column across 8 lanes and let the caller slice lane 0.
+        lse_ref[0] = jnp.broadcast_to(m_scr[:] + jnp.log(l), (l.shape[0], _LSE_LANES))
 
 
-def flash_fwd(q, k, v, *, scale, causal, bq=128, bk=128, interpret=False):
+_LSE_LANES = 8
+
+
+def _expand_rows(x):
+    """(bh, sq) -> (bh, sq, 8) broadcast, the tileable carrier layout."""
+    return jnp.broadcast_to(x[..., None], (*x.shape, _LSE_LANES))
+
+
+def flash_fwd(q, k, v, *, scale, causal, bq=1024, bk=1024, interpret=False):
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq, bk = min(bq, sq), min(bk, sk)
+    bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
 
     o, lse = pl.pallas_call(
@@ -96,11 +121,11 @@ def flash_fwd(q, k, v, *, scale, causal, bq=128, bk=128, interpret=False):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, _LSE_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -112,7 +137,7 @@ def flash_fwd(q, k, v, *, scale, causal, bq=128, bk=128, interpret=False):
         ),
         interpret=interpret,
     )(q, k, v)
-    return o, lse
+    return o, lse[..., 0]
 
 
 # --- backward -----------------------------------------------------------------
@@ -141,11 +166,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols <= rows + off, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.exp(s - lse_ref[0][:, 0:1])
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0][:, 0:1]) * scale
         acc_scr[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -180,14 +205,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols <= rows + off, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])  # (bq, bk)
+        p = jnp.exp(s - lse_ref[0][:, 0:1])  # (bq, bk)
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0][:, 0:1]) * scale
         dk_scr[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -198,13 +223,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def flash_bwd(q, k, v, o, lse, do, *, scale, causal, bq=128, bk=128,
+def flash_bwd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
               interpret=False):
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq, bk = min(bq, sq), min(bk, sk)
+    bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    lse3, delta3 = _expand_rows(lse), _expand_rows(delta)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -215,8 +241,8 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, bq=128, bk=128,
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
@@ -225,7 +251,7 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, bq=128, bk=128,
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse3, delta3)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
@@ -236,8 +262,8 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, bq=128, bk=128,
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, bq, _LSE_LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LSE_LANES), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
@@ -255,5 +281,5 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, bq=128, bk=128,
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse3, delta3)
     return dq, dk, dv

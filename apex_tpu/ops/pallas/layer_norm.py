@@ -82,12 +82,14 @@ def ln_fwd(x2d, weight, bias, *, eps: float, rms: bool, interpret: bool):
 
     in_specs = [pl.BlockSpec((br, hidden), lambda i: (i, 0))]
     args = [x2d]
+    # affine params ride as (1, hidden): flat 1D bf16 operands hit a
+    # Mosaic/XLA sublane-packing layout mismatch on real TPU hardware
     if weight is not None:
-        in_specs.append(pl.BlockSpec((hidden,), lambda i: (0,)))
-        args.append(weight)
+        in_specs.append(pl.BlockSpec((1, hidden), lambda i: (0, 0)))
+        args.append(weight.reshape(1, hidden))
     if bias is not None:
-        in_specs.append(pl.BlockSpec((hidden,), lambda i: (0,)))
-        args.append(bias)
+        in_specs.append(pl.BlockSpec((1, hidden), lambda i: (0, 0)))
+        args.append(bias.reshape(1, hidden))
     # explicit positional signatures: Pallas passes inputs then outputs
     # positionally, so absent refs must vanish from the signature entirely
     if weight is not None and bias is not None:
@@ -140,8 +142,8 @@ def _ln_bwd_kernel(
             dw_ref[:] = jnp.zeros_like(dw_ref)
             db_ref[:] = jnp.zeros_like(db_ref)
 
-        dw_ref[:] += jnp.sum(dy * xhat, axis=0)
-        db_ref[:] += jnp.sum(dy, axis=0)
+        dw_ref[:] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+        db_ref[:] += jnp.sum(dy, axis=0, keepdims=True)
     else:
         dyw = dy
     h = x.shape[1]
@@ -173,8 +175,8 @@ def ln_bwd(dy2d, x2d, mean, rstd, weight, *, rms: bool, interpret: bool):
     ]
     args = [dy2d, x2d, mean, rstd]
     if has_affine:
-        in_specs.append(pl.BlockSpec((hidden,), lambda i: (0,)))
-        args.append(weight)
+        in_specs.append(pl.BlockSpec((1, hidden), lambda i: (0, 0)))
+        args.append(weight.reshape(1, hidden))
         kernel = base
     else:
         kernel = lambda dy, x, m, r, dx, dwp, dbp: base(  # noqa: E731
@@ -187,13 +189,13 @@ def ln_bwd(dy2d, x2d, mean, rstd, weight, *, rms: bool, interpret: bool):
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((br, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((hidden,), lambda i: (0,)),
-            pl.BlockSpec((hidden,), lambda i: (0,)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows_p, hidden), x2d.dtype),
-            jax.ShapeDtypeStruct((hidden,), jnp.float32),
-            jax.ShapeDtypeStruct((hidden,), jnp.float32),
+            jax.ShapeDtypeStruct((1, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((1, hidden), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)
@@ -201,5 +203,5 @@ def ln_bwd(dy2d, x2d, mean, rstd, weight, *, rms: bool, interpret: bool):
         interpret=interpret,
     )(*args)
     if has_affine:
-        return dx[:rows], dw, db
+        return dx[:rows], dw[0], db[0]
     return dx[:rows], None, None

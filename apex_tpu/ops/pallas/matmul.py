@@ -46,7 +46,7 @@ def _matmul_kernel(x_ref, w_ref, b_ref, o_ref, acc_ref, *, activation, k_steps):
     def _epilogue():
         r = acc_ref[:]
         if b_ref is not None:
-            r = r + b_ref[:].astype(jnp.float32)
+            r = r + b_ref[:].astype(jnp.float32)  # (1, bn) broadcasts over rows
         o_ref[:] = _apply_act(r, activation).astype(o_ref.dtype)
 
 
@@ -88,8 +88,11 @@ def matmul_bias_act(
     ]
     args = [x, w]
     if b is not None:
-        in_specs.append(pl.BlockSpec((bn,), lambda i, j, k: (j,)))
-        args.append(b)
+        # bias rides as (1, N): a flat 1D bf16 operand hits a Mosaic/XLA
+        # layout mismatch ((1024)(128) vs (256)(128) sublane packing) on real
+        # TPU; 2D row form tiles cleanly.
+        in_specs.append(pl.BlockSpec((1, bn), lambda i, j, k: (0, j)))
+        args.append(b.reshape(1, -1))
         kernel = base
     else:
         kernel = lambda xr, wr, orf, acc: base(xr, wr, None, orf, acc)  # noqa: E731

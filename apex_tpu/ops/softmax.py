@@ -24,6 +24,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp.lists import apply_op_rules
 from apex_tpu.ops import _backend
 from apex_tpu.ops.pallas import softmax as _k
 
@@ -75,7 +76,9 @@ def scaled_masked_softmax(
     x: jax.Array, mask: jax.Array | None, scale: float = 1.0, *, impl: str = "auto"
 ) -> jax.Array:
     """``ScaledMaskedSoftmax`` (``fused_softmax.py:57-98``). ``mask`` is
-    boolean with True meaning *masked out*, broadcastable to ``x``."""
+    boolean with True meaning *masked out*, broadcastable to ``x``.
+    FLOAT-class under O1 (``lists/functional_overrides.py:28-67``)."""
+    x, = apply_op_rules("softmax", x)
     sk = x.shape[-1]
     use_pallas = _backend.choose_impl(impl, sk % 128 == 0) == "pallas"
     x2d = x.reshape(-1, sk)
@@ -90,7 +93,9 @@ def scaled_upper_triang_masked_softmax(
     x: jax.Array, scale: float = 1.0, *, impl: str = "auto"
 ) -> jax.Array:
     """``ScaledUpperTriangMaskedSoftmax`` (``fused_softmax.py:21-54``):
-    causal softmax over (..., sq, sk) with the triangle built in-kernel."""
+    causal softmax over (..., sq, sk) with the triangle built in-kernel.
+    FLOAT-class under O1."""
+    x, = apply_op_rules("softmax", x)
     sq, sk = x.shape[-2], x.shape[-1]
     use_pallas = _backend.choose_impl(impl, sk % 128 == 0) == "pallas"
     x2d = x.reshape(-1, sk)

@@ -18,8 +18,15 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp.lists import apply_op_rules
+
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _xent_core(logits, labels, smoothing, half_to_float):
+    loss, _ = _xent_fwd(logits, labels, smoothing, half_to_float)
+    return loss
+
+
 def softmax_cross_entropy_loss(
     logits: jax.Array,
     labels: jax.Array,
@@ -31,10 +38,26 @@ def softmax_cross_entropy_loss(
     ``smoothing``: label-smoothing factor ε — loss is
     ``(1-ε)·NLL(target) + ε·mean-NLL(all classes)`` (matching the kernel's
     smoothing formulation). ``half_to_float`` returns fp32 losses from half
-    inputs (the reference's flag of the same name).
+    inputs (the reference's flag of the same name). Losses are FLOAT-class
+    under O1 (``lists/functional_overrides.py:28-67``): half logits are cast
+    up when the ambient policy has per-op rules.
     """
-    loss, _ = _xent_fwd(logits, labels, smoothing, half_to_float)
-    return loss
+    logits, = apply_op_rules("cross_entropy", logits)
+    return _xent_core(logits, labels, smoothing, half_to_float)
+
+
+def binary_cross_entropy(
+    probs: jax.Array, targets: jax.Array, *, eps: float = 1e-12
+) -> jax.Array:
+    """Elementwise BCE on probabilities — the reference's canonical *banned*
+    op (``lists/functional_overrides.py:69-80``): under an O1 policy with
+    half inputs this raises (use logits + :func:`softmax_cross_entropy_loss`
+    or compute in fp32), matching ``wrap.err_if_any_half``
+    (``apex/amp/wrap.py:114-130``). Legal in fp32 or outside O1.
+    """
+    probs, targets = apply_op_rules("binary_cross_entropy", probs, targets)
+    p = jnp.clip(probs, eps, 1.0 - eps)
+    return -(targets * jnp.log(p) + (1.0 - targets) * jnp.log1p(-p))
 
 
 def _xent_fwd(logits, labels, smoothing, half_to_float):
@@ -64,7 +87,7 @@ def _xent_bwd(smoothing, half_to_float, res, dloss):
     return grad.astype(logits.dtype), None
 
 
-softmax_cross_entropy_loss.defvjp(_xent_fwd, _xent_bwd)
+_xent_core.defvjp(_xent_fwd, _xent_bwd)
 
 
 class SoftmaxCrossEntropyLoss:

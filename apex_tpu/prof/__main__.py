@@ -1,0 +1,23 @@
+"""CLI: ``python -m apex_tpu.prof <logdir> [--top N]``.
+
+Prints the top device time sinks and per-family roofline table from a
+``jax.profiler`` run — the TPU analog of ``python -m apex.pyprof.prof``
+(``apex/pyprof/prof/__main__.py``).
+"""
+
+import argparse
+
+from apex_tpu.prof.trace_reader import format_report
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="Analyze a jax.profiler trace directory")
+    p.add_argument("logdir", help="directory passed to jax.profiler.start_trace")
+    p.add_argument("--top", type=int, default=5, help="time sinks to show")
+    args = p.parse_args()
+    print(format_report(args.logdir, args.top))
+
+
+if __name__ == "__main__":
+    main()

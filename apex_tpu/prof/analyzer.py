@@ -61,7 +61,9 @@ def cost_analysis(fn, *args, **kwargs) -> Dict[str, float]:
 
 
 def _family_of(name: str) -> str:
-    n = name.lower()
+    # op names from traces carry the named_scope path ("gpt/attn/dot.7");
+    # classify on the final HLO segment
+    n = name.lower().rsplit("/", 1)[-1]
     for prefix, fam in FAMILIES.items():
         if n.startswith(prefix) or f".{prefix}" in n:
             return fam

@@ -1,0 +1,151 @@
+"""Real-trace post-processor: ingest a ``jax.profiler`` run and emit
+per-op / per-family time+cost tables.
+
+The pyprof pipeline analog (``apex/pyprof/parse/{db,nvvp,kernel}.py`` reads
+the nvprof SQLite DB and correlates kernels with NVTX ranges;
+``apex/pyprof/prof/__main__.py`` then prints per-kernel FLOPs/bytes). Here
+the source of truth is the ``trace.json.gz`` chrome trace that
+``jax.profiler.stop_trace`` writes under ``<logdir>/plugins/profile/<run>/``:
+
+* device rows (process ``/device:TPU:N``, thread ``XLA Ops``) carry one
+  complete-event per executed HLO, named with the full ``named_scope`` path
+  — the correlation step the reference needs a database join for comes free;
+* :func:`op_records` turns them into compact records, folding multiple
+  executions of the same op;
+* :func:`summarize` ranks time sinks and aggregates op families via
+  :func:`apex_tpu.prof.analyzer.analyze_ops` (whose hot path is the native
+  C++ aggregator ``csrc/trace_analyzer.cpp`` for large traces).
+
+CLI: ``python -m apex_tpu.prof <logdir> [--top N]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import gzip
+import json
+import os
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class TraceEvent:
+    name: str
+    start_us: float
+    dur_us: float
+    device: str       # e.g. "/device:TPU:0"
+    track: str        # e.g. "XLA Ops"
+    args: dict
+
+
+def _latest_run_dir(log_dir: str) -> str:
+    runs = sorted(glob.glob(os.path.join(log_dir, "plugins", "profile", "*")))
+    if not runs:
+        raise FileNotFoundError(f"no profiler runs under {log_dir!r}")
+    return runs[-1]
+
+
+def _trace_file(run_dir: str) -> str:
+    files = glob.glob(os.path.join(run_dir, "*.trace.json.gz"))
+    if not files:
+        raise FileNotFoundError(f"no trace.json.gz in {run_dir!r}")
+    return files[0]
+
+
+def read_trace(log_dir: str) -> List[TraceEvent]:
+    """Parse the newest run's chrome trace into device events."""
+    path = _trace_file(_latest_run_dir(log_dir))
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+
+    # metadata pass: pid -> process name, (pid, tid) -> thread name
+    procs = {}
+    threads = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            procs[e.get("pid")] = e.get("args", {}).get("name", "")
+        elif e.get("name") == "thread_name":
+            threads[(e.get("pid"), e.get("tid"))] = e.get("args", {}).get("name", "")
+
+    out: List[TraceEvent] = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        pid = e.get("pid")
+        dev = procs.get(pid, "")
+        out.append(TraceEvent(
+            name=e.get("name", ""),
+            start_us=float(e.get("ts", 0.0)),
+            dur_us=float(e.get("dur", 0.0)),
+            device=dev,
+            track=threads.get((pid, e.get("tid")), ""),
+            args=e.get("args", {}) or {},
+        ))
+    return out
+
+
+def device_op_events(events: Sequence[TraceEvent]) -> List[TraceEvent]:
+    """The per-HLO device rows — the analog of the kernels table pyprof
+    correlates against (``parse/db.py``)."""
+    return [
+        e for e in events
+        if "/device:" in e.device and e.track in ("XLA Ops", "Async XLA Ops")
+    ]
+
+
+def _scope_of(name: str) -> str:
+    """'encoder/block/attention/dot.7' -> 'encoder/block/attention'."""
+    return name.rsplit("/", 1)[0] if "/" in name else ""
+
+
+def op_records(events: Sequence[TraceEvent]) -> List[dict]:
+    """Fold executions into per-op records consumable by ``analyze_ops``.
+
+    Records carry flops/bytes when the trace supplies them in event args
+    (XProf exports them for some platforms; 0 otherwise — the family table
+    then reports time only).
+    """
+    acc: Dict[str, List[float]] = defaultdict(lambda: [0.0, 0.0, 0.0, 0.0])
+    for e in device_op_events(events):
+        a = acc[e.name]
+        a[0] += 1
+        a[1] += e.dur_us / 1e6
+        a[2] += float(e.args.get("flops", 0) or 0)
+        a[3] += float(e.args.get("bytes accessed", e.args.get("bytes", 0)) or 0)
+    return [
+        {"name": name, "count": int(c), "time_s": t, "flops": f, "bytes": b,
+         "scope": _scope_of(name)}
+        for name, (c, t, f, b) in acc.items()
+    ]
+
+
+def summarize(log_dir: str, top: int = 5) -> Tuple[List[dict], Dict[str, "OpStats"]]:
+    """(top-K time sinks, per-family stats) for the newest run."""
+    from apex_tpu.prof.analyzer import analyze_ops
+
+    recs = op_records(read_trace(log_dir))
+    recs.sort(key=lambda r: -r["time_s"])
+    fams = analyze_ops(recs)
+    return recs[:top], fams
+
+
+def format_report(log_dir: str, top: int = 5) -> str:
+    """pyprof.prof-style text report: top time sinks + family roofline."""
+    from apex_tpu.prof.analyzer import report
+
+    sinks, fams = summarize(log_dir, top)
+    lines = [f"top {len(sinks)} device time sinks:"]
+    total = sum(s.time_s for s in fams.values()) or 1.0
+    for r in sinks:
+        lines.append(
+            f"  {r['time_s']*1e3:9.3f} ms  {100*r['time_s']/total:5.1f}%  "
+            f"x{r['count']:<5d} {r['name'][:90]}"
+        )
+    lines.append("")
+    lines.append(report(fams))
+    return "\n".join(lines)

@@ -42,7 +42,9 @@ def main():
         "w2": jr.normal(jr.fold_in(key, 1), (H, D)) * 0.05, "b2": jnp.zeros((D,)),
     }
     master = amp.MasterWeights.create(params, policy)
-    opt = fused_adam(learning_rate=args.lr)
+    # skip wrapper: an overflowed fp16 step must leave Adam's m/v
+    # untouched, not just the params (cf. apex handle.py:128-154)
+    opt = amp.skip_step_if_nonfinite(fused_adam(learning_rate=args.lr))
     opt_state = opt.init(master.master)
     scaler = amp.init_loss_scaler(args.loss_scale or "dynamic")
 

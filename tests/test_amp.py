@@ -150,3 +150,191 @@ class TestMasterWeights:
         w3 = apply_updates_with_master(w, {"w": jnp.full((4,), 1.0)},
                                        grads_finite=jnp.asarray(False))
         np.testing.assert_allclose(np.asarray(w3.master["w"]), 1.0)
+
+
+class TestO1Wiring:
+    """O1 per-op semantics are enforced at apex_tpu.ops call sites — the
+    behavioral half of the reference's ``tests/L0/run_amp/test_basic_casts.py``
+    and ``test_promotion.py`` (wrappers: ``apex/amp/wrap.py:10-130``)."""
+
+    def test_dense_runs_half_under_o1(self):
+        from apex_tpu.ops import fused_dense
+
+        x = jnp.ones((4, 8), jnp.float32)
+        w = jnp.ones((8, 8), jnp.float32)
+        with amp.with_policy(amp.O1):
+            y = fused_dense(x, w)
+        assert y.dtype == jnp.bfloat16  # HALF-class: computed+returned in bf16
+
+    def test_dense_untouched_outside_o1(self):
+        from apex_tpu.ops import fused_dense
+
+        x = jnp.ones((4, 8), jnp.float32)
+        w = jnp.ones((8, 8), jnp.float32)
+        y = fused_dense(x, w)
+        assert y.dtype == jnp.float32
+
+    def test_mlp_runs_half_under_o1(self):
+        from apex_tpu.ops import mlp
+
+        x = jnp.ones((4, 8), jnp.float32)
+        w = [jnp.ones((8, 8), jnp.float32)]
+        b = [jnp.zeros((8,), jnp.float32)]
+        with amp.with_policy(amp.O1):
+            y = mlp(x, w, b)
+        assert y.dtype == jnp.bfloat16
+
+    def test_softmax_runs_float_under_o1(self):
+        from apex_tpu.ops import scaled_upper_triang_masked_softmax
+
+        x = jnp.ones((2, 4, 4), jnp.bfloat16)
+        with amp.with_policy(amp.O1):
+            y = scaled_upper_triang_masked_softmax(x)
+        assert y.dtype == jnp.float32  # FLOAT-class: half input cast up
+
+    def test_layer_norm_runs_float_under_o1(self):
+        from apex_tpu.ops import fused_layer_norm
+
+        x = jnp.ones((4, 8), jnp.bfloat16)
+        w = jnp.ones((8,), jnp.bfloat16)
+        b = jnp.zeros((8,), jnp.bfloat16)
+        with amp.with_policy(amp.O1):
+            y = fused_layer_norm(x, w, b)
+        assert y.dtype == jnp.float32
+
+    def test_xent_loss_float_under_o1(self):
+        from apex_tpu.ops import softmax_cross_entropy_loss
+
+        logits = jnp.ones((4, 16), jnp.bfloat16)
+        labels = jnp.zeros((4,), jnp.int32)
+        with amp.with_policy(amp.O1):
+            loss = softmax_cross_entropy_loss(logits, labels)
+        assert loss.dtype == jnp.float32
+
+    def test_flash_attention_half_under_o1(self):
+        from apex_tpu.ops.attention import flash_attention
+
+        q = jnp.ones((2, 8, 16), jnp.float32)
+        with amp.with_policy(amp.O1):
+            o = flash_attention(q, q, q, causal=True)
+        assert o.dtype == jnp.bfloat16
+
+    def test_banned_bce_raises_on_half_under_o1(self):
+        from apex_tpu.ops.xentropy import binary_cross_entropy
+
+        p = jnp.full((4,), 0.5, jnp.bfloat16)
+        t = jnp.ones((4,), jnp.bfloat16)
+        with amp.with_policy(amp.O1):
+            with pytest.raises(RuntimeError, match="numerically unsafe"):
+                binary_cross_entropy(p, t)
+
+    def test_banned_bce_ok_in_fp32_under_o1(self):
+        from apex_tpu.ops.xentropy import binary_cross_entropy
+
+        p = jnp.full((4,), 0.5, jnp.float32)
+        t = jnp.ones((4,), jnp.float32)
+        with amp.with_policy(amp.O1):
+            loss = binary_cross_entropy(p, t)
+        np.testing.assert_allclose(loss, -np.log(0.5), rtol=1e-5)
+
+    def test_banned_bce_ok_outside_o1(self):
+        from apex_tpu.ops.xentropy import binary_cross_entropy
+
+        p = jnp.full((4,), 0.5, jnp.bfloat16)
+        t = jnp.ones((4,), jnp.bfloat16)
+        loss = binary_cross_entropy(p, t)  # no amp: untouched, legal
+        assert loss.dtype == jnp.bfloat16
+
+    def test_promotion_widest_dtype(self):
+        # PROMOTE-class: mixed bf16/fp32 inputs promote to fp32
+        a = jnp.ones((4,), jnp.bfloat16)
+        b = jnp.ones((4,), jnp.float32)
+        out = amp_lists.apply_op_rules("add", a, b, policy=amp.O1)
+        assert all(x.dtype == jnp.float32 for x in out)
+
+    def test_promotion_same_dtype_kept(self):
+        a = jnp.ones((4,), jnp.bfloat16)
+        b = jnp.ones((4,), jnp.bfloat16)
+        out = amp_lists.apply_op_rules("cat", a, b, policy=amp.O1)
+        assert all(x.dtype == jnp.bfloat16 for x in out)
+
+    def test_int_leaves_pass_through(self):
+        labels = jnp.zeros((4,), jnp.int32)
+        x = jnp.ones((4,), jnp.float32)
+        out = amp_lists.apply_op_rules("dense", x, labels, policy=amp.O1)
+        assert out[0].dtype == jnp.bfloat16 and out[1].dtype == jnp.int32
+
+    def test_register_moves_family(self):
+        amp_lists.register_float_op("mlp")
+        try:
+            x = jnp.ones((4, 8), jnp.bfloat16)
+            out = amp_lists.apply_op_rules("mlp", x, policy=amp.O1)
+            assert out[0].dtype == jnp.float32
+        finally:
+            amp_lists.register_half_op("mlp")
+
+    def test_o1_grads_flow_through_casts(self):
+        from apex_tpu.ops import fused_dense
+
+        x = jnp.ones((4, 8), jnp.float32)
+        w = jnp.ones((8, 8), jnp.float32)
+
+        def loss(w):
+            with amp.with_policy(amp.O1):
+                return fused_dense(x, w).astype(jnp.float32).sum()
+
+        g = jax.grad(loss)(w)
+        assert g.dtype == jnp.float32  # cotangent cast back to param dtype
+        np.testing.assert_allclose(g, 4.0 * jnp.ones((8, 8)), rtol=1e-2)
+
+
+class TestSkipStepIfNonfinite:
+    """The functional skip-step must protect the optimizer's inner state,
+    not just params (reference ``handle.py:128-154`` skips the whole step;
+    found by the fp16 end-to-end drive: unguarded opt.update poisons m/v
+    with inf and training never recovers)."""
+
+    def test_overflow_leaves_state_and_params_clean(self):
+        import optax
+        from apex_tpu.optimizers import fused_adam
+
+        opt = amp.skip_step_if_nonfinite(fused_adam(learning_rate=1e-2))
+        params = {"w": jnp.ones((4,))}
+        state = opt.init(params)
+        bad = {"w": jnp.array([1.0, jnp.inf, 1.0, 1.0])}
+        updates, state2 = opt.update(bad, state, params)
+        assert all(np.all(np.asarray(u) == 0) for u in jax.tree.leaves(updates))
+        for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(state2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        # a following finite step proceeds normally
+        good = {"w": jnp.full((4,), 0.5)}
+        updates, state3 = opt.update(good, state2, params)
+        assert np.all(np.isfinite(np.asarray(updates["w"])))
+        assert float(jnp.abs(updates["w"]).sum()) > 0
+
+    def test_fp16_training_recovers_from_overflow(self):
+        from apex_tpu.optimizers import fused_adam
+
+        policy = amp.get_policy("O2", half_dtype=jnp.float16)
+        params = {"w": jnp.ones((8,)) * 0.1}
+        master = amp.MasterWeights.create(params, policy)
+        opt = amp.skip_step_if_nonfinite(fused_adam(learning_rate=1e-2))
+        opt_state = opt.init(master.master)
+        # scale so large the first fp16 grads overflow
+        scaler = amp.init_loss_scaler("dynamic", init_scale=2.0 ** 24)
+
+        def loss_fn(p, x):
+            return jnp.mean((x @ p["w"][:, None]) ** 2).astype(jnp.float32)
+
+        x = jnp.ones((4, 8), jnp.float16) * 100.0
+        losses = []
+        for _ in range(6):
+            loss, (grads, finite, scaler) = amp.scaled_value_and_grad(loss_fn)(
+                scaler, master.model, x)
+            updates, opt_state = opt.update(grads, opt_state, master.master)
+            master = amp.apply_updates_with_master(master, updates, grads_finite=finite)
+            losses.append(float(loss))
+        assert int(scaler.skipped_steps) >= 1, "expected at least one overflow"
+        assert np.isfinite(np.asarray(jax.tree.leaves(master.master))).all()
+        assert np.isfinite(losses[-1])

@@ -270,3 +270,94 @@ class TestBottleneckConv:
         mesh = mesh_lib.make_mesh()  # dp=8
         m2 = split_data_axis_for_bn(mesh, 4)
         assert m2.shape["bn"] == 4 and m2.shape["dp_outer"] == 2
+
+
+class TestZeroHardening:
+    """VERDICT r1 item 9: multi-step convergence, compressed all-gather,
+    overlap documentation (see distributed.py module docstring)."""
+
+    def _train(self, opt, steps=50, is_zero=False):
+        """Train a small MLP on a fixed regression task; returns the final
+        params and loss trajectory."""
+        mesh = mesh_lib.make_mesh()
+        key = jr.PRNGKey(7)
+        params = {
+            "w1": jr.normal(key, (16, 64)) * 0.1, "b1": jnp.zeros((64,)),
+            "w2": jr.normal(jr.fold_in(key, 1), (64, 16)) * 0.1,
+        }
+        w_true = jr.normal(jr.fold_in(key, 2), (16, 16))
+
+        def loss_fn(p, x, y):
+            h = jnp.tanh(x @ p["w1"] + p["b1"])
+            return jnp.mean((h @ p["w2"] - y) ** 2)
+
+        def make_step():
+            def step(params, opt_state, x, y):
+                def run(params, x, y, opt_state):
+                    loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+                    grads = jax.lax.pmean(grads, "dp")
+                    loss = jax.lax.pmean(loss, "dp")
+                    updates, opt_state = opt.update(grads, opt_state, params)
+                    return optax.apply_updates(params, updates), opt_state, loss
+
+                return mesh_lib.shard_map(
+                    run, mesh=mesh,
+                    in_specs=(P(), P("dp"), P("dp"), P()),
+                    out_specs=(P(), P(), P()),
+                )(params, x, y, opt_state)
+
+            return jax.jit(step)
+
+        if is_zero:
+            opt_state = mesh_lib.shard_map(
+                lambda p: opt.init(p), mesh=mesh, in_specs=P(), out_specs=P(),
+            )(params)
+        else:
+            opt_state = opt.init(params)
+        step = make_step()
+        losses = []
+        for i in range(steps):
+            x = jr.normal(jr.fold_in(key, 100 + i), (32, 16))
+            y = jnp.tanh(x @ w_true)
+            params, opt_state, loss = step(params, opt_state, x, y)
+            losses.append(float(loss))
+        mesh_lib.destroy_model_parallel()
+        return params, losses
+
+    def test_zero_adam_50_step_convergence_matches_unsharded(self):
+        """Sharded Adam == unsharded fused Adam over 50 steps (the
+        correctness bar of ``distributed_fused_adam.py:9``'s claim that
+        sharding is numerically transparent)."""
+        from apex_tpu.contrib.optimizers import distributed_fused_adam
+        from apex_tpu.optimizers import fused_adam
+
+        zp, zlosses = self._train(
+            distributed_fused_adam(learning_rate=1e-2), is_zero=True)
+        rp, rlosses = self._train(fused_adam(learning_rate=1e-2))
+        np.testing.assert_allclose(zlosses, rlosses, rtol=1e-4, atol=1e-6)
+        for a, e in zip(jax.tree.leaves(zp), jax.tree.leaves(rp)):
+            np.testing.assert_allclose(a, e, rtol=1e-4, atol=1e-6)
+        assert zlosses[-1] < zlosses[0] * 0.3, "did not converge"
+
+    def test_zero_bf16_allgather_converges_close(self):
+        """The e5m2-compressed-allgather analog: bf16 param all-gather
+        (``distributed_fused_lamb.py:86-95``'s ``e5m2_allgather`` option)
+        still converges, within bf16 tolerance of the fp32 path."""
+        from apex_tpu.contrib.optimizers import distributed_fused_adam
+
+        zp16, l16 = self._train(
+            distributed_fused_adam(learning_rate=1e-2,
+                                   all_gather_dtype=jnp.bfloat16),
+            is_zero=True)
+        zp32, l32 = self._train(
+            distributed_fused_adam(learning_rate=1e-2), is_zero=True)
+        assert l16[-1] < l16[0] * 0.4, "bf16 all-gather did not converge"
+        # close to the fp32 trajectory but not required bitwise
+        np.testing.assert_allclose(l16[-1], l32[-1], rtol=0.2, atol=5e-3)
+
+    def test_zero_lamb_50_steps_converges(self):
+        from apex_tpu.contrib.optimizers import distributed_fused_lamb
+
+        _, losses = self._train(
+            distributed_fused_lamb(learning_rate=5e-3), is_zero=True)
+        assert losses[-1] < losses[0] * 0.7

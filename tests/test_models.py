@@ -164,3 +164,52 @@ class TestResNet:
         params, _ = rn.init(K)
         n = sum(x.size for x in jax.tree.leaves(params))
         assert n == 25_557_032  # torchvision resnet50 exactly
+
+
+class TestGPTAttentionAndRematVariants:
+    """Pin the bench-critical config paths: all attention impls agree and
+    every remat policy computes identical loss/grads."""
+
+    def _small(self, **kw):
+        from apex_tpu.models import GPTConfig, GPTModel
+
+        cfg = GPTConfig(vocab_size=128, max_seq_len=128, hidden_size=64,
+                        num_layers=2, num_heads=2, **kw)
+        return GPTModel(cfg)
+
+    def test_attention_impls_agree(self):
+        import jax.random as jr
+
+        models = {impl: self._small(attention_impl=impl)
+                  for impl in ("softmax", "flash", "naive")}
+        params = models["softmax"].init(jr.PRNGKey(0))
+        toks = jr.randint(jr.PRNGKey(1), (2, 128), 0, 128)
+        losses = {impl: float(m.loss_fn(params, toks, toks))
+                  for impl, m in models.items()}
+        assert losses["softmax"] == pytest.approx(losses["naive"], rel=1e-5)
+        assert losses["softmax"] == pytest.approx(losses["flash"], rel=1e-3)
+
+    def test_remat_policies_identical_loss_and_grads(self):
+        import jax.random as jr
+
+        ref = None
+        for pol in ("full", "save_attn", "save_attn_mlp"):
+            m = self._small(remat=True, remat_policy=pol, attention_impl="flash")
+            params = m.init(jr.PRNGKey(0))
+            toks = jr.randint(jr.PRNGKey(1), (2, 128), 0, 128)
+            loss, grads = jax.value_and_grad(m.loss_fn)(params, toks, toks)
+            flat = np.concatenate([np.asarray(g, np.float32).ravel()
+                                   for g in jax.tree.leaves(grads)])
+            if ref is None:
+                ref = (float(loss), flat)
+            else:
+                assert float(loss) == pytest.approx(ref[0], rel=1e-6), pol
+                np.testing.assert_allclose(flat, ref[1], rtol=1e-5, atol=1e-7)
+
+    def test_invalid_config_strings_rejected(self):
+        from apex_tpu.models import GPTConfig
+
+        with pytest.raises(ValueError, match="attention_impl"):
+            GPTConfig(attention_impl="Flash")
+        with pytest.raises(ValueError, match="remat_policy"):
+            GPTConfig(remat_policy="save-attn")

@@ -1,0 +1,130 @@
+"""ASP channel-permutation search tests.
+
+Spec: the reference's permutation search improves 2:4 magnitude retention
+(``apex/contrib/sparsity/permutation_lib.py``, kernels under
+``permutation_search_kernels/``); its own test is magnitude-based too.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.sparsity import permutation as plib
+
+
+def _retention(m):
+    return float(plib.sum_after_2_to_4(jnp.asarray(m)))
+
+
+def _brute_force_best(m):
+    import itertools
+
+    c = m.shape[1]
+    best = -np.inf
+    for p in itertools.permutations(range(c)):
+        best = max(best, _retention(m[:, list(p)]))
+    return best
+
+
+class TestRetentionMetric:
+    def test_matches_manual(self):
+        m = np.array([[1.0, -2.0, 3.0, 0.5, 4.0, 0.1, 0.2, 0.3]])
+        # stripe 1: keep |3|,|2|; stripe 2: keep 4, 0.3
+        assert _retention(m) == pytest.approx(3 + 2 + 4 + 0.3)
+
+    def test_invariant_to_sign(self):
+        m = np.random.RandomState(0).randn(16, 8)
+        assert _retention(m) == pytest.approx(_retention(-m), rel=1e-6)
+
+
+class TestSwapScores:
+    def test_delta_matrix_matches_brute_force(self):
+        rng = np.random.RandomState(1)
+        m = rng.randn(8, 12).astype(np.float32)
+        delta = np.asarray(plib._swap_improvements(jnp.asarray(m)))
+        base = _retention(m)
+        for i in range(12):
+            for j in range(12):
+                if i // 4 == j // 4:
+                    assert delta[i, j] == -np.inf
+                    continue
+                sw = m.copy()
+                sw[:, [i, j]] = sw[:, [j, i]]
+                assert delta[i, j] == pytest.approx(
+                    _retention(sw) - base, abs=1e-3
+                ), (i, j)
+
+
+class TestSearch:
+    def test_exhaustive_finds_global_optimum(self):
+        rng = np.random.RandomState(2)
+        m = rng.randn(6, 8).astype(np.float32)
+        perm, imp = plib.exhaustive_search(jnp.asarray(m))
+        assert _retention(m[:, perm]) == pytest.approx(_brute_force_best(m), rel=1e-5)
+        assert imp >= 0
+
+    def test_greedy_strictly_improves_structured_case(self):
+        # two "large" channels per stripe-pair arranged adversarially: the
+        # identity grouping wastes one large channel per stripe
+        rng = np.random.RandomState(3)
+        c = 32
+        m = rng.randn(64, c).astype(np.float32) * 0.01
+        # columns 0..7 large, all in the first two stripes
+        m[:, :8] += rng.randn(64, 8).astype(np.float32) * 3
+        perm, imp = plib.greedy_swap_search(jnp.asarray(m))
+        assert imp > 0
+        assert _retention(m[:, perm]) > _retention(m) + 1e-3
+
+    def test_greedy_on_random_conv_net(self):
+        """VERDICT item 5 acceptance: searched permutation strictly improves
+        2:4 mask magnitude retention on a random conv net vs no permute."""
+        rng = np.random.RandomState(4)
+        convs = [rng.randn(3 * 3 * 16, 32), rng.randn(3 * 3 * 32, 64)]
+        for w in convs:
+            mat = w.T.astype(np.float32)  # (out, in*k*k): permute reduction dim
+            perm, imp = plib.search_for_good_permutation(jnp.asarray(mat))
+            assert imp > 0, "search failed to improve retention"
+            assert _retention(mat[:, perm]) > _retention(mat)
+
+    def test_permutation_is_valid(self):
+        rng = np.random.RandomState(5)
+        m = rng.randn(16, 16).astype(np.float32)
+        perm, _ = plib.search_for_good_permutation(jnp.asarray(m))
+        assert sorted(perm.tolist()) == list(range(16))
+        inv = plib.invert_permutation(perm)
+        np.testing.assert_array_equal(perm[inv], np.arange(16))
+
+    def test_apply_permutation_roundtrip(self):
+        rng = np.random.RandomState(6)
+        m = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+        perm, _ = plib.search_for_good_permutation(m)
+        permuted = plib.apply_permutation(m, perm)
+        restored = plib.apply_permutation(permuted, plib.invert_permutation(perm))
+        np.testing.assert_allclose(np.asarray(restored), np.asarray(m))
+
+
+class TestASPIntegration:
+    def test_asp_permute_then_mask_retains_more(self):
+        from apex_tpu.contrib.sparsity import ASP
+
+        rng = np.random.RandomState(7)
+        params = {
+            "dense": jnp.asarray(rng.randn(64, 32).astype(np.float32) *
+                                 np.r_[np.full(8, 4.0), np.full(24, 0.02)]),
+            "bias": jnp.asarray(rng.randn(64).astype(np.float32)),
+        }
+        asp = ASP()
+        perms = asp.search_permutations(params)
+        permuted = asp.permute_params(params, perms)
+        # bias untouched (identity perm)
+        np.testing.assert_allclose(np.asarray(permuted["bias"]),
+                                   np.asarray(params["bias"]))
+        before = _retention(np.asarray(params["dense"]))
+        after = _retention(np.asarray(permuted["dense"]))
+        assert after > before
+        # and the 2:4 mask on the permuted weight keeps that magnitude
+        masks = asp.compute_sparse_masks(permuted)
+        pruned = asp.apply_masks(permuted, masks)
+        kept = float(jnp.sum(jnp.abs(pruned["dense"])))
+        assert kept == pytest.approx(after, rel=1e-5)

@@ -1,0 +1,89 @@
+"""Trace post-processor tests (pyprof.parse/prof analog).
+
+The reader is validated against a synthetic chrome trace with the exact
+shape ``jax.profiler`` writes (M metadata rows naming processes/threads, X
+complete-events on the device's "XLA Ops" track); real-trace validation
+runs on TPU via ``tools/profile_bench.py``.
+"""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from apex_tpu.prof import trace_reader
+
+
+def _write_trace(tmp_path, events):
+    run = tmp_path / "plugins" / "profile" / "2026_01_01_00_00_00"
+    os.makedirs(run)
+    with gzip.open(run / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return str(tmp_path)
+
+
+@pytest.fixture
+def logdir(tmp_path):
+    events = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 3, "tid": 3, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 7, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 7, "tid": 1, "name": "thread_name",
+         "args": {"name": "python"}},
+        # device ops: a fusion executed twice, a dot once, named with scopes
+        {"ph": "X", "pid": 3, "tid": 3, "ts": 10.0, "dur": 100.0,
+         "name": "gpt/block/attention/dot.7", "args": {"flops": 2.0e9}},
+        {"ph": "X", "pid": 3, "tid": 3, "ts": 120.0, "dur": 50.0,
+         "name": "gpt/block/mlp/fusion.3", "args": {}},
+        {"ph": "X", "pid": 3, "tid": 3, "ts": 200.0, "dur": 50.0,
+         "name": "gpt/block/mlp/fusion.3", "args": {}},
+        {"ph": "X", "pid": 3, "tid": 3, "ts": 300.0, "dur": 25.0,
+         "name": "copy.1", "args": {}},
+        # host event must be excluded
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 0.0, "dur": 9999.0,
+         "name": "PjitFunction(train_step)"},
+    ]
+    return _write_trace(tmp_path, events)
+
+
+def test_read_trace_resolves_processes(logdir):
+    evs = trace_reader.read_trace(logdir)
+    assert len(evs) == 5
+    dev = trace_reader.device_op_events(evs)
+    assert len(dev) == 4
+    assert all(e.device == "/device:TPU:0" for e in dev)
+
+
+def test_op_records_fold_repeats(logdir):
+    recs = trace_reader.op_records(trace_reader.read_trace(logdir))
+    by_name = {r["name"]: r for r in recs}
+    fus = by_name["gpt/block/mlp/fusion.3"]
+    assert fus["count"] == 2
+    assert fus["time_s"] == pytest.approx(100e-6)
+    assert fus["scope"] == "gpt/block/mlp"
+    assert by_name["gpt/block/attention/dot.7"]["flops"] == pytest.approx(2.0e9)
+
+
+def test_summarize_ranks_time_sinks(logdir):
+    sinks, fams = trace_reader.summarize(logdir, top=2)
+    assert sinks[0]["name"] == "gpt/block/attention/dot.7"
+    assert sinks[1]["name"] == "gpt/block/mlp/fusion.3"
+    # families: dot -> gemm, fusion -> fusion, copy -> memory
+    assert fams["gemm"].flops == pytest.approx(2.0e9)
+    assert fams["fusion"].count == 1  # one folded record
+    assert "memory" in fams
+
+
+def test_format_report_names_top_sinks(logdir):
+    text = trace_reader.format_report(logdir, top=3)
+    assert "attention/dot.7" in text
+    assert "gemm" in text
+
+
+def test_missing_run_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        trace_reader.read_trace(str(tmp_path))

@@ -1,0 +1,68 @@
+"""Capture a jax.profiler trace of the flagship GPT train step on TPU and
+print the per-op report — VERDICT r1 item 6's acceptance run:
+
+    python tools/profile_bench.py [logdir]
+
+Produces the top-5 device time sinks + per-family roofline table via
+``apex_tpu.prof`` (the pyprof analog working on a *real* trace).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+
+def main():
+    logdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/apex_tpu_prof"
+    import optax
+
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.optimizers import fused_adam
+    from apex_tpu.prof import trace
+    from apex_tpu.prof.trace_reader import format_report
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, max_seq_len=1024, hidden_size=1024,
+                        num_layers=12, num_heads=16, remat=True,
+                        attention_impl="flash")
+        batch, seq = 16, 1024
+    else:
+        cfg = GPTConfig(vocab_size=1024, max_seq_len=128, hidden_size=128,
+                        num_layers=2, num_heads=4, remat=True,
+                        attention_impl="flash")
+        batch, seq = 2, 128
+
+    model = GPTModel(cfg)
+    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16),
+                          model.init(jr.PRNGKey(0)))
+    opt = fused_adam(1e-4)
+    opt_state = opt.init(params)
+    tokens = jr.randint(jr.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
+    targets = jr.randint(jr.PRNGKey(2), (batch, seq), 0, cfg.vocab_size)
+
+    @jax.jit
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(model.loss_fn)(params, tokens, targets)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        import optax as _o
+        return _o.apply_updates(params, updates), opt_state, loss
+
+    params, opt_state, loss = step(params, opt_state, tokens, targets)
+    float(loss)
+
+    with trace(logdir):
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, tokens, targets)
+        float(loss)
+
+    print(format_report(logdir, top=5))
+
+
+if __name__ == "__main__":
+    main()
