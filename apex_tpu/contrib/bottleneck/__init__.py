@@ -40,22 +40,45 @@ def halo_exchange(x: jax.Array, axis_name: str, halo: int = 1) -> jax.Array:
     return jnp.concatenate([from_above, x, from_below], axis=1)
 
 
+def _halo_from_above(x: jax.Array, axis_name: str, halo: int = 1) -> jax.Array:
+    """Prepend ``halo`` rows from the previous shard (zeros on shard 0)."""
+    size = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    down = [(i, (i + 1) % size) for i in range(size)]
+    from_above = jax.lax.ppermute(x[:, -halo:], axis_name, down)
+    from_above = jnp.where(rank == 0, jnp.zeros_like(from_above), from_above)
+    return jnp.concatenate([from_above, x], axis=1)
+
+
 def spatial_conv3x3(x, w, axis_name: str, stride: int = 1):
     """3x3 conv over an H-sharded activation: halo-exchange then VALID conv
-    over the padded shard (equivalent to the unsharded symmetric-pad conv).
-    stride must be 1: symmetric halo padding does not reproduce a strided
-    conv's window phase across shard boundaries."""
-    if stride != 1:
-        raise NotImplementedError(
-            "SpatialBottleneck supports stride=1 only (downsampling blocks "
-            "should run unsharded, as the reference restricts its spatial "
-            "group to the stride-1 trunk)"
-        )
-    xp = halo_exchange(x, axis_name, halo=1)
-    return jax.lax.conv_general_dilated(
-        xp, w, (stride, stride), ((0, 0), (1, 1)),
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-    )[:, : x.shape[1] // stride + (x.shape[1] % stride)]
+    over the padded shard — equivalent to the unsharded symmetric-pad conv.
+
+    stride=1: one halo row from each neighbor.
+    stride=2 (the strided window-phase handling of the reference's
+    ``SpatialBottleneck``, ``bottleneck.py:386+``): with symmetric (1,1)
+    padding, local output row j reads local input rows 2j-1..2j+1, so only a
+    *top* halo row is needed and the stride-2 VALID conv over
+    [above_row, local rows] reproduces the global phase exactly. Requires an
+    even local H so shard output boundaries land on stride multiples.
+    """
+    if stride == 1:
+        xp = halo_exchange(x, axis_name, halo=1)
+        return jax.lax.conv_general_dilated(
+            xp, w, (1, 1), ((0, 0), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )[:, : x.shape[1]]
+    if stride == 2:
+        if x.shape[1] % 2:
+            raise ValueError(
+                f"stride-2 spatial conv needs an even local H, got {x.shape[1]}"
+            )
+        xp = _halo_from_above(x, axis_name, halo=1)  # (N, H_local+1, W, C)
+        return jax.lax.conv_general_dilated(
+            xp, w, (2, 2), ((0, 0), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )[:, : x.shape[1] // 2]
+    raise NotImplementedError(f"spatial conv stride {stride} (1 or 2 only)")
 
 
 class Bottleneck:

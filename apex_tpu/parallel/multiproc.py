@@ -1,0 +1,38 @@
+"""Launcher parity note — the reference's ``apex.parallel.multiproc``
+(``apex/parallel/multiproc.py:1-35``) spawns one Python process per local
+GPU and sets the ``RANK``/``WORLD_SIZE`` env protocol (the pre-``torchrun``
+launcher).
+
+A JAX SPMD program needs no launcher on a single host: one process drives
+every local device, and ``jax.sharding.Mesh`` + ``shard_map`` replace the
+process-per-device model (SURVEY.md §2.4). On multi-host TPU pods the
+runtime itself provides the process group — each host runs the same script
+and calls :func:`jax.distributed.initialize`, which is what this module's
+:func:`main` does, making ``python -m apex_tpu.parallel.multiproc script.py``
+a drop-in spelling for users migrating launch commands.
+"""
+
+from __future__ import annotations
+
+import runpy
+import sys
+
+import jax
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(__doc__)
+        print(f"devices visible to this process: {jax.device_count()}")
+        return
+    try:
+        jax.distributed.initialize()  # no-op args on single-host
+    except Exception:
+        pass  # single-host / already initialized: proceed
+    script, sys.argv = argv[0], argv
+    runpy.run_path(script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

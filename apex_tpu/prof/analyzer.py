@@ -21,8 +21,11 @@ import jax
 from apex_tpu import native as _native
 
 # op-name prefixes → family, the analog of pyprof's per-family analyzer
-# classes (blas.py, conv.py, pointwise.py, reduction.py, …)
+# classes (blas.py, conv.py, pointwise.py, reduction.py, …). Order matters:
+# first match wins ("convert" must shadow "conv", "while" is a container).
 FAMILIES = {
+    "while": "control", "conditional": "control", "call": "control",
+    "convert": "cast",
     "dot": "gemm", "conv": "conv", "fusion": "fusion",
     "all-reduce": "collective", "all-gather": "collective",
     "reduce-scatter": "collective", "collective-permute": "collective",
@@ -30,6 +33,11 @@ FAMILIES = {
     "copy": "memory", "transpose": "memory", "broadcast": "memory",
     "custom-call": "custom",
 }
+
+# container rows span their children on the same trace track; they are
+# reported as their own family but excluded from top-sink rankings to avoid
+# double counting (trace_reader.summarize)
+CONTAINER_FAMILIES = ("control",)
 
 
 @dataclasses.dataclass

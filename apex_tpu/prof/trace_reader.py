@@ -125,13 +125,17 @@ def op_records(events: Sequence[TraceEvent]) -> List[dict]:
 
 
 def summarize(log_dir: str, top: int = 5) -> Tuple[List[dict], Dict[str, "OpStats"]]:
-    """(top-K time sinks, per-family stats) for the newest run."""
-    from apex_tpu.prof.analyzer import analyze_ops
+    """(top-K time sinks, per-family stats) for the newest run. Container
+    rows (while/conditional bodies, which span their children on the same
+    track) are excluded from the sink ranking to avoid double counting."""
+    from apex_tpu.prof.analyzer import CONTAINER_FAMILIES, _family_of, analyze_ops
 
     recs = op_records(read_trace(log_dir))
     recs.sort(key=lambda r: -r["time_s"])
     fams = analyze_ops(recs)
-    return recs[:top], fams
+    sinks = [r for r in recs
+             if _family_of(r["name"]) not in CONTAINER_FAMILIES]
+    return sinks[:top], fams
 
 
 def format_report(log_dir: str, top: int = 5) -> str:

@@ -26,11 +26,15 @@ class MegatronPretrainingSampler:
             micro_batch_size * data_parallel_size)
         self.drop_last = drop_last
         if total_samples <= 0:
-            raise ValueError("no sample to consume")
+            raise ValueError(f"total_samples must be positive, got {total_samples}")
         if consumed_samples >= total_samples:
-            raise ValueError("no samples left to consume")
+            raise ValueError(
+                f"consumed_samples ({consumed_samples}) already >= "
+                f"total_samples ({total_samples})")
         if data_parallel_rank >= data_parallel_size:
-            raise ValueError("data_parallel_rank should be smaller than size")
+            raise ValueError(
+                f"data_parallel_rank {data_parallel_rank} out of range for "
+                f"data_parallel_size {data_parallel_size}")
 
     def __len__(self):
         return self.total_samples

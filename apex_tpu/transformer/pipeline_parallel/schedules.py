@@ -244,3 +244,49 @@ def get_forward_backward_func(
             return forward_backward_pipelining_with_interleaving
         return forward_backward_pipelining_without_interleaving
     return forward_backward_no_pipelining
+
+
+def build_schedule(
+    *,
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    rampup_batch_size: Optional[list] = None,
+):
+    """Pick the schedule *and* its microbatch count from one config — the
+    glue the reference spreads across ``setup_microbatch_calculator``
+    (``pipeline_parallel/utils.py:58-104``) and
+    ``get_forward_backward_func``.
+
+    Returns ``(fwd_bwd_func, calculator)``: call ``calculator.get()`` for
+    the number of microbatches to split the global batch into (it changes
+    over time under ``rampup_batch_size``; call
+    ``calculator.update(consumed_samples, ...)`` per step then re-split),
+    and drive ``fwd_bwd_func`` with that many microbatches. The interleaved
+    schedule additionally wants ``virtual_chunks=v`` and chunked params.
+    """
+    from apex_tpu.transformer.microbatches import (
+        build_num_microbatches_calculator,
+    )
+
+    calc = build_num_microbatches_calculator(
+        global_batch_size, micro_batch_size, data_parallel_size,
+        rampup_batch_size,
+    )
+    if (pipeline_model_parallel_size > 1
+            and calc.get() < pipeline_model_parallel_size):
+        raise ValueError(
+            f"{calc.get()} microbatches cannot fill a "
+            f"{pipeline_model_parallel_size}-stage pipeline; lower "
+            "micro_batch_size or raise global_batch_size"
+        )
+    fn = get_forward_backward_func(
+        virtual_pipeline_model_parallel_size, pipeline_model_parallel_size,
+    )
+    if virtual_pipeline_model_parallel_size is not None \
+            and pipeline_model_parallel_size > 1:
+        fn = functools.partial(
+            fn, virtual_chunks=virtual_pipeline_model_parallel_size)
+    return fn, calc

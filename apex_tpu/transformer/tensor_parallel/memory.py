@@ -1,0 +1,102 @@
+"""Preallocated activation buffers — API parity with
+``apex/transformer/tensor_parallel/memory.py:23-133`` (``MemoryBuffer``,
+``RingMemBuffer``, ``allocate_mem_buff``).
+
+Why this exists on TPU at all: the reference preallocates contiguous CUDA
+memory so per-microbatch activation-checkpoint tensors don't fragment the
+caching allocator (its ``CheckpointFunction`` copies distributed hidden
+states into the buffer, ``random.py:45-84``). XLA has no runtime allocator
+to fragment — buffers are planned at compile time, and *donation*
+(``jax.jit(..., donate_argnums=...)``) is the idiomatic way to reuse a
+buffer across steps (see ``tests/test_aux.py::TestMemoryBuffer``'s aliasing
+evidence). The functional buffer below is therefore useful for the
+reference's *other* use: carrying a bounded scratch region through a scan
+(e.g. stashed hidden states) with explicit offset bookkeeping, while
+keeping the reference's allocate/get/reset call surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_MEM_BUFFS: Dict[str, "MemoryBuffer"] = {}
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MemoryBuffer:
+    """Functional contiguous buffer: ``add`` copies a tensor in at the
+    current offset and returns (new_buffer, view-shape slice info); ``get``
+    reads a chunk back. Unlike the CUDA original, every mutation returns a
+    new buffer value (donation makes the copy free under jit)."""
+
+    data: jax.Array
+    start: jax.Array  # scalar int32 offset of free space
+    in_use_value: float = dataclasses.field(
+        default=0.0, metadata=dict(static=False))
+
+    @classmethod
+    def create(cls, numel: int, dtype=jnp.float32) -> "MemoryBuffer":
+        return cls(data=jnp.zeros((numel,), dtype),
+                   start=jnp.zeros((), jnp.int32),
+                   in_use_value=0.0)
+
+    @property
+    def numel(self) -> int:
+        return self.data.shape[0]
+
+    def add(self, tensor: jax.Array) -> Tuple["MemoryBuffer", jax.Array]:
+        """Copy ``tensor`` into the buffer; returns (buffer', offset)."""
+        flat = tensor.reshape(-1).astype(self.data.dtype)
+        data = jax.lax.dynamic_update_slice(self.data, flat, (self.start,))
+        offset = self.start
+        return dataclasses.replace(
+            self, data=data, start=self.start + flat.shape[0]
+        ), offset
+
+    def get(self, offset: jax.Array, shape) -> jax.Array:
+        size = 1
+        for s in shape:
+            size *= int(s)
+        return jax.lax.dynamic_slice(self.data, (offset,), (size,)).reshape(shape)
+
+    def reset(self) -> "MemoryBuffer":
+        """``MemoryBuffer.reset`` — rewind the free pointer, keep storage."""
+        return dataclasses.replace(self, start=jnp.zeros((), jnp.int32))
+
+
+class RingMemBuffer:
+    """``RingMemBuffer`` (``memory.py:133``): a rotation of N buffers handed
+    out round-robin (the reference uses it for double-buffered checkpoint
+    activations)."""
+
+    def __init__(self, num_buffers: int, numel: int, dtype=jnp.float32):
+        self.buffers = [MemoryBuffer.create(numel, dtype)
+                        for _ in range(num_buffers)]
+        self._idx = -1
+
+    def get_next_buffer(self) -> MemoryBuffer:
+        self._idx = (self._idx + 1) % len(self.buffers)
+        return self.buffers[self._idx]
+
+
+def allocate_mem_buff(name: str, numel: int, dtype=jnp.float32,
+                      track_usage: bool = False) -> MemoryBuffer:
+    """``allocate_mem_buff`` (``memory.py:23``) — registry-backed."""
+    del track_usage  # usage is visible in the functional value itself
+    if name in _MEM_BUFFS:
+        raise ValueError(f"memory buffer {name!r} already allocated")
+    _MEM_BUFFS[name] = MemoryBuffer.create(numel, dtype)
+    return _MEM_BUFFS[name]
+
+
+def get_mem_buff(name: str) -> MemoryBuffer:
+    return _MEM_BUFFS[name]
+
+
+def destroy_mem_buffs() -> None:
+    _MEM_BUFFS.clear()

@@ -1,78 +1,397 @@
-"""Megatron-style global argument parser.
+"""Megatron-style global argument parser — full-surface re-design of
+``apex/transformer/testing/arguments.py`` (808 LoC).
 
-Re-design of ``apex/transformer/testing/arguments.py`` (808 LoC) +
-``global_vars.py:270``'s get/set singleton: the subset of arguments the
-transformer stack actually consumes, with the same names and defaults, plus
-the TPU-native extensions (context parallelism, sequence parallelism).
+Same argument groups, names, and defaults as the reference so Megatron-style
+launch commands parse unchanged; the validation pass (``parse_args``'s
+inline checks there) is :func:`validate_args`. TPU-native differences:
+
+* world size comes from ``jax.device_count()`` (no ``RANK``/``WORLD_SIZE``
+  env protocol — SPMD has one process), overridable for planning;
+* ``params_dtype`` is a jnp dtype; bf16 is the native half type;
+* knobs that only steer CUDA machinery (``--DDP-impl``, contiguous buffers,
+  masked-softmax fusion) are accepted for command compatibility and recorded
+  — the XLA compiler owns those decisions;
+* TPU extensions: ``--context-parallel-size``, ``--sequence-parallel``.
 """
 
 from __future__ import annotations
 
 import argparse
-from typing import Optional
+
+import jax
+import jax.numpy as jnp
 
 _GLOBAL_ARGS = None
 
 
-def parse_args(extra_args_provider=None, args_list=None) -> argparse.Namespace:
-    """``parse_args`` (``arguments.py``): model/train/parallel argument
-    groups; unrecognized args error like the reference."""
-    parser = argparse.ArgumentParser(description="apex_tpu arguments")
-
-    g = parser.add_argument_group("model")
-    g.add_argument("--num-layers", type=int, default=2)
-    g.add_argument("--hidden-size", type=int, default=64)
-    g.add_argument("--num-attention-heads", type=int, default=4)
-    g.add_argument("--ffn-hidden-size", type=int, default=None)
-    g.add_argument("--max-position-embeddings", type=int, default=512)
-    g.add_argument("--seq-length", type=int, default=128)
-    g.add_argument("--vocab-size", type=int, default=1024)
-    g.add_argument("--padded-vocab-size", type=int, default=None)
-
-    g = parser.add_argument_group("train")
-    g.add_argument("--micro-batch-size", type=int, default=2)
-    g.add_argument("--global-batch-size", type=int, default=None)
-    g.add_argument("--rampup-batch-size", nargs="*", type=int, default=None)
-    g.add_argument("--lr", type=float, default=1e-4)
-    g.add_argument("--train-iters", type=int, default=10)
-    g.add_argument("--fp16", action="store_true")
-    g.add_argument("--bf16", action="store_true")
-    g.add_argument("--loss-scale", type=float, default=None)
-    g.add_argument("--initial-loss-scale", type=float, default=2**16)
-    g.add_argument("--seed", type=int, default=1234)
-
-    g = parser.add_argument_group("parallel")
-    g.add_argument("--tensor-model-parallel-size", type=int, default=1)
-    g.add_argument("--pipeline-model-parallel-size", type=int, default=1)
-    g.add_argument("--virtual-pipeline-model-parallel-size", type=int, default=None)
-    g.add_argument("--context-parallel-size", type=int, default=1)
-    g.add_argument("--sequence-parallel", action="store_true")
-    g.add_argument("--num-microbatches", type=int, default=None)
-
+def parse_args(extra_args_provider=None, args_list=None, *,
+               defaults=None, ignore_unknown_args: bool = False,
+               validate: bool = True):
+    """Parse (and by default validate) the full Megatron argument surface."""
+    parser = argparse.ArgumentParser(
+        description="apex_tpu arguments", allow_abbrev=False)
+    for add in (_add_network_size_args, _add_regularization_args,
+                _add_training_args, _add_initialization_args,
+                _add_learning_rate_args, _add_checkpointing_args,
+                _add_mixed_precision_args, _add_distributed_args,
+                _add_validation_args, _add_data_args, _add_logging_args):
+        parser = add(parser)
     if extra_args_provider is not None:
         parser = extra_args_provider(parser)
-    args = parser.parse_args(args_list)
 
-    if args.padded_vocab_size is None:
-        # pad vocab to a multiple of 128*tp (the reference pads to
-        # make-vocab-size-divisible-by x tp)
-        mult = 128 * args.tensor_model_parallel_size
-        args.padded_vocab_size = ((args.vocab_size + mult - 1) // mult) * mult
-    if args.global_batch_size is None:
-        args.global_batch_size = args.micro_batch_size
-    if args.ffn_hidden_size is None:
-        args.ffn_hidden_size = 4 * args.hidden_size
+    if ignore_unknown_args:
+        args, _ = parser.parse_known_args(args_list)
+    else:
+        args = parser.parse_args(args_list)
+    if validate:
+        validate_args(args, defaults or {})
     return args
 
 
+def validate_args(args, defaults=None):
+    """The reference's consistency pass: parallel-size arithmetic, dtype
+    exclusivity, batch/virtual-stage divisibility, lr/seq sanity."""
+    defaults = defaults or {}
+
+    # -- distributed arithmetic (reference: world size env; here the mesh) --
+    if args.world_size is None:
+        args.world_size = jax.device_count()
+    args.rank = 0  # SPMD: one controller process
+    args.tensor_model_parallel_size = min(
+        args.tensor_model_parallel_size, args.world_size)
+    if args.world_size % args.tensor_model_parallel_size:
+        raise ValueError(
+            f"world size ({args.world_size}) is not divisible by tensor "
+            f"model parallel size ({args.tensor_model_parallel_size})")
+    args.pipeline_model_parallel_size = min(
+        args.pipeline_model_parallel_size,
+        args.world_size // args.tensor_model_parallel_size)
+    mp = args.pipeline_model_parallel_size * args.tensor_model_parallel_size
+    if args.world_size % mp:
+        raise ValueError(
+            f"world size ({args.world_size}) is not divisible by tensor "
+            f"({args.tensor_model_parallel_size}) x pipeline "
+            f"({args.pipeline_model_parallel_size}) parallel sizes")
+    args.data_parallel_size = args.world_size // mp
+    if args.pipeline_model_parallel_size > 1 \
+            and args.pipeline_model_parallel_split_rank is not None \
+            and args.pipeline_model_parallel_split_rank >= \
+            args.pipeline_model_parallel_size:
+        raise ValueError("split rank must be < pipeline model parallel size")
+
+    # -- deprecated spellings (same guidance as the reference) --
+    if getattr(args, "batch_size", None) is not None:
+        raise ValueError("--batch-size is no longer valid, "
+                         "use --micro-batch-size instead")
+    if getattr(args, "warmup", None) is not None:
+        raise ValueError("--warmup is no longer valid, "
+                         "use --lr-warmup-fraction instead")
+    if getattr(args, "model_parallel_size", None) is not None:
+        raise ValueError("--model-parallel-size is no longer valid, "
+                         "use --tensor-model-parallel-size instead")
+    if args.checkpoint_activations:
+        args.activations_checkpoint_method = "uniform"
+
+    # -- user-supplied defaults (only fill Nones) --
+    for key, val in defaults.items():
+        if getattr(args, key, None) is None:
+            setattr(args, key, val)
+
+    # -- batch sizes / virtual stages --
+    if args.micro_batch_size is None or args.micro_batch_size <= 0:
+        raise ValueError("--micro-batch-size must be positive")
+    if args.global_batch_size is None:
+        args.global_batch_size = args.micro_batch_size * args.data_parallel_size
+    if args.num_layers_per_virtual_pipeline_stage is not None:
+        if args.pipeline_model_parallel_size <= 2:
+            raise ValueError("interleaved schedule needs pipeline size > 2")
+        if args.num_layers % args.num_layers_per_virtual_pipeline_stage:
+            raise ValueError("num layers not divisible by layers per "
+                             "virtual pipeline stage")
+        args.virtual_pipeline_model_parallel_size = (
+            (args.num_layers // args.pipeline_model_parallel_size)
+            // args.num_layers_per_virtual_pipeline_stage)
+    else:
+        args.virtual_pipeline_model_parallel_size = None
+
+    # -- parameter dtype --
+    if args.fp16 and args.bf16:
+        raise ValueError("--fp16 and --bf16 are mutually exclusive")
+    args.params_dtype = jnp.float32
+    if args.fp16:
+        args.params_dtype = jnp.float16
+    if args.bf16:
+        args.params_dtype = jnp.bfloat16
+        # bf16 grads accumulate/all-reduce in fp32 (reference forces this)
+        args.accumulate_allreduce_grads_in_fp32 = True
+
+    args.consumed_train_samples = 0
+    args.consumed_valid_samples = 0
+
+    # -- iteration- vs sample-based training exclusivity --
+    if args.train_iters:
+        if args.train_samples is not None:
+            raise ValueError("iteration-based training excludes --train-samples")
+        if args.lr_decay_samples is not None:
+            raise ValueError("iteration-based training excludes lr decay samples")
+        if args.lr_warmup_samples != 0:
+            raise ValueError("iteration-based training excludes lr warmup samples")
+        if args.rampup_batch_size is not None:
+            raise ValueError("iteration-based training excludes batch rampup")
+        if args.lr_warmup_fraction is not None and args.lr_warmup_iters != 0:
+            raise ValueError(
+                "specify only one of lr-warmup-fraction and lr-warmup-iters")
+    if args.train_samples:
+        if args.train_iters is not None:
+            raise ValueError("sample-based training excludes --train-iters")
+        if args.lr_decay_iters is not None:
+            raise ValueError("sample-based training excludes lr decay iters")
+        if args.lr_warmup_iters != 0:
+            raise ValueError("sample-based training excludes lr warmup iters")
+        if args.lr_warmup_fraction is not None and args.lr_warmup_samples != 0:
+            raise ValueError(
+                "specify only one of lr-warmup-fraction and lr-warmup-samples")
+
+    # -- required / derived model dims --
+    for req in ("num_layers", "hidden_size", "num_attention_heads",
+                "max_position_embeddings"):
+        if getattr(args, req) is None:
+            raise ValueError(f"{req} argument is None")
+    if args.ffn_hidden_size is None:
+        args.ffn_hidden_size = 4 * args.hidden_size
+    if args.kv_channels is None:
+        if args.hidden_size % args.num_attention_heads:
+            raise ValueError("hidden size not divisible by attention heads")
+        args.kv_channels = args.hidden_size // args.num_attention_heads
+    if args.seq_length is not None:
+        if args.encoder_seq_length is not None:
+            raise ValueError("specify only one of seq-length and "
+                             "encoder-seq-length")
+        args.encoder_seq_length = args.seq_length
+    else:
+        if args.encoder_seq_length is None:
+            raise ValueError("one of --seq-length / --encoder-seq-length "
+                             "is required")
+        args.seq_length = args.encoder_seq_length
+    if args.seq_length and args.max_position_embeddings < args.seq_length:
+        raise ValueError("max position embeddings < sequence length")
+    if args.decoder_seq_length is not None \
+            and args.max_position_embeddings < args.decoder_seq_length:
+        raise ValueError("max position embeddings < decoder sequence length")
+    if args.lr is not None and args.min_lr > args.lr:
+        raise ValueError("min lr > lr")
+    if args.save is not None and args.save_interval is None:
+        raise ValueError("--save needs --save-interval")
+    if args.fp16_lm_cross_entropy and not args.fp16:
+        raise ValueError("fp16 lm cross entropy requires --fp16")
+    if args.fp32_residual_connection and not (args.fp16 or args.bf16):
+        raise ValueError("fp32 residual connection requires fp16/bf16")
+
+    # -- vocab padding (make-vocab-size-divisible-by x tp) --
+    if getattr(args, "vocab_size", None) is not None \
+            and getattr(args, "padded_vocab_size", None) is None:
+        mult = args.make_vocab_size_divisible_by * \
+            args.tensor_model_parallel_size
+        args.padded_vocab_size = ((args.vocab_size + mult - 1) // mult) * mult
+    return args
+
+
+# --- argument groups (names/defaults mirror the reference) -------------------
+
+def _add_network_size_args(parser):
+    g = parser.add_argument_group(title="network size")
+    g.add_argument("--num-layers", type=int, default=None)
+    g.add_argument("--hidden-size", type=int, default=None)
+    g.add_argument("--ffn-hidden-size", type=int, default=None)
+    g.add_argument("--num-attention-heads", type=int, default=None)
+    g.add_argument("--kv-channels", type=int, default=None)
+    g.add_argument("--max-position-embeddings", type=int, default=None)
+    g.add_argument("--make-vocab-size-divisible-by", type=int, default=128)
+    g.add_argument("--vocab-size", type=int, default=None)
+    g.add_argument("--padded-vocab-size", type=int, default=None)
+    g.add_argument("--layernorm-epsilon", type=float, default=1e-5)
+    g.add_argument("--apply-residual-connection-post-layernorm",
+                   action="store_true")
+    g.add_argument("--openai-gelu", action="store_true")
+    g.add_argument("--onnx-safe", type=bool, default=None)
+    return parser
+
+
+def _add_logging_args(parser):
+    g = parser.add_argument_group(title="logging")
+    g.add_argument("--log-params-norm", action="store_true")
+    g.add_argument("--log-num-zeros-in-grad", action="store_true")
+    g.add_argument("--tensorboard-log-interval", type=int, default=1)
+    g.add_argument("--tensorboard-queue-size", type=int, default=1000)
+    g.add_argument("--log-timers-to-tensorboard", action="store_true")
+    g.add_argument("--log-batch-size-to-tensorboard", action="store_true")
+    g.add_argument("--log-learning-rate-to-tensorboard", action="store_false")
+    g.add_argument("--log-loss-scale-to-tensorboard", action="store_false")
+    g.add_argument("--log-validation-ppl-to-tensorboard", action="store_true")
+    return parser
+
+
+def _add_regularization_args(parser):
+    g = parser.add_argument_group(title="regularization")
+    g.add_argument("--attention-dropout", type=float, default=0.1)
+    g.add_argument("--hidden-dropout", type=float, default=0.1)
+    g.add_argument("--weight-decay", type=float, default=0.01)
+    g.add_argument("--clip-grad", type=float, default=1.0)
+    g.add_argument("--adam-beta1", type=float, default=0.9)
+    g.add_argument("--adam-beta2", type=float, default=0.999)
+    g.add_argument("--adam-eps", type=float, default=1e-8)
+    g.add_argument("--sgd-momentum", type=float, default=0.9)
+    return parser
+
+
+def _add_training_args(parser):
+    g = parser.add_argument_group(title="training")
+    g.add_argument("--micro-batch-size", type=int, default=None)
+    g.add_argument("--batch-size", type=int, default=None,
+                   help="deprecated; use --micro-batch-size")
+    g.add_argument("--global-batch-size", type=int, default=None)
+    g.add_argument("--rampup-batch-size", nargs="*", default=None)
+    g.add_argument("--checkpoint-activations", action="store_true")
+    g.add_argument("--activations-checkpoint-method", type=str, default=None,
+                   choices=["uniform", "block"])
+    g.add_argument("--activations-checkpoint-num-layers", type=int, default=1)
+    g.add_argument("--distribute-checkpointed-activations",
+                   action="store_true")
+    g.add_argument("--train-iters", type=int, default=None)
+    g.add_argument("--train-samples", type=int, default=None)
+    g.add_argument("--log-interval", type=int, default=100)
+    g.add_argument("--exit-interval", type=int, default=None)
+    g.add_argument("--exit-duration-in-mins", type=int, default=None)
+    g.add_argument("--optimizer", type=str, default="adam",
+                   choices=["adam", "sgd"])
+    g.add_argument("--dataloader-type", type=str, default=None,
+                   choices=["single", "cyclic"])
+    # CUDA-machinery knobs, accepted for command compat; XLA owns fusion
+    g.add_argument("--no-async-tensor-model-parallel-allreduce",
+                   action="store_true")
+    g.add_argument("--no-persist-layer-norm", action="store_true")
+    g.add_argument("--sequence-parallel", action="store_true")
+    g.add_argument("--no-gradient-accumulation-fusion", action="store_true")
+    return parser
+
+
+def _add_initialization_args(parser):
+    g = parser.add_argument_group(title="initialization")
+    g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--init-method-std", type=float, default=0.02)
+    g.add_argument("--init-method-xavier-uniform", action="store_true")
+    return parser
+
+
+def _add_learning_rate_args(parser):
+    g = parser.add_argument_group(title="learning rate")
+    g.add_argument("--lr", type=float, default=None)
+    g.add_argument("--lr-decay-style", type=str, default="linear",
+                   choices=["constant", "linear", "cosine"])
+    g.add_argument("--lr-decay-iters", type=int, default=None)
+    g.add_argument("--lr-decay-samples", type=int, default=None)
+    g.add_argument("--lr-warmup-fraction", type=float, default=None)
+    g.add_argument("--lr-warmup-iters", type=int, default=0)
+    g.add_argument("--lr-warmup-samples", type=int, default=0)
+    g.add_argument("--warmup", type=int, default=None,
+                   help="deprecated; use --lr-warmup-fraction")
+    g.add_argument("--min-lr", type=float, default=0.0)
+    g.add_argument("--override-lr-scheduler", action="store_true")
+    g.add_argument("--use-checkpoint-lr-scheduler", action="store_true")
+    return parser
+
+
+def _add_checkpointing_args(parser):
+    g = parser.add_argument_group(title="checkpointing")
+    g.add_argument("--save", type=str, default=None)
+    g.add_argument("--save-interval", type=int, default=None)
+    g.add_argument("--no-save-optim", action="store_true", default=None)
+    g.add_argument("--no-save-rng", action="store_true", default=None)
+    g.add_argument("--load", type=str, default=None)
+    g.add_argument("--no-load-optim", action="store_true", default=None)
+    g.add_argument("--no-load-rng", action="store_true", default=None)
+    g.add_argument("--finetune", action="store_true")
+    return parser
+
+
+def _add_mixed_precision_args(parser):
+    g = parser.add_argument_group(title="mixed precision")
+    g.add_argument("--fp16", action="store_true")
+    g.add_argument("--bf16", action="store_true")
+    g.add_argument("--loss-scale", type=float, default=None)
+    g.add_argument("--initial-loss-scale", type=float, default=2 ** 32)
+    g.add_argument("--min-loss-scale", type=float, default=1.0)
+    g.add_argument("--loss-scale-window", type=float, default=1000)
+    g.add_argument("--hysteresis", type=int, default=2)
+    g.add_argument("--fp32-residual-connection", action="store_true")
+    g.add_argument("--no-query-key-layer-scaling", action="store_false",
+                   dest="apply_query_key_layer_scaling")
+    g.add_argument("--attention-softmax-in-fp32", action="store_true")
+    g.add_argument("--accumulate-allreduce-grads-in-fp32",
+                   action="store_true")
+    g.add_argument("--fp16-lm-cross-entropy", action="store_true")
+    return parser
+
+
+def _add_distributed_args(parser):
+    g = parser.add_argument_group(title="distributed")
+    g.add_argument("--tensor-model-parallel-size", type=int, default=1)
+    g.add_argument("--pipeline-model-parallel-size", type=int, default=1)
+    g.add_argument("--pipeline-model-parallel-split-rank", type=int,
+                   default=None)
+    g.add_argument("--model-parallel-size", type=int, default=None,
+                   help="deprecated; use --tensor-model-parallel-size")
+    g.add_argument("--num-layers-per-virtual-pipeline-stage", type=int,
+                   default=None)
+    g.add_argument("--world-size", type=int, default=None,
+                   help="default: jax.device_count()")
+    g.add_argument("--context-parallel-size", type=int, default=1)
+    g.add_argument("--DDP-impl", default="local", choices=["local", "torch"],
+                   help="accepted for compat; XLA handles grad allreduce")
+    g.add_argument("--use-contiguous-buffers-in-local-ddp",
+                   action="store_true", help="compat no-op (XLA fuses)")
+    g.add_argument("--use-cpu-initialization", action="store_true",
+                   default=None)
+    return parser
+
+
+def _add_validation_args(parser):
+    g = parser.add_argument_group(title="validation")
+    g.add_argument("--eval-iters", type=int, default=100)
+    g.add_argument("--eval-interval", type=int, default=1000)
+    return parser
+
+
+def _add_data_args(parser):
+    g = parser.add_argument_group(title="data and dataloader")
+    g.add_argument("--data-path", nargs="*", default=None)
+    g.add_argument("--split", type=str, default="969, 30, 1")
+    g.add_argument("--vocab-file", type=str, default=None)
+    g.add_argument("--merge-file", type=str, default=None)
+    g.add_argument("--seq-length", type=int, default=None)
+    g.add_argument("--encoder-seq-length", type=int, default=None)
+    g.add_argument("--decoder-seq-length", type=int, default=None)
+    g.add_argument("--retriever-seq-length", type=int, default=256)
+    g.add_argument("--sample-rate", type=float, default=1.0)
+    g.add_argument("--mask-prob", type=float, default=0.15)
+    g.add_argument("--short-seq-prob", type=float, default=0.1)
+    g.add_argument("--mmap-warmup", action="store_true")
+    g.add_argument("--num-workers", type=int, default=2)
+    g.add_argument("--reset-position-ids", action="store_true")
+    g.add_argument("--reset-attention-mask", action="store_true")
+    g.add_argument("--eod-mask-loss", action="store_true")
+    return parser
+
+
+# --- global singleton (global_vars.py get/set pattern) -----------------------
+
 def set_args(args) -> None:
-    """``set_global_variables`` analog (``global_vars.py``)."""
     global _GLOBAL_ARGS
     _GLOBAL_ARGS = args
 
 
 def get_args():
-    """``get_args`` (``global_vars.py:270``)."""
     if _GLOBAL_ARGS is None:
-        raise RuntimeError("arguments are not initialized; call set_args(parse_args())")
+        raise RuntimeError(
+            "arguments are not initialized; call set_args(parse_args())")
     return _GLOBAL_ARGS

@@ -1,0 +1,93 @@
+"""Global-variables registry — re-design of
+``apex/transformer/testing/global_vars.py`` (get/set singleton pattern,
+``global_vars.py:34-107``).
+
+One ``set_global_variables(...)`` call wires the pieces the reference
+registers separately: parsed args, the microbatch calculator, wall timers,
+and an optional tensorboard writer. Accessors raise before initialization,
+matching ``_ensure_var_is_initialized``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from apex_tpu.transformer import microbatches as _mb
+from apex_tpu.transformer.pipeline_parallel.utils import Timers
+from apex_tpu.transformer.testing import arguments as _args_mod
+
+_GLOBAL_TIMERS: Optional[Timers] = None
+_GLOBAL_TENSORBOARD_WRITER = None
+_GLOBAL_ADLR_AUTORESUME = None
+
+
+def get_args():
+    """``global_vars.py:34``."""
+    return _args_mod.get_args()
+
+
+def get_num_microbatches() -> int:
+    """``global_vars.py:40``."""
+    return _mb.get_num_microbatches()
+
+
+def get_current_global_batch_size() -> int:
+    """``global_vars.py:44``."""
+    return _mb.get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples: int, *,
+                            consistency_check: bool = True) -> None:
+    """``global_vars.py:48``."""
+    _mb.update_num_microbatches(consumed_samples, consistency_check)
+
+
+def get_timers() -> Timers:
+    """``global_vars.py:81``."""
+    if _GLOBAL_TIMERS is None:
+        raise RuntimeError("timers are not initialized")
+    return _GLOBAL_TIMERS
+
+
+def get_tensorboard_writer():
+    """``global_vars.py:69`` — None unless the caller registered one."""
+    return _GLOBAL_TENSORBOARD_WRITER
+
+
+def get_adlr_autoresume():
+    """``global_vars.py:75`` — the ADLR auto-resume stub; always None here
+    (the reference's is an import probe for an NVIDIA-internal module)."""
+    return _GLOBAL_ADLR_AUTORESUME
+
+
+def set_global_variables(extra_args_provider=None, args_defaults=None,
+                         args_list=None, ignore_unknown_args: bool = False):
+    """``global_vars.py:87``: parse+validate args, then initialize the
+    microbatch calculator and timers from them."""
+    global _GLOBAL_TIMERS
+    args = _args_mod.parse_args(
+        extra_args_provider, args_list,
+        defaults=args_defaults or {},
+        ignore_unknown_args=ignore_unknown_args,
+    )
+    _args_mod.set_args(args)
+    _mb.setup_microbatch_calculator(
+        args.global_batch_size, args.micro_batch_size,
+        args.data_parallel_size,
+        rampup_batch_size=[int(x) for x in args.rampup_batch_size]
+        if args.rampup_batch_size else None,
+    )
+    _GLOBAL_TIMERS = Timers()
+    return args
+
+
+def set_tensorboard_writer(writer) -> None:
+    global _GLOBAL_TENSORBOARD_WRITER
+    _GLOBAL_TENSORBOARD_WRITER = writer
+
+
+def destroy_global_vars() -> None:
+    global _GLOBAL_TIMERS, _GLOBAL_TENSORBOARD_WRITER
+    _GLOBAL_TIMERS = None
+    _GLOBAL_TENSORBOARD_WRITER = None
+    _args_mod.set_args(None)

@@ -54,7 +54,11 @@ def build(impl: str, cfg_kwargs, donate: bool):
     from apex_tpu.optimizers import fused_adam
 
     if impl == "baseline":
-        cfg_kwargs = dict(cfg_kwargs, attention_impl="naive")
+        # the stock-JAX formulation: naive attention and whole-block
+        # jax.checkpoint (the selective mlp_only policy is framework value,
+        # like the reference's activation-recompute machinery)
+        cfg_kwargs = dict(cfg_kwargs, attention_impl="naive",
+                          remat_policy="full")
     cfg = GPTConfig(**cfg_kwargs)
     model = GPTModel(cfg)
     params = model.init(jr.PRNGKey(0))
@@ -94,7 +98,7 @@ def main():
         # flash path is ~1G over from saved mlp/logit intermediates).
         cfg = dict(vocab_size=32768, max_seq_len=1024, hidden_size=1024,
                    num_layers=12, num_heads=16, tp_size=1, remat=True,
-                   attention_impl="flash")
+                   attention_impl="flash", remat_policy="mlp_only")
         batch, seq, iters = 16, 1024, 20
     else:  # smoke-test scale for CPU runs
         cfg = dict(vocab_size=1024, max_seq_len=128, hidden_size=128,

@@ -1,0 +1,171 @@
+"""DCGAN under mixed precision — port of ``examples/dcgan/main_amp.py``.
+
+The reference demonstrates amp's multiple-models / multiple-optimizers /
+multiple-losses surface (``amp.initialize([netD, netG], [optD, optG],
+num_losses=3)`` and three ``scale_loss(..., loss_id=i)`` contexts). The
+functional translation: one policy, three independent loss-scaler states
+(errD_real, errD_fake, errG), two optimizers — no patching.
+
+The discriminator loss is binary cross-entropy on probabilities — the
+canonical *banned* fp16 op (``lists/functional_overrides.py:69-80``): under
+O1 the loss runs in fp32 (policy casts network outputs up), exactly the
+reference's behavior.
+
+Run (CPU smoke): JAX_PLATFORMS=cpu python examples/dcgan/main_amp.py \
+    --niter 2 --iters-per-epoch 4 --imageSize 16
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import optax
+
+from apex_tpu import amp
+from apex_tpu.optimizers import fused_adam
+from apex_tpu.ops.xentropy import binary_cross_entropy
+
+
+def conv(x, w, stride=2):
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def conv_t(x, w, stride=2):
+    return jax.lax.conv_transpose(
+        x, w, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def init_generator(key, nz, ngf, image_size):
+    s0 = image_size // 4
+    ks = jr.split(key, 3)
+    return {
+        "fc": jr.normal(ks[0], (nz, s0 * s0 * ngf * 2)) * 0.05,
+        "ct1": jr.normal(ks[1], (4, 4, ngf * 2, ngf)) * 0.05,
+        "ct2": jr.normal(ks[2], (4, 4, ngf, 3)) * 0.05,
+    }
+
+
+def generator(p, z):
+    z = z.astype(p["fc"].dtype)  # follow the policy's compute dtype
+    # spatial start size / width are static shapes recovered from the params
+    ngf = p["ct1"].shape[3]
+    s0 = int((p["fc"].shape[1] // (ngf * 2)) ** 0.5)
+    h = jax.nn.relu(z @ p["fc"]).reshape(z.shape[0], s0, s0, ngf * 2)
+    h = jax.nn.relu(conv_t(h, p["ct1"]))
+    return jnp.tanh(conv_t(h, p["ct2"]))
+
+
+def init_discriminator(key, ndf):
+    ks = jr.split(key, 3)
+    return {
+        "c1": jr.normal(ks[0], (4, 4, 3, ndf)) * 0.05,
+        "c2": jr.normal(ks[1], (4, 4, ndf, ndf * 2)) * 0.05,
+        "fc": jr.normal(ks[2], (ndf * 2, 1)) * 0.05,
+    }
+
+
+def discriminator(p, x):
+    x = x.astype(p["c1"].dtype)  # follow the policy's compute dtype
+    h = jax.nn.leaky_relu(conv(x, p["c1"]), 0.2)
+    h = jax.nn.leaky_relu(conv(h, p["c2"]), 0.2)
+    h = h.mean(axis=(1, 2))
+    return jax.nn.sigmoid(h @ p["fc"])[:, 0]
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dataset", default="fake", help="fake: synthetic data")
+    parser.add_argument("--batchSize", type=int, default=64)
+    parser.add_argument("--imageSize", type=int, default=16)
+    parser.add_argument("--nz", type=int, default=100)
+    parser.add_argument("--ngf", type=int, default=64)
+    parser.add_argument("--ndf", type=int, default=64)
+    parser.add_argument("--niter", type=int, default=25)
+    parser.add_argument("--iters-per-epoch", type=int, default=8)
+    parser.add_argument("--lr", type=float, default=2e-4)
+    parser.add_argument("--beta1", type=float, default=0.5)
+    parser.add_argument("--manualSeed", type=int, default=2809)
+    parser.add_argument("--opt_level", default="O1")
+    args = parser.parse_args()
+    print(args)
+
+    policy = amp.get_policy(args.opt_level)
+    key = jr.PRNGKey(args.manualSeed)
+    netG = init_generator(jr.fold_in(key, 0), args.nz, args.ngf, args.imageSize)
+    netD = init_discriminator(jr.fold_in(key, 1), args.ndf)
+    mG = amp.MasterWeights.create(netG, policy)
+    mD = amp.MasterWeights.create(netD, policy)
+
+    optG = amp.skip_step_if_nonfinite(
+        fused_adam(learning_rate=args.lr, b1=args.beta1, b2=0.999))
+    optD = amp.skip_step_if_nonfinite(
+        fused_adam(learning_rate=args.lr, b1=args.beta1, b2=0.999))
+    stG, stD = optG.init(mG.master), optD.init(mD.master)
+    # three scalers, one per loss — the reference's num_losses=3 /
+    # loss_id protocol (main_amp.py: scale_loss(errD_real, optimizerD, 0)...)
+    scalers = [amp.init_loss_scaler("dynamic") for _ in range(3)]
+
+    real_label, fake_label = 1.0, 0.0
+
+    def d_loss_real(dp, x):
+        out = discriminator(policy.cast_to_compute(dp), x).astype(jnp.float32)
+        return binary_cross_entropy(out, jnp.full_like(out, real_label)).mean()
+
+    def d_loss_fake(dp, fake):
+        out = discriminator(policy.cast_to_compute(dp), fake).astype(jnp.float32)
+        return binary_cross_entropy(out, jnp.full_like(out, fake_label)).mean()
+
+    def g_loss(gp, dp, z):
+        fake = generator(policy.cast_to_compute(gp), z)
+        out = discriminator(policy.cast_to_compute(dp), fake).astype(jnp.float32)
+        return binary_cross_entropy(out, jnp.full_like(out, real_label)).mean()
+
+    @jax.jit
+    def train_step(mG, mD, stG, stD, s0, s1, s2, x, z):
+        with amp.with_policy(policy):
+            fake = generator(policy.cast_to_compute(mG.model), z)
+            # D step: two scaled losses, summed grads (reference backward()s
+            # errD_real and errD_fake separately into the same grads)
+            lr_, (gr, fr, s0) = amp.scaled_value_and_grad(d_loss_real)(
+                s0, mD.model, policy.cast_to_compute(x))
+            lf_, (gf, ff, s1) = amp.scaled_value_and_grad(d_loss_fake)(
+                s1, mD.model, jax.lax.stop_gradient(fake))
+            gD = jax.tree.map(jnp.add, gr, gf)
+            finD = jnp.logical_and(fr, ff)
+            upD, stD = optD.update(gD, stD, mD.master)
+            mD = amp.apply_updates_with_master(mD, upD, grads_finite=finD)
+
+            # G step through the updated D
+            lg_, (gG, fg, s2) = amp.scaled_value_and_grad(
+                lambda gp, z: g_loss(gp, mD.model, z))(s2, mG.model, z)
+            upG, stG = optG.update(gG, stG, mG.master)
+            mG = amp.apply_updates_with_master(mG, upG, grads_finite=fg)
+        return mG, mD, stG, stD, s0, s1, s2, lr_ + lf_, lg_
+
+    for epoch in range(args.niter):
+        for i in range(args.iters_per_epoch):
+            k = jr.fold_in(key, epoch * 10000 + i)
+            # dataset='fake': smooth random blobs as the real distribution
+            base = jr.normal(jr.fold_in(k, 0),
+                             (args.batchSize, 4, 4, 3))
+            x = jax.image.resize(
+                base, (args.batchSize, args.imageSize, args.imageSize, 3),
+                "linear").clip(-1, 1)
+            z = jr.normal(jr.fold_in(k, 1), (args.batchSize, args.nz))
+            (mG, mD, stG, stD, scalers[0], scalers[1], scalers[2],
+             lossD, lossG) = train_step(
+                mG, mD, stG, stD, *scalers, x, z)
+        print(f"[{epoch}/{args.niter}] Loss_D: {float(lossD):.4f} "
+              f"Loss_G: {float(lossG):.4f} "
+              f"scale: {float(scalers[0].loss_scale):.0f}")
+
+    assert jnp.isfinite(lossD) and jnp.isfinite(lossG)
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

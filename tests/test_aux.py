@@ -202,17 +202,69 @@ class TestBatchSamplers:
 
 
 class TestArguments:
+    BASE = ["--num-layers", "4", "--hidden-size", "64",
+            "--num-attention-heads", "4", "--max-position-embeddings", "128",
+            "--seq-length", "128", "--micro-batch-size", "2"]
+
     def test_parse_and_singleton(self):
         from apex_tpu.transformer.testing import get_args, parse_args, set_args
 
-        args = parse_args(args_list=[
-            "--num-layers", "4", "--tensor-model-parallel-size", "2",
-            "--vocab-size", "1000",
+        args = parse_args(args_list=self.BASE + [
+            "--tensor-model-parallel-size", "2", "--vocab-size", "1000",
+            "--world-size", "8",
         ])
         assert args.num_layers == 4
         assert args.padded_vocab_size == 1024  # padded to 128*tp
+        assert args.data_parallel_size == 4   # 8 / (tp=2 * pp=1)
         set_args(args)
         assert get_args().num_layers == 4
+
+    def test_derived_defaults(self):
+        from apex_tpu.transformer.testing import parse_args
+
+        args = parse_args(args_list=self.BASE + ["--world-size", "1"])
+        assert args.ffn_hidden_size == 256          # 4*hidden
+        assert args.kv_channels == 16               # hidden/heads
+        assert args.encoder_seq_length == 128       # from seq-length
+        assert args.global_batch_size == 2          # micro * dp
+
+    def test_bf16_forces_fp32_grad_accumulation(self):
+        import jax.numpy as jnp
+
+        from apex_tpu.transformer.testing import parse_args
+
+        args = parse_args(args_list=self.BASE + ["--bf16", "--world-size", "1"])
+        assert args.params_dtype == jnp.bfloat16
+        assert args.accumulate_allreduce_grads_in_fp32
+
+    def test_virtual_pipeline_derivation(self):
+        from apex_tpu.transformer.testing import parse_args
+
+        args = parse_args(args_list=[
+            "--num-layers", "16", "--hidden-size", "64",
+            "--num-attention-heads", "4", "--max-position-embeddings", "128",
+            "--seq-length", "128", "--micro-batch-size", "2",
+            "--pipeline-model-parallel-size", "4",
+            "--num-layers-per-virtual-pipeline-stage", "2",
+            "--world-size", "8",
+        ])
+        assert args.virtual_pipeline_model_parallel_size == 2  # (16/4)/2
+
+    def test_rejections(self):
+        from apex_tpu.transformer.testing import parse_args
+
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            parse_args(args_list=self.BASE + ["--fp16", "--bf16",
+                                              "--world-size", "1"])
+        with pytest.raises(ValueError, match="no longer valid"):
+            parse_args(args_list=self.BASE + ["--batch-size", "4",
+                                              "--world-size", "1"])
+        with pytest.raises(ValueError, match="not divisible"):
+            parse_args(args_list=self.BASE + [
+                "--tensor-model-parallel-size", "3", "--world-size", "8"])
+        with pytest.raises(ValueError, match="min lr"):
+            parse_args(args_list=self.BASE + [
+                "--lr", "0.001", "--min-lr", "0.01", "--world-size", "1"])
 
 
 class TestCheckpoint:
@@ -264,3 +316,76 @@ class TestModelParallelScaler:
         )(jnp.ones((4,)))
         assert float(scale) == 8.0  # backed off on every rank
         assert int(finite) == 0
+
+
+class TestMemoryBuffer:
+    """MemoryBuffer/RingMemBuffer parity (reference
+    ``tensor_parallel/memory.py:23-133``) + the donation evidence the module
+    docstring cites: on TPU the allocator-fragmentation problem the CUDA
+    buffer solves is handled by XLA donation aliasing."""
+
+    def test_add_get_reset_roundtrip(self):
+        from apex_tpu.transformer.tensor_parallel.memory import MemoryBuffer
+
+        buf = MemoryBuffer.create(64)
+        x = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+        buf, off0 = buf.add(x)
+        y = jnp.ones((8,), jnp.float32)
+        buf, off1 = buf.add(y)
+        assert int(off0) == 0 and int(off1) == 12
+        np.testing.assert_array_equal(buf.get(off0, (3, 4)), x)
+        np.testing.assert_array_equal(buf.get(off1, (8,)), y)
+        buf = buf.reset()
+        assert int(buf.start) == 0
+        assert buf.numel == 64  # storage retained
+
+    def test_buffer_works_under_jit_and_scan(self):
+        from apex_tpu.transformer.tensor_parallel.memory import MemoryBuffer
+
+        def stash_all(xs):
+            def body(buf, x):
+                buf, off = buf.add(x)
+                return buf, off
+
+            buf, offs = jax.lax.scan(body, MemoryBuffer.create(32), xs)
+            return buf, offs
+
+        xs = jnp.arange(24, dtype=jnp.float32).reshape(4, 6)
+        buf, offs = jax.jit(stash_all)(xs)
+        np.testing.assert_array_equal(np.asarray(offs), [0, 6, 12, 18])
+        np.testing.assert_array_equal(buf.get(offs[2], (6,)), xs[2])
+
+    def test_ring_buffer_rotates(self):
+        from apex_tpu.transformer.tensor_parallel.memory import RingMemBuffer
+
+        ring = RingMemBuffer(2, 16)
+        a, b, c = (ring.get_next_buffer() for _ in range(3))
+        assert a is c and a is not b
+
+    def test_registry(self):
+        from apex_tpu.transformer.tensor_parallel import memory as mem
+
+        mem.destroy_mem_buffs()
+        buf = mem.allocate_mem_buff("acts", 128)
+        assert mem.get_mem_buff("acts") is buf
+        with pytest.raises(ValueError, match="already allocated"):
+            mem.allocate_mem_buff("acts", 128)
+        mem.destroy_mem_buffs()
+
+    def test_donation_aliases_buffers(self):
+        """The evidence: donated inputs alias outputs (alias bytes > 0), so
+        a training step reuses its parameter/optimizer buffers in place —
+        the role the reference's preallocated buffer plays."""
+        params = {"w": jnp.ones((256, 256))}
+
+        @jax.jit
+        def step_plain(p):
+            return jax.tree.map(lambda x: x * 0.9, p)
+
+        step_donated = jax.jit(
+            lambda p: jax.tree.map(lambda x: x * 0.9, p), donate_argnums=0)
+
+        plain = step_plain.lower(params).compile().memory_analysis()
+        donated = step_donated.lower(params).compile().memory_analysis()
+        assert donated.alias_size_in_bytes > 0
+        assert donated.alias_size_in_bytes > plain.alias_size_in_bytes

@@ -1,0 +1,61 @@
+"""Smoke-run every example script the way a user would (VERDICT r1 item 10:
+'examples never executed by CI').
+
+Each runs as a subprocess with the virtual 8-device CPU mesh, few iters,
+synthetic data; pass = exit 0 and the script's own success markers.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable] + args, cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=timeout,
+    )
+    assert proc.returncode == 0, f"{' '.join(args)}\n{proc.stderr[-3000:]}"
+    return proc.stdout
+
+
+def test_simple_distributed_example():
+    out = _run(["examples/simple/distributed/run.py",
+                "--opt-level", "O2", "--steps", "25"])
+    assert "loss" in out
+    # loss printed at step 0 and the last step; it must decrease
+    lines = [ln for ln in out.splitlines() if ln.strip().startswith("step")]
+    first = float(lines[0].split("loss")[1].split()[0])
+    last = float(lines[-1].split("loss")[1].split()[0])
+    assert last < first
+
+
+def test_imagenet_example_synthetic():
+    out = _run(["examples/imagenet/main_amp.py", "--synthetic",
+                "--opt-level", "O2", "--sync-bn", "--iters", "3",
+                "--batch-size", "16", "--image-size", "32",
+                "--num-classes", "10"])
+    assert "img/s" in out or "loss" in out.lower()
+
+
+def test_dcgan_example():
+    out = _run(["examples/dcgan/main_amp.py", "--niter", "2",
+                "--iters-per-epoch", "2", "--imageSize", "16",
+                "--batchSize", "8", "--ngf", "8", "--ndf", "8"])
+    assert "done" in out
+
+
+def test_dcgan_example_o2():
+    out = _run(["examples/dcgan/main_amp.py", "--niter", "1",
+                "--iters-per-epoch", "2", "--imageSize", "16",
+                "--batchSize", "8", "--ngf", "8", "--ndf", "8",
+                "--opt_level", "O2"])
+    assert "done" in out

@@ -1,0 +1,130 @@
+"""Megatron testing-surface parity: global_vars wiring, dynamic batch size,
+GPT scaling — equivalents of the reference's
+``tests/L0/run_transformer/run_dynamic_batchsize_test.py`` and
+``gpt_scaling_test.py`` plus ``testing/global_vars.py`` coverage.
+"""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from apex_tpu.transformer.testing import global_vars
+
+BASE = ["--num-layers", "4", "--hidden-size", "64",
+        "--num-attention-heads", "4", "--max-position-embeddings", "128",
+        "--seq-length", "128"]
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    yield
+    global_vars.destroy_global_vars()
+
+
+class TestGlobalVars:
+    def test_set_global_variables_wires_everything(self):
+        args = global_vars.set_global_variables(args_list=BASE + [
+            "--micro-batch-size", "2", "--global-batch-size", "16",
+            "--world-size", "8",
+        ])
+        assert global_vars.get_args() is args
+        assert global_vars.get_num_microbatches() == 1  # 16/(2*8dp)
+        assert global_vars.get_current_global_batch_size() == 16
+        timers = global_vars.get_timers()
+        timers("tick").start()
+        timers("tick").stop()
+        assert timers("tick").elapsed() >= 0
+        assert global_vars.get_tensorboard_writer() is None
+        assert global_vars.get_adlr_autoresume() is None
+
+    def test_accessors_raise_before_init(self):
+        with pytest.raises(RuntimeError):
+            global_vars.get_timers()
+
+
+class TestDynamicBatchSize:
+    """``run_dynamic_batchsize_test.py``: with --rampup-batch-size the
+    number of microbatches grows as samples are consumed, and fwd/bwd runs
+    at each microbatch count."""
+
+    def test_rampup_schedule_and_fwd_bwd(self):
+        from apex_tpu.transformer.pipeline_parallel import schedules
+
+        global_vars.set_global_variables(args_list=BASE + [
+            "--micro-batch-size", "1", "--global-batch-size", "8",
+            "--rampup-batch-size", "2", "2", "24",
+            "--train-samples", "48", "--world-size", "1",
+        ])
+        params = {"w": jr.normal(jr.PRNGKey(0), (8, 8)) * 0.3}
+
+        def loss_fn(p, mb):
+            return jnp.mean((jnp.tanh(mb @ p["w"]) - mb) ** 2)
+
+        seen = []
+        consumed = 0
+        while consumed < 48:
+            global_vars.update_num_microbatches(consumed,
+                                                consistency_check=False)
+            m = global_vars.get_num_microbatches()
+            seen.append(m)
+            mbs = jr.normal(jr.fold_in(jr.PRNGKey(1), consumed), (m, 4, 8))
+            loss, grads = schedules.forward_backward_no_pipelining(
+                loss_fn, params, mbs)
+            assert np.isfinite(float(loss))
+            consumed += global_vars.get_current_global_batch_size()
+        # batch size ramped 2 -> 8 => microbatches ramped 2 -> 8
+        assert seen[0] < seen[-1]
+        assert seen == sorted(seen)
+        assert seen[-1] == 8
+
+
+class TestGPTScaling:
+    """``gpt_scaling_test.py``: the GPT stack must hold up as width/depth and
+    parallelism scale (CI sizes; the real sweep runs on hardware)."""
+
+    @pytest.mark.parametrize("hidden,layers", [(64, 2), (128, 4)])
+    def test_width_depth_scaling(self, hidden, layers):
+        from apex_tpu.models import GPTConfig, GPTModel
+
+        cfg = GPTConfig(vocab_size=256, max_seq_len=64, hidden_size=hidden,
+                        num_layers=layers, num_heads=4)
+        model = GPTModel(cfg)
+        params = model.init(jr.PRNGKey(0))
+        n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+        # parameter count tracks 12*L*H^2 + embeddings
+        expected = 12 * layers * hidden * hidden
+        assert n_params > expected
+        toks = jr.randint(jr.PRNGKey(1), (2, 64), 0, 256)
+        loss = jax.jit(model.loss_fn)(params, toks, toks)
+        assert np.isfinite(float(loss))
+
+    def test_tp4_scaling_runs(self):
+        """Parallelism-scaling smoke at tp=4 (bitwise tp-vs-dense parity is
+        covered by tests/test_models.py::test_tp2_matches_tp1; the
+        reference's scaling test likewise only records that larger configs
+        run)."""
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.models import GPTConfig, GPTModel
+        from apex_tpu.parallel import mesh as mesh_lib
+
+        toks = jr.randint(jr.PRNGKey(1), (2, 32), 0, 256)
+        mesh = mesh_lib.initialize_model_parallel(tensor_model_parallel_size=4)
+        try:
+            tp_model = GPTModel(GPTConfig(
+                vocab_size=256, max_seq_len=32, hidden_size=64,
+                num_layers=2, num_heads=4, tp_size=4))
+
+            def run(toks):
+                p = tp_model.init(jr.PRNGKey(0))
+                return tp_model.loss_fn(p, toks, toks)
+
+            loss = mesh_lib.shard_map(
+                run, mesh=mesh, in_specs=P(), out_specs=P(),
+            )(toks)
+            # random-init LM loss must sit near ln(vocab)
+            assert float(loss) == pytest.approx(np.log(256), rel=0.25)
+        finally:
+            mesh_lib.destroy_model_parallel()

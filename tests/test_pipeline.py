@@ -216,3 +216,184 @@ class TestP2P:
             run, mesh=mesh, in_specs=P("pp"), out_specs=P("pp")
         )(x)
         np.testing.assert_allclose(y, x)
+
+
+def gpt_block_stage(params, x):
+    """A real transformer block as a pipeline stage (LN -> attention ->
+    residual -> LN -> MLP -> residual), activations (batch, seq, hid)."""
+    from apex_tpu.ops import fused_layer_norm
+    from apex_tpu.ops.attention import flash_attention
+
+    h = fused_layer_norm(x, params["ln1_w"], params["ln1_b"])
+    b, s, hid = h.shape
+    heads, d = 2, hid // 2
+    qkv = h @ params["qkv_w"]  # (b, s, 3*hid)
+    q, k, v = jnp.split(qkv.reshape(b, s, heads, 3 * (hid // heads)), 3, -1)
+    ctx = flash_attention(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), causal=True,
+    ).transpose(0, 2, 1, 3).reshape(b, s, hid)
+    x = x + ctx @ params["ao_w"]
+    h = fused_layer_norm(x, params["ln2_w"], params["ln2_b"])
+    h = jax.nn.gelu(h @ params["up_w"], approximate=True)
+    return x + h @ params["dn_w"]
+
+
+def make_gpt_stage_params(key, n_stages, hid=HID):
+    def one(k):
+        ks = jr.split(k, 4)
+        return {
+            "ln1_w": jnp.ones((hid,)), "ln1_b": jnp.zeros((hid,)),
+            "ln2_w": jnp.ones((hid,)), "ln2_b": jnp.zeros((hid,)),
+            "qkv_w": jr.normal(ks[0], (hid, 3 * hid)) * 0.2,
+            "ao_w": jr.normal(ks[1], (hid, hid)) * 0.2,
+            "up_w": jr.normal(ks[2], (hid, 4 * hid)) * 0.2,
+            "dn_w": jr.normal(ks[3], (4 * hid, hid)) * 0.2,
+        }
+    return [one(jr.fold_in(key, i)) for i in range(n_stages)]
+
+
+class TestGPTBlockPipeline:
+    """VERDICT r1 item 7: a real GPT block through pp=4 with interleaving
+    (parity target ``tests/L0/run_transformer/test_pipeline_parallel_fwd_bwd.py``)."""
+
+    def test_pp4_interleaved_gpt_blocks_match_serial(self):
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=4)
+        v, S = 2, 4  # 8 transformer blocks over 4 devices, 2 chunks each
+        plist = make_gpt_stage_params(jr.fold_in(K, 20), v * S)
+        M = 8
+        mbs = jr.normal(jr.fold_in(K, 21), (M, 2, 8, HID))  # (M, b, s, hid)
+        tgts = jr.normal(jr.fold_in(K, 22), (M, 2, 8, HID))
+
+        def loss_head(out, tgt):
+            return jnp.mean((out - tgt) ** 2)
+
+        # device r holds chunks (r, r+S): stack (v, S, ...), shard S over pp
+        chunked = jax.tree.map(
+            lambda *xs: jnp.stack(xs).reshape(v, S, *xs[0].shape), *plist
+        )
+
+        def run(p, m, t):
+            local = jax.tree.map(lambda x: x[:, 0], p)  # (v, ...) this device
+            loss, g = schedules.forward_backward_pipelining_with_interleaving(
+                gpt_block_stage, loss_head, local, m, t, virtual_chunks=v
+            )
+            return loss, jax.tree.map(lambda x: x[:, None], g)
+
+        loss, grads = mesh_lib.shard_map(
+            run, mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P(None, "pp"), chunked), P(), P()),
+            out_specs=(P(), jax.tree.map(lambda _: P(None, "pp"), chunked)),
+        )(chunked, mbs, tgts)
+
+        def serial_loss(chunked_p):
+            # virtual stage order: chunk c, device r -> stage c*S + r
+            plist_l = [jax.tree.map(lambda x: x[c, r], chunked_p)
+                       for c in range(v) for r in range(S)]
+            outs = jax.vmap(lambda m: serial_forward_gpt(plist_l, m))(mbs)
+            return jnp.mean(jax.vmap(loss_head)(outs, tgts))
+
+        def serial_forward_gpt(pl, x):
+            for p in pl:
+                x = gpt_block_stage(p, x)
+            return x
+
+        ref_loss, ref_grads = jax.value_and_grad(serial_loss)(chunked)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-4, atol=1e-5)
+        for a, e in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads)):
+            np.testing.assert_allclose(a, e, rtol=5e-3, atol=5e-4)
+
+
+class TestPipelineMemory:
+    """Substantiate the 1F1B-memory-equivalence claim (schedules.py docstring):
+    with stage remat the pipeline's temp memory must be well below the
+    no-remat (GPipe-like) schedule's."""
+
+    def test_remat_bounds_pipeline_temp_memory(self):
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=4)
+        plist = make_stage_params(jr.fold_in(K, 30), 4)
+        stacked = stack_params(plist)
+        M = 16
+        mbs = jr.normal(jr.fold_in(K, 31), (M, 4, HID))
+        tgts = jr.normal(jr.fold_in(K, 32), (M, 4, HID))
+
+        def loss_head(out, tgt):
+            return jnp.mean((out - tgt) ** 2)
+
+        def make(remat):
+            def run(p, m, t):
+                def full_loss(local):
+                    outs = schedules.pipeline_spmd_forward(
+                        stage_fn, local, m, remat=remat)
+                    return jnp.mean(jax.vmap(loss_head)(outs, t))
+                loss, g = jax.value_and_grad(full_loss)(
+                    jax.tree.map(lambda x: x[0], p))
+                return loss, jax.tree.map(lambda x: x[None], g)
+
+            return jax.jit(mesh_lib.shard_map(
+                run, mesh=mesh,
+                in_specs=(jax.tree.map(lambda _: P("pp"), stacked), P(), P()),
+                out_specs=(P(), jax.tree.map(lambda _: P("pp"), stacked)),
+            ))
+
+        temps = {}
+        for remat in (False, True):
+            c = make(remat).lower(stacked, mbs, tgts).compile()
+            temps[remat] = c.memory_analysis().temp_size_in_bytes
+        # documented measurement: remat must cut temp memory substantially
+        # (no-remat keeps every tick's residuals live)
+        assert temps[True] < temps[False] * 0.7, temps
+
+
+class TestBuildSchedule:
+    """build_schedule glues the microbatch calculator to the schedule
+    dispatcher (VERDICT r1 item 7's 'currently disconnected' fix)."""
+
+    def test_picks_microbatches_and_schedule(self):
+        fn, calc = schedules.build_schedule(
+            global_batch_size=64, micro_batch_size=2, data_parallel_size=2,
+            pipeline_model_parallel_size=4)
+        assert calc.get() == 16
+        assert fn is schedules.forward_backward_pipelining_without_interleaving
+
+    def test_interleaved_partial(self):
+        import functools
+
+        fn, calc = schedules.build_schedule(
+            global_batch_size=32, micro_batch_size=2, data_parallel_size=1,
+            pipeline_model_parallel_size=4,
+            virtual_pipeline_model_parallel_size=2)
+        assert isinstance(fn, functools.partial)
+        assert fn.keywords["virtual_chunks"] == 2
+        assert calc.get() == 16
+
+    def test_rejects_underfilled_pipeline(self):
+        with pytest.raises(ValueError, match="cannot fill"):
+            schedules.build_schedule(
+                global_batch_size=8, micro_batch_size=4,
+                data_parallel_size=1, pipeline_model_parallel_size=4)
+
+    def test_end_to_end_with_calculator(self):
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=4)
+        fn, calc = schedules.build_schedule(
+            global_batch_size=8, micro_batch_size=2, data_parallel_size=1,
+            pipeline_model_parallel_size=4)
+        M = calc.get()
+        plist = make_stage_params(jr.fold_in(K, 40), 4)
+        stacked = stack_params(plist)
+        mbs = jr.normal(jr.fold_in(K, 41), (M, 2, HID))
+        tgts = jr.normal(jr.fold_in(K, 42), (M, 2, HID))
+
+        def loss_head(out, tgt):
+            return jnp.mean((out - tgt) ** 2)
+
+        def run(p, m, t):
+            loss, g = fn(stage_fn, loss_head, jax.tree.map(lambda x: x[0], p), m, t)
+            return loss, jax.tree.map(lambda x: x[None], g)
+
+        loss, _ = mesh_lib.shard_map(
+            run, mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P("pp"), stacked), P(), P()),
+            out_specs=(P(), jax.tree.map(lambda _: P("pp"), stacked)),
+        )(stacked, mbs, tgts)
+        assert np.isfinite(float(loss))
