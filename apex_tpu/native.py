@@ -11,7 +11,10 @@ Components:
 * ``plan_layout`` — chunk-layout metadata (apex_C / multi_tensor_apply host
   loop analog, ``csrc/layout_planner.cpp``);
 * ``aggregate_trace`` — profiler record aggregation (pyprof.prof analog,
-  ``csrc/trace_analyzer.cpp``).
+  ``csrc/trace_analyzer.cpp``);
+* ``parse_trace`` — gunzip + parse of ``trace.json.gz`` profiler dumps
+  (pyprof.parse / sqlite analog, ``csrc/trace_parser.cpp``) — the IO stage
+  that dominates post-processing of real multi-MB traces.
 """
 
 from __future__ import annotations
@@ -49,8 +52,16 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.aggregate_trace_json.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
         ]
+        lib.parse_trace_gz.restype = ctypes.c_int64
+        lib.parse_trace_gz.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+        ]
+        lib.free_buffer.restype = None
+        lib.free_buffer.argtypes = [ctypes.POINTER(ctypes.c_char)]
         _lib = lib
-    except OSError:
+    except (OSError, AttributeError):
+        # AttributeError: a stale .so from an older build missing newer
+        # symbols — treat as not built so callers use the Python fallback
         _lib = None
     return _lib
 
@@ -95,6 +106,24 @@ def plan_layout(sizes, chunk_size: int) -> Tuple[np.ndarray, np.ndarray]:
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
     return c2t, offsets
+
+
+def parse_trace(path: str) -> list:
+    """Parse a ``*.trace.json.gz`` profiler dump natively; returns the
+    resolved event list ([{"name","ts","dur","device","track","args"}]).
+    Raises if the native library is absent (callers check
+    :func:`available`) or the file is unreadable/malformed."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library not built; run apex_tpu.native.build()")
+    buf = ctypes.POINTER(ctypes.c_char)()
+    n = lib.parse_trace_gz(path.encode(), ctypes.byref(buf))
+    if n < 0:
+        raise ValueError(f"native trace parse failed for {path!r}")
+    try:
+        return json.loads(ctypes.string_at(buf, n).decode())
+    finally:
+        lib.free_buffer(buf)
 
 
 def aggregate_trace(records_json: str) -> Dict[str, dict]:

@@ -14,10 +14,19 @@ a drop-in spelling for users migrating launch commands.
 
 from __future__ import annotations
 
+import os
 import runpy
 import sys
+import warnings
 
 import jax
+
+# env vars that mean the user explicitly asked for multi-process init — a
+# failure then is a real wiring error and must not be swallowed
+_EXPLICIT_DIST_ENV = (
+    "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+    "JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+)
 
 
 def main(argv=None) -> None:
@@ -27,9 +36,15 @@ def main(argv=None) -> None:
         print(f"devices visible to this process: {jax.device_count()}")
         return
     try:
-        jax.distributed.initialize()  # no-op args on single-host
-    except Exception:
-        pass  # single-host / already initialized: proceed
+        jax.distributed.initialize()  # auto-detects pod coordinates
+    except Exception as e:
+        if any(os.environ.get(k) for k in _EXPLICIT_DIST_ENV):
+            raise  # requested multi-host init failed: fail loudly, don't
+            # run every host as its own single-host world
+        if "already" not in str(e).lower():
+            warnings.warn(
+                f"jax.distributed.initialize() unavailable ({e}); "
+                "running single-host")
     script, sys.argv = argv[0], argv
     runpy.run_path(script, run_name="__main__")
 

@@ -34,10 +34,34 @@ FAMILIES = {
     "custom-call": "custom",
 }
 
+# XLA's own per-op classification as exported in XProf trace event args
+# (``hlo_category``) — authoritative when present; the name-prefix table
+# above is the fallback for traces without it. "convolution fusion" is the
+# TPU label for fusions rooted at a dot/conv, i.e. the MXU work.
+CATEGORY_FAMILIES = {
+    "convolution": "gemm", "convolution fusion": "gemm",
+    "loop fusion": "fusion", "input fusion": "fusion",
+    "output fusion": "fusion", "fusion": "fusion",
+    "custom-call": "custom", "custom fusion": "custom",
+    "non-fusion elementwise": "pointwise",
+    "data formatting": "memory",
+    "copy": "memory", "copy-start": "memory", "copy-done": "memory",
+    "dynamic-update-slice": "memory", "dynamic-slice": "memory",
+    "broadcast": "memory", "slice": "memory", "iota": "memory",
+    "reshape": "memory", "transpose": "memory",
+    "async-start": "async", "async-done": "async", "async": "async",
+    "all-reduce": "collective", "all-gather": "collective",
+    "reduce-scatter": "collective", "collective-permute": "collective",
+    "all-to-all": "collective", "send": "collective", "recv": "collective",
+    "reduce": "reduction", "sort": "sort", "convert": "cast",
+    "while": "control", "conditional": "control", "call": "control",
+}
+
 # container rows span their children on the same trace track; they are
 # reported as their own family but excluded from top-sink rankings to avoid
-# double counting (trace_reader.summarize)
-CONTAINER_FAMILIES = ("control",)
+# double counting (trace_reader.summarize). async-start rows likewise span
+# the wrapped op, which is reported separately.
+CONTAINER_FAMILIES = ("control", "async")
 
 
 @dataclasses.dataclass
@@ -68,8 +92,13 @@ def cost_analysis(fn, *args, **kwargs) -> Dict[str, float]:
     return dict(ca or {})
 
 
-def _family_of(name: str) -> str:
-    # op names from traces carry the named_scope path ("gpt/attn/dot.7");
+def _family_of(name: str, category: str = "") -> str:
+    # XLA's own hlo_category (XProf traces) is authoritative
+    if category:
+        fam = CATEGORY_FAMILIES.get(category.lower())
+        if fam:
+            return fam
+    # fallback: op names carry the named_scope path ("gpt/attn/dot.7");
     # classify on the final HLO segment
     n = name.lower().rsplit("/", 1)[-1]
     for prefix, fam in FAMILIES.items():
@@ -85,7 +114,8 @@ def analyze_ops(ops: Sequence[dict]) -> Dict[str, OpStats]:
     if _native.available() and len(ops) >= 1024:
         agg = _native.aggregate_trace(
             json.dumps([
-                {"f": _family_of(o.get("name", "")), "flops": float(o.get("flops", 0.0)),
+                {"f": _family_of(o.get("name", ""), o.get("category", "")),
+                 "flops": float(o.get("flops", 0.0)),
                  "bytes": float(o.get("bytes", 0.0)), "t": float(o.get("time_s", 0.0))}
                 for o in ops
             ])
@@ -98,7 +128,7 @@ def analyze_ops(ops: Sequence[dict]) -> Dict[str, OpStats]:
 
     acc: Dict[str, List[float]] = defaultdict(lambda: [0, 0.0, 0.0, 0.0])
     for o in ops:
-        fam = _family_of(o.get("name", ""))
+        fam = _family_of(o.get("name", ""), o.get("category", ""))
         a = acc[fam]
         a[0] += 1
         a[1] += float(o.get("flops", 0.0))

@@ -30,6 +30,15 @@ from collections import defaultdict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
+# the arg keys the analysis pipeline consumes; both trace parsers (the
+# native csrc/trace_parser.cpp and the Python fallback) restrict
+# TraceEvent.args to these so behavior doesn't depend on which is built
+WANTED_ARGS = frozenset((
+    "model_flops", "bytes_accessed", "raw_bytes_accessed", "hlo_category",
+    "source", "flops", "bytes", "bytes accessed",
+))
+
+
 @dataclasses.dataclass
 class TraceEvent:
     name: str
@@ -37,7 +46,7 @@ class TraceEvent:
     dur_us: float
     device: str       # e.g. "/device:TPU:0"
     track: str        # e.g. "XLA Ops"
-    args: dict
+    args: dict        # WANTED_ARGS subset of the raw event args
 
 
 def _latest_run_dir(log_dir: str) -> str:
@@ -55,8 +64,27 @@ def _trace_file(run_dir: str) -> str:
 
 
 def read_trace(log_dir: str) -> List[TraceEvent]:
-    """Parse the newest run's chrome trace into device events."""
+    """Parse the newest run's chrome trace into device events.
+
+    IO goes through the native parser (``csrc/trace_parser.cpp``) when
+    built — one C pass replaces gzip+json.load, the dominant cost on real
+    multi-MB traces; the pure-Python path is the fallback."""
     path = _trace_file(_latest_run_dir(log_dir))
+
+    from apex_tpu import native as _native
+    if _native.available():
+        try:
+            return [
+                TraceEvent(
+                    name=e["name"], start_us=e["ts"], dur_us=e["dur"],
+                    device=e["device"], track=e["track"],
+                    args=e.get("args") or {},
+                )
+                for e in _native.parse_trace(path)
+            ]
+        except (ValueError, KeyError):
+            pass  # malformed for the fast path; fall through to Python
+
     with gzip.open(path, "rt") as f:
         data = json.load(f)
     events = data.get("traceEvents", [])
@@ -78,13 +106,14 @@ def read_trace(log_dir: str) -> List[TraceEvent]:
             continue
         pid = e.get("pid")
         dev = procs.get(pid, "")
+        args = e.get("args") or {}
         out.append(TraceEvent(
             name=e.get("name", ""),
             start_us=float(e.get("ts", 0.0)),
             dur_us=float(e.get("dur", 0.0)),
             device=dev,
             track=threads.get((pid, e.get("tid")), ""),
-            args=e.get("args", {}) or {},
+            args={k: v for k, v in args.items() if k in WANTED_ARGS},
         ))
     return out
 
@@ -103,53 +132,135 @@ def _scope_of(name: str) -> str:
     return name.rsplit("/", 1)[0] if "/" in name else ""
 
 
+def _f(args: dict, *keys) -> float:
+    for k in keys:
+        v = args.get(k)
+        if v not in (None, ""):
+            try:
+                return float(v)
+            except (TypeError, ValueError):
+                pass
+    return 0.0
+
+
 def op_records(events: Sequence[TraceEvent]) -> List[dict]:
     """Fold executions into per-op records consumable by ``analyze_ops``.
 
-    Records carry flops/bytes when the trace supplies them in event args
-    (XProf exports them for some platforms; 0 otherwise — the family table
-    then reports time only).
+    XProf device events carry XLA's own per-op cost model in args —
+    ``model_flops``, ``bytes_accessed``, ``hlo_category``, and the Python
+    ``source`` line the HLO was traced from (the correlation pyprof does
+    with a database join, ``apex/pyprof/parse/db.py``). Plain traces
+    without those keys still aggregate by name/time.
     """
-    acc: Dict[str, List[float]] = defaultdict(lambda: [0.0, 0.0, 0.0, 0.0])
+    acc: Dict[str, List] = defaultdict(lambda: [0.0, 0.0, 0.0, 0.0, "", ""])
     for e in device_op_events(events):
         a = acc[e.name]
         a[0] += 1
         a[1] += e.dur_us / 1e6
-        a[2] += float(e.args.get("flops", 0) or 0)
-        a[3] += float(e.args.get("bytes accessed", e.args.get("bytes", 0)) or 0)
+        a[2] += _f(e.args, "model_flops", "flops")
+        a[3] += _f(e.args, "bytes_accessed", "raw_bytes_accessed",
+                   "bytes accessed", "bytes")
+        a[4] = a[4] or str(e.args.get("hlo_category", "") or "")
+        a[5] = a[5] or str(e.args.get("source", "") or "")
     return [
         {"name": name, "count": int(c), "time_s": t, "flops": f, "bytes": b,
-         "scope": _scope_of(name)}
-        for name, (c, t, f, b) in acc.items()
+         "scope": _scope_of(name), "category": cat, "source": src}
+        for name, (c, t, f, b, cat, src) in acc.items()
     ]
 
 
-def summarize(log_dir: str, top: int = 5) -> Tuple[List[dict], Dict[str, "OpStats"]]:
-    """(top-K time sinks, per-family stats) for the newest run. Container
-    rows (while/conditional bodies, which span their children on the same
+def by_source(recs: Sequence[dict]) -> List[dict]:
+    """Roll device time up to the Python source line that emitted the HLO —
+    model-code attribution (the reference gets this from NVTX call-site
+    JSON, ``apex/pyprof/nvtx/nvmarker.py``). Records without a source
+    (renamed/fused away) aggregate under ``""`` and are dropped. Container
+    rows (while/conditional bodies, async wrappers) span their children and
+    are excluded — they would otherwise double-count the whole loop body
+    onto the ``lax.scan`` call site."""
+    from apex_tpu.prof.analyzer import CONTAINER_FAMILIES, _family_of
+
+    acc: Dict[str, List[float]] = defaultdict(lambda: [0.0, 0.0, 0.0, 0.0])
+    for r in recs:
+        src = r.get("source", "")
+        if not src:
+            continue
+        if _family_of(r["name"], r.get("category", "")) in CONTAINER_FAMILIES:
+            continue
+        a = acc[src]
+        a[0] += r["count"]
+        a[1] += r["time_s"]
+        a[2] += r.get("flops", 0.0)
+        a[3] += r.get("bytes", 0.0)
+    out = [
+        {"source": s, "count": int(c), "time_s": t, "flops": f, "bytes": b}
+        for s, (c, t, f, b) in acc.items()
+    ]
+    out.sort(key=lambda r: -r["time_s"])
+    return out
+
+
+def _analyze_run(log_dir: str):
+    """(all records by time desc, non-container sinks, per-family stats)
+    — the shared core of summarize/format_report. Container rows
+    (while/conditional bodies, which span their children on the same
     track) are excluded from the sink ranking to avoid double counting."""
-    from apex_tpu.prof.analyzer import CONTAINER_FAMILIES, _family_of, analyze_ops
+    from apex_tpu.prof.analyzer import (CONTAINER_FAMILIES, _family_of,
+                                        analyze_ops)
 
     recs = op_records(read_trace(log_dir))
     recs.sort(key=lambda r: -r["time_s"])
     fams = analyze_ops(recs)
     sinks = [r for r in recs
-             if _family_of(r["name"]) not in CONTAINER_FAMILIES]
+             if _family_of(r["name"], r.get("category", ""))
+             not in CONTAINER_FAMILIES]
+    return recs, sinks, fams
+
+
+def summarize(log_dir: str, top: int = 5) -> Tuple[List[dict], Dict[str, "OpStats"]]:
+    """(top-K time sinks, per-family stats) for the newest run."""
+    _, sinks, fams = _analyze_run(log_dir)
     return sinks[:top], fams
 
 
 def format_report(log_dir: str, top: int = 5) -> str:
-    """pyprof.prof-style text report: top time sinks + family roofline."""
-    from apex_tpu.prof.analyzer import report
+    """pyprof.prof-style text report: top time sinks (with the Python
+    source line each HLO traces to), top source-line rollup, and the
+    per-family roofline table."""
+    from apex_tpu.prof.analyzer import CONTAINER_FAMILIES, report
 
-    sinks, fams = summarize(log_dir, top)
+    recs, sinks, fams = _analyze_run(log_dir)
+    if not recs:
+        return ("no per-HLO device events in trace — the CPU backend "
+                "exports host events only; capture on TPU/GPU for op-level "
+                "analysis")
+    sinks = sinks[:top]
     lines = [f"top {len(sinks)} device time sinks:"]
-    total = sum(s.time_s for s in fams.values()) or 1.0
+    total = sum(s.time_s for f, s in fams.items()
+                if f not in CONTAINER_FAMILIES) or 1.0
     for r in sinks:
+        src = r.get("source", "")
+        src = f"  [{_short_source(src)}]" if src else ""
         lines.append(
             f"  {r['time_s']*1e3:9.3f} ms  {100*r['time_s']/total:5.1f}%  "
-            f"x{r['count']:<5d} {r['name'][:90]}"
+            f"x{r['count']:<5d} {r['name'][:70]}{src}"
         )
+    srcs = [r for r in by_source(recs) if r["source"]][:top]
+    if srcs:
+        lines.append("")
+        lines.append(f"top {len(srcs)} source lines by device time:")
+        for r in srcs:
+            lines.append(
+                f"  {r['time_s']*1e3:9.3f} ms  {100*r['time_s']/total:5.1f}%  "
+                f"{_short_source(r['source'])}"
+            )
     lines.append("")
     lines.append(report(fams))
     return "\n".join(lines)
+
+
+def _short_source(src: str) -> str:
+    """/abs/path/pkg/mod.py:12 -> pkg/mod.py:12 (last two path segments)."""
+    head, _, line = src.rpartition(":")
+    parts = (head or src).split(os.sep)
+    short = os.sep.join(parts[-2:])
+    return f"{short}:{line}" if head else short

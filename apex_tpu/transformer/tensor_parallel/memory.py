@@ -50,8 +50,23 @@ class MemoryBuffer:
         return self.data.shape[0]
 
     def add(self, tensor: jax.Array) -> Tuple["MemoryBuffer", jax.Array]:
-        """Copy ``tensor`` into the buffer; returns (buffer', offset)."""
+        """Copy ``tensor`` into the buffer; returns (buffer', offset).
+
+        Overflow raises when the offset is concrete (eager / top of jit,
+        mirroring the reference's ``assert`` on double allocation). Under a
+        traced offset (inside scan) the caller must size the buffer
+        statically — ``dynamic_update_slice`` would clamp the start index
+        and silently corrupt earlier entries."""
         flat = tensor.reshape(-1).astype(self.data.dtype)
+        if not isinstance(self.start, jax.core.Tracer):
+            if int(self.start) + flat.shape[0] > self.numel:
+                raise ValueError(
+                    f"MemoryBuffer overflow: offset {int(self.start)} + "
+                    f"{flat.shape[0]} elements > capacity {self.numel}")
+        elif flat.shape[0] > self.numel:
+            raise ValueError(
+                f"MemoryBuffer overflow: tensor of {flat.shape[0]} elements "
+                f"can never fit capacity {self.numel}")
         data = jax.lax.dynamic_update_slice(self.data, flat, (self.start,))
         offset = self.start
         return dataclasses.replace(

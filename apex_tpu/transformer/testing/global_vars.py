@@ -91,3 +91,7 @@ def destroy_global_vars() -> None:
     _GLOBAL_TIMERS = None
     _GLOBAL_TENSORBOARD_WRITER = None
     _args_mod.set_args(None)
+    # the calculator set_global_variables installed is global state too —
+    # leaving it populated would let "destroyed" state answer
+    # get_num_microbatches() with a stale value
+    _mb._CALCULATOR = None

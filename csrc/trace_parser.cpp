@@ -1,0 +1,332 @@
+// Gzipped chrome-trace parser — the native IO stage of the profiler
+// pipeline.
+//
+// The reference's parse stage reads the nvprof SQLite database in C
+// (sqlite3 via apex/pyprof/parse/db.py); the TPU trace artifact is the
+// multi-megabyte trace.json.gz that jax.profiler writes. Loading that
+// through Python's json module dominates post-processing time for real
+// traces, so this file does the whole IO stage natively: gunzip (zlib),
+// scan the JSON event stream, resolve process/thread metadata, and emit
+// only the compact per-event records apex_tpu.prof.trace_reader needs
+// (name/ts/dur/device/track + the XProf cost-model args).
+//
+// Exposed C ABI (ctypes):
+//   parse_trace_gz(path, &out) -> bytes written (malloc'd; -1 on error)
+//   free_buffer(out)
+//
+// Output JSON: [{"name":..,"ts":..,"dur":..,"device":..,"track":..,
+//                "args":{subset}}, ...]
+
+#include <zlib.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- gunzip
+
+bool read_gz(const char* path, std::string* out) {
+  gzFile f = gzopen(path, "rb");
+  if (!f) return false;
+  char buf[1 << 16];
+  int n;
+  while ((n = gzread(f, buf, sizeof(buf))) > 0) out->append(buf, n);
+  bool ok = (n == 0);
+  gzclose(f);
+  return ok;
+}
+
+// ------------------------------------------------- minimal JSON parser
+// Full-fidelity scanning parser for the subset of JSON that chrome traces
+// use; values we don't need are skipped without materialization.
+
+struct Parser {
+  const char* p;
+  const char* end;
+
+  explicit Parser(const std::string& s) : p(s.data()), end(s.data() + s.size()) {}
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool eat(char c) {
+    ws();
+    if (p < end && *p == c) { ++p; return true; }
+    return false;
+  }
+  char peek() {
+    ws();
+    return p < end ? *p : '\0';
+  }
+
+  // parse a JSON string into out (unescaped)
+  bool string(std::string* out) {
+    ws();
+    if (p >= end || *p != '"') return false;
+    ++p;
+    out->clear();
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': out->push_back('\n'); break;
+          case 't': out->push_back('\t'); break;
+          case 'r': out->push_back('\r'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'u': {
+            // keep BMP escapes as '?' placeholders — names we care about
+            // are ASCII; fidelity here doesn't affect aggregation
+            if (end - p >= 5) p += 4;
+            out->push_back('?');
+            break;
+          }
+          default: out->push_back(*p); break;
+        }
+        ++p;
+      } else {
+        out->push_back(*p++);
+      }
+    }
+    if (p >= end) return false;
+    ++p;  // closing quote
+    return true;
+  }
+
+  bool number(double* out) {
+    ws();
+    char* e = nullptr;
+    *out = strtod(p, &e);
+    if (e == p) return false;
+    p = e;
+    return true;
+  }
+
+  // skip any JSON value
+  bool skip() {
+    ws();
+    if (p >= end) return false;
+    switch (*p) {
+      case '"': { std::string s; return string(&s); }
+      case '{': {
+        ++p;
+        if (eat('}')) return true;
+        while (true) {
+          std::string k;
+          if (!string(&k) || !eat(':') || !skip()) return false;
+          if (eat('}')) return true;
+          if (!eat(',')) return false;
+        }
+      }
+      case '[': {
+        ++p;
+        if (eat(']')) return true;
+        while (true) {
+          if (!skip()) return false;
+          if (eat(']')) return true;
+          if (!eat(',')) return false;
+        }
+      }
+      case 't': p += 4; return p <= end;
+      case 'f': p += 5; return p <= end;
+      case 'n': p += 4; return p <= end;
+      default: { double d; return number(&d); }
+    }
+  }
+};
+
+// ------------------------------------------------------------- emitter
+
+void json_escape(const std::string& s, std::string* out) {
+  for (char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\t': *out += "\\t"; break;
+      case '\r': *out += "\\r"; break;
+      default:
+        if ((unsigned char)c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(c);
+        }
+    }
+  }
+}
+
+// the XProf args the analyzer consumes (analyzer.py / trace_reader.py)
+bool wanted_arg(const std::string& k) {
+  return k == "model_flops" || k == "bytes_accessed" ||
+         k == "raw_bytes_accessed" || k == "hlo_category" || k == "source" ||
+         k == "flops" || k == "bytes" || k == "bytes accessed";
+}
+
+struct Event {
+  std::string name;
+  double ts = 0, dur = 0;
+  int64_t pid = -1, tid = -1;
+  std::string args_json;  // pre-serialized subset
+};
+
+}  // namespace
+
+extern "C" {
+
+void free_buffer(char* buf) { free(buf); }
+
+int64_t parse_trace_gz(const char* path, char** out_buf) {
+  std::string raw;
+  if (!read_gz(path, &raw)) return -1;
+
+  Parser ps(raw);
+  std::vector<Event> events;
+  std::map<int64_t, std::string> procs;
+  std::map<std::pair<int64_t, int64_t>, std::string> threads;
+
+  // top level: {"traceEvents": [...], ...}
+  if (!ps.eat('{')) return -1;
+  bool found = false;
+  while (!found) {
+    std::string key;
+    if (!ps.string(&key) || !ps.eat(':')) return -1;
+    if (key == "traceEvents") {
+      found = true;
+      break;
+    }
+    if (!ps.skip()) return -1;
+    if (!ps.eat(',')) return -1;  // traceEvents must still be ahead
+  }
+  if (!ps.eat('[')) return -1;
+
+  if (ps.peek() != ']') {
+    do {
+      if (!ps.eat('{')) return -1;
+      Event ev;
+      std::string ph, meta_name, meta_arg_name;
+      bool have_args = false;
+      if (ps.peek() != '}') {
+        do {
+          std::string key;
+          if (!ps.string(&key) || !ps.eat(':')) return -1;
+          if (key == "ph") {
+            if (!ps.string(&ph)) return -1;
+          } else if (key == "name") {
+            if (!ps.string(&ev.name)) return -1;
+          } else if (key == "ts") {
+            if (!ps.number(&ev.ts)) return -1;
+          } else if (key == "dur") {
+            if (!ps.number(&ev.dur)) return -1;
+          } else if (key == "pid" || key == "tid") {
+            double d;
+            if (!ps.number(&d)) return -1;
+            (key == "pid" ? ev.pid : ev.tid) = (int64_t)d;
+          } else if (key == "args") {
+            // inline-parse the args object, keeping wanted keys
+            have_args = true;
+            if (!ps.eat('{')) { if (!ps.skip()) return -1; }
+            else if (ps.peek() == '}') { ps.eat('}'); }
+            else {
+              std::string acc;
+              do {
+                std::string ak;
+                if (!ps.string(&ak) || !ps.eat(':')) return -1;
+                if (ak == "name") {  // metadata payload
+                  if (ps.peek() == '"') {
+                    if (!ps.string(&meta_arg_name)) return -1;
+                  } else if (!ps.skip()) return -1;
+                } else if (wanted_arg(ak)) {
+                  std::string sval;
+                  double dval;
+                  if (ps.peek() == '"') {
+                    if (!ps.string(&sval)) return -1;
+                    if (!acc.empty()) acc += ",";
+                    acc += "\"";
+                    json_escape(ak, &acc);
+                    acc += "\":\"";
+                    json_escape(sval, &acc);
+                    acc += "\"";
+                  } else if (ps.peek() == '{' || ps.peek() == '[' ||
+                             ps.peek() == 't' || ps.peek() == 'f' ||
+                             ps.peek() == 'n') {
+                    if (!ps.skip()) return -1;
+                  } else {
+                    if (!ps.number(&dval)) return -1;
+                    char buf[40];
+                    snprintf(buf, sizeof(buf), "%.17g", dval);
+                    if (!acc.empty()) acc += ",";
+                    acc += "\"";
+                    json_escape(ak, &acc);
+                    acc += "\":";
+                    acc += buf;
+                  }
+                } else {
+                  if (!ps.skip()) return -1;
+                }
+              } while (ps.eat(','));
+              if (!ps.eat('}')) return -1;
+              ev.args_json = "{" + acc + "}";
+            }
+          } else {
+            if (!ps.skip()) return -1;
+          }
+        } while (ps.eat(','));
+      }
+      if (!ps.eat('}')) return -1;
+
+      if (ph == "M") {
+        if (ev.name == "process_name" && ev.pid >= 0)
+          procs[ev.pid] = meta_arg_name;
+        else if (ev.name == "thread_name" && ev.pid >= 0)
+          threads[{ev.pid, ev.tid}] = meta_arg_name;
+      } else if (ph == "X") {
+        if (!have_args || ev.args_json.empty()) ev.args_json = "{}";
+        events.push_back(std::move(ev));
+      }
+    } while (ps.eat(','));
+  }
+  if (!ps.eat(']')) return -1;
+
+  // resolve + emit
+  std::string out = "[";
+  char buf[64];
+  for (size_t i = 0; i < events.size(); ++i) {
+    const Event& ev = events[i];
+    if (i) out += ",";
+    out += "{\"name\":\"";
+    json_escape(ev.name, &out);
+    out += "\",\"ts\":";
+    snprintf(buf, sizeof(buf), "%.17g", ev.ts);
+    out += buf;
+    out += ",\"dur\":";
+    snprintf(buf, sizeof(buf), "%.17g", ev.dur);
+    out += buf;
+    out += ",\"device\":\"";
+    auto pit = procs.find(ev.pid);
+    if (pit != procs.end()) json_escape(pit->second, &out);
+    out += "\",\"track\":\"";
+    auto tit = threads.find({ev.pid, ev.tid});
+    if (tit != threads.end()) json_escape(tit->second, &out);
+    out += "\",\"args\":";
+    out += ev.args_json;
+    out += "}";
+  }
+  out += "]";
+
+  char* mem = (char*)malloc(out.size() + 1);
+  if (!mem) return -1;
+  memcpy(mem, out.c_str(), out.size() + 1);
+  *out_buf = mem;
+  return (int64_t)out.size();
+}
+
+}  // extern "C"

@@ -339,6 +339,14 @@ class TestMemoryBuffer:
         assert int(buf.start) == 0
         assert buf.numel == 64  # storage retained
 
+    def test_overflow_raises_eagerly(self):
+        from apex_tpu.transformer.tensor_parallel.memory import MemoryBuffer
+
+        buf = MemoryBuffer.create(8)
+        buf, _ = buf.add(jnp.ones((6,), jnp.float32))
+        with pytest.raises(ValueError, match="overflow"):
+            buf.add(jnp.ones((6,), jnp.float32))  # 6 + 6 > 8
+
     def test_buffer_works_under_jit_and_scan(self):
         from apex_tpu.transformer.tensor_parallel.memory import MemoryBuffer
 

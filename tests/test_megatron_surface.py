@@ -43,6 +43,17 @@ class TestGlobalVars:
         with pytest.raises(RuntimeError):
             global_vars.get_timers()
 
+    def test_destroy_resets_microbatch_calculator(self):
+        global_vars.set_global_variables(args_list=BASE + [
+            "--micro-batch-size", "2", "--global-batch-size", "16",
+            "--world-size", "8",
+        ])
+        assert global_vars.get_num_microbatches() == 1
+        global_vars.destroy_global_vars()
+        # destroyed state must not answer with a stale calculator
+        with pytest.raises(RuntimeError):
+            global_vars.get_num_microbatches()
+
 
 class TestDynamicBatchSize:
     """``run_dynamic_batchsize_test.py``: with --rampup-batch-size the
