@@ -38,6 +38,14 @@ def pallas_enabled() -> bool:
     return os.environ.get(_ENV, "1") != "0"
 
 
+def interpret_forced() -> bool:
+    """True when the test suite forces interpret-mode kernels everywhere
+    (``APEX_TPU_PALLAS=interpret``) — ops whose ``auto`` resolves to the XLA
+    composition on measured grounds still take the kernel path then, so the
+    kernel code stays covered off-TPU."""
+    return os.environ.get(_ENV, "") == "interpret"
+
+
 def choose_impl(impl: str, shapes_ok: bool) -> str:
     """Resolve an ``impl`` argument to 'pallas' or 'xla'."""
     if impl not in ("auto", "pallas", "xla"):

@@ -1,16 +1,20 @@
-"""Flash attention (functional) + ring attention for context parallelism.
+"""Flash attention (functional) + ring / Ulysses sequence parallelism.
 
 ``flash_attention`` supersedes the reference's ``apex.contrib.fmha``
 (``apex/contrib/fmha/fmha.py:33-76``: fp16, seq≤512 only) and the fused MHA
 cores of ``apex.contrib.multihead_attn``: one blockwise kernel, any length,
 causal or full, bf16/fp32.
 
-``ring_attention`` is the long-context capability the reference lacks
-entirely (SURVEY.md §5 "Long-context: not present"): Q/K/V are sharded over
-the ``cp`` mesh axis along sequence; KV shards rotate around the ring via
-``ppermute`` while each device folds incoming blocks into the online-softmax
-state. Communication hides behind the per-step attention compute (the
-ring-attention formulation of Liu et al.; blockwise core shared with flash).
+``ring_attention`` and ``ulysses_attention`` are the long-context
+capabilities the reference lacks entirely (SURVEY.md §5 "Long-context: not
+present"; §2.3 lists both CP and Ulysses as absent strategies). Ring: Q/K/V
+sharded over the ``cp`` mesh axis along sequence; KV shards rotate via
+``ppermute`` while each device folds incoming blocks into the
+online-softmax state — O(s_local) memory, comm hidden behind per-step
+compute. Ulysses: two ``all_to_all``s swap sequence sharding for head
+sharding so each device runs *unmodified* flash attention over the full
+sequence for its head subset — cheaper comm than ring when heads ≥ devices
+(2 all-to-alls of the activations vs cp rotations of KV).
 """
 
 from __future__ import annotations
@@ -99,13 +103,16 @@ def flash_attention(
     HALF-class under O1 (attention is matmul-shaped; the in-kernel softmax
     accumulates fp32 regardless).
 
-    ``impl='auto'`` picks the Pallas kernel only from seq >= 4096: below
-    that, XLA's batched-matmul composition of the same math (still
+    ``impl='auto'`` picks the Pallas kernel from seq >= 1024: below that the
+    grid/launch overhead outweighs the saved score-tensor HBM traffic and
+    XLA's batched-matmul composition of the same math (still
     recompute-in-backward via this function's custom_vjp — O(s) residuals)
-    is faster on v5e-class chips; above it, the materialized (s, s) score
-    tensors XLA streams through HBM dominate and the kernel wins. Measured
-    fwd+bwd on v5e (ms, pallas vs xla): S=1024 16.0/10.2, S=2048 14.9/13.1,
-    S=4096 11.0/14.1, S=8192 14.8/17.3."""
+    is faster on v5e-class chips. Measured end-to-end on the GPT-medium
+    train step (v5e, S=1024, bh=256, d=64): pallas 248.7 ms/step vs xla
+    264.6 — isolated-kernel timings through the remote tunnel had
+    previously suggested a 4096 crossover, but the full-step measurement
+    (where the kernel competes with everything else for HBM) is the one
+    that matters."""
     q, k, v = apply_op_rules("attention", q, k, v)
     d = q.shape[-1]
     scale = float(scale if scale is not None else 1.0 / d ** 0.5)
@@ -117,7 +124,7 @@ def flash_attention(
         q3.shape[-2] % 128 == 0 and k3.shape[-2] % 128 == 0
         and (d % 128 == 0 or d == 64)
     )
-    if impl == "auto" and k3.shape[-2] < 4096:
+    if impl == "auto" and k3.shape[-2] < 1024:
         impl = "xla"
     use_pallas = _backend.choose_impl(impl, ok) == "pallas"
     o = _flash_core(q3, k3, v3, scale, causal, use_pallas)
@@ -190,3 +197,50 @@ def ring_attention(
     )
     (m_acc, l_acc, o_acc, _, _), _ = jax.lax.scan(step, init, None, length=cp)
     return (o_acc / jnp.maximum(l_acc, 1e-30)).astype(q.dtype)
+
+
+# --- Ulysses attention (all-to-all sequence parallel) -------------------------
+
+def ulysses_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *, axis_name: str = mesh_lib.CONTEXT_AXIS, causal: bool = False,
+    scale: Optional[float] = None, impl: str = "auto",
+) -> jax.Array:
+    """DeepSpeed-Ulysses-style sequence parallelism: q/k/v are this device's
+    (batch, s_local, heads, head_dim) sequence shard with ALL heads; an
+    ``all_to_all`` re-shards heads over ``axis_name`` while gathering the
+    full sequence, unmodified :func:`flash_attention` runs per local head
+    group, and a reverse ``all_to_all`` restores sequence sharding.
+
+    Must run inside shard_map with the axis bound; requires
+    ``heads % axis_size == 0``. Complements :func:`ring_attention`: Ulysses
+    moves activations twice (cheap when heads >= devices, and each device
+    sees the full sequence so any attention variant drops in); ring never
+    materializes the full sequence on one device (memory-optimal, arbitrary
+    cp). Backward is the transposed all-to-alls around flash's custom VJP —
+    no hand-written grad needed.
+    """
+    sp = jax.lax.axis_size(axis_name)
+    b, s_local, h, d = q.shape
+    if h % sp != 0:
+        raise ValueError(
+            f"ulysses_attention needs heads ({h}) divisible by the "
+            f"{axis_name!r} axis size ({sp}); use ring_attention otherwise")
+
+    # (b, s/P, h, d) -> (b, s, h/P, d): scatter heads, gather sequence
+    def seq_to_head(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qg, kg, vg = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    s, h_loc = qg.shape[1], qg.shape[2]
+
+    def to_bh(x):  # (b, s, h_loc, d) -> (b*h_loc, s, d)
+        return x.transpose(0, 2, 1, 3).reshape(b * h_loc, s, d)
+
+    o = flash_attention(to_bh(qg), to_bh(kg), to_bh(vg),
+                        causal=causal, scale=scale, impl=impl)
+    o = o.reshape(b, h_loc, s, d).transpose(0, 2, 1, 3)
+    # (b, s, h/P, d) -> (b, s/P, h, d): gather heads, re-scatter sequence
+    return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)

@@ -31,7 +31,11 @@ def _mm(x, w, b=None, activation="none", use_pallas=False, out_dtype=None):
             x, w, b, activation=activation, out_dtype=out_dtype,
             interpret=_backend.interpret_mode(),
         )
-    r = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    # no preferred_element_type=f32: the MXU accumulates bf16 dots in fp32
+    # regardless, and forcing an f32 *output* doubles HBM traffic on every
+    # intermediate (measured 0.65x vs stock jnp on the DenseGeluDense
+    # microbench before this change)
+    r = jnp.dot(x, w)
     if b is not None:
         r = r + b
     if activation == "gelu":
@@ -142,6 +146,14 @@ def fused_dense_gelu_dense(
 def _choose(impl: str, x, w) -> bool:
     # pallas path needs lane-aligned contraction/output dims
     ok = x.shape[-1] % 128 == 0 and w.shape[0] % 128 == 0
+    # auto == xla here: XLA's native dot outruns the Pallas matmul on every
+    # measured dense shape (tools/microbench.py, v5e: pallas 0.031 ms vs xla
+    # 0.023 ms on 2k x 1024x4096 fwd+bwd) — the fused-dense win is the
+    # custom_vjp epilogue/recompute structure, which both impls share. The
+    # kernel stays reachable via impl='pallas' (and the env force) for
+    # shapes XLA tiles badly.
+    if impl == "auto" and not _backend.interpret_forced():
+        impl = "xla"
     return _backend.choose_impl(impl, ok) == "pallas"
 
 

@@ -9,4 +9,5 @@ tensor + pipeline parallel layers and schedules built on one
 from apex_tpu.parallel import mesh as parallel_state  # noqa: F401
 from apex_tpu.transformer import tensor_parallel  # noqa: F401
 from apex_tpu.transformer import pipeline_parallel  # noqa: F401
+from apex_tpu.transformer import moe  # noqa: F401
 from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType, ModelType  # noqa: F401

@@ -1,0 +1,178 @@
+"""Mixture-of-experts FFN with expert parallelism (TPU-first extension).
+
+The reference has no MoE (SURVEY.md §2.3 lists EP as an absent strategy);
+this fills the gap the TPU way: GShard/Switch-style static-capacity routing
+expressed as einsums (XLA sees only fixed shapes — no ragged dispatch), with
+experts sharded over a mesh axis and tokens exchanged by two
+``lax.all_to_all``s, the same pattern Ulysses attention uses for heads.
+
+Components:
+* :func:`router_topk` — softmax gate + iterative top-k slot assignment with
+  per-expert capacity, returning dense (tokens, E, C) dispatch/combine
+  tensors; overflowing tokens are dropped (zero combine weight), underfull
+  slots are zero-padded — both static-shape-friendly.
+* :class:`MoEMLP` — per-expert two-layer FFN over the dispatched
+  (E, C, d) blocks; batched einsum keeps every expert's GEMM on the MXU.
+* :func:`moe_layer` — dispatch → (optional expert-parallel all_to_all) →
+  experts → reverse all_to_all → combine; returns the output and the
+  auxiliary losses (Switch load-balance, router z-loss).
+
+Expert parallelism: run inside ``shard_map`` with ``axis_name`` bound (the
+``dp`` axis by default — expert parallelism folds over data parallelism,
+``apex_tpu.parallel.mesh.EXPERT_AXIS`` note). Each device hosts
+``E // axis_size`` experts; the first all_to_all routes every device's
+dispatched blocks to the experts' owners, the second routes results back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def router_topk(
+    logits: jax.Array,
+    capacity: int,
+    k: int = 2,
+    *,
+    normalize_gates: bool = True,
+) -> Tuple[jax.Array, jax.Array, dict]:
+    """Top-k token→expert assignment with capacity.
+
+    ``logits``: (T, E). Returns ``(dispatch, combine, aux)`` where
+    ``dispatch`` is a one-hot (T, E, C) routing tensor, ``combine`` the
+    gate-weighted version used to merge expert outputs, and ``aux`` carries
+    ``load_balance_loss`` (Switch-style: E · Σ_e fraction_e · mean-gate_e,
+    1.0 at uniform routing) and ``router_z_loss``.
+
+    Slot assignment is k rounds of argmax with chosen gates masked out;
+    within a round, tokens claim expert slots in token order (cumsum), and a
+    token whose expert is full is dropped for that round. All shapes static.
+    """
+    T, E = logits.shape
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    remaining = gates
+    counts = jnp.zeros((E,), jnp.int32)
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    gate_sum = jnp.zeros((T,), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    first_choice = None
+
+    for _ in range(k):
+        choice = jnp.argmax(remaining, axis=-1)                    # (T,)
+        onehot = jax.nn.one_hot(choice, E, dtype=jnp.float32)      # (T, E)
+        if first_choice is None:
+            first_choice = onehot
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) + counts[None, :]  # (T, E)
+        slot = jnp.sum(pos * onehot, axis=-1)                      # (T,)
+        fits = slot < capacity
+        slot_oh = jax.nn.one_hot(jnp.where(fits, slot, capacity).astype(jnp.int32),
+                                 capacity, dtype=jnp.float32)      # (T, C) 0 row if dropped
+        d = onehot[:, :, None] * slot_oh[:, None, :]               # (T, E, C)
+        gate_val = jnp.sum(gates * onehot, axis=-1) * fits         # (T,)
+        dispatch = dispatch + d
+        combine = combine + gate_val[:, None, None] * d
+        gate_sum = gate_sum + gate_val
+        counts = counts + jnp.sum(onehot * fits[:, None], axis=0).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)                     # mask chosen
+
+    if normalize_gates:
+        combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
+
+    # Switch load balance over the FIRST choice (the dominant assignment):
+    # fraction of tokens routed to e x mean router prob for e, scaled by E.
+    frac = jnp.mean(first_choice, axis=0)
+    prob = jnp.mean(gates, axis=0)
+    aux = {
+        "load_balance_loss": E * jnp.sum(frac * prob),
+        "router_z_loss": jnp.mean(jax.nn.logsumexp(
+            logits.astype(jnp.float32), axis=-1) ** 2),
+    }
+    return dispatch, combine, aux
+
+
+@dataclasses.dataclass
+class MoEMLP:
+    """Per-expert FFN bank (num_experts_local, hidden, ffn) — GEMMs stay
+    batched over experts so the MXU sees (E·C, hidden) x (hidden, ffn)."""
+
+    num_experts: int
+    hidden: int
+    ffn: int
+
+    def init(self, key, dtype=jnp.float32):
+        k1, k2, k3 = jax.random.split(key, 3)
+        s1 = (2.0 / self.hidden) ** 0.5
+        s2 = (2.0 / self.ffn) ** 0.5
+        return {
+            "router": jax.random.normal(k3, (self.hidden, self.num_experts), dtype) * 0.02,
+            "w1": jax.random.normal(k1, (self.num_experts, self.hidden, self.ffn), dtype) * s1,
+            "b1": jnp.zeros((self.num_experts, self.ffn), dtype),
+            "w2": jax.random.normal(k2, (self.num_experts, self.ffn, self.hidden), dtype) * s2,
+            "b2": jnp.zeros((self.num_experts, self.hidden), dtype),
+        }
+
+
+def _expert_ffn(params, x_ecd):
+    """(E_local, C', d) through each expert's two-layer GELU FFN."""
+    h = jnp.einsum("ecd,edf->ecf", x_ecd, params["w1"]) + params["b1"][:, None, :]
+    h = jax.nn.gelu(h, approximate=True)
+    return jnp.einsum("ecf,efd->ecd", h, params["w2"]) + params["b2"][:, None, :]
+
+
+def moe_layer(
+    params: dict,
+    x: jax.Array,
+    *,
+    k: int = 2,
+    capacity_factor: float = 1.25,
+    axis_name: Optional[str] = None,
+    normalize_gates: bool = True,
+) -> Tuple[jax.Array, dict]:
+    """MoE FFN over ``x`` (..., hidden); returns (y, aux_losses).
+
+    With ``axis_name`` (inside shard_map): experts are sharded over the
+    axis — ``params['w1']`` etc. hold this device's ``E_local`` experts and
+    the router logits cover all ``E_local · axis_size`` experts. Dispatched
+    blocks take one ``all_to_all`` to the expert owners and one back.
+    """
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    xt = x.reshape(-1, d)
+    T = xt.shape[0]
+
+    ep = jax.lax.axis_size(axis_name) if axis_name else 1
+    e_local = params["w1"].shape[0]
+    E = e_local * ep
+    if params["router"].shape[-1] != E:
+        raise ValueError(
+            f"router covers {params['router'].shape[-1]} experts but the "
+            f"expert bank holds {e_local} x axis size {ep} = {E}")
+    capacity = max(1, int(capacity_factor * k * T / E))
+
+    logits = xt.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    dispatch, combine, aux = router_topk(
+        logits, capacity, k, normalize_gates=normalize_gates)
+
+    expert_in = jnp.einsum("td,tec->ecd", xt, dispatch.astype(xt.dtype))  # (E, C, d)
+
+    if axis_name:
+        # (E, C, d) -> (ep, e_local, C, d) -> a2a -> (e_local, ep*C, d):
+        # each device gathers every peer's blocks for ITS experts
+        blocks = expert_in.reshape(ep, e_local, capacity, d)
+        blocks = jax.lax.all_to_all(blocks, axis_name, split_axis=0,
+                                    concat_axis=2, tiled=True)
+        out = _expert_ffn(params, blocks.reshape(e_local, ep * capacity, d))
+        out = out.reshape(1, e_local, ep * capacity, d)
+        out = jax.lax.all_to_all(out, axis_name, split_axis=2,
+                                 concat_axis=0, tiled=True)
+        expert_out = out.reshape(E, capacity, d)
+    else:
+        expert_out = _expert_ffn(params, expert_in)
+
+    y = jnp.einsum("ecd,tec->td", expert_out, combine.astype(xt.dtype))
+    return y.reshape(*lead, d), aux

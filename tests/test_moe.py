@@ -1,0 +1,115 @@
+"""MoE + expert parallelism tests (TPU-first extension; the reference has no
+MoE — SURVEY.md §2.3 EP row)."""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel import mesh as mesh_lib
+from apex_tpu.transformer.moe import MoEMLP, moe_layer, router_topk
+
+K = jr.PRNGKey(77)
+
+
+class TestRouter:
+    def test_topk_dispatch_shapes_and_onehot(self):
+        logits = jr.normal(K, (16, 4))
+        dispatch, combine, aux = router_topk(logits, capacity=8, k=2)
+        assert dispatch.shape == (16, 4, 8)
+        # every token claims at most k slots, one-hot per (expert, slot)
+        assert float(jnp.max(dispatch)) == 1.0
+        per_token = jnp.sum(dispatch, axis=(1, 2))
+        assert float(jnp.max(per_token)) <= 2.0
+        # no expert slot double-claimed
+        per_slot = jnp.sum(dispatch, axis=0)
+        assert float(jnp.max(per_slot)) <= 1.0
+
+    def test_uniform_router_balance_loss_is_one(self):
+        logits = jnp.zeros((64, 8))
+        _, _, aux = router_topk(logits, capacity=16, k=1)
+        np.testing.assert_allclose(float(aux["load_balance_loss"]), 1.0, rtol=1e-5)
+
+    def test_capacity_drops_overflow(self):
+        # all tokens want expert 0, capacity 2 -> only 2 slots filled in
+        # round 1; round 2 routes to the runner-up expert
+        logits = jnp.tile(jnp.array([[5.0, 1.0, 0.0, 0.0]]), (10, 1))
+        dispatch, combine, _ = router_topk(logits, capacity=2, k=1)
+        assert float(jnp.sum(dispatch[:, 0])) == 2.0
+        assert float(jnp.sum(dispatch)) == 2.0  # rest dropped
+
+    def test_identical_experts_reduce_to_dense_mlp(self):
+        """With every expert holding the same weights and gates renormalized,
+        MoE(x) == MLP(x) for every non-dropped token."""
+        T, H, F, E = 32, 16, 32, 4
+        bank = MoEMLP(E, H, F)
+        params = bank.init(K)
+        # make all experts identical
+        for n in ("w1", "b1", "w2", "b2"):
+            params[n] = jnp.broadcast_to(params[n][:1], params[n].shape)
+        x = jr.normal(jr.fold_in(K, 1), (T, H))
+        y, _ = moe_layer(params, x, k=2, capacity_factor=4.0)  # ample capacity
+        w1, b1 = params["w1"][0], params["b1"][0]
+        w2, b2 = params["w2"][0], params["b2"][0]
+        ref = jax.nn.gelu(x @ w1 + b1, approximate=True) @ w2 + b2
+        np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestExpertParallel:
+    def test_ep_matches_single_device(self):
+        """8-way expert parallelism over the dp axis must reproduce the
+        unsharded layer: same params, same tokens, same output."""
+        mesh = mesh_lib.make_mesh()  # dp = 8 = expert-parallel degree
+        T, H, F, E = 64, 16, 32, 8
+        bank = MoEMLP(E, H, F)
+        params = bank.init(K)
+        x = jr.normal(jr.fold_in(K, 2), (T, H))
+
+        y_ref, aux_ref = moe_layer(params, x, k=2, capacity_factor=4.0)
+
+        def shard(params, x):
+            # shard_map's in_specs hand each device its expert slice of
+            # w1/b1/w2/b2 and its token slice of x; the router replicates
+            y, _ = moe_layer(params, x, k=2, capacity_factor=4.0,
+                             axis_name="dp")
+            return y
+
+        y = mesh_lib.shard_map(
+            shard, mesh=mesh,
+            in_specs=({"router": P(), "w1": P("dp"), "b1": P("dp"),
+                       "w2": P("dp"), "b2": P("dp")}, P("dp")),
+            out_specs=P("dp"),
+        )(params, x)
+        np.testing.assert_allclose(y, y_ref, rtol=1e-4, atol=1e-5)
+
+    def test_ep_router_shape_mismatch_raises(self):
+        mesh = mesh_lib.make_mesh()
+        bank = MoEMLP(4, 8, 16)  # 4 experts but dp=8 -> E = local*8 != 4
+        params = bank.init(K)
+        x = jr.normal(K, (16, 8))
+        with pytest.raises(ValueError, match="router covers"):
+            mesh_lib.shard_map(
+                lambda p, x: moe_layer(p, x, axis_name="dp")[0],
+                mesh=mesh,
+                in_specs=({"router": P(), "w1": P(), "b1": P(),
+                           "w2": P(), "b2": P()}, P("dp")),
+                out_specs=P("dp"),
+            )(params, x)
+
+
+class TestMoEGrads:
+    def test_grads_flow_to_experts_and_router(self):
+        T, H, F, E = 32, 16, 32, 4
+        bank = MoEMLP(E, H, F)
+        params = bank.init(K)
+        x = jr.normal(jr.fold_in(K, 3), (T, H))
+
+        def loss(params):
+            y, aux = moe_layer(params, x, k=2, capacity_factor=2.0)
+            return jnp.sum(y ** 2) + 0.01 * aux["load_balance_loss"]
+
+        g = jax.grad(loss)(params)
+        for n in ("router", "w1", "w2"):
+            assert float(jnp.sum(jnp.abs(g[n]))) > 0.0, n
