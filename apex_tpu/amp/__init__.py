@@ -56,4 +56,16 @@ from apex_tpu.amp.lists import (  # noqa: F401
     register_float_op,
     register_half_op,
     register_promote_op,
+    register_float_function,
+    register_half_function,
+    register_promote_function,
+)
+from apex_tpu.amp.frontend import (  # noqa: F401
+    AmpState,
+    initialize,
+    half_function,
+    float_function,
+    promote_function,
+    disable_casts,
+    master_params,
 )

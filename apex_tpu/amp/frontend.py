@@ -1,0 +1,149 @@
+"""``amp.initialize`` and the decorator/context surface — frontend parity.
+
+The reference's entry point (``apex/amp/frontend.py:195-358``) mutates the
+model/optimizer in place and hides scaler state in a module global. The
+functional mirror takes a param pytree and an optax-style optimizer and
+returns everything explicitly as an :class:`AmpState`: cast (or
+master-wrapped) params, the (overflow-guarded) optimizer, the loss-scaler
+state, and the resolved policy. Nothing is patched; the training step
+composes these values.
+
+Also here: ``half_function`` / ``float_function`` / ``promote_function``
+decorators (``apex/amp/amp.py:30-57`` — e.g. ``apex/mlp/mlp.py:24`` marks
+MLP as half-class), ``disable_casts`` (``apex/amp/handle.py:163-167``), and
+``master_params`` (``apex/amp/_amp_state.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import lists as _lists
+from apex_tpu.amp.master import MasterWeights
+from apex_tpu.amp.policy import O0, Policy, get_policy, with_policy
+from apex_tpu.amp.scaler import (LossScalerState, init_loss_scaler,
+                                 skip_step_if_nonfinite)
+
+# per-level loss-scale defaults, Properties tables (frontend.py:102-191):
+# O1/O2 default "dynamic", O0/O3 default 1.0
+_DEFAULT_LOSS_SCALE = {"O0": 1.0, "O1": "dynamic", "O2": "dynamic", "O3": 1.0}
+
+
+@dataclasses.dataclass
+class AmpState:
+    """Everything ``amp.initialize`` configures, as explicit values."""
+
+    params: Any                     # cast pytree, or MasterWeights (O2)
+    optimizer: Any                  # optax-style; overflow-guarded if scaled
+    scaler: Optional[LossScalerState]
+    policy: Policy
+
+
+def initialize(
+    params,
+    optimizer=None,
+    opt_level: str = "O1",
+    *,
+    half_dtype=jnp.bfloat16,
+    loss_scale=None,
+    keep_batchnorm_fp32: Optional[bool] = None,
+    master_weights: Optional[bool] = None,
+    verbosity: int = 0,
+) -> AmpState:
+    """Functional ``amp.initialize`` (``apex/amp/frontend.py:195``).
+
+    * ``opt_level`` / ``keep_batchnorm_fp32`` / ``master_weights`` /
+      ``loss_scale`` keep the reference's names, defaults, and per-level
+      validation (via :func:`apex_tpu.amp.policy.get_policy`);
+    * params are cast to the policy's param dtype — O2 wraps them in
+      :class:`MasterWeights` (fp32 masters + half model copy);
+    * ``loss_scale=None`` takes the level's default ("dynamic" for O1/O2,
+      1.0 for O0/O3 — with bf16 the dynamic scaler simply never fires);
+    * the optimizer is wrapped with :func:`skip_step_if_nonfinite` whenever
+      a scaler is active, the functional form of the reference's patched
+      ``optimizer.step`` overflow skip.
+
+    Run the model under ``with_policy(state.policy)`` (or pass the policy
+    explicitly) so O1 per-op rules apply — the moral equivalent of the
+    reference's namespace patching.
+    """
+    del verbosity  # rank-aware logging covers this (utils/logging.py)
+    policy = get_policy(opt_level, half_dtype=half_dtype,
+                        keep_norm_f32=keep_batchnorm_fp32,
+                        master_weights=master_weights)
+
+    if loss_scale is None:
+        loss_scale = _DEFAULT_LOSS_SCALE[opt_level]
+    scaler = init_loss_scaler(loss_scale)
+    scaled = scaler.dynamic or float(scaler.loss_scale) != 1.0
+
+    if policy.master_weights:
+        out_params = MasterWeights.create(params, policy)
+    else:
+        out_params = jax.tree.map(
+            lambda a: a.astype(policy.param_dtype)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+            else a,
+            params,
+        )
+
+    if optimizer is not None and scaled:
+        optimizer = skip_step_if_nonfinite(optimizer)
+
+    return AmpState(params=out_params, optimizer=optimizer,
+                    scaler=scaler if scaled else None, policy=policy)
+
+
+def _op_decorator(register):
+    def decorator(fn):
+        name = fn.__name__
+        if (name in _lists.HALF_OPS or name in _lists.FLOAT_OPS
+                or name in _lists.PROMOTE_OPS):
+            import warnings
+
+            warnings.warn(
+                f"amp: {name!r} is already a registered op family — "
+                f"decorating a function with this name rewrites the O1 cast "
+                f"rule for every op that consults it; rename the function "
+                f"if that is not intended")
+        register(name)
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            cast = _lists.apply_op_rules(name, *args)
+            return fn(*cast, **kwargs)
+
+        return wrapped
+
+    return decorator
+
+
+#: Decorators marking a function's cast class under O1 — float kwargs are
+#: left untouched (positional arrays only), like the reference's wrappers
+#: cast ``args`` (``apex/amp/wrap.py:19-25``).
+half_function = _op_decorator(_lists.register_half_op)
+float_function = _op_decorator(_lists.register_float_op)
+promote_function = _op_decorator(_lists.register_promote_op)
+
+
+def disable_casts():
+    """Context manager suspending O1 per-op casting
+    (``apex/amp/handle.py:163-167`` — the reference flips the handle
+    inactive so wrapped ops run untouched; here the O0 policy is pushed, so
+    ``apply_op_rules`` becomes identity)."""
+    return with_policy(O0)
+
+
+def master_params(state) -> list:
+    """fp32 master leaves (``apex.amp.master_params(optimizer)``) — accepts
+    an :class:`AmpState`, a :class:`MasterWeights`, or a bare pytree."""
+    if isinstance(state, AmpState):
+        state = state.params
+    if isinstance(state, MasterWeights):
+        state = state.master
+    return jax.tree.leaves(state)

@@ -84,6 +84,24 @@ def register_promote_op(name: str) -> None:
     PROMOTE_OPS.add(name)
 
 
+def _ref_spelling(register):
+    """Reference-spelling wrappers: ``amp.register_half_function(module,
+    'fn')`` (``apex/amp/__init__.py``) keys on a (module, name) pair because
+    it must monkey-patch the module; the op-rule tables key on the op name
+    alone, so the module argument is accepted and ignored."""
+
+    def wrapper(module_or_name, function_name: str | None = None) -> None:
+        register(function_name if function_name is not None else module_or_name)
+
+    wrapper.__doc__ = _ref_spelling.__doc__
+    return wrapper
+
+
+register_half_function = _ref_spelling(register_half_op)
+register_float_function = _ref_spelling(register_float_op)
+register_promote_function = _ref_spelling(register_promote_op)
+
+
 _HALF_DTYPES = (jnp.float16, jnp.bfloat16)
 
 

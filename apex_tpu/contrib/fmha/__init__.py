@@ -28,3 +28,15 @@ class FMHAFun:
 
 def fmha(qkv, causal: bool = False):
     return FMHAFun.apply(qkv, causal)
+
+
+class FMHA:
+    """Module-shape parity with the reference's ``FMHA`` wrapper
+    (``apex/contrib/fmha/fmha.py:60-76``) — minus its seq<=512 / fp16 /
+    SM80 restrictions, which the flash kernel does not have."""
+
+    def __init__(self, causal: bool = False):
+        self.causal = causal
+
+    def __call__(self, qkv):
+        return FMHAFun.apply(qkv, self.causal)

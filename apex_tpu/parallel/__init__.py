@@ -37,3 +37,5 @@ from apex_tpu.parallel.distributed import (  # noqa: F401
 )
 from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, BatchNormState  # noqa: F401
 from apex_tpu.parallel.larc import larc  # noqa: F401
+
+LARC = larc  # reference spelling (``apex.parallel.LARC``)

@@ -1,0 +1,7 @@
+"""``apex.transformer.functional`` parity namespace."""
+
+from apex_tpu.ops.softmax import (scaled_masked_softmax,  # noqa: F401
+                                  scaled_upper_triang_masked_softmax)
+from apex_tpu.transformer.functional.fused_softmax import (  # noqa: F401
+    FusedScaleMaskSoftmax,
+)

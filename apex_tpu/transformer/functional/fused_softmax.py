@@ -1,0 +1,123 @@
+"""``FusedScaleMaskSoftmax`` — the user-facing scale+mask+softmax module.
+
+Parity surface for ``apex/transformer/functional/fused_softmax.py:101-207``,
+re-designed for TPU. The reference routes between two CUDA kernels and a
+torch fallback based on a table of warp-level constraints
+(``is_kernel_available``: fp16/bf16 only, 16 < sk ≤ 2048, ``sq % 4 == 0``,
+``b·np`` divisibility by an arch-dependent batch-per-block). Here the Pallas
+kernel streams any sequence length, so eligibility collapses to lane
+alignment (``sk % 128 == 0``) plus the user's fusion flag; everything else
+falls back to the jnp composition with identical semantics (fp32 softmax for
+half inputs when ``softmax_in_fp32``, result cast back).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.softmax import (scaled_masked_softmax,
+                                  scaled_upper_triang_masked_softmax)
+from apex_tpu.transformer.enums import AttnMaskType
+
+
+class FusedScaleMaskSoftmax:
+    """scale + mask + softmax over (b, np, sq, sk) attention scores.
+
+    Arguments follow the reference module:
+
+    * ``input_in_fp16`` / ``input_in_bf16`` — declared input precision
+      (mutually exclusive; either enables the kernel path);
+    * ``attn_mask_type`` — ``AttnMaskType.causal`` builds the upper-triangle
+      mask in-kernel, ``AttnMaskType.padding`` applies the passed mask;
+    * ``scaled_masked_softmax_fusion`` — user opt-in to the kernel;
+    * ``mask_func`` — fallback-path masking function ``(scores, mask) ->
+      masked`` (the kernel path applies masks natively);
+    * ``softmax_in_fp32`` — fallback computes softmax in fp32 and casts back;
+    * ``scale`` — optional score scaling (requires ``softmax_in_fp32``,
+      matching the reference's constraint).
+    """
+
+    def __init__(
+        self,
+        input_in_fp16: bool,
+        input_in_bf16: bool,
+        attn_mask_type: AttnMaskType,
+        scaled_masked_softmax_fusion: bool,
+        mask_func: Optional[Callable],
+        softmax_in_fp32: bool,
+        scale: Optional[float],
+    ):
+        if input_in_fp16 and input_in_bf16:
+            raise RuntimeError(
+                "both fp16 and bf16 flags cannot be active at the same time.")
+        self.input_in_fp16 = input_in_fp16
+        self.input_in_bf16 = input_in_bf16
+        self.input_in_float16 = input_in_fp16 or input_in_bf16
+        self.attn_mask_type = attn_mask_type
+        self.scaled_masked_softmax_fusion = scaled_masked_softmax_fusion
+        self.mask_func = mask_func
+        self.softmax_in_fp32 = softmax_in_fp32
+        self.scale = scale
+        if not (scale is None or softmax_in_fp32):
+            raise RuntimeError("softmax should be in fp32 when scaled")
+        if attn_mask_type not in (AttnMaskType.causal, AttnMaskType.padding):
+            raise ValueError("Invalid attn_mask_type.")
+
+    def __call__(self, scores: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
+        assert scores.ndim == 4  # (b, np, sq, sk)
+        if self.is_kernel_available(mask, *scores.shape):
+            return self.forward_fused_softmax(scores, mask)
+        return self.forward_jnp_softmax(scores, mask)
+
+    def is_kernel_available(self, mask, b, np, sq, sk) -> bool:
+        """The reference's constraint table (fp16-only, ``16 < sk <= 2048``,
+        warp divisibility — ``fused_softmax.py:159-179``) reduces to: user
+        opted in, half-precision input, and a lane-aligned softmax axis.
+        Notably there is NO upper sequence cap. Causal keeps the reference
+        kernel's square-scores requirement
+        (``scaled_upper_triang_masked_softmax.h`` assumes sq == sk);
+        rectangular causal shapes take the fallback."""
+        if self.attn_mask_type == AttnMaskType.causal and sq != sk:
+            return False
+        return bool(
+            self.scaled_masked_softmax_fusion
+            and self.input_in_float16
+            and sk % 128 == 0
+            and (mask is not None or self.attn_mask_type == AttnMaskType.causal)
+        )
+
+    def forward_fused_softmax(self, scores, mask):
+        scale = self.scale if self.scale is not None else 1.0
+        if self.attn_mask_type == AttnMaskType.causal:
+            return scaled_upper_triang_masked_softmax(scores, scale)
+        return scaled_masked_softmax(scores, mask, scale)
+
+    def forward_jnp_softmax(self, scores, mask):
+        """Fallback with the reference's dtype dance (`forward_torch_softmax`):
+        fp32 softmax for half inputs when requested, cast back after."""
+        orig = scores.dtype
+        if self.input_in_float16 and self.softmax_in_fp32:
+            scores = scores.astype(jnp.float32)
+        if self.scale is not None:
+            scores = scores * self.scale
+        if mask is not None:
+            # the reference calls mask_func unconditionally when a mask is
+            # present (fused_softmax.py:193) — never drop a mask silently
+            if self.mask_func is not None:
+                scores = self.mask_func(scores, mask)
+            else:
+                scores = jnp.where(mask, -1e30, scores)
+        if self.attn_mask_type == AttnMaskType.causal:
+            # top-left alignment (row r sees cols <= r), the kernel path's
+            # convention (ops/softmax.py:_xla_fwd) and the reference's
+            # square-triangle semantics
+            sq, sk = scores.shape[-2], scores.shape[-1]
+            causal = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None]
+            scores = jnp.where(causal, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        if self.input_in_float16 and self.softmax_in_fp32:
+            probs = probs.astype(orig)
+        return probs

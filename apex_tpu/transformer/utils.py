@@ -1,0 +1,48 @@
+"""Utilities shared by tensor_parallel and pipeline_parallel — the
+``apex/transformer/utils.py`` parity surface.
+
+``split_tensor_into_1d_equal_chunks`` / ``gather_split_1d_tensor`` are the
+reference's sequence-parallel activation scatter/gather (used by its
+checkpoint buffer, ``tensor_parallel/random.py:45-84``). There the rank
+indexes a flat view and an ``_all_gather_base`` reassembles it; here the
+same pair is a ``dynamic_slice`` by ``axis_index`` and an ``all_gather``,
+valid inside ``shard_map`` with the axis bound.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from apex_tpu.parallel import mesh as mesh_lib
+from apex_tpu.transformer.tensor_parallel.utils import (  # noqa: F401
+    divide,
+    ensure_divisibility,
+    split_tensor_along_last_dim,
+)
+
+
+def split_tensor_into_1d_equal_chunks(
+    x: jax.Array, *, axis_name: str = mesh_lib.TENSOR_AXIS
+) -> jax.Array:
+    """This rank's equal chunk of the flattened tensor
+    (``apex/transformer/utils.py:22-30``). Requires the flat size to divide
+    the axis size; run inside shard_map."""
+    flat = x.reshape(-1)
+    world = jax.lax.axis_size(axis_name)
+    if flat.shape[0] % world:
+        raise ValueError(
+            f"tensor of {flat.shape[0]} elements does not split evenly over "
+            f"{world} ranks")
+    per = flat.shape[0] // world
+    rank = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_slice_in_dim(flat, rank * per, per, 0)
+
+
+def gather_split_1d_tensor(
+    chunk: jax.Array, *, axis_name: str = mesh_lib.TENSOR_AXIS
+) -> jax.Array:
+    """Inverse of :func:`split_tensor_into_1d_equal_chunks`
+    (``apex/transformer/utils.py:33-46``): all-gather the rank chunks back
+    into the full flat tensor."""
+    return jax.lax.all_gather(chunk.reshape(-1), axis_name, axis=0,
+                              tiled=True)

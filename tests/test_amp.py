@@ -338,3 +338,105 @@ class TestSkipStepIfNonfinite:
         assert int(scaler.skipped_steps) >= 1, "expected at least one overflow"
         assert np.isfinite(np.asarray(jax.tree.leaves(master.master))).all()
         assert np.isfinite(losses[-1])
+
+
+class TestFrontend:
+    """``amp.initialize`` + decorator surface (``apex/amp/frontend.py:195``,
+    ``amp.py:30-57``, ``handle.py:163-167``)."""
+
+    def _params(self):
+        return {"w": jnp.ones((4, 4), jnp.float32),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def test_initialize_o2_wraps_masters_and_scaler(self):
+        from apex_tpu.optimizers import fused_adam
+
+        st = amp.initialize(self._params(), fused_adam(1e-3), "O2",
+                            half_dtype=jnp.float16)
+        assert isinstance(st.params, amp.MasterWeights)
+        assert st.params.model["w"].dtype == jnp.float16
+        assert st.params.master["w"].dtype == jnp.float32
+        assert st.scaler is not None and st.scaler.dynamic
+        assert st.policy.master_weights
+
+    def test_initialize_o0_is_identity_no_scaler(self):
+        st = amp.initialize(self._params(), None, "O0")
+        assert st.scaler is None  # loss_scale 1.0 and static => unscaled
+        assert st.params["w"].dtype == jnp.float32
+        assert st.params["step"].dtype == jnp.int32  # ints untouched
+
+    def test_initialize_o1_keeps_params_fp32(self):
+        st = amp.initialize(self._params(), None, "O1")
+        assert st.params["w"].dtype == jnp.float32
+        assert st.policy.per_op_rules
+
+    def test_initialize_trains_end_to_end(self):
+        """The returned pieces compose into a working O2 fp16 step."""
+        from apex_tpu.optimizers import fused_sgd
+
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (8,), jnp.float32)}
+        st = amp.initialize(params, fused_sgd(learning_rate=0.05), "O2",
+                            half_dtype=jnp.float16)
+        opt_state = st.optimizer.init(st.params.master)
+        x = jax.random.normal(jax.random.PRNGKey(1), (16, 8), jnp.float16)
+
+        def loss_fn(p, x):
+            return jnp.mean((x @ p["w"]) ** 2).astype(jnp.float32)
+
+        master, scaler = st.params, st.scaler
+        losses = []
+        for _ in range(10):
+            loss, (g, finite, scaler) = amp.scaled_value_and_grad(loss_fn)(
+                scaler, master.model, x)
+            updates, opt_state = st.optimizer.update(g, opt_state, master.master)
+            master = amp.apply_updates_with_master(master, updates,
+                                                   grads_finite=finite)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, losses
+
+    def test_half_function_decorator_casts_under_o1(self):
+        seen = {}
+
+        @amp.half_function
+        def my_matmul_like_op(a, b):
+            seen["dtypes"] = (a.dtype, b.dtype)
+            return a @ b
+
+        a = jnp.ones((4, 4), jnp.float32)
+        with amp.with_policy(amp.get_policy("O1")):
+            my_matmul_like_op(a, a)
+        assert seen["dtypes"] == (jnp.bfloat16, jnp.bfloat16)
+        # no ambient O1 -> untouched
+        my_matmul_like_op(a, a)
+        assert seen["dtypes"] == (jnp.float32, jnp.float32)
+
+    def test_float_function_decorator_upcasts(self):
+        seen = {}
+
+        @amp.float_function
+        def my_loss_like_op(a):
+            seen["dtype"] = a.dtype
+            return a.sum()
+
+        with amp.with_policy(amp.get_policy("O1")):
+            my_loss_like_op(jnp.ones((4,), jnp.bfloat16))
+        assert seen["dtype"] == jnp.float32
+
+    def test_disable_casts_suspends_o1(self):
+        seen = {}
+
+        @amp.half_function
+        def another_op(a):
+            seen["dtype"] = a.dtype
+            return a
+
+        with amp.with_policy(amp.get_policy("O1")):
+            with amp.disable_casts():
+                another_op(jnp.ones((2,), jnp.float32))
+        assert seen["dtype"] == jnp.float32
+
+    def test_master_params(self):
+        st = amp.initialize(self._params(), None, "O2")
+        leaves = amp.master_params(st)
+        assert all(l.dtype in (jnp.float32, jnp.int32) for l in leaves)
+        assert len(leaves) == 2

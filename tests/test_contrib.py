@@ -392,6 +392,19 @@ class TestZeroHardening:
         # close to the fp32 trajectory but not required bitwise
         np.testing.assert_allclose(l16[-1], l32[-1], rtol=0.2, atol=5e-3)
 
+    def test_zero_e5m2_allgather_converges(self):
+        """Exact parity with the reference's fp8 option: ``e5m2_allgather``
+        (``distributed_fused_lamb.py:86-95``) — params all-gathered as
+        float8_e5m2. Coarser than bf16, so only convergence (not closeness
+        to the fp32 trajectory) is required."""
+        from apex_tpu.contrib.optimizers import distributed_fused_adam
+
+        _, l8 = self._train(
+            distributed_fused_adam(learning_rate=1e-2,
+                                   all_gather_dtype=jnp.float8_e5m2),
+            is_zero=True)
+        assert l8[-1] < l8[0] * 0.5, f"e5m2 all-gather did not converge: {l8}"
+
     def test_zero_lamb_50_steps_converges(self):
         from apex_tpu.contrib.optimizers import distributed_fused_lamb
 

@@ -1,0 +1,141 @@
+"""transformer.functional + transformer.utils parity tests
+(``apex/transformer/functional/fused_softmax.py``, ``transformer/utils.py``;
+reference test: ``tests/L0/run_transformer/test_fused_softmax.py``)."""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel import mesh as mesh_lib
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
+from apex_tpu.transformer.utils import (gather_split_1d_tensor,
+                                        split_tensor_into_1d_equal_chunks)
+
+K = jr.PRNGKey(5)
+
+
+def _mask_func(scores, mask):
+    return jnp.where(mask, -1e30, scores)
+
+
+class TestFusedScaleMaskSoftmax:
+    def _ref(self, scores, mask, scale, causal):
+        s = scores.astype(jnp.float32) * scale
+        if causal:
+            sq, sk = s.shape[-2], s.shape[-1]
+            cm = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None]
+            s = jnp.where(cm, s, -1e30)
+        if mask is not None:
+            s = jnp.where(mask, -1e30, s)
+        return jax.nn.softmax(s, -1).astype(scores.dtype)
+
+    @pytest.mark.parametrize("fusion", [True, False])
+    def test_causal_matches_reference(self, fusion):
+        scores = jr.normal(K, (2, 4, 128, 128), jnp.bfloat16)
+        m = FusedScaleMaskSoftmax(
+            input_in_fp16=False, input_in_bf16=True,
+            attn_mask_type=AttnMaskType.causal,
+            scaled_masked_softmax_fusion=fusion,
+            mask_func=None, softmax_in_fp32=True, scale=0.5)
+        out = m(scores, None)
+        assert out.dtype == scores.dtype
+        np.testing.assert_allclose(
+            out.astype(jnp.float32),
+            self._ref(scores, None, 0.5, True).astype(jnp.float32),
+            rtol=2e-2, atol=2e-3)
+
+    @pytest.mark.parametrize("fusion", [True, False])
+    def test_padding_mask_matches_reference(self, fusion):
+        scores = jr.normal(K, (2, 4, 64, 128), jnp.bfloat16)
+        mask = jr.bernoulli(jr.fold_in(K, 1), 0.3, (2, 1, 64, 128))
+        m = FusedScaleMaskSoftmax(
+            input_in_fp16=False, input_in_bf16=True,
+            attn_mask_type=AttnMaskType.padding,
+            scaled_masked_softmax_fusion=fusion,
+            mask_func=_mask_func, softmax_in_fp32=True, scale=None)
+        out = m(scores, mask)
+        np.testing.assert_allclose(
+            out.astype(jnp.float32),
+            self._ref(scores, mask, 1.0, False).astype(jnp.float32),
+            rtol=2e-2, atol=2e-3)
+
+    def test_no_sequence_cap(self):
+        """The reference kernel refuses sk > 2048
+        (``fused_softmax.py:166``); ours must not."""
+        m = FusedScaleMaskSoftmax(
+            input_in_fp16=False, input_in_bf16=True,
+            attn_mask_type=AttnMaskType.causal,
+            scaled_masked_softmax_fusion=True,
+            mask_func=None, softmax_in_fp32=True, scale=None)
+        assert m.is_kernel_available(None, 1, 1, 4096, 4096)
+        # unaligned softmax axis falls back, never errors
+        assert not m.is_kernel_available(None, 1, 1, 100, 100)
+        out = m(jr.normal(K, (1, 1, 100, 100), jnp.bfloat16), None)
+        np.testing.assert_allclose(float(jnp.sum(out, -1).mean()), 1.0, rtol=1e-2)
+
+    def test_padding_mask_never_dropped_without_mask_func(self):
+        """mask_func=None must still apply the mask (the reference calls
+        mask_func unconditionally; silently attending to padding is the
+        worst failure mode)."""
+        scores = jr.normal(K, (1, 1, 8, 128), jnp.bfloat16)
+        mask = jnp.zeros((1, 1, 8, 128), bool).at[..., 64:].set(True)
+        m = FusedScaleMaskSoftmax(
+            input_in_fp16=False, input_in_bf16=True,
+            attn_mask_type=AttnMaskType.padding,
+            scaled_masked_softmax_fusion=False,  # force the fallback
+            mask_func=None, softmax_in_fp32=True, scale=None)
+        out = m(scores, mask)
+        assert float(jnp.max(out[..., 64:])) == 0.0
+
+    def test_rectangular_causal_takes_fallback_consistently(self):
+        """sq != sk causal: kernel ineligible (the reference kernel assumes
+        square scores), and the fallback's triangle matches the kernel's
+        top-left alignment at square shapes."""
+        m = FusedScaleMaskSoftmax(
+            input_in_fp16=False, input_in_bf16=True,
+            attn_mask_type=AttnMaskType.causal,
+            scaled_masked_softmax_fusion=True,
+            mask_func=None, softmax_in_fp32=True, scale=None)
+        assert not m.is_kernel_available(None, 2, 4, 64, 128)
+        out = m(jr.normal(K, (1, 1, 64, 128), jnp.bfloat16), None)
+        # row 0 attends only to column 0 (top-left convention)
+        np.testing.assert_allclose(float(out[0, 0, 0, 0]), 1.0, rtol=1e-3)
+        assert float(jnp.max(out[0, 0, 0, 1:])) == 0.0
+
+    def test_invalid_flag_combinations_raise(self):
+        with pytest.raises(RuntimeError, match="both fp16 and bf16"):
+            FusedScaleMaskSoftmax(True, True, AttnMaskType.causal, True,
+                                  None, True, None)
+        with pytest.raises(RuntimeError, match="fp32 when scaled"):
+            FusedScaleMaskSoftmax(True, False, AttnMaskType.causal, True,
+                                  None, False, 2.0)
+
+
+class TestSplitGather1D:
+    def test_roundtrip_over_tp(self):
+        mesh = mesh_lib.make_mesh(tensor_model_parallel_size=4)
+        x = jr.normal(K, (8, 16))
+
+        def f(x):
+            chunk = split_tensor_into_1d_equal_chunks(x, axis_name="tp")
+            # each rank holds numel/4
+            full = gather_split_1d_tensor(chunk, axis_name="tp")
+            return full.reshape(x.shape)
+
+        y = mesh_lib.shard_map(
+            f, mesh=mesh, in_specs=(P(),), out_specs=P(),
+        )(x)
+        np.testing.assert_array_equal(y, x)
+
+    def test_uneven_split_raises(self):
+        mesh = mesh_lib.make_mesh(tensor_model_parallel_size=4)
+        x = jnp.ones((3, 5))  # 15 elements, not divisible by 4
+        with pytest.raises(ValueError, match="does not split evenly"):
+            mesh_lib.shard_map(
+                lambda x: split_tensor_into_1d_equal_chunks(x, axis_name="tp"),
+                mesh=mesh, in_specs=(P(),), out_specs=P("tp"),
+            )(x)
