@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, Optional
+from typing import Any, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -36,11 +36,16 @@ _DEFAULT_LOSS_SCALE = {"O0": 1.0, "O1": "dynamic", "O2": "dynamic", "O3": 1.0}
 
 @dataclasses.dataclass
 class AmpState:
-    """Everything ``amp.initialize`` configures, as explicit values."""
+    """Everything ``amp.initialize`` configures, as explicit values.
+
+    ``scaler`` is ``None`` when scaling is inactive (static scale 1.0 —
+    O0/O3 defaults), one :class:`LossScalerState` normally, or a list of
+    ``num_losses`` independent states when multiple losses were requested.
+    """
 
     params: Any                     # cast pytree, or MasterWeights (O2)
     optimizer: Any                  # optax-style; overflow-guarded if scaled
-    scaler: Optional[LossScalerState]
+    scaler: Union[None, LossScalerState, list]
     policy: Policy
 
 
@@ -53,6 +58,7 @@ def initialize(
     loss_scale=None,
     keep_batchnorm_fp32: Optional[bool] = None,
     master_weights: Optional[bool] = None,
+    num_losses: int = 1,
     verbosity: int = 0,
 ) -> AmpState:
     """Functional ``amp.initialize`` (``apex/amp/frontend.py:195``).
@@ -66,7 +72,11 @@ def initialize(
       1.0 for O0/O3 — with bf16 the dynamic scaler simply never fires);
     * the optimizer is wrapped with :func:`skip_step_if_nonfinite` whenever
       a scaler is active, the functional form of the reference's patched
-      ``optimizer.step`` overflow skip.
+      ``optimizer.step`` overflow skip;
+    * ``num_losses > 1`` returns a LIST of independent scaler states
+      (the reference's per-loss ``LossScaler`` array,
+      ``apex/amp/_initialize.py:227-231`` + ``scale_loss(..., loss_id)``) —
+      pass ``state.scaler[i]`` to :func:`scaled_value_and_grad` per loss.
 
     Run the model under ``with_policy(state.policy)`` (or pass the policy
     explicitly) so O1 per-op rules apply — the moral equivalent of the
@@ -81,6 +91,8 @@ def initialize(
         loss_scale = _DEFAULT_LOSS_SCALE[opt_level]
     scaler = init_loss_scaler(loss_scale)
     scaled = scaler.dynamic or float(scaler.loss_scale) != 1.0
+    if scaled and num_losses > 1:
+        scaler = [init_loss_scaler(loss_scale) for _ in range(num_losses)]
 
     if policy.master_weights:
         out_params = MasterWeights.create(params, policy)

@@ -39,6 +39,9 @@ HALF_OPS = {
     # matmul/conv family → MXU, compute dtype
     "conv", "conv1d", "conv2d", "conv3d", "conv_transpose",
     "dense", "linear", "matmul", "bmm", "einsum", "attention", "mlp",
+    # RNN cells are gate matmuls (cf. wrap.rnn_cast / rnn_compat,
+    # apex/amp/wrap.py:157-265 — the reference casts weights+inputs half)
+    "rnn", "lstm", "gru",
 }
 
 FLOAT_OPS = {

@@ -11,3 +11,18 @@ from apex_tpu.contrib.optimizers.distributed import (  # noqa: F401
     distributed_fused_adam,
     distributed_fused_lamb,
 )
+
+# The reference also carries deprecated pre-`apex.optimizers` copies here
+# (``apex/contrib/optimizers/fused_adam.py`` etc., kept for old import
+# paths) and a contrib FP16_Optimizer for them
+# (``contrib/optimizers/fp16_optimizer.py:4``). One implementation serves
+# both import paths in this framework:
+from apex_tpu.fp16_utils import FP16_Optimizer  # noqa: F401
+from apex_tpu.optimizers import (  # noqa: F401
+    FusedAdam,
+    FusedLAMB,
+    FusedSGD,
+    fused_adam,
+    fused_lamb,
+    fused_sgd,
+)

@@ -53,6 +53,12 @@ class GPTConfig:
     # whole attention re-forward, the measured-fastest policy that still
     # bounds the big (4H) mlp activations.
     remat_policy: str = "full"
+    # scan vs unrolled layer loop: scan compiles O(1) in depth (the
+    # reference-style module list is inherently "unrolled"); unrolling
+    # removes the scan carry's copy/dynamic-slice overhead at the price of
+    # depth-proportional compile time — measured on the flagship bench
+    # before choosing the default
+    scan_layers: bool = True
     dtype: Any = jnp.float32  # param dtype; compute follows inputs/policy
     # "softmax": materialized scores + fused causal softmax (the Megatron
     # path, ``standalone_gpt.py``'s ParallelAttention); "flash": blockwise
@@ -278,14 +284,23 @@ class GPTModel:
             else:
                 block = jax.checkpoint(block)
 
-        def body(x, layer_and_key):
-            layer, i = layer_and_key
-            k = None if key is None else jax.random.fold_in(key, i)
-            return block(layer, x, k), None
+        if c.scan_layers:
+            def body(x, layer_and_key):
+                layer, i = layer_and_key
+                k = None if key is None else jax.random.fold_in(key, i)
+                return block(layer, x, k), None
 
-        x, _ = jax.lax.scan(
-            body, x, (params["layers"], jnp.arange(c.num_layers))
-        )
+            x, _ = jax.lax.scan(
+                body, x, (params["layers"], jnp.arange(c.num_layers))
+            )
+        else:
+            # unrolled: larger program (compile time ~ num_layers) but no
+            # while-loop carry copies / dynamic-slices; XLA schedules across
+            # layer boundaries
+            for i in range(c.num_layers):
+                layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+                k = None if key is None else jax.random.fold_in(key, i)
+                x = block(layer, x, k)
         if self.sp:
             x = self._sp_gather(x)  # full seq for the head
         return fused_layer_norm(x, params["lnf_w"], params["lnf_b"])

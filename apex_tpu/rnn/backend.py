@@ -38,7 +38,22 @@ class RNN:
     def __call__(self, params: list, x: jax.Array,
                  initial_states: Optional[list] = None,
                  key: Optional[jax.Array] = None):
-        """Returns (outputs (B, T, H), final_states list)."""
+        """Returns (outputs (B, T, H), final_states list).
+
+        Under an ambient O1 policy, inputs and weights cast to the 'rnn'
+        rule's dtype on entry — the reference's RNN-specific cast machinery
+        (``apex/amp/wrap.py:157-265`` ``rnn_cast``/``new_rnn_cast``,
+        ``rnn_compat.py``) collapsed to one pytree cast; states follow via
+        ``x.dtype``."""
+        from apex_tpu.amp.lists import apply_op_rules
+
+        (x,) = apply_op_rules("rnn", x)
+        params = jax.tree.map(lambda a: apply_op_rules("rnn", a)[0], params)
+        if initial_states is not None:
+            # user-supplied states must join the cast too, or the fp32
+            # carry would promote every gate sum back to fp32
+            initial_states = jax.tree.map(
+                lambda a: apply_op_rules("rnn", a)[0], initial_states)
         b = x.shape[0]
         finals = []
         h = x

@@ -440,3 +440,28 @@ class TestFrontend:
         leaves = amp.master_params(st)
         assert all(l.dtype in (jnp.float32, jnp.int32) for l in leaves)
         assert len(leaves) == 2
+
+    def test_num_losses_independent_scalers(self):
+        """``amp.initialize(..., num_losses=2)`` — per-loss scaler states
+        (reference: per-loss ``LossScaler``s ``_initialize.py:227-231``;
+        test ``test_multiple_models_optimizers_losses.py``). An overflow on
+        loss 0 must back off scaler 0 only."""
+        st = amp.initialize(self._params(), None, "O2",
+                            half_dtype=jnp.float16, num_losses=2)
+        assert isinstance(st.scaler, list) and len(st.scaler) == 2
+        s0, s1 = st.scaler
+
+        def ok_loss(p):
+            return jnp.sum(p["w"].astype(jnp.float32) * 1e-3)
+
+        def overflow_loss(p):
+            # fp16 grads overflow under the big scale
+            return jnp.sum((p["w"] * 3e4).astype(jnp.float32))
+
+        p16 = {"w": jnp.ones((4, 4), jnp.float16)}
+        _, (_, fin1, s1_new) = amp.scaled_value_and_grad(ok_loss)(s1, p16)
+        _, (_, fin0, s0_new) = amp.scaled_value_and_grad(overflow_loss)(s0, p16)
+        assert bool(fin1)
+        assert not bool(fin0)
+        assert float(s0_new.loss_scale) == float(s0.loss_scale) / 2
+        assert float(s1_new.loss_scale) == float(s1.loss_scale)

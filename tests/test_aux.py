@@ -107,6 +107,25 @@ class TestRNN:
         y, _ = apply(params, jr.normal(K, (2, 6, 8)))
         assert y.shape == (2, 6, 32)
 
+    def test_o1_casts_rnn_to_half(self):
+        """RNN participates in the O1 cast engine — the reference's
+        rnn_cast machinery (``apex/amp/wrap.py:157-265``; test:
+        ``tests/L0/run_amp/test_rnn.py``): fp32 weights+inputs run the
+        cells in the policy's compute dtype."""
+        from apex_tpu import amp
+        from apex_tpu.rnn import LSTM
+
+        rnn = LSTM(8, 16)
+        params = rnn.init(K)  # fp32
+        x = jr.normal(jr.fold_in(K, 3), (2, 5, 8))  # fp32
+        with amp.with_policy(amp.get_policy("O1")):
+            y, _ = rnn(params, x)
+        assert y.dtype == jnp.bfloat16
+        y32, _ = rnn(params, x)  # no ambient policy: untouched
+        assert y32.dtype == jnp.float32
+        np.testing.assert_allclose(
+            y.astype(jnp.float32), y32, rtol=2e-2, atol=2e-2)
+
 
 class TestReparameterization:
     def test_weight_norm_roundtrip(self):
