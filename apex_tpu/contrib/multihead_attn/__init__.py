@@ -18,8 +18,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp.lists import apply_op_rules
 from apex_tpu.ops import fused_layer_norm
-from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.attention import flash_attention, masked_scores
 
 
 def _linear_init(key, shape, dtype):
@@ -32,6 +33,21 @@ def _dropout(x, rate, key):
         return x
     keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+def _attention(q, k, v, *, causal, rate, key):
+    """Attention core. Without dropout (or at eval) this is the flash
+    kernel; with probs dropout it is the reference's
+    ``fast_mask_softmax_dropout`` semantics (dropout ON the attention
+    weights, ``mask_softmax_dropout_func.py``) over materialized probs —
+    the flash recurrence cannot drop individual weights."""
+    if rate <= 0 or key is None:
+        return flash_attention(q, k, v, causal=causal)
+    q, k, v = apply_op_rules("attention", q, k, v)
+    s = masked_scores(q, k, 1.0 / q.shape[-1] ** 0.5, causal)
+    probs = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    probs = _dropout(probs, rate, key)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
 @dataclasses.dataclass
@@ -102,14 +118,13 @@ class SelfMultiheadAttn:
         def split_heads(t):
             return t.reshape(b, s, h, d).transpose(0, 2, 1, 3)
 
-        o = flash_attention(split_heads(q), split_heads(kk), split_heads(v),
-                            causal=causal)
+        o = _attention(split_heads(q), split_heads(kk), split_heads(v),
+                       causal=causal,
+                       rate=self.dropout if is_training else 0.0, key=key)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, e)
         o = o @ params["out_weight"].T
         if self.bias:
             o = o + params["out_bias"]
-        if is_training:
-            o = _dropout(o, self.dropout, key)
         if self.include_norm_add:
             o = o + residual
         return o
@@ -164,13 +179,12 @@ class EncdecMultiheadAttn:
         q = q.reshape(b, sq, h, d).transpose(0, 2, 1, 3)
         kk = kk.reshape(b, sk, h, d).transpose(0, 2, 1, 3)
         v = v.reshape(b, sk, h, d).transpose(0, 2, 1, 3)
-        o = flash_attention(q, kk, v, causal=False)
+        o = _attention(q, kk, v, causal=False,
+                       rate=self.dropout if is_training else 0.0, key=key)
         o = o.transpose(0, 2, 1, 3).reshape(b, sq, e)
         o = o @ params["out_weight"].T
         if self.bias:
             o = o + params["out_bias"]
-        if is_training:
-            o = _dropout(o, self.dropout, key)
         if self.include_norm_add:
             o = o + residual
         return o

@@ -33,12 +33,19 @@ from apex_tpu.parallel import mesh as mesh_lib
 
 # --- single-device flash attention -------------------------------------------
 
-def _xla_attention(q, k, v, scale, causal):
-    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+def masked_scores(q, k, scale, causal):
+    """fp32 scaled scores over (..., seq, head_dim) with the bottom-right-
+    aligned causal mask (last ``sq`` query rows of an ``sk``-long context)."""
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         mask = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None] + (sk - sq)
         s = jnp.where(mask, s, _k.NEG_INF)
+    return s
+
+
+def _xla_attention(q, k, v, scale, causal):
+    s = masked_scores(q, k, scale, causal)
     lse = jax.nn.logsumexp(s, axis=-1)
     p = jnp.exp(s - lse[..., None])
     o = jnp.einsum("bqk,bkd->bqd", p.astype(q.dtype), v)
@@ -75,11 +82,7 @@ def _flash_bwd(scale, causal, use_pallas, res, do):
             interpret=_backend.interpret_mode(),
         )
     else:
-        s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
-        if causal:
-            sq, sk = s.shape[-2], s.shape[-1]
-            mask = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None] + (sk - sq)
-            s = jnp.where(mask, s, _k.NEG_INF)
+        s = masked_scores(q, k, scale, causal)
         p = jnp.exp(s - lse[..., None])
         dof = do.astype(jnp.float32)
         dv = jnp.einsum("bqk,bqd->bkd", p, dof).astype(v.dtype)

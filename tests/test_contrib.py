@@ -128,6 +128,25 @@ class TestMultiheadAttn:
         assert out.shape == (2, 8, 32)
         assert bool(jnp.all(jnp.isfinite(out)))
 
+    def test_probs_dropout_semantics(self):
+        """Training dropout acts on the attention WEIGHTS (the reference's
+        ``fast_mask_softmax_dropout``), is unbiased in expectation, and
+        vanishes at eval."""
+        from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+
+        m = SelfMultiheadAttn(embed_dim=16, num_heads=2, dropout=0.3)
+        params = m.init(K)
+        x = jr.normal(jr.fold_in(K, 7), (2, 8, 16))
+        o_eval = m(params, x, is_training=False)
+        o1 = m(params, x, key=jr.fold_in(K, 8), is_training=True)
+        o2 = m(params, x, key=jr.fold_in(K, 9), is_training=True)
+        assert not np.allclose(o1, o2)       # stochastic
+        assert not np.allclose(o1, o_eval)   # actually drops
+        # expectation over many keys approaches the eval output
+        outs = jnp.stack([m(params, x, key=jr.fold_in(K, 100 + i))
+                          for i in range(200)])
+        np.testing.assert_allclose(outs.mean(0), o_eval, atol=0.08)
+
     def test_fmha_packed_layout(self):
         from apex_tpu.contrib.fmha import fmha
 
