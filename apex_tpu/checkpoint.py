@@ -60,6 +60,100 @@ def restore_checkpoint(path: str, template: TrainState) -> TrainState:
     return ckpt.restore(path, template)
 
 
+# --- auto-resume / preemption (pipeline_parallel/utils.py:142-144) ------------
+
+class AutoResume:
+    """Save-on-preemption protocol. The reference carries an ADLR auto-resume
+    stub (``get_autoresume`` ``apex/transformer/pipeline_parallel/utils.py:142-144``
+    and the commented termination check ``:286-300``) that defers to an
+    external cluster library; on Cloud TPU the termination signal is a plain
+    SIGTERM delivered ahead of preemption, so the guard is self-contained:
+    install signal handlers, poll ``termination_requested()`` from the train
+    loop, and ``check_and_save`` writes the TrainState before exit.
+
+    Handlers chain to any previously-installed handler and are restored by
+    ``uninstall()``.
+    """
+
+    def __init__(self, signals=None):
+        import signal as _signal
+
+        self._signal = _signal
+        self._requested = False
+        self._prev = {}
+        for s in signals if signals is not None else (_signal.SIGTERM,):
+            try:
+                self._prev[s] = _signal.signal(s, self._handler)
+            except ValueError:
+                # signal.signal only works on the main thread; degrade to the
+                # cooperative protocol (request_termination still works)
+                pass
+
+    def _handler(self, signum, frame):
+        self._requested = True
+        prev = self._prev.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+
+    def request_termination(self) -> None:
+        """Mark termination as requested (tests / cooperative shutdown)."""
+        self._requested = True
+
+    def termination_requested(self) -> bool:
+        return self._requested
+
+    def check_and_save(self, path: str, state: TrainState) -> bool:
+        """If termination was requested, checkpoint ``state`` to ``path`` and
+        return True (caller should break its train loop). The analog of the
+        reference's ``check_adlr_autoresume_termination``.
+
+        On multi-host meshes the decision is agreed across processes first
+        (a signal can land between two hosts' polls; an unagreed flag would
+        have one host enter the collective orbax save while the others run
+        ahead — the reason Megatron all-reduces its termination flag). All
+        processes therefore return the same value and enter the save
+        together."""
+        if not self._agreed_termination():
+            return False
+        save_checkpoint(path, state)
+        return True
+
+    def _agreed_termination(self) -> bool:
+        if jax.process_count() == 1:
+            return self._requested
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            jnp.asarray(self._requested, jnp.int32))
+        agreed = bool(np.max(np.asarray(flags)))
+        if agreed:
+            self._requested = True  # adopt the peer's signal
+        return agreed
+
+    def uninstall(self) -> None:
+        global _AUTORESUME
+        for s, prev in self._prev.items():
+            self._signal.signal(s, prev)
+        self._prev.clear()
+        if _AUTORESUME is self:
+            # never leave the singleton pointing at a dead (handler-less)
+            # guard — the next get_autoresume() installs a fresh one
+            _AUTORESUME = None
+
+
+_AUTORESUME: Optional[AutoResume] = None
+
+
+def get_autoresume() -> AutoResume:
+    """Process-wide ``AutoResume`` (reference spelling:
+    ``pipeline_parallel/utils.py:142-144``), installed on first use."""
+    global _AUTORESUME
+    if _AUTORESUME is None:
+        _AUTORESUME = AutoResume()
+    return _AUTORESUME
+
+
 # --- amp state-dict parity (frontend.py:361-400) ------------------------------
 
 def amp_state_dict(scaler_states) -> dict:

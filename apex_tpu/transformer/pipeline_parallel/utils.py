@@ -167,3 +167,23 @@ def get_timers() -> Timers:
     if _GLOBAL_TIMERS is None:
         _GLOBAL_TIMERS = Timers()
     return _GLOBAL_TIMERS
+
+
+def get_autoresume():
+    """Reference spelling (``pipeline_parallel/utils.py:142-144``). Returns
+    the process-wide :class:`apex_tpu.checkpoint.AutoResume` — a working
+    SIGTERM-based guard rather than the reference's external-library stub."""
+    from apex_tpu import checkpoint as _ckpt
+
+    return _ckpt.get_autoresume()
+
+
+def check_adlr_autoresume_termination(iteration, state, path,
+                                      interval: int = 1) -> bool:
+    """Every ``interval`` iterations, checkpoint-and-signal-stop if
+    preemption was requested (the reference's commented check,
+    ``pipeline_parallel/utils.py:286-300``). Returns True when the caller
+    should break its train loop (instead of the reference's ``sys.exit``)."""
+    if interval and iteration % interval != 0:
+        return False
+    return get_autoresume().check_and_save(path, state)

@@ -8,10 +8,12 @@ The algorithm ports directly — it is three collectives over the tp axis:
    contributes (reference masked gather + all_reduce, :40-58);
 3. ``psum`` of per-shard ``sum(exp)`` (reference :60-66).
 
-Backward reproduces the reference's saved-softmax gradient
-(``:80-99``): ``d logits = (softmax - onehot_masked) * dloss`` on each
-shard, with label smoothing exactly as the reference's ``label_smoothing``
-branch computes it.
+Backward computes the reference's gradient (``:80-99``):
+``d logits = (softmax - onehot_masked) * dloss`` on each shard, with label
+smoothing exactly as the reference's ``label_smoothing`` branch computes
+it — but from (logits, max, sum_exp) residuals with the softmax recomputed
+in the backward pass (the ops/xentropy.py memory design) rather than the
+reference's saved fp32 softmax.
 """
 
 from __future__ import annotations
@@ -76,23 +78,25 @@ def _vce_fwd(logits, target, label_smoothing, axis_name):
             vocab * log_sum_exp - sum_logits
         )
 
-    softmax = jnp.exp(lf) / sum_exp[..., None]
-    # dtype witness: backward casts the (large) logits cotangent back to the
-    # input dtype (bf16 logits must not get an fp32 gradient tensor)
-    witness = jnp.zeros((), logits.dtype)
-    return loss, (softmax, in_shard, t_idx, witness)
+    # Residuals: the input logits (aliasing the unembedding gemm's output —
+    # no extra (..., V/tp) write) plus the O(tokens) stats; backward
+    # recomputes the softmax the way ops/xentropy.py does. Saving the fp32
+    # softmax instead would add a residual 2× the logits' size at bf16 and a
+    # full extra HBM pass to write it.
+    return loss, (logits, m, sum_exp, in_shard, t_idx)
 
 
 def _vce_bwd(label_smoothing, axis_name, res, dloss):
-    softmax, in_shard, t_idx, witness = res
-    per = softmax.shape[-1]
+    logits, m, sum_exp, in_shard, t_idx = res
+    per = logits.shape[-1]
+    sf = jnp.exp(logits.astype(jnp.float32) - m[..., None]) / sum_exp[..., None]
     onehot = jax.nn.one_hot(t_idx, per, dtype=jnp.float32) * in_shard[..., None]
     if label_smoothing > 0:
         vocab = per * (1 if axis_name is None else jax.lax.axis_size(axis_name))
-        grad = softmax - (1.0 - label_smoothing) * onehot - label_smoothing / vocab
+        grad = sf - (1.0 - label_smoothing) * onehot - label_smoothing / vocab
     else:
-        grad = softmax - onehot
-    return (grad * dloss[..., None]).astype(witness.dtype), None
+        grad = sf - onehot
+    return (grad * dloss[..., None]).astype(logits.dtype), None
 
 
 vocab_parallel_cross_entropy.defvjp(_vce_fwd, _vce_bwd)

@@ -303,6 +303,46 @@ class TestCheckpoint:
         for a, e in zip(jax.tree.leaves(restored.params), jax.tree.leaves(params)):
             np.testing.assert_array_equal(a, e)  # bitwise
 
+    def test_autoresume_sigterm_saves_and_resumes(self, tmp_path):
+        """Preemption protocol: SIGTERM sets the flag, check_and_save writes
+        the TrainState, a fresh run restores it bitwise (reference's ADLR
+        auto-resume stub, here self-contained)."""
+        import signal
+
+        from apex_tpu.checkpoint import (
+            AutoResume, TrainState, restore_checkpoint)
+        from apex_tpu.transformer.pipeline_parallel.utils import (
+            check_adlr_autoresume_termination, get_autoresume)
+
+        guard = AutoResume()
+        try:
+            params = {"w": jr.normal(K, (3, 3))}
+            state = TrainState(step=jnp.asarray(11), params=params,
+                               opt_state=())
+            path = os.path.join(str(tmp_path), "preempt")
+            assert not guard.termination_requested()
+            assert guard.check_and_save(path, state) is False
+            os.kill(os.getpid(), signal.SIGTERM)  # simulated preemption
+            assert guard.termination_requested()
+            assert guard.check_and_save(path, state) is True
+            restored = restore_checkpoint(
+                path, jax.tree.map(jnp.zeros_like, state))
+            assert int(restored.step) == 11
+            np.testing.assert_array_equal(restored.params["w"], params["w"])
+        finally:
+            guard.uninstall()
+
+        # reference-spelling wrapper honours the check interval
+        g = get_autoresume()
+        try:
+            assert check_adlr_autoresume_termination(
+                3, state, os.path.join(str(tmp_path), "p2"), interval=2) is False
+            g.request_termination()
+            assert check_adlr_autoresume_termination(
+                4, state, os.path.join(str(tmp_path), "p2"), interval=2) is True
+        finally:
+            g.uninstall()
+
     def test_amp_state_dict_parity(self):
         from apex_tpu.amp.scaler import init_loss_scaler
         from apex_tpu.checkpoint import amp_load_state_dict, amp_state_dict

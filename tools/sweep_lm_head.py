@@ -1,0 +1,44 @@
+"""One-off sweep: does the lighter vocab-parallel-CE residual (logits+stats
+instead of fp32 softmax) unlock remat=False or batch 32 on the flagship
+bench shape? Prints ms/step per config."""
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import build
+
+
+def run(tag, cfg, batch, iters=8):
+    tokens = jr.randint(jr.PRNGKey(1), (batch, 1024), 0, cfg["vocab_size"])
+    targets = jr.randint(jr.PRNGKey(2), (batch, 1024), 0, cfg["vocab_size"])
+    try:
+        step, params, opt_state = build("fused", cfg, donate=True)
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, loss = step(params, opt_state, tokens, targets)
+        float(loss)
+        dt = (time.perf_counter() - t0) / iters
+        print(f"{tag}: {dt*1e3:.1f} ms/step  {batch*1024/dt:,.0f} tok/s")
+    except Exception as e:
+        print(f"{tag}: FAILED {type(e).__name__}: {str(e)[:160]}")
+
+
+BASE = dict(vocab_size=32768, max_seq_len=1024, hidden_size=1024,
+            num_layers=12, num_heads=16, tp_size=1, remat=True,
+            attention_impl="flash", remat_policy="mlp_only",
+            scan_layers=False)
+
+if __name__ == "__main__":
+    import os
+    os.environ["APEX_TPU_PALLAS"] = "1"
+    run("b16 remat=mlp_only", BASE, 16)
+    run("b16 remat=False", dict(BASE, remat=False), 16)
+    run("b32 remat=mlp_only", BASE, 32)
+    run("b32 remat=False", dict(BASE, remat=False), 32)
