@@ -11,8 +11,9 @@ reference persists amp's per-loss scaler state (``amp.state_dict()``
 Here one ``TrainState`` pytree holds (master params, optimizer state, loss
 scaler state, step) and round-trips through orbax — saving the *fp32
 masters* (like the O2 hook) so resume is bitwise regardless of the compute
-dtype. ``save``/``restore`` are synchronous; pass an
-``orbax.checkpoint.CheckpointManager`` for async/rotation policies.
+dtype. ``save``/``restore`` are synchronous; :class:`CheckpointManager`
+below adds async saves and ``max_to_keep`` rotation, and
+:class:`AutoResume` the save-on-preemption protocol.
 """
 
 from __future__ import annotations
@@ -58,6 +59,56 @@ def restore_checkpoint(path: str, template: TrainState) -> TrainState:
         raise RuntimeError("orbax is unavailable in this environment")
     ckpt = ocp.StandardCheckpointer()
     return ckpt.restore(path, template)
+
+
+class CheckpointManager:
+    """Rotating, optionally-async checkpoints over :class:`TrainState` —
+    beyond the reference's library-level state dicts (its trainers save
+    synchronously with ``torch.save``): ``save`` returns once the on-device
+    state is snapshotted and the write overlaps subsequent train steps;
+    ``max_to_keep`` rotates old steps out. Thin policy layer over
+    ``orbax.checkpoint.CheckpointManager`` so :class:`AutoResume` and the
+    bitwise-resume guarantees of :func:`save_checkpoint` carry over.
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 async_save: bool = True, save_interval_steps: int = 1):
+        if not _HAS_ORBAX:
+            raise RuntimeError("orbax is unavailable in this environment")
+        self._mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=async_save,
+            ),
+        )
+
+    def save(self, step: int, state: TrainState) -> bool:
+        """Returns False when skipped by ``save_interval_steps``."""
+        return self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, template: TrainState,
+                step: Optional[int] = None) -> TrainState:
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint to restore")
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(template))
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 # --- auto-resume / preemption (pipeline_parallel/utils.py:142-144) ------------

@@ -149,6 +149,7 @@ def forward_backward_no_pipelining(
     microbatches: Any,
     *,
     grad_reduce_axis: Optional[str] = None,
+    accum_dtype=jnp.float32,
 ):
     """Grad accumulation over microbatches without pipelining
     (``fwd_bwd_no_pipelining.py:31``): the reference defers the DDP grad
@@ -156,17 +157,31 @@ def forward_backward_no_pipelining(
     single ``psum`` (if ``grad_reduce_axis``) happens once at the end —
     the same once-per-step communication.
 
+    ``accum_dtype``: the accumulator's dtype, fp32 by default — the
+    reference's ``main_grad`` semantics (wgrads accumulate into a
+    persistent fp32 buffer even for half params,
+    ``tensor_parallel/layers.py:259-315`` /
+    ``csrc/megatron/fused_weight_gradient_dense.cpp:19-20``); with M
+    microbatches of bf16 grads a bf16 accumulator would lose up to
+    log2(M) bits of the sum. Pass ``None`` to accumulate in each param's
+    own dtype. The scan's donated carry keeps the buffer in place — no
+    per-microbatch HBM round trip beyond the grads themselves.
+
     ``loss_fn(params, microbatch) -> scalar mean loss``; returns
-    (mean loss, grads averaged over microbatches).
+    (mean loss, grads averaged over microbatches, in ``accum_dtype``).
     """
     vg = jax.value_and_grad(loss_fn)
 
     def step(acc, mb):
         loss, g = vg(params, mb)
         acc_loss, acc_g = acc
-        return (acc_loss + loss, jax.tree.map(jnp.add, acc_g, g)), None
+        return (acc_loss + loss,
+                jax.tree.map(lambda a, x: a + x.astype(a.dtype), acc_g, g)), None
 
-    zero = (jnp.zeros(()), jax.tree.map(jnp.zeros_like, params))
+    def zeros_like_acc(p):
+        return jnp.zeros(p.shape, accum_dtype or p.dtype)
+
+    zero = (jnp.zeros(()), jax.tree.map(zeros_like_acc, params))
     (loss_sum, grad_sum), _ = jax.lax.scan(step, zero, microbatches)
     n = jax.tree.leaves(microbatches)[0].shape[0]
     loss = loss_sum / n
@@ -177,6 +192,31 @@ def forward_backward_no_pipelining(
     return loss, grads
 
 
+def _main_grad_cast(params, accum_dtype):
+    """fp32 main-grad accumulation for the scanned schedules: upcast the
+    params the autodiff differentiates, and re-cast to the compute dtype
+    *inside* each pipeline tick — the scan transpose then accumulates the
+    per-tick cotangents in ``accum_dtype`` (the reference's persistent fp32
+    ``main_grad`` buffer, ``tensor_parallel/layers.py:259-315``), while every
+    tick still computes in the params' own dtype. Returns
+    (upcast params, per-tick downcast fn)."""
+    if accum_dtype is None:
+        return params, lambda p: p
+
+    def up(x):
+        return (x.astype(accum_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x)
+
+    def down(p):
+        return jax.tree.map(
+            lambda x, like: (x.astype(like.dtype)
+                             if jnp.issubdtype(like.dtype, jnp.floating)
+                             else x),
+            p, params)
+
+    return jax.tree.map(up, params), down
+
+
 def forward_backward_pipelining_without_interleaving(
     stage_fn: Callable,
     loss_head: Callable[[jax.Array, Any], jax.Array],
@@ -185,6 +225,7 @@ def forward_backward_pipelining_without_interleaving(
     targets: Any,
     *,
     axis_name: str = mesh_lib.PIPELINE_AXIS,
+    accum_dtype=jnp.float32,
 ):
     """1F1B-equivalent schedule (``fwd_bwd_pipelining_without_interleaving.py:155``):
     pipelined forward via scan+ppermute, backward from autodiff, stage remat
@@ -193,17 +234,20 @@ def forward_backward_pipelining_without_interleaving(
     ``loss_head(outputs_m, targets_m) -> scalar`` maps a final-stage output
     microbatch + its targets to a loss (the reference's last-stage
     ``loss_func``, ``schedules/common.py:297-301``).
-    Returns (mean loss, grads wrt stage_params).
+    Returns (mean loss, grads wrt stage_params in ``accum_dtype`` — see
+    :func:`_main_grad_cast`; ``None`` accumulates in the params' dtype).
     """
+    p_acc, down = _main_grad_cast(stage_params, accum_dtype)
 
     def full_loss(p):
         outs = pipeline_spmd_forward(
-            stage_fn, p, microbatches, axis_name=axis_name, remat=True
+            lambda pp, x: stage_fn(down(pp), x), p, microbatches,
+            axis_name=axis_name, remat=True
         )
         losses = jax.vmap(loss_head)(outs, targets)
         return jnp.mean(losses)
 
-    return jax.value_and_grad(full_loss)(stage_params)
+    return jax.value_and_grad(full_loss)(p_acc)
 
 
 def forward_backward_pipelining_with_interleaving(
@@ -215,6 +259,7 @@ def forward_backward_pipelining_with_interleaving(
     *,
     virtual_chunks: int,
     axis_name: str = mesh_lib.PIPELINE_AXIS,
+    accum_dtype=jnp.float32,
 ):
     """Interleaved (virtual-stage) schedule
     (``fwd_bwd_pipelining_with_interleaving.py:25-375``): each device holds
@@ -222,15 +267,19 @@ def forward_backward_pipelining_with_interleaving(
     loops around the device ring. ``stage_params_chunks`` leaves carry a
     leading (virtual_chunks,) axis."""
 
+    p_acc, down = _main_grad_cast(stage_params_chunks, accum_dtype)
+
     def full_loss(p):
         outs = pipeline_spmd_forward(
-            stage_fn, p, microbatches,
+            # down only consults leaf dtypes, so it composes with the
+            # per-chunk vmap inside pipeline_spmd_forward
+            lambda pp, x: stage_fn(down(pp), x), p, microbatches,
             axis_name=axis_name, virtual_chunks=virtual_chunks, remat=True,
         )
         losses = jax.vmap(loss_head)(outs, targets)
         return jnp.mean(losses)
 
-    return jax.value_and_grad(full_loss)(stage_params_chunks)
+    return jax.value_and_grad(full_loss)(p_acc)
 
 
 def get_forward_backward_func(

@@ -18,6 +18,16 @@ from apex_tpu.parallel import mesh as mesh_lib
 
 K = jr.PRNGKey(33)
 
+# On real TPU, fp32 matmuls go through the MXU with bf16-rounded operands at
+# the default precision — both the kernels and the dense oracle carry
+# ~1e-3-scale rounding the CPU (true-fp32) run doesn't, so the hardware run
+# checks kernel-vs-oracle agreement at that scale, not fp32 exactness.
+_EXACT = jax.default_backend() != "tpu"
+ATOL = 2e-5 if _EXACT else 3e-3
+RTOL = 2e-5 if _EXACT else 3e-3
+G_ATOL = 2e-5 if _EXACT else 5e-3
+G_RTOL = 2e-4 if _EXACT else 5e-3
+
 
 def dense_ref(q, k, v, causal, scale=None):
     d = q.shape[-1]
@@ -37,7 +47,7 @@ class TestFlashAttention:
         k = jr.normal(jr.fold_in(K, 1), (2, 4, 64, 32))
         v = jr.normal(jr.fold_in(K, 2), (2, 4, 64, 32))
         o = flash_attention(q, k, v, causal=causal)
-        np.testing.assert_allclose(o, dense_ref(q, k, v, causal), rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(o, dense_ref(q, k, v, causal), rtol=RTOL, atol=ATOL)
 
     @pytest.mark.parametrize("causal", [False, True])
     def test_grads_match_dense(self, causal):
@@ -49,7 +59,7 @@ class TestFlashAttention:
         g1 = jax.grad(f1, argnums=(0, 1, 2))(q, k, v)
         g2 = jax.grad(f2, argnums=(0, 1, 2))(q, k, v)
         for a, e in zip(g1, g2):
-            np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-5)
+            np.testing.assert_allclose(a, e, rtol=G_RTOL, atol=G_ATOL)
 
     def test_long_sequence_beyond_reference_cap(self):
         # fmha caps at 512 and fused softmax at 2048; we run 4096
@@ -61,16 +71,22 @@ class TestFlashAttention:
     @pytest.mark.pallas
     @pytest.mark.parametrize("causal", [False, True])
     def test_pallas_kernel_fwd_bwd(self, causal, monkeypatch):
+        # interpret mode checks the kernel's LOGIC, not hardware numerics —
+        # force true-fp32 dots so the check is exact on TPU too (at default
+        # precision the kernel's MXU dp and the elementwise delta disagree
+        # by ~1e-3 exactly where the causal grad is identically zero)
         monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
         q = jr.normal(K, (1, 256, 64)).astype(jnp.float32)
         k = jr.normal(jr.fold_in(K, 5), (1, 256, 64))
         v = jr.normal(jr.fold_in(K, 6), (1, 256, 64))
-        o = flash_attention(q, k, v, causal=causal, impl="pallas")
-        np.testing.assert_allclose(o, dense_ref(q, k, v, causal), rtol=2e-5, atol=2e-5)
-        f1 = lambda q, k, v: jnp.sum(jnp.cos(flash_attention(q, k, v, causal=causal, impl="pallas")))
-        f2 = lambda q, k, v: jnp.sum(jnp.cos(dense_ref(q, k, v, causal)))
-        g1 = jax.grad(f1, argnums=(0, 1, 2))(q, k, v)
-        g2 = jax.grad(f2, argnums=(0, 1, 2))(q, k, v)
+        with jax.default_matmul_precision("highest"):
+            o = flash_attention(q, k, v, causal=causal, impl="pallas")
+            np.testing.assert_allclose(o, dense_ref(q, k, v, causal),
+                                       rtol=2e-5, atol=2e-5)
+            f1 = lambda q, k, v: jnp.sum(jnp.cos(flash_attention(q, k, v, causal=causal, impl="pallas")))
+            f2 = lambda q, k, v: jnp.sum(jnp.cos(dense_ref(q, k, v, causal)))
+            g1 = jax.grad(f1, argnums=(0, 1, 2))(q, k, v)
+            g2 = jax.grad(f2, argnums=(0, 1, 2))(q, k, v)
         for a, e in zip(g1, g2):
             np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-4)
 
@@ -92,7 +108,7 @@ class TestRingAttention:
             out_specs=P(None, "cp"),
         )(q, k, v)
         np.testing.assert_allclose(
-            o, dense_ref(q, k, v, causal), rtol=2e-5, atol=2e-5
+            o, dense_ref(q, k, v, causal), rtol=RTOL, atol=ATOL
         )
 
     def test_grads_flow(self):
@@ -121,7 +137,7 @@ class TestRingAttention:
             lambda q, k, v: jnp.sum(dense_ref(q, k, v, True) ** 2), argnums=(0, 1, 2)
         )(q, k, v)
         for a, e in zip(g, gref):
-            np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-5)
+            np.testing.assert_allclose(a, e, rtol=G_RTOL, atol=G_ATOL)
 
 
 class TestUlyssesAttention:
@@ -146,7 +162,7 @@ class TestUlyssesAttention:
         # oracle: per-head dense attention over the full sequence
         ref = dense_ref(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                         v.transpose(0, 2, 1, 3), causal).transpose(0, 2, 1, 3)
-        np.testing.assert_allclose(o, ref, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(o, ref, rtol=RTOL, atol=ATOL)
 
     def test_grads_match_dense(self):
         sp = 4
@@ -172,7 +188,7 @@ class TestUlyssesAttention:
             return jnp.sum(o * o)
         gref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
         for a, e in zip(g, gref):
-            np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-5)
+            np.testing.assert_allclose(a, e, rtol=G_RTOL, atol=G_ATOL)
 
     def test_heads_not_divisible_raises(self):
         sp = 4

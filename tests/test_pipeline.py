@@ -123,6 +123,46 @@ class TestPipelineSPMD:
         for a, e in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads)):
             np.testing.assert_allclose(a, e, rtol=1e-4, atol=1e-5)
 
+    def test_1f1b_bf16_params_accumulate_fp32_main_grad(self):
+        """Pipelined schedules share the fp32 main-grad accumulation: bf16
+        stage params yield fp32 grads that match the serial oracle."""
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=4)
+        plist = make_stage_params(jr.fold_in(K, 40), 4)
+        stacked = jax.tree.map(lambda x: x.astype(jnp.bfloat16),
+                               stack_params(plist))
+        mbs = jr.normal(jr.fold_in(K, 41), (4, 2, HID)).astype(jnp.bfloat16)
+        tgts = jr.normal(jr.fold_in(K, 42), (4, 2, HID)).astype(jnp.bfloat16)
+
+        def loss_head(out, tgt):
+            return jnp.mean((out.astype(jnp.float32)
+                             - tgt.astype(jnp.float32)) ** 2)
+
+        def run(p, m, t):
+            loss, g = schedules.forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_head, jax.tree.map(lambda x: x[0], p), m, t
+            )
+            return loss, jax.tree.map(lambda x: x[None], g)
+
+        loss, grads = mesh_lib.shard_map(
+            run,
+            mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P("pp"), stacked), P(), P()),
+            out_specs=(P(), jax.tree.map(lambda _: P("pp"), stacked)),
+        )(stacked, mbs, tgts)
+        assert all(g.dtype == jnp.float32 for g in jax.tree.leaves(grads))
+
+        def serial_loss(stacked_p):
+            plist_l = [jax.tree.map(lambda x: x[i], stacked_p)
+                       for i in range(4)]
+            outs = jax.vmap(lambda m: serial_forward(plist_l, m))(mbs)
+            return jnp.mean(jax.vmap(loss_head)(outs, tgts))
+
+        _, ref_grads = jax.value_and_grad(serial_loss)(
+            jax.tree.map(lambda x: x.astype(jnp.float32), stacked))
+        for a, e in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads)):
+            # bf16 per-tick rounding bounds the agreement, not accumulation
+            np.testing.assert_allclose(a, e, rtol=0.06, atol=6e-3)
+
     def test_interleaved_matches_serial(self):
         # pp=2 devices, 2 virtual chunks each → 4 virtual stages
         mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=2)
@@ -190,6 +230,41 @@ class TestPipelineSPMD:
         ref_loss, ref_grad = jax.value_and_grad(ref)(w)
         np.testing.assert_allclose(loss, ref_loss, rtol=1e-6)
         np.testing.assert_allclose(grads, ref_grad, rtol=1e-5, atol=1e-6)
+
+    def test_no_pipelining_fp32_main_grad_accumulation(self):
+        """bf16 params: the accumulator is fp32 by default (the reference's
+        main_grad semantics) so many small microbatch grads don't cancel in
+        bf16; accum_dtype=None degrades to param-dtype accumulation."""
+        w = (jr.normal(K, (HID, HID)) * 0.1).astype(jnp.bfloat16)
+        # 64 microbatches of tiny grads — a bf16 accumulator swallows them
+        mbs = (jr.normal(jr.fold_in(K, 9), (64, 2, HID)) * 1e-2
+               ).astype(jnp.bfloat16)
+
+        def loss_fn(w, mb):
+            return jnp.mean((mb.astype(jnp.float32) @ w.astype(jnp.float32))
+                            ** 2)
+
+        loss, grads = schedules.forward_backward_no_pipelining(
+            loss_fn, w, mbs)
+        assert jax.tree.leaves(grads)[0].dtype == jnp.float32
+
+        def ref(w):
+            return jnp.mean(jax.vmap(lambda m: loss_fn(w, m))(mbs))
+
+        _, ref_grad = jax.value_and_grad(ref)(w)
+        rel = (jnp.abs(grads - ref_grad.astype(jnp.float32)).max()
+               / jnp.abs(ref_grad).max())
+        _, g_bf16 = schedules.forward_backward_no_pipelining(
+            loss_fn, w, mbs, accum_dtype=None)
+        assert g_bf16.dtype == jnp.bfloat16
+        rel_bf16 = (jnp.abs(g_bf16.astype(jnp.float32)
+                            - ref_grad.astype(jnp.float32)).max()
+                    / jnp.abs(ref_grad).max())
+        # each microbatch grad is itself bf16-rounded (the cotangent casts
+        # back at the astype boundary), so fp32 accumulation can't be exact
+        # — but it must beat accumulating in bf16 by a clear margin
+        assert rel < 5e-3
+        assert rel_bf16 > 2 * rel  # bf16 accumulation visibly loses bits
 
     def test_dispatcher(self):
         f = schedules.get_forward_backward_func(None, 1)
