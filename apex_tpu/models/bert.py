@@ -103,9 +103,9 @@ class BertModel:
         c = self.config
         b, s, _ = x.shape
         h, d = c.local_heads, c.head_dim
-        qkv = self.qkv(p["qkv"], x).reshape(b, s, h, 3 * d)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        # grouped (3, h, d) local packing — see models/gpt.py:_attention
+        qkv = self.qkv(p["qkv"], x).reshape(b, s, 3, h, d)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
         # mask: (b, 1, 1, s) True = masked out (padding)
         mask = None if pad_mask is None else pad_mask[:, None, None, :]

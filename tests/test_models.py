@@ -111,16 +111,19 @@ def _shard_layer_leaf(path, x, tp, cfg):
     L = x.shape[0]
     heads = cfg.num_heads
     if "qkv" in name and "weight" in name:
+        # dense output features are (3, heads, d) grouped — each TP shard
+        # takes its head range from every q/k/v group
         per = heads // tp
-        y = x.reshape(L, heads, -1, x.shape[-1])
+        y = x.reshape(L, 3, heads, -1, x.shape[-1])
         return jnp.stack(
-            [y[:, i * per:(i + 1) * per].reshape(L, -1, x.shape[-1]) for i in range(tp)]
+            [y[:, :, i * per:(i + 1) * per].reshape(L, -1, x.shape[-1])
+             for i in range(tp)]
         )
     if "qkv" in name and "bias" in name:
         per = heads // tp
-        y = x.reshape(L, heads, -1)
+        y = x.reshape(L, 3, heads, -1)
         return jnp.stack(
-            [y[:, i * per:(i + 1) * per].reshape(L, -1) for i in range(tp)]
+            [y[:, :, i * per:(i + 1) * per].reshape(L, -1) for i in range(tp)]
         )
     if "mlp_up" in name and "weight" in name:
         return jnp.stack(jnp.split(x, tp, axis=1))
