@@ -1,6 +1,11 @@
-"""Megatron-style batch samplers — re-design of ``apex/transformer/_data/``."""
+"""Megatron-style batch samplers — re-design of ``apex/transformer/_data/``
+— plus host→device prefetching (the torch-DataLoader overlap, TPU-style)."""
 
 from apex_tpu.transformer._data._batchsampler import (  # noqa: F401
     MegatronPretrainingRandomSampler,
     MegatronPretrainingSampler,
+)
+from apex_tpu.transformer._data.prefetch import (  # noqa: F401
+    data_parallel_iterator,
+    prefetch_to_device,
 )

@@ -220,6 +220,36 @@ class TestBatchSamplers:
         assert all(16 <= i < 32 for i in flat)  # rank-1 bucket
 
 
+class TestPrefetch:
+    def test_prefetch_order_and_device(self):
+        from apex_tpu.transformer._data import prefetch_to_device
+
+        batches = [{"x": np.full((4, 3), i, np.float32)} for i in range(7)]
+        out = list(prefetch_to_device(iter(batches), size=2))
+        assert len(out) == 7
+        for i, b in enumerate(out):
+            assert isinstance(b["x"], jax.Array)
+            np.testing.assert_array_equal(b["x"], batches[i]["x"])
+
+    def test_data_parallel_iterator_shards_batch(self):
+        from apex_tpu.transformer._data import data_parallel_iterator
+        from apex_tpu.parallel import mesh as mesh_lib
+
+        mesh_lib.initialize_model_parallel()
+        batches = ({"x": np.arange(16 * 2, dtype=np.float32).reshape(16, 2)}
+                   for _ in range(3))
+        out = list(data_parallel_iterator(batches))
+        assert len(out) == 3
+        shard_shapes = {s.data.shape for s in out[0]["x"].addressable_shards}
+        assert shard_shapes == {(2, 2)}  # 16 rows over dp=8
+
+    def test_size_validation(self):
+        from apex_tpu.transformer._data import prefetch_to_device
+
+        with pytest.raises(ValueError):
+            list(prefetch_to_device(iter([]), size=0))
+
+
 class TestArguments:
     BASE = ["--num-layers", "4", "--hidden-size", "64",
             "--num-attention-heads", "4", "--max-position-embeddings", "128",

@@ -46,6 +46,16 @@ def test_imagenet_example_synthetic():
     assert "img/s" in out or "loss" in out.lower()
 
 
+def test_imagenet_example_prefetched_host_data():
+    """The non-synthetic path: host numpy batches through the
+    double-buffered dp-sharded prefetcher."""
+    out = _run(["examples/imagenet/main_amp.py",
+                "--opt-level", "O2", "--iters", "3", "--lr", "0.001",
+                "--batch-size", "16", "--image-size", "32",
+                "--num-classes", "10"])
+    assert "img/s" in out
+
+
 def test_dcgan_example():
     out = _run(["examples/dcgan/main_amp.py", "--niter", "2",
                 "--iters-per-epoch", "2", "--imageSize", "16",
