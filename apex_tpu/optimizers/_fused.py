@@ -1,23 +1,34 @@
 """Shared scaffolding for the fused optimizers.
 
 Every fused optimizer follows the reference's shape
-(``apex/optimizers/fused_adam.py:98-171``): collect all params into flat
-lists, run ONE fused update over them, write results back. Here the flat list
-is the chunked buffer of :mod:`apex_tpu.optimizers.multi_tensor`, the fused
-update is a pure function ``(g2d, p2d, state2d..., count) -> (new_p2d,
-new_state2d...)`` that XLA compiles to a single fused loop, and the write-back
-is the unflatten. Each optimizer exposes an optax-compatible
-``GradientTransformation`` so it chains with schedules/clipping like any other.
+(``apex/optimizers/fused_adam.py:98-171``): collect all params, run ONE
+fused update over them, write results back. Math is fp32 regardless of
+param dtype (``MATH_T = float`` in every reference kernel, e.g.
+``csrc/multi_tensor_adam.cu``); updates are cast back to each param's
+dtype. Each optimizer exposes an optax-compatible
+``GradientTransformation`` so it chains with schedules/clipping like any
+other.
 
-Math is fp32 regardless of param dtype (``MATH_T = float`` in every reference
-kernel, e.g. ``csrc/multi_tensor_adam.cu``); updates are cast back to each
-param's dtype at unflatten.
+Two layouts implement that contract:
+
+* ``per_tensor`` (default): the update formula maps over the param pytree;
+  XLA fuses the whole per-leaf elementwise forest into a handful of loops.
+  The reference's multi-tensor *launcher* exists to amortize CUDA kernel
+  dispatch over thousands of tensors — on TPU there is no per-tensor
+  dispatch to amortize, and honest carry-loop timing (tools/microbench.py)
+  showed the chunked path's flatten/unflatten costing two full HBM passes:
+  18.4 vs 4.0 ms per step against per-tensor optax on a 186M-param GPT
+  pytree, ~19 ms/step on the flagship bench.
+* ``chunked``: the :mod:`apex_tpu.optimizers.multi_tensor` mega-buffer —
+  the reference's semantic twin and the substrate the ZeRO-style
+  distributed optimizers shard over (there the flat buffer pays for itself
+  as the reduce-scatter/all-gather layout).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +55,92 @@ def schedule_value(lr, count):
     here is the post-increment 1-based counter kernels use for bias
     correction, so schedules see ``count - 1``."""
     return lr(count - 1) if callable(lr) else jnp.asarray(lr, jnp.float32)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PerTensorState:
+    """Optimizer state as fp32 pytrees mirroring the params."""
+
+    count: jax.Array                 # i32 step counter
+    buffers: Dict[str, PyTree]       # name -> pytree of f32 leaves
+    scalars: Dict[str, PyTree]       # name -> pytree of f32 scalars
+
+
+def resolve_layout(layout: str, chunk_size=None) -> str:
+    """``auto`` → per_tensor (measured: see module docstring) — unless the
+    caller explicitly tuned ``chunk_size``, which only the chunked engine
+    honors; silently ignoring it would be worse than taking the hint."""
+    if layout == "auto":
+        return "chunked" if chunk_size is not None else "per_tensor"
+    if layout not in ("per_tensor", "chunked"):
+        raise ValueError(
+            f"layout must be auto|per_tensor|chunked, got {layout!r}")
+    return layout
+
+
+def make_per_tensor_transform(
+    *,
+    state_buffers: tuple,
+    leaf_kernel: Callable[..., tuple],
+    global_stats: Optional[Callable] = None,
+    state_scalars: tuple = (),
+) -> optax.GradientTransformation:
+    """Build a GradientTransformation from a per-leaf fp32 update.
+
+    ``leaf_kernel(g32, p32, bufs: dict, scal: dict, count, stats) ->
+    (new_p32, new_bufs, new_scal)`` runs on each leaf; ``global_stats``
+    (optional) maps the full fp32 grad pytree to a value passed to every
+    leaf (e.g. LAMB's global grad norm).
+    """
+
+    def init_fn(params):
+        buffers = {
+            name: jax.tree.map(
+                lambda x: jnp.zeros(x.shape, jnp.float32), params)
+            for name in state_buffers
+        }
+        scalars = {
+            name: jax.tree.map(lambda x: jnp.zeros((), jnp.float32), params)
+            for name in state_scalars
+        }
+        return PerTensorState(
+            count=jnp.zeros((), jnp.int32), buffers=buffers, scalars=scalars)
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused optimizers require params")
+        count = state.count + 1
+        g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        stats = global_stats(g32, count) if global_stats else None
+
+        leaves_g, treedef = jax.tree.flatten(g32)
+        leaves_p = jax.tree.leaves(params)
+        bufs = {n: jax.tree.leaves(state.buffers[n]) for n in state_buffers}
+        scal = {n: jax.tree.leaves(state.scalars[n]) for n in state_scalars}
+        upd, new_bufs, new_scal = [], {n: [] for n in state_buffers}, \
+            {n: [] for n in state_scalars}
+        for i, (g, p) in enumerate(zip(leaves_g, leaves_p)):
+            p32 = p.astype(jnp.float32)
+            nb = {n: bufs[n][i] for n in state_buffers}
+            ns = {n: scal[n][i] for n in state_scalars}
+            new_p, nb, ns = leaf_kernel(g, p32, nb, ns, count, stats)
+            upd.append((new_p - p32).astype(p.dtype))
+            for n in state_buffers:
+                new_bufs[n].append(nb[n])
+            for n in state_scalars:
+                new_scal[n].append(ns[n])
+
+        new_state = PerTensorState(
+            count=count,
+            buffers={n: jax.tree.unflatten(treedef, new_bufs[n])
+                     for n in state_buffers},
+            scalars={n: jax.tree.unflatten(treedef, new_scal[n])
+                     for n in state_scalars},
+        )
+        return jax.tree.unflatten(treedef, upd), new_state
+
+    return optax.GradientTransformation(init_fn, update_fn)
 
 
 def make_fused_transform(

@@ -14,7 +14,9 @@ import jax.numpy as jnp
 import optax
 
 from apex_tpu.optimizers import multi_tensor as mt
-from apex_tpu.optimizers._fused import make_fused_transform, schedule_value
+from apex_tpu.optimizers._fused import (
+    make_fused_transform, make_per_tensor_transform, resolve_layout,
+    schedule_value)
 
 
 def fused_adagrad(
@@ -22,7 +24,8 @@ def fused_adagrad(
     eps: float = 1e-10,
     weight_decay: float = 0.0,
     adagrad_w_mode: bool = False,
-    chunk_size: int = mt.DEFAULT_CHUNK,
+    chunk_size: int = None,  # explicit value implies layout='chunked'
+    layout: str = "auto",
 ) -> optax.GradientTransformation:
     def kernel(g, p, buffers, scalars, count, layout):
         h = buffers["h"]
@@ -35,7 +38,13 @@ def fused_adagrad(
         lr = schedule_value(learning_rate, count)
         return p - lr * update, {"h": h}, scalars
 
-    return make_fused_transform(state_buffers=("h",), kernel=kernel, chunk_size=chunk_size)
+    if resolve_layout(layout, chunk_size) == "per_tensor":
+        return make_per_tensor_transform(
+            state_buffers=("h",),
+            leaf_kernel=lambda g, p, b, sc, c, stats: kernel(g, p, b, sc, c, None),
+        )
+
+    return make_fused_transform(state_buffers=("h",), kernel=kernel, chunk_size=chunk_size or mt.DEFAULT_CHUNK)
 
 
 FusedAdagrad = fused_adagrad

@@ -20,7 +20,9 @@ import jax.numpy as jnp
 import optax
 
 from apex_tpu.optimizers import multi_tensor as mt
-from apex_tpu.optimizers._fused import make_fused_transform, schedule_value
+from apex_tpu.optimizers._fused import (
+    make_fused_transform, make_per_tensor_transform, resolve_layout,
+    schedule_value)
 
 
 def fused_adam(
@@ -31,10 +33,10 @@ def fused_adam(
     weight_decay: float = 0.0,
     adam_w_mode: bool = True,
     bias_correction: bool = True,
-    chunk_size: int = mt.DEFAULT_CHUNK,
+    chunk_size: int = None,  # explicit value implies layout='chunked'
+    layout: str = "auto",
 ) -> optax.GradientTransformation:
-    def kernel(g, p, buffers, scalars, count, layout):
-        m, v = buffers["m"], buffers["v"]
+    def adam_math(g, p, m, v, count):
         step = count.astype(jnp.float32)
         if not adam_w_mode and weight_decay:
             g = g + weight_decay * p
@@ -49,10 +51,22 @@ def fused_adam(
         if adam_w_mode and weight_decay:
             update = update + weight_decay * p
         lr = schedule_value(learning_rate, count)
-        return p - lr * update, {"m": m, "v": v}, scalars
+        return p - lr * update, m, v
+
+    if resolve_layout(layout, chunk_size) == "per_tensor":
+        def leaf_kernel(g, p, bufs, scal, count, stats):
+            new_p, m, v = adam_math(g, p, bufs["m"], bufs["v"], count)
+            return new_p, {"m": m, "v": v}, scal
+
+        return make_per_tensor_transform(
+            state_buffers=("m", "v"), leaf_kernel=leaf_kernel)
+
+    def kernel(g, p, buffers, scalars, count, layout_):
+        new_p, m, v = adam_math(g, p, buffers["m"], buffers["v"], count)
+        return new_p, {"m": m, "v": v}, scalars
 
     return make_fused_transform(
-        state_buffers=("m", "v"), kernel=kernel, chunk_size=chunk_size
+        state_buffers=("m", "v"), kernel=kernel, chunk_size=chunk_size or mt.DEFAULT_CHUNK
     )
 
 

@@ -21,26 +21,26 @@ from __future__ import annotations
 import jax.numpy as jnp
 import optax
 
+import jax
+
 from apex_tpu.optimizers import multi_tensor as mt
-from apex_tpu.optimizers._fused import make_fused_transform, schedule_value
+from apex_tpu.optimizers._fused import (
+    make_fused_transform, make_per_tensor_transform, resolve_layout,
+    schedule_value)
 
 
-def lamb_chunked_update(
-    g, p, m, v, count, layout, *,
+def lamb_update_math(
+    g, p, m, v, count, clipped, *, sqnorm, broadcast,
     learning_rate, b1, b2, eps, weight_decay, bias_correction,
-    grad_averaging, max_grad_norm, use_nvlamb,
+    grad_averaging, use_nvlamb,
 ):
-    """The two-phase LAMB math over chunked buffers; shared by
-    :func:`fused_lamb` and ``fused_mixed_precision_lamb``.
-
-    Returns ``(new_p, new_m, new_v)``.
-    """
+    """Phase-2 LAMB math, layout-injected: ``sqnorm(t)`` returns per-tensor
+    squared norms and ``broadcast(r)`` expands per-tensor scalars back to
+    ``t``'s shape — identity/scalar for the per-tensor layout, segment ops
+    for the chunked buffer. One copy of the formula serves both layouts and
+    ``fused_mixed_precision_lamb``. Returns ``(new_p, new_m, new_v)``."""
     step = count.astype(jnp.float32)
     beta3 = 1.0 - b1 if grad_averaging else 1.0
-
-    # phase 1: global norm + clip (fused_lamb.py:120-141, lamb.cu:66)
-    gnorm = mt.global_norm(g)
-    clipped = jnp.where(gnorm > max_grad_norm, gnorm / max_grad_norm, 1.0)
     g = g / clipped
 
     m = b1 * m + beta3 * g
@@ -54,15 +54,43 @@ def lamb_chunked_update(
     if weight_decay:
         update = update + weight_decay * p
 
-    # phase 2: per-tensor trust ratios (lamb.cu:244-262)
-    p_norm = jnp.sqrt(mt.per_tensor_sqnorm(p, layout))
-    u_norm = jnp.sqrt(mt.per_tensor_sqnorm(update, layout))
+    # per-tensor trust ratios (lamb.cu:244-262)
+    p_norm = jnp.sqrt(sqnorm(p))
+    u_norm = jnp.sqrt(sqnorm(update))
     lr = schedule_value(learning_rate, count)
     if use_nvlamb or weight_decay != 0.0:
-        ratio = jnp.where((p_norm > 0.0) & (u_norm > 0.0), lr * p_norm / u_norm, lr)
+        ratio = jnp.where((p_norm > 0.0) & (u_norm > 0.0),
+                          lr * p_norm / u_norm,
+                          jnp.full_like(p_norm, lr))
     else:
         ratio = jnp.full_like(p_norm, lr)
-    return p - mt.broadcast_per_tensor(ratio, layout) * update, m, v
+    return p - broadcast(ratio) * update, m, v
+
+
+def clip_by_global_norm(gnorm, max_grad_norm):
+    """phase 1's divisor (fused_lamb.py:120-141, lamb.cu:66)."""
+    return jnp.where(gnorm > max_grad_norm, gnorm / max_grad_norm, 1.0)
+
+
+def lamb_chunked_update(
+    g, p, m, v, count, layout, *,
+    learning_rate, b1, b2, eps, weight_decay, bias_correction,
+    grad_averaging, max_grad_norm, use_nvlamb,
+):
+    """The two-phase LAMB math over chunked buffers; shared by
+    :func:`fused_lamb` and ``fused_mixed_precision_lamb``.
+
+    Returns ``(new_p, new_m, new_v)``.
+    """
+    clipped = clip_by_global_norm(mt.global_norm(g), max_grad_norm)
+    return lamb_update_math(
+        g, p, m, v, count, clipped,
+        sqnorm=lambda t: mt.per_tensor_sqnorm(t, layout),
+        broadcast=lambda r: mt.broadcast_per_tensor(r, layout),
+        learning_rate=learning_rate, b1=b1, b2=b2, eps=eps,
+        weight_decay=weight_decay, bias_correction=bias_correction,
+        grad_averaging=grad_averaging, use_nvlamb=use_nvlamb,
+    )
 
 
 def fused_lamb(
@@ -76,11 +104,33 @@ def fused_lamb(
     adam_w_mode: bool = True,
     max_grad_norm: float = 1.0,
     use_nvlamb: bool = False,
-    chunk_size: int = mt.DEFAULT_CHUNK,
+    chunk_size: int = None,  # explicit value implies layout='chunked'
+    layout: str = "auto",
 ) -> optax.GradientTransformation:
-    def kernel(g, p, buffers, scalars, count, layout):
+    if resolve_layout(layout, chunk_size) == "per_tensor":
+        def global_stats(g32, count):
+            # phase 1: global norm over ALL params (fused_lamb.py:120-141)
+            gnorm = jnp.sqrt(sum(jnp.sum(x * x) for x in jax.tree.leaves(g32)))
+            return clip_by_global_norm(gnorm, max_grad_norm)
+
+        def leaf_kernel(g, p, bufs, scal, count, clipped):
+            new_p, m, v = lamb_update_math(
+                g, p, bufs["m"], bufs["v"], count, clipped,
+                sqnorm=lambda t: jnp.sum(t * t),
+                broadcast=lambda r: r,
+                learning_rate=learning_rate, b1=b1, b2=b2, eps=eps,
+                weight_decay=weight_decay, bias_correction=bias_correction,
+                grad_averaging=grad_averaging, use_nvlamb=use_nvlamb,
+            )
+            return new_p, {"m": m, "v": v}, scal
+
+        return make_per_tensor_transform(
+            state_buffers=("m", "v"), leaf_kernel=leaf_kernel,
+            global_stats=global_stats)
+
+    def kernel(g, p, buffers, scalars, count, layout_):
         new_p, m, v = lamb_chunked_update(
-            g, p, buffers["m"], buffers["v"], count, layout,
+            g, p, buffers["m"], buffers["v"], count, layout_,
             learning_rate=learning_rate, b1=b1, b2=b2, eps=eps,
             weight_decay=weight_decay, bias_correction=bias_correction,
             grad_averaging=grad_averaging, max_grad_norm=max_grad_norm,
@@ -89,7 +139,7 @@ def fused_lamb(
         return new_p, {"m": m, "v": v}, scalars
 
     return make_fused_transform(
-        state_buffers=("m", "v"), kernel=kernel, chunk_size=chunk_size
+        state_buffers=("m", "v"), kernel=kernel, chunk_size=chunk_size or mt.DEFAULT_CHUNK
     )
 
 

@@ -18,7 +18,9 @@ import jax.numpy as jnp
 import optax
 
 from apex_tpu.optimizers import multi_tensor as mt
-from apex_tpu.optimizers._fused import make_fused_transform, schedule_value
+from apex_tpu.optimizers._fused import (
+    make_fused_transform, make_per_tensor_transform, resolve_layout,
+    schedule_value)
 
 
 def fused_sgd(
@@ -28,7 +30,8 @@ def fused_sgd(
     weight_decay: float = 0.0,
     nesterov: bool = False,
     grad_scale: float = 1.0,
-    chunk_size: int = mt.DEFAULT_CHUNK,
+    chunk_size: int = None,  # explicit value implies layout='chunked'
+    layout: str = "auto",
 ) -> optax.GradientTransformation:
     if nesterov and (momentum <= 0 or dampening != 0):
         raise ValueError("nesterov requires momentum > 0 and zero dampening")
@@ -50,10 +53,17 @@ def fused_sgd(
         lr = schedule_value(learning_rate, count)
         return p - lr * d_p, new_buffers, scalars
 
+    if resolve_layout(layout, chunk_size) == "per_tensor":
+        # the kernel is purely elementwise — reuse it per leaf
+        return make_per_tensor_transform(
+            state_buffers=("momentum",) if momentum else (),
+            leaf_kernel=lambda g, p, b, sc, c, stats: kernel(g, p, b, sc, c, None),
+        )
+
     return make_fused_transform(
         state_buffers=("momentum",) if momentum else (),
         kernel=kernel,
-        chunk_size=chunk_size,
+        chunk_size=chunk_size or mt.DEFAULT_CHUNK,
     )
 
 
