@@ -16,10 +16,13 @@ non-trivial residuals:
   (blockwise flash attention; removes the reference's seq≤512 / sk≤2048 caps)
 * ``transducer_{joint,loss}_cuda`` → :mod:`apex_tpu.ops.transducer`
 
-Kernel selection: ``impl='auto'`` uses Pallas on TPU (interpret mode on CPU in
-tests), falling back to the jnp composition when shapes don't meet the tiling
-constraints — mirroring how the reference falls back to torch ops when a
-kernel's eligibility check fails (``fused_softmax.py:159-179``).
+Kernel selection: ``impl='auto'`` resolves to each op's *measured* default
+(see ``_backend`` and PERF.md): the flash-attention kernel from seq >= 1024;
+the custom-VJP XLA compositions for layer norm, softmax, dense, and MLP,
+which outran their kernels at every measured shape. ``impl='pallas'`` forces
+a kernel (raising when shapes miss its tiling constraints — the analog of
+the reference's eligibility check failing, ``fused_softmax.py:159-179``);
+``impl='xla'`` forces the composition.
 """
 
 from apex_tpu.ops.layer_norm import (  # noqa: F401

@@ -9,7 +9,12 @@ constraints, and (c) an override:
 
 * ``impl='pallas'`` — always use the Pallas kernel (interpret mode off-TPU);
 * ``impl='xla'``    — always use the jnp composition;
-* ``impl='auto'``   — Pallas when on TPU and shapes qualify, else jnp.
+* ``impl='auto'``   — each op's *measured* default: flash attention picks
+  the Pallas kernel from seq >= 1024 (the one kernel family with a large
+  honest win — it removes an O(s²) HBM tensor XLA cannot); layer norm,
+  softmax, dense, and MLP resolve to their custom-VJP XLA compositions,
+  which outran the kernels at every measured shape (PERF.md). Ops encode
+  their default via :func:`resolve_auto`.
 
 ``APEX_TPU_PALLAS=0`` disables Pallas globally (escape hatch);
 ``APEX_TPU_PALLAS=interpret`` forces interpret-mode kernels everywhere, which
@@ -44,6 +49,15 @@ def interpret_forced() -> bool:
     composition on measured grounds still take the kernel path then, so the
     kernel code stays covered off-TPU."""
     return os.environ.get(_ENV, "") == "interpret"
+
+
+def resolve_auto(impl: str, default: str = "xla") -> str:
+    """Resolve ``impl='auto'`` to an op's measured default — except under
+    ``APEX_TPU_PALLAS=interpret``, where auto keeps taking the kernel path
+    so CPU tests cover the kernel code regardless of the default."""
+    if impl == "auto" and not interpret_forced():
+        return default
+    return impl
 
 
 def choose_impl(impl: str, shapes_ok: bool) -> str:

@@ -152,9 +152,7 @@ def _choose(impl: str, x, w) -> bool:
     # custom_vjp epilogue/recompute structure, which both impls share. The
     # kernel stays reachable via impl='pallas' (and the env force) for
     # shapes XLA tiles badly.
-    if impl == "auto" and not _backend.interpret_forced():
-        impl = "xla"
-    return _backend.choose_impl(impl, ok) == "pallas"
+    return _backend.choose_impl(_backend.resolve_auto(impl), ok) == "pallas"
 
 
 # --- module wrappers ----------------------------------------------------------

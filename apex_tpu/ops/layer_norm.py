@@ -130,9 +130,7 @@ def _ln_auto(impl: str) -> str:
     fusion pipelines the same HBM traffic better. The kernel stays
     reachable via ``impl='pallas'`` and carries the custom-VJP residual
     structure either way."""
-    if impl == "auto" and not _backend.interpret_forced():
-        return "xla"
-    return impl
+    return _backend.resolve_auto(impl)
 
 
 # --- public functional API ----------------------------------------------------

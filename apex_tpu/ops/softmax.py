@@ -80,7 +80,11 @@ def scaled_masked_softmax(
     FLOAT-class under O1 (``lists/functional_overrides.py:28-67``)."""
     x, = apply_op_rules("softmax", x)
     sk = x.shape[-1]
-    use_pallas = _backend.choose_impl(impl, sk % 128 == 0) == "pallas"
+    # auto == xla (measured, v5e: GPT-shaped causal (64,1024,1024) bf16
+    # fwd+bwd — pallas 3.98 ms, this op's xla path 2.69, naive jnp 3.47;
+    # the recompute-from-y backward is the win and both impls share it)
+    use_pallas = _backend.choose_impl(
+        _backend.resolve_auto(impl), sk % 128 == 0) == "pallas"
     x2d = x.reshape(-1, sk)
     mask2d = None
     if mask is not None:
@@ -97,7 +101,11 @@ def scaled_upper_triang_masked_softmax(
     FLOAT-class under O1."""
     x, = apply_op_rules("softmax", x)
     sq, sk = x.shape[-2], x.shape[-1]
-    use_pallas = _backend.choose_impl(impl, sk % 128 == 0) == "pallas"
+    # auto == xla (measured, v5e: GPT-shaped causal (64,1024,1024) bf16
+    # fwd+bwd — pallas 3.98 ms, this op's xla path 2.69, naive jnp 3.47;
+    # the recompute-from-y backward is the win and both impls share it)
+    use_pallas = _backend.choose_impl(
+        _backend.resolve_auto(impl), sk % 128 == 0) == "pallas"
     x2d = x.reshape(-1, sk)
     y = _softmax_core(x2d, None, float(scale), True, sq, use_pallas)
     return y.reshape(x.shape)
