@@ -197,6 +197,43 @@ class TestVocabParallelCrossEntropy:
             ref = nll
         np.testing.assert_allclose(loss, ref, rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_kernel_path_matches_unsharded(self, smoothing, monkeypatch):
+        """The fused-stats kernel path under a real tp axis: guards the
+        owning-shard-only max rebase (``t_logit = psum(t_raw - where(in_shard,
+        m, 0))``) and the ``l_loc * exp(m_loc - m)`` sum-exp rebase, which
+        axis_name=None tests never exercise. Shard vocab 512/tp=4 = 128
+        columns — the kernel's minimum tileable block."""
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        tp_size = 4
+        mesh = tp_mesh(tp_size)
+        vocab = 512
+        logits = jr.normal(K, (8, vocab)) * 2 + 3  # shift: exposes rebase bugs
+        # include an out-of-vocab sentinel no shard owns
+        target = jr.randint(jr.fold_in(K, 11), (8,), 0, vocab).at[3].set(-100)
+
+        loss = mesh_lib.shard_map(
+            lambda l, t: tp.vocab_parallel_cross_entropy(
+                l, t, smoothing, impl="pallas"),
+            mesh=mesh, in_specs=(P(None, "tp"), P()), out_specs=P(),
+        )(logits, target)
+
+        lse = jax.nn.logsumexp(logits, -1)
+        safe_t = jnp.clip(target, 0, vocab - 1)
+        # sentinel rows: both dispatch paths yield t_logit == 0 *relative to
+        # the global row max*, i.e. loss = lse - max — encode that here
+        t_logit = jnp.where(
+            (target >= 0) & (target < vocab),
+            jnp.take_along_axis(logits, safe_t[:, None], -1)[:, 0],
+            jnp.max(logits, -1))
+        nll = lse - t_logit
+        if smoothing:
+            ref = (1 - smoothing) * nll + smoothing / vocab * jnp.sum(
+                lse[:, None] - logits, -1)
+        else:
+            ref = nll
+        np.testing.assert_allclose(loss, ref, rtol=1e-5, atol=1e-5)
+
     def test_grad_matches_unsharded(self):
         tp_size = 4
         mesh = tp_mesh(tp_size)
