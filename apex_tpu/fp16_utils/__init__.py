@@ -10,6 +10,7 @@ with master-weight + loss-scaling bookkeeping.
 
 from apex_tpu.fp16_utils.fp16util import (  # noqa: F401
     BN_CONVERT_EXEMPT,
+    FP16Model,
     convert_network,
     master_params_to_model_params,
     model_grads_to_master_grads,

@@ -65,3 +65,29 @@ def model_grads_to_master_grads(model_grads: PyTree) -> PyTree:
 
 def to_python_float(x) -> float:
     return float(x)
+
+
+class FP16Model:
+    """Half-precision model wrapper — ``FP16Model``
+    (``apex/fp16_utils/fp16util.py:73-83``): converts the network
+    batchnorm-safe (norm params stay fp32) and casts floating inputs to the
+    half dtype before the forward.
+
+    The reference wraps an ``nn.Module``; here a model is (apply_fn, params),
+    so the wrapper holds the converted params and a callable.
+    """
+
+    def __init__(self, apply_fn: Callable, params: PyTree,
+                 dtype=jnp.bfloat16, exempt=BN_CONVERT_EXEMPT):
+        self.apply_fn = apply_fn
+        self.params = convert_network(params, dtype, exempt)
+        self.dtype = dtype
+
+    def __call__(self, *inputs, **kwargs):
+        def cast(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(self.dtype)
+            return x
+
+        return self.apply_fn(self.params, *jax.tree.map(cast, inputs),
+                             **jax.tree.map(cast, kwargs))

@@ -36,15 +36,16 @@ def _blocks(n, b):
 
 
 def _fit_block(n, pref):
-    """Largest 128-multiple divisor of ``n`` that is <= ``pref``: blocks must
-    tile the sequence exactly — Pallas pads partial edge blocks with
-    *uninitialized* data, which would flow into the softmax accumulators
-    (fwd) and into dk/dv (bwd, padded rows pass the causal mask)."""
-    b = min(pref, n)
-    b -= b % 128
-    while b > 128 and n % b:
-        b -= 128
-    return max(b, 128) if n % 128 == 0 else n
+    """Largest 128-multiple divisor of ``n`` that is <= ``pref``, falling
+    back to the whole sequence as one block when n has no 128-multiple
+    divisor (the caller's shapes-ok gate rejects such shapes for the
+    non-interpret path). Exact tiling matters: Pallas pads partial edge
+    blocks with *uninitialized* data, which would flow into the softmax
+    accumulators (fwd) and into dk/dv (bwd, padded rows pass the causal
+    mask)."""
+    from apex_tpu.ops.pallas import exact_block
+
+    return exact_block(n, pref, 128) or n
 
 
 # --- forward ------------------------------------------------------------------

@@ -28,23 +28,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.ops.pallas import exact_block
+
 NEG_INF = -1e30
 _LANES = 8  # row-stat carrier lanes (cf. attention._LSE_LANES)
 
 
-def _divisor_block(n: int, pref: int, quantum: int) -> int:
-    """Largest ``quantum``-multiple divisor of ``n`` that is <= ``pref``;
-    blocks must tile exactly (Pallas pads edge blocks with uninitialized
-    data, which would poison max/sum)."""
-    b = min(pref, n)
-    b -= b % quantum
-    while b > quantum and n % b:
-        b -= quantum
-    return b if b >= quantum and n % b == 0 else 0
-
-
 def shapes_ok(n: int, v: int) -> bool:
-    return _divisor_block(n, 256, 8) > 0 and _divisor_block(v, 2048, 128) > 0
+    return exact_block(n, 256, 8) > 0 and exact_block(v, 2048, 128) > 0
 
 
 def _stats_kernel(x_ref, lab_ref, m_ref, l_ref, t_ref, s_ref,
@@ -84,8 +75,8 @@ def xent_stats(logits2d, labels, *, interpret=False):
     ``(max, sumexp_rel_max, target_logit_raw, row_sum_raw)``; labels outside
     ``[0, V)`` yield ``target_logit_raw == 0``."""
     n, v = logits2d.shape
-    bn = _divisor_block(n, 256, 8)
-    bv = _divisor_block(v, 2048, 128)
+    bn = exact_block(n, 256, 8)
+    bv = exact_block(v, 2048, 128)
     if not bn or not bv:
         raise ValueError(f"untileable ({n}, {v}) for the xent stats kernel")
     nv = v // bv

@@ -39,3 +39,32 @@ from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, BatchNormState  # no
 from apex_tpu.parallel.larc import larc  # noqa: F401
 
 LARC = larc  # reference spelling (``apex.parallel.LARC``)
+
+
+def create_syncbn_process_group(group_size: int, mesh=None):
+    """BN stats groups of ``group_size`` devices — name-parity port of
+    ``apex.parallel.create_syncbn_process_group``
+    (``apex/parallel/__init__.py:58-95``). The reference builds NCCL
+    subgroups; on a mesh the same partition is an axis split, so this
+    returns ``(mesh, axis_name)``: pass the axis name to
+    :class:`SyncBatchNorm` / :func:`sync_batch_norm` and run under the
+    returned mesh.
+
+    ``group_size == 0`` means the whole dp axis (reference: world size);
+    ``group_size == 1`` returns ``(mesh, None)`` — local BN, matching the
+    reference's "equivalent to non-sync bn".
+    """
+    from apex_tpu.contrib.groupbn import split_data_axis_for_bn
+    from apex_tpu.parallel import mesh as _mesh_lib
+
+    mesh = mesh if mesh is not None else _mesh_lib.get_mesh()
+    if group_size == 0:
+        return mesh, DATA_AXIS
+    if group_size == 1:
+        return mesh, None
+    dp = mesh.shape[DATA_AXIS]
+    if group_size < 2 or dp % group_size:
+        raise ValueError(
+            f"group_size ({group_size}) must be a positive divisor of the "
+            f"dp axis ({dp})")
+    return split_data_axis_for_bn(mesh, group_size), "bn"

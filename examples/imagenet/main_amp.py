@@ -98,7 +98,12 @@ def main():
     y = jr.randint(jr.fold_in(key, 1), (b,), 0, args.num_classes)
 
     if args.synthetic:
-        # warm (compile)
+        # warm TWICE: the first call compiles against the freshly-created
+        # state's shardings; feeding outputs back changes the input avals
+        # (shard_map outputs carry explicit NamedShardings) and triggers one
+        # more compile — both must happen outside the timed region
+        master, bn_state, opt_state, scaler, loss = step(
+            master, bn_state, opt_state, scaler, x, y)
         master, bn_state, opt_state, scaler, loss = step(
             master, bn_state, opt_state, scaler, x, y)
         float(loss)
@@ -120,8 +125,11 @@ def main():
 
         it = data_parallel_iterator(host_batches())
         # warm with a SHARDED batch — the sharding is part of the jit cache
-        # key, so warming unsharded would recompile inside the timed loop
+        # key, so warming unsharded would recompile inside the timed loop —
+        # and twice, so the fed-back state's NamedShardings compile too
         xb, yb = next(it)
+        master, bn_state, opt_state, scaler, loss = step(
+            master, bn_state, opt_state, scaler, xb, yb)
         master, bn_state, opt_state, scaler, loss = step(
             master, bn_state, opt_state, scaler, xb, yb)
         float(loss)

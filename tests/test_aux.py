@@ -36,6 +36,23 @@ class TestFP16Utils:
         assert conv["w"].dtype == jnp.bfloat16
         assert conv["bn_scale"].dtype == jnp.float32  # BN exempt
 
+    def test_fp16_model_wrapper(self):
+        """``FP16Model`` (``apex/fp16_utils/fp16util.py:73-83``): params
+        converted batchnorm-safe, floating inputs cast before the forward."""
+        from apex_tpu.fp16_utils import FP16Model
+
+        params = {"w": jnp.ones((4, 4)), "bn_scale": jnp.ones((4,))}
+
+        def apply_fn(p, x):
+            assert x.dtype == jnp.bfloat16  # inputs arrive half
+            return (x @ p["w"]) * p["bn_scale"]
+
+        model = FP16Model(apply_fn, params)
+        assert model.params["w"].dtype == jnp.bfloat16
+        assert model.params["bn_scale"].dtype == jnp.float32  # exempt
+        y = model(jnp.ones((2, 4), jnp.float32))
+        assert y.shape == (2, 4)
+
     def test_fp16_optimizer_step_and_overflow_skip(self):
         from apex_tpu.fp16_utils import FP16_Optimizer
 

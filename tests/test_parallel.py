@@ -86,6 +86,39 @@ class TestSyncBatchNorm:
         np.testing.assert_allclose(np.asarray(new_state.running_mean), 0.1 * mean,
                                    atol=1e-5)
 
+    def test_create_syncbn_process_group(self, mesh8):
+        """``create_syncbn_process_group`` (``apex/parallel/__init__.py:
+        58-95``): BN groups of 4 inside dp=8 — stats shared within a group,
+        independent across groups."""
+        from apex_tpu.parallel import create_syncbn_process_group
+
+        m2, axis = create_syncbn_process_group(4, mesh8)
+        assert axis == "bn" and m2.shape["bn"] == 4 and m2.shape["dp_outer"] == 2
+
+        rng = np.random.RandomState(3)
+        x = rng.randn(16, 3).astype(np.float32)  # 2 per device
+        state = BatchNormState.create(3)
+
+        def f(x):
+            y, _ = sync_batch_norm(x, None, None, state, axis_name=axis)
+            return y
+
+        y = jax.jit(shard_map(
+            f, mesh=m2, in_specs=P(("dp_outer", "bn")),
+            out_specs=P(("dp_outer", "bn")),
+        ))(x)
+        # per-group reference: first 8 rows = group 0, last 8 = group 1
+        out = np.asarray(y)
+        for g in range(2):
+            grp = x[g * 8:(g + 1) * 8]
+            ref = (grp - grp.mean(0)) / np.sqrt(grp.var(0) + 1e-5)
+            np.testing.assert_allclose(out[g * 8:(g + 1) * 8], ref, atol=1e-4)
+
+        # group_size 0 -> whole dp axis; 1 -> local BN
+        _, a0 = create_syncbn_process_group(0, mesh8)
+        _, a1 = create_syncbn_process_group(1, mesh8)
+        assert a0 == "dp" and a1 is None
+
     def test_eval_uses_running_stats(self):
         x = np.random.RandomState(1).randn(4, 3).astype(np.float32)
         state = BatchNormState(
