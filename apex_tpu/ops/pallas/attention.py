@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.ops.pallas import exact_block
+
 NEG_INF = -1e30
 
 
@@ -43,8 +45,6 @@ def _fit_block(n, pref):
     blocks with *uninitialized* data, which would flow into the softmax
     accumulators (fwd) and into dk/dv (bwd, padded rows pass the causal
     mask)."""
-    from apex_tpu.ops.pallas import exact_block
-
     return exact_block(n, pref, 128) or n
 
 

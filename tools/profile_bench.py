@@ -29,7 +29,7 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         cfg = GPTConfig(vocab_size=32768, max_seq_len=1024, hidden_size=1024,
-                        num_layers=12, num_heads=16, remat=False,
+                        num_layers=12, num_heads=8, remat=False,
                         attention_impl="flash", scan_layers=False)
         batch, seq = 16, 1024
     else:
