@@ -103,15 +103,16 @@ class BertModel:
         c = self.config
         b, s, _ = x.shape
         h, d = c.local_heads, c.head_dim
-        # grouped (3, h, d) local packing — see models/gpt.py:_attention
-        qkv = self.qkv(p["qkv"], x).reshape(b, s, 3, h, d)
-        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        # Head-batched projection, grouped (3, h, d) local packing — the
+        # transpose-free layout of models/gpt.py:_attention
+        qkv = self.qkv.headwise(p["qkv"], x, 3 * h).reshape(b, 3, h, s, d)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
         # mask: (b, 1, 1, s) True = masked out (padding)
         mask = None if pad_mask is None else pad_mask[:, None, None, :]
         probs = scaled_masked_softmax(scores, mask, 1.0 / float(d) ** 0.5)
         ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
-        return self.attn_out(p["attn_out"], ctx.transpose(0, 2, 1, 3).reshape(b, s, h * d))
+        return self.attn_out.headwise(p["attn_out"], ctx)
 
     def _block(self, p, x, pad_mask):
         # post-LN (BERT): LN(x + sublayer(x))

@@ -158,20 +158,19 @@ class GPTModel:
     def _attention(self, p, x, key):
         c = self.config
         h, d = c.local_heads, c.head_dim
-        qkv = self.qkv(p["qkv"], x)  # (b, s_full, 3*h*d local) — SP gathers seq
-        b, s = qkv.shape[0], qkv.shape[1]
-        # local output features are packed (3, h, d) — q|k|v grouped, heads
-        # within each group. Megatron packs (h, 3d) because its *global*
-        # qkv weight must shard per-head across tp ranks; here params are
-        # built per-rank, so within a rank the grouped order is free — and
-        # it makes the q/k/v split a coarse contiguous slice instead of a
-        # fine strided one (measured: the strided splits were ~3 ms/step
-        # of pure data-formatting on the flagship bench).
-        qkv = qkv.reshape(b, s, 3, h, d)
+        # Head-batched QKV projection (ColumnParallelLinear.headwise):
+        # q/k/v come out (b, h, s, d) — the attention layout — straight
+        # from the MXU; the flat matmul + per-head transpose formulation
+        # spent ~14 ms/step of the flagship bench in pure layout copies.
+        # Local output features stay packed (3, h, d) — q|k|v grouped,
+        # heads within each group (Megatron packs (h, 3d) because its
+        # *global* qkv weight must shard per-head across tp ranks; here
+        # params are built per-rank, so the grouped order is free).
+        qkv = self.qkv.headwise(p["qkv"], x, 3 * h)  # (b, 3h, s_full, d)
+        b, s = qkv.shape[0], qkv.shape[2]
+        qkv = qkv.reshape(b, 3, h, s, d)
         # (b, h, s, d)
-        q = qkv[:, :, 0].transpose(0, 2, 1, 3)
-        k = qkv[:, :, 1].transpose(0, 2, 1, 3)
-        v = qkv[:, :, 2].transpose(0, 2, 1, 3)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
         use_flash = c.attention_impl == "flash" and not (
             c.dropout > 0 and key is not None  # flash path has no probs dropout
         )
@@ -195,8 +194,9 @@ class GPTModel:
             if c.dropout > 0 and key is not None:
                 probs = _dropout(probs, c.dropout, jax.random.fold_in(key, 0))
             ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
-        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h * d)
-        return self.attn_out(p["attn_out"], ctx)
+        # Output projection contracted directly over (heads, d) — no
+        # transpose back to (b, s, h*d) (RowParallelLinear.headwise).
+        return self.attn_out.headwise(p["attn_out"], ctx)
 
     def _mlp(self, p, x):
         h = self.mlp_up(p["mlp_up"], x)
