@@ -37,6 +37,11 @@ class GPTConfig:
     ffn_hidden_size: Optional[int] = None  # default 4*hidden
     num_layers: int = 12
     num_heads: int = 16
+    # grouped-query attention: fewer kv heads than query heads (None =
+    # num_heads, full MHA; 1 = MQA). Beyond the reference — its fmha
+    # kernels require equal head counts. Must divide num_heads and be
+    # divisible by tp_size.
+    num_kv_heads: Optional[int] = None
     tp_size: int = 1
     tp_axis: Optional[str] = "tp"  # None → single-chip, no collectives
     sequence_parallel: bool = False
@@ -77,6 +82,18 @@ class GPTConfig:
             raise ValueError(
                 f"remat_policy must be full|save_attn|save_attn_mlp|mlp_only, "
                 f"got {self.remat_policy!r}")
+        if self.num_kv_heads is not None:
+            if self.num_kv_heads < 1:
+                raise ValueError(
+                    f"num_kv_heads must be >= 1, got {self.num_kv_heads}")
+            if self.num_heads % self.num_kv_heads:
+                raise ValueError(
+                    f"num_kv_heads ({self.num_kv_heads}) must divide "
+                    f"num_heads ({self.num_heads})")
+            if self.num_kv_heads % self.tp_size:
+                raise ValueError(
+                    f"num_kv_heads ({self.num_kv_heads}) must be divisible "
+                    f"by tp_size ({self.tp_size})")
 
     @property
     def ffn(self) -> int:
@@ -89,6 +106,19 @@ class GPTConfig:
     @property
     def local_heads(self) -> int:
         return divide(self.num_heads, self.tp_size)
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads if self.num_kv_heads is not None else self.num_heads
+
+    @property
+    def local_kv_heads(self) -> int:
+        return divide(self.kv_heads, self.tp_size)
+
+    @property
+    def qkv_features(self) -> int:
+        """Global QKV projection width: h_q + 2*h_kv head groups."""
+        return (self.num_heads + 2 * self.kv_heads) * self.head_dim
 
 
 class GPTModel:
@@ -108,7 +138,7 @@ class GPTModel:
         # activations are (batch, seq, hidden) → seq_dim=1 for the SP
         # all-gather/reduce-scatter boundaries
         self.qkv = tp_lib.ColumnParallelLinear(
-            c.hidden_size, 3 * c.hidden_size, tp_size=c.tp_size, axis_name=axis,
+            c.hidden_size, c.qkv_features, tp_size=c.tp_size, axis_name=axis,
             sequence_parallel=sp, seq_dim=1,
         )
         self.attn_out = tp_lib.RowParallelLinear(
@@ -162,18 +192,27 @@ class GPTModel:
         # q/k/v come out (b, h, s, d) — the attention layout — straight
         # from the MXU; the flat matmul + per-head transpose formulation
         # spent ~14 ms/step of the flagship bench in pure layout copies.
-        # Local output features stay packed (3, h, d) — q|k|v grouped,
-        # heads within each group (Megatron packs (h, 3d) because its
-        # *global* qkv weight must shard per-head across tp ranks; here
-        # params are built per-rank, so the grouped order is free).
-        qkv = self.qkv.headwise(p["qkv"], x, 3 * h)  # (b, 3h, s_full, d)
+        # Local output features stay packed (q-heads | k-heads | v-heads) —
+        # grouped, heads within each group (Megatron packs (h, 3d) because
+        # its *global* qkv weight must shard per-head across tp ranks; here
+        # params are built per-rank, so the grouped order is free). With
+        # grouped-query attention (num_kv_heads < num_heads) the k/v groups
+        # are simply narrower.
+        hkv = c.local_kv_heads
+        qkv = self.qkv.headwise(p["qkv"], x, h + 2 * hkv)  # (b, h+2hkv, s, d)
         b, s = qkv.shape[0], qkv.shape[2]
-        qkv = qkv.reshape(b, 3, h, s, d)
-        # (b, h, s, d)
-        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        # (b, h, s, d) / (b, hkv, s, d)
+        q = qkv[:, :h]
+        k = qkv[:, h:h + hkv]
+        v = qkv[:, h + hkv:]
         use_flash = c.attention_impl == "flash" and not (
             c.dropout > 0 and key is not None  # flash path has no probs dropout
         )
+        if not use_flash and hkv < h:
+            # flash handles grouped kv natively (kernel index maps); the
+            # materialized-scores paths below broadcast kv heads instead
+            k = jnp.repeat(k, h // hkv, axis=1)
+            v = jnp.repeat(v, h // hkv, axis=1)
         if use_flash:
             ctx = flash_attention(q, k, v, causal=True)
         elif c.attention_impl == "naive":

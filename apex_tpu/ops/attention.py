@@ -65,7 +65,10 @@ def _flash_fwd_res(q, k, v, scale, causal, use_pallas):
             interpret=_backend.interpret_mode(),
         )
     else:
-        o, lse = _xla_attention(q, k, v, scale, causal)
+        group = q.shape[0] // k.shape[0]
+        o, lse = _xla_attention(
+            q, jnp.repeat(k, group, 0), jnp.repeat(v, group, 0), scale, causal
+        ) if group > 1 else _xla_attention(q, k, v, scale, causal)
     return o, (q, k, v, o, lse)
 
 
@@ -82,15 +85,24 @@ def _flash_bwd(scale, causal, use_pallas, res, do):
             interpret=_backend.interpret_mode(),
         )
     else:
-        s = masked_scores(q, k, scale, causal)
+        group = q.shape[0] // k.shape[0]
+        kf = jnp.repeat(k, group, 0) if group > 1 else k
+        vf = jnp.repeat(v, group, 0) if group > 1 else v
+        s = masked_scores(q, kf, scale, causal)
         p = jnp.exp(s - lse[..., None])
         dof = do.astype(jnp.float32)
-        dv = jnp.einsum("bqk,bqd->bkd", p, dof).astype(v.dtype)
-        dp = jnp.einsum("bqd,bkd->bqk", dof, v.astype(jnp.float32))
+        dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+        dp = jnp.einsum("bqd,bkd->bqk", dof, vf.astype(jnp.float32))
         delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1, keepdims=True)
         ds = p * (dp - delta) * scale
-        dq = jnp.einsum("bqk,bkd->bqd", ds, k.astype(jnp.float32)).astype(q.dtype)
-        dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32)).astype(k.dtype)
+        dq = jnp.einsum("bqk,bkd->bqd", ds, kf.astype(jnp.float32)).astype(q.dtype)
+        dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32))
+        if group > 1:
+            # per-q-head kv grads -> sum each kv group
+            sk, d = k.shape[1], k.shape[2]
+            dk = dk.reshape(-1, group, sk, d).sum(1)
+            dv = dv.reshape(-1, group, sk, d).sum(1)
+        dk, dv = dk.astype(k.dtype), dv.astype(v.dtype)
     return dq, dk, dv
 
 
@@ -105,6 +117,13 @@ def flash_attention(
     leading batch/head dims. No sequence-length cap (cf. fmha's 512).
     HALF-class under O1 (attention is matmul-shaped; the in-kernel softmax
     accumulates fp32 regardless).
+
+    Grouped-query / multi-query attention: k/v may carry FEWER heads than q
+    — flattened leading dims must divide q's (e.g. q (b, 8, s, d) with kv
+    (b, 2, s, d) is a group of 4; kv (b, 1, s, d) is MQA). The kernel reads
+    each kv row once per group via its BlockSpec index map — kv is never
+    repeated in HBM. A capability the reference's fixed-shape fmha kernels
+    (seq≤512, equal heads) cannot express.
 
     ``impl='auto'`` picks the Pallas kernel from seq >= 1024: below that the
     grid/launch overhead outweighs the saved score-tensor HBM traffic and
@@ -123,6 +142,23 @@ def flash_attention(
     q3 = q.reshape(-1, q.shape[-2], d)
     k3 = k.reshape(-1, k.shape[-2], d)
     v3 = v.reshape(-1, v.shape[-2], d)
+    if k.shape[:-2] != v.shape[:-2]:
+        raise ValueError(f"k/v leading dims differ: {k.shape} vs {v.shape}")
+    if q.ndim >= 4:
+        # batch dims must MATCH; only the head axis (last leading dim) may
+        # be narrower on kv — a flattened-ratio check alone would accept a
+        # mismatched batch dim and silently pair q rows with wrong batches
+        if (q.shape[:-3] != k.shape[:-3]
+                or q.shape[-3] % k.shape[-3]):
+            raise ValueError(
+                f"kv heads ({k.shape[-3]}) must divide q heads "
+                f"({q.shape[-3]}) with equal batch dims "
+                f"({q.shape[:-3]} vs {k.shape[:-3]}) for grouped-query "
+                f"attention")
+    elif q3.shape[0] % k3.shape[0]:
+        raise ValueError(
+            f"kv heads ({k3.shape[0]} flattened) must divide q heads "
+            f"({q3.shape[0]} flattened) for grouped-query attention")
     ok = (
         q3.shape[-2] % 128 == 0 and k3.shape[-2] % 128 == 0
         and (d % 128 == 0 or d == 64)

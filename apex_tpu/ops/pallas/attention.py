@@ -109,8 +109,13 @@ def _expand_rows(x):
 
 
 def flash_fwd(q, k, v, *, scale, causal, bq=1024, bk=1024, interpret=False):
+    """q (bh, sq, d); k/v (bh_kv, sk, d) where bh_kv divides bh — grouped-
+    query attention falls out of the kv BlockSpec index maps (q row ``b``
+    reads kv row ``b // group``), zero-copy: kv shards are never repeated
+    in HBM."""
     bh, sq, d = q.shape
     sk = k.shape[1]
+    group = bh // k.shape[0]
     bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
 
@@ -120,8 +125,8 @@ def flash_fwd(q, k, v, *, scale, causal, bq=1024, bk=1024, interpret=False):
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j, g=group: (b // g, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j, g=group: (b // g, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -232,8 +237,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def flash_bwd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
               interpret=False):
+    """Gradients; with grouped kv (bh_kv < bh) dk/dv come back at kv shape —
+    the dkv kernel runs per *q*-head (its scratch accumulates over q blocks
+    within one grid row, so cross-head accumulation can't live in-kernel)
+    and the per-head partials are summed over each kv group outside, where
+    XLA fuses the reduction into the kernel's output write."""
     bh, sq, d = q.shape
     sk = k.shape[1]
+    group = bh // k.shape[0]
     bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -245,8 +256,8 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j, g=group: (b // g, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j, g=group: (b // g, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i, j: (b, i, 0)),
@@ -266,8 +277,8 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
         grid=(bh, nk, nq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i, g=group: (b // g, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i, g=group: (b // g, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bq, _LSE_LANES), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bq, _LSE_LANES), lambda b, j, i: (b, i, 0)),
@@ -289,4 +300,7 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
         ),
         interpret=interpret,
     )(q, k, v, do, lse3, delta3)
+    if group > 1:
+        dk = dk.astype(jnp.float32).reshape(-1, group, sk, d).sum(1).astype(k.dtype)
+        dv = dv.astype(jnp.float32).reshape(-1, group, sk, d).sum(1).astype(v.dtype)
     return dq, dk, dv

@@ -91,6 +91,82 @@ class TestFlashAttention:
             np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-4)
 
 
+class TestGroupedQueryAttention:
+    """GQA/MQA: kv with fewer heads than q — beyond the reference's fmha
+    (which requires equal head counts). Oracle: full MHA on repeated kv."""
+
+    @pytest.mark.parametrize("kv_heads", [1, 2])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_repeated_kv(self, kv_heads, causal):
+        b, hq, s, d = 2, 4, 32, 16
+        q = jr.normal(K, (b, hq, s, d))
+        k = jr.normal(jr.fold_in(K, 1), (b, kv_heads, s, d))
+        v = jr.normal(jr.fold_in(K, 2), (b, kv_heads, s, d))
+        o = flash_attention(q, k, v, causal=causal)
+        rep = hq // kv_heads
+        o_ref = dense_ref(q, jnp.repeat(k, rep, 1), jnp.repeat(v, rep, 1), causal)
+        np.testing.assert_allclose(o, o_ref, rtol=RTOL, atol=ATOL)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_repeated_kv(self, causal):
+        b, hq, kvh, s, d = 1, 4, 2, 32, 16
+        q = jr.normal(K, (b, hq, s, d))
+        k = jr.normal(jr.fold_in(K, 3), (b, kvh, s, d))
+        v = jr.normal(jr.fold_in(K, 4), (b, kvh, s, d))
+        rep = hq // kvh
+
+        f1 = lambda q, k, v: jnp.sum(jnp.sin(flash_attention(q, k, v, causal=causal)))
+
+        def f2(q, k, v):
+            o = dense_ref(q, jnp.repeat(k, rep, 1), jnp.repeat(v, rep, 1), causal)
+            return jnp.sum(jnp.sin(o))
+
+        g1 = jax.grad(f1, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f2, argnums=(0, 1, 2))(q, k, v)
+        for a, e in zip(g1, g2):
+            np.testing.assert_allclose(a, e, rtol=G_RTOL, atol=G_ATOL)
+
+    @pytest.mark.pallas
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_pallas_kernel_gqa_fwd_bwd(self, causal, monkeypatch):
+        """The kernel's zero-copy kv index maps (fwd, dq, dkv) + the
+        group-summed dk/dv epilogue."""
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        b, hq, kvh, s, d = 1, 4, 2, 256, 64
+        q = jr.normal(K, (b, hq, s, d)).astype(jnp.float32)
+        k = jr.normal(jr.fold_in(K, 5), (b, kvh, s, d))
+        v = jr.normal(jr.fold_in(K, 6), (b, kvh, s, d))
+        rep = hq // kvh
+        with jax.default_matmul_precision("highest"):
+            o = flash_attention(q, k, v, causal=causal, impl="pallas")
+            o_ref = dense_ref(q, jnp.repeat(k, rep, 1), jnp.repeat(v, rep, 1),
+                              causal)
+            np.testing.assert_allclose(o, o_ref, rtol=2e-5, atol=2e-5)
+            f1 = lambda q, k, v: jnp.sum(jnp.cos(
+                flash_attention(q, k, v, causal=causal, impl="pallas")))
+
+            def f2(q, k, v):
+                o = dense_ref(q, jnp.repeat(k, rep, 1), jnp.repeat(v, rep, 1),
+                              causal)
+                return jnp.sum(jnp.cos(o))
+
+            g1 = jax.grad(f1, argnums=(0, 1, 2))(q, k, v)
+            g2 = jax.grad(f2, argnums=(0, 1, 2))(q, k, v)
+        for a, e in zip(g1, g2):
+            np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-4)
+
+    def test_mismatched_heads_raise(self):
+        q = jr.normal(K, (2, 3, 32, 16))
+        k = jr.normal(K, (2, 2, 32, 16))
+        with pytest.raises(ValueError, match="kv heads"):
+            flash_attention(q, k, k)
+        # a mismatched BATCH dim must not be mistaken for a kv-head group
+        q = jr.normal(K, (2, 4, 32, 16))
+        k = jr.normal(K, (1, 4, 32, 16))
+        with pytest.raises(ValueError, match="equal batch dims"):
+            flash_attention(q, k, k)
+
+
 class TestRingAttention:
     @pytest.mark.parametrize("causal", [False, True])
     def test_matches_dense_full_sequence(self, causal):

@@ -233,3 +233,32 @@ class TestGPTAttentionAndRematVariants:
             GPTConfig(attention_impl="Flash")
         with pytest.raises(ValueError, match="remat_policy"):
             GPTConfig(remat_policy="save-attn")
+
+    def test_gqa_flash_matches_softmax_impl(self):
+        """Grouped-query attention cross-check: the flash path broadcasts kv
+        through the kernel's index maps, the softmax path via jnp.repeat —
+        identical weights must give identical loss and grads."""
+        import jax.random as jr
+
+        models = {impl: self._small(attention_impl=impl, num_kv_heads=1)
+                  for impl in ("softmax", "flash")}
+        params = models["softmax"].init(jr.PRNGKey(0))
+        toks = jr.randint(jr.PRNGKey(1), (2, 128), 0, 128)
+        with jax.default_matmul_precision("highest"):
+            l1, g1 = jax.value_and_grad(models["softmax"].loss_fn)(params, toks, toks)
+            l2, g2 = jax.value_and_grad(models["flash"].loss_fn)(params, toks, toks)
+        assert float(l1) == pytest.approx(float(l2), rel=1e-4)
+        for a, e in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(e, np.float32),
+                                       rtol=1e-3, atol=1e-4)
+
+    def test_gqa_config_validation(self):
+        from apex_tpu.models import GPTConfig
+
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            GPTConfig(num_heads=4, num_kv_heads=3)
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            GPTConfig(num_heads=8, num_kv_heads=1, tp_size=2)
+        cfg = GPTConfig(num_heads=8, num_kv_heads=2)
+        assert cfg.qkv_features == (8 + 4) * cfg.head_dim

@@ -98,6 +98,53 @@ class TestColumnRowParallel:
         ref = jnp.maximum(x @ wc.T + bc, 0) @ wr.T + br
         np.testing.assert_allclose(y, ref, rtol=2e-5, atol=2e-5)
 
+    def test_headwise_matches_flat_call(self):
+        """Column/Row ``headwise`` (the transpose-free attention-layout
+        projections) == ``__call__`` + explicit reshapes/transposes, under a
+        real tp axis with bias and grads."""
+        tp_size = 2
+        mesh = tp_mesh(tp_size)
+        b, s, H, heads, d = 2, 8, 16, 4, 4  # h*d == H
+        h_loc = heads // tp_size
+        col = tp.ColumnParallelLinear(H, 3 * H, tp_size=tp_size, bias=True)
+        row = tp.RowParallelLinear(H, H, tp_size=tp_size, bias=True)
+        wc = jr.normal(K, (3 * H, H)) * 0.1
+        bc = jr.normal(jr.fold_in(K, 1), (3 * H,)) * 0.1
+        wr = jr.normal(jr.fold_in(K, 2), (H, H)) * 0.1
+        br = jr.normal(jr.fold_in(K, 3), (H,)) * 0.1
+        x = jr.normal(jr.fold_in(K, 4), (b, s, H))
+
+        def via_headwise(x, wc, bc, wr, br):
+            qkv = col.headwise({"weight": wc, "bias": bc}, x, 3 * h_loc)
+            ctx = qkv.reshape(b, 3, h_loc, s, d)[:, 0]  # take "q"
+            return row.headwise({"weight": wr, "bias": br}, ctx)
+
+        def via_flat(x, wc, bc, wr, br):
+            y = col({"weight": wc, "bias": bc}, x)  # (b, s, 3*h_loc*d)
+            q = y.reshape(b, s, 3, h_loc, d)[:, :, 0].transpose(0, 2, 1, 3)
+            return row({"weight": wr, "bias": br},
+                       q.transpose(0, 2, 1, 3).reshape(b, s, h_loc * d))
+
+        specs = (P(), P("tp", None), P("tp"), P(None, "tp"), P())
+        args = (x, wc, bc, wr, br)
+        y1 = mesh_lib.shard_map(via_headwise, mesh=mesh, in_specs=specs,
+                                out_specs=P())(*args)
+        y2 = mesh_lib.shard_map(via_flat, mesh=mesh, in_specs=specs,
+                                out_specs=P())(*args)
+        np.testing.assert_allclose(y1, y2, rtol=2e-5, atol=2e-5)
+
+        def loss(f):
+            def inner(x, wc, bc, wr, br):
+                out = f(x, wc, bc, wr, br)
+                return jnp.sum(jnp.sin(out))
+            return mesh_lib.shard_map(
+                lambda *a: jax.grad(inner, argnums=(0, 1, 2))(*a),
+                mesh=mesh, in_specs=specs,
+                out_specs=(P(), P("tp", None), P("tp")))(*args)
+
+        for g1, g2 in zip(loss(via_headwise), loss(via_flat)):
+            np.testing.assert_allclose(g1, g2, rtol=2e-5, atol=2e-5)
+
     def test_column_gather_output(self):
         tp_size = 4
         mesh = tp_mesh(tp_size)
