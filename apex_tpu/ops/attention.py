@@ -197,12 +197,23 @@ def ring_attention(
     scale = float(scale if scale is not None else 1.0 / d ** 0.5)
     s_local = q.shape[-2]
     perm = [(i, (i + 1) % cp) for i in range(cp)]
+    if q.shape[0] % k.shape[0]:
+        raise ValueError(
+            f"kv rows ({k.shape[0]}) must divide q rows ({q.shape[0]}) "
+            f"for grouped-query ring attention")
+    group = q.shape[0] // k.shape[0]
 
     qf = q.astype(jnp.float32)
 
     @jax.checkpoint
     def partial_scores(kv, kv_rank):
         kk, vv = kv
+        if group > 1:
+            # grouped-query: the NARROW kv rotates the ring (that is the
+            # GQA bandwidth win under context parallelism); broadcast to q
+            # heads only here, at compute time
+            kk = jnp.repeat(kk, group, 0)
+            vv = jnp.repeat(vv, group, 0)
         s = jnp.einsum("bqd,bkd->bqk", qf, kk.astype(jnp.float32)) * scale
         if causal:
             q_pos = rank * s_local + jnp.arange(s_local)[:, None]
@@ -261,12 +272,17 @@ def ulysses_attention(
     """
     sp = jax.lax.axis_size(axis_name)
     b, s_local, h, d = q.shape
-    if h % sp != 0:
+    h_kv = k.shape[2]
+    if h % sp != 0 or h_kv % sp != 0:
         raise ValueError(
-            f"ulysses_attention needs heads ({h}) divisible by the "
-            f"{axis_name!r} axis size ({sp}); use ring_attention otherwise")
+            f"ulysses_attention needs q heads ({h}) and kv heads ({h_kv}) "
+            f"divisible by the {axis_name!r} axis size ({sp}); use "
+            f"ring_attention otherwise")
 
-    # (b, s/P, h, d) -> (b, s, h/P, d): scatter heads, gather sequence
+    # (b, s/P, h, d) -> (b, s, h/P, d): scatter heads, gather sequence.
+    # With grouped-query kv (h_kv < h) each tensor scatters its own head
+    # count — the kv all_to_alls move group-times less data, and the
+    # downstream flash kernel handles the grouping natively.
     def seq_to_head(x):
         return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                                   tiled=True)
@@ -274,8 +290,8 @@ def ulysses_attention(
     qg, kg, vg = seq_to_head(q), seq_to_head(k), seq_to_head(v)
     s, h_loc = qg.shape[1], qg.shape[2]
 
-    def to_bh(x):  # (b, s, h_loc, d) -> (b*h_loc, s, d)
-        return x.transpose(0, 2, 1, 3).reshape(b * h_loc, s, d)
+    def to_bh(x):  # (b, s, x_heads, d) -> (b*x_heads, s, d)
+        return x.transpose(0, 2, 1, 3).reshape(b * x.shape[2], s, d)
 
     o = flash_attention(to_bh(qg), to_bh(kg), to_bh(vg),
                         causal=causal, scale=scale, impl=impl)

@@ -187,6 +187,29 @@ class TestRingAttention:
             o, dense_ref(q, k, v, causal), rtol=RTOL, atol=ATOL
         )
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grouped_kv_matches_dense(self, causal):
+        """GQA under context parallelism: the NARROW kv rotates the ring
+        (the bandwidth win); result == dense on repeated kv."""
+        cp = 4
+        mesh = mesh_lib.make_mesh(context_parallel_size=cp)
+        S, hq, kvh, d = 32, 4, 2, 16
+        q = jr.normal(K, (hq, S, d))         # (bh_q, s, d) rows
+        k = jr.normal(jr.fold_in(K, 7), (kvh, S, d))
+        v = jr.normal(jr.fold_in(K, 8), (kvh, S, d))
+
+        o = mesh_lib.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, causal=causal),
+            mesh=mesh,
+            in_specs=(P(None, "cp"), P(None, "cp"), P(None, "cp")),
+            out_specs=P(None, "cp"),
+        )(q, k, v)
+        rep = hq // kvh
+        np.testing.assert_allclose(
+            o, dense_ref(q, jnp.repeat(k, rep, 0), jnp.repeat(v, rep, 0),
+                         causal),
+            rtol=RTOL, atol=ATOL)
+
     def test_grads_flow(self):
         cp = 4
         mesh = mesh_lib.make_mesh(context_parallel_size=cp)
@@ -238,6 +261,30 @@ class TestUlyssesAttention:
         # oracle: per-head dense attention over the full sequence
         ref = dense_ref(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                         v.transpose(0, 2, 1, 3), causal).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(o, ref, rtol=RTOL, atol=ATOL)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grouped_kv_matches_dense(self, causal):
+        """GQA through Ulysses: q and kv scatter their own head counts (kv
+        all_to_alls move group-times less data); flash handles grouping."""
+        sp = 4
+        mesh = mesh_lib.make_mesh(context_parallel_size=sp)
+        B, S, H, HKV, D = 2, 32, 8, 4, 16
+        q = jr.normal(K, (B, S, H, D))
+        k = jr.normal(jr.fold_in(K, 21), (B, S, HKV, D))
+        v = jr.normal(jr.fold_in(K, 22), (B, S, HKV, D))
+
+        o = mesh_lib.shard_map(
+            lambda q, k, v: ulysses_attention(q, k, v, causal=causal),
+            mesh=mesh,
+            in_specs=(P(None, "cp"),) * 3,
+            out_specs=P(None, "cp"),
+        )(q, k, v)
+        rep = H // HKV
+        kr = jnp.repeat(k, rep, 2)
+        vr = jnp.repeat(v, rep, 2)
+        ref = dense_ref(q.transpose(0, 2, 1, 3), kr.transpose(0, 2, 1, 3),
+                        vr.transpose(0, 2, 1, 3), causal).transpose(0, 2, 1, 3)
         np.testing.assert_allclose(o, ref, rtol=RTOL, atol=ATOL)
 
     def test_grads_match_dense(self):

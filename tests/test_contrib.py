@@ -61,11 +61,12 @@ class TestDistributedOptimizers:
             st = zopt.init(params)
             return jnp.asarray(st.buffers["m"].shape[0])
 
+        dp = mesh.shape["dp"]
         rows = mesh_lib.shard_map(
             state_rows, mesh=mesh, in_specs=P(), out_specs=P(),
         )(params)
-        padded = n_chunks + ((-n_chunks) % 8)
-        assert int(rows) == padded // 8  # 1/dp of the chunk rows
+        padded = n_chunks + ((-n_chunks) % dp)
+        assert int(rows) == padded // dp  # 1/dp of the chunk rows
 
     def test_zero_lamb_runs_and_differs_from_adam(self):
         from apex_tpu.contrib.optimizers import distributed_fused_lamb
@@ -104,7 +105,9 @@ class TestMultiheadAttn:
         o = jnp.einsum("bhqk,bhkd->bhqd", p, heads(v))
         o = o.transpose(0, 2, 1, 3).reshape(2, 16, 32)
         ref = o @ params["out_weight"].T + params["out_bias"]
-        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+        # hardware MXU default precision carries ~3e-4 rounding both sides
+        tol = 2e-5 if jax.default_backend() != "tpu" else 1e-3
+        np.testing.assert_allclose(out, ref, rtol=tol, atol=tol)
 
     def test_norm_add_residual(self):
         from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
@@ -324,8 +327,11 @@ class TestBottleneckConv:
         from apex_tpu.contrib.groupbn import split_data_axis_for_bn
 
         mesh = mesh_lib.make_mesh()  # dp=8
+        dp = mesh.shape["dp"]
+        if dp < 4 or dp % 4:
+            pytest.skip("needs dp divisible by 4 (hardware mode has one chip)")
         m2 = split_data_axis_for_bn(mesh, 4)
-        assert m2.shape["bn"] == 4 and m2.shape["dp_outer"] == 2
+        assert m2.shape["bn"] == 4 and m2.shape["dp_outer"] == dp // 4
 
 
 class TestZeroHardening:
