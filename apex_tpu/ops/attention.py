@@ -33,62 +33,74 @@ from apex_tpu.parallel import mesh as mesh_lib
 
 # --- single-device flash attention -------------------------------------------
 
-def masked_scores(q, k, scale, causal):
+def masked_scores(q, k, scale, causal, kv_lens=None):
     """fp32 scaled scores over (..., seq, head_dim) with the bottom-right-
-    aligned causal mask (last ``sq`` query rows of an ``sk``-long context)."""
+    aligned causal mask (last ``sq`` query rows of an ``sk``-long context)
+    and optional per-row valid kv lengths (padding)."""
     s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    sq, sk = s.shape[-2], s.shape[-1]
     if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
         mask = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None] + (sk - sq)
         s = jnp.where(mask, s, _k.NEG_INF)
+    if kv_lens is not None:
+        s = jnp.where(jnp.arange(sk)[None, None, :] < kv_lens[:, None, None],
+                      s, _k.NEG_INF)
     return s
 
 
-def _xla_attention(q, k, v, scale, causal):
-    s = masked_scores(q, k, scale, causal)
+def _xla_attention(q, k, v, scale, causal, kv_lens=None):
+    s = masked_scores(q, k, scale, causal, kv_lens)
     lse = jax.nn.logsumexp(s, axis=-1)
     p = jnp.exp(s - lse[..., None])
     o = jnp.einsum("bqk,bkd->bqd", p.astype(q.dtype), v)
+    if kv_lens is not None:
+        # fully-masked rows: uniform-softmax garbage -> zeros, and pin lse
+        # to 0 so backward's exp(NEG_INF - lse) underflows to 0 (the kernel
+        # path's dead-row convention)
+        dead = (kv_lens == 0)[:, None]
+        o = jnp.where(dead[..., None], 0.0, o).astype(q.dtype)
+        lse = jnp.where(dead, 0.0, lse)
     return o, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_core(q, k, v, scale, causal, use_pallas):
-    o, _ = _flash_fwd_res(q, k, v, scale, causal, use_pallas)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_core(q, k, v, kv_lens, scale, causal, use_pallas):
+    o, _ = _flash_fwd_res(q, k, v, kv_lens, scale, causal, use_pallas)
     return o
 
 
-def _flash_fwd_res(q, k, v, scale, causal, use_pallas):
+def _flash_fwd_res(q, k, v, kv_lens, scale, causal, use_pallas):
     if use_pallas:
         o, lse = _k.flash_fwd(
-            q, k, v, scale=scale, causal=causal,
-            interpret=_backend.interpret_mode(),
-        )
-    else:
-        group = q.shape[0] // k.shape[0]
-        o, lse = _xla_attention(
-            q, jnp.repeat(k, group, 0), jnp.repeat(v, group, 0), scale, causal
-        ) if group > 1 else _xla_attention(q, k, v, scale, causal)
-    return o, (q, k, v, o, lse)
-
-
-def _flash_fwd(q, k, v, scale, causal, use_pallas):
-    o, res = _flash_fwd_res(q, k, v, scale, causal, use_pallas)
-    return o, res
-
-
-def _flash_bwd(scale, causal, use_pallas, res, do):
-    q, k, v, o, lse = res
-    if use_pallas:
-        dq, dk, dv = _k.flash_bwd(
-            q, k, v, o, lse, do, scale=scale, causal=causal,
+            q, k, v, scale=scale, causal=causal, kv_lens=kv_lens,
             interpret=_backend.interpret_mode(),
         )
     else:
         group = q.shape[0] // k.shape[0]
         kf = jnp.repeat(k, group, 0) if group > 1 else k
         vf = jnp.repeat(v, group, 0) if group > 1 else v
-        s = masked_scores(q, kf, scale, causal)
+        o, lse = _xla_attention(q, kf, vf, scale, causal, kv_lens)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_fwd(q, k, v, kv_lens, scale, causal, use_pallas):
+    o, res = _flash_fwd_res(q, k, v, kv_lens, scale, causal, use_pallas)
+    return o, (res, kv_lens)
+
+
+def _flash_bwd(scale, causal, use_pallas, res_and_lens, do):
+    res, kv_lens = res_and_lens
+    q, k, v, o, lse = res
+    if use_pallas:
+        dq, dk, dv = _k.flash_bwd(
+            q, k, v, o, lse, do, scale=scale, causal=causal, kv_lens=kv_lens,
+            interpret=_backend.interpret_mode(),
+        )
+    else:
+        group = q.shape[0] // k.shape[0]
+        kf = jnp.repeat(k, group, 0) if group > 1 else k
+        vf = jnp.repeat(v, group, 0) if group > 1 else v
+        s = masked_scores(q, kf, scale, causal, kv_lens)
         p = jnp.exp(s - lse[..., None])
         dof = do.astype(jnp.float32)
         dv = jnp.einsum("bqk,bqd->bkd", p, dof)
@@ -103,7 +115,12 @@ def _flash_bwd(scale, causal, use_pallas, res, do):
             dk = dk.reshape(-1, group, sk, d).sum(1)
             dv = dv.reshape(-1, group, sk, d).sum(1)
         dk, dv = dk.astype(k.dtype), dv.astype(v.dtype)
-    return dq, dk, dv
+    if kv_lens is None:
+        dlens = None
+    else:
+        import numpy as np
+        dlens = np.zeros(kv_lens.shape, jax.dtypes.float0)
+    return dq, dk, dv, dlens
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
@@ -111,7 +128,8 @@ _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
-    *, causal: bool = False, scale: Optional[float] = None, impl: str = "auto",
+    *, causal: bool = False, scale: Optional[float] = None,
+    kv_lens: Optional[jax.Array] = None, impl: str = "auto",
 ) -> jax.Array:
     """Blockwise attention over (..., seq, head_dim) with any number of
     leading batch/head dims. No sequence-length cap (cf. fmha's 512).
@@ -124,6 +142,17 @@ def flash_attention(
     each kv row once per group via its BlockSpec index map — kv is never
     repeated in HBM. A capability the reference's fixed-shape fmha kernels
     (seq≤512, equal heads) cannot express.
+
+    ``kv_lens``: per-row valid kv length over q's leading dims (padded
+    batches) — positions >= the length are masked out; the compute of KV
+    blocks entirely past it is skipped dynamically in-kernel (their
+    HBM→VMEM copies still run — BlockSpec DMA is unconditional), so ragged
+    batches save MXU time but not block DMA. Rows with length 0 return
+    zeros. Composes with ``causal``. Passing ``kv_lens=None`` compiles
+    kernels with no varlen operand or masking at all. (The reference's
+    fused softmax takes a full (b,1,sq,sk) mask tensor; a length vector
+    expresses the padded-batch case in O(rows) and keeps the flash memory
+    profile.)
 
     ``impl='auto'`` picks the Pallas kernel from seq >= 1024: below that the
     grid/launch overhead outweighs the saved score-tensor HBM traffic and
@@ -166,7 +195,15 @@ def flash_attention(
     if impl == "auto" and k3.shape[-2] < 1024 and not _backend.interpret_forced():
         impl = "xla"  # measured: grid overhead beats saved score traffic
     use_pallas = _backend.choose_impl(impl, ok) == "pallas"
-    o = _flash_core(q3, k3, v3, scale, causal, use_pallas)
+    if kv_lens is not None:
+        if kv_lens.shape != lead:
+            raise ValueError(
+                f"kv_lens shape {kv_lens.shape} must equal q's leading dims "
+                f"{lead}")
+        # int32 before the custom_vjp: backward returns a float0 cotangent,
+        # which JAX only accepts for integer primals
+        kv_lens = kv_lens.reshape(-1).astype(jnp.int32)
+    o = _flash_core(q3, k3, v3, kv_lens, scale, causal, use_pallas)
     return o.reshape(*lead, q.shape[-2], d)
 
 

@@ -50,8 +50,15 @@ def _fit_block(n, pref):
 
 # --- forward ------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, nk, off):
+def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, varlen):
+    """``varlen`` is a STATIC specialization flag: without kv lengths the
+    kernel carries no length operand, no per-block length select, and no
+    dynamic predicate conjunct — the common (non-padded) call pays nothing.
+    """
+    if varlen:
+        q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     i = pl.program_id(1)  # q block
     j = pl.program_id(2)  # k block
 
@@ -62,8 +69,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     # causal: process only blocks intersecting the (bottom-right aligned)
-    # lower triangle — row r attends cols <= r + off, off = sk - sq
+    # lower triangle — row r attends cols <= r + off, off = sk - sq.
+    # varlen: additionally skip KV blocks entirely past this row's valid
+    # length (a *dynamic* predicate — pl.when predicates the block; note the
+    # block's DMA is issued regardless, only the compute is skipped).
     run = (not causal) or (j * bk <= (i + 1) * bq - 1 + off)
+    if varlen:
+        kvlen = kvlen_ref[0, 0, 0]
+        run = jnp.logical_and(run, j * bk < kvlen)
 
     @pl.when(run)
     def _step():
@@ -75,10 +88,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (bq, bk)
+        if causal or varlen:
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
             rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols <= rows + off, s, NEG_INF)
+        if varlen:
+            s = jnp.where(cols < kvlen, s, NEG_INF)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -94,10 +110,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finish():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_val = m_scr[:] + jnp.log(l)
+        if varlen:
+            # fully-masked rows (kvlen == 0): lse would be NEG_INF+log(eps),
+            # and backward's exp(s - lse) with s == NEG_INF would overflow
+            # to exp(+huge); pin dead rows' lse to 0 so p == exp(NEG_INF).
+            lse_val = jnp.where(l_scr[:] > 0.0, lse_val, 0.0)
         # lse rides an (sq, 8) layout: TPU blocks must tile (8, 128) or match
         # the array dim, so a flat (1, bq) row block won't lower — broadcast
         # the column across 8 lanes and let the caller slice lane 0.
-        lse_ref[0] = jnp.broadcast_to(m_scr[:] + jnp.log(l), (l.shape[0], _LSE_LANES))
+        lse_ref[0] = jnp.broadcast_to(lse_val, (l.shape[0], _LSE_LANES))
 
 
 _LSE_LANES = 8
@@ -108,26 +130,48 @@ def _expand_rows(x):
     return jnp.broadcast_to(x[..., None], (*x.shape, _LSE_LANES))
 
 
-def flash_fwd(q, k, v, *, scale, causal, bq=1024, bk=1024, interpret=False):
+def _kvlen_rows(kv_lens, bh, sk):
+    """(bh,) int32 valid-lengths -> the (bh, 8) lane-carrier the kernels
+    read; None means every row sees the full sk."""
+    if kv_lens is None:
+        kv_lens = jnp.full((bh,), sk, jnp.int32)
+    return jnp.broadcast_to(kv_lens.astype(jnp.int32)[:, None, None],
+                            (bh, 1, _LSE_LANES))
+
+
+def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bq=1024, bk=1024,
+              interpret=False):
     """q (bh, sq, d); k/v (bh_kv, sk, d) where bh_kv divides bh — grouped-
     query attention falls out of the kv BlockSpec index maps (q row ``b``
     reads kv row ``b // group``), zero-copy: kv shards are never repeated
-    in HBM."""
+    in HBM. ``kv_lens`` (bh,) int32 masks each row's kv positions >= its
+    length (padded batches); the MXU/VPU work of KV blocks entirely past
+    the length is skipped dynamically (their DMA still runs — BlockSpec
+    copies are unconditional). ``kv_lens=None`` compiles a kernel with no
+    varlen operand or masking at all."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     group = bh // k.shape[0]
     bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
+    varlen = kv_lens is not None
+
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j, g=group: (b // g, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j, g=group: (b // g, j, 0)),
+    ]
+    args = [q, k, v]
+    if varlen:
+        in_specs.append(
+            pl.BlockSpec((1, 1, _LSE_LANES), lambda b, i, j: (b, 0, 0)))
+        args.append(_kvlen_rows(kv_lens, bh, sk))
 
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, off=sk - sq),
+                          bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=varlen),
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j, g=group: (b // g, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j, g=group: (b // g, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i, j: (b, i, 0)),
@@ -145,14 +189,18 @@ def flash_fwd(q, k, v, *, scale, causal, bq=1024, bk=1024, interpret=False):
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
     return o, lse[..., 0]
 
 
 # --- backward -----------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_scr, *, scale, causal, bq, bk, nk, off):
+def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, varlen):
+    if varlen:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref,
+         dq_ref, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr = refs
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -161,6 +209,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     run = (not causal) or (j * bk <= (i + 1) * bq - 1 + off)
+    if varlen:
+        kvlen = kvlen_ref[0, 0, 0]
+        run = jnp.logical_and(run, j * bk < kvlen)
 
     @pl.when(run)
     def _step():
@@ -172,10 +223,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if causal or varlen:
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
             rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols <= rows + off, s, NEG_INF)
+        if varlen:
+            s = jnp.where(cols < kvlen, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, 0:1])
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -190,8 +244,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = acc_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, bq, bk, nq, off):
+def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, varlen):
+    if varlen:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
     j = pl.program_id(1)  # k block (outer)
     i = pl.program_id(2)  # q block (inner, accumulated)
 
@@ -201,6 +260,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     run = (not causal) or ((i + 1) * bq - 1 + off >= j * bk)
+    if varlen:
+        kvlen = kvlen_ref[0, 0, 0]
+        run = jnp.logical_and(run, j * bk < kvlen)
 
     @pl.when(run)
     def _step():
@@ -212,10 +274,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if causal or varlen:
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
             rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols <= rows + off, s, NEG_INF)
+        if varlen:
+            s = jnp.where(cols < kvlen, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, 0:1])  # (bq, bk)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -235,8 +300,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def flash_bwd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
-              interpret=False):
+def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
+              bq=1024, bk=1024, interpret=False):
     """Gradients; with grouped kv (bh_kv < bh) dk/dv come back at kv shape —
     the dkv kernel runs per *q*-head (its scratch accumulates over q blocks
     within one grid row, so cross-head accumulation can't live in-kernel)
@@ -249,10 +314,16 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     lse3, delta3 = _expand_rows(lse), _expand_rows(delta)
+    varlen = kv_lens is not None
+    extra_args = [_kvlen_rows(kv_lens, bh, sk)] if varlen else []
+
+    def kvlen_spec(index_map):
+        return ([pl.BlockSpec((1, 1, _LSE_LANES), index_map)]
+                if varlen else [])
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, off=sk - sq),
+                          bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=varlen),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -261,7 +332,7 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i, j: (b, i, 0)),
-        ],
+        ] + kvlen_spec(lambda b, i, j: (b, 0, 0)),
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
@@ -269,11 +340,11 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q, k, v, do, lse3, delta3)
+    )(q, k, v, do, lse3, delta3, *extra_args)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, off=sk - sq),
+                          bq=bq, bk=bk, nq=nq, off=sk - sq, varlen=varlen),
         grid=(bh, nk, nq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
@@ -282,7 +353,7 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bq, _LSE_LANES), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bq, _LSE_LANES), lambda b, j, i: (b, i, 0)),
-        ],
+        ] + kvlen_spec(lambda b, j, i: (b, 0, 0)),
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
@@ -299,7 +370,7 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q, k, v, do, lse3, delta3)
+    )(q, k, v, do, lse3, delta3, *extra_args)
     if group > 1:
         dk = dk.astype(jnp.float32).reshape(-1, group, sk, d).sum(1).astype(k.dtype)
         dv = dv.astype(jnp.float32).reshape(-1, group, sk, d).sum(1).astype(v.dtype)

@@ -167,6 +167,92 @@ class TestGroupedQueryAttention:
             flash_attention(q, k, k)
 
 
+class TestVarlenAttention:
+    """Per-row kv valid lengths (padded batches) — the flash analog of the
+    reference's mask-tensor softmax, expressed in O(rows)."""
+
+    def _oracle(self, q, k, v, lens, causal):
+        sk = k.shape[-2]
+        s = jnp.einsum("...qd,...kd->...qk", q, k) / q.shape[-1] ** 0.5
+        if causal:
+            sq = s.shape[-2]
+            cm = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None] + (sk - sq)
+            s = jnp.where(cm, s, -1e30)
+        lm = jnp.arange(sk)[None, None, :] < lens[:, None, None]
+        s = jnp.where(lm, s, -1e30)
+        o = jnp.einsum("...qk,...kd->...qd", jax.nn.softmax(s, -1), v)
+        return jnp.where((lens == 0)[:, None, None], 0.0, o)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_masked_dense(self, causal):
+        bh, s, d = 4, 32, 16
+        q = jr.normal(K, (bh, s, d))
+        k = jr.normal(jr.fold_in(K, 1), (bh, s, d))
+        v = jr.normal(jr.fold_in(K, 2), (bh, s, d))
+        lens = jnp.array([32, 17, 1, 0], jnp.int32)
+        o = flash_attention(q, k, v, causal=causal, kv_lens=lens)
+        np.testing.assert_allclose(o, self._oracle(q, k, v, lens, causal),
+                                   rtol=RTOL, atol=ATOL)
+
+    def test_grads_match_masked_dense(self):
+        bh, s, d = 3, 32, 16
+        q = jr.normal(K, (bh, s, d))
+        k = jr.normal(jr.fold_in(K, 3), (bh, s, d))
+        v = jr.normal(jr.fold_in(K, 4), (bh, s, d))
+        lens = jnp.array([32, 9, 0], jnp.int32)
+        f1 = lambda q, k, v: jnp.sum(jnp.sin(
+            flash_attention(q, k, v, causal=True, kv_lens=lens)))
+        f2 = lambda q, k, v: jnp.sum(jnp.sin(self._oracle(q, k, v, lens, True)))
+        g1 = jax.grad(f1, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f2, argnums=(0, 1, 2))(q, k, v)
+        for a, e in zip(g1, g2):
+            np.testing.assert_allclose(a, e, rtol=G_RTOL, atol=G_ATOL)
+
+    @pytest.mark.pallas
+    def test_pallas_kernel_varlen_fwd_bwd(self, monkeypatch):
+        """In-kernel masking + dynamic block skip + dead-row lse pinning."""
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        bh, s, d = 2, 256, 64
+        q = jr.normal(K, (bh, s, d)).astype(jnp.float32)
+        k = jr.normal(jr.fold_in(K, 5), (bh, s, d))
+        v = jr.normal(jr.fold_in(K, 6), (bh, s, d))
+        lens = jnp.array([256, 0], jnp.int32)  # include a DEAD row: the
+        # kernel's all-blocks-skipped path + lse pinning must hold in-kernel
+        with jax.default_matmul_precision("highest"):
+            o = flash_attention(q, k, v, causal=True, kv_lens=lens,
+                                impl="pallas")
+            np.testing.assert_allclose(o, self._oracle(q, k, v, lens, True),
+                                       rtol=2e-5, atol=2e-5)
+            f1 = lambda q, k, v: jnp.sum(jnp.cos(flash_attention(
+                q, k, v, causal=True, kv_lens=lens, impl="pallas")))
+            f2 = lambda q, k, v: jnp.sum(jnp.cos(
+                self._oracle(q, k, v, lens, True)))
+            g1 = jax.grad(f1, argnums=(0, 1, 2))(q, k, v)
+            g2 = jax.grad(f2, argnums=(0, 1, 2))(q, k, v)
+        for a, e in zip(g1, g2):
+            np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-4)
+
+    def test_varlen_with_gqa(self):
+        b, hq, kvh, s, d = 2, 4, 2, 32, 16
+        q = jr.normal(K, (b, hq, s, d))
+        k = jr.normal(jr.fold_in(K, 7), (b, kvh, s, d))
+        v = jr.normal(jr.fold_in(K, 8), (b, kvh, s, d))
+        lens = jnp.broadcast_to(jnp.array([20, 32], jnp.int32)[:, None],
+                                (b, hq))
+        o = flash_attention(q, k, v, kv_lens=lens)
+        rep = hq // kvh
+        kr, vr = jnp.repeat(k, rep, 1), jnp.repeat(v, rep, 1)
+        ref = self._oracle(q.reshape(b * hq, s, d), kr.reshape(b * hq, s, d),
+                           vr.reshape(b * hq, s, d), lens.reshape(-1),
+                           False).reshape(b, hq, s, d)
+        np.testing.assert_allclose(o, ref, rtol=RTOL, atol=ATOL)
+
+    def test_bad_lens_shape_raises(self):
+        q = jr.normal(K, (2, 4, 32, 16))
+        with pytest.raises(ValueError, match="kv_lens"):
+            flash_attention(q, q, q, kv_lens=jnp.ones((2,), jnp.int32))
+
+
 class TestRingAttention:
     @pytest.mark.parametrize("causal", [False, True])
     def test_matches_dense_full_sequence(self, causal):
