@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.ops import fused_layer_norm, scaled_masked_softmax
+from apex_tpu.ops.attention import flash_attention
 from apex_tpu.transformer import tensor_parallel as tp_lib
 from apex_tpu.transformer.tensor_parallel.utils import divide
 
@@ -33,6 +34,19 @@ class BertConfig:
     tp_axis: Optional[str] = "tp"
     remat: bool = True
     dtype: Any = jnp.float32
+    # "softmax": materialized scores through the fused scaled-masked-softmax
+    # kernel, arbitrary pad masks (the Megatron standalone_bert path).
+    # "flash": blockwise flash attention with the pad mask converted to
+    # per-row kv lengths — O(s) memory, no sequence cap; requires the mask
+    # to be SUFFIX padding (True only after each row's last valid token),
+    # the layout every standard BERT batcher produces.
+    attention_impl: str = "softmax"
+
+    def __post_init__(self):
+        if self.attention_impl not in ("softmax", "flash"):
+            raise ValueError(
+                f"attention_impl must be softmax|flash, got "
+                f"{self.attention_impl!r}")
 
     @property
     def ffn(self) -> int:
@@ -107,11 +121,25 @@ class BertModel:
         # transpose-free layout of models/gpt.py:_attention
         qkv = self.qkv.headwise(p["qkv"], x, 3 * h).reshape(b, 3, h, s, d)
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
-        # mask: (b, 1, 1, s) True = masked out (padding)
-        mask = None if pad_mask is None else pad_mask[:, None, None, :]
-        probs = scaled_masked_softmax(scores, mask, 1.0 / float(d) ** 0.5)
-        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        if c.attention_impl == "flash":
+            # pad mask -> per-row valid lengths: the row is truncated at the
+            # FIRST masked position. For suffix padding (every standard BERT
+            # batcher) this equals the valid length exactly; for an interior
+            # mask it truncates early rather than ever attending a masked
+            # token (sum(~mask) would) — still prefer the softmax impl for
+            # arbitrary masks.
+            kv_lens = None
+            if pad_mask is not None:
+                lens = jnp.where(jnp.any(pad_mask, -1),
+                                 jnp.argmax(pad_mask, -1), s).astype(jnp.int32)
+                kv_lens = jnp.broadcast_to(lens[:, None], (b, h))
+            ctx = flash_attention(q, k, v, kv_lens=kv_lens)
+        else:
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+            # mask: (b, 1, 1, s) True = masked out (padding)
+            mask = None if pad_mask is None else pad_mask[:, None, None, :]
+            probs = scaled_masked_softmax(scores, mask, 1.0 / float(d) ** 0.5)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
         return self.attn_out.headwise(p["attn_out"], ctx)
 
     def _block(self, p, x, pad_mask):

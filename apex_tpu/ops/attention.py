@@ -36,13 +36,18 @@ from apex_tpu.parallel import mesh as mesh_lib
 def masked_scores(q, k, scale, causal, kv_lens=None):
     """fp32 scaled scores over (..., seq, head_dim) with the bottom-right-
     aligned causal mask (last ``sq`` query rows of an ``sk``-long context)
-    and optional per-row valid kv lengths (padding)."""
+    and optional per-row valid kv lengths (padding). ``kv_lens`` requires
+    the flattened 3D layout (rows, seq, d) with one length per row."""
     s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
     sq, sk = s.shape[-2], s.shape[-1]
     if causal:
         mask = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None] + (sk - sq)
         s = jnp.where(mask, s, _k.NEG_INF)
     if kv_lens is not None:
+        if s.ndim != 3:
+            raise ValueError(
+                "kv_lens masking requires 3D (rows, sq, sk) scores; flatten "
+                "leading dims to rows first")
         s = jnp.where(jnp.arange(sk)[None, None, :] < kv_lens[:, None, None],
                       s, _k.NEG_INF)
     return s

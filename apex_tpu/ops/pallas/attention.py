@@ -130,11 +130,10 @@ def _expand_rows(x):
     return jnp.broadcast_to(x[..., None], (*x.shape, _LSE_LANES))
 
 
-def _kvlen_rows(kv_lens, bh, sk):
-    """(bh,) int32 valid-lengths -> the (bh, 8) lane-carrier the kernels
-    read; None means every row sees the full sk."""
-    if kv_lens is None:
-        kv_lens = jnp.full((bh,), sk, jnp.int32)
+def _kvlen_rows(kv_lens, bh):
+    """(bh,) int32 valid-lengths -> the (bh, 1, 8) lane-carrier the varlen
+    kernels read (callers only build this when lengths are present — the
+    no-length case compiles kernels with no length operand at all)."""
     return jnp.broadcast_to(kv_lens.astype(jnp.int32)[:, None, None],
                             (bh, 1, _LSE_LANES))
 
@@ -165,7 +164,7 @@ def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bq=1024, bk=1024,
     if varlen:
         in_specs.append(
             pl.BlockSpec((1, 1, _LSE_LANES), lambda b, i, j: (b, 0, 0)))
-        args.append(_kvlen_rows(kv_lens, bh, sk))
+        args.append(_kvlen_rows(kv_lens, bh))
 
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
@@ -315,7 +314,7 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     lse3, delta3 = _expand_rows(lse), _expand_rows(delta)
     varlen = kv_lens is not None
-    extra_args = [_kvlen_rows(kv_lens, bh, sk)] if varlen else []
+    extra_args = [_kvlen_rows(kv_lens, bh)] if varlen else []
 
     def kvlen_spec(index_map):
         return ([pl.BlockSpec((1, 1, _LSE_LANES), index_map)]

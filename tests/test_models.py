@@ -157,6 +157,30 @@ class TestBert:
         loss2 = m.mlm_loss(params, toks, tgts, lm2, pad_mask=pad)
         assert loss != loss2
 
+    def test_flash_impl_matches_softmax_on_suffix_padding(self):
+        """BERT's flash path converts the suffix pad mask to per-row kv
+        lengths (varlen flash); on standard suffix-padded batches it must
+        agree with the mask-tensor softmax path at masked-out-loss parity."""
+        kw = dict(vocab_size=64, max_seq_len=32, hidden_size=32,
+                  num_layers=2, num_heads=4)
+        m_soft = BertModel(BertConfig(**kw))
+        m_flash = BertModel(BertConfig(attention_impl="flash", **kw))
+        params = m_soft.init(K)
+        toks = jr.randint(jr.fold_in(K, 7), (2, 16), 0, 64)
+        tgts = jr.randint(jr.fold_in(K, 8), (2, 16), 0, 64)
+        # suffix padding: rows valid to 16 and 10
+        pad = jnp.zeros((2, 16), bool).at[1, 10:].set(True)
+        loss_mask = (~pad).astype(jnp.float32)
+        with jax.default_matmul_precision("highest"):
+            l1 = m_soft.mlm_loss(params, toks, tgts, loss_mask, pad_mask=pad)
+            l2 = m_flash.mlm_loss(params, toks, tgts, loss_mask, pad_mask=pad)
+        # the two masked softmaxes differ only in the -10000-additive vs
+        # -inf masking of dead columns — loss over VALID positions agrees
+        assert float(l1) == pytest.approx(float(l2), rel=2e-3)
+        g = jax.grad(lambda p: m_flash.mlm_loss(
+            p, toks, tgts, loss_mask, pad_mask=pad))(params)
+        assert all(bool(jnp.all(jnp.isfinite(x))) for x in jax.tree.leaves(g))
+
     def test_pooler(self):
         cfg = BertConfig(vocab_size=64, max_seq_len=32, hidden_size=32,
                          num_layers=1, num_heads=4)
