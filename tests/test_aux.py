@@ -257,8 +257,9 @@ class TestPrefetch:
                    for _ in range(3))
         out = list(data_parallel_iterator(batches))
         assert len(out) == 3
+        dp = mesh_lib.get_data_parallel_world_size()
         shard_shapes = {s.data.shape for s in out[0]["x"].addressable_shards}
-        assert shard_shapes == {(2, 2)}  # 16 rows over dp=8
+        assert shard_shapes == {(16 // dp, 2)}  # 16 rows over dp
 
     def test_size_validation(self):
         from apex_tpu.transformer._data import prefetch_to_device

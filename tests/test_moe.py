@@ -54,7 +54,9 @@ class TestRouter:
         w1, b1 = params["w1"][0], params["b1"][0]
         w2, b2 = params["w2"][0], params["b2"][0]
         ref = jax.nn.gelu(x @ w1 + b1, approximate=True) @ w2 + b2
-        np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+        # hardware: fp32 matmuls run bf16-rounded at default MXU precision
+        tol = (1e-4, 1e-5) if jax.default_backend() != "tpu" else (2e-2, 2e-2)
+        np.testing.assert_allclose(y, ref, rtol=tol[0], atol=tol[1])
 
 
 class TestExpertParallel:
@@ -86,6 +88,8 @@ class TestExpertParallel:
 
     def test_ep_router_shape_mismatch_raises(self):
         mesh = mesh_lib.make_mesh()
+        if mesh.shape["dp"] < 2:
+            pytest.skip("mismatch needs dp > 1 (4 local experts x dp != 4)")
         bank = MoEMLP(4, 8, 16)  # 4 experts but dp=8 -> E = local*8 != 4
         params = bank.init(K)
         x = jr.normal(K, (16, 8))
