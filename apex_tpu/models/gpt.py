@@ -355,14 +355,21 @@ class GPTModel:
         x = self.hidden_states(params, tokens, key)
         return jnp.dot(x, params["embedding"]["weight"].T)
 
-    def loss_fn(self, params, tokens, targets, key=None):
+    def loss_fn(self, params, tokens, targets, key=None, loss_mask=None):
         """Mean LM loss via vocab-parallel CE (the reference's
-        ``vocab_parallel_cross_entropy`` on the last stage)."""
+        ``vocab_parallel_cross_entropy`` on the last stage). ``loss_mask``
+        (tokens-shaped, 1 = count) weights the mean — the consumer of
+        ``get_ltor_masks_and_position_ids``'s loss mask (reference
+        ``pipeline_parallel/utils.py:303``: EOD and padding positions are
+        excluded from the loss there the same way)."""
         logits = self.logits(params, tokens, key)
         losses = tp_lib.vocab_parallel_cross_entropy(
             logits, targets, axis_name=self.axis
         )
-        return jnp.mean(losses)
+        if loss_mask is None:
+            return jnp.mean(losses)
+        m = loss_mask.astype(losses.dtype)
+        return jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)
 
 
 def _dropout(x, rate, key):

@@ -286,3 +286,29 @@ class TestGPTAttentionAndRematVariants:
             GPTConfig(num_heads=8, num_kv_heads=1, tp_size=2)
         cfg = GPTConfig(num_heads=8, num_kv_heads=2)
         assert cfg.qkv_features == (8 + 4) * cfg.head_dim
+
+
+class TestGPTLossMask:
+    def test_loss_mask_weights_the_mean(self):
+        """loss_fn(loss_mask=...) consumes get_ltor_masks_and_position_ids'
+        loss mask: masked positions drop out of the mean exactly."""
+        cfg = GPTConfig(vocab_size=64, max_seq_len=32, hidden_size=32,
+                        num_layers=1, num_heads=2, remat=False)
+        m = GPTModel(cfg)
+        params = m.init(K)
+        toks = jr.randint(jr.fold_in(K, 1), (2, 16), 0, 64)
+        tgts = jr.randint(jr.fold_in(K, 2), (2, 16), 0, 64)
+        full = m.loss_fn(params, toks, tgts)
+        ones = m.loss_fn(params, toks, tgts, loss_mask=jnp.ones((2, 16)))
+        assert float(full) == pytest.approx(float(ones), rel=1e-6)
+        # mask half: equals the mean over the kept positions
+        mask = jnp.zeros((2, 16)).at[:, :8].set(1.0)
+        masked = m.loss_fn(params, toks, tgts, loss_mask=mask)
+        logits = m.logits(params, toks)
+        from apex_tpu.transformer import tensor_parallel as tp
+        per_tok = tp.vocab_parallel_cross_entropy(logits, tgts, axis_name=None)
+        ref = float(jnp.mean(per_tok[:, :8]))
+        assert float(masked) == pytest.approx(ref, rel=1e-5)
+        # all-masked: finite (denominator clamped), not NaN
+        z = m.loss_fn(params, toks, tgts, loss_mask=jnp.zeros((2, 16)))
+        assert float(z) == 0.0
