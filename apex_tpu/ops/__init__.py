@@ -17,7 +17,8 @@ non-trivial residuals:
 * ``transducer_{joint,loss}_cuda`` → :mod:`apex_tpu.ops.transducer`
 
 Kernel selection: ``impl='auto'`` resolves to each op's *measured* default
-(see ``_backend`` and PERF.md): the flash-attention kernel from seq >= 1024;
+(see ``_backend`` and PERF.md): the flash-attention kernel from seq >= 1024
+(512 at head_dim >= 128 — ``attention.flash_auto_crossover``);
 the custom-VJP XLA compositions for layer norm, softmax, dense, and MLP,
 which outran their kernels at every measured shape. ``impl='pallas'`` forces
 a kernel (raising when shapes miss its tiling constraints — the analog of

@@ -10,7 +10,8 @@ constraints, and (c) an override:
 * ``impl='pallas'`` — always use the Pallas kernel (interpret mode off-TPU);
 * ``impl='xla'``    — always use the jnp composition;
 * ``impl='auto'``   — each op's *measured* default: flash attention picks
-  the Pallas kernel from seq >= 1024 (the one kernel family with a large
+  the Pallas kernel from seq >= 1024 — or seq >= 512 at head_dim >= 128
+  (``attention.flash_auto_crossover``) — (the one kernel family with a large
   honest win — it removes an O(s²) HBM tensor XLA cannot); layer norm,
   softmax, dense, and MLP resolve to their custom-VJP XLA compositions,
   which outran the kernels at every measured shape (PERF.md). Ops encode

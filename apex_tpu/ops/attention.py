@@ -33,6 +33,13 @@ from apex_tpu.parallel import mesh as mesh_lib
 
 # --- single-device flash attention -------------------------------------------
 
+def flash_auto_crossover(head_dim: int) -> int:
+    """Minimum kv sequence length at which ``impl='auto'`` picks the Pallas
+    kernel — measured end-to-end on v5e (see :func:`flash_attention`'s
+    docstring table): 1024 at head_dim 64, 512 from head_dim 128 (full MXU
+    lanes lower the kernel's break-even)."""
+    return 512 if head_dim >= 128 else 1024
+
 def masked_scores(q, k, scale, causal, kv_lens=None):
     """fp32 scaled scores over (..., seq, head_dim) with the bottom-right-
     aligned causal mask (last ``sq`` query rows of an ``sk``-long context)
@@ -159,16 +166,18 @@ def flash_attention(
     expresses the padded-batch case in O(rows) and keeps the flash memory
     profile.)
 
-    ``impl='auto'`` picks the Pallas kernel from seq >= 1024: below that the
-    grid/launch overhead outweighs the saved score-tensor HBM traffic and
-    XLA's batched-matmul composition of the same math (still
-    recompute-in-backward via this function's custom_vjp — O(s) residuals)
-    is faster on v5e-class chips. Measured end-to-end on the GPT-medium
-    train step (v5e, S=1024, bh=256, d=64): pallas 248.7 ms/step vs xla
-    264.6 — isolated-kernel timings through the remote tunnel had
-    previously suggested a 4096 crossover, but the full-step measurement
-    (where the kernel competes with everything else for HBM) is the one
-    that matters."""
+    ``impl='auto'`` picks the Pallas kernel from seq >= 1024, or from
+    seq >= 512 when head_dim >= 128 (full MXU lanes lower the kernel's
+    break-even): below the crossover the grid/launch overhead outweighs the
+    saved score-tensor HBM traffic and XLA's batched-matmul composition of
+    the same math (still recompute-in-backward via this function's
+    custom_vjp — O(s) residuals) is faster on v5e-class chips. Measured
+    end-to-end on GPT-medium train steps (v5e): d=64 S=1024 pallas 248.7
+    vs xla 264.6 ms/step; d=128 S=512 163.4 vs 170.1 (kernel wins), S=256
+    165.8 vs 158.7 (xla wins). Isolated-kernel timings through the remote
+    tunnel had previously suggested a 4096 crossover — the full-step
+    measurement (where the kernel competes with everything else for HBM)
+    is the one that matters."""
     q, k, v = apply_op_rules("attention", q, k, v)
     d = q.shape[-1]
     scale = float(scale if scale is not None else 1.0 / d ** 0.5)
@@ -197,8 +206,9 @@ def flash_attention(
         q3.shape[-2] % 128 == 0 and k3.shape[-2] % 128 == 0
         and (d % 128 == 0 or d == 64)
     )
-    if impl == "auto" and k3.shape[-2] < 1024 and not _backend.interpret_forced():
-        impl = "xla"  # measured: grid overhead beats saved score traffic
+    if (impl == "auto" and k3.shape[-2] < flash_auto_crossover(d)
+            and not _backend.interpret_forced()):
+        impl = "xla"  # grid overhead beats saved score traffic below this
     use_pallas = _backend.choose_impl(impl, ok) == "pallas"
     if kv_lens is not None:
         if kv_lens.shape != lead:

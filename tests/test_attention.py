@@ -409,3 +409,15 @@ class TestUlyssesAttention:
                 mesh=mesh, in_specs=(P(None, "cp"),),
                 out_specs=P(None, "cp"),
             )(q)
+
+
+class TestFlashAutoDispatch:
+    def test_crossover_rule(self):
+        """The measured auto-dispatch thresholds (PERF.md): 1024 at d=64,
+        512 from d=128 — pinned so a dispatch edit can't silently flip
+        which impl serves S in [512, 1024)."""
+        from apex_tpu.ops.attention import flash_auto_crossover
+
+        assert flash_auto_crossover(64) == 1024
+        assert flash_auto_crossover(128) == 512
+        assert flash_auto_crossover(256) == 512
