@@ -300,16 +300,11 @@ class GPTModel:
             m = _dropout(m, c.dropout, jax.random.fold_in(key, 2))
         return x + m
 
-    # --- forward --------------------------------------------------------------
-
-    def hidden_states(self, params, tokens, key=None):
+    def wrapped_block(self):
+        """The transformer block with the config's remat policy applied —
+        the unit both :meth:`hidden_states` and the pipeline stage
+        partitioner (``pipeline_parallel/build_model.py``) iterate."""
         c = self.config
-        s = tokens.shape[1]
-        x = self.embedding(params["embedding"], tokens)
-        x = x + params["pos_embedding"][:s]
-        if self.sp:
-            x = self._sp_scatter(x)  # residual stream is seq-sharded
-
         block = self._block
         if c.remat:
             if c.remat_policy == "save_attn":
@@ -328,6 +323,19 @@ class GPTModel:
                 pass  # _block already wraps its mlp half in jax.checkpoint
             else:
                 block = jax.checkpoint(block)
+        return block
+
+    # --- forward --------------------------------------------------------------
+
+    def hidden_states(self, params, tokens, key=None):
+        c = self.config
+        s = tokens.shape[1]
+        x = self.embedding(params["embedding"], tokens)
+        x = x + params["pos_embedding"][:s]
+        if self.sp:
+            x = self._sp_scatter(x)  # residual stream is seq-sharded
+
+        block = self.wrapped_block()
 
         if c.scan_layers:
             def body(x, layer_and_key):
@@ -353,6 +361,19 @@ class GPTModel:
     def logits(self, params, tokens, key=None):
         """Tied unembedding: local shard logits (b, s, V/tp)."""
         x = self.hidden_states(params, tokens, key)
+        return self.unembed(params, x)
+
+    def unembed(self, params, x):
+        """Hidden states → local-shard logits. Under tp the input passes
+        through copy-to-region (identity forward, psum backward) — the LM
+        head is column-parallel over vocab, so each shard's matmul backward
+        yields only its vocab slice's contribution to dx; without the psum
+        transpose, per-rank gradients of everything upstream (final LN, the
+        whole stack) would be partial sums (Megatron's
+        ``parallel_lm_logits`` places the same ``copy_to`` for the same
+        reason)."""
+        if self.axis is not None:
+            x = tp_lib.copy_to_tensor_model_parallel_region(x, self.axis)
         return jnp.dot(x, params["embedding"]["weight"].T)
 
     def loss_fn(self, params, tokens, targets, key=None, loss_mask=None):
@@ -375,6 +396,87 @@ class GPTModel:
 def _dropout(x, rate, key):
     keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+def shard_params_for_tp(params, tp: int, config: GPTConfig):
+    """Split a replicated (tp=1) :meth:`GPTModel.init` pytree into per-rank
+    TP shards: every leaf gains a leading ``(tp,)`` axis holding rank r's
+    slice at index r (replicated leaves are broadcast). Shard under
+    ``P('tp', ...)`` specs and index ``[0]`` inside ``shard_map``.
+
+    The layout mirrors the layers' own partitioning (reference
+    ``tensor_parallel/layers.py``): qkv/mlp_up column-sharded by head /
+    output features, attn_out/mlp_down row-sharded by input features,
+    embedding vocab-sharded; LNs, positions and row-linear biases
+    replicated. The qkv split respects the (q-heads | k-heads | v-heads)
+    grouped feature packing of :meth:`GPTModel._attention`, including
+    narrower k/v groups under grouped-query attention."""
+    c = config
+    hq, hkv = c.num_heads, c.kv_heads
+    d = c.head_dim
+
+    def split_qkv(x, feature_axis):
+        # features packed (q: hq*d | k: hkv*d | v: hkv*d); each rank takes
+        # its head range from every group
+        q, k, v = jnp.split(
+            x, [hq * d, (hq + hkv) * d], axis=feature_axis)
+
+        def per_rank(y, heads):
+            shape = y.shape
+            hs = y.reshape(
+                *shape[:feature_axis], heads, d, *shape[feature_axis + 1:])
+            return [
+                jnp.take(hs, jnp.arange(i * heads // tp, (i + 1) * heads // tp),
+                         axis=feature_axis).reshape(
+                             *shape[:feature_axis], heads // tp * d,
+                             *shape[feature_axis + 1:])
+                for i in range(tp)
+            ]
+
+        qs, ks, vs = per_rank(q, hq), per_rank(k, hkv), per_rank(v, hkv)
+        return jnp.stack([
+            jnp.concatenate([qs[i], ks[i], vs[i]], axis=feature_axis)
+            for i in range(tp)
+        ])
+
+    def shard_layer_leaf(path, x):
+        name = "/".join(str(p) for p in path)
+        # leaves carry a leading (num_layers,) axis from the stacked init
+        if "qkv" in name and "weight" in name:
+            return split_qkv(x, 1)
+        if "qkv" in name and "bias" in name:
+            return split_qkv(x, 1)
+        if "mlp_up" in name:  # weight (L, ffn, hid) or bias (L, ffn)
+            return jnp.stack(jnp.split(x, tp, axis=1))
+        if "attn_out" in name and "weight" in name:  # (L, hid, hid) row-shard
+            return split_qkv_like_rows(x)
+        if "mlp_down" in name and "weight" in name:  # (L, hid, ffn)
+            return jnp.stack(jnp.split(x, tp, axis=2))
+        return jnp.broadcast_to(x, (tp,) + x.shape)
+
+    def split_qkv_like_rows(x):
+        # attn_out input features are (heads, d) contiguous — row-shard by
+        # head range
+        L, out = x.shape[0], x.shape[1]
+        y = x.reshape(L, out, hq, d)
+        per = hq // tp
+        return jnp.stack([
+            y[:, :, i * per:(i + 1) * per].reshape(L, out, per * d)
+            for i in range(tp)
+        ])
+
+    return {
+        "embedding": {
+            "weight": jnp.stack(
+                jnp.split(params["embedding"]["weight"], tp, axis=0)),
+        },
+        "pos_embedding": jnp.broadcast_to(
+            params["pos_embedding"], (tp,) + params["pos_embedding"].shape),
+        "layers": jax.tree_util.tree_map_with_path(
+            shard_layer_leaf, params["layers"]),
+        "lnf_w": jnp.broadcast_to(params["lnf_w"], (tp,) + params["lnf_w"].shape),
+        "lnf_b": jnp.broadcast_to(params["lnf_b"], (tp,) + params["lnf_b"].shape),
+    }
 
 
 # --- sequence-parallel boundary collectives (custom transposes) ---------------

@@ -100,33 +100,41 @@ def _flash_fwd(q, k, v, kv_lens, scale, causal, use_pallas):
     return o, (res, kv_lens)
 
 
-def _flash_bwd(scale, causal, use_pallas, res_and_lens, do):
-    res, kv_lens = res_and_lens
-    q, k, v, o, lse = res
+def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, scale, causal, use_pallas):
+    """dq/dk/dv from saved (o, lse). With a *global* lse this is also the
+    per-shard backward of distributed (ring) attention: p = exp(s − lse)
+    and Δ = rowsum(do·o_final) are exact per shard, so each shard's ds —
+    and hence its dq/dk/dv contribution — needs no cross-shard state."""
     if use_pallas:
-        dq, dk, dv = _k.flash_bwd(
+        return _k.flash_bwd(
             q, k, v, o, lse, do, scale=scale, causal=causal, kv_lens=kv_lens,
             interpret=_backend.interpret_mode(),
         )
-    else:
-        group = q.shape[0] // k.shape[0]
-        kf = jnp.repeat(k, group, 0) if group > 1 else k
-        vf = jnp.repeat(v, group, 0) if group > 1 else v
-        s = masked_scores(q, kf, scale, causal, kv_lens)
-        p = jnp.exp(s - lse[..., None])
-        dof = do.astype(jnp.float32)
-        dv = jnp.einsum("bqk,bqd->bkd", p, dof)
-        dp = jnp.einsum("bqd,bkd->bqk", dof, vf.astype(jnp.float32))
-        delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1, keepdims=True)
-        ds = p * (dp - delta) * scale
-        dq = jnp.einsum("bqk,bkd->bqd", ds, kf.astype(jnp.float32)).astype(q.dtype)
-        dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32))
-        if group > 1:
-            # per-q-head kv grads -> sum each kv group
-            sk, d = k.shape[1], k.shape[2]
-            dk = dk.reshape(-1, group, sk, d).sum(1)
-            dv = dv.reshape(-1, group, sk, d).sum(1)
-        dk, dv = dk.astype(k.dtype), dv.astype(v.dtype)
+    group = q.shape[0] // k.shape[0]
+    kf = jnp.repeat(k, group, 0) if group > 1 else k
+    vf = jnp.repeat(v, group, 0) if group > 1 else v
+    s = masked_scores(q, kf, scale, causal, kv_lens)
+    p = jnp.exp(s - lse[..., None])
+    dof = do.astype(jnp.float32)
+    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+    dp = jnp.einsum("bqd,bkd->bqk", dof, vf.astype(jnp.float32))
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf.astype(jnp.float32)).astype(q.dtype)
+    dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32))
+    if group > 1:
+        # per-q-head kv grads -> sum each kv group
+        sk, d = k.shape[1], k.shape[2]
+        dk = dk.reshape(-1, group, sk, d).sum(1)
+        dv = dv.reshape(-1, group, sk, d).sum(1)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _flash_bwd(scale, causal, use_pallas, res_and_lens, do):
+    res, kv_lens = res_and_lens
+    q, k, v, o, lse = res
+    dq, dk, dv = _flash_bwd_impl(
+        q, k, v, o, lse, do, kv_lens, scale, causal, use_pallas)
     if kv_lens is None:
         dlens = None
     else:

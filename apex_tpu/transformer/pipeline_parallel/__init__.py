@@ -25,3 +25,7 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
     get_forward_backward_func,
     pipeline_spmd_forward,
 )
+from apex_tpu.transformer.pipeline_parallel.build_model import (  # noqa: F401
+    GPTPipeline,
+    build_model,
+)

@@ -1,0 +1,274 @@
+"""Stage partitioner: run the flagship ``GPTModel`` through the pipeline.
+
+Equivalent of the reference's ``build_model`` + pre/post-process placement
+(``apex/transformer/pipeline_parallel/schedules/common.py:29-148``): there,
+``build_model`` constructs one module (or ``virtual_pipeline`` chunk
+modules) per pipeline rank, with ``pre_process`` (embedding) true only on
+the first stage and ``post_process`` (loss head) only on the last, and the
+schedules thread tensors between them over NCCL p2p.
+
+The TPU-native formulation keeps one SPMD program: :class:`GPTPipeline`
+*partitions the parameters* instead of the module —
+
+* ``partition()`` reshapes the model's stacked ``(num_layers, ...)`` layer
+  params into per-stage / per-virtual-chunk slices whose leading axis is
+  sharded over the ``pp`` mesh axis (virtual stage ``k = c·pp + rank`` runs
+  global layers ``[k·Lc, (k+1)·Lc)`` — the reference's interleaved
+  assignment, ``parallel_state.py:135-145``, is a plain reshape here);
+* pre-process (vocab-parallel embedding + positions) is *computed*
+  replicated on every pp rank — a cheap gather — but its parameters only
+  receive cotangents through pp rank 0's microbatch injection, which is the
+  SPMD image of "embedding lives on the first stage";
+* post-process (final LN, tied unembedding, vocab-parallel cross entropy)
+  likewise runs replicated but the loss is broadcast from rank 0 with a
+  masked transpose, so head/tied-embedding gradients are exactly the first
+  stage's — one ``psum`` over pp replicates them (the reference needs a
+  dedicated embedding all-reduce group for the tied weight,
+  ``parallel_state.py:338-375``; here it is the same psum).
+
+Everything of the shipped model crosses the schedule: flash attention,
+grouped-query kv, Megatron-SP boundary collectives, the remat policies, and
+vocab-parallel CE with its fused-statistics kernel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import fused_layer_norm
+from apex_tpu.parallel import mesh as mesh_lib
+from apex_tpu.transformer import tensor_parallel as tp_lib
+from apex_tpu.transformer.pipeline_parallel import schedules
+
+PyTree = Any
+
+
+def build_model(
+    model,
+    *,
+    pipeline_model_parallel_size: Optional[int] = None,
+    virtual_chunks: Optional[int] = None,
+    pp_axis: str = mesh_lib.PIPELINE_AXIS,
+) -> "GPTPipeline":
+    """Reference-named frontend (``schedules/common.py:29``): build the
+    pipeline decomposition of ``model`` from the installed mesh (or explicit
+    sizes)."""
+    if pipeline_model_parallel_size is None:
+        pipeline_model_parallel_size = \
+            mesh_lib.get_pipeline_model_parallel_world_size()
+        if virtual_chunks is None:
+            virtual_chunks = \
+                mesh_lib.get_virtual_pipeline_model_parallel_world_size()
+    return GPTPipeline(
+        model, pipeline_model_parallel_size,
+        virtual_chunks=virtual_chunks or 1, pp_axis=pp_axis,
+    )
+
+
+@dataclasses.dataclass
+class GPTPipeline:
+    """Pipeline-parallel execution of a :class:`~apex_tpu.models.GPTModel`.
+
+    ``partition``/``unpartition`` convert between the model's native param
+    pytree and the stage-sharded one; :meth:`loss_and_grads` is the full
+    fwd+bwd (to be called inside ``shard_map`` with the ``pp`` — and, when
+    ``model.config.tp_size > 1``, ``tp`` — axes bound), returning the same
+    loss as ``model.loss_fn`` on the concatenated microbatches, with
+    gradients laid out like the partitioned params.
+    """
+
+    model: Any
+    pp: int
+    virtual_chunks: int = 1
+    pp_axis: str = mesh_lib.PIPELINE_AXIS
+
+    def __post_init__(self):
+        c = self.model.config
+        v = self.virtual_chunks
+        if self.pp < 2:
+            raise ValueError("GPTPipeline needs pipeline_model_parallel_size"
+                             f" >= 2, got {self.pp}")
+        if c.num_layers % (self.pp * v):
+            raise ValueError(
+                f"num_layers ({c.num_layers}) must be divisible by pp*v "
+                f"({self.pp}*{v})")
+        if c.dropout > 0:
+            # per-(layer, microbatch, tick) key threading through the scan
+            # is not wired; the flagship trains dropout-free (cf. the bench)
+            raise NotImplementedError(
+                "GPTPipeline does not support dropout > 0")
+
+    @property
+    def layers_per_chunk(self) -> int:
+        return self.model.config.num_layers // (self.pp * self.virtual_chunks)
+
+    # --- parameter layout -----------------------------------------------------
+
+    def partition(self, params: PyTree) -> PyTree:
+        """Model params (layers stacked ``(L, ...)``) → pipeline params:
+
+        * ``stages``: layer leaves reshaped ``(pp, Lc, ...)`` (or
+          ``(v, pp, Lc, ...)`` interleaved) — shard the ``pp`` axis;
+        * ``embed``: embedding + positions (replicate over pp);
+        * ``head``: final LN (replicate over pp).
+
+        Works per TP shard: apply under ``jax.vmap`` for params carrying a
+        leading ``(tp,)`` axis (see ``models.gpt.shard_params_for_tp``).
+        """
+        pp, v, lc = self.pp, self.virtual_chunks, self.layers_per_chunk
+
+        def split(x):
+            y = x.reshape(v, pp, lc, *x.shape[1:])
+            return y[0] if v == 1 else y
+
+        return {
+            "embed": {"embedding": params["embedding"],
+                      "pos_embedding": params["pos_embedding"]},
+            "stages": jax.tree.map(split, params["layers"]),
+            "head": {"lnf_w": params["lnf_w"], "lnf_b": params["lnf_b"]},
+        }
+
+    def unpartition(self, pipe_params: PyTree) -> PyTree:
+        """Inverse of :meth:`partition` (checkpoint compatibility: saved
+        pipelines round-trip to the plain model layout)."""
+        pp, v, lc = self.pp, self.virtual_chunks, self.layers_per_chunk
+
+        def join(x):
+            y = x[None] if v == 1 else x
+            return y.reshape(pp * v * lc, *y.shape[3:])
+
+        e, h = pipe_params["embed"], pipe_params["head"]
+        return {
+            "embedding": e["embedding"],
+            "pos_embedding": e["pos_embedding"],
+            "layers": jax.tree.map(join, pipe_params["stages"]),
+            "lnf_w": h["lnf_w"], "lnf_b": h["lnf_b"],
+        }
+
+    def param_specs(self, pipe_params: PyTree, *leading) -> PyTree:
+        """PartitionSpecs matching a :meth:`partition` output: stage leaves
+        sharded over ``pp`` on their stage axis, embed/head replicated over
+        pp. ``leading`` axis names (e.g. ``'tp'``) are prepended to every
+        spec for trees carrying extra leading mesh axes (the
+        ``shard_params_for_tp`` → ``jax.vmap(partition)`` composition)."""
+        from jax.sharding import PartitionSpec as P
+        stage_spec = P(*leading,
+                       *((None,) if self.virtual_chunks > 1 else ()),
+                       self.pp_axis)
+        rep = P(*leading)
+        return {
+            "embed": jax.tree.map(lambda _: rep, pipe_params["embed"]),
+            "stages": jax.tree.map(lambda _: stage_spec,
+                                   pipe_params["stages"]),
+            "head": jax.tree.map(lambda _: rep, pipe_params["head"]),
+        }
+
+    # --- forward pieces (all run inside shard_map) ----------------------------
+
+    def _embed(self, ep, tokens):
+        """(M, b, s) int tokens → (M, b, s[/tp], hid) stage-0 activations.
+        Computed on every pp rank; only rank 0's injection into the
+        pipeline consumes cotangents (pre-process placement)."""
+        model = self.model
+        M, b, s = tokens.shape
+        x = model.embedding(ep["embedding"], tokens.reshape(M * b, s))
+        x = x + ep["pos_embedding"][:s]
+        if model.sp:
+            x = model._sp_scatter(x)
+        return x.reshape(M, b, *x.shape[1:])
+
+    def _stage(self, chunk_params, x):
+        """One virtual stage: ``layers_per_chunk`` full transformer blocks
+        (the model's own remat policy per block)."""
+        block = self.model.wrapped_block()
+
+        def body(x, layer):
+            return block(layer, x, None), None
+
+        x, _ = jax.lax.scan(body, x, chunk_params)
+        return x
+
+    def _head_loss(self, hp, ep, outs, targets, loss_mask):
+        """Final LN → tied unembedding → vocab-parallel CE → masked mean.
+        ``outs`` are valid on pp rank 0 only; the caller broadcasts the
+        resulting loss with a masked transpose (post-process placement)."""
+        model = self.model
+        M, b = outs.shape[0], outs.shape[1]
+        x = outs.reshape(M * b, *outs.shape[2:])
+        if model.sp:
+            x = model._sp_gather(x)
+        x = fused_layer_norm(x, hp["lnf_w"], hp["lnf_b"])
+        logits = model.unembed({"embedding": ep["embedding"]}, x)
+        losses = tp_lib.vocab_parallel_cross_entropy(
+            logits, targets.reshape(M * b, -1), axis_name=model.axis)
+        if loss_mask is None:
+            return jnp.mean(losses)
+        m = loss_mask.reshape(M * b, -1).astype(losses.dtype)
+        return jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+    # --- the full step --------------------------------------------------------
+
+    def loss_and_grads(
+        self,
+        pipe_params: PyTree,
+        tokens: jax.Array,
+        targets: jax.Array,
+        *,
+        loss_mask: Optional[jax.Array] = None,
+        accum_dtype=jnp.float32,
+        dp_axis: Optional[str] = None,
+    ):
+        """Pipelined forward+backward over ``(M, b, s)`` microbatched
+        tokens. Must run inside ``shard_map``; ``pipe_params`` are this
+        device's local slices (stage leaves ``(Lc, ...)``, or
+        ``(v, Lc, ...)`` interleaved). Returns ``(loss, grads)`` with grads
+        shaped like ``pipe_params`` in ``accum_dtype`` (fp32 main-grad
+        accumulation across microbatch ticks, cf.
+        ``schedules._main_grad_cast``). ``dp_axis`` adds the data-parallel
+        pmean of loss and grads."""
+        model, v = self.model, self.virtual_chunks
+        e_acc, e_down = schedules._main_grad_cast(
+            pipe_params["embed"], accum_dtype)
+        s_acc, s_down = schedules._main_grad_cast(
+            pipe_params["stages"], accum_dtype)
+        h_acc, h_down = schedules._main_grad_cast(
+            pipe_params["head"], accum_dtype)
+
+        def full_loss(p):
+            emb = self._embed(e_down(p["embed"]), tokens)
+            outs = schedules.pipeline_spmd_forward(
+                lambda cp, x: self._stage(s_down(cp), x),
+                p["stages"], emb,
+                axis_name=self.pp_axis, virtual_chunks=v,
+                remat=model.config.remat, broadcast_outputs=False,
+            )
+            loss = self._head_loss(
+                h_down(p["head"]), e_down(p["embed"]), outs, targets,
+                loss_mask)
+            # all pre/post-process parameter cotangents mask to pp rank 0
+            return schedules._broadcast_from_first(loss, self.pp_axis)
+
+        loss, g = jax.value_and_grad(full_loss)(
+            {"embed": e_acc, "stages": s_acc, "head": h_acc})
+
+        # embedding/head grads live on pp rank 0 (masked transpose of the
+        # loss broadcast); replicate — the reference's embedding-group
+        # all-reduce for the tied weight (parallel_state.py:338-375)
+        psum_pp = lambda t: jax.tree.map(
+            lambda x: jax.lax.psum(x, self.pp_axis), t)
+        g["embed"], g["head"] = psum_pp(g["embed"]), psum_pp(g["head"])
+
+        if model.sp:
+            # params applied to seq-sharded activations saw one tp rank's
+            # slice each (cf. GPTModel.sp_grad_sync)
+            synced = model.sp_grad_sync({"layers": g["stages"]})
+            g["stages"] = synced["layers"]
+
+        if dp_axis is not None:
+            loss = jax.lax.pmean(loss, dp_axis)
+            g = jax.tree.map(lambda x: jax.lax.pmean(x, dp_axis), g)
+        return loss, g

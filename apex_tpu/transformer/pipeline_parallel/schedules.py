@@ -73,10 +73,22 @@ def pipeline_spmd_forward(
     axis_name: str = mesh_lib.PIPELINE_AXIS,
     virtual_chunks: int = 1,
     remat: bool = True,
+    broadcast_outputs: bool = True,
 ):
     """Run the SPMD pipeline forward; returns per-microbatch outputs of the
     final stage (shape = microbatches.shape with the feature dims of the
     stage output), valid on the stage that holds them (masked elsewhere).
+
+    ``broadcast_outputs=False`` skips the final replication: outputs are
+    valid on pp rank 0 only (zeros elsewhere). Callers that reduce the
+    outputs to a scalar loss should prefer this and broadcast the *loss*
+    with :func:`_broadcast_from_first` instead — then every parameter
+    consumed outside the pipelined middle (embedding, loss head, tied
+    unembedding weights) gets a cotangent masked to rank 0, and one psum
+    over pp replicates the true gradient. Broadcasting the outputs instead
+    makes head-parameter gradients replicated but *tied* parameters (used
+    both inside the rank-0-masked injection and the replicated head) a mix
+    of masked and replicated contributions that no single collective fixes.
 
     ``stage_fn(params, x) -> y`` must keep ``y.shape == x.shape`` (uniform
     inter-stage activations — the reference has the same constraint via its
@@ -139,6 +151,8 @@ def pipeline_spmd_forward(
     state0 = jnp.zeros((v,) + mb_shape, microbatches.dtype)
     outputs0 = jnp.zeros((M,) + mb_shape, microbatches.dtype)
     (_, outputs), _ = jax.lax.scan(tick, (state0, outputs0), jnp.arange(T))
+    if not broadcast_outputs:
+        return outputs
     # replicate the collected outputs (they live on device 0 post-rotation)
     return _broadcast_from_first(outputs, axis_name)
 

@@ -74,25 +74,8 @@ class TestGPT:
         tgts = jr.randint(jr.fold_in(K, 6), (2, 16), 0, 64)
         ref_loss = m1.loss_fn(params1, toks, tgts)
 
-        # shard layer params: stacked leading (layers, ...) → per-leaf shard
-        def shard_layers(layers):
-            cfg_like = cfg1
-            return jax.tree_util.tree_map_with_path(
-                lambda path, x: _shard_layer_leaf(path, x, 2, cfg_like),
-                layers,
-            )
-
-        sharded = {
-            "embedding": {
-                "weight": params1["embedding"]["weight"].reshape(2, 32, cfg1.hidden_size)
-            },
-            "pos_embedding": jnp.broadcast_to(
-                params1["pos_embedding"], (2,) + params1["pos_embedding"].shape
-            ),
-            "layers": shard_layers(params1["layers"]),
-            "lnf_w": jnp.broadcast_to(params1["lnf_w"], (2, cfg1.hidden_size)),
-            "lnf_b": jnp.broadcast_to(params1["lnf_b"], (2, cfg1.hidden_size)),
-        }
+        from apex_tpu.models.gpt import shard_params_for_tp
+        sharded = shard_params_for_tp(params1, 2, cfg1)
         specs = jax.tree.map(lambda _: P("tp"), sharded)
 
         loss = mesh_lib.shard_map(
@@ -103,41 +86,55 @@ class TestGPT:
         )(sharded, toks, tgts)
         np.testing.assert_allclose(loss, ref_loss, rtol=1e-5, atol=1e-5)
 
+    def test_tp2_grads_match_tp1(self):
+        """Per-rank grads computed INSIDE shard_map (the training-step
+        formulation) must match the unsharded model's — exercises the
+        copy-to-region transpose before the tied unembedding, without which
+        every upstream gradient is a partial vocab-shard sum."""
+        mesh = mesh_lib.make_mesh(tensor_model_parallel_size=2)
+        cfg1 = GPTConfig(**SMALL, tp_size=1)
+        cfg2 = GPTConfig(**SMALL, tp_size=2)
+        m1, m2 = GPTModel(cfg1), GPTModel(cfg2)
+        params1 = m1.init(K)
+        toks = jr.randint(jr.fold_in(K, 15), (2, 16), 0, 64)
+        tgts = jr.randint(jr.fold_in(K, 16), (2, 16), 0, 64)
 
-def _shard_layer_leaf(path, x, tp, cfg):
-    """x has leading (num_layers,) axis; shard trailing dims per TP layout
-    and return with a new leading (tp,) axis."""
-    name = "/".join(str(p) for p in path)
-    L = x.shape[0]
-    heads = cfg.num_heads
-    if "qkv" in name and "weight" in name:
-        # dense output features are (3, heads, d) grouped — each TP shard
-        # takes its head range from every q/k/v group
-        per = heads // tp
-        y = x.reshape(L, 3, heads, -1, x.shape[-1])
-        return jnp.stack(
-            [y[:, :, i * per:(i + 1) * per].reshape(L, -1, x.shape[-1])
-             for i in range(tp)]
-        )
-    if "qkv" in name and "bias" in name:
-        per = heads // tp
-        y = x.reshape(L, 3, heads, -1)
-        return jnp.stack(
-            [y[:, :, i * per:(i + 1) * per].reshape(L, -1) for i in range(tp)]
-        )
-    if "mlp_up" in name and "weight" in name:
-        return jnp.stack(jnp.split(x, tp, axis=1))
-    if "mlp_up" in name and "bias" in name:
-        return jnp.stack(jnp.split(x, tp, axis=1))
-    if "attn_out" in name and "weight" in name:
-        per = heads // tp
-        y = x.reshape(L, x.shape[1], heads, -1)
-        return jnp.stack(
-            [y[:, :, i * per:(i + 1) * per].reshape(L, x.shape[1], -1) for i in range(tp)]
-        )
-    if "mlp_down" in name and "weight" in name:
-        return jnp.stack(jnp.split(x, tp, axis=2))
-    return jnp.broadcast_to(x, (tp,) + x.shape)
+        from apex_tpu.models.gpt import shard_params_for_tp
+        sharded = shard_params_for_tp(params1, 2, cfg1)
+        specs = jax.tree.map(lambda _: P("tp"), sharded)
+
+        def run(p, t, g):
+            loss, grads = jax.value_and_grad(m2.loss_fn)(
+                jax.tree.map(lambda x: x[0], p), t, g)
+            return loss, jax.tree.map(lambda x: x[None], grads)
+
+        with jax.default_matmul_precision("highest"):
+            loss, grads = jax.jit(mesh_lib.shard_map(
+                run, mesh=mesh, in_specs=(specs, P(), P()),
+                out_specs=(P(), specs),
+            ))(sharded, toks, tgts)
+            ref_loss, ref = jax.value_and_grad(m1.loss_fn)(
+                params1, toks, tgts)
+
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-5, atol=1e-6)
+        # replicated leaves: each tp shard must hold the full grad
+        np.testing.assert_allclose(
+            grads["lnf_w"][0], ref["lnf_w"], rtol=2e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            grads["pos_embedding"][0], ref["pos_embedding"],
+            rtol=2e-4, atol=1e-5)
+        for n in ("ln1_w", "ln1_b", "ln2_w", "ln2_b"):
+            np.testing.assert_allclose(
+                grads["layers"][n][0], ref["layers"][n], rtol=2e-4,
+                atol=1e-5, err_msg=n)
+        # sharded leaves reassemble to the full grad
+        emb = jnp.concatenate(list(grads["embedding"]["weight"]), axis=0)
+        np.testing.assert_allclose(
+            emb, ref["embedding"]["weight"], rtol=2e-4, atol=1e-5)
+        up = jnp.concatenate(
+            list(grads["layers"]["mlp_up"]["weight"]), axis=1)
+        np.testing.assert_allclose(
+            up, ref["layers"]["mlp_up"]["weight"], rtol=2e-4, atol=1e-5)
 
 
 class TestBert:
