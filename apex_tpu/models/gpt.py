@@ -442,9 +442,7 @@ def shard_params_for_tp(params, tp: int, config: GPTConfig):
     def shard_layer_leaf(path, x):
         name = "/".join(str(p) for p in path)
         # leaves carry a leading (num_layers,) axis from the stacked init
-        if "qkv" in name and "weight" in name:
-            return split_qkv(x, 1)
-        if "qkv" in name and "bias" in name:
+        if "qkv" in name:  # weight (L, F, hid) and bias (L, F) split alike
             return split_qkv(x, 1)
         if "mlp_up" in name:  # weight (L, ffn, hid) or bias (L, ffn)
             return jnp.stack(jnp.split(x, tp, axis=1))

@@ -9,12 +9,13 @@ causal or full, bf16/fp32.
 capabilities the reference lacks entirely (SURVEY.md §5 "Long-context: not
 present"; §2.3 lists both CP and Ulysses as absent strategies). Ring: Q/K/V
 sharded over the ``cp`` mesh axis along sequence; KV shards rotate via
-``ppermute`` while each device folds incoming blocks into the
-online-softmax state — O(s_local) memory, comm hidden behind per-step
-compute. Ulysses: two ``all_to_all``s swap sequence sharding for head
-sharding so each device runs *unmodified* flash attention over the full
-sequence for its head subset — cheaper comm than ring when heads ≥ devices
-(2 all-to-alls of the activations vs cp rotations of KV).
+``ppermute`` while each device folds incoming *flash-kernel* (o, lse)
+pieces into the online-softmax state — O(s_local·d) memory, comm hidden
+behind per-step compute, causal load balanced by zigzag stripe sharding
+(see :func:`ring_attention`). Ulysses: two ``all_to_all``s swap sequence
+sharding for head sharding so each device runs *unmodified* flash attention
+over the full sequence for its head subset — cheaper comm than ring when
+heads ≥ devices (2 all-to-alls of the activations vs cp rotations of KV).
 """
 
 from __future__ import annotations
@@ -232,81 +233,285 @@ def flash_attention(
 
 # --- ring attention (context parallel) ---------------------------------------
 
+def zigzag_indices(cp: int, s: int):
+    """The zigzag (striped) sequence permutation for causal context
+    parallelism: the sequence is cut into ``2·cp`` stripes and device ``r``
+    holds stripes ``(r, 2cp−1−r)`` — pairing an early stripe (little causal
+    work) with a late one (much) so every rank's total is equal. Returns
+    ``order`` such that ``x[order]`` laid out contiguously and sharded over
+    ``cp`` gives each device its stripe pair, plus the inverse."""
+    import numpy as np
+    if s % (2 * cp):
+        raise ValueError(f"sequence ({s}) must divide into 2*cp ({2 * cp}) "
+                         "stripes for zigzag sharding")
+    stripe = s // (2 * cp)
+    order = np.concatenate([
+        np.r_[r * stripe:(r + 1) * stripe,
+              (2 * cp - 1 - r) * stripe:(2 * cp - r) * stripe]
+        for r in range(cp)
+    ])
+    inverse = np.argsort(order)
+    return order, inverse
+
+
+def zigzag_shard(x: jax.Array, cp: int, seq_axis: int = -2) -> jax.Array:
+    """Permute ``seq_axis`` into zigzag order (host/global side; shard the
+    result contiguously over the cp mesh axis)."""
+    order, _ = zigzag_indices(cp, x.shape[seq_axis])
+    return jnp.take(x, jnp.asarray(order), axis=seq_axis)
+
+
+def zigzag_unshard(x: jax.Array, cp: int, seq_axis: int = -2) -> jax.Array:
+    """Inverse of :func:`zigzag_shard`."""
+    _, inverse = zigzag_indices(cp, x.shape[seq_axis])
+    return jnp.take(x, jnp.asarray(inverse), axis=seq_axis)
+
+
+def _piece_fwd(q, k, v, scale, causal, use_pallas):
+    """(o, lse) of one attention piece through the flash kernel (or the XLA
+    composition below its crossover)."""
+    if use_pallas:
+        return _k.flash_fwd(q, k, v, scale=scale, causal=causal,
+                            kv_lens=None, interpret=_backend.interpret_mode())
+    group = q.shape[0] // k.shape[0]
+    kf = jnp.repeat(k, group, 0) if group > 1 else k
+    vf = jnp.repeat(v, group, 0) if group > 1 else v
+    return _xla_attention(q, kf, vf, scale, causal)
+
+
+def _fold(o1, l1, o2, l2):
+    """Merge two normalized attention pieces over the same q rows:
+    (o, lse) ⊕ (o, lse) → (o, lse), the online-softmax combine."""
+    m = jnp.maximum(l1, l2)
+    e1 = jnp.exp(l1 - m)
+    e2 = jnp.exp(l2 - m)
+    tot = e1 + e2
+    o = (o1 * (e1 / tot)[..., None]
+         + o2.astype(jnp.float32) * (e2 / tot)[..., None])
+    return o, m + jnp.log(tot)
+
+
+def _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas):
+    cp = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def rotate(t):
+        return jax.tree.map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm), t)
+
+    # step 0 — the local shard. Causal: the zigzag stripe pair [a; b] is
+    # position-monotonic, so plain (blockwise) causal flash over the local
+    # 2·ss rows is exactly the diagonal work.
+    o0, l0 = _piece_fwd(q, k, v, scale, causal, use_pallas)
+
+    if not causal:
+        def step(carry, _):
+            o_acc, l_acc, kv = carry
+            kv = rotate(kv)
+            oi, li = _piece_fwd(q, kv[0], kv[1], scale, False, use_pallas)
+            o_acc, l_acc = _fold(o_acc, l_acc, oi, li)
+            return (o_acc, l_acc, kv), None
+
+        (o_acc, l_acc, _), _ = jax.lax.scan(
+            step, (o0.astype(jnp.float32), l0, (k, v)), None, length=cp - 1)
+        return o_acc.astype(q.dtype), l_acc
+
+    ss = q.shape[-2] // 2
+    q_lo, q_hi = q[:, :ss], q[:, ss:]
+
+    def step(carry, t):
+        o_lo, l_lo, o_hi, l_hi, kv = carry
+        kv = rotate(kv)
+        kk, vv = kv
+        k_lo, k_hi = kk[:, :ss], kk[:, ss:]
+        v_lo, v_hi = vv[:, :ss], vv[:, ss:]
+        j = (rank - t) % cp
+        # piece 1: this rank's HIGH stripe vs the arriving LOW stripe —
+        # always a full (unmasked) attend (stripe j < cp <= 2cp−1−rank)
+        o1, l1 = _piece_fwd(q_hi, k_lo, v_lo, scale, False, use_pallas)
+        o_hi, l_hi = _fold(o_hi, l_hi, o1, l1)
+        # piece 2: j < rank → our LOW stripe sees their LOW stripe;
+        # j > rank → our HIGH stripe sees their HIGH stripe. Both full
+        # attends — zigzag leaves no partially- or fully-masked work.
+        lo_case = j < rank
+        q2 = jnp.where(lo_case, q_lo, q_hi)
+        k2 = jnp.where(lo_case, k_lo, k_hi)
+        v2 = jnp.where(lo_case, v_lo, v_hi)
+        o2, l2 = _piece_fwd(q2, k2, v2, scale, False, use_pallas)
+        o_lo2, l_lo2 = _fold(o_lo, l_lo, o2, l2)
+        o_hi2, l_hi2 = _fold(o_hi, l_hi, o2, l2)
+        o_lo = jnp.where(lo_case, o_lo2, o_lo)
+        l_lo = jnp.where(lo_case, l_lo2, l_lo)
+        o_hi = jnp.where(lo_case, o_hi, o_hi2)
+        l_hi = jnp.where(lo_case, l_hi, l_hi2)
+        return (o_lo, l_lo, o_hi, l_hi, kv), None
+
+    init = (o0[:, :ss].astype(jnp.float32), l0[:, :ss],
+            o0[:, ss:].astype(jnp.float32), l0[:, ss:], (k, v))
+    (o_lo, l_lo, o_hi, l_hi, _), _ = jax.lax.scan(
+        step, init, jnp.arange(1, cp), length=cp - 1)
+    o = jnp.concatenate([o_lo, o_hi], axis=1).astype(q.dtype)
+    lse = jnp.concatenate([l_lo, l_hi], axis=1)
+    return o, lse
+
+
+def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, scale, causal, use_pallas):
+    """The distributed flash backward: per ring step call ``flash_bwd``
+    with the GLOBAL (o, lse) — p and Δ are then exact per shard — while a
+    dkv accumulator travels the ring with its kv shard and arrives home
+    after a full cycle carrying every rank's contribution (the reference
+    has no CP at all; this is the standard ring-attention backward)."""
+    cp = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def rotate(t):
+        return jax.tree.map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm), t)
+
+    dq0, dk0, dv0 = _flash_bwd_impl(
+        q, k, v, o, lse, do, None, scale, causal, use_pallas)
+
+    if not causal:
+        def step(carry, _):
+            dq, kv, dk, dv = carry
+            kv, (dk, dv) = rotate(kv), rotate((dk, dv))
+            dqi, dki, dvi = _flash_bwd_impl(
+                q, kv[0], kv[1], o, lse, do, None, scale, False, use_pallas)
+            return (dq + dqi, kv, dk + dki.astype(dk.dtype),
+                    dv + dvi.astype(dv.dtype)), None
+
+        init = (dq0.astype(jnp.float32), (k, v),
+                dk0.astype(jnp.float32), dv0.astype(jnp.float32))
+        (dq, _, dk, dv), _ = jax.lax.scan(step, init, None, length=cp - 1)
+        dk, dv = rotate((dk, dv))  # final hop brings the accumulators home
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    ss = q.shape[-2] // 2
+    halves = lambda x: (x[:, :ss], x[:, ss:])
+    q_lo, q_hi = halves(q)
+    o_lo, o_hi = halves(o)
+    l_lo, l_hi = halves(lse)
+    do_lo, do_hi = halves(do)
+
+    def step(carry, t):
+        dq_lo, dq_hi, kv, dk_lo, dk_hi, dv_lo, dv_hi = carry
+        kv = rotate(kv)
+        dk_lo, dk_hi, dv_lo, dv_hi = rotate((dk_lo, dk_hi, dv_lo, dv_hi))
+        kk, vv = kv
+        k_lo, k_hi = halves(kk)
+        v_lo, v_hi = halves(vv)
+        j = (rank - t) % cp
+        # piece 1 (mirror of forward): q_hi vs arriving kv_lo, full attend
+        dq1, dk1, dv1 = _flash_bwd_impl(
+            q_hi, k_lo, v_lo, o_hi, l_hi, do_hi, None, scale, False,
+            use_pallas)
+        dq_hi = dq_hi + dq1
+        dk_lo = dk_lo + dk1
+        dv_lo = dv_lo + dv1
+        # piece 2: the selected stripe pair
+        lo_case = j < rank
+        q2 = jnp.where(lo_case, q_lo, q_hi)
+        o2 = jnp.where(lo_case, o_lo, o_hi)
+        l2 = jnp.where(lo_case, l_lo, l_hi)
+        do2 = jnp.where(lo_case, do_lo, do_hi)
+        k2 = jnp.where(lo_case, k_lo, k_hi)
+        v2 = jnp.where(lo_case, v_lo, v_hi)
+        dq2, dk2, dv2 = _flash_bwd_impl(
+            q2, k2, v2, o2, l2, do2, None, scale, False, use_pallas)
+        dq_lo = dq_lo + jnp.where(lo_case, dq2, 0.0)
+        dq_hi = dq_hi + jnp.where(lo_case, 0.0, dq2)
+        dk_lo = dk_lo + jnp.where(lo_case, dk2, 0.0)
+        dk_hi = dk_hi + jnp.where(lo_case, 0.0, dk2)
+        dv_lo = dv_lo + jnp.where(lo_case, dv2, 0.0)
+        dv_hi = dv_hi + jnp.where(lo_case, 0.0, dv2)
+        return (dq_lo, dq_hi, kv, dk_lo, dk_hi, dv_lo, dv_hi), None
+
+    f32 = jnp.float32
+    init = (dq0[:, :ss].astype(f32), dq0[:, ss:].astype(f32), (k, v),
+            dk0[:, :ss].astype(f32), dk0[:, ss:].astype(f32),
+            dv0[:, :ss].astype(f32), dv0[:, ss:].astype(f32))
+    (dq_lo, dq_hi, _, dk_lo, dk_hi, dv_lo, dv_hi), _ = jax.lax.scan(
+        step, init, jnp.arange(1, cp), length=cp - 1)
+    dk_lo, dk_hi, dv_lo, dv_hi = rotate((dk_lo, dk_hi, dv_lo, dv_hi))
+    dq = jnp.concatenate([dq_lo, dq_hi], axis=1).astype(q.dtype)
+    dk = jnp.concatenate([dk_lo, dk_hi], axis=1).astype(k.dtype)
+    dv = jnp.concatenate([dv_lo, dv_hi], axis=1).astype(v.dtype)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_core(q, k, v, axis_name, scale, causal, use_pallas):
+    o, _ = _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas)
+    return o
+
+
+def _ring_fwd(q, k, v, axis_name, scale, causal, use_pallas):
+    o, lse = _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_bwd(axis_name, scale, causal, use_pallas, res, do):
+    q, k, v, o, lse = res
+    return _ring_bwd_impl(
+        q, k, v, o, lse, do, axis_name, scale, causal, use_pallas)
+
+
+_ring_core.defvjp(_ring_fwd, _ring_bwd)
+
+
 def ring_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     *, axis_name: str = mesh_lib.CONTEXT_AXIS, causal: bool = False,
     scale: Optional[float] = None, impl: str = "auto",
 ) -> jax.Array:
     """Attention over a sequence sharded along ``axis_name``: q/k/v are this
-    device's (bh, s_local, d) shard; the full sequence is cp·s_local.
+    device's (bh, s_local, d) shard; the full sequence is cp·s_local. Must
+    run inside shard_map with the axis bound.
 
-    Must run inside shard_map with the axis bound. Per ring step the local
-    KV shard rotates to the next device and the blockwise state (m, l, acc)
-    folds the arriving shard in — identical math to flash attention's inner
-    loop, with the block loop distributed over devices. Causal masking uses
-    each shard's global offset, skipping fully-masked shards' compute is left
-    to XLA (the mask zeroes them).
+    Built on the flash kernel family: per ring step the arriving KV shard
+    goes through :func:`_piece_fwd` (the Pallas kernel above its measured
+    crossover) and the normalized (o, lse) pieces merge by the
+    online-softmax fold — per-step memory is O(s_local·d); no (s_local ×
+    s_local) score tensor ever exists outside kernel VMEM. Backward is the
+    distributed flash backward (:func:`_ring_bwd_impl`): kv re-rotates the
+    ring while a dkv accumulator travels with each shard, so residuals are
+    O(s_local·d) too.
 
-    Backward differentiates through the ``lax.scan`` of ring steps; each
-    step's attention is rematerialized (``jax.checkpoint``) so live memory
-    stays O(s_local) — the blockwise-parallel-transformer property.
+    ``causal=True`` REQUIRES the zigzag stripe layout: shard
+    ``zigzag_shard(x, cp)`` over the axis (and ``zigzag_unshard`` the
+    output). Device r then holds stripes (r, 2cp−1−r) of 2·cp total, and
+    every ring step on every rank is exactly two *unmasked* stripe-pair
+    flash calls — total FLOPs equal the lower-triangle minimum (half of
+    full), perfectly load-balanced, with no masked-and-discarded work and
+    no conditionals. (Contiguous causal sharding would leave rank 0 idle
+    (cp−1)/cp of the time and burn 2× the FLOPs in masked work.)
+
+    Grouped-query kv: the NARROW kv rotates the ring — group-times less
+    ICI traffic — and the kernels read it via their index maps.
+
+    The reference has no context parallelism at all (SURVEY §2.3); this is
+    the long-context extension built to the repo's own kernel bar.
     """
-    cp = jax.lax.axis_size(axis_name)
-    rank = jax.lax.axis_index(axis_name)
     d = q.shape[-1]
     scale = float(scale if scale is not None else 1.0 / d ** 0.5)
-    s_local = q.shape[-2]
-    perm = [(i, (i + 1) % cp) for i in range(cp)]
     if q.shape[0] % k.shape[0]:
         raise ValueError(
             f"kv rows ({k.shape[0]}) must divide q rows ({q.shape[0]}) "
             f"for grouped-query ring attention")
-    group = q.shape[0] // k.shape[0]
-
-    qf = q.astype(jnp.float32)
-
-    @jax.checkpoint
-    def partial_scores(kv, kv_rank):
-        kk, vv = kv
-        if group > 1:
-            # grouped-query: the NARROW kv rotates the ring (that is the
-            # GQA bandwidth win under context parallelism); broadcast to q
-            # heads only here, at compute time
-            kk = jnp.repeat(kk, group, 0)
-            vv = jnp.repeat(vv, group, 0)
-        s = jnp.einsum("bqd,bkd->bqk", qf, kk.astype(jnp.float32)) * scale
-        if causal:
-            q_pos = rank * s_local + jnp.arange(s_local)[:, None]
-            k_pos = kv_rank * s_local + jnp.arange(s_local)[None, :]
-            s = jnp.where(k_pos <= q_pos, s, _k.NEG_INF)
-        m = jnp.max(s, axis=-1, keepdims=True)
-        p = jnp.exp(s - m)
-        l = jnp.sum(p, axis=-1, keepdims=True)
-        o = jnp.einsum("bqk,bkd->bqd", p, vv.astype(jnp.float32))
-        return m, l, o
-
-    def step(carry, _):
-        m_acc, l_acc, o_acc, kv, kv_rank = carry
-        m, l, o = partial_scores(kv, kv_rank)
-        m_new = jnp.maximum(m_acc, m)
-        alpha = jnp.exp(m_acc - m_new)
-        beta = jnp.exp(m - m_new)
-        l_new = l_acc * alpha + l * beta
-        o_new = o_acc * alpha + o * beta
-        kv = jax.tree.map(lambda x: jax.lax.ppermute(x, axis_name, perm), kv)
-        kv_rank = (kv_rank - 1) % cp
-        return (m_new, l_new, o_new, kv, kv_rank), None
-
-    bh = q.shape[0]
-    init = (
-        jnp.full((bh, s_local, 1), _k.NEG_INF, jnp.float32),
-        jnp.zeros((bh, s_local, 1), jnp.float32),
-        jnp.zeros((bh, s_local, d), jnp.float32),
-        (k, v),
-        rank,
-    )
-    (m_acc, l_acc, o_acc, _, _), _ = jax.lax.scan(step, init, None, length=cp)
-    return (o_acc / jnp.maximum(l_acc, 1e-30)).astype(q.dtype)
+    s_loc = q.shape[-2]
+    if causal and s_loc % 2:
+        raise ValueError(
+            f"causal ring attention needs an even local sequence "
+            f"({s_loc}) — two zigzag stripes per device")
+    ss = s_loc // 2 if causal else s_loc
+    ok = ss % 128 == 0 and (d % 128 == 0 or d == 64)
+    if (impl == "auto" and ss < flash_auto_crossover(d)
+            and not _backend.interpret_forced()):
+        impl = "xla"
+    use_pallas = _backend.choose_impl(impl, ok) == "pallas"
+    return _ring_core(q, k, v, axis_name, scale, causal, use_pallas)
 
 
 # --- Ulysses attention (all-to-all sequence parallel) -------------------------

@@ -63,6 +63,11 @@ class MeshSpec:
     context_parallel_size: int = 1
     expert_parallel_size: int = 1
     virtual_pipeline_model_parallel_size: Optional[int] = None
+    # Encoder-decoder (T5-class) two-segment pipelines: stages
+    # [0, split) run the encoder, [split, pp) the decoder (reference
+    # ``parallel_state.py:147-149``; consumed by
+    # ``pipeline_parallel.encoder_decoder``).
+    pipeline_model_parallel_split_rank: Optional[int] = None
 
     def __post_init__(self):
         if self.virtual_pipeline_model_parallel_size is not None:
@@ -72,6 +77,13 @@ class MeshSpec:
                 )
         if self.expert_parallel_size > 1 and self.data_parallel_size % self.expert_parallel_size:
             raise ValueError("expert_parallel_size must divide data_parallel_size")
+        split = self.pipeline_model_parallel_split_rank
+        if split is not None and not (
+                0 < split < self.pipeline_model_parallel_size):
+            raise ValueError(
+                f"pipeline_model_parallel_split_rank ({split}) must lie "
+                f"strictly inside [1, pp) — both segments need at least one "
+                f"stage (pp={self.pipeline_model_parallel_size})")
 
     @property
     def model_parallel_size(self) -> int:
@@ -93,6 +105,7 @@ def initialize_model_parallel(
     context_parallel_size: int = 1,
     virtual_pipeline_model_parallel_size: Optional[int] = None,
     expert_parallel_size: int = 1,
+    pipeline_model_parallel_split_rank: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     """Build and install the global mesh.
@@ -125,6 +138,7 @@ def initialize_model_parallel(
         context_parallel_size=context_parallel_size,
         expert_parallel_size=expert_parallel_size,
         virtual_pipeline_model_parallel_size=virtual_pipeline_model_parallel_size,
+        pipeline_model_parallel_split_rank=pipeline_model_parallel_split_rank,
     )
     device_array = np.asarray(devices).reshape(
         data_parallel_size,
@@ -215,6 +229,12 @@ def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
     return get_mesh_spec().virtual_pipeline_model_parallel_size
 
 
+def get_pipeline_model_parallel_split_rank() -> Optional[int]:
+    """First decoder stage of a two-segment (encoder-decoder) pipeline, or
+    None for single-segment models (``parallel_state.py:147-149``)."""
+    return get_mesh_spec().pipeline_model_parallel_split_rank
+
+
 def get_rank_info() -> str:
     """Short mesh descriptor for log records (cf. ``parallel_state.py:250-259``)."""
     if _SPEC is None:
@@ -263,3 +283,35 @@ def is_pipeline_first_stage() -> jax.Array:
 
 def is_pipeline_last_stage() -> jax.Array:
     return jax.lax.axis_index(PIPELINE_AXIS) == jax.lax.axis_size(PIPELINE_AXIS) - 1
+
+
+def is_pipeline_stage_before_split(rank=None) -> jax.Array:
+    """This stage runs encoder blocks (reference ``parallel_state.py:338``).
+    In-shard_map by default; pass an explicit ``rank`` for host-side use."""
+    split = get_pipeline_model_parallel_split_rank()
+    if rank is None:
+        rank = jax.lax.axis_index(PIPELINE_AXIS)
+    if split is None:
+        return rank >= 0  # vacuously true, traced- and host-friendly
+    return rank < split
+
+
+def is_pipeline_stage_after_split(rank=None) -> jax.Array:
+    """This stage runs decoder blocks (``parallel_state.py:355``)."""
+    split = get_pipeline_model_parallel_split_rank()
+    if rank is None:
+        rank = jax.lax.axis_index(PIPELINE_AXIS)
+    if split is None:
+        return rank >= 0  # vacuously true
+    return rank >= split
+
+
+def is_pipeline_stage_at_split(rank=None) -> jax.Array:
+    """Last encoder stage — the stage whose successor starts the decoder
+    (``parallel_state.py:369-375``)."""
+    split = get_pipeline_model_parallel_split_rank()
+    if rank is None:
+        rank = jax.lax.axis_index(PIPELINE_AXIS)
+    if split is None:
+        return rank < 0  # vacuously false
+    return rank == split - 1

@@ -29,3 +29,7 @@ from apex_tpu.transformer.pipeline_parallel.build_model import (  # noqa: F401
     GPTPipeline,
     build_model,
 )
+from apex_tpu.transformer.pipeline_parallel.encoder_decoder import (  # noqa: F401
+    forward_backward_pipelining_enc_dec,
+    pipeline_spmd_forward_enc_dec,
+)

@@ -1,0 +1,153 @@
+"""Two-segment (encoder→decoder) pipeline: the split-rank schedule.
+
+Re-design of the reference's encoder-decoder pipeline support: there,
+``parallel_state`` carries a ``pipeline_model_parallel_split_rank``
+(``parallel_state.py:147-149``) with dedicated embedding groups
+(``:338-375``), and the schedules route two tensor streams — decoder
+activations plus the encoder output for cross-attention — through the p2p
+machinery, with the decoder's own input embedding entering at the split
+stage.
+
+SPMD formulation: one program for all stages. The pipeline state is a PAIR
+``(h, ctx)`` that rotates the ring together —
+
+* ``h``: the working activations. Stage 0 injects the embedded *encoder*
+  microbatch; the split stage swaps in the embedded *decoder* microbatch
+  (mid-pipeline pre-process placement);
+* ``ctx``: the cross-attention context. Zero through the encoder segment;
+  latched to the arriving ``h`` (the completed encoder output) at the
+  split stage, then traveling with its microbatch through every decoder
+  stage — the SPMD image of the reference forwarding the encoder output
+  stage-to-stage alongside the decoder stream.
+
+Stages select encoder vs decoder compute with ``lax.cond`` on the pp rank
+(one branch executes per device at runtime — encoder stages don't pay for
+decoder FLOPs or vice versa). Every stage holds the union param structure;
+the unused fields on the other segment's stages are dead weights (the cost
+of program uniformity — pp·v times smaller than the model, irrelevant).
+
+Encoder and decoder activations must share (batch, seq, hidden) shape —
+the same uniform-``tensor_shape`` constraint the reference's schedules
+impose (``fwd_bwd_pipelining_without_interleaving.py:187``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel import mesh as mesh_lib
+from apex_tpu.transformer.pipeline_parallel import schedules
+
+PyTree = Any
+
+
+def pipeline_spmd_forward_enc_dec(
+    enc_fn: Callable[[PyTree, jax.Array], jax.Array],
+    dec_fn: Callable[[PyTree, jax.Array, jax.Array], jax.Array],
+    stage_params: PyTree,
+    enc_microbatches: jax.Array,
+    dec_microbatches: jax.Array,
+    *,
+    split_rank: Optional[int] = None,
+    axis_name: str = mesh_lib.PIPELINE_AXIS,
+    remat: bool = True,
+    broadcast_outputs: bool = True,
+):
+    """Forward of the two-segment pipeline. ``enc_fn(params, h)`` runs on
+    stages [0, split); ``dec_fn(params, h, enc_ctx)`` on [split, pp).
+    ``enc_microbatches``/``dec_microbatches``: (M, ...) embedded inputs for
+    the two segments (same trailing shape). Returns the decoder outputs per
+    microbatch (masked to pp rank 0 unless ``broadcast_outputs``)."""
+    S = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    if split_rank is None:
+        split_rank = mesh_lib.get_pipeline_model_parallel_split_rank()
+    if split_rank is None or not (0 < split_rank < S):
+        raise ValueError(
+            f"encoder-decoder pipeline needs 0 < split_rank < pp "
+            f"(got {split_rank}, pp={S})")
+    M = enc_microbatches.shape[0]
+    mb_shape = enc_microbatches.shape[1:]
+    T = M + S - 1
+
+    def stage(params, h, ctx):
+        return jax.lax.cond(
+            rank < split_rank,
+            lambda p, h_, c_: enc_fn(p, h_),
+            lambda p, h_, c_: dec_fn(p, h_, c_),
+            params, h, ctx,
+        )
+
+    fn = jax.checkpoint(stage) if remat else stage
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        (h, ctx), outputs = carry
+        # stage-0 pre-process: inject the embedded encoder microbatch
+        m0 = jnp.clip(t, 0, M - 1)
+        enc_in = jax.lax.dynamic_index_in_dim(
+            enc_microbatches, m0, 0, keepdims=False)
+        h = jnp.where(rank == 0, enc_in, h)
+        # split-stage pre-process: the arriving h is the completed encoder
+        # output for microbatch (t - split); latch it as cross-attention
+        # context and swap in that microbatch's embedded decoder input
+        ms = jnp.clip(t - split_rank, 0, M - 1)
+        dec_in = jax.lax.dynamic_index_in_dim(
+            dec_microbatches, ms, 0, keepdims=False)
+        at_split = rank == split_rank
+        ctx = jnp.where(at_split, h, ctx)
+        h = jnp.where(at_split, dec_in, h)
+
+        y = fn(stage_params, h, ctx)
+        # the context travels with its microbatch through decoder stages
+        h_next, ctx_next = jax.tree.map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm), (y, ctx))
+
+        out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+        valid = (t >= S - 1) & (rank == 0)
+        updated = jax.lax.dynamic_update_index_in_dim(
+            outputs, h_next.astype(outputs.dtype), out_idx, 0)
+        outputs = jnp.where(valid, updated, outputs)
+        return ((h_next, ctx_next), outputs), None
+
+    state0 = (jnp.zeros(mb_shape, enc_microbatches.dtype),
+              jnp.zeros(mb_shape, enc_microbatches.dtype))
+    outputs0 = jnp.zeros((M,) + mb_shape, enc_microbatches.dtype)
+    (_, outputs), _ = jax.lax.scan(tick, (state0, outputs0), jnp.arange(T))
+    if not broadcast_outputs:
+        return outputs
+    return schedules._broadcast_from_first(outputs, axis_name)
+
+
+def forward_backward_pipelining_enc_dec(
+    enc_fn: Callable,
+    dec_fn: Callable,
+    loss_head: Callable[[jax.Array, Any], jax.Array],
+    stage_params: PyTree,
+    enc_microbatches: jax.Array,
+    dec_microbatches: jax.Array,
+    targets: Any,
+    *,
+    split_rank: Optional[int] = None,
+    axis_name: str = mesh_lib.PIPELINE_AXIS,
+    accum_dtype=jnp.float32,
+):
+    """1F1B-class fwd+bwd of the two-segment pipeline (cf.
+    ``forward_backward_pipelining_without_interleaving``). Returns
+    (mean loss, grads wrt stage_params in ``accum_dtype``)."""
+    p_acc, down = schedules._main_grad_cast(stage_params, accum_dtype)
+
+    def full_loss(p):
+        outs = pipeline_spmd_forward_enc_dec(
+            lambda pp, h: enc_fn(down(pp), h),
+            lambda pp, h, c: dec_fn(down(pp), h, c),
+            p, enc_microbatches, dec_microbatches,
+            split_rank=split_rank, axis_name=axis_name, remat=True,
+        )
+        losses = jax.vmap(loss_head)(outs, targets)
+        return jnp.mean(losses)
+
+    return jax.value_and_grad(full_loss)(p_acc)
