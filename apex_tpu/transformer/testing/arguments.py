@@ -383,6 +383,35 @@ def _add_data_args(parser):
     return parser
 
 
+def initialize_model_parallel_from_args(args, devices=None):
+    """The launcher glue the reference spreads over its test/entry scripts:
+    hand EVERY parsed parallelism flag — tp/pp/cp sizes, virtual-pp, and
+    the encoder-decoder split rank — to ``initialize_model_parallel`` so
+    every accepted flag actually changes execution. The mesh is built over
+    ``args.world_size`` devices so ``args.data_parallel_size`` (set by
+    ``validate_args``) agrees with the installed decomposition."""
+    import jax
+
+    from apex_tpu.parallel import mesh as mesh_lib
+
+    if devices is None:
+        devices = jax.devices()[:args.world_size]
+    if len(devices) != args.world_size:
+        raise ValueError(
+            f"{len(devices)} device(s) do not match --world-size "
+            f"{args.world_size}")
+    return mesh_lib.initialize_model_parallel(
+        tensor_model_parallel_size=args.tensor_model_parallel_size,
+        pipeline_model_parallel_size=args.pipeline_model_parallel_size,
+        context_parallel_size=getattr(args, "context_parallel_size", 1) or 1,
+        virtual_pipeline_model_parallel_size=getattr(
+            args, "virtual_pipeline_model_parallel_size", None),
+        pipeline_model_parallel_split_rank=(
+            args.pipeline_model_parallel_split_rank),
+        devices=devices,
+    )
+
+
 # --- global singleton (global_vars.py get/set pattern) -----------------------
 
 def set_args(args) -> None:
