@@ -158,6 +158,20 @@ class BertModel:
             x = x + jnp.take(params["type_embedding"], token_types, axis=0)
         x = fused_layer_norm(x, params["ln_emb_w"], params["ln_emb_b"])
 
+        if (c.attention_impl == "flash" and pad_mask is not None
+                and not isinstance(pad_mask, jax.core.Tracer)):
+            # eager call (tests, interactive; checked HERE, before the
+            # scan/remat turns the mask into a tracer): fail loudly on an
+            # interior mask instead of silently truncating at the first
+            # masked position (under jit the mask is traced and this check
+            # can't run — the docstring constraint stands)
+            mb = pad_mask.astype(bool)  # accept 0/1 float masks
+            if bool(jnp.any(mb[..., :-1] & ~mb[..., 1:])):
+                raise ValueError(
+                    "attention_impl='flash' supports suffix padding only "
+                    "(the pad mask must be monotone per row); use "
+                    "attention_impl='softmax' for interior masks")
+
         block = self._block
         if c.remat:
             block = jax.checkpoint(block, static_argnums=())

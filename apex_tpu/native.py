@@ -9,7 +9,9 @@ host-side Python overhead for very large models/traces.
 
 Components:
 * ``plan_layout`` — chunk-layout metadata (apex_C / multi_tensor_apply host
-  loop analog, ``csrc/layout_planner.cpp``);
+  loop analog) — pure numpy: a vectorized repeat/cumsum, so a C version
+  had nothing to add (r2 review agreed; the former ``layout_planner.cpp``
+  duplicating it is deleted);
 * ``aggregate_trace`` — profiler record aggregation (pyprof.prof analog,
   ``csrc/trace_analyzer.cpp``);
 * ``parse_trace`` — gunzip + parse of ``trace.json.gz`` profiler dumps
@@ -43,11 +45,6 @@ def _load() -> Optional[ctypes.CDLL]:
         return None
     try:
         lib = ctypes.CDLL(path)
-        lib.plan_layout.restype = ctypes.c_int64
-        lib.plan_layout.argtypes = [
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
-        ]
         lib.aggregate_trace_json.restype = ctypes.c_int64
         lib.aggregate_trace_json.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
@@ -84,28 +81,14 @@ def available() -> bool:
 
 
 def plan_layout(sizes, chunk_size: int) -> Tuple[np.ndarray, np.ndarray]:
-    """(chunk_to_tensor i32[n_chunks], tensor_offsets i64[n_tensors]) —
-    native when built, numpy otherwise."""
+    """(chunk_to_tensor i32[n_chunks], tensor_offsets i64[n_tensors]).
+    Vectorized numpy — already optimal host-side (no per-tensor Python
+    loop), which is why this component has no native counterpart."""
     sizes = np.asarray(sizes, np.int64)
-    lib = _load()
-    if lib is None:
-        chunk_counts = np.maximum(1, -(-sizes // chunk_size))
-        c2t = np.repeat(np.arange(len(sizes), dtype=np.int32), chunk_counts)
-        offsets = np.concatenate([[0], np.cumsum(chunk_counts)[:-1]]) * chunk_size
-        return c2t, offsets.astype(np.int64)
-    n = len(sizes)
-    total = lib.plan_layout(
-        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n, chunk_size,
-        None, None,
-    )
-    c2t = np.empty(total, np.int32)
-    offsets = np.empty(n, np.int64)
-    lib.plan_layout(
-        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n, chunk_size,
-        c2t.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-    )
-    return c2t, offsets
+    chunk_counts = np.maximum(1, -(-sizes // chunk_size))
+    c2t = np.repeat(np.arange(len(sizes), dtype=np.int32), chunk_counts)
+    offsets = np.concatenate([[0], np.cumsum(chunk_counts)[:-1]]) * chunk_size
+    return c2t, offsets.astype(np.int64)
 
 
 def parse_trace(path: str) -> list:

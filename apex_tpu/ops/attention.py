@@ -189,6 +189,15 @@ def flash_attention(
     is the one that matters."""
     q, k, v = apply_op_rules("attention", q, k, v)
     d = q.shape[-1]
+    if causal and q.shape[-2] > k.shape[-2]:
+        # bottom-right-aligned causal with sq > sk gives the first
+        # (sq - sk) q rows ZERO visible keys — their softmax is undefined
+        # (the kernel would emit exp(0)-weighted garbage). No attention
+        # semantics wants this; reject instead of returning garbage.
+        raise ValueError(
+            f"causal attention requires sq <= sk (bottom-right alignment); "
+            f"got sq={q.shape[-2]} > sk={k.shape[-2]} — rows before the "
+            f"context start would attend nothing")
     scale = float(scale if scale is not None else 1.0 / d ** 0.5)
     lead = q.shape[:-2]
     q3 = q.reshape(-1, q.shape[-2], d)

@@ -358,8 +358,14 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            # grouped kv: per-q-head partials stay fp32 so the group-sum
+            # below accumulates unrounded (a bf16 partial would round each
+            # head's contribution before the sum); ungrouped writes go
+            # straight out in the kv dtype
+            jax.ShapeDtypeStruct(
+                (bh, sk, d), jnp.float32 if group > 1 else k.dtype),
+            jax.ShapeDtypeStruct(
+                (bh, sk, d), jnp.float32 if group > 1 else v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -371,6 +377,6 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
         interpret=interpret,
     )(q, k, v, do, lse3, delta3, *extra_args)
     if group > 1:
-        dk = dk.astype(jnp.float32).reshape(-1, group, sk, d).sum(1).astype(k.dtype)
-        dv = dv.astype(jnp.float32).reshape(-1, group, sk, d).sum(1).astype(v.dtype)
+        dk = dk.reshape(-1, group, sk, d).sum(1).astype(k.dtype)
+        dv = dv.reshape(-1, group, sk, d).sum(1).astype(v.dtype)
     return dq, dk, dv

@@ -65,8 +65,8 @@ def make_layout(tree: PyTree, chunk_size: int = DEFAULT_CHUNK) -> ChunkLayout:
     leaves, treedef = jax.tree.flatten(tree)
     shapes = tuple(tuple(x.shape) for x in leaves)
     sizes = [int(np.prod(s)) for s in shapes]
-    # native planner (csrc/layout_planner.cpp — the apex_C/multi_tensor host
-    # loop) when built; identical numpy fallback otherwise
+    # vectorized host-side planner (the apex_C/multi_tensor_apply host
+    # loop analog; numpy repeat/cumsum, no native tier needed)
     chunk_to_tensor, _ = native.plan_layout(sizes, chunk_size)
     return ChunkLayout(
         chunk_to_tensor=jnp.asarray(chunk_to_tensor),

@@ -25,9 +25,16 @@ falls out of reverse-mode). The memory knob that 1F1B turns is here
 * remat + offload policies → beyond the reference.
 
 Utilization note: warmup/cooldown bubbles are identical to the reference's
-(pipeline theory doesn't change); the interleaved variant trades a longer
-fill (v·S−1 ticks vs S−1) for per-tick work that XLA can overlap across the
-v chunk computations — see ``pipeline_spmd_forward``'s ``virtual_chunks``.
+(pipeline theory doesn't change). The interleaved schedule implements the
+classic v-fold bubble shrink (``fwd_bwd_pipelining_with_interleaving.py:25``)
+in scan form: with microbatches injected in groups of S, device r at tick t
+holds exactly ONE in-flight item — ``u = t − r`` determines its chunk
+``(u//S) mod v`` and microbatch ``S·((u//S)//v) + u mod S`` — so every tick
+costs ONE chunk (1/v of a stage) and the fill is S−1 *chunk*-ticks instead
+of the non-interleaved S−1 stage-ticks: total forward time
+``M·v + S − 1`` chunk-times vs ``(M + S − 1)·v``. Requires ``M % S == 0``
+(the reference's ``num_microbatches % pipeline_parallel_size == 0`` assert,
+``fwd_bwd_pipelining_with_interleaving.py:87``).
 """
 
 from __future__ import annotations
@@ -101,7 +108,10 @@ def pipeline_spmd_forward(
 
     With ``virtual_chunks=v > 1``, ``stage_params`` must have a leading axis
     of size v (this device's chunks, virtual stage k = c·S + rank for chunk
-    c) — the interleaved schedule (``parallel_state.py:135-145``).
+    c — the reference's interleaved assignment, ``parallel_state.py:135-145``)
+    and ``M % S == 0`` (microbatches flow in groups of S). Per tick each
+    device computes exactly ONE chunk — the classic interleaved schedule's
+    1/v-stage ticks; see the module docstring for the timing model.
     """
     S = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
@@ -109,46 +119,85 @@ def pipeline_spmd_forward(
     v = virtual_chunks
     mb_shape = microbatches.shape[1:]
 
-    fn = jax.checkpoint(stage_fn) if remat else stage_fn
-    total_stages = v * S
-    T = M + total_stages - 1
-
     perm = [(i, (i + 1) % S) for i in range(S)]
 
-    def tick(carry, t):
-        state, outputs = carry  # state: (v, *mb), outputs: (M, *mb)
-        # inject microbatch t on (stage 0, chunk 0)
-        inject = jax.lax.dynamic_index_in_dim(
-            microbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False
-        )
-        x0 = jnp.where(rank == 0, inject, state[0])
-        state = state.at[0].set(x0)
+    if v == 1:
+        fn = jax.checkpoint(stage_fn) if remat else stage_fn
+        T = M + S - 1
 
-        if v == 1:
-            y = fn(stage_params, state[0])[None]
-        else:
-            y = jax.vmap(fn)(stage_params, state)
+        def tick(carry, t):
+            x, outputs = carry  # x: (*mb), outputs: (M, *mb)
+            inject = jax.lax.dynamic_index_in_dim(
+                microbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False
+            )
+            x = jnp.where(rank == 0, inject, x)
+            y = fn(stage_params, x)
+            sent = jax.lax.ppermute(y, axis_name, perm)
 
-        # rotate every chunk's output to the next device on the ring
-        sent = jax.lax.ppermute(y, axis_name, perm)
+            # microbatch m exits at tick m + S - 1, arriving (post-rotate)
+            # at device 0
+            out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+            valid = (t >= S - 1) & (rank == 0)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                outputs, sent.astype(outputs.dtype), out_idx, 0
+            )
+            outputs = jnp.where(valid, updated, outputs)
+            return (sent, outputs), None
 
-        # device 0 receives: chunk c takes the wrap-around of chunk c-1;
-        # chunk v-1's wrap-around is the pipeline's final output
-        final = sent[v - 1]
-        shifted = jnp.roll(sent, 1, axis=0)
-        state_next = jnp.where(rank == 0, shifted, sent)
+    else:
+        if M % S:
+            raise ValueError(
+                f"the interleaved schedule needs num_microbatches ({M}) "
+                f"divisible by the pipeline size ({S}) — microbatches flow "
+                "in groups of S (the reference asserts the same, "
+                "fwd_bwd_pipelining_with_interleaving.py:87)")
+        T = M * v + S - 1
 
-        # collect final outputs: microbatch m exits at tick m + total-1,
-        # arriving (post-rotate) at device 0
-        out_idx = jnp.clip(t - (total_stages - 1), 0, M - 1)
-        valid = (t >= total_stages - 1) & (rank == 0)
-        updated = jax.lax.dynamic_update_index_in_dim(
-            outputs, final.astype(outputs.dtype), out_idx, 0
-        )
-        outputs = jnp.where(valid, updated, outputs)
-        return (state_next, outputs), None
+        def chunk_fn(params, c, x):
+            # the chunk slice lives INSIDE the (rematted) tick function:
+            # it is recomputed from the loop-invariant stacked params in
+            # backward rather than stacked into T-length scan residuals
+            chunk_params = jax.tree.map(
+                lambda p: jax.lax.dynamic_index_in_dim(
+                    p, c, 0, keepdims=False), params)
+            return stage_fn(chunk_params, x)
 
-    state0 = jnp.zeros((v,) + mb_shape, microbatches.dtype)
+        cfn = jax.checkpoint(chunk_fn) if remat else chunk_fn
+
+        def item(u):
+            """(chunk, microbatch, in-range) of the item with phase ``u``:
+            the unique work unit at (device r, tick t) with u = t − r.
+            Conflict-freedom: u determines (c, m) bijectively, and the
+            chunk-c→c+1 wrap adds exactly S to u, so activations rotate one
+            device per tick with no stalls."""
+            uc = jnp.maximum(u, 0)
+            c = (uc // S) % v
+            m = S * ((uc // S) // v) + uc % S
+            return c, jnp.clip(m, 0, M - 1), (u >= 0) & (m < M)
+
+        def tick(carry, t):
+            x, outputs = carry  # ONE in-flight activation per device
+            c, m, _ = item(t - rank)
+            # stage-0 pre-process: whenever device 0's active chunk is 0 it
+            # starts a fresh microbatch (this also retires the item that
+            # just finished chunk v-1 on the wrap-around)
+            inject = jax.lax.dynamic_index_in_dim(
+                microbatches, m, 0, keepdims=False)
+            x = jnp.where((rank == 0) & (c == 0), inject, x)
+            y = cfn(stage_params, c, x)
+            sent = jax.lax.ppermute(y, axis_name, perm)
+
+            # the item device S-1 just finished (u = t − (S−1)) arrives at
+            # device 0 post-rotate; it is final iff its chunk was v−1
+            c_out, m_out, in_range = item(t - (S - 1))
+            valid = in_range & (c_out == v - 1) & (rank == 0)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                outputs, sent.astype(outputs.dtype), m_out, 0
+            )
+            outputs = jnp.where(valid, updated, outputs)
+            return (sent, outputs), None
+
+    state0 = jnp.zeros(mb_shape, microbatches.dtype)
     outputs0 = jnp.zeros((M,) + mb_shape, microbatches.dtype)
     (_, outputs), _ = jax.lax.scan(tick, (state0, outputs0), jnp.arange(T))
     if not broadcast_outputs:
@@ -329,6 +378,13 @@ def build_schedule(
     ``calculator.update(consumed_samples, ...)`` per step then re-split),
     and drive ``fwd_bwd_func`` with that many microbatches. The interleaved
     schedule additionally wants ``virtual_chunks=v`` and chunked params.
+
+    When to interleave (PERF.md "Interleaved schedule"): v>1 shrinks the
+    pipeline fill from (S−1)·v to S−1 chunk-times — forward cost
+    ``M·v + S − 1`` vs ``(M + S − 1)·v`` chunk-times — at the price of
+    v× more ppermutes of one microbatch activation (tiny next to a chunk's
+    FLOPs on ICI). Prefer v>1 whenever ``num_layers`` divides pp·v and the
+    microbatch count is a multiple of pp (required).
     """
     from apex_tpu.transformer.microbatches import (
         build_num_microbatches_calculator,
@@ -345,6 +401,33 @@ def build_schedule(
             f"{pipeline_model_parallel_size}-stage pipeline; lower "
             "micro_batch_size or raise global_batch_size"
         )
+    if (virtual_pipeline_model_parallel_size is not None
+            and pipeline_model_parallel_size > 1):
+        # every batch size the ramp will ever produce must divide into
+        # pp-sized microbatch groups — a mid-training ramp step must not
+        # discover the ValueError inside the schedule
+        per_mb = micro_batch_size * data_parallel_size
+        if rampup_batch_size is None:
+            batch_sizes = [global_batch_size]
+        else:
+            start, incr = int(rampup_batch_size[0]), int(rampup_batch_size[1])
+            batch_sizes = list(range(start, global_batch_size, incr))
+            batch_sizes.append(global_batch_size)
+        for gbs in batch_sizes:
+            if gbs % per_mb:
+                raise ValueError(
+                    f"ramped global batch size {gbs} is not divisible by "
+                    f"micro_batch_size*dp ({per_mb}) — the calculator's "
+                    f"consistency check would fail mid-training"
+                )
+            m = gbs // per_mb
+            if m % pipeline_model_parallel_size:
+                raise ValueError(
+                    f"the interleaved schedule needs every microbatch count "
+                    f"divisible by the pipeline size "
+                    f"({pipeline_model_parallel_size}); batch size {gbs} "
+                    f"yields {m} microbatches"
+                )
     fn = get_forward_backward_func(
         virtual_pipeline_model_parallel_size, pipeline_model_parallel_size,
     )

@@ -156,6 +156,41 @@ class TestGroupedQueryAttention:
         for a, e in zip(g1, g2):
             np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.pallas
+    def test_bf16_gqa_dkv_accumulates_fp32(self, monkeypatch):
+        """ADVICE r2: the dkv kernel's per-q-head partials must be fp32 so
+        the group sum doesn't round each head's contribution to bf16 first.
+        With fp32 partials, bf16-input dk differs from the fp32 oracle by
+        one output rounding, not by group-many."""
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        b, hq, kvh, s, d = 1, 8, 1, 128, 64  # MQA: group of 8 partials
+        q32 = jr.normal(K, (b, hq, s, d))
+        k32 = jr.normal(jr.fold_in(K, 7), (b, kvh, s, d))
+        v32 = jr.normal(jr.fold_in(K, 8), (b, kvh, s, d))
+        to16 = lambda x: x.astype(jnp.bfloat16)
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, impl="pallas").astype(jnp.float32))
+
+        with jax.default_matmul_precision("highest"):
+            _, dk16, _ = jax.grad(loss, argnums=(0, 1, 2))(
+                to16(q32), to16(k32), to16(v32))
+            _, dk32, _ = jax.grad(loss, argnums=(0, 1, 2))(q32, k32, v32)
+        err = jnp.max(jnp.abs(dk16.astype(jnp.float32) - dk32))
+        # one bf16 rounding of the final sum: |err| <= ~2^-8 * |dk|;
+        # bf16-rounded partials would accumulate ~sqrt(8) times that
+        bound = float(jnp.max(jnp.abs(dk32))) * 2 ** -8
+        assert float(err) <= bound * 1.5, (float(err), bound)
+
+    def test_causal_sq_gt_sk_raises(self):
+        """ADVICE r2: bottom-right causal with sq > sk has rows attending
+        nothing — reject instead of emitting exp(0) garbage."""
+        q = jr.normal(K, (2, 64, 16))
+        k = jr.normal(jr.fold_in(K, 9), (2, 32, 16))
+        with pytest.raises(ValueError, match="sq <= sk"):
+            flash_attention(q, k, k, causal=True)
+
     def test_mismatched_heads_raise(self):
         q = jr.normal(K, (2, 3, 32, 16))
         k = jr.normal(K, (2, 2, 32, 16))

@@ -178,6 +178,21 @@ class TestBert:
             p, toks, tgts, loss_mask, pad_mask=pad))(params)
         assert all(bool(jnp.all(jnp.isfinite(x))) for x in jax.tree.leaves(g))
 
+    def test_flash_impl_rejects_interior_mask_eagerly(self):
+        """ADVICE r2: the flash path's first-True length conversion would
+        silently truncate an interior (non-suffix) mask — eager calls must
+        raise instead (float 0/1 masks included)."""
+        kw = dict(vocab_size=64, max_seq_len=16, hidden_size=32,
+                  num_layers=1, num_heads=2)
+        m = BertModel(BertConfig(attention_impl="flash", **kw))
+        params = m.init(K)
+        toks = jr.randint(jr.fold_in(K, 9), (1, 16), 0, 64)
+        pad = jnp.zeros((1, 16)).at[0, 12:].set(1.0)  # float suffix: fine
+        m.hidden_states(params, toks, pad_mask=pad)
+        with pytest.raises(ValueError, match="suffix padding"):
+            m.hidden_states(params, toks,
+                            pad_mask=pad.at[0, 5].set(1.0))
+
     def test_pooler(self):
         cfg = BertConfig(vocab_size=64, max_seq_len=32, hidden_size=32,
                          num_layers=1, num_heads=4)

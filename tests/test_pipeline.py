@@ -448,6 +448,15 @@ class TestBuildSchedule:
                 global_batch_size=8, micro_batch_size=4,
                 data_parallel_size=1, pipeline_model_parallel_size=4)
 
+    def test_interleaved_rejects_ragged_microbatch_count(self):
+        """The group-of-S flow (and the reference's assert,
+        fwd_bwd_pipelining_with_interleaving.py:87) needs M % pp == 0."""
+        with pytest.raises(ValueError, match="divisible"):
+            schedules.build_schedule(
+                global_batch_size=12, micro_batch_size=2,
+                data_parallel_size=1, pipeline_model_parallel_size=4,
+                virtual_pipeline_model_parallel_size=2)
+
     def test_end_to_end_with_calculator(self):
         mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=4)
         fn, calc = schedules.build_schedule(
