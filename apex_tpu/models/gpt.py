@@ -71,8 +71,26 @@ class GPTConfig:
     # "naive": plain jnp softmax with autodiff-saved probabilities — the
     # stock-JAX reference point benchmarks compare against, never preferred.
     attention_impl: str = "softmax"
+    # Mixture-of-experts in the MLP slot (None = dense). The expert FFN
+    # width is ``ffn``; experts shard over ``ep_axis`` when run inside
+    # shard_map (apex_tpu.parallel.mesh's dedicated ep axis). The router's
+    # aux losses enter loss_fn with the coefficients below; aux stats
+    # (incl. drop_fraction) surface via loss_fn(..., return_aux=True).
+    moe_num_experts: Optional[int] = None
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coeff: float = 1e-2
+    moe_z_coeff: float = 1e-3
+    ep_axis: Optional[str] = None
 
     def __post_init__(self):
+        if self.moe_num_experts is not None:
+            if self.moe_num_experts < 2:
+                raise ValueError("moe_num_experts must be >= 2 (None = dense)")
+            if self.tp_size > 1:
+                raise ValueError(
+                    "MoE composes with dp/ep/pp but not (yet) tp: experts "
+                    "shard over the ep axis; set tp_size=1")
         if self.attention_impl not in ("softmax", "flash", "naive"):
             raise ValueError(
                 f"attention_impl must be softmax|flash|naive, got "
@@ -132,6 +150,10 @@ class GPTModel:
         self.axis = axis
         sp = c.sequence_parallel and c.tp_size > 1
         self.sp = sp
+        self.moe = c.moe_num_experts is not None
+        if self.moe:
+            from apex_tpu.transformer.moe import MoEMLP
+            self.moe_bank = MoEMLP(c.moe_num_experts, c.hidden_size, c.ffn)
         self.embedding = tp_lib.VocabParallelEmbedding(
             c.vocab_size, c.hidden_size, tp_size=c.tp_size, axis_name=axis
         )
@@ -162,16 +184,23 @@ class GPTModel:
         layers = []
         for i in range(c.num_layers):
             k = jax.random.split(keys[i], 4)
-            layers.append({
+            layer = {
                 "ln1_w": jnp.ones((c.hidden_size,), c.dtype),
                 "ln1_b": jnp.zeros((c.hidden_size,), c.dtype),
                 "qkv": self.qkv.init(k[0], rank, c.dtype),
                 "attn_out": self.attn_out.init(k[1], rank, c.dtype),
                 "ln2_w": jnp.ones((c.hidden_size,), c.dtype),
                 "ln2_b": jnp.zeros((c.hidden_size,), c.dtype),
-                "mlp_up": self.mlp_up.init(k[2], rank, c.dtype),
-                "mlp_down": self.mlp_down.init(k[3], rank, c.dtype),
-            })
+            }
+            if self.moe:
+                # the FULL expert bank; under expert parallelism shard the
+                # leading expert axis of w1/b1/w2/b2 over ep (router
+                # replicated) — cf. shard_params_for_tp's pattern
+                layer["moe"] = self.moe_bank.init(k[2], c.dtype)
+            else:
+                layer["mlp_up"] = self.mlp_up.init(k[2], rank, c.dtype)
+                layer["mlp_down"] = self.mlp_down.init(k[3], rank, c.dtype)
+            layers.append(layer)
         params = {
             "embedding": self.embedding.init(keys[-2], rank, c.dtype),
             "pos_embedding": jax.random.normal(
@@ -238,6 +267,14 @@ class GPTModel:
         return self.attn_out.headwise(p["attn_out"], ctx)
 
     def _mlp(self, p, x):
+        if self.moe:
+            from apex_tpu.transformer.moe import moe_layer
+            c = self.config
+            y, aux = moe_layer(
+                p["moe"], x, k=c.moe_top_k,
+                capacity_factor=c.moe_capacity_factor,
+                axis_name=c.ep_axis, priority="gate")
+            return y, aux
         h = self.mlp_up(p["mlp_up"], x)
         h = jax.nn.gelu(h, approximate=True)
         if self.config.remat and self.config.remat_policy == "save_attn_mlp":
@@ -280,6 +317,7 @@ class GPTModel:
         return out
 
     def _block(self, p, x, key):
+        """Residual block. Dense: → new x. MoE: → (new x, router aux)."""
         c = self.config
         a = self._attention(p, fused_layer_norm(x, p["ln1_w"], p["ln1_b"]), key)
         if c.remat and c.remat_policy in ("save_attn", "save_attn_mlp"):
@@ -296,9 +334,13 @@ class GPTModel:
         if c.remat and c.remat_policy == "mlp_only":
             mlp_half = jax.checkpoint(mlp_half)
         m = mlp_half(p, x)
+        aux = None
+        if self.moe:
+            m, aux = m
         if c.dropout > 0 and key is not None:
             m = _dropout(m, c.dropout, jax.random.fold_in(key, 2))
-        return x + m
+        x = x + m
+        return (x, aux) if self.moe else x
 
     def wrapped_block(self):
         """The transformer block with the config's remat policy applied —
@@ -328,6 +370,13 @@ class GPTModel:
     # --- forward --------------------------------------------------------------
 
     def hidden_states(self, params, tokens, key=None):
+        x, _ = self.hidden_states_with_aux(params, tokens, key)
+        return x
+
+    def hidden_states_with_aux(self, params, tokens, key=None):
+        """(final hidden states, MoE router aux dict or None). The aux
+        scalars (load_balance_loss, router_z_loss, drop_fraction) are
+        per-layer means."""
         c = self.config
         s = tokens.shape[1]
         x = self.embedding(params["embedding"], tokens)
@@ -336,27 +385,45 @@ class GPTModel:
             x = self._sp_scatter(x)  # residual stream is seq-sharded
 
         block = self.wrapped_block()
+        aux0 = ({"load_balance_loss": jnp.zeros(()),
+                 "router_z_loss": jnp.zeros(()),
+                 "drop_fraction": jnp.zeros(())} if self.moe else None)
 
         if c.scan_layers:
-            def body(x, layer_and_key):
+            def body(carry, layer_and_key):
+                x, aux = carry
                 layer, i = layer_and_key
                 k = None if key is None else jax.random.fold_in(key, i)
-                return block(layer, x, k), None
+                out = block(layer, x, k)
+                if self.moe:
+                    x, a = out
+                    aux = jax.tree.map(lambda t, u: t + u, aux, a)
+                else:
+                    x = out
+                return (x, aux), None
 
-            x, _ = jax.lax.scan(
-                body, x, (params["layers"], jnp.arange(c.num_layers))
+            (x, aux), _ = jax.lax.scan(
+                body, (x, aux0), (params["layers"], jnp.arange(c.num_layers))
             )
         else:
             # unrolled: larger program (compile time ~ num_layers) but no
             # while-loop carry copies / dynamic-slices; XLA schedules across
             # layer boundaries
+            aux = aux0
             for i in range(c.num_layers):
                 layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
                 k = None if key is None else jax.random.fold_in(key, i)
-                x = block(layer, x, k)
+                out = block(layer, x, k)
+                if self.moe:
+                    x, a = out
+                    aux = jax.tree.map(lambda t, u: t + u, aux, a)
+                else:
+                    x = out
+        if self.moe:
+            aux = jax.tree.map(lambda t: t / c.num_layers, aux)
         if self.sp:
             x = self._sp_gather(x)  # full seq for the head
-        return fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
+        return fused_layer_norm(x, params["lnf_w"], params["lnf_b"]), aux
 
     def logits(self, params, tokens, key=None):
         """Tied unembedding: local shard logits (b, s, V/tp)."""
@@ -376,21 +443,34 @@ class GPTModel:
             x = tp_lib.copy_to_tensor_model_parallel_region(x, self.axis)
         return jnp.dot(x, params["embedding"]["weight"].T)
 
-    def loss_fn(self, params, tokens, targets, key=None, loss_mask=None):
+    def loss_fn(self, params, tokens, targets, key=None, loss_mask=None,
+                return_aux=False):
         """Mean LM loss via vocab-parallel CE (the reference's
         ``vocab_parallel_cross_entropy`` on the last stage). ``loss_mask``
         (tokens-shaped, 1 = count) weights the mean — the consumer of
         ``get_ltor_masks_and_position_ids``'s loss mask (reference
         ``pipeline_parallel/utils.py:303``: EOD and padding positions are
-        excluded from the loss there the same way)."""
-        logits = self.logits(params, tokens, key)
+        excluded from the loss there the same way).
+
+        With MoE, the router's load-balance and z losses enter with the
+        config coefficients; ``return_aux=True`` additionally returns the
+        aux dict (per-layer-mean load_balance_loss / router_z_loss /
+        drop_fraction — the drop stat training loops should log)."""
+        x, aux = self.hidden_states_with_aux(params, tokens, key)
+        logits = self.unembed(params, x)
         losses = tp_lib.vocab_parallel_cross_entropy(
             logits, targets, axis_name=self.axis
         )
         if loss_mask is None:
-            return jnp.mean(losses)
-        m = loss_mask.astype(losses.dtype)
-        return jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)
+            loss = jnp.mean(losses)
+        else:
+            m = loss_mask.astype(losses.dtype)
+            loss = jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)
+        if self.moe:
+            c = self.config
+            loss = (loss + c.moe_aux_coeff * aux["load_balance_loss"]
+                    + c.moe_z_coeff * aux["router_z_loss"])
+        return (loss, aux) if return_aux else loss
 
 
 def _dropout(x, rate, key):

