@@ -217,34 +217,108 @@ class GPTModel:
     def _attention(self, p, x, key):
         c = self.config
         h, d = c.local_heads, c.head_dim
+        hkv = c.local_kv_heads
+        use_flash = c.attention_impl == "flash" and not (
+            c.dropout > 0 and key is not None  # flash path has no probs dropout
+        )
+        if use_flash:
+            xg = self.qkv.gather_input(x)             # (b, s, H) full seq
+            s_len = xg.shape[1]
+            from apex_tpu.amp.lists import apply_op_rules
+            from apex_tpu.ops import _backend
+            from apex_tpu.ops.attention import (bshd_kernel_ok,
+                                                flash_auto_crossover,
+                                                fused_qkv_attention)
+            # the O1 per-op cast applies before the kernel-eligibility
+            # gate — an fp16-casting policy must land on the XLA path
+            # (Mosaic has no f16), so the gate sees the POST-cast dtype
+            xc, w_qkv, b_qkv, w_out = apply_op_rules(
+                "attention", xg, p["qkv"]["weight"],
+                p["qkv"].get("bias"), p["attn_out"]["weight"])
+            fused_ok = (
+                "bias" in p["qkv"]
+                and bshd_kernel_ok(s_len, s_len, h, d, xc.dtype)
+                and (s_len >= flash_auto_crossover(d)
+                     or _backend.interpret_forced())
+                and _backend.choose_impl("auto", True) == "pallas"
+            )
+            if fused_ok:
+                # The zero-layout-copy path: packed QKV GEMM → flash
+                # kernels reading head windows straight from the packed
+                # buffer → output GEMM, all plain 2D contractions with a
+                # hand-written VJP (see ops.attention.fused_qkv_attention
+                # — kills the ~4.5 GB/step of XLA layout-conversion copies
+                # the composed formulation paid, PERF.md r3).
+                y = fused_qkv_attention(
+                    xc, w_qkv, b_qkv, w_out, h, hkv, d,
+                    1.0 / float(d) ** 0.5, True)
+                y = self.attn_out.reduce_output(y)
+                if "bias" in p["attn_out"]:
+                    y = y + p["attn_out"]["bias"]
+                return y
+            if (not bshd_kernel_ok(s_len, s_len, h, d, xc.dtype)
+                    and d == 64 and s_len % 128 == 0
+                    and xc.dtype != jnp.float16
+                    and (s_len >= flash_auto_crossover(d)
+                         or _backend.interpret_forced())
+                    and _backend.choose_impl("auto", True) == "pallas"):
+                # d=64 multi-head can't ride the folded bshd layout (its
+                # 64-wide blocks break the 128-lane tile rule) but the
+                # bh-flat kernel handles d=64 fine — keep the pre-r3
+                # head-batched route so those configs don't silently lose
+                # the kernel (the layout copies it pays are the r2 cost
+                # model; head_dim 128 is the recommended config anyway)
+                qkv4 = self.qkv.headwise(p["qkv"], x, h + 2 * hkv)
+                q4 = qkv4[:, :h]
+                k4 = qkv4[:, h:h + hkv]
+                v4 = qkv4[:, h + hkv:]
+                ctx4 = flash_attention(q4, k4, v4, causal=True)
+                return self.attn_out.headwise(p["attn_out"], ctx4)
+            # Below the kernel crossover (or bias-less layers): seq-major
+            # (bshd) einsums + the flash entry's XLA/Pallas dispatch. The
+            # (b, s, h, d) layout is the GEMM's natural output, so this
+            # path too avoids the old head-batched formulation's copies.
+            w = p["qkv"]["weight"]                    # (G*d, H), q|k|v packed
+            H = w.shape[-1]
+            wq = w[:h * d].reshape(h, d, H)
+            wk = w[h * d:(h + hkv) * d].reshape(hkv, d, H)
+            wv = w[(h + hkv) * d:].reshape(hkv, d, H)
+            q = jnp.einsum("bsH,hdH->bshd", xg, wq)
+            k = jnp.einsum("bsH,hdH->bshd", xg, wk)
+            v = jnp.einsum("bsH,hdH->bshd", xg, wv)
+            if "bias" in p["qkv"]:
+                bias = p["qkv"]["bias"]
+                q = q + bias[:h * d].reshape(h, d)
+                k = k + bias[h * d:(h + hkv) * d].reshape(hkv, d)
+                v = v + bias[(h + hkv) * d:].reshape(hkv, d)
+            ctx = flash_attention(q, k, v, causal=True, layout="bshd")
+            wo = p["attn_out"]["weight"].reshape(-1, h, d)
+            y = jnp.einsum("bshd,Hhd->bsH", ctx, wo)
+            y = self.attn_out.reduce_output(y)
+            if "bias" in p["attn_out"]:
+                y = y + p["attn_out"]["bias"]
+            return y
+
         # Head-batched QKV projection (ColumnParallelLinear.headwise):
-        # q/k/v come out (b, h, s, d) — the attention layout — straight
-        # from the MXU; the flat matmul + per-head transpose formulation
-        # spent ~14 ms/step of the flagship bench in pure layout copies.
+        # q/k/v come out (b, h, s, d) straight from the MXU (the
+        # materialized-scores paths below want that layout anyway).
         # Local output features stay packed (q-heads | k-heads | v-heads) —
         # grouped, heads within each group (Megatron packs (h, 3d) because
         # its *global* qkv weight must shard per-head across tp ranks; here
         # params are built per-rank, so the grouped order is free). With
         # grouped-query attention (num_kv_heads < num_heads) the k/v groups
         # are simply narrower.
-        hkv = c.local_kv_heads
         qkv = self.qkv.headwise(p["qkv"], x, h + 2 * hkv)  # (b, h+2hkv, s, d)
         b, s = qkv.shape[0], qkv.shape[2]
         # (b, h, s, d) / (b, hkv, s, d)
         q = qkv[:, :h]
         k = qkv[:, h:h + hkv]
         v = qkv[:, h + hkv:]
-        use_flash = c.attention_impl == "flash" and not (
-            c.dropout > 0 and key is not None  # flash path has no probs dropout
-        )
-        if not use_flash and hkv < h:
-            # flash handles grouped kv natively (kernel index maps); the
-            # materialized-scores paths below broadcast kv heads instead
+        if hkv < h:
+            # the materialized-scores paths below broadcast kv heads
             k = jnp.repeat(k, h // hkv, axis=1)
             v = jnp.repeat(v, h // hkv, axis=1)
-        if use_flash:
-            ctx = flash_attention(q, k, v, causal=True)
-        elif c.attention_impl == "naive":
+        if c.attention_impl == "naive":
             # stock-JAX formulation: materialized scores, jnp softmax, probs
             # saved by autodiff for backward — no framework ops
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / float(d) ** 0.5

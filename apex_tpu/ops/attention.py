@@ -147,10 +147,160 @@ def _flash_bwd(scale, causal, use_pallas, res_and_lens, do):
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
+# --- seq-major (bshd) core ----------------------------------------------------
+
+def bshd_kernel_ok(sq: int, sk: int, h: int, d: int, dtype) -> bool:
+    """Mosaic eligibility for the seq-major (folded) kernels — shared by
+    ``flash_attention(layout='bshd')``, ``fused_qkv_attention`` callers,
+    and the GPT fused-path gate so the rule lives in ONE place. The folded
+    (b, s, h·d) views take d-wide column blocks, so d must tile the
+    128-lane rule itself (d == 64 only passes when it IS the folded dim,
+    i.e. a single head); f16 has no Mosaic support at all."""
+    return (sq % 128 == 0 and sk % 128 == 0
+            and (d % 128 == 0 or (h == 1 and d == 64))
+            and dtype != jnp.float16)
+
+
+def _to_bh(x):  # (b, s, h, d) -> (b*h, s, d) for the XLA fallback
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _from_bh(x, b, h):  # (b*h, s, d) -> (b, s, h, d)
+    s, d = x.shape[1], x.shape[2]
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_core_bshd(q, k, v, scale, causal, use_pallas):
+    o, _ = _flash_fwd_res_bshd(q, k, v, scale, causal, use_pallas)
+    return o
+
+
+def _flash_fwd_res_bshd(q, k, v, scale, causal, use_pallas):
+    if use_pallas:
+        o, lse = _k.flash_fwd_bshd(
+            q, k, v, scale=scale, causal=causal,
+            interpret=_backend.interpret_mode())
+    else:
+        b, h = q.shape[0], q.shape[2]
+        group = h // k.shape[2]
+        # flat repeat matches the grouped row order (q row b·h + h_i reads
+        # kv row (b·h + h_i)//group) — same expansion _flash_bwd_impl uses
+        kf = _to_bh(k)
+        vf = _to_bh(v)
+        if group > 1:
+            kf = jnp.repeat(kf, group, 0)
+            vf = jnp.repeat(vf, group, 0)
+        o3, lse3 = _xla_attention(_to_bh(q), kf, vf, scale, causal)
+        o = _from_bh(o3, b, h)
+        lse = lse3.reshape(b, h, -1)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_fwd_bshd(q, k, v, scale, causal, use_pallas):
+    o, res = _flash_fwd_res_bshd(q, k, v, scale, causal, use_pallas)
+    return o, res
+
+
+def _flash_bwd_bshd(scale, causal, use_pallas, res, do):
+    q, k, v, o, lse = res
+    if use_pallas:
+        return _k.flash_bwd_bshd(
+            q, k, v, o, lse, do, scale=scale, causal=causal,
+            interpret=_backend.interpret_mode())
+    b, h = q.shape[0], q.shape[2]
+    h_kv = k.shape[2]
+    dq3, dk3, dv3 = _flash_bwd_impl(
+        _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(o),
+        lse.reshape(b * h, -1), _to_bh(do), None, scale, causal,
+        use_pallas=False)
+    return (_from_bh(dq3, b, h), _from_bh(dk3, b, h_kv),
+            _from_bh(dv3, b, h_kv))
+
+
+_flash_core_bshd.defvjp(_flash_fwd_bshd, _flash_bwd_bshd)
+
+
+# --- fused projection + attention block ---------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def fused_qkv_attention(x, w_qkv, b_qkv, w_out, h, h_kv, d, scale, causal):
+    """Packed-QKV projection → flash attention → output projection as ONE
+    differentiable block in which every large contraction is a plain 2D
+    GEMM over (tokens, features) folded views, and the flash kernels read
+    q/k/v straight out of the packed projection buffer via window-offset
+    index maps.
+
+    Why it exists (PERF.md r3): composed from separate einsums, XLA's
+    layout assignment inserts ~4.5 GB/step of conversion copies between
+    the projection dots (whose multi-dim-contraction forward/transpose
+    lowerings pick non-default layouts) and the Pallas kernels (which pin
+    default layouts). Folding everything to 2D GEMMs leaves no layout
+    freedom anywhere, and the hand-written VJP contracts dq/dk/dv against
+    their weight windows separately — a packed dqkv is never materialized.
+
+    ``x`` (b, s, H); ``w_qkv`` ((h + 2·h_kv)·d, H) packed q|k|v (heads
+    contiguous per part); ``b_qkv`` ((h+2·h_kv)·d,); ``w_out`` (O, h·d).
+    Returns (b, s, O) — the output-projection bias and (under tp) the
+    partial-product reduce stay with the caller, matching
+    ``RowParallelLinear``'s post-reduce bias order. Pallas-only (the
+    caller gates on kernel eligibility)."""
+    y, _ = _fused_attn_fwd(x, w_qkv, b_qkv, w_out, h, h_kv, d, scale,
+                           causal)
+    return y
+
+
+def _fused_attn_fwd(x, w_qkv, b_qkv, w_out, h, h_kv, d, scale, causal):
+    b, s, H = x.shape
+    qkv = (jnp.dot(x.reshape(-1, H), w_qkv.T) + b_qkv).reshape(b, s, -1)
+    o, lse = _k.flash_fwd_packed(
+        qkv, h, h_kv, d, scale=scale, causal=causal,
+        interpret=_backend.interpret_mode())
+    y = jnp.dot(o.reshape(-1, h * d), w_out.T).reshape(b, s, -1)
+    return y, (x, qkv, o, lse, w_qkv, w_out)
+
+
+def _fused_attn_bwd(h, h_kv, d, scale, causal, res, dy):
+    x, qkv, o, lse, w_qkv, w_out = res
+    b, s, H = x.shape
+    T = b * s
+    dy2 = dy.reshape(T, -1)
+    o2 = o.reshape(T, h * d)
+    dw_out = jnp.dot(dy2.T, o2)
+    do = jnp.dot(dy2, w_out).reshape(b, s, h * d)
+    dq, dk, dv = _k.flash_bwd_packed(
+        qkv, h, h_kv, d, o, lse, do, scale=scale, causal=causal,
+        interpret=_backend.interpret_mode())
+    x2 = x.reshape(T, H)
+    dq2 = dq.reshape(T, -1)
+    dk2 = dk.reshape(T, -1)
+    dv2 = dv.reshape(T, -1)
+    wq = w_qkv[:h * d]
+    wk = w_qkv[h * d:(h + h_kv) * d]
+    wv = w_qkv[(h + h_kv) * d:]
+    dx = (jnp.dot(dq2, wq) + jnp.dot(dk2, wk) + jnp.dot(dv2, wv)
+          ).reshape(b, s, H)
+    dw_qkv = jnp.concatenate(
+        [jnp.dot(dq2.T, x2), jnp.dot(dk2.T, x2), jnp.dot(dv2.T, x2)], 0)
+    db_qkv = jnp.concatenate(
+        [jnp.sum(dq2, 0), jnp.sum(dk2, 0), jnp.sum(dv2, 0)])
+    return dx, dw_qkv.astype(w_qkv.dtype), db_qkv.astype(w_qkv.dtype), \
+        dw_out.astype(w_out.dtype)
+
+
+fused_qkv_attention.defvjp(
+    lambda x, wq, bq, wo, h, hk, d, sc, ca:
+        _fused_attn_fwd(x, wq, bq, wo, h, hk, d, sc, ca),
+    _fused_attn_bwd,
+)
+
+
 def flash_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     *, causal: bool = False, scale: Optional[float] = None,
     kv_lens: Optional[jax.Array] = None, impl: str = "auto",
+    layout: str = "bhsd",
 ) -> jax.Array:
     """Blockwise attention over (..., seq, head_dim) with any number of
     leading batch/head dims. No sequence-length cap (cf. fmha's 512).
@@ -186,8 +336,42 @@ def flash_attention(
     165.8 vs 158.7 (xla wins). Isolated-kernel timings through the remote
     tunnel had previously suggested a 4096 crossover — the full-step
     measurement (where the kernel competes with everything else for HBM)
-    is the one that matters."""
+    is the one that matters.
+
+    ``layout='bshd'``: operands are (batch, seq, heads, head_dim) — the
+    seq-major layout the QKV projection GEMMs naturally emit. The Pallas
+    kernels read it via head-strided index maps, so NO layout-conversion
+    copies sit between the projections and the kernels (the bh-flat layout
+    cost the flagship ~4.5 GB/step of pure copies — PERF.md r3). Prefer it
+    whenever q/k/v come straight from a (tokens, features) GEMM; kv_lens
+    is not supported in this layout."""
     q, k, v = apply_op_rules("attention", q, k, v)
+    if layout not in ("bhsd", "bshd"):
+        raise ValueError(f"layout must be bhsd|bshd, got {layout!r}")
+    if layout == "bshd":
+        if kv_lens is not None:
+            raise NotImplementedError("kv_lens requires layout='bhsd'")
+        if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+            raise ValueError(
+                f"layout='bshd' takes (b, s, h, d) operands; got "
+                f"{q.shape} / {k.shape}")
+        if causal and q.shape[1] > k.shape[1]:
+            raise ValueError(
+                f"causal attention requires sq <= sk; got sq={q.shape[1]} "
+                f"> sk={k.shape[1]}")
+        if q.shape[2] % k.shape[2] or k.shape[:2] != v.shape[:2]:
+            raise ValueError(
+                f"kv heads ({k.shape[2]}) must divide q heads "
+                f"({q.shape[2]}) with matching batch/seq dims")
+        d = q.shape[-1]
+        s_scale = float(scale if scale is not None else 1.0 / d ** 0.5)
+        ok = bshd_kernel_ok(q.shape[1], k.shape[1], q.shape[2], d, q.dtype)
+        impl_ = impl
+        if (impl_ == "auto" and k.shape[1] < flash_auto_crossover(d)
+                and not _backend.interpret_forced()):
+            impl_ = "xla"
+        use_pallas = _backend.choose_impl(impl_, ok) == "pallas"
+        return _flash_core_bshd(q, k, v, s_scale, causal, use_pallas)
     d = q.shape[-1]
     if causal and q.shape[-2] > k.shape[-2]:
         # bottom-right-aligned causal with sq > sk gives the first
@@ -223,6 +407,11 @@ def flash_attention(
     ok = (
         q3.shape[-2] % 128 == 0 and k3.shape[-2] % 128 == 0
         and (d % 128 == 0 or d == 64)
+        # the Mosaic dialect has no f16: strict-fp16 runs (half_dtype=
+        # float16) take the XLA composition — measured on hardware, see
+        # PERF.md "fp16-strict" (bf16 is the TPU half type; fp16 pays
+        # this kernel tax on top of its scaler requirement)
+        and q.dtype != jnp.float16
     )
     if (impl == "auto" and k3.shape[-2] < flash_auto_crossover(d)
             and not _backend.interpret_forced()):

@@ -50,10 +50,14 @@ def _fit_block(n, pref):
 
 # --- forward ------------------------------------------------------------------
 
-def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, varlen):
+def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, varlen, bshd=False):
     """``varlen`` is a STATIC specialization flag: without kv lengths the
     kernel carries no length operand, no per-block length select, and no
     dynamic predicate conjunct — the common (non-padded) call pays nothing.
+    ``bshd``: the seq-major layout — q/k/v/o ride (b, s, h·d) folded views
+    whose blocks are IDENTICAL to the bh-flat ones (a (bq, d) tile, the
+    head picked by the block index along the folded feature dim), so only
+    the lse carrier's rank differs ((b, h, sq, LANES) vs (bh, sq, LANES)).
     """
     if varlen:
         q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
@@ -119,7 +123,11 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, varlen):
         # lse rides an (sq, 8) layout: TPU blocks must tile (8, 128) or match
         # the array dim, so a flat (1, bq) row block won't lower — broadcast
         # the column across 8 lanes and let the caller slice lane 0.
-        lse_ref[0] = jnp.broadcast_to(lse_val, (l.shape[0], _LSE_LANES))
+        lse_b = jnp.broadcast_to(lse_val, (l.shape[0], _LSE_LANES))
+        if bshd:  # (b, h, sq, LANES) carrier
+            lse_ref[0, 0] = lse_b
+        else:
+            lse_ref[0] = lse_b
 
 
 _LSE_LANES = 8
@@ -192,9 +200,210 @@ def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bq=1024, bk=1024,
     return o, lse[..., 0]
 
 
+def flash_fwd_packed(qkv, h, h_kv, d, *, scale, causal, bq=1024, bk=1024,
+                     interpret=False):
+    """Flash forward reading q/k/v directly out of the PACKED projection
+    output: ``qkv`` (b, s, (h+2·h_kv)·d), features ordered q|k|v with heads
+    contiguous inside each part. The same buffer rides in three times with
+    window-offset index maps — the projection GEMM's output feeds the
+    kernel with no slice, no copy, no layout change at all. Returns
+    (o (b, s, h·d), lse (b, h, s))."""
+    b, s, _ = qkv.shape
+    group = h // h_kv
+    bq, bk = _fit_block(s, bq), _fit_block(s, bk)
+    nq, nk = _blocks(s, bq), _blocks(s, bk)
+
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk, off=0, varlen=False,
+                          bshd=True),
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d),
+                         lambda t, i, j, h=h: (t // h, i, t % h)),
+            pl.BlockSpec((1, bk, d),
+                         lambda t, i, j, h=h, g=group:
+                         (t // h, j, h + (t % h) // g)),
+            pl.BlockSpec((1, bk, d),
+                         lambda t, i, j, h=h, hk=h_kv, g=group:
+                         (t // h, j, h + hk + (t % h) // g)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d),
+                         lambda t, i, j, h=h: (t // h, i, t % h)),
+            pl.BlockSpec((1, 1, bq, _LSE_LANES),
+                         lambda t, i, j, h=h: (t // h, t % h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s, h * d), qkv.dtype),
+            jax.ShapeDtypeStruct((b, h, s, _LSE_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(qkv, qkv, qkv)
+    return o, lse[..., 0]
+
+
+def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
+                     bq=1024, bk=1024, interpret=False):
+    """Backward of :func:`flash_fwd_packed`: returns SEPARATE folded grads
+    (dq (b, s, h·d), dk/dv (b, s, h_kv·d)) — the caller contracts each
+    against its weight window (plain 2D GEMMs), never materializing a
+    packed dqkv."""
+    b, s, _ = qkv.shape
+    group = h // h_kv
+    bq, bk = _fit_block(s, bq), _fit_block(s, bk)
+    nq, nk = _blocks(s, bq), _blocks(s, bk)
+    delta = jnp.sum(
+        do.astype(jnp.float32).reshape(b, s, h, d)
+        * o.astype(jnp.float32).reshape(b, s, h, d), axis=-1)
+    lse4 = _expand_rows(lse)
+    delta4 = _expand_rows(delta.transpose(0, 2, 1))
+
+    qm = lambda t, i, j, h=h: (t // h, i, t % h)  # noqa: E731
+    km = lambda t, i, j, h=h, g=group: (t // h, j, h + (t % h) // g)  # noqa: E731
+    vm = lambda t, i, j, h=h, hk=h_kv, g=group: (  # noqa: E731
+        t // h, j, h + hk + (t % h) // g)
+    dom = lambda t, i, j, h=h: (t // h, i, t % h)  # noqa: E731
+    rm = lambda t, i, j, h=h: (t // h, t % h, i, 0)  # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk, off=0, varlen=False,
+                          bshd=True),
+        grid=(b * h, nq, nk),
+        in_specs=[pl.BlockSpec((1, bq, d), qm),
+                  pl.BlockSpec((1, bk, d), km),
+                  pl.BlockSpec((1, bk, d), vm),
+                  pl.BlockSpec((1, bq, d), dom),
+                  pl.BlockSpec((1, 1, bq, _LSE_LANES), rm),
+                  pl.BlockSpec((1, 1, bq, _LSE_LANES), rm)],
+        out_specs=pl.BlockSpec((1, bq, d), qm),
+        out_shape=jax.ShapeDtypeStruct((b, s, h * d), qkv.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(qkv, qkv, qkv, do, lse4, delta4)
+
+    qm2 = lambda t, j, i, h=h: (t // h, i, t % h)  # noqa: E731
+    km2 = lambda t, j, i, h=h, g=group: (t // h, j, h + (t % h) // g)  # noqa: E731
+    vm2 = lambda t, j, i, h=h, hk=h_kv, g=group: (  # noqa: E731
+        t // h, j, h + hk + (t % h) // g)
+    dom2 = lambda t, j, i, h=h: (t // h, i, t % h)  # noqa: E731
+    rm2 = lambda t, j, i, h=h: (t // h, t % h, i, 0)  # noqa: E731
+    dkm = lambda t, j, i, h=h: (t // h, j, t % h)  # noqa: E731
+    dkv_dt = jnp.float32 if group > 1 else qkv.dtype
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq, off=0, varlen=False,
+                          bshd=True),
+        grid=(b * h, nk, nq),
+        in_specs=[pl.BlockSpec((1, bq, d), qm2),
+                  pl.BlockSpec((1, bk, d), km2),
+                  pl.BlockSpec((1, bk, d), vm2),
+                  pl.BlockSpec((1, bq, d), dom2),
+                  pl.BlockSpec((1, 1, bq, _LSE_LANES), rm2),
+                  pl.BlockSpec((1, 1, bq, _LSE_LANES), rm2)],
+        out_specs=[pl.BlockSpec((1, bk, d), dkm),
+                   pl.BlockSpec((1, bk, d), dkm)],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s, h * d), dkv_dt),
+            jax.ShapeDtypeStruct((b, s, h * d), dkv_dt),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(qkv, qkv, qkv, do, lse4, delta4)
+    if group > 1:
+        dk = dk.reshape(b, s, h_kv, group, d).sum(3).astype(qkv.dtype)
+        dv = dv.reshape(b, s, h_kv, group, d).sum(3).astype(qkv.dtype)
+        dk = dk.reshape(b, s, h_kv * d)
+        dv = dv.reshape(b, s, h_kv * d)
+    return dq, dk, dv
+
+
+def flash_fwd_bshd(q, k, v, *, scale, causal, bq=1024, bk=1024,
+                   interpret=False):
+    """Seq-major flash forward: q (b, sq, h, d); k/v (b, sk, h_kv, d).
+
+    The (s, h·d)-minor layout is exactly what the QKV projection GEMMs
+    emit, so no layout conversion feeds the kernel (removes the
+    ~4.5 GB/step of pre/post-kernel copies the bh-flat layout cost the
+    flagship, PERF.md r3). Mechanics: the operands ride as (b, s, h·d)
+    folded views (free bitcasts) and the head is selected by the block
+    index along the folded feature dim — a d-wide column block, satisfying
+    Mosaic's (8, 128) trailing-tile rule where a 4D singleton-head block
+    cannot. Returns (o (b, sq, h, d), lse (b, h, sq))."""
+    b, sq, h, d = q.shape
+    sk, h_kv = k.shape[1], k.shape[2]
+    group = h // h_kv
+    bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
+    nq, nk = _blocks(sq, bq), _blocks(sk, bk)
+
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=False,
+                          bshd=True),
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d),
+                         lambda t, i, j, h=h: (t // h, i, t % h)),
+            pl.BlockSpec((1, bk, d),
+                         lambda t, i, j, h=h, g=group:
+                         (t // h, j, (t % h) // g)),
+            pl.BlockSpec((1, bk, d),
+                         lambda t, i, j, h=h, g=group:
+                         (t // h, j, (t % h) // g)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d),
+                         lambda t, i, j, h=h: (t // h, i, t % h)),
+            pl.BlockSpec((1, 1, bq, _LSE_LANES),
+                         lambda t, i, j, h=h: (t // h, t % h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sq, h * d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, _LSE_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(q.reshape(b, sq, h * d), k.reshape(b, sk, h_kv * d),
+      v.reshape(b, sk, h_kv * d))
+    return o.reshape(b, sq, h, d), lse[..., 0]
+
+
 # --- backward -----------------------------------------------------------------
 
-def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, varlen):
+def _rd_row(ref, bshd):
+    """lse/delta carrier block → (rows, LANES): the bshd carrier is the
+    4D (b, h, sq, LANES) array, the flat one (bh, sq, LANES)."""
+    return ref[0, 0] if bshd else ref[0]
+
+
+def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, varlen,
+                   bshd=False):
     if varlen:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref,
          dq_ref, acc_scr) = refs
@@ -229,11 +438,12 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, varlen):
             s = jnp.where(cols <= rows + off, s, NEG_INF)
         if varlen:
             s = jnp.where(cols < kvlen, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, 0:1])
+        p = jnp.exp(s - _rd_row(lse_ref, bshd)[:, 0:1])
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = (p * (dp - delta_ref[0][:, 0:1]) * scale).astype(k.dtype)
+        ds = (p * (dp - _rd_row(delta_ref, bshd)[:, 0:1]) * scale
+              ).astype(k.dtype)
         acc_scr[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -243,7 +453,8 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, varlen):
         dq_ref[0] = acc_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, varlen):
+def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, varlen,
+                    bshd=False):
     if varlen:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref,
          dk_ref, dv_ref, dk_scr, dv_scr) = refs
@@ -280,7 +491,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, varlen):
             s = jnp.where(cols <= rows + off, s, NEG_INF)
         if varlen:
             s = jnp.where(cols < kvlen, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, 0:1])  # (bq, bk)
+        p = jnp.exp(s - _rd_row(lse_ref, bshd)[:, 0:1])  # (bq, bk)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32
@@ -288,7 +499,8 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, varlen):
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = (p * (dp - delta_ref[0][:, 0:1]) * scale).astype(q.dtype)
+        ds = (p * (dp - _rd_row(delta_ref, bshd)[:, 0:1]) * scale
+              ).astype(q.dtype)
         dk_scr[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -379,4 +591,93 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
     if group > 1:
         dk = dk.reshape(-1, group, sk, d).sum(1).astype(k.dtype)
         dv = dv.reshape(-1, group, sk, d).sum(1).astype(v.dtype)
+    return dq, dk, dv
+
+
+def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
+                   interpret=False):
+    """Seq-major backward (cf. :func:`flash_fwd_bshd`): q/o/do
+    (b, sq, h, d), k/v (b, sk, h_kv, d), lse (b, h, sq). Returns
+    (dq (b, sq, h, d), dk/dv (b, sk, h_kv, d))."""
+    b, sq, h, d = q.shape
+    sk, h_kv = k.shape[1], k.shape[2]
+    group = h // h_kv
+    bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
+    nq, nk = _blocks(sq, bq), _blocks(sk, bk)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # (b, sq, h) -> the (b, h, sq, LANES) carrier the kernels read rowwise
+    lse4 = _expand_rows(lse)
+    delta4 = _expand_rows(delta.transpose(0, 2, 1))
+    # folded (b, s, h·d) views — free bitcasts, head = block index (see
+    # flash_fwd_bshd)
+    q3 = q.reshape(b, sq, h * d)
+    k3 = k.reshape(b, sk, h_kv * d)
+    v3 = v.reshape(b, sk, h_kv * d)
+    do3 = do.reshape(b, sq, h * d)
+
+    def q_spec(index_map):
+        return pl.BlockSpec((1, bq, d), index_map)
+
+    def kv_spec(index_map):
+        return pl.BlockSpec((1, bk, d), index_map)
+
+    def row_spec(index_map):
+        return pl.BlockSpec((1, 1, bq, _LSE_LANES), index_map)
+
+    qm = lambda t, i, j, h=h: (t // h, i, t % h)  # noqa: E731
+    km = lambda t, i, j, h=h, g=group: (t // h, j, (t % h) // g)  # noqa: E731
+    rm = lambda t, i, j, h=h: (t // h, t % h, i, 0)  # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=False,
+                          bshd=True),
+        grid=(b * h, nq, nk),
+        in_specs=[q_spec(qm), kv_spec(km), kv_spec(km), q_spec(qm),
+                  row_spec(rm), row_spec(rm)],
+        out_specs=q_spec(qm),
+        out_shape=jax.ShapeDtypeStruct((b, sq, h * d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse4, delta4)
+
+    qm2 = lambda t, j, i, h=h: (t // h, i, t % h)  # noqa: E731
+    km2 = lambda t, j, i, h=h, g=group: (t // h, j, (t % h) // g)  # noqa: E731
+    rm2 = lambda t, j, i, h=h: (t // h, t % h, i, 0)  # noqa: E731
+    # grouped kv: per-q-head fp32 partials at q-head positions, summed per
+    # kv group outside (same rationale as flash_bwd)
+    dkv_dtypes = (jnp.float32, jnp.float32) if group > 1 else (k.dtype,
+                                                               v.dtype)
+    dkm = lambda t, j, i, h=h: (t // h, j, t % h)  # noqa: E731
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq, off=sk - sq, varlen=False,
+                          bshd=True),
+        grid=(b * h, nk, nq),
+        in_specs=[q_spec(qm2), kv_spec(km2), kv_spec(km2), q_spec(qm2),
+                  row_spec(rm2), row_spec(rm2)],
+        out_specs=[kv_spec(dkm), kv_spec(dkm)],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sk, h * d), dkv_dtypes[0]),
+            jax.ShapeDtypeStruct((b, sk, h * d), dkv_dtypes[1]),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse4, delta4)
+    dq = dq.reshape(b, sq, h, d)
+    dk = dk.reshape(b, sk, h, d)
+    dv = dv.reshape(b, sk, h, d)
+    if group > 1:
+        dk = dk.reshape(b, sk, h_kv, group, d).sum(3).astype(k.dtype)
+        dv = dv.reshape(b, sk, h_kv, group, d).sum(3).astype(v.dtype)
     return dq, dk, dv

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 
 
-def router_topk(
+def router_topk_sparse(
     logits: jax.Array,
     capacity: int,
     k: int = 2,
@@ -41,17 +41,27 @@ def router_topk(
     normalize_gates: bool = True,
     priority: str = "gate",
 ) -> Tuple[jax.Array, jax.Array, dict]:
-    """Top-k token→expert assignment with capacity.
+    """Top-k token→expert assignment with capacity, SPARSE form.
 
-    ``logits``: (T, E). Returns ``(dispatch, combine, aux)`` where
-    ``dispatch`` is a one-hot (T, E, C) routing tensor, ``combine`` the
-    gate-weighted version used to merge expert outputs, and ``aux`` carries
-    ``load_balance_loss`` (Switch-style: E · Σ_e fraction_e · mean-gate_e,
-    1.0 at uniform routing), ``router_z_loss``, and ``drop_fraction`` —
-    the fraction of the T·k (token, choice) assignments that overflowed
-    their expert's capacity and were dropped (surfaced so training loops
-    can log/alarm on routing collapse rather than inferring it from zero
-    combine weights).
+    ``logits``: (T, E). Returns ``(slot_ids, gates, aux)``:
+
+    * ``slot_ids`` (k, T) int32 — round r assigns token t to flat expert
+      slot ``e·C + c``; dropped (over-capacity) assignments point at the
+      sentinel slot ``E·C`` (a dump row the dispatch scatter writes into
+      and the combine gather zero-weights);
+    * ``gates`` (k, T) fp32 — the (optionally renormalized) combine
+      weights, 0 for dropped assignments;
+    * ``aux`` — ``load_balance_loss`` (Switch-style: E · Σ_e fraction_e ·
+      mean-gate_e, 1.0 at uniform routing), ``router_z_loss``, and
+      ``drop_fraction`` (share of the T·k assignments that overflowed —
+      surfaced so training loops can alarm on routing collapse).
+
+    The sparse form is what :func:`moe_layer` consumes: dispatch/combine
+    become an O(T·d) row scatter/gather instead of the GShard one-hot
+    einsum whose (T, E, C) tensors are quadratic in tokens — at the
+    flagship scale (T=16k, E=8) those weigh 2.7 GB each and cost 5× the
+    expert FFN's own FLOPs (measured OOM, PERF.md r3). Use
+    :func:`router_topk` when the dense masks themselves are wanted.
 
     Slot assignment is k rounds of argmax with chosen gates masked out.
     ``priority`` decides who wins a full expert's last slots within a
@@ -68,11 +78,11 @@ def router_topk(
 
     remaining = gates
     counts = jnp.zeros((E,), jnp.int32)
-    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
     gate_sum = jnp.zeros((T,), jnp.float32)
-    combine = jnp.zeros((T, E, capacity), jnp.float32)
     first_choice = None
     dropped = jnp.zeros((), jnp.float32)
+    slot_ids = []
+    gate_rounds = []
 
     for _ in range(k):
         choice = jnp.argmax(remaining, axis=-1)                    # (T,)
@@ -93,19 +103,18 @@ def router_topk(
             pos = (jnp.cumsum(onehot, axis=0) - 1.0) + counts[None, :]
             slot = jnp.sum(pos * onehot, axis=-1)                  # (T,)
         fits = slot < capacity
-        slot_oh = jax.nn.one_hot(jnp.where(fits, slot, capacity).astype(jnp.int32),
-                                 capacity, dtype=jnp.float32)      # (T, C) 0 row if dropped
-        d = onehot[:, :, None] * slot_oh[:, None, :]               # (T, E, C)
+        flat = choice.astype(jnp.int32) * capacity + slot.astype(jnp.int32)
+        slot_ids.append(jnp.where(fits, flat, E * capacity))
         gate_val = gate_round * fits                               # (T,)
-        dispatch = dispatch + d
-        combine = combine + gate_val[:, None, None] * d
+        gate_rounds.append(gate_val)
         gate_sum = gate_sum + gate_val
         counts = counts + jnp.sum(onehot * fits[:, None], axis=0).astype(jnp.int32)
         remaining = remaining * (1.0 - onehot)                     # mask chosen
         dropped = dropped + jnp.sum(1.0 - fits)
 
+    gates_out = jnp.stack(gate_rounds)                             # (k, T)
     if normalize_gates:
-        combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
+        gates_out = gates_out / jnp.maximum(gate_sum, 1e-9)[None, :]
 
     # Switch load balance over the FIRST choice (the dominant assignment):
     # fraction of tokens routed to e x mean router prob for e, scaled by E.
@@ -117,7 +126,33 @@ def router_topk(
             logits.astype(jnp.float32), axis=-1) ** 2),
         "drop_fraction": dropped / float(T * k),
     }
-    return dispatch, combine, aux
+    return jnp.stack(slot_ids), gates_out, aux
+
+
+def router_topk(
+    logits: jax.Array,
+    capacity: int,
+    k: int = 2,
+    *,
+    normalize_gates: bool = True,
+    priority: str = "gate",
+) -> Tuple[jax.Array, jax.Array, dict]:
+    """Dense (GShard-mask) form of :func:`router_topk_sparse`: returns
+    ``(dispatch (T, E, C) one-hot, combine (T, E, C) gate-weighted, aux)``.
+    O(T·E·C) memory — fine for tests/small routing, quadratic in tokens at
+    scale (prefer the sparse form `moe_layer` uses)."""
+    T, E = logits.shape
+    slot_ids, gates, aux = router_topk_sparse(
+        logits, capacity, k, normalize_gates=normalize_gates,
+        priority=priority)
+    dispatch = jnp.zeros((T, E * capacity + 1), jnp.float32)
+    combine = jnp.zeros((T, E * capacity + 1), jnp.float32)
+    rows = jnp.arange(T)
+    for r in range(slot_ids.shape[0]):
+        dispatch = dispatch.at[rows, slot_ids[r]].add(1.0)
+        combine = combine.at[rows, slot_ids[r]].add(gates[r])
+    return (dispatch[:, :-1].reshape(T, E, capacity),
+            combine[:, :-1].reshape(T, E, capacity), aux)
 
 
 @dataclasses.dataclass
@@ -182,11 +217,20 @@ def moe_layer(
     capacity = max(1, int(capacity_factor * k * T / E))
 
     logits = xt.astype(jnp.float32) @ params["router"].astype(jnp.float32)
-    dispatch, combine, aux = router_topk(
+    slot_ids, gates, aux = router_topk_sparse(
         logits, capacity, k, normalize_gates=normalize_gates,
         priority=priority)
 
-    expert_in = jnp.einsum("td,tec->ecd", xt, dispatch.astype(xt.dtype))  # (E, C, d)
+    # Dispatch as an O(T·d) row scatter into (E·C + 1, d) — the last row
+    # is the dump slot dropped assignments write into. Slot ids are unique
+    # across rounds (counts carry over), so `.set` semantics hold; `.add`
+    # keeps the dump row well-defined. The GShard one-hot einsum this
+    # replaces materialized (T, E, C) masks — quadratic in tokens and 5×
+    # the expert FFN's FLOPs at flagship scale (PERF.md r3).
+    buf = jnp.zeros((E * capacity + 1, d), xt.dtype)
+    for r in range(k):
+        buf = buf.at[slot_ids[r]].add(xt)
+    expert_in = buf[:-1].reshape(E, capacity, d)
 
     if axis_name:
         # (E, C, d) -> (ep, e_local, C, d) -> a2a -> (e_local, ep*C, d):
@@ -202,5 +246,12 @@ def moe_layer(
     else:
         expert_out = _expert_ffn(params, expert_in)
 
-    y = jnp.einsum("ecd,tec->td", expert_out, combine.astype(xt.dtype))
+    # Combine as a gather: y_t = Σ_r gate_r(t) · expert_out[slot_r(t)]
+    # (the dump row contributes with gate 0 — masked anyway for safety).
+    flat_out = jnp.concatenate(
+        [expert_out.reshape(E * capacity, d),
+         jnp.zeros((1, d), expert_out.dtype)], 0)
+    y = jnp.zeros_like(xt)
+    for r in range(k):
+        y = y + gates[r][:, None].astype(xt.dtype) * flat_out[slot_ids[r]]
     return y.reshape(*lead, d), aux

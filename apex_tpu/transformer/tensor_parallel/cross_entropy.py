@@ -65,7 +65,10 @@ def _vce_fwd(logits, target, label_smoothing, axis_name, impl="auto"):
     for d in logits.shape[:-1]:
         n *= d
     vocab = per * (1 if axis_name is None else jax.lax.axis_size(axis_name))
-    use_kernel = _backend.choose_impl(impl, _xk.shapes_ok(n, per)) == "pallas"
+    # the Mosaic dialect has no f16: strict-fp16 logits take the jnp path
+    use_kernel = _backend.choose_impl(
+        impl, _xk.shapes_ok(n, per) and logits.dtype != jnp.float16
+    ) == "pallas"
     if use_kernel:
         # One blockwise pass over the bf16/fp32 logits gives the per-row
         # (max, exp-sum, target-logit, row-sum) stats without the full-size

@@ -76,7 +76,7 @@ def discriminator(p, x):
     return jax.nn.sigmoid(h @ p["fc"])[:, 0]
 
 
-def main():
+def parse_args(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--dataset", default="fake", help="fake: synthetic data")
     parser.add_argument("--batchSize", type=int, default=64)
@@ -90,9 +90,12 @@ def main():
     parser.add_argument("--beta1", type=float, default=0.5)
     parser.add_argument("--manualSeed", type=int, default=2809)
     parser.add_argument("--opt_level", default="O1")
-    args = parser.parse_args()
-    print(args)
+    return parser.parse_args(argv)
 
+
+def train(args, verbose: bool = True):
+    """Run the example; returns the L1 record (per-step D/G loss curves +
+    final dynamic scales) for the test tier."""
     policy = amp.get_policy(args.opt_level)
     key = jr.PRNGKey(args.manualSeed)
     netG = init_generator(jr.fold_in(key, 0), args.nz, args.ngf, args.imageSize)
@@ -146,6 +149,8 @@ def main():
             mG = amp.apply_updates_with_master(mG, upG, grads_finite=fg)
         return mG, mD, stG, stD, s0, s1, s2, lr_ + lf_, lg_
 
+    rec = {"iteration": [], "loss_d": [], "loss_g": []}
+    it = 0
     for epoch in range(args.niter):
         for i in range(args.iters_per_epoch):
             k = jr.fold_in(key, epoch * 10000 + i)
@@ -159,11 +164,25 @@ def main():
             (mG, mD, stG, stD, scalers[0], scalers[1], scalers[2],
              lossD, lossG) = train_step(
                 mG, mD, stG, stD, *scalers, x, z)
-        print(f"[{epoch}/{args.niter}] Loss_D: {float(lossD):.4f} "
-              f"Loss_G: {float(lossG):.4f} "
-              f"scale: {float(scalers[0].loss_scale):.0f}")
+            rec["iteration"].append(it)
+            rec["loss_d"].append(float(lossD))
+            rec["loss_g"].append(float(lossG))
+            it += 1
+        if verbose:
+            print(f"[{epoch}/{args.niter}] Loss_D: {float(lossD):.4f} "
+                  f"Loss_G: {float(lossG):.4f} "
+                  f"scale: {float(scalers[0].loss_scale):.0f}")
 
     assert jnp.isfinite(lossD) and jnp.isfinite(lossG)
+    rec["skipped_steps"] = sum(int(s.skipped_steps) for s in scalers)
+    rec["final_scales"] = [float(s.loss_scale) for s in scalers]
+    return rec
+
+
+def main():
+    args = parse_args()
+    print(args)
+    train(args)
     print("done")
 
 

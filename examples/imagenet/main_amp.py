@@ -31,10 +31,12 @@ from apex_tpu.ops import softmax_cross_entropy_loss
 from apex_tpu.parallel import mesh as mesh_lib
 
 
-def parse_args():
+def parse_args(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--opt-level", default="O2", choices=["O0", "O1", "O2", "O3"])
     p.add_argument("--loss-scale", default=None)
+    p.add_argument("--keep-batchnorm-fp32", default=None,
+                   choices=[None, "True", "False"])
     p.add_argument("--sync-bn", action="store_true")
     p.add_argument("--batch-size", type=int, default=256, help="global batch")
     p.add_argument("--image-size", type=int, default=224)
@@ -44,15 +46,23 @@ def parse_args():
     p.add_argument("--weight-decay", type=float, default=1e-4)
     p.add_argument("--iters", type=int, default=100)
     p.add_argument("--synthetic", action="store_true")
+    p.add_argument("--deterministic", action="store_true",
+                   help="fixed seeds + fresh deterministic batch per iter; "
+                        "records the per-iteration loss curve (the "
+                        "reference L1 tier's --deterministic contract)")
     p.add_argument("--label-smoothing", type=float, default=0.0)
-    return p.parse_args()
+    return p.parse_args(argv)
 
 
-def main():
-    args = parse_args()
+def train(args):
+    """Run the example; returns the L1 record dict (per-iteration losses,
+    skipped steps, throughput) — importable by the test tier the way the
+    reference's run_test.sh shells out to main_amp.py --deterministic."""
     mesh = mesh_lib.initialize_model_parallel()
     dp = mesh_lib.get_data_parallel_world_size()
-    policy = amp.get_policy(args.opt_level)
+    kn = (None if args.keep_batchnorm_fp32 is None
+          else args.keep_batchnorm_fp32 == "True")
+    policy = amp.get_policy(args.opt_level, keep_norm_f32=kn)
     print(f"devices={jax.device_count()} dp={dp} opt_level={args.opt_level} "
           f"sync_bn={args.sync_bn} global_batch={args.batch_size}")
 
@@ -75,7 +85,11 @@ def main():
 
     def train_step(master, bn_state, opt_state, scaler, x, y):
         def run(master, bn_state, opt_state, scaler, x, y):
-            x = x.astype(policy.compute_dtype)
+            # inputs follow the MODEL params' dtype (O0/O1 fp32 — O1's
+            # per-op tables cast at wrapped-op entry; O2/O3 half). Casting
+            # to compute_dtype under O1 would feed bf16 activations into
+            # fp32 raw convs — exactly the mismatch the L1 tier caught.
+            x = x.astype(policy.param_dtype)
             (loss, new_bn), (grads, finite, scaler) = amp.scaled_value_and_grad(
                 loss_fn, has_aux=True)(scaler, master.model, bn_state, x, y)
             grads = jax.lax.pmean(grads, "dp")
@@ -96,6 +110,29 @@ def main():
     b, s = args.batch_size, args.image_size
     x = jr.normal(key, (b, s, s, 3), jnp.float32)
     y = jr.randint(jr.fold_in(key, 1), (b,), 0, args.num_classes)
+
+    if args.deterministic:
+        # L1 mode: a FRESH deterministic batch each iteration (a real loss
+        # curve, not one batch memorized), losses recorded per iteration.
+        # Each class stamps a strong color-bias template on its images so
+        # the task is learnable in tens of iterations.
+        templates = jr.normal(jr.fold_in(key, 2),
+                              (args.num_classes, 1, 1, 3)) * 2.0
+        rec = {"iteration": [], "loss": []}
+        t0 = time.perf_counter()
+        for i in range(args.iters):
+            k = jr.fold_in(key, 100 + i)
+            y = jr.randint(k, (b,), 0, args.num_classes)
+            x = (jr.normal(jr.fold_in(k, 1), (b, s, s, 3), jnp.float32)
+                 + templates[y])
+            master, bn_state, opt_state, scaler, loss = step(
+                master, bn_state, opt_state, scaler, x, y)
+            rec["iteration"].append(i)
+            rec["loss"].append(float(loss))
+        dt = time.perf_counter() - t0
+        rec["skipped_steps"] = int(scaler.skipped_steps)
+        rec["img_per_s"] = args.iters * b / dt
+        return rec
 
     if args.synthetic:
         # warm TWICE: the first call compiles against the freshly-created
@@ -139,8 +176,22 @@ def main():
                 master, bn_state, opt_state, scaler, xb, yb)
     lv = float(loss)  # hard sync
     dt = time.perf_counter() - t0
-    print(f"loss {lv:.4f}  throughput {args.iters * b / dt:.1f} img/s "
-          f"({dt / args.iters * 1e3:.1f} ms/step)")
+    return {"loss": [lv], "img_per_s": args.iters * b / dt,
+            "ms_per_step": dt / args.iters * 1e3,
+            "skipped_steps": int(scaler.skipped_steps)}
+
+
+def main():
+    args = parse_args()
+    rec = train(args)
+    if args.deterministic:
+        print(f"final loss {rec['loss'][-1]:.4f}  "
+              f"skipped {rec['skipped_steps']}  "
+              f"{rec['img_per_s']:.1f} img/s")
+    else:
+        print(f"loss {rec['loss'][-1]:.4f}  throughput "
+              f"{rec['img_per_s']:.1f} img/s "
+              f"({rec['ms_per_step']:.1f} ms/step)")
 
 
 if __name__ == "__main__":

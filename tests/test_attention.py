@@ -183,6 +183,139 @@ class TestGroupedQueryAttention:
         bound = float(jnp.max(jnp.abs(dk32))) * 2 ** -8
         assert float(err) <= bound * 1.5, (float(err), bound)
 
+    @pytest.mark.pallas
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("h,kv_heads,d", [(4, 4, 128), (4, 2, 128),
+                                              (4, 1, 128), (1, 1, 64)])
+    def test_bshd_layout_kernels_match_dense(self, causal, h, kv_heads, d,
+                                             monkeypatch):
+        """Seq-major (b, s, h, d) kernels — the zero-layout-copy path the
+        flagship uses — fwd + grads against the dense oracle, incl. GQA.
+        Shapes restricted to the folded-layout tiling rule: d must tile
+        128 lanes itself (d=64 only single-head) — see bshd_kernel_ok."""
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        b, s = 2, 256
+        q = jr.normal(K, (b, s, h, d))
+        k = jr.normal(jr.fold_in(K, 13), (b, s, kv_heads, d))
+        v = jr.normal(jr.fold_in(K, 14), (b, s, kv_heads, d))
+        rep = h // kv_heads
+
+        def dense(q, k, v):
+            # oracle in (b, h, s, d) with repeated kv
+            t = lambda x: x.transpose(0, 2, 1, 3)
+            return t(dense_ref(t(q), jnp.repeat(t(k), rep, 1),
+                               jnp.repeat(t(v), rep, 1), causal))
+
+        with jax.default_matmul_precision("highest"):
+            o = flash_attention(q, k, v, causal=causal, layout="bshd",
+                                impl="pallas")
+            np.testing.assert_allclose(o, dense(q, k, v), rtol=2e-5,
+                                       atol=2e-5)
+
+            f1 = lambda q, k, v: jnp.sum(jnp.cos(flash_attention(
+                q, k, v, causal=causal, layout="bshd", impl="pallas")))
+            f2 = lambda q, k, v: jnp.sum(jnp.cos(dense(q, k, v)))
+            g1 = jax.grad(f1, argnums=(0, 1, 2))(q, k, v)
+            g2 = jax.grad(f2, argnums=(0, 1, 2))(q, k, v)
+        for a, e in zip(g1, g2):
+            np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_bshd_xla_fallback_matches_dense(self, causal):
+        """Below the crossover the bshd entry runs the XLA composition."""
+        b, h, s, d = 2, 4, 32, 16
+        q = jr.normal(K, (b, s, h, d))
+        k = jr.normal(jr.fold_in(K, 15), (b, s, 2, d))
+        v = jr.normal(jr.fold_in(K, 16), (b, s, 2, d))
+        t = lambda x: x.transpose(0, 2, 1, 3)
+        o = flash_attention(q, k, v, causal=causal, layout="bshd")
+        ref = t(dense_ref(t(q), jnp.repeat(t(k), 2, 1),
+                          jnp.repeat(t(v), 2, 1), causal))
+        np.testing.assert_allclose(o, ref, rtol=RTOL, atol=ATOL)
+        g = jax.grad(lambda q: jnp.sum(flash_attention(
+            q, k, v, causal=causal, layout="bshd") ** 2))(q)
+        gref = jax.grad(lambda q: jnp.sum(t(dense_ref(
+            t(q), jnp.repeat(t(k), 2, 1), jnp.repeat(t(v), 2, 1),
+            causal)) ** 2))(q)
+        np.testing.assert_allclose(g, gref, rtol=G_RTOL, atol=G_ATOL)
+
+    def test_bshd_rejects_kv_lens_and_bad_rank(self):
+        q = jr.normal(K, (2, 32, 4, 16))
+        with pytest.raises(NotImplementedError, match="kv_lens"):
+            flash_attention(q, q, q, layout="bshd",
+                            kv_lens=jnp.ones((2, 4), jnp.int32))
+        with pytest.raises(ValueError, match="bshd"):
+            flash_attention(q.reshape(8, 32, 16), q.reshape(8, 32, 16),
+                            q.reshape(8, 32, 16), layout="bshd")
+
+    def test_bshd_eligibility_rule(self):
+        """The folded layout's d-wide blocks must tile 128 lanes — d=64
+        multi-head configs are NOT kernel-eligible (would fail Mosaic's
+        trailing-tile rule on hardware; caught by review r3)."""
+        from apex_tpu.ops.attention import bshd_kernel_ok
+
+        assert bshd_kernel_ok(1024, 1024, 8, 128, jnp.bfloat16)
+        assert bshd_kernel_ok(1024, 1024, 1, 64, jnp.bfloat16)
+        assert not bshd_kernel_ok(1024, 1024, 8, 64, jnp.bfloat16)
+        assert not bshd_kernel_ok(1000, 1024, 8, 128, jnp.bfloat16)
+        assert not bshd_kernel_ok(1024, 1024, 8, 128, jnp.float16)
+        # d=64 multi-head with explicit pallas raises rather than lowering
+        q = jr.normal(K, (2, 256, 4, 64))
+        with pytest.raises(ValueError, match="tiling"):
+            flash_attention(q, q, q, layout="bshd", impl="pallas")
+
+    @pytest.mark.pallas
+    @pytest.mark.parametrize("kv_heads", [4, 2])
+    def test_fused_qkv_attention_matches_composition(self, kv_heads,
+                                                     monkeypatch):
+        """The flagship's zero-layout-copy block (packed projection →
+        window-reading kernels → output GEMM, hand-written VJP): forward
+        and EVERY cotangent (x, packed weight, packed bias, out weight)
+        against the composed einsum+dense formulation."""
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        from apex_tpu.ops.attention import fused_qkv_attention
+
+        b, s, H, h, d = 2, 256, 64, 4, 16
+        hkv = kv_heads
+        G = h + 2 * hkv
+        key = jr.fold_in(K, 31)
+        x = jr.normal(key, (b, s, H))
+        w_qkv = jr.normal(jr.fold_in(key, 1), (G * d, H)) * 0.1
+        b_qkv = jr.normal(jr.fold_in(key, 2), (G * d,)) * 0.1
+        w_out = jr.normal(jr.fold_in(key, 3), (H, h * d)) * 0.1
+        scale = 1.0 / d ** 0.5
+
+        def composed(x, w_qkv, b_qkv, w_out):
+            qkv = jnp.einsum("bsH,FH->bsF", x, w_qkv) + b_qkv
+            qkv = qkv.reshape(b, s, G, d)
+            t = lambda z: z.transpose(0, 2, 1, 3)
+            q, k, v = (t(qkv[:, :, :h]), t(qkv[:, :, h:h + hkv]),
+                       t(qkv[:, :, h + hkv:]))
+            rep = h // hkv
+            o = dense_ref(q, jnp.repeat(k, rep, 1), jnp.repeat(v, rep, 1),
+                          True, scale)
+            return jnp.einsum("bhsd,Hhd->bsH", o,
+                              w_out.reshape(H, h, d))
+
+        def fused(x, w_qkv, b_qkv, w_out):
+            return fused_qkv_attention(x, w_qkv, b_qkv, w_out, h, hkv, d,
+                                       scale, True)
+
+        with jax.default_matmul_precision("highest"):
+            y1 = fused(x, w_qkv, b_qkv, w_out)
+            y2 = composed(x, w_qkv, b_qkv, w_out)
+            np.testing.assert_allclose(y1, y2, rtol=2e-5, atol=2e-5)
+
+            loss1 = lambda *a: jnp.sum(jnp.sin(fused(*a)))
+            loss2 = lambda *a: jnp.sum(jnp.sin(composed(*a)))
+            g1 = jax.grad(loss1, argnums=(0, 1, 2, 3))(
+                x, w_qkv, b_qkv, w_out)
+            g2 = jax.grad(loss2, argnums=(0, 1, 2, 3))(
+                x, w_qkv, b_qkv, w_out)
+        for a, e, name in zip(g1, g2, ("dx", "dw_qkv", "db_qkv", "dw_out")):
+            np.testing.assert_allclose(a, e, rtol=3e-4, atol=3e-4,
+                                       err_msg=name)
+
     def test_causal_sq_gt_sk_raises(self):
         """ADVICE r2: bottom-right causal with sq > sk has rows attending
         nothing — reject instead of emitting exp(0) garbage."""

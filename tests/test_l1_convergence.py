@@ -39,13 +39,20 @@ def _cells():
                 yield o, kn, ls
 
 
-def _baseline(model):
-    path = os.path.join(BASELINE_DIR, f"{model}_O0.json")
+import jax  # noqa: E402
+
+_ON_CPU = jax.default_backend() == "cpu"
+
+
+def _baseline(model, opt_level="O0"):
+    path = os.path.join(BASELINE_DIR, f"{model}_{opt_level}.json")
+    if not os.path.exists(path):
+        return None
     with open(path) as f:
         return json.load(f)
 
 
-def _check_against_fp32(rec, base, half: bool):
+def _check_against_fp32(rec, base, half: bool, cell_base=None):
     losses = np.asarray(rec["loss"])
     ref = np.asarray(base["loss"])
     assert np.all(np.isfinite(losses)), "loss diverged to non-finite"
@@ -53,21 +60,30 @@ def _check_against_fp32(rec, base, half: bool):
     if not half:
         # fp32 configs must reproduce the committed baseline closely
         np.testing.assert_allclose(losses, ref, rtol=2e-3, atol=2e-4)
-    else:
-        # bf16 curves track the fp32 baseline: point-wise within a loose
-        # envelope and the training signal (net loss decrease) preserved
-        denom = np.maximum(np.abs(ref), 0.05)
-        assert np.max(np.abs(losses - ref) / denom) < 0.35, (
-            f"curve diverged from fp32 baseline: {losses} vs {ref}"
-        )
-        assert losses[-1] < losses[0] * 0.9, "no convergence"
+        return
+    if cell_base is not None and _ON_CPU:
+        # per-cell committed curve: deterministic on the same platform, so
+        # the comparison is TIGHT — a subtly wrong O2 master-weight update
+        # moves the curve far beyond this (the r2 envelope could hide it)
+        np.testing.assert_allclose(
+            losses, np.asarray(cell_base["loss"]), rtol=5e-3, atol=5e-4)
+    # bf16 curves track the fp32 baseline: point-wise within an envelope
+    # and the training signal (net loss decrease) preserved
+    denom = np.maximum(np.abs(ref), 0.05)
+    assert np.max(np.abs(losses - ref) / denom) < 0.25, (
+        f"curve diverged from fp32 baseline: {losses} vs {ref}"
+    )
+    assert losses[-1] < losses[0] * 0.9, "no convergence"
 
 
 @pytest.mark.parametrize("opt_level,keep_norm,loss_scale", list(_cells()),
                          ids=lambda v: str(v))
 def test_mlp_cross_product(opt_level, keep_norm, loss_scale):
     rec = l1_harness.run_config("mlp", opt_level, keep_norm, loss_scale)
-    _check_against_fp32(rec, _baseline("mlp"), half=opt_level != "O0")
+    cell = (_baseline("mlp", opt_level)
+            if (keep_norm, loss_scale) == (None, "dynamic") else None)
+    _check_against_fp32(rec, _baseline("mlp"), half=opt_level != "O0",
+                        cell_base=cell)
 
 
 @pytest.mark.parametrize("opt_level", OPT_LEVELS)
@@ -75,7 +91,35 @@ def test_cnn_opt_levels(opt_level):
     # conv+SyncBN model over the dp=8 mesh (the ResNet-50 stand-in); full
     # keep_norm/loss_scale product exercised on the MLP above
     rec = l1_harness.run_config("cnn", opt_level, None, "dynamic")
-    _check_against_fp32(rec, _baseline("cnn"), half=opt_level != "O0")
+    _check_against_fp32(rec, _baseline("cnn"), half=opt_level != "O0",
+                        cell_base=_baseline("cnn", opt_level))
+
+
+@pytest.mark.parametrize("model", ["mlp", "cnn"])
+def test_fp16_strict_cell(model):
+    """VERDICT r2 item 8: the strict-fp16 path (half_dtype=float16 +
+    dynamic scaler) as an L1 cell — exercises the overflow skip/recover
+    machinery at training scale, not just scaler unit tests. fp16's 5-bit
+    exponent makes early overflows likely at the 2^16 initial scale; the
+    scaler must back off and the curve still track fp32."""
+    import jax.numpy as jnp
+
+    rec = l1_harness.run_config(model, "O2", None, "dynamic",
+                                half_dtype=jnp.float16)
+    losses = np.asarray(rec["loss"])
+    assert np.all(np.isfinite(losses))
+    # skips allowed (that's the mechanism) but bounded: recovery must work
+    assert rec["skipped_steps"] <= 6, rec["skipped_steps"]
+    ref = np.asarray(_baseline(model)["loss"])
+    denom = np.maximum(np.abs(ref), 0.05)
+    # wider envelope than the bf16 cells: the scaler's initial 2^16 scale
+    # overflows fp16's 5-bit exponent on the first step(s); each skip
+    # delays an update and the offset compounds through adam's moments, so
+    # the curve runs parallel-but-shifted to fp32 (measured max relative
+    # gap ~0.31). The cell's contract is skip/recover + convergence, both
+    # asserted hard above/below
+    assert np.max(np.abs(losses - ref) / denom) < 0.45, (losses, ref)
+    assert losses[-1] < losses[0] * 0.9, "no convergence"
 
 
 def test_o0_matches_committed_baseline_exactly():
@@ -101,3 +145,11 @@ def test_regenerate_baselines():
         with open(os.path.join(BASELINE_DIR, f"{model}_O0.json"), "w") as f:
             json.dump(rec, f, indent=1)
         print(f"wrote {model}_O0.json  final loss {rec['loss'][-1]:.5f}")
+        # per-cell half-precision curves (default kn, dynamic scale): the
+        # tight same-platform comparison targets
+        for o in ("O1", "O2", "O3"):
+            rec = l1_harness.run_config(model, o, None, "dynamic")
+            with open(os.path.join(BASELINE_DIR, f"{model}_{o}.json"),
+                      "w") as f:
+                json.dump(rec, f, indent=1)
+            print(f"wrote {model}_{o}.json  final loss {rec['loss'][-1]:.5f}")

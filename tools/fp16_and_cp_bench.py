@@ -1,0 +1,143 @@
+"""Hardware rows for PERF.md (r3):
+
+1. fp16-strict flagship variant: the GPT-medium bench step under
+   half_dtype=float16 with fp32 master weights + the DYNAMIC loss scaler —
+   the scaler's skip/recover path at training scale on the real chip, plus
+   the throughput cost vs bf16.
+2. ring vs Ulysses context parallelism at seq >= 8192 — single-chip
+   kernel-path timing (the collectives are identity at cp=1, so this
+   isolates the compute formulations; cross-device parity is covered by
+   the cp=4 CPU-mesh tests and the driver gate).
+
+Run: python tools/fp16_and_cp_bench.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+
+def fp16_flagship():
+    import optax
+
+    from apex_tpu import amp
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.optimizers import fused_adam
+
+    cfg = GPTConfig(vocab_size=32768, max_seq_len=1024, hidden_size=1024,
+                    num_layers=12, num_heads=8, remat=False,
+                    attention_impl="flash", scan_layers=False)
+    model = GPTModel(cfg)
+    policy = amp.get_policy("O2", half_dtype=jnp.float16)
+    params = model.init(jr.PRNGKey(0))
+    master = amp.MasterWeights.create(params, policy)
+    opt = amp.skip_step_if_nonfinite(fused_adam(learning_rate=1e-4))
+    opt_state = opt.init(master.master)
+    scaler = amp.init_loss_scaler("dynamic")
+    batch, seq = 16, 1024
+    tokens = jr.randint(jr.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
+    targets = jr.randint(jr.PRNGKey(2), (batch, seq), 0, cfg.vocab_size)
+
+    def loss_fn(p, tokens, targets):
+        return model.loss_fn(p, tokens, targets)
+
+    def step(master, opt_state, scaler, tokens, targets):
+        loss, (grads, finite, scaler) = amp.scaled_value_and_grad(loss_fn)(
+            scaler, master.model, tokens, targets)
+        updates, opt_state = opt.update(grads, opt_state, master.master)
+        master = amp.apply_updates_with_master(
+            master, updates, grads_finite=finite)
+        return master, opt_state, scaler, loss
+
+    f = jax.jit(step, donate_argnums=(0, 1))
+    scales = []
+    master, opt_state, scaler, loss = f(master, opt_state, scaler, tokens,
+                                        targets)
+    master, opt_state, scaler, loss = f(master, opt_state, scaler, tokens,
+                                        targets)
+    float(loss)
+    t0 = time.perf_counter()
+    iters = 20
+    for i in range(iters):
+        master, opt_state, scaler, loss = f(master, opt_state, scaler,
+                                            tokens, targets)
+        if i % 5 == 0:
+            scales.append(float(scaler.loss_scale))
+    lv = float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    # note: the in-loop scale fetches sync the chain; re-time clean
+    master, opt_state, scaler, loss = f(master, opt_state, scaler, tokens,
+                                        targets)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        master, opt_state, scaler, loss = f(master, opt_state, scaler,
+                                            tokens, targets)
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"fp16-strict flagship: {batch * seq / dt:,.0f} tok/s "
+          f"({dt * 1e3:.1f} ms/step)  loss={lv:.3f}  "
+          f"skipped={int(scaler.skipped_steps)}  "
+          f"scale trajectory={scales} -> {float(scaler.loss_scale):.0f}")
+
+
+def cp_long_seq():
+    from apex_tpu.ops.attention import (flash_attention, ring_attention,
+                                        ulysses_attention, zigzag_shard)
+    from apex_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.initialize_model_parallel()  # 1 chip: cp=1 identity
+    b, h, s, d = 4, 8, 8192, 128
+
+    q = jr.normal(jr.PRNGKey(3), (b * h, s, d), jnp.bfloat16)
+
+    def time_fn(f, *args):
+        g = jax.jit(lambda *a: jnp.sum(
+            jax.grad(lambda *aa: jnp.sum(f(*aa).astype(jnp.float32)))(
+                *a).astype(jnp.float32)))
+        g(*args)
+        x = g(*args)
+        float(x)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            x = g(*args)
+        float(x)
+        return (time.perf_counter() - t0) / 5 * 1e3
+
+    t_flash = time_fn(lambda q: flash_attention(q, q, q, causal=True), q)
+
+    from jax.sharding import PartitionSpec as P
+    qz = zigzag_shard(q, 1, 1)
+
+    def ring(qq):
+        return mesh_lib.shard_map(
+            lambda q: ring_attention(q, q, q, causal=True),
+            mesh=mesh, in_specs=P(None, "cp"), out_specs=P(None, "cp"),
+        )(qq)
+
+    t_ring = time_fn(ring, qz)
+
+    q4 = q.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    def uly(qq):
+        return mesh_lib.shard_map(
+            lambda q: ulysses_attention(q, q, q, causal=True),
+            mesh=mesh, in_specs=P(None, "cp"), out_specs=P(None, "cp"),
+        )(qq)
+
+    t_uly = time_fn(uly, q4)
+    print(f"seq {s} fwd+bwd (bh={b * h}, d={d}, 1 chip): "
+          f"flash {t_flash:.1f} ms  ring(cp=1) {t_ring:.1f} ms  "
+          f"ulysses(cp=1) {t_uly:.1f} ms")
+    mesh_lib.destroy_model_parallel()
+
+
+if __name__ == "__main__":
+    fp16_flagship()
+    cp_long_seq()
