@@ -535,11 +535,7 @@ class GPTModel:
         losses = tp_lib.vocab_parallel_cross_entropy(
             logits, targets, axis_name=self.axis
         )
-        if loss_mask is None:
-            loss = jnp.mean(losses)
-        else:
-            m = loss_mask.astype(losses.dtype)
-            loss = jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)
+        loss = tp_lib.masked_mean(losses, loss_mask)
         if self.moe:
             c = self.config
             loss = (loss + c.moe_aux_coeff * aux["load_balance_loss"]

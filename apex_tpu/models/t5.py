@@ -1,0 +1,382 @@
+"""T5-class encoder-decoder transformer (seq2seq flagship for the
+split-rank pipeline).
+
+The reference ships standalone GPT/BERT test models
+(``apex/transformer/testing/standalone_gpt.py``, ``standalone_bert.py``)
+and carries encoder-decoder *plumbing* (``ModelType.encoder_and_decoder``,
+the pipeline split rank, ``parallel_state.py:147-149``) but no
+encoder-decoder model to drive it. This fills that hole TPU-first:
+
+* pre-LN encoder blocks (bidirectional self-attention + MLP);
+* pre-LN decoder blocks (causal self-attention → cross-attention over the
+  encoder output → MLP);
+* learned positions, tied embedding/unembedding shared by both sides,
+  vocab-parallel cross entropy on the decoder output;
+* attention through :func:`~apex_tpu.ops.attention.flash_attention`
+  (``attention_impl='flash'``) or the fused-softmax composition;
+* :class:`EncDecPipeline` partitions the stacks over a two-segment
+  pipeline — stages ``[0, split)`` hold encoder layers, ``[split, pp)``
+  decoder layers — driving
+  :func:`~apex_tpu.transformer.pipeline_parallel.pipeline_spmd_forward_enc_dec`
+  with the REAL model (the depth standard ``GPTPipeline`` set for GPT).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import fused_layer_norm, scaled_masked_softmax
+from apex_tpu.ops.attention import flash_attention
+from apex_tpu.transformer import tensor_parallel as tp_lib
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    max_seq_len: int = 512
+    hidden_size: int = 512
+    ffn_hidden_size: Optional[int] = None  # default 4*hidden
+    num_encoder_layers: int = 6
+    num_decoder_layers: int = 6
+    num_heads: int = 8
+    dtype: Any = jnp.float32
+    attention_impl: str = "softmax"  # softmax | flash
+    remat: bool = True
+
+    def __post_init__(self):
+        if self.attention_impl not in ("softmax", "flash"):
+            raise ValueError(
+                f"attention_impl must be softmax|flash, got "
+                f"{self.attention_impl!r}")
+
+    @property
+    def ffn(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        return divide(self.hidden_size, self.num_heads)
+
+
+def _dense(key, shape, dtype, scale=None):
+    fan_in = shape[-1]
+    s = scale if scale is not None else (1.0 / fan_in) ** 0.5
+    return jax.random.normal(key, shape, dtype) * s
+
+
+class EncoderDecoderModel:
+    """Functional T5-class model. ``init(key)`` → params;
+    ``loss_fn(params, enc_tokens, dec_tokens, targets)`` → mean CE of the
+    decoder output (teacher forcing: ``dec_tokens`` is the shifted-right
+    target stream)."""
+
+    def __init__(self, config: T5Config):
+        self.config = config
+
+    # --- params ---------------------------------------------------------------
+
+    def init(self, key):
+        c = self.config
+        H, F = c.hidden_size, c.ffn
+
+        def enc_layer(k):
+            ks = jax.random.split(k, 4)
+            return {
+                "ln1_w": jnp.ones((H,), c.dtype),
+                "ln1_b": jnp.zeros((H,), c.dtype),
+                "qkv": _dense(ks[0], (3 * H, H), c.dtype),
+                "attn_out": _dense(ks[1], (H, H), c.dtype),
+                "ln2_w": jnp.ones((H,), c.dtype),
+                "ln2_b": jnp.zeros((H,), c.dtype),
+                "mlp_up": _dense(ks[2], (F, H), c.dtype),
+                "mlp_down": _dense(ks[3], (H, F), c.dtype),
+            }
+
+        def dec_layer(k):
+            ks = jax.random.split(k, 7)
+            return {
+                "ln1_w": jnp.ones((H,), c.dtype),
+                "ln1_b": jnp.zeros((H,), c.dtype),
+                "qkv": _dense(ks[0], (3 * H, H), c.dtype),
+                "attn_out": _dense(ks[1], (H, H), c.dtype),
+                "ln_x_w": jnp.ones((H,), c.dtype),
+                "ln_x_b": jnp.zeros((H,), c.dtype),
+                "xq": _dense(ks[2], (H, H), c.dtype),
+                "xkv": _dense(ks[3], (2 * H, H), c.dtype),
+                "x_out": _dense(ks[4], (H, H), c.dtype),
+                "ln2_w": jnp.ones((H,), c.dtype),
+                "ln2_b": jnp.zeros((H,), c.dtype),
+                "mlp_up": _dense(ks[5], (F, H), c.dtype),
+                "mlp_down": _dense(ks[6], (H, F), c.dtype),
+            }
+
+        keys = jax.random.split(key, c.num_encoder_layers
+                                + c.num_decoder_layers + 2)
+        enc = [enc_layer(keys[i]) for i in range(c.num_encoder_layers)]
+        dec = [dec_layer(keys[c.num_encoder_layers + i])
+               for i in range(c.num_decoder_layers)]
+        return {
+            "embedding": _dense(keys[-2], (c.vocab_size, H), c.dtype,
+                                scale=1.0),
+            "pos_embedding": jax.random.normal(
+                keys[-1], (c.max_seq_len, H), c.dtype) * 0.01,
+            "encoder": jax.tree.map(lambda *x: jnp.stack(x), *enc),
+            "decoder": jax.tree.map(lambda *x: jnp.stack(x), *dec),
+            "ln_enc_w": jnp.ones((H,), c.dtype),
+            "ln_enc_b": jnp.zeros((H,), c.dtype),
+            "ln_dec_w": jnp.ones((H,), c.dtype),
+            "ln_dec_b": jnp.zeros((H,), c.dtype),
+        }
+
+    # --- attention pieces -----------------------------------------------------
+
+    def _heads(self, x):
+        b, s, _ = x.shape
+        c = self.config
+        return x.reshape(b, s, c.num_heads, c.head_dim).transpose(0, 2, 1, 3)
+
+    def _merge(self, x):
+        b, h, s, d = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+    def _attn(self, q, k, v, causal):
+        c = self.config
+        if c.attention_impl == "flash":
+            return flash_attention(q, k, v, causal=causal)
+        d = q.shape[-1]
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+        b, h, sq, sk = scores.shape
+        if causal:
+            mask = ~jnp.tril(jnp.ones((sq, sk), bool))
+            probs = scaled_masked_softmax(
+                scores, mask[None, None], 1.0 / float(d) ** 0.5)
+        else:
+            probs = scaled_masked_softmax(scores, None, 1.0 / float(d) ** 0.5)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+    # --- blocks ---------------------------------------------------------------
+
+    def encoder_block(self, p, x):
+        h = fused_layer_norm(x, p["ln1_w"], p["ln1_b"])
+        q, k, v = jnp.split(h @ p["qkv"].T, 3, -1)
+        a = self._merge(self._attn(self._heads(q), self._heads(k),
+                                   self._heads(v), False))
+        x = x + a @ p["attn_out"].T
+        h = fused_layer_norm(x, p["ln2_w"], p["ln2_b"])
+        return x + jax.nn.gelu(h @ p["mlp_up"].T,
+                               approximate=True) @ p["mlp_down"].T
+
+    def decoder_block(self, p, x, enc_out):
+        h = fused_layer_norm(x, p["ln1_w"], p["ln1_b"])
+        q, k, v = jnp.split(h @ p["qkv"].T, 3, -1)
+        a = self._merge(self._attn(self._heads(q), self._heads(k),
+                                   self._heads(v), True))
+        x = x + a @ p["attn_out"].T
+        h = fused_layer_norm(x, p["ln_x_w"], p["ln_x_b"])
+        q = h @ p["xq"].T
+        ck, cv = jnp.split(enc_out @ p["xkv"].T, 2, -1)
+        a = self._merge(self._attn(self._heads(q), self._heads(ck),
+                                   self._heads(cv), False))
+        x = x + a @ p["x_out"].T
+        h = fused_layer_norm(x, p["ln2_w"], p["ln2_b"])
+        return x + jax.nn.gelu(h @ p["mlp_up"].T,
+                               approximate=True) @ p["mlp_down"].T
+
+    def _wrapped(self, fn):
+        return jax.checkpoint(fn) if self.config.remat else fn
+
+    # --- forward --------------------------------------------------------------
+
+    def embed(self, params, tokens):
+        x = jnp.take(params["embedding"], tokens, axis=0)
+        return x + params["pos_embedding"][:tokens.shape[1]]
+
+    def encode(self, params, enc_tokens):
+        x = self.embed(params, enc_tokens)
+        block = self._wrapped(self.encoder_block)
+
+        def body(x, layer):
+            return block(layer, x), None
+
+        x, _ = jax.lax.scan(body, x, params["encoder"])
+        return fused_layer_norm(x, params["ln_enc_w"], params["ln_enc_b"])
+
+    def decode(self, params, dec_tokens, enc_out):
+        x = self.embed(params, dec_tokens)
+        block = self._wrapped(self.decoder_block)
+
+        def body(x, layer):
+            return block(layer, x, enc_out), None
+
+        x, _ = jax.lax.scan(body, x, params["decoder"])
+        return fused_layer_norm(x, params["ln_dec_w"], params["ln_dec_b"])
+
+    def logits(self, params, enc_tokens, dec_tokens):
+        enc_out = self.encode(params, enc_tokens)
+        x = self.decode(params, dec_tokens, enc_out)
+        return x @ params["embedding"].T  # tied unembedding
+
+    def loss_fn(self, params, enc_tokens, dec_tokens, targets,
+                loss_mask=None):
+        logits = self.logits(params, enc_tokens, dec_tokens)
+        losses = tp_lib.vocab_parallel_cross_entropy(
+            logits, targets, axis_name=None)
+        return tp_lib.masked_mean(losses, loss_mask)
+
+
+@dataclasses.dataclass
+class EncDecPipeline:
+    """Two-segment pipeline execution of :class:`EncoderDecoderModel`:
+    stages ``[0, split)`` hold encoder-layer slices, ``[split, pp)``
+    decoder-layer slices. Stage params carry the UNION structure (each
+    stage stores both segments' leaves; the unused one is dead weight —
+    program uniformity, cf. ``pipeline_parallel/encoder_decoder.py``).
+
+    ``partition(params)`` → ``{embed, stages, head}`` with stage leaves
+    leading ``(pp, ...)``; ``loss_and_grads`` runs inside shard_map with
+    the pp axis bound and returns the same loss as ``loss_fn`` on the
+    concatenated microbatches."""
+
+    model: EncoderDecoderModel
+    pp: int
+    split: int
+
+    def __post_init__(self):
+        c = self.model.config
+        if not (0 < self.split < self.pp):
+            raise ValueError(
+                f"split ({self.split}) must lie strictly inside the "
+                f"{self.pp}-stage pipeline")
+        if c.num_encoder_layers % self.split:
+            raise ValueError(
+                f"num_encoder_layers ({c.num_encoder_layers}) must divide "
+                f"over {self.split} encoder stages")
+        if c.num_decoder_layers % (self.pp - self.split):
+            raise ValueError(
+                f"num_decoder_layers ({c.num_decoder_layers}) must divide "
+                f"over {self.pp - self.split} decoder stages")
+
+    @property
+    def enc_per_stage(self):
+        return self.model.config.num_encoder_layers // self.split
+
+    @property
+    def dec_per_stage(self):
+        return self.model.config.num_decoder_layers // (self.pp - self.split)
+
+    def partition(self, params):
+        ne, nd = self.enc_per_stage, self.dec_per_stage
+        n_dec_stages = self.pp - self.split
+
+        def split_enc(x):  # (L_e, ...) -> (pp, ne, ...): pad decoder
+            y = x.reshape(self.split, ne, *x.shape[1:])
+            pad = jnp.zeros((n_dec_stages, ne) + x.shape[1:], x.dtype)
+            return jnp.concatenate([y, pad], 0)
+
+        def split_dec(x):  # (L_d, ...) -> (pp, nd, ...): pad encoder
+            y = x.reshape(n_dec_stages, nd, *x.shape[1:])
+            pad = jnp.zeros((self.split, nd) + x.shape[1:], x.dtype)
+            return jnp.concatenate([pad, y], 0)
+
+        return {
+            "embed": {"embedding": params["embedding"],
+                      "pos_embedding": params["pos_embedding"],
+                      "ln_enc_w": params["ln_enc_w"],
+                      "ln_enc_b": params["ln_enc_b"]},
+            "stages": {
+                "enc": jax.tree.map(split_enc, params["encoder"]),
+                "dec": jax.tree.map(split_dec, params["decoder"]),
+            },
+            "head": {"ln_dec_w": params["ln_dec_w"],
+                     "ln_dec_b": params["ln_dec_b"]},
+        }
+
+    def param_specs(self, pipe_params):
+        from jax.sharding import PartitionSpec as P
+        return {
+            "embed": jax.tree.map(lambda _: P(), pipe_params["embed"]),
+            "stages": jax.tree.map(lambda _: P("pp"),
+                                   pipe_params["stages"]),
+            "head": jax.tree.map(lambda _: P(), pipe_params["head"]),
+        }
+
+    def loss_and_grads(self, pipe_params, enc_tokens, dec_tokens, targets,
+                       *, loss_mask=None, accum_dtype=jnp.float32,
+                       dp_axis=None):
+        """(M, b, s) microbatched token triples → (loss, grads). Must run
+        inside shard_map with the pp axis bound; stage leaves are this
+        device's local (n_layers, ...) slices."""
+        from apex_tpu.transformer.pipeline_parallel import (
+            encoder_decoder, schedules)
+
+        model = self.model
+        e_acc, e_down = schedules._main_grad_cast(
+            pipe_params["embed"], accum_dtype)
+        s_acc, s_down = schedules._main_grad_cast(
+            pipe_params["stages"], accum_dtype)
+        h_acc, h_down = schedules._main_grad_cast(
+            pipe_params["head"], accum_dtype)
+
+        M, b, s_dec = dec_tokens.shape
+
+        def full_loss(p):
+            ep = e_down(p["embed"])
+
+            def enc_fn(sp_, h):
+                def body(h, layer):
+                    return self.model._wrapped(
+                        model.encoder_block)(layer, h), None
+                h, _ = jax.lax.scan(body, h, sp_["enc"])
+                return h
+
+            def dec_fn(sp_, h, ctx):
+                # the encoder output enters the decoder segment through
+                # the LATCHED context; the final-encoder LN applies at the
+                # seam (each decoder stage normalizes its arriving raw
+                # ctx — same value as the serial model's one-time LN)
+                ctx = fused_layer_norm(ctx, ep["ln_enc_w"],
+                                       ep["ln_enc_b"])
+
+                def body(h, layer):
+                    return self.model._wrapped(
+                        lambda pl, hh: model.decoder_block(pl, hh, ctx)
+                    )(layer, h), None
+                h, _ = jax.lax.scan(body, h, sp_["dec"])
+                return h
+
+            emb_p = {"embedding": ep["embedding"],
+                     "pos_embedding": ep["pos_embedding"]}
+            enc_emb = jax.vmap(lambda t: model.embed(emb_p, t))(enc_tokens)
+            dec_emb = jax.vmap(lambda t: model.embed(emb_p, t))(dec_tokens)
+            outs = encoder_decoder.pipeline_spmd_forward_enc_dec(
+                lambda pp_, h: enc_fn(s_down(pp_), h),
+                lambda pp_, h, ctx_: dec_fn(s_down(pp_), h, ctx_),
+                p["stages"], enc_emb, dec_emb,
+                split_rank=self.split, remat=False,
+                broadcast_outputs=False,
+            )
+            hp = h_down(p["head"])
+            x = outs.reshape(M * b, s_dec, -1)
+            x = fused_layer_norm(x, hp["ln_dec_w"], hp["ln_dec_b"])
+            logits = x @ ep["embedding"].T
+            losses = tp_lib.vocab_parallel_cross_entropy(
+                logits, targets.reshape(M * b, s_dec), axis_name=None)
+            lm = (None if loss_mask is None
+                  else loss_mask.reshape(M * b, s_dec))
+            loss = tp_lib.masked_mean(losses, lm)
+            return schedules._broadcast_from_first(loss, "pp")
+
+        loss, g = jax.value_and_grad(full_loss)(
+            {"embed": e_acc, "stages": s_acc, "head": h_acc})
+        psum_pp = lambda t: jax.tree.map(  # noqa: E731
+            lambda x: jax.lax.psum(x, "pp"), t)
+        g["embed"], g["head"] = psum_pp(g["embed"]), psum_pp(g["head"])
+        if dp_axis is not None:
+            loss = jax.lax.pmean(loss, dp_axis)
+            g = jax.tree.map(lambda x: jax.lax.pmean(x, dp_axis), g)
+        return loss, g

@@ -96,11 +96,8 @@ class GPTPipeline:
             raise ValueError(
                 f"num_layers ({c.num_layers}) must be divisible by pp*v "
                 f"({self.pp}*{v})")
-        if c.dropout > 0:
-            # per-(layer, microbatch, tick) key threading through the scan
-            # is not wired; the flagship trains dropout-free (cf. the bench)
-            raise NotImplementedError(
-                "GPTPipeline does not support dropout > 0")
+        # dropout: supported — per-application keys fold from
+        # (tick, pp rank, layer-in-chunk); pass `key` to loss_and_grads
         if getattr(c, "moe_num_experts", None) is not None:
             # the MoE block returns (x, router aux) which the uniform
             # stage carrier doesn't thread; MoE composes with dp/ep today
@@ -187,15 +184,24 @@ class GPTPipeline:
             x = model._sp_scatter(x)
         return x.reshape(M, b, *x.shape[1:])
 
-    def _stage(self, chunk_params, x):
+    def _stage(self, chunk_params, x, t=None, key=None):
         """One virtual stage: ``layers_per_chunk`` full transformer blocks
-        (the model's own remat policy per block)."""
+        (the model's own remat policy per block). With ``key`` (dropout),
+        each block folds a distinct key from (tick, pp rank, layer) — the
+        (microbatch, stage) identity the schedule's tick index carries."""
         block = self.model.wrapped_block()
+        if key is not None:
+            rank = jax.lax.axis_index(self.pp_axis)
+            key = jax.random.fold_in(jax.random.fold_in(key, t), rank)
 
-        def body(x, layer):
-            return block(layer, x, None), None
+        def body(carry, layer_i):
+            x = carry
+            layer, i = layer_i
+            k = None if key is None else jax.random.fold_in(key, i)
+            return block(layer, x, k), None
 
-        x, _ = jax.lax.scan(body, x, chunk_params)
+        n = jax.tree.leaves(chunk_params)[0].shape[0]
+        x, _ = jax.lax.scan(body, x, (chunk_params, jnp.arange(n)))
         return x
 
     def _head_loss(self, hp, ep, outs, targets, loss_mask):
@@ -211,10 +217,8 @@ class GPTPipeline:
         logits = model.unembed({"embedding": ep["embedding"]}, x)
         losses = tp_lib.vocab_parallel_cross_entropy(
             logits, targets.reshape(M * b, -1), axis_name=model.axis)
-        if loss_mask is None:
-            return jnp.mean(losses)
-        m = loss_mask.reshape(M * b, -1).astype(losses.dtype)
-        return jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)
+        lm = None if loss_mask is None else loss_mask.reshape(M * b, -1)
+        return tp_lib.masked_mean(losses, lm)
 
     # --- the full step --------------------------------------------------------
 
@@ -227,6 +231,7 @@ class GPTPipeline:
         loss_mask: Optional[jax.Array] = None,
         accum_dtype=jnp.float32,
         dp_axis: Optional[str] = None,
+        key: Optional[jax.Array] = None,
     ):
         """Pipelined forward+backward over ``(M, b, s)`` microbatched
         tokens. Must run inside ``shard_map``; ``pipe_params`` are this
@@ -235,8 +240,24 @@ class GPTPipeline:
         shaped like ``pipe_params`` in ``accum_dtype`` (fp32 main-grad
         accumulation across microbatch ticks, cf.
         ``schedules._main_grad_cast``). ``dp_axis`` adds the data-parallel
-        pmean of loss and grads."""
+        pmean of loss and grads. ``key`` enables dropout (required when
+        ``config.dropout > 0``): keys fold per (tick, stage, layer) so
+        every (microbatch, layer) application draws a distinct mask, and
+        when ``dp_axis`` is given the dp rank folds in here too — data-
+        parallel replicas draw decorrelated masks without caller effort.
+
+        NOTE dropout forces the materialized-scores attention path even
+        for ``attention_impl='flash'`` (the kernels carry no in-kernel
+        probs dropout — ``GPTModel._attention`` documents the same): at
+        long sequence the (b, h, s, s) probability tensors dominate
+        memory. Train long-context dropout-free (the flagship does) or
+        budget for the O(s²) activations."""
         model, v = self.model, self.virtual_chunks
+        if model.config.dropout > 0 and key is None:
+            raise ValueError(
+                "config.dropout > 0 requires a `key` for loss_and_grads")
+        if key is not None and dp_axis is not None:
+            key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
         e_acc, e_down = schedules._main_grad_cast(
             pipe_params["embed"], accum_dtype)
         s_acc, s_down = schedules._main_grad_cast(
@@ -247,10 +268,11 @@ class GPTPipeline:
         def full_loss(p):
             emb = self._embed(e_down(p["embed"]), tokens)
             outs = schedules.pipeline_spmd_forward(
-                lambda cp, x: self._stage(s_down(cp), x),
+                lambda cp, x, t: self._stage(s_down(cp), x, t, key),
                 p["stages"], emb,
                 axis_name=self.pp_axis, virtual_chunks=v,
                 remat=model.config.remat, broadcast_outputs=False,
+                tick_arg=True,
             )
             loss = self._head_loss(
                 h_down(p["head"]), e_down(p["embed"]), outs, targets,

@@ -81,6 +81,7 @@ def pipeline_spmd_forward(
     virtual_chunks: int = 1,
     remat: bool = True,
     broadcast_outputs: bool = True,
+    tick_arg: bool = False,
 ):
     """Run the SPMD pipeline forward; returns per-microbatch outputs of the
     final stage (shape = microbatches.shape with the feature dims of the
@@ -112,6 +113,11 @@ def pipeline_spmd_forward(
     and ``M % S == 0`` (microbatches flow in groups of S). Per tick each
     device computes exactly ONE chunk — the classic interleaved schedule's
     1/v-stage ticks; see the module docstring for the timing model.
+
+    ``tick_arg=True`` calls ``stage_fn(params, x, t)`` with the tick index
+    — combined with ``axis_index`` inside the stage this identifies the
+    (microbatch, stage) pair, which is exactly what per-microbatch RNG
+    (dropout) needs to fold a distinct key per application.
     """
     S = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
@@ -122,7 +128,9 @@ def pipeline_spmd_forward(
     perm = [(i, (i + 1) % S) for i in range(S)]
 
     if v == 1:
-        fn = jax.checkpoint(stage_fn) if remat else stage_fn
+        base_fn = (stage_fn if tick_arg
+                   else (lambda p, x, t: stage_fn(p, x)))
+        fn = jax.checkpoint(base_fn) if remat else base_fn
         T = M + S - 1
 
         def tick(carry, t):
@@ -131,7 +139,7 @@ def pipeline_spmd_forward(
                 microbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False
             )
             x = jnp.where(rank == 0, inject, x)
-            y = fn(stage_params, x)
+            y = fn(stage_params, x, t)
             sent = jax.lax.ppermute(y, axis_name, perm)
 
             # microbatch m exits at tick m + S - 1, arriving (post-rotate)
@@ -153,13 +161,15 @@ def pipeline_spmd_forward(
                 "fwd_bwd_pipelining_with_interleaving.py:87)")
         T = M * v + S - 1
 
-        def chunk_fn(params, c, x):
+        def chunk_fn(params, c, x, t):
             # the chunk slice lives INSIDE the (rematted) tick function:
             # it is recomputed from the loop-invariant stacked params in
             # backward rather than stacked into T-length scan residuals
             chunk_params = jax.tree.map(
                 lambda p: jax.lax.dynamic_index_in_dim(
                     p, c, 0, keepdims=False), params)
+            if tick_arg:
+                return stage_fn(chunk_params, x, t)
             return stage_fn(chunk_params, x)
 
         cfn = jax.checkpoint(chunk_fn) if remat else chunk_fn
@@ -184,7 +194,7 @@ def pipeline_spmd_forward(
             inject = jax.lax.dynamic_index_in_dim(
                 microbatches, m, 0, keepdims=False)
             x = jnp.where((rank == 0) & (c == 0), inject, x)
-            y = cfn(stage_params, c, x)
+            y = cfn(stage_params, c, x, t)
             sent = jax.lax.ppermute(y, axis_name, perm)
 
             # the item device S-1 just finished (u = t − (S−1)) arrives at

@@ -138,3 +138,15 @@ def _vce_bwd(label_smoothing, axis_name, impl, res, dloss):
 
 
 vocab_parallel_cross_entropy.defvjp(_vce_fwd, _vce_bwd)
+
+
+def masked_mean(losses: jax.Array, loss_mask=None) -> jax.Array:
+    """Mean per-token loss, optionally weighted by a 0/1 ``loss_mask``
+    (1 = count) — the reduction every loss head shares (reference
+    ``pipeline_parallel/utils.py:303``: EOD/padding positions excluded
+    the same way). The 1.0 denominator floor keeps an all-masked batch
+    finite (loss 0) instead of NaN."""
+    if loss_mask is None:
+        return jnp.mean(losses)
+    m = loss_mask.astype(losses.dtype)
+    return jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)
