@@ -459,9 +459,11 @@ class GPTModel:
             x = self._sp_scatter(x)  # residual stream is seq-sharded
 
         block = self.wrapped_block()
-        aux0 = ({"load_balance_loss": jnp.zeros(()),
-                 "router_z_loss": jnp.zeros(()),
-                 "drop_fraction": jnp.zeros(())} if self.moe else None)
+        if self.moe:
+            from apex_tpu.transformer.moe import router_aux_zeros
+            aux0 = router_aux_zeros()
+        else:
+            aux0 = None
 
         if c.scan_layers:
             def body(carry, layer_and_key):

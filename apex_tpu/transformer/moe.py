@@ -33,6 +33,20 @@ import jax
 import jax.numpy as jnp
 
 
+# the router aux schema — THE definition every consumer zero-initializes
+# from (gpt.hidden_states_with_aux, GPTPipeline._stage accumulate against
+# this exact tree structure)
+ROUTER_AUX_ZEROS = {"load_balance_loss": 0.0, "router_z_loss": 0.0,
+                    "drop_fraction": 0.0}
+
+
+def router_aux_zeros(dtype=None):
+    """Fresh zero aux tree matching :func:`router_topk_sparse`'s output."""
+    import jax.numpy as _jnp
+    return {k: _jnp.zeros((), dtype or _jnp.float32)
+            for k in ROUTER_AUX_ZEROS}
+
+
 def router_topk_sparse(
     logits: jax.Array,
     capacity: int,

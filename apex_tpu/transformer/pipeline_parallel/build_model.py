@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from apex_tpu.ops import fused_layer_norm
 from apex_tpu.parallel import mesh as mesh_lib
 from apex_tpu.transformer import tensor_parallel as tp_lib
+from apex_tpu.transformer.moe import ROUTER_AUX_ZEROS, router_aux_zeros
 from apex_tpu.transformer.pipeline_parallel import schedules
 
 PyTree = Any
@@ -97,13 +98,9 @@ class GPTPipeline:
                 f"num_layers ({c.num_layers}) must be divisible by pp*v "
                 f"({self.pp}*{v})")
         # dropout: supported — per-application keys fold from
-        # (tick, pp rank, layer-in-chunk); pass `key` to loss_and_grads
-        if getattr(c, "moe_num_experts", None) is not None:
-            # the MoE block returns (x, router aux) which the uniform
-            # stage carrier doesn't thread; MoE composes with dp/ep today
-            raise NotImplementedError(
-                "GPTPipeline does not (yet) support MoE configs; use "
-                "dp/ep parallelism for MoE models")
+        # (tick, pp rank, layer-in-chunk); pass `key` to loss_and_grads.
+        # MoE: supported — the schedule's validity-masked aux accumulator
+        # threads the router losses differentiably (`aux_init`).
 
     @property
     def layers_per_chunk(self) -> int:
@@ -188,21 +185,32 @@ class GPTPipeline:
         """One virtual stage: ``layers_per_chunk`` full transformer blocks
         (the model's own remat policy per block). With ``key`` (dropout),
         each block folds a distinct key from (tick, pp rank, layer) — the
-        (microbatch, stage) identity the schedule's tick index carries."""
+        (microbatch, stage) identity the schedule's tick index carries.
+        MoE models return ``(x, summed router aux)`` for the schedule's
+        masked accumulator."""
         block = self.model.wrapped_block()
+        moe = self.model.moe
         if key is not None:
             rank = jax.lax.axis_index(self.pp_axis)
             key = jax.random.fold_in(jax.random.fold_in(key, t), rank)
 
         def body(carry, layer_i):
-            x = carry
+            x, aux = carry
             layer, i = layer_i
             k = None if key is None else jax.random.fold_in(key, i)
-            return block(layer, x, k), None
+            out = block(layer, x, k)
+            if moe:
+                x, a = out
+                aux = jax.tree.map(jnp.add, aux, a)
+            else:
+                x = out
+            return (x, aux), None
 
         n = jax.tree.leaves(chunk_params)[0].shape[0]
-        x, _ = jax.lax.scan(body, x, (chunk_params, jnp.arange(n)))
-        return x
+        aux0 = router_aux_zeros() if moe else jnp.zeros(())
+        (x, aux), _ = jax.lax.scan(
+            body, (x, aux0), (chunk_params, jnp.arange(n)))
+        return (x, aux) if moe else x
 
     def _head_loss(self, hp, ep, outs, targets, loss_mask):
         """Final LN → tied unembedding → vocab-parallel CE → masked mean.
@@ -232,6 +240,7 @@ class GPTPipeline:
         accum_dtype=jnp.float32,
         dp_axis: Optional[str] = None,
         key: Optional[jax.Array] = None,
+        return_aux: bool = False,
     ):
         """Pipelined forward+backward over ``(M, b, s)`` microbatched
         tokens. Must run inside ``shard_map``; ``pipe_params`` are this
@@ -265,22 +274,44 @@ class GPTPipeline:
         h_acc, h_down = schedules._main_grad_cast(
             pipe_params["head"], accum_dtype)
 
+        M = tokens.shape[0]
+
         def full_loss(p):
             emb = self._embed(e_down(p["embed"]), tokens)
-            outs = schedules.pipeline_spmd_forward(
+            out = schedules.pipeline_spmd_forward(
                 lambda cp, x, t: self._stage(s_down(cp), x, t, key),
                 p["stages"], emb,
                 axis_name=self.pp_axis, virtual_chunks=v,
                 remat=model.config.remat, broadcast_outputs=False,
                 tick_arg=True,
+                aux_init=ROUTER_AUX_ZEROS if model.moe else None,
             )
+            if model.moe:
+                outs, aux_local = out
+                # per-rank masked sums over this rank's real work, totaled
+                # over pp with the psum-forward/IDENTITY-backward mapping:
+                # a raw lax.psum here would transpose conservatively to
+                # another psum (check_vma=False) and scale every aux-path
+                # gradient by pp_size (review r3; same hazard
+                # _broadcast_from_first's custom VJP exists to avoid)
+                aux = jax.tree.map(
+                    lambda x: tp_lib.reduce_from_tensor_model_parallel_region(
+                        x, self.pp_axis) / (M * model.config.num_layers),
+                    aux_local)
+            else:
+                outs, aux = out, None
             loss = self._head_loss(
                 h_down(p["head"]), e_down(p["embed"]), outs, targets,
                 loss_mask)
             # all pre/post-process parameter cotangents mask to pp rank 0
-            return schedules._broadcast_from_first(loss, self.pp_axis)
+            loss = schedules._broadcast_from_first(loss, self.pp_axis)
+            if model.moe:
+                c = model.config
+                loss = (loss + c.moe_aux_coeff * aux["load_balance_loss"]
+                        + c.moe_z_coeff * aux["router_z_loss"])
+            return loss, aux
 
-        loss, g = jax.value_and_grad(full_loss)(
+        (loss, aux), g = jax.value_and_grad(full_loss, has_aux=True)(
             {"embed": e_acc, "stages": s_acc, "head": h_acc})
 
         # embedding/head grads live on pp rank 0 (masked transpose of the
@@ -299,4 +330,8 @@ class GPTPipeline:
         if dp_axis is not None:
             loss = jax.lax.pmean(loss, dp_axis)
             g = jax.tree.map(lambda x: jax.lax.pmean(x, dp_axis), g)
+            if aux is not None:
+                aux = jax.tree.map(lambda x: jax.lax.pmean(x, dp_axis), aux)
+        if return_aux:
+            return loss, g, aux
         return loss, g

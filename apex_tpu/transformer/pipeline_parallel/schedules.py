@@ -82,6 +82,7 @@ def pipeline_spmd_forward(
     remat: bool = True,
     broadcast_outputs: bool = True,
     tick_arg: bool = False,
+    aux_init: PyTree = None,
 ):
     """Run the SPMD pipeline forward; returns per-microbatch outputs of the
     final stage (shape = microbatches.shape with the feature dims of the
@@ -118,6 +119,16 @@ def pipeline_spmd_forward(
     — combined with ``axis_index`` inside the stage this identifies the
     (microbatch, stage) pair, which is exactly what per-microbatch RNG
     (dropout) needs to fold a distinct key per application.
+
+    ``aux_init`` (a pytree of scalars) switches the stage to an
+    aux-carrying contract: ``stage_fn`` returns ``(y, aux_tree)`` and the
+    scan accumulates each tick's aux — masked by tick VALIDITY, so
+    warmup/cooldown garbage lanes contribute zero — into the init tree;
+    the function then returns ``(outputs, aux_sum)``. The per-rank sum
+    covers this rank's real (microbatch, stage) work only; ``psum`` over
+    pp gives the global total (MoE router aux losses are the consumer —
+    they must enter the objective differentiably, which the scan-carried
+    accumulator provides).
     """
     S = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
@@ -127,6 +138,12 @@ def pipeline_spmd_forward(
 
     perm = [(i, (i + 1) % S) for i in range(S)]
 
+    aux = aux_init is not None
+
+    def _mask_aux(a, ok):
+        m = ok.astype(jnp.float32)
+        return jax.tree.map(lambda x: x * m, a)
+
     if v == 1:
         base_fn = (stage_fn if tick_arg
                    else (lambda p, x, t: stage_fn(p, x)))
@@ -134,12 +151,18 @@ def pipeline_spmd_forward(
         T = M + S - 1
 
         def tick(carry, t):
-            x, outputs = carry  # x: (*mb), outputs: (M, *mb)
+            x, outputs, aux_sum = carry  # x: (*mb), outputs: (M, *mb)
             inject = jax.lax.dynamic_index_in_dim(
                 microbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False
             )
             x = jnp.where(rank == 0, inject, x)
             y = fn(stage_params, x, t)
+            if aux:
+                y, a = y
+                # this rank holds a REAL microbatch iff 0 <= t-rank < M
+                u = t - rank
+                aux_sum = jax.tree.map(
+                    jnp.add, aux_sum, _mask_aux(a, (u >= 0) & (u < M)))
             sent = jax.lax.ppermute(y, axis_name, perm)
 
             # microbatch m exits at tick m + S - 1, arriving (post-rotate)
@@ -150,7 +173,7 @@ def pipeline_spmd_forward(
                 outputs, sent.astype(outputs.dtype), out_idx, 0
             )
             outputs = jnp.where(valid, updated, outputs)
-            return (sent, outputs), None
+            return (sent, outputs, aux_sum), None
 
     else:
         if M % S:
@@ -186,8 +209,8 @@ def pipeline_spmd_forward(
             return c, jnp.clip(m, 0, M - 1), (u >= 0) & (m < M)
 
         def tick(carry, t):
-            x, outputs = carry  # ONE in-flight activation per device
-            c, m, _ = item(t - rank)
+            x, outputs, aux_sum = carry  # ONE in-flight activation/device
+            c, m, in_flight = item(t - rank)
             # stage-0 pre-process: whenever device 0's active chunk is 0 it
             # starts a fresh microbatch (this also retires the item that
             # just finished chunk v-1 on the wrap-around)
@@ -195,6 +218,10 @@ def pipeline_spmd_forward(
                 microbatches, m, 0, keepdims=False)
             x = jnp.where((rank == 0) & (c == 0), inject, x)
             y = cfn(stage_params, c, x, t)
+            if aux:
+                y, a = y
+                aux_sum = jax.tree.map(
+                    jnp.add, aux_sum, _mask_aux(a, in_flight))
             sent = jax.lax.ppermute(y, axis_name, perm)
 
             # the item device S-1 just finished (u = t − (S−1)) arrives at
@@ -205,11 +232,18 @@ def pipeline_spmd_forward(
                 outputs, sent.astype(outputs.dtype), m_out, 0
             )
             outputs = jnp.where(valid, updated, outputs)
-            return (sent, outputs), None
+            return (sent, outputs, aux_sum), None
 
     state0 = jnp.zeros(mb_shape, microbatches.dtype)
     outputs0 = jnp.zeros((M,) + mb_shape, microbatches.dtype)
-    (_, outputs), _ = jax.lax.scan(tick, (state0, outputs0), jnp.arange(T))
+    aux0 = (jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), aux_init)
+            if aux else jnp.zeros(()))
+    (_, outputs, aux_sum), _ = jax.lax.scan(
+        tick, (state0, outputs0, aux0), jnp.arange(T))
+    if aux and not broadcast_outputs:
+        return outputs, aux_sum
+    if aux:
+        return _broadcast_from_first(outputs, axis_name), aux_sum
     if not broadcast_outputs:
         return outputs
     # replicate the collected outputs (they live on device 0 post-rotation)

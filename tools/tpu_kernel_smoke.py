@@ -44,10 +44,27 @@ check("dgd bwd", jax.grad(lambda x: fused_dense_gelu_dense(x, wd, bd, w2, b2, im
 check("mlp bwd", jax.grad(lambda x: mlp(x, [wd], [bd], "relu", impl="pallas").astype(jnp.float32).sum()), xd)
 
 # flash attention
-from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.attention import flash_attention, fused_qkv_attention
 q = jr.normal(k, (8, 512, 64), jnp.bfloat16)
 check("flash fwd", lambda q: flash_attention(q, q, q, causal=True, impl="pallas"), q)
 check("flash bwd", jax.grad(lambda q: flash_attention(q, q, q, causal=True, impl="pallas").astype(jnp.float32).sum()), q)
+
+# seq-major (bshd) + fused attention block (the r3 zero-copy flagship path)
+qb = jr.normal(k, (2, 512, 4, 128), jnp.bfloat16)
+check("flash bshd fwd", lambda q: flash_attention(
+    q, q, q, causal=True, impl="pallas", layout="bshd"), qb)
+check("flash bshd bwd", jax.grad(lambda q: flash_attention(
+    q, q, q, causal=True, impl="pallas",
+    layout="bshd").astype(jnp.float32).sum()), qb)
+xf = jr.normal(k, (2, 512, 512), jnp.bfloat16)
+wqkv = jr.normal(k, (3 * 4 * 128, 512), jnp.bfloat16) * 0.02
+bqkv = jnp.zeros((3 * 4 * 128,), jnp.bfloat16)
+wout = jr.normal(k, (512, 4 * 128), jnp.bfloat16) * 0.02
+check("fused_qkv_attention fwd", lambda x: fused_qkv_attention(
+    x, wqkv, bqkv, wout, 4, 4, 128, 128 ** -0.5, True), xf)
+check("fused_qkv_attention bwd", jax.grad(lambda x: fused_qkv_attention(
+    x, wqkv, bqkv, wout, 4, 4, 128, 128 ** -0.5,
+    True).astype(jnp.float32).sum()), xf)
 
 # fused optimizers (multi-tensor engine)
 from apex_tpu.optimizers import fused_adam, fused_lamb, fused_sgd
