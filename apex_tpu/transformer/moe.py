@@ -41,10 +41,9 @@ ROUTER_AUX_ZEROS = {"load_balance_loss": 0.0, "router_z_loss": 0.0,
 
 
 def router_aux_zeros(dtype=None):
-    """Fresh zero aux tree matching :func:`router_topk_sparse`'s output."""
-    import jax.numpy as _jnp
-    return {k: _jnp.zeros((), dtype or _jnp.float32)
-            for k in ROUTER_AUX_ZEROS}
+    """Fresh init tree matching :func:`router_topk_sparse`'s aux output."""
+    return jax.tree.map(
+        lambda v: jnp.full((), v, dtype or jnp.float32), ROUTER_AUX_ZEROS)
 
 
 def router_topk_sparse(

@@ -101,6 +101,14 @@ class GPTPipeline:
         # (tick, pp rank, layer-in-chunk); pass `key` to loss_and_grads.
         # MoE: supported — the schedule's validity-masked aux accumulator
         # threads the router losses differentiably (`aux_init`).
+        if getattr(c, "ep_axis", None) is not None:
+            # the partitioner carries no ep dimension for the expert banks
+            # (each stage holds its full banks); expert-parallel all_to_alls
+            # inside a pipeline stage need an ep-aware partition first
+            raise NotImplementedError(
+                "GPTPipeline supports MoE with replicated expert banks "
+                "(ep_axis=None); expert parallelism inside the pipeline is "
+                "not wired — drop ep_axis or use dp/ep without pp")
 
     @property
     def layers_per_chunk(self) -> int:

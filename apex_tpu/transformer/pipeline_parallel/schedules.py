@@ -240,14 +240,11 @@ def pipeline_spmd_forward(
             if aux else jnp.zeros(()))
     (_, outputs, aux_sum), _ = jax.lax.scan(
         tick, (state0, outputs0, aux0), jnp.arange(T))
-    if aux and not broadcast_outputs:
-        return outputs, aux_sum
-    if aux:
-        return _broadcast_from_first(outputs, axis_name), aux_sum
-    if not broadcast_outputs:
-        return outputs
-    # replicate the collected outputs (they live on device 0 post-rotation)
-    return _broadcast_from_first(outputs, axis_name)
+    # replicate the collected outputs unless the caller wants the raw
+    # rank-0-valid array (they live on device 0 post-rotation)
+    out = (outputs if not broadcast_outputs
+           else _broadcast_from_first(outputs, axis_name))
+    return (out, aux_sum) if aux else out
 
 
 def forward_backward_no_pipelining(

@@ -142,6 +142,17 @@ def main():
         results[impl] = timeit(step, params, opt_state, tokens, targets, iters)
         del step, params, opt_state
 
+    if results["baseline"] / results["fused"] > 3.0:
+        # a >3x ratio has always been a transient tunnel stall in the
+        # baseline pass (observed once: 12.5x), never a real kernel gap —
+        # re-time the baseline and keep the faster (honest) measurement
+        os.environ["APEX_TPU_PALLAS"] = "0"
+        step, params, opt_state = build("baseline", cfg, donate)
+        results["baseline"] = min(
+            results["baseline"],
+            timeit(step, params, opt_state, tokens, targets, iters))
+        del step, params, opt_state
+
     tokens_per_s = batch * seq / results["fused"]
     vs_baseline = results["baseline"] / results["fused"]
     flops_per_s = model_flops_per_token(cfg, seq) * tokens_per_s
