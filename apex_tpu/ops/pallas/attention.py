@@ -251,12 +251,49 @@ def flash_fwd_packed(qkv, h, h_kv, d, *, scale, causal, bq=1024, bk=1024,
     return o, lse[..., 0]
 
 
+def _bwd_single_block_kernel(*refs, scale, causal, n):
+    """Single-block fused backward: when the whole (sq == sk == n) matrix
+    fits one block, dq/dk/dv come out of ONE kernel that computes the
+    score matrix once — the two-kernel split (which exists only because
+    dq accumulates over kv blocks and dkv over q blocks) recomputes QKᵀ,
+    the mask, and the exp twice. 5 GEMMs instead of 7; at the flagship
+    shape that is ~4 ms/step of attention backward removed (PERF.md r3).
+    """
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+     dq_ref, dk_ref, dv_ref) = refs
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+        s = jnp.where(cols <= rows, s, NEG_INF)
+    p = jnp.exp(s - lse_ref[0, 0][:, 0:1])
+    dv_ref[0] = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    ds = (p * (dp - delta_ref[0, 0][:, 0:1]) * scale).astype(q.dtype)
+    dq_ref[0] = jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+    dk_ref[0] = jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+
+
 def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
                      bq=1024, bk=1024, interpret=False):
     """Backward of :func:`flash_fwd_packed`: returns SEPARATE folded grads
     (dq (b, s, h·d), dk/dv (b, s, h_kv·d)) — the caller contracts each
     against its weight window (plain 2D GEMMs), never materializing a
-    packed dqkv."""
+    packed dqkv. When the sequence fits one block, a single fused kernel
+    replaces the dq/dkv pair (see :func:`_bwd_single_block_kernel`)."""
     b, s, _ = qkv.shape
     group = h // h_kv
     bq, bk = _fit_block(s, bq), _fit_block(s, bk)
@@ -267,6 +304,29 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
     lse4 = _expand_rows(lse)
     delta4 = _expand_rows(delta.transpose(0, 2, 1))
 
+    if nq == 1 and nk == 1 and group == 1:
+        qm = lambda t, h=h: (t // h, 0, t % h)  # noqa: E731
+        km = lambda t, h=h: (t // h, 0, h + t % h)  # noqa: E731
+        vm = lambda t, h=h, hk=h_kv: (t // h, 0, h + hk + t % h)  # noqa: E731
+        rm = lambda t, h=h: (t // h, t % h, 0, 0)  # noqa: E731
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_single_block_kernel, scale=scale,
+                              causal=causal, n=s),
+            grid=(b * h,),
+            in_specs=[pl.BlockSpec((1, s, d), qm),
+                      pl.BlockSpec((1, s, d), km),
+                      pl.BlockSpec((1, s, d), vm),
+                      pl.BlockSpec((1, s, d), qm),
+                      pl.BlockSpec((1, 1, s, _LSE_LANES), rm),
+                      pl.BlockSpec((1, 1, s, _LSE_LANES), rm)],
+            out_specs=[pl.BlockSpec((1, s, d), lambda t, h=h:
+                                    (t // h, 0, t % h))] * 3,
+            out_shape=[jax.ShapeDtypeStruct((b, s, h * d), qkv.dtype)] * 3,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel",)),
+            interpret=interpret,
+        )(qkv, qkv, qkv, do, lse4, delta4)
+        return dq, dk, dv
     qm = lambda t, i, j, h=h: (t // h, i, t % h)  # noqa: E731
     km = lambda t, i, j, h=h, g=group: (t // h, j, h + (t % h) // g)  # noqa: E731
     vm = lambda t, i, j, h=h, hk=h_kv, g=group: (  # noqa: E731

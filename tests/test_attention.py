@@ -265,8 +265,9 @@ class TestGroupedQueryAttention:
             flash_attention(q, q, q, layout="bshd", impl="pallas")
 
     @pytest.mark.pallas
+    @pytest.mark.parametrize("causal", [True, False])
     @pytest.mark.parametrize("kv_heads", [4, 2])
-    def test_fused_qkv_attention_matches_composition(self, kv_heads,
+    def test_fused_qkv_attention_matches_composition(self, kv_heads, causal,
                                                      monkeypatch):
         """The flagship's zero-layout-copy block (packed projection →
         window-reading kernels → output GEMM, hand-written VJP): forward
@@ -293,13 +294,13 @@ class TestGroupedQueryAttention:
                        t(qkv[:, :, h + hkv:]))
             rep = h // hkv
             o = dense_ref(q, jnp.repeat(k, rep, 1), jnp.repeat(v, rep, 1),
-                          True, scale)
+                          causal, scale)
             return jnp.einsum("bhsd,Hhd->bsH", o,
                               w_out.reshape(H, h, d))
 
         def fused(x, w_qkv, b_qkv, w_out):
             return fused_qkv_attention(x, w_qkv, b_qkv, w_out, h, hkv, d,
-                                       scale, True)
+                                       scale, causal)
 
         with jax.default_matmul_precision("highest"):
             y1 = fused(x, w_qkv, b_qkv, w_out)
