@@ -254,8 +254,11 @@ def fused_qkv_attention(x, w_qkv, b_qkv, w_out, h, h_kv, d, scale, causal):
 def _fused_attn_fwd(x, w_qkv, b_qkv, w_out, h, h_kv, d, scale, causal):
     b, s, H = x.shape
     qkv = (jnp.dot(x.reshape(-1, H), w_qkv.T) + b_qkv).reshape(b, s, -1)
+    # full_lse: keep the (b, h, s, LANES) lane carrier as the residual —
+    # backward hands it straight back to the kernel (slicing lane 0 here
+    # would force a re-broadcast there, one slice+broadcast pair per layer)
     o, lse = _k.flash_fwd_packed(
-        qkv, h, h_kv, d, scale=scale, causal=causal,
+        qkv, h, h_kv, d, scale=scale, causal=causal, full_lse=True,
         interpret=_backend.interpret_mode())
     y = jnp.dot(o.reshape(-1, h * d), w_out.T).reshape(b, s, -1)
     return y, (x, qkv, o, lse, w_qkv, w_out)

@@ -201,13 +201,16 @@ def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bq=1024, bk=1024,
 
 
 def flash_fwd_packed(qkv, h, h_kv, d, *, scale, causal, bq=1024, bk=1024,
-                     interpret=False):
+                     full_lse=False, interpret=False):
     """Flash forward reading q/k/v directly out of the PACKED projection
     output: ``qkv`` (b, s, (h+2·h_kv)·d), features ordered q|k|v with heads
     contiguous inside each part. The same buffer rides in three times with
     window-offset index maps — the projection GEMM's output feeds the
     kernel with no slice, no copy, no layout change at all. Returns
-    (o (b, s, h·d), lse (b, h, s))."""
+    (o (b, s, h·d), lse (b, h, s)) — or, with ``full_lse``, the raw
+    (b, h, s, LANES) lane carrier the kernel wrote, which
+    :func:`flash_bwd_packed` accepts directly: round-tripping through the
+    sliced form costs a slice + re-broadcast pair per layer for nothing."""
     b, s, _ = qkv.shape
     group = h // h_kv
     bq, bk = _fit_block(s, bq), _fit_block(s, bk)
@@ -248,7 +251,7 @@ def flash_fwd_packed(qkv, h, h_kv, d, *, scale, causal, bq=1024, bk=1024,
         ),
         interpret=interpret,
     )(qkv, qkv, qkv)
-    return o, lse[..., 0]
+    return o, (lse if full_lse else lse[..., 0])
 
 
 def _bwd_single_block_kernel(*refs, scale, causal, n):
@@ -258,8 +261,14 @@ def _bwd_single_block_kernel(*refs, scale, causal, n):
     dq accumulates over kv blocks and dkv over q blocks) recomputes QKᵀ,
     the mask, and the exp twice. 5 GEMMs instead of 7; at the flagship
     shape that is ~4 ms/step of attention backward removed (PERF.md r3).
+
+    D = rowsum(do·o) is computed HERE from the o block rather than taken
+    as an operand: the XLA prologue that produced it materialized the
+    fp32 do·o product (67 MB/layer), layout-copied it, reduced it, and
+    broadcast the result into the lane carrier — ~0.4 ms/layer of pure
+    HBM traffic for a VPU rowsum the kernel gets for free (PERF.md r3).
     """
-    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
      dq_ref, dk_ref, dv_ref) = refs
     q = q_ref[0]
     k = k_ref[0]
@@ -273,12 +282,14 @@ def _bwd_single_block_kernel(*refs, scale, causal, n):
         cols = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
         s = jnp.where(cols <= rows, s, NEG_INF)
     p = jnp.exp(s - lse_ref[0, 0][:, 0:1])
+    delta = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+                    axis=1, keepdims=True)
     dv_ref[0] = jax.lax.dot_general(
         p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32).astype(dv_ref.dtype)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-    ds = (p * (dp - delta_ref[0, 0][:, 0:1]) * scale).astype(q.dtype)
+    ds = (p * (dp - delta) * scale).astype(q.dtype)
     dq_ref[0] = jax.lax.dot_general(
         ds, k, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32).astype(dq_ref.dtype)
@@ -293,16 +304,16 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
     (dq (b, s, h·d), dk/dv (b, s, h_kv·d)) — the caller contracts each
     against its weight window (plain 2D GEMMs), never materializing a
     packed dqkv. When the sequence fits one block, a single fused kernel
-    replaces the dq/dkv pair (see :func:`_bwd_single_block_kernel`)."""
+    replaces the dq/dkv pair (see :func:`_bwd_single_block_kernel`).
+
+    ``lse`` may be the sliced (b, h, s) form or the (b, h, s, LANES)
+    carrier exactly as :func:`flash_fwd_packed` ``full_lse=True`` returned
+    it — passing the carrier skips a per-layer re-broadcast."""
     b, s, _ = qkv.shape
     group = h // h_kv
     bq, bk = _fit_block(s, bq), _fit_block(s, bk)
     nq, nk = _blocks(s, bq), _blocks(s, bk)
-    delta = jnp.sum(
-        do.astype(jnp.float32).reshape(b, s, h, d)
-        * o.astype(jnp.float32).reshape(b, s, h, d), axis=-1)
-    lse4 = _expand_rows(lse)
-    delta4 = _expand_rows(delta.transpose(0, 2, 1))
+    lse4 = lse if lse.ndim == 4 else _expand_rows(lse)
 
     if nq == 1 and nk == 1 and group == 1:
         qm = lambda t, h=h: (t // h, 0, t % h)  # noqa: E731
@@ -317,7 +328,7 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
                       pl.BlockSpec((1, s, d), km),
                       pl.BlockSpec((1, s, d), vm),
                       pl.BlockSpec((1, s, d), qm),
-                      pl.BlockSpec((1, 1, s, _LSE_LANES), rm),
+                      pl.BlockSpec((1, s, d), qm),
                       pl.BlockSpec((1, 1, s, _LSE_LANES), rm)],
             out_specs=[pl.BlockSpec((1, s, d), lambda t, h=h:
                                     (t // h, 0, t % h))] * 3,
@@ -325,8 +336,12 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel",)),
             interpret=interpret,
-        )(qkv, qkv, qkv, do, lse4, delta4)
+        )(qkv, qkv, qkv, do, o, lse4)
         return dq, dk, dv
+    delta = jnp.sum(
+        do.astype(jnp.float32).reshape(b, s, h, d)
+        * o.astype(jnp.float32).reshape(b, s, h, d), axis=-1)
+    delta4 = _expand_rows(delta.transpose(0, 2, 1))
     qm = lambda t, i, j, h=h: (t // h, i, t % h)  # noqa: E731
     km = lambda t, i, j, h=h, g=group: (t // h, j, h + (t % h) // g)  # noqa: E731
     vm = lambda t, i, j, h=h, hk=h_kv, g=group: (  # noqa: E731
