@@ -84,9 +84,11 @@ def _flash_core(q, k, v, kv_lens, scale, causal, use_pallas):
 
 def _flash_fwd_res(q, k, v, kv_lens, scale, causal, use_pallas):
     if use_pallas:
+        # full_lse: the residual keeps the (bh, sq, LANES) carrier so the
+        # backward kernel reads it as-is (no slice/re-broadcast round trip)
         o, lse = _k.flash_fwd(
             q, k, v, scale=scale, causal=causal, kv_lens=kv_lens,
-            interpret=_backend.interpret_mode(),
+            full_lse=True, interpret=_backend.interpret_mode(),
         )
     else:
         group = q.shape[0] // k.shape[0]
@@ -179,8 +181,9 @@ def _flash_core_bshd(q, k, v, scale, causal, use_pallas):
 
 def _flash_fwd_res_bshd(q, k, v, scale, causal, use_pallas):
     if use_pallas:
+        # carrier residual, same rationale as _flash_fwd_res
         o, lse = _k.flash_fwd_bshd(
-            q, k, v, scale=scale, causal=causal,
+            q, k, v, scale=scale, causal=causal, full_lse=True,
             interpret=_backend.interpret_mode())
     else:
         b, h = q.shape[0], q.shape[2]

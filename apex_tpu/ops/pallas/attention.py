@@ -146,8 +146,19 @@ def _kvlen_rows(kv_lens, bh):
                             (bh, 1, _LSE_LANES))
 
 
+
+def _group_sum(x, h_kv, group, d, dtype):
+    """Per-q-head fp32 dk/dv partials (b, s, h·d) → kv-head grads
+    (b, s, h_kv·d): sum each kv group's q heads, THEN cast (fp32 before the
+    cross-head sum — the ADVICE r2 precision rule; XLA fuses the reduction
+    into the kernel's output write)."""
+    b, s, _ = x.shape
+    return x.reshape(b, s, h_kv, group, d).sum(3).astype(
+        dtype).reshape(b, s, h_kv * d)
+
+
 def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bq=1024, bk=1024,
-              interpret=False):
+              full_lse=False, interpret=False):
     """q (bh, sq, d); k/v (bh_kv, sk, d) where bh_kv divides bh — grouped-
     query attention falls out of the kv BlockSpec index maps (q row ``b``
     reads kv row ``b // group``), zero-copy: kv shards are never repeated
@@ -155,7 +166,9 @@ def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bq=1024, bk=1024,
     length (padded batches); the MXU/VPU work of KV blocks entirely past
     the length is skipped dynamically (their DMA still runs — BlockSpec
     copies are unconditional). ``kv_lens=None`` compiles a kernel with no
-    varlen operand or masking at all."""
+    varlen operand or masking at all. ``full_lse`` returns the raw
+    (bh, sq, LANES) lane carrier, which :func:`flash_bwd` accepts directly
+    (saves the slice + re-broadcast pair when lse only rides residuals)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     group = bh // k.shape[0]
@@ -197,7 +210,7 @@ def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bq=1024, bk=1024,
         ),
         interpret=interpret,
     )(*args)
-    return o, lse[..., 0]
+    return o, (lse if full_lse else lse[..., 0])
 
 
 def flash_fwd_packed(qkv, h, h_kv, d, *, scale, causal, bq=1024, bk=1024,
@@ -315,11 +328,17 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
     nq, nk = _blocks(s, bq), _blocks(s, bk)
     lse4 = lse if lse.ndim == 4 else _expand_rows(lse)
 
-    if nq == 1 and nk == 1 and group == 1:
+    if nq == 1 and nk == 1:
         qm = lambda t, h=h: (t // h, 0, t % h)  # noqa: E731
-        km = lambda t, h=h: (t // h, 0, h + t % h)  # noqa: E731
-        vm = lambda t, h=h, hk=h_kv: (t // h, 0, h + hk + t % h)  # noqa: E731
+        km = lambda t, h=h, g=group: (t // h, 0, h + (t % h) // g)  # noqa: E731
+        vm = lambda t, h=h, hk=h_kv, g=group: (  # noqa: E731
+            t // h, 0, h + hk + (t % h) // g)
         rm = lambda t, h=h: (t // h, t % h, 0, 0)  # noqa: E731
+        # grouped kv: each grid point is one q head, so dk/dv come out as
+        # per-q-head fp32 partials (fp32 BEFORE the cross-head sum — the
+        # ADVICE r2 precision rule) and the group reduction happens outside,
+        # where XLA fuses it into the output write.
+        dkv_dt = jnp.float32 if group > 1 else qkv.dtype
         dq, dk, dv = pl.pallas_call(
             functools.partial(_bwd_single_block_kernel, scale=scale,
                               causal=causal, n=s),
@@ -330,13 +349,19 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
                       pl.BlockSpec((1, s, d), qm),
                       pl.BlockSpec((1, s, d), qm),
                       pl.BlockSpec((1, 1, s, _LSE_LANES), rm)],
-            out_specs=[pl.BlockSpec((1, s, d), lambda t, h=h:
-                                    (t // h, 0, t % h))] * 3,
-            out_shape=[jax.ShapeDtypeStruct((b, s, h * d), qkv.dtype)] * 3,
+            out_specs=[pl.BlockSpec((1, s, d), qm)] * 3,
+            out_shape=[
+                jax.ShapeDtypeStruct((b, s, h * d), qkv.dtype),
+                jax.ShapeDtypeStruct((b, s, h * d), dkv_dt),
+                jax.ShapeDtypeStruct((b, s, h * d), dkv_dt),
+            ],
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel",)),
             interpret=interpret,
         )(qkv, qkv, qkv, do, o, lse4)
+        if group > 1:
+            dk = _group_sum(dk, h_kv, group, d, qkv.dtype)
+            dv = _group_sum(dv, h_kv, group, d, qkv.dtype)
         return dq, dk, dv
     delta = jnp.sum(
         do.astype(jnp.float32).reshape(b, s, h, d)
@@ -405,15 +430,13 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
         interpret=interpret,
     )(qkv, qkv, qkv, do, lse4, delta4)
     if group > 1:
-        dk = dk.reshape(b, s, h_kv, group, d).sum(3).astype(qkv.dtype)
-        dv = dv.reshape(b, s, h_kv, group, d).sum(3).astype(qkv.dtype)
-        dk = dk.reshape(b, s, h_kv * d)
-        dv = dv.reshape(b, s, h_kv * d)
+        dk = _group_sum(dk, h_kv, group, d, qkv.dtype)
+        dv = _group_sum(dv, h_kv, group, d, qkv.dtype)
     return dq, dk, dv
 
 
 def flash_fwd_bshd(q, k, v, *, scale, causal, bq=1024, bk=1024,
-                   interpret=False):
+                   full_lse=False, interpret=False):
     """Seq-major flash forward: q (b, sq, h, d); k/v (b, sk, h_kv, d).
 
     The (s, h·d)-minor layout is exactly what the QKV projection GEMMs
@@ -466,7 +489,7 @@ def flash_fwd_bshd(q, k, v, *, scale, causal, bq=1024, bk=1024,
         interpret=interpret,
     )(q.reshape(b, sq, h * d), k.reshape(b, sk, h_kv * d),
       v.reshape(b, sk, h_kv * d))
-    return o.reshape(b, sq, h, d), lse[..., 0]
+    return o.reshape(b, sq, h, d), (lse if full_lse else lse[..., 0])
 
 
 # --- backward -----------------------------------------------------------------
@@ -592,14 +615,18 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
     the dkv kernel runs per *q*-head (its scratch accumulates over q blocks
     within one grid row, so cross-head accumulation can't live in-kernel)
     and the per-head partials are summed over each kv group outside, where
-    XLA fuses the reduction into the kernel's output write."""
+    XLA fuses the reduction into the kernel's output write.
+
+    ``lse`` is the sliced (bh, sq) form or the (bh, sq, LANES) carrier from
+    ``flash_fwd(full_lse=True)``."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     group = bh // k.shape[0]
     bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    lse3, delta3 = _expand_rows(lse), _expand_rows(delta)
+    lse3 = lse if lse.ndim == 3 else _expand_rows(lse)
+    delta3 = _expand_rows(delta)
     varlen = kv_lens is not None
     extra_args = [_kvlen_rows(kv_lens, bh)] if varlen else []
 
@@ -672,8 +699,9 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
 def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
                    interpret=False):
     """Seq-major backward (cf. :func:`flash_fwd_bshd`): q/o/do
-    (b, sq, h, d), k/v (b, sk, h_kv, d), lse (b, h, sq). Returns
-    (dq (b, sq, h, d), dk/dv (b, sk, h_kv, d))."""
+    (b, sq, h, d), k/v (b, sk, h_kv, d), lse (b, h, sq) or the
+    (b, h, sq, LANES) carrier from ``flash_fwd_bshd(full_lse=True)``.
+    Returns (dq (b, sq, h, d), dk/dv (b, sk, h_kv, d))."""
     b, sq, h, d = q.shape
     sk, h_kv = k.shape[1], k.shape[2]
     group = h // h_kv
@@ -681,7 +709,7 @@ def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     # (b, sq, h) -> the (b, h, sq, LANES) carrier the kernels read rowwise
-    lse4 = _expand_rows(lse)
+    lse4 = lse if lse.ndim == 4 else _expand_rows(lse)
     delta4 = _expand_rows(delta.transpose(0, 2, 1))
     # folded (b, s, h·d) views — free bitcasts, head = block index (see
     # flash_fwd_bshd)
@@ -750,9 +778,9 @@ def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
         interpret=interpret,
     )(q3, k3, v3, do3, lse4, delta4)
     dq = dq.reshape(b, sq, h, d)
-    dk = dk.reshape(b, sk, h, d)
-    dv = dv.reshape(b, sk, h, d)
     if group > 1:
-        dk = dk.reshape(b, sk, h_kv, group, d).sum(3).astype(k.dtype)
-        dv = dv.reshape(b, sk, h_kv, group, d).sum(3).astype(v.dtype)
+        dk = _group_sum(dk, h_kv, group, d, k.dtype)
+        dv = _group_sum(dv, h_kv, group, d, v.dtype)
+    dk = dk.reshape(b, sk, h_kv, d)
+    dv = dv.reshape(b, sk, h_kv, d)
     return dq, dk, dv
