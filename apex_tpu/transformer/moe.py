@@ -168,6 +168,98 @@ def router_topk(
             combine[:, :-1].reshape(T, E, capacity), aux)
 
 
+def _slot_inverse(slot_ids, gates, num_slots):
+    """Invert the token→slot assignment: slot ids are UNIQUE across rounds
+    (the slot cumsum carries counts over), so the (T, d) dispatch scatter is
+    a permutation — invertible into (S,)-sized scalar scatters that cost
+    1/512th of the row scatter they replace. Returns (inv (S,) int32 —
+    which token fills each slot, valid (S,) bool — empty slots must
+    contribute zeros). The per-slot gate value is NOT built here:
+    `_gather_combine_bwd` derives it from its own residuals, keeping the
+    inversion-by-scatter logic in exactly one consumer per quantity."""
+    k, T = slot_ids.shape
+    del gates
+    inv = jnp.zeros((num_slots,), jnp.int32)
+    valid = jnp.zeros((num_slots,), jnp.bool_)
+    tok = jnp.arange(T, dtype=jnp.int32)
+    for r in range(k):
+        sid = slot_ids[r]  # dump assignments (== num_slots) drop out of range
+        inv = inv.at[sid].set(tok, mode="drop")
+        valid = valid.at[sid].set(True, mode="drop")
+    return inv, valid
+
+
+@jax.custom_vjp
+def _gather_dispatch(xt, slot_ids, inv, valid):
+    """(T, d) tokens → (S, d) expert slots, as a row GATHER both ways.
+
+    The obvious formulation — ``buf.at[slot_ids].add(xt)`` — is an XLA row
+    scatter, and its transpose (plus the remat re-forward) made the
+    dispatch/combine pair cost ~62 ms/step at the flagship MoE shape
+    (PERF.md r3): TPU scatters neither fuse nor pipeline the way gathers
+    do. With the slot inverse precomputed, forward is ``xt[inv]`` masked by
+    slot validity, and the hand-written VJP routes the cotangent back with
+    the forward's own ``slot_ids`` gather — no (T, d)-sized scatter exists
+    in either direction."""
+    return jnp.where(valid[:, None], xt[inv], 0).astype(xt.dtype)
+
+
+def _gather_dispatch_fwd(xt, slot_ids, inv, valid):
+    return _gather_dispatch(xt, slot_ids, inv, valid), (slot_ids, inv.shape)
+
+
+def _gather_dispatch_bwd(res, g):
+    import numpy as np
+    slot_ids, inv_shape = res
+    gp = jnp.concatenate([g, jnp.zeros((1, g.shape[1]), g.dtype)], 0)
+    dxt = gp[slot_ids[0]]
+    for r in range(1, slot_ids.shape[0]):
+        dxt = dxt + gp[slot_ids[r]]
+    f0 = lambda s: np.zeros(s, jax.dtypes.float0)  # noqa: E731
+    return dxt, f0(slot_ids.shape), f0(inv_shape), f0(inv_shape)
+
+
+_gather_dispatch.defvjp(_gather_dispatch_fwd, _gather_dispatch_bwd)
+
+
+@jax.custom_vjp
+def _gather_combine(op, gates, slot_ids, inv, valid):
+    """y_t = Σ_r gates_r(t) · op[slot_r(t)] with the dump row synthesized as
+    a zero row; the VJP's d_op is a gather by ``inv`` (the scatter-free
+    mirror of :func:`_gather_dispatch`)."""
+    opp = jnp.concatenate([op, jnp.zeros((1, op.shape[1]), op.dtype)], 0)
+    y = gates[0][:, None].astype(opp.dtype) * opp[slot_ids[0]]
+    for r in range(1, gates.shape[0]):
+        y = y + gates[r][:, None].astype(opp.dtype) * opp[slot_ids[r]]
+    return y
+
+
+def _gather_combine_fwd(op, gates, slot_ids, inv, valid):
+    return (_gather_combine(op, gates, slot_ids, inv, valid),
+            (op, gates, slot_ids, inv, valid))
+
+
+def _gather_combine_bwd(res, dy):
+    import numpy as np
+    op, gates, slot_ids, inv, valid = res
+    S = op.shape[0]
+    gates_slot = jnp.zeros((S,), jnp.float32)
+    for r in range(gates.shape[0]):
+        gates_slot = gates_slot.at[slot_ids[r]].set(gates[r], mode="drop")
+    d_op = (jnp.where(valid, gates_slot, 0.0)[:, None]
+            * dy.astype(jnp.float32)[inv]).astype(op.dtype)
+    opp = jnp.concatenate([op, jnp.zeros((1, op.shape[1]), op.dtype)], 0)
+    dyf = dy.astype(jnp.float32)
+    d_gates = jnp.stack([
+        jnp.sum(dyf * opp[slot_ids[r]].astype(jnp.float32), axis=-1)
+        for r in range(gates.shape[0])])
+    f0 = lambda a: np.zeros(a.shape, jax.dtypes.float0)  # noqa: E731
+    return d_op, d_gates, f0(slot_ids), f0(inv), f0(valid)
+
+
+_gather_combine.defvjp(_gather_combine_fwd, _gather_combine_bwd)
+
+
 @dataclasses.dataclass
 class MoEMLP:
     """Per-expert FFN bank (num_experts_local, hidden, ffn) — GEMMs stay
@@ -234,16 +326,16 @@ def moe_layer(
         logits, capacity, k, normalize_gates=normalize_gates,
         priority=priority)
 
-    # Dispatch as an O(T·d) row scatter into (E·C + 1, d) — the last row
-    # is the dump slot dropped assignments write into. Slot ids are unique
-    # across rounds (counts carry over), so `.set` semantics hold; `.add`
-    # keeps the dump row well-defined. The GShard one-hot einsum this
-    # replaces materialized (T, E, C) masks — quadratic in tokens and 5×
-    # the expert FFN's FLOPs at flagship scale (PERF.md r3).
-    buf = jnp.zeros((E * capacity + 1, d), xt.dtype)
-    for r in range(k):
-        buf = buf.at[slot_ids[r]].add(xt)
-    expert_in = buf[:-1].reshape(E, capacity, d)
+    # Dispatch/combine as row GATHERS in both directions (forward AND
+    # cotangent): slot uniqueness makes the assignment a permutation, so
+    # the slot→token inverse turns the O(T·d) row scatter — and the
+    # scatter its transpose would emit — into gathers (custom VJPs above;
+    # the scatter formulation cost ~62 ms/step at flagship MoE scale).
+    # The GShard one-hot einsum both replace materialized (T, E, C) masks
+    # — quadratic in tokens and 5× the expert FFN's own FLOPs (PERF.md r3).
+    inv, valid = _slot_inverse(slot_ids, gates, E * capacity)
+    expert_in = _gather_dispatch(xt, slot_ids, inv, valid
+                                 ).reshape(E, capacity, d)
 
     if axis_name:
         # (E, C, d) -> (ep, e_local, C, d) -> a2a -> (e_local, ep*C, d):
@@ -259,12 +351,6 @@ def moe_layer(
     else:
         expert_out = _expert_ffn(params, expert_in)
 
-    # Combine as a gather: y_t = Σ_r gate_r(t) · expert_out[slot_r(t)]
-    # (the dump row contributes with gate 0 — masked anyway for safety).
-    flat_out = jnp.concatenate(
-        [expert_out.reshape(E * capacity, d),
-         jnp.zeros((1, d), expert_out.dtype)], 0)
-    y = jnp.zeros_like(xt)
-    for r in range(k):
-        y = y + gates[r][:, None].astype(xt.dtype) * flat_out[slot_ids[r]]
-    return y.reshape(*lead, d), aux
+    y = _gather_combine(expert_out.reshape(E * capacity, d), gates,
+                        slot_ids, inv, valid)
+    return y.reshape(*lead, d).astype(x.dtype), aux

@@ -92,6 +92,58 @@ class TestFlashAttention:
             np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-4)
 
 
+class TestLseCarrierForms:
+    """flash_bwd / flash_bwd_bshd accept lse as the sliced row vector OR
+    the (…, LANES) lane carrier flash_fwd(full_lse=True) returns — both
+    must produce identical grads (the custom-VJP residuals keep the
+    carrier to skip a slice/re-broadcast pair per layer)."""
+
+    @pytest.mark.pallas
+    def test_sliced_vs_carrier_identical(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        from apex_tpu.ops.pallas import attention as A
+
+        q = jr.normal(K, (2, 256, 64)).astype(jnp.float32)
+        k = jr.normal(jr.fold_in(K, 41), (2, 256, 64))
+        v = jr.normal(jr.fold_in(K, 42), (2, 256, 64))
+        do = jr.normal(jr.fold_in(K, 43), (2, 256, 64))
+        with jax.default_matmul_precision("highest"):
+            o, lse = A.flash_fwd(q, k, v, scale=0.125, causal=True,
+                                 interpret=True)
+            o2, lse_c = A.flash_fwd(q, k, v, scale=0.125, causal=True,
+                                    full_lse=True, interpret=True)
+            np.testing.assert_array_equal(o, o2)
+            np.testing.assert_array_equal(lse, lse_c[..., 0])
+            g_sliced = A.flash_bwd(q, k, v, o, lse, do, scale=0.125,
+                                   causal=True, interpret=True)
+            g_carrier = A.flash_bwd(q, k, v, o, lse_c, do, scale=0.125,
+                                    causal=True, interpret=True)
+        for a, e in zip(g_carrier, g_sliced):
+            np.testing.assert_array_equal(a, e)
+
+    @pytest.mark.pallas
+    def test_bshd_sliced_vs_carrier_identical(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        from apex_tpu.ops.pallas import attention as A
+
+        q = jr.normal(K, (2, 256, 4, 16)).astype(jnp.float32)
+        k = jr.normal(jr.fold_in(K, 44), (2, 256, 2, 16))
+        v = jr.normal(jr.fold_in(K, 45), (2, 256, 2, 16))
+        do = jr.normal(jr.fold_in(K, 46), (2, 256, 4, 16))
+        with jax.default_matmul_precision("highest"):
+            o, lse = A.flash_fwd_bshd(q, k, v, scale=0.25, causal=False,
+                                      interpret=True)
+            _, lse_c = A.flash_fwd_bshd(q, k, v, scale=0.25, causal=False,
+                                        full_lse=True, interpret=True)
+            np.testing.assert_array_equal(lse, lse_c[..., 0])
+            g_sliced = A.flash_bwd_bshd(q, k, v, o, lse, do, scale=0.25,
+                                        causal=False, interpret=True)
+            g_carrier = A.flash_bwd_bshd(q, k, v, o, lse_c, do, scale=0.25,
+                                         causal=False, interpret=True)
+        for a, e in zip(g_carrier, g_sliced):
+            np.testing.assert_array_equal(a, e)
+
+
 class TestGroupedQueryAttention:
     """GQA/MQA: kv with fewer heads than q — beyond the reference's fmha
     (which requires equal head counts). Oracle: full MHA on repeated kv."""

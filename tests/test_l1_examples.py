@@ -141,7 +141,7 @@ class TestImagenetExampleL1:
 
 
 class TestDcganExampleL1:
-    @pytest.mark.parametrize("opt_level", ["O0", "O1", "O2"])
+    @pytest.mark.parametrize("opt_level", OPT_LEVELS)
     def test_opt_level_cell(self, dcgan, opt_level):
         rec = dcgan.train(_dcgan_args(dcgan, opt_level), verbose=False)
         d = np.asarray(rec["loss_d"])
@@ -168,7 +168,7 @@ def test_regenerate_example_baselines(imagenet, dcgan):
         with open(os.path.join(BASELINE_DIR, f"imagenet_{o}.json"), "w") as f:
             json.dump(rec, f, indent=1)
         print(f"imagenet_{o}: final {rec['loss'][-1]:.4f}")
-    for o in ["O0", "O1", "O2"]:
+    for o in OPT_LEVELS:
         rec = dcgan.train(_dcgan_args(dcgan, o), verbose=False)
         with open(os.path.join(BASELINE_DIR, f"dcgan_{o}.json"), "w") as f:
             json.dump(rec, f, indent=1)
