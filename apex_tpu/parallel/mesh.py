@@ -198,6 +198,63 @@ def _build_mesh(devices, dp, ep, pp, cp, tp) -> Mesh:
                 (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS))
 
 
+def hybrid_device_order(devices: Sequence, model_parallel: int) -> list:
+    """Reorder ``devices`` so the model-parallel axes (the mesh's inner
+    ``model_parallel`` extent) stay INSIDE one slice's ICI and the
+    data-parallel axis (outermost) spans slices over DCN.
+
+    Multi-slice TPU pods expose ``device.slice_index``; within a slice,
+    ``device.id`` preserves the ICI torus order jax already provides. The
+    flat reshape in :func:`_build_mesh` then puts slice boundaries exactly
+    at dp-group boundaries — dp all-reduces ride DCN, tp/cp/pp/ep
+    collectives never leave a slice (the scaling-book hybrid recipe;
+    jax's ``mesh_utils.create_hybrid_device_mesh`` does the same
+    arrangement for the 2-level case).
+
+    Pure list-ordering (no Mesh construction) so it is testable with stub
+    devices. Raises if any slice's device count is not a multiple of
+    ``model_parallel`` — a model group straddling DCN is the exact layout
+    this function exists to prevent."""
+    slices: dict = {}
+    for d in devices:
+        slices.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    if len(slices) == 1:
+        return list(devices)  # single slice (or CPU): nothing to arrange
+    for idx, devs in slices.items():
+        if len(devs) % model_parallel:
+            raise RuntimeError(
+                f"slice {idx} holds {len(devs)} devices — not a multiple of "
+                f"the model-parallel extent ({model_parallel}); a tp/pp/cp "
+                f"group would straddle DCN")
+    out = []
+    for idx in sorted(slices):
+        out.extend(sorted(slices[idx], key=lambda d: d.id))
+    return out
+
+
+def make_hybrid_mesh(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    context_parallel_size: int = 1,
+    expert_parallel_size: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """:func:`make_mesh` with the multi-slice (DCN) device arrangement of
+    :func:`hybrid_device_order` applied first. On a single slice (or CPU)
+    this is exactly ``make_mesh``."""
+    if devices is None:
+        devices = jax.devices()
+    # the contiguous inner block of _build_mesh's reshape: ep sits just
+    # INSIDE dp in the 5-D layout, so ep all_to_alls are slice-local only
+    # if ep is part of the extent the slice-divisibility guard covers
+    inner = (expert_parallel_size * pipeline_model_parallel_size
+             * context_parallel_size * tensor_model_parallel_size)
+    return make_mesh(
+        tensor_model_parallel_size, pipeline_model_parallel_size,
+        context_parallel_size, expert_parallel_size,
+        devices=hybrid_device_order(devices, inner))
+
+
 def destroy_model_parallel() -> None:
     """Tear down global state (cf. ``parallel_state.py:555-580``)."""
     global _MESH, _SPEC

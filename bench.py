@@ -83,14 +83,20 @@ def build(impl: str, cfg_kwargs, donate: bool):
     return jax.jit(train_step, **jit_kwargs), params, opt_state
 
 
-def timeit(step, params, opt_state, tokens, targets, iters):
+def timeit(step, params, opt_state, tokens, targets, iters, passes=2):
+    """Min over ``passes`` timed loops — the remote tunnel adds ±2%
+    transient stalls; min-of-N is applied to BOTH impls so vs_baseline
+    stays symmetric."""
     params, opt_state, loss = step(params, opt_state, tokens, targets)  # compile+warm
     float(loss)  # host fetch: the only reliable device sync over the tunnel
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, opt_state, loss = step(params, opt_state, tokens, targets)
-    float(loss)  # forces completion of the whole dependent chain
-    return (time.perf_counter() - t0) / iters
+    best = float("inf")
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, loss = step(params, opt_state, tokens, targets)
+        float(loss)  # forces completion of the whole dependent chain
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
 
 
 def main():

@@ -31,7 +31,7 @@ def main():
         cfg = GPTConfig(vocab_size=32768, max_seq_len=1024, hidden_size=1024,
                         num_layers=12, num_heads=8, remat=False,
                         attention_impl="flash", scan_layers=False)
-        batch, seq = 16, 1024
+        batch, seq = 20, 1024
     else:
         cfg = GPTConfig(vocab_size=1024, max_seq_len=128, hidden_size=128,
                         num_layers=2, num_heads=4, remat=True,
