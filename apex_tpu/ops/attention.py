@@ -759,12 +759,26 @@ def ulysses_attention(
     qg, kg, vg = seq_to_head(q), seq_to_head(k), seq_to_head(v)
     s, h_loc = qg.shape[1], qg.shape[2]
 
-    def to_bh(x):  # (b, s, x_heads, d) -> (b*x_heads, s, d)
-        return x.transpose(0, 2, 1, 3).reshape(b * x.shape[2], s, d)
+    if bshd_kernel_ok(s, s, h_loc, d, qg.dtype):
+        # the all_to_all emits (b, s, h_loc, d) — exactly the kernels'
+        # seq-major bshd layout, so attention runs on it directly; the
+        # former unconditional bh-flat round trip (transpose+reshape on
+        # every operand and the output, plus their autodiff transposes)
+        # was pure layout traffic — the ~22% "head re-sharding" overhead
+        # PERF.md measured was mostly these, not the collectives
+        o = flash_attention(qg, kg, vg, causal=causal, scale=scale,
+                            impl=impl, layout="bshd")
+    else:
+        # bshd tiling ineligible (e.g. head_dim 64 with several local
+        # heads) — keep the flat-kernel path rather than letting the bshd
+        # XLA fallback materialize full (s, s) scores over the GATHERED
+        # sequence at exactly the long-context scale Ulysses targets
+        def to_bh(x):  # (b, s, x_heads, d) -> (b*x_heads, s, d)
+            return x.transpose(0, 2, 1, 3).reshape(b * x.shape[2], s, d)
 
-    o = flash_attention(to_bh(qg), to_bh(kg), to_bh(vg),
-                        causal=causal, scale=scale, impl=impl)
-    o = o.reshape(b, h_loc, s, d).transpose(0, 2, 1, 3)
+        o = flash_attention(to_bh(qg), to_bh(kg), to_bh(vg),
+                            causal=causal, scale=scale, impl=impl)
+        o = o.reshape(b, h_loc, s, d).transpose(0, 2, 1, 3)
     # (b, s, h/P, d) -> (b, s/P, h, d): gather heads, re-scatter sequence
     return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)

@@ -655,6 +655,31 @@ class TestUlyssesAttention:
                         v.transpose(0, 2, 1, 3), causal).transpose(0, 2, 1, 3)
         np.testing.assert_allclose(o, ref, rtol=RTOL, atol=ATOL)
 
+    @pytest.mark.pallas
+    def test_head_dim_64_multi_head_takes_flat_kernel(self, monkeypatch):
+        """Review catch: head_dim 64 with several local heads is bshd-
+        ineligible — Ulysses must route through the bh-flat kernel path
+        (impl='pallas' would raise on the bshd direct call), never the
+        bshd XLA fallback that materializes full gathered-seq scores."""
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        sp = 2
+        mesh = mesh_lib.make_mesh(context_parallel_size=sp)
+        B, S, H, D = 1, 256, 4, 64
+        q = jr.normal(K, (B, S, H, D)).astype(jnp.float32)
+        k = jr.normal(jr.fold_in(K, 61), (B, S, H, D))
+        v = jr.normal(jr.fold_in(K, 62), (B, S, H, D))
+        with jax.default_matmul_precision("highest"):
+            o = mesh_lib.shard_map(
+                lambda q, k, v: ulysses_attention(q, k, v, causal=True,
+                                                  impl="pallas"),
+                mesh=mesh,
+                in_specs=(P(None, "cp"),) * 3,
+                out_specs=P(None, "cp"),
+            )(q, k, v)
+            ref = dense_ref(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                            v.transpose(0, 2, 1, 3), True).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(o, ref, rtol=2e-5, atol=2e-5)
+
     @pytest.mark.parametrize("causal", [False, True])
     def test_grouped_kv_matches_dense(self, causal):
         """GQA through Ulysses: q and kv scatter their own head counts (kv
