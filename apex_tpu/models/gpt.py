@@ -87,10 +87,11 @@ class GPTConfig:
         if self.moe_num_experts is not None:
             if self.moe_num_experts < 2:
                 raise ValueError("moe_num_experts must be >= 2 (None = dense)")
-            if self.tp_size > 1:
+            if self.ffn % self.tp_size:
                 raise ValueError(
-                    "MoE composes with dp/ep/pp but not (yet) tp: experts "
-                    "shard over the ep axis; set tp_size=1")
+                    f"MoE with tensor parallelism shards each expert's ffn "
+                    f"dim: ffn ({self.ffn}) must be divisible by tp_size "
+                    f"({self.tp_size})")
         if self.attention_impl not in ("softmax", "flash", "naive"):
             raise ValueError(
                 f"attention_impl must be softmax|flash|naive, got "
@@ -153,7 +154,8 @@ class GPTModel:
         self.moe = c.moe_num_experts is not None
         if self.moe:
             from apex_tpu.transformer.moe import MoEMLP
-            self.moe_bank = MoEMLP(c.moe_num_experts, c.hidden_size, c.ffn)
+            self.moe_bank = MoEMLP(c.moe_num_experts, c.hidden_size, c.ffn,
+                                   tp_size=c.tp_size)
         self.embedding = tp_lib.VocabParallelEmbedding(
             c.vocab_size, c.hidden_size, tp_size=c.tp_size, axis_name=axis
         )
@@ -193,10 +195,11 @@ class GPTModel:
                 "ln2_b": jnp.zeros((c.hidden_size,), c.dtype),
             }
             if self.moe:
-                # the FULL expert bank; under expert parallelism shard the
-                # leading expert axis of w1/b1/w2/b2 over ep (router
-                # replicated) — cf. shard_params_for_tp's pattern
-                layer["moe"] = self.moe_bank.init(k[2], c.dtype)
+                # the FULL expert bank (this tp rank's ffn shard under tp);
+                # under expert parallelism shard the leading expert axis of
+                # w1/b1/w2/b2 over ep (router replicated) — cf.
+                # shard_params_for_tp's pattern
+                layer["moe"] = self.moe_bank.init(k[2], rank, c.dtype)
             else:
                 layer["mlp_up"] = self.mlp_up.init(k[2], rank, c.dtype)
                 layer["mlp_down"] = self.mlp_down.init(k[3], rank, c.dtype)
@@ -344,10 +347,20 @@ class GPTModel:
         if self.moe:
             from apex_tpu.transformer.moe import moe_layer
             c = self.config
+            if self.sp:
+                # Megatron-SP boundary: the residual stream is seq-sharded
+                # over tp; routing needs every rank to see identical full
+                # sequences (the expert ffn shards split the SAME tokens'
+                # GEMMs), so gather on entry and re-scatter on exit — the
+                # same all-gather/reduce-scatter placement the dense MLP's
+                # Col/Row linears use, hoisted around the whole MoE block.
+                x = self._sp_gather(x)
             y, aux = moe_layer(
                 p["moe"], x, k=c.moe_top_k,
                 capacity_factor=c.moe_capacity_factor,
-                axis_name=c.ep_axis, priority="gate")
+                axis_name=c.ep_axis, tp_axis=self.axis, priority="gate")
+            if self.sp:
+                y = self._sp_scatter(y)
             return y, aux
         h = self.mlp_up(p["mlp_up"], x)
         h = jax.nn.gelu(h, approximate=True)
@@ -381,7 +394,11 @@ class GPTModel:
         lay = dict(grads["layers"])
         for name in ("ln1_w", "ln1_b", "ln2_w", "ln2_b"):
             lay[name] = jax.lax.psum(lay[name], self.axis)
+        # moe layers have no mlp_down; their expert-bank grads come from
+        # FULL (gathered) sequences so need no tp sync (see _mlp)
         for mod in ("attn_out", "mlp_down"):
+            if mod not in lay:
+                continue
             m = dict(lay[mod])
             if "bias" in m:
                 m["bias"] = jax.lax.psum(m["bias"], self.axis)
@@ -602,6 +619,15 @@ def shard_params_for_tp(params, tp: int, config: GPTConfig):
             return split_qkv_like_rows(x)
         if "mlp_down" in name and "weight" in name:  # (L, hid, ffn)
             return jnp.stack(jnp.split(x, tp, axis=2))
+        if "moe" in name:
+            # expert banks shard each expert's ffn dim (MoEMLP tp layout):
+            # w1 (L, E, hid, ffn) col-, w2 (L, E, ffn, hid) row-, b1
+            # (L, E, ffn) alike; router (L, hid, E) and b2 (L, E, hid)
+            # replicate
+            if "w1" in name:
+                return jnp.stack(jnp.split(x, tp, axis=3))
+            if "b1" in name or "w2" in name:
+                return jnp.stack(jnp.split(x, tp, axis=2))
         return jnp.broadcast_to(x, (tp,) + x.shape)
 
     def split_qkv_like_rows(x):

@@ -168,6 +168,22 @@ def router_topk(
             combine[:, :-1].reshape(T, E, capacity), aux)
 
 
+def slot_ids_are_unique(slot_ids, num_slots) -> jax.Array:
+    """Debug invariant behind :func:`_slot_inverse` and the gather
+    dispatch/combine VJPs: every real (< ``num_slots``) slot id appears AT
+    MOST ONCE across all k rounds. :func:`router_topk_sparse` guarantees it
+    (the per-expert slot cumsum carries ``counts`` across rounds, so two
+    assignments can never land on the same (expert, position)); a future
+    router emitting duplicates would silently drop tokens in the
+    ``mode='drop'`` scatters and corrupt the hand-written VJPs. Returns a
+    traced bool — assert it in tests / under a debug flag whenever the
+    routing logic changes (tests/test_moe.py::TestRouter does)."""
+    flat = slot_ids.reshape(-1)
+    counts = jnp.zeros((num_slots + 1,), jnp.int32).at[
+        jnp.clip(flat, 0, num_slots)].add(1)
+    return jnp.all(counts[:num_slots] <= 1)
+
+
 def _slot_inverse(slot_ids, gates, num_slots):
     """Invert the token→slot assignment: slot ids are UNIQUE across rounds
     (the slot cumsum carries counts over), so the (T, d) dispatch scatter is
@@ -263,30 +279,63 @@ _gather_combine.defvjp(_gather_combine_fwd, _gather_combine_bwd)
 @dataclasses.dataclass
 class MoEMLP:
     """Per-expert FFN bank (num_experts_local, hidden, ffn) — GEMMs stay
-    batched over experts so the MXU sees (E·C, hidden) x (hidden, ffn)."""
+    batched over experts so the MXU sees (E·C, hidden) x (hidden, ffn).
+
+    ``tp_size > 1``: each expert's FFN is tensor-parallel over its ffn dim
+    (w1 column-sharded, w2 row-sharded — the same Col→Row split the dense
+    ``ParallelMLP`` uses, reference ``standalone_gpt.py:236``); b2 is
+    replicated and added after the tp reduce. Composes orthogonally with
+    expert parallelism: ep shards *which experts* a device owns, tp shards
+    *each expert's* GEMMs."""
 
     num_experts: int
     hidden: int
     ffn: int
+    tp_size: int = 1
 
-    def init(self, key, dtype=jnp.float32):
+    @property
+    def ffn_per_partition(self) -> int:
+        if self.ffn % self.tp_size:
+            raise ValueError(
+                f"ffn ({self.ffn}) must be divisible by tp_size "
+                f"({self.tp_size}) for tensor-parallel experts")
+        return self.ffn // self.tp_size
+
+    def init(self, key, rank: int = 0, dtype=jnp.float32):
+        """This tp rank's shard. The full (tp=1) bank is generated and
+        sliced so a per-rank init equals the corresponding slice of a
+        replicated init (the ``shard_params_for_tp`` contract)."""
         k1, k2, k3 = jax.random.split(key, 3)
         s1 = (2.0 / self.hidden) ** 0.5
         s2 = (2.0 / self.ffn) ** 0.5
+        fp = self.ffn_per_partition
+        sl = slice(rank * fp, (rank + 1) * fp)
+        w1 = jax.random.normal(
+            k1, (self.num_experts, self.hidden, self.ffn), dtype) * s1
+        w2 = jax.random.normal(
+            k2, (self.num_experts, self.ffn, self.hidden), dtype) * s2
         return {
             "router": jax.random.normal(k3, (self.hidden, self.num_experts), dtype) * 0.02,
-            "w1": jax.random.normal(k1, (self.num_experts, self.hidden, self.ffn), dtype) * s1,
-            "b1": jnp.zeros((self.num_experts, self.ffn), dtype),
-            "w2": jax.random.normal(k2, (self.num_experts, self.ffn, self.hidden), dtype) * s2,
+            "w1": w1[:, :, sl],
+            "b1": jnp.zeros((self.num_experts, fp), dtype),
+            "w2": w2[:, sl, :],
             "b2": jnp.zeros((self.num_experts, self.hidden), dtype),
         }
 
 
-def _expert_ffn(params, x_ecd):
-    """(E_local, C', d) through each expert's two-layer GELU FFN."""
+def _expert_ffn(params, x_ecd, tp_axis=None):
+    """(E_local, C', d) through each expert's two-layer GELU FFN. With
+    ``tp_axis`` the ffn dim is sharded over it: the input enters through
+    copy-to-region (identity fwd, psum bwd) and the partial products leave
+    through reduce-from-region (psum fwd, identity bwd) — the Megatron
+    Col→Row collective placement, expert-batched."""
+    from apex_tpu.transformer.tensor_parallel import mappings
+    x_ecd = mappings.copy_to_tensor_model_parallel_region(x_ecd, tp_axis)
     h = jnp.einsum("ecd,edf->ecf", x_ecd, params["w1"]) + params["b1"][:, None, :]
     h = jax.nn.gelu(h, approximate=True)
-    return jnp.einsum("ecf,efd->ecd", h, params["w2"]) + params["b2"][:, None, :]
+    y = jnp.einsum("ecf,efd->ecd", h, params["w2"])
+    y = mappings.reduce_from_tensor_model_parallel_region(y, tp_axis)
+    return y + params["b2"][:, None, :]
 
 
 def moe_layer(
@@ -296,6 +345,7 @@ def moe_layer(
     k: int = 2,
     capacity_factor: float = 1.25,
     axis_name: Optional[str] = None,
+    tp_axis: Optional[str] = None,
     normalize_gates: bool = True,
     priority: str = "gate",
 ) -> Tuple[jax.Array, dict]:
@@ -306,6 +356,10 @@ def moe_layer(
     axis — ``params['w1']`` etc. hold this device's ``E_local`` experts and
     the router logits cover all ``E_local · axis_size`` experts. Dispatched
     blocks take one ``all_to_all`` to the expert owners and one back.
+
+    With ``tp_axis``: each expert's ffn dim is additionally sharded over
+    that axis (see :class:`MoEMLP`); routing/dispatch/combine run
+    replicated across tp — only the expert GEMMs split.
     """
     lead = x.shape[:-1]
     d = x.shape[-1]
@@ -343,13 +397,14 @@ def moe_layer(
         blocks = expert_in.reshape(ep, e_local, capacity, d)
         blocks = jax.lax.all_to_all(blocks, axis_name, split_axis=0,
                                     concat_axis=2, tiled=True)
-        out = _expert_ffn(params, blocks.reshape(e_local, ep * capacity, d))
+        out = _expert_ffn(params, blocks.reshape(e_local, ep * capacity, d),
+                          tp_axis)
         out = out.reshape(1, e_local, ep * capacity, d)
         out = jax.lax.all_to_all(out, axis_name, split_axis=2,
                                  concat_axis=0, tiled=True)
         expert_out = out.reshape(E, capacity, d)
     else:
-        expert_out = _expert_ffn(params, expert_in)
+        expert_out = _expert_ffn(params, expert_in, tp_axis)
 
     y = _gather_combine(expert_out.reshape(E * capacity, d), gates,
                         slot_ids, inv, valid)

@@ -100,15 +100,10 @@ class GPTPipeline:
         # dropout: supported — per-application keys fold from
         # (tick, pp rank, layer-in-chunk); pass `key` to loss_and_grads.
         # MoE: supported — the schedule's validity-masked aux accumulator
-        # threads the router losses differentiably (`aux_init`).
-        if getattr(c, "ep_axis", None) is not None:
-            # the partitioner carries no ep dimension for the expert banks
-            # (each stage holds its full banks); expert-parallel all_to_alls
-            # inside a pipeline stage need an ep-aware partition first
-            raise NotImplementedError(
-                "GPTPipeline supports MoE with replicated expert banks "
-                "(ep_axis=None); expert parallelism inside the pipeline is "
-                "not wired — drop ep_axis or use dp/ep without pp")
+        # threads the router losses differentiably (`aux_init`), and with
+        # ``config.ep_axis`` set the expert banks' E axis is sharded over
+        # ep (param_specs) while the two all_to_alls run stage-local inside
+        # the scanned tick — ep composes with pp/tp/dp in ONE program.
 
     @property
     def layers_per_chunk(self) -> int:
@@ -160,18 +155,30 @@ class GPTPipeline:
     def param_specs(self, pipe_params: PyTree, *leading) -> PyTree:
         """PartitionSpecs matching a :meth:`partition` output: stage leaves
         sharded over ``pp`` on their stage axis, embed/head replicated over
-        pp. ``leading`` axis names (e.g. ``'tp'``) are prepended to every
-        spec for trees carrying extra leading mesh axes (the
+        pp. With ``config.ep_axis`` set, the expert banks' E axis (just
+        after the per-stage layer axis) additionally shards over ep —
+        inside shard_map each device then holds its stage's slice of ITS
+        experts only. ``leading`` axis names (e.g. ``'tp'``) are prepended
+        to every spec for trees carrying extra leading mesh axes (the
         ``shard_params_for_tp`` → ``jax.vmap(partition)`` composition)."""
         from jax.sharding import PartitionSpec as P
-        stage_spec = P(*leading,
-                       *((None,) if self.virtual_chunks > 1 else ()),
-                       self.pp_axis)
+        pre = (*leading, *((None,) if self.virtual_chunks > 1 else ()))
+        stage_spec = P(*pre, self.pp_axis)
+        ep_ax = getattr(self.model.config, "ep_axis", None)
+        expert_spec = P(*pre, self.pp_axis, None, ep_ax)
         rep = P(*leading)
+
+        def stage_leaf(path, _):
+            names = {q.key for q in path if hasattr(q, "key")}
+            if (ep_ax is not None and "moe" in names
+                    and names & {"w1", "b1", "w2", "b2"}):
+                return expert_spec
+            return stage_spec
+
         return {
             "embed": jax.tree.map(lambda _: rep, pipe_params["embed"]),
-            "stages": jax.tree.map(lambda _: stage_spec,
-                                   pipe_params["stages"]),
+            "stages": jax.tree_util.tree_map_with_path(
+                stage_leaf, pipe_params["stages"]),
             "head": jax.tree.map(lambda _: rep, pipe_params["head"]),
         }
 
@@ -257,7 +264,11 @@ class GPTPipeline:
         shaped like ``pipe_params`` in ``accum_dtype`` (fp32 main-grad
         accumulation across microbatch ticks, cf.
         ``schedules._main_grad_cast``). ``dp_axis`` adds the data-parallel
-        pmean of loss and grads. ``key`` enables dropout (required when
+        pmean of loss and grads. With ``config.ep_axis`` set the ep axis is
+        ALWAYS reduced over (it is a data axis carrying different batch
+        rows per shard): loss/replicated-param grads pmean over ep, while
+        expert-bank grads — sharded, already group-summed by the a2a
+        transpose — are normalized by 1/ep. ``key`` enables dropout (required when
         ``config.dropout > 0``): keys fold per (tick, stage, layer) so
         every (microbatch, layer) application draws a distinct mask, and
         when ``dp_axis`` is given the dp rank folds in here too — data-
@@ -270,11 +281,15 @@ class GPTPipeline:
         memory. Train long-context dropout-free (the flagship does) or
         budget for the O(s²) activations."""
         model, v = self.model, self.virtual_chunks
+        ep_ax = getattr(model.config, "ep_axis", None)
         if model.config.dropout > 0 and key is None:
             raise ValueError(
                 "config.dropout > 0 requires a `key` for loss_and_grads")
         if key is not None and dp_axis is not None:
             key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
+        if key is not None and ep_ax is not None:
+            # ep is a data axis (each ep shard holds different batch rows)
+            key = jax.random.fold_in(key, jax.lax.axis_index(ep_ax))
         e_acc, e_down = schedules._main_grad_cast(
             pipe_params["embed"], accum_dtype)
         s_acc, s_down = schedules._main_grad_cast(
@@ -334,6 +349,31 @@ class GPTPipeline:
             # slice each (cf. GPTModel.sp_grad_sync)
             synced = model.sp_grad_sync({"layers": g["stages"]})
             g["stages"] = synced["layers"]
+
+        if ep_ax is not None:
+            # ep is data parallelism for everything EXCEPT the expert
+            # banks: replicated params need the pmean over ep like any
+            # data axis, while each ep shard's expert-bank grads already
+            # hold the whole ep group's token contributions (the a2a
+            # transpose routed them in) — the group-mean objective only
+            # needs the 1/ep normalization, no collective.
+            ep_size = jax.lax.axis_size(ep_ax)
+            loss = jax.lax.pmean(loss, ep_ax)
+
+            def ep_stage_leaf(path, x):
+                names = {q.key for q in path if hasattr(q, "key")}
+                if "moe" in names and names & {"w1", "b1", "w2", "b2"}:
+                    return x / ep_size
+                return jax.lax.pmean(x, ep_ax)
+
+            g["stages"] = jax.tree_util.tree_map_with_path(
+                ep_stage_leaf, g["stages"])
+            g["embed"] = jax.tree.map(
+                lambda x: jax.lax.pmean(x, ep_ax), g["embed"])
+            g["head"] = jax.tree.map(
+                lambda x: jax.lax.pmean(x, ep_ax), g["head"])
+            if aux is not None:
+                aux = jax.tree.map(lambda x: jax.lax.pmean(x, ep_ax), aux)
 
         if dp_axis is not None:
             loss = jax.lax.pmean(loss, dp_axis)

@@ -105,6 +105,69 @@ class TestExpertParallel:
 
 
 class TestMoEGrads:
+    def test_slot_ids_unique_invariant(self):
+        """The invariant the gather dispatch/combine VJPs depend on
+        (ADVICE r3 #1): across all k rounds, no real slot id repeats —
+        checked over adversarial routings (over-subscribed expert, uniform
+        logits, random)."""
+        from apex_tpu.transformer.moe import (router_topk_sparse,
+                                              slot_ids_are_unique)
+
+        cases = [
+            jr.normal(jr.fold_in(K, 40), (64, 4)),
+            jnp.zeros((64, 4)),
+            jnp.tile(jnp.array([[9.0, 1.0, 0.0, 0.0]]), (64, 1)),
+        ]
+        for cap in (1, 4, 16):
+            for logits in cases:
+                for prio in ("gate", "token"):
+                    slot_ids, _, _ = router_topk_sparse(
+                        logits, cap, k=2, priority=prio)
+                    assert bool(slot_ids_are_unique(slot_ids, 4 * cap)), (
+                        cap, prio)
+
+    def test_gather_vjps_match_scatter_autodiff(self):
+        """Grad-parity regression (ADVICE r3 #2): the hand-written
+        _gather_dispatch/_gather_combine VJPs against plain autodiff of the
+        scatter/add formulation they replaced."""
+        from apex_tpu.transformer.moe import (_gather_combine,
+                                              _gather_dispatch,
+                                              _slot_inverse,
+                                              router_topk_sparse)
+
+        T, H, E, cap = 32, 16, 4, 8
+        S = E * cap
+        logits = jr.normal(jr.fold_in(K, 41), (T, E))
+        slot_ids, gates, _ = router_topk_sparse(logits, cap, k=2)
+        inv, valid = _slot_inverse(slot_ids, gates, S)
+        xt = jr.normal(jr.fold_in(K, 42), (T, H))
+        w = jr.normal(jr.fold_in(K, 43), (H, H)) * 0.3
+
+        def scatter_moe(xt, w):
+            # the pre-r3 formulation: row scatter in, gather+weight out
+            buf = jnp.zeros((S + 1, H)).at[slot_ids[0]].add(xt)
+            buf = buf.at[slot_ids[1]].add(xt)
+            op = jnp.tanh(buf[:S] @ w)
+            opp = jnp.concatenate([op, jnp.zeros((1, H))], 0)
+            y = (gates[0][:, None] * opp[slot_ids[0]]
+                 + gates[1][:, None] * opp[slot_ids[1]])
+            return jnp.sum(y ** 2)
+
+        def gather_moe(xt, w):
+            ein = _gather_dispatch(xt, slot_ids, inv, valid)
+            op = jnp.tanh(ein @ w)
+            y = _gather_combine(op, gates, slot_ids, inv, valid)
+            return jnp.sum(y ** 2)
+
+        # forward parity first (dispatch differs on the dump row only)
+        np.testing.assert_allclose(float(gather_moe(xt, w)),
+                                   float(scatter_moe(xt, w)),
+                                   rtol=1e-5)
+        g_ref = jax.grad(scatter_moe, argnums=(0, 1))(xt, w)
+        g_got = jax.grad(gather_moe, argnums=(0, 1))(xt, w)
+        np.testing.assert_allclose(g_got[0], g_ref[0], rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(g_got[1], g_ref[1], rtol=1e-4, atol=1e-6)
+
     def test_grads_flow_to_experts_and_router(self):
         T, H, F, E = 32, 16, 32, 4
         bank = MoEMLP(E, H, F)
@@ -261,11 +324,65 @@ class TestGPTMoE:
             assert jnp.isfinite(aux[k_]), k_
         assert 0.0 <= float(aux["drop_fraction"]) <= 1.0
 
-    def test_moe_rejects_tp(self):
+    def test_moe_ffn_not_divisible_by_tp_raises(self):
         from apex_tpu.models import GPTConfig
 
-        with pytest.raises(ValueError, match="MoE composes"):
-            GPTConfig(**self.KW, moe_num_experts=4, tp_size=2)
+        with pytest.raises(ValueError, match="divisible by tp_size"):
+            GPTConfig(**self.KW, ffn_hidden_size=130, moe_num_experts=4,
+                      tp_size=4)
+
+    @pytest.mark.parametrize("sp", [False, True])
+    def test_gpt_moe_tp2_matches_tp1(self, sp):
+        """MoE x tensor parallelism: each expert's ffn dim is tp-sharded
+        (MoEMLP tp layout), routing replicated — loss and grads must match
+        the unsharded model. With sequence parallelism, _mlp gathers the
+        seq-sharded residual stream around the whole MoE block."""
+        from apex_tpu.models import GPTConfig, GPTModel
+        from apex_tpu.models.gpt import shard_params_for_tp
+
+        mesh = mesh_lib.make_mesh(tensor_model_parallel_size=2)
+        kw = dict(self.KW, moe_num_experts=4, moe_top_k=2,
+                  moe_capacity_factor=2.0)
+        cfg1 = GPTConfig(**kw)
+        cfg2 = GPTConfig(**kw, tp_size=2, sequence_parallel=sp)
+        m1, m2 = GPTModel(cfg1), GPTModel(cfg2)
+        params1 = m1.init(K)
+        toks = jr.randint(jr.fold_in(K, 70), (2, 16), 0, 64)
+        tgts = jr.randint(jr.fold_in(K, 71), (2, 16), 0, 64)
+
+        sharded = shard_params_for_tp(params1, 2, cfg1)
+        specs = jax.tree.map(lambda _: P("tp"), sharded)
+
+        def run(p, t, g):
+            loss, grads = jax.value_and_grad(m2.loss_fn)(
+                jax.tree.map(lambda x: x[0], p), t, g)
+            if m2.sp:
+                grads = m2.sp_grad_sync(grads)
+            return loss, jax.tree.map(lambda x: x[None], grads)
+
+        with jax.default_matmul_precision("highest"):
+            loss, grads = jax.jit(mesh_lib.shard_map(
+                run, mesh=mesh, in_specs=(specs, P(), P()),
+                out_specs=(P(), specs),
+            ))(sharded, toks, tgts)
+            ref_loss, ref = jax.value_and_grad(m1.loss_fn)(
+                params1, toks, tgts)
+
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=1e-5, atol=1e-6)
+        lay, ref_lay = grads["layers"]["moe"], ref["layers"]["moe"]
+        # replicated leaves hold the full grad on every shard
+        np.testing.assert_allclose(lay["router"][0], ref_lay["router"],
+                                   rtol=2e-4, atol=1e-5)
+        np.testing.assert_allclose(lay["b2"][0], ref_lay["b2"],
+                                   rtol=2e-4, atol=1e-5)
+        # ffn-sharded leaves: concat tp shards back to the full bank
+        np.testing.assert_allclose(
+            jnp.concatenate([lay["w1"][0], lay["w1"][1]], axis=3),
+            ref_lay["w1"], rtol=2e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            jnp.concatenate([lay["w2"][0], lay["w2"][1]], axis=2),
+            ref_lay["w2"], rtol=2e-4, atol=1e-5)
 
     def test_gpt_moe_through_pipeline_matches_serial(self):
         """MoE + pipeline composition: the schedule's validity-masked aux
@@ -328,3 +445,151 @@ class TestGPTMoE:
         np.testing.assert_allclose(
             got["layers"]["moe"]["w1"], ref_g["layers"]["moe"]["w1"],
             rtol=3e-4, atol=1e-5)
+
+
+class TestMoEPipelineEP:
+    """Expert parallelism INSIDE the pipeline — the axes compose in one
+    program (VERDICT r3 next-round #1): GPTPipeline partitions the expert
+    banks over ep via param_specs, the two all_to_alls run stage-local
+    inside the scanned tick, and loss_and_grads folds ep into the data
+    reduction."""
+
+    KW = dict(vocab_size=64, max_seq_len=16, hidden_size=32, num_layers=2,
+              num_heads=4)
+
+    def _oracle(self, cfg1, params, toks, tgts, shards, b):
+        """Mean loss/grads over per-(data-shard, microbatch) serial calls —
+        routing capacity is per call, matching each device's per-tick
+        token count."""
+        from apex_tpu.models import GPTModel
+
+        m = GPTModel(cfg1)
+        M = toks.shape[0]
+
+        def f(p):
+            per = []
+            for r in range(shards):
+                sl = slice(r * b, (r + 1) * b)
+                for i in range(M):
+                    per.append(m.loss_fn(p, toks[i, sl], tgts[i, sl]))
+            return jnp.mean(jnp.stack(per))
+
+        return jax.value_and_grad(f)(params)
+
+    def test_pp2_ep2_dp2_matches_serial_shards(self):
+        from apex_tpu.models import GPTConfig, GPTModel
+        from apex_tpu.transformer.pipeline_parallel import GPTPipeline
+
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=2,
+                                  expert_parallel_size=2)  # dp2 x ep2 x pp2
+        kw = dict(self.KW, moe_num_experts=4, moe_top_k=2,
+                  moe_capacity_factor=2.0, attention_impl="flash")
+        cfg1 = GPTConfig(**kw)
+        cfg = GPTConfig(**kw, ep_axis="ep")
+        m = GPTModel(cfg)
+        params = GPTModel(cfg1).init(K)
+        pipe = GPTPipeline(m, pp=2)
+        part = pipe.partition(params)
+        specs = pipe.param_specs(part)
+
+        M, b, s = 2, 2, 16
+        shards = 4  # dp x ep
+        toks = jr.randint(jr.fold_in(K, 80), (M, b * shards, s), 0, 64)
+        tgts = jr.randint(jr.fold_in(K, 81), (M, b * shards, s), 0, 64)
+
+        def run(p, toks, tgts):
+            lp = dict(p, stages=jax.tree.map(lambda x: x[0], p["stages"]))
+            loss, g = pipe.loss_and_grads(lp, toks, tgts, dp_axis="dp")
+            g["stages"] = jax.tree.map(lambda x: x[None], g["stages"])
+            return loss, g
+
+        with jax.default_matmul_precision("highest"):
+            loss, grads = jax.jit(mesh_lib.shard_map(
+                run, mesh=mesh,
+                in_specs=(specs, P(None, ("dp", "ep")),
+                          P(None, ("dp", "ep"))),
+                out_specs=(P(), specs),
+            ))(part, toks, tgts)
+            ref_loss, ref_g = self._oracle(cfg1, params, toks, tgts,
+                                           shards, b)
+
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+        got = pipe.unpartition(grads)
+        np.testing.assert_allclose(
+            got["layers"]["moe"]["router"], ref_g["layers"]["moe"]["router"],
+            rtol=3e-4, atol=1e-6)
+        np.testing.assert_allclose(
+            got["layers"]["moe"]["w1"], ref_g["layers"]["moe"]["w1"],
+            rtol=3e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            got["layers"]["moe"]["b2"], ref_g["layers"]["moe"]["b2"],
+            rtol=3e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            got["layers"]["qkv"]["weight"], ref_g["layers"]["qkv"]["weight"],
+            rtol=3e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            got["pos_embedding"], ref_g["pos_embedding"],
+            rtol=3e-4, atol=1e-6)
+
+    def test_tp2_pp2_ep2_one_mesh(self):
+        """The full 4-axis composition (dp x pp x tp x ep in ONE mesh/one
+        shard_map): tp shards each expert's ffn and the attention, pp the
+        layers, ep the expert banks."""
+        from apex_tpu.models import GPTConfig, GPTModel
+        from apex_tpu.models.gpt import shard_params_for_tp
+        from apex_tpu.transformer.pipeline_parallel import GPTPipeline
+
+        mesh = mesh_lib.make_mesh(
+            tensor_model_parallel_size=2, pipeline_model_parallel_size=2,
+            expert_parallel_size=2)  # dp1 x ep2 x pp2 x tp2
+        kw = dict(self.KW, moe_num_experts=4, moe_top_k=2,
+                  moe_capacity_factor=2.0, attention_impl="flash")
+        cfg1 = GPTConfig(**kw)
+        cfg = GPTConfig(**kw, tp_size=2, sequence_parallel=True,
+                        ep_axis="ep")
+        m = GPTModel(cfg)
+        params1 = GPTModel(cfg1).init(K)
+        pipe = GPTPipeline(m, pp=2)
+        part = jax.vmap(pipe.partition)(shard_params_for_tp(params1, 2, cfg1))
+        specs = pipe.param_specs(part, "tp")
+
+        M, b, s = 2, 2, 16
+        shards = 2  # ep (dp extent is 1)
+        toks = jr.randint(jr.fold_in(K, 90), (M, b * shards, s), 0, 64)
+        tgts = jr.randint(jr.fold_in(K, 91), (M, b * shards, s), 0, 64)
+
+        def run(p, toks, tgts):
+            lp = jax.tree.map(lambda x: x[0], p)  # strip tp
+            lp["stages"] = jax.tree.map(lambda x: x[0], lp["stages"])  # pp
+            loss, g = pipe.loss_and_grads(lp, toks, tgts, dp_axis="dp")
+            g["stages"] = jax.tree.map(lambda x: x[None, None], g["stages"])
+            g["embed"] = jax.tree.map(lambda x: x[None], g["embed"])
+            g["head"] = jax.tree.map(lambda x: x[None], g["head"])
+            return loss, g
+
+        with jax.default_matmul_precision("highest"):
+            loss, grads = jax.jit(mesh_lib.shard_map(
+                run, mesh=mesh,
+                in_specs=(specs, P(None, ("dp", "ep")),
+                          P(None, ("dp", "ep"))),
+                out_specs=(P(), specs),
+            ))(part, toks, tgts)
+            ref_loss, ref_g = self._oracle(cfg1, params1, toks, tgts,
+                                           shards, b)
+
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+        got = jax.vmap(pipe.unpartition)(grads)
+        # tp-replicated leaves: rank 0's tree against the oracle
+        np.testing.assert_allclose(
+            got["layers"]["moe"]["router"][0],
+            ref_g["layers"]["moe"]["router"], rtol=3e-4, atol=1e-6)
+        np.testing.assert_allclose(
+            got["layers"]["moe"]["b2"][0], ref_g["layers"]["moe"]["b2"],
+            rtol=3e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            got["lnf_w"][0], ref_g["lnf_w"], rtol=3e-4, atol=1e-5)
+        # ffn-sharded expert banks: concat the tp shards
+        np.testing.assert_allclose(
+            jnp.concatenate([got["layers"]["moe"]["w1"][0],
+                             got["layers"]["moe"]["w1"][1]], axis=-1),
+            ref_g["layers"]["moe"]["w1"], rtol=3e-4, atol=1e-5)
