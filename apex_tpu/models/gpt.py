@@ -221,9 +221,19 @@ class GPTModel:
         c = self.config
         h, d = c.local_heads, c.head_dim
         hkv = c.local_kv_heads
-        use_flash = c.attention_impl == "flash" and not (
-            c.dropout > 0 and key is not None  # flash path has no probs dropout
-        )
+        use_flash = c.attention_impl == "flash"
+        drop = c.dropout if (c.dropout > 0 and key is not None) else 0.0
+        seed = None
+        if drop > 0 and use_flash:
+            # in-kernel probs dropout seed: per (layer, op-slot 0) from the
+            # caller's folded key, plus the tp rank — each rank's heads
+            # draw decorrelated masks (Megatron's model-parallel RNG
+            # stream for attention dropout, tensor_parallel/random.py)
+            k0 = jax.random.fold_in(key, 0)
+            if self.axis is not None:
+                k0 = jax.random.fold_in(k0, jax.lax.axis_index(self.axis))
+            seed = jax.lax.bitcast_convert_type(
+                jax.random.bits(k0, (), jnp.uint32), jnp.int32)
         if use_flash:
             xg = self.qkv.gather_input(x)             # (b, s, H) full seq
             s_len = xg.shape[1]
@@ -253,8 +263,8 @@ class GPTModel:
                 # — kills the ~4.5 GB/step of XLA layout-conversion copies
                 # the composed formulation paid, PERF.md r3).
                 y = fused_qkv_attention(
-                    xc, w_qkv, b_qkv, w_out, h, hkv, d,
-                    1.0 / float(d) ** 0.5, True)
+                    xc, w_qkv, b_qkv, w_out, seed, h, hkv, d,
+                    1.0 / float(d) ** 0.5, True, drop)
                 y = self.attn_out.reduce_output(y)
                 if "bias" in p["attn_out"]:
                     y = y + p["attn_out"]["bias"]
@@ -275,7 +285,9 @@ class GPTModel:
                 q4 = qkv4[:, :h]
                 k4 = qkv4[:, h:h + hkv]
                 v4 = qkv4[:, h + hkv:]
-                ctx4 = flash_attention(q4, k4, v4, causal=True)
+                ctx4 = flash_attention(q4, k4, v4, causal=True,
+                                       dropout_rate=drop,
+                                       dropout_seed=seed)
                 return self.attn_out.headwise(p["attn_out"], ctx4)
             # Below the kernel crossover (or bias-less layers): seq-major
             # (bshd) einsums + the flash entry's XLA/Pallas dispatch. The
@@ -294,7 +306,8 @@ class GPTModel:
                 q = q + bias[:h * d].reshape(h, d)
                 k = k + bias[h * d:(h + hkv) * d].reshape(hkv, d)
                 v = v + bias[(h + hkv) * d:].reshape(hkv, d)
-            ctx = flash_attention(q, k, v, causal=True, layout="bshd")
+            ctx = flash_attention(q, k, v, causal=True, layout="bshd",
+                                  dropout_rate=drop, dropout_seed=seed)
             wo = p["attn_out"]["weight"].reshape(-1, h, d)
             y = jnp.einsum("bshd,Hhd->bsH", ctx, wo)
             y = self.attn_out.reduce_output(y)
@@ -563,7 +576,22 @@ class GPTModel:
 
 
 def _dropout(x, rate, key):
-    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    """Counter-hash dropout — the same PRNG family as the in-kernel
+    attention masks (``ops.pallas.attention.dropout_keep``): one scalar
+    threefry draw for the seed, then ~10 integer ops per element vs the
+    per-element threefry of ``jax.random.bernoulli`` (measured ~50 → ~3 ms
+    of residual-dropout cost per flagship train step, PERF.md r4)."""
+    from apex_tpu.ops.pallas.attention import dropout_keep
+    seed = jax.lax.bitcast_convert_type(
+        jax.random.bits(key, (), jnp.uint32), jnp.int32)
+    # (rows, cols) coordinates rather than one flat arange: a flat int32
+    # counter overflows at 2^31 elements (review r4) — splitting on the
+    # last axis keeps both coordinates small at any realistic shape
+    n = x.shape[-1]
+    rows = jnp.arange(x.size // n, dtype=jnp.int32)[:, None]
+    cols = jnp.arange(n, dtype=jnp.int32)[None, :]
+    keep = dropout_keep(seed, jnp.int32(0), rows, cols, rate
+                        ).reshape(x.shape)
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
 
 

@@ -26,10 +26,19 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+import numpy as _np
+
 from apex_tpu.amp.lists import apply_op_rules
 from apex_tpu.ops import _backend
 from apex_tpu.ops.pallas import attention as _k
 from apex_tpu.parallel import mesh as mesh_lib
+
+
+def _float0_like(x):
+    """Zero cotangent for an integer primal (kv_lens, dropout seeds):
+    custom-VJP backwards must return float0 for ints, None for absent."""
+    return (None if x is None
+            else _np.zeros(jnp.shape(x), jax.dtypes.float0))
 
 
 # --- single-device flash attention -------------------------------------------
@@ -61,10 +70,30 @@ def masked_scores(q, k, scale, causal, kv_lens=None):
     return s
 
 
-def _xla_attention(q, k, v, scale, causal, kv_lens=None):
+def _dropout_mask_scale_dense(seed, bh, sq, sk, rate):
+    """(bh, sq, sk) fp32 dropout multiplier from the SAME counter-based
+    hash the Pallas kernels evaluate blockwise (``pallas.attention
+    .dropout_keep``) — kernel and XLA dispatch produce BIT-IDENTICAL
+    masks, so the impl choice never changes a training run."""
+    t = jnp.arange(bh, dtype=jnp.int32)[:, None, None]
+    rows = jnp.arange(sq, dtype=jnp.int32)[None, :, None]
+    cols = jnp.arange(sk, dtype=jnp.int32)[None, None, :]
+    keep = _k.dropout_keep(jnp.asarray(seed, jnp.int32), t, rows, cols,
+                           rate)
+    return jnp.where(keep, jnp.float32(1.0 / (1.0 - rate)), 0.0)
+
+
+def _xla_attention(q, k, v, scale, causal, kv_lens=None,
+                   dropout_rate=0.0, dropout_seed=None):
     s = masked_scores(q, k, scale, causal, kv_lens)
     lse = jax.nn.logsumexp(s, axis=-1)
     p = jnp.exp(s - lse[..., None])
+    if dropout_rate > 0.0:
+        # probs dropout: the normalizer (lse) stays un-dropped, the
+        # weighted sum takes the masked, rescaled probabilities
+        p = p * _dropout_mask_scale_dense(
+            dropout_seed, s.shape[0], s.shape[-2], s.shape[-1],
+            dropout_rate)
     o = jnp.einsum("bqk,bkd->bqd", p.astype(q.dtype), v)
     if kv_lens is not None:
         # fully-masked rows: uniform-softmax garbage -> zeros, and pin lse
@@ -76,42 +105,56 @@ def _xla_attention(q, k, v, scale, causal, kv_lens=None):
     return o, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_core(q, k, v, kv_lens, scale, causal, use_pallas):
-    o, _ = _flash_fwd_res(q, k, v, kv_lens, scale, causal, use_pallas)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_core(q, k, v, kv_lens, dropout_seed, scale, causal, use_pallas,
+                dropout_rate):
+    o, _ = _flash_fwd_res(q, k, v, kv_lens, dropout_seed, scale, causal,
+                          use_pallas, dropout_rate)
     return o
 
 
-def _flash_fwd_res(q, k, v, kv_lens, scale, causal, use_pallas):
+def _flash_fwd_res(q, k, v, kv_lens, dropout_seed, scale, causal,
+                   use_pallas, dropout_rate):
     if use_pallas:
         # full_lse: the residual keeps the (bh, sq, LANES) carrier so the
         # backward kernel reads it as-is (no slice/re-broadcast round trip)
         o, lse = _k.flash_fwd(
             q, k, v, scale=scale, causal=causal, kv_lens=kv_lens,
             full_lse=True, interpret=_backend.interpret_mode(),
+            dropout_rate=dropout_rate, dropout_seed=dropout_seed,
         )
     else:
         group = q.shape[0] // k.shape[0]
         kf = jnp.repeat(k, group, 0) if group > 1 else k
         vf = jnp.repeat(v, group, 0) if group > 1 else v
-        o, lse = _xla_attention(q, kf, vf, scale, causal, kv_lens)
+        o, lse = _xla_attention(q, kf, vf, scale, causal, kv_lens,
+                                dropout_rate, dropout_seed)
     return o, (q, k, v, o, lse)
 
 
-def _flash_fwd(q, k, v, kv_lens, scale, causal, use_pallas):
-    o, res = _flash_fwd_res(q, k, v, kv_lens, scale, causal, use_pallas)
-    return o, (res, kv_lens)
+def _flash_fwd(q, k, v, kv_lens, dropout_seed, scale, causal, use_pallas,
+               dropout_rate):
+    o, res = _flash_fwd_res(q, k, v, kv_lens, dropout_seed, scale, causal,
+                            use_pallas, dropout_rate)
+    return o, (res, kv_lens, dropout_seed)
 
 
-def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, scale, causal, use_pallas):
+def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, scale, causal, use_pallas,
+                    dropout_rate=0.0, dropout_seed=None):
     """dq/dk/dv from saved (o, lse). With a *global* lse this is also the
     per-shard backward of distributed (ring) attention: p = exp(s − lse)
     and Δ = rowsum(do·o_final) are exact per shard, so each shard's ds —
-    and hence its dq/dk/dv contribution — needs no cross-shard state."""
+    and hence its dq/dk/dv contribution — needs no cross-shard state.
+
+    Dropout chain (S → P=softmax → Pd=mask∘P/(1-r) → O=Pd·V): the mask
+    regenerates from the same counter hash as forward; dV = Pdᵀ·dO and
+    dS = P ∘ (mask/(1-r) ∘ (dO·Vᵀ) − Δ) — Δ = rowsum(dO∘O) already equals
+    rowsum(Pd ∘ dPd), so only the dPd term re-masks."""
     if use_pallas:
         return _k.flash_bwd(
             q, k, v, o, lse, do, scale=scale, causal=causal, kv_lens=kv_lens,
             interpret=_backend.interpret_mode(),
+            dropout_rate=dropout_rate, dropout_seed=dropout_seed,
         )
     group = q.shape[0] // k.shape[0]
     kf = jnp.repeat(k, group, 0) if group > 1 else k
@@ -119,8 +162,16 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, scale, causal, use_pallas):
     s = masked_scores(q, kf, scale, causal, kv_lens)
     p = jnp.exp(s - lse[..., None])
     dof = do.astype(jnp.float32)
-    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+    if dropout_rate > 0.0:
+        ms = _dropout_mask_scale_dense(
+            dropout_seed, s.shape[0], s.shape[-2], s.shape[-1], dropout_rate)
+        pd = p * ms
+    else:
+        pd = p
+    dv = jnp.einsum("bqk,bqd->bkd", pd, dof)
     dp = jnp.einsum("bqd,bkd->bqk", dof, vf.astype(jnp.float32))
+    if dropout_rate > 0.0:
+        dp = dp * ms
     delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1, keepdims=True)
     ds = p * (dp - delta) * scale
     dq = jnp.einsum("bqk,bkd->bqd", ds, kf.astype(jnp.float32)).astype(q.dtype)
@@ -133,17 +184,13 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, scale, causal, use_pallas):
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _flash_bwd(scale, causal, use_pallas, res_and_lens, do):
-    res, kv_lens = res_and_lens
+def _flash_bwd(scale, causal, use_pallas, dropout_rate, res_pack, do):
+    res, kv_lens, dropout_seed = res_pack
     q, k, v, o, lse = res
     dq, dk, dv = _flash_bwd_impl(
-        q, k, v, o, lse, do, kv_lens, scale, causal, use_pallas)
-    if kv_lens is None:
-        dlens = None
-    else:
-        import numpy as np
-        dlens = np.zeros(kv_lens.shape, jax.dtypes.float0)
-    return dq, dk, dv, dlens
+        q, k, v, o, lse, do, kv_lens, scale, causal, use_pallas,
+        dropout_rate, dropout_seed)
+    return dq, dk, dv, _float0_like(kv_lens), _float0_like(dropout_seed)
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
@@ -173,18 +220,22 @@ def _from_bh(x, b, h):  # (b*h, s, d) -> (b, s, h, d)
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_core_bshd(q, k, v, scale, causal, use_pallas):
-    o, _ = _flash_fwd_res_bshd(q, k, v, scale, causal, use_pallas)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_core_bshd(q, k, v, dropout_seed, scale, causal, use_pallas,
+                     dropout_rate):
+    o, _ = _flash_fwd_res_bshd(q, k, v, dropout_seed, scale, causal,
+                               use_pallas, dropout_rate)
     return o
 
 
-def _flash_fwd_res_bshd(q, k, v, scale, causal, use_pallas):
+def _flash_fwd_res_bshd(q, k, v, dropout_seed, scale, causal, use_pallas,
+                        dropout_rate):
     if use_pallas:
         # carrier residual, same rationale as _flash_fwd_res
         o, lse = _k.flash_fwd_bshd(
             q, k, v, scale=scale, causal=causal, full_lse=True,
-            interpret=_backend.interpret_mode())
+            interpret=_backend.interpret_mode(),
+            dropout_rate=dropout_rate, dropout_seed=dropout_seed)
     else:
         b, h = q.shape[0], q.shape[2]
         group = h // k.shape[2]
@@ -195,31 +246,39 @@ def _flash_fwd_res_bshd(q, k, v, scale, causal, use_pallas):
         if group > 1:
             kf = jnp.repeat(kf, group, 0)
             vf = jnp.repeat(vf, group, 0)
-        o3, lse3 = _xla_attention(_to_bh(q), kf, vf, scale, causal)
+        o3, lse3 = _xla_attention(_to_bh(q), kf, vf, scale, causal, None,
+                                  dropout_rate, dropout_seed)
         o = _from_bh(o3, b, h)
         lse = lse3.reshape(b, h, -1)
     return o, (q, k, v, o, lse)
 
 
-def _flash_fwd_bshd(q, k, v, scale, causal, use_pallas):
-    o, res = _flash_fwd_res_bshd(q, k, v, scale, causal, use_pallas)
-    return o, res
+def _flash_fwd_bshd(q, k, v, dropout_seed, scale, causal, use_pallas,
+                    dropout_rate):
+    o, res = _flash_fwd_res_bshd(q, k, v, dropout_seed, scale, causal,
+                                 use_pallas, dropout_rate)
+    return o, (res, dropout_seed)
 
 
-def _flash_bwd_bshd(scale, causal, use_pallas, res, do):
+def _flash_bwd_bshd(scale, causal, use_pallas, dropout_rate, res_pack, do):
+    res, dropout_seed = res_pack
     q, k, v, o, lse = res
+    dseed = _float0_like(dropout_seed)
     if use_pallas:
-        return _k.flash_bwd_bshd(
+        dq, dk, dv = _k.flash_bwd_bshd(
             q, k, v, o, lse, do, scale=scale, causal=causal,
-            interpret=_backend.interpret_mode())
+            interpret=_backend.interpret_mode(),
+            dropout_rate=dropout_rate, dropout_seed=dropout_seed)
+        return dq, dk, dv, dseed
     b, h = q.shape[0], q.shape[2]
     h_kv = k.shape[2]
     dq3, dk3, dv3 = _flash_bwd_impl(
         _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(o),
         lse.reshape(b * h, -1), _to_bh(do), None, scale, causal,
-        use_pallas=False)
+        use_pallas=False, dropout_rate=dropout_rate,
+        dropout_seed=dropout_seed)
     return (_from_bh(dq3, b, h), _from_bh(dk3, b, h_kv),
-            _from_bh(dv3, b, h_kv))
+            _from_bh(dv3, b, h_kv), dseed)
 
 
 _flash_core_bshd.defvjp(_flash_fwd_bshd, _flash_bwd_bshd)
@@ -227,8 +286,9 @@ _flash_core_bshd.defvjp(_flash_fwd_bshd, _flash_bwd_bshd)
 
 # --- fused projection + attention block ---------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def fused_qkv_attention(x, w_qkv, b_qkv, w_out, h, h_kv, d, scale, causal):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def fused_qkv_attention(x, w_qkv, b_qkv, w_out, dropout_seed, h, h_kv, d,
+                        scale, causal, dropout_rate=0.0):
     """Packed-QKV projection → flash attention → output projection as ONE
     differentiable block in which every large contraction is a plain 2D
     GEMM over (tokens, features) folded views, and the flash kernels read
@@ -248,13 +308,17 @@ def fused_qkv_attention(x, w_qkv, b_qkv, w_out, h, h_kv, d, scale, causal):
     Returns (b, s, O) — the output-projection bias and (under tp) the
     partial-product reduce stay with the caller, matching
     ``RowParallelLinear``'s post-reduce bias order. Pallas-only (the
-    caller gates on kernel eligibility)."""
-    y, _ = _fused_attn_fwd(x, w_qkv, b_qkv, w_out, h, h_kv, d, scale,
-                           causal)
+    caller gates on kernel eligibility). ``dropout_rate > 0`` applies
+    in-kernel probs dropout (``dropout_seed`` required — pass None
+    otherwise); masks regenerate in backward from the same counter hash
+    (see ``pallas.attention.dropout_keep``)."""
+    y, _ = _fused_attn_fwd(x, w_qkv, b_qkv, w_out, dropout_seed, h, h_kv,
+                           d, scale, causal, dropout_rate)
     return y
 
 
-def _fused_attn_fwd(x, w_qkv, b_qkv, w_out, h, h_kv, d, scale, causal):
+def _fused_attn_fwd(x, w_qkv, b_qkv, w_out, dropout_seed, h, h_kv, d,
+                    scale, causal, dropout_rate=0.0):
     b, s, H = x.shape
     qkv = (jnp.dot(x.reshape(-1, H), w_qkv.T) + b_qkv).reshape(b, s, -1)
     # full_lse: keep the (b, h, s, LANES) lane carrier as the residual —
@@ -262,13 +326,14 @@ def _fused_attn_fwd(x, w_qkv, b_qkv, w_out, h, h_kv, d, scale, causal):
     # would force a re-broadcast there, one slice+broadcast pair per layer)
     o, lse = _k.flash_fwd_packed(
         qkv, h, h_kv, d, scale=scale, causal=causal, full_lse=True,
-        interpret=_backend.interpret_mode())
+        interpret=_backend.interpret_mode(),
+        dropout_rate=dropout_rate, dropout_seed=dropout_seed)
     y = jnp.dot(o.reshape(-1, h * d), w_out.T).reshape(b, s, -1)
-    return y, (x, qkv, o, lse, w_qkv, w_out)
+    return y, (x, qkv, o, lse, w_qkv, w_out, dropout_seed)
 
 
-def _fused_attn_bwd(h, h_kv, d, scale, causal, res, dy):
-    x, qkv, o, lse, w_qkv, w_out = res
+def _fused_attn_bwd(h, h_kv, d, scale, causal, dropout_rate, res, dy):
+    x, qkv, o, lse, w_qkv, w_out, dropout_seed = res
     b, s, H = x.shape
     T = b * s
     dy2 = dy.reshape(T, -1)
@@ -277,7 +342,8 @@ def _fused_attn_bwd(h, h_kv, d, scale, causal, res, dy):
     do = jnp.dot(dy2, w_out).reshape(b, s, h * d)
     dq, dk, dv = _k.flash_bwd_packed(
         qkv, h, h_kv, d, o, lse, do, scale=scale, causal=causal,
-        interpret=_backend.interpret_mode())
+        interpret=_backend.interpret_mode(),
+        dropout_rate=dropout_rate, dropout_seed=dropout_seed)
     x2 = x.reshape(T, H)
     dq2 = dq.reshape(T, -1)
     dk2 = dk.reshape(T, -1)
@@ -292,21 +358,18 @@ def _fused_attn_bwd(h, h_kv, d, scale, causal, res, dy):
     db_qkv = jnp.concatenate(
         [jnp.sum(dq2, 0), jnp.sum(dk2, 0), jnp.sum(dv2, 0)])
     return dx, dw_qkv.astype(w_qkv.dtype), db_qkv.astype(w_qkv.dtype), \
-        dw_out.astype(w_out.dtype)
+        dw_out.astype(w_out.dtype), _float0_like(dropout_seed)
 
 
-fused_qkv_attention.defvjp(
-    lambda x, wq, bq, wo, h, hk, d, sc, ca:
-        _fused_attn_fwd(x, wq, bq, wo, h, hk, d, sc, ca),
-    _fused_attn_bwd,
-)
+fused_qkv_attention.defvjp(_fused_attn_fwd, _fused_attn_bwd)
 
 
 def flash_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     *, causal: bool = False, scale: Optional[float] = None,
     kv_lens: Optional[jax.Array] = None, impl: str = "auto",
-    layout: str = "bhsd",
+    layout: str = "bhsd", dropout_rate: float = 0.0,
+    dropout_seed: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Blockwise attention over (..., seq, head_dim) with any number of
     leading batch/head dims. No sequence-length cap (cf. fmha's 512).
@@ -350,10 +413,27 @@ def flash_attention(
     copies sit between the projections and the kernels (the bh-flat layout
     cost the flagship ~4.5 GB/step of pure copies — PERF.md r3). Prefer it
     whenever q/k/v come straight from a (tokens, features) GEMM; kv_lens
-    is not supported in this layout."""
+    is not supported in this layout.
+
+    ``dropout_rate > 0`` applies IN-KERNEL probs dropout (the reference's
+    fused-attention capability, ``apex/contrib/csrc/fmha/fmha_api.cpp:44``):
+    masks come from a stateless counter hash of (seed, head, row, col) —
+    O(block) memory, regenerated in backward, bit-identical between the
+    Pallas and XLA dispatches, deterministic per ``dropout_seed`` (int32
+    scalar, required). The softmax normalizer is computed pre-dropout
+    (standard probs-dropout semantics: E[output] = no-dropout output)."""
     q, k, v = apply_op_rules("attention", q, k, v)
     if layout not in ("bhsd", "bshd"):
         raise ValueError(f"layout must be bhsd|bshd, got {layout!r}")
+    if dropout_rate > 0.0:
+        if not 0.0 < dropout_rate < 1.0:
+            raise ValueError(f"dropout_rate must be in [0, 1), got "
+                             f"{dropout_rate}")
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        dropout_seed = jnp.asarray(dropout_seed, jnp.int32)
+    else:
+        dropout_seed = None
     if layout == "bshd":
         if kv_lens is not None:
             raise NotImplementedError("kv_lens requires layout='bhsd'")
@@ -377,7 +457,8 @@ def flash_attention(
                 and not _backend.interpret_forced()):
             impl_ = "xla"
         use_pallas = _backend.choose_impl(impl_, ok) == "pallas"
-        return _flash_core_bshd(q, k, v, s_scale, causal, use_pallas)
+        return _flash_core_bshd(q, k, v, dropout_seed, s_scale, causal,
+                                use_pallas, dropout_rate)
     d = q.shape[-1]
     if causal and q.shape[-2] > k.shape[-2]:
         # bottom-right-aligned causal with sq > sk gives the first
@@ -431,7 +512,8 @@ def flash_attention(
         # int32 before the custom_vjp: backward returns a float0 cotangent,
         # which JAX only accepts for integer primals
         kv_lens = kv_lens.reshape(-1).astype(jnp.int32)
-    o = _flash_core(q3, k3, v3, kv_lens, scale, causal, use_pallas)
+    o = _flash_core(q3, k3, v3, kv_lens, dropout_seed, scale, causal,
+                    use_pallas, dropout_rate)
     return o.reshape(*lead, q.shape[-2], d)
 
 

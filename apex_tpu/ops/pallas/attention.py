@@ -32,6 +32,64 @@ from apex_tpu.ops.pallas import exact_block
 
 NEG_INF = -1e30
 
+# --- in-kernel attention dropout ---------------------------------------------
+#
+# The reference's fused attention kernels take a dropout probability inside
+# the kernel (``apex/contrib/csrc/fmha/fmha_api.cpp:44,80-83``, Philox
+# counters; ``apex/contrib/multihead_attn``'s fused softmax-dropout). The
+# TPU formulation replaces the stateful Philox stream with a STATELESS
+# counter-based hash of the global element coordinates: keep(t, row, col)
+# is a pure function of (seed, q-head index, score position), so
+# - forward and backward regenerate identical masks with zero saved state
+#   (the O(s²) mask tensor never exists — only (bq, bk) blocks in VMEM);
+# - the mask is independent of block sizes and of kernel vs XLA dispatch
+#   (the XLA fallback evaluates the same function — bit-identical masks);
+# - interpret-mode tests cover the real code path (pltpu.prng_random_bits
+#   has no interpret lowering in this jax; plain vector ops do).
+# Hash: murmur3's 32-bit finalizer (full avalanche) over a per-(seed, t)
+# key xor a unique per-element counter — splitmix-style, plenty for
+# Bernoulli masks.
+
+_U32 = jnp.uint32
+
+
+def _fmix32(h):
+    """murmur3 fmix32: bijective avalanche mix on uint32."""
+    h = h ^ (h >> _U32(16))
+    h = h * _U32(0x85EBCA6B)
+    h = h ^ (h >> _U32(13))
+    h = h * _U32(0xC2B2AE35)
+    h = h ^ (h >> _U32(16))
+    return h
+
+
+def dropout_keep(seed, t, rows, cols, rate):
+    """Bernoulli(1-rate) keep mask for score elements (rows, cols) of
+    q-head ``t``: uniform-in-[0,1) from the hash, compared in the integer
+    domain (Mosaic has no uint32->f32 cast). ``seed``/``t`` scalar int32
+    (traced ok); ``rows``/``cols`` int32 arrays of GLOBAL score
+    coordinates (broadcastable, e.g. (bq, 1) x (1, bk)); ``rate`` static.
+
+    Rows enter through their own fmix pass rather than a ``row·sk + col``
+    linear counter: the counter form wraps uint32 when sq·sk > 2^32, which
+    would hand row pairs 2^32/sk apart bit-identical masks exactly at the
+    long-context scale the kernels advertise (review r4). Per-row key
+    material costs one extra fmix32 on a (rows, 1) column — negligible."""
+    key = _fmix32(seed.astype(_U32) ^ (jnp.asarray(t).astype(_U32)
+                                       * _U32(0x9E3779B9)))
+    row_key = _fmix32(key ^ rows.astype(_U32))
+    thresh = _U32(min(1 << 24, int(round(rate * (1 << 24)))))
+    return (_fmix32(row_key ^ cols.astype(_U32)) >> _U32(8)) >= thresh
+
+
+def _mask_scale(seed, t, i, j, bq, bk, rate):
+    """(bq, bk) fp32 dropout multiplier (1/(1-rate) kept, 0 dropped) for
+    score block (i, j) — the shared fwd/bwd block recipe."""
+    rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+    keep = dropout_keep(seed, t, rows, cols, rate)
+    return jnp.where(keep, jnp.float32(1.0 / (1.0 - rate)), 0.0)
+
 
 def _blocks(n, b):
     return pl.cdiv(n, b)
@@ -50,7 +108,8 @@ def _fit_block(n, pref):
 
 # --- forward ------------------------------------------------------------------
 
-def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, varlen, bshd=False):
+def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, varlen, bshd=False,
+                rate=0.0):
     """``varlen`` is a STATIC specialization flag: without kv lengths the
     kernel carries no length operand, no per-block length select, and no
     dynamic predicate conjunct — the common (non-padded) call pays nothing.
@@ -58,11 +117,23 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, varlen, bshd=False):
     whose blocks are IDENTICAL to the bh-flat ones (a (bq, d) tile, the
     head picked by the block index along the folded feature dim), so only
     the lse carrier's rank differs ((b, h, sq, LANES) vs (bh, sq, LANES)).
+    ``rate > 0`` (static) adds in-kernel probs dropout: the softmax
+    normalizer ``l`` accumulates UN-dropped p (dropout applies to the
+    normalized probabilities), the output accumulator takes the masked,
+    1/(1-rate)-scaled p; masks come from :func:`dropout_keep` on global
+    coordinates and a seed operand in SMEM.
     """
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    n = 3
     if varlen:
-        q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        kvlen_ref = refs[n]
+        n += 1
+    if rate > 0.0:
+        seed_ref = refs[n]
+        n += 1
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = refs[n:]
+    t = pl.program_id(0)  # q-head row (dropout mask key)
     i = pl.program_id(1)  # q block
     j = pl.program_id(2)  # k block
 
@@ -104,8 +175,12 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, varlen, bshd=False):
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        if rate > 0.0:
+            pd = p * _mask_scale(seed_ref[0], t, i, j, bq, bk, rate)
+        else:
+            pd = p
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            pd.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_scr[:] = m_new
@@ -157,8 +232,19 @@ def _group_sum(x, h_kv, group, d, dtype):
         dtype).reshape(b, s, h_kv * d)
 
 
+def _seed_operand(dropout_seed):
+    """(1,) int32 SMEM operand from a scalar seed (traced or host)."""
+    if dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
+    return jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+
+
+_SMEM_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
 def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bq=1024, bk=1024,
-              full_lse=False, interpret=False):
+              full_lse=False, interpret=False, dropout_rate=0.0,
+              dropout_seed=None):
     """q (bh, sq, d); k/v (bh_kv, sk, d) where bh_kv divides bh — grouped-
     query attention falls out of the kv BlockSpec index maps (q row ``b``
     reads kv row ``b // group``), zero-copy: kv shards are never repeated
@@ -186,10 +272,14 @@ def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bq=1024, bk=1024,
         in_specs.append(
             pl.BlockSpec((1, 1, _LSE_LANES), lambda b, i, j: (b, 0, 0)))
         args.append(_kvlen_rows(kv_lens, bh))
+    if dropout_rate > 0.0:
+        in_specs.append(_SMEM_SPEC)
+        args.append(_seed_operand(dropout_seed))
 
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=varlen),
+                          bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=varlen,
+                          rate=dropout_rate),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=[
@@ -214,7 +304,8 @@ def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bq=1024, bk=1024,
 
 
 def flash_fwd_packed(qkv, h, h_kv, d, *, scale, causal, bq=1024, bk=1024,
-                     full_lse=False, interpret=False):
+                     full_lse=False, interpret=False, dropout_rate=0.0,
+                     dropout_seed=None):
     """Flash forward reading q/k/v directly out of the PACKED projection
     output: ``qkv`` (b, s, (h+2·h_kv)·d), features ordered q|k|v with heads
     contiguous inside each part. The same buffer rides in three times with
@@ -229,21 +320,27 @@ def flash_fwd_packed(qkv, h, h_kv, d, *, scale, causal, bq=1024, bk=1024,
     bq, bk = _fit_block(s, bq), _fit_block(s, bk)
     nq, nk = _blocks(s, bq), _blocks(s, bk)
 
+    args = [qkv, qkv, qkv]
+    in_specs = [
+        pl.BlockSpec((1, bq, d),
+                     lambda t, i, j, h=h: (t // h, i, t % h)),
+        pl.BlockSpec((1, bk, d),
+                     lambda t, i, j, h=h, g=group:
+                     (t // h, j, h + (t % h) // g)),
+        pl.BlockSpec((1, bk, d),
+                     lambda t, i, j, h=h, hk=h_kv, g=group:
+                     (t // h, j, h + hk + (t % h) // g)),
+    ]
+    if dropout_rate > 0.0:
+        in_specs.append(_SMEM_SPEC)
+        args.append(_seed_operand(dropout_seed))
+
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, off=0, varlen=False,
-                          bshd=True),
+                          bshd=True, rate=dropout_rate),
         grid=(b * h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d),
-                         lambda t, i, j, h=h: (t // h, i, t % h)),
-            pl.BlockSpec((1, bk, d),
-                         lambda t, i, j, h=h, g=group:
-                         (t // h, j, h + (t % h) // g)),
-            pl.BlockSpec((1, bk, d),
-                         lambda t, i, j, h=h, hk=h_kv, g=group:
-                         (t // h, j, h + hk + (t % h) // g)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d),
                          lambda t, i, j, h=h: (t // h, i, t % h)),
@@ -263,11 +360,11 @@ def flash_fwd_packed(qkv, h, h_kv, d, *, scale, causal, bq=1024, bk=1024,
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(qkv, qkv, qkv)
+    )(*args)
     return o, (lse if full_lse else lse[..., 0])
 
 
-def _bwd_single_block_kernel(*refs, scale, causal, n):
+def _bwd_single_block_kernel(*refs, scale, causal, n, rate=0.0):
     """Single-block fused backward: when the whole (sq == sk == n) matrix
     fits one block, dq/dk/dv come out of ONE kernel that computes the
     score matrix once — the two-kernel split (which exists only because
@@ -281,8 +378,13 @@ def _bwd_single_block_kernel(*refs, scale, causal, n):
     broadcast the result into the lane carrier — ~0.4 ms/layer of pure
     HBM traffic for a VPU rowsum the kernel gets for free (PERF.md r3).
     """
-    (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-     dq_ref, dk_ref, dv_ref) = refs
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref = refs[:6]
+    n_ = 6
+    if rate > 0.0:
+        seed_ref = refs[n_]
+        n_ += 1
+    dq_ref, dk_ref, dv_ref = refs[n_:]
     q = q_ref[0]
     k = k_ref[0]
     v = v_ref[0]
@@ -295,13 +397,20 @@ def _bwd_single_block_kernel(*refs, scale, causal, n):
         cols = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
         s = jnp.where(cols <= rows, s, NEG_INF)
     p = jnp.exp(s - lse_ref[0, 0][:, 0:1])
+    if rate > 0.0:
+        ms = _mask_scale(seed_ref[0], pl.program_id(0), 0, 0, n, n, rate)
+        pd = p * ms
+    else:
+        pd = p
     delta = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
                     axis=1, keepdims=True)
     dv_ref[0] = jax.lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32).astype(dv_ref.dtype)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    if rate > 0.0:
+        dp = dp * ms
     ds = (p * (dp - delta) * scale).astype(q.dtype)
     dq_ref[0] = jax.lax.dot_general(
         ds, k, (((1,), (0,)), ((), ())),
@@ -312,7 +421,8 @@ def _bwd_single_block_kernel(*refs, scale, causal, n):
 
 
 def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
-                     bq=1024, bk=1024, interpret=False):
+                     bq=1024, bk=1024, interpret=False, dropout_rate=0.0,
+                     dropout_seed=None):
     """Backward of :func:`flash_fwd_packed`: returns SEPARATE folded grads
     (dq (b, s, h·d), dk/dv (b, s, h_kv·d)) — the caller contracts each
     against its weight window (plain 2D GEMMs), never materializing a
@@ -339,16 +449,21 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
         # ADVICE r2 precision rule) and the group reduction happens outside,
         # where XLA fuses it into the output write.
         dkv_dt = jnp.float32 if group > 1 else qkv.dtype
+        sb_specs = [pl.BlockSpec((1, s, d), qm),
+                    pl.BlockSpec((1, s, d), km),
+                    pl.BlockSpec((1, s, d), vm),
+                    pl.BlockSpec((1, s, d), qm),
+                    pl.BlockSpec((1, s, d), qm),
+                    pl.BlockSpec((1, 1, s, _LSE_LANES), rm)]
+        sb_args = [qkv, qkv, qkv, do, o, lse4]
+        if dropout_rate > 0.0:
+            sb_specs.append(_SMEM_SPEC)
+            sb_args.append(_seed_operand(dropout_seed))
         dq, dk, dv = pl.pallas_call(
             functools.partial(_bwd_single_block_kernel, scale=scale,
-                              causal=causal, n=s),
+                              causal=causal, n=s, rate=dropout_rate),
             grid=(b * h,),
-            in_specs=[pl.BlockSpec((1, s, d), qm),
-                      pl.BlockSpec((1, s, d), km),
-                      pl.BlockSpec((1, s, d), vm),
-                      pl.BlockSpec((1, s, d), qm),
-                      pl.BlockSpec((1, s, d), qm),
-                      pl.BlockSpec((1, 1, s, _LSE_LANES), rm)],
+            in_specs=sb_specs,
             out_specs=[pl.BlockSpec((1, s, d), qm)] * 3,
             out_shape=[
                 jax.ShapeDtypeStruct((b, s, h * d), qkv.dtype),
@@ -358,7 +473,7 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel",)),
             interpret=interpret,
-        )(qkv, qkv, qkv, do, o, lse4)
+        )(*sb_args)
         if group > 1:
             dk = _group_sum(dk, h_kv, group, d, qkv.dtype)
             dv = _group_sum(dv, h_kv, group, d, qkv.dtype)
@@ -373,18 +488,21 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
         t // h, j, h + hk + (t % h) // g)
     dom = lambda t, i, j, h=h: (t // h, i, t % h)  # noqa: E731
     rm = lambda t, i, j, h=h: (t // h, t % h, i, 0)  # noqa: E731
+    seed_specs = [_SMEM_SPEC] if dropout_rate > 0.0 else []
+    seed_args = ([_seed_operand(dropout_seed)]
+                 if dropout_rate > 0.0 else [])
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, off=0, varlen=False,
-                          bshd=True),
+                          bshd=True, rate=dropout_rate),
         grid=(b * h, nq, nk),
         in_specs=[pl.BlockSpec((1, bq, d), qm),
                   pl.BlockSpec((1, bk, d), km),
                   pl.BlockSpec((1, bk, d), vm),
                   pl.BlockSpec((1, bq, d), dom),
                   pl.BlockSpec((1, 1, bq, _LSE_LANES), rm),
-                  pl.BlockSpec((1, 1, bq, _LSE_LANES), rm)],
+                  pl.BlockSpec((1, 1, bq, _LSE_LANES), rm)] + seed_specs,
         out_specs=pl.BlockSpec((1, bq, d), qm),
         out_shape=jax.ShapeDtypeStruct((b, s, h * d), qkv.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
@@ -392,7 +510,7 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(qkv, qkv, qkv, do, lse4, delta4)
+    )(qkv, qkv, qkv, do, lse4, delta4, *seed_args)
 
     qm2 = lambda t, j, i, h=h: (t // h, i, t % h)  # noqa: E731
     km2 = lambda t, j, i, h=h, g=group: (t // h, j, h + (t % h) // g)  # noqa: E731
@@ -406,14 +524,14 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, off=0, varlen=False,
-                          bshd=True),
+                          bshd=True, rate=dropout_rate),
         grid=(b * h, nk, nq),
         in_specs=[pl.BlockSpec((1, bq, d), qm2),
                   pl.BlockSpec((1, bk, d), km2),
                   pl.BlockSpec((1, bk, d), vm2),
                   pl.BlockSpec((1, bq, d), dom2),
                   pl.BlockSpec((1, 1, bq, _LSE_LANES), rm2),
-                  pl.BlockSpec((1, 1, bq, _LSE_LANES), rm2)],
+                  pl.BlockSpec((1, 1, bq, _LSE_LANES), rm2)] + seed_specs,
         out_specs=[pl.BlockSpec((1, bk, d), dkm),
                    pl.BlockSpec((1, bk, d), dkm)],
         out_shape=[
@@ -428,7 +546,7 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(qkv, qkv, qkv, do, lse4, delta4)
+    )(qkv, qkv, qkv, do, lse4, delta4, *seed_args)
     if group > 1:
         dk = _group_sum(dk, h_kv, group, d, qkv.dtype)
         dv = _group_sum(dv, h_kv, group, d, qkv.dtype)
@@ -436,7 +554,8 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
 
 
 def flash_fwd_bshd(q, k, v, *, scale, causal, bq=1024, bk=1024,
-                   full_lse=False, interpret=False):
+                   full_lse=False, interpret=False, dropout_rate=0.0,
+                   dropout_seed=None):
     """Seq-major flash forward: q (b, sq, h, d); k/v (b, sk, h_kv, d).
 
     The (s, h·d)-minor layout is exactly what the QKV projection GEMMs
@@ -453,21 +572,28 @@ def flash_fwd_bshd(q, k, v, *, scale, causal, bq=1024, bk=1024,
     bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
 
+    args = [q.reshape(b, sq, h * d), k.reshape(b, sk, h_kv * d),
+            v.reshape(b, sk, h_kv * d)]
+    in_specs = [
+        pl.BlockSpec((1, bq, d),
+                     lambda t, i, j, h=h: (t // h, i, t % h)),
+        pl.BlockSpec((1, bk, d),
+                     lambda t, i, j, h=h, g=group:
+                     (t // h, j, (t % h) // g)),
+        pl.BlockSpec((1, bk, d),
+                     lambda t, i, j, h=h, g=group:
+                     (t // h, j, (t % h) // g)),
+    ]
+    if dropout_rate > 0.0:
+        in_specs.append(_SMEM_SPEC)
+        args.append(_seed_operand(dropout_seed))
+
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=False,
-                          bshd=True),
+                          bshd=True, rate=dropout_rate),
         grid=(b * h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d),
-                         lambda t, i, j, h=h: (t // h, i, t % h)),
-            pl.BlockSpec((1, bk, d),
-                         lambda t, i, j, h=h, g=group:
-                         (t // h, j, (t % h) // g)),
-            pl.BlockSpec((1, bk, d),
-                         lambda t, i, j, h=h, g=group:
-                         (t // h, j, (t % h) // g)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d),
                          lambda t, i, j, h=h: (t // h, i, t % h)),
@@ -487,8 +613,7 @@ def flash_fwd_bshd(q, k, v, *, scale, causal, bq=1024, bk=1024,
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q.reshape(b, sq, h * d), k.reshape(b, sk, h_kv * d),
-      v.reshape(b, sk, h_kv * d))
+    )(*args)
     return o.reshape(b, sq, h, d), (lse if full_lse else lse[..., 0])
 
 
@@ -501,12 +626,18 @@ def _rd_row(ref, bshd):
 
 
 def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, varlen,
-                   bshd=False):
+                   bshd=False, rate=0.0):
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    n = 6
     if varlen:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref,
-         dq_ref, acc_scr) = refs
-    else:
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr = refs
+        kvlen_ref = refs[n]
+        n += 1
+    if rate > 0.0:
+        seed_ref = refs[n]
+        n += 1
+    dq_ref, acc_scr = refs[n:]
+    t = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -540,6 +671,11 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, varlen,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
+        if rate > 0.0:
+            # dS = P ∘ (M/(1-r) ∘ dPd − Δ): the mask re-enters on the dPd
+            # term only (Δ already equals rowsum(Pd ∘ dPd) — see the
+            # softmax-dropout chain in flash_bwd's docstring)
+            dp = dp * _mask_scale(seed_ref[0], t, i, j, bq, bk, rate)
         ds = (p * (dp - _rd_row(delta_ref, bshd)[:, 0:1]) * scale
               ).astype(k.dtype)
         acc_scr[:] += jax.lax.dot_general(
@@ -552,13 +688,18 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, varlen,
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, varlen,
-                    bshd=False):
+                    bshd=False, rate=0.0):
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    n = 6
     if varlen:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref,
-         dk_ref, dv_ref, dk_scr, dv_scr) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        kvlen_ref = refs[n]
+        n += 1
+    if rate > 0.0:
+        seed_ref = refs[n]
+        n += 1
+    dk_ref, dv_ref, dk_scr, dv_scr = refs[n:]
+    t = pl.program_id(0)
     j = pl.program_id(1)  # k block (outer)
     i = pl.program_id(2)  # q block (inner, accumulated)
 
@@ -590,13 +731,20 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, varlen,
         if varlen:
             s = jnp.where(cols < kvlen, s, NEG_INF)
         p = jnp.exp(s - _rd_row(lse_ref, bshd)[:, 0:1])  # (bq, bk)
+        if rate > 0.0:
+            ms = _mask_scale(seed_ref[0], t, i, j, bq, bk, rate)
+            pd = p * ms  # dropped+rescaled probs: dV = Pdᵀ dO
+        else:
+            pd = p
         dv_scr[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
+        if rate > 0.0:
+            dp = dp * ms
         ds = (p * (dp - _rd_row(delta_ref, bshd)[:, 0:1]) * scale
               ).astype(q.dtype)
         dk_scr[:] += jax.lax.dot_general(
@@ -610,7 +758,8 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, varlen,
 
 
 def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
-              bq=1024, bk=1024, interpret=False):
+              bq=1024, bk=1024, interpret=False, dropout_rate=0.0,
+              dropout_seed=None):
     """Gradients; with grouped kv (bh_kv < bh) dk/dv come back at kv shape —
     the dkv kernel runs per *q*-head (its scratch accumulates over q blocks
     within one grid row, so cross-head accumulation can't live in-kernel)
@@ -629,14 +778,20 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
     delta3 = _expand_rows(delta)
     varlen = kv_lens is not None
     extra_args = [_kvlen_rows(kv_lens, bh)] if varlen else []
+    if dropout_rate > 0.0:
+        extra_args.append(_seed_operand(dropout_seed))
 
     def kvlen_spec(index_map):
-        return ([pl.BlockSpec((1, 1, _LSE_LANES), index_map)]
-                if varlen else [])
+        specs = ([pl.BlockSpec((1, 1, _LSE_LANES), index_map)]
+                 if varlen else [])
+        if dropout_rate > 0.0:
+            specs.append(_SMEM_SPEC)
+        return specs
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=varlen),
+                          bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=varlen,
+                          rate=dropout_rate),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -657,7 +812,8 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, off=sk - sq, varlen=varlen),
+                          bq=bq, bk=bk, nq=nq, off=sk - sq, varlen=varlen,
+                          rate=dropout_rate),
         grid=(bh, nk, nq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
@@ -697,7 +853,7 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
 
 
 def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
-                   interpret=False):
+                   interpret=False, dropout_rate=0.0, dropout_seed=None):
     """Seq-major backward (cf. :func:`flash_fwd_bshd`): q/o/do
     (b, sq, h, d), k/v (b, sk, h_kv, d), lse (b, h, sq) or the
     (b, h, sq, LANES) carrier from ``flash_fwd_bshd(full_lse=True)``.
@@ -730,14 +886,17 @@ def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
     qm = lambda t, i, j, h=h: (t // h, i, t % h)  # noqa: E731
     km = lambda t, i, j, h=h, g=group: (t // h, j, (t % h) // g)  # noqa: E731
     rm = lambda t, i, j, h=h: (t // h, t % h, i, 0)  # noqa: E731
+    seed_specs = [_SMEM_SPEC] if dropout_rate > 0.0 else []
+    seed_args = ([_seed_operand(dropout_seed)]
+                 if dropout_rate > 0.0 else [])
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=False,
-                          bshd=True),
+                          bshd=True, rate=dropout_rate),
         grid=(b * h, nq, nk),
         in_specs=[q_spec(qm), kv_spec(km), kv_spec(km), q_spec(qm),
-                  row_spec(rm), row_spec(rm)],
+                  row_spec(rm), row_spec(rm)] + seed_specs,
         out_specs=q_spec(qm),
         out_shape=jax.ShapeDtypeStruct((b, sq, h * d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
@@ -745,7 +904,7 @@ def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q3, k3, v3, do3, lse4, delta4)
+    )(q3, k3, v3, do3, lse4, delta4, *seed_args)
 
     qm2 = lambda t, j, i, h=h: (t // h, i, t % h)  # noqa: E731
     km2 = lambda t, j, i, h=h, g=group: (t // h, j, (t % h) // g)  # noqa: E731
@@ -759,10 +918,10 @@ def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, off=sk - sq, varlen=False,
-                          bshd=True),
+                          bshd=True, rate=dropout_rate),
         grid=(b * h, nk, nq),
         in_specs=[q_spec(qm2), kv_spec(km2), kv_spec(km2), q_spec(qm2),
-                  row_spec(rm2), row_spec(rm2)],
+                  row_spec(rm2), row_spec(rm2)] + seed_specs,
         out_specs=[kv_spec(dkm), kv_spec(dkm)],
         out_shape=[
             jax.ShapeDtypeStruct((b, sk, h * d), dkv_dtypes[0]),
@@ -776,7 +935,7 @@ def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q3, k3, v3, do3, lse4, delta4)
+    )(q3, k3, v3, do3, lse4, delta4, *seed_args)
     dq = dq.reshape(b, sq, h, d)
     if group > 1:
         dk = _group_sum(dk, h_kv, group, d, k.dtype)

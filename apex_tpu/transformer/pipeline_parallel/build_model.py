@@ -273,13 +273,9 @@ class GPTPipeline:
         every (microbatch, layer) application draws a distinct mask, and
         when ``dp_axis`` is given the dp rank folds in here too — data-
         parallel replicas draw decorrelated masks without caller effort.
-
-        NOTE dropout forces the materialized-scores attention path even
-        for ``attention_impl='flash'`` (the kernels carry no in-kernel
-        probs dropout — ``GPTModel._attention`` documents the same): at
-        long sequence the (b, h, s, s) probability tensors dominate
-        memory. Train long-context dropout-free (the flagship does) or
-        budget for the O(s²) activations."""
+        Probs dropout rides IN-KERNEL on every flash path (counter-hash
+        masks, O(block) memory — ``ops.pallas.attention.dropout_keep``),
+        so ``dropout > 0`` keeps O(s) attention memory at long sequence."""
         model, v = self.model, self.virtual_chunks
         ep_ax = getattr(model.config, "ep_axis", None)
         if model.config.dropout > 0 and key is None:
