@@ -82,6 +82,17 @@ class GPTConfig:
     moe_aux_coeff: float = 1e-2
     moe_z_coeff: float = 1e-3
     ep_axis: Optional[str] = None
+    # Context parallelism: activations (and tokens) are sharded along the
+    # SEQUENCE over this mesh axis; attention runs distributed — "ring"
+    # (zigzag-sharded ring attention: shard `zigzag_shard(tokens, cp)`
+    # over the axis; O(s_local) memory, kv rotates the ring) or "ulysses"
+    # (contiguous sharding, two all_to_alls re-shard heads; needs
+    # heads % cp == 0). Everything else in the block is position-wise, so
+    # the model runs unchanged on the shard; position embeddings follow
+    # the layout (zigzag stripes / contiguous) automatically. Composes
+    # with tp (+SP), pp, and dp in one mesh.
+    cp_axis: Optional[str] = None
+    cp_impl: str = "ring"
 
     def __post_init__(self):
         if self.moe_num_experts is not None:
@@ -101,6 +112,20 @@ class GPTConfig:
             raise ValueError(
                 f"remat_policy must be full|save_attn|save_attn_mlp|mlp_only, "
                 f"got {self.remat_policy!r}")
+        if self.cp_axis is not None:
+            if self.cp_impl not in ("ring", "ulysses"):
+                raise ValueError(
+                    f"cp_impl must be ring|ulysses, got {self.cp_impl!r}")
+            if self.attention_impl != "flash":
+                raise ValueError(
+                    "context parallelism distributes the flash kernel "
+                    "family; set attention_impl='flash'")
+            if self.dropout > 0:
+                raise ValueError(
+                    "in-kernel attention dropout does not yet compose with "
+                    "context parallelism (the ring pieces would need "
+                    "per-(rank, step) seed folding); set dropout=0 or drop "
+                    "cp_axis")
         if self.num_kv_heads is not None:
             if self.num_kv_heads < 1:
                 raise ValueError(
@@ -217,6 +242,28 @@ class GPTModel:
 
     # --- blocks ---------------------------------------------------------------
 
+    def _cp_positions(self, s_loc):
+        """Global position ids of this cp rank's sequence shard: the zigzag
+        stripe pair for ring (device r holds stripes (r, 2cp−1−r) of 2·cp —
+        `ops.attention.zigzag_indices`), contiguous for ulysses."""
+        c = self.config
+        cp = jax.lax.axis_size(c.cp_axis)
+        if cp * s_loc > c.max_seq_len:
+            # out-of-range ids would silently CLAMP in the pos_embedding
+            # gather (JAX gather default) — half the sequence training on
+            # repeated positions with no error; fail at trace time instead
+            # (the dense path fails loudly via the [:s] shape mismatch)
+            raise ValueError(
+                f"global sequence cp*s_local = {cp}*{s_loc} exceeds "
+                f"max_seq_len ({c.max_seq_len}); raise max_seq_len")
+        rank = jax.lax.axis_index(c.cp_axis)
+        if c.cp_impl == "ring":
+            st = s_loc // 2
+            return jnp.concatenate([
+                rank * st + jnp.arange(st),
+                (2 * cp - 1 - rank) * st + jnp.arange(st)])
+        return rank * s_loc + jnp.arange(s_loc)
+
     def _attention(self, p, x, key):
         c = self.config
         h, d = c.local_heads, c.head_dim
@@ -249,7 +296,8 @@ class GPTModel:
                 "attention", xg, p["qkv"]["weight"],
                 p["qkv"].get("bias"), p["attn_out"]["weight"])
             fused_ok = (
-                "bias" in p["qkv"]
+                c.cp_axis is None  # cp: attention is distributed below
+                and "bias" in p["qkv"]
                 and bshd_kernel_ok(s_len, s_len, h, d, xc.dtype)
                 and (s_len >= flash_auto_crossover(d)
                      or _backend.interpret_forced())
@@ -269,7 +317,8 @@ class GPTModel:
                 if "bias" in p["attn_out"]:
                     y = y + p["attn_out"]["bias"]
                 return y
-            if (not bshd_kernel_ok(s_len, s_len, h, d, xc.dtype)
+            if (c.cp_axis is None
+                    and not bshd_kernel_ok(s_len, s_len, h, d, xc.dtype)
                     and d == 64 and s_len % 128 == 0
                     and xc.dtype != jnp.float16
                     and (s_len >= flash_auto_crossover(d)
@@ -306,8 +355,29 @@ class GPTModel:
                 q = q + bias[:h * d].reshape(h, d)
                 k = k + bias[h * d:(h + hkv) * d].reshape(hkv, d)
                 v = v + bias[(h + hkv) * d:].reshape(hkv, d)
-            ctx = flash_attention(q, k, v, causal=True, layout="bshd",
-                                  dropout_rate=drop, dropout_seed=seed)
+            if c.cp_axis is not None:
+                # context parallelism: q/k/v cover this device's sequence
+                # shard; attention distributes over the cp axis — ring (kv
+                # shards rotate, zigzag-balanced causal) or Ulysses (two
+                # all_to_alls trade seq for head sharding). The op-rules
+                # cast that flash_attention applies internally is applied
+                # here instead (ring/ulysses take q/k/v directly).
+                from apex_tpu.ops.attention import (ring_attention,
+                                                    ulysses_attention)
+                q, k, v = apply_op_rules("attention", q, k, v)
+                if c.cp_impl == "ulysses":
+                    ctx = ulysses_attention(q, k, v, axis_name=c.cp_axis,
+                                            causal=True)
+                else:
+                    b_sz, s_loc = q.shape[0], q.shape[1]
+                    to_bh = lambda z: z.transpose(0, 2, 1, 3).reshape(  # noqa: E731
+                        b_sz * z.shape[2], s_loc, d)
+                    of = ring_attention(to_bh(q), to_bh(k), to_bh(v),
+                                        axis_name=c.cp_axis, causal=True)
+                    ctx = of.reshape(b_sz, h, s_loc, d).transpose(0, 2, 1, 3)
+            else:
+                ctx = flash_attention(q, k, v, causal=True, layout="bshd",
+                                      dropout_rate=drop, dropout_seed=seed)
             wo = p["attn_out"]["weight"].reshape(-1, h, d)
             y = jnp.einsum("bshd,Hhd->bsH", ctx, wo)
             y = self.attn_out.reduce_output(y)
@@ -484,7 +554,12 @@ class GPTModel:
         c = self.config
         s = tokens.shape[1]
         x = self.embedding(params["embedding"], tokens)
-        x = x + params["pos_embedding"][:s]
+        if c.cp_axis is not None:
+            # tokens are a sequence shard: gather the shard's GLOBAL
+            # positions (zigzag stripes under ring)
+            x = x + params["pos_embedding"][self._cp_positions(s)]
+        else:
+            x = x + params["pos_embedding"][:s]
         if self.sp:
             x = self._sp_scatter(x)  # residual stream is seq-sharded
 

@@ -425,10 +425,10 @@ def flash_attention(
     q, k, v = apply_op_rules("attention", q, k, v)
     if layout not in ("bhsd", "bshd"):
         raise ValueError(f"layout must be bhsd|bshd, got {layout!r}")
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got "
+                         f"{dropout_rate}")
     if dropout_rate > 0.0:
-        if not 0.0 < dropout_rate < 1.0:
-            raise ValueError(f"dropout_rate must be in [0, 1), got "
-                             f"{dropout_rate}")
         if dropout_seed is None:
             raise ValueError("dropout_rate > 0 requires dropout_seed")
         dropout_seed = jnp.asarray(dropout_seed, jnp.int32)
@@ -792,7 +792,9 @@ def ring_attention(
             f"causal ring attention needs an even local sequence "
             f"({s_loc}) — two zigzag stripes per device")
     ss = s_loc // 2 if causal else s_loc
-    ok = ss % 128 == 0 and (d % 128 == 0 or d == 64)
+    # fp16 exclusion mirrors flash_attention's gate (Mosaic has no f16)
+    ok = (ss % 128 == 0 and (d % 128 == 0 or d == 64)
+          and q.dtype != jnp.float16)
     if (impl == "auto" and ss < flash_auto_crossover(d)
             and not _backend.interpret_forced()):
         impl = "xla"

@@ -191,7 +191,10 @@ class GPTPipeline:
         model = self.model
         M, b, s = tokens.shape
         x = model.embedding(ep["embedding"], tokens.reshape(M * b, s))
-        x = x + ep["pos_embedding"][:s]
+        if getattr(model.config, "cp_axis", None) is not None:
+            x = x + ep["pos_embedding"][model._cp_positions(s)]
+        else:
+            x = x + ep["pos_embedding"][:s]
         if model.sp:
             x = model._sp_scatter(x)
         return x.reshape(M, b, *x.shape[1:])
@@ -264,7 +267,10 @@ class GPTPipeline:
         shaped like ``pipe_params`` in ``accum_dtype`` (fp32 main-grad
         accumulation across microbatch ticks, cf.
         ``schedules._main_grad_cast``). ``dp_axis`` adds the data-parallel
-        pmean of loss and grads. With ``config.ep_axis`` set the ep axis is
+        pmean of loss and grads; it may be a tuple of axes — pass
+        ``('dp', 'cp')`` when context parallelism shards the sequence
+        (params replicated over cp, per-shard grads partial: cp reduces
+        exactly like dp). With ``config.ep_axis`` set the ep axis is
         ALWAYS reduced over (it is a data axis carrying different batch
         rows per shard): loss/replicated-param grads pmean over ep, while
         expert-bank grads — sharded, already group-summed by the a2a
@@ -282,7 +288,12 @@ class GPTPipeline:
             raise ValueError(
                 "config.dropout > 0 requires a `key` for loss_and_grads")
         if key is not None and dp_axis is not None:
-            key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
+            # dp_axis may be a tuple of data-like axes (e.g. ('dp', 'cp')
+            # — context parallelism reduces like dp: replicated params,
+            # per-shard partial grads)
+            for ax in (dp_axis if isinstance(dp_axis, (tuple, list))
+                       else (dp_axis,)):
+                key = jax.random.fold_in(key, jax.lax.axis_index(ax))
         if key is not None and ep_ax is not None:
             # ep is a data axis (each ep shard holds different batch rows)
             key = jax.random.fold_in(key, jax.lax.axis_index(ep_ax))
