@@ -369,6 +369,13 @@ class GPTModel:
                     ctx = ulysses_attention(q, k, v, axis_name=c.cp_axis,
                                             causal=True)
                 else:
+                    # ring's state machine is bh-flat, so this path pays
+                    # transpose/reshape pairs per layer (the layout
+                    # traffic Ulysses avoids by riding the bshd kernels —
+                    # PERF.md r3); prefer cp_impl='ulysses' when
+                    # heads % cp == 0 and memory admits the full-seq
+                    # gather. A bshd ring would need the zigzag fold
+                    # rewritten on 4D halves — candidate r5 work.
                     b_sz, s_loc = q.shape[0], q.shape[1]
                     to_bh = lambda z: z.transpose(0, 2, 1, 3).reshape(  # noqa: E731
                         b_sz * z.shape[2], s_loc, d)

@@ -455,3 +455,256 @@ class TestFastLayerNormLargeHidden:
             np.asarray(y.std(-1)), np.ones(16), atol=1e-2)
         g = jax.grad(lambda x: fast_layer_norm(x, w, b).sum())(x)
         assert np.isfinite(np.asarray(g)).all()
+
+
+class TestZeroFlagship:
+    """ZeRO under the REAL flagship models (VERDICT r3 next-round #4): the
+    dp-sharded optimizer state drives GPTModel param pytrees composed with
+    tp, the full 3D pipeline, and MoE+ep — trajectories match the
+    unsharded fused Adam."""
+
+    KW = dict(vocab_size=64, max_seq_len=16, hidden_size=32, num_layers=2,
+              num_heads=4, attention_impl="flash")
+    STEPS = 4
+
+    def _oracle(self, cfg1, params, batches, lr=1e-2):
+        """Unsharded fused-Adam trajectory on the single-device model."""
+        from apex_tpu.models import GPTModel
+        from apex_tpu.optimizers import fused_adam
+
+        m = GPTModel(cfg1)
+        opt = fused_adam(learning_rate=lr)
+        st = opt.init(params)
+        losses = []
+
+        @jax.jit
+        def step(p, st, toks, tgts):
+            def f(p_):
+                per = [m.loss_fn(p_, t, g) for t, g in
+                       zip(*map(list, (toks, tgts)))]
+                return jnp.mean(jnp.stack(per))
+            loss, g = jax.value_and_grad(f)(p)
+            u, st = opt.update(g, st, p)
+            return optax.apply_updates(p, u), st, loss
+
+        for toks, tgts in batches:
+            params, st, loss = step(params, st, toks, tgts)
+            losses.append(float(loss))
+        return losses
+
+    def test_zero_adam_under_gpt_tp2(self):
+        """Sharded-state update of tp-sharded params: ZeRO shards m/v over
+        dp=4 within each tp rank; per-(tp) param shards stay exact."""
+        from apex_tpu.contrib.optimizers import distributed_fused_adam
+        from apex_tpu.models import GPTConfig, GPTModel
+        from apex_tpu.models.gpt import shard_params_for_tp
+
+        mesh = mesh_lib.make_mesh(tensor_model_parallel_size=2)  # dp=4
+        cfg1 = GPTConfig(**self.KW)
+        cfg = GPTConfig(**self.KW, tp_size=2)
+        m = GPTModel(cfg)
+        params1 = GPTModel(cfg1).init(K)
+        sharded = shard_params_for_tp(params1, 2, cfg1)
+        specs = jax.tree.map(lambda _: P("tp"), sharded)
+        opt = distributed_fused_adam(learning_rate=1e-2)
+
+        b, s = 4, 16
+        batches = [
+            (jr.randint(jr.fold_in(K, 200 + i), (1, b, s), 0, 64),
+             jr.randint(jr.fold_in(K, 300 + i), (1, b, s), 0, 64))
+            for i in range(self.STEPS)]
+
+        st = mesh_lib.shard_map(
+            lambda p: opt.init(jax.tree.map(lambda x: x[0], p)),
+            mesh=mesh, in_specs=(specs,), out_specs=P(),
+        )(sharded)
+
+        @jax.jit
+        def step(p, st, toks, tgts):
+            def run(p, toks, tgts, st):
+                lp = jax.tree.map(lambda x: x[0], p)
+                loss, g = jax.value_and_grad(m.loss_fn)(
+                    lp, toks[0], tgts[0])
+                u, st = opt.update(g, st, lp)
+                newp = optax.apply_updates(lp, u)
+                return jax.tree.map(lambda x: x[None], newp), st, loss
+
+            return mesh_lib.shard_map(
+                run, mesh=mesh, in_specs=(specs, P(), P(), P()),
+                out_specs=(specs, P(), P()),
+            )(p, toks, tgts, st)
+
+        losses = []
+        with jax.default_matmul_precision("highest"):
+            for toks, tgts in batches:
+                sharded, st, loss = step(sharded, st, toks, tgts)
+                losses.append(float(loss))
+            ref = self._oracle(cfg1, params1, batches)
+        np.testing.assert_allclose(losses, ref, rtol=5e-4, atol=1e-5)
+        assert losses[-1] < losses[0], losses
+        # the ZeRO memory claim: per-device m/v rows are 1/dp of the chunks
+        dp = 4
+        n_chunks = st.layout.chunk_to_tensor.shape[0]
+        local_rows = st.buffers["m"].shape[0]
+        assert local_rows == -(-n_chunks // dp), (local_rows, n_chunks)
+        mesh_lib.destroy_model_parallel()
+
+    def test_zero_adam_under_3d_pipeline(self):
+        """The 3D step (dp2 x pp2 x tp2) with dp-SHARDED optimizer state:
+        pipe-layout params, ZeRO over dp inside the same shard_map as the
+        schedule, trajectory == single-device fused Adam."""
+        from apex_tpu.contrib.optimizers import distributed_fused_adam
+        from apex_tpu.models import GPTConfig, GPTModel
+        from apex_tpu.models.gpt import shard_params_for_tp
+        from apex_tpu.transformer.pipeline_parallel import GPTPipeline
+
+        mesh = mesh_lib.make_mesh(tensor_model_parallel_size=2,
+                                  pipeline_model_parallel_size=2)  # dp=2
+        cfg1 = GPTConfig(**self.KW)
+        cfg = GPTConfig(**self.KW, tp_size=2, sequence_parallel=True)
+        m = GPTModel(cfg)
+        params1 = GPTModel(cfg1).init(K)
+        pipe = GPTPipeline(m, pp=2)
+        part = jax.vmap(pipe.partition)(shard_params_for_tp(params1, 2, cfg1))
+        specs = pipe.param_specs(part, "tp")
+        opt = distributed_fused_adam(learning_rate=1e-2)
+
+        M, b, s, dp = 2, 2, 16, 2
+        batches = [
+            (jr.randint(jr.fold_in(K, 400 + i), (M, b * dp, s), 0, 64),
+             jr.randint(jr.fold_in(K, 500 + i), (M, b * dp, s), 0, 64))
+            for i in range(self.STEPS)]
+
+        def local(p):
+            lp = jax.tree.map(lambda x: x[0], p)
+            return dict(lp, stages=jax.tree.map(lambda x: x[0],
+                                                lp["stages"]))
+
+        st = mesh_lib.shard_map(
+            lambda p: opt.init(local(p)), mesh=mesh, in_specs=(specs,),
+            out_specs=P(),
+        )(part)
+
+        @jax.jit
+        def step(p, st, toks, tgts):
+            def run(p, toks, tgts, st):
+                lp = local(p)
+                loss, g = pipe.loss_and_grads(lp, toks, tgts, dp_axis="dp")
+                u, st = opt.update(g, st, lp)
+                newp = optax.apply_updates(lp, u)
+                newp["stages"] = jax.tree.map(lambda x: x[None, None],
+                                              newp["stages"])
+                newp["embed"] = jax.tree.map(lambda x: x[None],
+                                             newp["embed"])
+                newp["head"] = jax.tree.map(lambda x: x[None], newp["head"])
+                return newp, st, loss
+
+            return mesh_lib.shard_map(
+                run, mesh=mesh,
+                in_specs=(specs, P(None, "dp"), P(None, "dp"), P()),
+                out_specs=(specs, P(), P()),
+            )(p, toks, tgts, st)
+
+        losses = []
+        with jax.default_matmul_precision("highest"):
+            for toks, tgts in batches:
+                part, st, loss = step(part, st, toks, tgts)
+                losses.append(float(loss))
+
+            # oracle: per-(dp shard, microbatch) mean losses + fused adam
+            from apex_tpu.models import GPTModel as GM
+            from apex_tpu.optimizers import fused_adam
+            m1 = GM(cfg1)
+            opt1 = fused_adam(learning_rate=1e-2)
+            st1 = opt1.init(params1)
+            ref = []
+
+            @jax.jit
+            def ostep(p, st, toks, tgts):
+                def f(p_):
+                    per = [m1.loss_fn(p_, toks[i, r * b:(r + 1) * b],
+                                      tgts[i, r * b:(r + 1) * b])
+                           for r in range(dp) for i in range(M)]
+                    return jnp.mean(jnp.stack(per))
+                loss, g = jax.value_and_grad(f)(p)
+                u, st = opt1.update(g, st, p)
+                return optax.apply_updates(p, u), st, loss
+
+            p1 = params1
+            for toks, tgts in batches:
+                p1, st1, loss = ostep(p1, st1, toks, tgts)
+                ref.append(float(loss))
+
+        np.testing.assert_allclose(losses, ref, rtol=5e-4, atol=1e-5)
+        mesh_lib.destroy_model_parallel()
+
+    def test_zero_adam_under_moe_ep(self):
+        """ZeRO x MoE x ep: expert banks sharded over ep, their fp32 m/v
+        additionally sharded over dp — the memory lever that relaxes the
+        MoE remat budget (PERF.md r4). Trajectory == unsharded Adam."""
+        from apex_tpu.contrib.optimizers import distributed_fused_adam
+        from apex_tpu.models import GPTConfig, GPTModel
+
+        mesh = mesh_lib.make_mesh(expert_parallel_size=2)  # dp=4 x ep=2
+        kw = dict(self.KW, moe_num_experts=4, moe_top_k=2,
+                  moe_capacity_factor=2.0)
+        cfg1 = GPTConfig(**kw)
+        cfg = GPTConfig(**kw, ep_axis="ep")
+        m = GPTModel(cfg)
+        params = GPTModel(cfg1).init(K)
+        opt = distributed_fused_adam(learning_rate=1e-2)
+
+        def leaf_spec(path, _):
+            names = {q.key for q in path if hasattr(q, "key")}
+            if "moe" in names and names & {"w1", "b1", "w2", "b2"}:
+                return P(None, "ep")
+            return P()
+
+        pspec = jax.tree_util.tree_map_with_path(leaf_spec, params)
+        b, s = 2, 16
+        shards = 8  # dp*ep data shards
+        batches = [
+            (jr.randint(jr.fold_in(K, 600 + i), (b * shards, s), 0, 64),
+             jr.randint(jr.fold_in(K, 700 + i), (b * shards, s), 0, 64))
+            for i in range(self.STEPS)]
+
+        st = mesh_lib.shard_map(
+            lambda p: opt.init(p), mesh=mesh, in_specs=(pspec,),
+            out_specs=P(),
+        )(params)
+
+        @jax.jit
+        def step(p, st, toks, tgts):
+            def run(p, toks, tgts, st):
+                loss, g = jax.value_and_grad(m.loss_fn)(p, toks, tgts)
+                loss = jax.lax.pmean(loss, ("dp", "ep"))
+
+                def reduce_leaf(path, x):
+                    names = {q.key for q in path if hasattr(q, "key")}
+                    if "moe" in names and names & {"w1", "b1", "w2", "b2"}:
+                        return jax.lax.pmean(x, "dp") / 2  # ep size
+                    return jax.lax.pmean(x, ("dp", "ep"))
+
+                g = jax.tree_util.tree_map_with_path(reduce_leaf, g)
+                u, st = opt.update(g, st, p)
+                return optax.apply_updates(p, u), st, loss
+
+            return mesh_lib.shard_map(
+                run, mesh=mesh,
+                in_specs=(pspec, P(("dp", "ep")), P(("dp", "ep")), P()),
+                out_specs=(pspec, P(), P()),
+            )(p, toks, tgts, st)
+
+        losses = []
+        with jax.default_matmul_precision("highest"):
+            for toks, tgts in batches:
+                params, st, loss = step(params, st, toks, tgts)
+                losses.append(float(loss))
+
+            # oracle over the 8 data shards
+            b_sh = [
+                (jnp.stack([t[r * b:(r + 1) * b] for r in range(shards)]),
+                 jnp.stack([g[r * b:(r + 1) * b] for r in range(shards)]))
+                for t, g in batches]
+            ref = self._oracle(cfg1, GPTModel(cfg1).init(K), b_sh)
+        np.testing.assert_allclose(losses, ref, rtol=5e-4, atol=1e-5)
