@@ -311,7 +311,7 @@ class GPTModel:
                 # — kills the ~4.5 GB/step of XLA layout-conversion copies
                 # the composed formulation paid, PERF.md r3).
                 y = fused_qkv_attention(
-                    xc, w_qkv, b_qkv, w_out, seed, h, hkv, d,
+                    xc, w_qkv, b_qkv, w_out, seed, None, h, hkv, d,
                     1.0 / float(d) ** 0.5, True, drop)
                 y = self.attn_out.reduce_output(y)
                 if "bias" in p["attn_out"]:

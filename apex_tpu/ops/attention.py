@@ -220,21 +220,27 @@ def _from_bh(x, b, h):  # (b*h, s, d) -> (b, s, h, d)
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_core_bshd(q, k, v, dropout_seed, scale, causal, use_pallas,
-                     dropout_rate):
-    o, _ = _flash_fwd_res_bshd(q, k, v, dropout_seed, scale, causal,
-                               use_pallas, dropout_rate)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_core_bshd(q, k, v, kv_lens, dropout_seed, scale, causal,
+                     use_pallas, dropout_rate):
+    o, _ = _flash_fwd_res_bshd(q, k, v, kv_lens, dropout_seed, scale,
+                               causal, use_pallas, dropout_rate)
     return o
 
 
-def _flash_fwd_res_bshd(q, k, v, dropout_seed, scale, causal, use_pallas,
-                        dropout_rate):
+def _expand_lens_bh(kv_lens, h):
+    """(b,) per-batch lengths -> (b*h,) per-row for the flat XLA path
+    (matches _to_bh's b-major row order)."""
+    return None if kv_lens is None else jnp.repeat(kv_lens, h)
+
+
+def _flash_fwd_res_bshd(q, k, v, kv_lens, dropout_seed, scale, causal,
+                        use_pallas, dropout_rate):
     if use_pallas:
         # carrier residual, same rationale as _flash_fwd_res
         o, lse = _k.flash_fwd_bshd(
-            q, k, v, scale=scale, causal=causal, full_lse=True,
-            interpret=_backend.interpret_mode(),
+            q, k, v, scale=scale, causal=causal, kv_lens=kv_lens,
+            full_lse=True, interpret=_backend.interpret_mode(),
             dropout_rate=dropout_rate, dropout_seed=dropout_seed)
     else:
         b, h = q.shape[0], q.shape[2]
@@ -246,39 +252,41 @@ def _flash_fwd_res_bshd(q, k, v, dropout_seed, scale, causal, use_pallas,
         if group > 1:
             kf = jnp.repeat(kf, group, 0)
             vf = jnp.repeat(vf, group, 0)
-        o3, lse3 = _xla_attention(_to_bh(q), kf, vf, scale, causal, None,
+        o3, lse3 = _xla_attention(_to_bh(q), kf, vf, scale, causal,
+                                  _expand_lens_bh(kv_lens, h),
                                   dropout_rate, dropout_seed)
         o = _from_bh(o3, b, h)
         lse = lse3.reshape(b, h, -1)
     return o, (q, k, v, o, lse)
 
 
-def _flash_fwd_bshd(q, k, v, dropout_seed, scale, causal, use_pallas,
-                    dropout_rate):
-    o, res = _flash_fwd_res_bshd(q, k, v, dropout_seed, scale, causal,
-                                 use_pallas, dropout_rate)
-    return o, (res, dropout_seed)
+def _flash_fwd_bshd(q, k, v, kv_lens, dropout_seed, scale, causal,
+                    use_pallas, dropout_rate):
+    o, res = _flash_fwd_res_bshd(q, k, v, kv_lens, dropout_seed, scale,
+                                 causal, use_pallas, dropout_rate)
+    return o, (res, kv_lens, dropout_seed)
 
 
 def _flash_bwd_bshd(scale, causal, use_pallas, dropout_rate, res_pack, do):
-    res, dropout_seed = res_pack
+    res, kv_lens, dropout_seed = res_pack
     q, k, v, o, lse = res
+    dlens = _float0_like(kv_lens)
     dseed = _float0_like(dropout_seed)
     if use_pallas:
         dq, dk, dv = _k.flash_bwd_bshd(
             q, k, v, o, lse, do, scale=scale, causal=causal,
-            interpret=_backend.interpret_mode(),
+            kv_lens=kv_lens, interpret=_backend.interpret_mode(),
             dropout_rate=dropout_rate, dropout_seed=dropout_seed)
-        return dq, dk, dv, dseed
+        return dq, dk, dv, dlens, dseed
     b, h = q.shape[0], q.shape[2]
     h_kv = k.shape[2]
     dq3, dk3, dv3 = _flash_bwd_impl(
         _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(o),
-        lse.reshape(b * h, -1), _to_bh(do), None, scale, causal,
-        use_pallas=False, dropout_rate=dropout_rate,
+        lse.reshape(b * h, -1), _to_bh(do), _expand_lens_bh(kv_lens, h),
+        scale, causal, use_pallas=False, dropout_rate=dropout_rate,
         dropout_seed=dropout_seed)
     return (_from_bh(dq3, b, h), _from_bh(dk3, b, h_kv),
-            _from_bh(dv3, b, h_kv), dseed)
+            _from_bh(dv3, b, h_kv), dlens, dseed)
 
 
 _flash_core_bshd.defvjp(_flash_fwd_bshd, _flash_bwd_bshd)
@@ -286,9 +294,9 @@ _flash_core_bshd.defvjp(_flash_fwd_bshd, _flash_bwd_bshd)
 
 # --- fused projection + attention block ---------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
-def fused_qkv_attention(x, w_qkv, b_qkv, w_out, dropout_seed, h, h_kv, d,
-                        scale, causal, dropout_rate=0.0):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def fused_qkv_attention(x, w_qkv, b_qkv, w_out, dropout_seed, kv_lens, h,
+                        h_kv, d, scale, causal, dropout_rate=0.0):
     """Packed-QKV projection → flash attention → output projection as ONE
     differentiable block in which every large contraction is a plain 2D
     GEMM over (tokens, features) folded views, and the flash kernels read
@@ -311,29 +319,33 @@ def fused_qkv_attention(x, w_qkv, b_qkv, w_out, dropout_seed, h, h_kv, d,
     caller gates on kernel eligibility). ``dropout_rate > 0`` applies
     in-kernel probs dropout (``dropout_seed`` required — pass None
     otherwise); masks regenerate in backward from the same counter hash
-    (see ``pallas.attention.dropout_keep``)."""
-    y, _ = _fused_attn_fwd(x, w_qkv, b_qkv, w_out, dropout_seed, h, h_kv,
-                           d, scale, causal, dropout_rate)
+    (see ``pallas.attention.dropout_keep``). ``kv_lens`` (b,) int32 masks
+    each batch row's kv positions >= its length (padded batches; pass
+    None for full sequences)."""
+    y, _ = _fused_attn_fwd(x, w_qkv, b_qkv, w_out, dropout_seed, kv_lens,
+                           h, h_kv, d, scale, causal, dropout_rate)
     return y
 
 
-def _fused_attn_fwd(x, w_qkv, b_qkv, w_out, dropout_seed, h, h_kv, d,
-                    scale, causal, dropout_rate=0.0):
+def _fused_attn_fwd(x, w_qkv, b_qkv, w_out, dropout_seed, kv_lens, h,
+                    h_kv, d, scale, causal, dropout_rate=0.0):
     b, s, H = x.shape
     qkv = (jnp.dot(x.reshape(-1, H), w_qkv.T) + b_qkv).reshape(b, s, -1)
     # full_lse: keep the (b, h, s, LANES) lane carrier as the residual —
     # backward hands it straight back to the kernel (slicing lane 0 here
     # would force a re-broadcast there, one slice+broadcast pair per layer)
     o, lse = _k.flash_fwd_packed(
-        qkv, h, h_kv, d, scale=scale, causal=causal, full_lse=True,
-        interpret=_backend.interpret_mode(),
+        qkv, h, h_kv, d, scale=scale, causal=causal, kv_lens=kv_lens,
+        full_lse=True, interpret=_backend.interpret_mode(),
         dropout_rate=dropout_rate, dropout_seed=dropout_seed)
+    # dead rows (kv_lens == 0): the kernel writes zero context rows and
+    # zeros propagate through the projection — no extra masking needed
     y = jnp.dot(o.reshape(-1, h * d), w_out.T).reshape(b, s, -1)
-    return y, (x, qkv, o, lse, w_qkv, w_out, dropout_seed)
+    return y, (x, qkv, o, lse, w_qkv, w_out, dropout_seed, kv_lens)
 
 
 def _fused_attn_bwd(h, h_kv, d, scale, causal, dropout_rate, res, dy):
-    x, qkv, o, lse, w_qkv, w_out, dropout_seed = res
+    x, qkv, o, lse, w_qkv, w_out, dropout_seed, kv_lens = res
     b, s, H = x.shape
     T = b * s
     dy2 = dy.reshape(T, -1)
@@ -342,7 +354,7 @@ def _fused_attn_bwd(h, h_kv, d, scale, causal, dropout_rate, res, dy):
     do = jnp.dot(dy2, w_out).reshape(b, s, h * d)
     dq, dk, dv = _k.flash_bwd_packed(
         qkv, h, h_kv, d, o, lse, do, scale=scale, causal=causal,
-        interpret=_backend.interpret_mode(),
+        kv_lens=kv_lens, interpret=_backend.interpret_mode(),
         dropout_rate=dropout_rate, dropout_seed=dropout_seed)
     x2 = x.reshape(T, H)
     dq2 = dq.reshape(T, -1)
@@ -358,7 +370,8 @@ def _fused_attn_bwd(h, h_kv, d, scale, causal, dropout_rate, res, dy):
     db_qkv = jnp.concatenate(
         [jnp.sum(dq2, 0), jnp.sum(dk2, 0), jnp.sum(dv2, 0)])
     return dx, dw_qkv.astype(w_qkv.dtype), db_qkv.astype(w_qkv.dtype), \
-        dw_out.astype(w_out.dtype), _float0_like(dropout_seed)
+        dw_out.astype(w_out.dtype), _float0_like(dropout_seed), \
+        _float0_like(kv_lens)
 
 
 fused_qkv_attention.defvjp(_fused_attn_fwd, _fused_attn_bwd)
@@ -412,8 +425,10 @@ def flash_attention(
     kernels read it via head-strided index maps, so NO layout-conversion
     copies sit between the projections and the kernels (the bh-flat layout
     cost the flagship ~4.5 GB/step of pure copies — PERF.md r3). Prefer it
-    whenever q/k/v come straight from a (tokens, features) GEMM; kv_lens
-    is not supported in this layout.
+    whenever q/k/v come straight from a (tokens, features) GEMM. In this
+    layout ``kv_lens`` is PER BATCH ((b,) int32 — heads share a row's
+    padding), which is both the padded-batch reality and what the
+    kernels' head-folded index maps consume with zero expansion.
 
     ``dropout_rate > 0`` applies IN-KERNEL probs dropout (the reference's
     fused-attention capability, ``apex/contrib/csrc/fmha/fmha_api.cpp:44``):
@@ -435,8 +450,6 @@ def flash_attention(
     else:
         dropout_seed = None
     if layout == "bshd":
-        if kv_lens is not None:
-            raise NotImplementedError("kv_lens requires layout='bhsd'")
         if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
             raise ValueError(
                 f"layout='bshd' takes (b, s, h, d) operands; got "
@@ -451,14 +464,22 @@ def flash_attention(
                 f"({q.shape[2]}) with matching batch/seq dims")
         d = q.shape[-1]
         s_scale = float(scale if scale is not None else 1.0 / d ** 0.5)
+        if kv_lens is not None:
+            # per-BATCH lengths (heads share a row's padding) — the (b,)
+            # form the kernels' t//h index maps consume directly
+            if kv_lens.shape != (q.shape[0],):
+                raise ValueError(
+                    f"layout='bshd' takes per-batch kv_lens of shape "
+                    f"({q.shape[0]},); got {kv_lens.shape}")
+            kv_lens = kv_lens.astype(jnp.int32)
         ok = bshd_kernel_ok(q.shape[1], k.shape[1], q.shape[2], d, q.dtype)
         impl_ = impl
         if (impl_ == "auto" and k.shape[1] < flash_auto_crossover(d)
                 and not _backend.interpret_forced()):
             impl_ = "xla"
         use_pallas = _backend.choose_impl(impl_, ok) == "pallas"
-        return _flash_core_bshd(q, k, v, dropout_seed, s_scale, causal,
-                                use_pallas, dropout_rate)
+        return _flash_core_bshd(q, k, v, kv_lens, dropout_seed, s_scale,
+                                causal, use_pallas, dropout_rate)
     d = q.shape[-1]
     if causal and q.shape[-2] > k.shape[-2]:
         # bottom-right-aligned causal with sq > sk gives the first

@@ -242,6 +242,23 @@ def _seed_operand(dropout_seed):
 _SMEM_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
+def _tail_operands(kv_lens, rows, dropout_rate, dropout_seed, lens_map):
+    """(specs, args) for the OPTIONAL trailing kernel operands, in the
+    kernels' fixed unpack order: [kvlen carrier] then [dropout seed].
+    ``rows`` is the lens carrier's leading extent (bh for the flat
+    layout, b for bshd/packed); ``lens_map`` the grid->carrier index map.
+    One assembly point so a future operand cannot be appended in the
+    wrong order at one of the eight call sites."""
+    specs, args = [], []
+    if kv_lens is not None:
+        specs.append(pl.BlockSpec((1, 1, _LSE_LANES), lens_map))
+        args.append(_kvlen_rows(kv_lens, rows))
+    if dropout_rate > 0.0:
+        specs.append(_SMEM_SPEC)
+        args.append(_seed_operand(dropout_seed))
+    return specs, args
+
+
 def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bq=1024, bk=1024,
               full_lse=False, interpret=False, dropout_rate=0.0,
               dropout_seed=None):
@@ -268,13 +285,10 @@ def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bq=1024, bk=1024,
         pl.BlockSpec((1, bk, d), lambda b, i, j, g=group: (b // g, j, 0)),
     ]
     args = [q, k, v]
-    if varlen:
-        in_specs.append(
-            pl.BlockSpec((1, 1, _LSE_LANES), lambda b, i, j: (b, 0, 0)))
-        args.append(_kvlen_rows(kv_lens, bh))
-    if dropout_rate > 0.0:
-        in_specs.append(_SMEM_SPEC)
-        args.append(_seed_operand(dropout_seed))
+    tail_specs, tail_args = _tail_operands(
+        kv_lens, bh, dropout_rate, dropout_seed, lambda b, i, j: (b, 0, 0))
+    in_specs += tail_specs
+    args += tail_args
 
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
@@ -303,9 +317,9 @@ def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bq=1024, bk=1024,
     return o, (lse if full_lse else lse[..., 0])
 
 
-def flash_fwd_packed(qkv, h, h_kv, d, *, scale, causal, bq=1024, bk=1024,
-                     full_lse=False, interpret=False, dropout_rate=0.0,
-                     dropout_seed=None):
+def flash_fwd_packed(qkv, h, h_kv, d, *, scale, causal, kv_lens=None,
+                     bq=1024, bk=1024, full_lse=False, interpret=False,
+                     dropout_rate=0.0, dropout_seed=None):
     """Flash forward reading q/k/v directly out of the PACKED projection
     output: ``qkv`` (b, s, (h+2·h_kv)·d), features ordered q|k|v with heads
     contiguous inside each part. The same buffer rides in three times with
@@ -319,6 +333,7 @@ def flash_fwd_packed(qkv, h, h_kv, d, *, scale, causal, bq=1024, bk=1024,
     group = h // h_kv
     bq, bk = _fit_block(s, bq), _fit_block(s, bk)
     nq, nk = _blocks(s, bq), _blocks(s, bk)
+    varlen = kv_lens is not None
 
     args = [qkv, qkv, qkv]
     in_specs = [
@@ -331,13 +346,15 @@ def flash_fwd_packed(qkv, h, h_kv, d, *, scale, causal, bq=1024, bk=1024,
                      lambda t, i, j, h=h, hk=h_kv, g=group:
                      (t // h, j, h + hk + (t % h) // g)),
     ]
-    if dropout_rate > 0.0:
-        in_specs.append(_SMEM_SPEC)
-        args.append(_seed_operand(dropout_seed))
+    tail_specs, tail_args = _tail_operands(
+        kv_lens, b, dropout_rate, dropout_seed,
+        lambda t, i, j, h=h: (t // h, 0, 0))
+    in_specs += tail_specs
+    args += tail_args
 
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, off=0, varlen=False,
+                          bq=bq, bk=bk, nk=nk, off=0, varlen=varlen,
                           bshd=True, rate=dropout_rate),
         grid=(b * h, nq, nk),
         in_specs=in_specs,
@@ -421,8 +438,8 @@ def _bwd_single_block_kernel(*refs, scale, causal, n, rate=0.0):
 
 
 def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
-                     bq=1024, bk=1024, interpret=False, dropout_rate=0.0,
-                     dropout_seed=None):
+                     kv_lens=None, bq=1024, bk=1024, interpret=False,
+                     dropout_rate=0.0, dropout_seed=None):
     """Backward of :func:`flash_fwd_packed`: returns SEPARATE folded grads
     (dq (b, s, h·d), dk/dv (b, s, h_kv·d)) — the caller contracts each
     against its weight window (plain 2D GEMMs), never materializing a
@@ -437,8 +454,12 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
     bq, bk = _fit_block(s, bq), _fit_block(s, bk)
     nq, nk = _blocks(s, bq), _blocks(s, bk)
     lse4 = lse if lse.ndim == 4 else _expand_rows(lse)
+    varlen = kv_lens is not None
 
-    if nq == 1 and nk == 1:
+    # varlen rides the two-kernel split (the fused single-block kernel
+    # carries no length operand — padded batches pay one extra QK^T
+    # recompute, the same cost every multi-block sequence pays anyway)
+    if nq == 1 and nk == 1 and not varlen:
         qm = lambda t, h=h: (t // h, 0, t % h)  # noqa: E731
         km = lambda t, h=h, g=group: (t // h, 0, h + (t % h) // g)  # noqa: E731
         vm = lambda t, h=h, hk=h_kv, g=group: (  # noqa: E731
@@ -488,13 +509,13 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
         t // h, j, h + hk + (t % h) // g)
     dom = lambda t, i, j, h=h: (t // h, i, t % h)  # noqa: E731
     rm = lambda t, i, j, h=h: (t // h, t % h, i, 0)  # noqa: E731
-    seed_specs = [_SMEM_SPEC] if dropout_rate > 0.0 else []
-    seed_args = ([_seed_operand(dropout_seed)]
-                 if dropout_rate > 0.0 else [])
+    extra_specs, extra_args = _tail_operands(
+        kv_lens, b, dropout_rate, dropout_seed,
+        lambda t, i, j, h=h: (t // h, 0, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, off=0, varlen=False,
+                          bq=bq, bk=bk, nk=nk, off=0, varlen=varlen,
                           bshd=True, rate=dropout_rate),
         grid=(b * h, nq, nk),
         in_specs=[pl.BlockSpec((1, bq, d), qm),
@@ -502,7 +523,7 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
                   pl.BlockSpec((1, bk, d), vm),
                   pl.BlockSpec((1, bq, d), dom),
                   pl.BlockSpec((1, 1, bq, _LSE_LANES), rm),
-                  pl.BlockSpec((1, 1, bq, _LSE_LANES), rm)] + seed_specs,
+                  pl.BlockSpec((1, 1, bq, _LSE_LANES), rm)] + extra_specs,
         out_specs=pl.BlockSpec((1, bq, d), qm),
         out_shape=jax.ShapeDtypeStruct((b, s, h * d), qkv.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
@@ -510,7 +531,7 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(qkv, qkv, qkv, do, lse4, delta4, *seed_args)
+    )(qkv, qkv, qkv, do, lse4, delta4, *extra_args)
 
     qm2 = lambda t, j, i, h=h: (t // h, i, t % h)  # noqa: E731
     km2 = lambda t, j, i, h=h, g=group: (t // h, j, h + (t % h) // g)  # noqa: E731
@@ -520,10 +541,13 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
     rm2 = lambda t, j, i, h=h: (t // h, t % h, i, 0)  # noqa: E731
     dkm = lambda t, j, i, h=h: (t // h, j, t % h)  # noqa: E731
     dkv_dt = jnp.float32 if group > 1 else qkv.dtype
+    extra_specs2, _ = _tail_operands(
+        kv_lens, b, dropout_rate, dropout_seed,
+        lambda t, j, i, h=h: (t // h, 0, 0))
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, off=0, varlen=False,
+                          bq=bq, bk=bk, nq=nq, off=0, varlen=varlen,
                           bshd=True, rate=dropout_rate),
         grid=(b * h, nk, nq),
         in_specs=[pl.BlockSpec((1, bq, d), qm2),
@@ -531,7 +555,7 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
                   pl.BlockSpec((1, bk, d), vm2),
                   pl.BlockSpec((1, bq, d), dom2),
                   pl.BlockSpec((1, 1, bq, _LSE_LANES), rm2),
-                  pl.BlockSpec((1, 1, bq, _LSE_LANES), rm2)] + seed_specs,
+                  pl.BlockSpec((1, 1, bq, _LSE_LANES), rm2)] + extra_specs2,
         out_specs=[pl.BlockSpec((1, bk, d), dkm),
                    pl.BlockSpec((1, bk, d), dkm)],
         out_shape=[
@@ -546,16 +570,16 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(qkv, qkv, qkv, do, lse4, delta4, *seed_args)
+    )(qkv, qkv, qkv, do, lse4, delta4, *extra_args)
     if group > 1:
         dk = _group_sum(dk, h_kv, group, d, qkv.dtype)
         dv = _group_sum(dv, h_kv, group, d, qkv.dtype)
     return dq, dk, dv
 
 
-def flash_fwd_bshd(q, k, v, *, scale, causal, bq=1024, bk=1024,
-                   full_lse=False, interpret=False, dropout_rate=0.0,
-                   dropout_seed=None):
+def flash_fwd_bshd(q, k, v, *, scale, causal, kv_lens=None, bq=1024,
+                   bk=1024, full_lse=False, interpret=False,
+                   dropout_rate=0.0, dropout_seed=None):
     """Seq-major flash forward: q (b, sq, h, d); k/v (b, sk, h_kv, d).
 
     The (s, h·d)-minor layout is exactly what the QKV projection GEMMs
@@ -565,12 +589,17 @@ def flash_fwd_bshd(q, k, v, *, scale, causal, bq=1024, bk=1024,
     folded views (free bitcasts) and the head is selected by the block
     index along the folded feature dim — a d-wide column block, satisfying
     Mosaic's (8, 128) trailing-tile rule where a 4D singleton-head block
-    cannot. Returns (o (b, sq, h, d), lse (b, h, sq))."""
+    cannot. Returns (o (b, sq, h, d), lse (b, h, sq)).
+
+    ``kv_lens`` (b,) int32: per-BATCH valid kv lengths (heads share a
+    row's length — the padded-batch case); same masking/skip semantics as
+    :func:`flash_fwd`."""
     b, sq, h, d = q.shape
     sk, h_kv = k.shape[1], k.shape[2]
     group = h // h_kv
     bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
+    varlen = kv_lens is not None
 
     args = [q.reshape(b, sq, h * d), k.reshape(b, sk, h_kv * d),
             v.reshape(b, sk, h_kv * d)]
@@ -584,13 +613,15 @@ def flash_fwd_bshd(q, k, v, *, scale, causal, bq=1024, bk=1024,
                      lambda t, i, j, h=h, g=group:
                      (t // h, j, (t % h) // g)),
     ]
-    if dropout_rate > 0.0:
-        in_specs.append(_SMEM_SPEC)
-        args.append(_seed_operand(dropout_seed))
+    tail_specs, tail_args = _tail_operands(
+        kv_lens, b, dropout_rate, dropout_seed,
+        lambda t, i, j, h=h: (t // h, 0, 0))
+    in_specs += tail_specs
+    args += tail_args
 
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=False,
+                          bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=varlen,
                           bshd=True, rate=dropout_rate),
         grid=(b * h, nq, nk),
         in_specs=in_specs,
@@ -777,15 +808,12 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
     lse3 = lse if lse.ndim == 3 else _expand_rows(lse)
     delta3 = _expand_rows(delta)
     varlen = kv_lens is not None
-    extra_args = [_kvlen_rows(kv_lens, bh)] if varlen else []
-    if dropout_rate > 0.0:
-        extra_args.append(_seed_operand(dropout_seed))
+    _, extra_args = _tail_operands(
+        kv_lens, bh, dropout_rate, dropout_seed, None)
 
     def kvlen_spec(index_map):
-        specs = ([pl.BlockSpec((1, 1, _LSE_LANES), index_map)]
-                 if varlen else [])
-        if dropout_rate > 0.0:
-            specs.append(_SMEM_SPEC)
+        specs, _ = _tail_operands(
+            kv_lens, bh, dropout_rate, dropout_seed, index_map)
         return specs
 
     dq = pl.pallas_call(
@@ -852,8 +880,9 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
     return dq, dk, dv
 
 
-def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
-                   interpret=False, dropout_rate=0.0, dropout_seed=None):
+def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
+                   bq=1024, bk=1024, interpret=False, dropout_rate=0.0,
+                   dropout_seed=None):
     """Seq-major backward (cf. :func:`flash_fwd_bshd`): q/o/do
     (b, sq, h, d), k/v (b, sk, h_kv, d), lse (b, h, sq) or the
     (b, h, sq, LANES) carrier from ``flash_fwd_bshd(full_lse=True)``.
@@ -886,17 +915,18 @@ def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
     qm = lambda t, i, j, h=h: (t // h, i, t % h)  # noqa: E731
     km = lambda t, i, j, h=h, g=group: (t // h, j, (t % h) // g)  # noqa: E731
     rm = lambda t, i, j, h=h: (t // h, t % h, i, 0)  # noqa: E731
-    seed_specs = [_SMEM_SPEC] if dropout_rate > 0.0 else []
-    seed_args = ([_seed_operand(dropout_seed)]
-                 if dropout_rate > 0.0 else [])
+    varlen = kv_lens is not None
+    extra_specs, extra_args = _tail_operands(
+        kv_lens, b, dropout_rate, dropout_seed,
+        lambda t, i, j, h=h: (t // h, 0, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=False,
+                          bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=varlen,
                           bshd=True, rate=dropout_rate),
         grid=(b * h, nq, nk),
         in_specs=[q_spec(qm), kv_spec(km), kv_spec(km), q_spec(qm),
-                  row_spec(rm), row_spec(rm)] + seed_specs,
+                  row_spec(rm), row_spec(rm)] + extra_specs,
         out_specs=q_spec(qm),
         out_shape=jax.ShapeDtypeStruct((b, sq, h * d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
@@ -904,7 +934,7 @@ def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q3, k3, v3, do3, lse4, delta4, *seed_args)
+    )(q3, k3, v3, do3, lse4, delta4, *extra_args)
 
     qm2 = lambda t, j, i, h=h: (t // h, i, t % h)  # noqa: E731
     km2 = lambda t, j, i, h=h, g=group: (t // h, j, (t % h) // g)  # noqa: E731
@@ -914,14 +944,17 @@ def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
     dkv_dtypes = (jnp.float32, jnp.float32) if group > 1 else (k.dtype,
                                                                v.dtype)
     dkm = lambda t, j, i, h=h: (t // h, j, t % h)  # noqa: E731
+    extra_specs2, _ = _tail_operands(
+        kv_lens, b, dropout_rate, dropout_seed,
+        lambda t, j, i, h=h: (t // h, 0, 0))
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, off=sk - sq, varlen=False,
+                          bq=bq, bk=bk, nq=nq, off=sk - sq, varlen=varlen,
                           bshd=True, rate=dropout_rate),
         grid=(b * h, nk, nq),
         in_specs=[q_spec(qm2), kv_spec(km2), kv_spec(km2), q_spec(qm2),
-                  row_spec(rm2), row_spec(rm2)] + seed_specs,
+                  row_spec(rm2), row_spec(rm2)] + extra_specs2,
         out_specs=[kv_spec(dkm), kv_spec(dkm)],
         out_shape=[
             jax.ShapeDtypeStruct((b, sk, h * d), dkv_dtypes[0]),
@@ -935,7 +968,7 @@ def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, bq=1024, bk=1024,
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q3, k3, v3, do3, lse4, delta4, *seed_args)
+    )(q3, k3, v3, do3, lse4, delta4, *extra_args)
     dq = dq.reshape(b, sq, h, d)
     if group > 1:
         dk = _group_sum(dk, h_kv, group, d, k.dtype)

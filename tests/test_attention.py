@@ -291,9 +291,10 @@ class TestGroupedQueryAttention:
             causal)) ** 2))(q)
         np.testing.assert_allclose(g, gref, rtol=G_RTOL, atol=G_ATOL)
 
-    def test_bshd_rejects_kv_lens_and_bad_rank(self):
+    def test_bshd_rejects_bad_lens_shape_and_bad_rank(self):
         q = jr.normal(K, (2, 32, 4, 16))
-        with pytest.raises(NotImplementedError, match="kv_lens"):
+        # bshd kv_lens are per-BATCH (b,) — per-(b, h) is the bhsd form
+        with pytest.raises(ValueError, match="per-batch kv_lens"):
             flash_attention(q, q, q, layout="bshd",
                             kv_lens=jnp.ones((2, 4), jnp.int32))
         with pytest.raises(ValueError, match="bshd"):
@@ -351,8 +352,8 @@ class TestGroupedQueryAttention:
                               w_out.reshape(H, h, d))
 
         def fused(x, w_qkv, b_qkv, w_out):
-            return fused_qkv_attention(x, w_qkv, b_qkv, w_out, None, h,
-                                       hkv, d, scale, causal)
+            return fused_qkv_attention(x, w_qkv, b_qkv, w_out, None,
+                                       None, h, hkv, d, scale, causal)
 
         with jax.default_matmul_precision("highest"):
             y1 = fused(x, w_qkv, b_qkv, w_out)
@@ -884,8 +885,9 @@ class TestFlashDropout:
             return jnp.einsum("bshd,Hhd->bsH", o, w_out.reshape(H, h, d))
 
         def fused(x, w_qkv, b_qkv, w_out):
-            return fused_qkv_attention(x, w_qkv, b_qkv, w_out, seed, h,
-                                       hkv, d, scale, True, self.RATE)
+            return fused_qkv_attention(x, w_qkv, b_qkv, w_out, seed,
+                                       None, h, hkv, d, scale, True,
+                                       self.RATE)
 
         with jax.default_matmul_precision("highest"):
             np.testing.assert_allclose(fused(x, w_qkv, b_qkv, w_out),
@@ -962,3 +964,136 @@ class TestGPTFlashDropout:
         assert float(l1) != float(l0)
         for leaf in jax.tree.leaves(g):
             assert bool(jnp.all(jnp.isfinite(leaf)))
+
+
+class TestVarlenFastPath:
+    """kv_lens on the bshd and packed kernels (VERDICT r3 weak #5 / next
+    #6): per-BATCH lengths ride the head-folded index maps; BERT's padded
+    batches keep the zero-layout-copy route."""
+
+    def _dense_varlen_ref(self, q4, k4, v4, lens, scale):
+        """bhsd dense oracle from (b, s, h, d) operands + (b,) lengths."""
+        b, s, h, d = q4.shape
+        t = lambda z: z.transpose(0, 2, 1, 3).reshape(b * z.shape[2], s, d)
+        from apex_tpu.ops.attention import _xla_attention
+        o3, _ = _xla_attention(t(q4), t(k4), t(v4), scale, False,
+                               jnp.repeat(lens, h))
+        return o3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    @pytest.mark.parametrize("kv_heads", [2, 1])
+    def test_bshd_kernel_varlen_matches_dense(self, kv_heads, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        b, s, h, d = 4, 256, 2, 128
+        q = jr.normal(K, (b, s, h, d))
+        k = jr.normal(jr.fold_in(K, 80), (b, s, kv_heads, d))
+        v = jr.normal(jr.fold_in(K, 81), (b, s, kv_heads, d))
+        lens = jnp.array([256, 130, 7, 0], jnp.int32)
+        scale = 1.0 / d ** 0.5
+        rep = h // kv_heads
+
+        with jax.default_matmul_precision("highest"):
+            f1 = lambda q, k, v: jnp.sum(jnp.sin(flash_attention(
+                q, k, v, kv_lens=lens, layout="bshd", impl="pallas")))
+            ref = lambda q, k, v: jnp.sum(jnp.sin(self._dense_varlen_ref(
+                q, jnp.repeat(k, rep, 2), jnp.repeat(v, rep, 2), lens,
+                scale)))
+            np.testing.assert_allclose(float(f1(q, k, v)),
+                                       float(ref(q, k, v)), rtol=1e-5)
+            g1 = jax.grad(f1, argnums=(0, 1, 2))(q, k, v)
+            g2 = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+        for a, e, n in zip(g1, g2, "qkv"):
+            np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-5,
+                                       err_msg=n)
+
+    def test_bshd_varlen_with_dropout(self, monkeypatch):
+        """varlen + in-kernel dropout compose on the bshd path."""
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        b, s, h, d = 2, 128, 1, 128
+        q = jr.normal(K, (b, s, h, d))
+        lens = jnp.array([128, 60], jnp.int32)
+        seed = jnp.int32(3)
+        o = flash_attention(q, q, q, kv_lens=lens, layout="bshd",
+                            impl="pallas", dropout_rate=0.3,
+                            dropout_seed=seed)
+        o2 = flash_attention(q, q, q, kv_lens=lens, layout="bshd",
+                             impl="xla", dropout_rate=0.3,
+                             dropout_seed=seed)
+        np.testing.assert_allclose(o, o2, rtol=2e-5, atol=2e-5)
+        # masked-out tail of row 1 contributes nothing
+        assert bool(jnp.all(jnp.isfinite(o)))
+
+    def test_packed_fused_varlen_matches_bshd(self, monkeypatch):
+        """fused_qkv_attention with kv_lens == the bshd composition —
+        padded/ragged batches ride the zero-layout-copy block. Multi-block
+        (s=256, bq=128 via block override is not exposed — use s=256 with
+        default fitting) AND the two-kernel backward (varlen skips the
+        single-block fused kernel)."""
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        from apex_tpu.ops.attention import fused_qkv_attention
+
+        b, s, H, h, d = 2, 256, 64, 2, 128
+        hkv = 2
+        G = h + 2 * hkv
+        key = jr.fold_in(K, 82)
+        x = jr.normal(key, (b, s, H))
+        w_qkv = jr.normal(jr.fold_in(key, 1), (G * d, H)) * 0.1
+        b_qkv = jr.normal(jr.fold_in(key, 2), (G * d,)) * 0.1
+        w_out = jr.normal(jr.fold_in(key, 3), (H, h * d)) * 0.1
+        lens = jnp.array([256, 100], jnp.int32)
+        scale = 1.0 / d ** 0.5
+
+        def composed(x, w_qkv, b_qkv, w_out):
+            qkv = jnp.einsum("bsH,FH->bsF", x, w_qkv) + b_qkv
+            qkv = qkv.reshape(b, s, G, d)
+            q, k, v = (qkv[:, :, :h], qkv[:, :, h:h + hkv],
+                       qkv[:, :, h + hkv:])
+            o = flash_attention(q, k, v, kv_lens=lens, layout="bshd",
+                                impl="pallas", scale=scale, causal=True)
+            return jnp.einsum("bshd,Hhd->bsH", o, w_out.reshape(H, h, d))
+
+        def fused(x, w_qkv, b_qkv, w_out):
+            return fused_qkv_attention(x, w_qkv, b_qkv, w_out, None, lens,
+                                       h, hkv, d, scale, True)
+
+        with jax.default_matmul_precision("highest"):
+            np.testing.assert_allclose(fused(x, w_qkv, b_qkv, w_out),
+                                       composed(x, w_qkv, b_qkv, w_out),
+                                       rtol=2e-5, atol=2e-5)
+            l1 = lambda *a: jnp.sum(jnp.sin(fused(*a)))
+            l2 = lambda *a: jnp.sum(jnp.sin(composed(*a)))
+            g1 = jax.grad(l1, argnums=(0, 1, 2, 3))(x, w_qkv, b_qkv, w_out)
+            g2 = jax.grad(l2, argnums=(0, 1, 2, 3))(x, w_qkv, b_qkv, w_out)
+        for a, e, n in zip(g1, g2, ("x", "w_qkv", "b_qkv", "w_out")):
+            np.testing.assert_allclose(a, e, rtol=3e-4, atol=3e-5,
+                                       err_msg=n)
+
+    def test_bshd_rejects_wrong_lens_shape(self):
+        q = jr.normal(K, (2, 128, 1, 128))
+        with pytest.raises(ValueError, match="per-batch kv_lens"):
+            flash_attention(q, q, q, layout="bshd",
+                            kv_lens=jnp.zeros((2, 1), jnp.int32))
+
+    def test_bert_varlen_rides_bshd_kernels(self, monkeypatch):
+        """BERT with suffix padding on a bshd-eligible config (d=128):
+        flash == softmax impl, and the flash path goes through the bshd
+        kernels (interpret forced so the kernel code actually runs)."""
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        from apex_tpu.models import BertConfig, BertModel
+
+        kw = dict(vocab_size=64, max_seq_len=128, hidden_size=256,
+                  num_layers=2, num_heads=2)  # head_dim 128: bshd-eligible
+        m_f = BertModel(BertConfig(**kw, attention_impl="flash"))
+        m_s = BertModel(BertConfig(**kw, attention_impl="softmax"))
+        params = m_f.init(jr.fold_in(K, 83))
+        b, s = 2, 128
+        toks = jr.randint(jr.fold_in(K, 84), (b, s), 0, 64)
+        # suffix padding: row 0 full, row 1 valid through 57
+        pad_mask = jnp.arange(s)[None, :] >= jnp.array([[s], [57]])
+        with jax.default_matmul_precision("highest"):
+            h_f = m_f.hidden_states(params, toks, pad_mask=pad_mask)
+            h_s = m_s.hidden_states(params, toks, pad_mask=pad_mask)
+        # only VALID positions must agree (padding rows see garbage keys
+        # in neither impl but their outputs are don't-care)
+        np.testing.assert_allclose(h_f[0], h_s[0], rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(h_f[1, :57], h_s[1, :57], rtol=1e-4,
+                                   atol=1e-4)

@@ -61,12 +61,12 @@ wqkv = jr.normal(k, (3 * 4 * 128, 512), jnp.bfloat16) * 0.02
 bqkv = jnp.zeros((3 * 4 * 128,), jnp.bfloat16)
 wout = jr.normal(k, (512, 4 * 128), jnp.bfloat16) * 0.02
 check("fused_qkv_attention fwd", lambda x: fused_qkv_attention(
-    x, wqkv, bqkv, wout, None, 4, 4, 128, 128 ** -0.5, True), xf)
+    x, wqkv, bqkv, wout, None, None, 4, 4, 128, 128 ** -0.5, True), xf)
 check("fused_qkv_attention bwd", jax.grad(lambda x: fused_qkv_attention(
-    x, wqkv, bqkv, wout, None, 4, 4, 128, 128 ** -0.5,
+    x, wqkv, bqkv, wout, None, None, 4, 4, 128, 128 ** -0.5,
     True).astype(jnp.float32).sum()), xf)
 check("fused_qkv_attention dropout fwd", lambda x: fused_qkv_attention(
-    x, wqkv, bqkv, wout, jnp.int32(7), 4, 4, 128, 128 ** -0.5, True,
+    x, wqkv, bqkv, wout, jnp.int32(7), None, 4, 4, 128, 128 ** -0.5, True,
     0.1), xf)
 check("flash dropout bwd", jax.grad(lambda q: flash_attention(
     q, q, q, causal=True, impl="pallas", dropout_rate=0.1,
