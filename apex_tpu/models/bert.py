@@ -134,23 +134,14 @@ class BertModel:
                 # per-BATCH kv_lens consumed by the bshd kernels' head-
                 # folded index maps — padded batches keep the zero-layout-
                 # copy route (VERDICT r3 weak #5 cured)
+                from apex_tpu.ops.attention import (
+                    bshd_output_projection, bshd_qkv_projection)
                 xg = self.qkv.gather_input(x)
-                w = p["qkv"]["weight"]  # (3h·d, H), q|k|v head groups
-                H = w.shape[-1]
-                wq = w[:h * d].reshape(h, d, H)
-                wk = w[h * d:2 * h * d].reshape(h, d, H)
-                wv = w[2 * h * d:].reshape(h, d, H)
-                q = jnp.einsum("bsH,hdH->bshd", xg, wq)
-                k = jnp.einsum("bsH,hdH->bshd", xg, wk)
-                v = jnp.einsum("bsH,hdH->bshd", xg, wv)
-                if "bias" in p["qkv"]:
-                    bias = p["qkv"]["bias"]
-                    q = q + bias[:h * d].reshape(h, d)
-                    k = k + bias[h * d:2 * h * d].reshape(h, d)
-                    v = v + bias[2 * h * d:].reshape(h, d)
+                q, k, v = bshd_qkv_projection(
+                    xg, p["qkv"]["weight"], p["qkv"].get("bias"), h, h, d)
                 ctx = flash_attention(q, k, v, kv_lens=lens, layout="bshd")
-                wo = p["attn_out"]["weight"].reshape(-1, h, d)
-                y = jnp.einsum("bshd,Hhd->bsH", ctx, wo)
+                y = bshd_output_projection(ctx, p["attn_out"]["weight"],
+                                           h, d)
                 y = self.attn_out.reduce_output(y)
                 if "bias" in p["attn_out"]:
                     y = y + p["attn_out"]["bias"]

@@ -342,19 +342,10 @@ class GPTModel:
             # (bshd) einsums + the flash entry's XLA/Pallas dispatch. The
             # (b, s, h, d) layout is the GEMM's natural output, so this
             # path too avoids the old head-batched formulation's copies.
-            w = p["qkv"]["weight"]                    # (G*d, H), q|k|v packed
-            H = w.shape[-1]
-            wq = w[:h * d].reshape(h, d, H)
-            wk = w[h * d:(h + hkv) * d].reshape(hkv, d, H)
-            wv = w[(h + hkv) * d:].reshape(hkv, d, H)
-            q = jnp.einsum("bsH,hdH->bshd", xg, wq)
-            k = jnp.einsum("bsH,hdH->bshd", xg, wk)
-            v = jnp.einsum("bsH,hdH->bshd", xg, wv)
-            if "bias" in p["qkv"]:
-                bias = p["qkv"]["bias"]
-                q = q + bias[:h * d].reshape(h, d)
-                k = k + bias[h * d:(h + hkv) * d].reshape(hkv, d)
-                v = v + bias[(h + hkv) * d:].reshape(hkv, d)
+            from apex_tpu.ops.attention import (bshd_output_projection,
+                                                bshd_qkv_projection)
+            q, k, v = bshd_qkv_projection(
+                xg, p["qkv"]["weight"], p["qkv"].get("bias"), h, hkv, d)
             if c.cp_axis is not None:
                 # context parallelism: q/k/v cover this device's sequence
                 # shard; attention distributes over the cp axis — ring (kv
@@ -385,8 +376,7 @@ class GPTModel:
             else:
                 ctx = flash_attention(q, k, v, causal=True, layout="bshd",
                                       dropout_rate=drop, dropout_seed=seed)
-            wo = p["attn_out"]["weight"].reshape(-1, h, d)
-            y = jnp.einsum("bshd,Hhd->bsH", ctx, wo)
+            y = bshd_output_projection(ctx, p["attn_out"]["weight"], h, d)
             y = self.attn_out.reduce_output(y)
             if "bias" in p["attn_out"]:
                 y = y + p["attn_out"]["bias"]

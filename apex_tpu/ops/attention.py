@@ -210,6 +210,32 @@ def bshd_kernel_ok(sq: int, sk: int, h: int, d: int, dtype) -> bool:
             and dtype != jnp.float16)
 
 
+def bshd_qkv_projection(x, weight, bias, h, h_kv, d):
+    """(b, s, H) activations through a PACKED q|k|v weight ((h+2·h_kv)·d,
+    H), features ordered q-heads|k-heads|v-heads — straight to the
+    seq-major (b, s, heads, d) layout the bshd kernels read with no layout
+    copy. The ONE place the packed-layout slicing lives (GPT and BERT both
+    ride it; a layout change edits one function)."""
+    H = weight.shape[-1]
+    wq = weight[:h * d].reshape(h, d, H)
+    wk = weight[h * d:(h + h_kv) * d].reshape(h_kv, d, H)
+    wv = weight[(h + h_kv) * d:].reshape(h_kv, d, H)
+    q = jnp.einsum("bsH,hdH->bshd", x, wq)
+    k = jnp.einsum("bsH,hdH->bshd", x, wk)
+    v = jnp.einsum("bsH,hdH->bshd", x, wv)
+    if bias is not None:
+        q = q + bias[:h * d].reshape(h, d)
+        k = k + bias[h * d:(h + h_kv) * d].reshape(h_kv, d)
+        v = v + bias[(h + h_kv) * d:].reshape(h_kv, d)
+    return q, k, v
+
+
+def bshd_output_projection(ctx, weight, h, d):
+    """(b, s, h, d) attention context through the output weight (O, h·d),
+    contracted directly over (heads, d) — no transpose back to flat."""
+    return jnp.einsum("bshd,Hhd->bsH", ctx, weight.reshape(-1, h, d))
+
+
 def _to_bh(x):  # (b, s, h, d) -> (b*h, s, d) for the XLA fallback
     b, s, h, d = x.shape
     return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
