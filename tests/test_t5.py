@@ -314,3 +314,37 @@ class TestRematPolicies:
     def test_bad_policy_rejected(self):
         with pytest.raises(ValueError, match="remat_policy"):
             T5Config(**SMALL, remat_policy="half")
+
+    def test_encode_only_matches_blocks_through_pipeline(self):
+        """encode_only under the split-rank pipeline (the policy's primary
+        use case per PERF.md): the stage-local re-encode checkpoint must be
+        numerically transparent — loss and grads == the 'blocks' pipeline."""
+        M, b, s = 2, 2, 32
+        enc, dec, tgt = _data(jr.fold_in(K, 41), M, b, s)
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=2)
+        outs = {}
+        for policy in ("blocks", "encode_only"):
+            m = EncoderDecoderModel(T5Config(**SMALL, remat=True,
+                                             remat_policy=policy))
+            params = m.init(K)
+            pipe = EncDecPipeline(m, pp=2, split=1)
+            part = pipe.partition(params)
+            specs = pipe.param_specs(part)
+
+            def run(p, e, d, t):
+                lp = dict(p, stages=jax.tree.map(lambda x: x[0],
+                                                 p["stages"]))
+                loss, g = pipe.loss_and_grads(lp, e, d, t)
+                g["stages"] = jax.tree.map(lambda x: x[None], g["stages"])
+                return loss, g
+
+            with jax.default_matmul_precision("highest"):
+                outs[policy] = jax.jit(mesh_lib.shard_map(
+                    run, mesh=mesh, in_specs=(specs, P(), P(), P()),
+                    out_specs=(P(), specs),
+                ))(part, enc, dec, tgt)
+        np.testing.assert_allclose(float(outs["encode_only"][0]),
+                                   float(outs["blocks"][0]), rtol=1e-6)
+        for a, e in zip(jax.tree.leaves(outs["encode_only"][1]),
+                        jax.tree.leaves(outs["blocks"][1])):
+            np.testing.assert_allclose(a, e, rtol=1e-5, atol=1e-7)
