@@ -1,0 +1,49 @@
+"""Docs stay runnable: every ```python block in docs/TRAINING_GUIDE.md
+executes, in order, in one namespace on the virtual 8-device mesh — the
+"a new user can run DP→TP→PP from docs alone" guarantee (VERDICT r3 next
+#10), enforced rather than asserted."""
+
+import os
+import re
+
+import pytest
+
+
+def _guide_blocks():
+    path = os.path.join(os.path.dirname(__file__), "..", "docs",
+                        "TRAINING_GUIDE.md")
+    text = open(path).read()
+    return re.findall(r"```python\n(.*?)```", text, re.DOTALL)
+
+
+def test_training_guide_blocks_execute_in_order():
+    blocks = _guide_blocks()
+    assert len(blocks) >= 5, "guide lost its worked examples"
+    ns = {}
+    for i, block in enumerate(blocks):
+        try:
+            exec(compile(block, f"TRAINING_GUIDE.md[block {i}]", "exec"),
+                 ns)
+        except Exception as e:  # pragma: no cover - diagnostic
+            pytest.fail(f"guide block {i} failed: {type(e).__name__}: {e}\n"
+                        f"---\n{block}")
+
+
+def test_amp_worked_example_executes():
+    path = os.path.join(os.path.dirname(__file__), "..", "docs", "api",
+                        "amp.md")
+    block = re.findall(r"```python\n(.*?)```", open(path).read(),
+                       re.DOTALL)[0]
+    ns = {}
+    exec(compile(block, "amp.md[worked example]", "exec"), ns)
+    import jax.numpy as jnp
+    assert jnp.isfinite(ns["loss"])
+
+
+def test_guide_covers_the_ladder():
+    text = open(os.path.join(os.path.dirname(__file__), "..", "docs",
+                             "TRAINING_GUIDE.md")).read()
+    for needle in ("initialize_model_parallel", "shard_params_for_tp",
+                   "build_model", "loss_and_grads", "build_schedule",
+                   "zigzag_shard", "distributed_fused_adam"):
+        assert needle in text, f"guide dropped {needle}"
