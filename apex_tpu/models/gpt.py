@@ -120,12 +120,6 @@ class GPTConfig:
                 raise ValueError(
                     "context parallelism distributes the flash kernel "
                     "family; set attention_impl='flash'")
-            if self.dropout > 0:
-                raise ValueError(
-                    "in-kernel attention dropout does not yet compose with "
-                    "context parallelism (the ring pieces would need "
-                    "per-(rank, step) seed folding); set dropout=0 or drop "
-                    "cp_axis")
         if self.num_kv_heads is not None:
             if self.num_kv_heads < 1:
                 raise ValueError(
@@ -358,7 +352,9 @@ class GPTModel:
                 q, k, v = apply_op_rules("attention", q, k, v)
                 if c.cp_impl == "ulysses":
                     ctx = ulysses_attention(q, k, v, axis_name=c.cp_axis,
-                                            causal=True)
+                                            causal=True,
+                                            dropout_rate=drop,
+                                            dropout_seed=seed)
                 else:
                     # ring's state machine is bh-flat, so this path pays
                     # transpose/reshape pairs per layer (the layout
@@ -371,7 +367,9 @@ class GPTModel:
                     to_bh = lambda z: z.transpose(0, 2, 1, 3).reshape(  # noqa: E731
                         b_sz * z.shape[2], s_loc, d)
                     of = ring_attention(to_bh(q), to_bh(k), to_bh(v),
-                                        axis_name=c.cp_axis, causal=True)
+                                        axis_name=c.cp_axis, causal=True,
+                                        dropout_rate=drop,
+                                        dropout_seed=seed)
                     ctx = of.reshape(b_sz, h, s_loc, d).transpose(0, 2, 1, 3)
             else:
                 ctx = flash_attention(q, k, v, causal=True, layout="bshd",

@@ -600,16 +600,43 @@ def zigzag_unshard(x: jax.Array, cp: int, seq_axis: int = -2) -> jax.Array:
     return jnp.take(x, jnp.asarray(inverse), axis=seq_axis)
 
 
-def _piece_fwd(q, k, v, scale, causal, use_pallas):
+def fold_dropout_seed(seed, *ids):
+    """Derive a decorrelated int32 dropout seed from ``seed`` and integer
+    identifiers (cp rank, ring step, piece index, ...) via the same fmix32
+    avalanche the mask hash uses. Deterministic, traced-friendly; the
+    tool that lets distributed attention give every (shard, step, piece)
+    its own mask stream while forward and backward re-derive identical
+    seeds."""
+    h = jnp.asarray(seed).astype(jnp.uint32)
+    for i in ids:
+        h = _k._fmix32(h ^ (jnp.asarray(i).astype(jnp.uint32)
+                            * jnp.uint32(0x9E3779B9)))
+    return jax.lax.bitcast_convert_type(h, jnp.int32)
+
+
+def _piece_seed(dropout_seed, rank, t, piece):
+    """The ring's per-(rank, step, piece) mask-stream fold — ONE
+    definition so forward and the hand-written backward can never drift
+    apart (bit-identical folds are the gradient-correctness contract)."""
+    if dropout_seed is None:
+        return None
+    return fold_dropout_seed(dropout_seed, rank, t, piece)
+
+
+def _piece_fwd(q, k, v, scale, causal, use_pallas, dropout_rate=0.0,
+               dropout_seed=None):
     """(o, lse) of one attention piece through the flash kernel (or the XLA
     composition below its crossover)."""
     if use_pallas:
         return _k.flash_fwd(q, k, v, scale=scale, causal=causal,
-                            kv_lens=None, interpret=_backend.interpret_mode())
+                            kv_lens=None, interpret=_backend.interpret_mode(),
+                            dropout_rate=dropout_rate,
+                            dropout_seed=dropout_seed)
     group = q.shape[0] // k.shape[0]
     kf = jnp.repeat(k, group, 0) if group > 1 else k
     vf = jnp.repeat(v, group, 0) if group > 1 else v
-    return _xla_attention(q, kf, vf, scale, causal)
+    return _xla_attention(q, kf, vf, scale, causal, None, dropout_rate,
+                          dropout_seed)
 
 
 def _fold(o1, l1, o2, l2):
@@ -624,10 +651,16 @@ def _fold(o1, l1, o2, l2):
     return o, m + jnp.log(tot)
 
 
-def _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas):
+def _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas,
+                   dropout_rate=0.0, dropout_seed=None):
     cp = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def pseed(t, piece):
+        # each (q, k) pair is covered by exactly one piece, so the
+        # per-piece streams stay i.i.d. Bernoulli globally
+        return _piece_seed(dropout_seed, rank, t, piece)
 
     def rotate(t):
         return jax.tree.map(
@@ -636,18 +669,21 @@ def _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas):
     # step 0 — the local shard. Causal: the zigzag stripe pair [a; b] is
     # position-monotonic, so plain (blockwise) causal flash over the local
     # 2·ss rows is exactly the diagonal work.
-    o0, l0 = _piece_fwd(q, k, v, scale, causal, use_pallas)
+    o0, l0 = _piece_fwd(q, k, v, scale, causal, use_pallas,
+                        dropout_rate, pseed(0, 0))
 
     if not causal:
-        def step(carry, _):
+        def step(carry, t):
             o_acc, l_acc, kv = carry
             kv = rotate(kv)
-            oi, li = _piece_fwd(q, kv[0], kv[1], scale, False, use_pallas)
+            oi, li = _piece_fwd(q, kv[0], kv[1], scale, False, use_pallas,
+                                dropout_rate, pseed(t, 0))
             o_acc, l_acc = _fold(o_acc, l_acc, oi, li)
             return (o_acc, l_acc, kv), None
 
         (o_acc, l_acc, _), _ = jax.lax.scan(
-            step, (o0.astype(jnp.float32), l0, (k, v)), None, length=cp - 1)
+            step, (o0.astype(jnp.float32), l0, (k, v)),
+            jnp.arange(1, cp), length=cp - 1)
         return o_acc.astype(q.dtype), l_acc
 
     ss = q.shape[-2] // 2
@@ -662,7 +698,8 @@ def _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas):
         j = (rank - t) % cp
         # piece 1: this rank's HIGH stripe vs the arriving LOW stripe —
         # always a full (unmasked) attend (stripe j < cp <= 2cp−1−rank)
-        o1, l1 = _piece_fwd(q_hi, k_lo, v_lo, scale, False, use_pallas)
+        o1, l1 = _piece_fwd(q_hi, k_lo, v_lo, scale, False, use_pallas,
+                            dropout_rate, pseed(t, 1))
         o_hi, l_hi = _fold(o_hi, l_hi, o1, l1)
         # piece 2: j < rank → our LOW stripe sees their LOW stripe;
         # j > rank → our HIGH stripe sees their HIGH stripe. Both full
@@ -671,7 +708,8 @@ def _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas):
         q2 = jnp.where(lo_case, q_lo, q_hi)
         k2 = jnp.where(lo_case, k_lo, k_hi)
         v2 = jnp.where(lo_case, v_lo, v_hi)
-        o2, l2 = _piece_fwd(q2, k2, v2, scale, False, use_pallas)
+        o2, l2 = _piece_fwd(q2, k2, v2, scale, False, use_pallas,
+                            dropout_rate, pseed(t, 2))
         o_lo2, l_lo2 = _fold(o_lo, l_lo, o2, l2)
         o_hi2, l_hi2 = _fold(o_hi, l_hi, o2, l2)
         o_lo = jnp.where(lo_case, o_lo2, o_lo)
@@ -689,35 +727,44 @@ def _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas):
     return o, lse
 
 
-def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, scale, causal, use_pallas):
+def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, scale, causal,
+                   use_pallas, dropout_rate=0.0, dropout_seed=None):
     """The distributed flash backward: per ring step call ``flash_bwd``
     with the GLOBAL (o, lse) — p and Δ are then exact per shard — while a
     dkv accumulator travels the ring with its kv shard and arrives home
     after a full cycle carrying every rank's contribution (the reference
-    has no CP at all; this is the standard ring-attention backward)."""
+    has no CP at all; this is the standard ring-attention backward).
+    Dropout: each piece re-derives the SAME (rank, step, piece) seed fold
+    as forward, so masks regenerate exactly."""
     cp = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def pseed(t, piece):
+        return _piece_seed(dropout_seed, rank, t, piece)
 
     def rotate(t):
         return jax.tree.map(
             lambda x: jax.lax.ppermute(x, axis_name, perm), t)
 
     dq0, dk0, dv0 = _flash_bwd_impl(
-        q, k, v, o, lse, do, None, scale, causal, use_pallas)
+        q, k, v, o, lse, do, None, scale, causal, use_pallas,
+        dropout_rate, pseed(0, 0))
 
     if not causal:
-        def step(carry, _):
+        def step(carry, t):
             dq, kv, dk, dv = carry
             kv, (dk, dv) = rotate(kv), rotate((dk, dv))
             dqi, dki, dvi = _flash_bwd_impl(
-                q, kv[0], kv[1], o, lse, do, None, scale, False, use_pallas)
+                q, kv[0], kv[1], o, lse, do, None, scale, False,
+                use_pallas, dropout_rate, pseed(t, 0))
             return (dq + dqi, kv, dk + dki.astype(dk.dtype),
                     dv + dvi.astype(dv.dtype)), None
 
         init = (dq0.astype(jnp.float32), (k, v),
                 dk0.astype(jnp.float32), dv0.astype(jnp.float32))
-        (dq, _, dk, dv), _ = jax.lax.scan(step, init, None, length=cp - 1)
+        (dq, _, dk, dv), _ = jax.lax.scan(step, init, jnp.arange(1, cp),
+                                          length=cp - 1)
         dk, dv = rotate((dk, dv))  # final hop brings the accumulators home
         return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
@@ -739,7 +786,7 @@ def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, scale, causal, use_pallas):
         # piece 1 (mirror of forward): q_hi vs arriving kv_lo, full attend
         dq1, dk1, dv1 = _flash_bwd_impl(
             q_hi, k_lo, v_lo, o_hi, l_hi, do_hi, None, scale, False,
-            use_pallas)
+            use_pallas, dropout_rate, pseed(t, 1))
         dq_hi = dq_hi + dq1
         dk_lo = dk_lo + dk1
         dv_lo = dv_lo + dv1
@@ -752,7 +799,8 @@ def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, scale, causal, use_pallas):
         k2 = jnp.where(lo_case, k_lo, k_hi)
         v2 = jnp.where(lo_case, v_lo, v_hi)
         dq2, dk2, dv2 = _flash_bwd_impl(
-            q2, k2, v2, o2, l2, do2, None, scale, False, use_pallas)
+            q2, k2, v2, o2, l2, do2, None, scale, False, use_pallas,
+            dropout_rate, pseed(t, 2))
         dq_lo = dq_lo + jnp.where(lo_case, dq2, 0.0)
         dq_hi = dq_hi + jnp.where(lo_case, 0.0, dq2)
         dk_lo = dk_lo + jnp.where(lo_case, dk2, 0.0)
@@ -774,21 +822,27 @@ def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, scale, causal, use_pallas):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_core(q, k, v, axis_name, scale, causal, use_pallas):
-    o, _ = _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _ring_core(q, k, v, dropout_seed, axis_name, scale, causal,
+               use_pallas, dropout_rate):
+    o, _ = _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas,
+                          dropout_rate, dropout_seed)
     return o
 
 
-def _ring_fwd(q, k, v, axis_name, scale, causal, use_pallas):
-    o, lse = _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas)
-    return o, (q, k, v, o, lse)
+def _ring_fwd(q, k, v, dropout_seed, axis_name, scale, causal,
+              use_pallas, dropout_rate):
+    o, lse = _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas,
+                            dropout_rate, dropout_seed)
+    return o, (q, k, v, o, lse, dropout_seed)
 
 
-def _ring_bwd(axis_name, scale, causal, use_pallas, res, do):
-    q, k, v, o, lse = res
-    return _ring_bwd_impl(
-        q, k, v, o, lse, do, axis_name, scale, causal, use_pallas)
+def _ring_bwd(axis_name, scale, causal, use_pallas, dropout_rate, res, do):
+    q, k, v, o, lse, dropout_seed = res
+    dq, dk, dv = _ring_bwd_impl(
+        q, k, v, o, lse, do, axis_name, scale, causal, use_pallas,
+        dropout_rate, dropout_seed)
+    return dq, dk, dv, _float0_like(dropout_seed)
 
 
 _ring_core.defvjp(_ring_fwd, _ring_bwd)
@@ -798,6 +852,7 @@ def ring_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     *, axis_name: str = mesh_lib.CONTEXT_AXIS, causal: bool = False,
     scale: Optional[float] = None, impl: str = "auto",
+    dropout_rate: float = 0.0, dropout_seed: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention over a sequence sharded along ``axis_name``: q/k/v are this
     device's (bh, s_local, d) shard; the full sequence is cp·s_local. Must
@@ -826,9 +881,25 @@ def ring_attention(
 
     The reference has no context parallelism at all (SURVEY §2.3); this is
     the long-context extension built to the repo's own kernel bar.
+
+    ``dropout_rate > 0`` (``dropout_seed`` required; pass the SAME seed
+    on every cp rank — ranks decorrelate internally): in-kernel probs
+    dropout with a distinct mask stream per (rank, ring step, piece),
+    re-derived identically in the hand-written backward. Each (q, k)
+    pair is covered by exactly one piece, so masks stay i.i.d.
+    Bernoulli over the global score matrix.
     """
     d = q.shape[-1]
     scale = float(scale if scale is not None else 1.0 / d ** 0.5)
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got "
+                         f"{dropout_rate}")
+    if dropout_rate > 0.0:
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        dropout_seed = jnp.asarray(dropout_seed, jnp.int32)
+    else:
+        dropout_seed = None
     if q.shape[0] % k.shape[0]:
         raise ValueError(
             f"kv rows ({k.shape[0]}) must divide q rows ({q.shape[0]}) "
@@ -846,7 +917,8 @@ def ring_attention(
             and not _backend.interpret_forced()):
         impl = "xla"
     use_pallas = _backend.choose_impl(impl, ok) == "pallas"
-    return _ring_core(q, k, v, axis_name, scale, causal, use_pallas)
+    return _ring_core(q, k, v, dropout_seed, axis_name, scale, causal,
+                      use_pallas, dropout_rate)
 
 
 # --- Ulysses attention (all-to-all sequence parallel) -------------------------
@@ -855,6 +927,7 @@ def ulysses_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     *, axis_name: str = mesh_lib.CONTEXT_AXIS, causal: bool = False,
     scale: Optional[float] = None, impl: str = "auto",
+    dropout_rate: float = 0.0, dropout_seed: Optional[jax.Array] = None,
 ) -> jax.Array:
     """DeepSpeed-Ulysses-style sequence parallelism: q/k/v are this device's
     (batch, s_local, heads, head_dim) sequence shard with ALL heads; an
@@ -872,6 +945,14 @@ def ulysses_attention(
     """
     sp = jax.lax.axis_size(axis_name)
     b, s_local, h, d = q.shape
+    if dropout_rate > 0.0:
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        # each device attends a DIFFERENT head group over the full
+        # sequence: fold the cp rank so head groups draw decorrelated
+        # masks (pass the same base seed on every rank)
+        dropout_seed = fold_dropout_seed(
+            dropout_seed, jax.lax.axis_index(axis_name))
     h_kv = k.shape[2]
     if h % sp != 0 or h_kv % sp != 0:
         raise ValueError(
@@ -898,7 +979,9 @@ def ulysses_attention(
         # was pure layout traffic — the ~22% "head re-sharding" overhead
         # PERF.md measured was mostly these, not the collectives
         o = flash_attention(qg, kg, vg, causal=causal, scale=scale,
-                            impl=impl, layout="bshd")
+                            impl=impl, layout="bshd",
+                            dropout_rate=dropout_rate,
+                            dropout_seed=dropout_seed)
     else:
         # bshd tiling ineligible (e.g. head_dim 64 with several local
         # heads) — keep the flat-kernel path rather than letting the bshd
@@ -908,7 +991,9 @@ def ulysses_attention(
             return x.transpose(0, 2, 1, 3).reshape(b * x.shape[2], s, d)
 
         o = flash_attention(to_bh(qg), to_bh(kg), to_bh(vg),
-                            causal=causal, scale=scale, impl=impl)
+                            causal=causal, scale=scale, impl=impl,
+                            dropout_rate=dropout_rate,
+                            dropout_seed=dropout_seed)
         o = o.reshape(b, h_loc, s, d).transpose(0, 2, 1, 3)
     # (b, s, h/P, d) -> (b, s/P, h, d): gather heads, re-scatter sequence
     return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,

@@ -523,7 +523,53 @@ class TestContextParallelFlagship:
         with pytest.raises(ValueError, match="flash"):
             GPTConfig(**{**self.CPKW, "attention_impl": "softmax"},
                       cp_axis="cp")
-        with pytest.raises(ValueError, match="dropout"):
-            GPTConfig(**self.CPKW, cp_axis="cp", dropout=0.1)
         with pytest.raises(ValueError, match="cp_impl"):
             GPTConfig(**self.CPKW, cp_axis="cp", cp_impl="tree")
+        # dropout composes with cp since r4 (per-(rank, step, piece) seed
+        # folds in ring; rank-folded seeds in ulysses)
+        GPTConfig(**self.CPKW, cp_axis="cp", dropout=0.1)
+
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_cp_with_dropout_trains_keyed(self, impl):
+        """dropout > 0 on the cp flagship: finite keyed loss, determinism
+        per key, variation across keys — through pp x cp in one mesh."""
+        from apex_tpu.ops.attention import zigzag_shard
+
+        cfg = GPTConfig(**self.CPKW, cp_axis="cp", cp_impl=impl,
+                        dropout=0.2)
+        m = GPTModel(cfg)
+        params = GPTModel(GPTConfig(**self.CPKW)).init(jr.fold_in(K, 50))
+        pipe = GPTPipeline(m, pp=2)
+        part = pipe.partition(params)
+        specs = pipe.param_specs(part)
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=2,
+                                  context_parallel_size=2)
+        M, b, s, dp = 2, 2, 64, 2
+        toks = jr.randint(jr.fold_in(K, 51), (M, b * dp, s), 0, 64)
+        tgts = jr.randint(jr.fold_in(K, 52), (M, b * dp, s), 0, 64)
+        if impl == "ring":
+            toks = zigzag_shard(toks, 2, 2)
+            tgts = zigzag_shard(tgts, 2, 2)
+
+        def run(p, t, g, key):
+            lp = dict(p, stages=jax.tree.map(lambda x: x[0], p["stages"]))
+            loss, grads = pipe.loss_and_grads(
+                lp, t, g, dp_axis=("dp", "cp"), key=key)
+            grads["stages"] = jax.tree.map(lambda x: x[None],
+                                           grads["stages"])
+            return loss, grads
+
+        f = jax.jit(mesh_lib.shard_map(
+            run, mesh=mesh,
+            in_specs=(specs, P(None, "dp", "cp"), P(None, "dp", "cp"),
+                      P()),
+            out_specs=(P(), specs),
+        ))
+        l1, g1 = f(part, toks, tgts, jr.PRNGKey(1))
+        l1b, _ = f(part, toks, tgts, jr.PRNGKey(1))
+        l2, _ = f(part, toks, tgts, jr.PRNGKey(2))
+        assert jnp.isfinite(l1)
+        assert float(l1) == float(l1b)
+        assert float(l1) != float(l2)
+        for leaf in jax.tree.leaves(g1):
+            assert bool(jnp.all(jnp.isfinite(leaf)))
