@@ -355,14 +355,18 @@ class GPTModel:
                                             causal=True,
                                             dropout_rate=drop,
                                             dropout_seed=seed)
+                elif bshd_kernel_ok(q.shape[1] // 2, q.shape[1] // 2, h,
+                                    d, q.dtype):
+                    # ring rides the seq-major kernels directly (r4 late):
+                    # the stripe pieces read the projection GEMMs' layout
+                    # with zero transposes per ring step
+                    ctx = ring_attention(q, k, v, axis_name=c.cp_axis,
+                                         causal=True, layout="bshd",
+                                         dropout_rate=drop,
+                                         dropout_seed=seed)
                 else:
-                    # ring's state machine is bh-flat, so this path pays
-                    # transpose/reshape pairs per layer (the layout
-                    # traffic Ulysses avoids by riding the bshd kernels —
-                    # PERF.md r3); prefer cp_impl='ulysses' when
-                    # heads % cp == 0 and memory admits the full-seq
-                    # gather. A bshd ring would need the zigzag fold
-                    # rewritten on 4D halves — candidate r5 work.
+                    # bh-flat fallback (d=64-class shapes the folded bshd
+                    # tiling can't express): transpose round trip per layer
                     b_sz, s_loc = q.shape[0], q.shape[1]
                     to_bh = lambda z: z.transpose(0, 2, 1, 3).reshape(  # noqa: E731
                         b_sz * z.shape[2], s_loc, d)

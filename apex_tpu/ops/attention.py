@@ -639,28 +639,66 @@ def _piece_fwd(q, k, v, scale, causal, use_pallas, dropout_rate=0.0,
                           dropout_seed)
 
 
-def _fold(o1, l1, o2, l2):
+def _piece_fwd_bshd(q, k, v, scale, causal, use_pallas, dropout_rate=0.0,
+                    dropout_seed=None):
+    """(o (b, s, h, d), lse (b, h, s)) of one seq-major piece — the
+    bshd-layout twin of :func:`_piece_fwd` (kernels read the projection
+    GEMMs' natural layout; no transpose round trip per ring step)."""
+    o, res = _flash_fwd_res_bshd(q, k, v, None, dropout_seed, scale,
+                                 causal, use_pallas, dropout_rate)
+    lse = res[4]
+    # the pallas path returns the (b, h, s, LANES) carrier; the ring's
+    # fold arithmetic runs on the sliced (b, h, s) row form
+    return o, (lse[..., 0] if lse.ndim == 4 else lse)
+
+
+def _piece_bwd_bshd(q, k, v, o, lse, do, scale, causal, use_pallas,
+                    dropout_rate=0.0, dropout_seed=None):
+    """Piece backward in the bshd layout (lse (b, h, s)) — delegates to
+    the flash bshd backward with the ring's GLOBAL lse."""
+    dq, dk, dv, _, _ = _flash_bwd_bshd(
+        scale, causal, use_pallas, dropout_rate,
+        ((q, k, v, o, lse), None, dropout_seed), do)
+    return dq, dk, dv
+
+
+def _fold(o1, l1, o2, l2, bshd=False):
     """Merge two normalized attention pieces over the same q rows:
-    (o, lse) ⊕ (o, lse) → (o, lse), the online-softmax combine."""
+    (o, lse) ⊕ (o, lse) → (o, lse), the online-softmax combine. With
+    ``bshd``, o is (b, s, h, d) and lse (b, h, s) — the weights transpose
+    to the seq-major broadcast."""
     m = jnp.maximum(l1, l2)
     e1 = jnp.exp(l1 - m)
     e2 = jnp.exp(l2 - m)
     tot = e1 + e2
-    o = (o1 * (e1 / tot)[..., None]
-         + o2.astype(jnp.float32) * (e2 / tot)[..., None])
+    w1, w2 = e1 / tot, e2 / tot
+    if bshd:
+        w1 = w1.transpose(0, 2, 1)[..., None]
+        w2 = w2.transpose(0, 2, 1)[..., None]
+    else:
+        w1, w2 = w1[..., None], w2[..., None]
+    o = o1 * w1 + o2.astype(jnp.float32) * w2
     return o, m + jnp.log(tot)
 
 
 def _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas,
-                   dropout_rate=0.0, dropout_seed=None):
+                   dropout_rate=0.0, dropout_seed=None, bshd=False):
+    """Layout-generic ring forward: ``bshd=False`` takes (bh, s, d)
+    operands with lse (bh, s); ``bshd=True`` takes (b, s, h, d) with lse
+    (b, h, s) — the seq axis is 1 either way, only the lse carrier and
+    the piece/fold functions differ (the bshd kernels read the projection
+    GEMMs' layout directly, removing the per-ring-step transpose round
+    trip the flat layout paid)."""
     cp = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
+    piece = _piece_fwd_bshd if bshd else _piece_fwd
+    lse_ax = 2 if bshd else 1
 
-    def pseed(t, piece):
+    def pseed(t, piece_id):
         # each (q, k) pair is covered by exactly one piece, so the
         # per-piece streams stay i.i.d. Bernoulli globally
-        return _piece_seed(dropout_seed, rank, t, piece)
+        return _piece_seed(dropout_seed, rank, t, piece_id)
 
     def rotate(t):
         return jax.tree.map(
@@ -669,16 +707,16 @@ def _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas,
     # step 0 — the local shard. Causal: the zigzag stripe pair [a; b] is
     # position-monotonic, so plain (blockwise) causal flash over the local
     # 2·ss rows is exactly the diagonal work.
-    o0, l0 = _piece_fwd(q, k, v, scale, causal, use_pallas,
-                        dropout_rate, pseed(0, 0))
+    o0, l0 = piece(q, k, v, scale, causal, use_pallas,
+                   dropout_rate, pseed(0, 0))
 
     if not causal:
         def step(carry, t):
             o_acc, l_acc, kv = carry
             kv = rotate(kv)
-            oi, li = _piece_fwd(q, kv[0], kv[1], scale, False, use_pallas,
-                                dropout_rate, pseed(t, 0))
-            o_acc, l_acc = _fold(o_acc, l_acc, oi, li)
+            oi, li = piece(q, kv[0], kv[1], scale, False, use_pallas,
+                           dropout_rate, pseed(t, 0))
+            o_acc, l_acc = _fold(o_acc, l_acc, oi, li, bshd)
             return (o_acc, l_acc, kv), None
 
         (o_acc, l_acc, _), _ = jax.lax.scan(
@@ -686,7 +724,9 @@ def _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas,
             jnp.arange(1, cp), length=cp - 1)
         return o_acc.astype(q.dtype), l_acc
 
-    ss = q.shape[-2] // 2
+    ss = q.shape[1] // 2
+    lhalf = lambda l: (jax.lax.slice_in_dim(l, 0, ss, axis=lse_ax),  # noqa: E731
+                       jax.lax.slice_in_dim(l, ss, 2 * ss, axis=lse_ax))
     q_lo, q_hi = q[:, :ss], q[:, ss:]
 
     def step(carry, t):
@@ -698,9 +738,9 @@ def _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas,
         j = (rank - t) % cp
         # piece 1: this rank's HIGH stripe vs the arriving LOW stripe —
         # always a full (unmasked) attend (stripe j < cp <= 2cp−1−rank)
-        o1, l1 = _piece_fwd(q_hi, k_lo, v_lo, scale, False, use_pallas,
-                            dropout_rate, pseed(t, 1))
-        o_hi, l_hi = _fold(o_hi, l_hi, o1, l1)
+        o1, l1 = piece(q_hi, k_lo, v_lo, scale, False, use_pallas,
+                       dropout_rate, pseed(t, 1))
+        o_hi, l_hi = _fold(o_hi, l_hi, o1, l1, bshd)
         # piece 2: j < rank → our LOW stripe sees their LOW stripe;
         # j > rank → our HIGH stripe sees their HIGH stripe. Both full
         # attends — zigzag leaves no partially- or fully-masked work.
@@ -708,27 +748,29 @@ def _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas,
         q2 = jnp.where(lo_case, q_lo, q_hi)
         k2 = jnp.where(lo_case, k_lo, k_hi)
         v2 = jnp.where(lo_case, v_lo, v_hi)
-        o2, l2 = _piece_fwd(q2, k2, v2, scale, False, use_pallas,
-                            dropout_rate, pseed(t, 2))
-        o_lo2, l_lo2 = _fold(o_lo, l_lo, o2, l2)
-        o_hi2, l_hi2 = _fold(o_hi, l_hi, o2, l2)
+        o2, l2 = piece(q2, k2, v2, scale, False, use_pallas,
+                       dropout_rate, pseed(t, 2))
+        o_lo2, l_lo2 = _fold(o_lo, l_lo, o2, l2, bshd)
+        o_hi2, l_hi2 = _fold(o_hi, l_hi, o2, l2, bshd)
         o_lo = jnp.where(lo_case, o_lo2, o_lo)
         l_lo = jnp.where(lo_case, l_lo2, l_lo)
         o_hi = jnp.where(lo_case, o_hi, o_hi2)
         l_hi = jnp.where(lo_case, l_hi, l_hi2)
         return (o_lo, l_lo, o_hi, l_hi, kv), None
 
-    init = (o0[:, :ss].astype(jnp.float32), l0[:, :ss],
-            o0[:, ss:].astype(jnp.float32), l0[:, ss:], (k, v))
+    l0_lo, l0_hi = lhalf(l0)
+    init = (o0[:, :ss].astype(jnp.float32), l0_lo,
+            o0[:, ss:].astype(jnp.float32), l0_hi, (k, v))
     (o_lo, l_lo, o_hi, l_hi, _), _ = jax.lax.scan(
         step, init, jnp.arange(1, cp), length=cp - 1)
     o = jnp.concatenate([o_lo, o_hi], axis=1).astype(q.dtype)
-    lse = jnp.concatenate([l_lo, l_hi], axis=1)
+    lse = jnp.concatenate([l_lo, l_hi], axis=lse_ax)
     return o, lse
 
 
 def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, scale, causal,
-                   use_pallas, dropout_rate=0.0, dropout_seed=None):
+                   use_pallas, dropout_rate=0.0, dropout_seed=None,
+                   bshd=False):
     """The distributed flash backward: per ring step call ``flash_bwd``
     with the GLOBAL (o, lse) — p and Δ are then exact per shard — while a
     dkv accumulator travels the ring with its kv shard and arrives home
@@ -739,6 +781,14 @@ def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, scale, causal,
     cp = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
+    lse_ax = 2 if bshd else 1
+
+    def piece_bwd(qq, kk, vv, oo, ll, ddo, caus, sd):
+        if bshd:
+            return _piece_bwd_bshd(qq, kk, vv, oo, ll, ddo, scale, caus,
+                                   use_pallas, dropout_rate, sd)
+        return _flash_bwd_impl(qq, kk, vv, oo, ll, ddo, None, scale,
+                               caus, use_pallas, dropout_rate, sd)
 
     def pseed(t, piece):
         return _piece_seed(dropout_seed, rank, t, piece)
@@ -747,17 +797,14 @@ def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, scale, causal,
         return jax.tree.map(
             lambda x: jax.lax.ppermute(x, axis_name, perm), t)
 
-    dq0, dk0, dv0 = _flash_bwd_impl(
-        q, k, v, o, lse, do, None, scale, causal, use_pallas,
-        dropout_rate, pseed(0, 0))
+    dq0, dk0, dv0 = piece_bwd(q, k, v, o, lse, do, causal, pseed(0, 0))
 
     if not causal:
         def step(carry, t):
             dq, kv, dk, dv = carry
             kv, (dk, dv) = rotate(kv), rotate((dk, dv))
-            dqi, dki, dvi = _flash_bwd_impl(
-                q, kv[0], kv[1], o, lse, do, None, scale, False,
-                use_pallas, dropout_rate, pseed(t, 0))
+            dqi, dki, dvi = piece_bwd(q, kv[0], kv[1], o, lse, do, False,
+                                      pseed(t, 0))
             return (dq + dqi, kv, dk + dki.astype(dk.dtype),
                     dv + dvi.astype(dv.dtype)), None
 
@@ -768,11 +815,13 @@ def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, scale, causal,
         dk, dv = rotate((dk, dv))  # final hop brings the accumulators home
         return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
-    ss = q.shape[-2] // 2
+    ss = q.shape[1] // 2
     halves = lambda x: (x[:, :ss], x[:, ss:])
+    lhalf = lambda l: (jax.lax.slice_in_dim(l, 0, ss, axis=lse_ax),  # noqa: E731
+                       jax.lax.slice_in_dim(l, ss, 2 * ss, axis=lse_ax))
     q_lo, q_hi = halves(q)
     o_lo, o_hi = halves(o)
-    l_lo, l_hi = halves(lse)
+    l_lo, l_hi = lhalf(lse)
     do_lo, do_hi = halves(do)
 
     def step(carry, t):
@@ -784,9 +833,8 @@ def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, scale, causal,
         v_lo, v_hi = halves(vv)
         j = (rank - t) % cp
         # piece 1 (mirror of forward): q_hi vs arriving kv_lo, full attend
-        dq1, dk1, dv1 = _flash_bwd_impl(
-            q_hi, k_lo, v_lo, o_hi, l_hi, do_hi, None, scale, False,
-            use_pallas, dropout_rate, pseed(t, 1))
+        dq1, dk1, dv1 = piece_bwd(q_hi, k_lo, v_lo, o_hi, l_hi, do_hi,
+                                  False, pseed(t, 1))
         dq_hi = dq_hi + dq1
         dk_lo = dk_lo + dk1
         dv_lo = dv_lo + dv1
@@ -798,9 +846,8 @@ def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, scale, causal,
         do2 = jnp.where(lo_case, do_lo, do_hi)
         k2 = jnp.where(lo_case, k_lo, k_hi)
         v2 = jnp.where(lo_case, v_lo, v_hi)
-        dq2, dk2, dv2 = _flash_bwd_impl(
-            q2, k2, v2, o2, l2, do2, None, scale, False, use_pallas,
-            dropout_rate, pseed(t, 2))
+        dq2, dk2, dv2 = piece_bwd(q2, k2, v2, o2, l2, do2, False,
+                                  pseed(t, 2))
         dq_lo = dq_lo + jnp.where(lo_case, dq2, 0.0)
         dq_hi = dq_hi + jnp.where(lo_case, 0.0, dq2)
         dk_lo = dk_lo + jnp.where(lo_case, dk2, 0.0)
@@ -822,26 +869,27 @@ def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, scale, causal,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def _ring_core(q, k, v, dropout_seed, axis_name, scale, causal,
-               use_pallas, dropout_rate):
+               use_pallas, dropout_rate, bshd):
     o, _ = _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas,
-                          dropout_rate, dropout_seed)
+                          dropout_rate, dropout_seed, bshd)
     return o
 
 
 def _ring_fwd(q, k, v, dropout_seed, axis_name, scale, causal,
-              use_pallas, dropout_rate):
+              use_pallas, dropout_rate, bshd):
     o, lse = _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas,
-                            dropout_rate, dropout_seed)
+                            dropout_rate, dropout_seed, bshd)
     return o, (q, k, v, o, lse, dropout_seed)
 
 
-def _ring_bwd(axis_name, scale, causal, use_pallas, dropout_rate, res, do):
+def _ring_bwd(axis_name, scale, causal, use_pallas, dropout_rate, bshd,
+              res, do):
     q, k, v, o, lse, dropout_seed = res
     dq, dk, dv = _ring_bwd_impl(
         q, k, v, o, lse, do, axis_name, scale, causal, use_pallas,
-        dropout_rate, dropout_seed)
+        dropout_rate, dropout_seed, bshd)
     return dq, dk, dv, _float0_like(dropout_seed)
 
 
@@ -852,11 +900,16 @@ def ring_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     *, axis_name: str = mesh_lib.CONTEXT_AXIS, causal: bool = False,
     scale: Optional[float] = None, impl: str = "auto",
+    layout: str = "bhsd",
     dropout_rate: float = 0.0, dropout_seed: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention over a sequence sharded along ``axis_name``: q/k/v are this
-    device's (bh, s_local, d) shard; the full sequence is cp·s_local. Must
-    run inside shard_map with the axis bound.
+    device's (bh, s_local, d) shard — or, with ``layout='bshd'``, the
+    seq-major (b, s_local, h, d) shard the projection GEMMs emit, which
+    the kernels read with NO transpose round trip per ring step (the same
+    layout economics as ``flash_attention(layout='bshd')``; requires the
+    bshd tiling rule — head_dim 128 class). The full sequence is
+    cp·s_local. Must run inside shard_map with the axis bound.
 
     Built on the flash kernel family: per ring step the arriving KV shard
     goes through :func:`_piece_fwd` (the Pallas kernel above its measured
@@ -891,6 +944,9 @@ def ring_attention(
     """
     d = q.shape[-1]
     scale = float(scale if scale is not None else 1.0 / d ** 0.5)
+    if layout not in ("bhsd", "bshd"):
+        raise ValueError(f"layout must be bhsd|bshd, got {layout!r}")
+    bshd = layout == "bshd"
     if not 0.0 <= dropout_rate < 1.0:
         raise ValueError(f"dropout_rate must be in [0, 1), got "
                          f"{dropout_rate}")
@@ -900,25 +956,45 @@ def ring_attention(
         dropout_seed = jnp.asarray(dropout_seed, jnp.int32)
     else:
         dropout_seed = None
-    if q.shape[0] % k.shape[0]:
+    if q.shape[1] != k.shape[1] or k.shape[1] != v.shape[1]:
+        # ring requires IDENTICAL q/kv sequence sharding — a longer kv
+        # would silently stripe-slice at the wrong boundaries
+        raise ValueError(
+            f"ring attention requires equal q/k/v local sequence lengths; "
+            f"got {q.shape[1]} / {k.shape[1]} / {v.shape[1]}")
+    if bshd:
+        if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+            raise ValueError(
+                f"layout='bshd' takes (b, s, h, d) operands; got "
+                f"{q.shape} / {k.shape}")
+        if (q.shape[2] % k.shape[2] or q.shape[0] != k.shape[0]
+                or k.shape[:2] != v.shape[:2]):
+            raise ValueError(
+                f"kv heads ({k.shape[2]}) must divide q heads "
+                f"({q.shape[2]}) with matching batch/seq dims "
+                f"({q.shape} vs {k.shape})")
+    elif q.shape[0] % k.shape[0]:
         raise ValueError(
             f"kv rows ({k.shape[0]}) must divide q rows ({q.shape[0]}) "
             f"for grouped-query ring attention")
-    s_loc = q.shape[-2]
+    s_loc = q.shape[1]
     if causal and s_loc % 2:
         raise ValueError(
             f"causal ring attention needs an even local sequence "
             f"({s_loc}) — two zigzag stripes per device")
     ss = s_loc // 2 if causal else s_loc
-    # fp16 exclusion mirrors flash_attention's gate (Mosaic has no f16)
-    ok = (ss % 128 == 0 and (d % 128 == 0 or d == 64)
-          and q.dtype != jnp.float16)
+    if bshd:
+        ok = bshd_kernel_ok(ss, ss, q.shape[2], d, q.dtype)
+    else:
+        # fp16 exclusion mirrors flash_attention's gate (Mosaic has no f16)
+        ok = (ss % 128 == 0 and (d % 128 == 0 or d == 64)
+              and q.dtype != jnp.float16)
     if (impl == "auto" and ss < flash_auto_crossover(d)
             and not _backend.interpret_forced()):
         impl = "xla"
     use_pallas = _backend.choose_impl(impl, ok) == "pallas"
     return _ring_core(q, k, v, dropout_seed, axis_name, scale, causal,
-                      use_pallas, dropout_rate)
+                      use_pallas, dropout_rate, bshd)
 
 
 # --- Ulysses attention (all-to-all sequence parallel) -------------------------

@@ -1212,3 +1212,155 @@ class TestCpDropout:
                                           dropout_rate=0.1),
                 mesh=mesh, in_specs=P(None, "cp"),
                 out_specs=P(None, "cp"))(q)
+
+
+class TestRingBshd:
+    """Ring attention on the seq-major layout (r4 late): the stripe pieces
+    ride the bshd kernels — no transpose round trip per ring step."""
+
+    def _mesh(self):
+        return mesh_lib.make_mesh(context_parallel_size=2)
+
+    @pytest.mark.parametrize("kv_heads", [2, 1])
+    def test_bshd_ring_matches_flash(self, kv_heads, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        mesh = self._mesh()
+        b, s, h, d = 2, 512, 2, 128  # s_local 256, stripes 128
+        q = jr.normal(K, (b, s, h, d))
+        k = jr.normal(jr.fold_in(K, 95), (b, s, kv_heads, d))
+        v = jr.normal(jr.fold_in(K, 96), (b, s, kv_heads, d))
+
+        def run(q_, k_, v_):
+            return ring_attention(q_, k_, v_, axis_name="cp", causal=True,
+                                  layout="bshd", impl="pallas")
+
+        qz, kz, vz = (zigzag_shard(x, 2, 1) for x in (q, k, v))
+        with jax.default_matmul_precision("highest"):
+            o = jax.jit(mesh_lib.shard_map(
+                run, mesh=mesh, in_specs=(P(None, "cp"),) * 3,
+                out_specs=P(None, "cp"),
+            ))(qz, kz, vz)
+            o = zigzag_unshard(o, 2, 1)
+            ref = flash_attention(q, k, v, causal=True, layout="bshd",
+                                  impl="pallas")
+        np.testing.assert_allclose(o, ref, rtol=2e-4, atol=2e-5)
+
+    def test_bshd_ring_grads_match_flat_ring(self):
+        """Same math, two layouts: grads through the bshd state machine
+        must equal the flat one's (which is itself pinned to dense)."""
+        mesh = self._mesh()
+        b, s, h, d = 2, 128, 2, 64
+        q = jr.normal(K, (b, s, h, d))
+        k = jr.normal(jr.fold_in(K, 97), (b, s, h, d))
+        v = jr.normal(jr.fold_in(K, 98), (b, s, h, d))
+        to_bh = lambda z: z.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+        def run_bshd(q_, k_, v_):
+            f = lambda *a: jnp.sum(jnp.sin(ring_attention(
+                *a, axis_name="cp", causal=True, layout="bshd",
+                impl="xla")))
+            return jax.grad(f, argnums=(0, 1, 2))(q_, k_, v_)
+
+        def run_flat(q_, k_, v_):
+            f = lambda *a: jnp.sum(jnp.sin(ring_attention(
+                *a, axis_name="cp", causal=True, impl="xla")))
+            return jax.grad(f, argnums=(0, 1, 2))(q_, k_, v_)
+
+        with jax.default_matmul_precision("highest"):
+            qz, kz, vz = (zigzag_shard(x, 2, 1) for x in (q, k, v))
+            g4 = jax.jit(mesh_lib.shard_map(
+                run_bshd, mesh=mesh, in_specs=(P(None, "cp"),) * 3,
+                out_specs=(P(None, "cp"),) * 3,
+            ))(qz, kz, vz)
+            qf, kf, vf = (zigzag_shard(to_bh(x), 2, 1) for x in (q, k, v))
+            gf = jax.jit(mesh_lib.shard_map(
+                run_flat, mesh=mesh, in_specs=(P(None, "cp"),) * 3,
+                out_specs=(P(None, "cp"),) * 3,
+            ))(qf, kf, vf)
+        for a4, af, n in zip(g4, gf, "qkv"):
+            a4f = zigzag_unshard(a4, 2, 1)
+            aff = zigzag_unshard(af, 2, 1).reshape(b, h, s, d
+                                                   ).transpose(0, 2, 1, 3)
+            np.testing.assert_allclose(a4f, aff, rtol=2e-4, atol=2e-5,
+                                       err_msg=n)
+
+    def test_bshd_ring_dropout_grads_match_autodiff(self):
+        """The dropout mask-consistency witness on the bshd state machine
+        (custom VJP vs autodiff through the forward)."""
+        from apex_tpu.ops.attention import _ring_fwd_impl
+
+        mesh = self._mesh()
+        b, s, h, d = 1, 128, 2, 16
+        seed = jnp.int32(88)
+        q = jr.normal(K, (b, s, h, d))
+        k = jr.normal(jr.fold_in(K, 99), (b, s, h, d))
+        v = jr.normal(jr.fold_in(K, 100), (b, s, h, d))
+
+        def custom(q_, k_, v_):
+            o = ring_attention(q_, k_, v_, axis_name="cp", causal=True,
+                               layout="bshd", impl="xla",
+                               dropout_rate=0.3, dropout_seed=seed)
+            return jnp.sum(jnp.sin(o))
+
+        def auto(q_, k_, v_):
+            o, _ = _ring_fwd_impl(q_, k_, v_, "cp", 1.0 / d ** 0.5, True,
+                                  False, 0.3, seed, True)
+            return jnp.sum(jnp.sin(o))
+
+        def run(q_, k_, v_):
+            return (jax.grad(custom, argnums=(0, 1, 2))(q_, k_, v_),
+                    jax.grad(auto, argnums=(0, 1, 2))(q_, k_, v_))
+
+        qz, kz, vz = (zigzag_shard(x, 2, 1) for x in (q, k, v))
+        with jax.default_matmul_precision("highest"):
+            g1, g2 = jax.jit(mesh_lib.shard_map(
+                run, mesh=mesh, in_specs=(P(None, "cp"),) * 3,
+                out_specs=((P(None, "cp"),) * 3,) * 2,
+            ))(qz, kz, vz)
+        for a, e, n in zip(g1, g2, "qkv"):
+            np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-5,
+                                       err_msg=n)
+
+    def test_bshd_ring_pallas_bwd_matches_xla_dispatch(self, monkeypatch):
+        """The production path's backward (Pallas bshd piece kernels with
+        the ring's GLOBAL lse + per-piece dropout seeds) against the XLA
+        dispatch — masks are bit-identical across dispatches by design,
+        so grads must agree."""
+        monkeypatch.setenv("APEX_TPU_PALLAS", "interpret")
+        mesh = self._mesh()
+        b, s, h, d = 2, 512, 2, 128
+        seed = jnp.int32(21)
+        q = jr.normal(K, (b, s, h, d))
+        k = jr.normal(jr.fold_in(K, 101), (b, s, 1, d))  # GQA group 2
+        v = jr.normal(jr.fold_in(K, 102), (b, s, 1, d))
+
+        def make(impl):
+            def f(q_, k_, v_):
+                o = ring_attention(q_, k_, v_, axis_name="cp",
+                                   causal=True, layout="bshd", impl=impl,
+                                   dropout_rate=0.3, dropout_seed=seed)
+                return jnp.sum(jnp.sin(o))
+            def run(q_, k_, v_):
+                return jax.grad(f, argnums=(0, 1, 2))(q_, k_, v_)
+            return jax.jit(mesh_lib.shard_map(
+                run, mesh=mesh, in_specs=(P(None, "cp"),) * 3,
+                out_specs=(P(None, "cp"),) * 3))
+
+        qz, kz, vz = (zigzag_shard(x, 2, 1) for x in (q, k, v))
+        with jax.default_matmul_precision("highest"):
+            g_pl = make("pallas")(qz, kz, vz)
+            g_xla = make("xla")(qz, kz, vz)
+        for a, e, n in zip(g_pl, g_xla, "qkv"):
+            np.testing.assert_allclose(a, e, rtol=3e-4, atol=3e-5,
+                                       err_msg=n)
+
+    def test_bshd_ring_rejects_mismatched_seq(self):
+        mesh = self._mesh()
+        q = jr.normal(K, (1, 128, 2, 128))
+        k = jr.normal(K, (1, 256, 2, 128))
+        with pytest.raises(ValueError, match="equal q/k/v local sequence"):
+            mesh_lib.shard_map(
+                lambda q_, k_: ring_attention(q_, k_, k_, axis_name="cp",
+                                              layout="bshd"),
+                mesh=mesh, in_specs=(P(None, "cp"), P(None, "cp")),
+                out_specs=P(None, "cp"))(q, k)
