@@ -640,3 +640,75 @@ class TestMoEPipelineEP:
         np.testing.assert_allclose(
             got["layers"]["moe"]["w1"], ref_g["layers"]["moe"]["w1"],
             rtol=3e-4, atol=1e-5)
+
+    def test_five_axis_ep_pp_cp_one_mesh(self):
+        """MoE experts over ep, layers over pp, sequence over cp (ring),
+        batch over dp — FIVE mesh axes bound in one shard_map (tp=1 slot
+        present in the mesh). The 'axes compose' end state."""
+        from apex_tpu.models import GPTConfig, GPTModel
+        from apex_tpu.ops.attention import zigzag_shard
+        from apex_tpu.transformer.pipeline_parallel import GPTPipeline
+
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=2,
+                                  context_parallel_size=2,
+                                  expert_parallel_size=2)
+        assert dict(mesh.shape) == {"dp": 1, "ep": 2, "pp": 2, "cp": 2,
+                                    "tp": 1}
+        kw = dict(vocab_size=64, max_seq_len=64, hidden_size=32,
+                  num_layers=2, num_heads=4, attention_impl="flash",
+                  moe_num_experts=4, moe_top_k=2, moe_capacity_factor=2.0)
+        cfg1 = GPTConfig(**kw)
+        cfg = GPTConfig(**kw, ep_axis="ep", cp_axis="cp")
+        m = GPTModel(cfg)
+        params = GPTModel(cfg1).init(K)
+        pipe = GPTPipeline(m, pp=2)
+        part = pipe.partition(params)
+        specs = pipe.param_specs(part)
+
+        M, b, s = 2, 2, 64
+        shards = 2  # dp*ep data shards (dp extent 1)
+        toks = jr.randint(jr.fold_in(K, 110), (M, b * shards, s), 0, 64)
+        tgts = jr.randint(jr.fold_in(K, 111), (M, b * shards, s), 0, 64)
+        toks_sh = zigzag_shard(toks, 2, 2)
+        tgts_sh = zigzag_shard(tgts, 2, 2)
+
+        def run(p, t, g):
+            lp = dict(p, stages=jax.tree.map(lambda x: x[0], p["stages"]))
+            loss, grads = pipe.loss_and_grads(lp, t, g,
+                                              dp_axis=("dp", "cp"))
+            grads["stages"] = jax.tree.map(lambda x: x[None],
+                                           grads["stages"])
+            return loss, grads
+
+        with jax.default_matmul_precision("highest"):
+            loss, grads = jax.jit(mesh_lib.shard_map(
+                run, mesh=mesh,
+                in_specs=(specs, P(None, ("dp", "ep"), "cp"),
+                          P(None, ("dp", "ep"), "cp")),
+                out_specs=(P(), specs),
+            ))(part, toks_sh, tgts_sh)
+
+            # oracle: per-(ep shard, microbatch) serial losses on the FULL
+            # sequence (cp only shards the sequence, not the batch)
+            m1 = GPTModel(cfg1)
+            per = [m1.loss_fn(params, toks[i, r * b:(r + 1) * b],
+                              tgts[i, r * b:(r + 1) * b])
+                   for r in range(shards) for i in range(M)]
+            ref = float(jnp.mean(jnp.stack(per)))
+
+        np.testing.assert_allclose(float(loss), ref, rtol=2e-5)
+        got = pipe.unpartition(grads)
+        ref_g = jax.grad(lambda p: jnp.mean(jnp.stack([
+            m1.loss_fn(p, toks[i, r * b:(r + 1) * b],
+                       tgts[i, r * b:(r + 1) * b])
+            for r in range(shards) for i in range(M)])))(params)
+        # atol 1e-4: the ring fold's exp/log renormalization adds ~5e-5
+        # of float noise per backward chain — relative checks on near-zero
+        # router-grad entries need the absolute floor (loss parity above
+        # pins the semantics; routing decisions are identical at cf=2.0)
+        np.testing.assert_allclose(
+            got["layers"]["moe"]["router"], ref_g["layers"]["moe"]["router"],
+            rtol=5e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            got["layers"]["moe"]["w1"], ref_g["layers"]["moe"]["w1"],
+            rtol=5e-4, atol=1e-4)
