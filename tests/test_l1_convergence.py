@@ -30,6 +30,8 @@ KEEP_NORMS = [None, True, False]
 LOSS_SCALES = [None, 128.0, "dynamic"]
 
 
+pytestmark = pytest.mark.slow
+
 def _cells():
     for o in OPT_LEVELS:
         for kn in KEEP_NORMS:

@@ -34,6 +34,8 @@ OPT_LEVELS = ["O0", "O1", "O2", "O3"]
 _ON_CPU = jax.default_backend() == "cpu"
 
 
+pytestmark = pytest.mark.slow
+
 def _load_example(rel):
     import importlib.util
 
