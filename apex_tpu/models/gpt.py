@@ -305,7 +305,7 @@ class GPTModel:
                 # — kills the ~4.5 GB/step of XLA layout-conversion copies
                 # the composed formulation paid, PERF.md r3).
                 y = fused_qkv_attention(
-                    xc, w_qkv, b_qkv, w_out, seed, None, h, hkv, d,
+                    xc, w_qkv, b_qkv, w_out, None, seed, None, h, hkv, d,
                     1.0 / float(d) ** 0.5, True, drop)
                 y = self.attn_out.reduce_output(y)
                 if "bias" in p["attn_out"]:
@@ -557,6 +557,15 @@ class GPTModel:
             # tokens are a sequence shard: gather the shard's GLOBAL
             # positions (zigzag stripes under ring)
             x = x + params["pos_embedding"][self._cp_positions(s)]
+            if key is not None:
+                # decorrelate the residual-dropout streams per cp rank:
+                # each shard holds DIFFERENT global token positions, so an
+                # unfolded key would hand them identical local-coordinate
+                # keep masks (ADVICE r4). GPTPipeline folds its data-like
+                # axes (incl. cp) before its stage fns — which bypass this
+                # method — so the fold lives here for the direct path only.
+                key = jax.random.fold_in(
+                    key, jax.lax.axis_index(c.cp_axis))
         else:
             x = x + params["pos_embedding"][:s]
         if self.sp:

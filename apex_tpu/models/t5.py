@@ -59,9 +59,11 @@ class T5Config:
     # T5's relative position bias — per-stack (num_buckets, heads) tables
     # added to the SELF-attention scores (encoder bidirectional buckets,
     # decoder causal buckets; cross-attention carries none, per T5), no
-    # absolute positions. Requires attention_impl='softmax' (the bias
-    # enters the materialized scores; the flash kernels carry no bias
-    # operand).
+    # absolute positions. Composes with BOTH attention impls: 'flash'
+    # feeds the (h, sq, sk) bias to the kernels' in-kernel bias operand
+    # (r5 — no O(s²) score tensor; bias gradients via the dbias kernel
+    # flow back to the bucket table through the gather's autodiff), and
+    # 'softmax' adds it to the materialized scores.
     position_encoding: str = "learned"
     relative_num_buckets: int = 32
     relative_max_distance: int = 128
@@ -79,13 +81,6 @@ class T5Config:
             raise ValueError(
                 f"position_encoding must be learned|relative, got "
                 f"{self.position_encoding!r}")
-        if self.position_encoding == "relative" \
-                and self.attention_impl == "flash":
-            raise ValueError(
-                "relative position bias enters the materialized attention "
-                "scores; the flash kernels carry no bias operand — use "
-                "attention_impl='softmax' with position_encoding="
-                "'relative'")
 
     @property
     def ffn(self) -> int:
@@ -229,7 +224,13 @@ class EncoderDecoderModel:
     def _attn(self, q, k, v, causal, bias=None):
         c = self.config
         if c.attention_impl == "flash":
-            return flash_attention(q, k, v, causal=causal)
+            # bias (1, h, sq, sk) → the kernels' (h, sq, sk) per-head form
+            # (row r of the b·h flatten reads bias row r % h = its head);
+            # the flash custom-VJP returns dbias, which autodiff carries
+            # back through relative_bias's gather into the bucket table
+            return flash_attention(
+                q, k, v, causal=causal,
+                bias=None if bias is None else bias[0])
         d = q.shape[-1]
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
         b, h, sq, sk = scores.shape

@@ -50,13 +50,25 @@ def flash_auto_crossover(head_dim: int) -> int:
     lanes lower the kernel's break-even)."""
     return 512 if head_dim >= 128 else 1024
 
-def masked_scores(q, k, scale, causal, kv_lens=None):
+def masked_scores(q, k, scale, causal, kv_lens=None, bias=None):
     """fp32 scaled scores over (..., seq, head_dim) with the bottom-right-
     aligned causal mask (last ``sq`` query rows of an ``sk``-long context)
     and optional per-row valid kv lengths (padding). ``kv_lens`` requires
-    the flattened 3D layout (rows, seq, d) with one length per row."""
+    the flattened 3D layout (rows, seq, d) with one length per row.
+    ``bias`` (hb, sq, sk): additive score bias, row ``r`` reading bias row
+    ``r % hb`` (same contract as the Pallas kernels) — added to the scaled
+    scores BEFORE the masks; requires the 3D layout."""
     s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
     sq, sk = s.shape[-2], s.shape[-1]
+    if bias is not None:
+        if s.ndim != 3:
+            raise ValueError(
+                "bias requires 3D (rows, sq, sk) scores; flatten leading "
+                "dims to rows first")
+        hb = bias.shape[0]
+        # rows r = b·hb + th share bias row th — the reshape groups them
+        s = (s.reshape(-1, hb, sq, sk)
+             + bias.astype(jnp.float32)).reshape(s.shape)
     if causal:
         mask = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None] + (sk - sq)
         s = jnp.where(mask, s, _k.NEG_INF)
@@ -70,29 +82,37 @@ def masked_scores(q, k, scale, causal, kv_lens=None):
     return s
 
 
-def _dropout_mask_scale_dense(seed, bh, sq, sk, rate):
-    """(bh, sq, sk) fp32 dropout multiplier from the SAME counter-based
-    hash the Pallas kernels evaluate blockwise (``pallas.attention
-    .dropout_keep``) — kernel and XLA dispatch produce BIT-IDENTICAL
-    masks, so the impl choice never changes a training run."""
+def _dropout_keep_dense(seed, bh, sq, sk, rate):
+    """(bh, sq, sk) BOOL keep mask from the SAME counter-based hash the
+    Pallas kernels evaluate blockwise (``pallas.attention.dropout_keep``)
+    — kernel and XLA dispatch produce BIT-IDENTICAL masks, so the impl
+    choice never changes a training run. Bool (not a pre-scaled fp32
+    multiplier): the 1/(1-rate) rescale folds into each use site's
+    ``where`` so XLA fuses the mask into its consumer instead of holding
+    a persistent fp32 O(s²) tensor on the fallback path (ADVICE r4)."""
     t = jnp.arange(bh, dtype=jnp.int32)[:, None, None]
     rows = jnp.arange(sq, dtype=jnp.int32)[None, :, None]
     cols = jnp.arange(sk, dtype=jnp.int32)[None, None, :]
-    keep = _k.dropout_keep(jnp.asarray(seed, jnp.int32), t, rows, cols,
+    return _k.dropout_keep(jnp.asarray(seed, jnp.int32), t, rows, cols,
                            rate)
-    return jnp.where(keep, jnp.float32(1.0 / (1.0 - rate)), 0.0)
+
+
+def _dropout_apply_dense(x, keep, rate):
+    """mask-and-rescale fused in one ``where`` (see above)."""
+    return jnp.where(keep, x * jnp.float32(1.0 / (1.0 - rate)), 0.0)
 
 
 def _xla_attention(q, k, v, scale, causal, kv_lens=None,
-                   dropout_rate=0.0, dropout_seed=None):
-    s = masked_scores(q, k, scale, causal, kv_lens)
+                   dropout_rate=0.0, dropout_seed=None, bias=None):
+    s = masked_scores(q, k, scale, causal, kv_lens, bias)
     lse = jax.nn.logsumexp(s, axis=-1)
     p = jnp.exp(s - lse[..., None])
     if dropout_rate > 0.0:
         # probs dropout: the normalizer (lse) stays un-dropped, the
         # weighted sum takes the masked, rescaled probabilities
-        p = p * _dropout_mask_scale_dense(
-            dropout_seed, s.shape[0], s.shape[-2], s.shape[-1],
+        p = _dropout_apply_dense(
+            p, _dropout_keep_dense(dropout_seed, s.shape[0], s.shape[-2],
+                                   s.shape[-1], dropout_rate),
             dropout_rate)
     o = jnp.einsum("bqk,bkd->bqd", p.astype(q.dtype), v)
     if kv_lens is not None:
@@ -105,22 +125,22 @@ def _xla_attention(q, k, v, scale, causal, kv_lens=None,
     return o, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash_core(q, k, v, kv_lens, dropout_seed, scale, causal, use_pallas,
-                dropout_rate):
-    o, _ = _flash_fwd_res(q, k, v, kv_lens, dropout_seed, scale, causal,
-                          use_pallas, dropout_rate)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
+def _flash_core(q, k, v, bias, kv_lens, dropout_seed, scale, causal,
+                use_pallas, dropout_rate):
+    o, _ = _flash_fwd_res(q, k, v, bias, kv_lens, dropout_seed, scale,
+                          causal, use_pallas, dropout_rate)
     return o
 
 
-def _flash_fwd_res(q, k, v, kv_lens, dropout_seed, scale, causal,
+def _flash_fwd_res(q, k, v, bias, kv_lens, dropout_seed, scale, causal,
                    use_pallas, dropout_rate):
     if use_pallas:
         # full_lse: the residual keeps the (bh, sq, LANES) carrier so the
         # backward kernel reads it as-is (no slice/re-broadcast round trip)
         o, lse = _k.flash_fwd(
             q, k, v, scale=scale, causal=causal, kv_lens=kv_lens,
-            full_lse=True, interpret=_backend.interpret_mode(),
+            bias=bias, full_lse=True, interpret=_backend.interpret_mode(),
             dropout_rate=dropout_rate, dropout_seed=dropout_seed,
         )
     else:
@@ -128,52 +148,62 @@ def _flash_fwd_res(q, k, v, kv_lens, dropout_seed, scale, causal,
         kf = jnp.repeat(k, group, 0) if group > 1 else k
         vf = jnp.repeat(v, group, 0) if group > 1 else v
         o, lse = _xla_attention(q, kf, vf, scale, causal, kv_lens,
-                                dropout_rate, dropout_seed)
+                                dropout_rate, dropout_seed, bias)
     return o, (q, k, v, o, lse)
 
 
-def _flash_fwd(q, k, v, kv_lens, dropout_seed, scale, causal, use_pallas,
-               dropout_rate):
-    o, res = _flash_fwd_res(q, k, v, kv_lens, dropout_seed, scale, causal,
-                            use_pallas, dropout_rate)
-    return o, (res, kv_lens, dropout_seed)
+def _flash_fwd(q, k, v, bias, kv_lens, dropout_seed, scale, causal,
+               use_pallas, dropout_rate):
+    o, res = _flash_fwd_res(q, k, v, bias, kv_lens, dropout_seed, scale,
+                            causal, use_pallas, dropout_rate)
+    return o, (res, bias, kv_lens, dropout_seed)
 
 
 def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, scale, causal, use_pallas,
-                    dropout_rate=0.0, dropout_seed=None):
-    """dq/dk/dv from saved (o, lse). With a *global* lse this is also the
-    per-shard backward of distributed (ring) attention: p = exp(s − lse)
-    and Δ = rowsum(do·o_final) are exact per shard, so each shard's ds —
+                    dropout_rate=0.0, dropout_seed=None, bias=None):
+    """(dq, dk, dv, dbias) from saved (o, lse) — dbias is None when no bias
+    rode the forward. With a *global* lse this is also the per-shard
+    backward of distributed (ring) attention: p = exp(s − lse) and
+    Δ = rowsum(do·o_final) are exact per shard, so each shard's ds —
     and hence its dq/dk/dv contribution — needs no cross-shard state.
 
     Dropout chain (S → P=softmax → Pd=mask∘P/(1-r) → O=Pd·V): the mask
     regenerates from the same counter hash as forward; dV = Pdᵀ·dO and
     dS = P ∘ (mask/(1-r) ∘ (dO·Vᵀ) − Δ) — Δ = rowsum(dO∘O) already equals
-    rowsum(Pd ∘ dPd), so only the dPd term re-masks."""
+    rowsum(Pd ∘ dPd), so only the dPd term re-masks.
+
+    Bias: dbias = Σ over the rows sharing each bias row of the UNSCALED
+    dS (bias enters S additively after the 1/√d scale)."""
     if use_pallas:
-        return _k.flash_bwd(
+        out = _k.flash_bwd(
             q, k, v, o, lse, do, scale=scale, causal=causal, kv_lens=kv_lens,
-            interpret=_backend.interpret_mode(),
+            bias=bias, interpret=_backend.interpret_mode(),
             dropout_rate=dropout_rate, dropout_seed=dropout_seed,
         )
+        return out if bias is not None else (*out, None)
     group = q.shape[0] // k.shape[0]
     kf = jnp.repeat(k, group, 0) if group > 1 else k
     vf = jnp.repeat(v, group, 0) if group > 1 else v
-    s = masked_scores(q, kf, scale, causal, kv_lens)
+    s = masked_scores(q, kf, scale, causal, kv_lens, bias)
     p = jnp.exp(s - lse[..., None])
     dof = do.astype(jnp.float32)
     if dropout_rate > 0.0:
-        ms = _dropout_mask_scale_dense(
+        keep = _dropout_keep_dense(
             dropout_seed, s.shape[0], s.shape[-2], s.shape[-1], dropout_rate)
-        pd = p * ms
+        pd = _dropout_apply_dense(p, keep, dropout_rate)
     else:
         pd = p
     dv = jnp.einsum("bqk,bqd->bkd", pd, dof)
     dp = jnp.einsum("bqd,bkd->bqk", dof, vf.astype(jnp.float32))
     if dropout_rate > 0.0:
-        dp = dp * ms
+        dp = _dropout_apply_dense(dp, keep, dropout_rate)
     delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1, keepdims=True)
-    ds = p * (dp - delta) * scale
+    ds_pre = p * (dp - delta)  # the unscaled dS (the bias cotangent)
+    dbias = None
+    if bias is not None:
+        hb, sq, sk_ = bias.shape
+        dbias = ds_pre.reshape(-1, hb, sq, sk_).sum(0)
+    ds = ds_pre * scale
     dq = jnp.einsum("bqk,bkd->bqd", ds, kf.astype(jnp.float32)).astype(q.dtype)
     dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32))
     if group > 1:
@@ -181,16 +211,19 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, scale, causal, use_pallas,
         sk, d = k.shape[1], k.shape[2]
         dk = dk.reshape(-1, group, sk, d).sum(1)
         dv = dv.reshape(-1, group, sk, d).sum(1)
-    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype), dbias
 
 
 def _flash_bwd(scale, causal, use_pallas, dropout_rate, res_pack, do):
-    res, kv_lens, dropout_seed = res_pack
+    res, bias, kv_lens, dropout_seed = res_pack
     q, k, v, o, lse = res
-    dq, dk, dv = _flash_bwd_impl(
+    dq, dk, dv, dbias = _flash_bwd_impl(
         q, k, v, o, lse, do, kv_lens, scale, causal, use_pallas,
-        dropout_rate, dropout_seed)
-    return dq, dk, dv, _float0_like(kv_lens), _float0_like(dropout_seed)
+        dropout_rate, dropout_seed, bias)
+    if bias is not None:
+        dbias = dbias.astype(bias.dtype)
+    return (dq, dk, dv, dbias, _float0_like(kv_lens),
+            _float0_like(dropout_seed))
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
@@ -246,10 +279,10 @@ def _from_bh(x, b, h):  # (b*h, s, d) -> (b, s, h, d)
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash_core_bshd(q, k, v, kv_lens, dropout_seed, scale, causal,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
+def _flash_core_bshd(q, k, v, bias, kv_lens, dropout_seed, scale, causal,
                      use_pallas, dropout_rate):
-    o, _ = _flash_fwd_res_bshd(q, k, v, kv_lens, dropout_seed, scale,
+    o, _ = _flash_fwd_res_bshd(q, k, v, bias, kv_lens, dropout_seed, scale,
                                causal, use_pallas, dropout_rate)
     return o
 
@@ -260,19 +293,20 @@ def _expand_lens_bh(kv_lens, h):
     return None if kv_lens is None else jnp.repeat(kv_lens, h)
 
 
-def _flash_fwd_res_bshd(q, k, v, kv_lens, dropout_seed, scale, causal,
+def _flash_fwd_res_bshd(q, k, v, bias, kv_lens, dropout_seed, scale, causal,
                         use_pallas, dropout_rate):
     if use_pallas:
         # carrier residual, same rationale as _flash_fwd_res
         o, lse = _k.flash_fwd_bshd(
             q, k, v, scale=scale, causal=causal, kv_lens=kv_lens,
-            full_lse=True, interpret=_backend.interpret_mode(),
+            bias=bias, full_lse=True, interpret=_backend.interpret_mode(),
             dropout_rate=dropout_rate, dropout_seed=dropout_seed)
     else:
         b, h = q.shape[0], q.shape[2]
         group = h // k.shape[2]
         # flat repeat matches the grouped row order (q row b·h + h_i reads
-        # kv row (b·h + h_i)//group) — same expansion _flash_bwd_impl uses
+        # kv row (b·h + h_i)//group) — same expansion _flash_bwd_impl uses;
+        # bias rows keep the r % hb contract under the b-major flatten
         kf = _to_bh(k)
         vf = _to_bh(v)
         if group > 1:
@@ -280,39 +314,43 @@ def _flash_fwd_res_bshd(q, k, v, kv_lens, dropout_seed, scale, causal,
             vf = jnp.repeat(vf, group, 0)
         o3, lse3 = _xla_attention(_to_bh(q), kf, vf, scale, causal,
                                   _expand_lens_bh(kv_lens, h),
-                                  dropout_rate, dropout_seed)
+                                  dropout_rate, dropout_seed, bias)
         o = _from_bh(o3, b, h)
         lse = lse3.reshape(b, h, -1)
     return o, (q, k, v, o, lse)
 
 
-def _flash_fwd_bshd(q, k, v, kv_lens, dropout_seed, scale, causal,
+def _flash_fwd_bshd(q, k, v, bias, kv_lens, dropout_seed, scale, causal,
                     use_pallas, dropout_rate):
-    o, res = _flash_fwd_res_bshd(q, k, v, kv_lens, dropout_seed, scale,
-                                 causal, use_pallas, dropout_rate)
-    return o, (res, kv_lens, dropout_seed)
+    o, res = _flash_fwd_res_bshd(q, k, v, bias, kv_lens, dropout_seed,
+                                 scale, causal, use_pallas, dropout_rate)
+    return o, (res, bias, kv_lens, dropout_seed)
 
 
 def _flash_bwd_bshd(scale, causal, use_pallas, dropout_rate, res_pack, do):
-    res, kv_lens, dropout_seed = res_pack
+    res, bias, kv_lens, dropout_seed = res_pack
     q, k, v, o, lse = res
     dlens = _float0_like(kv_lens)
     dseed = _float0_like(dropout_seed)
     if use_pallas:
-        dq, dk, dv = _k.flash_bwd_bshd(
+        out = _k.flash_bwd_bshd(
             q, k, v, o, lse, do, scale=scale, causal=causal,
-            kv_lens=kv_lens, interpret=_backend.interpret_mode(),
+            kv_lens=kv_lens, bias=bias, interpret=_backend.interpret_mode(),
             dropout_rate=dropout_rate, dropout_seed=dropout_seed)
-        return dq, dk, dv, dlens, dseed
+        dq, dk, dv = out[:3]
+        dbias = out[3].astype(bias.dtype) if bias is not None else None
+        return dq, dk, dv, dbias, dlens, dseed
     b, h = q.shape[0], q.shape[2]
     h_kv = k.shape[2]
-    dq3, dk3, dv3 = _flash_bwd_impl(
+    dq3, dk3, dv3, dbias = _flash_bwd_impl(
         _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(o),
         lse.reshape(b * h, -1), _to_bh(do), _expand_lens_bh(kv_lens, h),
         scale, causal, use_pallas=False, dropout_rate=dropout_rate,
-        dropout_seed=dropout_seed)
+        dropout_seed=dropout_seed, bias=bias)
+    if bias is not None:
+        dbias = dbias.astype(bias.dtype)
     return (_from_bh(dq3, b, h), _from_bh(dk3, b, h_kv),
-            _from_bh(dv3, b, h_kv), dlens, dseed)
+            _from_bh(dv3, b, h_kv), dbias, dlens, dseed)
 
 
 _flash_core_bshd.defvjp(_flash_fwd_bshd, _flash_bwd_bshd)
@@ -320,9 +358,10 @@ _flash_core_bshd.defvjp(_flash_fwd_bshd, _flash_bwd_bshd)
 
 # --- fused projection + attention block ---------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
-def fused_qkv_attention(x, w_qkv, b_qkv, w_out, dropout_seed, kv_lens, h,
-                        h_kv, d, scale, causal, dropout_rate=0.0):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12))
+def fused_qkv_attention(x, w_qkv, b_qkv, w_out, bias, dropout_seed,
+                        kv_lens, h, h_kv, d, scale, causal,
+                        dropout_rate=0.0):
     """Packed-QKV projection → flash attention → output projection as ONE
     differentiable block in which every large contraction is a plain 2D
     GEMM over (tokens, features) folded views, and the flash kernels read
@@ -347,13 +386,16 @@ def fused_qkv_attention(x, w_qkv, b_qkv, w_out, dropout_seed, kv_lens, h,
     otherwise); masks regenerate in backward from the same counter hash
     (see ``pallas.attention.dropout_keep``). ``kv_lens`` (b,) int32 masks
     each batch row's kv positions >= its length (padded batches; pass
-    None for full sequences)."""
-    y, _ = _fused_attn_fwd(x, w_qkv, b_qkv, w_out, dropout_seed, kv_lens,
-                           h, h_kv, d, scale, causal, dropout_rate)
+    None for full sequences). ``bias`` (hb, s, s) with hb | h: additive
+    score bias read in-kernel (q-head row t reads bias row t % hb),
+    differentiated (dbias = Σ_batch dS via the batch-innermost dbias
+    kernel); pass None for unbiased attention."""
+    y, _ = _fused_attn_fwd(x, w_qkv, b_qkv, w_out, bias, dropout_seed,
+                           kv_lens, h, h_kv, d, scale, causal, dropout_rate)
     return y
 
 
-def _fused_attn_fwd(x, w_qkv, b_qkv, w_out, dropout_seed, kv_lens, h,
+def _fused_attn_fwd(x, w_qkv, b_qkv, w_out, bias, dropout_seed, kv_lens, h,
                     h_kv, d, scale, causal, dropout_rate=0.0):
     b, s, H = x.shape
     qkv = (jnp.dot(x.reshape(-1, H), w_qkv.T) + b_qkv).reshape(b, s, -1)
@@ -362,26 +404,28 @@ def _fused_attn_fwd(x, w_qkv, b_qkv, w_out, dropout_seed, kv_lens, h,
     # would force a re-broadcast there, one slice+broadcast pair per layer)
     o, lse = _k.flash_fwd_packed(
         qkv, h, h_kv, d, scale=scale, causal=causal, kv_lens=kv_lens,
-        full_lse=True, interpret=_backend.interpret_mode(),
+        bias=bias, full_lse=True, interpret=_backend.interpret_mode(),
         dropout_rate=dropout_rate, dropout_seed=dropout_seed)
     # dead rows (kv_lens == 0): the kernel writes zero context rows and
     # zeros propagate through the projection — no extra masking needed
     y = jnp.dot(o.reshape(-1, h * d), w_out.T).reshape(b, s, -1)
-    return y, (x, qkv, o, lse, w_qkv, w_out, dropout_seed, kv_lens)
+    return y, (x, qkv, o, lse, w_qkv, w_out, bias, dropout_seed, kv_lens)
 
 
 def _fused_attn_bwd(h, h_kv, d, scale, causal, dropout_rate, res, dy):
-    x, qkv, o, lse, w_qkv, w_out, dropout_seed, kv_lens = res
+    x, qkv, o, lse, w_qkv, w_out, bias, dropout_seed, kv_lens = res
     b, s, H = x.shape
     T = b * s
     dy2 = dy.reshape(T, -1)
     o2 = o.reshape(T, h * d)
     dw_out = jnp.dot(dy2.T, o2)
     do = jnp.dot(dy2, w_out).reshape(b, s, h * d)
-    dq, dk, dv = _k.flash_bwd_packed(
+    out = _k.flash_bwd_packed(
         qkv, h, h_kv, d, o, lse, do, scale=scale, causal=causal,
-        kv_lens=kv_lens, interpret=_backend.interpret_mode(),
+        kv_lens=kv_lens, bias=bias, interpret=_backend.interpret_mode(),
         dropout_rate=dropout_rate, dropout_seed=dropout_seed)
+    dq, dk, dv = out[:3]
+    dbias = out[3].astype(bias.dtype) if bias is not None else None
     x2 = x.reshape(T, H)
     dq2 = dq.reshape(T, -1)
     dk2 = dk.reshape(T, -1)
@@ -396,7 +440,7 @@ def _fused_attn_bwd(h, h_kv, d, scale, causal, dropout_rate, res, dy):
     db_qkv = jnp.concatenate(
         [jnp.sum(dq2, 0), jnp.sum(dk2, 0), jnp.sum(dv2, 0)])
     return dx, dw_qkv.astype(w_qkv.dtype), db_qkv.astype(w_qkv.dtype), \
-        dw_out.astype(w_out.dtype), _float0_like(dropout_seed), \
+        dw_out.astype(w_out.dtype), dbias, _float0_like(dropout_seed), \
         _float0_like(kv_lens)
 
 
@@ -406,8 +450,8 @@ fused_qkv_attention.defvjp(_fused_attn_fwd, _fused_attn_bwd)
 def flash_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     *, causal: bool = False, scale: Optional[float] = None,
-    kv_lens: Optional[jax.Array] = None, impl: str = "auto",
-    layout: str = "bhsd", dropout_rate: float = 0.0,
+    kv_lens: Optional[jax.Array] = None, bias: Optional[jax.Array] = None,
+    impl: str = "auto", layout: str = "bhsd", dropout_rate: float = 0.0,
     dropout_seed: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Blockwise attention over (..., seq, head_dim) with any number of
@@ -462,7 +506,26 @@ def flash_attention(
     O(block) memory, regenerated in backward, bit-identical between the
     Pallas and XLA dispatches, deterministic per ``dropout_seed`` (int32
     scalar, required). The softmax normalizer is computed pre-dropout
-    (standard probs-dropout semantics: E[output] = no-dropout output)."""
+    (standard probs-dropout semantics: E[output] = no-dropout output).
+    The realized drop probability is ``dropout_rate`` quantized to the
+    nearest multiple of 2^-24 (the hash compares in a 24-bit integer
+    domain) — sub-1e-7 rates round to off.
+
+    ``bias`` (hb, sq, sk): an arbitrary ADDITIVE score bias applied
+    IN-KERNEL — the reference's fused-mask capability
+    (``csrc/megatron/scaled_masked_softmax.cpp:85-94`` applies a
+    per-batch mask fused with scale+softmax; the additive ``attn_mask``
+    variants of ``contrib/multihead_attn/self_multihead_attn.py:144-198``)
+    generalized: T5 relative position bias, ALiBi slopes, additive
+    attention masks all ride the same operand. Row ``r`` of the flattened
+    (batch·heads) leading dims reads bias row ``r % hb`` — so (h, sq, sk)
+    is a per-head bias shared over batch (the T5 case), (1, sq, sk) a
+    broadcast bias, (b·h, sq, sk) fully per-row. Added to the scaled
+    scores BEFORE causal/kv_lens masks; differentiable (dbias = Σ over
+    the sharing rows of dS, computed by a third, batch-innermost backward
+    kernel — ~2 extra GEMM passes, paid only when bias is given).
+    Composes with causal, kv_lens, dropout, GQA, and both layouts (with
+    ``layout='bshd'`` hb must divide h)."""
     q, k, v = apply_op_rules("attention", q, k, v)
     if layout not in ("bhsd", "bshd"):
         raise ValueError(f"layout must be bhsd|bshd, got {layout!r}")
@@ -475,6 +538,14 @@ def flash_attention(
         dropout_seed = jnp.asarray(dropout_seed, jnp.int32)
     else:
         dropout_seed = None
+    if bias is not None:
+        sq_, sk_ = q.shape[-2], k.shape[-2]
+        if layout == "bshd":
+            sq_, sk_ = q.shape[1], k.shape[1]
+        if bias.ndim != 3 or bias.shape[1:] != (sq_, sk_):
+            raise ValueError(
+                f"bias must be (hb, sq, sk) = (hb, {sq_}, {sk_}); got "
+                f"{bias.shape}")
     if layout == "bshd":
         if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
             raise ValueError(
@@ -498,14 +569,18 @@ def flash_attention(
                     f"layout='bshd' takes per-batch kv_lens of shape "
                     f"({q.shape[0]},); got {kv_lens.shape}")
             kv_lens = kv_lens.astype(jnp.int32)
+        if bias is not None and q.shape[2] % bias.shape[0]:
+            raise ValueError(
+                f"layout='bshd' needs bias rows ({bias.shape[0]}) dividing "
+                f"q heads ({q.shape[2]})")
         ok = bshd_kernel_ok(q.shape[1], k.shape[1], q.shape[2], d, q.dtype)
         impl_ = impl
         if (impl_ == "auto" and k.shape[1] < flash_auto_crossover(d)
                 and not _backend.interpret_forced()):
             impl_ = "xla"
         use_pallas = _backend.choose_impl(impl_, ok) == "pallas"
-        return _flash_core_bshd(q, k, v, kv_lens, dropout_seed, s_scale,
-                                causal, use_pallas, dropout_rate)
+        return _flash_core_bshd(q, k, v, bias, kv_lens, dropout_seed,
+                                s_scale, causal, use_pallas, dropout_rate)
     d = q.shape[-1]
     if causal and q.shape[-2] > k.shape[-2]:
         # bottom-right-aligned causal with sq > sk gives the first
@@ -559,7 +634,11 @@ def flash_attention(
         # int32 before the custom_vjp: backward returns a float0 cotangent,
         # which JAX only accepts for integer primals
         kv_lens = kv_lens.reshape(-1).astype(jnp.int32)
-    o = _flash_core(q3, k3, v3, kv_lens, dropout_seed, scale, causal,
+    if bias is not None and q3.shape[0] % bias.shape[0]:
+        raise ValueError(
+            f"bias rows ({bias.shape[0]}) must divide q's flattened "
+            f"leading dims ({q3.shape[0]})")
+    o = _flash_core(q3, k3, v3, bias, kv_lens, dropout_seed, scale, causal,
                     use_pallas, dropout_rate)
     return o.reshape(*lead, q.shape[-2], d)
 
@@ -644,7 +723,7 @@ def _piece_fwd_bshd(q, k, v, scale, causal, use_pallas, dropout_rate=0.0,
     """(o (b, s, h, d), lse (b, h, s)) of one seq-major piece — the
     bshd-layout twin of :func:`_piece_fwd` (kernels read the projection
     GEMMs' natural layout; no transpose round trip per ring step)."""
-    o, res = _flash_fwd_res_bshd(q, k, v, None, dropout_seed, scale,
+    o, res = _flash_fwd_res_bshd(q, k, v, None, None, dropout_seed, scale,
                                  causal, use_pallas, dropout_rate)
     lse = res[4]
     # the pallas path returns the (b, h, s, LANES) carrier; the ring's
@@ -656,10 +735,10 @@ def _piece_bwd_bshd(q, k, v, o, lse, do, scale, causal, use_pallas,
                     dropout_rate=0.0, dropout_seed=None):
     """Piece backward in the bshd layout (lse (b, h, s)) — delegates to
     the flash bshd backward with the ring's GLOBAL lse."""
-    dq, dk, dv, _, _ = _flash_bwd_bshd(
+    out = _flash_bwd_bshd(
         scale, causal, use_pallas, dropout_rate,
-        ((q, k, v, o, lse), None, dropout_seed), do)
-    return dq, dk, dv
+        ((q, k, v, o, lse), None, None, dropout_seed), do)
+    return out[0], out[1], out[2]
 
 
 def _fold(o1, l1, o2, l2, bshd=False):
@@ -788,7 +867,7 @@ def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, scale, causal,
             return _piece_bwd_bshd(qq, kk, vv, oo, ll, ddo, scale, caus,
                                    use_pallas, dropout_rate, sd)
         return _flash_bwd_impl(qq, kk, vv, oo, ll, ddo, None, scale,
-                               caus, use_pallas, dropout_rate, sd)
+                               caus, use_pallas, dropout_rate, sd)[:3]
 
     def pseed(t, piece):
         return _piece_seed(dropout_seed, rank, t, piece)

@@ -74,7 +74,11 @@ def dropout_keep(seed, t, rows, cols, rate):
     linear counter: the counter form wraps uint32 when sq·sk > 2^32, which
     would hand row pairs 2^32/sk apart bit-identical masks exactly at the
     long-context scale the kernels advertise (review r4). Per-row key
-    material costs one extra fmix32 on a (rows, 1) column — negligible."""
+    material costs one extra fmix32 on a (rows, 1) column — negligible.
+
+    The realized keep probability is ``rate`` quantized to the nearest
+    multiple of 2^-24 (the integer-domain compare uses a 24-bit
+    threshold); rates below ~3e-8 round to dropout-off (ADVICE r4)."""
     key = _fmix32(seed.astype(_U32) ^ (jnp.asarray(t).astype(_U32)
                                        * _U32(0x9E3779B9)))
     row_key = _fmix32(key ^ rows.astype(_U32))
@@ -109,7 +113,7 @@ def _fit_block(n, pref):
 # --- forward ------------------------------------------------------------------
 
 def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, varlen, bshd=False,
-                rate=0.0):
+                rate=0.0, has_bias=False):
     """``varlen`` is a STATIC specialization flag: without kv lengths the
     kernel carries no length operand, no per-block length select, and no
     dynamic predicate conjunct — the common (non-padded) call pays nothing.
@@ -122,10 +126,18 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, varlen, bshd=False,
     normalized probabilities), the output accumulator takes the masked,
     1/(1-rate)-scaled p; masks come from :func:`dropout_keep` on global
     coordinates and a seed operand in SMEM.
+    ``has_bias`` (static) adds an additive score-bias operand — a
+    (1, bq, bk) block of the (hb, sq, sk) bias array, added to the scaled
+    scores BEFORE the causal/varlen masks (the reference's in-kernel
+    arbitrary mask, ``csrc/megatron/scaled_masked_softmax.cpp:85-94``,
+    generalized to any additive bias — T5 relative position bias rides it).
     """
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
     n = 3
+    if has_bias:
+        bias_ref = refs[n]
+        n += 1
     if varlen:
         kvlen_ref = refs[n]
         n += 1
@@ -163,6 +175,8 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, varlen, bshd=False,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (bq, bk)
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         if causal or varlen:
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
@@ -241,15 +255,26 @@ def _seed_operand(dropout_seed):
 
 _SMEM_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
 
+# additive-bias kernels cap blocks at 512: a (bq, bk) fp32 bias block is
+# bq·bk·4 bytes double-buffered (4 MB at 1024² — too much VMEM next to the
+# q/k/v/do blocks and accumulators; 1 MB at 512² fits comfortably)
+_BIAS_BLOCK_CAP = 512
 
-def _tail_operands(kv_lens, rows, dropout_rate, dropout_seed, lens_map):
+
+def _tail_operands(kv_lens, rows, dropout_rate, dropout_seed, lens_map,
+                   bias=None, bias_map=None, bias_block=None):
     """(specs, args) for the OPTIONAL trailing kernel operands, in the
-    kernels' fixed unpack order: [kvlen carrier] then [dropout seed].
-    ``rows`` is the lens carrier's leading extent (bh for the flat
-    layout, b for bshd/packed); ``lens_map`` the grid->carrier index map.
-    One assembly point so a future operand cannot be appended in the
-    wrong order at one of the eight call sites."""
+    kernels' fixed unpack order: [score bias] then [kvlen carrier] then
+    [dropout seed]. ``rows`` is the lens carrier's leading extent (bh for
+    the flat layout, b for bshd/packed); ``lens_map`` the grid->carrier
+    index map; ``bias`` the (hb, sq, sk) additive-score array with
+    ``bias_map`` its grid->(row, qblk, kblk) map and ``bias_block`` the
+    (1, bq, bk) block shape. One assembly point so a future operand cannot
+    be appended in the wrong order at one of the call sites."""
     specs, args = [], []
+    if bias is not None:
+        specs.append(pl.BlockSpec(bias_block, bias_map))
+        args.append(bias)
     if kv_lens is not None:
         specs.append(pl.BlockSpec((1, 1, _LSE_LANES), lens_map))
         args.append(_kvlen_rows(kv_lens, rows))
@@ -259,8 +284,8 @@ def _tail_operands(kv_lens, rows, dropout_rate, dropout_seed, lens_map):
     return specs, args
 
 
-def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bq=1024, bk=1024,
-              full_lse=False, interpret=False, dropout_rate=0.0,
+def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bias=None, bq=1024,
+              bk=1024, full_lse=False, interpret=False, dropout_rate=0.0,
               dropout_seed=None):
     """q (bh, sq, d); k/v (bh_kv, sk, d) where bh_kv divides bh — grouped-
     query attention falls out of the kv BlockSpec index maps (q row ``b``
@@ -271,13 +296,22 @@ def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bq=1024, bk=1024,
     copies are unconditional). ``kv_lens=None`` compiles a kernel with no
     varlen operand or masking at all. ``full_lse`` returns the raw
     (bh, sq, LANES) lane carrier, which :func:`flash_bwd` accepts directly
-    (saves the slice + re-broadcast pair when lse only rides residuals)."""
+    (saves the slice + re-broadcast pair when lse only rides residuals).
+
+    ``bias`` (hb, sq, sk) with hb | bh: an additive score bias, row ``r``
+    reading bias row ``r % hb`` — (h, sq, sk) shared over batch under the
+    b-major row order, (1, sq, sk) fully broadcast, (bh, sq, sk) per-row.
+    Added to the scaled scores before masks; block sizes cap at 512 so the
+    (bq, bk) bias blocks stay within VMEM."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     group = bh // k.shape[0]
+    if bias is not None:
+        bq, bk = min(bq, _BIAS_BLOCK_CAP), min(bk, _BIAS_BLOCK_CAP)
     bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
     varlen = kv_lens is not None
+    hb = 0 if bias is None else bias.shape[0]
 
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -286,14 +320,15 @@ def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bq=1024, bk=1024,
     ]
     args = [q, k, v]
     tail_specs, tail_args = _tail_operands(
-        kv_lens, bh, dropout_rate, dropout_seed, lambda b, i, j: (b, 0, 0))
+        kv_lens, bh, dropout_rate, dropout_seed, lambda b, i, j: (b, 0, 0),
+        bias, lambda b, i, j, hb=hb: (b % hb, i, j), (1, bq, bk))
     in_specs += tail_specs
     args += tail_args
 
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=varlen,
-                          rate=dropout_rate),
+                          rate=dropout_rate, has_bias=bias is not None),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=[
@@ -318,8 +353,8 @@ def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bq=1024, bk=1024,
 
 
 def flash_fwd_packed(qkv, h, h_kv, d, *, scale, causal, kv_lens=None,
-                     bq=1024, bk=1024, full_lse=False, interpret=False,
-                     dropout_rate=0.0, dropout_seed=None):
+                     bias=None, bq=1024, bk=1024, full_lse=False,
+                     interpret=False, dropout_rate=0.0, dropout_seed=None):
     """Flash forward reading q/k/v directly out of the PACKED projection
     output: ``qkv`` (b, s, (h+2·h_kv)·d), features ordered q|k|v with heads
     contiguous inside each part. The same buffer rides in three times with
@@ -328,12 +363,19 @@ def flash_fwd_packed(qkv, h, h_kv, d, *, scale, causal, kv_lens=None,
     (o (b, s, h·d), lse (b, h, s)) — or, with ``full_lse``, the raw
     (b, h, s, LANES) lane carrier the kernel wrote, which
     :func:`flash_bwd_packed` accepts directly: round-tripping through the
-    sliced form costs a slice + re-broadcast pair per layer for nothing."""
+    sliced form costs a slice + re-broadcast pair per layer for nothing.
+
+    ``bias`` (hb, s, s) with hb | h: additive score bias, q-head row
+    ``t = b·h + h_i`` reading bias row ``t % hb`` (i.e. per-head bias
+    shared over batch at hb == h; broadcast at hb == 1)."""
     b, s, _ = qkv.shape
     group = h // h_kv
+    if bias is not None:
+        bq, bk = min(bq, _BIAS_BLOCK_CAP), min(bk, _BIAS_BLOCK_CAP)
     bq, bk = _fit_block(s, bq), _fit_block(s, bk)
     nq, nk = _blocks(s, bq), _blocks(s, bk)
     varlen = kv_lens is not None
+    hb = 0 if bias is None else bias.shape[0]
 
     args = [qkv, qkv, qkv]
     in_specs = [
@@ -348,14 +390,16 @@ def flash_fwd_packed(qkv, h, h_kv, d, *, scale, causal, kv_lens=None,
     ]
     tail_specs, tail_args = _tail_operands(
         kv_lens, b, dropout_rate, dropout_seed,
-        lambda t, i, j, h=h: (t // h, 0, 0))
+        lambda t, i, j, h=h: (t // h, 0, 0),
+        bias, lambda t, i, j, hb=hb: (t % hb, i, j), (1, bq, bk))
     in_specs += tail_specs
     args += tail_args
 
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, off=0, varlen=varlen,
-                          bshd=True, rate=dropout_rate),
+                          bshd=True, rate=dropout_rate,
+                          has_bias=bias is not None),
         grid=(b * h, nq, nk),
         in_specs=in_specs,
         out_specs=[
@@ -438,8 +482,8 @@ def _bwd_single_block_kernel(*refs, scale, causal, n, rate=0.0):
 
 
 def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
-                     kv_lens=None, bq=1024, bk=1024, interpret=False,
-                     dropout_rate=0.0, dropout_seed=None):
+                     kv_lens=None, bias=None, bq=1024, bk=1024,
+                     interpret=False, dropout_rate=0.0, dropout_seed=None):
     """Backward of :func:`flash_fwd_packed`: returns SEPARATE folded grads
     (dq (b, s, h·d), dk/dv (b, s, h_kv·d)) — the caller contracts each
     against its weight window (plain 2D GEMMs), never materializing a
@@ -448,18 +492,26 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
 
     ``lse`` may be the sliced (b, h, s) form or the (b, h, s, LANES)
     carrier exactly as :func:`flash_fwd_packed` ``full_lse=True`` returned
-    it — passing the carrier skips a per-layer re-broadcast."""
+    it — passing the carrier skips a per-layer re-broadcast.
+
+    ``bias`` (hb, s, s), hb | h: adds a fourth output dbias (hb, s, s)
+    fp32 (see :func:`flash_bwd`)."""
     b, s, _ = qkv.shape
     group = h // h_kv
+    if bias is not None:
+        bq, bk = min(bq, _BIAS_BLOCK_CAP), min(bk, _BIAS_BLOCK_CAP)
     bq, bk = _fit_block(s, bq), _fit_block(s, bk)
     nq, nk = _blocks(s, bq), _blocks(s, bk)
     lse4 = lse if lse.ndim == 4 else _expand_rows(lse)
     varlen = kv_lens is not None
+    hb = 0 if bias is None else bias.shape[0]
 
-    # varlen rides the two-kernel split (the fused single-block kernel
-    # carries no length operand — padded batches pay one extra QK^T
-    # recompute, the same cost every multi-block sequence pays anyway)
-    if nq == 1 and nk == 1 and not varlen:
+    # varlen and bias ride the two-kernel split (the fused single-block
+    # kernel carries no length operand, and it computes delta internally —
+    # the dbias kernel needs delta as an operand; padded/biased batches pay
+    # one extra QK^T recompute, the same cost every multi-block sequence
+    # pays anyway)
+    if nq == 1 and nk == 1 and not varlen and bias is None:
         qm = lambda t, h=h: (t // h, 0, t % h)  # noqa: E731
         km = lambda t, h=h, g=group: (t // h, 0, h + (t % h) // g)  # noqa: E731
         vm = lambda t, h=h, hk=h_kv, g=group: (  # noqa: E731
@@ -511,12 +563,14 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
     rm = lambda t, i, j, h=h: (t // h, t % h, i, 0)  # noqa: E731
     extra_specs, extra_args = _tail_operands(
         kv_lens, b, dropout_rate, dropout_seed,
-        lambda t, i, j, h=h: (t // h, 0, 0))
+        lambda t, i, j, h=h: (t // h, 0, 0),
+        bias, lambda t, i, j, hb=hb: (t % hb, i, j), (1, bq, bk))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, off=0, varlen=varlen,
-                          bshd=True, rate=dropout_rate),
+                          bshd=True, rate=dropout_rate,
+                          has_bias=bias is not None),
         grid=(b * h, nq, nk),
         in_specs=[pl.BlockSpec((1, bq, d), qm),
                   pl.BlockSpec((1, bk, d), km),
@@ -543,12 +597,14 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
     dkv_dt = jnp.float32 if group > 1 else qkv.dtype
     extra_specs2, _ = _tail_operands(
         kv_lens, b, dropout_rate, dropout_seed,
-        lambda t, j, i, h=h: (t // h, 0, 0))
+        lambda t, j, i, h=h: (t // h, 0, 0),
+        bias, lambda t, j, i, hb=hb: (t % hb, i, j), (1, bq, bk))
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, off=0, varlen=varlen,
-                          bshd=True, rate=dropout_rate),
+                          bshd=True, rate=dropout_rate,
+                          has_bias=bias is not None),
         grid=(b * h, nk, nq),
         in_specs=[pl.BlockSpec((1, bq, d), qm2),
                   pl.BlockSpec((1, bk, d), km2),
@@ -574,11 +630,46 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
     if group > 1:
         dk = _group_sum(dk, h_kv, group, d, qkv.dtype)
         dv = _group_sum(dv, h_kv, group, d, qkv.dtype)
-    return dq, dk, dv
+    if bias is None:
+        return dq, dk, dv
+    # dbias over the packed buffer: q/k/v windows picked by feature-block
+    # offsets (0 | h | h+h_kv), row r = bi·hb + th (see flash_bwd_bshd)
+    nb = (b * h) // hb
+    qmap = lambda th, i, j, bi, hb=hb, h=h: (  # noqa: E731
+        (bi * hb + th) // h, i, (bi * hb + th) % h)
+    kmap = lambda th, i, j, bi, hb=hb, h=h, g=group: (  # noqa: E731
+        (bi * hb + th) // h, j, h + ((bi * hb + th) % h) // g)
+    vmap = lambda th, i, j, bi, hb=hb, h=h, hk=h_kv, g=group: (  # noqa: E731
+        (bi * hb + th) // h, j, h + hk + ((bi * hb + th) % h) // g)
+    rmap = lambda th, i, j, bi, hb=hb, h=h: (  # noqa: E731
+        (bi * hb + th) // h, (bi * hb + th) % h, i, 0)
+    db_specs = [
+        pl.BlockSpec((1, bq, d), qmap),
+        pl.BlockSpec((1, bk, d), kmap),
+        pl.BlockSpec((1, bk, d), vmap),
+        pl.BlockSpec((1, bq, d), qmap),
+        pl.BlockSpec((1, 1, bq, _LSE_LANES), rmap),
+        pl.BlockSpec((1, 1, bq, _LSE_LANES), rmap),
+        pl.BlockSpec((1, bq, bk), lambda th, i, j, bi: (th, i, j)),
+    ]
+    db_args = [qkv, qkv, qkv, do, lse4, delta4, bias]
+    if varlen:
+        db_specs.append(pl.BlockSpec(
+            (1, 1, _LSE_LANES),
+            lambda th, i, j, bi, hb=hb, h=h: ((bi * hb + th) // h, 0, 0)))
+        db_args.append(_kvlen_rows(kv_lens, b))
+    if dropout_rate > 0.0:
+        db_specs.append(_SMEM_SPEC)
+        db_args.append(_seed_operand(dropout_seed))
+    dbias = _dbias_pallas(
+        db_args, db_specs, hb=hb, sq=s, sk=s, nq=nq, nk=nk, nb=nb,
+        bq=bq, bk=bk, scale=scale, causal=causal, off=0,
+        varlen=varlen, bshd=True, rate=dropout_rate, interpret=interpret)
+    return dq, dk, dv, dbias
 
 
-def flash_fwd_bshd(q, k, v, *, scale, causal, kv_lens=None, bq=1024,
-                   bk=1024, full_lse=False, interpret=False,
+def flash_fwd_bshd(q, k, v, *, scale, causal, kv_lens=None, bias=None,
+                   bq=1024, bk=1024, full_lse=False, interpret=False,
                    dropout_rate=0.0, dropout_seed=None):
     """Seq-major flash forward: q (b, sq, h, d); k/v (b, sk, h_kv, d).
 
@@ -593,13 +684,19 @@ def flash_fwd_bshd(q, k, v, *, scale, causal, kv_lens=None, bq=1024,
 
     ``kv_lens`` (b,) int32: per-BATCH valid kv lengths (heads share a
     row's length — the padded-batch case); same masking/skip semantics as
-    :func:`flash_fwd`."""
+    :func:`flash_fwd`.
+
+    ``bias`` (hb, sq, sk) with hb | h: additive score bias, q-head row
+    ``t = b·h + h_i`` reading bias row ``t % hb``."""
     b, sq, h, d = q.shape
     sk, h_kv = k.shape[1], k.shape[2]
     group = h // h_kv
+    if bias is not None:
+        bq, bk = min(bq, _BIAS_BLOCK_CAP), min(bk, _BIAS_BLOCK_CAP)
     bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
     varlen = kv_lens is not None
+    hb = 0 if bias is None else bias.shape[0]
 
     args = [q.reshape(b, sq, h * d), k.reshape(b, sk, h_kv * d),
             v.reshape(b, sk, h_kv * d)]
@@ -615,14 +712,16 @@ def flash_fwd_bshd(q, k, v, *, scale, causal, kv_lens=None, bq=1024,
     ]
     tail_specs, tail_args = _tail_operands(
         kv_lens, b, dropout_rate, dropout_seed,
-        lambda t, i, j, h=h: (t // h, 0, 0))
+        lambda t, i, j, h=h: (t // h, 0, 0),
+        bias, lambda t, i, j, hb=hb: (t % hb, i, j), (1, bq, bk))
     in_specs += tail_specs
     args += tail_args
 
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=varlen,
-                          bshd=True, rate=dropout_rate),
+                          bshd=True, rate=dropout_rate,
+                          has_bias=bias is not None),
         grid=(b * h, nq, nk),
         in_specs=in_specs,
         out_specs=[
@@ -657,10 +756,13 @@ def _rd_row(ref, bshd):
 
 
 def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, varlen,
-                   bshd=False, rate=0.0):
+                   bshd=False, rate=0.0, has_bias=False):
     refs = list(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
     n = 6
+    if has_bias:
+        bias_ref = refs[n]
+        n += 1
     if varlen:
         kvlen_ref = refs[n]
         n += 1
@@ -691,6 +793,8 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, varlen,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         if causal or varlen:
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
@@ -719,10 +823,13 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, varlen,
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, varlen,
-                    bshd=False, rate=0.0):
+                    bshd=False, rate=0.0, has_bias=False):
     refs = list(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
     n = 6
+    if has_bias:
+        bias_ref = refs[n]
+        n += 1
     if varlen:
         kvlen_ref = refs[n]
         n += 1
@@ -754,6 +861,8 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, varlen,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         if causal or varlen:
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
@@ -788,9 +897,102 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, varlen,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _bwd_dbias_kernel(*refs, scale, causal, bq, bk, nb, hb, off, varlen,
+                      bshd=False, rate=0.0):
+    """dbias[th] = Σ_b dS over the rows sharing bias row ``th`` (bias grad
+    = sum of dS over batch — the custom-VJP contract for the additive
+    score bias). dS = P ∘ (M/(1-r)∘dPd − Δ) recomputed blockwise, exactly
+    the dq/dkv kernels' recipe, UNscaled (the 1/√d scale belongs to dq/dk,
+    not to the bias which enters S additively).
+
+    Grid (hb, nq, nk, nb) with the BATCH dim innermost: TPU Pallas only
+    accumulates an output block over *consecutive* grid steps, and the
+    cross-batch reduction is the one the dq/dkv grids (batch outermost)
+    cannot host — hence a third kernel. Costs one extra QKᵀ + dO·Vᵀ pair
+    (~2 of backward's 7 GEMMs), paid only when a bias is present.
+
+    Row identity: global q-head row r = b·hb + th — the same ``t`` the
+    forward grid used, so the dropout mask hash regenerates bit-exactly."""
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref = refs[:7]
+    n = 7
+    if varlen:
+        kvlen_ref = refs[n]
+        n += 1
+    if rate > 0.0:
+        seed_ref = refs[n]
+        n += 1
+    dbias_ref, acc_scr = refs[n:]
+    th = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    b = pl.program_id(3)
+    r = b * hb + th  # global q-head row (the forward grid's t)
+
+    @pl.when(b == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = (not causal) or (j * bk <= (i + 1) * bq - 1 + off)
+    if varlen:
+        kvlen = kvlen_ref[0, 0, 0]
+        run = jnp.logical_and(run, j * bk < kvlen)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale + bias_ref[0].astype(jnp.float32)
+        if causal or varlen:
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            s = jnp.where(cols <= rows + off, s, NEG_INF)
+        if varlen:
+            s = jnp.where(cols < kvlen, s, NEG_INF)
+        p = jnp.exp(s - _rd_row(lse_ref, bshd)[:, 0:1])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if rate > 0.0:
+            dp = dp * _mask_scale(seed_ref[0], r, i, j, bq, bk, rate)
+        acc_scr[:] += p * (dp - _rd_row(delta_ref, bshd)[:, 0:1])
+
+    @pl.when(b == nb - 1)
+    def _finish():
+        dbias_ref[0] = acc_scr[:]
+
+
+def _dbias_pallas(args, in_specs, *, hb, sq, sk, nq, nk, nb, bq, bk, scale,
+                  causal, off, varlen, bshd, rate, interpret):
+    """Launch :func:`_bwd_dbias_kernel` — shared by the three layouts
+    (only ``in_specs``/``args`` differ). Returns (hb, sq, sk) fp32."""
+    return pl.pallas_call(
+        functools.partial(_bwd_dbias_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nb=nb, hb=hb, off=off,
+                          varlen=varlen, bshd=bshd, rate=rate),
+        grid=(hb, nq, nk, nb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, bk),
+                               lambda th, i, j, b: (th, i, j)),
+        out_shape=jax.ShapeDtypeStruct((hb, sq, sk), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bq, bk), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            # the b accumulation is order-dependent: innermost dim stays
+            # sequential ("arbitrary"), the block-indexed dims parallel
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(*args)
+
+
 def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
-              bq=1024, bk=1024, interpret=False, dropout_rate=0.0,
-              dropout_seed=None):
+              bias=None, bq=1024, bk=1024, interpret=False,
+              dropout_rate=0.0, dropout_seed=None):
     """Gradients; with grouped kv (bh_kv < bh) dk/dv come back at kv shape —
     the dkv kernel runs per *q*-head (its scratch accumulates over q blocks
     within one grid row, so cross-head accumulation can't live in-kernel)
@@ -798,28 +1000,37 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
     XLA fuses the reduction into the kernel's output write.
 
     ``lse`` is the sliced (bh, sq) form or the (bh, sq, LANES) carrier from
-    ``flash_fwd(full_lse=True)``."""
+    ``flash_fwd(full_lse=True)``.
+
+    ``bias`` (hb, sq, sk), hb | bh (row r reads bias row r % hb — see
+    :func:`flash_fwd`): returns a FOURTH output, dbias (hb, sq, sk) fp32 =
+    Σ over the rows sharing each bias row of the unscaled dS, produced by
+    :func:`_bwd_dbias_kernel` (batch-innermost grid)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     group = bh // k.shape[0]
+    if bias is not None:
+        bq, bk = min(bq, _BIAS_BLOCK_CAP), min(bk, _BIAS_BLOCK_CAP)
     bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     lse3 = lse if lse.ndim == 3 else _expand_rows(lse)
     delta3 = _expand_rows(delta)
     varlen = kv_lens is not None
+    hb = 0 if bias is None else bias.shape[0]
     _, extra_args = _tail_operands(
-        kv_lens, bh, dropout_rate, dropout_seed, None)
+        kv_lens, bh, dropout_rate, dropout_seed, None, bias, None, None)
 
-    def kvlen_spec(index_map):
+    def tail_specs(index_map, bias_map):
         specs, _ = _tail_operands(
-            kv_lens, bh, dropout_rate, dropout_seed, index_map)
+            kv_lens, bh, dropout_rate, dropout_seed, index_map,
+            bias, bias_map, (1, bq, bk))
         return specs
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=varlen,
-                          rate=dropout_rate),
+                          rate=dropout_rate, has_bias=bias is not None),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -828,7 +1039,8 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i, j: (b, i, 0)),
-        ] + kvlen_spec(lambda b, i, j: (b, 0, 0)),
+        ] + tail_specs(lambda b, i, j: (b, 0, 0),
+                       lambda b, i, j, hb=hb: (b % hb, i, j)),
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
@@ -841,7 +1053,7 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, off=sk - sq, varlen=varlen,
-                          rate=dropout_rate),
+                          rate=dropout_rate, has_bias=bias is not None),
         grid=(bh, nk, nq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
@@ -850,7 +1062,8 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bq, _LSE_LANES), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bq, _LSE_LANES), lambda b, j, i: (b, i, 0)),
-        ] + kvlen_spec(lambda b, j, i: (b, 0, 0)),
+        ] + tail_specs(lambda b, j, i: (b, 0, 0),
+                       lambda b, j, i, hb=hb: (b % hb, i, j)),
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
@@ -877,21 +1090,54 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
     if group > 1:
         dk = dk.reshape(-1, group, sk, d).sum(1).astype(k.dtype)
         dv = dv.reshape(-1, group, sk, d).sum(1).astype(v.dtype)
-    return dq, dk, dv
+    if bias is None:
+        return dq, dk, dv
+    nb = bh // hb
+    qmap = lambda th, i, j, b, hb=hb: (b * hb + th, i, 0)  # noqa: E731
+    kmap = lambda th, i, j, b, hb=hb, g=group: (  # noqa: E731
+        (b * hb + th) // g, j, 0)
+    db_specs = [
+        pl.BlockSpec((1, bq, d), qmap),
+        pl.BlockSpec((1, bk, d), kmap),
+        pl.BlockSpec((1, bk, d), kmap),
+        pl.BlockSpec((1, bq, d), qmap),
+        pl.BlockSpec((1, bq, _LSE_LANES), qmap),
+        pl.BlockSpec((1, bq, _LSE_LANES), qmap),
+        pl.BlockSpec((1, bq, bk), lambda th, i, j, b: (th, i, j)),
+    ]
+    db_args = [q, k, v, do, lse3, delta3, bias]
+    if varlen:
+        db_specs.append(pl.BlockSpec(
+            (1, 1, _LSE_LANES),
+            lambda th, i, j, b, hb=hb: (b * hb + th, 0, 0)))
+        db_args.append(_kvlen_rows(kv_lens, bh))
+    if dropout_rate > 0.0:
+        db_specs.append(_SMEM_SPEC)
+        db_args.append(_seed_operand(dropout_seed))
+    dbias = _dbias_pallas(
+        db_args, db_specs, hb=hb, sq=sq, sk=sk, nq=nq, nk=nk, nb=nb,
+        bq=bq, bk=bk, scale=scale, causal=causal, off=sk - sq,
+        varlen=varlen, bshd=False, rate=dropout_rate, interpret=interpret)
+    return dq, dk, dv, dbias
 
 
 def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
-                   bq=1024, bk=1024, interpret=False, dropout_rate=0.0,
-                   dropout_seed=None):
+                   bias=None, bq=1024, bk=1024, interpret=False,
+                   dropout_rate=0.0, dropout_seed=None):
     """Seq-major backward (cf. :func:`flash_fwd_bshd`): q/o/do
     (b, sq, h, d), k/v (b, sk, h_kv, d), lse (b, h, sq) or the
     (b, h, sq, LANES) carrier from ``flash_fwd_bshd(full_lse=True)``.
-    Returns (dq (b, sq, h, d), dk/dv (b, sk, h_kv, d))."""
+    Returns (dq (b, sq, h, d), dk/dv (b, sk, h_kv, d)); with ``bias``
+    (hb, sq, sk), hb | h, a fourth output dbias (hb, sq, sk) fp32 (see
+    :func:`flash_bwd`)."""
     b, sq, h, d = q.shape
     sk, h_kv = k.shape[1], k.shape[2]
     group = h // h_kv
+    if bias is not None:
+        bq, bk = min(bq, _BIAS_BLOCK_CAP), min(bk, _BIAS_BLOCK_CAP)
     bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
+    hb = 0 if bias is None else bias.shape[0]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     # (b, sq, h) -> the (b, h, sq, LANES) carrier the kernels read rowwise
     lse4 = lse if lse.ndim == 4 else _expand_rows(lse)
@@ -918,12 +1164,14 @@ def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
     varlen = kv_lens is not None
     extra_specs, extra_args = _tail_operands(
         kv_lens, b, dropout_rate, dropout_seed,
-        lambda t, i, j, h=h: (t // h, 0, 0))
+        lambda t, i, j, h=h: (t // h, 0, 0),
+        bias, lambda t, i, j, hb=hb: (t % hb, i, j), (1, bq, bk))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=varlen,
-                          bshd=True, rate=dropout_rate),
+                          bshd=True, rate=dropout_rate,
+                          has_bias=bias is not None),
         grid=(b * h, nq, nk),
         in_specs=[q_spec(qm), kv_spec(km), kv_spec(km), q_spec(qm),
                   row_spec(rm), row_spec(rm)] + extra_specs,
@@ -946,12 +1194,14 @@ def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
     dkm = lambda t, j, i, h=h: (t // h, j, t % h)  # noqa: E731
     extra_specs2, _ = _tail_operands(
         kv_lens, b, dropout_rate, dropout_seed,
-        lambda t, j, i, h=h: (t // h, 0, 0))
+        lambda t, j, i, h=h: (t // h, 0, 0),
+        bias, lambda t, j, i, hb=hb: (t % hb, i, j), (1, bq, bk))
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, off=sk - sq, varlen=varlen,
-                          bshd=True, rate=dropout_rate),
+                          bshd=True, rate=dropout_rate,
+                          has_bias=bias is not None),
         grid=(b * h, nk, nq),
         in_specs=[q_spec(qm2), kv_spec(km2), kv_spec(km2), q_spec(qm2),
                   row_spec(rm2), row_spec(rm2)] + extra_specs2,
@@ -975,4 +1225,37 @@ def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
         dv = _group_sum(dv, h_kv, group, d, v.dtype)
     dk = dk.reshape(b, sk, h_kv, d)
     dv = dv.reshape(b, sk, h_kv, d)
-    return dq, dk, dv
+    if bias is None:
+        return dq, dk, dv
+    # dbias: batch-innermost grid; global q-head row r = b·hb + th maps to
+    # the folded (b, s, h·d) operands via (r // h, ·, r % h)
+    nb = (b * h) // hb
+    qmap = lambda th, i, j, bi, hb=hb, h=h: (  # noqa: E731
+        (bi * hb + th) // h, i, (bi * hb + th) % h)
+    kmap = lambda th, i, j, bi, hb=hb, h=h, g=group: (  # noqa: E731
+        (bi * hb + th) // h, j, ((bi * hb + th) % h) // g)
+    rmap = lambda th, i, j, bi, hb=hb, h=h: (  # noqa: E731
+        (bi * hb + th) // h, (bi * hb + th) % h, i, 0)
+    db_specs = [
+        pl.BlockSpec((1, bq, d), qmap),
+        pl.BlockSpec((1, bk, d), kmap),
+        pl.BlockSpec((1, bk, d), kmap),
+        pl.BlockSpec((1, bq, d), qmap),
+        pl.BlockSpec((1, 1, bq, _LSE_LANES), rmap),
+        pl.BlockSpec((1, 1, bq, _LSE_LANES), rmap),
+        pl.BlockSpec((1, bq, bk), lambda th, i, j, bi: (th, i, j)),
+    ]
+    db_args = [q3, k3, v3, do3, lse4, delta4, bias]
+    if varlen:
+        db_specs.append(pl.BlockSpec(
+            (1, 1, _LSE_LANES),
+            lambda th, i, j, bi, hb=hb, h=h: ((bi * hb + th) // h, 0, 0)))
+        db_args.append(_kvlen_rows(kv_lens, b))
+    if dropout_rate > 0.0:
+        db_specs.append(_SMEM_SPEC)
+        db_args.append(_seed_operand(dropout_seed))
+    dbias = _dbias_pallas(
+        db_args, db_specs, hb=hb, sq=sq, sk=sk, nq=nq, nk=nk, nb=nb,
+        bq=bq, bk=bk, scale=scale, causal=causal, off=sk - sq,
+        varlen=varlen, bshd=True, rate=dropout_rate, interpret=interpret)
+    return dq, dk, dv, dbias

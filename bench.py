@@ -83,15 +83,16 @@ def build(impl: str, cfg_kwargs, donate: bool):
     return jax.jit(train_step, **jit_kwargs), params, opt_state
 
 
-def timeit(step, params, opt_state, tokens, targets, iters, passes=2,
-           return_spread=False):
-    """Min over ``passes`` timed loops — the remote tunnel adds ±2%
-    transient stalls; min-of-N is applied to BOTH impls so vs_baseline
-    stays symmetric. ``return_spread`` additionally returns
-    (max - min)/min across the passes — the honest per-run noise bar the
-    headline ships with. Donated buffers chain through the pass loop, so
-    one call is safe under donation; do NOT reuse the caller's
-    params/opt_state after it."""
+def timeit(step, params, opt_state, tokens, targets, iters, passes=3,
+           return_passes=False):
+    """Min over ``passes`` timed loops (min-of-3, VERDICT r4 next #7) —
+    the remote tunnel adds transient stalls, and min-of-N is applied to
+    BOTH impls so vs_baseline stays symmetric. ``return_passes``
+    additionally returns the raw per-pass times so the shipped artifact
+    carries its own noise bar (spread = (max-min)/min across passes; a
+    single tunnel stall inflates max but never min). Donated buffers
+    chain through the pass loop, so one call is safe under donation; do
+    NOT reuse the caller's params/opt_state after it."""
     params, opt_state, loss = step(params, opt_state, tokens, targets)  # compile+warm
     float(loss)  # host fetch: the only reliable device sync over the tunnel
     times = []
@@ -102,8 +103,8 @@ def timeit(step, params, opt_state, tokens, targets, iters, passes=2,
         float(loss)  # forces completion of the whole dependent chain
         times.append((time.perf_counter() - t0) / iters)
     best = min(times)
-    if return_spread:
-        return best, (max(times) - best) / best
+    if return_passes:
+        return best, times
     return best
 
 
@@ -145,23 +146,29 @@ def main():
     # both ways; the historical "~5× donation cost through the tunnel" is
     # long gone) and shorter probe loops are noisier than any honest
     # decision margin. Donating is the memory-safer choice (params+opt
-    # state update in place) and its timed passes measure *more* stably
-    # (spread 0.03% vs ~1.2% non-donated in the r4 runs).
+    # state update in place). Noise accounting (VERDICT r4 weak #3): the
+    # HEADLINE is min-of-3 passes; spread_pct = (max-min)/min across the
+    # passes is the per-run noise bar and the raw pass times ship in the
+    # artifact. Through the tunnel a single transient stall can put ~1%
+    # on one pass (BENCH_r04's 1.19%) while back-to-back clean passes
+    # reproduce to ~0.1% — min-of-3 makes the headline insensitive to
+    # which kind of run the driver caught.
     donate = True
 
     results = {}
-    spread = 0.0
+    pass_times = []
     for impl in ("baseline", "fused"):
         os.environ["APEX_TPU_PALLAS"] = "0" if impl == "baseline" else "1"
         step, params, opt_state = build(impl, cfg, donate)
         if impl == "fused":
-            results[impl], spread = timeit(
+            results[impl], pass_times = timeit(
                 step, params, opt_state, tokens, targets, iters,
-                return_spread=True)
+                return_passes=True)
         else:
             results[impl] = timeit(
                 step, params, opt_state, tokens, targets, iters)
         del step, params, opt_state
+    spread = (max(pass_times) - min(pass_times)) / min(pass_times)
 
     if results["baseline"] / results["fused"] > 3.0:
         # a >3x ratio has always been a transient tunnel stall in the
@@ -187,6 +194,7 @@ def main():
         "model_tflops": round(flops_per_s / 1e12, 2),
         "donated": donate,
         "spread_pct": round(spread * 100, 2),
+        "pass_times_ms": [round(t * 1e3, 2) for t in pass_times],
     }))
 
 

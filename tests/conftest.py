@@ -18,7 +18,16 @@ if not os.environ.get("APEX_TPU_TEST_ON_TPU"):
     os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+    # APEX_TPU_VIRTUAL_DEVICES widens the harness for ad-hoc runs (e.g.
+    # 16 to debug a 4-axis composition in-process). The CHECKED-IN 16-wide
+    # gate does not use it: tests/test_full_composition.py spawns
+    # subprocesses that set the device-count XLA flag directly (the env
+    # must be set before jax initializes — a respawn is the only reliable
+    # way mid-suite). Default stays 8: the suite's shapes assume it, and
+    # 16 doubles every collective's cost.
+    n = os.environ.get("APEX_TPU_VIRTUAL_DEVICES", "8")
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}")
 
 import jax  # noqa: E402
 import pytest  # noqa: E402

@@ -242,10 +242,35 @@ class TestRelativePositionBias:
         l2 = m.logits(p, enc, dec2)
         np.testing.assert_allclose(l1[:, :20], l2[:, :20], atol=1e-5)
 
-    def test_relative_rejects_flash(self):
-        with pytest.raises(ValueError, match="relative position bias"):
-            T5Config(**SMALL, position_encoding="relative",
-                     attention_impl="flash")
+    def test_relative_flash_matches_softmax(self):
+        """Relative bias ON the flash path (VERDICT r4 next #1): the
+        (h, s, s) bias feeds the kernels' in-kernel bias operand, dbias
+        flows back through the bucket gather — loss and every gradient
+        (incl. both bucket tables) must match the materialized-softmax
+        composition."""
+        p = EncoderDecoderModel(
+            T5Config(**SMALL, position_encoding="relative")).init(K)
+        enc, dec, tgt = _data(jr.fold_in(K, 36), 1, 2, 32)
+        enc, dec, tgt = enc[0], dec[0], tgt[0]
+        models = {
+            impl: EncoderDecoderModel(
+                T5Config(**SMALL, position_encoding="relative",
+                         attention_impl=impl))
+            for impl in ("softmax", "flash")}
+        with jax.default_matmul_precision("highest"):
+            l_soft, g_soft = jax.value_and_grad(
+                models["softmax"].loss_fn)(p, enc, dec, tgt)
+            l_flash, g_flash = jax.value_and_grad(
+                models["flash"].loss_fn)(p, enc, dec, tgt)
+        np.testing.assert_allclose(float(l_soft), float(l_flash),
+                                   rtol=1e-5)
+        jax.tree_util.tree_map_with_path(
+            lambda path, a, b: np.testing.assert_allclose(
+                a, b, rtol=3e-3, atol=3e-4, err_msg=str(path)),
+            g_soft, g_flash)
+        # the bias is live on the flash path too
+        assert float(jnp.abs(g_flash["rel_bias_enc"]).sum()) > 0
+        assert float(jnp.abs(g_flash["rel_bias_dec"]).sum()) > 0
 
     def test_relative_through_pipeline_matches_serial(self):
         """The split-rank pipeline with relative bias: the per-stack

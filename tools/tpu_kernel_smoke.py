@@ -61,13 +61,21 @@ wqkv = jr.normal(k, (3 * 4 * 128, 512), jnp.bfloat16) * 0.02
 bqkv = jnp.zeros((3 * 4 * 128,), jnp.bfloat16)
 wout = jr.normal(k, (512, 4 * 128), jnp.bfloat16) * 0.02
 check("fused_qkv_attention fwd", lambda x: fused_qkv_attention(
-    x, wqkv, bqkv, wout, None, None, 4, 4, 128, 128 ** -0.5, True), xf)
+    x, wqkv, bqkv, wout, None, None, None, 4, 4, 128, 128 ** -0.5, True),
+    xf)
 check("fused_qkv_attention bwd", jax.grad(lambda x: fused_qkv_attention(
-    x, wqkv, bqkv, wout, None, None, 4, 4, 128, 128 ** -0.5,
+    x, wqkv, bqkv, wout, None, None, None, 4, 4, 128, 128 ** -0.5,
     True).astype(jnp.float32).sum()), xf)
 check("fused_qkv_attention dropout fwd", lambda x: fused_qkv_attention(
-    x, wqkv, bqkv, wout, jnp.int32(7), None, 4, 4, 128, 128 ** -0.5, True,
-    0.1), xf)
+    x, wqkv, bqkv, wout, None, jnp.int32(7), None, 4, 4, 128, 128 ** -0.5,
+    True, 0.1), xf)
+biash = jr.normal(k, (4, 512, 512), jnp.float32) * 0.5
+check("fused_qkv_attention bias bwd", jax.grad(lambda x: fused_qkv_attention(
+    x, wqkv, bqkv, wout, biash, None, None, 4, 4, 128, 128 ** -0.5,
+    True).astype(jnp.float32).sum()), xf)
+check("flash bias bwd", jax.grad(lambda q: flash_attention(
+    q, q, q, causal=True, impl="pallas",
+    bias=biash[:1, :, :]).astype(jnp.float32).sum()), q)
 check("flash dropout bwd", jax.grad(lambda q: flash_attention(
     q, q, q, causal=True, impl="pallas", dropout_rate=0.1,
     dropout_seed=jnp.int32(7)).astype(jnp.float32).sum()), q)
