@@ -42,7 +42,11 @@ PyTree = Any
 class ZeroState:
     count: jax.Array
     layout: mt.ChunkLayout
-    buffers: Dict[str, jax.Array]  # each (n_chunks/dp, chunk) — local shard
+    # each (n_chunks/dp, chunk) fp32 — this rank's local shard. Moments
+    # ("m"/"v") always; plus "master" (sharded fp32 master weights) when
+    # the params are sub-fp32 (bf16/fp16 training — the reference's
+    # mixed-precision DistributedFusedAdam keeps both fp32 and sharded).
+    buffers: Dict[str, jax.Array]
 
 
 class _ZeroOpt(NamedTuple):
@@ -66,38 +70,79 @@ def _local_shard(buf, axis_name):
 
 
 def _make_zero(kernel, state_buffers, *, axis_name, chunk_size, all_gather_dtype):
+    def _uniform_dtype(tree):
+        dts = {x.dtype for x in jax.tree.leaves(tree)}
+        return dts.pop() if len(dts) == 1 else None
+
     def init(params):
+        # flatten_to_chunks upcasts to fp32 (the kernels' MATH_T), so the
+        # m/v state is fp32 regardless of param dtype. Sub-fp32 params
+        # ADDITIONALLY keep a SHARDED fp32 master copy — the reference's
+        # mixed-precision semantics (``distributed_fused_adam.py:9``:
+        # fp32 moments + master weights for fp16 training, both
+        # 1/dp-sharded); without it the fp32 image would be re-derived
+        # from the ROUNDED low-precision params every step. fp32 params
+        # carry no master (it would duplicate the shard) — that path is
+        # bitwise unchanged from the pre-master implementation.
         buf, layout = mt.flatten_to_chunks(params, mt.make_layout(params, chunk_size))
         dp = jax.lax.axis_size(axis_name)
         local = _local_shard(_pad_chunks(buf, dp), axis_name)
+        buffers = {k: jnp.zeros(local.shape, jnp.float32)
+                   for k in state_buffers}
+        if any(x.dtype != jnp.float32 for x in jax.tree.leaves(params)):
+            buffers["master"] = local  # already the fp32 upcast
         return ZeroState(
             count=jnp.zeros((), jnp.int32),
             layout=layout,
-            buffers={k: jnp.zeros_like(local) for k in state_buffers},
+            buffers=buffers,
         )
 
     def update(grads, state, params):
         layout = state.layout
         dp = jax.lax.axis_size(axis_name)
-        gbuf, _ = mt.flatten_to_chunks(grads, layout)
-        pbuf, _ = mt.flatten_to_chunks(params, layout)
-        gbuf, pbuf = _pad_chunks(gbuf, dp), _pad_chunks(pbuf, dp)
+        buffers_in = dict(state.buffers)
+        master = buffers_in.pop("master", None)
+        # flatten grads in their OWN dtype when it is bf16: the
+        # reduce-scatter's wire bytes and staging memory halve, and
+        # bf16's fp32-sized exponent range makes the low-precision sum
+        # safe. fp16 (tiny exponent range — loss-scaled grads near 65504
+        # would overflow a dp-way sum) and mixed/other dtypes keep the
+        # fp32 mega-buffer, the pre-r5 behavior. The update math below
+        # is fp32 either way.
+        gdt = _uniform_dtype(grads)
+        if gdt != jnp.bfloat16:
+            gdt = jnp.float32
+        gbuf, _ = mt.flatten_to_chunks(grads, layout, dtype=gdt)
+        gbuf = _pad_chunks(gbuf, dp)
 
         # 1. reduce-scatter: mean gradient, sharded by chunk rows
         g_local = jax.lax.psum_scatter(
             gbuf, axis_name, scatter_dimension=0, tiled=True
-        ) / dp
-        p_local = _local_shard(pbuf, axis_name)
+        ).astype(jnp.float32) / dp
+        if master is not None:
+            # the persistent fp32 masters ARE the params; the replicated
+            # low-precision tree never flattens (saves a full fp32
+            # mega-buffer per step)
+            p_local = master
+        else:
+            pbuf, _ = mt.flatten_to_chunks(params, layout)
+            p_local = _local_shard(_pad_chunks(pbuf, dp), axis_name)
 
-        # 2. fused update on the local shard
+        # 2. fused update on the local fp32 shard
         count = state.count + 1
         new_p_local, new_buffers = kernel(
-            g_local, p_local, state.buffers, count, layout, axis_name
+            g_local, p_local, buffers_in, count, layout, axis_name
         )
+        if master is not None:
+            new_buffers = dict(new_buffers, master=new_p_local)
 
         # 3. all-gather updated shards (optionally reduced precision, the
-        # e5m2_allgather analog)
-        send = new_p_local.astype(all_gather_dtype) if all_gather_dtype else new_p_local
+        # e5m2_allgather analog). With fp32 masters the gather defaults to
+        # the PARAM dtype — params are the low-precision image of the
+        # sharded masters, and the wire carries param-width bytes.
+        gather_dt = all_gather_dtype or (
+            _uniform_dtype(params) if master is not None else None)
+        send = new_p_local.astype(gather_dt) if gather_dt else new_p_local
         full = jax.lax.all_gather(send, axis_name, axis=0, tiled=True)
         full = full.astype(jnp.float32)[: gbuf.shape[0]]
 

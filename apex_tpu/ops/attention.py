@@ -398,6 +398,15 @@ def fused_qkv_attention(x, w_qkv, b_qkv, w_out, bias, dropout_seed,
 def _fused_attn_fwd(x, w_qkv, b_qkv, w_out, bias, dropout_seed, kv_lens, h,
                     h_kv, d, scale, causal, dropout_rate=0.0):
     b, s, H = x.shape
+    if bias is not None:
+        # same contract flash_attention enforces: a non-dividing hb would
+        # pair heads with bias rows inconsistently across batches (the
+        # kernels' t % hb map) and the dbias grid would silently drop rows
+        if (bias.ndim != 3 or bias.shape[1:] != (s, s)
+                or h % bias.shape[0]):
+            raise ValueError(
+                f"bias must be (hb, {s}, {s}) with hb dividing h ({h}); "
+                f"got {bias.shape}")
     qkv = (jnp.dot(x.reshape(-1, H), w_qkv.T) + b_qkv).reshape(b, s, -1)
     # full_lse: keep the (b, h, s, LANES) lane carrier as the residual —
     # backward hands it straight back to the kernel (slicing lane 0 here

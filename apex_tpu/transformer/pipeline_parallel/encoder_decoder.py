@@ -55,12 +55,21 @@ def pipeline_spmd_forward_enc_dec(
     axis_name: str = mesh_lib.PIPELINE_AXIS,
     remat: bool = True,
     broadcast_outputs: bool = True,
+    mb_index: bool = False,
 ):
     """Forward of the two-segment pipeline. ``enc_fn(params, h)`` runs on
     stages [0, split); ``dec_fn(params, h, enc_ctx)`` on [split, pp).
     ``enc_microbatches``/``dec_microbatches``: (M, ...) embedded inputs for
     the two segments (same trailing shape). Returns the decoder outputs per
-    microbatch (masked to pp rank 0 unless ``broadcast_outputs``)."""
+    microbatch (masked to pp rank 0 unless ``broadcast_outputs``).
+
+    ``mb_index=True`` changes the stage-fn signatures to
+    ``enc_fn(params, h, m)`` / ``dec_fn(params, h, ctx, m)`` where ``m``
+    is the (traced, clipped) index of the microbatch this stage processes
+    on this tick — what per-microbatch side inputs (e.g. encoder padding
+    lengths) index by. On stage r at tick t the resident microbatch is
+    ``t - r`` (one hop per tick), clipped to [0, M) during fill/drain
+    where the compute is discarded anyway."""
     S = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     if split_rank is None:
@@ -73,13 +82,23 @@ def pipeline_spmd_forward_enc_dec(
     mb_shape = enc_microbatches.shape[1:]
     T = M + S - 1
 
-    def stage(params, h, ctx):
-        return jax.lax.cond(
-            rank < split_rank,
-            lambda p, h_, c_: enc_fn(p, h_),
-            lambda p, h_, c_: dec_fn(p, h_, c_),
-            params, h, ctx,
-        )
+    if mb_index:
+        def stage(params, h, ctx, m):
+            return jax.lax.cond(
+                rank < split_rank,
+                lambda p, h_, c_, m_: enc_fn(p, h_, m_),
+                lambda p, h_, c_, m_: dec_fn(p, h_, c_, m_),
+                params, h, ctx, m,
+            )
+    else:
+        def stage(params, h, ctx, m):
+            del m
+            return jax.lax.cond(
+                rank < split_rank,
+                lambda p, h_, c_: enc_fn(p, h_),
+                lambda p, h_, c_: dec_fn(p, h_, c_),
+                params, h, ctx,
+            )
 
     fn = jax.checkpoint(stage) if remat else stage
     perm = [(i, (i + 1) % S) for i in range(S)]
@@ -101,7 +120,10 @@ def pipeline_spmd_forward_enc_dec(
         ctx = jnp.where(at_split, h, ctx)
         h = jnp.where(at_split, dec_in, h)
 
-        y = fn(stage_params, h, ctx)
+        # the microbatch resident on this stage this tick (fill/drain
+        # ticks clip to a valid index; their compute is discarded)
+        m_here = jnp.clip(t - rank, 0, M - 1)
+        y = fn(stage_params, h, ctx, m_here)
         # the context travels with its microbatch through decoder stages
         h_next, ctx_next = jax.tree.map(
             lambda x: jax.lax.ppermute(x, axis_name, perm), (y, ctx))

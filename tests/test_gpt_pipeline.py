@@ -573,3 +573,229 @@ class TestContextParallelFlagship:
         assert float(l1) != float(l2)
         for leaf in jax.tree.leaves(g1):
             assert bool(jnp.all(jnp.isfinite(leaf)))
+
+
+class TestScheduleFeatureMatrix:
+    """schedule ∈ {1F1B (v=1), interleaved (v=2)} × feature ∈ {cp-ring,
+    ep, dropout, ZeRO}, each cell oracle-checked at toy shape (VERDICT r4
+    next #6). The named risk is ring-in-interleaved: the cp ring's
+    rotating KV state composed with the v-chunk rotation is exactly the
+    index arithmetic that breaks silently — here it must reproduce the
+    serial model's loss and gradients.
+
+    Strip/restore of the stage leaves differs per schedule ((pp, ...) at
+    v=1, (v, pp, ...) at v=2) — one helper pair so every cell exercises
+    the same plumbing."""
+
+    @staticmethod
+    def _strip(p, v):
+        sel = (lambda x: x[:, 0]) if v > 1 else (lambda x: x[0])
+        return dict(p, stages=jax.tree.map(sel, p["stages"]))
+
+    @staticmethod
+    def _restore_stages(g, v):
+        exp = (lambda x: x[:, None]) if v > 1 else (lambda x: x[None])
+        g["stages"] = jax.tree.map(exp, g["stages"])
+        return g
+
+    @pytest.mark.parametrize("v", [1, 2])
+    def test_cp_ring(self, v):
+        """Ring attention inside the (interleaved) pipeline: dp x pp x cp
+        with zigzag-sharded sequence; loss + grads == serial oracle."""
+        from apex_tpu.ops.attention import zigzag_shard
+
+        kw = dict(vocab_size=64, max_seq_len=64, hidden_size=32,
+                  num_layers=2 * v, num_heads=4, attention_impl="flash")
+        cfg1 = GPTConfig(**kw)
+        cfg = GPTConfig(**kw, cp_axis="cp")
+        m = GPTModel(cfg)
+        params = GPTModel(cfg1).init(jr.fold_in(K, 150 + v))
+        pipe = GPTPipeline(m, pp=2, virtual_chunks=v)
+        part = pipe.partition(params)
+        specs = pipe.param_specs(part)
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=2,
+                                  context_parallel_size=2)  # dp=2
+        M, b, s, dp = 2, 2, 64, 2
+        toks = jr.randint(jr.fold_in(K, 152), (M, b * dp, s), 0, 64)
+        tgts = jr.randint(jr.fold_in(K, 153), (M, b * dp, s), 0, 64)
+        toks_sh = zigzag_shard(toks, 2, 2)
+        tgts_sh = zigzag_shard(tgts, 2, 2)
+
+        def run(p, t, g):
+            loss, grads = pipe.loss_and_grads(
+                self._strip(p, v), t, g, dp_axis=("dp", "cp"))
+            return loss, self._restore_stages(grads, v)
+
+        with jax.default_matmul_precision("highest"):
+            loss, grads = jax.jit(mesh_lib.shard_map(
+                run, mesh=mesh,
+                in_specs=(specs, P(None, "dp", "cp"), P(None, "dp", "cp")),
+                out_specs=(P(), specs),
+            ))(part, toks_sh, tgts_sh)
+
+            def ref_fn(p):
+                per = [GPTModel(cfg1).loss_fn(
+                    p, toks[i, r * b:(r + 1) * b],
+                    tgts[i, r * b:(r + 1) * b])
+                    for r in range(dp) for i in range(M)]
+                return jnp.mean(jnp.stack(per))
+
+            ref_loss, ref_g = jax.value_and_grad(ref_fn)(params)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+        got = pipe.unpartition(grads)
+        for (pa, a), (_, e) in zip(
+                jax.tree_util.tree_leaves_with_path(got),
+                jax.tree_util.tree_leaves_with_path(ref_g)):
+            np.testing.assert_allclose(a, e, rtol=5e-4, atol=2e-5,
+                                       err_msg=jax.tree_util.keystr(pa))
+
+    @pytest.mark.parametrize("v", [1, 2])
+    def test_ep_moe(self, v):
+        """MoE expert banks over ep inside the (interleaved) pipeline."""
+        kw = dict(vocab_size=64, max_seq_len=16, hidden_size=32,
+                  num_layers=2 * v, num_heads=4, attention_impl="flash",
+                  moe_num_experts=4, moe_top_k=2, moe_capacity_factor=2.0)
+        cfg1 = GPTConfig(**kw)
+        cfg = GPTConfig(**kw, ep_axis="ep")
+        m = GPTModel(cfg)
+        params = GPTModel(cfg1).init(jr.fold_in(K, 160 + v))
+        pipe = GPTPipeline(m, pp=2, virtual_chunks=v)
+        part = pipe.partition(params)
+        specs = pipe.param_specs(part)
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=2,
+                                  expert_parallel_size=2)  # dp=2
+        M, b, s, shards = 2, 2, 16, 4
+        toks = jr.randint(jr.fold_in(K, 162), (M, b * shards, s), 0, 64)
+        tgts = jr.randint(jr.fold_in(K, 163), (M, b * shards, s), 0, 64)
+
+        def run(p, t, g):
+            loss, grads = pipe.loss_and_grads(
+                self._strip(p, v), t, g, dp_axis="dp")
+            return loss, self._restore_stages(grads, v)
+
+        with jax.default_matmul_precision("highest"):
+            loss, grads = jax.jit(mesh_lib.shard_map(
+                run, mesh=mesh,
+                in_specs=(specs, P(None, ("dp", "ep")),
+                          P(None, ("dp", "ep"))),
+                out_specs=(P(), specs),
+            ))(part, toks, tgts)
+
+            def ref_fn(p):
+                per = [GPTModel(cfg1).loss_fn(
+                    p, toks[i, r * b:(r + 1) * b],
+                    tgts[i, r * b:(r + 1) * b])
+                    for r in range(shards) for i in range(M)]
+                return jnp.mean(jnp.stack(per))
+
+            ref_loss, ref_g = jax.value_and_grad(ref_fn)(params)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+        got = pipe.unpartition(grads)
+        np.testing.assert_allclose(got["layers"]["moe"]["w1"],
+                                   ref_g["layers"]["moe"]["w1"],
+                                   rtol=5e-4, atol=2e-5)
+        np.testing.assert_allclose(got["layers"]["moe"]["router"],
+                                   ref_g["layers"]["moe"]["router"],
+                                   rtol=5e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("v", [1, 2])
+    def test_dropout(self, v):
+        """Dropout masks under both schedules: finite, deterministic per
+        key, varying across keys (no oracle exists — masks are
+        schedule-keyed by design)."""
+        kw = dict(SMALL, num_layers=4 * v, dropout=0.3)
+        model = GPTModel(GPTConfig(**kw))
+        pipe = GPTPipeline(model, pp=2, virtual_chunks=v)
+        params = model.init(jr.fold_in(K, 170 + v))
+        part = pipe.partition(params)
+        specs = pipe.param_specs(part)
+        toks, tgts = _tokens(jr.fold_in(K, 172), 4, 4, 16, 64)
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=2)
+
+        def run(p, t, g, key):
+            loss, _ = pipe.loss_and_grads(self._strip(p, v), t, g,
+                                          key=key, dp_axis="dp")
+            return loss
+
+        f = jax.jit(mesh_lib.shard_map(
+            run, mesh=mesh,
+            in_specs=(specs, P(None, "dp"), P(None, "dp"), P()),
+            out_specs=P()))
+        l1 = f(part, toks, tgts, jr.PRNGKey(8))
+        l1b = f(part, toks, tgts, jr.PRNGKey(8))
+        l2 = f(part, toks, tgts, jr.PRNGKey(9))
+        assert jnp.isfinite(l1) and jnp.isfinite(l2)
+        assert float(l1) == float(l1b)  # deterministic per key
+        assert float(l1) != float(l2)  # masks vary with the key
+
+    @pytest.mark.parametrize("v", [1, 2])
+    def test_zero(self, v):
+        """dp-sharded optimizer state (ZeRO) updating the pipeline-layout
+        params under both schedules: 4-step trajectory == unsharded fused
+        Adam on the serial model."""
+        import optax
+
+        from apex_tpu.contrib.optimizers import distributed_fused_adam
+        from apex_tpu.optimizers import fused_adam
+
+        kw = dict(vocab_size=64, max_seq_len=16, hidden_size=32,
+                  num_layers=2 * v, num_heads=4, attention_impl="flash")
+        cfg1 = GPTConfig(**kw)
+        m = GPTModel(cfg1)
+        params1 = m.init(jr.fold_in(K, 180 + v))
+        pipe = GPTPipeline(m, pp=2, virtual_chunks=v)
+        part = pipe.partition(params1)
+        specs = pipe.param_specs(part)
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=2)  # dp=4
+        opt = distributed_fused_adam(learning_rate=1e-2)
+        M, b, s, dp = 2, 2, 16, 4
+        batches = [
+            (jr.randint(jr.fold_in(K, 182 + 10 * i), (M, b * dp, s), 0, 64),
+             jr.randint(jr.fold_in(K, 183 + 10 * i), (M, b * dp, s), 0, 64))
+            for i in range(4)]
+
+        st = mesh_lib.shard_map(
+            lambda p: opt.init(self._strip(p, v)), mesh=mesh,
+            in_specs=(specs,), out_specs=P())(part)
+
+        @jax.jit
+        def step(p, st, t, g):
+            def run(p, t, g, st):
+                lp = self._strip(p, v)
+                loss, grads = pipe.loss_and_grads(lp, t, g, dp_axis="dp")
+                u, st = opt.update(grads, st, lp)
+                newp = optax.apply_updates(lp, u)
+                return self._restore_stages(dict(newp), v), st, loss
+
+            return mesh_lib.shard_map(
+                run, mesh=mesh,
+                in_specs=(specs, P(None, "dp"), P(None, "dp"), P()),
+                out_specs=(specs, P(), P()),
+            )(p, t, g, st)
+
+        losses = []
+        with jax.default_matmul_precision("highest"):
+            for t, g in batches:
+                part, st, loss = step(part, st, t, g)
+                losses.append(float(loss))
+
+            opt1 = fused_adam(learning_rate=1e-2)
+            st1 = opt1.init(params1)
+            ref = []
+
+            @jax.jit
+            def ostep(p, st, toks, tgts):
+                def f(p_):
+                    per = [m.loss_fn(p_, toks[i, r * b:(r + 1) * b],
+                                     tgts[i, r * b:(r + 1) * b])
+                           for r in range(dp) for i in range(M)]
+                    return jnp.mean(jnp.stack(per))
+                loss, g_ = jax.value_and_grad(f)(p)
+                u, st = opt1.update(g_, st, p)
+                return optax.apply_updates(p, u), st, loss
+
+            p1 = params1
+            for t, g in batches:
+                p1, st1, loss = ostep(p1, st1, t, g)
+                ref.append(float(loss))
+        np.testing.assert_allclose(losses, ref, rtol=5e-4, atol=1e-5)

@@ -373,3 +373,125 @@ class TestRematPolicies:
         for a, e in zip(jax.tree.leaves(outs["encode_only"][1]),
                         jax.tree.leaves(outs["blocks"][1])):
             np.testing.assert_allclose(a, e, rtol=1e-5, atol=1e-7)
+
+
+class TestEncoderPadding:
+    """enc_pad_lens through the enc-dec stack (VERDICT r4 next #4; the
+    reference's key_padding_mask, encdec_multihead_attn.py:106-119):
+    encoder self-attention and decoder cross-attention mask padded
+    encoder KEY positions, on the flash fast path via kv_lens."""
+
+    def _padded_vs_unpadded(self, impl):
+        """Padded batch == mean of per-row unpadded runs: the defining
+        property — padding must be invisible to valid positions."""
+        cfg = T5Config(**SMALL, attention_impl=impl)
+        m = EncoderDecoderModel(cfg)
+        p = m.init(K)
+        s = 32
+        lens = [32, 20]
+        enc_rows = [jr.randint(jr.fold_in(K, 40 + i), (1, L), 0, 64)
+                    for i, L in enumerate(lens)]
+        dec = jr.randint(jr.fold_in(K, 50), (2, s), 0, 64)
+        tgt = jr.randint(jr.fold_in(K, 51), (2, s), 0, 64)
+
+        # padded batch: rows padded to s with garbage tokens
+        pad_tok = 63
+        enc_pad = jnp.full((2, s), pad_tok, jnp.int32)
+        for i, row in enumerate(enc_rows):
+            enc_pad = enc_pad.at[i, :lens[i]].set(row[0])
+
+        with jax.default_matmul_precision("highest"):
+            got = m.loss_fn(p, enc_pad, dec, tgt,
+                            enc_pad_lens=jnp.array(lens, jnp.int32))
+            per_row = [
+                m.loss_fn(p, enc_rows[i], dec[i:i + 1], tgt[i:i + 1])
+                for i in range(2)
+            ]
+            ref = jnp.mean(jnp.stack(per_row))
+        np.testing.assert_allclose(float(got), float(ref), rtol=2e-5)
+
+        # padding must actually matter: without lens the garbage leaks
+        leak = m.loss_fn(p, enc_pad, dec, tgt)
+        assert abs(float(leak) - float(ref)) > 1e-4
+
+    def test_padded_matches_unpadded_softmax(self):
+        self._padded_vs_unpadded("softmax")
+
+    def test_padded_matches_unpadded_flash(self):
+        self._padded_vs_unpadded("flash")
+
+    def test_flash_matches_softmax_padded_grads(self):
+        """Both impls agree on a padded batch, through every gradient."""
+        p = EncoderDecoderModel(T5Config(**SMALL)).init(K)
+        enc = jr.randint(jr.fold_in(K, 60), (2, 32), 0, 64)
+        dec = jr.randint(jr.fold_in(K, 61), (2, 32), 0, 64)
+        tgt = jr.randint(jr.fold_in(K, 62), (2, 32), 0, 64)
+        lens = jnp.array([32, 12], jnp.int32)
+        out = {}
+        for impl in ("softmax", "flash"):
+            m = EncoderDecoderModel(T5Config(**SMALL, attention_impl=impl))
+            with jax.default_matmul_precision("highest"):
+                out[impl] = jax.value_and_grad(m.loss_fn)(
+                    p, enc, dec, tgt, enc_pad_lens=lens)
+        np.testing.assert_allclose(float(out["softmax"][0]),
+                                   float(out["flash"][0]), rtol=1e-5)
+        jax.tree_util.tree_map_with_path(
+            lambda path, a, b: np.testing.assert_allclose(
+                a, b, rtol=3e-3, atol=3e-4, err_msg=str(path)),
+            out["softmax"][1], out["flash"][1])
+
+    def test_padding_composes_with_relative_bias(self):
+        """kv_lens + in-kernel bias together on the flash path."""
+        cfgs = {impl: T5Config(**SMALL, position_encoding="relative",
+                               attention_impl=impl)
+                for impl in ("softmax", "flash")}
+        p = EncoderDecoderModel(cfgs["softmax"]).init(K)
+        enc = jr.randint(jr.fold_in(K, 63), (2, 32), 0, 64)
+        dec = jr.randint(jr.fold_in(K, 64), (2, 32), 0, 64)
+        tgt = jr.randint(jr.fold_in(K, 65), (2, 32), 0, 64)
+        lens = jnp.array([28, 16], jnp.int32)
+        with jax.default_matmul_precision("highest"):
+            losses = {
+                impl: float(EncoderDecoderModel(cfg).loss_fn(
+                    p, enc, dec, tgt, enc_pad_lens=lens))
+                for impl, cfg in cfgs.items()}
+        np.testing.assert_allclose(losses["softmax"], losses["flash"],
+                                   rtol=1e-5)
+
+    def test_pipeline_matches_serial_padded(self):
+        """The split-rank pipeline with (M, b) per-microbatch lens ==
+        the serial model row by row (loss + embed grads)."""
+        cfg = T5Config(**SMALL)
+        m = EncoderDecoderModel(cfg)
+        params = m.init(K)
+        pipe = EncDecPipeline(m, pp=2, split=1)
+        part = pipe.partition(params)
+        specs = pipe.param_specs(part)
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=2)
+        M, b, s = 2, 2, 32
+        enc, dec, tgt = _data(jr.fold_in(K, 70), M, b, s)
+        lens = jr.randint(jr.fold_in(K, 71), (M, b), 8, s + 1)
+
+        def run(p, e, d, t, pl):
+            lp = dict(p, stages=jax.tree.map(lambda x: x[0], p["stages"]))
+            loss, g = pipe.loss_and_grads(lp, e, d, t, enc_pad_lens=pl)
+            g["stages"] = jax.tree.map(lambda x: x[None], g["stages"])
+            return loss, g
+
+        with jax.default_matmul_precision("highest"):
+            loss, grads = jax.jit(mesh_lib.shard_map(
+                run, mesh=mesh, in_specs=(specs, P(), P(), P(), P()),
+                out_specs=(P(), specs),
+            ))(part, enc, dec, tgt, lens)
+
+            def serial(p):
+                return jnp.mean(jnp.stack([
+                    m.loss_fn(p, enc[i], dec[i], tgt[i],
+                              enc_pad_lens=lens[i])
+                    for i in range(M)]))
+
+            ref, ref_g = jax.value_and_grad(serial)(params)
+        np.testing.assert_allclose(float(loss), float(ref), rtol=2e-5)
+        np.testing.assert_allclose(grads["embed"]["embedding"],
+                                   ref_g["embedding"], rtol=3e-4,
+                                   atol=1e-6)

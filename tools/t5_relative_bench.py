@@ -1,0 +1,55 @@
+"""T5 relative-bias long-sequence A/B on one chip (PERF.md "T5 relative
+bias on flash (r5)"): flash (in-kernel bias operand) vs softmax
+(materialized (b,h,s,s) scores) at s=2048, T5-base-class shape.
+
+Usage: python tools/t5_relative_bench.py [impl] [batch] [seq]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import optax
+
+from apex_tpu.models import EncoderDecoderModel, T5Config
+
+impl = sys.argv[1] if len(sys.argv) > 1 else "flash"
+batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+seq = int(sys.argv[3]) if len(sys.argv) > 3 else 2048
+
+cfg = T5Config(vocab_size=32128, max_seq_len=seq, hidden_size=768,
+               ffn_hidden_size=3072, num_encoder_layers=12,
+               num_decoder_layers=12, num_heads=6, dtype=jnp.bfloat16,
+               attention_impl=impl, position_encoding="relative",
+               remat=True, remat_policy="blocks")
+m = EncoderDecoderModel(cfg)
+params = m.init(jr.PRNGKey(0))
+opt = optax.adam(1e-4)
+
+enc = jr.randint(jr.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
+dec = jr.randint(jr.PRNGKey(2), (batch, seq), 0, cfg.vocab_size)
+tgt = jr.randint(jr.PRNGKey(3), (batch, seq), 0, cfg.vocab_size)
+
+
+@jax.jit
+def step(params, opt_state):
+    loss, g = jax.value_and_grad(m.loss_fn)(params, enc, dec, tgt)
+    u, opt_state = opt.update(g, opt_state)
+    return optax.apply_updates(params, u), opt_state, loss
+
+
+opt_state = opt.init(params)
+params, opt_state, loss = step(params, opt_state)
+print("warm loss", float(loss))
+iters = 5
+times = []
+for _ in range(2):
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state)
+    float(loss)
+    times.append((time.perf_counter() - t0) / iters)
+ms = min(times) * 1e3
+print(f"impl={impl} b={batch} s={seq}: {ms:.1f} ms/step, "
+      f"{batch * seq / min(times):.0f} dec tok/s")
