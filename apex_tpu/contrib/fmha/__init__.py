@@ -2,14 +2,74 @@
 
 Re-design of ``apex.contrib.fmha`` (``apex/contrib/fmha/fmha.py:33-76``).
 The reference dispatches per-seqlen CUDA kernels valid only for fp16,
-seq ∈ {128,256,384,512}, head_dim 64 on SM80; here it is simply the
-blockwise flash kernel with none of those caps. The packed
-(total_tokens, ...) varlen interface is emulated by segment masking.
+seq ∈ {128,256,384,512}, head_dim 64 on SM80; here it is the blockwise
+flash kernel with none of those caps. Both reference surfaces exist:
+
+- :func:`fmha_varlen` — the REAL reference interface: token-packed
+  ``(total_tokens, 3, heads, head_dim)`` qkv with ``cu_seqlens``
+  boundaries (BERT-style unpadded batching, ``fmha.py:35``) and
+  in-kernel probs dropout (``p_dropout``). Internally the pack is
+  scattered to the seq-major padded layout whose per-batch ``kv_lens``
+  the kernels mask and block-skip natively, then gathered back — the
+  scatter/gather is O(total·h·d) elementwise against the kernel's
+  O(total·s) attention work.
+- :func:`fmha` — the padded ``(batch, seq, 3, heads, head_dim)`` layout
+  (no cu_seqlens needed when rows are equal length).
 """
 
+from typing import Optional
+
+import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.attention import flash_attention, seed_from_key
+
+
+def _unpack_indices(cu_seqlens, total):
+    """(segment id, within-segment position) for each packed token."""
+    tok = jnp.arange(total, dtype=jnp.int32)
+    seg = jnp.searchsorted(cu_seqlens[1:], tok, side="right").astype(jnp.int32)
+    pos = tok - cu_seqlens[seg]
+    return seg, pos
+
+
+def fmha_varlen(qkv, cu_seqlens, max_s: int, p_dropout: float = 0.0,
+                is_training: bool = True, causal: bool = False,
+                key: Optional[jax.Array] = None):
+    """``FMHAFun.apply(qkv, cu_seqlens, p_dropout, max_s, is_training)``
+    (``fmha.py:35-46``): qkv ``(total_tokens, 3, h, d)`` packed over
+    variable-length batch rows, ``cu_seqlens`` ``(batch+1,)`` int32
+    cumulative row boundaries (row r holds tokens
+    ``[cu_seqlens[r], cu_seqlens[r+1])``), ``max_s`` the static pad
+    length. Returns ``(total_tokens, h, d)``.
+
+    Dropout (``p_dropout`` > 0 with ``is_training`` and a PRNG ``key``)
+    is the in-kernel counter-hash probs dropout. Attention is per-row:
+    tokens never attend across ``cu_seqlens`` boundaries (the kernels'
+    per-batch ``kv_lens`` masking after scattering to the padded
+    layout)."""
+    total, three, h, d = qkv.shape
+    if three != 3:
+        raise ValueError(f"qkv must be (total, 3, h, d); got {qkv.shape}")
+    b = cu_seqlens.shape[0] - 1
+    cu_seqlens = cu_seqlens.astype(jnp.int32)
+    seg, pos = _unpack_indices(cu_seqlens, total)
+    padded = jnp.zeros((b, max_s, 3, h, d), qkv.dtype).at[seg, pos].set(qkv)
+    lens = jnp.diff(cu_seqlens)
+    rate = float(p_dropout) if is_training else 0.0
+    seed = None
+    if rate > 0:
+        if key is None:
+            raise ValueError("p_dropout > 0 with is_training needs a PRNG "
+                             "key")
+        seed = seed_from_key(key)
+    else:
+        rate = 0.0
+    o = flash_attention(
+        padded[:, :, 0], padded[:, :, 1], padded[:, :, 2],
+        causal=causal, layout="bshd", kv_lens=lens,
+        dropout_rate=rate, dropout_seed=seed)
+    return o[seg, pos]
 
 
 class FMHAFun:
@@ -17,8 +77,9 @@ class FMHAFun:
 
     @staticmethod
     def apply(qkv, causal=False):
-        """qkv: (batch, seq, 3, heads, head_dim) — the reference's packed
-        layout (fmha.py:60-76)."""
+        """qkv: (batch, seq, 3, heads, head_dim) — the equal-length padded
+        layout (``fmha.py:60-76``); varlen batches use
+        :func:`fmha_varlen`."""
         q = qkv[:, :, 0].transpose(0, 2, 1, 3)
         k = qkv[:, :, 1].transpose(0, 2, 1, 3)
         v = qkv[:, :, 2].transpose(0, 2, 1, 3)
@@ -33,10 +94,20 @@ def fmha(qkv, causal: bool = False):
 class FMHA:
     """Module-shape parity with the reference's ``FMHA`` wrapper
     (``apex/contrib/fmha/fmha.py:60-76``) — minus its seq<=512 / fp16 /
-    SM80 restrictions, which the flash kernel does not have."""
+    SM80 restrictions, which the flash kernel does not have. Takes the
+    packed varlen layout like the reference module: ``(total, 3·h·d)``
+    flat or ``(total, 3, h, d)``."""
 
-    def __init__(self, causal: bool = False):
+    def __init__(self, num_heads: int, head_dim: int, p_dropout: float = 0.0,
+                 causal: bool = False):
+        self.h, self.d = num_heads, head_dim
+        self.p_dropout = p_dropout
         self.causal = causal
 
-    def __call__(self, qkv):
-        return FMHAFun.apply(qkv, self.causal)
+    def __call__(self, qkv, cu_seqlens, max_s: int, is_training: bool = True,
+                 key: Optional[jax.Array] = None):
+        total = qkv.shape[0]
+        o = fmha_varlen(qkv.reshape(total, 3, self.h, self.d), cu_seqlens,
+                        max_s, self.p_dropout, is_training,
+                        causal=self.causal, key=key)
+        return o.reshape(total, self.h * self.d)

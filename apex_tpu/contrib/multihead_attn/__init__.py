@@ -4,10 +4,16 @@ Re-design of ``apex.contrib.multihead_attn``
 (``apex/contrib/multihead_attn/self_multihead_attn.py:27``,
 ``encdec_multihead_attn.py``): self- and encoder-decoder attention with
 optional fused pre-LayerNorm + residual-add (the reference's
-``include_norm_add`` variants) and optional biases. The fused CUDA/CUTLASS
-cores become one call into the blockwise flash kernel; the
-``fast_mask_softmax_dropout`` path corresponds to the fused softmax +
-explicit-key dropout here.
+``include_norm_add`` variants), optional biases, additive attention masks
+and key-padding masks. Everything — including probs dropout and both mask
+families — runs through the blockwise flash kernel: dropout is the
+kernel's in-kernel counter-hash dropout, the additive ``attn_mask`` is the
+kernel's fused score-bias operand, and ``key_padding_mask`` rides the same
+operand per batch (the ``pad_lens`` form keeps the O(rows) varlen fast
+path). The reference needs four CUDA variants for this matrix
+(``fast_self_multihead_attn{,_bias,_mask,_bias_additive_mask}``,
+``self_multihead_attn.py:36-88``); here it is one kernel family with
+optional operands.
 """
 
 from __future__ import annotations
@@ -18,9 +24,14 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.amp.lists import apply_op_rules
 from apex_tpu.ops import fused_layer_norm
-from apex_tpu.ops.attention import flash_attention, masked_scores
+from apex_tpu.ops.attention import flash_attention, seed_from_key
+
+# Additive mask value for excluded keys. Finite (not -inf) so a row whose
+# keys are ALL padded yields a uniform-softmax output instead of NaN —
+# such rows are meaningless either way (the reference NaNs there), but
+# finite outputs keep grad pipelines alive when users mask sloppily.
+_MASKED = -1e9
 
 
 def _linear_init(key, shape, dtype):
@@ -28,26 +39,84 @@ def _linear_init(key, shape, dtype):
     return jax.random.uniform(key, shape, dtype, -bound, bound)
 
 
-def _dropout(x, rate, key):
-    if rate <= 0 or key is None:
-        return x
-    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
-    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+def _norm_attn_mask(attn_mask, h, sq, sk):
+    """Additive attn_mask → the kernel's (hb, sq, sk) bias operand.
+    Accepts (sq, sk) shared over batch+heads or (hb, sq, sk) with hb | h
+    per-head (broadcast over batch)."""
+    if attn_mask.ndim == 2:
+        attn_mask = attn_mask[None]
+    if attn_mask.ndim != 3 or attn_mask.shape[1:] != (sq, sk) or h % attn_mask.shape[0]:
+        raise ValueError(
+            f"attn_mask must be (sq, sk) or (hb, sq, sk) with hb | heads; "
+            f"got {attn_mask.shape} for h={h}, sq={sq}, sk={sk}")
+    return attn_mask
 
 
-def _attention(q, k, v, *, causal, rate, key):
-    """Attention core. Without dropout (or at eval) this is the flash
-    kernel; with probs dropout it is the reference's
-    ``fast_mask_softmax_dropout`` semantics (dropout ON the attention
-    weights, ``mask_softmax_dropout_func.py``) over materialized probs —
-    the flash recurrence cannot drop individual weights."""
-    if rate <= 0 or key is None:
-        return flash_attention(q, k, v, causal=causal)
-    q, k, v = apply_op_rules("attention", q, k, v)
-    s = masked_scores(q, k, 1.0 / q.shape[-1] ** 0.5, causal)
-    probs = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    probs = _dropout(probs, rate, key)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+def _attention(q, k, v, *, causal, rate, key, attn_mask=None,
+               key_padding_mask=None, pad_lens=None):
+    """Attention core over (b, s, h, d) operands — ONE call into the flash
+    family for the whole option matrix:
+
+    - probs dropout (``rate`` > 0 with a PRNG ``key``) is IN-KERNEL
+      (the reference's fused ``fast_mask_softmax_dropout``); the softmax
+      normalizer is pre-dropout, so E[output] = no-dropout output.
+    - ``attn_mask``: ADDITIVE (sq, sk) or (hb, sq, sk) score mask →
+      the kernel's fused bias operand (``self_multihead_attn.py:144-198``
+      additive-mask variants).
+    - ``pad_lens`` (b,) int32 valid-key lengths: the varlen fast path —
+      O(b) metadata, masked KV blocks skipped in-kernel. The form padded
+      batches should use.
+    - ``key_padding_mask`` (b, sk) bool/int, nonzero = EXCLUDE (the
+      reference's ByteTensor convention): arbitrary per-batch patterns.
+      Rides the bias operand with batch-major bias rows: operands are
+      flattened HEAD-major (h, b, s, d) so bias row ``t % b`` selects the
+      batch — the kernel's modulo row-sharing, unchanged, gives per-batch
+      masks. Costs a materialized (b, sq, sk) fp32 mask (the same memory
+      class as the reference's (b, 1, sq, sk) mask tensor,
+      ``csrc/megatron/scaled_masked_softmax.cpp:85-94``) and two head
+      transposes; prefer ``pad_lens`` when padding is a suffix.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    rate = float(rate)
+    seed = seed_from_key(key) if (rate > 0 and key is not None) else None
+    if seed is None:
+        rate = 0.0
+    if attn_mask is not None:
+        attn_mask = _norm_attn_mask(attn_mask, h, sq, sk)
+    if key_padding_mask is not None:
+        if pad_lens is not None:
+            raise ValueError(
+                "key_padding_mask and pad_lens are two spellings of key "
+                "padding — pass one (pad_lens is the fast path)")
+        if attn_mask is not None:
+            # reference parity: self_multihead_attn.py:188 asserts the two
+            # are mutually exclusive (pad_lens + attn_mask DO compose)
+            raise ValueError(
+                "attn_mask and key_padding_mask are mutually exclusive "
+                "(use pad_lens for padding composed with attn_mask)")
+        if key_padding_mask.shape != (b, sk):
+            raise ValueError(
+                f"key_padding_mask must be (batch, src_len) = ({b}, {sk}); "
+                f"got {key_padding_mask.shape}")
+        bias = jnp.broadcast_to(
+            jnp.where(key_padding_mask.astype(bool)[:, None, :],
+                      jnp.float32(_MASKED), jnp.float32(0)),
+            (b, sq, sk))
+        # head-major flattening: rows t = h_i·b + b_i, bias row t % b = b_i
+        o = flash_attention(
+            q.transpose(2, 0, 1, 3), k.transpose(2, 0, 1, 3),
+            v.transpose(2, 0, 1, 3), causal=causal, bias=bias,
+            dropout_rate=rate, dropout_seed=seed)
+        return o.transpose(1, 2, 0, 3)
+    if pad_lens is not None:
+        pad_lens = jnp.asarray(pad_lens, jnp.int32)
+        if pad_lens.shape != (b,):
+            raise ValueError(
+                f"pad_lens must be per-batch ({b},); got {pad_lens.shape}")
+    return flash_attention(q, k, v, causal=causal, layout="bshd",
+                           kv_lens=pad_lens, bias=attn_mask,
+                           dropout_rate=rate, dropout_seed=seed)
 
 
 @dataclasses.dataclass
@@ -95,9 +164,19 @@ class SelfMultiheadAttn:
         return params
 
     def __call__(self, params, x, *, causal: bool = False,
+                 attn_mask: Optional[jax.Array] = None,
+                 key_padding_mask: Optional[jax.Array] = None,
+                 pad_lens: Optional[jax.Array] = None,
                  key: Optional[jax.Array] = None, is_training: bool = True):
         """x: (batch, seq, embed). Returns attention output (+ residual when
-        include_norm_add)."""
+        include_norm_add).
+
+        ``attn_mask``: additive (sq, sk) or (hb, sq, sk) score mask (fused
+        into the kernel). ``key_padding_mask``: (batch, src_len), nonzero =
+        exclude that key (reference ByteTensor semantics,
+        ``self_multihead_attn.py:144-151``); mutually exclusive with
+        attn_mask. ``pad_lens``: (batch,) valid key lengths — the varlen
+        fast path for suffix padding; composes with attn_mask/causal."""
         residual = x
         if self.include_norm_add:
             x = fused_layer_norm(x, params["ln_weight"], params["ln_bias"])
@@ -115,13 +194,14 @@ class SelfMultiheadAttn:
                 qkv = qkv + params["qkv_bias"]
             q, kk, v = jnp.split(qkv, 3, axis=-1)
 
-        def split_heads(t):
-            return t.reshape(b, s, h, d).transpose(0, 2, 1, 3)
-
-        o = _attention(split_heads(q), split_heads(kk), split_heads(v),
-                       causal=causal,
-                       rate=self.dropout if is_training else 0.0, key=key)
-        o = o.transpose(0, 2, 1, 3).reshape(b, s, e)
+        # (b, s, h, d) — the seq-major layout the projection GEMMs emit;
+        # the kernel's bshd index maps read it with no transpose copies
+        o = _attention(q.reshape(b, s, h, d), kk.reshape(b, s, h, d),
+                       v.reshape(b, s, h, d), causal=causal,
+                       rate=self.dropout if is_training else 0.0, key=key,
+                       attn_mask=attn_mask, key_padding_mask=key_padding_mask,
+                       pad_lens=pad_lens)
+        o = o.reshape(b, s, e)
         o = o @ params["out_weight"].T
         if self.bias:
             o = o + params["out_bias"]
@@ -162,8 +242,15 @@ class EncdecMultiheadAttn:
             params["ln_bias"] = jnp.zeros((e,), dtype)
         return params
 
-    def __call__(self, params, query, memory, *, key: Optional[jax.Array] = None,
+    def __call__(self, params, query, memory, *,
+                 attn_mask: Optional[jax.Array] = None,
+                 key_padding_mask: Optional[jax.Array] = None,
+                 pad_lens: Optional[jax.Array] = None,
+                 key: Optional[jax.Array] = None,
                  is_training: bool = True):
+        """``key_padding_mask`` (batch, src_len) excludes padded ENCODER
+        keys (``encdec_multihead_attn.py:106-119``); ``pad_lens`` (batch,)
+        is its varlen fast-path form (valid memory lengths)."""
         residual = query
         if self.include_norm_add:
             query = fused_layer_norm(query, params["ln_weight"], params["ln_bias"])
@@ -176,12 +263,12 @@ class EncdecMultiheadAttn:
             q = q + params["q_bias"]
             kv = kv + params["kv_bias"]
         kk, v = jnp.split(kv, 2, axis=-1)
-        q = q.reshape(b, sq, h, d).transpose(0, 2, 1, 3)
-        kk = kk.reshape(b, sk, h, d).transpose(0, 2, 1, 3)
-        v = v.reshape(b, sk, h, d).transpose(0, 2, 1, 3)
-        o = _attention(q, kk, v, causal=False,
-                       rate=self.dropout if is_training else 0.0, key=key)
-        o = o.transpose(0, 2, 1, 3).reshape(b, sq, e)
+        o = _attention(q.reshape(b, sq, h, d), kk.reshape(b, sk, h, d),
+                       v.reshape(b, sk, h, d), causal=False,
+                       rate=self.dropout if is_training else 0.0, key=key,
+                       attn_mask=attn_mask, key_padding_mask=key_padding_mask,
+                       pad_lens=pad_lens)
+        o = o.reshape(b, sq, e)
         o = o @ params["out_weight"].T
         if self.bias:
             o = o + params["out_bias"]

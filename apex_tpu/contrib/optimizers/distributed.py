@@ -69,7 +69,15 @@ def _local_shard(buf, axis_name):
     return jax.lax.dynamic_slice_in_dim(buf, rank * per, per, axis=0)
 
 
-def _make_zero(kernel, state_buffers, *, axis_name, chunk_size, all_gather_dtype):
+def _make_zero(kernel, state_buffers, *, axis_name, chunk_size,
+               all_gather_dtype, grad_reduce_dtype=None):
+    if grad_reduce_dtype is not None and jnp.dtype(grad_reduce_dtype) not in (
+            jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        raise ValueError(
+            f"grad_reduce_dtype must be float32 or bfloat16 (fp16's "
+            f"exponent range cannot carry a dp-way sum of loss-scaled "
+            f"grads); got {jnp.dtype(grad_reduce_dtype)}")
+
     def _uniform_dtype(tree):
         dts = {x.dtype for x in jax.tree.leaves(tree)}
         return dts.pop() if len(dts) == 1 else None
@@ -108,8 +116,14 @@ def _make_zero(kernel, state_buffers, *, axis_name, chunk_size, all_gather_dtype
         # safe. fp16 (tiny exponent range — loss-scaled grads near 65504
         # would overflow a dp-way sum) and mixed/other dtypes keep the
         # fp32 mega-buffer, the pre-r5 behavior. The update math below
-        # is fp32 either way.
-        gdt = _uniform_dtype(grads)
+        # is fp32 either way. grad_reduce_dtype=jnp.float32 forces the
+        # fp32 reduction for bf16 grads too (``allreduce_always_fp32``,
+        # ``apex/parallel/distributed.py:166`` — at very large dp the
+        # dp-way bf16 sum's rounding may matter more than the wire bytes).
+        if grad_reduce_dtype is not None:
+            gdt = jnp.dtype(grad_reduce_dtype)
+        else:
+            gdt = _uniform_dtype(grads)
         if gdt != jnp.bfloat16:
             gdt = jnp.float32
         gbuf, _ = mt.flatten_to_chunks(grads, layout, dtype=gdt)
@@ -157,6 +171,7 @@ def distributed_fused_adam(
     learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
     adam_w_mode: bool = True, *, axis_name: str = mesh_lib.DATA_AXIS,
     chunk_size: int = mt.DEFAULT_CHUNK, all_gather_dtype=None,
+    grad_reduce_dtype=None,
 ):
     """ZeRO Adam (``DistributedFusedAdam``, ``distributed_fused_adam.py:9``):
     m/v exist only as 1/dp shards."""
@@ -177,13 +192,15 @@ def distributed_fused_adam(
         return p - lr * upd, {"m": m, "v": v}
 
     return _make_zero(kernel, ("m", "v"), axis_name=axis_name,
-                      chunk_size=chunk_size, all_gather_dtype=all_gather_dtype)
+                      chunk_size=chunk_size, all_gather_dtype=all_gather_dtype,
+                      grad_reduce_dtype=grad_reduce_dtype)
 
 
 def distributed_fused_lamb(
     learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-6, weight_decay=0.01,
     max_grad_norm: Optional[float] = None, *, axis_name: str = mesh_lib.DATA_AXIS,
     chunk_size: int = mt.DEFAULT_CHUNK, all_gather_dtype=None,
+    grad_reduce_dtype=None,
 ):
     """ZeRO LAMB (``DistributedFusedLAMB``, ``distributed_fused_lamb.py:10``):
     per-tensor trust ratios from cross-shard psum'd norms, optional global
@@ -224,7 +241,8 @@ def distributed_fused_lamb(
         return p - lr * ratio * upd, {"m": m, "v": v}
 
     return _make_zero(kernel, ("m", "v"), axis_name=axis_name,
-                      chunk_size=chunk_size, all_gather_dtype=all_gather_dtype)
+                      chunk_size=chunk_size, all_gather_dtype=all_gather_dtype,
+                      grad_reduce_dtype=grad_reduce_dtype)
 
 
 def _local_segment_ids(layout, local_rows, axis):

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.ops import fused_layer_norm, scaled_upper_triang_masked_softmax
-from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.attention import flash_attention, seed_from_key
 from apex_tpu.transformer import tensor_parallel as tp_lib
 from apex_tpu.transformer.tensor_parallel.utils import divide
 
@@ -273,8 +273,7 @@ class GPTModel:
             k0 = jax.random.fold_in(key, 0)
             if self.axis is not None:
                 k0 = jax.random.fold_in(k0, jax.lax.axis_index(self.axis))
-            seed = jax.lax.bitcast_convert_type(
-                jax.random.bits(k0, (), jnp.uint32), jnp.int32)
+            seed = seed_from_key(k0)
         if use_flash:
             xg = self.qkv.gather_input(x)             # (b, s, H) full seq
             s_len = xg.shape[1]
@@ -665,8 +664,7 @@ def _dropout(x, rate, key):
     per-element threefry of ``jax.random.bernoulli`` (measured ~50 → ~3 ms
     of residual-dropout cost per flagship train step, PERF.md r4)."""
     from apex_tpu.ops.pallas.attention import dropout_keep
-    seed = jax.lax.bitcast_convert_type(
-        jax.random.bits(key, (), jnp.uint32), jnp.int32)
+    seed = seed_from_key(key)
     # (rows, cols) coordinates rather than one flat arange: a flat int32
     # counter overflows at 2^31 elements (review r4) — splitting on the
     # last axis keeps both coordinates small at any realistic shape

@@ -688,6 +688,15 @@ def zigzag_unshard(x: jax.Array, cp: int, seq_axis: int = -2) -> jax.Array:
     return jnp.take(x, jnp.asarray(inverse), axis=seq_axis)
 
 
+def seed_from_key(key) -> jax.Array:
+    """The int32 dropout seed for the counter-hash dropout family from a
+    ``jax.random`` PRNG key. One place so every module (GPT blocks,
+    contrib MHA, ...) derives seeds identically — the mapping is the
+    cross-module determinism contract for the in-kernel masks."""
+    return jax.lax.bitcast_convert_type(
+        jax.random.bits(key, (), jnp.uint32), jnp.int32)
+
+
 def fold_dropout_seed(seed, *ids):
     """Derive a decorrelated int32 dropout seed from ``seed`` and integer
     identifiers (cp rank, ring step, piece index, ...) via the same fmix32

@@ -82,23 +82,19 @@ def pipeline_spmd_forward_enc_dec(
     mb_shape = enc_microbatches.shape[1:]
     T = M + S - 1
 
-    if mb_index:
-        def stage(params, h, ctx, m):
-            return jax.lax.cond(
-                rank < split_rank,
-                lambda p, h_, c_, m_: enc_fn(p, h_, m_),
-                lambda p, h_, c_, m_: dec_fn(p, h_, c_, m_),
-                params, h, ctx, m,
-            )
-    else:
-        def stage(params, h, ctx, m):
-            del m
-            return jax.lax.cond(
-                rank < split_rank,
-                lambda p, h_, c_: enc_fn(p, h_),
-                lambda p, h_, c_: dec_fn(p, h_, c_),
-                params, h, ctx,
-            )
+    if not mb_index:
+        # normalize the two signatures to the mb_index form so ONE stage
+        # dispatch serves both modes
+        enc_fn = (lambda f: lambda p, h, m: f(p, h))(enc_fn)
+        dec_fn = (lambda f: lambda p, h, c, m: f(p, h, c))(dec_fn)
+
+    def stage(params, h, ctx, m):
+        return jax.lax.cond(
+            rank < split_rank,
+            lambda p, h_, c_, m_: enc_fn(p, h_, m_),
+            lambda p, h_, c_, m_: dec_fn(p, h_, c_, m_),
+            params, h, ctx, m,
+        )
 
     fn = jax.checkpoint(stage) if remat else stage
     perm = [(i, (i + 1) % S) for i in range(S)]

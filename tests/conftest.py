@@ -10,6 +10,18 @@ module-level code in conftest (imported by pytest before test modules).
 """
 
 import os
+import resource
+
+# XLA's CPU compiler recurses deeply (LLVM + scan-transpose lowering); the
+# default 8 MB thread stack is MARGINAL for the suite's biggest programs —
+# the interleaved-pipeline MoE oracle segfaulted mid-suite on it (compile
+# threads inherit RLIMIT_STACK as their default pthread stack size). Raise
+# the soft limit before jax spawns any threads.
+_soft, _hard = resource.getrlimit(resource.RLIMIT_STACK)
+_want = 256 * 1024 * 1024
+if _soft != resource.RLIM_INFINITY and _soft < _want:
+    if _hard == resource.RLIM_INFINITY or _hard >= _want:
+        resource.setrlimit(resource.RLIMIT_STACK, (_want, _hard))
 
 # Force CPU regardless of ambient JAX_PLATFORMS (the dev box tunnels one real
 # TPU chip; tests need the 8-device virtual mesh). Set APEX_TPU_TEST_ON_TPU=1

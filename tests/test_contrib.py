@@ -48,6 +48,50 @@ class TestDistributedOptimizers:
         for a, e in zip(jax.tree.leaves(new_params), jax.tree.leaves(ref_params)):
             np.testing.assert_allclose(a, e, rtol=1e-5, atol=1e-6)
 
+    def test_zero_grad_reduce_dtype_opt_out(self):
+        """bf16 grads reduce-scatter in bf16 by default (halved wire
+        bytes); ``grad_reduce_dtype=float32`` restores the fp32 reduction
+        (the reference DDP's ``allreduce_always_fp32``,
+        ``apex/parallel/distributed.py:166``) — with identical grads per
+        rank the fp32-forced trajectory matches the unsharded fused Adam
+        on the bf16 grads exactly (no low-precision sum in the path)."""
+        from apex_tpu.contrib.optimizers import distributed_fused_adam
+        from apex_tpu.optimizers import fused_adam
+
+        mesh, params, grads = self._setup()
+        bparams = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+        bgrads = jax.tree.map(lambda x: x.astype(jnp.bfloat16), grads)
+
+        def run(opt, params, grads):
+            def step(params, grads):
+                state = opt.init(params)
+                updates, _ = opt.update(grads, state, params)
+                return optax.apply_updates(params, updates)
+            return mesh_lib.shard_map(
+                step, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            )(params, grads)
+
+        forced = run(distributed_fused_adam(
+            learning_rate=1e-2, grad_reduce_dtype=jnp.float32),
+            bparams, bgrads)
+        ref_opt = fused_adam(learning_rate=1e-2)
+        st = ref_opt.init(bparams)
+        up, _ = ref_opt.update(bgrads, st, bparams)
+        ref = optax.apply_updates(bparams, up)
+        for a, e in zip(jax.tree.leaves(forced), jax.tree.leaves(ref)):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(e, np.float32),
+                rtol=1e-2, atol=1e-5)
+        # the default (bf16 reduce) still lands within bf16 rounding of it
+        default = run(distributed_fused_adam(learning_rate=1e-2),
+                      bparams, bgrads)
+        for a, e in zip(jax.tree.leaves(default), jax.tree.leaves(forced)):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(e, np.float32),
+                rtol=2e-2, atol=1e-4)
+        with pytest.raises(ValueError, match="grad_reduce_dtype"):
+            distributed_fused_adam(grad_reduce_dtype=jnp.float16)
+
     def test_zero_state_is_sharded(self):
         from apex_tpu.contrib.optimizers import distributed_fused_adam
         from apex_tpu.optimizers import multi_tensor as mt
@@ -150,12 +194,213 @@ class TestMultiheadAttn:
                           for i in range(200)])
         np.testing.assert_allclose(outs.mean(0), o_eval, atol=0.08)
 
+    def _dense_ref(self, m, params, x, *, causal=False, add_mask=None,
+                   pad_mask=None):
+        """Materialized-scores oracle for SelfMultiheadAttn (no dropout)."""
+        qkv = x @ params["qkv_weight"].T
+        if "qkv_bias" in params:
+            qkv = qkv + params["qkv_bias"]
+        q, k, v = jnp.split(qkv, 3, -1)
+        b, s, e = x.shape
+        h, d = m.num_heads, m.head_dim
+
+        def heads(t):
+            return t.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+
+        sc = jnp.einsum("bhqd,bhkd->bhqk", heads(q), heads(k)) / jnp.sqrt(
+            float(d))
+        if add_mask is not None:          # additive (hb, sq, sk), hb | h
+            am = add_mask[None] if add_mask.ndim == 2 else add_mask
+            sc = sc + jnp.broadcast_to(
+                jnp.tile(am, (h // am.shape[0], 1, 1)), sc.shape)
+        if pad_mask is not None:          # (b, sk) nonzero = exclude
+            sc = jnp.where(pad_mask.astype(bool)[:, None, None, :],
+                           -1e9, sc)
+        if causal:
+            sc = jnp.where(jnp.tril(jnp.ones((s, s), bool)), sc, -jnp.inf)
+        p = jax.nn.softmax(sc, -1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, heads(v))
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, e)
+        o = o @ params["out_weight"].T
+        if "out_bias" in params:
+            o = o + params["out_bias"]
+        return o
+
+    def test_additive_attn_mask_fused(self):
+        """The reference's additive-attn_mask variant
+        (``self_multihead_attn.py:144-198``) rides the flash bias operand:
+        output AND gradients match a materialized-scores oracle."""
+        from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+
+        m = SelfMultiheadAttn(embed_dim=32, num_heads=4, bias=True)
+        params = m.init(K)
+        x = jr.normal(jr.fold_in(K, 31), (2, 16, 32))
+        # a banded additive mask, shared over batch+heads (the reference's
+        # time-mask shape) plus a per-head variant
+        band = jnp.where(
+            jnp.abs(jnp.arange(16)[:, None] - jnp.arange(16)[None]) > 4,
+            -1e9, 0.0)
+        per_head = jr.normal(jr.fold_in(K, 32), (4, 16, 16))
+        for mask in (band, per_head):
+            def loss(p, mk):
+                return jnp.sum(m(p, x, attn_mask=mk, is_training=False) ** 2)
+
+            def loss_ref(p, mk):
+                return jnp.sum(self._dense_ref(m, p, x, add_mask=mk) ** 2)
+
+            np.testing.assert_allclose(
+                m(params, x, attn_mask=mask, is_training=False),
+                self._dense_ref(m, params, x, add_mask=mask),
+                rtol=2e-5, atol=2e-5)
+            g = jax.grad(loss)(params, mask)
+            g_ref = jax.grad(loss_ref)(params, mask)
+            for name in g:
+                np.testing.assert_allclose(g[name], g_ref[name],
+                                           rtol=1e-4, atol=1e-4)
+            # the mask itself is differentiable through the bias operand
+            gm = jax.grad(loss, argnums=1)(params, mask)
+            gm_ref = jax.grad(loss_ref, argnums=1)(params, mask)
+            np.testing.assert_allclose(gm, gm_ref, rtol=1e-4, atol=1e-4)
+
+    def test_key_padding_mask_per_batch(self):
+        """(b, sk) key_padding_mask with DIFFERENT (non-suffix) patterns
+        per batch row — the per-batch bias via head-major flattening —
+        matches the oracle; masked keys get zero value-gradient."""
+        from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+
+        m = SelfMultiheadAttn(embed_dim=32, num_heads=4)
+        params = m.init(K)
+        x = jr.normal(jr.fold_in(K, 33), (3, 16, 32))
+        pad = jnp.stack([
+            (jnp.arange(16) % 3 == 0),          # strided holes
+            (jnp.arange(16) >= 10),             # suffix padding
+            jnp.zeros((16,), bool),             # nothing masked
+        ])
+        out = m(params, x, key_padding_mask=pad, is_training=False)
+        ref = self._dense_ref(m, params, x, pad_mask=pad)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+        # mutually exclusive with attn_mask (reference parity,
+        # self_multihead_attn.py:188)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            m(params, x, key_padding_mask=pad,
+              attn_mask=jnp.zeros((16, 16)), is_training=False)
+
+    def test_pad_lens_varlen_fast_path(self):
+        """pad_lens (the kv_lens varlen form) equals both the
+        key_padding_mask suffix form and a per-row trimmed oracle, and
+        composes with causal."""
+        from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+
+        m = SelfMultiheadAttn(embed_dim=32, num_heads=4)
+        params = m.init(K)
+        x = jr.normal(jr.fold_in(K, 34), (2, 16, 32))
+        lens = jnp.array([11, 16], jnp.int32)
+        suffix = jnp.arange(16)[None] >= lens[:, None]
+        for causal in (False, True):
+            out = m(params, x, pad_lens=lens, causal=causal,
+                    is_training=False)
+            ref = m(params, x, key_padding_mask=suffix, causal=causal,
+                    is_training=False)
+            np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+            # rows past a batch's length are garbage-in-garbage-out for
+            # that batch only; valid-region outputs must equal a run on
+            # the trimmed batch
+            trimmed = m(params, x[:1, :11], causal=causal,
+                        is_training=False)
+            np.testing.assert_allclose(out[0, :11], trimmed[0],
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_masks_compose_with_inkernel_dropout(self):
+        """Dropout + mask in the SAME kernel call: eval-mode equals the
+        oracle, training keeps the mask (masked keys stay excluded in
+        every sample) and stays unbiased."""
+        from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+
+        m = SelfMultiheadAttn(embed_dim=16, num_heads=2, dropout=0.25)
+        params = m.init(K)
+        x = jr.normal(jr.fold_in(K, 35), (2, 8, 16))
+        pad = jnp.stack([jnp.arange(8) >= 6, jnp.arange(8) % 2 == 1])
+        o_eval = m(params, x, key_padding_mask=pad, is_training=False)
+        outs = jnp.stack([
+            m(params, x, key_padding_mask=pad, key=jr.fold_in(K, 200 + i))
+            for i in range(200)])
+        assert not np.allclose(outs[0], outs[1])
+        np.testing.assert_allclose(outs.mean(0), o_eval, atol=0.12)
+        # determinism per key
+        np.testing.assert_array_equal(
+            m(params, x, key_padding_mask=pad, key=jr.fold_in(K, 200)),
+            outs[0])
+
+    def test_encdec_memory_padding(self):
+        """Encoder-memory padding through EncdecMultiheadAttn: pad_lens
+        and key_padding_mask agree with a trimmed-memory oracle
+        (``encdec_multihead_attn.py:106-119``)."""
+        from apex_tpu.contrib.multihead_attn import EncdecMultiheadAttn
+
+        m = EncdecMultiheadAttn(embed_dim=32, num_heads=4, bias=True)
+        params = m.init(K)
+        q = jr.normal(jr.fold_in(K, 36), (2, 8, 32))
+        mem = jr.normal(jr.fold_in(K, 37), (2, 24, 32))
+        lens = jnp.array([17, 24], jnp.int32)
+        out = m(params, q, mem, pad_lens=lens, is_training=False)
+        suffix = jnp.arange(24)[None] >= lens[:, None]
+        out2 = m(params, q, mem, key_padding_mask=suffix, is_training=False)
+        np.testing.assert_allclose(out, out2, rtol=2e-5, atol=2e-5)
+        trimmed = m(params, q[:1], mem[:1, :17], is_training=False)
+        np.testing.assert_allclose(out[0], trimmed[0], rtol=2e-5, atol=2e-5)
+
     def test_fmha_packed_layout(self):
         from apex_tpu.contrib.fmha import fmha
 
         qkv = jr.normal(K, (2, 16, 3, 4, 8))
         o = fmha(qkv, causal=True)
         assert o.shape == (2, 16, 4, 8)
+
+    def test_fmha_varlen_cu_seqlens(self):
+        """The reference's REAL interface (``fmha.py:35-46``): token-packed
+        qkv + cu_seqlens. Each row's slice must equal dense attention on
+        that row alone (no cross-row leakage), fwd and grads."""
+        from apex_tpu.contrib.fmha import FMHA, fmha_varlen
+
+        h, d = 2, 8
+        lens = [5, 12, 1]
+        cu = jnp.cumsum(jnp.array([0] + lens)).astype(jnp.int32)
+        total = int(cu[-1])
+        qkv = jr.normal(jr.fold_in(K, 40), (total, 3, h, d))
+
+        def run(qkv):
+            return fmha_varlen(qkv, cu, max_s=16)
+
+        out = run(qkv)
+        assert out.shape == (total, h, d)
+
+        def row_oracle(row_qkv):
+            q, k, v = (row_qkv[:, i].transpose(1, 0, 2) for i in range(3))
+            s = jnp.einsum("hqd,hkd->hqk", q, k) / jnp.sqrt(float(d))
+            p = jax.nn.softmax(s, -1)
+            return jnp.einsum("hqk,hkd->hqd", p, v).transpose(1, 0, 2)
+
+        starts = [0] + list(jnp.cumsum(jnp.array(lens))[:-1])
+        for r, (st, ln) in enumerate(zip(starts, lens)):
+            st = int(st)
+            np.testing.assert_allclose(
+                out[st:st + ln], row_oracle(qkv[st:st + ln]),
+                rtol=2e-5, atol=2e-5)
+        # gradient flows through the scatter/gather round-trip; a token's
+        # grad only sees its own row (leakage would show cross-row terms)
+        g = jax.grad(lambda x: jnp.sum(run(x)[: lens[0]] ** 2))(qkv)
+        assert bool(jnp.all(g[lens[0] + lens[1]:] == 0))
+        assert bool(jnp.any(g[: lens[0]] != 0))
+        # module wrapper: flat (total, 3·h·d) in/out with in-kernel dropout
+        m = FMHA(num_heads=h, head_dim=d, p_dropout=0.3)
+        flat = qkv.reshape(total, 3 * h * d)
+        o1 = m(flat, cu, max_s=16, key=jr.fold_in(K, 41))
+        o2 = m(flat, cu, max_s=16, key=jr.fold_in(K, 42))
+        assert o1.shape == (total, h * d)
+        assert not np.allclose(o1, o2)
+        np.testing.assert_allclose(
+            m(flat, cu, max_s=16, is_training=False),
+            out.reshape(total, h * d), rtol=2e-5, atol=2e-5)
 
 
 class TestTransducer:
