@@ -8,8 +8,10 @@ batch-norm (``apex_tpu.parallel``), fused multi-tensor optimizers
 Pallas TPU kernels (``apex_tpu.ops``, re-exported via ``apex_tpu.normalization``,
 ``apex_tpu.fused_dense``, ``apex_tpu.mlp``), Megatron-style tensor + pipeline
 parallelism over a ``jax.sharding.Mesh`` (``apex_tpu.transformer``), ZeRO-style
-sharded optimizers and further optional modules (``apex_tpu.contrib``), and a
-profiler (``apex_tpu.prof``).
+sharded optimizers and further optional modules (``apex_tpu.contrib``), a
+profiler (``apex_tpu.prof``), and runtime telemetry — metrics registry,
+step-event JSONL stream, reporting CLI — with no reference analog
+(``apex_tpu.monitor``, docs/OBSERVABILITY.md).
 
 Where Apex relies on CUDA streams, NCCL process groups, and monkey-patching,
 this framework uses named mesh axes + XLA collectives, functional precision

@@ -45,6 +45,7 @@ from apex_tpu.amp.scaler import (  # noqa: F401
     all_finite,
     apply_if_finite,
     skip_step_if_nonfinite,
+    scaler_metrics,
     state_dict,
     load_state_dict,
 )

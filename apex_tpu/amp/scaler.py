@@ -216,6 +216,20 @@ def skip_step_if_nonfinite(opt):
     return optax.GradientTransformation(init, update)
 
 
+# -- observability -------------------------------------------------------------
+
+def scaler_metrics(state: LossScalerState) -> dict:
+    """Host-side observability numbers for a scaler state: the loss scale,
+    the growth tracker and the lifetime overflow (skipped-step) count as
+    Python scalars. This is the pull point ``apex_tpu.monitor`` reads
+    (``monitor.observe_scaler``) — one device→host sync, only when called."""
+    return {
+        "loss_scale": float(state.loss_scale),
+        "growth_tracker": int(state.growth_tracker),
+        "skipped_steps": int(state.skipped_steps),
+    }
+
+
 # -- state-dict parity (apex/amp/frontend.py:361-400) -------------------------
 
 def state_dict(state: LossScalerState) -> dict:

@@ -1,0 +1,75 @@
+"""Unified training telemetry.
+
+The subsystem the reference apex never had: ``apex.pyprof`` profiles
+kernels after the fact, but nothing in the reference answers "what is my
+loss scale doing", "how many steps did AMP skip", "what is the pipeline
+bubble costing me" *while training runs*. ``apex_tpu.monitor`` is that
+layer:
+
+* :mod:`~apex_tpu.monitor.registry` — host-side metrics registry
+  (counters / gauges / timers), rank-tagged via
+  :func:`apex_tpu.utils.logging.set_rank_info`, with a structured JSONL
+  emitter and near-zero overhead when disabled;
+* :mod:`~apex_tpu.monitor.hooks` — instrumentation hooks for the hot
+  paths: AMP scaler, optimizers (grad/update norms), pipeline schedules
+  (geometry + bubble fraction), collectives (count + bytes per traced
+  step);
+* :mod:`~apex_tpu.monitor.schema` — JSON schemas + validator shared by
+  the monitor stream, ``bench.py`` artifacts and the multichip gate
+  (``tools/validate_metrics.py`` is the CLI);
+* :mod:`~apex_tpu.monitor.report` — ``python -m apex_tpu.monitor report
+  events.jsonl`` aggregates the stream into a step-timeline summary
+  (tokens/s, spec-peak MFU, overflow rate, bubble %).
+
+Quick start::
+
+    from apex_tpu import monitor
+
+    monitor.enable("events.jsonl")          # or APEX_TPU_MONITOR=...
+    monitor.emit_meta(device_kind=..., model_flops_per_token=...)
+    for step in range(n_steps):
+        monitor.begin_step()
+        with monitor.timer("train/step"):
+            params, opt_state, scaler, loss = train_step(...)
+            jax.block_until_ready(loss)
+        monitor.hooks.observe_scaler(scaler)
+        monitor.end_step(tokens=batch * seq, loss=float(loss))
+
+See ``docs/OBSERVABILITY.md`` for the event schema and overhead notes.
+"""
+
+from apex_tpu.monitor import hooks  # noqa: F401
+from apex_tpu.monitor.registry import (  # noqa: F401
+    SCHEMA_VERSION,
+    MetricsRegistry,
+    begin_step,
+    check_record_honesty,
+    counter,
+    disable,
+    emit_event,
+    emit_meta,
+    enable,
+    enable_from_env,
+    enabled,
+    end_step,
+    gauge,
+    get_registry,
+    observe_seconds,
+    timer,
+)
+from apex_tpu.monitor.hooks import (  # noqa: F401
+    count_collective,
+    observe_grads,
+    observe_optimizer_step,
+    observe_scaler,
+    observe_updates,
+    pipeline_bubble_fraction,
+    record_pipeline_schedule,
+    tree_bytes,
+)
+from apex_tpu.monitor.schema import gate_metrics, validate, validate_jsonl  # noqa: F401
+from apex_tpu.monitor.report import (  # noqa: F401
+    PEAK_FLOPS_BY_DEVICE,
+    aggregate,
+    spec_peak_flops,
+)

@@ -1,0 +1,8 @@
+"""CLI entry: ``python -m apex_tpu.monitor report events.jsonl``."""
+
+import sys
+
+from apex_tpu.monitor.report import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,201 @@
+"""Instrumentation hooks wiring the hot paths into the metrics registry.
+
+Three kinds of hook, by *when* they run:
+
+* **host-side pull hooks** (:func:`observe_scaler`, :func:`observe_grads`,
+  :func:`observe_updates`) — called from the training loop on state it
+  already holds. These are the only hooks that touch device values, so the
+  one device→host sync they cost is explicit and opt-in; while monitoring
+  is disabled they return immediately without looking at their argument.
+* **trace-time static hooks** (:func:`count_collective`,
+  :func:`record_pipeline_schedule`) — called from inside traced code
+  (``p2p_communication``, ``schedules``) while JAX is *tracing*, where
+  shapes and schedule geometry are static Python values. They cost nothing
+  at run time: a jitted step re-executes the collectives, not the Python
+  that counted them, so counts are **per traced program** (a retrace adds
+  another program's worth). The report reads them from the step records'
+  lifetime ``counters_total`` — tracing usually happens during warm-up,
+  before any step window opens, so per-step deltas would miss them.
+* **wall-clock timers** — ``monitor.timer("train/step")`` around the
+  blocking step call; see ``docs/OBSERVABILITY.md`` for the pattern.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from apex_tpu.monitor import registry as _reg
+
+# re-exported registry entry points, so instrumented call sites in other
+# subsystems depend on this module's public surface only
+enabled = _reg.enabled
+emit_event = _reg.emit_event
+
+PyTree = Any
+
+
+# --- AMP scaler --------------------------------------------------------------
+
+def observe_scaler(state) -> Optional[dict]:
+    """Pull loss-scale observability numbers from a
+    :class:`~apex_tpu.amp.scaler.LossScalerState`.
+
+    Gauges: ``amp/loss_scale``, ``amp/growth_tracker``,
+    ``amp/skipped_steps_total``; counter ``amp/overflow_steps`` advances by
+    the delta in ``skipped_steps`` since the previous observation, so step
+    records carry per-step overflow counts. The FIRST observation is the
+    delta baseline (a resumed checkpoint's historical skips must not count
+    as this run's overflows) — observe the scaler once before the training
+    loop so an overflow in the very first step is attributed to it.
+    Returns the pulled numbers (the same dict
+    :func:`apex_tpu.amp.scaler_metrics` computes), or ``None`` while
+    monitoring is disabled.
+    """
+    r = _reg.get_registry()
+    if r is None:
+        return None
+    from apex_tpu.amp.scaler import scaler_metrics
+
+    m = scaler_metrics(state)
+    r.gauge("amp/loss_scale", m["loss_scale"])
+    r.gauge("amp/growth_tracker", m["growth_tracker"])
+    r.gauge("amp/skipped_steps_total", m["skipped_steps"])
+    prev = getattr(r, "_amp_skipped_prev", None)
+    if prev is not None and m["skipped_steps"] > prev:
+        r.counter("amp/overflow_steps", m["skipped_steps"] - prev)
+    r._amp_skipped_prev = m["skipped_steps"]
+    return m
+
+
+# --- optimizers --------------------------------------------------------------
+
+def _tree_norm(tree: PyTree) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    leaves = [l for l in jax.tree.leaves(tree)
+              if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating)]
+    if not leaves:
+        return 0.0
+    total = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    return float(jnp.sqrt(total))
+
+
+def observe_grads(grads: PyTree) -> Optional[float]:
+    """Gauge ``optim/grad_norm`` = global L2 norm of a grad pytree.
+
+    Host-side: call it on the grads your step returned (one reduction on
+    device, one scalar transfer). No-op while disabled."""
+    r = _reg.get_registry()
+    if r is None:
+        return None
+    n = _tree_norm(grads)
+    r.gauge("optim/grad_norm", n)
+    return n
+
+
+def observe_updates(updates: PyTree) -> Optional[float]:
+    """Gauge ``optim/update_norm`` = global L2 norm of the parameter
+    updates an optimizer produced."""
+    r = _reg.get_registry()
+    if r is None:
+        return None
+    n = _tree_norm(updates)
+    r.gauge("optim/update_norm", n)
+    return n
+
+
+def observe_optimizer_step(grads: PyTree = None,
+                           updates: PyTree = None) -> Optional[dict]:
+    """One-call optimizer observability: gauges ``optim/grad_norm`` and
+    ``optim/update_norm`` from the pytrees the step already produced.
+    Returns the pulled numbers, or ``None`` while disabled (in which case
+    the arguments are never touched — no device work)."""
+    r = _reg.get_registry()
+    if r is None:
+        return None
+    out = {}
+    if grads is not None:
+        out["grad_norm"] = observe_grads(grads)
+    if updates is not None:
+        out["update_norm"] = observe_updates(updates)
+    return out
+
+
+# --- pipeline schedules ------------------------------------------------------
+
+def pipeline_bubble_fraction(num_microbatches: int, pipeline_size: int,
+                             virtual_chunks: int = 1) -> float:
+    """Analytic bubble fraction of the scanned SPMD schedule: the forward
+    sweep runs ``M·v + S − 1`` chunk-ticks of which ``S − 1`` are fill/drain
+    (module docstring of ``pipeline_parallel.schedules`` has the timing
+    model; measured by ``tests/test_pipeline.py::TestBubbleUtilization``)."""
+    ticks = num_microbatches * virtual_chunks + pipeline_size - 1
+    return (pipeline_size - 1) / ticks if ticks else 0.0
+
+
+def record_pipeline_schedule(*, num_microbatches: int, pipeline_size: int,
+                             virtual_chunks: int = 1,
+                             tick_bytes: Optional[int] = None,
+                             axis: str = "pp") -> None:
+    """Record a pipeline schedule's static geometry (trace-time hook).
+
+    Emits one ``pipeline_schedule`` event with the tick count and bubble
+    fraction, sets gauge ``pipeline/bubble_fraction``, and — when the
+    per-tick activation size is known — accounts the schedule's ppermute
+    traffic via :func:`count_collective` (ticks × bytes per step)."""
+    r = _reg.get_registry()
+    if r is None:
+        return
+    ticks = num_microbatches * virtual_chunks + pipeline_size - 1
+    bubble = pipeline_bubble_fraction(num_microbatches, pipeline_size,
+                                      virtual_chunks)
+    r.gauge("pipeline/bubble_fraction", bubble)
+    r.emit_event(
+        "pipeline_schedule",
+        num_microbatches=num_microbatches,
+        pipeline_size=pipeline_size,
+        virtual_chunks=virtual_chunks,
+        ticks=ticks,
+        bubble_fraction=round(bubble, 6),
+    )
+    if tick_bytes:
+        count_collective("ppermute", bytes=tick_bytes, count=ticks,
+                         axis=axis)
+
+
+# --- collectives -------------------------------------------------------------
+
+def tree_bytes(tree: PyTree) -> int:
+    """Static payload size of a pytree of (possibly traced) arrays; shapes
+    are known at trace time even when values are tracers."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        size = getattr(leaf, "size", None)
+        dtype = getattr(leaf, "dtype", None)
+        if size is not None and dtype is not None:
+            try:
+                total += int(size) * dtype.itemsize
+            except TypeError:  # polymorphic / abstract size
+                pass
+    return total
+
+
+def count_collective(kind: str, *, bytes: int = 0, count: int = 1,
+                     axis: str = "") -> None:
+    """Counter hook for communication primitives (trace-time).
+
+    Counts land in ``collective/<kind>_calls`` and
+    ``collective/<kind>_bytes`` (tagged per mesh axis as
+    ``collective/<kind>[<axis>]_*`` when ``axis`` is given). Because traced
+    code runs this Python once per trace, totals are per *traced* step —
+    the natural unit for a jitted training step."""
+    r = _reg.get_registry()
+    if r is None:
+        return
+    tag = f"{kind}[{axis}]" if axis else kind
+    r.counter(f"collective/{tag}_calls", count)
+    if bytes:
+        r.counter(f"collective/{tag}_bytes", bytes * count)

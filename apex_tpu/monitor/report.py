@@ -1,0 +1,262 @@
+"""Aggregate a monitor JSONL stream into a step-timeline summary.
+
+``python -m apex_tpu.monitor report events.jsonl`` prints a human summary
+(tokens/s, derived MFU, overflow rate, pipeline bubble %, collective
+volume); ``--json`` prints one machine-readable JSON object instead.
+
+The MFU convention is the same spec-peak one the bench artifact uses
+(``BENCH_r05.json``): analytic model FLOPs per token (from the ``meta``
+record) × achieved tokens/s ÷ the chip's public peak dense bf16 FLOP/s
+(:data:`PEAK_FLOPS_BY_DEVICE`, which ``bench.py`` imports — one table, one
+code path). The headline tokens/s uses the **best** (minimum-duration)
+step, matching the bench's min-of-passes headline; the mean is reported
+alongside.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Iterable, List, Optional
+
+# peak dense bf16 FLOP/s per chip by device kind (public spec sheets) —
+# THE spec-peak table: bench.py and the report both read it, so "mfu" means
+# the same thing in BENCH_*.json and in `monitor report` output.
+PEAK_FLOPS_BY_DEVICE = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def spec_peak_flops(device_kind: Optional[str]) -> Optional[float]:
+    """Peak dense bf16 FLOP/s for a device kind, or None when unknown
+    (CPU hosts, future chips) — callers must then omit MFU rather than
+    fabricate it."""
+    if device_kind is None:
+        return None
+    return PEAK_FLOPS_BY_DEVICE.get(device_kind)
+
+
+def read_records(lines: Iterable[str]) -> List[Dict[str, Any]]:
+    records = []
+    for line in lines:
+        line = line.strip()
+        if line:
+            records.append(json.loads(line))
+    return records
+
+
+def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold a record stream into the step-timeline summary dict.
+
+    A file holding several runs (appended streams; each run opens with a
+    ``meta`` record) aggregates the LAST run only — a stale run's faster
+    steps must not leak into this run's tokens/s headline. The summary
+    carries ``runs_in_file`` when earlier runs were skipped.
+    """
+    meta_idx = [i for i, r in enumerate(records) if r.get("kind") == "meta"]
+    runs_in_file = len(meta_idx)
+    if runs_in_file > 1:
+        records = records[meta_idx[-1]:]
+    meta: Dict[str, Any] = {}
+    steps = []
+    gate_records = []
+    schedule = None
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "meta":
+            meta.update({k: v for k, v in rec.items()
+                         if k not in ("schema", "kind", "t_s", "process",
+                                      "rank")})
+        elif kind == "step":
+            steps.append(rec)
+        elif kind == "gate":
+            gate_records.append(rec)
+        elif kind == "event" and rec.get("name") == "pipeline_schedule":
+            schedule = rec
+
+    summary: Dict[str, Any] = {
+        "num_steps": len(steps),
+        "num_records": len(records),
+    }
+    if runs_in_file > 1:
+        summary["runs_in_file"] = runs_in_file
+    if meta:
+        summary["meta"] = meta
+
+    durs = [s["dur_s"] for s in steps
+            if isinstance(s.get("dur_s"), (int, float)) and s["dur_s"] > 0]
+    if durs:
+        summary["step_time_s"] = {
+            "best": min(durs),
+            "mean": sum(durs) / len(durs),
+            "worst": max(durs),
+        }
+    token_steps = [s for s in steps
+                   if isinstance(s.get("tokens"), (int, float))
+                   and isinstance(s.get("dur_s"), (int, float))
+                   and s["dur_s"] > 0]
+    if token_steps:
+        best = min(token_steps, key=lambda s: s["dur_s"] / s["tokens"])
+        total_tokens = sum(s["tokens"] for s in token_steps)
+        total_time = sum(s["dur_s"] for s in token_steps)
+        summary["tokens_per_s"] = {
+            "best": best["tokens"] / best["dur_s"],
+            "mean": total_tokens / total_time,
+        }
+        fpt = meta.get("model_flops_per_token")
+        peak = spec_peak_flops(meta.get("device_kind"))
+        if isinstance(fpt, (int, float)):
+            flops_per_s = fpt * summary["tokens_per_s"]["best"]
+            summary["model_tflops"] = flops_per_s / 1e12
+            if peak:
+                summary["mfu"] = flops_per_s / peak
+
+    # overflow rate: per-step overflow counters, falling back to the
+    # lifetime gauge delta across the stream
+    overflows = sum(s.get("counters", {}).get("amp/overflow_steps", 0)
+                    for s in steps)
+    if not overflows and steps:
+        totals = [s["gauges"].get("amp/skipped_steps_total")
+                  for s in steps
+                  if "amp/skipped_steps_total" in s.get("gauges", {})]
+        if len(totals) >= 2:
+            overflows = totals[-1] - totals[0]
+    if steps:
+        summary["overflow_rate"] = overflows / len(steps)
+    scales = [s["gauges"].get("amp/loss_scale") for s in steps
+              if "amp/loss_scale" in s.get("gauges", {})]
+    if scales:
+        summary["loss_scale_last"] = scales[-1]
+
+    if schedule is not None:
+        summary["pipeline"] = {
+            "bubble_fraction": schedule.get("bubble_fraction"),
+            "num_microbatches": schedule.get("num_microbatches"),
+            "pipeline_size": schedule.get("pipeline_size"),
+            "virtual_chunks": schedule.get("virtual_chunks"),
+            "ticks": schedule.get("ticks"),
+        }
+        # per-(microbatch, stage) wall time: a chunk-tick is exactly one
+        # microbatch through one (virtual) stage, so when the caller timed
+        # the schedule call (monitor.timer("pipeline/fwd_bwd") around the
+        # blocking fwd/bwd), total time / calls / ticks is the per-tick
+        # wall estimate (forward-sweep convention; backward ticks ride in
+        # the same timed window, so this upper-bounds the forward tick)
+        ticks = schedule.get("ticks")
+        tot_n, tot_s = 0, 0.0
+        for s in steps:
+            t = s.get("timers", {}).get("pipeline/fwd_bwd")
+            if t:
+                tot_n += t.get("count", 0)
+                tot_s += t.get("total_s", 0.0)
+        if ticks and tot_n:
+            summary["pipeline"]["per_tick_wall_s"] = tot_s / tot_n / ticks
+
+    # collective volume from the LAST step's lifetime totals: trace-time
+    # counting runs during warm-up compilation, usually BEFORE step 0's
+    # delta baseline, so summing per-step deltas would read 0. Totals are
+    # per traced program (re-traces add to them), not per executed step.
+    collectives: Dict[str, Dict[str, float]] = {}
+    totals = steps[-1].get("counters_total", {}) if steps else {}
+    if not totals:  # pre-counters_total streams: fall back to delta sums
+        for s in steps:
+            for name, v in s.get("counters", {}).items():
+                if name.startswith("collective/"):
+                    totals[name] = totals.get(name, 0) + v
+    for name, v in totals.items():
+        if name.startswith("collective/"):
+            base, sep, field = name[len("collective/"):].rpartition("_")
+            if not sep:  # a stray unsuffixed counter must not kill the CLI
+                base, field = field, "calls"
+            collectives.setdefault(base, {})[field] = v
+    if collectives:
+        summary["collectives"] = collectives
+
+    if gate_records:
+        summary["gates"] = [
+            {"name": g.get("name"), "ok": g.get("ok"),
+             "skipped": sorted(k for k, v in g.get("metrics", {}).items()
+                               if isinstance(v, dict) and v.get("skipped"))}
+            for g in gate_records
+        ]
+    return summary
+
+
+def render(summary: Dict[str, Any]) -> str:
+    """Human-readable step-timeline summary."""
+    lines = [f"monitor report: {summary['num_records']} records, "
+             f"{summary['num_steps']} steps"]
+    st = summary.get("step_time_s")
+    if st:
+        lines.append(f"  step time   best {st['best']*1e3:.2f} ms   "
+                     f"mean {st['mean']*1e3:.2f} ms   "
+                     f"worst {st['worst']*1e3:.2f} ms")
+    tps = summary.get("tokens_per_s")
+    if tps:
+        lines.append(f"  tokens/s    best {tps['best']:.1f}   "
+                     f"mean {tps['mean']:.1f}")
+    if "mfu" in summary:
+        lines.append(f"  mfu         {summary['mfu']:.4f}  "
+                     f"(model {summary['model_tflops']:.2f} TFLOP/s vs "
+                     f"{summary['meta'].get('device_kind')} spec peak)")
+    elif "model_tflops" in summary:
+        lines.append(f"  model flops {summary['model_tflops']:.2f} TFLOP/s "
+                     f"(no spec peak for this device; MFU omitted)")
+    if "overflow_rate" in summary:
+        lines.append(f"  overflow    {summary['overflow_rate']:.4f} "
+                     f"skipped steps/step"
+                     + (f", loss scale now {summary['loss_scale_last']:g}"
+                        if "loss_scale_last" in summary else ""))
+    pipe = summary.get("pipeline")
+    if pipe and pipe.get("bubble_fraction") is not None:
+        lines.append(f"  pipeline    bubble {100*pipe['bubble_fraction']:.2f}%"
+                     f"  (M={pipe.get('num_microbatches')} "
+                     f"S={pipe.get('pipeline_size')} "
+                     f"v={pipe.get('virtual_chunks')})")
+        if pipe.get("per_tick_wall_s") is not None:
+            lines.append(f"  pipeline    per-(microbatch,stage) tick "
+                         f"{pipe['per_tick_wall_s']*1e3:.3f} ms wall")
+    for name, fields in sorted(summary.get("collectives", {}).items()):
+        calls = fields.get("calls", 0)
+        nbytes = fields.get("bytes", 0)
+        lines.append(f"  collective  {name}: {calls:g} calls"
+                     + (f", {nbytes/1e6:.2f} MB" if nbytes else "")
+                     + "  (per traced program)")
+    for gate in summary.get("gates", []):
+        skipped = (", skipped: " + ", ".join(gate["skipped"])
+                   if gate["skipped"] else "")
+        lines.append(f"  gate        {gate['name']}: "
+                     f"{'OK' if gate['ok'] else 'FAILED'}{skipped}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m apex_tpu.monitor",
+        description="apex_tpu telemetry tools")
+    sub = parser.add_subparsers(dest="command", required=True)
+    rep = sub.add_parser("report", help="summarize a monitor JSONL stream")
+    rep.add_argument("path", help="events.jsonl produced with monitoring on")
+    rep.add_argument("--json", action="store_true",
+                     help="print the summary as one JSON object")
+    args = parser.parse_args(argv)
+
+    with open(args.path) as fh:
+        records = read_records(fh)
+    summary = aggregate(records)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(render(summary))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

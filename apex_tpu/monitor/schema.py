@@ -1,0 +1,279 @@
+"""JSON schemas for monitor events and bench/gate artifacts, plus a
+self-contained validator.
+
+One schema family covers every JSON artifact the repo emits:
+
+* monitor JSONL records (``kind`` ∈ meta/event/step/gate) — the stream
+  written by :mod:`apex_tpu.monitor.registry`;
+* ``BENCH_*.json``-style bench result objects (the line ``bench.py``
+  prints);
+* the MULTICHIP gate record printed by ``__graft_entry__.dryrun_multichip``.
+
+The validator implements the JSON-Schema subset these schemas use
+(``type``, ``properties``, ``required``, ``items``, ``enum``,
+``additionalProperties``) so validation works without the ``jsonschema``
+package; when that package is importable, :func:`validate` cross-checks
+against it too (belt and braces — the schemas stay standard JSON Schema).
+
+Honesty rule (enforced here *and* at the emitter): a record that reports
+success (``ok: true`` or ``status: "OK"``) must not contain a non-finite
+number or a stringified ``'nan'``/``'inf'`` metric anywhere. Skipped
+metrics appear as ``{"skipped": true, "reason": ...}`` objects.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Dict, Iterable, List, Tuple
+
+from apex_tpu.monitor.registry import (
+    SCHEMA_VERSION,
+    _nonfinite_paths,
+    _stringified_nonfinite_paths,
+)
+
+# value of a gate metric: a finite number, or an explicit skip marker
+_METRIC_VALUE = {
+    "anyOf": [
+        {"type": "number"},
+        {
+            "type": "object",
+            "properties": {
+                "skipped": {"enum": [True]},
+                "reason": {"type": "string"},
+            },
+            "required": ["skipped", "reason"],
+            "additionalProperties": False,
+        },
+    ]
+}
+
+_COMMON = {
+    "schema": {"enum": [SCHEMA_VERSION]},
+    "kind": {"type": "string"},
+    "t_s": {"type": "number"},
+    "process": {"type": "integer"},
+    "rank": {"type": "string"},
+}
+
+STEP_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["step"]},
+        "step": {"type": "integer"},
+        "dur_s": {"type": "number"},
+        "counters": {"type": "object"},
+        "counters_total": {"type": "object"},
+        "gauges": {"type": "object"},
+        "timers": {"type": "object"},
+        "tokens": {"type": "number"},
+        "loss": {"anyOf": [{"type": "number"}, {"type": "string"}]},
+    },
+    "required": ["schema", "kind", "step", "dur_s", "counters", "gauges"],
+}
+
+META_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["meta"]},
+        "device_kind": {"type": "string"},
+        "peak_flops": {"anyOf": [{"type": "number"}, {"type": "null"}]},
+        "model_flops_per_token": {"type": "number"},
+    },
+    "required": ["schema", "kind"],
+}
+
+EVENT_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["event"]},
+        "name": {"type": "string"},
+    },
+    "required": ["schema", "kind", "name"],
+}
+
+GATE_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["gate"]},
+        "name": {"type": "string"},
+        "ok": {"type": "boolean"},
+        "metrics": {"type": "object",
+                    "additionalProperties": _METRIC_VALUE},
+    },
+    "required": ["schema", "kind", "name", "ok", "metrics"],
+}
+
+BENCH_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "metric": {"type": "string"},
+        "value": {"type": "number"},
+        "unit": {"type": "string"},
+        "vs_baseline": {"type": "number"},
+        "mfu": {"anyOf": [{"type": "number"}, {"type": "null"}]},
+        "model_tflops": {"anyOf": [{"type": "number"}, {"type": "null"}]},
+        "spread_pct": {"type": "number"},
+        "pass_times_ms": {"type": "array", "items": {"type": "number"}},
+    },
+    "required": ["metric", "value", "unit"],
+}
+
+SCHEMAS_BY_KIND = {
+    "step": STEP_SCHEMA,
+    "meta": META_SCHEMA,
+    "event": EVENT_SCHEMA,
+    "gate": GATE_SCHEMA,
+}
+
+# --- minimal JSON-Schema subset validator ------------------------------------
+
+_TYPES = {
+    "object": dict,
+    "array": list,
+    "string": str,
+    "integer": int,
+    "boolean": bool,
+    "null": type(None),
+}
+
+
+def _check(obj: Any, schema: Dict[str, Any], path: str, errors: List[str]) -> None:
+    if "enum" in schema:
+        if obj not in schema["enum"]:
+            errors.append(f"{path or '<root>'}: {obj!r} not in {schema['enum']}")
+        return
+    if "anyOf" in schema:
+        for sub in schema["anyOf"]:
+            sub_errors: List[str] = []
+            _check(obj, sub, path, sub_errors)
+            if not sub_errors:
+                return
+        errors.append(f"{path or '<root>'}: {obj!r} matches no anyOf branch")
+        return
+    t = schema.get("type")
+    if t is not None:
+        if t == "number":
+            ok = isinstance(obj, (int, float)) and not isinstance(obj, bool)
+        elif t == "integer":
+            ok = isinstance(obj, int) and not isinstance(obj, bool)
+        else:
+            ok = isinstance(obj, _TYPES[t])
+        if not ok:
+            errors.append(f"{path or '<root>'}: expected {t}, got "
+                          f"{type(obj).__name__}")
+            return
+    if isinstance(obj, dict):
+        props = schema.get("properties", {})
+        for key in schema.get("required", []):
+            if key not in obj:
+                errors.append(f"{path or '<root>'}: missing required "
+                              f"key {key!r}")
+        extra = schema.get("additionalProperties", True)
+        for key, val in obj.items():
+            sub = props.get(key)
+            kpath = f"{path}.{key}" if path else str(key)
+            if sub is not None:
+                _check(val, sub, kpath, errors)
+            elif extra is False:
+                errors.append(f"{kpath}: unexpected key")
+            elif isinstance(extra, dict):
+                _check(val, extra, kpath, errors)
+    elif isinstance(obj, list) and "items" in schema:
+        for i, val in enumerate(obj):
+            _check(val, schema["items"], f"{path}[{i}]", errors)
+
+
+def _honesty_errors(record: Dict[str, Any]) -> List[str]:
+    claims = (record.get("ok") is True
+              or (isinstance(record.get("status"), str)
+                  and record["status"].upper() == "OK")
+              # bench results are success artifacts by construction
+              or ("metric" in record and "value" in record))
+    if not claims:
+        return []
+    errors = [f"success record has non-finite value at {p}"
+              for p in _nonfinite_paths(record)]
+    errors.extend(f"success record has stringified non-finite value at {p}"
+                  for p in _stringified_nonfinite_paths(record))
+    return errors
+
+
+def validate(record: Dict[str, Any],
+             schema: Dict[str, Any] = None) -> List[str]:
+    """Validate one record; returns a list of error strings (empty = valid).
+
+    Without an explicit ``schema``, monitor records dispatch on ``kind``
+    and objects with ``metric``/``value`` validate as bench results.
+    """
+    if schema is None:
+        if "kind" in record:
+            schema = SCHEMAS_BY_KIND.get(record["kind"])
+            if schema is None:
+                return [f"unknown record kind {record['kind']!r}"]
+        elif "metric" in record:
+            schema = BENCH_SCHEMA
+        else:
+            return ["record has neither 'kind' nor 'metric'; cannot dispatch"]
+    errors: List[str] = []
+    _check(record, schema, "", errors)
+    errors.extend(_honesty_errors(record))
+    if not errors:
+        try:  # cross-check with the real jsonschema when present
+            import jsonschema
+        except ImportError:
+            pass
+        else:
+            try:
+                jsonschema.validate(record, schema)
+            except jsonschema.ValidationError as e:  # pragma: no cover
+                errors.append(f"jsonschema: {e.message}")
+    return errors
+
+
+def validate_jsonl(lines: Iterable[str]) -> List[Tuple[int, str]]:
+    """Validate a monitor JSONL stream; returns [(lineno, error), ...]."""
+    problems: List[Tuple[int, str]] = []
+    n = 0
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        n += 1
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError as e:
+            problems.append((lineno, f"invalid JSON: {e}"))
+            continue
+        for err in validate(record):
+            problems.append((lineno, err))
+    if n == 0:
+        problems.append((0, "stream contains no records"))
+    return problems
+
+
+def gate_metrics(values: Dict[str, Any]) -> Dict[str, Any]:
+    """Normalize a gate's metric dict: finite numbers pass through, a
+    ``(skipped, reason)`` tuple or non-finite number becomes the explicit
+    skip object. Non-finite numbers are *rejected* — the caller must have
+    decided to skip, not silently measured nan."""
+    out: Dict[str, Any] = {}
+    for name, v in values.items():
+        if isinstance(v, tuple) and len(v) == 2 and v[0] == "skipped":
+            out[name] = {"skipped": True, "reason": str(v[1])}
+        elif isinstance(v, (int, float)):
+            if isinstance(v, float) and not math.isfinite(v):
+                raise ValueError(
+                    f"gate metric {name!r} is {v}; mark it skipped with "
+                    "('skipped', reason) instead of passing a non-finite "
+                    "measurement")
+            out[name] = v
+        else:
+            raise TypeError(f"gate metric {name!r}: expected number or "
+                            f"('skipped', reason), got {type(v).__name__}")
+    return out

@@ -71,6 +71,11 @@ def all_reduce_gradients(
     ``world_size/predivide_factor`` after (so the full division happens in two
     stages), or plain average / sum.
     """
+    from apex_tpu.monitor import hooks as monitor_hooks
+
+    if monitor_hooks.enabled():  # trace-time count, zero run-time cost
+        monitor_hooks.count_collective(
+            "psum", bytes=monitor_hooks.tree_bytes(grads), axis=axis_name)
 
     def reduce_one(g: jax.Array) -> jax.Array:
         orig_dtype = g.dtype

@@ -26,6 +26,7 @@ from typing import Any
 
 import jax
 
+from apex_tpu.monitor import hooks as monitor_hooks
 from apex_tpu.parallel import mesh as mesh_lib
 
 PyTree = Any
@@ -34,6 +35,9 @@ PyTree = Any
 def _rotate(x: PyTree, axis_name: str, shift: int) -> PyTree:
     size = jax.lax.axis_size(axis_name)
     perm = [(i, (i + shift) % size) for i in range(size)]
+    if monitor_hooks.enabled():  # trace-time count, zero run-time cost
+        monitor_hooks.count_collective(
+            "ppermute", bytes=monitor_hooks.tree_bytes(x), axis=axis_name)
     return jax.tree.map(lambda a: jax.lax.ppermute(a, axis_name, perm), x)
 
 

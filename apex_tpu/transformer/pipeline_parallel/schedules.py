@@ -45,6 +45,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.monitor import hooks as monitor_hooks
 from apex_tpu.parallel import mesh as mesh_lib
 
 PyTree = Any
@@ -233,6 +234,17 @@ def pipeline_spmd_forward(
             )
             outputs = jnp.where(valid, updated, outputs)
             return (sent, outputs, aux_sum), None
+
+    # trace-time telemetry: schedule geometry (M, S, v → ticks, bubble
+    # fraction) and the scanned ppermute's traffic (ticks × one microbatch
+    # activation). S, M, T are static Python ints here, so this costs
+    # nothing unless monitoring is enabled, and nothing at run time either
+    # way (re-emitted per retrace, not per step).
+    monitor_hooks.record_pipeline_schedule(
+        num_microbatches=M, pipeline_size=S, virtual_chunks=v,
+        tick_bytes=(functools.reduce(lambda a, b: a * b, mb_shape, 1)
+                    * microbatches.dtype.itemsize),
+        axis=axis_name)
 
     state0 = jnp.zeros(mb_shape, microbatches.dtype)
     outputs0 = jnp.zeros((M,) + mb_shape, microbatches.dtype)
@@ -482,4 +494,15 @@ def build_schedule(
             and pipeline_model_parallel_size > 1:
         fn = functools.partial(
             fn, virtual_chunks=virtual_pipeline_model_parallel_size)
+    if monitor_hooks.enabled():
+        monitor_hooks.emit_event(
+            "schedule_config",
+            schedule=getattr(fn, "func", fn).__name__,
+            num_microbatches=calc.get(),
+            micro_batch_size=micro_batch_size,
+            global_batch_size=global_batch_size,
+            data_parallel_size=data_parallel_size,
+            pipeline_model_parallel_size=pipeline_model_parallel_size,
+            virtual_chunks=virtual_pipeline_model_parallel_size or 1,
+        )
     return fn, calc

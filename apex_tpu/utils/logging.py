@@ -19,6 +19,7 @@ import os
 import sys
 
 _RANK_INFO: str = ""
+_PROCESS_INDEX: int | None = None  # cached first successful jax.process_index()
 
 
 def set_rank_info(info: str) -> None:
@@ -31,11 +32,38 @@ def get_rank_info() -> str:
     return _RANK_INFO
 
 
+def process_index() -> int:
+    """This host's process index: ``jax.process_index()`` when jax is
+    importable and its backend already initialized (the multi-host truth),
+    else the ``JAX_PROCESS_INDEX`` env var, else 0.
+
+    The jax path is gated on the backend being up — a log line must never
+    be the thing that initializes a TPU backend (import-time records fire
+    before ``conftest``/launchers finish selecting the platform)."""
+    global _PROCESS_INDEX
+    if _PROCESS_INDEX is not None:
+        return _PROCESS_INDEX
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        try:
+            from jax._src import xla_bridge
+
+            if xla_bridge._backends:  # initialized — reading it is free
+                _PROCESS_INDEX = int(jax_mod.process_index())
+                return _PROCESS_INDEX
+        except Exception:  # internals moved / backend mid-init: fall back
+            pass
+    try:
+        return int(os.environ.get("JAX_PROCESS_INDEX", 0))
+    except ValueError:
+        return 0
+
+
 class RankInfoFilter(logging.Filter):
     """Injects ``rank_info`` into every record (cf. RankInfoFormatter)."""
 
     def filter(self, record: logging.LogRecord) -> bool:
-        record.rank_info = _RANK_INFO or f"p{os.environ.get('JAX_PROCESS_INDEX', 0)}"
+        record.rank_info = _RANK_INFO or f"p{process_index()}"
         return True
 
 
@@ -43,7 +71,14 @@ _FORMAT = "%(asctime)s [%(rank_info)s] %(levelname)s %(name)s: %(message)s"
 
 
 def get_logger(name: str = "apex_tpu", level: int | None = None) -> logging.Logger:
-    """Per-module logger factory (cf. ``apex/transformer/log_util.py``)."""
+    """Per-module logger factory (cf. ``apex/transformer/log_util.py``).
+
+    Level precedence, re-evaluated on *every* call (not just the first):
+    an explicit ``level`` argument wins and sticks; otherwise
+    ``APEX_TPU_LOG_LEVEL`` is re-applied — so exporting the env var after a
+    module already configured its logger still takes effect on the next
+    ``get_logger`` — unless a previous call pinned an explicit level; the
+    default is WARNING."""
     logger = logging.getLogger(name)
     if not getattr(logger, "_apex_tpu_configured", False):
         handler = logging.StreamHandler(sys.stderr)
@@ -55,7 +90,8 @@ def get_logger(name: str = "apex_tpu", level: int | None = None) -> logging.Logg
     env_level = os.environ.get("APEX_TPU_LOG_LEVEL")
     if level is not None:
         logger.setLevel(level)
-    elif env_level:
+        logger._apex_tpu_explicit_level = True  # type: ignore[attr-defined]
+    elif env_level and not getattr(logger, "_apex_tpu_explicit_level", False):
         logger.setLevel(env_level.upper())
     elif logger.level == logging.NOTSET:
         logger.setLevel(logging.WARNING)

@@ -12,7 +12,16 @@ attention (materialized scores, jnp softmax, probs saved by autodiff) and
 unfused optax adam — the TPU analog of the reference's "apex vs stock
 PyTorch" pitch (the reference publishes no numbers of its own, SURVEY.md
 §6). ``mfu`` uses the PaLM-style analytic model-FLOPs count
-(6N + 12*L*S*H per token) against the chip's peak bf16 FLOP/s.
+(6N + 12*L*S*H per token) against the chip's peak bf16 FLOP/s — the table
+shared with ``apex_tpu.monitor.report``, so the report CLI derives the
+same MFU from the same convention.
+
+With ``APEX_TPU_MONITOR=<path>`` the bench additionally streams monitor
+telemetry (a ``meta`` record + one ``step`` record per timed fused pass,
+emitted AFTER each pass's clock stops) and ``python -m apex_tpu.monitor
+report <path>`` reproduces the tokens/s headline from them. The printed
+result object is schema-validated before printing (no nan can ship
+inside a success artifact).
 """
 
 import json
@@ -23,16 +32,10 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
-# peak dense bf16 FLOP/s per chip by device kind (public spec sheets)
-_PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
+# the spec-peak table lives in apex_tpu.monitor.report — one table shared
+# by this artifact and `python -m apex_tpu.monitor report`, so "mfu" means
+# the same thing everywhere
+from apex_tpu import monitor
 
 
 def model_flops_per_token(cfg, seq):
@@ -84,7 +87,7 @@ def build(impl: str, cfg_kwargs, donate: bool):
 
 
 def timeit(step, params, opt_state, tokens, targets, iters, passes=3,
-           return_passes=False):
+           return_passes=False, monitor_tokens=None):
     """Min over ``passes`` timed loops (min-of-3, VERDICT r4 next #7) —
     the remote tunnel adds transient stalls, and min-of-N is applied to
     BOTH impls so vs_baseline stays symmetric. ``return_passes``
@@ -92,16 +95,26 @@ def timeit(step, params, opt_state, tokens, targets, iters, passes=3,
     carries its own noise bar (spread = (max-min)/min across passes; a
     single tunnel stall inflates max but never min). Donated buffers
     chain through the pass loop, so one call is safe under donation; do
-    NOT reuse the caller's params/opt_state after it."""
+    NOT reuse the caller's params/opt_state after it.
+
+    ``monitor_tokens`` (tokens per iteration) additionally emits one
+    monitor ``step`` record per timed pass — AFTER the pass's clock stops,
+    so telemetry adds zero time inside the measured window (the <1%
+    monitoring-overhead budget is enforced by construction)."""
     params, opt_state, loss = step(params, opt_state, tokens, targets)  # compile+warm
     float(loss)  # host fetch: the only reliable device sync over the tunnel
     times = []
+    last_loss = None
     for _ in range(passes):
         t0 = time.perf_counter()
         for _ in range(iters):
             params, opt_state, loss = step(params, opt_state, tokens, targets)
-        float(loss)  # forces completion of the whole dependent chain
+        last_loss = float(loss)  # forces completion of the whole dependent chain
         times.append((time.perf_counter() - t0) / iters)
+        if monitor_tokens is not None and monitor.enabled():
+            monitor.begin_step()
+            monitor.end_step(dur_s=times[-1], tokens=monitor_tokens,
+                             loss=last_loss, iters=iters)
     best = min(times)
     if return_passes:
         return best, times
@@ -110,6 +123,7 @@ def timeit(step, params, opt_state, tokens, targets, iters, passes=3,
 
 def main():
     on_tpu = jax.default_backend() == "tpu"
+    monitor.enable_from_env()  # APEX_TPU_MONITOR=<path> streams JSONL
     if on_tpu:
         # remat=False: the un-rematted step fits 16G since the
         # vocab-parallel CE stopped saving an fp32 softmax residual
@@ -155,15 +169,26 @@ def main():
     # which kind of run the driver caught.
     donate = True
 
+    if monitor.enabled():
+        monitor.emit_meta(
+            device_kind=jax.devices()[0].device_kind if on_tpu else "cpu",
+            backend=jax.default_backend(),
+            model_flops_per_token=model_flops_per_token(cfg, seq),
+            batch=batch, seq=seq, iters=iters, config=cfg,
+            metric="gpt_medium_train_step_throughput",
+        )
+
     results = {}
     pass_times = []
     for impl in ("baseline", "fused"):
         os.environ["APEX_TPU_PALLAS"] = "0" if impl == "baseline" else "1"
         step, params, opt_state = build(impl, cfg, donate)
         if impl == "fused":
+            # only the fused (framework) passes are the headline; their
+            # step records are what `monitor report` reproduces tokens/s from
             results[impl], pass_times = timeit(
                 step, params, opt_state, tokens, targets, iters,
-                return_passes=True)
+                return_passes=True, monitor_tokens=batch * seq)
         else:
             results[impl] = timeit(
                 step, params, opt_state, tokens, targets, iters)
@@ -184,8 +209,9 @@ def main():
     tokens_per_s = batch * seq / results["fused"]
     vs_baseline = results["baseline"] / results["fused"]
     flops_per_s = model_flops_per_token(cfg, seq) * tokens_per_s
-    peak = _PEAK_FLOPS.get(jax.devices()[0].device_kind) if on_tpu else None
-    print(json.dumps({
+    peak = (monitor.spec_peak_flops(jax.devices()[0].device_kind)
+            if on_tpu else None)
+    result = {
         "metric": "gpt_medium_train_step_throughput",
         "value": round(tokens_per_s, 1),
         "unit": "tokens/s/chip",
@@ -195,7 +221,15 @@ def main():
         "donated": donate,
         "spread_pct": round(spread * 100, 2),
         "pass_times_ms": [round(t * 1e3, 2) for t in pass_times],
-    }))
+    }
+    # the artifact is schema-checked before it is printed: a nan/inf in a
+    # bench result must crash the bench, never ship inside a success line
+    errors = monitor.validate(result)
+    if errors:
+        raise ValueError(f"bench artifact failed validation: {errors}")
+    if monitor.enabled():
+        monitor.emit_event("bench_result", **result)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -136,6 +136,74 @@ class TestLossScaler:
         assert int(s2.growth_tracker) == 1
 
 
+class TestScalerObservability:
+    """The scaler's observability surface: ``skipped_steps`` and
+    growth-tracker transitions across a full overflow → recovery → growth
+    sequence, and the monitor hook surfacing the same numbers (the AMP half
+    of the ``apex_tpu.monitor`` wiring)."""
+
+    def _snap(self, s):
+        return (float(s.loss_scale), int(s.growth_tracker),
+                int(s.skipped_steps))
+
+    def test_overflow_recovery_growth_transitions(self):
+        s = amp.init_loss_scaler("dynamic", init_scale=2.0 ** 16,
+                                 growth_interval=2)
+        assert self._snap(s) == (2.0 ** 16, 0, 0)
+        # overflow: scale halves, tracker resets, lifetime skip count +1
+        s = amp.update_loss_scaler(s, jnp.asarray(False))
+        assert self._snap(s) == (2.0 ** 15, 0, 1)
+        # recovery: one clean step ticks the tracker, scale holds
+        s = amp.update_loss_scaler(s, jnp.asarray(True))
+        assert self._snap(s) == (2.0 ** 15, 1, 1)
+        # growth: second clean step hits the interval — scale doubles back,
+        # tracker resets, skip count is lifetime (never resets)
+        s = amp.update_loss_scaler(s, jnp.asarray(True))
+        assert self._snap(s) == (2.0 ** 16, 0, 1)
+        # second overflow after the growth: backoff again, count climbs
+        s = amp.update_loss_scaler(s, jnp.asarray(False))
+        assert self._snap(s) == (2.0 ** 15, 0, 2)
+
+    def test_scaler_metrics_pull(self):
+        s = amp.init_loss_scaler("dynamic", init_scale=1024.0)
+        s = amp.update_loss_scaler(s, jnp.asarray(False))
+        m = amp.scaler_metrics(s)
+        assert m == {"loss_scale": 512.0, "growth_tracker": 0,
+                     "skipped_steps": 1}
+        assert all(isinstance(v, (int, float)) and not hasattr(v, "dtype")
+                   for v in m.values())  # host scalars, not arrays
+
+    def test_monitor_hook_surfaces_the_same_numbers(self):
+        import io
+
+        from apex_tpu import monitor
+
+        buf = io.StringIO()
+        monitor.enable(stream=buf)
+        try:
+            s = amp.init_loss_scaler("dynamic", init_scale=2.0 ** 16,
+                                     growth_interval=2)
+            seen = []
+            for finite in (True, False, True, True):
+                monitor.begin_step()
+                s = amp.update_loss_scaler(s, jnp.asarray(finite))
+                pulled = monitor.observe_scaler(s)
+                assert pulled == amp.scaler_metrics(s)
+                seen.append(monitor.end_step(dur_s=1e-3))
+            reg = monitor.get_registry()
+            assert reg.gauges["amp/loss_scale"] == float(s.loss_scale)
+            assert reg.gauges["amp/skipped_steps_total"] == 1
+            # exactly the overflow step carries the per-step overflow count
+            overflow_steps = [r["step"] for r in seen
+                              if r["counters"].get("amp/overflow_steps")]
+            assert overflow_steps == [1]
+            # the stream's gauge trajectory replays the state transitions
+            scales = [r["gauges"]["amp/loss_scale"] for r in seen]
+            assert scales == [2.0 ** 16, 2.0 ** 15, 2.0 ** 15, 2.0 ** 16]
+        finally:
+            monitor.disable()
+
+
 class TestMasterWeights:
     def test_o2_roundtrip(self):
         from apex_tpu.amp import MasterWeights, apply_updates_with_master

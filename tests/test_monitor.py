@@ -1,0 +1,462 @@
+"""Monitor subsystem: registry semantics, hook wiring, schema validation,
+report aggregation, artifact honesty.
+
+The fast tier-1 loop for the telemetry layer: emit → validate → report
+round-trips in-process (no subprocesses, no mesh), plus the bench-parity
+contract — `monitor report` must reproduce tokens/s from the same records
+``bench.py`` emits — and the VERDICT r5 weak-#1 regression guard: no
+artifact path can put ``nan`` inside a line/record that claims OK.
+"""
+
+import importlib.util
+import io
+import json
+import os
+import sys
+
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu import amp, monitor
+from apex_tpu.monitor import report as monitor_report
+from apex_tpu.monitor import schema as monitor_schema
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture
+def registry():
+    buf = io.StringIO()
+    reg = monitor.enable(stream=buf)
+    try:
+        yield reg, buf
+    finally:
+        monitor.disable()
+
+
+def records_of(buf: io.StringIO):
+    return [json.loads(line) for line in buf.getvalue().splitlines()]
+
+
+class TestRegistry:
+    def test_disabled_hooks_are_noops(self):
+        assert not monitor.enabled()
+        # none of these may touch their argument while disabled
+        monitor.counter("x")
+        monitor.gauge("y", 1.0)
+        assert monitor.observe_scaler(object()) is None
+        assert monitor.observe_grads(object()) is None
+        assert monitor.observe_updates(object()) is None
+        assert monitor.end_step() is None
+        with monitor.timer("t"):
+            pass
+
+    def test_counters_gauges_timers(self, registry):
+        reg, _ = registry
+        reg.counter("c")
+        reg.counter("c", 2)
+        reg.gauge("g", 3.5)
+        reg.gauge("g", 4.5)  # last value wins
+        with reg.timer("t"):
+            pass
+        assert reg.counters["c"] == 3
+        assert reg.gauges["g"] == 4.5
+        assert reg.timers["t"][0] == 1
+        assert reg.timers["t"][1] >= 0
+
+    def test_step_records_carry_deltas(self, registry):
+        reg, buf = registry
+        reg.counter("collective/psum[dp]_calls", 5)
+        reg.begin_step()
+        reg.counter("collective/psum[dp]_calls", 2)
+        rec = reg.end_step(tokens=128, dur_s=0.5)
+        # only the in-window delta, not the lifetime total
+        assert rec["counters"] == {"collective/psum[dp]_calls": 2}
+        assert rec["step"] == 0
+        reg.begin_step()
+        rec2 = reg.end_step(dur_s=0.25)
+        assert rec2["step"] == 1
+        assert rec2["counters"] == {}
+        assert len(records_of(buf)) == 2
+
+    def test_counters_total_survive_pre_step_counting(self, registry):
+        """Trace-time collective counts land during warm-up, BEFORE the
+        first step window — the lifetime totals in the step record are how
+        they reach the report."""
+        reg, _ = registry
+        reg.counter("collective/ppermute[pp]_calls", 11)  # "during tracing"
+        reg.begin_step()
+        rec = reg.end_step(dur_s=0.1)
+        assert rec["counters"] == {}  # nothing inside the window
+        assert rec["counters_total"]["collective/ppermute[pp]_calls"] == 11
+        from apex_tpu.monitor.report import aggregate
+
+        summary = aggregate([rec])
+        assert summary["collectives"]["ppermute[pp]"]["calls"] == 11
+
+    def test_repeated_end_step_does_not_double_count(self, registry):
+        reg, _ = registry
+        reg.begin_step()
+        reg.counter("amp/overflow_steps", 1)
+        rec1 = reg.end_step(dur_s=0.1)
+        rec2 = reg.end_step(dur_s=0.1)  # no begin_step: fresh baseline
+        assert rec1["counters"] == {"amp/overflow_steps": 1}
+        assert rec2["counters"] == {}
+
+    def test_enable_truncates_by_default(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        for _ in range(2):
+            reg = monitor.enable(str(path))
+            reg.emit_event("run")
+            monitor.disable()
+        assert len(path.read_text().splitlines()) == 1  # one run, one file
+        reg = monitor.enable(str(path), append=True)
+        reg.emit_event("run")
+        monitor.disable()
+        assert len(path.read_text().splitlines()) == 2
+
+    def test_report_aggregates_last_run_of_appended_file(self, tmp_path):
+        from apex_tpu.monitor.report import aggregate, read_records
+
+        path = tmp_path / "events.jsonl"
+        for best_dur, tokens in ((0.01, 100), (0.02, 100)):
+            reg = monitor.enable(str(path), append=True)
+            reg.emit_meta(device_kind="cpu")
+            reg.begin_step()
+            reg.end_step(dur_s=best_dur, tokens=tokens)
+            monitor.disable()
+        summary = aggregate(read_records(path.read_text().splitlines()))
+        # the stale (faster) first run must NOT leak into the headline
+        assert summary["runs_in_file"] == 2
+        assert summary["num_steps"] == 1
+        assert summary["tokens_per_s"]["best"] == pytest.approx(100 / 0.02)
+
+    def test_rank_tagging(self, registry):
+        from apex_tpu.utils.logging import set_rank_info
+
+        reg, _ = registry
+        set_rank_info("dp0/pp1/cp0/tp0")
+        try:
+            rec = reg.emit_event("x")
+        finally:
+            set_rank_info("")
+        assert rec["rank"] == "dp0/pp1/cp0/tp0"
+        assert isinstance(rec["process"], int)
+
+    def test_enable_from_env_path(self, tmp_path, monkeypatch):
+        path = tmp_path / "events.jsonl"
+        monkeypatch.setenv("APEX_TPU_MONITOR", str(path))
+        reg = monitor.enable_from_env()
+        try:
+            assert reg is not None
+            reg.emit_event("hello")
+        finally:
+            monitor.disable()
+        assert monitor.validate_jsonl(path.read_text().splitlines()) == []
+
+
+class TestHonesty:
+    def test_success_record_with_nan_refused(self, registry):
+        reg, _ = registry
+        with pytest.raises(ValueError, match="non-finite"):
+            reg.emit("gate", name="g", ok=True,
+                     metrics={"loss": float("nan")})
+
+    def test_ok_status_with_inf_refused(self, registry):
+        reg, _ = registry
+        with pytest.raises(ValueError, match="non-finite"):
+            reg.emit("event", name="e", status="OK", value=float("inf"))
+
+    def test_non_success_records_may_carry_nonfinite(self, registry):
+        reg, buf = registry
+        reg.begin_step()
+        reg.end_step(dur_s=0.1, loss=float("nan"))  # diverged loss: allowed
+        (rec,) = records_of(buf)
+        assert rec["loss"] == "nan"  # stringified — the stream stays JSON
+
+    def test_gate_metrics_rejects_nonfinite(self):
+        with pytest.raises(ValueError, match="skipped"):
+            monitor.gate_metrics({"x": float("nan")})
+
+    def test_gate_metrics_skip_objects(self):
+        out = monitor.gate_metrics(
+            {"a": 1.5, "b": ("skipped", "needs n % 16 == 0")})
+        assert out == {"a": 1.5,
+                       "b": {"skipped": True, "reason": "needs n % 16 == 0"}}
+
+    def test_validator_flags_stringified_nan_in_success(self):
+        errs = monitor_schema.validate(
+            {"schema": 1, "kind": "gate", "name": "g", "ok": True,
+             "metrics": {"loss": "nan"}})
+        assert any("nan" in e or "non-finite" in e for e in errs)
+
+
+class TestHooks:
+    def test_observe_scaler_matches_state(self, registry):
+        reg, _ = registry
+        s = amp.init_loss_scaler("dynamic", init_scale=2.0 ** 16)
+        s = amp.update_loss_scaler(s, jnp.asarray(False))
+        m = monitor.observe_scaler(s)
+        assert m == amp.scaler_metrics(s)
+        assert reg.gauges["amp/loss_scale"] == 2.0 ** 15
+        assert reg.gauges["amp/skipped_steps_total"] == 1
+        # delta counting: the second observation adds only the new overflow
+        s = amp.update_loss_scaler(s, jnp.asarray(False))
+        monitor.observe_scaler(s)
+        assert reg.counters["amp/overflow_steps"] == 1
+
+    def test_observe_grads_and_updates(self, registry):
+        reg, _ = registry
+        g = {"w": jnp.asarray([3.0, 4.0]), "step": jnp.zeros((), jnp.int32)}
+        assert monitor.observe_grads(g) == pytest.approx(5.0)
+        assert monitor.observe_updates({"w": jnp.zeros((2,))}) == 0.0
+        assert reg.gauges["optim/grad_norm"] == pytest.approx(5.0)
+        assert reg.gauges["optim/update_norm"] == 0.0
+        out = monitor.observe_optimizer_step(grads=g)
+        assert out["grad_norm"] == pytest.approx(5.0)
+
+    def test_bubble_fraction_matches_schedule_theory(self):
+        # forward sweep is M*v + S - 1 chunk-ticks, S - 1 of them fill/drain
+        # (tests/test_pipeline.py::TestBubbleUtilization measures the same
+        # numbers from the schedule's validity masks)
+        assert monitor.pipeline_bubble_fraction(8, 4, 1) == pytest.approx(
+            3 / 11)
+        assert monitor.pipeline_bubble_fraction(8, 4, 4) == pytest.approx(
+            3 / 35)
+
+    def test_record_pipeline_schedule(self, registry):
+        reg, buf = registry
+        monitor.record_pipeline_schedule(
+            num_microbatches=8, pipeline_size=4, virtual_chunks=2,
+            tick_bytes=1024, axis="pp")
+        assert reg.gauges["pipeline/bubble_fraction"] == pytest.approx(3 / 19)
+        assert reg.counters["collective/ppermute[pp]_calls"] == 19
+        assert reg.counters["collective/ppermute[pp]_bytes"] == 19 * 1024
+        (rec,) = records_of(buf)
+        assert rec["name"] == "pipeline_schedule" and rec["ticks"] == 19
+
+    def test_count_collective_and_tree_bytes(self, registry):
+        reg, _ = registry
+        tree = {"a": jnp.zeros((4, 8), jnp.float32),
+                "b": jnp.zeros((2,), jnp.bfloat16)}
+        nbytes = monitor.tree_bytes(tree)
+        assert nbytes == 4 * 8 * 4 + 2 * 2
+        monitor.count_collective("psum", bytes=nbytes, axis="dp")
+        assert reg.counters["collective/psum[dp]_bytes"] == nbytes
+
+
+class TestRoundTrip:
+    """emit → validate → report, the tier-1 loop of the ISSUE."""
+
+    def _simulate(self, path):
+        reg = monitor.enable(str(path))
+        try:
+            monitor.emit_meta(device_kind="TPU v5p",
+                              model_flops_per_token=1e9,
+                              batch=4, seq=256)
+            monitor.record_pipeline_schedule(
+                num_microbatches=8, pipeline_size=4, tick_bytes=64)
+            scaler = amp.init_loss_scaler("dynamic", init_scale=2.0 ** 16,
+                                          growth_interval=2)
+            durs = [0.02, 0.0199, 0.0201, 0.0198]
+            # overflow on step 1 (after the baseline observation on step 0),
+            # then recovery and growth back at growth_interval=2
+            finites = [True, False, True, True]
+            for dur, finite in zip(durs, finites):
+                monitor.begin_step()
+                scaler = amp.update_loss_scaler(scaler, jnp.asarray(finite))
+                monitor.observe_scaler(scaler)
+                # the pattern a pipelined loop uses: time the blocking
+                # fwd/bwd so the report can derive per-tick wall time
+                monitor.observe_seconds("pipeline/fwd_bwd", dur * 0.8)
+                monitor.end_step(dur_s=dur, tokens=4 * 256, loss=4.5)
+            return durs
+        finally:
+            monitor.disable()
+
+    def test_emit_validate_report(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        durs = self._simulate(path)
+        lines = path.read_text().splitlines()
+        assert monitor.validate_jsonl(lines) == []
+
+        summary = monitor.aggregate(monitor_report.read_records(lines))
+        assert summary["num_steps"] == 4
+        # tokens/s headline = best step, the bench's min-of-passes rule
+        expect = 4 * 256 / min(durs)
+        assert summary["tokens_per_s"]["best"] == pytest.approx(
+            expect, rel=5e-3)
+        # MFU via the shared spec-peak table
+        peak = monitor.PEAK_FLOPS_BY_DEVICE["TPU v5p"]
+        assert summary["mfu"] == pytest.approx(1e9 * expect / peak, rel=1e-6)
+        assert summary["overflow_rate"] == pytest.approx(1 / 4)
+        assert summary["pipeline"]["bubble_fraction"] == pytest.approx(
+            3 / 11, abs=1e-5)
+        # per-(microbatch, stage) wall time: timed fwd/bwd calls / ticks
+        expect_tick = sum(d * 0.8 for d in durs) / 4 / 11
+        assert summary["pipeline"]["per_tick_wall_s"] == pytest.approx(
+            expect_tick, rel=1e-6)
+        # scaler halved on the overflow, then grew back at the interval
+        assert summary["loss_scale_last"] == 2.0 ** 16
+
+    def test_report_cli(self, tmp_path, capsys):
+        path = tmp_path / "events.jsonl"
+        self._simulate(path)
+        assert monitor_report.main(["report", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "tokens/s" in out and "overflow" in out and "bubble" in out
+        assert monitor_report.main(["report", str(path), "--json"]) == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["num_steps"] == 4
+
+
+def _load_validate_tool():
+    tool_path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                             "validate_metrics.py")
+    spec = importlib.util.spec_from_file_location("validate_metrics",
+                                                  tool_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestValidateTool:
+    def test_clean_stream_passes(self, tmp_path):
+        tool = _load_validate_tool()
+        path = tmp_path / "events.jsonl"
+        reg = monitor.enable(str(path))
+        try:
+            reg.emit_event("x")
+            reg.begin_step()
+            reg.end_step(dur_s=0.1)
+        finally:
+            monitor.disable()
+        assert tool.validate_file(str(path)) == []
+
+    def test_bench_wrapper_passes(self, tmp_path):
+        tool = _load_validate_tool()
+        wrapper = {"n": 5, "rc": 0, "tail": "...",
+                   "parsed": {"metric": "m", "value": 1.0, "unit": "u"}}
+        p = tmp_path / "BENCH_x.json"
+        p.write_text(json.dumps(wrapper))
+        assert tool.validate_file(str(p)) == []
+
+    def test_nan_inside_ok_line_fails(self, tmp_path):
+        """The VERDICT r5 weak-#1 artifact shape must be flagged."""
+        tool = _load_validate_tool()
+        wrapper = {"n_devices": 8, "rc": 0, "ok": True,
+                   "tail": "dryrun_multichip(n=8): loss=4.37 "
+                           "tpcp_4axis_loss=nan OK\n"}
+        p = tmp_path / "MULTICHIP_x.json"
+        p.write_text(json.dumps(wrapper))
+        problems = tool.validate_file(str(p))
+        assert problems and "non-finite" in problems[0]
+
+    def test_skip_token_inside_ok_line_passes(self, tmp_path):
+        tool = _load_validate_tool()
+        wrapper = {"n_devices": 8, "rc": 0, "ok": True,
+                   "tail": "dryrun_multichip(n=8): loss=4.37 "
+                           "tpcp_4axis_loss=SKIP(needs-n%16==0) OK\n"}
+        p = tmp_path / "MULTICHIP_x.json"
+        p.write_text(json.dumps(wrapper))
+        assert tool.validate_file(str(p)) == []
+
+    def test_repo_bench_artifacts_validate(self):
+        tool = _load_validate_tool()
+        root = os.path.join(os.path.dirname(__file__), "..")
+        bench_files = sorted(
+            f for f in os.listdir(root)
+            if f.startswith("BENCH_") and f.endswith(".json"))
+        assert bench_files, "repo lost its bench artifacts"
+        for name in bench_files:
+            assert tool.validate_file(os.path.join(root, name)) == [], name
+
+
+class TestLoggingSatellite:
+    """The logging fixes riding with the monitor PR: APEX_TPU_LOG_LEVEL is
+    re-applied on every get_logger call, and the rank fallback can come
+    from jax.process_index() once the backend is up."""
+
+    def test_env_level_reapplied_after_first_configuration(self, monkeypatch):
+        import logging
+
+        from apex_tpu.utils.logging import get_logger
+
+        name = "apex_tpu.test_monitor.env_level"
+        monkeypatch.delenv("APEX_TPU_LOG_LEVEL", raising=False)
+        assert get_logger(name).level == logging.WARNING
+        monkeypatch.setenv("APEX_TPU_LOG_LEVEL", "DEBUG")
+        assert get_logger(name).level == logging.DEBUG  # took effect late
+        monkeypatch.setenv("APEX_TPU_LOG_LEVEL", "ERROR")
+        assert get_logger(name).level == logging.ERROR
+
+    def test_explicit_level_pins_against_env(self, monkeypatch):
+        import logging
+
+        from apex_tpu.utils.logging import get_logger
+
+        name = "apex_tpu.test_monitor.pinned"
+        get_logger(name, level=logging.INFO)
+        monkeypatch.setenv("APEX_TPU_LOG_LEVEL", "CRITICAL")
+        assert get_logger(name).level == logging.INFO
+
+    def test_process_index_from_jax_when_backend_up(self):
+        import jax
+
+        import apex_tpu.utils.logging as log_util
+
+        log_util._PROCESS_INDEX = None  # drop the cache
+        try:
+            jax.devices()  # backend definitely initialized now
+            assert log_util.process_index() == jax.process_index()
+        finally:
+            log_util._PROCESS_INDEX = None
+
+    def test_rank_filter_uses_fallback(self):
+        import logging
+
+        from apex_tpu.utils.logging import RankInfoFilter, get_rank_info
+
+        assert get_rank_info() == ""  # no mesh in this test
+        record = logging.LogRecord("n", logging.INFO, "p", 1, "m", (), None)
+        assert RankInfoFilter().filter(record)
+        assert record.rank_info.startswith("p")
+
+
+class TestGateReporting:
+    """__graft_entry__'s gate artifact: SKIP sentinels, schema'd record."""
+
+    def test_report_gate_renders_skips_not_nan(self, capsys):
+        import __graft_entry__ as graft
+
+        graft._report_gate(
+            4, dp=2, pp=2, tp=1, cp=2,
+            loss=4.5, moe_4axis_loss=4.4,
+            cp_pipe_loss=4.3,
+            t5_loss=18.8,
+            tpcp_4axis_loss=graft._SKIP("needs n_devices % 16 == 0"),
+            ring_vs_flash=3e-7,
+        )
+        out = capsys.readouterr().out
+        gate_line = [l for l in out.splitlines() if l.endswith(" OK")][0]
+        assert "nan" not in gate_line
+        assert "tpcp_4axis_loss=SKIP(needs-n_devices-%-16-==-0)" in gate_line
+        json_line = [l for l in out.splitlines()
+                     if l.startswith("MULTICHIP_GATE ")][0]
+        record = json.loads(json_line[len("MULTICHIP_GATE "):])
+        assert monitor.validate(record) == []
+        assert record["metrics"]["tpcp_4axis_loss"] == {
+            "skipped": True, "reason": "needs n_devices % 16 == 0"}
+        assert record["metrics"]["loss"] == 4.5
+
+    def test_report_gate_refuses_nan_measurement(self, capsys):
+        import __graft_entry__ as graft
+
+        with pytest.raises(ValueError, match="skipped"):
+            graft._report_gate(
+                4, dp=2, pp=2, tp=1, cp=2,
+                loss=float("nan"), moe_4axis_loss=4.4, cp_pipe_loss=4.3,
+                t5_loss=18.8, tpcp_4axis_loss=graft._SKIP("x"),
+                ring_vs_flash=3e-7,
+            )
+        assert " OK" not in capsys.readouterr().out

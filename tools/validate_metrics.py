@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Validate monitor JSONL streams and bench/gate JSON artifacts.
+
+Usage::
+
+    python tools/validate_metrics.py events.jsonl BENCH_r05.json ...
+
+Dispatch is by content, not extension:
+
+* ``.jsonl`` files (or any file whose first non-blank line parses as a
+  JSON object with a ``kind``) validate as a monitor event stream against
+  :mod:`apex_tpu.monitor.schema`;
+* bench result objects (``{"metric": ..., "value": ...}``) validate
+  against the BENCH schema;
+* driver wrappers are unwrapped: ``{"parsed": {...}}`` (BENCH_r*.json)
+  validates the inner result; ``{"ok": ..., "tail": ...}``
+  (MULTICHIP_r*.json) additionally enforces the artifact-honesty rule on
+  the captured gate output — an OK line carrying ``=nan``/``=inf`` fails
+  (VERDICT r5 weak #1), and any embedded ``MULTICHIP_GATE`` JSON record is
+  schema-validated.
+
+Exit status 0 when every file is clean; 1 otherwise, with one problem per
+line on stderr. The logic lives in ``apex_tpu.monitor.schema`` so tests
+and the emitter share it; this file is the CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from apex_tpu.monitor import schema  # noqa: E402
+
+# a token like loss=nan / ring_vs_flash=inf inside a success line
+_NAN_TOKEN = re.compile(r"=\s*(nan|[+-]?inf(inity)?)\b", re.IGNORECASE)
+
+
+def check_gate_tail(tail: str) -> list:
+    """Honesty scan of captured gate stdout: success lines must not carry
+    non-finite metric tokens, and embedded MULTICHIP_GATE records must
+    validate."""
+    problems = []
+    for line in tail.splitlines():
+        stripped = line.strip()
+        if stripped.startswith("MULTICHIP_GATE "):
+            try:
+                record = json.loads(stripped[len("MULTICHIP_GATE "):])
+            except json.JSONDecodeError as e:
+                problems.append(f"embedded gate record is invalid JSON: {e}")
+                continue
+            problems.extend(f"embedded gate record: {err}"
+                            for err in schema.validate(record))
+        elif stripped.endswith(" OK") or stripped == "OK":
+            if _NAN_TOKEN.search(stripped):
+                problems.append(
+                    f"OK line carries a non-finite metric token: {stripped!r}")
+    return problems
+
+
+def validate_object(obj) -> list:
+    """Validate one JSON artifact object, unwrapping driver envelopes."""
+    if isinstance(obj, dict) and "kind" in obj:
+        return schema.validate(obj)
+    if isinstance(obj, dict) and "metric" in obj:
+        return schema.validate(obj, schema.BENCH_SCHEMA)
+    if isinstance(obj, dict) and isinstance(obj.get("parsed"), dict):
+        return [f"parsed: {e}"
+                for e in schema.validate(obj["parsed"], schema.BENCH_SCHEMA)]
+    if isinstance(obj, dict) and "tail" in obj:
+        if obj.get("ok") or obj.get("rc") == 0:
+            return check_gate_tail(str(obj["tail"]))
+        return []  # failed runs may contain anything; they claim nothing
+    return ["unrecognized artifact shape (no kind/metric/parsed/tail)"]
+
+
+def validate_file(path: str) -> list:
+    problems = []
+    with open(path) as fh:
+        text = fh.read()
+    # one JSON value in the whole file → single artifact; otherwise JSONL
+    obj = None
+    if not path.endswith(".jsonl"):
+        try:
+            obj = json.loads(text)
+        except json.JSONDecodeError:
+            obj = None
+    if obj is None:
+        for lineno, err in schema.validate_jsonl(text.splitlines()):
+            problems.append(f"{path}:{lineno}: {err}")
+        return problems
+    problems.extend(f"{path}: {e}" for e in validate_object(obj))
+    return problems
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    all_problems = []
+    for path in argv:
+        all_problems.extend(validate_file(path))
+    for problem in all_problems:
+        print(problem, file=sys.stderr)
+    if not all_problems:
+        print(f"{len(argv)} artifact(s) valid")
+    return 1 if all_problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
