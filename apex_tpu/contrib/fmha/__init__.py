@@ -47,11 +47,27 @@ def fmha_varlen(qkv, cu_seqlens, max_s: int, p_dropout: float = 0.0,
     is the in-kernel counter-hash probs dropout. Attention is per-row:
     tokens never attend across ``cu_seqlens`` boundaries (the kernels'
     per-batch ``kv_lens`` masking after scattering to the padded
-    layout)."""
+    layout).
+
+    ``max_s`` must be >= the longest row: the scatter into the padded
+    (b, max_s, ...) layout DROPS out-of-bounds tokens (JAX scatter
+    semantics), silently truncating any row longer than ``max_s``. With a
+    concrete ``cu_seqlens`` that is checked eagerly here (raises); when
+    ``cu_seqlens`` is traced (inside jit) the check cannot run and the
+    truncation hazard is the CALLER's to exclude — pass the true padded
+    length, as the reference API requires (``fmha.py:35``)."""
     total, three, h, d = qkv.shape
     if three != 3:
         raise ValueError(f"qkv must be (total, 3, h, d); got {qkv.shape}")
     b = cu_seqlens.shape[0] - 1
+    if not isinstance(cu_seqlens, jax.core.Tracer):
+        import numpy as np
+        row_lens = np.diff(np.asarray(cu_seqlens))
+        if row_lens.size and int(row_lens.max()) > max_s:
+            raise ValueError(
+                f"max_s ({max_s}) is smaller than the longest row "
+                f"({int(row_lens.max())}): the padded-layout scatter would "
+                f"silently drop that row's tokens past max_s")
     cu_seqlens = cu_seqlens.astype(jnp.int32)
     seg, pos = _unpack_indices(cu_seqlens, total)
     padded = jnp.zeros((b, max_s, 3, h, d), qkv.dtype).at[seg, pos].set(qkv)

@@ -79,9 +79,13 @@ def _attention(q, k, v, *, causal, rate, key, attn_mask=None,
     b, sq, h, d = q.shape
     sk = k.shape[1]
     rate = float(rate)
-    seed = seed_from_key(key) if (rate > 0 and key is not None) else None
-    if seed is None:
-        rate = 0.0
+    if rate > 0 and key is None:
+        # fmha_varlen parity: training-mode dropout without a key used to
+        # silently run dropout-free — a silent train/eval mismatch; fail
+        raise ValueError(
+            "dropout > 0 with is_training=True needs a PRNG key (pass "
+            "key=..., or is_training=False for eval)")
+    seed = seed_from_key(key) if rate > 0 else None
     if attn_mask is not None:
         attn_mask = _norm_attn_mask(attn_mask, h, sq, sk)
     if key_padding_mask is not None:

@@ -1,0 +1,28 @@
+"""Inference: KV-cached autoregressive decode + encoder serving.
+
+The serving tier the training-only reference never had (ROADMAP north
+star: "serves heavy traffic from millions of users"). Three pieces:
+
+* :class:`~apex_tpu.inference.engine.DecodeEngine` — batched generation
+  for the flagship GPT: pre-allocated donated KV cache in the
+  attention-native ``(layers, batch, kv_heads, max_s, head_dim)`` layout,
+  jit'd ``prefill`` (reuses the flash-attention training forward) and a
+  ``decode_step`` that compiles ONCE (stable avals, in-place
+  ``dynamic_update_slice`` cache writes) — greedy, temperature, and
+  top-k sampling;
+* :func:`~apex_tpu.inference.engine.jit_encoder` — BERT-style encoder
+  serving (stable-aval jit of the training forward; encoders need no
+  cache);
+* :func:`~apex_tpu.inference.sampling.sample_logits` — the sampling
+  primitive.
+
+The fused decode-attention op lives in
+:func:`apex_tpu.ops.decode_attention` (Pallas kernel + XLA fallback);
+the cached model math in :class:`apex_tpu.models.GPTModel`'s
+``prefill_block``/``decode_qkv``/``decode_block`` branch. Serving
+throughput is measured by ``python bench.py --decode`` (see
+``docs/api/inference.md`` for the cache-layout and HBM-bound analysis).
+"""
+
+from apex_tpu.inference.engine import DecodeEngine, jit_encoder  # noqa: F401
+from apex_tpu.inference.sampling import sample_logits  # noqa: F401

@@ -1,0 +1,213 @@
+"""KV-cached autoregressive decode engine for the flagship GPT.
+
+The serving path the training repo lacked: generating a token by re-running
+the full forward over the whole prefix is O(s²) work per token; with a KV
+cache each new token costs one token's projections plus ONE streaming pass
+over the cache — the O(s) HBM-bound floor decode lives at ("LLM Inference
+Acceleration via Efficient Operation Fusion", arXiv:2502.17728: the decode
+hot path is memory-bound and won by removing staging traffic and per-token
+dispatch, not FLOPs).
+
+Design contract (what makes ``decode_step`` compile ONCE and stay compiled):
+
+* **Pre-allocated, donated cache.** ``init_cache`` allocates
+  ``(layers, batch, kv_heads, max_s, head_dim)`` k/v buffers up front —
+  the attention-native layout :func:`apex_tpu.ops.decode_attention` reads
+  directly. Every step updates them via ``lax.dynamic_update_slice`` at a
+  *traced* position, so the avals never change; ``donate_argnums`` hands
+  the buffers back to XLA so the update is in place — no per-token HBM
+  realloc, no copy of the O(layers·batch·max_s) state.
+* **Stable avals everywhere.** The step signature is
+  ``(params, cache, tokens (b,), pos scalar, key)`` — every argument keeps
+  one shape/dtype for the whole generation, so the jit cache holds exactly
+  one executable (asserted by ``tests/test_inference.py`` via
+  ``decode_step._cache_size()``).
+* **Static sampling config.** temperature/top-k are fixed at engine
+  construction (they select the sampling program, not data).
+
+Prefill reuses the training forward (flash-attention blocks) over the whole
+prompt at once and returns the populated cache — one compile per distinct
+prompt length (pad prompts to a few bucket lengths to bound that).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.inference.sampling import sample_logits
+from apex_tpu.models.gpt import GPTModel
+from apex_tpu.ops import fused_layer_norm
+
+
+class DecodeEngine:
+    """Batched greedy/sampling generation over a :class:`GPTModel`.
+
+    ``engine = DecodeEngine(model)``;
+    ``tokens = engine.generate(params, prompt, max_new_tokens)``.
+
+    ``max_seq_len`` caps the cache (default: the model's); allocate it as
+    a multiple of 128 so the fused decode kernel's tiling constraint holds
+    on TPU (any length works functionally — the op falls back to XLA).
+    ``cache_dtype`` defaults to the model's param dtype; serve bf16 caches
+    for 2x cache capacity at bf16-activation quality.
+    """
+
+    def __init__(self, model: GPTModel, *, max_seq_len: Optional[int] = None,
+                 cache_dtype: Any = None, temperature: float = 0.0,
+                 top_k: int = 0):
+        model.check_decode_supported()
+        self.model = model
+        c = self.config = model.config
+        self.max_s = int(max_seq_len or c.max_seq_len)
+        if self.max_s > c.max_seq_len:
+            raise ValueError(
+                f"cache max_seq_len ({self.max_s}) exceeds the model's "
+                f"position table ({c.max_seq_len})")
+        self.cache_dtype = cache_dtype or c.dtype
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        # one jitted executable each; decode additionally donates the cache
+        # (argnums: params=0, cache=1, tokens=2, pos=3, key=4)
+        self.prefill = jax.jit(self._prefill)
+        self.decode_step = jax.jit(self._decode_step, donate_argnums=(1,))
+
+    # --- cache ---------------------------------------------------------------
+
+    def init_cache(self, batch: int):
+        """Pre-allocated zeroed KV cache:
+        ``{"k"/"v": (layers, batch, kv_heads, max_s, head_dim)}``."""
+        c = self.config
+        shape = (c.num_layers, batch, c.local_kv_heads, self.max_s,
+                 c.head_dim)
+        return {"k": jnp.zeros(shape, self.cache_dtype),
+                "v": jnp.zeros(shape, self.cache_dtype)}
+
+    def cache_bytes(self, batch: int) -> int:
+        """HBM footprint of one cache (both k and v), for capacity math."""
+        c = self.config
+        itemsize = jnp.dtype(self.cache_dtype).itemsize
+        return (2 * c.num_layers * batch * c.local_kv_heads * self.max_s
+                * c.head_dim * itemsize)
+
+    # --- prefill -------------------------------------------------------------
+
+    def _sample(self, logits, key):
+        return sample_logits(logits, key, temperature=self.temperature,
+                             top_k=self.top_k)
+
+    def _prefill(self, params, tokens, key):
+        """Prompt (b, s) → (cache populated at [0, s), next token (b,),
+        last-position logits (b, V)). The forward is the training block
+        structure (flash attention over the full prompt) with each layer's
+        k/v exposed — cache contents ARE the training forward's k/v."""
+        model, c = self.model, self.config
+        b, s = tokens.shape
+        x = model.embedding(params["embedding"], tokens)
+        x = x + params["pos_embedding"][:s]
+        ks, vs = [], []
+        for i in range(c.num_layers):
+            layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+            x, (k, v) = model.prefill_block(layer, x)
+            ks.append(k)
+            vs.append(v)
+        x = fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
+        logits = model.unembed(params, x[:, -1:])[:, 0]
+        cache = self.init_cache(b)
+        # static-length write: s is a trace-time constant of this prompt
+        cache = {
+            "k": cache["k"].at[:, :, :, :s].set(
+                jnp.stack(ks).astype(self.cache_dtype)),
+            "v": cache["v"].at[:, :, :, :s].set(
+                jnp.stack(vs).astype(self.cache_dtype)),
+        }
+        return cache, self._sample(logits, key), logits
+
+    # --- decode --------------------------------------------------------------
+
+    def _decode_step(self, params, cache, tokens, pos, key):
+        """One generation step: run ``tokens`` (b,) — the tokens at
+        position ``pos`` (scalar int32, count of cache rows already live)
+        — through the stack against the cache, write their k/v at ``pos``,
+        and sample position ``pos+1``'s tokens. Returns (cache, next
+        tokens, logits). Avals are independent of ``pos``: compiled
+        exactly once per (batch, cache shape)."""
+        model, c = self.model, self.config
+        b = tokens.shape[0]
+        pos = jnp.asarray(pos, jnp.int32)
+        x = model.embedding(params["embedding"], tokens[:, None])
+        x = x + jax.lax.dynamic_slice(
+            params["pos_embedding"], (pos, 0), (1, c.hidden_size))[None]
+        ck, cv = cache["k"], cache["v"]
+        lengths = jnp.full((b,), pos + 1, jnp.int32)
+        zero = jnp.int32(0)
+        for i in range(c.num_layers):
+            layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+            q, k_row, v_row = model.decode_qkv(layer, x)
+            # in-place row write into the DONATED stacked buffers (layer
+            # index static, position traced — one executable for all pos)
+            ck = jax.lax.dynamic_update_slice(
+                ck, k_row[None].astype(ck.dtype),
+                (jnp.int32(i), zero, zero, pos, zero))
+            cv = jax.lax.dynamic_update_slice(
+                cv, v_row[None].astype(cv.dtype),
+                (jnp.int32(i), zero, zero, pos, zero))
+            x = model.decode_block(layer, x, q, ck[i], cv[i], lengths)
+        x = fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
+        logits = model.unembed(params, x)[:, 0]
+        return {"k": ck, "v": cv}, self._sample(logits, key), logits
+
+    # --- generation loop -----------------------------------------------------
+
+    def generate(self, params, prompt, max_new_tokens: int,
+                 key: Optional[jax.Array] = None) -> jax.Array:
+        """Greedy/sampled continuation: prompt (b, s) int32 → generated
+        tokens (b, max_new_tokens). Python-loop driver over the jit'd
+        steps; the loop body re-binds the donated cache each step."""
+        b, s = prompt.shape
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens} (the "
+                f"prefill itself samples the first token)")
+        if s + max_new_tokens > self.max_s:
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the cache ({self.max_s})")
+        if self.temperature > 0 and key is None:
+            raise ValueError("temperature > 0 generation requires a key")
+        if key is None:  # greedy: the key operand is ignored but keeps the
+            key = jax.random.PRNGKey(0)  # step signature (and avals) fixed
+        cache, tok, _ = self.prefill(params, prompt,
+                                     jax.random.fold_in(key, 0))
+        out = [tok]
+        for t in range(1, max_new_tokens):
+            cache, tok, _ = self.decode_step(
+                params, cache, tok, jnp.int32(s + t - 1),
+                jax.random.fold_in(key, t))
+            out.append(tok)
+        return jnp.stack(out, axis=1)
+
+
+def jit_encoder(model, *, with_pooler: bool = True):
+    """BERT-style encoder serving: the trivial reuse case — encoders have
+    no autoregressive structure, so "inference engine" is just the
+    training forward jit'd with stable (padded-batch) avals. Returns
+    ``encode(params, tokens, token_types=None, pad_mask=None)`` →
+    (hidden (b, s, H), pooled (b, H) or None). Pad every request batch to
+    fixed (b, s) buckets and pass ``pad_mask`` so one executable serves
+    all traffic."""
+    @functools.partial(jax.jit, static_argnames=("pool",))
+    def _encode(params, tokens, token_types, pad_mask, pool):
+        hidden = model.hidden_states(params, tokens, token_types=token_types,
+                                     pad_mask=pad_mask)
+        pooled = model.pooled(params, hidden) if pool else None
+        return hidden, pooled
+
+    def encode(params, tokens, token_types=None, pad_mask=None
+               ) -> Tuple[jax.Array, Optional[jax.Array]]:
+        return _encode(params, tokens, token_types, pad_mask, with_pooler)
+
+    return encode

@@ -1,0 +1,46 @@
+"""Token sampling for the decode loop.
+
+Greedy, temperature, and top-k sampling over the last-position logits.
+``temperature`` and ``top_k`` are STATIC (python numbers fixed at engine
+construction): inside the jit'd ``decode_step`` they select the sampling
+program once — the sampled path never branches at run time, which is part
+of the zero-recompile contract (the alternative, traced sampling knobs,
+would either re-trace per setting or drag a dynamic ``top_k`` sort into
+every step).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# masked-out logit value for top-k filtering; finite (not -inf) so a
+# pathological all-filtered row degrades to uniform instead of NaN
+_FILTERED = -1e30
+
+
+def sample_logits(logits: jax.Array, key: Optional[jax.Array] = None,
+                  *, temperature: float = 0.0, top_k: int = 0) -> jax.Array:
+    """(b, V) logits → (b,) int32 token ids.
+
+    ``temperature == 0`` is greedy argmax (no key needed). Otherwise the
+    categorical draw runs over ``logits / temperature``, optionally
+    restricted to each row's ``top_k`` highest logits (``top_k == 0`` =
+    full vocab). The softmax normalization happens inside
+    ``jax.random.categorical`` via the Gumbel trick — no materialized
+    probability vector."""
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if key is None:
+        raise ValueError("temperature > 0 sampling requires a PRNG key")
+    scaled = logits.astype(jnp.float32) / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled < kth, _FILTERED, scaled)
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)

@@ -539,6 +539,85 @@ class GPTModel:
                 block = jax.checkpoint(block)
         return block
 
+    # --- KV-cached inference branch -------------------------------------------
+    #
+    # The decode-time twin of _attention/_block: prefill runs the training
+    # forward once over the prompt and EXPOSES each layer's k/v in the
+    # attention-native cache layout (b, h_kv, s, d); decode_block runs ONE
+    # token through a block against the pre-allocated cache via the fused
+    # decode-attention op. Cache allocation, the in-place
+    # dynamic_update_slice writes, and sampling live in
+    # apex_tpu.inference.DecodeEngine — this branch holds only model math,
+    # so a weight-layout change cannot strand the inference path.
+    # Inference-only: no dropout, single-chip (tp_size == 1), dense MLP.
+
+    def check_decode_supported(self):
+        c = self.config
+        if c.tp_size > 1 or self.moe or c.cp_axis is not None:
+            raise NotImplementedError(
+                "the KV-cached decode path is single-chip dense-MLP only "
+                "(tp_size == 1, no MoE, no context parallelism) — serve "
+                "tp-sharded checkpoints by merging shards first")
+
+    def _proj_qkv_bshd(self, p, x):
+        """(b, s, H) → seq-major q (b, s, h, d), k/v (b, s, h_kv, d) via
+        the packed projection — the SAME weight slicing every training
+        path uses (``bshd_qkv_projection``), so cached k/v are the
+        training forward's k/v activations by construction."""
+        from apex_tpu.ops.attention import bshd_qkv_projection
+        c = self.config
+        return bshd_qkv_projection(
+            x, p["qkv"]["weight"], p["qkv"].get("bias"),
+            c.local_heads, c.local_kv_heads, c.head_dim)
+
+    def _proj_attn_out(self, p, ctx):
+        """(b, s, h, d) context → (b, s, H) through the output weight."""
+        from apex_tpu.ops.attention import bshd_output_projection
+        c = self.config
+        y = bshd_output_projection(
+            ctx, p["attn_out"]["weight"], c.local_heads, c.head_dim)
+        if "bias" in p["attn_out"]:
+            y = y + p["attn_out"]["bias"]
+        return y
+
+    def prefill_block(self, p, x):
+        """One block of the PREFILL forward: the training block (pre-LN →
+        causal attention → residual → pre-LN → MLP → residual, no dropout)
+        that additionally returns this layer's (k, v) in the cache layout
+        (b, h_kv, s, d) — what the engine writes into cache positions
+        [0, s)."""
+        h_in = fused_layer_norm(x, p["ln1_w"], p["ln1_b"])
+        q, k, v = self._proj_qkv_bshd(p, h_in)
+        from apex_tpu.ops.attention import flash_attention
+        ctx = flash_attention(q, k, v, causal=True, layout="bshd")
+        x = x + self._proj_attn_out(p, ctx)
+        m = self._mlp(p, fused_layer_norm(x, p["ln2_w"], p["ln2_b"]))
+        return x + m, (k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3))
+
+    def decode_qkv(self, p, x):
+        """ONE token's attention inputs: pre-LN + packed projection of the
+        residual stream x (b, 1, H) → q (b, h, d) plus this token's cache
+        rows k/v (b, h_kv, 1, d) — shaped for the engine's
+        ``dynamic_update_slice`` write at the current position (the write
+        happens BEFORE attention so the token attends to itself)."""
+        h_in = fused_layer_norm(x, p["ln1_w"], p["ln1_b"])
+        q, k, v = self._proj_qkv_bshd(p, h_in)
+        return q[:, 0], k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
+
+    def decode_block(self, p, x, q, k_lay, v_lay, lengths):
+        """One token through one block against this layer's cache slices
+        (ALREADY holding the token's own k/v row — the engine writes
+        between :meth:`decode_qkv` and this call): x (b, 1, H) is the
+        block's residual-stream input, ``q`` (b, h, d) the token's query
+        heads, ``k_lay``/``v_lay`` (b, h_kv, max_s, d), ``lengths`` (b,)
+        the live prefix length INCLUDING this token. Returns the block
+        output (b, 1, H)."""
+        from apex_tpu.ops import decode_attention
+        ctx = decode_attention(q, k_lay, v_lay, lengths)
+        x = x + self._proj_attn_out(p, ctx[:, None])
+        m = self._mlp(p, fused_layer_norm(x, p["ln2_w"], p["ln2_b"]))
+        return x + m
+
     # --- forward --------------------------------------------------------------
 
     def hidden_states(self, params, tokens, key=None):

@@ -46,6 +46,7 @@ from apex_tpu.monitor.registry import (  # noqa: F401
     check_record_honesty,
     counter,
     disable,
+    emit_decode,
     emit_event,
     emit_meta,
     enable,

@@ -227,6 +227,22 @@ class MetricsRegistry:
     def emit_event(self, name: str, **fields) -> Dict[str, Any]:
         return self.emit("event", name=name, **fields)
 
+    def emit_decode(self, status: str, **fields) -> Dict[str, Any]:
+        """Serving-bench record (``bench.py --decode``). ``status`` "OK"
+        puts the record under the honesty rule (finite numbers or explicit
+        ``("skipped", reason)`` tuples only — normalized here through
+        :func:`apex_tpu.monitor.schema.gate_metrics` semantics); "SKIP"
+        requires a ``reason``."""
+        if status not in ("OK", "SKIP"):
+            raise ValueError(f"status must be OK|SKIP, got {status!r}")
+        if status == "SKIP" and not fields.get("reason"):
+            raise ValueError("a SKIP decode record must carry a reason")
+        for name, v in list(fields.items()):
+            if (isinstance(v, tuple) and len(v) == 2
+                    and v[0] == "skipped"):
+                fields[name] = {"skipped": True, "reason": str(v[1])}
+        return self.emit("decode", status=status, **fields)
+
     # -- step lifecycle ------------------------------------------------------
 
     def begin_step(self, step: Optional[int] = None) -> None:
@@ -393,6 +409,13 @@ def emit_meta(**fields) -> Optional[Dict[str, Any]]:
     r = _REGISTRY
     if r is not None:
         return r.emit_meta(**fields)
+    return None
+
+
+def emit_decode(status: str, **fields) -> Optional[Dict[str, Any]]:
+    r = _REGISTRY
+    if r is not None:
+        return r.emit_decode(status, **fields)
     return None
 
 

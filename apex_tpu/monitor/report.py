@@ -67,6 +67,7 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     meta: Dict[str, Any] = {}
     steps = []
     gate_records = []
+    decode_records = []
     schedule = None
     for rec in records:
         kind = rec.get("kind")
@@ -78,6 +79,8 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             steps.append(rec)
         elif kind == "gate":
             gate_records.append(rec)
+        elif kind == "decode":
+            decode_records.append(rec)
         elif kind == "event" and rec.get("name") == "pipeline_schedule":
             schedule = rec
 
@@ -179,6 +182,21 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     if collectives:
         summary["collectives"] = collectives
 
+    if decode_records:
+        # the serving leg: last record wins (same one-run-per-stream rule
+        # the step headline follows); explicit skip objects surface as a
+        # skipped-metric list, mirroring the gate summary
+        d = decode_records[-1]
+        summary["decode"] = {
+            "status": d.get("status"),
+            "skipped": sorted(k for k, v in d.items()
+                              if isinstance(v, dict) and v.get("skipped")),
+            **{k: d[k] for k in ("tokens_per_s", "prefill_ms", "spread_pct",
+                                 "vs_naive", "batch", "prompt_len",
+                                 "new_tokens", "reason")
+               if isinstance(d.get(k), (int, float, str))},
+        }
+
     if gate_records:
         summary["gates"] = [
             {"name": g.get("name"), "ok": g.get("ok"),
@@ -229,6 +247,21 @@ def render(summary: Dict[str, Any]) -> str:
         lines.append(f"  collective  {name}: {calls:g} calls"
                      + (f", {nbytes/1e6:.2f} MB" if nbytes else "")
                      + "  (per traced program)")
+    dec = summary.get("decode")
+    if dec:
+        if dec.get("status") == "SKIP":
+            lines.append(f"  decode      SKIP({dec.get('reason', '?')})")
+        else:
+            parts = []
+            if isinstance(dec.get("tokens_per_s"), (int, float)):
+                parts.append(f"{dec['tokens_per_s']:.1f} tok/s/chip")
+            if isinstance(dec.get("prefill_ms"), (int, float)):
+                parts.append(f"prefill {dec['prefill_ms']:.2f} ms")
+            if isinstance(dec.get("vs_naive"), (int, float)):
+                parts.append(f"{dec['vs_naive']:.2f}x vs naive recompute")
+            if dec.get("skipped"):
+                parts.append("skipped: " + ", ".join(dec["skipped"]))
+            lines.append("  decode      " + "   ".join(parts))
     for gate in summary.get("gates", []):
         skipped = (", skipped: " + ", ".join(gate["skipped"])
                    if gate["skipped"] else "")

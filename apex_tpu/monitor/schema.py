@@ -3,8 +3,9 @@ self-contained validator.
 
 One schema family covers every JSON artifact the repo emits:
 
-* monitor JSONL records (``kind`` ∈ meta/event/step/gate) — the stream
-  written by :mod:`apex_tpu.monitor.registry`;
+* monitor JSONL records (``kind`` ∈ meta/event/step/gate/decode) — the
+  stream written by :mod:`apex_tpu.monitor.registry` (``decode`` is the
+  serving-bench record ``bench.py --decode`` emits);
 * ``BENCH_*.json``-style bench result objects (the line ``bench.py``
   prints);
 * the MULTICHIP gate record printed by ``__graft_entry__.dryrun_multichip``.
@@ -124,11 +125,40 @@ BENCH_SCHEMA = {
     "required": ["metric", "value", "unit"],
 }
 
+# serving-bench step event (`python bench.py --decode`): one record per
+# decode bench run. status "OK" engages the honesty rule (no non-finite
+# values anywhere); a leg that cannot be measured honestly (e.g. the naive
+# recompute baseline off-TPU) rides as an explicit skip object, and an
+# entirely unmeasurable leg is status "SKIP" with a reason — never nan.
+DECODE_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["decode"]},
+        "status": {"enum": ["OK", "SKIP"]},
+        "reason": {"type": "string"},  # required when status == "SKIP"
+        "tokens_per_s": _METRIC_VALUE,   # decode throughput per chip
+        "prefill_ms": _METRIC_VALUE,     # one prompt through prefill
+        "spread_pct": _METRIC_VALUE,     # (max-min)/min over timed passes
+        "naive_tokens_per_s": _METRIC_VALUE,  # recompute-the-prefix baseline
+        "vs_naive": _METRIC_VALUE,            # cached / naive ratio
+        "batch": {"type": "integer"},
+        "prompt_len": {"type": "integer"},
+        "new_tokens": {"type": "integer"},
+        "max_seq_len": {"type": "integer"},
+        "pass_times_ms": {"type": "array", "items": {"type": "number"}},
+        "config": {"type": "object"},
+        "backend": {"type": "string"},
+    },
+    "required": ["schema", "kind", "status"],
+}
+
 SCHEMAS_BY_KIND = {
     "step": STEP_SCHEMA,
     "meta": META_SCHEMA,
     "event": EVENT_SCHEMA,
     "gate": GATE_SCHEMA,
+    "decode": DECODE_SCHEMA,
 }
 
 # --- minimal JSON-Schema subset validator ------------------------------------
@@ -223,6 +253,12 @@ def validate(record: Dict[str, Any],
     errors: List[str] = []
     _check(record, schema, "", errors)
     errors.extend(_honesty_errors(record))
+    # the conditional half of the decode contract (the emitter enforces it
+    # too, but externally produced streams must not pass the validator
+    # with a claim-free, reason-free skip)
+    if (record.get("kind") == "decode" and record.get("status") == "SKIP"
+            and not record.get("reason")):
+        errors.append("SKIP decode record must carry a reason")
     if not errors:
         try:  # cross-check with the real jsonschema when present
             import jsonschema

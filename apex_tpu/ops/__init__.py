@@ -47,3 +47,4 @@ from apex_tpu.ops.xentropy import softmax_cross_entropy_loss  # noqa: F401
 from apex_tpu.ops.focal_loss import focal_loss  # noqa: F401
 from apex_tpu.ops.attention import (flash_attention, ring_attention,  # noqa: F401
                                     ulysses_attention)
+from apex_tpu.ops.decode_attention import decode_attention  # noqa: F401

@@ -1,0 +1,105 @@
+"""Fused decode attention: one query token per sequence against a KV cache.
+
+The inference-side sibling of :func:`apex_tpu.ops.attention.flash_attention`:
+forward-only (decode never differentiates), GQA-aware, masked to each row's
+current length so a pre-allocated ``max_s`` cache costs compute proportional
+to the live prefix. The Pallas kernel
+(:mod:`apex_tpu.ops.pallas.decode_attention`) streams the cache through VMEM
+once with the online-softmax recurrence in scratch — no ``logits-max``-style
+staging writes; the XLA fallback is the same math as one fused
+scores→softmax→weighted-sum composition (what ``JAX_PLATFORMS=cpu`` runs).
+
+Dispatch follows the house rule (:mod:`apex_tpu.ops._backend`): Pallas on
+TPU when the cache shape satisfies the tiling constraints, interpret-mode
+Pallas under ``APEX_TPU_PALLAS=interpret``, XLA otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import _backend
+from apex_tpu.ops.pallas.attention import NEG_INF
+from apex_tpu.ops.pallas.decode_attention import decode_attn_fwd
+
+
+def decode_kernel_ok(max_s: int, d: int, dtype) -> bool:
+    """Mosaic eligibility for the decode kernel: the cache's seq dim must
+    tile in 128-blocks and d must fill the lane dim (the same trailing-dim
+    rules as the flash family; f16 has no Mosaic support). The inference
+    engine allocates ``max_s`` as a 128-multiple precisely so this holds."""
+    return (max_s % 128 == 0 and (d % 128 == 0 or d == 64)
+            and dtype != jnp.float16)
+
+
+def _xla_decode(q, k, v, lengths, scale):
+    """(b, h_kv, group, d) q against (b, h_kv, max_s, d) cache — a single
+    einsum→softmax→einsum chain; XLA fuses the max/exp/sum on one pass of
+    the scores, which never leave registers/cache at CPU test scale."""
+    s = jnp.einsum("bgqd,bgkd->bgqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    mask = jnp.arange(k.shape[2])[None, None, None, :] \
+        < lengths[:, None, None, None]
+    s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bgqk,bgkd->bgqd", p.astype(v.dtype), v)
+    # length-0 rows: uniform-softmax garbage -> zeros (the kernel's
+    # dead-row convention)
+    dead = (lengths == 0)[:, None, None, None]
+    return jnp.where(dead, 0.0, o).astype(q.dtype)
+
+
+def decode_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, lengths: jax.Array,
+    *, scale: Optional[float] = None, impl: str = "auto",
+) -> jax.Array:
+    """Attention of ONE query token per sequence over a KV cache.
+
+    ``q`` (b, h, d) — the current token's query heads; ``k``/``v``
+    (b, h_kv, max_s, d) — the pre-allocated cache in the attention-native
+    layout (``h_kv`` must divide ``h``; fewer kv heads = GQA, 1 = MQA);
+    ``lengths`` (b,) int32 — the number of LIVE cache positions per row
+    (the new token's k/v already written); positions >= the length are
+    masked and, on the kernel path, whole KV blocks past it are skipped.
+    Returns (b, h, d).
+
+    No causal mask: at decode the query IS the last position, so "mask to
+    the current length" is the entire causal structure. Forward-only —
+    wrap in ``jax.lax.stop_gradient`` semantics by construction (there is
+    no VJP; decode paths never differentiate).
+    """
+    if q.ndim != 3 or k.ndim != 4 or k.shape != v.shape:
+        raise ValueError(
+            f"decode_attention takes q (b, h, d) and k/v (b, h_kv, max_s, "
+            f"d); got q {q.shape}, k {k.shape}, v {v.shape}")
+    b, h, d = q.shape
+    h_kv, max_s = k.shape[1], k.shape[2]
+    if k.shape[0] != b or k.shape[3] != d or h % h_kv:
+        raise ValueError(
+            f"cache (b, h_kv, max_s, d) must match q's batch/head_dim with "
+            f"h_kv | h; got q {q.shape} vs cache {k.shape}")
+    if lengths.shape != (b,):
+        raise ValueError(f"lengths must be ({b},); got {lengths.shape}")
+    lengths = lengths.astype(jnp.int32)
+    group = h // h_kv
+    scale = float(scale if scale is not None else 1.0 / d ** 0.5)
+    qg = q.reshape(b, h_kv, group, d)
+
+    # gate on BOTH operand dtypes: a mixed fp16 cache under fp32 q must
+    # fall back too (Mosaic has no f16 in any operand position)
+    ok = decode_kernel_ok(max_s, d, q.dtype) and k.dtype != jnp.float16
+    # decode is HBM-bound: the kernel's one-pass cache read is the measured
+    # default on TPU; off-TPU interpret-mode kernels are pure overhead
+    use_pallas = _backend.choose_impl(impl, ok) == "pallas"
+    if not use_pallas:
+        return _xla_decode(qg, k, v, lengths, scale).reshape(b, h, d)
+    o = decode_attn_fwd(
+        qg.reshape(b * h_kv, group, d),
+        k.reshape(b * h_kv, max_s, d),
+        v.reshape(b * h_kv, max_s, d),
+        jnp.repeat(lengths, h_kv),
+        scale=scale, interpret=_backend.interpret_mode())
+    return o.reshape(b, h, d)

@@ -1,0 +1,121 @@
+"""Fused decode-attention Pallas kernel: one query token against a KV cache.
+
+The decode hot path is HBM-bound — each generated token must stream the
+whole KV cache through the chip once, and arithmetic intensity is O(1)
+(one query row per cache row). What a kernel can win here is therefore
+not FLOPs but *passes*: the composed XLA formulation materializes the
+(heads, max_s) score tensor, writes it, reads it back for the row max,
+writes the exp, reads it again for the sum — each a full staging pass
+over an O(max_s) tensor ("LLM Inference Acceleration via Efficient
+Operation Fusion", arXiv:2502.17728, makes exactly this staging-write
+argument for softmax/layernorm on decode). This kernel runs the online-
+softmax recurrence in VMEM scratch: the cache streams HBM→VMEM exactly
+once and nothing O(max_s) is ever written back.
+
+Layout contract (the attention-native cache layout the inference engine
+allocates): q ``(b·h_kv, group, d)`` — the query heads of one kv group
+folded into the sublane dim — and k/v ``(b·h_kv, max_s, d)``, a free
+reshape of the engine's ``(b, h_kv, max_s, d)`` cache. ``lengths`` rides
+the same (rows, 1, LANES) lane carrier as the flash kernels' kv_lens;
+KV blocks entirely past a row's length are skipped dynamically (their
+DMA still runs — BlockSpec copies are unconditional), so short contexts
+in a long cache pay MXU time proportional to the *current* length.
+
+GQA falls out of the layout: the group's q heads share the kv row as
+rows of one (group, bk) score block — the head-grouping analog of the
+head-batched projection layout (PERF.md). MQA is group == h.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops.pallas import exact_block
+from apex_tpu.ops.pallas.attention import _LSE_LANES, NEG_INF, _kvlen_rows
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, m_scr, l_scr,
+                   acc_scr, *, scale, bk, nk):
+    """Online-softmax decode step for one (batch, kv-head) row.
+
+    Grid (b·h_kv, nk): the kv axis is the ONLY sequential dim; scratch
+    carries (m, l, acc) across kv blocks and the output is written once
+    at the last block — no (group, max_s) score tensor exists anywhere,
+    in VMEM or HBM.
+    """
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    kvlen = len_ref[0, 0, 0]
+
+    # skip KV blocks entirely past the current length — decode against a
+    # pre-allocated max_s cache must cost MXU time ~ the LIVE prefix only
+    @pl.when(j * bk < kvlen)
+    def _step():
+        q = q_ref[0]  # (group, d) — the kv group's query heads
+        k = k_ref[0]  # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (group, bk)
+        cols = j * bk + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], bk), 1)
+        s = jnp.where(cols < kvlen, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        # length-0 rows never ran a step: l == 0 → zeros out (the flash
+        # kernels' dead-row convention)
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def decode_attn_fwd(q, k, v, lengths, *, scale, bk=512, interpret=False):
+    """q (rows, group, d); k/v (rows, max_s, d) with rows = b·h_kv;
+    ``lengths`` (rows,) int32 — positions >= the length are masked and
+    whole blocks past it are skipped. Returns (rows, group, d) context.
+    Forward-only: decode never differentiates."""
+    rows, group, d = q.shape
+    max_s = k.shape[1]
+    bk = exact_block(max_s, bk, 128) or max_s
+    nk = pl.cdiv(max_s, bk)
+
+    return pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, bk=bk, nk=nk),
+        grid=(rows, nk),
+        in_specs=[
+            pl.BlockSpec((1, group, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, _LSE_LANES), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, group, d), lambda b, j: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, group, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(q, k, v, _kvlen_rows(lengths, rows))

@@ -22,6 +22,11 @@ _want = 256 * 1024 * 1024
 if _soft != resource.RLIM_INFINITY and _soft < _want:
     if _hard == resource.RLIM_INFINITY or _hard >= _want:
         resource.setrlimit(resource.RLIMIT_STACK, (_want, _hard))
+    elif _hard > _soft:
+        # hard cap finite but below 256 MB: raise to the cap rather than
+        # skipping the raise entirely — every byte of compile-thread stack
+        # helps, and the cap is the most an unprivileged process can get
+        resource.setrlimit(resource.RLIMIT_STACK, (_hard, _hard))
 
 # Force CPU regardless of ambient JAX_PLATFORMS (the dev box tunnels one real
 # TPU chip; tests need the 8-device virtual mesh). Set APEX_TPU_TEST_ON_TPU=1
@@ -73,6 +78,92 @@ if os.environ.get("APEX_TPU_TEST_ON_TPU"):
     _mesh_lib.make_mesh = _skip_when_starved(_mesh_lib.make_mesh)
     _mesh_lib.initialize_model_parallel = _skip_when_starved(
         _mesh_lib.initialize_model_parallel)
+
+
+# --- tier-1 time budget (off-TPU) --------------------------------------------
+#
+# The jax-version compat shims (PR 2) un-broke ~160 seed-failing tests —
+# interpret-mode kernel suites and big composition oracles that now really
+# RUN on the 2-core CPU harness instead of failing fast on an
+# AttributeError. Honest, but the fast tier has a hard wall-clock budget
+# (ROADMAP's 870 s tier-1 command): measured at 2140 s with everything in.
+# The heaviest of the rescued tests (>= ~6 s each, 1400 s combined) move to
+# the `slow` tier HERE, in one tunable list, rather than scattering marks
+# across 12 files. They still run in the full suite (`-m ''`) and on
+# hardware (`APEX_TPU_TEST_ON_TPU=1` skips this demotion — on a real TPU
+# the kernels are fast). Durations from /tmp-less honest measurement, see
+# PR 2.
+_SLOW_OFF_TPU = {
+    "tests/test_examples.py::test_imagenet_example_synthetic",
+    "tests/test_entry.py::test_dryrun_multichip_respawn_path",
+    "tests/test_examples.py::test_imagenet_example_prefetched_host_data",
+    "tests/test_entry.py::test_dryrun_multichip_tp_only[4]",
+    "tests/test_entry.py::test_dryrun_multichip_8",
+    "tests/test_megatron_surface.py::TestGPTScaling::test_tp4_scaling_runs",
+    "tests/test_docs.py::test_training_guide_blocks_execute_in_order",
+    "tests/test_contrib.py::TestZeroFlagship::test_zero_adam_under_moe_ep[4]",
+    "tests/test_gpt_pipeline.py::TestScheduleFeatureMatrix::test_ep_moe[1]",
+    "tests/test_moe.py::TestMoEPipelineEP::test_interleaved_v2_pp2_ep2",
+    "tests/test_gpt_pipeline.py::TestScheduleFeatureMatrix::test_zero[2]",
+    "tests/test_moe.py::TestMoEPipelineEP::test_five_axis_ep_pp_cp_one_mesh",
+    "tests/test_gpt_pipeline.py::TestScheduleFeatureMatrix::test_zero[1]",
+    "tests/test_gpt_pipeline.py::TestScheduleFeatureMatrix::test_ep_moe[2]",
+    "tests/test_enc_dec_pipeline.py::TestEncDecPipeline::test_loss_and_grads_match_serial",
+    "tests/test_entry.py::test_dryrun_multichip_2",
+    "tests/test_moe.py::TestMoEPipelineEP::test_pp2_ep2_dp2_matches_serial_shards",
+    "tests/test_gpt_pipeline.py::TestScheduleFeatureMatrix::test_cp_ring[2]",
+    "tests/test_moe.py::TestGPTMoE::test_gpt_moe_through_pipeline_matches_serial",
+    "tests/test_t5.py::TestRelativePositionBias::test_relative_through_pipeline_matches_serial",
+    "tests/test_pipeline.py::TestGPTBlockPipeline::test_pp4_interleaved_gpt_blocks_match_serial",
+    "tests/test_gpt_pipeline.py::TestScheduleFeatureMatrix::test_cp_ring[1]",
+    "tests/test_contrib.py::TestZeroFlagship::test_zero_adam_under_3d_pipeline",
+    "tests/test_moe.py::TestExpertParallel::test_ep_matches_single_device",
+    "tests/test_moe.py::TestMoEPipelineEP::test_tp2_pp2_ep2_one_mesh",
+    "tests/test_gpt_pipeline.py::TestGPTPipelineParity::test_pp2_tp2_dp2_sp_full_3d",
+    "tests/test_moe.py::TestDedicatedEpAxis::test_moe_on_ep_axis_matches_single_device",
+    "tests/test_gpt_pipeline.py::TestContextParallelFlagship::test_pp2_cp2_dp2_pipeline",
+    "tests/test_models.py::TestGPT::test_tp2_grads_match_tp1",
+    "tests/test_contrib.py::TestZeroFlagship::test_zero_adam_under_gpt_tp2[4]",
+    "tests/test_attention.py::TestGPTFlashDropout::test_flash_dropout_trains_and_is_keyed",
+    "tests/test_models.py::TestGPT::test_tp2_matches_tp1[False]",
+    "tests/test_t5.py::TestEncDecPipelineModel::test_pipeline_matches_serial[1]",
+    "tests/test_t5.py::TestEncoderPadding::test_pipeline_matches_serial_padded",
+    "tests/test_t5.py::TestRematPolicies::test_encode_only_matches_blocks_through_pipeline",
+    "tests/test_models.py::TestGPT::test_tp2_matches_tp1[True]",
+    "tests/test_examples.py::test_simple_distributed_example",
+    "tests/test_gpt_pipeline.py::TestContextParallelFlagship::test_pp2_cp2_tp2_one_mesh",
+    "tests/test_contrib.py::TestDistributedOptimizers::test_zero_grad_reduce_dtype_opt_out",
+    "tests/test_enc_dec_pipeline.py::TestEncDecPipeline::test_uses_installed_mesh_split",
+    "tests/test_gpt_pipeline.py::TestContextParallelFlagship::test_cp_with_dropout_trains_keyed[ring]",
+    "tests/test_gpt_pipeline.py::TestGPTPipelineParity::test_pp2_matches_single_device[softmax]",
+    "tests/test_moe.py::TestGPTMoE::test_gpt_moe_tp2_matches_tp1[False]",
+    "tests/test_t5.py::TestEncDecPipelineModel::test_pipeline_matches_serial[2]",
+    "tests/test_gpt_pipeline.py::TestGPTPipelinePartition::test_dropout_trains_with_distinct_masks",
+    "tests/test_contrib.py::TestDistributedOptimizers::test_zero_lamb_runs_and_differs_from_adam",
+    "tests/test_pipeline.py::TestPipelineSPMD::test_interleaved_matches_serial",
+    "tests/test_attention.py::TestRingBshd::test_bshd_ring_pallas_bwd_matches_xla_dispatch",
+    "tests/test_enc_dec_pipeline.py::TestEncDecPipeline::test_split_rank_changes_execution",
+    "tests/test_attention.py::TestRingAttention::test_grouped_kv_grads_match_dense",
+    "tests/test_attention.py::TestFlashBias::test_bshd_composed_gqa_varlen_dropout",
+    "tests/test_transformer_tp.py::TestTP8Flagship::test_gpt_tp8_loss_and_grads_match_tp1",
+    "tests/test_gpt_pipeline.py::TestGPTPipelineParity::test_pp2_interleaved_matches_single_device",
+    "tests/test_gpt_pipeline.py::TestGPTPipelineParity::test_pp2_matches_single_device[flash]",
+    "tests/test_contrib.py::TestDistributedOptimizers::test_zero_adam_matches_fused_adam",
+    "tests/test_pipeline.py::TestPipelineSPMD::test_1f1b_loss_and_grads_match_serial",
+    "tests/test_attention.py::TestFlashDropout::test_packed_fused_matches_bshd_same_seed",
+    "tests/test_gpt_pipeline.py::TestContextParallelFlagship::test_gpt_cp_matches_full_sequence[ring]",
+    "tests/test_gpt_pipeline.py::TestScheduleFeatureMatrix::test_dropout[2]",
+    "tests/test_attention.py::TestVarlenFastPath::test_packed_fused_varlen_matches_bshd",
+    "tests/test_transformer_tp.py::TestColumnRowParallel::test_headwise_matches_flat_call",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("APEX_TPU_TEST_ON_TPU"):
+        return
+    for item in items:
+        if item.nodeid in _SLOW_OFF_TPU:
+            item.add_marker(pytest.mark.slow)
 
 
 @pytest.fixture

@@ -310,6 +310,62 @@ class TestMultiheadAttn:
             np.testing.assert_allclose(out[0, :11], trimmed[0],
                                        rtol=2e-5, atol=2e-5)
 
+    def test_pad_lens_compose_with_attn_mask_oracle(self):
+        """pad_lens AND an additive attn_mask in ONE call — the documented
+        composition (docstring: "pad_lens ... composes with attn_mask") —
+        against a materialized-scores oracle applying both: additive mask
+        on the scores, then -inf past each row's length (ADVICE r5: the
+        composition was documented but never tested)."""
+        from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+
+        b, s, e, h = 2, 16, 32, 4
+        d = e // h
+        m = SelfMultiheadAttn(embed_dim=e, num_heads=h)
+        params = m.init(K)
+        x = jr.normal(jr.fold_in(K, 40), (b, s, e))
+        lens = jnp.array([11, 16], jnp.int32)
+        # a per-head additive mask (h, sq, sk) — the T5/ALiBi-shaped case
+        mask = 0.5 * jr.normal(jr.fold_in(K, 41), (h, s, s))
+
+        out = m(params, x, pad_lens=lens, attn_mask=mask,
+                is_training=False)
+
+        # oracle: projections by hand, scores + mask, pad cut, softmax
+        q = (x @ params["qkv_weight"].T)
+        qh, kh, vh = jnp.split(q, 3, axis=-1)
+        qh = qh.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+        kh = kh.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+        vh = vh.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(d)
+        scores = scores + mask[None]
+        keyok = jnp.arange(s)[None, None, None, :] < lens[:, None, None, None]
+        scores = jnp.where(keyok, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+        ref = ctx.transpose(0, 2, 1, 3).reshape(b, s, e) \
+            @ params["out_weight"].T
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_training_dropout_without_key_raises(self):
+        """fmha_varlen parity (ADVICE r5): dropout > 0 with is_training
+        and no key must raise, not silently run dropout-free."""
+        from apex_tpu.contrib.multihead_attn import (EncdecMultiheadAttn,
+                                                     SelfMultiheadAttn)
+
+        m = SelfMultiheadAttn(embed_dim=16, num_heads=2, dropout=0.3)
+        params = m.init(K)
+        x = jr.normal(jr.fold_in(K, 42), (2, 8, 16))
+        with pytest.raises(ValueError, match="PRNG key"):
+            m(params, x, is_training=True)
+        # eval mode stays key-free
+        m(params, x, is_training=False)
+        me = EncdecMultiheadAttn(embed_dim=16, num_heads=2, dropout=0.3)
+        pe = me.init(K)
+        mem = jr.normal(jr.fold_in(K, 43), (2, 6, 16))
+        with pytest.raises(ValueError, match="PRNG key"):
+            me(pe, x, mem, is_training=True)
+
     def test_masks_compose_with_inkernel_dropout(self):
         """Dropout + mask in the SAME kernel call: eval-mode equals the
         oracle, training keeps the mask (masked keys stay excluded in
@@ -401,6 +457,24 @@ class TestMultiheadAttn:
         np.testing.assert_allclose(
             m(flat, cu, max_s=16, is_training=False),
             out.reshape(total, h * d), rtol=2e-5, atol=2e-5)
+
+    def test_fmha_varlen_max_s_too_small_raises_eagerly(self):
+        """max_s < the longest row used to TRUNCATE that row silently (the
+        padded-layout scatter drops out-of-bounds tokens); with a concrete
+        cu_seqlens it must raise instead (ADVICE r5). Traced cu_seqlens
+        cannot be checked — the docstring documents that hazard."""
+        from apex_tpu.contrib.fmha import fmha_varlen
+
+        h, d = 2, 8
+        cu = jnp.array([0, 5, 17], jnp.int32)  # rows of 5 and 12
+        qkv = jr.normal(jr.fold_in(K, 44), (17, 3, h, d))
+        with pytest.raises(ValueError, match="max_s"):
+            fmha_varlen(qkv, cu, max_s=8)
+        # an adequate max_s still works
+        assert fmha_varlen(qkv, cu, max_s=12).shape == (17, h, d)
+        # traced path: must stay traceable (no concretization error)
+        out = jax.jit(lambda q, c: fmha_varlen(q, c, max_s=12))(qkv, cu)
+        assert out.shape == (17, h, d)
 
 
 class TestTransducer:

@@ -1,0 +1,291 @@
+"""KV-cached decode engine tests (ISSUE 2 acceptance criteria).
+
+The contracts under test:
+
+* greedy decode == teacher-forced argmax of the full (non-cached) forward,
+  token for token, for MHA and GQA configs at fp32 tolerance;
+* prefill cache contents == the training forward's k/v activations;
+* zero recompiles: ``decode_step``'s jit cache stays at ONE executable
+  across >= 8 decoded tokens (stable avals + donated cache);
+* the fused decode-attention op agrees with its XLA fallback (and a dense
+  oracle) across GQA/MQA/MHA, ragged lengths, and dead rows;
+* sampling semantics (greedy/temperature/top-k);
+* ``decode`` monitor records validate through the schema and the
+  ``tools/validate_metrics.py`` CLI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from apex_tpu import monitor
+from apex_tpu.inference import DecodeEngine, jit_encoder, sample_logits
+from apex_tpu.models import BertConfig, BertModel, GPTConfig, GPTModel
+from apex_tpu.ops import decode_attention
+
+K = jr.PRNGKey(7)
+
+
+def _tiny_gpt(num_kv_heads=None, **over):
+    kwargs = dict(vocab_size=97, max_seq_len=64, hidden_size=32,
+                  num_layers=2, num_heads=4, num_kv_heads=num_kv_heads,
+                  attention_impl="flash", remat=False, dropout=0.0)
+    kwargs.update(over)
+    cfg = GPTConfig(**kwargs)
+    model = GPTModel(cfg)
+    return model, model.init(K)
+
+
+class TestDecodeAttentionOp:
+    def _oracle(self, q, k, v, lens):
+        b, h, d = q.shape
+        g = h // k.shape[1]
+        out = np.zeros((b, h, d), np.float32)
+        for bi in range(b):
+            L = int(lens[bi])
+            if L == 0:
+                continue
+            for hi in range(h):
+                s = (np.asarray(q[bi, hi], np.float32)
+                     @ np.asarray(k[bi, hi // g, :L], np.float32).T
+                     / np.sqrt(d))
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                out[bi, hi] = p @ np.asarray(v[bi, hi // g, :L], np.float32)
+        return out
+
+    @pytest.mark.parametrize("h_kv", [8, 2, 1])  # MHA / GQA / MQA
+    def test_xla_and_kernel_match_oracle(self, h_kv):
+        b, h, max_s, d = 3, 8, 256, 64
+        q = jr.normal(K, (b, h, d))
+        k = jr.normal(jr.fold_in(K, 1), (b, h_kv, max_s, d))
+        v = jr.normal(jr.fold_in(K, 2), (b, h_kv, max_s, d))
+        lens = jnp.array([5, max_s, 0], jnp.int32)
+        want = self._oracle(q, k, v, lens)
+        got_xla = decode_attention(q, k, v, lens, impl="xla")
+        np.testing.assert_allclose(np.asarray(got_xla), want,
+                                   rtol=2e-5, atol=2e-5)
+        # interpret-mode Pallas runs the real kernel code path off-TPU
+        got_pl = decode_attention(q, k, v, lens, impl="pallas")
+        np.testing.assert_allclose(np.asarray(got_pl), want,
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_shape_validation(self):
+        q = jnp.zeros((2, 4, 64))
+        k = jnp.zeros((2, 2, 128, 64))
+        with pytest.raises(ValueError, match="lengths"):
+            decode_attention(q, k, k, jnp.zeros((3,), jnp.int32))
+        with pytest.raises(ValueError, match="h_kv"):
+            decode_attention(q, jnp.zeros((2, 3, 128, 64)),
+                             jnp.zeros((2, 3, 128, 64)),
+                             jnp.zeros((2,), jnp.int32))
+
+
+class TestDecodeEngine:
+    @pytest.mark.parametrize("num_kv_heads", [None, 2])  # MHA and GQA
+    def test_greedy_matches_teacher_forced_full_forward(self, num_kv_heads):
+        model, params = _tiny_gpt(num_kv_heads)
+        engine = DecodeEngine(model)
+        prompt = jr.randint(jr.fold_in(K, 3), (2, 7), 0, 97)
+        n = 8
+        got = engine.generate(params, prompt, n)
+
+        seq = prompt
+        want = []
+        for _ in range(n):
+            logits = model.logits(params, seq)  # full non-cached forward
+            nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+            want.append(nxt)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(jnp.stack(want, 1)))
+
+    def test_prefill_cache_matches_training_kv(self):
+        """The cache after prefill holds EXACTLY the k/v activations the
+        training forward computes for the prompt — layer by layer."""
+        model, params = _tiny_gpt(num_kv_heads=2)
+        c = model.config
+        engine = DecodeEngine(model)
+        prompt = jr.randint(jr.fold_in(K, 4), (2, 9), 0, 97)
+        cache, _, _ = engine.prefill(params, prompt, K)
+        b, s = prompt.shape
+
+        # training-forward k/v: the same packed projection applied to each
+        # block's pre-LN input, traced independently of the engine
+        from apex_tpu.ops import fused_layer_norm
+        x = model.embedding(params["embedding"], prompt)
+        x = x + params["pos_embedding"][:s]
+        for i in range(c.num_layers):
+            layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+            h_in = fused_layer_norm(x, layer["ln1_w"], layer["ln1_b"])
+            _, k, v = model._proj_qkv_bshd(layer, h_in)
+            np.testing.assert_allclose(
+                np.asarray(cache["k"][i, :, :, :s]),
+                np.asarray(k.transpose(0, 2, 1, 3)), rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(
+                np.asarray(cache["v"][i, :, :, :s]),
+                np.asarray(v.transpose(0, 2, 1, 3)), rtol=1e-5, atol=1e-5)
+            x, _ = model.prefill_block(layer, x)
+        # and positions >= s stay zero (pre-allocated, untouched)
+        assert not np.asarray(cache["k"][:, :, :, s:]).any()
+
+    def test_decode_step_compiles_once(self):
+        """Zero recompiles in steady state: stable avals + donated cache
+        keep the jit cache at ONE executable across >= 8 tokens."""
+        model, params = _tiny_gpt()
+        engine = DecodeEngine(model)
+        prompt = jr.randint(jr.fold_in(K, 5), (2, 5), 0, 97)
+        cache, tok, _ = engine.prefill(params, prompt, K)
+        for t in range(8):
+            cache, tok, _ = engine.decode_step(
+                params, cache, tok, jnp.int32(5 + t), jr.fold_in(K, t))
+            assert engine.decode_step._cache_size() == 1, \
+                f"decode_step re-traced at token {t}"
+
+    def test_sampled_generation_stays_in_topk_support(self):
+        model, params = _tiny_gpt()
+        engine = DecodeEngine(model, temperature=0.7, top_k=3)
+        prompt = jr.randint(jr.fold_in(K, 6), (2, 4), 0, 97)
+        toks = engine.generate(params, prompt, 6, key=jr.fold_in(K, 60))
+        # every sampled token must be one of the step's top-3 logits; replay
+        # teacher-forced on the sampled sequence to check membership
+        seq = prompt
+        for t in range(6):
+            logits = model.logits(params, seq)[:, -1]
+            top3 = jax.lax.top_k(logits, 3)[1]
+            for bi in range(2):
+                assert int(toks[bi, t]) in np.asarray(top3[bi])
+            seq = jnp.concatenate([seq, toks[:, t:t + 1]], 1)
+
+    def test_generate_rejects_overflow_and_missing_key(self):
+        model, params = _tiny_gpt()
+        engine = DecodeEngine(model)  # cache = max_seq_len = 64
+        prompt = jnp.zeros((1, 60), jnp.int32)
+        with pytest.raises(ValueError, match="exceeds the cache"):
+            engine.generate(params, prompt, 8)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            engine.generate(params, prompt[:, :4], 0)
+        hot = DecodeEngine(model, temperature=1.0)
+        with pytest.raises(ValueError, match="requires a key"):
+            hot.generate(params, prompt[:, :4], 2)
+
+    def test_tp_sharded_model_rejected(self):
+        model = GPTModel(GPTConfig(vocab_size=64, hidden_size=32,
+                                   num_layers=1, num_heads=4, tp_size=2))
+        with pytest.raises(NotImplementedError, match="single-chip"):
+            DecodeEngine(model)
+
+    def test_bert_encoder_serving(self):
+        cfg = BertConfig(vocab_size=50, max_seq_len=32, hidden_size=32,
+                         num_layers=2, num_heads=4, remat=False)
+        m = BertModel(cfg)
+        p = m.init(jr.fold_in(K, 8))
+        encode = jit_encoder(m)
+        toks = jr.randint(jr.fold_in(K, 9), (2, 16), 0, 50)
+        mask = jnp.zeros((2, 16), bool)
+        h, pooled = encode(p, toks, pad_mask=mask)
+        assert h.shape == (2, 16, 32) and pooled.shape == (2, 32)
+        np.testing.assert_allclose(
+            np.asarray(h),
+            np.asarray(m.hidden_states(p, toks, pad_mask=mask)),
+            rtol=1e-6, atol=1e-6)
+
+
+class TestSampling:
+    def test_greedy_is_argmax(self):
+        logits = jr.normal(K, (3, 11))
+        np.testing.assert_array_equal(
+            np.asarray(sample_logits(logits)),
+            np.asarray(jnp.argmax(logits, -1)))
+
+    def test_topk_restricts_support(self):
+        logits = jr.normal(jr.fold_in(K, 1), (4, 32))
+        top = np.asarray(jax.lax.top_k(logits, 5)[1])
+        for i in range(50):
+            toks = sample_logits(logits, jr.fold_in(K, 100 + i),
+                                 temperature=1.3, top_k=5)
+            for bi in range(4):
+                assert int(toks[bi]) in top[bi]
+
+    def test_temperature_sharpens(self):
+        """Cold sampling concentrates on the argmax."""
+        logits = jnp.array([[0.0, 1.0, 2.0, 2.5]])
+        cold = np.asarray(jnp.stack([
+            sample_logits(logits, jr.fold_in(K, i), temperature=0.05)[0]
+            for i in range(100)]))
+        assert (cold == 3).mean() > 0.95
+
+    def test_key_required_and_validation(self):
+        logits = jnp.zeros((1, 4))
+        with pytest.raises(ValueError, match="PRNG key"):
+            sample_logits(logits, None, temperature=1.0)
+        with pytest.raises(ValueError, match="temperature"):
+            sample_logits(logits, K, temperature=-1.0)
+
+
+class TestDecodeMonitorRecords:
+    def test_emit_decode_roundtrip_and_validation(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        monitor.enable(str(path))
+        try:
+            monitor.emit_meta(device_kind="cpu")
+            rec = monitor.emit_decode(
+                "OK", tokens_per_s=1234.5, prefill_ms=8.1, spread_pct=0.6,
+                naive_tokens_per_s=100.0, vs_naive=12.3, batch=2,
+                prompt_len=32, new_tokens=16)
+            assert monitor.validate(rec) == []
+        finally:
+            monitor.disable()
+        lines = path.read_text().splitlines()
+        assert monitor.validate_jsonl(lines) == []
+        from apex_tpu.monitor import report as monitor_report
+        summary = monitor_report.aggregate(
+            monitor_report.read_records(lines))
+        assert summary["decode"]["tokens_per_s"] == 1234.5
+        assert summary["decode"]["status"] == "OK"
+
+    def test_ok_decode_record_with_nan_refused(self):
+        reg = monitor.MetricsRegistry()
+        with pytest.raises(ValueError, match="non-finite"):
+            reg.emit_decode("OK", tokens_per_s=float("nan"))
+
+    def test_skip_needs_reason_and_skip_tuples_normalize(self):
+        reg = monitor.MetricsRegistry()
+        with pytest.raises(ValueError, match="reason"):
+            reg.emit_decode("SKIP")
+        rec = reg.emit_decode("SKIP", reason="no TPU",
+                              vs_naive=("skipped", "no TPU"))
+        assert rec["vs_naive"] == {"skipped": True, "reason": "no TPU"}
+        assert monitor.validate(rec) == []
+        # the validator enforces it too (externally produced streams):
+        bare = {k: v for k, v in rec.items() if k != "reason"}
+        assert any("reason" in e for e in monitor.validate(bare))
+
+
+@pytest.mark.slow
+class TestDecodeBenchLeg:
+    def test_bench_decode_emits_valid_skip_record_off_tpu(self, tmp_path):
+        """The serving bench leg end-to-end at smoke scale: off-TPU it must
+        print/emit an explicit SKIP record — schema-valid, no nan — and the
+        stream must pass the validator CLI."""
+        root = os.path.join(os.path.dirname(__file__), "..")
+        path = tmp_path / "decode.jsonl"
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   APEX_TPU_MONITOR=str(path))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "bench.py"), "--decode"],
+            capture_output=True, text=True, env=env, cwd=root, timeout=600)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        record = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert record["kind"] == "decode" and record["status"] == "SKIP"
+        assert record["vs_naive"]["skipped"] is True
+        assert monitor.validate(record) == []
+        assert monitor.validate_jsonl(
+            path.read_text().splitlines()) == []

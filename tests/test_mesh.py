@@ -42,8 +42,9 @@ def test_destroy():
 
 
 def test_axis_rank_inside_shard_map():
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel.mesh import shard_map
 
     mesh = mesh_lib.initialize_model_parallel(tensor_model_parallel_size=4)
 

@@ -361,6 +361,37 @@ class TestValidateTool:
         p.write_text(json.dumps(wrapper))
         assert tool.validate_file(str(p)) == []
 
+    def test_cli_over_fresh_stream_with_decode_records(self, tmp_path):
+        """Tier-1 schema-drift gate (ISSUE 2 satellite): the validator CLI
+        must pass a freshly emitted stream carrying every record kind —
+        including the serving-bench ``decode`` records (OK and SKIP forms)
+        — so a schema/emitter drift fails in-suite, not at bench time."""
+        tool = _load_validate_tool()
+        path = tmp_path / "events.jsonl"
+        monitor.enable(str(path))
+        try:
+            monitor.emit_meta(device_kind="cpu", model_flops_per_token=1e6)
+            monitor.begin_step()
+            monitor.end_step(dur_s=0.01, tokens=128)
+            monitor.emit_decode(
+                "OK", tokens_per_s=5000.0, prefill_ms=12.5, spread_pct=0.4,
+                naive_tokens_per_s=400.0, vs_naive=12.5, batch=4,
+                prompt_len=64, new_tokens=32)
+            monitor.emit_decode(
+                "SKIP", reason="no TPU attached",
+                vs_naive=("skipped", "no TPU attached"))
+        finally:
+            monitor.disable()
+        assert tool.main([str(path)]) == 0
+
+        # drift guard: an OK decode record carrying nan (hand-forged past
+        # the emitter) must fail the CLI
+        bad = json.loads(path.read_text().splitlines()[2])
+        bad["tokens_per_s"] = "nan"
+        bad_path = tmp_path / "bad.jsonl"
+        bad_path.write_text(json.dumps(bad) + "\n")
+        assert tool.main([str(bad_path)]) == 1
+
     def test_repo_bench_artifacts_validate(self):
         tool = _load_validate_tool()
         root = os.path.join(os.path.dirname(__file__), "..")

@@ -10,8 +10,17 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax keeps shard_map in experimental. CAUTION:
+    # that implementation transposes lax.psum to psum regardless of the
+    # replication check, so jax.grad taken INSIDE shard_map through an
+    # explicit psum yields axis-size-scaled gradients there (see the
+    # hazard note on apex_tpu.parallel.mesh.shard_map) — tests here take
+    # grads OUTSIDE the wrapper, which is correct on every version.
+    from jax.experimental.shard_map import shard_map
 
 from apex_tpu.parallel import (
     BatchNormState,
@@ -169,18 +178,20 @@ class TestSyncBatchNorm:
         x = rng.randn(16, 3).astype(np.float32)
         state = BatchNormState.create(3)
 
-        def local_loss(x):
+        def local_fwd(x):
             y, _ = sync_batch_norm(x, None, None, state, axis_name="dp")
             return y
 
-        def sharded_loss(x):
-            y = local_loss(x)
-            return jax.lax.psum(jnp.sum(y ** 2), "dp")
+        # differentiate THROUGH the shard_map (grad outside): the backward
+        # reduction across shards still flows through the psum'd batch
+        # statistics, and the formulation is stable across jax's shard_map
+        # psum-transpose revisions
+        def total_loss(x):
+            y = shard_map(local_fwd, mesh=mesh8,
+                          in_specs=P("dp"), out_specs=P("dp"))(x)
+            return jnp.sum(y ** 2)
 
-        grad_sharded = jax.jit(
-            shard_map(jax.grad(sharded_loss), mesh=mesh8,
-                      in_specs=P("dp"), out_specs=P("dp"))
-        )(x)
+        grad_sharded = jax.jit(jax.grad(total_loss))(jnp.asarray(x))
 
         def global_loss(x):
             y, _ = sync_batch_norm(x, None, None, state, axis_name=None)

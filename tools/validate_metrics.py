@@ -9,7 +9,9 @@ Dispatch is by content, not extension:
 
 * ``.jsonl`` files (or any file whose first non-blank line parses as a
   JSON object with a ``kind``) validate as a monitor event stream against
-  :mod:`apex_tpu.monitor.schema`;
+  :mod:`apex_tpu.monitor.schema` — including ``decode`` serving-bench
+  records (``python bench.py --decode``), whose ``status: "OK"`` engages
+  the same no-nan honesty rule as gates;
 * bench result objects (``{"metric": ..., "value": ...}``) validate
   against the BENCH schema;
 * driver wrappers are unwrapped: ``{"parsed": {...}}`` (BENCH_r*.json)
