@@ -1,0 +1,65 @@
+"""apexlint — TPU tracing-hazard and kernel-constraint static analysis.
+
+Usage (CLI)::
+
+    python -m apex_tpu.lint apex_tpu/ [--format text|json]
+        [--baseline tools/apexlint_baseline.json]
+        [--select APX1,APX301] [--ignore APX5] [--list-rules]
+
+Usage (API)::
+
+    from apex_tpu import lint
+    findings, suppressed = lint.lint_source(src, path="x.py")
+    findings, stats = lint.lint_paths(["apex_tpu/"])
+
+Rule families (catalogue with bad/good snippets: docs/api/lint.md):
+
+* **APX1xx** tracing/recompile hazards (control flow, concretization,
+  host numpy on traced values; static_argnums hygiene)
+* **APX2xx** donation/aliasing (use-after-donation, donated buffers not
+  re-threaded through loops)
+* **APX3xx** Pallas kernel constraints ((8, 128) tiling, index-map arity,
+  interpret-mode fallback convention)
+* **APX4xx** collective/axis hygiene (axis names outside dp/tp/pp/cp/ep)
+* **APX5xx** PRNG and precision discipline (dropout without a key,
+  constant PRNG keys, bf16/fp32 cast mixing)
+
+Suppression: ``# apexlint: disable=APX101`` (comma-separated, or ``all``)
+on the flagged line; repo-wide intentional findings live in
+``tools/apexlint_baseline.json`` — every entry carries a ``reason``.
+
+The lint package itself imports only the stdlib (``ast``/``json``) — the
+analysis cannot be confused by the jax version it vets. The
+``python -m apex_tpu.lint`` CLI does ride the parent ``apex_tpu`` import
+(which imports jax); see ``core.py``'s docstring for driving the engine
+jax-free.
+"""
+
+from apex_tpu.lint.core import (  # noqa: F401
+    Finding,
+    KNOWN_MESH_AXES,
+    PARSE_ERROR_CODE,
+    REGISTRY,
+    REPORT_VERSION,
+    Rule,
+    apply_baseline,
+    build_report,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    validate_report,
+)
+
+# importing the rule modules populates REGISTRY
+from apex_tpu.lint import (  # noqa: E402,F401
+    rules_collectives,
+    rules_donation,
+    rules_pallas,
+    rules_prng,
+    rules_tracing,
+)
+
+
+def iter_rules():
+    """Registered rules in code order."""
+    return [REGISTRY[c] for c in sorted(REGISTRY)]

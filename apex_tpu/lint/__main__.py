@@ -1,0 +1,115 @@
+"""CLI for apexlint. ``python -m apex_tpu.lint --help``.
+
+Exit codes: 0 = clean (after suppressions/baseline), 1 = findings,
+2 = usage or baseline error. The tier-1 gate
+(tests/test_lint.py::TestDogfoodGate) runs exactly this entry point over
+``apex_tpu/`` and fails on non-zero.
+
+The repo's committed baseline (``tools/apexlint_baseline.json`` next to
+the ``apex_tpu`` package) loads by default so a bare
+``python -m apex_tpu.lint apex_tpu/`` judges the tree the way CI does;
+``--baseline FILE`` substitutes another, ``--no-baseline`` disables.
+Unused-entry warnings only fire for an explicit ``--baseline`` (a partial
+run — one file — legitimately misses most default-baseline entries).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from apex_tpu import lint
+
+
+def default_baseline_path() -> str:
+    """The committed repo baseline, resolved package-relative (cwd-proof)."""
+    import apex_tpu
+    pkg = os.path.dirname(os.path.abspath(apex_tpu.__file__))
+    return os.path.join(os.path.dirname(pkg), "tools",
+                        "apexlint_baseline.json")
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.lint",
+        description="TPU tracing-hazard and kernel-constraint linter "
+                    "(rule catalogue: docs/api/lint.md)")
+    p.add_argument("paths", nargs="*", help=".py files or directories")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", metavar="FILE",
+                   help="JSON baseline of documented-intentional findings "
+                        "(entries carry a reason); matched by (path, code). "
+                        "Default: the repo's tools/apexlint_baseline.json "
+                        "when present")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the default repo baseline")
+    p.add_argument("--select", metavar="CODES",
+                   help="comma-separated code prefixes to run (e.g. "
+                        "APX1,APX301)")
+    p.add_argument("--ignore", metavar="CODES",
+                   help="comma-separated code prefixes to skip")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+    return p
+
+
+def _codes(arg):
+    if not arg:
+        return None
+    return [c.strip().upper() for c in arg.split(",") if c.strip()]
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        for r in lint.iter_rules():
+            print(f"{r.code}  {r.name}: {r.summary}")
+        return 0
+    if not args.paths:
+        print("error: no paths given (try `python -m apex_tpu.lint "
+              "apex_tpu/`)", file=sys.stderr)
+        return 2
+
+    try:
+        findings, stats = lint.lint_paths(
+            args.paths, select=_codes(args.select), ignore=_codes(args.ignore))
+    except (FileNotFoundError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline
+    explicit = baseline_path is not None
+    if baseline_path is None and not args.no_baseline:
+        cand = default_baseline_path()
+        if os.path.exists(cand):
+            baseline_path = cand
+    baselined, unused = 0, []
+    if baseline_path:
+        try:
+            entries = lint.load_baseline(baseline_path)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        findings, baselined, unused = lint.apply_baseline(findings, entries)
+        if not explicit:
+            unused = []  # partial runs legitimately miss default entries
+
+    report = lint.build_report(findings, stats, baselined)
+    if args.format == "json":
+        print(json.dumps(report, indent=1))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"{len(findings)} finding(s) in {stats['files_scanned']} "
+              f"file(s) ({stats['suppressed_inline']} inline-suppressed, "
+              f"{baselined} baselined)")
+    for e in unused:
+        print(f"warning: unused baseline entry {e['path']}:{e['code']} "
+              f"({e['reason']}) — remove it", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,755 @@
+"""apexlint engine: rule registry, per-module analysis context, taint
+propagation, suppression, baseline, and the report document.
+
+Why a repo-local linter instead of flake8 plugins: the hazards that have
+actually cost this repo debugging rounds are *JAX-semantic*, not syntactic —
+Python control flow on traced values, donated buffers read after the jitted
+call, Pallas block shapes off the (8, 128) tile, collectives naming axes the
+mesh doesn't define, dropout-rate parameters with no PRNG-key path (the
+``multihead_attn`` bug). The reference ships the same kind of correctness
+tooling next to its kernels (ASP mask checkers; pyprof's static analyzers
+over 26 op families, PAPER §5); this module is that discipline for the
+tracing-time failure modes a JAX/Pallas rewrite trades CUDA's compile-time
+type errors for.
+
+Everything here is stdlib-only (``ast`` + ``json``): the analysis never
+imports jax, so it cannot be confused — or broken — by the jax version it
+is vetting code against (jax API drift is one of the bug classes it
+catches). The ``python -m apex_tpu.lint`` entry does import the parent
+``apex_tpu`` package (which imports jax) — a totally broken jax install
+therefore breaks the CLI, not the engine; the escape hatch is copying the
+``apex_tpu/lint`` directory out as a standalone package (its internal
+imports are the only non-stdlib ones and are all within the package).
+:func:`lint_source` guards against a partially-imported engine by
+refusing to run with an empty rule registry.
+
+Analysis model
+--------------
+One :class:`ModuleContext` per file carries the parsed tree, import-alias
+resolution (``jnp`` → ``jax.numpy``), a parent map, and per-line suppression
+sets. Rules are plain functions registered with :func:`rule`; each walks the
+tree itself (files are small; a shared dispatch loop would save nothing).
+
+The tracing rules (APX1xx) use a deliberately *flow-insensitive* taint pass:
+parameters of a jit-traced function are tainted, assignments propagate taint,
+and reads of statically-known properties (``.shape``/``.ndim``/``.dtype``/
+``.size``, ``len()``, ``isinstance()``, ``is None`` checks) launder it.
+Flow-insensitivity overapproximates; the escape hatches are
+``# apexlint: disable=CODE`` on the flagged line and the committed baseline
+(every entry carrying a human reason).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: Canonical mesh axis names (apex_tpu.parallel.mesh). Collective/partition
+#: rules treat any other string-literal axis as a typo until baselined.
+KNOWN_MESH_AXES = frozenset({"dp", "tp", "pp", "cp", "ep"})
+
+#: Attribute reads that are static at trace time — accessing them on a traced
+#: array yields a Python value, so they END a taint chain.
+STATIC_ATTRS = frozenset({
+    "shape", "ndim", "dtype", "size", "itemsize", "sharding", "aval",
+    "weak_type",
+})
+
+#: Host calls whose result is static regardless of argument taint.
+#: (getattr is NOT here: getattr(x, "T") on a traced array is traced —
+#: it launders only when the attribute name is itself a static property.)
+_LAUNDERING_CALLS = frozenset({"len", "isinstance", "type", "hasattr",
+                               "id", "repr"})
+
+PARSE_ERROR_CODE = "APX000"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.code)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    code: str
+    name: str
+    summary: str
+    check: Callable[["ModuleContext"], Iterable[Finding]]
+
+
+REGISTRY: Dict[str, Rule] = {}
+
+
+def rule(code: str, name: str, summary: str):
+    """Register a rule. ``check(ctx)`` yields :class:`Finding`."""
+
+    def deco(fn):
+        if code in REGISTRY:  # pragma: no cover - programming error
+            raise ValueError(f"duplicate rule code {code}")
+        REGISTRY[code] = Rule(code, name, summary, fn)
+        return fn
+
+    return deco
+
+
+# --- per-module context -------------------------------------------------------
+
+# codes matched strictly so trailing prose is allowed:
+#   x = ...  # apexlint: disable=APX301 - ragged edge is masked in-kernel
+_SUPPRESS_RE = re.compile(
+    r"#\s*apexlint:\s*disable=(all|APX\d{3}(?!\d)(?:\s*,\s*APX\d{3}(?!\d))*)",
+    re.IGNORECASE)
+
+
+class ModuleContext:
+    def __init__(self, path: str, source: str, tree: ast.Module,
+                 scan_rel: Optional[str] = None):
+        self.path = path
+        #: path relative to the scanned root (lint_paths sets it) — the
+        #: part of the path the REPO is responsible for; test-likeness is
+        #: judged on this so an ancestor directory named tests/examples
+        #: outside the checkout cannot disable rules
+        self.scan_rel = scan_rel if scan_rel is not None else path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.aliases = _collect_aliases(tree)
+        self.defs: Dict[str, ast.FunctionDef] = {}
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, node)
+        self.suppressions = _collect_suppressions(source, self.lines)
+
+    # -- name resolution ------------------------------------------------------
+
+    def canonical(self, node) -> Optional[str]:
+        """Dotted name of a Name/Attribute chain with import aliases expanded:
+        ``pl.BlockSpec`` → ``jax.experimental.pallas.BlockSpec``. None for
+        anything that isn't a plain dotted chain."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(self.aliases.get(node.id, node.id))
+            return ".".join(reversed(parts))
+        return None
+
+    def call_name(self, call: ast.Call) -> Optional[str]:
+        return self.canonical(call.func)
+
+    def ancestors(self, node):
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(self, node) -> Optional[ast.FunctionDef]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def finding(self, node, code: str, message: str) -> Finding:
+        return Finding(self.path, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), code, message)
+
+    def is_testlike_path(self) -> bool:
+        """Test/example code is exempt from library-discipline rules
+        (APX502). Directory components must match EXACTLY ('tests', not
+        any prefix) so an absolute checkout path like /home/testuser/...
+        cannot silently disable rules for the whole library; only the file
+        basename itself is prefix-matched."""
+        parts = self.scan_rel.replace("\\", "/").lower().split("/")
+        dirs, base = parts[:-1], parts[-1]
+        if any(d in ("test", "tests", "testing", "example", "examples",
+                     "fixtures") for d in dirs):
+            return True
+        return base.startswith(("test_", "test.", "conftest", "example"))
+
+
+def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _comment_texts(source: str, lines: Sequence[str]):
+    """(lineno, comment text) pairs — real COMMENT tokens only, so a
+    directive spelled inside a string literal is not a directive. Falls
+    back to whole-line scanning if tokenization fails (the file may be
+    mid-edit; a missed suppression is safer than a phantom one)."""
+    import io
+    import tokenize
+    try:
+        return [(tok.start[0], tok.string) for tok in
+                tokenize.generate_tokens(io.StringIO(source).readline)
+                if tok.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return list(enumerate(lines, start=1))
+
+
+def _collect_suppressions(source: str,
+                          lines: Sequence[str]) -> Dict[int, frozenset]:
+    out: Dict[int, frozenset] = {}
+    for i, text in _comment_texts(source, lines):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        raw = m.group(1).strip()
+        if raw.lower() == "all":
+            out[i] = frozenset({"all"})
+        else:
+            out[i] = frozenset(c.strip().upper() for c in raw.split(",")
+                               if c.strip())
+    return out
+
+
+# --- taint (APX1xx support) ---------------------------------------------------
+
+def is_none_check(test: ast.expr) -> bool:
+    """``x is None`` / ``x is not None`` — a static pytree-structure check,
+    legal on traced values (None never traces)."""
+    return (isinstance(test, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+            and (any(isinstance(c, ast.Constant) and c.value is None
+                     for c in test.comparators)
+                 or (isinstance(test.left, ast.Constant)
+                     and test.left.value is None)))
+
+
+def expr_taint(expr: ast.expr, tainted: frozenset) -> bool:
+    """Is any value flowing out of ``expr`` derived from a tainted name —
+    stopping at statically-known properties (shape/dtype/len/...)?"""
+    if isinstance(expr, ast.Name):
+        return expr.id in tainted
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in STATIC_ATTRS:
+            return False
+        return expr_taint(expr.value, tainted)
+    if isinstance(expr, ast.Call):
+        fname = None
+        if isinstance(expr.func, ast.Name):
+            fname = expr.func.id
+        if fname in _LAUNDERING_CALLS:
+            return False
+        if fname == "getattr" and len(expr.args) >= 2 and \
+                isinstance(expr.args[1], ast.Constant) and \
+                expr.args[1].value in STATIC_ATTRS:
+            return False  # getattr(x, "shape"): static like x.shape
+        args = list(expr.args) + [k.value for k in expr.keywords]
+        if isinstance(expr.func, ast.Attribute):
+            args.append(expr.func.value)
+        return any(expr_taint(a, tainted) for a in args)
+    if isinstance(expr, ast.Compare):
+        if is_none_check(expr):
+            return False
+        return any(expr_taint(e, tainted)
+                   for e in [expr.left] + list(expr.comparators))
+    if isinstance(expr, ast.Constant):
+        return False
+    if isinstance(expr, (ast.Lambda, ast.FunctionDef)):
+        return False
+    return any(expr_taint(child, tainted)
+               for child in ast.iter_child_nodes(expr)
+               if isinstance(child, ast.expr))
+
+
+def _assign_targets(node) -> List[str]:
+    names: List[str] = []
+
+    def rec(t):
+        if isinstance(t, ast.Name):
+            names.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                rec(e)
+        elif isinstance(t, ast.Starred):
+            rec(t.value)
+
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            rec(t)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.For)):
+        rec(node.target)
+    return names
+
+
+def tainted_names(fn: ast.FunctionDef, static_names: frozenset) -> frozenset:
+    """Flow-insensitive taint fixpoint: traced params + everything assigned
+    from a tainted expression anywhere in the function body."""
+    args = fn.args
+    params = [a.arg for a in
+              list(getattr(args, "posonlyargs", [])) + args.args
+              + args.kwonlyargs]
+    if args.vararg:
+        params.append(args.vararg.arg)
+    if args.kwarg:
+        params.append(args.kwarg.arg)
+    cache = getattr(fn, "_apexlint_taint", None)
+    if cache is None:
+        cache = fn._apexlint_taint = {}
+    if static_names in cache:
+        return cache[static_names]
+    taint = {p for p in params if p not in static_names and p != "self"}
+
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            value = None
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = node.value
+            elif isinstance(node, ast.AugAssign):
+                value = node.value
+            elif isinstance(node, ast.For):
+                value = node.iter
+            if value is None:
+                continue
+            if expr_taint(value, frozenset(taint)):
+                for name in _assign_targets(node):
+                    if name not in taint:
+                        taint.add(name)
+                        changed = True
+    cache[static_names] = frozenset(taint)
+    return cache[static_names]
+
+
+# --- jit-wrap discovery (shared by APX1xx/2xx) --------------------------------
+
+JIT_WRAPPERS = frozenset({
+    "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit", "pjit.pjit",
+})
+
+
+def _is_trace_wrapper(canon: Optional[str]) -> bool:
+    """jit/pjit plus the other tracers the ISSUE spec names: shard_map
+    (any spelling — the repo's own mesh.shard_map included) and pmap.
+    Functions wrapped by any of these have traced parameters."""
+    if canon is None:
+        return False
+    return (canon in JIT_WRAPPERS
+            or canon == "shard_map" or canon.endswith(".shard_map")
+            or canon in ("jax.pmap", "pmap"))
+
+
+def _const_int(node) -> Optional[int]:
+    """An int literal, including negative ones (``-1`` parses as
+    ``UnaryOp(USub, Constant)``)."""
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = _const_int(node.operand)
+        return None if inner is None else -inner
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def _const_int_seq(node) -> Optional[List[int]]:
+    """Literal int / tuple-or-list of int literals → list of ints; None when
+    the value isn't statically readable (a variable, a computed tuple)."""
+    single = _const_int(node)
+    if single is not None:
+        return [single]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            v = _const_int(e)
+            if v is None:
+                return None
+            out.append(v)
+        return out
+    return None
+
+
+def _const_str_seq(node) -> Optional[List[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return out
+    return None
+
+
+@dataclasses.dataclass
+class JitSite:
+    """One jax.jit/pjit wrap: the Call (or bare decorator) node, the wrapped
+    FunctionDef when resolvable, and the statically-readable kwargs."""
+    node: ast.AST
+    fn: Optional[ast.FunctionDef]
+    static_argnums: Optional[List[int]] = None
+    static_argnames: Optional[List[str]] = None
+    donate_argnums: Optional[List[int]] = None
+    donate_argnames: Optional[List[str]] = None
+    raw_kwargs: dict = dataclasses.field(default_factory=dict)
+    #: True when jit wrapped a BOUND method (``jax.jit(self._step)``):
+    #: argnum indices then count from the first post-self parameter. A
+    #: DECORATED method is wrapped unbound — indices count ``self`` at 0.
+    bound: bool = False
+
+
+def _read_jit_kwargs(site: JitSite, call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg is None:
+            continue
+        site.raw_kwargs[kw.arg] = kw.value
+        if kw.arg == "static_argnums":
+            site.static_argnums = _const_int_seq(kw.value)
+        elif kw.arg == "static_argnames":
+            site.static_argnames = _const_str_seq(kw.value)
+        elif kw.arg == "donate_argnums":
+            site.donate_argnums = _const_int_seq(kw.value)
+        elif kw.arg == "donate_argnames":
+            site.donate_argnames = _const_str_seq(kw.value)
+
+
+def positional_params(fn, bound: bool = True) -> List[str]:
+    """Positional parameter names of a FunctionDef/Lambda as an argnum
+    index space. ``bound=True`` (a ``jax.jit(self.method)`` value wrap)
+    drops ``self`` — jit saw the bound method; ``bound=False`` (a
+    decorator on the def) keeps it — jit wraps the unbound function and
+    index 0 IS ``self``."""
+    args = fn.args
+    pos = [a.arg for a in list(getattr(args, "posonlyargs", [])) + args.args]
+    if bound and pos and pos[0] == "self":
+        pos = pos[1:]
+    return pos
+
+
+def is_unbound_method(fn) -> bool:
+    pos = [a.arg for a in
+           list(getattr(fn.args, "posonlyargs", [])) + fn.args.args]
+    return bool(pos) and pos[0] == "self"
+
+
+def jit_sites(ctx: ModuleContext) -> List[JitSite]:
+    """Every trace-wrap in the module: decorators (bare, call, or
+    functools.partial(jax.jit, ...)) and ``jax.jit(f, ...)`` /
+    ``shard_map(f, ...)`` / ``pmap(f, ...)`` value calls whose wrapped
+    function is resolvable. Cached per context — six rules consult this."""
+    cached = getattr(ctx, "_jit_sites", None)
+    if cached is not None:
+        return cached
+    sites: List[JitSite] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                site = _jit_site_from_decorator(ctx, dec, node)
+                if site:
+                    sites.append(site)
+        elif isinstance(node, ast.Call):
+            canon = ctx.call_name(node)
+            if _is_trace_wrapper(canon) and node.args:
+                target = node.args[0]
+                fn, bound = None, False
+                if isinstance(target, ast.Name):
+                    fn = ctx.defs.get(target.id)
+                elif isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id == "self":
+                    fn = ctx.defs.get(target.attr)
+                    bound = True
+                elif isinstance(target, ast.Lambda):
+                    fn = None
+                site = JitSite(node, fn, bound=bound)
+                _read_jit_kwargs(site, node)
+                sites.append(site)
+    ctx._jit_sites = sites
+    return sites
+
+
+def _jit_site_from_decorator(ctx, dec, fn) -> Optional[JitSite]:
+    canon = ctx.canonical(dec)
+    if _is_trace_wrapper(canon):
+        return JitSite(dec, fn)
+    if isinstance(dec, ast.Call):
+        fcanon = ctx.call_name(dec)
+        if _is_trace_wrapper(fcanon):
+            site = JitSite(dec, fn)
+            _read_jit_kwargs(site, dec)
+            return site
+        if fcanon in ("functools.partial", "partial") and dec.args and \
+                _is_trace_wrapper(ctx.canonical(dec.args[0])):
+            site = JitSite(dec, fn)
+            _read_jit_kwargs(site, dec)
+            return site
+    return None
+
+
+def traced_functions(ctx: ModuleContext) -> List[Tuple[ast.FunctionDef,
+                                                       frozenset]]:
+    """(function, static param names) pairs for every def whose body jax
+    traces. static_argnums are resolved to names through the def's
+    positional parameter list (``self`` skipped for bound-method wraps)."""
+    out = {}
+    for site in jit_sites(ctx):
+        if site.fn is None:
+            continue
+        statics = set(site.static_argnames or [])
+        pos = positional_params(site.fn, site.bound)
+        for idx in site.static_argnums or []:
+            real = idx if idx >= 0 else len(pos) + idx
+            if 0 <= real < len(pos):
+                statics.add(pos[real])
+        key = site.fn
+        # a function wrapped more than once is traced with EVERY wrap's
+        # arguments: only params static in ALL wraps are safely static
+        # (union would let one static wrap silence hazards in the others)
+        if key in out:
+            out[key] = out[key] & frozenset(statics)
+        else:
+            out[key] = frozenset(statics)
+    return list(out.items())
+
+
+# --- running ------------------------------------------------------------------
+
+def _iter_py_files(paths: Sequence[str]) -> List[Tuple[str, str]]:
+    """(path, scan_rel) pairs; scan_rel is the path below the scanned
+    argument (argument basename included) — the part the repo owns."""
+    files: List[Tuple[str, str]] = []
+    for p in paths:
+        if os.path.isdir(p):
+            base = os.path.basename(os.path.normpath(os.path.abspath(p)))
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        fp = os.path.join(root, n)
+                        files.append(
+                            (fp, os.path.join(base, os.path.relpath(fp, p))))
+        elif p.endswith(".py"):
+            files.append((p, os.path.basename(p)))
+        else:
+            raise FileNotFoundError(f"not a .py file or directory: {p}")
+    return files
+
+
+def _norm(path: str) -> str:
+    return os.path.normpath(path).replace(os.sep, "/")
+
+
+def _code_selected(code: str, select, ignore) -> bool:
+    if select and not any(code.startswith(s) for s in select):
+        return False
+    if ignore and any(code.startswith(s) for s in ignore):
+        return False
+    return True
+
+
+def lint_source(source: str, path: str = "<memory>.py",
+                select: Optional[Sequence[str]] = None,
+                ignore: Optional[Sequence[str]] = None,
+                scan_rel: Optional[str] = None,
+                ) -> Tuple[List[Finding], int]:
+    """Lint one source string. Returns (findings, inline_suppressed_count).
+    The API entry the fixture tests and the docs pre-flight example use."""
+    if not REGISTRY:
+        raise RuntimeError(
+            "no rules registered — import apex_tpu.lint (which loads the "
+            "rule modules), not apex_tpu.lint.core alone; an empty "
+            "registry would report every file as clean")
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(_norm(path), e.lineno or 1, e.offset or 0,
+                        PARSE_ERROR_CODE,
+                        f"file does not parse: {e.msg}")], 0
+    ctx = ModuleContext(_norm(path), source, tree,
+                        scan_rel=_norm(scan_rel) if scan_rel else None)
+    findings: List[Finding] = []
+    for code in sorted(REGISTRY):
+        if not _code_selected(code, select, ignore):
+            continue
+        findings.extend(REGISTRY[code].check(ctx))
+    kept, suppressed = [], 0
+    for f in findings:
+        sup = ctx.suppressions.get(f.line, frozenset())
+        if "all" in sup or f.code in sup:
+            suppressed += 1
+        else:
+            kept.append(f)
+    kept.sort(key=Finding.sort_key)
+    return kept, suppressed
+
+
+def lint_paths(paths: Sequence[str],
+               select: Optional[Sequence[str]] = None,
+               ignore: Optional[Sequence[str]] = None,
+               ) -> Tuple[List[Finding], dict]:
+    files = _iter_py_files(paths)
+    findings: List[Finding] = []
+    inline = 0
+    for fp, scan_rel in files:
+        try:
+            import tokenize
+            with tokenize.open(fp) as fh:  # honors PEP 263 coding lines
+                src = fh.read()
+        except (UnicodeDecodeError, SyntaxError, LookupError) as e:
+            findings.append(Finding(_norm(fp), 1, 0, PARSE_ERROR_CODE,
+                                    f"file cannot be decoded: {e}"))
+            continue
+        got, sup = lint_source(src, path=fp, select=select, ignore=ignore,
+                               scan_rel=scan_rel)
+        findings.extend(got)
+        inline += sup
+    findings.sort(key=Finding.sort_key)
+    return findings, {"files_scanned": len(files),
+                      "suppressed_inline": inline}
+
+
+# --- baseline -----------------------------------------------------------------
+
+class BaselineError(ValueError):
+    pass
+
+
+def load_baseline(path: str) -> List[dict]:
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) or not isinstance(doc.get("entries"), list):
+        raise BaselineError(
+            f"{path}: baseline must be {{'version': 1, 'entries': [...]}}")
+    entries = doc["entries"]
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict):
+            raise BaselineError(f"{path}: entries[{i}] is not an object")
+        for field in ("path", "code", "reason"):
+            if not isinstance(e.get(field), str) or not e[field].strip():
+                raise BaselineError(
+                    f"{path}: entries[{i}] missing non-empty '{field}' — "
+                    "every baselined finding must carry its reason")
+    return entries
+
+
+def _baseline_matches(entry: dict, finding: Finding) -> bool:
+    ep, fp = _norm(entry["path"]), _norm(finding.path)
+    return (entry["code"] == finding.code
+            and (fp == ep or fp.endswith("/" + ep)))
+
+
+def apply_baseline(findings: List[Finding], entries: List[dict]
+                   ) -> Tuple[List[Finding], int, List[dict]]:
+    """Returns (kept findings, baselined count, unused entries)."""
+    used = [False] * len(entries)
+    kept: List[Finding] = []
+    baselined = 0
+    for f in findings:
+        hit = False
+        for i, e in enumerate(entries):
+            if _baseline_matches(e, f):
+                used[i] = True
+                hit = True
+        if hit:
+            baselined += 1
+        else:
+            kept.append(f)
+    unused = [e for e, u in zip(entries, used) if not u]
+    return kept, baselined, unused
+
+
+# --- report document ----------------------------------------------------------
+
+REPORT_VERSION = 1
+
+
+def build_report(findings: List[Finding], stats: dict,
+                 baselined: int = 0) -> dict:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.code] = counts.get(f.code, 0) + 1
+    return {
+        "tool": "apexlint",
+        "version": REPORT_VERSION,
+        "findings": [f.to_dict() for f in findings],
+        "counts": counts,
+        "files_scanned": stats.get("files_scanned", 0),
+        "suppressed_inline": stats.get("suppressed_inline", 0),
+        "suppressed_baseline": baselined,
+    }
+
+
+_CODE_RE = re.compile(r"^APX\d{3}$")
+
+
+def validate_report(obj) -> List[str]:
+    """Schema check for ``--format json`` output — consumed by
+    ``tools/validate_metrics.py --lint-report`` so the lint artifact is
+    gated the same way bench/gate artifacts are."""
+    problems: List[str] = []
+    if not isinstance(obj, dict):
+        return ["lint report is not a JSON object"]
+    if obj.get("tool") != "apexlint":
+        problems.append("tool != 'apexlint'")
+    if obj.get("version") != REPORT_VERSION:
+        problems.append(f"version != {REPORT_VERSION}")
+    findings = obj.get("findings")
+    if not isinstance(findings, list):
+        problems.append("findings is not a list")
+        findings = []
+    counts: Dict[str, int] = {}
+    for i, f in enumerate(findings):
+        where = f"findings[{i}]"
+        if not isinstance(f, dict):
+            problems.append(f"{where} is not an object")
+            continue
+        if not (isinstance(f.get("path"), str) and f["path"]):
+            problems.append(f"{where}.path missing/empty")
+        if not (isinstance(f.get("line"), int) and f["line"] >= 1):
+            problems.append(f"{where}.line must be an int >= 1")
+        if not (isinstance(f.get("col"), int) and f["col"] >= 0):
+            problems.append(f"{where}.col must be an int >= 0")
+        code = f.get("code")
+        if not (isinstance(code, str) and _CODE_RE.match(code)):
+            problems.append(f"{where}.code must match APXnnn")
+        else:
+            counts[code] = counts.get(code, 0) + 1
+        if not (isinstance(f.get("message"), str) and f["message"].strip()):
+            problems.append(f"{where}.message missing/empty")
+    if isinstance(obj.get("counts"), dict):
+        if obj["counts"] != counts and not problems:
+            problems.append(
+                f"counts {obj['counts']} disagree with findings {counts}")
+    else:
+        problems.append("counts is not an object")
+    for field in ("files_scanned", "suppressed_inline", "suppressed_baseline"):
+        v = obj.get(field)
+        if not (isinstance(v, int) and v >= 0):
+            problems.append(f"{field} must be an int >= 0")
+    return problems

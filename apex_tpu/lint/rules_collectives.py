@@ -1,0 +1,143 @@
+"""APX4xx — collective and mesh-axis hygiene.
+
+Collectives reference mesh axes by *name*; a typo'd axis string is not a
+compile error until the collective actually executes under a mesh that
+lacks it — often only on multi-host hardware, far from the edit. The
+repo's canonical axes are ``dp/tp/pp/cp/ep``
+(``apex_tpu.parallel.mesh``); anything else in a string literal is either
+a typo or a local convention worth baselining with a reason.
+
+Rules
+-----
+APX401  unknown-collective-axis   psum/pmean/ppermute/axis_index/… with a
+                                  string-literal axis outside dp/tp/pp/cp/ep
+APX402  unknown-partition-axis    PartitionSpec naming an axis outside the
+                                  known mesh axes (shard_map in_specs/
+                                  out_specs included — they are built of
+                                  PartitionSpecs)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from apex_tpu.lint.core import KNOWN_MESH_AXES, ModuleContext, rule
+
+#: collective → positional index of its axis-name argument
+_COLLECTIVES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "ppermute": 1,
+    "all_gather": 1, "psum_scatter": 1, "pswapaxes": 1, "all_to_all": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+
+
+def _axis_literals(node) -> List[ast.Constant]:
+    """String constants inside an axis argument (plain or tuple/list)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _collective_axis_arg(call: ast.Call, pos: int) -> Optional[ast.expr]:
+    # only `axis_name=` names a mesh axis; `axis=` on all_gather/
+    # psum_scatter/all_to_all is the array-DIMENSION int and must not
+    # shadow a typo'd positional axis name
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    if len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+#: callables that BIND new axis names: a psum over such a name is legal
+_BINDERS = frozenset({"pmap", "vmap", "xmap", "shard_map", "Mesh",
+                      "make_mesh"})
+
+
+def _bound_axis_names(ctx: ModuleContext) -> frozenset:
+    """Axis names bound by pmap/vmap/shard_map/Mesh calls in this module
+    (ISSUE spec: 'not drawn from the known mesh axes OR an enclosing
+    binder'). Module-wide, not scope-exact — a typo only escapes if the
+    same typo also appears in a binder, which is then consistent code."""
+    bound = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = ctx.call_name(node) or ""
+        if canon.rsplit(".", 1)[-1] not in _BINDERS:
+            continue
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis_names"):
+                bound.update(lit.value for lit in _axis_literals(kw.value))
+        # positional spellings: Mesh(devices, ("x", "y")) and
+        # pmap(f, "batch")
+        if canon.rsplit(".", 1)[-1] in ("Mesh", "make_mesh", "pmap") and \
+                len(node.args) >= 2:
+            bound.update(lit.value for lit in _axis_literals(node.args[1]))
+    return frozenset(bound)
+
+
+@rule("APX401", "unknown-collective-axis",
+      "collective with a string-literal axis name outside the repo's mesh "
+      "axes dp/tp/pp/cp/ep or an enclosing binder")
+def check_apx401(ctx: ModuleContext):
+    allowed = KNOWN_MESH_AXES | _bound_axis_names(ctx)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = ctx.call_name(node) or ""
+        short = canon.rsplit(".", 1)[-1]
+        if short not in _COLLECTIVES:
+            continue
+        if not (canon.startswith("jax.lax.") or canon.startswith("lax.")
+                or canon == short):
+            continue
+        axis_arg = _collective_axis_arg(node, _COLLECTIVES[short])
+        if axis_arg is None:
+            continue
+        for lit in _axis_literals(axis_arg):
+            if lit.value not in allowed:
+                yield ctx.finding(
+                    lit, "APX401",
+                    f"`{short}` over axis {lit.value!r} — not one of the "
+                    f"mesh's axes ({'/'.join(sorted(KNOWN_MESH_AXES))}) "
+                    "nor bound by a pmap/vmap/shard_map/Mesh in this "
+                    "module; a typo'd axis only fails when the collective "
+                    "runs under a real mesh (use the mesh_lib.*_AXIS "
+                    "constants)")
+
+
+def _is_partition_spec(ctx: ModuleContext, call: ast.Call) -> bool:
+    canon = ctx.call_name(call) or ""
+    return canon.endswith(".PartitionSpec") or canon == "PartitionSpec"
+
+
+def _spec_axis_literals(call: ast.Call) -> Iterable[ast.Constant]:
+    for arg in call.args:
+        yield from _axis_literals(arg)
+
+
+@rule("APX402", "unknown-partition-axis",
+      "PartitionSpec naming an axis outside the known mesh axes — "
+      "shard_map in_specs/out_specs with such a spec fail only when the "
+      "mesh is live")
+def check_apx402(ctx: ModuleContext):
+    allowed = KNOWN_MESH_AXES | _bound_axis_names(ctx)
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and _is_partition_spec(ctx, node)):
+            continue
+        for lit in _spec_axis_literals(node):
+            if lit.value not in allowed:
+                yield ctx.finding(
+                    lit, "APX402",
+                    f"PartitionSpec axis {lit.value!r} is not one of the "
+                    f"mesh's axes ({'/'.join(sorted(KNOWN_MESH_AXES))}) "
+                    "nor bound by a Mesh/pmap/shard_map in this module — "
+                    "the spec only fails at shard_map/jit time under a "
+                    "mesh that lacks it")

@@ -1,0 +1,300 @@
+"""APX2xx — buffer-donation and aliasing hygiene.
+
+``donate_argnums`` hands the argument's HBM to XLA: the caller's array is
+dead after the call. Reading it afterwards returns garbage (on TPU) or a
+``deleted buffer`` error (with checks on) — and the failure only reproduces
+on hardware, which is exactly why it belongs in a static pass. The decode
+engine donates its KV cache (``inference/engine.py``); its generate loop is
+the canonical *correct* pattern (re-bind the donated buffer from the call
+result every iteration).
+
+Rules
+-----
+APX201  use-after-donation       a donated argument read after the jitted
+                                 call in the same function body
+APX202  donated-not-rebound      a donating call inside a Python loop whose
+                                 donated argument is never re-bound from the
+                                 result — next iteration reuses a dead buffer
+
+Known limitations (conservative false NEGATIVES, never false positives):
+a donating call nested inside a ``with``/``try`` body only scans its own
+block for later reads (the post-block scan is reserved for straight-line
+calls — branch-nested donations would otherwise flag reads on paths where
+the donation never ran), and the jit-target resolution here is a local
+sibling of ``core.jit_sites``'s value-call arm (kept separate because this
+module needs call-site index spaces, not def-site ones).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from apex_tpu.lint.core import (ModuleContext, is_unbound_method, jit_sites,
+                                positional_params, rule)
+
+_JIT_NAMES = ("jax.jit", "jax.pjit", "jax.experimental.pjit.pjit")
+
+
+def _wrapped_def(ctx: ModuleContext, call: ast.Call):
+    """(fn, bound) for the FunctionDef/Lambda a ``jax.jit(target, ...)``
+    call wraps, when resolvable (plain name, ``self.x`` bound method, or
+    inline lambda)."""
+    if not call.args:
+        return None, False
+    target = call.args[0]
+    if isinstance(target, ast.Name):
+        return ctx.defs.get(target.id), False
+    if isinstance(target, ast.Attribute) and \
+            isinstance(target.value, ast.Name) and target.value.id == "self":
+        return ctx.defs.get(target.attr), True
+    if isinstance(target, ast.Lambda):
+        return target, False
+    return None, False
+
+
+def _donated_indices(ctx: ModuleContext, call: ast.Call) -> Optional[List[int]]:
+    """Donated CALL-SITE positional indices of a jit value-wrap:
+    donate_argnums directly (jit saw exactly the callable the call site
+    sees, bound or not), or donate_argnames resolved through the wrapped
+    function's parameter list."""
+    from apex_tpu.lint.core import _const_int_seq, _const_str_seq
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return _const_int_seq(kw.value)
+        if kw.arg == "donate_argnames":
+            names = _const_str_seq(kw.value)
+            fn, bound = _wrapped_def(ctx, call)
+            if names and fn is not None:
+                pos = positional_params(fn, bound)
+                return [pos.index(n) for n in names if n in pos] or None
+            return None
+    return None
+
+
+def _donating_callables(ctx: ModuleContext) -> Dict[str, List[int]]:
+    """Names (plain or ``self.X`` attribute) bound to a jax.jit(...) result
+    with donated arguments → donated positional indices."""
+    out: Dict[str, List[int]] = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        call = node.value
+        if not (isinstance(call, ast.Call)
+                and ctx.call_name(call) in _JIT_NAMES):
+            continue
+        idxs = _donated_indices(ctx, call)
+        if not idxs:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = idxs
+            elif isinstance(t, ast.Attribute):
+                out[t.attr] = idxs
+    for site in jit_sites(ctx):
+        if site.fn is None:
+            continue
+        idxs = site.donate_argnums
+        if not idxs and site.donate_argnames:
+            pos = positional_params(site.fn, site.bound)
+            idxs = [pos.index(n) for n in site.donate_argnames if n in pos]
+        if idxs and not site.bound and is_unbound_method(site.fn):
+            # decorated method: jit indices count `self` at 0, but the
+            # `obj.step(...)` call site the map is consulted at does not
+            idxs = [i - 1 for i in idxs if i >= 1]
+        if idxs:
+            out[site.fn.name] = idxs
+    return out
+
+
+def _donated_args(ctx: ModuleContext, call: ast.Call,
+                  donors: Dict[str, List[int]]) -> List[Tuple[str, ast.Call]]:
+    """(name, call) for each plain-Name positional argument of ``call`` that
+    the callee donates."""
+    idxs: Optional[List[int]] = None
+    f = call.func
+    if isinstance(f, ast.Name) and f.id in donors:
+        idxs = donors[f.id]
+    elif isinstance(f, ast.Attribute) and f.attr in donors:
+        idxs = donors[f.attr]
+    elif isinstance(f, ast.Call) and ctx.call_name(f) in _JIT_NAMES:
+        idxs = _donated_indices(ctx, f)
+    if not idxs:
+        return []
+    out = []
+    for i in idxs:
+        if 0 <= i < len(call.args) and isinstance(call.args[i], ast.Name):
+            out.append((call.args[i].id, call))
+    return out
+
+
+def _name_events(body_nodes, name: str):
+    """(lineno, col, kind) for every use of ``name``; kind in
+    {'load', 'store'}. ``del x`` reads nothing and unbinds the name, so
+    it counts as a store (it safely ends the use-after-donation scan)."""
+    events = []
+    for stmt in body_nodes:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, ast.Name) and \
+                    node.target.id == name:
+                # `a += 1` LOADS a before storing — on a donated buffer
+                # that load is the hazard, not a safe re-bind
+                events.append((node.target.lineno,
+                               node.target.col_offset, "load"))
+            if isinstance(node, ast.Name) and node.id == name:
+                kind = "load" if isinstance(node.ctx, ast.Load) else "store"
+                events.append((node.lineno, node.col_offset, kind))
+    return sorted(events)
+
+
+def _enclosing_loop(ctx: ModuleContext, node) -> Optional[ast.AST]:
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.For, ast.While)):
+            return anc
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+    return None
+
+
+def _stmt_of(ctx: ModuleContext, node):
+    """The outermost statement of the enclosing scope containing ``node``.
+    Anchoring 'after the call' at the scope-level statement (not the
+    innermost one) keeps reads in a sibling `else:` branch — which can
+    never execute after the donating call — out of APX201's line scan."""
+    cur = node
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Module)):
+            return cur
+        cur = anc
+    return cur
+
+
+def _inner_stmt_of(ctx: ModuleContext, node):
+    """The innermost simple statement containing ``node`` — the unit the
+    same-statement read check (`out = step(x, y) + x`) operates on."""
+    cur = node
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Module, ast.For, ast.While, ast.If,
+                            ast.With, ast.Try)):
+            return cur
+        cur = anc
+    return cur
+
+
+def _in_span(node, ev_line, ev_col) -> bool:
+    if ev_line < node.lineno or ev_line > node.end_lineno:
+        return False
+    if ev_line == node.lineno and ev_col < node.col_offset:
+        return False
+    if ev_line == node.end_lineno and ev_col >= node.end_col_offset:
+        return False
+    return True
+
+
+def _following_in_same_body(ctx: ModuleContext, inner):
+    """Statements after ``inner`` in the statement list that contains it —
+    code that definitely executes after the call on the same path."""
+    parent = ctx.parents.get(inner)
+    if parent is None:
+        return []
+    for field in ("body", "orelse", "finalbody"):
+        stmts = getattr(parent, field, None)
+        if isinstance(stmts, list) and inner in stmts:
+            return stmts[stmts.index(inner) + 1:]
+    return []
+
+
+@rule("APX201", "use-after-donation",
+      "a parameter listed in donate_argnums is read after the jitted call — "
+      "its buffer was handed to XLA and is dead")
+def check_apx201(ctx: ModuleContext):
+    donors = _donating_callables(ctx)
+    if not donors:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        pairs = _donated_args(ctx, node, donors)
+        if not pairs or _enclosing_loop(ctx, node) is not None:
+            continue  # loop bodies are APX202's flow
+        fn = ctx.enclosing_function(node)
+        scope = fn.body if fn is not None else ctx.tree.body
+        stmt = _stmt_of(ctx, node)
+        inner = _inner_stmt_of(ctx, node)
+        after = getattr(stmt, "end_lineno", stmt.lineno)
+        # reads after the outer compound are only checked when the call is
+        # NOT nested in a branch — a conditional donation does not make a
+        # post-branch read dead on every path (branch-internal reads below
+        # stay covered either way)
+        straight_line = inner is stmt
+        for name, call in pairs:
+            # a read in the donating statement but OUTSIDE the call itself
+            # (`out = step(x, y) + x`) executes after the call returns —
+            # use-after-donation in one line
+            same_stmt = [(ln, c, k) for ln, c, k
+                         in _name_events([inner], name)
+                         if not _in_span(call, ln, c)]
+            if any(k == "load" for _, _, k in same_stmt):
+                yield ctx.finding(
+                    call, "APX201",
+                    f"`{name}` is donated to this call and read again in "
+                    "the same statement — that read executes after the "
+                    "call returns, on a dead buffer")
+                continue
+            # `x = step(x, ...)`: the statement's own assignment re-binds
+            # the donated name the moment the call returns
+            if any(k == "store" for _, _, k in same_stmt):
+                continue
+            stmts = list(_following_in_same_body(ctx, inner))
+            if straight_line:
+                stmts.extend(s for s in scope
+                             if s.lineno > after and s not in stmts)
+            # statement-wise, in order: within one statement the RHS
+            # loads execute BEFORE the target store (`x = x * 2` after
+            # donating x reads the dead buffer, then re-binds)
+            for s in sorted(stmts, key=lambda s: s.lineno):
+                evs = _name_events([s], name)
+                loads = [e for e in evs if e[2] == "load"]
+                if loads:
+                    yield ctx.finding(
+                        call, "APX201",
+                        f"`{name}` is donated to this call but read "
+                        f"again at line {loads[0][0]} — the donated "
+                        "buffer is dead after the call; re-bind it from "
+                        "the result or drop the donation")
+                    break
+                if any(e[2] == "store" for e in evs):
+                    break  # re-bound before any read: fine
+
+
+@rule("APX202", "donated-not-rebound-in-loop",
+      "a donating call inside a loop whose donated argument is never "
+      "re-bound from the result — iteration 2 feeds a dead buffer")
+def check_apx202(ctx: ModuleContext):
+    donors = _donating_callables(ctx)
+    if not donors:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        loop = _enclosing_loop(ctx, node)
+        if loop is None:
+            continue
+        for name, call in _donated_args(ctx, node, donors):
+            stored = any(kind == "store" for _, _, kind
+                         in _name_events(loop.body, name))
+            # `for b in bufs: step(b)` — the loop target is a FRESH
+            # buffer each iteration, never a donated-dead one
+            from apex_tpu.lint.core import _assign_targets
+            if isinstance(loop, ast.For) and name in _assign_targets(loop):
+                stored = True
+            if not stored:
+                yield ctx.finding(
+                    call, "APX202",
+                    f"`{name}` is donated inside this loop but never "
+                    "re-bound from the call result — the next iteration "
+                    "passes a buffer XLA already reused; thread it through "
+                    "(`x, ... = step(x, ...)`) or use lax.scan/fori_loop")

@@ -1,0 +1,152 @@
+"""APX3xx — Pallas TPU kernel constraints.
+
+Mosaic tiles VMEM as (sublane, lane) = (8, 128) fp32 tiles (bf16 packs
+(16, 128), int8 (32, 128) — all multiples of the fp32 tile, so the base
+multiple is the sound static check; see /opt/skills guides and PERF.md's
+retile notes). Block shapes off the tile force relayouts or padding on
+every grid step — the exact class of silent perf bug the fmha_varlen
+truncation round came from. And every kernel in this repo must stay
+runnable off-TPU: ``ops/`` convention plumbs ``interpret=`` through each
+``pl.pallas_call`` so the CPU suite executes the real kernel bodies
+(``APEX_TPU_PALLAS=interpret``).
+
+Rules
+-----
+APX301  blockspec-off-tile        literal trailing block dims not multiples
+                                  of (8, 128) (size-1 dims exempt)
+APX302  index-map-arity           BlockSpec index_map lambda whose arity
+                                  differs from the literal grid rank — it
+                                  positionally ignores (or invents) a grid
+                                  axis
+APX303  pallas-call-no-interpret  pl.pallas_call without an ``interpret=``
+                                  kwarg — unrunnable in the CPU test suite
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from apex_tpu.lint.core import ModuleContext, rule
+
+_SUBLANE, _LANE = 8, 128
+
+
+def _is_blockspec(ctx: ModuleContext, call: ast.Call) -> bool:
+    canon = ctx.call_name(call) or ""
+    return canon.endswith(".BlockSpec") or canon == "BlockSpec"
+
+
+def _is_pallas_call(ctx: ModuleContext, call: ast.Call) -> bool:
+    canon = ctx.call_name(call) or ""
+    return canon.endswith(".pallas_call") or canon == "pallas_call"
+
+
+def _block_shape(call: ast.Call) -> Optional[ast.Tuple]:
+    if call.args and isinstance(call.args[0], ast.Tuple):
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "block_shape" and isinstance(kw.value, ast.Tuple):
+            return kw.value
+    return None
+
+
+@rule("APX301", "blockspec-off-tile",
+      "BlockSpec trailing block dims must be multiples of the (8, 128) "
+      "TPU tile (dtype-packed tiles are multiples of it too); size-1 "
+      "dims are exempt")
+def check_apx301(ctx: ModuleContext):
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and _is_blockspec(ctx, node)):
+            continue
+        shape = _block_shape(node)
+        if shape is None or len(shape.elts) < 1:
+            continue
+        dims = shape.elts
+        checks = []
+        if len(dims) >= 1:
+            checks.append((dims[-1], _LANE, "last (lane)"))
+        if len(dims) >= 2:
+            checks.append((dims[-2], _SUBLANE, "second-to-last (sublane)"))
+        for expr, mult, which in checks:
+            if isinstance(expr, ast.Constant) and isinstance(expr.value, int) \
+                    and not isinstance(expr.value, bool):
+                v = expr.value
+                if v != 1 and v % mult:
+                    yield ctx.finding(
+                        expr, "APX301",
+                        f"{which} block dim {v} is not a multiple of "
+                        f"{mult} — Mosaic pads every grid step to the "
+                        f"({_SUBLANE}, {_LANE}) tile (bf16/int8 tiles are "
+                        "multiples of it); round the block up or fold the "
+                        "ragged edge into masking")
+
+
+def _grid_rank(call: ast.Call) -> Optional[int]:
+    for kw in call.keywords:
+        if kw.arg != "grid":
+            continue
+        if isinstance(kw.value, ast.Tuple):
+            return len(kw.value.elts)
+        if isinstance(kw.value, ast.Constant) and \
+                isinstance(kw.value.value, int):
+            return 1
+    return None
+
+
+def _index_map(call: ast.Call) -> Optional[ast.Lambda]:
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Lambda):
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "index_map" and isinstance(kw.value, ast.Lambda):
+            return kw.value
+    return None
+
+
+@rule("APX302", "index-map-arity",
+      "a BlockSpec index_map whose lambda arity differs from the grid rank "
+      "positionally ignores (or invents) a grid axis")
+def check_apx302(ctx: ModuleContext):
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and _is_pallas_call(ctx, node)):
+            continue
+        rank = _grid_rank(node)
+        if rank is None:
+            continue
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Call) and _is_blockspec(ctx, sub)):
+                continue
+            lam = _index_map(sub)
+            if lam is None:
+                continue
+            if lam.args.vararg is not None:
+                continue  # `lambda *ixs:` handles every grid rank
+            # bound constants (lambda i, j, g=group: ...) are not grid axes
+            arity = len(lam.args.args) - len(lam.args.defaults)
+            if arity != rank:
+                yield ctx.finding(
+                    lam, "APX302",
+                    f"index_map takes {arity} grid indices but the grid "
+                    f"has rank {rank} — the map ignores or invents a grid "
+                    "axis (intentional value-level broadcast like "
+                    "`lambda i, j: (i, 0)` is fine and not flagged)")
+
+
+@rule("APX303", "pallas-call-no-interpret",
+      "pl.pallas_call without an interpret= kwarg — the repo's ops/ "
+      "convention requires the interpret-mode fallback so CPU tests "
+      "execute the real kernel body")
+def check_apx303(ctx: ModuleContext):
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and _is_pallas_call(ctx, node)):
+            continue
+        kw_names = {kw.arg for kw in node.keywords}
+        if "interpret" in kw_names:
+            continue
+        if None in kw_names:  # **kwargs may carry interpret through
+            continue
+        yield ctx.finding(
+            node, "APX303",
+            "pallas_call without interpret= — plumb the op's interpret "
+            "flag (ops/_backend.interpret_mode()) through so the kernel "
+            "runs in the CPU suite (APEX_TPU_PALLAS=interpret)")

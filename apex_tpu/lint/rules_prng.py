@@ -1,0 +1,134 @@
+"""APX5xx — PRNG and precision discipline.
+
+The motivating bug is real and local: ``multihead_attn`` accepted a
+dropout rate in training mode and, when no PRNG key arrived, silently ran
+dropout-free — a train/eval mismatch nothing surfaced until the fmha
+parity round. Constant ``PRNGKey(0)`` in library code is the same family
+(every process, every step, the same randomness), and fp32/bf16 literal
+cast mixing inside one expression silently promotes back to fp32 —
+defeating the downcast the author thought they applied.
+
+Rules
+-----
+APX501  dropout-without-key   a def taking a dropout rate and a training
+                              flag but no PRNG key/rng/seed parameter
+APX502  constant-prng-key     jax.random.PRNGKey(<literal>) in non-test
+                              library code
+APX503  mixed-precision-cast  one binop mixing an .astype(bf16) operand
+                              with an .astype(fp32) operand
+"""
+
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.lint.core import ModuleContext, rule
+
+_TRAINING_PARAMS = frozenset({
+    "is_training", "training", "train", "is_train", "deterministic",
+})
+
+
+def _keyish(name: str) -> bool:
+    n = name.lower()
+    return any(tok in n for tok in ("key", "rng", "seed", "prng"))
+
+
+def _dropoutish(name: str) -> bool:
+    # "drop" must appear: a bare `rate` is the conventional learning/decay
+    # rate name and carries no dropout intent
+    n = name.lower()
+    if _keyish(n):
+        return False
+    return "dropout" in n or n in ("p_drop", "drop_rate", "drop_p")
+
+
+@rule("APX501", "dropout-without-key",
+      "a function taking a dropout rate and a training flag but no PRNG "
+      "key parameter cannot honor the rate — the multihead_attn "
+      "silent-no-dropout bug shape")
+def check_apx501(ctx: ModuleContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        args = node.args
+        names = [a.arg for a in
+                 list(getattr(args, "posonlyargs", [])) + args.args
+                 + args.kwonlyargs]
+        has_dropout = any(_dropoutish(n) for n in names)
+        has_training = any(n in _TRAINING_PARAMS for n in names)
+        has_key = any(_keyish(n) for n in names)
+        if has_dropout and has_training and not has_key:
+            yield ctx.finding(
+                node, "APX501",
+                f"`{node.name}` accepts a dropout rate and a training flag "
+                "but no PRNG key/rng/seed parameter — with no key it can "
+                "only drop out deterministically or not at all (the "
+                "multihead_attn bug); accept a key and raise when "
+                "rate > 0 in training without one")
+
+
+@rule("APX502", "constant-prng-key",
+      "jax.random.PRNGKey(<int literal>) in non-test code — identical "
+      "randomness every process and every call")
+def check_apx502(ctx: ModuleContext):
+    if ctx.is_testlike_path():
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = ctx.call_name(node) or ""
+        if not (canon.endswith("random.PRNGKey")
+                or canon.endswith("random.key")):
+            continue
+        seed_arg = node.args[0] if node.args else None
+        if seed_arg is None:
+            for kw in node.keywords:
+                if kw.arg == "seed":
+                    seed_arg = kw.value
+        if isinstance(seed_arg, ast.Constant) and \
+                isinstance(seed_arg.value, int):
+            yield ctx.finding(
+                node, "APX502",
+                f"constant PRNG key `{ast.unparse(node)}` in library code "
+                "— every process and every call draws the same stream; "
+                "thread a key in, or fold_in rank/step")
+
+
+def _cast_dtype(expr) -> str:
+    """'bf16' / 'fp32' when ``expr`` is an explicit literal cast there."""
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute) \
+            and expr.func.attr == "astype" and expr.args:
+        return _dtype_token(expr.args[0])
+    return ""
+
+
+def _dtype_token(node) -> str:
+    text = ""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        text = node.value
+    elif isinstance(node, (ast.Attribute, ast.Name)):
+        text = ast.unparse(node)
+    if text.endswith("bfloat16") or text == "bf16":
+        return "bf16"
+    if text.endswith("float32") or text == "fp32":
+        return "fp32"
+    return ""
+
+
+@rule("APX503", "mixed-precision-cast",
+      "one binary op mixing an .astype(bfloat16) operand with an "
+      ".astype(float32) operand — the bf16 downcast silently promotes "
+      "straight back to fp32")
+def check_apx503(ctx: ModuleContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.BinOp):
+            continue
+        kinds = {_cast_dtype(node.left), _cast_dtype(node.right)}
+        if kinds == {"bf16", "fp32"}:
+            yield ctx.finding(
+                node, "APX503",
+                "mixing .astype(bfloat16) and .astype(float32) operands "
+                "in one op — jnp promotes the pair to fp32, so the bf16 "
+                "cast only costs precision without saving bytes; cast "
+                "once, after the op")

@@ -1,0 +1,268 @@
+"""APX1xx — tracing/recompile hazards.
+
+The bug class: code inside a ``jax.jit``/``pjit``-traced function treating a
+traced value as a Python value. On CUDA these were compile-time type errors;
+under tracing they surface as ``ConcretizationTypeError`` at best and as
+silent per-call recompilation or host round-trips at worst (the jax-version
+drift round broke ~160 seed tests on exactly this seam).
+
+Rules
+-----
+APX101  python-control-flow-on-traced   ``if``/``while`` on a traced value
+APX102  concretization-call             ``int()``/``float()``/``bool()``/
+                                        ``.item()``/``.tolist()`` on traced
+APX103  host-numpy-on-traced            ``np.*`` applied to traced values
+APX104  bad-static-argnums              non-int static_argnums, out-of-range
+                                        indices, unknown static_argnames
+APX105  alias-shadowing-parameter       a parameter named np/jnp/pl/... —
+                                        inside that scope the "module" is
+                                        data (the host-call confusion vector)
+APX106  jit-in-body                     jax.jit of a module-level function
+                                        inside another function body — a
+                                        fresh wrapper (and retrace) per call
+"""
+
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.lint.core import (JIT_WRAPPERS, JitSite, ModuleContext,
+                                expr_taint, is_none_check, jit_sites,
+                                positional_params, rule, traced_functions)
+
+_CONCRETIZERS = {"int", "float", "bool", "complex"}
+_CONCRETIZER_METHODS = {"item", "tolist", "__bool__", "__int__", "__float__"}
+
+
+@rule("APX101", "python-control-flow-on-traced",
+      "Python if/while branches on a value derived from a jit-traced "
+      "parameter; use jax.lax.cond/select or jnp.where")
+def check_apx101(ctx: ModuleContext):
+    for fn, statics in traced_functions(ctx):
+        taint = _fn_taint(fn, statics)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                if is_none_check(node.test):
+                    continue
+                if expr_taint(node.test, taint):
+                    yield ctx.finding(
+                        node, "APX101",
+                        f"`{_kw(node)}` on a value derived from a traced "
+                        f"parameter of jitted `{fn.name}` — this forces "
+                        "concretization (ConcretizationTypeError) or a "
+                        "retrace per value; restructure with jax.lax.cond/"
+                        "jnp.where, or mark the driving argument static")
+            elif isinstance(node, ast.IfExp):
+                if not is_none_check(node.test) and \
+                        expr_taint(node.test, taint):
+                    yield ctx.finding(
+                        node, "APX101",
+                        f"conditional expression on a traced value inside "
+                        f"jitted `{fn.name}`; use jnp.where/lax.select")
+
+
+def _kw(node):
+    return "if" if isinstance(node, (ast.If, ast.IfExp)) else "while"
+
+
+@rule("APX102", "concretization-call",
+      "int()/float()/bool()/.item()/.tolist() on a traced value inside a "
+      "jitted function — a host sync the trace cannot express")
+def check_apx102(ctx: ModuleContext):
+    for fn, statics in traced_functions(ctx):
+        taint = _fn_taint(fn, statics)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in _CONCRETIZERS and node.args and \
+                    expr_taint(node.args[0], taint):
+                yield ctx.finding(
+                    node, "APX102",
+                    f"`{node.func.id}()` on a traced value inside jitted "
+                    f"`{fn.name}` raises ConcretizationTypeError at trace "
+                    "time; keep it an array (astype) or mark the argument "
+                    "static")
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _CONCRETIZER_METHODS and \
+                    expr_taint(node.func.value, taint):
+                yield ctx.finding(
+                    node, "APX102",
+                    f"`.{node.func.attr}()` on a traced value inside jitted "
+                    f"`{fn.name}` forces a device→host transfer the trace "
+                    "cannot express")
+
+
+@rule("APX103", "host-numpy-on-traced",
+      "host numpy applied to traced values inside a jitted function — "
+      "silently concretizes (or fails); use jnp")
+def check_apx103(ctx: ModuleContext):
+    for fn, statics in traced_functions(ctx):
+        taint = _fn_taint(fn, statics)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = ctx.call_name(node)
+            if not canon or not (canon == "numpy"
+                                 or canon.startswith("numpy.")):
+                continue
+            args = list(node.args) + [k.value for k in node.keywords]
+            if any(expr_taint(a, taint) for a in args):
+                yield ctx.finding(
+                    node, "APX103",
+                    f"`{ast.unparse(node.func)}` called on a traced value "
+                    f"inside jitted `{fn.name}` — host numpy concretizes "
+                    "its inputs; use the jnp equivalent (host numpy on "
+                    "static shapes/constants is fine)")
+
+
+@rule("APX104", "bad-static-argnums",
+      "static_argnums entries that are not ints, index past the wrapped "
+      "function's positional parameters, or static_argnames naming a "
+      "parameter that does not exist")
+def check_apx104(ctx: ModuleContext):
+    for site in jit_sites(ctx):
+        yield from _check_site(ctx, site)
+
+
+def _check_site(ctx: ModuleContext, site: JitSite):
+    raw_nums = site.raw_kwargs.get("static_argnums")
+    if raw_nums is not None and site.static_argnums is None and \
+            _has_wrong_type_literal(raw_nums):
+        # only literal elements of a WRONG type are provably bad; Name
+        # elements (static_argnums=(AXIS,)) are legal and unreadable, and
+        # static_argnums=None is jax's own default
+        yield ctx.finding(
+            raw_nums, "APX104",
+            "static_argnums must be int positions; strings belong in "
+            "static_argnames, and array-valued statics are unhashable — "
+            "jit will reject or silently retrace per call")
+        return
+    if site.fn is None:
+        return
+    args = site.fn.args
+    pos = positional_params(site.fn, site.bound)
+    for idx in site.static_argnums or []:
+        real = idx if idx >= 0 else len(pos) + idx
+        if not 0 <= real < len(pos):
+            yield ctx.finding(
+                site.raw_kwargs.get("static_argnums", site.node), "APX104",
+                f"static_argnums={idx} is out of range for "
+                f"`{site.fn.name}` ({len(pos)} positional parameter(s))")
+        else:
+            default = _default_for(args, pos, real)
+            if pos[real] == "self":
+                continue  # decorated method: index 0 is self, no default
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                yield ctx.finding(
+                    site.raw_kwargs.get("static_argnums", site.node),
+                    "APX104",
+                    f"static_argnums={idx} marks `{pos[real]}` static but "
+                    "its default is an unhashable "
+                    f"{type(default).__name__.lower()} literal — jit "
+                    "requires hashable statics")
+    names = {a.arg for a in (list(getattr(args, "posonlyargs", []))
+                             + args.args + args.kwonlyargs)}
+    for name in site.static_argnames or []:
+        if name not in names:
+            yield ctx.finding(
+                site.raw_kwargs.get("static_argnames", site.node), "APX104",
+                f"static_argnames={name!r} does not name a parameter of "
+                f"`{site.fn.name}`")
+
+
+#: Conventional array-ecosystem module aliases. A parameter wearing one of
+#: these names turns every ``np.``/``pl.`` expression in its scope into an
+#: attribute read on DATA — the exact confusion APX103 exists to catch, one
+#: edit away. (The reference's ``(b, np, sq, sk)`` softmax signature is the
+#: canonical offender.)
+_MODULE_ALIASES = frozenset({
+    "np", "numpy", "jnp", "jax", "lax", "pl", "pltpu", "jr", "jsp",
+})
+
+
+@rule("APX105", "alias-shadowing-parameter",
+      "a parameter named np/jnp/jax/lax/pl/pltpu/jr shadows the "
+      "conventional module alias — inside that scope the module is data")
+def check_apx105(ctx: ModuleContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            continue
+        args = node.args
+        for a in (list(getattr(args, "posonlyargs", [])) + args.args
+                  + args.kwonlyargs):
+            if a.arg in _MODULE_ALIASES:
+                fname = getattr(node, "name", "<lambda>")
+                yield ctx.finding(
+                    a if hasattr(a, "lineno") else node, "APX105",
+                    f"parameter `{a.arg}` of `{fname}` shadows the "
+                    f"conventional `{a.arg}` module alias — any "
+                    f"`{a.arg}.` expression in this scope silently reads "
+                    "an attribute off data instead of calling the module; "
+                    "rename the parameter")
+
+
+@rule("APX106", "jit-in-body",
+      "jax.jit applied to a module-level function inside another function "
+      "body — builds a fresh wrapper (and retraces) every call; hoist the "
+      "jitted callable to module scope")
+def check_apx106(ctx: ModuleContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = ctx.call_name(node)
+        if canon not in JIT_WRAPPERS:
+            continue
+        if ctx.enclosing_function(node) is None:
+            continue  # module scope: the correct place
+        if not node.args or not isinstance(node.args[0], ast.Name):
+            continue  # jitting a parameter/closure/bound method: not
+            # hoistable, the wrapper legitimately lives here
+        target = node.args[0].id
+        fn = ctx.defs.get(target)
+        if fn is None or ctx.enclosing_function(fn) is not None:
+            continue  # not a module-level def
+        if any(not isinstance(kw.value, (ast.Constant, ast.Tuple, ast.List))
+               for kw in node.keywords):
+            continue  # kwargs capture local state; hoisting would change them
+        parent = ctx.parents.get(node)
+        if isinstance(parent, ast.Assign) and any(
+                isinstance(t, ast.Attribute) for t in parent.targets):
+            continue  # `self.step = jax.jit(f, ...)`: deliberately
+            # once-per-instance (the decode-engine pattern)
+        yield ctx.finding(
+            node, "APX106",
+            f"jax.jit(`{target}`) inside a function body builds a fresh "
+            "wrapper — and a fresh trace — per invocation of the "
+            "enclosing function; hoist `= jax.jit(...)` to module scope "
+            "so the trace cache is shared across calls")
+
+
+def _has_wrong_type_literal(node) -> bool:
+    """A static_argnums value provably not int positions: a non-int,
+    non-None literal (str/float/bytes), directly or as a container
+    element."""
+    def bad(e):
+        return (isinstance(e, ast.Constant) and e.value is not None
+                and not (isinstance(e.value, int)
+                         and not isinstance(e.value, bool)))
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return any(bad(e) for e in node.elts)
+    return bad(node)
+
+
+def _default_for(args: ast.arguments, pos, idx):
+    """Default expr for positional parameter index ``idx`` (post-self)."""
+    all_pos = [a.arg for a in
+               list(getattr(args, "posonlyargs", [])) + args.args]
+    shift = len(all_pos) - len(pos)  # 1 when self was dropped
+    j = idx + shift - (len(all_pos) - len(args.defaults))
+    if 0 <= j < len(args.defaults):
+        return args.defaults[j]
+    return None
+
+
+def _fn_taint(fn, statics):
+    from apex_tpu.lint.core import tainted_names
+    return tainted_names(fn, statics)

@@ -543,8 +543,8 @@ class EncDecPipeline:
 
                 def body(h, layer):
                     return self.model._wrapped(
-                        lambda pl, hh: model.decoder_block(
-                            pl, hh, ctx, dec_b, lens)
+                        lambda lp, hh: model.decoder_block(
+                            lp, hh, ctx, dec_b, lens)
                     )(layer, h), None
                 h, _ = jax.lax.scan(body, h, sp_["dec"])
                 return h

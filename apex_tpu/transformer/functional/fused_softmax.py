@@ -72,7 +72,7 @@ class FusedScaleMaskSoftmax:
             return self.forward_fused_softmax(scores, mask)
         return self.forward_jnp_softmax(scores, mask)
 
-    def is_kernel_available(self, mask, b, np, sq, sk) -> bool:
+    def is_kernel_available(self, mask, b, nh, sq, sk) -> bool:
         """The reference's constraint table (fp16-only, ``16 < sk <= 2048``,
         warp divisibility — ``fused_softmax.py:159-179``) reduces to: user
         opted in, half-precision input, and a lane-aligned softmax axis.

@@ -1,0 +1,958 @@
+"""apexlint tests: one positive + one negative fixture per rule, CLI
+exit-code and JSON-schema behavior, and the tier-1 dogfood gate — the
+linter runs clean over ``apex_tpu/`` with the committed baseline, so any
+new finding fails CI until it is fixed or baselined with a reason.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from apex_tpu import lint
+from apex_tpu.lint.__main__ import main as lint_main
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+BASELINE = os.path.join(REPO, "tools", "apexlint_baseline.json")
+
+# --- per-rule fixtures --------------------------------------------------------
+# (bad triggers the code, good is the nearest legitimate idiom — drawn from
+# real patterns in this repo wherever one exists)
+
+FIXTURES = {
+    "APX101": (
+        """
+import jax
+@jax.jit
+def f(x):
+    if x > 0:
+        return x
+    return -x
+""",
+        """
+import jax
+import jax.numpy as jnp
+@jax.jit
+def f(x, mask=None):
+    if mask is None:          # static pytree-structure check: fine
+        mask = jnp.ones_like(x)
+    if x.shape[0] > 2:        # shape is static under trace: fine
+        x = x * 2
+    return jnp.where(x > 0, x, -x)
+""",
+    ),
+    "APX102": (
+        """
+import jax
+@jax.jit
+def f(x):
+    return x * int(x)
+""",
+        """
+import jax
+@jax.jit
+def f(x):
+    return x * int(x.shape[0])
+""",
+    ),
+    "APX103": (
+        """
+import jax
+import numpy as np
+@jax.jit
+def f(x):
+    return np.sum(x)
+""",
+        """
+import jax
+import numpy as np
+@jax.jit
+def f(x):
+    return x * np.prod(x.shape)
+""",
+    ),
+    "APX104": (
+        """
+import jax
+def g(a, b):
+    return a + b
+h = jax.jit(g, static_argnums=(5,))
+""",
+        """
+import jax
+def g(a, b):
+    return a + b
+h = jax.jit(g, static_argnums=(1,))
+""",
+    ),
+    "APX105": (
+        """
+def is_kernel_available(mask, b, np, sq, sk):
+    return sk % 128 == 0
+""",
+        """
+def is_kernel_available(mask, b, nh, sq, sk):
+    return sk % 128 == 0
+""",
+    ),
+    "APX106": (
+        """
+import jax
+def score(m):
+    return m * 2
+def search(m):
+    fn = jax.jit(score)
+    return fn(m)
+""",
+        """
+import jax
+def score(m):
+    return m * 2
+_score = jax.jit(score)
+def search(m):
+    return _score(m)
+""",
+    ),
+    "APX201": (
+        """
+import jax
+def f(x, y):
+    step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+    out = step(x, y)
+    return out + x
+""",
+        """
+import jax
+def f(x, y):
+    step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+    x = step(x, y)
+    return x + y
+""",
+    ),
+    "APX202": (
+        """
+import jax
+def train(params, batches):
+    step = jax.jit(lambda p, b: p, donate_argnums=(0,))
+    for b in batches:
+        loss = step(params, b)
+    return loss
+""",
+        """
+import jax
+def train(params, batches):
+    step = jax.jit(lambda p, b: (p, 0.0), donate_argnums=(0,))
+    for b in batches:
+        params, loss = step(params, b)
+    return params, loss
+""",
+    ),
+    "APX301": (
+        """
+from jax.experimental import pallas as pl
+spec = pl.BlockSpec((8, 100), lambda i: (i, 0))
+""",
+        """
+from jax.experimental import pallas as pl
+bn = 100
+specs = [pl.BlockSpec((8, 128), lambda i: (i, 0)),
+         pl.BlockSpec((1, 1, 128), lambda i: (i, 0, 0)),
+         pl.BlockSpec((8, bn), lambda i: (i, 0))]
+""",
+    ),
+    "APX302": (
+        """
+from jax.experimental import pallas as pl
+def f(k, x):
+    return pl.pallas_call(
+        k, grid=(4, 4),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+        out_shape=None, interpret=True)(x)
+""",
+        """
+from jax.experimental import pallas as pl
+def f(k, x):
+    return pl.pallas_call(
+        k, grid=(4, 4),
+        in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+        out_shape=None, interpret=True)(x)
+""",
+    ),
+    "APX303": (
+        """
+from jax.experimental import pallas as pl
+def f(k, x):
+    return pl.pallas_call(k, grid=(4,), out_shape=None)(x)
+""",
+        """
+from jax.experimental import pallas as pl
+def f(k, x, interpret=False):
+    return pl.pallas_call(k, grid=(4,), out_shape=None,
+                          interpret=interpret)(x)
+""",
+    ),
+    "APX401": (
+        """
+import jax
+def f(x):
+    return jax.lax.psum(x, "dpp")
+""",
+        """
+import jax
+def f(x, axis_name="dp"):
+    return jax.lax.psum(x, axis_name) + jax.lax.psum(x, "tp")
+""",
+    ),
+    "APX402": (
+        """
+from jax.sharding import PartitionSpec as P
+spec = P("model", None)
+""",
+        """
+from jax.sharding import PartitionSpec as P
+spec = P("dp", None, "tp")
+""",
+    ),
+    "APX501": (
+        """
+def attn(q, k, v, dropout=0.1, is_training=True):
+    return q
+""",
+        """
+def attn(q, k, v, dropout=0.1, is_training=True, key=None):
+    if dropout > 0 and is_training and key is None:
+        raise ValueError("dropout needs a key")
+    return q
+""",
+    ),
+    "APX502": (
+        """
+import jax
+def make_stream():
+    return jax.random.PRNGKey(42)
+""",
+        """
+import jax
+def make_stream(seed):
+    return jax.random.PRNGKey(seed)
+""",
+    ),
+    "APX503": (
+        """
+import jax.numpy as jnp
+def f(a, b):
+    return a.astype(jnp.bfloat16) * b.astype(jnp.float32)
+""",
+        """
+import jax.numpy as jnp
+def f(a, b):
+    return (a.astype(jnp.float32) * b.astype(jnp.float32)
+            ).astype(jnp.bfloat16)
+""",
+    ),
+}
+
+
+class TestRuleFixtures:
+    @pytest.mark.parametrize("code", sorted(FIXTURES))
+    def test_positive(self, code):
+        bad, _ = FIXTURES[code]
+        findings, _ = lint.lint_source(bad, path="apex_tpu/fixture.py")
+        assert code in {f.code for f in findings}, (
+            f"{code} failed to fire on its bad fixture: {findings}")
+
+    @pytest.mark.parametrize("code", sorted(FIXTURES))
+    def test_negative(self, code):
+        _, good = FIXTURES[code]
+        findings, _ = lint.lint_source(good, path="apex_tpu/fixture.py")
+        assert code not in {f.code for f in findings}, (
+            f"{code} false-positived on its good fixture: "
+            f"{[f.render() for f in findings if f.code == code]}")
+
+    def test_every_registered_rule_has_fixtures(self):
+        codes = {r.code for r in lint.iter_rules()}
+        assert codes - {lint.PARSE_ERROR_CODE} == set(FIXTURES)
+
+    def test_rule_breadth_meets_acceptance(self):
+        """>= 10 distinct codes spanning all five APX families."""
+        codes = sorted(FIXTURES)
+        assert len(codes) >= 10
+        families = {c[:4] for c in codes}
+        assert families == {"APX1", "APX2", "APX3", "APX4", "APX5"}
+
+    def test_apx502_skips_test_paths(self):
+        bad, _ = FIXTURES["APX502"]
+        findings, _ = lint.lint_source(bad, path="tests/test_fixture.py")
+        assert "APX502" not in {f.code for f in findings}
+
+    def test_parse_error_is_a_finding(self):
+        findings, _ = lint.lint_source("def broken(:\n", path="x.py")
+        assert [f.code for f in findings] == [lint.PARSE_ERROR_CODE]
+
+    def test_apx201_same_statement_read_after_call(self):
+        src = """
+import jax
+def f(x, y):
+    step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+    out = step(x, y) + x
+    return out
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX201" in {f.code for f in findings}
+
+    def test_apx201_skips_sibling_exclusive_branch(self):
+        src = """
+import jax
+def f(x, y, cond):
+    step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+    if cond:
+        out = step(x, y)
+    else:
+        out = x * 2
+    return out
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX201" not in {f.code for f in findings}
+
+    def test_apx202_fires_for_donate_argnames_too(self):
+        src = """
+import jax
+def f(cache, x):
+    return cache, x
+step = jax.jit(f, donate_argnames=("cache",))
+def loop(cache, xs):
+    for x in xs:
+        out = step(cache, x)
+    return out
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX202" in {f.code for f in findings}
+
+    def test_apx201_same_branch_read_flagged_at_true_line(self):
+        src = """
+import jax
+def f(c, x, flag):
+    step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+    if flag:
+        out = step(c, x)
+        print(c)
+        return out
+    return c
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        hits = [f for f in findings if f.code == "APX201"]
+        # the dead read is print(c) at line 7, same branch; the return c
+        # at line 9 runs only on the no-donation path and must NOT be the
+        # cited line
+        assert len(hits) == 1 and "line 7" in hits[0].message
+
+    def test_apx201_post_branch_read_after_conditional_donation_not_flagged(
+            self):
+        src = """
+import jax
+def f(c, x, flag):
+    step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+    if flag:
+        out = step(c, x)
+        return out
+    return c * 2
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX201" not in {f.code for f in findings}
+
+    def test_negative_static_argnums_parse_and_resolve(self):
+        src = """
+import jax
+import functools
+@functools.partial(jax.jit, static_argnums=(-1,))
+def f(x, mode):
+    if mode == "fast":
+        return x * 2
+    return x
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        codes = {f.code for f in findings}
+        # -1 resolves to `mode` (static): no APX104, and the branch on the
+        # static param is not a tracing hazard
+        assert "APX104" not in codes and "APX101" not in codes
+
+    def test_getattr_does_not_launder_taint(self):
+        src = """
+import jax
+@jax.jit
+def f(x):
+    if getattr(x, "T").sum():
+        return x
+    return -x
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX101" in {f.code for f in findings}
+        good = src.replace('getattr(x, "T").sum()',
+                           'getattr(x, "ndim") > 1')
+        findings, _ = lint.lint_source(good, path="apex_tpu/fixture.py")
+        assert "APX101" not in {f.code for f in findings}
+
+    def test_disable_all_is_case_insensitive(self):
+        src = ('from jax.experimental import pallas as pl\n'
+               'spec = pl.BlockSpec((8, 100), lambda i: (i, 0))'
+               '  # apexlint: disable=ALL\n')
+        findings, suppressed = lint.lint_source(src, path="x.py")
+        assert not findings and suppressed == 1
+
+    def test_apx201_read_in_rebinding_statement_still_flagged(self):
+        src = """
+import jax
+def f(x, y):
+    step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+    out = step(x, y)
+    x = x * 2
+    return out + x
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        hits = [f for f in findings if f.code == "APX201"]
+        assert len(hits) == 1 and "line 6" in hits[0].message
+
+    def test_apx502_not_disabled_by_testlike_checkout_prefix(self):
+        bad, _ = FIXTURES["APX502"]
+        findings, _ = lint.lint_source(
+            bad, path="/home/testuser/repo/apex_tpu/engine.py")
+        assert "APX502" in {f.code for f in findings}
+        # exact test-directory components still exempt
+        findings, _ = lint.lint_source(bad, path="repo/tests/helper.py")
+        assert "APX502" not in {f.code for f in findings}
+
+    def test_apx401_axis_kwarg_is_a_dimension_not_a_name(self):
+        src = """
+import jax
+def f(x):
+    return jax.lax.all_gather(x, "dpp", axis=0)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX401" in {f.code for f in findings}
+
+    def test_apx302_star_args_index_map_exempt(self):
+        src = """
+from jax.experimental import pallas as pl
+def f(k, x):
+    return pl.pallas_call(
+        k, grid=(4, 4),
+        in_specs=[pl.BlockSpec((8, 128), lambda *ixs: (ixs[0], 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+        out_shape=None, interpret=True)(x)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX302" not in {f.code for f in findings}
+
+    def test_apx501_bare_rate_is_not_dropout(self):
+        src = """
+def apply_decay(step, rate, train):
+    return rate if train else 0.0
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX501" not in {f.code for f in findings}
+
+    def test_apx104_int_valued_name_element_is_legal(self):
+        src = """
+import jax
+AXIS = 1
+def g(a, b):
+    return a + b
+h = jax.jit(g, static_argnums=(AXIS,))
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX104" not in {f.code for f in findings}
+
+    def test_apx202_loop_target_is_a_fresh_buffer(self):
+        src = """
+import jax
+def f(bufs):
+    step = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+    outs = []
+    for b in bufs:
+        outs.append(step(b))
+    return outs
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX202" not in {f.code for f in findings}
+
+    def test_apx401_pmap_positional_axis_name_allowed(self):
+        src = """
+import jax
+def inner(x):
+    return jax.lax.psum(x, "batch")
+g = jax.pmap(inner, "batch")
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX401" not in {f.code for f in findings}
+
+    def test_directive_inside_string_literal_is_not_a_directive(self):
+        src = ('import jax\n'
+               'def f():\n'
+               '    m = "docs: # apexlint: disable=all"; '
+               'k = jax.random.PRNGKey(0)\n'
+               '    return m, k\n')
+        findings, suppressed = lint.lint_source(
+            src, path="apex_tpu/fixture.py")
+        assert "APX502" in {f.code for f in findings} and suppressed == 0
+
+    def test_empty_registry_refuses_to_run(self, monkeypatch):
+        monkeypatch.setattr(lint.core, "REGISTRY", {})
+        with pytest.raises(RuntimeError, match="no rules registered"):
+            lint.lint_source("x = 1\n", path="x.py")
+
+    def test_decorated_method_static_argnums_count_self(self):
+        # jit decorating a METHOD wraps the unbound function: index 0 is
+        # self, index 1 is `n` — neither APX104 nor APX101 may fire
+        src = """
+import jax
+import functools
+class E:
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def step(self, n, x):
+        if n > 0:
+            return x * n
+        return x
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        codes = {f.code for f in findings}
+        assert "APX104" not in codes and "APX101" not in codes
+
+    def test_decorated_method_donation_shifts_to_call_site(self):
+        # donate_argnums=(1,) on a decorated method donates `cache`,
+        # which is call-site position 0 of self.step(cache, tok)
+        src = """
+import jax
+import functools
+class E:
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def step(self, cache, tok):
+        return cache, tok
+    def serve(self, cache, toks):
+        for t in toks:
+            out, _ = self.step(cache, t)
+        return out
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        hits = [f for f in findings if f.code == "APX202"]
+        assert len(hits) == 1 and "`cache`" in hits[0].message
+
+    def test_testlike_exemption_scoped_below_scan_root(self, tmp_path):
+        # a checkout under .../examples/... must not disable APX502:
+        # test-likeness is judged on the path below the scanned argument
+        pkg = tmp_path / "examples" / "repo" / "mylib"
+        pkg.mkdir(parents=True)
+        (pkg / "engine.py").write_text(
+            "import jax\ndef f():\n    return jax.random.PRNGKey(0)\n")
+        findings, _ = lint.lint_paths([str(pkg)])
+        assert "APX502" in {f.code for f in findings}
+        # while a tests/ dir INSIDE the scanned tree stays exempt
+        (pkg / "tests").mkdir()
+        (pkg / "tests" / "helper.py").write_text(
+            "import jax\ndef f():\n    return jax.random.PRNGKey(0)\n")
+        findings, _ = lint.lint_paths([str(pkg)])
+        assert len([f for f in findings if f.code == "APX502"]) == 1
+
+    def test_shard_map_wrapped_functions_are_traced(self):
+        # ISSUE spec: 'decorated or wrapped with jax.jit/pjit/shard_map'
+        src = """
+from apex_tpu.parallel import mesh as mesh_lib
+def body(x):
+    if x.sum() > 0:
+        return x
+    return -x
+run = mesh_lib.shard_map(body, mesh=None, in_specs=None, out_specs=None)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX101" in {f.code for f in findings}
+
+    def test_pmap_wrapped_functions_are_traced(self):
+        src = """
+import jax
+def body(x):
+    return x * float(x)
+g = jax.pmap(body)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX102" in {f.code for f in findings}
+
+    def test_non_utf8_file_is_a_finding_not_a_crash(self, tmp_path):
+        p = tmp_path / "legacy.py"
+        p.write_bytes(b"# coding: latin-1\n# caf\xe9\nx = 1\n")
+        bad = tmp_path / "broken.py"
+        bad.write_bytes(b"\xff\xfe garbage not a coding\n")
+        findings, _ = lint.lint_paths([str(tmp_path)])
+        # the PEP-263 latin-1 file decodes fine; the undecodable one
+        # becomes an APX000 finding instead of an uncaught traceback
+        assert [f.code for f in findings] == [lint.PARSE_ERROR_CODE]
+        assert "broken.py" in findings[0].path
+
+    def test_apx201_augassign_reads_the_dead_buffer(self):
+        src = """
+import jax
+def f(a, b):
+    step = jax.jit(lambda x, y: x + y, donate_argnums=(0,))
+    out = step(a, b)
+    a += 1
+    return out
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX201" in {f.code for f in findings}
+
+    def test_multiple_wraps_intersect_statics(self):
+        # one static wrap must not silence the hazard the plain wrap traces
+        src = """
+import jax
+def f(n, x):
+    if n > 0:
+        return x
+    return -x
+g1 = jax.jit(f, static_argnums=(0,))
+g2 = jax.jit(f)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX101" in {f.code for f in findings}
+
+    def test_apx104_static_argnums_none_is_legal(self):
+        src = """
+import jax
+def g(a, b):
+    return a + b
+h = jax.jit(g, static_argnums=None)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX104" not in {f.code for f in findings}
+
+    def test_apx201_del_after_donation_is_not_a_read(self):
+        src = """
+import jax
+def f(x, y):
+    step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+    out = step(x, y)
+    del x
+    return out
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX201" not in {f.code for f in findings}
+
+    def test_apx401_binder_bound_axis_allowed(self):
+        src = """
+import jax
+def inner(x):
+    return jax.lax.psum(x, "batch")
+f = jax.pmap(inner, axis_name="batch")
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX401" not in {f.code for f in findings}
+
+    def test_apx401_402_mesh_positional_axis_names_allowed(self):
+        src = """
+import jax
+from jax.sharding import Mesh, PartitionSpec
+def build(devices, v):
+    mesh = Mesh(devices, ("x", "y"))
+    spec = PartitionSpec("x", "y")
+    return mesh, spec, jax.lax.psum(v, "x")
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        codes = {f.code for f in findings}
+        assert "APX401" not in codes and "APX402" not in codes
+
+    def test_apx502_keyword_seed_spelling_flagged(self):
+        src = """
+import jax
+def make_stream():
+    return jax.random.PRNGKey(seed=7)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX502" in {f.code for f in findings}
+
+    def test_apx106_skips_once_per_instance_attribute_wrap(self):
+        src = """
+import jax
+def step(p, g):
+    return p - g
+class Engine:
+    def __init__(self):
+        self.step = jax.jit(step, static_argnums=(1,))
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/fixture.py")
+        assert "APX106" not in {f.code for f in findings}
+
+
+class TestSuppression:
+    def test_inline_disable(self):
+        src = ('from jax.experimental import pallas as pl\n'
+               'spec = pl.BlockSpec((8, 100), lambda i: (i, 0))'
+               '  # apexlint: disable=APX301\n')
+        findings, suppressed = lint.lint_source(src, path="x.py")
+        assert not findings and suppressed == 1
+
+    def test_inline_disable_all(self):
+        src = ('from jax.experimental import pallas as pl\n'
+               'spec = pl.BlockSpec((8, 100), lambda i: (i, 0))'
+               '  # apexlint: disable=all\n')
+        findings, suppressed = lint.lint_source(src, path="x.py")
+        assert not findings and suppressed == 1
+
+    def test_trailing_prose_after_code_still_suppresses(self):
+        src = ('from jax.experimental import pallas as pl\n'
+               'spec = pl.BlockSpec((8, 100), lambda i: (i, 0))'
+               '  # apexlint: disable=APX301 - ragged edge is masked\n')
+        findings, suppressed = lint.lint_source(src, path="x.py")
+        assert not findings and suppressed == 1
+
+    def test_typod_long_code_does_not_prefix_suppress(self):
+        # 'APX3019' must not silently suppress APX301 via prefix match
+        src = ('from jax.experimental import pallas as pl\n'
+               'spec = pl.BlockSpec((8, 100), lambda i: (i, 0))'
+               '  # apexlint: disable=APX3019\n')
+        findings, suppressed = lint.lint_source(src, path="x.py")
+        assert [f.code for f in findings] == ["APX301"] and suppressed == 0
+
+    def test_wrong_code_does_not_suppress(self):
+        src = ('from jax.experimental import pallas as pl\n'
+               'spec = pl.BlockSpec((8, 100), lambda i: (i, 0))'
+               '  # apexlint: disable=APX999\n')
+        findings, suppressed = lint.lint_source(src, path="x.py")
+        assert [f.code for f in findings] == ["APX301"] and suppressed == 0
+
+
+class TestBaseline:
+    def test_match_and_unused(self):
+        f1 = lint.Finding("apex_tpu/a.py", 3, 0, "APX301", "m")
+        f2 = lint.Finding("apex_tpu/b.py", 9, 0, "APX502", "m")
+        entries = [
+            {"path": "apex_tpu/a.py", "code": "APX301", "reason": "r"},
+            {"path": "apex_tpu/zz.py", "code": "APX101", "reason": "r"},
+        ]
+        kept, baselined, unused = lint.apply_baseline([f1, f2], entries)
+        assert kept == [f2] and baselined == 1
+        assert unused == [entries[1]]
+
+    def test_reason_required(self, tmp_path):
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps(
+            {"version": 1,
+             "entries": [{"path": "a.py", "code": "APX301"}]}))
+        with pytest.raises(ValueError, match="reason"):
+            lint.load_baseline(str(p))
+
+    def test_committed_baseline_entries_all_carry_reasons(self):
+        entries = lint.load_baseline(BASELINE)  # raises if malformed
+        assert all(len(e["reason"]) > 20 for e in entries), (
+            "baseline reasons must actually explain the intent")
+
+
+class TestCLI:
+    def _run(self, argv, capsys):
+        rc = lint_main(argv)
+        out = capsys.readouterr()
+        return rc, out.out, out.err
+
+    def test_exit_1_on_findings(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(FIXTURES["APX301"][0])
+        rc, out, _ = self._run([str(bad)], capsys)
+        assert rc == 1 and "APX301" in out
+
+    def test_exit_0_on_clean(self, tmp_path, capsys):
+        good = tmp_path / "good.py"
+        good.write_text(FIXTURES["APX301"][1])
+        rc, out, _ = self._run([str(good)], capsys)
+        assert rc == 0 and "0 finding(s)" in out
+
+    def test_exit_2_on_missing_path(self, tmp_path, capsys):
+        rc, _, err = self._run([str(tmp_path / "nope.xyz")], capsys)
+        assert rc == 2 and "error" in err
+
+    def test_exit_2_on_no_args(self, capsys):
+        rc, _, err = self._run([], capsys)
+        assert rc == 2
+
+    def test_select_and_ignore(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(FIXTURES["APX301"][0] + FIXTURES["APX502"][0])
+        rc, out, _ = self._run([str(bad), "--select", "APX3"], capsys)
+        assert rc == 1 and "APX301" in out and "APX502" not in out
+        rc, out, _ = self._run([str(bad), "--ignore", "APX3,APX5"], capsys)
+        assert rc == 0
+
+    def test_json_report_validates(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(FIXTURES["APX301"][0] + FIXTURES["APX502"][0])
+        rc, out, _ = self._run([str(bad), "--format", "json"], capsys)
+        assert rc == 1
+        report = json.loads(out)
+        assert lint.validate_report(report) == []
+        assert report["counts"]["APX301"] == 1
+        assert report["files_scanned"] == 1
+
+    def test_list_rules(self, capsys):
+        rc, out, _ = self._run(["--list-rules"], capsys)
+        assert rc == 0
+        for r in lint.iter_rules():
+            assert r.code in out
+
+    def test_baseline_flag(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(FIXTURES["APX301"][0])
+        bl = tmp_path / "bl.json"
+        bl.write_text(json.dumps({"version": 1, "entries": [
+            {"path": str(bad).replace(os.sep, "/"), "code": "APX301",
+             "reason": "fixture: documented-intentional"}]}))
+        rc, out, _ = self._run(
+            [str(bad), "--baseline", str(bl)], capsys)
+        assert rc == 0 and "1 baselined" in out
+
+
+class TestReportSchema:
+    def test_rejects_corruption(self):
+        report = lint.build_report(
+            [lint.Finding("a.py", 2, 0, "APX101", "m")],
+            {"files_scanned": 1, "suppressed_inline": 0})
+        assert lint.validate_report(report) == []
+        for mutate in (
+            lambda r: r.update(tool="other"),
+            lambda r: r.update(version=99),
+            lambda r: r["findings"][0].update(line=0),
+            lambda r: r["findings"][0].update(code="E501"),
+            lambda r: r["findings"][0].update(message=""),
+            lambda r: r.update(counts={"APX101": 7}),
+            lambda r: r.update(files_scanned=-1),
+            lambda r: r.pop("counts"),
+        ):
+            broken = json.loads(json.dumps(report))
+            mutate(broken)
+            assert lint.validate_report(broken), mutate
+
+    def test_not_an_object(self):
+        assert lint.validate_report([1, 2]) != []
+
+
+class TestValidateMetricsLintReport:
+    """tools/validate_metrics.py --lint-report gates the lint artifact the
+    same way bench/gate artifacts are gated."""
+
+    def _vm(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import validate_metrics
+        finally:
+            sys.path.pop(0)
+        return validate_metrics
+
+    def test_roundtrip_from_cli_report(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(FIXTURES["APX301"][0])
+        assert lint_main([str(bad), "--format", "json"]) == 1
+        report_path = tmp_path / "lint.json"
+        report_path.write_text(capsys.readouterr().out)
+        vm = self._vm()
+        assert vm.main(["--lint-report", str(report_path)]) == 0
+        capsys.readouterr()
+
+    def test_content_dispatch_without_flag(self, tmp_path, capsys):
+        report = lint.build_report(
+            [], {"files_scanned": 3, "suppressed_inline": 0})
+        p = tmp_path / "lint.json"
+        p.write_text(json.dumps(report))
+        vm = self._vm()
+        assert vm.main([str(p)]) == 0
+        capsys.readouterr()
+
+    def test_corrupt_report_fails(self, tmp_path, capsys):
+        report = lint.build_report(
+            [lint.Finding("a.py", 2, 0, "APX101", "m")],
+            {"files_scanned": 1, "suppressed_inline": 0})
+        report["counts"] = {"APX101": 99}
+        p = tmp_path / "lint.json"
+        p.write_text(json.dumps(report))
+        vm = self._vm()
+        assert vm.main(["--lint-report", str(p)]) == 1
+        err = capsys.readouterr().err
+        assert "disagree" in err
+
+    def test_flag_forces_lint_interpretation(self, tmp_path, capsys):
+        # a report that lost its tool key: content dispatch would call it
+        # an unrecognized shape; --lint-report must fail it AS a lint report
+        p = tmp_path / "lint.json"
+        p.write_text(json.dumps({"findings": []}))
+        vm = self._vm()
+        assert vm.main(["--lint-report", str(p)]) == 1
+        assert "tool" in capsys.readouterr().err
+
+
+class TestDogfoodGate:
+    """The tier-1 gate: apexlint over apex_tpu/ must be clean modulo the
+    committed baseline. A new hazard anywhere in the library fails the
+    suite until fixed or baselined-with-reason."""
+
+    def test_apex_tpu_lints_clean_through_real_cli(self, monkeypatch,
+                                                   capsys):
+        """The acceptance-criterion invocation — `python -m apex_tpu.lint
+        apex_tpu/` with no flags — driven through the CLI entry point
+        (argparse, exit codes, default package-relative baseline). Run
+        in-process rather than via subprocess purely to keep the tier-1
+        wall-clock down (a subprocess re-pays the jax import)."""
+        monkeypatch.chdir(REPO)
+        rc = lint_main(["apex_tpu/", "--format", "json"])
+        out = capsys.readouterr().out
+        assert rc == 0, (
+            f"apexlint found non-baselined findings:\n{out}\n"
+            "fix them or baseline with a reason in "
+            "tools/apexlint_baseline.json")
+        report = json.loads(out)
+        assert lint.validate_report(report) == []
+        assert report["findings"] == []
+        assert report["suppressed_baseline"] >= 1
+        assert report["files_scanned"] > 100
+
+    def test_no_baseline_resurfaces_the_baselined_finding(self, capsys):
+        rc = lint_main([os.path.join(REPO, "apex_tpu", "inference",
+                                     "engine.py"), "--no-baseline"])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "APX502" in out  # the engine's documented dummy key
+
+    def test_committed_baseline_has_no_stale_entries(self, capsys):
+        """Every committed baseline entry still matches a live finding —
+        checked on the one file the baseline names (cheap), with the
+        explicit --baseline path so unused-entry warnings engage."""
+        entries = lint.load_baseline(BASELINE)
+        paths = sorted({os.path.join(REPO, e["path"]) for e in entries})
+        rc = lint_main(paths + ["--baseline", BASELINE])
+        captured = capsys.readouterr()
+        assert rc == 0
+        assert "unused baseline entry" not in captured.err
+
+    def test_gate_scope_has_no_inline_all_suppressions(self):
+        """`disable=all` is for fixtures/docs, not the library: every
+        library suppression must name its code (reviewable intent)."""
+        for root, dirs, names in os.walk(os.path.join(REPO, "apex_tpu")):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for n in names:
+                if not n.endswith(".py"):
+                    continue
+                text = open(os.path.join(root, n), encoding="utf-8").read()
+                assert "apexlint: disable=all" not in text, (
+                    os.path.join(root, n))
+
+
+class TestDocsCatalogue:
+    """docs/api/lint.md is under the enforced docs tier: every registered
+    rule appears with a bad + good snippet."""
+
+    def test_every_rule_documented(self):
+        path = os.path.join(REPO, "docs", "api", "lint.md")
+        text = open(path, encoding="utf-8").read()
+        for r in lint.iter_rules():
+            assert f"### {r.code}" in text, f"{r.code} missing from lint.md"
+        n_rules = len(lint.iter_rules())
+        assert text.count("```python") >= 2 * n_rules, (
+            "each rule needs a bad and a good snippet")
+        for needle in ("--baseline", "apexlint: disable=", "--format json",
+                       "tools/apexlint_baseline.json"):
+            assert needle in text, f"lint.md lost its {needle} workflow"

@@ -379,6 +379,103 @@ class TestGPTBlockPipeline:
             np.testing.assert_allclose(a, e, rtol=5e-3, atol=5e-4)
 
 
+class TestInterleavedV3Uneven:
+    """VERDICT r5 Next #8: v=3 with an uneven layer count in the
+    schedule×feature matrix. 5 real layers mapped onto pp=2 × v=3 = 6
+    virtual stages — the last stage is an identity pad (w1=b1=w2=0 makes
+    the residual-MLP stage `x + tanh(0)@0 = x`), which is how a layer
+    count that does not divide v·S rides the interleaved schedule. The
+    bookkeeping under test: odd v breaks the power-of-two chunk/microbatch
+    index arithmetic if anything in `item()` silently assumed v | 2."""
+
+    def _stages(self):
+        plist = make_stage_params(jr.fold_in(K, 50), 5)
+        pad = jax.tree.map(jnp.zeros_like, plist[0])  # identity stage
+        return plist + [pad]
+
+    def test_v3_uneven_grads_match_serial(self):
+        S, v = 2, 3
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=S)
+        plist = self._stages()  # 6 virtual stages, the 6th a pad
+        M = 2  # the minimum M % S == 0 load: parity, not throughput
+        mbs = jr.normal(jr.fold_in(K, 51), (M, 2, HID))
+        tgts = jr.normal(jr.fold_in(K, 52), (M, 2, HID))
+
+        # device r holds chunks [r, r+S, r+2S]: stack (v, S, ...)
+        chunks = [[plist[c * S + r] for r in range(S)] for c in range(v)]
+        stacked = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[jax.tree.map(lambda *ys: jnp.stack(ys), *row)
+              for row in chunks],
+        )
+
+        def loss_head(out, tgt):
+            return jnp.mean((out - tgt) ** 2)
+
+        def run(p, m, t):
+            loss, g = schedules.forward_backward_pipelining_with_interleaving(
+                stage_fn, loss_head, jax.tree.map(lambda x: x[:, 0], p),
+                m, t, virtual_chunks=v,
+            )
+            return loss, jax.tree.map(lambda x: x[:, None], g)
+
+        loss, grads = mesh_lib.shard_map(
+            run, mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P(None, "pp"), stacked),
+                      P(), P()),
+            out_specs=(P(), jax.tree.map(lambda _: P(None, "pp"), stacked)),
+        )(stacked, mbs, tgts)
+
+        def serial_loss(stacked_p):
+            plist_l = [jax.tree.map(lambda x: x[k // S, k % S], stacked_p)
+                       for k in range(v * S)]
+            outs = jax.vmap(lambda m: serial_forward(plist_l, m))(mbs)
+            return jnp.mean(jax.vmap(loss_head)(outs, tgts))
+
+        ref_loss, ref_grads = jax.value_and_grad(serial_loss)(stacked)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-5, atol=1e-6)
+        for a, e in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads)):
+            np.testing.assert_allclose(a, e, rtol=1e-4, atol=1e-5)
+        # the identity pad really is inert: its parameter grads vanish
+        # (serial agrees, so check on the pipeline's own output)
+        pad = jax.tree.map(lambda x: x[v - 1, S - 1], grads)
+        assert all(float(jnp.abs(g).max()) < 1e-6
+                   for g in jax.tree.leaves(pad))
+        # and the pipeline really ran 5 effective layers: equal to the
+        # 5-real-stage serial model exactly
+        plist5 = [jax.tree.map(lambda x: x[k // S, k % S], stacked)
+                  for k in range(5)]
+        outs5 = jax.vmap(lambda m: serial_forward(plist5, m))(mbs)
+        ref5 = jnp.mean(jax.vmap(loss_head)(outs5, tgts))
+        np.testing.assert_allclose(loss, ref5, rtol=1e-5, atol=1e-6)
+
+    def test_v3_per_device_work_counters(self):
+        """Same geometry through the aux contract: every device executes
+        exactly M·v chunk-ticks (pads included — an identity chunk still
+        occupies its schedule slot), fill is S−1 chunk-ticks."""
+        S, v, M = 2, 3, 6
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=S)
+        feat = 8
+        mb = jr.normal(jr.fold_in(K, 53), (M, 2, feat))
+        params = jnp.ones((v, 1, feat))
+
+        def stage(p, x):
+            return x * p[0], 1.0
+
+        def run(p, mb):
+            out, work = schedules.pipeline_spmd_forward(
+                stage, p, mb, virtual_chunks=v, remat=False, aux_init=0.0)
+            return out, work[None]
+
+        _, work = mesh_lib.shard_map(
+            run, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P("pp")),
+        )(params, mb)
+        # M*v real chunk-ticks per device out of the scan's M*v + S - 1
+        # total (util 18/19 here); the closed form itself is validated
+        # against measured counters across v in TestBubbleUtilization
+        np.testing.assert_array_equal(np.asarray(work), np.full(S, M * v))
+
+
 class TestPipelineMemory:
     """Substantiate the 1F1B-memory-equivalence claim (schedules.py docstring):
     with stage remat the pipeline's temp memory must be well below the
@@ -516,15 +613,18 @@ class TestBubbleUtilization:
     def test_per_device_work_counters_show_v2_bubble_shrink(self):
         M, S = 8, 4
         utils = {}
-        for v in (1, 2, 4):
+        for v in (1, 2, 3, 4):
             work, T = self._measure(v, M, S)
             # every device executes exactly its M*v real chunk-ticks —
             # the schedule wastes no slots beyond the theoretical fill
+            # (odd v included: the item() arithmetic is modular, not
+            # power-of-two)
             np.testing.assert_array_equal(work, np.full(S, M * v))
             utils[v] = M * v / T
-        # closed form (M*v)/(M*v + S - 1): 0.727 / 0.842 / 0.914
+        # closed form (M*v)/(M*v + S - 1): 0.727 / 0.842 / 0.889 / 0.914
         np.testing.assert_allclose(utils[1], 8 / 11)
         np.testing.assert_allclose(utils[2], 16 / 19)
+        np.testing.assert_allclose(utils[3], 24 / 27)
         np.testing.assert_allclose(utils[4], 32 / 35)
         assert utils[2] > utils[1], "v=2 must shrink the bubble vs v=1"
-        assert utils[4] > utils[2]
+        assert utils[4] > utils[3] > utils[2]

@@ -4,6 +4,7 @@
 Usage::
 
     python tools/validate_metrics.py events.jsonl BENCH_r05.json ...
+    python tools/validate_metrics.py --lint-report lint.json ...
 
 Dispatch is by content, not extension:
 
@@ -19,7 +20,16 @@ Dispatch is by content, not extension:
   (MULTICHIP_r*.json) additionally enforces the artifact-honesty rule on
   the captured gate output — an OK line carrying ``=nan``/``=inf`` fails
   (VERDICT r5 weak #1), and any embedded ``MULTICHIP_GATE`` JSON record is
-  schema-validated.
+  schema-validated;
+* apexlint reports (``python -m apex_tpu.lint --format json``, shape
+  ``{"tool": "apexlint", ...}``) validate against
+  ``apex_tpu.lint.validate_report`` — so the lint artifact is gated the
+  same way bench/gate artifacts are. Well-formed lint reports are
+  auto-detected, so mixing them with bench/gate files in one invocation
+  just works; ``--lint-report`` instead forces EVERY listed file to be
+  judged as a lint report (a malformed file that lost its ``tool`` key
+  must fail as a bad lint report, not as an unrecognized shape) — don't
+  combine it with non-lint artifacts.
 
 Exit status 0 when every file is clean; 1 otherwise, with one problem per
 line on stderr. The logic lives in ``apex_tpu.monitor.schema`` so tests
@@ -63,8 +73,16 @@ def check_gate_tail(tail: str) -> list:
     return problems
 
 
+def validate_lint_report(obj) -> list:
+    """Validate an apexlint ``--format json`` report."""
+    from apex_tpu.lint import validate_report
+    return validate_report(obj)
+
+
 def validate_object(obj) -> list:
     """Validate one JSON artifact object, unwrapping driver envelopes."""
+    if isinstance(obj, dict) and obj.get("tool") == "apexlint":
+        return validate_lint_report(obj)
     if isinstance(obj, dict) and "kind" in obj:
         return schema.validate(obj)
     if isinstance(obj, dict) and "metric" in obj:
@@ -79,10 +97,16 @@ def validate_object(obj) -> list:
     return ["unrecognized artifact shape (no kind/metric/parsed/tail)"]
 
 
-def validate_file(path: str) -> list:
+def validate_file(path: str, *, as_lint_report: bool = False) -> list:
     problems = []
     with open(path) as fh:
         text = fh.read()
+    if as_lint_report:
+        try:
+            obj = json.loads(text)
+        except json.JSONDecodeError as e:
+            return [f"{path}: not JSON: {e}"]
+        return [f"{path}: {e}" for e in validate_lint_report(obj)]
     # one JSON value in the whole file → single artifact; otherwise JSONL
     obj = None
     if not path.endswith(".jsonl"):
@@ -100,12 +124,14 @@ def validate_file(path: str) -> list:
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    as_lint = "--lint-report" in argv
+    argv = [a for a in argv if a != "--lint-report"]
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
     all_problems = []
     for path in argv:
-        all_problems.extend(validate_file(path))
+        all_problems.extend(validate_file(path, as_lint_report=as_lint))
     for problem in all_problems:
         print(problem, file=sys.stderr)
     if not all_problems:
