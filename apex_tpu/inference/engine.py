@@ -144,6 +144,15 @@ class DecodeEngine:
         ck, cv = cache["k"], cache["v"]
         lengths = jnp.full((b,), pos + 1, jnp.int32)
         zero = jnp.int32(0)
+        # T5-style relative bias at decode, for free: a model exposing
+        # ``decode_rel_bias(params) -> BucketedBias`` (causal table) gets
+        # it threaded into every block's fused decode attention — the
+        # kernel recomputes the bias from the tiny table and the live
+        # length, so the cache layout, avals, and the zero-recompile
+        # contract are untouched. Models without the hook (stock GPT:
+        # learned positions) pass None.
+        rel_hook = getattr(model, "decode_rel_bias", None)
+        rel_bias = None if rel_hook is None else rel_hook(params)
         for i in range(c.num_layers):
             layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
             q, k_row, v_row = model.decode_qkv(layer, x)
@@ -155,7 +164,8 @@ class DecodeEngine:
             cv = jax.lax.dynamic_update_slice(
                 cv, v_row[None].astype(cv.dtype),
                 (jnp.int32(i), zero, zero, pos, zero))
-            x = model.decode_block(layer, x, q, ck[i], cv[i], lengths)
+            x = model.decode_block(layer, x, q, ck[i], cv[i], lengths,
+                                   rel_bias=rel_bias)
         x = fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
         logits = model.unembed(params, x)[:, 0]
         return {"k": ck, "v": cv}, self._sample(logits, key), logits

@@ -19,7 +19,8 @@ Rule families (catalogue with bad/good snippets: docs/api/lint.md):
 * **APX2xx** donation/aliasing (use-after-donation, donated buffers not
   re-threaded through loops)
 * **APX3xx** Pallas kernel constraints ((8, 128) tiling, index-map arity,
-  interpret-mode fallback convention)
+  interpret-mode fallback convention, materialized O(s²) bias into fused
+  attention)
 * **APX4xx** collective/axis hygiene (axis names outside dp/tp/pp/cp/ep)
 * **APX5xx** PRNG and precision discipline (dropout without a key,
   constant PRNG keys, bf16/fp32 cast mixing)

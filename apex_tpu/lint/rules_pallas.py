@@ -20,6 +20,12 @@ APX302  index-map-arity           BlockSpec index_map lambda whose arity
                                   axis
 APX303  pallas-call-no-interpret  pl.pallas_call without an ``interpret=``
                                   kwarg — unrunnable in the CPU test suite
+APX304  materialized-bias-into-flash  a materialized full-(h, sq, sk)
+                                  relative bias (``relative_bias(...)`` /
+                                  ``BucketedBias.materialize(...)``)
+                                  feeding a fused-attention ``bias=``
+                                  operand — O(h·s²) HBM that defeats the
+                                  kernel; pass the BucketedBias itself
 """
 
 from __future__ import annotations
@@ -130,6 +136,100 @@ def check_apx302(ctx: ModuleContext):
                     f"has rank {rank} — the map ignores or invents a grid "
                     "axis (intentional value-level broadcast like "
                     "`lambda i, j: (i, 0)` is fine and not flagged)")
+
+
+_ATTN_SINKS = ("flash_attention", "fused_qkv_attention", "ring_attention",
+               "ulysses_attention")
+
+
+def _is_bias_materializer(ctx: ModuleContext, call: ast.Call) -> bool:
+    canon = ctx.call_name(call) or ""
+    return (canon == "relative_bias" or canon.endswith(".relative_bias")
+            or canon.endswith(".materialize"))
+
+
+def _materializer_tainted(ctx: ModuleContext, expr: ast.expr,
+                          tainted: set) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call) and _is_bias_materializer(ctx, sub):
+            return True
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return True
+    return False
+
+
+def _scope_nodes(body):
+    """All AST nodes lexically inside ``body``, NOT descending into nested
+    function definitions (each function is its own taint scope; lambdas
+    stay in-scope — they close over the same names)."""
+    out = []
+    stack = list(body)
+    while stack:
+        n = stack.pop()
+        out.append(n)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # nested scope: listed, never entered
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _scope_bodies(tree: ast.Module):
+    """Per-lexical-scope statement lists: module top level (function
+    bodies excluded) + each function — the flow-insensitive scoping the
+    taint rules use."""
+    yield tree.body
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield n.body
+
+
+@rule("APX304", "materialized-bias-into-flash",
+      "a materialized full-(h, sq, sk) relative bias feeding a fused-"
+      "attention bias= operand — O(h·s²) HBM where the bucketed table "
+      "operand computes the same bias in-kernel from O(buckets·h)")
+def check_apx304(ctx: ModuleContext):
+    for body in _scope_bodies(ctx.tree):
+        stmts = _scope_nodes(body)
+        # flow-insensitive taint: names assigned (anywhere in the scope)
+        # from an expression containing a materializer call; iterate to a
+        # fixpoint so a = relative_bias(...); b = a[0] taints b too
+        tainted: set = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in stmts:
+                if isinstance(node, ast.Assign) and _materializer_tainted(
+                        ctx, node.value, tainted):
+                    for tgt in node.targets:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name) and \
+                                    n.id not in tainted:
+                                tainted.add(n.id)
+                                changed = True
+        for node in stmts:
+            if not isinstance(node, ast.Call):
+                continue
+            canon = ctx.call_name(node) or ""
+            if not any(canon == s or canon.endswith("." + s)
+                       for s in _ATTN_SINKS):
+                continue
+            bias_expr = None
+            for kw in node.keywords:
+                if kw.arg == "bias":
+                    bias_expr = kw.value
+            if (bias_expr is None and canon.endswith("fused_qkv_attention")
+                    and len(node.args) >= 5):
+                bias_expr = node.args[4]  # (x, w_qkv, b_qkv, w_out, bias)
+            if bias_expr is None:
+                continue
+            if _materializer_tainted(ctx, bias_expr, tainted):
+                yield ctx.finding(
+                    bias_expr, "APX304",
+                    "materialized (h, sq, sk) relative bias feeds a "
+                    "fused-attention call — O(h·s²) HBM (1.6 GB fp32 at "
+                    "s=8192, h=6) that the kernel exists to avoid; pass "
+                    "the BucketedBias table operand instead (the kernels "
+                    "recompute the bias per tile from O(buckets·h))")
 
 
 @rule("APX303", "pallas-call-no-interpret",

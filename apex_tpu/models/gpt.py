@@ -604,16 +604,19 @@ class GPTModel:
         q, k, v = self._proj_qkv_bshd(p, h_in)
         return q[:, 0], k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
 
-    def decode_block(self, p, x, q, k_lay, v_lay, lengths):
+    def decode_block(self, p, x, q, k_lay, v_lay, lengths, rel_bias=None):
         """One token through one block against this layer's cache slices
         (ALREADY holding the token's own k/v row — the engine writes
         between :meth:`decode_qkv` and this call): x (b, 1, H) is the
         block's residual-stream input, ``q`` (b, h, d) the token's query
         heads, ``k_lay``/``v_lay`` (b, h_kv, max_s, d), ``lengths`` (b,)
-        the live prefix length INCLUDING this token. Returns the block
+        the live prefix length INCLUDING this token. ``rel_bias``: an
+        optional causal BucketedBias the engine threads from the model's
+        ``decode_rel_bias`` hook (T5-style relative bias at decode —
+        recomputed in-kernel from the tiny table). Returns the block
         output (b, 1, H)."""
         from apex_tpu.ops import decode_attention
-        ctx = decode_attention(q, k_lay, v_lay, lengths)
+        ctx = decode_attention(q, k_lay, v_lay, lengths, bias=rel_bias)
         x = x + self._proj_attn_out(p, ctx[:, None])
         m = self._mlp(p, fused_layer_norm(x, p["ln2_w"], p["ln2_b"]))
         return x + m

@@ -30,7 +30,10 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.ops import fused_layer_norm, scaled_masked_softmax
-from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.attention import BucketedBias, flash_attention
+# the ONE bucketing closed form, shared with the Pallas kernels (public
+# re-export: tests and user code keep importing it from here)
+from apex_tpu.ops.pallas.attention import relative_position_bucket
 from apex_tpu.transformer import tensor_parallel as tp_lib
 from apex_tpu.transformer.tensor_parallel.utils import divide
 
@@ -60,13 +63,20 @@ class T5Config:
     # added to the SELF-attention scores (encoder bidirectional buckets,
     # decoder causal buckets; cross-attention carries none, per T5), no
     # absolute positions. Composes with BOTH attention impls: 'flash'
-    # feeds the (h, sq, sk) bias to the kernels' in-kernel bias operand
-    # (r5 — no O(s²) score tensor; bias gradients via the dbias kernel
-    # flow back to the bucket table through the gather's autodiff), and
-    # 'softmax' adds it to the materialized scores.
+    # hands the flash kernels the BUCKETED operand (r6, default — the
+    # tiny table rides into VMEM and every score tile recomputes its
+    # bias in-kernel: O(buckets·h) bias memory instead of the former
+    # materialized O(h·s²) array, and the table gradient comes from the
+    # in-kernel dtable kernel), and 'softmax' adds the materialized bias
+    # to the scores.
     position_encoding: str = "learned"
     relative_num_buckets: int = 32
     relative_max_distance: int = 128
+    # "bucketed": the in-kernel path above (flash only). "materialized":
+    # the r5 behavior — build the (1, h, sq, sk) array host-side and feed
+    # the kernels' array-bias operand. Kept as the FALLBACK/ORACLE the
+    # parity tests compare against; O(h·s²) HBM, unusable at long seq.
+    relative_bias_impl: str = "bucketed"
 
     def __post_init__(self):
         if self.attention_impl not in ("softmax", "flash"):
@@ -81,6 +91,10 @@ class T5Config:
             raise ValueError(
                 f"position_encoding must be learned|relative, got "
                 f"{self.position_encoding!r}")
+        if self.relative_bias_impl not in ("bucketed", "materialized"):
+            raise ValueError(
+                f"relative_bias_impl must be bucketed|materialized, got "
+                f"{self.relative_bias_impl!r}")
 
     @property
     def ffn(self) -> int:
@@ -97,35 +111,14 @@ def _dense(key, shape, dtype, scale=None):
     return jax.random.normal(key, shape, dtype) * s
 
 
-def relative_position_bucket(rel_pos, *, bidirectional, num_buckets,
-                             max_distance):
-    """T5's relative-position bucketing (mesh-tf
-    ``_relative_position_bucket``): ``rel_pos = key_pos - query_pos``.
-    Half the buckets hold exact small offsets, the other half log-spaced
-    larger ones up to ``max_distance``; bidirectional stacks split the
-    range by sign, causal stacks clamp the future to bucket 0."""
-    ret = jnp.zeros_like(rel_pos)
-    n = -rel_pos
-    if bidirectional:
-        num_buckets //= 2
-        ret = ret + (n < 0).astype(jnp.int32) * num_buckets
-        n = jnp.abs(n)
-    else:
-        n = jnp.maximum(n, 0)
-    max_exact = num_buckets // 2
-    is_small = n < max_exact
-    val_large = max_exact + (
-        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
-        / jnp.log(max_distance / max_exact)
-        * (num_buckets - max_exact)).astype(jnp.int32)
-    val_large = jnp.minimum(val_large, num_buckets - 1)
-    return ret + jnp.where(is_small, n, val_large)
-
-
 def relative_bias(table, sq, sk, *, bidirectional, num_buckets,
                   max_distance):
-    """(1, heads, sq, sk) additive attention bias from a
-    (num_buckets, heads) table."""
+    """(1, heads, sq, sk) additive attention bias MATERIALIZED from a
+    (num_buckets, heads) table — the oracle/softmax-impl form (the flash
+    default computes the same bias in-kernel from the table; see
+    ``T5Config.relative_bias_impl``). ``relative_position_bucket`` is
+    re-exported here from ``ops.pallas.attention`` — the ONE closed-form
+    definition the kernels evaluate per tile."""
     rel = (jnp.arange(sk, dtype=jnp.int32)[None, :]
            - jnp.arange(sq, dtype=jnp.int32)[:, None])
     buckets = relative_position_bucket(
@@ -231,19 +224,25 @@ class EncoderDecoderModel:
         cross-attention takes the SAME lengths over the encoder memory."""
         c = self.config
         if c.attention_impl == "flash":
-            # bias (1, h, sq, sk) → the kernels' (h, sq, sk) per-head form
-            # (row r of the b·h flatten reads bias row r % h = its head);
-            # the flash custom-VJP returns dbias, which autodiff carries
-            # back through relative_bias's gather into the bucket table.
-            # kv_lens expands to q's (b, h) leading dims (heads share a
-            # row's padding) — the flash path stays fused under padding.
+            # bucketed mode hands the kernels the BucketedBias operand
+            # directly (in-kernel recompute; dtable kernel grads).
+            # Materialized mode: bias (1, h, sq, sk) → the kernels'
+            # (h, sq, sk) per-head form (row r of the b·h flatten reads
+            # bias row r % h = its head); the flash custom-VJP returns
+            # dbias, which autodiff carries back through relative_bias's
+            # gather into the bucket table. kv_lens expands to q's (b, h)
+            # leading dims (heads share a row's padding) — the flash path
+            # stays fused under padding.
             lens = None
             if kv_lens is not None:
                 lens = jnp.broadcast_to(kv_lens[:, None].astype(jnp.int32),
                                         q.shape[:2])
+            if isinstance(bias, BucketedBias):
+                fbias = bias
+            else:
+                fbias = None if bias is None else bias[0]
             return flash_attention(
-                q, k, v, causal=causal, kv_lens=lens,
-                bias=None if bias is None else bias[0])
+                q, k, v, causal=causal, kv_lens=lens, bias=fbias)
         d = q.shape[-1]
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
         b, h, sq, sk = scores.shape
@@ -312,24 +311,32 @@ class EncoderDecoderModel:
             return jax.checkpoint(fn)
         return fn
 
-    def enc_bias(self, params, sq, sk):
-        '''Shared encoder self-attention bias, or None (learned mode).'''
+    def _stack_bias(self, params, name, sq, sk, bidirectional):
         c = self.config
         if c.position_encoding != "relative":
             return None
+        if (c.attention_impl == "flash"
+                and c.relative_bias_impl == "bucketed"):
+            # the in-kernel path: hand the TINY table to the kernels —
+            # nothing O(s²) is ever built, and the same operand rides
+            # ring/ulysses under cp (global offsets per stripe piece)
+            return BucketedBias(
+                params[name], bidirectional=bidirectional,
+                max_distance=c.relative_max_distance)
         return relative_bias(
-            params["rel_bias_enc"].astype(jnp.float32), sq, sk,
-            bidirectional=True, num_buckets=c.relative_num_buckets,
+            params[name].astype(jnp.float32), sq, sk,
+            bidirectional=bidirectional,
+            num_buckets=c.relative_num_buckets,
             max_distance=c.relative_max_distance)
 
+    def enc_bias(self, params, sq, sk):
+        '''Shared encoder self-attention bias — a BucketedBias on the
+        flash bucketed path, the materialized (1, h, sq, sk) array on the
+        softmax/materialized-oracle paths, or None (learned mode).'''
+        return self._stack_bias(params, "rel_bias_enc", sq, sk, True)
+
     def dec_bias(self, params, sq, sk):
-        c = self.config
-        if c.position_encoding != "relative":
-            return None
-        return relative_bias(
-            params["rel_bias_dec"].astype(jnp.float32), sq, sk,
-            bidirectional=False, num_buckets=c.relative_num_buckets,
-            max_distance=c.relative_max_distance)
+        return self._stack_bias(params, "rel_bias_dec", sq, sk, False)
 
     # --- forward --------------------------------------------------------------
 

@@ -48,6 +48,7 @@ from apex_tpu.monitor.registry import (  # noqa: F401
     disable,
     emit_decode,
     emit_event,
+    emit_longseq_bias,
     emit_meta,
     enable,
     enable_from_env,

@@ -227,21 +227,31 @@ class MetricsRegistry:
     def emit_event(self, name: str, **fields) -> Dict[str, Any]:
         return self.emit("event", name=name, **fields)
 
-    def emit_decode(self, status: str, **fields) -> Dict[str, Any]:
-        """Serving-bench record (``bench.py --decode``). ``status`` "OK"
-        puts the record under the honesty rule (finite numbers or explicit
-        ``("skipped", reason)`` tuples only — normalized here through
-        :func:`apex_tpu.monitor.schema.gate_metrics` semantics); "SKIP"
-        requires a ``reason``."""
+    def _emit_status_record(self, kind: str, status: str,
+                            **fields) -> Dict[str, Any]:
+        """Shared construction for the status-carrying bench records
+        (``decode``, ``longseq_bias``): "OK" puts the record under the
+        honesty rule (finite numbers or explicit ``("skipped", reason)``
+        tuples only); "SKIP" requires a ``reason``."""
         if status not in ("OK", "SKIP"):
             raise ValueError(f"status must be OK|SKIP, got {status!r}")
         if status == "SKIP" and not fields.get("reason"):
-            raise ValueError("a SKIP decode record must carry a reason")
+            raise ValueError(f"a SKIP {kind} record must carry a reason")
         for name, v in list(fields.items()):
             if (isinstance(v, tuple) and len(v) == 2
                     and v[0] == "skipped"):
                 fields[name] = {"skipped": True, "reason": str(v[1])}
-        return self.emit("decode", status=status, **fields)
+        return self.emit(kind, status=status, **fields)
+
+    def emit_decode(self, status: str, **fields) -> Dict[str, Any]:
+        """Serving-bench record (``bench.py --decode``)."""
+        return self._emit_status_record("decode", status, **fields)
+
+    def emit_longseq_bias(self, status: str, **fields) -> Dict[str, Any]:
+        """Long-seq in-kernel-bias bench record (``bench.py
+        --longseq-bias``): bucketed vs materialized relative-bias flash,
+        tokens/s + HBM high-water."""
+        return self._emit_status_record("longseq_bias", status, **fields)
 
     # -- step lifecycle ------------------------------------------------------
 
@@ -416,6 +426,13 @@ def emit_decode(status: str, **fields) -> Optional[Dict[str, Any]]:
     r = _REGISTRY
     if r is not None:
         return r.emit_decode(status, **fields)
+    return None
+
+
+def emit_longseq_bias(status: str, **fields) -> Optional[Dict[str, Any]]:
+    r = _REGISTRY
+    if r is not None:
+        return r.emit_longseq_bias(status, **fields)
     return None
 
 

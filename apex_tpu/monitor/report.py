@@ -68,6 +68,7 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     steps = []
     gate_records = []
     decode_records = []
+    longseq_records = []
     schedule = None
     for rec in records:
         kind = rec.get("kind")
@@ -81,6 +82,8 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             gate_records.append(rec)
         elif kind == "decode":
             decode_records.append(rec)
+        elif kind == "longseq_bias":
+            longseq_records.append(rec)
         elif kind == "event" and rec.get("name") == "pipeline_schedule":
             schedule = rec
 
@@ -182,20 +185,31 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     if collectives:
         summary["collectives"] = collectives
 
-    if decode_records:
-        # the serving leg: last record wins (same one-run-per-stream rule
-        # the step headline follows); explicit skip objects surface as a
-        # skipped-metric list, mirroring the gate summary
-        d = decode_records[-1]
-        summary["decode"] = {
+    def status_summary(recs, fields):
+        # a status-carrying bench record (decode / longseq_bias): last
+        # record wins (same one-run-per-stream rule the step headline
+        # follows); explicit skip objects surface as a skipped-metric
+        # list, mirroring the gate summary
+        d = recs[-1]
+        return {
             "status": d.get("status"),
             "skipped": sorted(k for k, v in d.items()
                               if isinstance(v, dict) and v.get("skipped")),
-            **{k: d[k] for k in ("tokens_per_s", "prefill_ms", "spread_pct",
-                                 "vs_naive", "batch", "prompt_len",
-                                 "new_tokens", "reason")
+            **{k: d[k] for k in (*fields, "reason")
                if isinstance(d.get(k), (int, float, str))},
         }
+
+    if decode_records:
+        summary["decode"] = status_summary(
+            decode_records, ("tokens_per_s", "prefill_ms", "spread_pct",
+                             "vs_naive", "batch", "prompt_len",
+                             "new_tokens"))
+
+    if longseq_records:
+        summary["longseq_bias"] = status_summary(
+            longseq_records, ("tokens_per_s", "tokens_per_s_materialized",
+                              "vs_materialized", "hbm_peak_mb",
+                              "hbm_peak_materialized_mb", "seq"))
 
     if gate_records:
         summary["gates"] = [
@@ -262,6 +276,23 @@ def render(summary: Dict[str, Any]) -> str:
             if dec.get("skipped"):
                 parts.append("skipped: " + ", ".join(dec["skipped"]))
             lines.append("  decode      " + "   ".join(parts))
+    lsb = summary.get("longseq_bias")
+    if lsb:
+        if lsb.get("status") == "SKIP":
+            lines.append(
+                f"  longseq-bias SKIP({lsb.get('reason', '?')})")
+        else:
+            parts = []
+            if isinstance(lsb.get("tokens_per_s"), (int, float)):
+                parts.append(f"{lsb['tokens_per_s']:.1f} tok/s bucketed")
+            if isinstance(lsb.get("vs_materialized"), (int, float)):
+                parts.append(f"{lsb['vs_materialized']:.2f}x vs "
+                             f"materialized")
+            if isinstance(lsb.get("hbm_peak_mb"), (int, float)):
+                parts.append(f"HBM peak {lsb['hbm_peak_mb']:.0f} MB")
+            if lsb.get("skipped"):
+                parts.append("skipped: " + ", ".join(lsb["skipped"]))
+            lines.append("  longseq-bias " + "   ".join(parts))
     for gate in summary.get("gates", []):
         skipped = (", skipped: " + ", ".join(gate["skipped"])
                    if gate["skipped"] else "")

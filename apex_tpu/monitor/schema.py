@@ -153,12 +153,47 @@ DECODE_SCHEMA = {
     "required": ["schema", "kind", "status"],
 }
 
+# long-sequence in-kernel-bias bench record (`python bench.py
+# --longseq-bias`): fwd+bwd flash attention with the BUCKETED relative
+# bias vs the MATERIALIZED (h, s, s) operand at long seq — tokens/s and
+# the HBM high-water of each. Same status semantics as `decode`: "OK"
+# engages the honesty rule; a leg that cannot be measured honestly rides
+# as an explicit skip object; off-TPU the record is status "SKIP" with a
+# reason — never nan.
+LONGSEQ_BIAS_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["longseq_bias"]},
+        "status": {"enum": ["OK", "SKIP"]},
+        "reason": {"type": "string"},  # required when status == "SKIP"
+        "tokens_per_s": _METRIC_VALUE,      # bucketed fwd+bwd throughput
+        "tokens_per_s_materialized": _METRIC_VALUE,  # the r5 baseline
+        "vs_materialized": _METRIC_VALUE,   # bucketed / materialized ratio
+        "hbm_peak_mb": _METRIC_VALUE,           # bucketed high-water
+        "hbm_peak_materialized_mb": _METRIC_VALUE,  # baseline high-water
+        "bias_bytes": {"type": "integer"},          # O(buckets·h) operand
+        "bias_bytes_materialized": {"type": "integer"},  # O(h·s²) operand
+        "seq": {"type": "integer"},
+        "batch": {"type": "integer"},
+        "heads": {"type": "integer"},
+        "head_dim": {"type": "integer"},
+        "num_buckets": {"type": "integer"},
+        "causal": {"type": "boolean"},
+        "spread_pct": _METRIC_VALUE,
+        "pass_times_ms": {"type": "array", "items": {"type": "number"}},
+        "backend": {"type": "string"},
+    },
+    "required": ["schema", "kind", "status"],
+}
+
 SCHEMAS_BY_KIND = {
     "step": STEP_SCHEMA,
     "meta": META_SCHEMA,
     "event": EVENT_SCHEMA,
     "gate": GATE_SCHEMA,
     "decode": DECODE_SCHEMA,
+    "longseq_bias": LONGSEQ_BIAS_SCHEMA,
 }
 
 # --- minimal JSON-Schema subset validator ------------------------------------
@@ -253,12 +288,14 @@ def validate(record: Dict[str, Any],
     errors: List[str] = []
     _check(record, schema, "", errors)
     errors.extend(_honesty_errors(record))
-    # the conditional half of the decode contract (the emitter enforces it
+    # the conditional half of the status contract (the emitter enforces it
     # too, but externally produced streams must not pass the validator
     # with a claim-free, reason-free skip)
-    if (record.get("kind") == "decode" and record.get("status") == "SKIP"
+    if (record.get("kind") in ("decode", "longseq_bias")
+            and record.get("status") == "SKIP"
             and not record.get("reason")):
-        errors.append("SKIP decode record must carry a reason")
+        errors.append(
+            f"SKIP {record.get('kind')} record must carry a reason")
     if not errors:
         try:  # cross-check with the real jsonschema when present
             import jsonschema

@@ -45,6 +45,6 @@ from apex_tpu.ops.fused_dense import (  # noqa: F401
 from apex_tpu.ops.mlp import MLP, mlp  # noqa: F401
 from apex_tpu.ops.xentropy import softmax_cross_entropy_loss  # noqa: F401
 from apex_tpu.ops.focal_loss import focal_loss  # noqa: F401
-from apex_tpu.ops.attention import (flash_attention, ring_attention,  # noqa: F401
-                                    ulysses_attention)
+from apex_tpu.ops.attention import (BucketedBias, flash_attention,  # noqa: F401
+                                    ring_attention, ulysses_attention)
 from apex_tpu.ops.decode_attention import decode_attention  # noqa: F401

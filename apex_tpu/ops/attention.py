@@ -20,8 +20,9 @@ heads ≥ devices (2 all-to-alls of the activations vs cp rotations of KV).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -31,6 +32,7 @@ import numpy as _np
 from apex_tpu.amp.lists import apply_op_rules
 from apex_tpu.ops import _backend
 from apex_tpu.ops.pallas import attention as _k
+from apex_tpu.ops.pallas.attention import relative_position_bucket  # noqa: F401 (public re-export)
 from apex_tpu.parallel import mesh as mesh_lib
 
 
@@ -39,6 +41,129 @@ def _float0_like(x):
     custom-VJP backwards must return float0 for ints, None for absent."""
     return (None if x is None
             else _np.zeros(jnp.shape(x), jax.dtypes.float0))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class BucketedBias:
+    """T5 bucketed relative position bias as a first-class attention
+    operand: the TINY ``(num_buckets, heads)`` table plus its bucketing
+    config, recomputed per score tile INSIDE the flash kernels — the
+    O(h·s²) HBM of a materialized ``(h, sq, sk)`` bias array collapses to
+    O(num_buckets·h) (~1.6 GB → ~1 KB at s=8192, h=6), and because every
+    tile derives its bias from GLOBAL coordinates (``q_offset`` /
+    ``k_offset``: the global position of this shard's first query/key
+    row), the same operand is computable per block under ANY sequence
+    sharding — which is what lets ``ring_attention`` and
+    ``ulysses_attention`` accept it (the materialized array cannot ride
+    cp without replicating O(s²) per device).
+
+    ``bidirectional=True`` is the T5 encoder bucketing (sign-split
+    buckets), ``False`` the causal decoder form (future clamps to bucket
+    0). Differentiable in ``table`` (the flash custom-VJPs return the
+    bucket-table cotangent, computed in-kernel by the dtable kernel on
+    the Pallas path); offsets are integer positions (float0 cotangents).
+
+    Pass an instance as ``bias=`` to :func:`flash_attention` (both
+    layouts), :func:`ring_attention`, :func:`ulysses_attention`, or
+    ``decode_attention``. The packed ``fused_qkv_attention`` path takes
+    materialized arrays only."""
+
+    table: jax.Array                 # (num_buckets, heads)
+    bidirectional: bool = False
+    max_distance: int = 128
+    q_offset: Any = 0                # global position of query row 0
+    k_offset: Any = 0                # global position of key row 0
+
+    def tree_flatten(self):
+        return ((self.table, self.q_offset, self.k_offset),
+                (self.bidirectional, self.max_distance))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        table, q_off, k_off = children
+        return cls(table, aux[0], aux[1], q_off, k_off)
+
+    @property
+    def num_buckets(self) -> int:
+        return self.table.shape[0]
+
+    @property
+    def heads(self) -> int:
+        return self.table.shape[1]
+
+    @property
+    def static(self):
+        """The kernels' static bucketing triple."""
+        return (self.num_buckets, self.bidirectional, self.max_distance)
+
+    def shifted(self, dq, dk) -> "BucketedBias":
+        """Same table, offsets advanced by (dq, dk) — how the cp paths
+        hand each stripe piece its global window."""
+        return BucketedBias(self.table, self.bidirectional,
+                            self.max_distance,
+                            self.q_offset + dq, self.k_offset + dk)
+
+    def kernel_operands(self):
+        """(table (h, 128) fp32 head-major, offsets (2,) int32, static) —
+        the Pallas kernels' ``rel_bias`` triple (one (1, 128) VMEM row per
+        head; buckets pad the lane dim)."""
+        nb, h = self.table.shape
+        tab = jnp.zeros((h, _k._REL_LANES), jnp.float32)
+        tab = tab.at[:, :nb].set(self.table.astype(jnp.float32).T)
+        off = jnp.stack([
+            jnp.asarray(self.q_offset, jnp.int32).reshape(()),
+            jnp.asarray(self.k_offset, jnp.int32).reshape(())])
+        return tab, off, self.static
+
+    def materialize(self, sq, sk) -> jax.Array:
+        """The (heads, sq, sk) fp32 array this operand abbreviates — the
+        XLA-fallback/oracle form (O(h·sq·sk): only for fallbacks and
+        tests; the kernels never build it)."""
+        rel = ((jnp.asarray(self.k_offset, jnp.int32)
+                + jnp.arange(sk, dtype=jnp.int32))[None, :]
+               - (jnp.asarray(self.q_offset, jnp.int32)
+                  + jnp.arange(sq, dtype=jnp.int32))[:, None])
+        buckets = relative_position_bucket(
+            rel, bidirectional=self.bidirectional,
+            num_buckets=self.num_buckets, max_distance=self.max_distance)
+        return self.table.astype(jnp.float32)[buckets].transpose(2, 0, 1)
+
+
+def _bias_rows(bias) -> int:
+    """Leading (row) extent of the bias operand — table heads for the
+    bucketed form, hb for a materialized array — for the r % hb divide
+    checks shared by both forms."""
+    return bias.heads if isinstance(bias, BucketedBias) else bias.shape[0]
+
+
+def _validate_bucketed(bias: BucketedBias) -> None:
+    if bias.table.ndim != 2:
+        raise ValueError(
+            f"BucketedBias.table must be (num_buckets, heads); got "
+            f"{bias.table.shape}")
+    nb = bias.num_buckets
+    if not 2 <= nb <= _k._REL_LANES:
+        raise ValueError(
+            f"num_buckets must be in [2, {_k._REL_LANES}] (the table pads "
+            f"one 128-lane VMEM row); got {nb}")
+    if bias.bidirectional and nb % 2:
+        raise ValueError(
+            f"bidirectional bucketing splits the range by sign and needs "
+            f"an even num_buckets; got {nb}")
+
+
+def _bucketed_table_grad(bias: BucketedBias, dbias_arr: jax.Array):
+    """(num_buckets, heads) table cotangent from a materialized dbias
+    (heads, sq, sk) — the gather's VJP (scatter-add by bucket), used by
+    the XLA fallback backward (the Pallas path gets dtable straight from
+    the in-kernel dtable kernel)."""
+    sq, sk = dbias_arr.shape[1], dbias_arr.shape[2]
+    _, vjp = jax.vjp(
+        lambda t: dataclasses.replace(bias, table=t).materialize(sq, sk),
+        bias.table)
+    (dtable,) = vjp(dbias_arr)
+    return dtable
 
 
 # --- single-device flash attention -------------------------------------------
@@ -135,20 +260,27 @@ def _flash_core(q, k, v, bias, kv_lens, dropout_seed, scale, causal,
 
 def _flash_fwd_res(q, k, v, bias, kv_lens, dropout_seed, scale, causal,
                    use_pallas, dropout_rate):
+    bucketed = isinstance(bias, BucketedBias)
     if use_pallas:
         # full_lse: the residual keeps the (bh, sq, LANES) carrier so the
         # backward kernel reads it as-is (no slice/re-broadcast round trip)
         o, lse = _k.flash_fwd(
             q, k, v, scale=scale, causal=causal, kv_lens=kv_lens,
-            bias=bias, full_lse=True, interpret=_backend.interpret_mode(),
+            bias=None if bucketed else bias,
+            rel_bias=bias.kernel_operands() if bucketed else None,
+            full_lse=True, interpret=_backend.interpret_mode(),
             dropout_rate=dropout_rate, dropout_seed=dropout_seed,
         )
     else:
         group = q.shape[0] // k.shape[0]
         kf = jnp.repeat(k, group, 0) if group > 1 else k
         vf = jnp.repeat(v, group, 0) if group > 1 else v
+        # XLA fallback: the bucketed operand materializes (the O(s²) array
+        # exists ONLY on this path — small-seq / non-kernel shapes)
+        bias_arr = (bias.materialize(q.shape[1], k.shape[1]) if bucketed
+                    else bias)
         o, lse = _xla_attention(q, kf, vf, scale, causal, kv_lens,
-                                dropout_rate, dropout_seed, bias)
+                                dropout_rate, dropout_seed, bias_arr)
     return o, (q, k, v, o, lse)
 
 
@@ -173,18 +305,31 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, scale, causal, use_pallas,
     rowsum(Pd ∘ dPd), so only the dPd term re-masks.
 
     Bias: dbias = Σ over the rows sharing each bias row of the UNSCALED
-    dS (bias enters S additively after the 1/√d scale)."""
+    dS (bias enters S additively after the 1/√d scale). With a
+    :class:`BucketedBias` the fourth output is the (num_buckets, heads)
+    TABLE cotangent instead (in-kernel dtable on the Pallas path; gather
+    VJP on the materialized fallback)."""
+    bucketed = isinstance(bias, BucketedBias)
     if use_pallas:
         out = _k.flash_bwd(
             q, k, v, o, lse, do, scale=scale, causal=causal, kv_lens=kv_lens,
-            bias=bias, interpret=_backend.interpret_mode(),
+            bias=None if bucketed else bias,
+            rel_bias=bias.kernel_operands() if bucketed else None,
+            interpret=_backend.interpret_mode(),
             dropout_rate=dropout_rate, dropout_seed=dropout_seed,
         )
-        return out if bias is not None else (*out, None)
+        if bias is None:
+            return (*out, None)
+        if bucketed:
+            dq, dk, dv, dtab_hm = out
+            return dq, dk, dv, dtab_hm[:, :bias.num_buckets].T
+        return out
     group = q.shape[0] // k.shape[0]
     kf = jnp.repeat(k, group, 0) if group > 1 else k
     vf = jnp.repeat(v, group, 0) if group > 1 else v
-    s = masked_scores(q, kf, scale, causal, kv_lens, bias)
+    bias_arr = (bias.materialize(q.shape[1], k.shape[1]) if bucketed
+                else bias)
+    s = masked_scores(q, kf, scale, causal, kv_lens, bias_arr)
     p = jnp.exp(s - lse[..., None])
     dof = do.astype(jnp.float32)
     if dropout_rate > 0.0:
@@ -201,8 +346,10 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, scale, causal, use_pallas,
     ds_pre = p * (dp - delta)  # the unscaled dS (the bias cotangent)
     dbias = None
     if bias is not None:
-        hb, sq, sk_ = bias.shape
+        hb, sq, sk_ = bias_arr.shape
         dbias = ds_pre.reshape(-1, hb, sq, sk_).sum(0)
+        if bucketed:
+            dbias = _bucketed_table_grad(bias, dbias)
     ds = ds_pre * scale
     dq = jnp.einsum("bqk,bkd->bqd", ds, kf.astype(jnp.float32)).astype(q.dtype)
     dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32))
@@ -214,16 +361,29 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kv_lens, scale, causal, use_pallas,
     return dq, dk.astype(k.dtype), dv.astype(v.dtype), dbias
 
 
+def _bias_cotangent(bias, dbias):
+    """Package the 4th backward output as the bias primal's cotangent:
+    arrays get the array grad in their own dtype; a BucketedBias gets a
+    BucketedBias whose table is the (num_buckets, heads) grad and whose
+    integer offsets carry float0."""
+    if bias is None:
+        return None
+    if isinstance(bias, BucketedBias):
+        return BucketedBias(
+            dbias.astype(bias.table.dtype), bias.bidirectional,
+            bias.max_distance, _float0_like(bias.q_offset),
+            _float0_like(bias.k_offset))
+    return dbias.astype(bias.dtype)
+
+
 def _flash_bwd(scale, causal, use_pallas, dropout_rate, res_pack, do):
     res, bias, kv_lens, dropout_seed = res_pack
     q, k, v, o, lse = res
     dq, dk, dv, dbias = _flash_bwd_impl(
         q, k, v, o, lse, do, kv_lens, scale, causal, use_pallas,
         dropout_rate, dropout_seed, bias)
-    if bias is not None:
-        dbias = dbias.astype(bias.dtype)
-    return (dq, dk, dv, dbias, _float0_like(kv_lens),
-            _float0_like(dropout_seed))
+    return (dq, dk, dv, _bias_cotangent(bias, dbias),
+            _float0_like(kv_lens), _float0_like(dropout_seed))
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
@@ -295,11 +455,14 @@ def _expand_lens_bh(kv_lens, h):
 
 def _flash_fwd_res_bshd(q, k, v, bias, kv_lens, dropout_seed, scale, causal,
                         use_pallas, dropout_rate):
+    bucketed = isinstance(bias, BucketedBias)
     if use_pallas:
         # carrier residual, same rationale as _flash_fwd_res
         o, lse = _k.flash_fwd_bshd(
             q, k, v, scale=scale, causal=causal, kv_lens=kv_lens,
-            bias=bias, full_lse=True, interpret=_backend.interpret_mode(),
+            bias=None if bucketed else bias,
+            rel_bias=bias.kernel_operands() if bucketed else None,
+            full_lse=True, interpret=_backend.interpret_mode(),
             dropout_rate=dropout_rate, dropout_seed=dropout_seed)
     else:
         b, h = q.shape[0], q.shape[2]
@@ -312,9 +475,11 @@ def _flash_fwd_res_bshd(q, k, v, bias, kv_lens, dropout_seed, scale, causal,
         if group > 1:
             kf = jnp.repeat(kf, group, 0)
             vf = jnp.repeat(vf, group, 0)
+        bias_arr = (bias.materialize(q.shape[1], k.shape[1]) if bucketed
+                    else bias)
         o3, lse3 = _xla_attention(_to_bh(q), kf, vf, scale, causal,
                                   _expand_lens_bh(kv_lens, h),
-                                  dropout_rate, dropout_seed, bias)
+                                  dropout_rate, dropout_seed, bias_arr)
         o = _from_bh(o3, b, h)
         lse = lse3.reshape(b, h, -1)
     return o, (q, k, v, o, lse)
@@ -327,19 +492,29 @@ def _flash_fwd_bshd(q, k, v, bias, kv_lens, dropout_seed, scale, causal,
     return o, (res, bias, kv_lens, dropout_seed)
 
 
-def _flash_bwd_bshd(scale, causal, use_pallas, dropout_rate, res_pack, do):
-    res, bias, kv_lens, dropout_seed = res_pack
-    q, k, v, o, lse = res
-    dlens = _float0_like(kv_lens)
-    dseed = _float0_like(dropout_seed)
+def _flash_bwd_bshd_impl(q, k, v, o, lse, do, kv_lens, scale, causal,
+                         use_pallas, dropout_rate=0.0, dropout_seed=None,
+                         bias=None):
+    """(dq, dk, dv, dbias) for the seq-major layout — the bshd twin of
+    :func:`_flash_bwd_impl`, same raw-cotangent contract: dbias is the
+    UNcast fp32 bucket-table grad (BucketedBias) / fp32 dbias array /
+    None — so cross-piece accumulators (the ring) sum full-precision
+    partials and only the final custom-vjp cotangent casts to the
+    primal's dtype."""
+    bucketed = isinstance(bias, BucketedBias)
     if use_pallas:
         out = _k.flash_bwd_bshd(
             q, k, v, o, lse, do, scale=scale, causal=causal,
-            kv_lens=kv_lens, bias=bias, interpret=_backend.interpret_mode(),
+            kv_lens=kv_lens, bias=None if bucketed else bias,
+            rel_bias=bias.kernel_operands() if bucketed else None,
+            interpret=_backend.interpret_mode(),
             dropout_rate=dropout_rate, dropout_seed=dropout_seed)
         dq, dk, dv = out[:3]
-        dbias = out[3].astype(bias.dtype) if bias is not None else None
-        return dq, dk, dv, dbias, dlens, dseed
+        dbias = None
+        if bias is not None:
+            dbias = (out[3][:, :bias.num_buckets].T if bucketed
+                     else out[3])
+        return dq, dk, dv, dbias
     b, h = q.shape[0], q.shape[2]
     h_kv = k.shape[2]
     dq3, dk3, dv3, dbias = _flash_bwd_impl(
@@ -347,10 +522,18 @@ def _flash_bwd_bshd(scale, causal, use_pallas, dropout_rate, res_pack, do):
         lse.reshape(b * h, -1), _to_bh(do), _expand_lens_bh(kv_lens, h),
         scale, causal, use_pallas=False, dropout_rate=dropout_rate,
         dropout_seed=dropout_seed, bias=bias)
-    if bias is not None:
-        dbias = dbias.astype(bias.dtype)
     return (_from_bh(dq3, b, h), _from_bh(dk3, b, h_kv),
-            _from_bh(dv3, b, h_kv), dbias, dlens, dseed)
+            _from_bh(dv3, b, h_kv), dbias)
+
+
+def _flash_bwd_bshd(scale, causal, use_pallas, dropout_rate, res_pack, do):
+    res, bias, kv_lens, dropout_seed = res_pack
+    q, k, v, o, lse = res
+    dq, dk, dv, dbias = _flash_bwd_bshd_impl(
+        q, k, v, o, lse, do, kv_lens, scale, causal, use_pallas,
+        dropout_rate, dropout_seed, bias)
+    return (dq, dk, dv, _bias_cotangent(bias, dbias),
+            _float0_like(kv_lens), _float0_like(dropout_seed))
 
 
 _flash_core_bshd.defvjp(_flash_fwd_bshd, _flash_bwd_bshd)
@@ -398,6 +581,11 @@ def fused_qkv_attention(x, w_qkv, b_qkv, w_out, bias, dropout_seed,
 def _fused_attn_fwd(x, w_qkv, b_qkv, w_out, bias, dropout_seed, kv_lens, h,
                     h_kv, d, scale, causal, dropout_rate=0.0):
     b, s, H = x.shape
+    if isinstance(bias, BucketedBias):
+        raise ValueError(
+            "fused_qkv_attention takes a materialized (hb, s, s) bias; "
+            "the bucketed form rides flash_attention(layout='bshd') (same "
+            "kernels, separate projections)")
     if bias is not None:
         # same contract flash_attention enforces: a non-dividing hb would
         # pair heads with bias rows inconsistently across batches (the
@@ -547,7 +735,9 @@ def flash_attention(
         dropout_seed = jnp.asarray(dropout_seed, jnp.int32)
     else:
         dropout_seed = None
-    if bias is not None:
+    if isinstance(bias, BucketedBias):
+        _validate_bucketed(bias)
+    elif bias is not None:
         sq_, sk_ = q.shape[-2], k.shape[-2]
         if layout == "bshd":
             sq_, sk_ = q.shape[1], k.shape[1]
@@ -578,10 +768,10 @@ def flash_attention(
                     f"layout='bshd' takes per-batch kv_lens of shape "
                     f"({q.shape[0]},); got {kv_lens.shape}")
             kv_lens = kv_lens.astype(jnp.int32)
-        if bias is not None and q.shape[2] % bias.shape[0]:
+        if bias is not None and q.shape[2] % _bias_rows(bias):
             raise ValueError(
-                f"layout='bshd' needs bias rows ({bias.shape[0]}) dividing "
-                f"q heads ({q.shape[2]})")
+                f"layout='bshd' needs bias rows ({_bias_rows(bias)}) "
+                f"dividing q heads ({q.shape[2]})")
         ok = bshd_kernel_ok(q.shape[1], k.shape[1], q.shape[2], d, q.dtype)
         impl_ = impl
         if (impl_ == "auto" and k.shape[1] < flash_auto_crossover(d)
@@ -643,9 +833,9 @@ def flash_attention(
         # int32 before the custom_vjp: backward returns a float0 cotangent,
         # which JAX only accepts for integer primals
         kv_lens = kv_lens.reshape(-1).astype(jnp.int32)
-    if bias is not None and q3.shape[0] % bias.shape[0]:
+    if bias is not None and q3.shape[0] % _bias_rows(bias):
         raise ValueError(
-            f"bias rows ({bias.shape[0]}) must divide q's flattened "
+            f"bias rows ({_bias_rows(bias)}) must divide q's flattened "
             f"leading dims ({q3.shape[0]})")
     o = _flash_core(q3, k3, v3, bias, kv_lens, dropout_seed, scale, causal,
                     use_pallas, dropout_rate)
@@ -721,42 +911,40 @@ def _piece_seed(dropout_seed, rank, t, piece):
 
 
 def _piece_fwd(q, k, v, scale, causal, use_pallas, dropout_rate=0.0,
-               dropout_seed=None):
+               dropout_seed=None, kv_lens=None, bias=None):
     """(o, lse) of one attention piece through the flash kernel (or the XLA
-    composition below its crossover)."""
-    if use_pallas:
-        return _k.flash_fwd(q, k, v, scale=scale, causal=causal,
-                            kv_lens=None, interpret=_backend.interpret_mode(),
-                            dropout_rate=dropout_rate,
-                            dropout_seed=dropout_seed)
-    group = q.shape[0] // k.shape[0]
-    kf = jnp.repeat(k, group, 0) if group > 1 else k
-    vf = jnp.repeat(v, group, 0) if group > 1 else v
-    return _xla_attention(q, kf, vf, scale, causal, None, dropout_rate,
-                          dropout_seed)
+    composition below its crossover). ``kv_lens``/``bias`` are this
+    PIECE's window-local operands (lengths clipped to the piece's kv
+    window; a :class:`BucketedBias` with the piece's global offsets).
+    Rows whose window is EMPTY come back with lse == NEG_INF — the
+    single-kernel dead-row lse=0 is an *output* convention; inside the
+    ring's online-softmax fold it would weight a dead piece e^0."""
+    o, res = _flash_fwd_res(q, k, v, bias, kv_lens, dropout_seed, scale,
+                            causal, use_pallas, dropout_rate)
+    lse = res[4]
+    if lse.ndim == 3:  # pallas (bh, s, LANES) carrier → (bh, s) rows
+        lse = lse[..., 0]
+    if kv_lens is not None:
+        lse = jnp.where(kv_lens[:, None] > 0, lse, _k.NEG_INF)
+    return o, lse
 
 
 def _piece_fwd_bshd(q, k, v, scale, causal, use_pallas, dropout_rate=0.0,
-                    dropout_seed=None):
+                    dropout_seed=None, kv_lens=None, bias=None):
     """(o (b, s, h, d), lse (b, h, s)) of one seq-major piece — the
     bshd-layout twin of :func:`_piece_fwd` (kernels read the projection
-    GEMMs' natural layout; no transpose round trip per ring step)."""
-    o, res = _flash_fwd_res_bshd(q, k, v, None, None, dropout_seed, scale,
-                                 causal, use_pallas, dropout_rate)
+    GEMMs' natural layout; no transpose round trip per ring step).
+    ``kv_lens`` is the piece-window (b,) form; dead-piece rows get
+    lse == NEG_INF (see :func:`_piece_fwd`)."""
+    o, res = _flash_fwd_res_bshd(q, k, v, bias, kv_lens, dropout_seed,
+                                 scale, causal, use_pallas, dropout_rate)
     lse = res[4]
     # the pallas path returns the (b, h, s, LANES) carrier; the ring's
     # fold arithmetic runs on the sliced (b, h, s) row form
-    return o, (lse[..., 0] if lse.ndim == 4 else lse)
-
-
-def _piece_bwd_bshd(q, k, v, o, lse, do, scale, causal, use_pallas,
-                    dropout_rate=0.0, dropout_seed=None):
-    """Piece backward in the bshd layout (lse (b, h, s)) — delegates to
-    the flash bshd backward with the ring's GLOBAL lse."""
-    out = _flash_bwd_bshd(
-        scale, causal, use_pallas, dropout_rate,
-        ((q, k, v, o, lse), None, None, dropout_seed), do)
-    return out[0], out[1], out[2]
+    lse = lse[..., 0] if lse.ndim == 4 else lse
+    if kv_lens is not None:
+        lse = jnp.where(kv_lens[:, None, None] > 0, lse, _k.NEG_INF)
+    return o, lse
 
 
 def _fold(o1, l1, o2, l2, bshd=False):
@@ -778,53 +966,139 @@ def _fold(o1, l1, o2, l2, bshd=False):
     return o, m + jnp.log(tot)
 
 
+def _piece_lens(kv_lens, k_off, extent):
+    """This piece's kv window lengths: global valid lengths clipped to a
+    kv window starting at global position ``k_off`` with ``extent``
+    columns — how the per-row/per-batch ``kv_lens`` operand rides any
+    sequence sharding (a position is valid iff its GLOBAL index is below
+    the row's length)."""
+    if kv_lens is None:
+        return None
+    return jnp.clip(kv_lens - k_off, 0, extent)
+
+
+def _zigzag_pair_lens(kv_lens, a_off, b_off, ss):
+    """Valid kv count of the CONCATENATED zigzag stripe pair [a; b]: the
+    pair is position-monotonic (a < b), so the globally-valid positions
+    form a local PREFIX and a single per-row length expresses them."""
+    if kv_lens is None:
+        return None
+    return (jnp.clip(kv_lens - a_off, 0, ss)
+            + jnp.clip(kv_lens - b_off, 0, ss))
+
+
 def _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas,
-                   dropout_rate=0.0, dropout_seed=None, bshd=False):
+                   dropout_rate=0.0, dropout_seed=None, bshd=False,
+                   kv_lens=None, bias=None):
     """Layout-generic ring forward: ``bshd=False`` takes (bh, s, d)
     operands with lse (bh, s); ``bshd=True`` takes (b, s, h, d) with lse
     (b, h, s) — the seq axis is 1 either way, only the lse carrier and
     the piece/fold functions differ (the bshd kernels read the projection
     GEMMs' layout directly, removing the per-ring-step transpose round
-    trip the flat layout paid)."""
+    trip the flat layout paid).
+
+    ``kv_lens`` (global per-row/per-batch valid lengths) and ``bias`` (a
+    :class:`BucketedBias`) ride per piece: every piece knows its kv
+    window's GLOBAL start, so lengths clip to the window
+    (:func:`_piece_lens`) and the bias recomputes in-kernel from the
+    window's offsets (:meth:`BucketedBias.shifted`). With bias under
+    causal zigzag, step 0 decomposes into its three stripe pieces
+    (lo·lo causal, hi·hi causal, hi·lo full) — the concatenated pair is
+    position-monotonic but not position-CONTIGUOUS, which a mask
+    tolerates and an offset-pair does not."""
     cp = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
     piece = _piece_fwd_bshd if bshd else _piece_fwd
     lse_ax = 2 if bshd else 1
+    s_loc = q.shape[1]
 
     def pseed(t, piece_id):
         # each (q, k) pair is covered by exactly one piece, so the
         # per-piece streams stay i.i.d. Bernoulli globally
         return _piece_seed(dropout_seed, rank, t, piece_id)
 
+    def pb(q_off, k_off):
+        return None if bias is None else bias.shifted(q_off, k_off)
+
     def rotate(t):
         return jax.tree.map(
             lambda x: jax.lax.ppermute(x, axis_name, perm), t)
 
-    # step 0 — the local shard. Causal: the zigzag stripe pair [a; b] is
-    # position-monotonic, so plain (blockwise) causal flash over the local
-    # 2·ss rows is exactly the diagonal work.
-    o0, l0 = piece(q, k, v, scale, causal, use_pallas,
-                   dropout_rate, pseed(0, 0))
+    def pin_dead(lse):
+        # GLOBALLY-dead rows (kv_lens == 0): every piece folded in at
+        # lse == NEG_INF, so the accumulated lse is ~NEG_INF — pin it to
+        # 0 (the single-kernel dead-row convention) AFTER all folds, so
+        # backward's p = exp(NEG_INF − 0) underflows to 0 on every piece
+        # (with lse ≈ NEG_INF it would be exp(0) = 1: garbage dq/dk/dv
+        # for padded-out rows)
+        if kv_lens is None:
+            return lse
+        live = kv_lens > 0
+        return jnp.where(live[:, None, None] if bshd else live[:, None],
+                         lse, 0.0)
 
     if not causal:
+        # contiguous sharding: shard r holds global rows [r·s_loc, ...)
+        q_off = rank * s_loc
+        o0, l0 = piece(q, k, v, scale, False, use_pallas,
+                       dropout_rate, pseed(0, 0),
+                       kv_lens=_piece_lens(kv_lens, q_off, s_loc),
+                       bias=pb(q_off, q_off))
+
         def step(carry, t):
             o_acc, l_acc, kv = carry
             kv = rotate(kv)
+            k_off = ((rank - t) % cp) * s_loc
             oi, li = piece(q, kv[0], kv[1], scale, False, use_pallas,
-                           dropout_rate, pseed(t, 0))
+                           dropout_rate, pseed(t, 0),
+                           kv_lens=_piece_lens(kv_lens, k_off, s_loc),
+                           bias=pb(q_off, k_off))
             o_acc, l_acc = _fold(o_acc, l_acc, oi, li, bshd)
             return (o_acc, l_acc, kv), None
 
         (o_acc, l_acc, _), _ = jax.lax.scan(
             step, (o0.astype(jnp.float32), l0, (k, v)),
             jnp.arange(1, cp), length=cp - 1)
-        return o_acc.astype(q.dtype), l_acc
+        return o_acc.astype(q.dtype), pin_dead(l_acc)
 
-    ss = q.shape[1] // 2
+    ss = s_loc // 2
+    # zigzag stripe pair: rank r holds stripes (r, 2cp−1−r) of 2·cp
+    a_off = rank * ss
+    b_off = (2 * cp - 1 - rank) * ss
     lhalf = lambda l: (jax.lax.slice_in_dim(l, 0, ss, axis=lse_ax),  # noqa: E731
                        jax.lax.slice_in_dim(l, ss, 2 * ss, axis=lse_ax))
     q_lo, q_hi = q[:, :ss], q[:, ss:]
+
+    # step 0 — the local stripe pair. Without bias: ONE causal flash over
+    # the position-monotonic pair (local causal == global causal; varlen
+    # valid positions form a local prefix, _zigzag_pair_lens). With bias:
+    # the three stripe pieces, each position-contiguous with its own
+    # global offsets.
+    if bias is None:
+        o0, l0 = piece(q, k, v, scale, True, use_pallas,
+                       dropout_rate, pseed(0, 0),
+                       kv_lens=_zigzag_pair_lens(kv_lens, a_off, b_off, ss))
+        l0_lo, l0_hi = lhalf(l0)
+        o_lo0, l_lo0 = o0[:, :ss].astype(jnp.float32), l0_lo
+        o_hi0, l_hi0 = o0[:, ss:].astype(jnp.float32), l0_hi
+    else:
+        k_lo0, k_hi0 = k[:, :ss], k[:, ss:]
+        v_lo0, v_hi0 = v[:, :ss], v[:, ss:]
+        o_ll, l_ll = piece(q_lo, k_lo0, v_lo0, scale, True, use_pallas,
+                           dropout_rate, pseed(0, 0),
+                           kv_lens=_piece_lens(kv_lens, a_off, ss),
+                           bias=pb(a_off, a_off))
+        o_hh, l_hh = piece(q_hi, k_hi0, v_hi0, scale, True, use_pallas,
+                           dropout_rate, pseed(0, 1),
+                           kv_lens=_piece_lens(kv_lens, b_off, ss),
+                           bias=pb(b_off, b_off))
+        o_hl, l_hl = piece(q_hi, k_lo0, v_lo0, scale, False, use_pallas,
+                           dropout_rate, pseed(0, 2),
+                           kv_lens=_piece_lens(kv_lens, a_off, ss),
+                           bias=pb(b_off, a_off))
+        o_lo0, l_lo0 = o_ll.astype(jnp.float32), l_ll
+        o_hi0, l_hi0 = _fold(o_hh, l_hh, o_hl, l_hl, bshd)
 
     def step(carry, t):
         o_lo, l_lo, o_hi, l_hi, kv = carry
@@ -833,10 +1107,13 @@ def _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas,
         k_lo, k_hi = kk[:, :ss], kk[:, ss:]
         v_lo, v_hi = vv[:, :ss], vv[:, ss:]
         j = (rank - t) % cp
+        ja, jb = j * ss, (2 * cp - 1 - j) * ss
         # piece 1: this rank's HIGH stripe vs the arriving LOW stripe —
         # always a full (unmasked) attend (stripe j < cp <= 2cp−1−rank)
         o1, l1 = piece(q_hi, k_lo, v_lo, scale, False, use_pallas,
-                       dropout_rate, pseed(t, 1))
+                       dropout_rate, pseed(t, 1),
+                       kv_lens=_piece_lens(kv_lens, ja, ss),
+                       bias=pb(b_off, ja))
         o_hi, l_hi = _fold(o_hi, l_hi, o1, l1, bshd)
         # piece 2: j < rank → our LOW stripe sees their LOW stripe;
         # j > rank → our HIGH stripe sees their HIGH stripe. Both full
@@ -845,8 +1122,12 @@ def _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas,
         q2 = jnp.where(lo_case, q_lo, q_hi)
         k2 = jnp.where(lo_case, k_lo, k_hi)
         v2 = jnp.where(lo_case, v_lo, v_hi)
+        qo2 = jnp.where(lo_case, a_off, b_off)
+        ko2 = jnp.where(lo_case, ja, jb)
         o2, l2 = piece(q2, k2, v2, scale, False, use_pallas,
-                       dropout_rate, pseed(t, 2))
+                       dropout_rate, pseed(t, 2),
+                       kv_lens=_piece_lens(kv_lens, ko2, ss),
+                       bias=pb(qo2, ko2))
         o_lo2, l_lo2 = _fold(o_lo, l_lo, o2, l2, bshd)
         o_hi2, l_hi2 = _fold(o_hi, l_hi, o2, l2, bshd)
         o_lo = jnp.where(lo_case, o_lo2, o_lo)
@@ -855,64 +1136,90 @@ def _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas,
         l_hi = jnp.where(lo_case, l_hi, l_hi2)
         return (o_lo, l_lo, o_hi, l_hi, kv), None
 
-    l0_lo, l0_hi = lhalf(l0)
-    init = (o0[:, :ss].astype(jnp.float32), l0_lo,
-            o0[:, ss:].astype(jnp.float32), l0_hi, (k, v))
+    init = (o_lo0, l_lo0, o_hi0, l_hi0, (k, v))
     (o_lo, l_lo, o_hi, l_hi, _), _ = jax.lax.scan(
         step, init, jnp.arange(1, cp), length=cp - 1)
     o = jnp.concatenate([o_lo, o_hi], axis=1).astype(q.dtype)
     lse = jnp.concatenate([l_lo, l_hi], axis=lse_ax)
-    return o, lse
+    return o, pin_dead(lse)
 
 
 def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, scale, causal,
                    use_pallas, dropout_rate=0.0, dropout_seed=None,
-                   bshd=False):
+                   bshd=False, kv_lens=None, bias=None):
     """The distributed flash backward: per ring step call ``flash_bwd``
     with the GLOBAL (o, lse) — p and Δ are then exact per shard — while a
     dkv accumulator travels the ring with its kv shard and arrives home
     after a full cycle carrying every rank's contribution (the reference
     has no CP at all; this is the standard ring-attention backward).
     Dropout: each piece re-derives the SAME (rank, step, piece) seed fold
-    as forward, so masks regenerate exactly."""
+    as forward, so masks regenerate exactly. ``kv_lens``/``bias``: each
+    piece re-derives the SAME window lens/offsets as forward; the
+    bucket-table cotangent accumulates across pieces into a FOURTH return
+    (fp32, this rank's partial — the caller psums it over the cp axis:
+    the global dS decomposes disjointly over (rank, step, piece)).
+    Returns (dq, dk, dv, dtable-or-None)."""
     cp = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
     lse_ax = 2 if bshd else 1
+    s_loc = q.shape[1]
 
-    def piece_bwd(qq, kk, vv, oo, ll, ddo, caus, sd):
-        if bshd:
-            return _piece_bwd_bshd(qq, kk, vv, oo, ll, ddo, scale, caus,
-                                   use_pallas, dropout_rate, sd)
-        return _flash_bwd_impl(qq, kk, vv, oo, ll, ddo, None, scale,
-                               caus, use_pallas, dropout_rate, sd)[:3]
+    def piece_bwd(qq, kk, vv, oo, ll, ddo, caus, sd, lens=None, pbias=None):
+        # both layouts return the RAW fp32 bucket-table grad (no cast to
+        # the table dtype between pieces — the cp·3 partials accumulate
+        # full-precision, matching the single-chip cast-once-at-the-end)
+        impl = _flash_bwd_bshd_impl if bshd else _flash_bwd_impl
+        return impl(qq, kk, vv, oo, ll, ddo, lens, scale, caus,
+                    use_pallas, dropout_rate, sd, pbias)
 
     def pseed(t, piece):
         return _piece_seed(dropout_seed, rank, t, piece)
 
-    def rotate(t):
+    def pb(q_off, k_off):
+        return None if bias is None else bias.shifted(q_off, k_off)
+
+    def rotate_tree(t):
         return jax.tree.map(
             lambda x: jax.lax.ppermute(x, axis_name, perm), t)
 
-    dq0, dk0, dv0 = piece_bwd(q, k, v, o, lse, do, causal, pseed(0, 0))
+    # dtable accumulator: the bias-less ring carries a scalar dummy so the
+    # scan carry structure stays uniform (dead weight of one float)
+    dt0 = (jnp.zeros(bias.table.shape, jnp.float32) if bias is not None
+           else jnp.zeros((), jnp.float32))
+
+    def dt_add(acc, dbi):
+        return acc if dbi is None else acc + dbi.astype(jnp.float32)
 
     if not causal:
+        q_off = rank * s_loc
+        dq0, dk0, dv0, db0 = piece_bwd(
+            q, k, v, o, lse, do, False, pseed(0, 0),
+            lens=_piece_lens(kv_lens, q_off, s_loc), pbias=pb(q_off, q_off))
+        dt0 = dt_add(dt0, db0)
+
         def step(carry, t):
-            dq, kv, dk, dv = carry
-            kv, (dk, dv) = rotate(kv), rotate((dk, dv))
-            dqi, dki, dvi = piece_bwd(q, kv[0], kv[1], o, lse, do, False,
-                                      pseed(t, 0))
+            dq, kv, dk, dv, dt = carry
+            kv, (dk, dv) = rotate_tree(kv), rotate_tree((dk, dv))
+            k_off = ((rank - t) % cp) * s_loc
+            dqi, dki, dvi, dbi = piece_bwd(
+                q, kv[0], kv[1], o, lse, do, False, pseed(t, 0),
+                lens=_piece_lens(kv_lens, k_off, s_loc),
+                pbias=pb(q_off, k_off))
             return (dq + dqi, kv, dk + dki.astype(dk.dtype),
-                    dv + dvi.astype(dv.dtype)), None
+                    dv + dvi.astype(dv.dtype), dt_add(dt, dbi)), None
 
         init = (dq0.astype(jnp.float32), (k, v),
-                dk0.astype(jnp.float32), dv0.astype(jnp.float32))
-        (dq, _, dk, dv), _ = jax.lax.scan(step, init, jnp.arange(1, cp),
-                                          length=cp - 1)
-        dk, dv = rotate((dk, dv))  # final hop brings the accumulators home
-        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+                dk0.astype(jnp.float32), dv0.astype(jnp.float32), dt0)
+        (dq, _, dk, dv, dt), _ = jax.lax.scan(step, init, jnp.arange(1, cp),
+                                              length=cp - 1)
+        dk, dv = rotate_tree((dk, dv))  # final hop brings accumulators home
+        return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+                dt if bias is not None else None)
 
-    ss = q.shape[1] // 2
+    ss = s_loc // 2
+    a_off = rank * ss
+    b_off = (2 * cp - 1 - rank) * ss
     halves = lambda x: (x[:, :ss], x[:, ss:])
     lhalf = lambda l: (jax.lax.slice_in_dim(l, 0, ss, axis=lse_ax),  # noqa: E731
                        jax.lax.slice_in_dim(l, ss, 2 * ss, axis=lse_ax))
@@ -920,21 +1227,54 @@ def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, scale, causal,
     o_lo, o_hi = halves(o)
     l_lo, l_hi = lhalf(lse)
     do_lo, do_hi = halves(do)
+    f32 = jnp.float32
+
+    if bias is None:
+        dq0, dk0, dv0, _ = piece_bwd(
+            q, k, v, o, lse, do, True, pseed(0, 0),
+            lens=_zigzag_pair_lens(kv_lens, a_off, b_off, ss))
+        dq_lo0, dq_hi0 = dq0[:, :ss].astype(f32), dq0[:, ss:].astype(f32)
+        dk_lo0, dk_hi0 = dk0[:, :ss].astype(f32), dk0[:, ss:].astype(f32)
+        dv_lo0, dv_hi0 = dv0[:, :ss].astype(f32), dv0[:, ss:].astype(f32)
+    else:
+        # the forward's three stripe pieces, mirrored (same seeds/windows)
+        k_lo0, k_hi0 = halves(k)
+        v_lo0, v_hi0 = halves(v)
+        dqll, dkll, dvll, dbll = piece_bwd(
+            q_lo, k_lo0, v_lo0, o_lo, l_lo, do_lo, True, pseed(0, 0),
+            lens=_piece_lens(kv_lens, a_off, ss), pbias=pb(a_off, a_off))
+        dqhh, dkhh, dvhh, dbhh = piece_bwd(
+            q_hi, k_hi0, v_hi0, o_hi, l_hi, do_hi, True, pseed(0, 1),
+            lens=_piece_lens(kv_lens, b_off, ss), pbias=pb(b_off, b_off))
+        dqhl, dkhl, dvhl, dbhl = piece_bwd(
+            q_hi, k_lo0, v_lo0, o_hi, l_hi, do_hi, False, pseed(0, 2),
+            lens=_piece_lens(kv_lens, a_off, ss), pbias=pb(b_off, a_off))
+        dq_lo0 = dqll.astype(f32)
+        dq_hi0 = dqhh.astype(f32) + dqhl.astype(f32)
+        dk_lo0 = dkll.astype(f32) + dkhl.astype(f32)
+        dk_hi0 = dkhh.astype(f32)
+        dv_lo0 = dvll.astype(f32) + dvhl.astype(f32)
+        dv_hi0 = dvhh.astype(f32)
+        dt0 = dt_add(dt_add(dt_add(dt0, dbll), dbhh), dbhl)
 
     def step(carry, t):
-        dq_lo, dq_hi, kv, dk_lo, dk_hi, dv_lo, dv_hi = carry
-        kv = rotate(kv)
-        dk_lo, dk_hi, dv_lo, dv_hi = rotate((dk_lo, dk_hi, dv_lo, dv_hi))
+        dq_lo, dq_hi, kv, dk_lo, dk_hi, dv_lo, dv_hi, dt = carry
+        kv = rotate_tree(kv)
+        dk_lo, dk_hi, dv_lo, dv_hi = rotate_tree(
+            (dk_lo, dk_hi, dv_lo, dv_hi))
         kk, vv = kv
         k_lo, k_hi = halves(kk)
         v_lo, v_hi = halves(vv)
         j = (rank - t) % cp
+        ja, jb = j * ss, (2 * cp - 1 - j) * ss
         # piece 1 (mirror of forward): q_hi vs arriving kv_lo, full attend
-        dq1, dk1, dv1 = piece_bwd(q_hi, k_lo, v_lo, o_hi, l_hi, do_hi,
-                                  False, pseed(t, 1))
+        dq1, dk1, dv1, db1 = piece_bwd(
+            q_hi, k_lo, v_lo, o_hi, l_hi, do_hi, False, pseed(t, 1),
+            lens=_piece_lens(kv_lens, ja, ss), pbias=pb(b_off, ja))
         dq_hi = dq_hi + dq1
         dk_lo = dk_lo + dk1
         dv_lo = dv_lo + dv1
+        dt = dt_add(dt, db1)
         # piece 2: the selected stripe pair
         lo_case = j < rank
         q2 = jnp.where(lo_case, q_lo, q_hi)
@@ -943,51 +1283,61 @@ def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, scale, causal,
         do2 = jnp.where(lo_case, do_lo, do_hi)
         k2 = jnp.where(lo_case, k_lo, k_hi)
         v2 = jnp.where(lo_case, v_lo, v_hi)
-        dq2, dk2, dv2 = piece_bwd(q2, k2, v2, o2, l2, do2, False,
-                                  pseed(t, 2))
+        qo2 = jnp.where(lo_case, a_off, b_off)
+        ko2 = jnp.where(lo_case, ja, jb)
+        dq2, dk2, dv2, db2 = piece_bwd(
+            q2, k2, v2, o2, l2, do2, False, pseed(t, 2),
+            lens=_piece_lens(kv_lens, ko2, ss), pbias=pb(qo2, ko2))
         dq_lo = dq_lo + jnp.where(lo_case, dq2, 0.0)
         dq_hi = dq_hi + jnp.where(lo_case, 0.0, dq2)
         dk_lo = dk_lo + jnp.where(lo_case, dk2, 0.0)
         dk_hi = dk_hi + jnp.where(lo_case, 0.0, dk2)
         dv_lo = dv_lo + jnp.where(lo_case, dv2, 0.0)
         dv_hi = dv_hi + jnp.where(lo_case, 0.0, dv2)
-        return (dq_lo, dq_hi, kv, dk_lo, dk_hi, dv_lo, dv_hi), None
+        dt = dt_add(dt, db2)
+        return (dq_lo, dq_hi, kv, dk_lo, dk_hi, dv_lo, dv_hi, dt), None
 
-    f32 = jnp.float32
-    init = (dq0[:, :ss].astype(f32), dq0[:, ss:].astype(f32), (k, v),
-            dk0[:, :ss].astype(f32), dk0[:, ss:].astype(f32),
-            dv0[:, :ss].astype(f32), dv0[:, ss:].astype(f32))
-    (dq_lo, dq_hi, _, dk_lo, dk_hi, dv_lo, dv_hi), _ = jax.lax.scan(
+    init = (dq_lo0, dq_hi0, (k, v), dk_lo0, dk_hi0, dv_lo0, dv_hi0, dt0)
+    (dq_lo, dq_hi, _, dk_lo, dk_hi, dv_lo, dv_hi, dt), _ = jax.lax.scan(
         step, init, jnp.arange(1, cp), length=cp - 1)
-    dk_lo, dk_hi, dv_lo, dv_hi = rotate((dk_lo, dk_hi, dv_lo, dv_hi))
+    dk_lo, dk_hi, dv_lo, dv_hi = rotate_tree((dk_lo, dk_hi, dv_lo, dv_hi))
     dq = jnp.concatenate([dq_lo, dq_hi], axis=1).astype(q.dtype)
     dk = jnp.concatenate([dk_lo, dk_hi], axis=1).astype(k.dtype)
     dv = jnp.concatenate([dv_lo, dv_hi], axis=1).astype(v.dtype)
-    return dq, dk, dv
+    return dq, dk, dv, (dt if bias is not None else None)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _ring_core(q, k, v, dropout_seed, axis_name, scale, causal,
-               use_pallas, dropout_rate, bshd):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _ring_core(q, k, v, bias, kv_lens, dropout_seed, axis_name, scale,
+               causal, use_pallas, dropout_rate, bshd):
     o, _ = _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas,
-                          dropout_rate, dropout_seed, bshd)
+                          dropout_rate, dropout_seed, bshd, kv_lens, bias)
     return o
 
 
-def _ring_fwd(q, k, v, dropout_seed, axis_name, scale, causal,
-              use_pallas, dropout_rate, bshd):
+def _ring_fwd(q, k, v, bias, kv_lens, dropout_seed, axis_name, scale,
+              causal, use_pallas, dropout_rate, bshd):
     o, lse = _ring_fwd_impl(q, k, v, axis_name, scale, causal, use_pallas,
-                            dropout_rate, dropout_seed, bshd)
-    return o, (q, k, v, o, lse, dropout_seed)
+                            dropout_rate, dropout_seed, bshd, kv_lens, bias)
+    return o, (q, k, v, o, lse, bias, kv_lens, dropout_seed)
 
 
 def _ring_bwd(axis_name, scale, causal, use_pallas, dropout_rate, bshd,
               res, do):
-    q, k, v, o, lse, dropout_seed = res
-    dq, dk, dv = _ring_bwd_impl(
+    q, k, v, o, lse, bias, kv_lens, dropout_seed = res
+    dq, dk, dv, dtab = _ring_bwd_impl(
         q, k, v, o, lse, do, axis_name, scale, causal, use_pallas,
-        dropout_rate, dropout_seed, bshd)
-    return dq, dk, dv, _float0_like(dropout_seed)
+        dropout_rate, dropout_seed, bshd, kv_lens, bias)
+    dbias = None
+    if bias is not None:
+        # this rank's partial — every (rank, step, piece) covers a
+        # disjoint slice of the global score matrix, so the global table
+        # grad is the plain cp-sum (each rank returns the full value: the
+        # table is replicated, like the ring's traveling dkv convention)
+        dtab = jax.lax.psum(dtab, axis_name)
+        dbias = _bias_cotangent(bias, dtab)
+    return (dq, dk, dv, dbias, _float0_like(kv_lens),
+            _float0_like(dropout_seed))
 
 
 _ring_core.defvjp(_ring_fwd, _ring_bwd)
@@ -997,7 +1347,8 @@ def ring_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     *, axis_name: str = mesh_lib.CONTEXT_AXIS, causal: bool = False,
     scale: Optional[float] = None, impl: str = "auto",
-    layout: str = "bhsd",
+    layout: str = "bhsd", kv_lens: Optional[jax.Array] = None,
+    bias: Optional[BucketedBias] = None,
     dropout_rate: float = 0.0, dropout_seed: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention over a sequence sharded along ``axis_name``: q/k/v are this
@@ -1038,12 +1389,52 @@ def ring_attention(
     re-derived identically in the hand-written backward. Each (q, k)
     pair is covered by exactly one piece, so masks stay i.i.d.
     Bernoulli over the global score matrix.
+
+    ``bias``: a :class:`BucketedBias` (pass the SAME replicated table on
+    every cp rank) — relative position bias under context parallelism.
+    Because the bucketed form recomputes per tile from GLOBAL offsets,
+    every ring piece derives its own (q_offset, k_offset) window and the
+    bias follows the zigzag/contiguous sharding exactly; the bucket-table
+    gradient is psum'd over the cp axis in the hand-written backward. A
+    materialized (hb, sq, sk) array is REJECTED here: it cannot ride cp
+    without replicating O(s²) HBM per device — exactly what this operand
+    exists to avoid.
+
+    ``kv_lens``: GLOBAL per-row valid kv lengths ((bh,) int32 flat /
+    (b,) with ``layout='bshd'``, replicated over cp) — padded batches
+    under context parallelism. Each piece masks its kv window by the
+    clipped global length; pieces whose window is empty fold in with
+    zero weight. Rows with length 0 return zeros.
     """
     d = q.shape[-1]
     scale = float(scale if scale is not None else 1.0 / d ** 0.5)
     if layout not in ("bhsd", "bshd"):
         raise ValueError(f"layout must be bhsd|bshd, got {layout!r}")
     bshd = layout == "bshd"
+    if bias is not None:
+        if not isinstance(bias, BucketedBias):
+            raise ValueError(
+                "ring_attention takes bias as a BucketedBias (the bucketed "
+                "table recomputes per block under any sharding); a "
+                "materialized (hb, sq, sk) array cannot ride context "
+                "parallelism without O(s²) replication")
+        _validate_bucketed(bias)
+        heads = q.shape[2] if bshd else None
+        if bshd and heads % bias.heads:
+            raise ValueError(
+                f"bias table heads ({bias.heads}) must divide q heads "
+                f"({heads})")
+        if not bshd and q.shape[0] % bias.heads:
+            raise ValueError(
+                f"bias table heads ({bias.heads}) must divide q rows "
+                f"({q.shape[0]})")
+    if kv_lens is not None:
+        want = (q.shape[0],)
+        if kv_lens.shape != want:
+            raise ValueError(
+                f"kv_lens must be {want} ({'per-batch' if bshd else 'per-row'}"
+                f" global lengths); got {kv_lens.shape}")
+        kv_lens = kv_lens.astype(jnp.int32)
     if not 0.0 <= dropout_rate < 1.0:
         raise ValueError(f"dropout_rate must be in [0, 1), got "
                          f"{dropout_rate}")
@@ -1090,16 +1481,43 @@ def ring_attention(
             and not _backend.interpret_forced()):
         impl = "xla"
     use_pallas = _backend.choose_impl(impl, ok) == "pallas"
-    return _ring_core(q, k, v, dropout_seed, axis_name, scale, causal,
-                      use_pallas, dropout_rate, bshd)
+    return _ring_core(q, k, v, bias, kv_lens, dropout_seed, axis_name,
+                      scale, causal, use_pallas, dropout_rate, bshd)
 
 
 # --- Ulysses attention (all-to-all sequence parallel) -------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _table_head_slice(table, axis_name, h_loc, heads):
+    """This rank's (num_buckets, h_loc) column slice of the REPLICATED
+    bucket table — with a hand VJP that scatters the local grad back to
+    full width and psums it over the axis, so the replicated table's
+    cotangent is the global sum (each head group contributes its own
+    columns disjointly)."""
+    start = jax.lax.axis_index(axis_name) * h_loc
+    return jax.lax.dynamic_slice_in_dim(table, start, h_loc, axis=1)
+
+
+def _ths_fwd(table, axis_name, h_loc, heads):
+    return _table_head_slice(table, axis_name, h_loc, heads), ()
+
+
+def _ths_bwd(axis_name, h_loc, heads, _res, d_local):
+    start = jax.lax.axis_index(axis_name) * h_loc
+    full = jnp.zeros((d_local.shape[0], heads), d_local.dtype)
+    full = jax.lax.dynamic_update_slice_in_dim(full, d_local, start, axis=1)
+    return (jax.lax.psum(full, axis_name),)
+
+
+_table_head_slice.defvjp(_ths_fwd, _ths_bwd)
+
 
 def ulysses_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     *, axis_name: str = mesh_lib.CONTEXT_AXIS, causal: bool = False,
     scale: Optional[float] = None, impl: str = "auto",
+    kv_lens: Optional[jax.Array] = None,
+    bias: Optional[BucketedBias] = None,
     dropout_rate: float = 0.0, dropout_seed: Optional[jax.Array] = None,
 ) -> jax.Array:
     """DeepSpeed-Ulysses-style sequence parallelism: q/k/v are this device's
@@ -1115,9 +1533,37 @@ def ulysses_attention(
     materializes the full sequence on one device (memory-optimal, arbitrary
     cp). Backward is the transposed all-to-alls around flash's custom VJP —
     no hand-written grad needed.
+
+    ``bias``: a :class:`BucketedBias` (same replicated table on every
+    rank; table heads == q heads, or 1 for a broadcast bias). After the
+    all-to-all each device holds the FULL sequence for a head subset, so
+    the table simply slices to this rank's head columns
+    (:func:`_table_head_slice` — its VJP scatters + psums the table grad)
+    and rides unmodified :func:`flash_attention`; offsets stay 0 (global
+    positions ARE local positions here). ``kv_lens``: (b,) per-batch
+    GLOBAL valid kv lengths (replicated over the axis — the gathered
+    sequence is the global one).
     """
     sp = jax.lax.axis_size(axis_name)
     b, s_local, h, d = q.shape
+    if bias is not None:
+        if not isinstance(bias, BucketedBias):
+            raise ValueError(
+                "ulysses_attention takes bias as a BucketedBias (a "
+                "materialized array cannot ride context parallelism "
+                "without O(s²) replication)")
+        _validate_bucketed(bias)
+        if bias.heads not in (1, h):
+            raise ValueError(
+                f"ulysses bias table heads ({bias.heads}) must be 1 "
+                f"(broadcast) or equal q heads ({h}) — heads re-shard "
+                f"over the axis, so per-head tables slice by rank")
+    if kv_lens is not None:
+        if kv_lens.shape != (b,):
+            raise ValueError(
+                f"ulysses kv_lens must be per-batch ({b},) global "
+                f"lengths; got {kv_lens.shape}")
+        kv_lens = kv_lens.astype(jnp.int32)
     if dropout_rate > 0.0:
         if dropout_seed is None:
             raise ValueError("dropout_rate > 0 requires dropout_seed")
@@ -1144,6 +1590,13 @@ def ulysses_attention(
     qg, kg, vg = seq_to_head(q), seq_to_head(k), seq_to_head(v)
     s, h_loc = qg.shape[1], qg.shape[2]
 
+    local_bias = bias
+    if bias is not None and bias.heads == h:
+        # per-head table: this rank attends heads [rank·h_loc, ...) — take
+        # their columns (grad scatters + psums back through the hand VJP)
+        local_bias = dataclasses.replace(
+            bias, table=_table_head_slice(bias.table, axis_name, h_loc, h))
+
     if bshd_kernel_ok(s, s, h_loc, d, qg.dtype):
         # the all_to_all emits (b, s, h_loc, d) — exactly the kernels'
         # seq-major bshd layout, so attention runs on it directly; the
@@ -1152,7 +1605,8 @@ def ulysses_attention(
         # was pure layout traffic — the ~22% "head re-sharding" overhead
         # PERF.md measured was mostly these, not the collectives
         o = flash_attention(qg, kg, vg, causal=causal, scale=scale,
-                            impl=impl, layout="bshd",
+                            impl=impl, layout="bshd", kv_lens=kv_lens,
+                            bias=local_bias,
                             dropout_rate=dropout_rate,
                             dropout_seed=dropout_seed)
     else:
@@ -1165,6 +1619,9 @@ def ulysses_attention(
 
         o = flash_attention(to_bh(qg), to_bh(kg), to_bh(vg),
                             causal=causal, scale=scale, impl=impl,
+                            kv_lens=(None if kv_lens is None
+                                     else jnp.repeat(kv_lens, h_loc)),
+                            bias=local_bias,
                             dropout_rate=dropout_rate,
                             dropout_seed=dropout_seed)
         o = o.reshape(b, h_loc, s, d).transpose(0, 2, 1, 3)

@@ -35,12 +35,24 @@ def decode_kernel_ok(max_s: int, d: int, dtype) -> bool:
             and dtype != jnp.float16)
 
 
-def _xla_decode(q, k, v, lengths, scale):
+def _xla_decode(q, k, v, lengths, scale, bias=None):
     """(b, h_kv, group, d) q against (b, h_kv, max_s, d) cache — a single
     einsum→softmax→einsum chain; XLA fuses the max/exp/sum on one pass of
-    the scores, which never leave registers/cache at CPU test scale."""
+    the scores, which never leave registers/cache at CPU test scale.
+    ``bias``: a causal BucketedBias — the query sits at position
+    ``lengths - 1``, keys at [0, max_s)."""
     s = jnp.einsum("bgqd,bgkd->bgqk", q, k,
                    preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        from apex_tpu.ops.pallas.attention import relative_position_bucket
+        b_, h_kv, group, max_s = s.shape
+        rel = (jnp.arange(max_s, dtype=jnp.int32)[None, :]
+               - (lengths.astype(jnp.int32)[:, None] - 1))
+        buckets = relative_position_bucket(
+            rel, bidirectional=False, num_buckets=bias.num_buckets,
+            max_distance=bias.max_distance)            # (b, max_s)
+        vals = bias.table.astype(jnp.float32)[buckets]  # (b, max_s, h)
+        s = s + vals.transpose(0, 2, 1).reshape(b_, h_kv, group, max_s)
     mask = jnp.arange(k.shape[2])[None, None, None, :] \
         < lengths[:, None, None, None]
     s = jnp.where(mask, s, NEG_INF)
@@ -54,7 +66,7 @@ def _xla_decode(q, k, v, lengths, scale):
 
 def decode_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, lengths: jax.Array,
-    *, scale: Optional[float] = None, impl: str = "auto",
+    *, scale: Optional[float] = None, impl: str = "auto", bias=None,
 ) -> jax.Array:
     """Attention of ONE query token per sequence over a KV cache.
 
@@ -70,6 +82,15 @@ def decode_attention(
     the current length" is the entire causal structure. Forward-only —
     wrap in ``jax.lax.stop_gradient`` semantics by construction (there is
     no VJP; decode paths never differentiate).
+
+    ``bias``: a CAUSAL :class:`~apex_tpu.ops.attention.BucketedBias`
+    (``bidirectional=False``; table heads == h) — T5-style relative
+    position bias at decode: the query is position ``lengths - 1``, so
+    rel_pos = key − (len − 1) derives from the length operand the kernel
+    already carries, and the bias recomputes in-kernel from the tiny
+    table (offsets are cache positions; the container's q/k offsets are
+    ignored here). The decode sibling of the flash kernels' in-kernel
+    bucketed bias.
     """
     if q.ndim != 3 or k.ndim != 4 or k.shape != v.shape:
         raise ValueError(
@@ -87,6 +108,25 @@ def decode_attention(
     group = h // h_kv
     scale = float(scale if scale is not None else 1.0 / d ** 0.5)
     qg = q.reshape(b, h_kv, group, d)
+    rel_bias = None
+    if bias is not None:
+        from apex_tpu.ops.attention import BucketedBias, _validate_bucketed
+        if not isinstance(bias, BucketedBias):
+            raise ValueError(
+                "decode_attention takes bias as a BucketedBias (decode "
+                "recomputes the bias from the table and the live length; "
+                "a materialized array has no decode form)")
+        _validate_bucketed(bias)
+        if bias.bidirectional:
+            raise ValueError(
+                "decode bias must use causal bucketing "
+                "(bidirectional=False) — the query IS the last position")
+        if bias.heads != h:
+            raise ValueError(
+                f"decode bias table heads ({bias.heads}) must equal q "
+                f"heads ({h})")
+        rel_bias = (bias.kernel_operands()[0],
+                    (bias.num_buckets, bias.max_distance))
 
     # gate on BOTH operand dtypes: a mixed fp16 cache under fp32 q must
     # fall back too (Mosaic has no f16 in any operand position)
@@ -95,11 +135,13 @@ def decode_attention(
     # default on TPU; off-TPU interpret-mode kernels are pure overhead
     use_pallas = _backend.choose_impl(impl, ok) == "pallas"
     if not use_pallas:
-        return _xla_decode(qg, k, v, lengths, scale).reshape(b, h, d)
+        return _xla_decode(qg, k, v, lengths, scale,
+                           bias).reshape(b, h, d)
     o = decode_attn_fwd(
         qg.reshape(b * h_kv, group, d),
         k.reshape(b * h_kv, max_s, d),
         v.reshape(b * h_kv, max_s, d),
         jnp.repeat(lengths, h_kv),
-        scale=scale, interpret=_backend.interpret_mode())
+        scale=scale, rel_bias=rel_bias,
+        interpret=_backend.interpret_mode())
     return o.reshape(b, h, d)

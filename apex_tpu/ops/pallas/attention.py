@@ -95,6 +95,75 @@ def _mask_scale(seed, t, i, j, bq, bk, rate):
     return jnp.where(keep, jnp.float32(1.0 / (1.0 - rate)), 0.0)
 
 
+# --- in-kernel bucketed relative position bias --------------------------------
+#
+# The T5 relative bias is a LOOKUP: bias(q_pos, k_pos) = table[bucket(k_pos -
+# q_pos), head] with bucket() a cheap closed form (exact small offsets, log-
+# spaced large ones). Feeding it to the kernels as a materialized (h, sq, sk)
+# operand costs O(h·s²) HBM (~1.6 GB fp32 at s=8192, h=6) — defeating the
+# fused kernel's entire value proposition (never materializing O(s²)
+# tensors; the reference fmha's core design, ``contrib/csrc/fmha``). Instead
+# the kernels take the TINY (num_buckets, h) table itself (padded head-major
+# to one (1, 128) VMEM row per head) plus a (2,) SMEM global-offset pair, and
+# recompute each (bq, bk) bias tile from the grid coordinates: bucket indices
+# from the closed form, then a num_buckets-step select-sum against the table
+# row (VPU work ~num_buckets ops/element, overlapped with the MXU matmul;
+# the arXiv:2502.17728 recompute-beats-streaming argument). The offsets make
+# the SAME kernel correct under context parallelism: a shard whose q rows
+# start at global position Q and kv block at K computes bucket((K + c) -
+# (Q + r)) — bias follows the data onto any sharding for free.
+
+_REL_LANES = 128  # table rows pad to one full lane row; num_buckets <= 128
+
+
+def relative_position_bucket(rel_pos, *, bidirectional, num_buckets,
+                             max_distance):
+    """T5's relative-position bucketing (mesh-tf
+    ``_relative_position_bucket``): ``rel_pos = key_pos - query_pos``.
+    Half the buckets hold exact small offsets, the other half log-spaced
+    larger ones up to ``max_distance``; bidirectional stacks split the
+    range by sign, causal stacks clamp the future to bucket 0. Pure jnp on
+    any-rank int32 arrays — the SAME function evaluates on (sq, sk) grids
+    host-side (materialized oracle) and on (bq, bk) tiles inside the
+    Pallas kernels (the sole definition, so kernel and oracle cannot
+    drift)."""
+    ret = jnp.zeros_like(rel_pos)
+    n = -rel_pos
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_large = max_exact + (
+        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
+        / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)).astype(jnp.int32)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_large)
+
+
+def _rel_bias_block(tab_ref, off_ref, i, j, bq, bk, rel):
+    """(bq, bk) fp32 bias tile recomputed from grid coordinates: global
+    positions from the (2,) SMEM offsets, buckets from the closed form,
+    values by a ``num_buckets``-step select-sum over this head's (1, 128)
+    table row. ``rel = (num_buckets, bidirectional, max_distance)``."""
+    nb, bidir, maxd = rel
+    rows = off_ref[0] + i * bq + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, 1), 0)
+    cols = off_ref[1] + j * bk + jax.lax.broadcasted_iota(
+        jnp.int32, (1, bk), 1)
+    buckets = relative_position_bucket(
+        cols - rows, bidirectional=bidir, num_buckets=nb, max_distance=maxd)
+    bias = jnp.zeros((bq, bk), jnp.float32)
+    for b in range(nb):
+        bias = bias + jnp.where(buckets == b, tab_ref[0, b],
+                                jnp.float32(0.0))
+    return bias
+
+
 def _blocks(n, b):
     return pl.cdiv(n, b)
 
@@ -113,7 +182,7 @@ def _fit_block(n, pref):
 # --- forward ------------------------------------------------------------------
 
 def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, varlen, bshd=False,
-                rate=0.0, has_bias=False):
+                rate=0.0, has_bias=False, rel=None):
     """``varlen`` is a STATIC specialization flag: without kv lengths the
     kernel carries no length operand, no per-block length select, and no
     dynamic predicate conjunct — the common (non-padded) call pays nothing.
@@ -131,6 +200,10 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, varlen, bshd=False,
     scores BEFORE the causal/varlen masks (the reference's in-kernel
     arbitrary mask, ``csrc/megatron/scaled_masked_softmax.cpp:85-94``,
     generalized to any additive bias — T5 relative position bias rides it).
+    ``rel`` (static, exclusive with ``has_bias``) instead RECOMPUTES the T5
+    bucketed relative bias per tile from a (1, 128) table row + (2,) SMEM
+    global offsets (see :func:`_rel_bias_block`) — no O(s²) bias operand
+    exists anywhere.
     """
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
@@ -138,6 +211,9 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, varlen, bshd=False,
     if has_bias:
         bias_ref = refs[n]
         n += 1
+    if rel is not None:
+        rtab_ref, roff_ref = refs[n:n + 2]
+        n += 2
     if varlen:
         kvlen_ref = refs[n]
         n += 1
@@ -177,6 +253,8 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, varlen, bshd=False,
         ) * scale  # (bq, bk)
         if has_bias:
             s = s + bias_ref[0].astype(jnp.float32)
+        if rel is not None:
+            s = s + _rel_bias_block(rtab_ref, roff_ref, i, j, bq, bk, rel)
         if causal or varlen:
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
@@ -255,26 +333,47 @@ def _seed_operand(dropout_seed):
 
 _SMEM_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
 
-# additive-bias kernels cap blocks at 512: a (bq, bk) fp32 bias block is
-# bq·bk·4 bytes double-buffered (4 MB at 1024² — too much VMEM next to the
-# q/k/v/do blocks and accumulators; 1 MB at 512² fits comfortably)
+# The 512-block cap applies ONLY to the MATERIALIZED (hb, sq, sk) bias
+# operand (the oracle/fallback form, and contrib's additive attn_mask): its
+# (bq, bk) fp32 blocks are bq·bk·4 bytes double-buffered — 4 MB at 1024²,
+# too much VMEM next to the q/k/v/do blocks and accumulators; 1 MB at 512²
+# fits. The BUCKETED path carries one (1, 128) table row + a (2,) scalar
+# pair instead, so it tiles at the normal (uncapped) block sizes — the r6
+# change that removed the cap from the production relative-bias path.
 _BIAS_BLOCK_CAP = 512
 
 
+def _bias_blocks(bias, bq, bk):
+    """(bq, bk) clamped to the materialized-bias VMEM cap when a bias
+    ARRAY operand is present; unchanged otherwise (incl. bucketed)."""
+    if bias is not None:
+        return min(bq, _BIAS_BLOCK_CAP), min(bk, _BIAS_BLOCK_CAP)
+    return bq, bk
+
+
 def _tail_operands(kv_lens, rows, dropout_rate, dropout_seed, lens_map,
-                   bias=None, bias_map=None, bias_block=None):
+                   bias=None, bias_map=None, bias_block=None,
+                   rel=None, rel_map=None):
     """(specs, args) for the OPTIONAL trailing kernel operands, in the
-    kernels' fixed unpack order: [score bias] then [kvlen carrier] then
-    [dropout seed]. ``rows`` is the lens carrier's leading extent (bh for
-    the flat layout, b for bshd/packed); ``lens_map`` the grid->carrier
-    index map; ``bias`` the (hb, sq, sk) additive-score array with
-    ``bias_map`` its grid->(row, qblk, kblk) map and ``bias_block`` the
-    (1, bq, bk) block shape. One assembly point so a future operand cannot
-    be appended in the wrong order at one of the call sites."""
+    kernels' fixed unpack order: [score bias] then [rel table + offsets]
+    then [kvlen carrier] then [dropout seed]. ``rows`` is the lens
+    carrier's leading extent (bh for the flat layout, b for bshd/packed);
+    ``lens_map`` the grid->carrier index map; ``bias`` the (hb, sq, sk)
+    additive-score array with ``bias_map`` its grid->(row, qblk, kblk) map
+    and ``bias_block`` the (1, bq, bk) block shape; ``rel`` the bucketed
+    pair (table (hb, 128) fp32 head-major, offsets (2,) int32) with
+    ``rel_map`` the grid->(head row, 0) map. One assembly point so a
+    future operand cannot be appended in the wrong order at one of the
+    call sites."""
     specs, args = [], []
     if bias is not None:
         specs.append(pl.BlockSpec(bias_block, bias_map))
         args.append(bias)
+    if rel is not None:
+        specs.append(pl.BlockSpec((1, _REL_LANES), rel_map))
+        args.append(rel[0])
+        specs.append(_SMEM_SPEC)
+        args.append(rel[1])
     if kv_lens is not None:
         specs.append(pl.BlockSpec((1, 1, _LSE_LANES), lens_map))
         args.append(_kvlen_rows(kv_lens, rows))
@@ -284,9 +383,9 @@ def _tail_operands(kv_lens, rows, dropout_rate, dropout_seed, lens_map,
     return specs, args
 
 
-def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bias=None, bq=1024,
-              bk=1024, full_lse=False, interpret=False, dropout_rate=0.0,
-              dropout_seed=None):
+def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bias=None,
+              rel_bias=None, bq=1024, bk=1024, full_lse=False,
+              interpret=False, dropout_rate=0.0, dropout_seed=None):
     """q (bh, sq, d); k/v (bh_kv, sk, d) where bh_kv divides bh — grouped-
     query attention falls out of the kv BlockSpec index maps (q row ``b``
     reads kv row ``b // group``), zero-copy: kv shards are never repeated
@@ -301,17 +400,25 @@ def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bias=None, bq=1024,
     ``bias`` (hb, sq, sk) with hb | bh: an additive score bias, row ``r``
     reading bias row ``r % hb`` — (h, sq, sk) shared over batch under the
     b-major row order, (1, sq, sk) fully broadcast, (bh, sq, sk) per-row.
-    Added to the scaled scores before masks; block sizes cap at 512 so the
-    (bq, bk) bias blocks stay within VMEM."""
+    Added to the scaled scores before masks.
+
+    ``rel_bias`` (exclusive with ``bias``): the BUCKETED relative-bias
+    triple ``(table (hb, 128) fp32 head-major, offsets (2,) int32,
+    (num_buckets, bidirectional, max_distance))`` — the bias is recomputed
+    per tile inside the kernel from the tiny table (see
+    :func:`_rel_bias_block`); no (hb, sq, sk) array exists anywhere. Row
+    ``r`` reads table row ``r % hb`` (same contract as ``bias``)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     group = bh // k.shape[0]
-    if bias is not None:
-        bq, bk = min(bq, _BIAS_BLOCK_CAP), min(bk, _BIAS_BLOCK_CAP)
+    bq, bk = _bias_blocks(bias, bq, bk)
     bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
     varlen = kv_lens is not None
     hb = 0 if bias is None else bias.shape[0]
+    rel, rel_static = (None, None) if rel_bias is None else (
+        rel_bias[:2], rel_bias[2])
+    rhb = 0 if rel is None else rel[0].shape[0]
 
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -321,14 +428,16 @@ def flash_fwd(q, k, v, *, scale, causal, kv_lens=None, bias=None, bq=1024,
     args = [q, k, v]
     tail_specs, tail_args = _tail_operands(
         kv_lens, bh, dropout_rate, dropout_seed, lambda b, i, j: (b, 0, 0),
-        bias, lambda b, i, j, hb=hb: (b % hb, i, j), (1, bq, bk))
+        bias, lambda b, i, j, hb=hb: (b % hb, i, j), (1, bq, bk),
+        rel, lambda b, i, j, rhb=rhb: (b % rhb, 0))
     in_specs += tail_specs
     args += tail_args
 
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=varlen,
-                          rate=dropout_rate, has_bias=bias is not None),
+                          rate=dropout_rate, has_bias=bias is not None,
+                          rel=rel_static),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=[
@@ -370,8 +479,7 @@ def flash_fwd_packed(qkv, h, h_kv, d, *, scale, causal, kv_lens=None,
     shared over batch at hb == h; broadcast at hb == 1)."""
     b, s, _ = qkv.shape
     group = h // h_kv
-    if bias is not None:
-        bq, bk = min(bq, _BIAS_BLOCK_CAP), min(bk, _BIAS_BLOCK_CAP)
+    bq, bk = _bias_blocks(bias, bq, bk)
     bq, bk = _fit_block(s, bq), _fit_block(s, bk)
     nq, nk = _blocks(s, bq), _blocks(s, bk)
     varlen = kv_lens is not None
@@ -498,8 +606,7 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
     fp32 (see :func:`flash_bwd`)."""
     b, s, _ = qkv.shape
     group = h // h_kv
-    if bias is not None:
-        bq, bk = min(bq, _BIAS_BLOCK_CAP), min(bk, _BIAS_BLOCK_CAP)
+    bq, bk = _bias_blocks(bias, bq, bk)
     bq, bk = _fit_block(s, bq), _fit_block(s, bk)
     nq, nk = _blocks(s, bq), _blocks(s, bk)
     lse4 = lse if lse.ndim == 4 else _expand_rows(lse)
@@ -669,8 +776,8 @@ def flash_bwd_packed(qkv, h, h_kv, d, o, lse, do, *, scale, causal,
 
 
 def flash_fwd_bshd(q, k, v, *, scale, causal, kv_lens=None, bias=None,
-                   bq=1024, bk=1024, full_lse=False, interpret=False,
-                   dropout_rate=0.0, dropout_seed=None):
+                   rel_bias=None, bq=1024, bk=1024, full_lse=False,
+                   interpret=False, dropout_rate=0.0, dropout_seed=None):
     """Seq-major flash forward: q (b, sq, h, d); k/v (b, sk, h_kv, d).
 
     The (s, h·d)-minor layout is exactly what the QKV projection GEMMs
@@ -687,16 +794,19 @@ def flash_fwd_bshd(q, k, v, *, scale, causal, kv_lens=None, bias=None,
     :func:`flash_fwd`.
 
     ``bias`` (hb, sq, sk) with hb | h: additive score bias, q-head row
-    ``t = b·h + h_i`` reading bias row ``t % hb``."""
+    ``t = b·h + h_i`` reading bias row ``t % hb``. ``rel_bias``: the
+    bucketed triple (see :func:`flash_fwd`), table row ``t % hb``."""
     b, sq, h, d = q.shape
     sk, h_kv = k.shape[1], k.shape[2]
     group = h // h_kv
-    if bias is not None:
-        bq, bk = min(bq, _BIAS_BLOCK_CAP), min(bk, _BIAS_BLOCK_CAP)
+    bq, bk = _bias_blocks(bias, bq, bk)
     bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
     varlen = kv_lens is not None
     hb = 0 if bias is None else bias.shape[0]
+    rel, rel_static = (None, None) if rel_bias is None else (
+        rel_bias[:2], rel_bias[2])
+    rhb = 0 if rel is None else rel[0].shape[0]
 
     args = [q.reshape(b, sq, h * d), k.reshape(b, sk, h_kv * d),
             v.reshape(b, sk, h_kv * d)]
@@ -713,7 +823,8 @@ def flash_fwd_bshd(q, k, v, *, scale, causal, kv_lens=None, bias=None,
     tail_specs, tail_args = _tail_operands(
         kv_lens, b, dropout_rate, dropout_seed,
         lambda t, i, j, h=h: (t // h, 0, 0),
-        bias, lambda t, i, j, hb=hb: (t % hb, i, j), (1, bq, bk))
+        bias, lambda t, i, j, hb=hb: (t % hb, i, j), (1, bq, bk),
+        rel, lambda t, i, j, rhb=rhb: (t % rhb, 0))
     in_specs += tail_specs
     args += tail_args
 
@@ -721,7 +832,7 @@ def flash_fwd_bshd(q, k, v, *, scale, causal, kv_lens=None, bias=None,
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=varlen,
                           bshd=True, rate=dropout_rate,
-                          has_bias=bias is not None),
+                          has_bias=bias is not None, rel=rel_static),
         grid=(b * h, nq, nk),
         in_specs=in_specs,
         out_specs=[
@@ -756,13 +867,16 @@ def _rd_row(ref, bshd):
 
 
 def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, varlen,
-                   bshd=False, rate=0.0, has_bias=False):
+                   bshd=False, rate=0.0, has_bias=False, rel=None):
     refs = list(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
     n = 6
     if has_bias:
         bias_ref = refs[n]
         n += 1
+    if rel is not None:
+        rtab_ref, roff_ref = refs[n:n + 2]
+        n += 2
     if varlen:
         kvlen_ref = refs[n]
         n += 1
@@ -795,6 +909,8 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, varlen,
         ) * scale
         if has_bias:
             s = s + bias_ref[0].astype(jnp.float32)
+        if rel is not None:
+            s = s + _rel_bias_block(rtab_ref, roff_ref, i, j, bq, bk, rel)
         if causal or varlen:
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
@@ -823,13 +939,16 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, varlen,
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, varlen,
-                    bshd=False, rate=0.0, has_bias=False):
+                    bshd=False, rate=0.0, has_bias=False, rel=None):
     refs = list(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
     n = 6
     if has_bias:
         bias_ref = refs[n]
         n += 1
+    if rel is not None:
+        rtab_ref, roff_ref = refs[n:n + 2]
+        n += 2
     if varlen:
         kvlen_ref = refs[n]
         n += 1
@@ -863,6 +982,8 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, varlen,
         ) * scale
         if has_bias:
             s = s + bias_ref[0].astype(jnp.float32)
+        if rel is not None:
+            s = s + _rel_bias_block(rtab_ref, roff_ref, i, j, bq, bk, rel)
         if causal or varlen:
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
@@ -990,8 +1111,126 @@ def _dbias_pallas(args, in_specs, *, hb, sq, sk, nq, nk, nb, bq, bk, scale,
     )(*args)
 
 
+def _bwd_dtable_kernel(*refs, scale, causal, bq, bk, nq, nk, nb, hb, off,
+                       varlen, bshd=False, rate=0.0, rel=None):
+    """Bucket-table gradient for the IN-KERNEL relative bias:
+    dtable[bucket, th] = Σ over the rows sharing table column ``th`` and
+    over all (r, c) with bucket(c − r) == bucket of the UNSCALED dS —
+    the chain rule of the per-tile recompute, with the (sq, sk) → bucket
+    contraction done inside the kernel (dS itself never leaves VMEM; the
+    O(s²) dbias intermediate of the materialized path has no analog here).
+
+    Grid (hb, nq, nk, nb), ALL inner dims accumulating into one (1, 128)
+    output row per table column — unlike the dbias kernel (whose (hb, sq,
+    sk) output blocks are indexed by (i, j), forcing batch-innermost),
+    nothing here depends on (i, j), so the whole inner grid is one long
+    consecutive revisit of the same block. Per-step cost: the dq/dkv
+    kernels' dS recompute + ``num_buckets`` masked reductions of the
+    (bq, bk) tile (VPU, overlapped with the step's two GEMMs).
+
+    Row identity: global q-head row r = b·hb + th (the forward grid's
+    ``t``), so the dropout mask hash regenerates bit-exactly."""
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    n = 6
+    rtab_ref, roff_ref = refs[n:n + 2]
+    n += 2
+    if varlen:
+        kvlen_ref = refs[n]
+        n += 1
+    if rate > 0.0:
+        seed_ref = refs[n]
+        n += 1
+    dtab_ref, acc_scr = refs[n:]
+    th = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    b = pl.program_id(3)
+    r = b * hb + th  # global q-head row (the forward grid's t)
+    nbk, bidir, maxd = rel
+
+    @pl.when(jnp.logical_and(jnp.logical_and(i == 0, j == 0), b == 0))
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = (not causal) or (j * bk <= (i + 1) * bq - 1 + off)
+    if varlen:
+        kvlen = kvlen_ref[0, 0, 0]
+        run = jnp.logical_and(run, j * bk < kvlen)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        s = s + _rel_bias_block(rtab_ref, roff_ref, i, j, bq, bk, rel)
+        if causal or varlen:
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            s = jnp.where(cols <= rows + off, s, NEG_INF)
+        if varlen:
+            s = jnp.where(cols < kvlen, s, NEG_INF)
+        p = jnp.exp(s - _rd_row(lse_ref, bshd)[:, 0:1])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if rate > 0.0:
+            dp = dp * _mask_scale(seed_ref[0], r, i, j, bq, bk, rate)
+        ds = p * (dp - _rd_row(delta_ref, bshd)[:, 0:1])
+        # bucket indices of this tile, recomputed exactly as forward
+        grows = roff_ref[0] + i * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, 1), 0)
+        gcols = roff_ref[1] + j * bk + jax.lax.broadcasted_iota(
+            jnp.int32, (1, bk), 1)
+        buckets = relative_position_bucket(
+            gcols - grows, bidirectional=bidir, num_buckets=nbk,
+            max_distance=maxd)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, _REL_LANES), 1)
+        upd = jnp.zeros((1, _REL_LANES), jnp.float32)
+        for bkt in range(nbk):
+            sb = jnp.sum(jnp.where(buckets == bkt, ds, 0.0))
+            upd = upd + jnp.where(lane == bkt, sb, jnp.float32(0.0))
+        acc_scr[:] += upd
+
+    @pl.when(jnp.logical_and(jnp.logical_and(i == nq - 1, j == nk - 1),
+                             b == nb - 1))
+    def _finish():
+        dtab_ref[:] = acc_scr[:]
+
+
+def _dtable_pallas(args, in_specs, *, hb, nq, nk, nb, bq, bk, scale,
+                   causal, off, varlen, bshd, rate, rel, interpret):
+    """Launch :func:`_bwd_dtable_kernel` — shared by the flat and bshd
+    layouts (only ``in_specs``/``args`` differ). Returns (hb, 128) fp32
+    head-major bucket-table grads (caller slices/transposes back to the
+    (num_buckets, hb) table shape)."""
+    return pl.pallas_call(
+        functools.partial(_bwd_dtable_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq, nk=nk, nb=nb, hb=hb,
+                          off=off, varlen=varlen, bshd=bshd, rate=rate,
+                          rel=rel),
+        grid=(hb, nq, nk, nb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, _REL_LANES),
+                               lambda th, i, j, b: (th, 0)),
+        out_shape=jax.ShapeDtypeStruct((hb, _REL_LANES), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((1, _REL_LANES), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            # every inner dim accumulates into the one output row, so the
+            # whole inner grid must stay sequential
+            dimension_semantics=("parallel", "arbitrary", "arbitrary",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(*args)
+
+
 def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
-              bias=None, bq=1024, bk=1024, interpret=False,
+              bias=None, rel_bias=None, bq=1024, bk=1024, interpret=False,
               dropout_rate=0.0, dropout_seed=None):
     """Gradients; with grouped kv (bh_kv < bh) dk/dv come back at kv shape —
     the dkv kernel runs per *q*-head (its scratch accumulates over q blocks
@@ -1005,12 +1244,16 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
     ``bias`` (hb, sq, sk), hb | bh (row r reads bias row r % hb — see
     :func:`flash_fwd`): returns a FOURTH output, dbias (hb, sq, sk) fp32 =
     Σ over the rows sharing each bias row of the unscaled dS, produced by
-    :func:`_bwd_dbias_kernel` (batch-innermost grid)."""
+    :func:`_bwd_dbias_kernel` (batch-innermost grid).
+
+    ``rel_bias`` (the bucketed triple, see :func:`flash_fwd`): the dq/dkv
+    kernels recompute the bias per tile, and the FOURTH output is the
+    head-major bucket-table grad (hb, 128) fp32 from
+    :func:`_bwd_dtable_kernel` — no O(s²) dbias intermediate exists."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     group = bh // k.shape[0]
-    if bias is not None:
-        bq, bk = min(bq, _BIAS_BLOCK_CAP), min(bk, _BIAS_BLOCK_CAP)
+    bq, bk = _bias_blocks(bias, bq, bk)
     bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -1018,19 +1261,24 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
     delta3 = _expand_rows(delta)
     varlen = kv_lens is not None
     hb = 0 if bias is None else bias.shape[0]
+    rel, rel_static = (None, None) if rel_bias is None else (
+        rel_bias[:2], rel_bias[2])
+    rhb = 0 if rel is None else rel[0].shape[0]
     _, extra_args = _tail_operands(
-        kv_lens, bh, dropout_rate, dropout_seed, None, bias, None, None)
+        kv_lens, bh, dropout_rate, dropout_seed, None, bias, None, None,
+        rel, None)
 
-    def tail_specs(index_map, bias_map):
+    def tail_specs(index_map, bias_map, rel_map):
         specs, _ = _tail_operands(
             kv_lens, bh, dropout_rate, dropout_seed, index_map,
-            bias, bias_map, (1, bq, bk))
+            bias, bias_map, (1, bq, bk), rel, rel_map)
         return specs
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=varlen,
-                          rate=dropout_rate, has_bias=bias is not None),
+                          rate=dropout_rate, has_bias=bias is not None,
+                          rel=rel_static),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -1040,7 +1288,8 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
             pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i, j: (b, i, 0)),
         ] + tail_specs(lambda b, i, j: (b, 0, 0),
-                       lambda b, i, j, hb=hb: (b % hb, i, j)),
+                       lambda b, i, j, hb=hb: (b % hb, i, j),
+                       lambda b, i, j, rhb=rhb: (b % rhb, 0)),
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
@@ -1053,7 +1302,8 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, off=sk - sq, varlen=varlen,
-                          rate=dropout_rate, has_bias=bias is not None),
+                          rate=dropout_rate, has_bias=bias is not None,
+                          rel=rel_static),
         grid=(bh, nk, nq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
@@ -1063,7 +1313,8 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
             pl.BlockSpec((1, bq, _LSE_LANES), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bq, _LSE_LANES), lambda b, j, i: (b, i, 0)),
         ] + tail_specs(lambda b, j, i: (b, 0, 0),
-                       lambda b, j, i, hb=hb: (b % hb, i, j)),
+                       lambda b, j, i, hb=hb: (b % hb, i, j),
+                       lambda b, j, i, rhb=rhb: (b % rhb, 0)),
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
@@ -1090,6 +1341,36 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
     if group > 1:
         dk = dk.reshape(-1, group, sk, d).sum(1).astype(k.dtype)
         dv = dv.reshape(-1, group, sk, d).sum(1).astype(v.dtype)
+    if rel is not None:
+        nb = bh // rhb
+        qmap = lambda th, i, j, b, rhb=rhb: (b * rhb + th, i, 0)  # noqa: E731
+        kmap = lambda th, i, j, b, rhb=rhb, g=group: (  # noqa: E731
+            (b * rhb + th) // g, j, 0)
+        dt_specs = [
+            pl.BlockSpec((1, bq, d), qmap),
+            pl.BlockSpec((1, bk, d), kmap),
+            pl.BlockSpec((1, bk, d), kmap),
+            pl.BlockSpec((1, bq, d), qmap),
+            pl.BlockSpec((1, bq, _LSE_LANES), qmap),
+            pl.BlockSpec((1, bq, _LSE_LANES), qmap),
+            pl.BlockSpec((1, _REL_LANES), lambda th, i, j, b: (th, 0)),
+            _SMEM_SPEC,
+        ]
+        dt_args = [q, k, v, do, lse3, delta3, rel[0], rel[1]]
+        if varlen:
+            dt_specs.append(pl.BlockSpec(
+                (1, 1, _LSE_LANES),
+                lambda th, i, j, b, rhb=rhb: (b * rhb + th, 0, 0)))
+            dt_args.append(_kvlen_rows(kv_lens, bh))
+        if dropout_rate > 0.0:
+            dt_specs.append(_SMEM_SPEC)
+            dt_args.append(_seed_operand(dropout_seed))
+        dtable = _dtable_pallas(
+            dt_args, dt_specs, hb=rhb, nq=nq, nk=nk, nb=nb, bq=bq, bk=bk,
+            scale=scale, causal=causal, off=sk - sq, varlen=varlen,
+            bshd=False, rate=dropout_rate, rel=rel_static,
+            interpret=interpret)
+        return dq, dk, dv, dtable
     if bias is None:
         return dq, dk, dv
     nb = bh // hb
@@ -1122,22 +1403,25 @@ def flash_bwd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
 
 
 def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
-                   bias=None, bq=1024, bk=1024, interpret=False,
-                   dropout_rate=0.0, dropout_seed=None):
+                   bias=None, rel_bias=None, bq=1024, bk=1024,
+                   interpret=False, dropout_rate=0.0, dropout_seed=None):
     """Seq-major backward (cf. :func:`flash_fwd_bshd`): q/o/do
     (b, sq, h, d), k/v (b, sk, h_kv, d), lse (b, h, sq) or the
     (b, h, sq, LANES) carrier from ``flash_fwd_bshd(full_lse=True)``.
     Returns (dq (b, sq, h, d), dk/dv (b, sk, h_kv, d)); with ``bias``
     (hb, sq, sk), hb | h, a fourth output dbias (hb, sq, sk) fp32 (see
-    :func:`flash_bwd`)."""
+    :func:`flash_bwd`); with ``rel_bias`` (the bucketed triple) a fourth
+    output dtable (hb, 128) fp32 head-major (see :func:`flash_bwd`)."""
     b, sq, h, d = q.shape
     sk, h_kv = k.shape[1], k.shape[2]
     group = h // h_kv
-    if bias is not None:
-        bq, bk = min(bq, _BIAS_BLOCK_CAP), min(bk, _BIAS_BLOCK_CAP)
+    bq, bk = _bias_blocks(bias, bq, bk)
     bq, bk = _fit_block(sq, bq), _fit_block(sk, bk)
     nq, nk = _blocks(sq, bq), _blocks(sk, bk)
     hb = 0 if bias is None else bias.shape[0]
+    rel, rel_static = (None, None) if rel_bias is None else (
+        rel_bias[:2], rel_bias[2])
+    rhb = 0 if rel is None else rel[0].shape[0]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     # (b, sq, h) -> the (b, h, sq, LANES) carrier the kernels read rowwise
     lse4 = lse if lse.ndim == 4 else _expand_rows(lse)
@@ -1165,13 +1449,14 @@ def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
     extra_specs, extra_args = _tail_operands(
         kv_lens, b, dropout_rate, dropout_seed,
         lambda t, i, j, h=h: (t // h, 0, 0),
-        bias, lambda t, i, j, hb=hb: (t % hb, i, j), (1, bq, bk))
+        bias, lambda t, i, j, hb=hb: (t % hb, i, j), (1, bq, bk),
+        rel, lambda t, i, j, rhb=rhb: (t % rhb, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, off=sk - sq, varlen=varlen,
                           bshd=True, rate=dropout_rate,
-                          has_bias=bias is not None),
+                          has_bias=bias is not None, rel=rel_static),
         grid=(b * h, nq, nk),
         in_specs=[q_spec(qm), kv_spec(km), kv_spec(km), q_spec(qm),
                   row_spec(rm), row_spec(rm)] + extra_specs,
@@ -1195,13 +1480,14 @@ def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
     extra_specs2, _ = _tail_operands(
         kv_lens, b, dropout_rate, dropout_seed,
         lambda t, j, i, h=h: (t // h, 0, 0),
-        bias, lambda t, j, i, hb=hb: (t % hb, i, j), (1, bq, bk))
+        bias, lambda t, j, i, hb=hb: (t % hb, i, j), (1, bq, bk),
+        rel, lambda t, j, i, rhb=rhb: (t % rhb, 0))
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, off=sk - sq, varlen=varlen,
                           bshd=True, rate=dropout_rate,
-                          has_bias=bias is not None),
+                          has_bias=bias is not None, rel=rel_static),
         grid=(b * h, nk, nq),
         in_specs=[q_spec(qm2), kv_spec(km2), kv_spec(km2), q_spec(qm2),
                   row_spec(rm2), row_spec(rm2)] + extra_specs2,
@@ -1225,6 +1511,42 @@ def flash_bwd_bshd(q, k, v, o, lse, do, *, scale, causal, kv_lens=None,
         dv = _group_sum(dv, h_kv, group, d, v.dtype)
     dk = dk.reshape(b, sk, h_kv, d)
     dv = dv.reshape(b, sk, h_kv, d)
+    if rel is not None:
+        # dtable: global q-head row r = b·rhb + th over the folded
+        # (b, s, h·d) operands via (r // h, ·, r % h)
+        nb = (b * h) // rhb
+        qmap = lambda th, i, j, bi, rhb=rhb, h=h: (  # noqa: E731
+            (bi * rhb + th) // h, i, (bi * rhb + th) % h)
+        kmap = lambda th, i, j, bi, rhb=rhb, h=h, g=group: (  # noqa: E731
+            (bi * rhb + th) // h, j, ((bi * rhb + th) % h) // g)
+        rmap = lambda th, i, j, bi, rhb=rhb, h=h: (  # noqa: E731
+            (bi * rhb + th) // h, (bi * rhb + th) % h, i, 0)
+        dt_specs = [
+            pl.BlockSpec((1, bq, d), qmap),
+            pl.BlockSpec((1, bk, d), kmap),
+            pl.BlockSpec((1, bk, d), kmap),
+            pl.BlockSpec((1, bq, d), qmap),
+            pl.BlockSpec((1, 1, bq, _LSE_LANES), rmap),
+            pl.BlockSpec((1, 1, bq, _LSE_LANES), rmap),
+            pl.BlockSpec((1, _REL_LANES), lambda th, i, j, bi: (th, 0)),
+            _SMEM_SPEC,
+        ]
+        dt_args = [q3, k3, v3, do3, lse4, delta4, rel[0], rel[1]]
+        if varlen:
+            dt_specs.append(pl.BlockSpec(
+                (1, 1, _LSE_LANES),
+                lambda th, i, j, bi, rhb=rhb, h=h: (
+                    (bi * rhb + th) // h, 0, 0)))
+            dt_args.append(_kvlen_rows(kv_lens, b))
+        if dropout_rate > 0.0:
+            dt_specs.append(_SMEM_SPEC)
+            dt_args.append(_seed_operand(dropout_seed))
+        dtable = _dtable_pallas(
+            dt_args, dt_specs, hb=rhb, nq=nq, nk=nk, nb=nb, bq=bq, bk=bk,
+            scale=scale, causal=causal, off=sk - sq, varlen=varlen,
+            bshd=True, rate=dropout_rate, rel=rel_static,
+            interpret=interpret)
+        return dq, dk, dv, dtable
     if bias is None:
         return dq, dk, dv
     # dbias: batch-innermost grid; global q-head row r = b·hb + th maps to

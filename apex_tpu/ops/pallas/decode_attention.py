@@ -36,18 +36,32 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops.pallas import exact_block
-from apex_tpu.ops.pallas.attention import _LSE_LANES, NEG_INF, _kvlen_rows
+from apex_tpu.ops.pallas.attention import (_LSE_LANES, _REL_LANES, NEG_INF,
+                                           _kvlen_rows,
+                                           relative_position_bucket)
 
 
-def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, m_scr, l_scr,
-                   acc_scr, *, scale, bk, nk):
+def _decode_kernel(*refs, scale, bk, nk, rel=None):
     """Online-softmax decode step for one (batch, kv-head) row.
 
     Grid (b·h_kv, nk): the kv axis is the ONLY sequential dim; scratch
     carries (m, l, acc) across kv blocks and the output is written once
     at the last block — no (group, max_s) score tensor exists anywhere,
     in VMEM or HBM.
+
+    ``rel = (num_buckets, max_distance)`` (static) adds the T5 CAUSAL
+    bucketed relative bias recomputed in-kernel from a (group, 128)
+    head-major table block: the query IS position ``kvlen - 1``, so
+    rel_pos = col − (kvlen − 1) needs no extra operand beyond the table —
+    the decode sibling of the flash kernels' ``rel_bias``.
     """
+    refs = list(refs)
+    q_ref, k_ref, v_ref, len_ref = refs[:4]
+    n = 4
+    if rel is not None:
+        rtab_ref = refs[n]
+        n += 1
+    o_ref, m_scr, l_scr, acc_scr = refs[n:]
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -69,6 +83,17 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, m_scr, l_scr,
             preferred_element_type=jnp.float32) * scale  # (group, bk)
         cols = j * bk + jax.lax.broadcasted_iota(
             jnp.int32, (q.shape[0], bk), 1)
+        if rel is not None:
+            nbk, maxd = rel
+            buckets = relative_position_bucket(
+                cols - (kvlen - 1), bidirectional=False, num_buckets=nbk,
+                max_distance=maxd)  # (group, bk), rows identical
+            bias = jnp.zeros(s.shape, jnp.float32)
+            for b in range(nbk):
+                bias = bias + jnp.where(buckets == b,
+                                        rtab_ref[:, b:b + 1],
+                                        jnp.float32(0.0))
+            s = s + bias
         s = jnp.where(cols < kvlen, s, NEG_INF)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -88,25 +113,45 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, m_scr, l_scr,
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
 
 
-def decode_attn_fwd(q, k, v, lengths, *, scale, bk=512, interpret=False):
+def decode_attn_fwd(q, k, v, lengths, *, scale, rel_bias=None, bk=512,
+                    interpret=False):
     """q (rows, group, d); k/v (rows, max_s, d) with rows = b·h_kv;
     ``lengths`` (rows,) int32 — positions >= the length are masked and
     whole blocks past it are skipped. Returns (rows, group, d) context.
-    Forward-only: decode never differentiates."""
+    Forward-only: decode never differentiates.
+
+    ``rel_bias``: ``(table (h, 128) fp32 head-major, (num_buckets,
+    max_distance))`` — causal T5 bucketed bias recomputed in-kernel;
+    row r's table block covers its kv group's q heads
+    ([(r % h_kv)·group, ...))."""
     rows, group, d = q.shape
     max_s = k.shape[1]
     bk = exact_block(max_s, bk, 128) or max_s
     nk = pl.cdiv(max_s, bk)
+    rel, rel_static = (None, None) if rel_bias is None else (
+        rel_bias[0], rel_bias[1])
+
+    in_specs = [
+        pl.BlockSpec((1, group, d), lambda b, j: (b, 0, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+        pl.BlockSpec((1, 1, _LSE_LANES), lambda b, j: (b, 0, 0)),
+    ]
+    args = [q, k, v, _kvlen_rows(lengths, rows)]
+    if rel is not None:
+        # rows iterate (batch, kv head); table rows are q heads — row r's
+        # group block sits at head offset (r % h_kv)·group
+        h_kv = rel.shape[0] // group
+        in_specs.append(pl.BlockSpec(
+            (group, _REL_LANES),
+            lambda b, j, hk=h_kv: (b % hk, 0)))
+        args.append(rel)
 
     return pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, bk=bk, nk=nk),
+        functools.partial(_decode_kernel, scale=scale, bk=bk, nk=nk,
+                          rel=rel_static),
         grid=(rows, nk),
-        in_specs=[
-            pl.BlockSpec((1, group, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, 1, _LSE_LANES), lambda b, j: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, group, d), lambda b, j: (b, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((rows, group, d), q.dtype),
         scratch_shapes=[
@@ -118,4 +163,4 @@ def decode_attn_fwd(q, k, v, lengths, *, scale, bk=512, interpret=False):
             dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q, k, v, _kvlen_rows(lengths, rows))
+    )(*args)

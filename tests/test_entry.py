@@ -23,23 +23,70 @@ def test_entry_compiles_and_runs():
     assert float(loss) > 0
 
 
-def test_dryrun_multichip_8():
+def test_dryrun_multichip_8(monkeypatch):
     # Under the test conftest there are 8 virtual CPU devices, so this runs
     # inline; under a real single-chip session it exercises the subprocess
-    # respawn path. Both must succeed.
+    # respawn path. Both must succeed. The 16-wide leg respawn is disabled
+    # here (these tests cover the gate's own mechanics; the multi-minute
+    # 16-wide child runs once in test_full_composition and on every real
+    # driver invocation, which never sets this env).
+    monkeypatch.setenv("APEX_TPU_GATE_16WIDE", "0")
     graft.dryrun_multichip(8)
 
 
 def test_dryrun_multichip_respawn_path(monkeypatch):
     """Force the subprocess path even when 8 local devices exist."""
+    monkeypatch.setenv("APEX_TPU_GATE_16WIDE", "0")
     monkeypatch.setattr(jax, "device_count", lambda: 1)
     graft.dryrun_multichip(8)
 
 
-def test_dryrun_multichip_2():
+def test_dryrun_multichip_2(monkeypatch):
+    monkeypatch.setenv("APEX_TPU_GATE_16WIDE", "0")
     graft.dryrun_multichip(2)
 
 
 @pytest.mark.parametrize("n", [4])
-def test_dryrun_multichip_tp_only(n):
+def test_dryrun_multichip_tp_only(n, monkeypatch):
+    monkeypatch.setenv("APEX_TPU_GATE_16WIDE", "0")
     graft.dryrun_multichip(n)
+
+
+def test_16wide_respawn_parses_and_skips(monkeypatch, capsys):
+    """The 16-wide leg machinery without the 16-wide cost: a faked child
+    proves the result-line parse; the env opt-out and a timeout both
+    yield explicit skips (never nan); a failed child raises."""
+    import subprocess as sp
+
+    monkeypatch.setenv("APEX_TPU_GATE_16WIDE", "0")
+    out = graft._respawn_16wide_legs()
+    assert out["tpcp_4axis_loss"][0] == "skipped"
+    monkeypatch.delenv("APEX_TPU_GATE_16WIDE")
+
+    class FakeProc:
+        returncode = 0
+        stderr = ""
+        stdout = ("noise\nSIXTEEN_WIDE_LEGS "
+                  '{"moe_16wide_loss": 4.31, "tpcp_4axis_loss": 4.36}\n')
+
+    monkeypatch.setattr(graft.subprocess, "run",
+                        lambda *a, **k: FakeProc())
+    out = graft._respawn_16wide_legs()
+    assert out == {"moe_16wide_loss": 4.31, "tpcp_4axis_loss": 4.36}
+
+    def timeout(*a, **k):
+        raise sp.TimeoutExpired(cmd="x", timeout=900)
+
+    monkeypatch.setattr(graft.subprocess, "run", timeout)
+    out = graft._respawn_16wide_legs()
+    assert out["moe_16wide_loss"][0] == "skipped"
+    assert "900s" in out["moe_16wide_loss"][1]
+
+    class FailProc(FakeProc):
+        returncode = 3
+        stderr = "boom"
+
+    monkeypatch.setattr(graft.subprocess, "run",
+                        lambda *a, **k: FailProc())
+    with pytest.raises(RuntimeError, match="rc=3"):
+        graft._respawn_16wide_legs()

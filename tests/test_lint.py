@@ -194,6 +194,23 @@ def f(k, x, interpret=False):
                           interpret=interpret)(x)
 """,
     ),
+    "APX304": (
+        """
+from apex_tpu.models.t5 import relative_bias
+from apex_tpu.ops.attention import flash_attention
+def f(q, k, v, table, s):
+    bias = relative_bias(table, s, s, bidirectional=True,
+                         num_buckets=32, max_distance=128)
+    return flash_attention(q, k, v, causal=False, bias=bias[0])
+""",
+        """
+from apex_tpu.ops.attention import BucketedBias, flash_attention
+def f(q, k, v, table):
+    return flash_attention(
+        q, k, v, causal=False,
+        bias=BucketedBias(table, bidirectional=True, max_distance=128))
+""",
+    ),
     "APX401": (
         """
 import jax
@@ -956,3 +973,63 @@ class TestDocsCatalogue:
         for needle in ("--baseline", "apexlint: disable=", "--format json",
                        "tools/apexlint_baseline.json"):
             assert needle in text, f"lint.md lost its {needle} workflow"
+
+
+class TestAPX304MaterializedBias:
+    """Beyond the fixture pair: the taint survives name hops and
+    subscripts, .materialize() counts as a materializer, and the
+    positional bias slot of fused_qkv_attention is covered."""
+
+    def test_materialize_method_into_ring(self):
+        src = """
+from apex_tpu.ops.attention import ring_attention
+def f(q, k, v, bb, s):
+    return ring_attention(q, k, v, bias=bb.materialize(s, s))
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX304" in {f.code for f in findings}
+
+    def test_taint_through_subscript_and_positional_fused(self):
+        src = """
+from apex_tpu.models import t5
+from apex_tpu.ops.attention import fused_qkv_attention
+def f(x, w, b, wo, table, s, h, d):
+    arr = t5.relative_bias(table, s, s, bidirectional=False,
+                           num_buckets=32, max_distance=128)
+    full = arr[0]
+    return fused_qkv_attention(x, w, b, wo, full, None, None, h, 1, d,
+                               1.0, True)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX304" in {f.code for f in findings}
+
+    def test_oracle_materialize_without_attention_is_clean(self):
+        src = """
+from apex_tpu.ops.attention import BucketedBias
+def oracle(bb, s):
+    return bb.materialize(s, s)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX304" not in {f.code for f in findings}
+
+    def test_unknown_provenance_param_is_clean(self):
+        src = """
+from apex_tpu.ops.attention import flash_attention
+def f(q, k, v, bias):
+    return flash_attention(q, k, v, bias=bias)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX304" not in {f.code for f in findings}
+
+    def test_inline_suppression(self):
+        src = """
+from apex_tpu.models.t5 import relative_bias
+from apex_tpu.ops.attention import flash_attention
+def f(q, k, v, t, s):
+    bias = relative_bias(t, s, s, bidirectional=True, num_buckets=32,
+                         max_distance=128)
+    return flash_attention(q, k, v, bias=bias[0])  # apexlint: disable=APX304
+"""
+        findings, suppressed = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX304" not in {f.code for f in findings}
+        assert suppressed == 1

@@ -466,12 +466,16 @@ class TestGateReporting:
             cp_pipe_loss=4.3,
             t5_loss=18.8,
             tpcp_4axis_loss=graft._SKIP("needs n_devices % 16 == 0"),
+            moe_16wide_loss=4.31,
             ring_vs_flash=3e-7,
+            ring_bias_vs_flash=graft._SKIP("16-wide respawn timed out"),
         )
         out = capsys.readouterr().out
         gate_line = [l for l in out.splitlines() if l.endswith(" OK")][0]
         assert "nan" not in gate_line
         assert "tpcp_4axis_loss=SKIP(needs-n_devices-%-16-==-0)" in gate_line
+        assert "ring_bias_vs_flash=SKIP(16-wide-respawn-timed-out)" in \
+            gate_line
         json_line = [l for l in out.splitlines()
                      if l.startswith("MULTICHIP_GATE ")][0]
         record = json.loads(json_line[len("MULTICHIP_GATE "):])
@@ -491,3 +495,67 @@ class TestGateReporting:
                 ring_vs_flash=3e-7,
             )
         assert " OK" not in capsys.readouterr().out
+
+
+class TestLongseqBiasRecords:
+    """The ``longseq_bias`` bench record (``bench.py --longseq-bias``):
+    in-kernel bucketed bias vs the materialized baseline — same status/
+    honesty contract as the decode record."""
+
+    def test_emit_roundtrip_and_validation(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        monitor.enable(str(path))
+        try:
+            rec = monitor.emit_longseq_bias(
+                "OK", tokens_per_s=52000.0,
+                tokens_per_s_materialized=31000.0, vs_materialized=1.677,
+                hbm_peak_mb=900.5, hbm_peak_materialized_mb=2400.0,
+                bias_bytes=768, bias_bytes_materialized=1610612736,
+                seq=8192, batch=1, heads=6, head_dim=128, num_buckets=32,
+                causal=False, spread_pct=0.3)
+            assert monitor.validate(rec) == []
+        finally:
+            monitor.disable()
+        assert monitor.validate_jsonl(path.read_text().splitlines()) == []
+
+    def test_ok_with_nan_refused_and_skip_needs_reason(self):
+        reg = monitor.MetricsRegistry()
+        with pytest.raises(ValueError, match="non-finite"):
+            reg.emit_longseq_bias("OK", tokens_per_s=float("nan"))
+        with pytest.raises(ValueError, match="reason"):
+            reg.emit_longseq_bias("SKIP")
+        rec = reg.emit_longseq_bias(
+            "SKIP", reason="no TPU",
+            hbm_peak_mb=("skipped", "no memory_stats"))
+        assert rec["hbm_peak_mb"] == {"skipped": True,
+                                      "reason": "no memory_stats"}
+        assert monitor.validate(rec) == []
+        # the validator enforces the reason too (external streams)
+        bare = {k: v for k, v in rec.items() if k != "reason"}
+        assert any("reason" in e for e in monitor.validate(bare))
+
+
+@pytest.mark.slow
+class TestLongseqBiasBenchLeg:
+    def test_bench_longseq_bias_emits_valid_skip_record_off_tpu(
+            self, tmp_path):
+        """The long-seq bias leg end-to-end at smoke scale: off-TPU it
+        must print/emit an explicit SKIP record — schema-valid, no nan —
+        and the stream must pass the validator CLI."""
+        import subprocess
+        root = os.path.join(os.path.dirname(__file__), "..")
+        path = tmp_path / "longseq.jsonl"
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   APEX_TPU_MONITOR=str(path))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "bench.py"),
+             "--longseq-bias"],
+            capture_output=True, text=True, env=env, cwd=root, timeout=600)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        record = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert record["kind"] == "longseq_bias"
+        assert record["status"] == "SKIP" and record["reason"]
+        assert record["hbm_peak_mb"]["skipped"] is True
+        assert monitor.validate(record) == []
+        tool = _load_validate_tool()
+        assert tool.main([str(path)]) == 0
