@@ -15,6 +15,14 @@ APX402  unknown-partition-axis    PartitionSpec naming an axis outside the
                                   known mesh axes (shard_map in_specs/
                                   out_specs included — they are built of
                                   PartitionSpecs)
+APX403  blocking-collective-feeds-matmul
+                                  a ``lax.all_gather`` result feeding a
+                                  matmul/einsum, or a matmul feeding
+                                  ``lax.psum_scatter`` — inside shard_map
+                                  these blocking boundary collectives stall
+                                  the MXU; ``ops.collective_matmul`` /
+                                  ``overlap_comm=True`` overlaps them
+                                  (advisory)
 """
 
 from __future__ import annotations
@@ -110,6 +118,75 @@ def check_apx401(ctx: ModuleContext):
                     "module; a typo'd axis only fails when the collective "
                     "runs under a real mesh (use the mesh_lib.*_AXIS "
                     "constants)")
+
+
+# --- APX403: blocking boundary collective around a matmul ---------------------
+
+_MM_SHORT = frozenset({"dot", "matmul", "einsum", "dot_general", "tensordot"})
+
+
+def _is_lax_call(ctx: ModuleContext, node, name: str) -> bool:
+    canon = ctx.call_name(node) or ""
+    return canon in (f"jax.lax.{name}", f"lax.{name}", name)
+
+
+def _is_matmul_call(ctx: ModuleContext, node) -> bool:
+    canon = ctx.call_name(node) or ""
+    short = canon.rsplit(".", 1)[-1]
+    if short not in _MM_SHORT:
+        return False
+    return (canon == short
+            or canon.startswith(("jax.numpy.", "numpy.", "jax.lax.",
+                                 "lax.")))
+
+
+@rule("APX403", "blocking-collective-feeds-matmul",
+      "a lax.all_gather result feeding a matmul/einsum (or a matmul "
+      "feeding lax.psum_scatter) — the blocking boundary collective "
+      "stalls the MXU inside shard_map where the ring-overlapped "
+      "collective matmul (ops.collective_matmul / overlap_comm=True) "
+      "hides it behind the chunk GEMMs (advisory)")
+def check_apx403(ctx: ModuleContext):
+    from apex_tpu.lint.rules_pallas import (_expr_has, _scope_bodies,
+                                            _scope_nodes, _taint_names)
+
+    def is_all_gather(call):
+        return _is_lax_call(ctx, call, "all_gather")
+
+    def is_matmul(call):
+        return _is_matmul_call(ctx, call)
+
+    for body in _scope_bodies(ctx.tree):
+        stmts = _scope_nodes(body)
+        gathered = _taint_names(stmts, is_all_gather)
+        matmuled = _taint_names(stmts, is_matmul)
+        for node in stmts:
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_matmul_call(ctx, node):
+                # an all-gather result among the matmul operands
+                operands = list(node.args) + [k.value for k in node.keywords]
+                for arg in operands:
+                    if _expr_has(is_all_gather, arg, gathered):
+                        yield ctx.finding(
+                            node, "APX403",
+                            "all-gather result feeds this matmul — inside "
+                            "shard_map the blocking gather stalls the MXU "
+                            "for the full boundary latency; "
+                            "ops.collective_matmul.all_gather_matmul (or "
+                            "overlap_comm=True on the linear) overlaps "
+                            "the transfer with per-chunk GEMMs (advisory)")
+                        break
+            elif _is_lax_call(ctx, node, "psum_scatter") and node.args:
+                if _expr_has(is_matmul, node.args[0], matmuled):
+                    yield ctx.finding(
+                        node, "APX403",
+                        "matmul result feeds this psum_scatter — inside "
+                        "shard_map the blocking reduce-scatter stalls the "
+                        "MXU after the GEMM completes; "
+                        "ops.collective_matmul.matmul_reduce_scatter (or "
+                        "overlap_comm=True on the linear) computes one "
+                        "output shard per ring step instead (advisory)")
 
 
 def _is_partition_spec(ctx: ModuleContext, call: ast.Call) -> bool:

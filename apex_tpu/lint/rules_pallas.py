@@ -148,14 +148,35 @@ def _is_bias_materializer(ctx: ModuleContext, call: ast.Call) -> bool:
             or canon.endswith(".materialize"))
 
 
-def _materializer_tainted(ctx: ModuleContext, expr: ast.expr,
-                          tainted: set) -> bool:
+def _expr_has(pred, expr: ast.expr, tainted: set) -> bool:
+    """Does ``expr`` contain a call matching ``pred`` or a tainted name?"""
     for sub in ast.walk(expr):
-        if isinstance(sub, ast.Call) and _is_bias_materializer(ctx, sub):
+        if isinstance(sub, ast.Call) and pred(sub):
             return True
         if isinstance(sub, ast.Name) and sub.id in tainted:
             return True
     return False
+
+
+def _taint_names(stmts, pred) -> set:
+    """Flow-insensitive per-scope taint fixpoint: names assigned (anywhere
+    in the scope) from an expression containing a ``pred`` call or an
+    already-tainted name — iterated so ``a = seed(...); b = a[0]`` taints
+    ``b`` too. Shared by APX304 and APX403 (one copy of the taint
+    semantics; per-scope via :func:`_scope_bodies`/:func:`_scope_nodes`)."""
+    tainted: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for node in stmts:
+            if isinstance(node, ast.Assign) and _expr_has(
+                    pred, node.value, tainted):
+                for tgt in node.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name) and n.id not in tainted:
+                            tainted.add(n.id)
+                            changed = True
+    return tainted
 
 
 def _scope_nodes(body):
@@ -188,24 +209,12 @@ def _scope_bodies(tree: ast.Module):
       "attention bias= operand — O(h·s²) HBM where the bucketed table "
       "operand computes the same bias in-kernel from O(buckets·h)")
 def check_apx304(ctx: ModuleContext):
+    def is_materializer(call):
+        return _is_bias_materializer(ctx, call)
+
     for body in _scope_bodies(ctx.tree):
         stmts = _scope_nodes(body)
-        # flow-insensitive taint: names assigned (anywhere in the scope)
-        # from an expression containing a materializer call; iterate to a
-        # fixpoint so a = relative_bias(...); b = a[0] taints b too
-        tainted: set = set()
-        changed = True
-        while changed:
-            changed = False
-            for node in stmts:
-                if isinstance(node, ast.Assign) and _materializer_tainted(
-                        ctx, node.value, tainted):
-                    for tgt in node.targets:
-                        for n in ast.walk(tgt):
-                            if isinstance(n, ast.Name) and \
-                                    n.id not in tainted:
-                                tainted.add(n.id)
-                                changed = True
+        tainted = _taint_names(stmts, is_materializer)
         for node in stmts:
             if not isinstance(node, ast.Call):
                 continue
@@ -222,7 +231,7 @@ def check_apx304(ctx: ModuleContext):
                 bias_expr = node.args[4]  # (x, w_qkv, b_qkv, w_out, bias)
             if bias_expr is None:
                 continue
-            if _materializer_tainted(ctx, bias_expr, tainted):
+            if _expr_has(is_materializer, bias_expr, tainted):
                 yield ctx.finding(
                     bias_expr, "APX304",
                     "materialized (h, sq, sk) relative bias feeds a "

@@ -45,6 +45,14 @@ class GPTConfig:
     tp_size: int = 1
     tp_axis: Optional[str] = "tp"  # None → single-chip, no collectives
     sequence_parallel: bool = False
+    # Ring-overlapped TP boundary collectives (ops.collective_matmul): the
+    # Column/Row linears and the flash attention projections trade their
+    # blocking all-gather/reduce-scatter/psum for compute-overlapped
+    # ppermute rings (with SP: ag→matmul and matmul→reduce-scatter;
+    # without: overlapped backward psum / matmul→all-reduce). Blocking
+    # (False) stays the parity oracle. Requires tp_size >= 2 and the
+    # flash attention path; composing with cp is future work.
+    tp_overlap: bool = False
     dropout: float = 0.0
     remat: bool = True
     # "full": recompute the whole block in backward (Megatron
@@ -120,6 +128,29 @@ class GPTConfig:
                 raise ValueError(
                     "context parallelism distributes the flash kernel "
                     "family; set attention_impl='flash'")
+        if self.tp_overlap:
+            if self.tp_size < 2:
+                raise ValueError(
+                    "tp_overlap overlaps the tp boundary collectives with "
+                    "the linears' GEMMs; it needs tp_size >= 2 (there is "
+                    "no collective to hide at tp_size=1)")
+            if self.tp_axis is None:
+                raise ValueError(
+                    "tp_overlap needs a bound tp axis; tp_axis=None runs "
+                    "the linears without collectives, so the flag would "
+                    "silently measure the blocking path — unset "
+                    "tp_overlap or name the mesh axis")
+            if self.attention_impl != "flash":
+                raise ValueError(
+                    "tp_overlap rides the flash attention path (the packed "
+                    "QKV projection the ring feeds); set "
+                    "attention_impl='flash'")
+            if self.cp_axis is not None:
+                raise ValueError(
+                    "tp_overlap does not yet compose with context "
+                    "parallelism (the cp attention branch re-shards the "
+                    "sequence the rings chunk); run cp with the blocking "
+                    "boundary collectives")
         if self.num_kv_heads is not None:
             if self.num_kv_heads < 1:
                 raise ValueError(
@@ -180,21 +211,23 @@ class GPTModel:
         )
         # activations are (batch, seq, hidden) → seq_dim=1 for the SP
         # all-gather/reduce-scatter boundaries
+        overlap = c.tp_overlap and axis is not None
+        self.overlap = overlap
         self.qkv = tp_lib.ColumnParallelLinear(
             c.hidden_size, c.qkv_features, tp_size=c.tp_size, axis_name=axis,
-            sequence_parallel=sp, seq_dim=1,
+            sequence_parallel=sp, seq_dim=1, overlap_comm=overlap,
         )
         self.attn_out = tp_lib.RowParallelLinear(
             c.hidden_size, c.hidden_size, tp_size=c.tp_size, axis_name=axis,
-            sequence_parallel=sp, seq_dim=1,
+            sequence_parallel=sp, seq_dim=1, overlap_comm=overlap,
         )
         self.mlp_up = tp_lib.ColumnParallelLinear(
             c.hidden_size, c.ffn, tp_size=c.tp_size, axis_name=axis,
-            sequence_parallel=sp, seq_dim=1,
+            sequence_parallel=sp, seq_dim=1, overlap_comm=overlap,
         )
         self.mlp_down = tp_lib.RowParallelLinear(
             c.ffn, c.hidden_size, tp_size=c.tp_size, axis_name=axis,
-            sequence_parallel=sp, seq_dim=1,
+            sequence_parallel=sp, seq_dim=1, overlap_comm=overlap,
         )
 
     # --- params ---------------------------------------------------------------
@@ -274,6 +307,8 @@ class GPTModel:
             if self.axis is not None:
                 k0 = jax.random.fold_in(k0, jax.lax.axis_index(self.axis))
             seed = seed_from_key(k0)
+        if use_flash and self.overlap:
+            return self._attention_tp_overlap(p, x, drop, seed)
         if use_flash:
             xg = self.qkv.gather_input(x)             # (b, s, H) full seq
             s_len = xg.shape[1]
@@ -423,6 +458,41 @@ class GPTModel:
         # Output projection contracted directly over (heads, d) — no
         # transpose back to (b, s, h*d) (RowParallelLinear.headwise).
         return self.attn_out.headwise(p["attn_out"], ctx)
+
+    def _attention_tp_overlap(self, p, x, drop, seed):
+        """The flash attention block with the TP boundary collectives fused
+        into ring collective matmuls (``ops.collective_matmul``): the
+        packed QKV projection rides the ag→matmul ring (SP) or the plain
+        local GEMM with an overlapped-psum backward (copy_matmul), and the
+        output projection the matmul→reduce-scatter / matmul→all-reduce
+        ring — no blocking all-gather of the activation anywhere in the
+        block, forward or backward. The weight packing is the same
+        (q-heads | k-heads | v-heads) feature order every other path uses,
+        so ``shard_params_for_tp`` shards are shared with the blocking
+        oracle."""
+        c = self.config
+        h, hkv, d = c.local_heads, c.local_kv_heads, c.head_dim
+        from apex_tpu.amp.lists import apply_op_rules
+        from apex_tpu.ops import collective_matmul as cm
+        xc, w_qkv, b_qkv, w_out = apply_op_rules(
+            "attention", x, p["qkv"]["weight"], p["qkv"].get("bias"),
+            p["attn_out"]["weight"])
+        proj = cm.all_gather_matmul if self.sp else cm.copy_matmul
+        y = proj(xc, w_qkv, axis_name=self.axis, seq_dim=1)
+        if b_qkv is not None:
+            y = y + b_qkv
+        b_sz, s_len = y.shape[0], y.shape[1]
+        q = y[..., :h * d].reshape(b_sz, s_len, h, d)
+        k = y[..., h * d:(h + hkv) * d].reshape(b_sz, s_len, hkv, d)
+        v = y[..., (h + hkv) * d:].reshape(b_sz, s_len, hkv, d)
+        ctx = flash_attention(q, k, v, causal=True, layout="bshd",
+                              dropout_rate=drop, dropout_seed=seed)
+        epi = cm.matmul_reduce_scatter if self.sp else cm.matmul_all_reduce
+        out = epi(ctx.reshape(b_sz, s_len, h * d), w_out,
+                  axis_name=self.axis, seq_dim=1)
+        if "bias" in p["attn_out"]:
+            out = out + p["attn_out"]["bias"]
+        return out
 
     def _mlp(self, p, x):
         if self.moe:
@@ -854,6 +924,16 @@ import functools as _functools
 @_functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
 def _sp_scatter_seq1(x, axis_name):
     size = jax.lax.axis_size(axis_name)
+    if x.shape[1] % size:
+        # a flooring chunk would silently DROP the trailing tokens from
+        # every rank's shard (and the backward gather would rebuild the
+        # wrong length deep inside XLA) — fail at trace time, naming the
+        # knob
+        raise ValueError(
+            f"GPTConfig(sequence_parallel=True): sequence length "
+            f"{x.shape[1]} is not divisible by the {axis_name!r} axis "
+            f"size {size} — the SP residual stream shards the sequence "
+            f"per tp rank; pad the sequence to a multiple of {size}")
     rank = jax.lax.axis_index(axis_name)
     chunk = x.shape[1] // size
     return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=1)

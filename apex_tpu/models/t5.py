@@ -77,6 +77,13 @@ class T5Config:
     # the kernels' array-bias operand. Kept as the FALLBACK/ORACLE the
     # parity tests compare against; O(h·s²) HBM, unusable at long seq.
     relative_bias_impl: str = "bucketed"
+    # Mirror of GPTConfig.tp_overlap, validated here so the flag means
+    # the same thing across both model configs: this stack's block
+    # builders run their linears UNSHARDED (no tp axis — the enc-dec
+    # model parallelizes over dp/pp only), so there is no boundary
+    # collective to overlap and True is an eager config error rather
+    # than a silent no-op.
+    tp_overlap: bool = False
 
     def __post_init__(self):
         if self.attention_impl not in ("softmax", "flash"):
@@ -95,6 +102,13 @@ class T5Config:
             raise ValueError(
                 f"relative_bias_impl must be bucketed|materialized, got "
                 f"{self.relative_bias_impl!r}")
+        if self.tp_overlap:
+            raise ValueError(
+                "tp_overlap overlaps tensor-parallel boundary collectives "
+                "with the linears' GEMMs, and the enc-dec stack runs its "
+                "linears unsharded (dp/pp only — no tp axis, no boundary "
+                "collective to hide); set tp_overlap on GPTConfig, whose "
+                "Column/Row parallel linears carry the overlapped rings")
 
     @property
     def ffn(self) -> int:

@@ -50,6 +50,7 @@ from apex_tpu.monitor.registry import (  # noqa: F401
     emit_event,
     emit_longseq_bias,
     emit_meta,
+    emit_tp_overlap,
     enable,
     enable_from_env,
     enabled,

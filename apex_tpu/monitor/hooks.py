@@ -183,6 +183,17 @@ def tree_bytes(tree: PyTree) -> int:
     return total
 
 
+def count_traffic(kind: str, payload: PyTree, axis_name: str, *,
+                  count: int = 1) -> None:
+    """The ``enabled()``-guarded :func:`count_collective` +
+    :func:`tree_bytes` one-liner every instrumented collective call site
+    uses (mappings, the SP layers, the collective-matmul rings, pipeline
+    ``_rotate``) — one place to change if the counting contract grows."""
+    if enabled():
+        count_collective(kind, bytes=tree_bytes(payload), count=count,
+                         axis=axis_name)
+
+
 def count_collective(kind: str, *, bytes: int = 0, count: int = 1,
                      axis: str = "") -> None:
     """Counter hook for communication primitives (trace-time).

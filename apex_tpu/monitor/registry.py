@@ -253,6 +253,12 @@ class MetricsRegistry:
         tokens/s + HBM high-water."""
         return self._emit_status_record("longseq_bias", status, **fields)
 
+    def emit_tp_overlap(self, status: str, **fields) -> Dict[str, Any]:
+        """TP-overlap bench record (``bench.py --tp-overlap``):
+        ring-overlapped vs blocking boundary-collective tokens/s at
+        tp >= 2."""
+        return self._emit_status_record("tp_overlap", status, **fields)
+
     # -- step lifecycle ------------------------------------------------------
 
     def begin_step(self, step: Optional[int] = None) -> None:
@@ -433,6 +439,13 @@ def emit_longseq_bias(status: str, **fields) -> Optional[Dict[str, Any]]:
     r = _REGISTRY
     if r is not None:
         return r.emit_longseq_bias(status, **fields)
+    return None
+
+
+def emit_tp_overlap(status: str, **fields) -> Optional[Dict[str, Any]]:
+    r = _REGISTRY
+    if r is not None:
+        return r.emit_tp_overlap(status, **fields)
     return None
 
 

@@ -69,6 +69,7 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     gate_records = []
     decode_records = []
     longseq_records = []
+    tp_overlap_records = []
     schedule = None
     for rec in records:
         kind = rec.get("kind")
@@ -84,6 +85,8 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             decode_records.append(rec)
         elif kind == "longseq_bias":
             longseq_records.append(rec)
+        elif kind == "tp_overlap":
+            tp_overlap_records.append(rec)
         elif kind == "event" and rec.get("name") == "pipeline_schedule":
             schedule = rec
 
@@ -211,6 +214,12 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                               "vs_materialized", "hbm_peak_mb",
                               "hbm_peak_materialized_mb", "seq"))
 
+    if tp_overlap_records:
+        summary["tp_overlap"] = status_summary(
+            tp_overlap_records, ("tokens_per_s", "tokens_per_s_blocking",
+                                 "vs_blocking", "tp", "batch", "seq",
+                                 "spread_pct", "spread_pct_blocking"))
+
     if gate_records:
         summary["gates"] = [
             {"name": g.get("name"), "ok": g.get("ok"),
@@ -293,6 +302,21 @@ def render(summary: Dict[str, Any]) -> str:
             if lsb.get("skipped"):
                 parts.append("skipped: " + ", ".join(lsb["skipped"]))
             lines.append("  longseq-bias " + "   ".join(parts))
+    tpo = summary.get("tp_overlap")
+    if tpo:
+        if tpo.get("status") == "SKIP":
+            lines.append(f"  tp-overlap  SKIP({tpo.get('reason', '?')})")
+        else:
+            parts = []
+            if isinstance(tpo.get("tokens_per_s"), (int, float)):
+                parts.append(f"{tpo['tokens_per_s']:.1f} tok/s overlapped")
+            if isinstance(tpo.get("vs_blocking"), (int, float)):
+                parts.append(f"{tpo['vs_blocking']:.2f}x vs blocking")
+            if isinstance(tpo.get("tp"), (int, float)):
+                parts.append(f"tp={tpo['tp']:g}")
+            if tpo.get("skipped"):
+                parts.append("skipped: " + ", ".join(tpo["skipped"]))
+            lines.append("  tp-overlap  " + "   ".join(parts))
     for gate in summary.get("gates", []):
         skipped = (", skipped: " + ", ".join(gate["skipped"])
                    if gate["skipped"] else "")

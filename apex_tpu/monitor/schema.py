@@ -3,9 +3,11 @@ self-contained validator.
 
 One schema family covers every JSON artifact the repo emits:
 
-* monitor JSONL records (``kind`` ∈ meta/event/step/gate/decode) — the
-  stream written by :mod:`apex_tpu.monitor.registry` (``decode`` is the
-  serving-bench record ``bench.py --decode`` emits);
+* monitor JSONL records (``kind`` ∈ meta/event/step/gate/decode/
+  longseq_bias/tp_overlap) — the stream written by
+  :mod:`apex_tpu.monitor.registry` (``decode`` is the serving-bench
+  record ``bench.py --decode`` emits; ``tp_overlap`` the
+  ring-overlapped-vs-blocking record of ``bench.py --tp-overlap``);
 * ``BENCH_*.json``-style bench result objects (the line ``bench.py``
   prints);
 * the MULTICHIP gate record printed by ``__graft_entry__.dryrun_multichip``.
@@ -187,6 +189,42 @@ LONGSEQ_BIAS_SCHEMA = {
     "required": ["schema", "kind", "status"],
 }
 
+# TP-overlap bench record (`python bench.py --tp-overlap`): one fwd+bwd
+# train-pass throughput comparison between the ring-overlapped boundary
+# collectives (`tp_overlap=True` / `overlap_comm=True`) and the blocking
+# oracle, at tp >= 2. Same status semantics as `decode`/`longseq_bias`:
+# "OK" (real multichip TPU) engages the honesty rule; off-TPU (or a
+# single-chip host) the record is an explicit SKIP with a reason — the
+# smoke-scale measurements may ride along as finite fields, but a SKIP
+# record claims no speedup. Never nan in an OK line.
+TP_OVERLAP_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["tp_overlap"]},
+        "status": {"enum": ["OK", "SKIP"]},
+        "reason": {"type": "string"},  # required when status == "SKIP"
+        "tokens_per_s": _METRIC_VALUE,           # overlapped fwd+bwd
+        "tokens_per_s_blocking": _METRIC_VALUE,  # the blocking oracle
+        "vs_blocking": _METRIC_VALUE,            # overlapped / blocking
+        "tp": {"type": "integer"},
+        "batch": {"type": "integer"},
+        "seq": {"type": "integer"},
+        "sequence_parallel": {"type": "boolean"},
+        # spread over each run separately: vs_blocking is a ratio, so the
+        # blocking denominator's noise bar matters as much as the
+        # overlapped numerator's
+        "spread_pct": _METRIC_VALUE,
+        "spread_pct_blocking": _METRIC_VALUE,
+        "pass_times_ms": {"type": "array", "items": {"type": "number"}},
+        "pass_times_blocking_ms": {"type": "array",
+                                   "items": {"type": "number"}},
+        "config": {"type": "object"},
+        "backend": {"type": "string"},
+    },
+    "required": ["schema", "kind", "status"],
+}
+
 SCHEMAS_BY_KIND = {
     "step": STEP_SCHEMA,
     "meta": META_SCHEMA,
@@ -194,6 +232,7 @@ SCHEMAS_BY_KIND = {
     "gate": GATE_SCHEMA,
     "decode": DECODE_SCHEMA,
     "longseq_bias": LONGSEQ_BIAS_SCHEMA,
+    "tp_overlap": TP_OVERLAP_SCHEMA,
 }
 
 # --- minimal JSON-Schema subset validator ------------------------------------
@@ -291,7 +330,7 @@ def validate(record: Dict[str, Any],
     # the conditional half of the status contract (the emitter enforces it
     # too, but externally produced streams must not pass the validator
     # with a claim-free, reason-free skip)
-    if (record.get("kind") in ("decode", "longseq_bias")
+    if (record.get("kind") in ("decode", "longseq_bias", "tp_overlap")
             and record.get("status") == "SKIP"
             and not record.get("reason")):
         errors.append(

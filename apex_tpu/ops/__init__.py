@@ -48,3 +48,9 @@ from apex_tpu.ops.focal_loss import focal_loss  # noqa: F401
 from apex_tpu.ops.attention import (BucketedBias, flash_attention,  # noqa: F401
                                     ring_attention, ulysses_attention)
 from apex_tpu.ops.decode_attention import decode_attention  # noqa: F401
+from apex_tpu.ops.collective_matmul import (  # noqa: F401
+    all_gather_matmul,
+    copy_matmul,
+    matmul_all_reduce,
+    matmul_reduce_scatter,
+)

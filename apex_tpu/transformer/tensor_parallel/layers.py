@@ -51,12 +51,48 @@ def _default_init(key, shape, dtype):
 
 # --- sequence-parallel collectives (SP extension) -----------------------------
 
-def _sp_all_gather_seq(x, axis_name, seq_dim=0):
-    """Gather the sequence dim entering a TP matmul (Megatron-SP boundary)."""
+# trace-time collective accounting (cf. ``all_reduce_gradients``) — the
+# TP axis shows up in ``monitor report``'s collective traffic; one shim,
+# shared with the blocking mappings
+_count_collective = mappings._count_collective
+
+
+def _check_seq_axis(x, seq_dim, layer, op):
+    if not 0 <= seq_dim < x.ndim:
+        raise ValueError(
+            f"{layer}(sequence_parallel=True): seq_dim={seq_dim} is not an "
+            f"axis of the activation (shape {x.shape}) — the {op} would "
+            f"die inside XLA; pass seq_dim=0 for (s, b, h) or 1 for "
+            f"(b, s, h)")
+
+
+def _sp_all_gather_seq(x, axis_name, seq_dim=0, layer="ColumnParallelLinear"):
+    """Gather the sequence dim entering a TP matmul (Megatron-SP boundary).
+
+    ``x`` is this rank's sequence SHARD (any local length gathers); the
+    eager check here is the seq_dim itself — an out-of-range dim fails
+    deep inside XLA's tiled all-gather naming neither layer nor knob."""
+    _check_seq_axis(x, seq_dim, layer, "tiled all_gather")
+    _count_collective("all_gather", x, axis_name)
     return jax.lax.all_gather(x, axis_name, axis=seq_dim, tiled=True)
 
 
-def _sp_reduce_scatter_seq(x, axis_name, seq_dim=0):
+def _sp_reduce_scatter_seq(x, axis_name, seq_dim=0,
+                           layer="RowParallelLinear"):
+    """Reduce-scatter the sequence dim leaving a TP matmul. Validated
+    eagerly: an uneven ``tiled=True`` scatter otherwise fails deep inside
+    XLA with a shape error that names neither the layer nor the knob."""
+    _check_seq_axis(x, seq_dim, layer, "tiled psum_scatter")
+    size = jax.lax.axis_size(axis_name)
+    if x.shape[seq_dim] % size:
+        raise ValueError(
+            f"{layer}(sequence_parallel=True): sequence extent "
+            f"{x.shape[seq_dim]} (axis {seq_dim} of {x.shape}) is not "
+            f"divisible by the {axis_name!r} axis size {size} — the SP "
+            f"reduce-scatter splits the sequence per rank; pad the "
+            f"sequence to a multiple of {size} or disable "
+            f"sequence_parallel on this linear")
+    _count_collective("psum_scatter", x, axis_name)
     return jax.lax.psum_scatter(x, axis_name, scatter_dimension=seq_dim, tiled=True)
 
 
@@ -75,6 +111,18 @@ class ColumnParallelLinear:
     init_method: Callable = _default_init
     axis_name: str = mesh_lib.TENSOR_AXIS
     tp_size: int = 1
+    # ring-overlapped boundary collectives (ops.collective_matmul): with
+    # SP, the input all-gather becomes the bidirectional ag→matmul ring;
+    # without, the backward's dx psum rides copy_matmul's overlapped ring.
+    # The blocking path (False) is kept as the parity oracle.
+    overlap_comm: bool = False
+
+    def __post_init__(self):
+        if self.overlap_comm and self.gather_output:
+            raise ValueError(
+                "overlap_comm rides gather_output=False (the Megatron "
+                "Column→Row pairing); the output-gather boundary has no "
+                "overlapped form")
 
     @property
     def output_size_per_partition(self) -> int:
@@ -103,8 +151,16 @@ class ColumnParallelLinear:
         return mappings.copy_to_tensor_model_parallel_region(x, self.axis_name)
 
     def __call__(self, params: dict, x: jax.Array) -> jax.Array:
-        x = self.gather_input(x)
-        y = jnp.dot(x, params["weight"].T)
+        if self.overlap_comm and self.axis_name is not None and self.tp_size > 1:
+            from apex_tpu.ops import collective_matmul as cm
+
+            fn = (cm.all_gather_matmul if self.sequence_parallel
+                  else cm.copy_matmul)
+            y = fn(x, params["weight"], axis_name=self.axis_name,
+                   seq_dim=self.seq_dim)
+        else:
+            x = self.gather_input(x)
+            y = jnp.dot(x, params["weight"].T)
         if self.bias:
             y = y + params["bias"]
         if self.gather_output:
@@ -143,6 +199,10 @@ class RowParallelLinear:
     init_method: Callable = _default_init
     axis_name: str = mesh_lib.TENSOR_AXIS
     tp_size: int = 1
+    # ring-overlapped epilogue (ops.collective_matmul): with SP the
+    # matmul→reduce-scatter transpose ring, without the reduce-scatter
+    # ring + chunk all-gather. Blocking path kept as the parity oracle.
+    overlap_comm: bool = False
 
     @property
     def input_size_per_partition(self) -> int:
@@ -173,8 +233,16 @@ class RowParallelLinear:
     def __call__(self, params: dict, x: jax.Array) -> jax.Array:
         if not self.input_is_parallel:
             x = mappings.scatter_to_tensor_model_parallel_region(x, self.axis_name)
-        y = jnp.dot(x, params["weight"].T)
-        y = self.reduce_output(y)
+        if self.overlap_comm and self.axis_name is not None and self.tp_size > 1:
+            from apex_tpu.ops import collective_matmul as cm
+
+            fn = (cm.matmul_reduce_scatter if self.sequence_parallel
+                  else cm.matmul_all_reduce)
+            y = fn(x, params["weight"], axis_name=self.axis_name,
+                   seq_dim=self.seq_dim)
+        else:
+            y = jnp.dot(x, params["weight"].T)
+            y = self.reduce_output(y)
         if self.bias:
             y = y + params["bias"]
         return y

@@ -28,6 +28,22 @@ import jax.numpy as jnp
 from apex_tpu.parallel import mesh as mesh_lib
 
 
+def _count_collective(kind: str, x: jax.Array, axis_name: str) -> None:
+    """Trace-time collective accounting (the same hook
+    ``all_reduce_gradients`` and the pipeline ``_rotate`` use) — without
+    it the TP axis is invisible in ``monitor report``'s traffic line.
+    Lazy-import shim only; the counting contract lives in
+    ``monitor.hooks.count_traffic``."""
+    from apex_tpu.monitor import hooks as monitor_hooks
+
+    monitor_hooks.count_traffic(kind, x, axis_name)
+
+
+def _psum_counted(x: jax.Array, axis_name: str) -> jax.Array:
+    _count_collective("psum", x, axis_name)
+    return jax.lax.psum(x, axis_name)
+
+
 def _split_local(x: jax.Array, axis_name: str) -> jax.Array:
     """This rank's slice of the last dimension (mappings.py:79-90)."""
     size = jax.lax.axis_size(axis_name)
@@ -38,6 +54,7 @@ def _split_local(x: jax.Array, axis_name: str) -> jax.Array:
 
 def _gather_last(x: jax.Array, axis_name: str) -> jax.Array:
     """All-gather along the last dim (mappings.py:92-105)."""
+    _count_collective("all_gather", x, axis_name)
     return jax.lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
 
 
@@ -52,7 +69,7 @@ def _copy_fwd(x, axis_name):
 
 
 def _copy_bwd(axis_name, _, g):
-    return (jax.lax.psum(g, axis_name),)
+    return (_psum_counted(g, axis_name),)
 
 
 _copy_core.defvjp(lambda x, axis_name: _copy_fwd(x, axis_name), _copy_bwd)
@@ -67,11 +84,11 @@ def copy_to_tensor_model_parallel_region(x, axis_name=mesh_lib.TENSOR_AXIS):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
 def _reduce_core(x, axis_name):
-    return jax.lax.psum(x, axis_name)
+    return _psum_counted(x, axis_name)
 
 
 def _reduce_fwd(x, axis_name):
-    return jax.lax.psum(x, axis_name), None
+    return _psum_counted(x, axis_name), None
 
 
 def _reduce_bwd(axis_name, _, g):

@@ -155,6 +155,50 @@ _SLOW_OFF_TPU = {
     "tests/test_gpt_pipeline.py::TestScheduleFeatureMatrix::test_dropout[2]",
     "tests/test_attention.py::TestVarlenFastPath::test_packed_fused_varlen_matches_bshd",
     "tests/test_transformer_tp.py::TestColumnRowParallel::test_headwise_matches_flat_call",
+    # r7 (tp-overlap PR): the ring-overlap parity matrix joins tier-1, so
+    # the heaviest remaining tests with a cheaper tier-1 sibling move here
+    # (same rule as above — they still run under `-m ''` and on hardware):
+    # each row names the sibling that keeps the family covered in tier-1.
+    # (several of the un-jitted whales were instead made ~3-10x faster by
+    # jitting their interpret-mode grads — see test_attention/test_t5.)
+    "tests/test_t5.py::TestBucketedRelativeBias::test_bucketed_matches_materialized_flash",  # kernel-level: TestBucketedBias::test_kernel_fwd_bwd_vs_materialized
+    "tests/test_models.py::TestResNet::test_train_and_eval_modes",  # examples: test_dcgan_example; resnet fwd: TestResNet shape tests
+    "tests/test_moe.py::TestGPTMoE::test_gpt_moe_tp2_matches_tp1[True]",  # sibling [False] demoted in PR 2; dense parity: test_identical_experts_match_dense_gpt
+    "tests/test_inference.py::TestDecodeEngine::test_greedy_matches_teacher_forced_full_forward[None]",  # see GQA [2] row below
+    "tests/test_t5.py::TestRematPolicies::test_encode_only_matches_blocks",  # pipeline variant demoted in PR 2; policy parity: TestGPTAttentionAndRematVariants
+    "tests/test_t5.py::TestRelativePositionBias::test_relative_model_trains_and_bias_matters",  # parity: test_relative_flash_matches_softmax stays
+    "tests/test_permutation.py::TestSearch::test_exhaustive_finds_global_optimum",  # TestGreedyVsExhaustive stays tier-1
+    "tests/test_pipeline.py::TestInterleavedV3Uneven::test_v3_uneven_grads_match_serial",  # v=2/v=4 interleaved parity stays (TestPipelineSPMD fast rows)
+    "tests/test_examples.py::test_dcgan_example_o2",  # test_dcgan_example (O0) stays
+    "tests/test_t5.py::TestEncoderPadding::test_padded_matches_unpadded_softmax",  # flash sibling test_flash_matches_softmax_padded_grads stays
+    # r7 second pass: the full suite measured 997s on this host against the
+    # 870s tier-1 wall, so the heaviest remaining redundantly-covered rows
+    # move here too (same contract: `-m ''` and hardware still run them;
+    # each row names the sibling that keeps its family covered in tier-1):
+    "tests/test_inference.py::TestDecodeEngine::test_greedy_matches_teacher_forced_full_forward[2]",  # test_prefill_cache_matches_training_kv + test_decode_step_compiles_once + TestSampling::test_greedy_is_argmax stay
+    "tests/test_attention.py::TestRingAttention::test_grads_match_dense[True]",  # [False] grads + test_matches_dense_full_sequence[True] (causal fwd) stay
+    "tests/test_enc_dec_pipeline.py::TestEncDecPipeline::test_forward_matches_serial[1]",  # split [3] stays
+    "tests/test_enc_dec_pipeline.py::TestEncDecPipeline::test_forward_matches_serial[2]",  # split [3] stays
+    "tests/test_contrib.py::TestMultiheadAttn::test_fmha_varlen_cu_seqlens",  # kernel varlen: TestVarlenAttention::test_pallas_kernel_varlen_fwd_bwd stays
+    "tests/test_inference.py::TestDecodeRelativeBias::test_engine_threads_the_hook",  # test_kernel_matches_xla_and_flash_oracle stays
+    "tests/test_inference.py::TestDecodeEngine::test_sampled_generation_stays_in_topk_support",  # TestSampling::test_topk_restricts_support stays
+    "tests/test_docs.py::test_amp_worked_example_executes",  # test_training_guide_blocks_execute_in_order still executes every guide block
+    "tests/test_contrib.py::TestZeroHardening::test_zero_bf16_allgather_converges_close",  # test_zero_bf16_params_fp32_masters + test_zero_e5m2_allgather_converges stay
+    "tests/test_attention.py::TestBucketedBias::test_kernel_fwd_bwd_vs_materialized[False-True]",  # [True-False] + remaining combos stay
+    "tests/test_models.py::TestResNet::test_param_count_matches_torchvision",  # TestResNet shape tests stay
+    "tests/test_contrib.py::TestBottleneckConv::test_spatial_bottleneck_strided_matches_unsharded",  # unstrided test_spatial_bottleneck_matches_unsharded stays
+    "tests/test_attention.py::TestGroupedQueryAttention::test_bshd_layout_kernels_match_dense[4-4-128-False]",  # gqa ratios [4-1-128] and [4-2-128] stay
+    "tests/test_attention.py::TestGroupedQueryAttention::test_bshd_layout_kernels_match_dense[1-1-64-False]",  # gqa ratios [4-1-128] and [4-2-128] stay
+    "tests/test_attention.py::TestFlashBias::test_kernel_fwd_bwd_vs_dense[1-False]",  # [2-False]/[2-True] stay
+    "tests/test_t5.py::TestEncoderPadding::test_padded_matches_unpadded_flash",  # test_flash_matches_softmax_padded_grads stays
+    "tests/test_attention.py::TestCpDropout::test_ring_dropout_grads_match_autodiff",  # bshd sibling TestRingBshd::test_bshd_ring_dropout_grads_match_autodiff stays
+    "tests/test_models.py::TestGPT::test_remat_matches_no_remat",  # TestGPTAttentionAndRematVariants::test_remat_policies_identical_loss_and_grads stays
+    "tests/test_attention.py::TestRingBshd::test_bshd_ring_matches_flash[2]",  # [1] stays
+    "tests/test_attention.py::TestLseCarrierForms::test_sliced_vs_carrier_identical",  # bshd variant test_bshd_sliced_vs_carrier_identical stays
+    "tests/test_attention.py::TestGroupedQueryAttention::test_fused_qkv_attention_matches_composition[4-True]",  # [2-True] stays
+    "tests/test_contrib.py::TestTransducer::test_loss_grad_finite",  # test_loss_matches_brute_force (alignment-enumeration oracle) stays
+    "tests/test_attention.py::TestVarlenFastPath::test_bshd_kernel_varlen_matches_dense[2]",  # [1] + test_bert_varlen_rides_bshd_kernels stay
+    "tests/test_attention.py::TestFlashDropout::test_kernel_matches_dense_same_mask[False]",  # [True] stays
 }
 
 

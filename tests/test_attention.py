@@ -191,7 +191,8 @@ class TestGroupedQueryAttention:
         v = jr.normal(jr.fold_in(K, 6), (b, kvh, s, d))
         rep = hq // kvh
         with jax.default_matmul_precision("highest"):
-            o = flash_attention(q, k, v, causal=causal, impl="pallas")
+            o = jax.jit(lambda q, k, v: flash_attention(
+                q, k, v, causal=causal, impl="pallas"))(q, k, v)
             o_ref = dense_ref(q, jnp.repeat(k, rep, 1), jnp.repeat(v, rep, 1),
                               causal)
             np.testing.assert_allclose(o, o_ref, rtol=2e-5, atol=2e-5)
@@ -203,8 +204,8 @@ class TestGroupedQueryAttention:
                               causal)
                 return jnp.sum(jnp.cos(o))
 
-            g1 = jax.grad(f1, argnums=(0, 1, 2))(q, k, v)
-            g2 = jax.grad(f2, argnums=(0, 1, 2))(q, k, v)
+            g1 = jax.jit(jax.grad(f1, argnums=(0, 1, 2)))(q, k, v)
+            g2 = jax.jit(jax.grad(f2, argnums=(0, 1, 2)))(q, k, v)
         for a, e in zip(g1, g2):
             np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-4)
 
@@ -226,9 +227,10 @@ class TestGroupedQueryAttention:
                 q, k, v, impl="pallas").astype(jnp.float32))
 
         with jax.default_matmul_precision("highest"):
-            _, dk16, _ = jax.grad(loss, argnums=(0, 1, 2))(
+            _, dk16, _ = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(
                 to16(q32), to16(k32), to16(v32))
-            _, dk32, _ = jax.grad(loss, argnums=(0, 1, 2))(q32, k32, v32)
+            _, dk32, _ = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(
+                q32, k32, v32)
         err = jnp.max(jnp.abs(dk16.astype(jnp.float32) - dk32))
         # one bf16 rounding of the final sum: |err| <= ~2^-8 * |dk|;
         # bf16-rounded partials would accumulate ~sqrt(8) times that
@@ -794,10 +796,11 @@ class TestFlashDropout:
                 dropout_rate=self.RATE, dropout_seed=seed)))
             f2 = lambda q, k, v: jnp.sum(jnp.sin(self._dense_drop_ref(
                 q, k, v, causal, scale, seed, self.RATE)))
-            np.testing.assert_allclose(float(f1(q, k, v)),
-                                       float(f2(q, k, v)), rtol=1e-5)
-            g1 = jax.grad(f1, argnums=(0, 1, 2))(q, k, v)
-            g2 = jax.grad(f2, argnums=(0, 1, 2))(q, k, v)
+            np.testing.assert_allclose(float(jax.jit(f1)(q, k, v)),
+                                       float(jax.jit(f2)(q, k, v)),
+                                       rtol=1e-5)
+            g1 = jax.jit(jax.grad(f1, argnums=(0, 1, 2)))(q, k, v)
+            g2 = jax.jit(jax.grad(f2, argnums=(0, 1, 2)))(q, k, v)
         for a, e, n in zip(g1, g2, "qkv"):
             np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-5,
                                        err_msg=n)
@@ -813,8 +816,9 @@ class TestFlashDropout:
         rep = h // hkv
 
         with jax.default_matmul_precision("highest"):
-            o = flash_attention(q, k, v, causal=True, impl="pallas",
-                                dropout_rate=self.RATE, dropout_seed=seed)
+            o = jax.jit(lambda q, k, v: flash_attention(
+                q, k, v, causal=True, impl="pallas",
+                dropout_rate=self.RATE, dropout_seed=seed))(q, k, v)
             ref = self._dense_drop_ref(
                 q.reshape(b * h, s, d),
                 jnp.repeat(k, rep, 1).reshape(b * h, s, d),
@@ -1498,10 +1502,10 @@ class TestFlashBias:
             return bshd_output_projection(ctx, w_out, h, d).sum()
 
         with jax.default_matmul_precision("highest"):
-            ga = jax.grad(fused, (0, 1, 2, 3, 4))(x, w_qkv, b_qkv, w_out,
-                                                  bias)
-            gb = jax.grad(composed, (0, 1, 2, 3, 4))(x, w_qkv, b_qkv,
-                                                     w_out, bias)
+            ga = jax.jit(jax.grad(fused, (0, 1, 2, 3, 4)))(
+                x, w_qkv, b_qkv, w_out, bias)
+            gb = jax.jit(jax.grad(composed, (0, 1, 2, 3, 4)))(
+                x, w_qkv, b_qkv, w_out, bias)
         for a, e, n in zip(ga, gb, ["dx", "dw_qkv", "db_qkv", "dw_out",
                                     "dbias"]):
             np.testing.assert_allclose(a, e, rtol=2e-3, atol=2e-3,
@@ -1557,14 +1561,15 @@ class TestBucketedBias:
                 impl="xla")))
 
         with jax.default_matmul_precision("highest"):
-            o1 = flash_attention(q, k, v, causal=causal,
-                                 bias=self._bb(tab, bidir), impl="pallas")
+            o1 = jax.jit(lambda q, k, v, t: flash_attention(
+                q, k, v, causal=causal, bias=self._bb(t, bidir),
+                impl="pallas"))(q, k, v, tab)
             o2 = flash_attention(q, k, v, causal=causal,
                                  bias=self._bb(tab, bidir).materialize(s, s),  # apexlint: disable=APX304
                                  impl="xla")
             np.testing.assert_allclose(o1, o2, rtol=1e-4, atol=1e-4)
-            g1 = jax.grad(bucketed, (0, 1, 2, 3))(q, k, v, tab)
-            g2 = jax.grad(oracle, (0, 1, 2, 3))(q, k, v, tab)
+            g1 = jax.jit(jax.grad(bucketed, (0, 1, 2, 3)))(q, k, v, tab)
+            g2 = jax.jit(jax.grad(oracle, (0, 1, 2, 3)))(q, k, v, tab)
         for a, e, n in zip(g1, g2, ["dq", "dk", "dv", "dtable"]):
             np.testing.assert_allclose(a, e, rtol=5e-4, atol=5e-4,
                                        err_msg=n)
@@ -1591,8 +1596,8 @@ class TestBucketedBias:
             return f
 
         with jax.default_matmul_precision("highest"):
-            g1 = jax.grad(make("pallas"), (0, 1, 2, 3))(q, k, v, tab)
-            g2 = jax.grad(make("xla"), (0, 1, 2, 3))(q, k, v, tab)
+            g1 = jax.jit(jax.grad(make("pallas"), (0, 1, 2, 3)))(q, k, v, tab)
+            g2 = jax.jit(jax.grad(make("xla"), (0, 1, 2, 3)))(q, k, v, tab)
         for a, e, n in zip(g1, g2, ["dq", "dk", "dv", "dtable"]):
             np.testing.assert_allclose(a, e, rtol=2e-3, atol=2e-3,
                                        err_msg=n)
@@ -1700,13 +1705,13 @@ class TestBucketedBias:
                                   for x in (q, k, v))
                 else:
                     qs, ks, vs = q, k, v
-                g = mesh_lib.shard_map(
+                g = jax.jit(mesh_lib.shard_map(
                     lambda q, k, v, t: jax.grad(
                         ring_loss, argnums=(0, 1, 2, 3))(q, k, v, t),
                     mesh=mesh, in_specs=(spec,) * 3 + (P(),),
                     out_specs=(spec,) * 3 + (P(),),
-                )(qs, ks, vs, tab)
-                gref = jax.grad(flash_loss, argnums=(0, 1, 2, 3))(
+                ))(qs, ks, vs, tab)
+                gref = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2, 3)))(
                     q, k, v, tab)
             for i, (a, e, n) in enumerate(
                     zip(g, gref, ["dq", "dk", "dv", "dtable"])):
@@ -1740,13 +1745,14 @@ class TestBucketedBias:
 
         spec = P(None, "cp")
         with jax.default_matmul_precision("highest"):
-            g = mesh_lib.shard_map(
+            g = jax.jit(mesh_lib.shard_map(
                 lambda q, k, v, t: jax.grad(
                     u_loss, argnums=(0, 1, 2, 3))(q, k, v, t),
                 mesh=mesh, in_specs=(spec,) * 3 + (P(),),
                 out_specs=(spec,) * 3 + (P(),),
-            )(q, k, v, tab)
-            gref = jax.grad(f_loss, argnums=(0, 1, 2, 3))(q, k, v, tab)
+            ))(q, k, v, tab)
+            gref = jax.jit(jax.grad(f_loss, argnums=(0, 1, 2, 3)))(
+                q, k, v, tab)
         for a, e, n in zip(g, gref, ["dq", "dk", "dv", "dtable"]):
             np.testing.assert_allclose(a, e, rtol=2e-3, atol=2e-3,
                                        err_msg=n)

@@ -211,6 +211,20 @@ def f(q, k, v, table):
         bias=BucketedBias(table, bidirectional=True, max_distance=128))
 """,
     ),
+    "APX403": (
+        """
+import jax
+import jax.numpy as jnp
+def f(x, w):
+    xg = jax.lax.all_gather(x, "tp", axis=0, tiled=True)
+    return jnp.dot(xg, w.T)
+""",
+        """
+from apex_tpu.ops.collective_matmul import all_gather_matmul
+def f(x, w):
+    return all_gather_matmul(x, w, axis_name="tp", seq_dim=0)
+""",
+    ),
     "APX401": (
         """
 import jax
@@ -1032,4 +1046,79 @@ def f(q, k, v, t, s):
 """
         findings, suppressed = lint.lint_source(src, path="apex_tpu/x.py")
         assert "APX304" not in {f.code for f in findings}
+        assert suppressed == 1
+
+
+class TestAPX403BlockingCollectiveMatmul:
+    """Beyond the fixture pair: both directions of the pattern, the
+    einsum sink, taint through name hops, and the idioms that must stay
+    clean (the blocking oracle keeps its gather and matmul in separate
+    functions; a psum_scatter of a non-matmul value is not the pattern)."""
+
+    def test_matmul_into_psum_scatter(self):
+        src = """
+import jax
+import jax.numpy as jnp
+def f(x, w):
+    y = jnp.dot(x, w.T)
+    return jax.lax.psum_scatter(y, "tp", scatter_dimension=0, tiled=True)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX403" in {f.code for f in findings}
+
+    def test_gather_into_einsum_through_name_hop(self):
+        src = """
+import jax
+import jax.numpy as jnp
+def f(x, w):
+    xg = jax.lax.all_gather(x, "tp", axis=1, tiled=True)
+    xx = xg * 2.0
+    return jnp.einsum("bsh,oh->bso", xx, w)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX403" in {f.code for f in findings}
+
+    def test_direct_nesting_fires(self):
+        src = """
+import jax
+import jax.numpy as jnp
+def f(x, w):
+    return jnp.matmul(jax.lax.all_gather(x, "tp", tiled=True), w)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX403" in {f.code for f in findings}
+
+    def test_gather_and_matmul_in_separate_scopes_clean(self):
+        # the blocking oracle's shape: _sp_all_gather_seq returns the
+        # gather, the dot lives in __call__ — separate taint scopes
+        src = """
+import jax
+import jax.numpy as jnp
+def gather(x):
+    return jax.lax.all_gather(x, "tp", axis=0, tiled=True)
+def matmul(xg, w):
+    return jnp.dot(xg, w.T)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX403" not in {f.code for f in findings}
+
+    def test_psum_scatter_of_non_matmul_clean(self):
+        src = """
+import jax
+def f(g):
+    return jax.lax.psum_scatter(g, "tp", scatter_dimension=0, tiled=True)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX403" not in {f.code for f in findings}
+
+    def test_inline_suppression(self):
+        src = """
+import jax
+import jax.numpy as jnp
+def f(x, w):
+    xg = jax.lax.all_gather(x, "tp", axis=0, tiled=True)
+    return jnp.dot(xg, w.T)  # apexlint: disable=APX403
+"""
+        findings, suppressed = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX403" not in {f.code for f in findings}
         assert suppressed == 1

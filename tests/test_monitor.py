@@ -497,6 +497,169 @@ class TestGateReporting:
         assert " OK" not in capsys.readouterr().out
 
 
+class TestTPCollectiveCounts:
+    """ISSUE 5 satellite: the tensor-parallel mappings/layers collectives
+    emit ``count_collective`` (bytes + axis) like ``all_reduce_gradients``
+    and the pipeline ``_rotate`` already do — the tp axis shows up in
+    ``monitor report``'s collective traffic line. Counting is trace-time:
+    one un-jitted shard_map call registers the counters."""
+
+    def _mesh(self):
+        from apex_tpu.parallel import mesh as mesh_lib
+        return mesh_lib.make_mesh(tensor_model_parallel_size=4)
+
+    def test_sp_layer_collectives_counted(self, registry):
+        import jax.random as jr
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.parallel import mesh as mesh_lib
+        from apex_tpu.transformer import tensor_parallel as tp_lib
+
+        reg, _ = registry
+        mesh = self._mesh()
+        col = tp_lib.ColumnParallelLinear(8, 16, tp_size=4, bias=False,
+                                          sequence_parallel=True)
+        row = tp_lib.RowParallelLinear(16, 8, tp_size=4, bias=False,
+                                       sequence_parallel=True)
+        x = jr.normal(jr.PRNGKey(0), (4, 2, 8))
+        wc = jr.normal(jr.PRNGKey(1), (16, 8))
+        wr = jr.normal(jr.PRNGKey(2), (8, 16))
+        mesh_lib.shard_map(
+            lambda x, wc, wr: row({"weight": wr}, col({"weight": wc}, x)),
+            mesh=mesh,
+            in_specs=(P("tp"), P("tp", None), P(None, "tp")),
+            out_specs=P("tp"),
+        )(x, wc, wr)
+        c = reg.counters
+        assert c.get("collective/all_gather[tp]_calls", 0) >= 1
+        assert c.get("collective/all_gather[tp]_bytes", 0) > 0
+        assert c.get("collective/psum_scatter[tp]_calls", 0) >= 1
+        assert c.get("collective/psum_scatter[tp]_bytes", 0) > 0
+
+    def test_mappings_psum_counted(self, registry):
+        import jax
+        import jax.random as jr
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.parallel import mesh as mesh_lib
+        from apex_tpu.transformer import tensor_parallel as tp_lib
+
+        reg, _ = registry
+        mesh = self._mesh()
+        x = jr.normal(jr.PRNGKey(3), (4, 8))
+
+        def f(x):
+            # forward psum (reduce_from) + backward psum (copy_to's VJP)
+            y = tp_lib.reduce_from_tensor_model_parallel_region(x, "tp")
+            return jax.grad(lambda x: (
+                tp_lib.copy_to_tensor_model_parallel_region(x, "tp") ** 2
+            ).sum())(y)
+
+        mesh_lib.shard_map(f, mesh=mesh, in_specs=P(), out_specs=P())(x)
+        assert reg.counters.get("collective/psum[tp]_calls", 0) >= 2
+
+    def test_overlap_ring_ppermute_counted(self, registry):
+        import jax.random as jr
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.parallel import mesh as mesh_lib
+        from apex_tpu.transformer import tensor_parallel as tp_lib
+
+        reg, _ = registry
+        mesh = self._mesh()
+        col = tp_lib.ColumnParallelLinear(8, 16, tp_size=4, bias=False,
+                                          sequence_parallel=True,
+                                          overlap_comm=True)
+        x = jr.normal(jr.PRNGKey(4), (4, 2, 8))
+        wc = jr.normal(jr.PRNGKey(5), (16, 8))
+        mesh_lib.shard_map(
+            lambda x, wc: col({"weight": wc}, x), mesh=mesh,
+            in_specs=(P("tp"), P("tp", None)), out_specs=P("tp"))(x, wc)
+        c = reg.counters
+        # tp=4 bidirectional ag ring: 2 fwd + 1 bwd ppermute steps
+        assert c.get("collective/ppermute[tp]_calls", 0) >= 3
+        assert c.get("collective/ppermute[tp]_bytes", 0) > 0
+        # the overlapped path replaced the blocking gather entirely
+        assert "collective/all_gather[tp]_calls" not in c
+
+
+class TestTPOverlapRecords:
+    """The ``tp_overlap`` bench record (``bench.py --tp-overlap``):
+    overlapped vs blocking boundary collectives — same status/honesty
+    contract as the decode and longseq_bias records."""
+
+    def test_emit_roundtrip_and_validation(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        monitor.enable(str(path))
+        try:
+            rec = monitor.emit_tp_overlap(
+                "OK", tokens_per_s=61000.0, tokens_per_s_blocking=52000.0,
+                vs_blocking=1.173, tp=4, batch=8, seq=1024,
+                sequence_parallel=True, spread_pct=0.4,
+                pass_times_ms=[134.2, 134.5, 134.9], backend="tpu")
+            assert monitor.validate(rec) == []
+        finally:
+            monitor.disable()
+        assert monitor.validate_jsonl(path.read_text().splitlines()) == []
+
+    def test_ok_with_nan_refused_and_skip_needs_reason(self):
+        reg = monitor.MetricsRegistry()
+        with pytest.raises(ValueError, match="non-finite"):
+            reg.emit_tp_overlap("OK", tokens_per_s=float("nan"))
+        with pytest.raises(ValueError, match="reason"):
+            reg.emit_tp_overlap("SKIP")
+        rec = reg.emit_tp_overlap(
+            "SKIP", reason="cpu smoke run",
+            vs_blocking=("skipped", "cpu smoke run"))
+        assert rec["vs_blocking"] == {"skipped": True,
+                                      "reason": "cpu smoke run"}
+        assert monitor.validate(rec) == []
+        # the validator enforces the reason on external streams too
+        bare = {k: v for k, v in rec.items() if k != "reason"}
+        assert any("reason" in e for e in monitor.validate(bare))
+
+    def test_report_aggregates_and_renders(self):
+        reg = monitor.MetricsRegistry()
+        ok = reg.emit_tp_overlap(
+            "OK", tokens_per_s=61000.0, tokens_per_s_blocking=52000.0,
+            vs_blocking=1.173, tp=4, batch=8, seq=1024)
+        summary = monitor_report.aggregate([ok])
+        assert summary["tp_overlap"]["vs_blocking"] == 1.173
+        text = monitor_report.render(summary)
+        assert "tp-overlap" in text and "1.17x vs blocking" in text
+        skip = reg.emit_tp_overlap("SKIP", reason="no TPU")
+        text = monitor_report.render(monitor_report.aggregate([skip]))
+        assert "tp-overlap  SKIP(no TPU)" in text
+
+
+@pytest.mark.slow
+class TestTPOverlapBenchLeg:
+    def test_bench_tp_overlap_emits_valid_skip_record_off_tpu(
+            self, tmp_path):
+        """The tp-overlap leg end-to-end at smoke scale: off-TPU it runs
+        both impls on the virtual mesh and must print/emit an explicit
+        SKIP record — schema-valid, no nan — that the validator CLI
+        accepts."""
+        import subprocess
+        root = os.path.join(os.path.dirname(__file__), "..")
+        path = tmp_path / "tpoverlap.jsonl"
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   APEX_TPU_MONITOR=str(path))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "bench.py"),
+             "--tp-overlap"],
+            capture_output=True, text=True, env=env, cwd=root, timeout=600)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        record = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert record["kind"] == "tp_overlap"
+        assert record["status"] == "SKIP" and record["reason"]
+        assert record["tokens_per_s"] > 0
+        assert record["tokens_per_s_blocking"] > 0
+        assert monitor.validate(record) == []
+        tool = _load_validate_tool()
+        assert tool.main([str(path)]) == 0
+
+
 class TestLongseqBiasRecords:
     """The ``longseq_bias`` bench record (``bench.py --longseq-bias``):
     in-kernel bucketed bias vs the materialized baseline — same status/

@@ -258,10 +258,10 @@ class TestRelativePositionBias:
                          attention_impl=impl))
             for impl in ("softmax", "flash")}
         with jax.default_matmul_precision("highest"):
-            l_soft, g_soft = jax.value_and_grad(
-                models["softmax"].loss_fn)(p, enc, dec, tgt)
-            l_flash, g_flash = jax.value_and_grad(
-                models["flash"].loss_fn)(p, enc, dec, tgt)
+            l_soft, g_soft = jax.jit(jax.value_and_grad(
+                models["softmax"].loss_fn))(p, enc, dec, tgt)
+            l_flash, g_flash = jax.jit(jax.value_and_grad(
+                models["flash"].loss_fn))(p, enc, dec, tgt)
         np.testing.assert_allclose(float(l_soft), float(l_flash),
                                    rtol=1e-5)
         jax.tree_util.tree_map_with_path(
@@ -523,8 +523,8 @@ class TestBucketedRelativeBias:
             m = EncoderDecoderModel(cfg)
             p = m.init(K)
             with jax.default_matmul_precision("highest"):
-                return jax.value_and_grad(
-                    lambda p: m.loss_fn(p, enc, dec, tgt))(p)
+                return jax.jit(jax.value_and_grad(
+                    lambda p: m.loss_fn(p, enc, dec, tgt)))(p)
 
         l_b, g_b = loss_and_grads("bucketed")
         l_m, g_m = loss_and_grads("materialized")

@@ -11,10 +11,12 @@ Dispatch is by content, not extension:
 * ``.jsonl`` files (or any file whose first non-blank line parses as a
   JSON object with a ``kind``) validate as a monitor event stream against
   :mod:`apex_tpu.monitor.schema` — including ``decode`` serving-bench
-  records (``python bench.py --decode``) and ``longseq_bias`` records
+  records (``python bench.py --decode``), ``longseq_bias`` records
   (``python bench.py --longseq-bias``: in-kernel bucketed bias vs the
-  materialized baseline), whose ``status: "OK"`` engages the same no-nan
-  honesty rule as gates (and whose SKIP must carry a reason);
+  materialized baseline) and ``tp_overlap`` records (``python bench.py
+  --tp-overlap``: ring-overlapped vs blocking TP boundary collectives),
+  whose ``status: "OK"`` engages the same no-nan honesty rule as gates
+  (and whose SKIP must carry a reason);
 * bench result objects (``{"metric": ..., "value": ...}``) validate
   against the BENCH schema;
 * driver wrappers are unwrapped: ``{"parsed": {...}}`` (BENCH_r*.json)
