@@ -40,6 +40,7 @@ import jax.numpy as jnp
 
 from apex_tpu.inference.sampling import sample_logits
 from apex_tpu.models.gpt import GPTModel
+from apex_tpu.monitor import spans as monitor_spans
 from apex_tpu.ops import fused_layer_norm
 
 
@@ -104,6 +105,10 @@ class DecodeEngine:
         last-position logits (b, V)). The forward is the training block
         structure (flash attention over the full prompt) with each layer's
         k/v exposed — cache contents ARE the training forward's k/v."""
+        with monitor_spans.span("decode_prefill"):
+            return self._prefill_body(params, tokens, key)
+
+    def _prefill_body(self, params, tokens, key):
         model, c = self.model, self.config
         b, s = tokens.shape
         x = model.embedding(params["embedding"], tokens)
@@ -135,6 +140,14 @@ class DecodeEngine:
         and sample position ``pos+1``'s tokens. Returns (cache, next
         tokens, logits). Avals are independent of ``pos``: compiled
         exactly once per (batch, cache shape)."""
+        # trace-time step-anatomy span: every HLO of the decode step
+        # carries the decode_step scope into device traces (the join key
+        # `monitor report --anatomy` correlates on); no-op when
+        # monitoring is off, and never touches the zero-recompile avals
+        with monitor_spans.span("decode_step"):
+            return self._decode_step_body(params, cache, tokens, pos, key)
+
+    def _decode_step_body(self, params, cache, tokens, pos, key):
         model, c = self.model, self.config
         b = tokens.shape[0]
         pos = jnp.asarray(pos, jnp.int32)

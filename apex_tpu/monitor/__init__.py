@@ -14,6 +14,10 @@ layer:
   paths: AMP scaler, optimizers (grad/update norms), pipeline schedules
   (geometry + bubble fraction), collectives (count + bytes per traced
   step);
+* :mod:`~apex_tpu.monitor.spans` — step-anatomy spans: host enter/exit
+  timestamps + the ``jax.named_scope`` join key into device traces
+  (``prof.trace_reader`` correlates the two and ``monitor report
+  --anatomy`` prints the per-step breakdown);
 * :mod:`~apex_tpu.monitor.schema` — JSON schemas + validator shared by
   the monitor stream, ``bench.py`` artifacts and the multichip gate
   (``tools/validate_metrics.py`` is the CLI);
@@ -50,6 +54,7 @@ from apex_tpu.monitor.registry import (  # noqa: F401
     emit_event,
     emit_longseq_bias,
     emit_meta,
+    emit_profile,
     emit_tp_overlap,
     enable,
     enable_from_env,
@@ -70,6 +75,7 @@ from apex_tpu.monitor.hooks import (  # noqa: F401
     record_pipeline_schedule,
     tree_bytes,
 )
+from apex_tpu.monitor.spans import collective_span, span, span_path  # noqa: F401
 from apex_tpu.monitor.schema import gate_metrics, validate, validate_jsonl  # noqa: F401
 from apex_tpu.monitor.report import (  # noqa: F401
     PEAK_FLOPS_BY_DEVICE,

@@ -259,6 +259,12 @@ class MetricsRegistry:
         tp >= 2."""
         return self._emit_status_record("tp_overlap", status, **fields)
 
+    def emit_profile(self, status: str, **fields) -> Dict[str, Any]:
+        """Step-anatomy profile record (``bench.py --profile``): spans +
+        device trace fused into the per-step compute/collective/bubble/
+        host-gap breakdown plus the calibrated CostDB artifact."""
+        return self._emit_status_record("profile", status, **fields)
+
     # -- step lifecycle ------------------------------------------------------
 
     def begin_step(self, step: Optional[int] = None) -> None:
@@ -446,6 +452,13 @@ def emit_tp_overlap(status: str, **fields) -> Optional[Dict[str, Any]]:
     r = _REGISTRY
     if r is not None:
         return r.emit_tp_overlap(status, **fields)
+    return None
+
+
+def emit_profile(status: str, **fields) -> Optional[Dict[str, Any]]:
+    r = _REGISTRY
+    if r is not None:
+        return r.emit_profile(status, **fields)
     return None
 
 

@@ -334,15 +334,46 @@ def main(argv: Optional[List[str]] = None) -> int:
     rep.add_argument("path", help="events.jsonl produced with monitoring on")
     rep.add_argument("--json", action="store_true",
                      help="print the summary as one JSON object")
+    rep.add_argument("--anatomy", action="store_true",
+                     help="per-step anatomy (% compute / collective-exposed"
+                          " / bubble / host gap per device) from the span "
+                          "stream joined with a jax.profiler trace")
+    rep.add_argument("--trace", metavar="LOGDIR",
+                     help="profiler log dir to join spans against "
+                          "(required with --anatomy)")
     args = parser.parse_args(argv)
 
     with open(args.path) as fh:
         records = read_records(fh)
     summary = aggregate(records)
+
+    anatomy_rows = None
+    if args.anatomy:
+        if not args.trace:
+            parser.error("--anatomy needs --trace LOGDIR (the directory "
+                         "passed to jax.profiler.start_trace)")
+        # the join lives in prof (it reads chrome traces); imported lazily
+        # so the plain report never pays for it
+        from apex_tpu.prof import trace_reader
+
+        spans = [r for r in records if r.get("kind") == "span"]
+        try:
+            events = trace_reader.read_trace(args.trace)
+        except FileNotFoundError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        anatomy_rows = trace_reader.step_anatomy(spans, events)
+        summary["anatomy"] = anatomy_rows
+
     if args.json:
         print(json.dumps(summary))
     else:
         print(render(summary))
+        if anatomy_rows is not None:
+            from apex_tpu.prof.trace_reader import format_anatomy
+
+            print("step anatomy (% of step wall):")
+            print(format_anatomy(anatomy_rows))
     return 0
 
 

@@ -225,6 +225,124 @@ TP_OVERLAP_SCHEMA = {
     "required": ["schema", "kind", "status"],
 }
 
+# span record (monitor.spans.span): one host enter/exit window per
+# instrumented region. ``name`` is the /-joined path of nested spans —
+# the named-scope prefix device-trace ops carry, i.e. the host↔device
+# join key. ``traced: true`` marks spans recorded while JAX traced (host
+# times then measure tracing, not execution; consumers use the path and
+# the collective attrs ``coll``/``axis``/``bytes`` only).
+SPAN_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["span"]},
+        "name": {"type": "string"},
+        "t0_ns": {"type": "integer"},
+        "dur_ns": {"type": "integer"},
+        "traced": {"type": "boolean"},
+        "coll": {"type": "string"},    # collective kind (psum, ppermute, …)
+        "axis": {"type": "string"},    # mesh axis the collective rides
+        "bytes": {"type": "integer"},  # static payload size per execution
+        "step": {"type": "integer"},
+    },
+    "required": ["schema", "kind", "name", "t0_ns", "dur_ns"],
+}
+
+# step-anatomy profile record (`python bench.py --profile`): spans +
+# jax.profiler trace fused into the per-step breakdown and a calibrated
+# CostDB artifact. Same status semantics as decode/longseq_bias: "OK"
+# (real TPU trace with per-HLO device events) engages the honesty rule;
+# off-TPU the chrome trace is host-only, so the record is an explicit
+# SKIP with the smoke wall-times riding along — never nan in an OK line.
+PROFILE_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["profile"]},
+        "status": {"enum": ["OK", "SKIP"]},
+        "reason": {"type": "string"},  # required when status == "SKIP"
+        "steps": {"type": "integer"},          # timed step spans captured
+        "compute_pct": _METRIC_VALUE,          # of step wall, mean
+        "collective_exposed_pct": _METRIC_VALUE,
+        "bubble_pct": _METRIC_VALUE,           # device idle inside the step
+        "host_gap_pct": _METRIC_VALUE,         # wall not covered by device
+        "step_wall_ms": _METRIC_VALUE,         # mean host step-span wall
+        "tokens_per_s": _METRIC_VALUE,
+        "costdb_collective_rows": {"type": "integer"},
+        "costdb_gemm_classes": {"type": "integer"},
+        "costdb_path": {"type": "string"},
+        "timeline_path": {"type": "string"},
+        "trace_dir": {"type": "string"},
+        "span_records": {"type": "integer"},
+        "config": {"type": "object"},
+        "backend": {"type": "string"},
+    },
+    "required": ["schema", "kind", "status"],
+}
+
+# the CostDB artifact (prof.calibrate.build_costdb): measured spans +
+# counted-bytes hooks distilled into achieved bytes/s per collective
+# (kind × axis × power-of-two size bucket) and achieved FLOP/s per GEMM
+# shape-class — what the auto-parallelism planner (ROADMAP item 2)
+# consumes. A standalone JSON artifact, not an emitter record, but it
+# dispatches through the same kind-keyed validator so
+# `tools/validate_metrics.py --costdb` gates it like bench/gate records.
+_COSTDB_STAT = {
+    "type": "object",
+    "properties": {
+        "n": {"type": "integer"},         # samples folded into the row
+        "mean": {"type": "number"},
+        "min": {"type": "number"},
+        "max": {"type": "number"},
+        "spread_pct": {"type": "number"},  # (max-min)/min over samples
+    },
+    "required": ["n", "mean", "min", "max", "spread_pct"],
+}
+
+COSTDB_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "schema": {"enum": [SCHEMA_VERSION]},
+        "kind": {"enum": ["costdb"]},
+        "device_kind": {"type": "string"},
+        "backend": {"type": "string"},
+        "source": {"type": "string"},  # spans | counters (which join built it)
+        "collectives": {
+            "type": "object",
+            # key "<kind>[<axis>]" -> list of size-bucket rows
+            "additionalProperties": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "properties": {
+                        "bucket_bytes": {"type": "integer"},  # 2^k floor
+                        "bytes": _COSTDB_STAT,        # payload per execution
+                        "bytes_per_s": _COSTDB_STAT,  # achieved bandwidth
+                    },
+                    "required": ["bucket_bytes", "bytes_per_s"],
+                },
+            },
+        },
+        "gemms": {
+            "type": "object",
+            # key: shape-class label (power-of-two FLOPs decade)
+            "additionalProperties": {
+                "type": "object",
+                "properties": {
+                    "flops_per_s": _COSTDB_STAT,  # achieved
+                    "predicted_flops_per_s": {
+                        "anyOf": [{"type": "number"}, {"type": "null"}]},
+                },
+                "required": ["flops_per_s"],
+            },
+        },
+        "predicted_flops_per_s": {
+            # whole-program XLA cost-model rate (flops / optimal_seconds)
+            "anyOf": [{"type": "number"}, {"type": "null"}]},
+    },
+    "required": ["schema", "kind", "collectives", "gemms"],
+}
+
 SCHEMAS_BY_KIND = {
     "step": STEP_SCHEMA,
     "meta": META_SCHEMA,
@@ -233,6 +351,9 @@ SCHEMAS_BY_KIND = {
     "decode": DECODE_SCHEMA,
     "longseq_bias": LONGSEQ_BIAS_SCHEMA,
     "tp_overlap": TP_OVERLAP_SCHEMA,
+    "span": SPAN_SCHEMA,
+    "profile": PROFILE_SCHEMA,
+    "costdb": COSTDB_SCHEMA,
 }
 
 # --- minimal JSON-Schema subset validator ------------------------------------
@@ -330,7 +451,8 @@ def validate(record: Dict[str, Any],
     # the conditional half of the status contract (the emitter enforces it
     # too, but externally produced streams must not pass the validator
     # with a claim-free, reason-free skip)
-    if (record.get("kind") in ("decode", "longseq_bias", "tp_overlap")
+    if (record.get("kind") in ("decode", "longseq_bias", "tp_overlap",
+                               "profile")
             and record.get("status") == "SKIP"
             and not record.get("reason")):
         errors.append(

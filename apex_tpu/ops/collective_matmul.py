@@ -68,6 +68,18 @@ def _count_ppermute(payload, count, axis_name):
                                     count=count)
 
 
+def _ring_span(op, payload, axis_name):
+    """Trace-time step-anatomy span around one ring decomposition: the
+    ring's HLOs (chunk GEMMs + ppermute hops) carry the
+    ``<op>_ring_<axis>`` named scope into device traces, and the span
+    record carries the per-hop chunk size (``bytes``) for CostDB
+    calibration — the hop count rides the ``_count_ppermute`` counters.
+    No-op while monitoring is disabled."""
+    from apex_tpu.monitor import spans as monitor_spans
+
+    return monitor_spans.collective_span(f"{op}_ring", payload, axis_name)
+
+
 def _check_operands(x, w, seq_dim, op, *, features_from):
     """Eager shape validation with errors that name the operand and the
     layer knob (``overlap_comm``) instead of a deep-XLA shape mismatch."""
@@ -203,8 +215,9 @@ def _dw_fold(acc, g_chunk, x_chunk):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
 def _ag_matmul(x, w, axis_name, seq_dim):
-    y, _ = _ring_all_gather_apply(
-        x, lambda c: jnp.dot(c, w.T), axis_name, seq_dim)
+    with _ring_span("ag_matmul", x, axis_name):
+        y, _ = _ring_all_gather_apply(
+            x, lambda c: jnp.dot(c, w.T), axis_name, seq_dim)
     return y
 
 
@@ -225,8 +238,9 @@ def _ag_matmul_bwd(axis_name, seq_dim, res, g):
     def dw_ride(acc, x_chunk, j):  # x chunks rotate; g slices are local
         return _dw_fold(acc, _seq_chunk(g, seq_dim, j, s_loc), x_chunk)
 
-    dx, dw = _ring_reduce_scatter(
-        contrib, axis_name, payload=x, payload_fn=dw_ride)
+    with _ring_span("ag_matmul_bwd", g, axis_name):
+        dx, dw = _ring_reduce_scatter(
+            contrib, axis_name, payload=x, payload_fn=dw_ride)
     return dx.astype(x.dtype), dw.astype(w.dtype)
 
 
@@ -258,7 +272,8 @@ def _mm_rs(x, w, axis_name, seq_dim):
     def contrib(j):
         return jnp.dot(_seq_chunk(x, seq_dim, j, s_loc), w.T)
 
-    y, _ = _ring_reduce_scatter(contrib, axis_name)
+    with _ring_span("mm_rs", x, axis_name):
+        y, _ = _ring_reduce_scatter(contrib, axis_name)
     return y
 
 
@@ -273,8 +288,9 @@ def _mm_rs_bwd(axis_name, seq_dim, res, g):
     def dw_ride(acc, g_chunk, j):  # g chunks rotate; x slices are local
         return _dw_fold(acc, g_chunk, _seq_chunk(x, seq_dim, j, s_loc))
 
-    dx, dw = _ring_all_gather_apply(
-        g, lambda c: jnp.dot(c, w), axis_name, seq_dim, acc_fn=dw_ride)
+    with _ring_span("mm_rs_bwd", g, axis_name):
+        dx, dw = _ring_all_gather_apply(
+            g, lambda c: jnp.dot(c, w), axis_name, seq_dim, acc_fn=dw_ride)
     return dx.astype(x.dtype), dw.astype(w.dtype)
 
 
@@ -308,12 +324,14 @@ def _mm_ar(x, w, axis_name, seq_dim):
     def contrib(j):
         return jnp.dot(_seq_chunk(x, seq_dim, j, s_loc), w.T)
 
-    chunk, _ = _ring_reduce_scatter(contrib, axis_name)
-    # all-gather phase: the reduced chunks rotate back out — pure comm,
-    # but each destination chunk was summed once, in ring order, so every
-    # rank receives bitwise-identical bytes (an XLA psum makes no such
-    # ordering promise)
-    y, _ = _ring_all_gather_apply(chunk, lambda c: c, axis_name, seq_dim)
+    with _ring_span("mm_ar", x, axis_name):
+        chunk, _ = _ring_reduce_scatter(contrib, axis_name)
+        # all-gather phase: the reduced chunks rotate back out — pure
+        # comm, but each destination chunk was summed once, in ring
+        # order, so every rank receives bitwise-identical bytes (an XLA
+        # psum makes no such ordering promise)
+        y, _ = _ring_all_gather_apply(chunk, lambda c: c, axis_name,
+                                      seq_dim)
     return y
 
 
@@ -371,8 +389,10 @@ def _copy_mm_bwd(axis_name, seq_dim, res, g):
     def contrib(j):
         return jnp.dot(_seq_chunk(g, seq_dim, j, s_loc), w)
 
-    chunk, _ = _ring_reduce_scatter(contrib, axis_name)
-    dx, _ = _ring_all_gather_apply(chunk, lambda c: c, axis_name, seq_dim)
+    with _ring_span("copy_mm_bwd", g, axis_name):
+        chunk, _ = _ring_reduce_scatter(contrib, axis_name)
+        dx, _ = _ring_all_gather_apply(chunk, lambda c: c, axis_name,
+                                       seq_dim)
     dw = _dw_fold(None, g, x)
     return dx.astype(x.dtype), dw.astype(w.dtype)
 

@@ -18,3 +18,4 @@ The reference's three stages (SURVEY.md §5) map to TPU-native equivalents:
 
 from apex_tpu.prof.marker import annotate, init, trace  # noqa: F401
 from apex_tpu.prof.analyzer import OpStats, analyze_ops, cost_analysis  # noqa: F401
+from apex_tpu.prof.calibrate import build_costdb, validate_costdb, write_costdb  # noqa: F401

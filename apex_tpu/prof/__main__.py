@@ -1,23 +1,67 @@
-"""CLI: ``python -m apex_tpu.prof <logdir> [--top N]``.
+"""CLI: ``python -m apex_tpu.prof <logdir> [--top N]
+[--spans events.jsonl [--anatomy] [--merged out.json]]``.
 
 Prints the top device time sinks and per-family roofline table from a
 ``jax.profiler`` run — the TPU analog of ``python -m apex.pyprof.prof``
-(``apex/pyprof/prof/__main__.py``).
+(``apex/pyprof/prof/__main__.py``). With ``--spans`` (a monitor JSONL
+stream carrying span records), ``--anatomy`` additionally prints the
+per-step anatomy table and ``--merged`` writes the fused host+device
+chrome-trace timeline.
+
+Exit status: 0 on success; 2 when the logdir holds no trace run (one
+line on stderr naming the searched glob — a missing capture must not
+read as a crash).
 """
 
 import argparse
+import sys
 
-from apex_tpu.prof.trace_reader import format_report
+from apex_tpu.prof.trace_reader import (
+    format_anatomy,
+    format_report,
+    read_span_stream,
+    read_trace,
+    step_anatomy,
+    write_merged_timeline,
+)
 
 
-def main():
+def main(argv=None) -> int:
     p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.prof",
         description="Analyze a jax.profiler trace directory")
-    p.add_argument("logdir", help="directory passed to jax.profiler.start_trace")
+    p.add_argument("logdir",
+                   help="directory passed to jax.profiler.start_trace")
     p.add_argument("--top", type=int, default=5, help="time sinks to show")
-    args = p.parse_args()
-    print(format_report(args.logdir, args.top))
+    p.add_argument("--spans", metavar="EVENTS_JSONL",
+                   help="monitor JSONL stream with span records to join "
+                        "against the trace")
+    p.add_argument("--anatomy", action="store_true",
+                   help="print the per-step anatomy table (needs --spans)")
+    p.add_argument("--merged", metavar="OUT_JSON",
+                   help="write the merged host+device chrome trace "
+                        "(needs --spans; .gz suffix gzips)")
+    args = p.parse_args(argv)
+    if (args.anatomy or args.merged) and not args.spans:
+        p.error("--anatomy/--merged need --spans EVENTS_JSONL")
+
+    try:
+        print(format_report(args.logdir, args.top))
+        if args.spans:
+            events = read_trace(args.logdir)
+            spans = read_span_stream(args.spans)
+            if args.anatomy:
+                print()
+                print("step anatomy (% of step wall):")
+                print(format_anatomy(step_anatomy(spans, events)))
+            if args.merged:
+                write_merged_timeline(args.merged, spans, events)
+                print(f"merged timeline written to {args.merged}")
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -22,10 +22,12 @@ from apex_tpu import native as _native
 
 # op-name prefixes → family, the analog of pyprof's per-family analyzer
 # classes (blas.py, conv.py, pointwise.py, reduction.py, …). Order matters:
-# first match wins ("convert" must shadow "conv", "while" is a container).
+# first match wins ("convert" must shadow "conv", "dynamic-update-slice"
+# must shadow "dynamic-slice", "while" is a container).
 FAMILIES = {
     "while": "control", "conditional": "control", "call": "control",
     "convert": "cast",
+    "dynamic-update-slice": "memory", "dynamic-slice": "memory",
     "dot": "gemm", "conv": "conv", "fusion": "fusion",
     "all-reduce": "collective", "all-gather": "collective",
     "reduce-scatter": "collective", "collective-permute": "collective",
@@ -54,6 +56,7 @@ CATEGORY_FAMILIES = {
     "reduce-scatter": "collective", "collective-permute": "collective",
     "all-to-all": "collective", "send": "collective", "recv": "collective",
     "reduce": "reduction", "sort": "sort", "convert": "cast",
+    "gather": "memory", "scatter": "memory",
     "while": "control", "conditional": "control", "call": "control",
 }
 
@@ -94,17 +97,30 @@ def cost_analysis(fn, *args, **kwargs) -> Dict[str, float]:
 
 def _family_of(name: str, category: str = "") -> str:
     # XLA's own hlo_category (XProf traces) is authoritative
-    if category:
-        fam = CATEGORY_FAMILIES.get(category.lower())
-        if fam:
-            return fam
-    # fallback: op names carry the named_scope path ("gpt/attn/dot.7");
-    # classify on the final HLO segment
     n = name.lower().rsplit("/", 1)[-1]
-    for prefix, fam in FAMILIES.items():
-        if n.startswith(prefix) or f".{prefix}" in n:
-            return fam
-    return "other"
+    base = CATEGORY_FAMILIES.get(category.lower()) if category else None
+    if base is None:
+        # fallback: op names carry the named_scope path
+        # ("gpt/attn/dot.7"); classify on the final HLO segment
+        base = "other"
+        for prefix, fam in FAMILIES.items():
+            if n.startswith(prefix) or f".{prefix}" in n:
+                base = fam
+                break
+    # refinements (the ROADMAP item-5 op-family slice):
+    # a REAL convolution HLO also lands in XLA's "convolution" category —
+    # split it from the dot-rooted MXU work by name, so ResNet profiles
+    # read "conv", not "gemm"
+    if (base == "gemm" and n.startswith("conv")
+            and not n.startswith("convert")):
+        base = "conv"
+    # embedding-style lookups (table gathers, their update-scatters and
+    # the fusions XLA roots at them) attribute to their own family when
+    # the scope says so — MXU work (gemm/conv) is never reclassified
+    if (base in ("memory", "fusion", "pointwise", "other")
+            and "embed" in name.lower()):
+        base = "embedding"
+    return base
 
 
 def analyze_ops(ops: Sequence[dict]) -> Dict[str, OpStats]:

@@ -1,0 +1,241 @@
+"""CostDB calibration: distill measured spans + counted bytes into the
+achieved-rate database the auto-parallelism planner consumes.
+
+ROADMAP item 2's planner (AMP-style, arXiv:2210.07297) needs to *price*
+a candidate plan: how many bytes/s does an ``all_gather`` over ``tp``
+actually move at a given payload size on this topology, and how many
+FLOP/s does a GEMM of a given size class actually achieve — numbers a
+spec sheet cannot give (they depend on ICI wiring, payload size, and
+compiler behavior). This module builds that database from telemetry the
+repo already emits:
+
+* **collectives** — each instrumented collective rides a monitor span
+  (:mod:`apex_tpu.monitor.spans`) whose record carries ``coll`` (kind),
+  ``axis`` and ``bytes`` (static payload per execution); the device
+  events under the span's named-scope path carry the measured durations.
+  One matched device event = one sample ``bytes / dur``; samples fold
+  per ``kind[axis]`` × power-of-two size bucket with spread. When a
+  stream predates spans, the counted-bytes hooks
+  (``collective/<kind>[<axis>]_bytes/_calls`` in step records) price the
+  trace's collective HLOs instead (``source: "counters"``).
+* **GEMMs** — device events in the ``gemm`` family carry XLA's own
+  ``model_flops``; achieved FLOP/s folds per power-of-two FLOPs class.
+  ``predicted_flops_per_s`` (from :func:`apex_tpu.prof.cost_analysis`'s
+  flops / optimal-seconds, when the caller measured it) rides along so
+  the planner can see achieved vs predicted in one artifact.
+
+The artifact is ``kind: "costdb"`` and schema-validated
+(:data:`apex_tpu.monitor.schema.COSTDB_SCHEMA`;
+``tools/validate_metrics.py --costdb`` gates it like bench records).
+``bench.py --profile`` emits one per gate workload.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.monitor.registry import SCHEMA_VERSION
+
+# HLO collective op kind -> the counter kind the hooks use; the join key
+# of the counted-bytes fallback path
+_HLO_TO_COUNTER_KIND = {
+    "all-reduce": "psum",
+    "all-gather": "all_gather",
+    "reduce-scatter": "reduce_scatter",
+    "collective-permute": "ppermute",
+    "all-to-all": "all_to_all",
+}
+
+
+def size_bucket(nbytes: float) -> int:
+    """Power-of-two floor of a payload size — the CostDB's size-bucket
+    key (1 for anything below 2 bytes)."""
+    b = 1
+    while b * 2 <= nbytes:
+        b *= 2
+    return b
+
+
+def _stat(samples: Sequence[float]) -> dict:
+    lo, hi = min(samples), max(samples)
+    return {
+        "n": len(samples),
+        "mean": sum(samples) / len(samples),
+        "min": lo,
+        "max": hi,
+        "spread_pct": 100.0 * (hi - lo) / lo if lo > 0 else 0.0,
+    }
+
+
+def _collective_events(events):
+    from apex_tpu.prof.analyzer import _family_of
+    from apex_tpu.prof.trace_reader import device_op_events
+
+    return [e for e in device_op_events(events)
+            if _family_of(e.name, e.args.get("hlo_category", ""))
+            == "collective" and e.dur_us > 0]
+
+
+def collective_samples_from_spans(
+        spans: Sequence[dict],
+        events) -> List[Tuple[str, float, float]]:
+    """``(key, bytes, dur_s)`` per executed collective, joined span→device
+    by named-scope path prefix. ``key`` is ``"<coll>[<axis>]"``. A ring
+    span contains one ppermute HLO per hop, each moving the span's chunk
+    payload — every hop is its own bandwidth sample."""
+    coll_spans = {}
+    for s in spans:
+        if s.get("kind") == "span" and s.get("coll") and s.get("bytes"):
+            coll_spans.setdefault(s["name"], s)
+    out = []
+    for path, s in coll_spans.items():
+        key = f"{s['coll']}[{s.get('axis', '')}]"
+        nbytes = float(s["bytes"])
+        for e in _collective_events(events):
+            if e.name == path or e.name.startswith(path + "/"):
+                out.append((key, nbytes, e.dur_us / 1e6))
+    return out
+
+
+def counted_bytes_per_call(records: Sequence[dict]) -> Dict[str, float]:
+    """``{"<kind>[<axis>]": bytes per call}`` from the last step record's
+    lifetime counters — the counted-bytes hooks' view of the traffic
+    (per traced program, the natural unit for one jitted step)."""
+    totals = {}
+    for r in records:
+        if r.get("kind") == "step" and r.get("counters_total"):
+            totals = r["counters_total"]
+    out = {}
+    for name, v in totals.items():
+        if name.startswith("collective/") and name.endswith("_bytes"):
+            tag = name[len("collective/"):-len("_bytes")]
+            calls = totals.get(f"collective/{tag}_calls", 0)
+            if calls:
+                out[tag] = float(v) / float(calls)
+    return out
+
+
+def collective_samples_from_counters(
+        records: Sequence[dict],
+        events) -> List[Tuple[str, float, float]]:
+    """The pre-span fallback: price each collective HLO in the trace at
+    the counted bytes/call of its counter kind. Only unambiguous kinds
+    participate — a kind counted on two axes cannot be attributed to a
+    device event without the span join, and a wrong price is worse than
+    a missing row."""
+    per_call = counted_bytes_per_call(records)
+    by_kind: Dict[str, List[str]] = defaultdict(list)
+    for tag in per_call:
+        kind = tag.split("[", 1)[0]
+        by_kind[kind].append(tag)
+    out = []
+    for e in _collective_events(events):
+        seg = e.name.lower().rsplit("/", 1)[-1]
+        cat = str(e.args.get("hlo_category", "")).lower()
+        for hlo, kind in _HLO_TO_COUNTER_KIND.items():
+            if seg.startswith(hlo) or cat == hlo:
+                tags = by_kind.get(kind, [])
+                if len(tags) == 1:  # unambiguous axis
+                    out.append((tags[0], per_call[tags[0]], e.dur_us / 1e6))
+                break
+    return out
+
+
+def gemm_samples(events) -> List[Tuple[str, float, float]]:
+    """``(shape-class, flops, dur_s)`` per executed GEMM-family op with a
+    known FLOP count. The class key is the power-of-two FLOPs floor —
+    ops of one jitted program keep one class per shape, and the planner
+    prices candidate GEMMs by the nearest class."""
+    from apex_tpu.prof.analyzer import _family_of
+    from apex_tpu.prof.trace_reader import _f, device_op_events
+
+    out = []
+    for e in device_op_events(events):
+        if _family_of(e.name, e.args.get("hlo_category", "")) != "gemm":
+            continue
+        flops = _f(e.args, "model_flops", "flops")
+        if flops > 0 and e.dur_us > 0:
+            out.append((f"flops_{size_bucket(flops)}", flops,
+                        e.dur_us / 1e6))
+    return out
+
+
+def build_costdb(records: Sequence[dict], events, *,
+                 device_kind: Optional[str] = None,
+                 backend: Optional[str] = None,
+                 predicted_flops_per_s: Optional[float] = None) -> dict:
+    """Distill a monitor record stream + a device trace into the CostDB.
+
+    ``records`` is the full JSONL stream (span records give the primary
+    span→device join; step records give the counted-bytes fallback when
+    no collective spans matched). Returns the ``kind: "costdb"``
+    artifact — schema-valid by construction, with every rate a finite
+    number (zero-duration events never become samples)."""
+    spans = [r for r in records if r.get("kind") == "span"]
+    samples = collective_samples_from_spans(spans, events)
+    source = "spans"
+    if not samples:
+        samples = collective_samples_from_counters(records, events)
+        source = "counters"
+
+    buckets: Dict[str, Dict[int, List[Tuple[float, float]]]] = \
+        defaultdict(lambda: defaultdict(list))
+    for key, nbytes, dur_s in samples:
+        buckets[key][size_bucket(nbytes)].append((nbytes, dur_s))
+    collectives = {}
+    for key, per_bucket in sorted(buckets.items()):
+        rows = []
+        for bucket, pairs in sorted(per_bucket.items()):
+            rows.append({
+                "bucket_bytes": bucket,
+                "bytes": _stat([b for b, _ in pairs]),
+                "bytes_per_s": _stat([b / d for b, d in pairs]),
+            })
+        collectives[key] = rows
+
+    per_class: Dict[str, List[float]] = defaultdict(list)
+    for cls, flops, dur_s in gemm_samples(events):
+        per_class[cls].append(flops / dur_s)
+    gemms = {
+        cls: {"flops_per_s": _stat(rates),
+              "predicted_flops_per_s": predicted_flops_per_s}
+        for cls, rates in sorted(per_class.items())
+    }
+
+    db = {
+        "schema": SCHEMA_VERSION,
+        "kind": "costdb",
+        "source": source,
+        "collectives": collectives,
+        "gemms": gemms,
+        "predicted_flops_per_s": predicted_flops_per_s,
+    }
+    if device_kind is not None:
+        db["device_kind"] = device_kind
+    if backend is not None:
+        db["backend"] = backend
+    return db
+
+
+def validate_costdb(db: dict) -> List[str]:
+    """Schema-validate a CostDB artifact (the shared kind-keyed
+    validator); returns error strings, empty when valid."""
+    from apex_tpu.monitor import schema
+
+    errors = list(schema.validate(db, schema.COSTDB_SCHEMA))
+    if db.get("kind") != "costdb":
+        errors.append(f"kind must be 'costdb', got {db.get('kind')!r}")
+    return errors
+
+
+def write_costdb(path: str, db: dict) -> str:
+    """Validate then write the CostDB as one JSON object; refuses an
+    invalid artifact the same way the bench refuses an invalid record."""
+    errors = validate_costdb(db)
+    if errors:
+        raise ValueError(f"refusing to write invalid costdb: {errors}")
+    with open(path, "w") as fh:
+        json.dump(db, fh, indent=1)
+    return path

@@ -50,16 +50,20 @@ class TraceEvent:
 
 
 def _latest_run_dir(log_dir: str) -> str:
-    runs = sorted(glob.glob(os.path.join(log_dir, "plugins", "profile", "*")))
+    pattern = os.path.join(log_dir, "plugins", "profile", "*")
+    runs = sorted(glob.glob(pattern))
     if not runs:
-        raise FileNotFoundError(f"no profiler runs under {log_dir!r}")
+        raise FileNotFoundError(
+            f"no profiler runs under {log_dir!r} (searched {pattern!r}; "
+            "pass the directory given to jax.profiler.start_trace)")
     return runs[-1]
 
 
 def _trace_file(run_dir: str) -> str:
-    files = glob.glob(os.path.join(run_dir, "*.trace.json.gz"))
+    pattern = os.path.join(run_dir, "*.trace.json.gz")
+    files = glob.glob(pattern)
     if not files:
-        raise FileNotFoundError(f"no trace.json.gz in {run_dir!r}")
+        raise FileNotFoundError(f"no chrome trace (searched {pattern!r})")
     return files[0]
 
 
@@ -264,3 +268,296 @@ def _short_source(src: str) -> str:
     parts = (head or src).split(os.sep)
     short = os.sep.join(parts[-2:])
     return f"{short}:{line}" if head else short
+
+
+# --- host↔device correlation (step anatomy) -----------------------------------
+#
+# The monitor's span stream (monitor.spans) records host enter/exit
+# windows whose names are named-scope paths — the same paths device-trace
+# op names carry as prefixes. That prefix IS the join: no database
+# correlation pass (the reference needs apex/pyprof/parse/db.py), just a
+# string match. The functions below fuse the two halves into per-step
+# anatomy rows (% compute / collective-exposed / bubble / host gap, per
+# device) and one merged chrome-trace timeline.
+
+
+def read_span_stream(source) -> List[dict]:
+    """The ``span`` records of a monitor JSONL stream (a path or an
+    iterable of lines), in emission order."""
+    if isinstance(source, str):
+        with open(source) as fh:
+            lines = fh.read().splitlines()
+    else:
+        lines = list(source)
+    spans = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        rec = json.loads(line)
+        if rec.get("kind") == "span":
+            spans.append(rec)
+    return spans
+
+
+def host_step_spans(spans: Sequence[dict]) -> List[dict]:
+    """The host-phase step windows: spans whose final path segment is
+    ``step`` and that were NOT recorded under a trace (traced spans'
+    host durations measure tracing, not execution), by start time."""
+    return sorted(
+        (s for s in spans
+         if s.get("name", "").rsplit("/", 1)[-1] == "step"
+         and not s.get("traced")),
+        key=lambda s: s.get("t0_ns", 0))
+
+
+def correlate(spans: Sequence[dict],
+              events: Sequence[TraceEvent]) -> Dict[str, dict]:
+    """Join device op events onto span scope paths.
+
+    A device event belongs to span path ``p`` when its name is ``p`` or
+    starts with ``p + "/"`` (named-scope nesting). Returns
+    ``{span_path: {"span": record, "count", "time_s", "flops", "bytes",
+    "events": [...]}}`` — one entry per distinct span path (a traced span
+    re-emitted per retrace still yields one entry)."""
+    out: Dict[str, dict] = {}
+    dev = device_op_events(events)
+    for s in spans:
+        path = s.get("name", "")
+        if not path or path in out:
+            continue
+        matched = [e for e in dev
+                   if e.name == path or e.name.startswith(path + "/")]
+        out[path] = {
+            "span": s,
+            "count": len(matched),
+            "time_s": sum(e.dur_us for e in matched) / 1e6,
+            "flops": sum(_f(e.args, "model_flops", "flops")
+                         for e in matched),
+            "bytes": sum(_f(e.args, "bytes_accessed", "raw_bytes_accessed",
+                            "bytes accessed", "bytes") for e in matched),
+            "events": matched,
+        }
+    return out
+
+
+def split_steps(events: Sequence[TraceEvent],
+                n: int) -> List[List[TraceEvent]]:
+    """Partition one device's op events into ``n`` execution windows by
+    cutting at the ``n−1`` largest idle gaps. One jitted step is one
+    dense burst of device work; the gaps between bursts are host time —
+    the same boundary the host step spans measure — so cutting at the
+    widest gaps recovers the per-step windows without any clock
+    alignment between host and device."""
+    evs = sorted(events, key=lambda e: e.start_us)
+    if n <= 1 or len(evs) <= 1:
+        return [evs] if evs else []
+    gaps = []  # (idle gap before event i, i)
+    frontier = evs[0].start_us + evs[0].dur_us
+    for i in range(1, len(evs)):
+        gaps.append((evs[i].start_us - frontier, i))
+        frontier = max(frontier, evs[i].start_us + evs[i].dur_us)
+    cuts = sorted(i for _, i in sorted(gaps, reverse=True)[:n - 1])
+    windows = []
+    prev = 0
+    for c in cuts:
+        windows.append(evs[prev:c])
+        prev = c
+    windows.append(evs[prev:])
+    return windows
+
+
+def _merge_intervals(intervals):
+    """Sorted merge of (start, end) pairs."""
+    out = []
+    for s, e in sorted(intervals):
+        if out and s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return out
+
+
+def _total(merged) -> float:
+    return sum(e - s for s, e in merged)
+
+
+def _intersect_total(a, b) -> float:
+    """Total overlap length of two MERGED interval lists."""
+    total, i, j = 0.0, 0, 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def step_anatomy(spans: Sequence[dict],
+                 events: Sequence[TraceEvent]) -> List[dict]:
+    """Fuse host step spans with device op events into per-(step, device)
+    anatomy rows.
+
+    Per device, the op events split into as many execution windows as
+    there are host step spans (:func:`split_steps`); window *i* pairs
+    with step span *i* (both are in time order — no host↔device clock
+    alignment needed). Within a window, with ``K`` = the union of
+    compute-op intervals (every non-container, non-collective family)
+    and ``C`` = the union of collective intervals:
+
+    * ``compute_s``             = \\|K\\|
+    * ``collective_exposed_s``  = \\|C\\| − \\|C ∩ K\\| (collective time no
+      compute hides — overlapped collectives cost nothing here)
+    * ``bubble_s``              = window extent − \\|K ∪ C\\| (device idle
+      inside the step)
+    * ``host_gap_s``            = host wall − extent (step time the
+      device never saw: dispatch, host work between launches)
+
+    and the four percentages are of the host wall, so they sum to 100
+    (when the host wall is shorter than the device extent — mismatched
+    streams — the extent is the denominator and ``host_gap`` is 0).
+    """
+    steps = host_step_spans(spans)
+    if not steps:
+        return []
+    from apex_tpu.prof.analyzer import CONTAINER_FAMILIES, _family_of
+
+    by_device: Dict[str, List[TraceEvent]] = defaultdict(list)
+    for e in device_op_events(events):
+        by_device[e.device].append(e)
+
+    rows = []
+    for device in sorted(by_device):
+        windows = split_steps(by_device[device], len(steps))
+        for i, (span, win) in enumerate(zip(steps, windows)):
+            comp, coll = [], []
+            for e in win:
+                fam = _family_of(e.name, e.args.get("hlo_category", ""))
+                if fam in CONTAINER_FAMILIES:
+                    continue
+                iv = (e.start_us / 1e6, (e.start_us + e.dur_us) / 1e6)
+                (coll if fam == "collective" else comp).append(iv)
+            K = _merge_intervals(comp)
+            C = _merge_intervals(coll)
+            busy = _merge_intervals(comp + coll)
+            compute_s = _total(K)
+            exposed_s = _total(C) - _intersect_total(C, K)
+            extent = ((max(e.start_us + e.dur_us for e in win)
+                       - min(e.start_us for e in win)) / 1e6 if win else 0.0)
+            bubble_s = extent - _total(busy)
+            wall_s = span.get("dur_ns", 0) / 1e9
+            denom = max(wall_s, extent)
+            host_gap_s = max(0.0, wall_s - extent)
+            pct = (lambda x: 100.0 * x / denom) if denom else (lambda x: 0.0)
+            rows.append({
+                "step": span.get("step", i),
+                "device": device,
+                "wall_s": wall_s,
+                "compute_s": compute_s,
+                "collective_exposed_s": exposed_s,
+                "bubble_s": bubble_s,
+                "host_gap_s": host_gap_s,
+                "compute_pct": pct(compute_s),
+                "collective_exposed_pct": pct(exposed_s),
+                "bubble_pct": pct(bubble_s),
+                "host_gap_pct": pct(host_gap_s),
+            })
+    return rows
+
+
+def format_anatomy(rows: Sequence[dict]) -> str:
+    """Text table of :func:`step_anatomy` rows — what ``python -m
+    apex_tpu.monitor report --anatomy`` prints."""
+    if not rows:
+        return ("no anatomy rows: need host step spans in the stream AND "
+                "per-HLO device events in the trace (CPU traces are "
+                "host-only; capture on TPU/GPU)")
+    lines = [f"{'step':>5} {'device':<18}{'wall ms':>9}{'compute%':>10}"
+             f"{'coll-exp%':>11}{'bubble%':>9}{'host-gap%':>11}"]
+    for r in rows:
+        lines.append(
+            f"{r['step']:>5} {r['device']:<18}{r['wall_s']*1e3:>9.3f}"
+            f"{r['compute_pct']:>10.2f}{r['collective_exposed_pct']:>11.2f}"
+            f"{r['bubble_pct']:>9.2f}{r['host_gap_pct']:>11.2f}")
+    return "\n".join(lines)
+
+
+def merged_timeline(spans: Sequence[dict],
+                    events: Sequence[TraceEvent]) -> dict:
+    """One chrome-trace/Perfetto JSON object holding BOTH halves: the
+    monitor's host spans (one track per process, trace-time spans on
+    their own track) and the device op events. Host timestamps are
+    monotonic-ns and device timestamps profiler-epoch µs, so the host
+    track is shifted to align the first host step span with the start of
+    the first device window — alignment is presentational; the anatomy
+    numbers come from :func:`step_anatomy`, which never mixes the
+    clocks."""
+    trace_events = []
+    pids: Dict[str, int] = {}
+
+    def pid_of(name):
+        if name not in pids:
+            pids[name] = len(pids) + 1
+            trace_events.append({"ph": "M", "pid": pids[name],
+                                 "name": "process_name",
+                                 "args": {"name": name}})
+        return pids[name]
+
+    dev = device_op_events(events)
+    steps = host_step_spans(spans)
+    offset_us = 0.0
+    if spans:
+        t0_host = min(s.get("t0_ns", 0) for s in spans) / 1e3
+        if steps and dev:
+            t0_host = steps[0]["t0_ns"] / 1e3
+            offset_us = min(e.start_us for e in dev) - t0_host
+        elif dev:
+            offset_us = min(e.start_us for e in dev) - t0_host
+
+    threads_named = set()
+
+    def name_thread(pid, tid, label):
+        if (pid, tid) not in threads_named:
+            threads_named.add((pid, tid))
+            trace_events.append({"ph": "M", "pid": pid, "tid": tid,
+                                 "name": "thread_name",
+                                 "args": {"name": label}})
+
+    for s in spans:
+        pid = pid_of(f"host:spans (process {s.get('process', 0)})")
+        tid = 2 if s.get("traced") else 1
+        name_thread(pid, tid, "spans (trace-time)" if tid == 2 else "spans")
+        args = {k: v for k, v in s.items()
+                if k not in ("schema", "kind", "t_s", "name", "t0_ns",
+                             "dur_ns")}
+        trace_events.append({
+            "ph": "X", "pid": pid, "tid": tid, "name": s["name"],
+            "ts": s["t0_ns"] / 1e3 + offset_us,
+            "dur": s.get("dur_ns", 0) / 1e3, "args": args})
+
+    for e in dev:
+        pid = pid_of(e.device)
+        name_thread(pid, 1, e.track or "XLA Ops")
+        trace_events.append({
+            "ph": "X", "pid": pid, "tid": 1, "name": e.name,
+            "ts": e.start_us, "dur": e.dur_us, "args": dict(e.args)})
+    return {"traceEvents": trace_events}
+
+
+def write_merged_timeline(path: str, spans: Sequence[dict],
+                          events: Sequence[TraceEvent]) -> str:
+    """Write :func:`merged_timeline` as JSON (gzipped when ``path`` ends
+    in ``.gz``); returns ``path``. Load it in Perfetto / chrome://tracing
+    to see host spans and device kernels on one timeline."""
+    data = merged_timeline(spans, events)
+    if path.endswith(".gz"):
+        with gzip.open(path, "wt") as fh:
+            json.dump(data, fh)
+    else:
+        with open(path, "w") as fh:
+            json.dump(data, fh)
+    return path

@@ -27,6 +27,7 @@ from typing import Any
 import jax
 
 from apex_tpu.monitor import hooks as monitor_hooks
+from apex_tpu.monitor import spans as monitor_spans
 from apex_tpu.parallel import mesh as mesh_lib
 
 PyTree = Any
@@ -38,7 +39,12 @@ def _rotate(x: PyTree, axis_name: str, shift: int) -> PyTree:
     if monitor_hooks.enabled():  # trace-time count, zero run-time cost
         monitor_hooks.count_collective(
             "ppermute", bytes=monitor_hooks.tree_bytes(x), axis=axis_name)
-    return jax.tree.map(lambda a: jax.lax.ppermute(a, axis_name, perm), x)
+    # span at trace time: the ppermute's HLOs carry the ppermute_<axis>
+    # scope into device traces (the anatomy/CostDB join key), and the span
+    # record carries the counted bytes for calibration
+    with monitor_spans.collective_span("ppermute", x, axis_name):
+        return jax.tree.map(
+            lambda a: jax.lax.ppermute(a, axis_name, perm), x)
 
 
 def send_forward(x: PyTree, axis_name: str = mesh_lib.PIPELINE_AXIS) -> PyTree:

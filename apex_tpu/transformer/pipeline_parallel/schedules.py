@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.monitor import hooks as monitor_hooks
+from apex_tpu.monitor import spans as monitor_spans
 from apex_tpu.parallel import mesh as mesh_lib
 
 PyTree = Any
@@ -157,14 +158,19 @@ def pipeline_spmd_forward(
                 microbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False
             )
             x = jnp.where(rank == 0, inject, x)
-            y = fn(stage_params, x, t)
+            # spans run once at trace time: the stage's HLOs carry the
+            # pp_stage scope and the rotation the ppermute_<axis> scope
+            # into device traces (step-anatomy/CostDB join keys)
+            with monitor_spans.span("pp_stage"):
+                y = fn(stage_params, x, t)
             if aux:
                 y, a = y
                 # this rank holds a REAL microbatch iff 0 <= t-rank < M
                 u = t - rank
                 aux_sum = jax.tree.map(
                     jnp.add, aux_sum, _mask_aux(a, (u >= 0) & (u < M)))
-            sent = jax.lax.ppermute(y, axis_name, perm)
+            with monitor_spans.collective_span("ppermute", y, axis_name):
+                sent = jax.lax.ppermute(y, axis_name, perm)
 
             # microbatch m exits at tick m + S - 1, arriving (post-rotate)
             # at device 0
@@ -218,12 +224,14 @@ def pipeline_spmd_forward(
             inject = jax.lax.dynamic_index_in_dim(
                 microbatches, m, 0, keepdims=False)
             x = jnp.where((rank == 0) & (c == 0), inject, x)
-            y = cfn(stage_params, c, x, t)
+            with monitor_spans.span("pp_stage"):
+                y = cfn(stage_params, c, x, t)
             if aux:
                 y, a = y
                 aux_sum = jax.tree.map(
                     jnp.add, aux_sum, _mask_aux(a, in_flight))
-            sent = jax.lax.ppermute(y, axis_name, perm)
+            with monitor_spans.collective_span("ppermute", y, axis_name):
+                sent = jax.lax.ppermute(y, axis_name, perm)
 
             # the item device S-1 just finished (u = t − (S−1)) arrives at
             # device 0 post-rotate; it is final iff its chunk was v−1

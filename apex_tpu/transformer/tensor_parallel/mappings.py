@@ -40,8 +40,13 @@ def _count_collective(kind: str, x: jax.Array, axis_name: str) -> None:
 
 
 def _psum_counted(x: jax.Array, axis_name: str) -> jax.Array:
+    from apex_tpu.monitor import spans as monitor_spans
+
     _count_collective("psum", x, axis_name)
-    return jax.lax.psum(x, axis_name)
+    # trace-time span: the psum's HLOs carry the psum_<axis> scope into
+    # device traces and the span record carries bytes for calibration
+    with monitor_spans.collective_span("psum", x, axis_name):
+        return jax.lax.psum(x, axis_name)
 
 
 def _split_local(x: jax.Array, axis_name: str) -> jax.Array:
@@ -54,8 +59,11 @@ def _split_local(x: jax.Array, axis_name: str) -> jax.Array:
 
 def _gather_last(x: jax.Array, axis_name: str) -> jax.Array:
     """All-gather along the last dim (mappings.py:92-105)."""
+    from apex_tpu.monitor import spans as monitor_spans
+
     _count_collective("all_gather", x, axis_name)
-    return jax.lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
+    with monitor_spans.collective_span("all_gather", x, axis_name):
+        return jax.lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))

@@ -526,6 +526,170 @@ def tp_overlap_main():
     emit(status, **fields)
 
 
+def profile_main(argv=None):
+    """``python bench.py --profile [--logdir D] [--costdb F]`` — the
+    step-anatomy leg: run the flagship train step with fwd_bwd/optimizer
+    spans under a ``jax.profiler`` capture, fuse the span stream with the
+    device trace (``prof.trace_reader.step_anatomy``), write the merged
+    host+device timeline and the calibrated CostDB artifact
+    (``prof.calibrate``), and emit ONE ``profile`` monitor record.
+
+    On TPU the chrome trace carries per-HLO device events, the anatomy
+    percentages are real and the record is ``status: "OK"``; off-TPU the
+    trace is host-only (no XLA Ops track), so the record is an explicit
+    ``status: "SKIP"`` with the smoke wall-times riding along and every
+    device-derived metric an explicit skip object — never nan in an OK
+    line. Span/trace/anatomy/CostDB *math* is tier-1-tested on synthetic
+    fixtures; this leg is the real-capture path."""
+    import sys
+
+    from apex_tpu.monitor import report as monitor_report
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    def _opt(flag, default):
+        return argv[argv.index(flag) + 1] if flag in argv else default
+
+    logdir = _opt("--logdir", "/tmp/apex_tpu_profile")
+    os.makedirs(logdir, exist_ok=True)
+    costdb_path = _opt("--costdb", os.path.join(logdir, "costdb.json"))
+
+    on_tpu = jax.default_backend() == "tpu"
+    # spans need a live registry at TRACE time (scope names bake into the
+    # compiled program's op names); respect APEX_TPU_MONITOR, else stream
+    # next to the trace
+    reg = monitor.enable_from_env()
+    if reg is None:
+        stream_path = os.path.join(logdir, "events.jsonl")
+        monitor.enable(stream_path)
+    else:
+        stream_path = os.environ["APEX_TPU_MONITOR"]
+
+    import optax
+
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.optimizers import fused_adam
+    from apex_tpu.prof import calibrate, cost_analysis, trace
+    from apex_tpu.prof import trace_reader
+
+    if on_tpu:
+        cfg = dict(vocab_size=32768, max_seq_len=1024, hidden_size=1024,
+                   num_layers=12, num_heads=8, tp_size=1, remat=False,
+                   attention_impl="flash", scan_layers=False)
+        batch, seq, steps = 20, 1024, 5
+        cast = jnp.bfloat16
+    else:  # smoke scale; the record is SKIP either way (host-only trace)
+        cfg = dict(vocab_size=256, max_seq_len=64, hidden_size=64,
+                   num_layers=2, num_heads=4, tp_size=1, remat=False,
+                   attention_impl="flash")
+        batch, seq, steps = 2, 64, 3
+        cast = None
+
+    model = GPTModel(GPTConfig(**cfg))
+    params = model.init(jr.PRNGKey(0))
+    if cast is not None:
+        params = jax.tree.map(lambda x: x.astype(cast), params)
+    opt = fused_adam(learning_rate=1e-4)
+    opt_state = opt.init(params)
+    tokens = jr.randint(jr.PRNGKey(1), (batch, seq), 0, cfg["vocab_size"])
+    targets = jr.randint(jr.PRNGKey(2), (batch, seq), 0, cfg["vocab_size"])
+
+    def train_step(params, opt_state, tokens, targets):
+        # traced spans: fwd_bwd / optimizer scope every HLO they cover —
+        # the join key the anatomy table and CostDB calibration read back
+        # out of the device trace
+        with monitor.span("fwd_bwd"):
+            loss, grads = jax.value_and_grad(model.loss_fn)(
+                params, tokens, targets)
+        with monitor.span("optimizer"):
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    monitor.emit_meta(
+        device_kind=jax.devices()[0].device_kind if on_tpu else "cpu",
+        backend=jax.default_backend(),
+        model_flops_per_token=model_flops_per_token(cfg, seq),
+        batch=batch, seq=seq, config=cfg,
+        metric="gpt_step_anatomy_profile",
+    )
+    # XLA's own prediction for the whole program — the costdb's
+    # achieved-vs-predicted reference line. TPU only: the CPU backend
+    # reports no optimal_seconds, so the smoke run would pay a second
+    # full compile for a None
+    pred = None
+    if on_tpu:
+        ca = cost_analysis(train_step, params, opt_state, tokens, targets)
+        if ca.get("flops", 0) > 0 and ca.get("optimal_seconds", 0) > 0:
+            pred = ca["flops"] / ca["optimal_seconds"]
+
+    # compile+warm OUTSIDE the capture (scope names are program
+    # properties; the capture only needs executions)
+    params, opt_state, loss = step(params, opt_state, tokens, targets)
+    float(loss)
+    with trace(logdir):
+        for i in range(steps):
+            with monitor.span("step", step=i):
+                params, opt_state, loss = step(params, opt_state, tokens,
+                                               targets)
+                float(loss)  # block INSIDE the span: wall time is honest
+
+    records = monitor_report.read_records(open(stream_path))
+    spans = [r for r in records if r.get("kind") == "span"]
+    events = trace_reader.read_trace(logdir)
+    rows = trace_reader.step_anatomy(spans, events)
+    timeline_path = os.path.join(logdir, "merged_trace.json")
+    trace_reader.write_merged_timeline(timeline_path, spans, events)
+    db = calibrate.build_costdb(
+        records, events,
+        device_kind=jax.devices()[0].device_kind if on_tpu else "cpu",
+        backend=jax.default_backend(), predicted_flops_per_s=pred)
+    calibrate.write_costdb(costdb_path, db)
+
+    walls = [s["dur_ns"] / 1e9 for s in
+             trace_reader.host_step_spans(spans)]
+    fields = dict(
+        steps=len(walls), span_records=len(spans),
+        step_wall_ms=round(sum(walls) / len(walls) * 1e3, 3),
+        tokens_per_s=round(batch * seq / min(walls), 1),
+        costdb_collective_rows=sum(len(v) for v in
+                                   db["collectives"].values()),
+        costdb_gemm_classes=len(db["gemms"]),
+        costdb_path=costdb_path, timeline_path=timeline_path,
+        trace_dir=logdir, config=cfg, backend=jax.default_backend(),
+    )
+
+    def mean_pct(key):
+        return round(sum(r[key] for r in rows) / len(rows), 2)
+
+    if rows and on_tpu:
+        fields.update(compute_pct=mean_pct("compute_pct"),
+                      collective_exposed_pct=mean_pct(
+                          "collective_exposed_pct"),
+                      bubble_pct=mean_pct("bubble_pct"),
+                      host_gap_pct=mean_pct("host_gap_pct"))
+        status = "OK"
+    else:
+        reason = ("step anatomy needs per-HLO device events; this "
+                  f"{jax.default_backend()} trace is host-only"
+                  if not rows else
+                  "anatomy percentages are a TPU measurement; this is a "
+                  f"{jax.default_backend()} smoke run")
+        for k in ("compute_pct", "collective_exposed_pct", "bubble_pct",
+                  "host_gap_pct"):
+            fields[k] = ("skipped", reason)
+        fields["reason"] = reason
+        status = "SKIP"
+
+    record = monitor.get_registry().emit_profile(status, **fields)
+    errors = monitor.validate(record)
+    if errors:
+        raise ValueError(f"profile bench record failed validation: {errors}")
+    print(json.dumps(record))
+
+
 def main():
     on_tpu = jax.default_backend() == "tpu"
     monitor.enable_from_env()  # APEX_TPU_MONITOR=<path> streams JSONL
@@ -640,7 +804,9 @@ def main():
 if __name__ == "__main__":
     import sys
 
-    if "--decode" in sys.argv[1:]:
+    if "--profile" in sys.argv[1:]:
+        profile_main([a for a in sys.argv[1:] if a != "--profile"])
+    elif "--decode" in sys.argv[1:]:
         decode_main()
     elif "--longseq-bias" in sys.argv[1:]:
         longseq_bias_main()

@@ -40,6 +40,52 @@ def test_amp_worked_example_executes():
     assert jnp.isfinite(ns["loss"])
 
 
+def _doc_blocks(*relpath):
+    path = os.path.join(os.path.dirname(__file__), "..", "docs", *relpath)
+    return re.findall(r"```python\n(.*?)```", open(path).read(), re.DOTALL)
+
+
+def _exec_blocks(blocks, label):
+    ns = {}
+    for i, block in enumerate(blocks):
+        try:
+            exec(compile(block, f"{label}[block {i}]", "exec"), ns)
+        except Exception as e:  # pragma: no cover - diagnostic
+            pytest.fail(f"{label} block {i} failed: "
+                        f"{type(e).__name__}: {e}\n---\n{block}")
+    return ns
+
+
+def test_observability_blocks_execute_in_order():
+    """The monitor doc's snippets — quickstart, span/anatomy join,
+    CostDB calibration — all execute (the monitor/lint docs standard:
+    enforced, not asserted)."""
+    blocks = _doc_blocks("OBSERVABILITY.md")
+    assert len(blocks) >= 3, "OBSERVABILITY.md lost its worked examples"
+    _exec_blocks(blocks, "OBSERVABILITY.md")
+    # the doc must tear down the process-wide registry it enabled
+    from apex_tpu import monitor
+    assert not monitor.enabled()
+
+
+def test_prof_api_blocks_execute_in_order():
+    """docs/api/prof.md: capture → report → correlate/anatomy →
+    calibrate → cost_analysis, one namespace, runnable on CPU."""
+    blocks = _doc_blocks("api", "prof.md")
+    assert len(blocks) >= 5, "prof.md lost its worked examples"
+    _exec_blocks(blocks, "prof.md")
+
+
+def test_observability_covers_anatomy_and_calibration():
+    path = os.path.join(os.path.dirname(__file__), "..", "docs",
+                        "OBSERVABILITY.md")
+    text = open(path).read()
+    for needle in ("monitor.span", "--anatomy", "step_anatomy",
+                   "build_costdb", "--costdb", "host gap",
+                   "collective-exposed", "bench.py --profile"):
+        assert needle in text, f"OBSERVABILITY.md dropped {needle}"
+
+
 def test_guide_covers_the_ladder():
     text = open(os.path.join(os.path.dirname(__file__), "..", "docs",
                              "TRAINING_GUIDE.md")).read()

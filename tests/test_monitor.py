@@ -722,3 +722,305 @@ class TestLongseqBiasBenchLeg:
         assert monitor.validate(record) == []
         tool = _load_validate_tool()
         assert tool.main([str(path)]) == 0
+
+
+class TestSpans:
+    """The step-anatomy span API (monitor.spans): host enter/exit records
+    riding the JSONL stream, named-scope join keys into device traces,
+    near-no-op when disabled, ``traced`` honesty inside jit."""
+
+    def test_disabled_span_is_noop(self):
+        assert not monitor.enabled()
+        with monitor.span("step", step=0):
+            pass
+        assert monitor.span_path() == ""
+
+    def test_span_records_path_time_and_attrs(self, registry):
+        reg, buf = registry
+        with monitor.span("step", step=3):
+            assert monitor.span_path() == "step"
+            with monitor.span("fwd_bwd"):
+                assert monitor.span_path() == "step/fwd_bwd"
+        assert monitor.span_path() == ""
+        recs = records_of(buf)
+        assert [r["name"] for r in recs] == ["step/fwd_bwd", "step"]
+        for r in recs:
+            assert r["kind"] == "span"
+            assert r["dur_ns"] >= 0 and r["t0_ns"] > 0
+            assert "traced" not in r  # host phase
+            assert monitor.validate(r) == []
+        assert recs[1]["step"] == 3
+        # nesting: the inner window is inside the outer one
+        assert recs[0]["t0_ns"] >= recs[1]["t0_ns"]
+
+    def test_traced_span_is_flagged(self, registry):
+        import jax
+        import jax.numpy as jnp
+
+        reg, buf = registry
+
+        def f(x):
+            with monitor.span("fwd_bwd"):
+                return x * 2
+
+        jax.jit(f)(jnp.ones(4))
+        spans = [r for r in records_of(buf) if r["kind"] == "span"]
+        assert spans and all(s["traced"] is True for s in spans)
+        assert all(monitor.validate(s) == [] for s in spans)
+
+    def test_collective_span_attrs_and_none_axis(self, registry):
+        import jax.numpy as jnp
+
+        reg, buf = registry
+        with monitor.collective_span("psum", jnp.zeros((4, 8)), "tp"):
+            pass
+        with monitor.collective_span("psum", jnp.zeros((4, 8)), None):
+            pass  # tp=1 fallthrough: no record
+        spans = [r for r in records_of(buf) if r["kind"] == "span"]
+        assert len(spans) == 1
+        s = spans[0]
+        assert s["name"] == "psum_tp"
+        assert s["coll"] == "psum" and s["axis"] == "tp"
+        assert s["bytes"] == 4 * 8 * 4
+
+    def test_mappings_emit_collective_spans(self, registry):
+        import jax.random as jr
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.parallel import mesh as mesh_lib
+        from apex_tpu.transformer import tensor_parallel as tp_lib
+
+        reg, buf = registry
+        mesh = mesh_lib.make_mesh(tensor_model_parallel_size=4)
+        x = jr.normal(jr.PRNGKey(3), (4, 8))
+        mesh_lib.shard_map(
+            lambda x: tp_lib.reduce_from_tensor_model_parallel_region(
+                x, "tp"),
+            mesh=mesh, in_specs=P(), out_specs=P())(x)
+        spans = [r for r in records_of(buf) if r["kind"] == "span"]
+        psums = [s for s in spans if s["name"].endswith("psum_tp")]
+        assert psums, spans
+        assert psums[0]["coll"] == "psum"
+        assert psums[0]["bytes"] > 0
+        assert psums[0]["traced"] is True  # shard_map traces the fn
+
+    def test_overlap_ring_emits_ring_span(self, registry):
+        import jax.random as jr
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.ops.collective_matmul import all_gather_matmul
+        from apex_tpu.parallel import mesh as mesh_lib
+
+        reg, buf = registry
+        mesh = mesh_lib.make_mesh(tensor_model_parallel_size=4)
+        x = jr.normal(jr.PRNGKey(0), (4, 2, 8))
+        w = jr.normal(jr.PRNGKey(1), (4, 8))
+        mesh_lib.shard_map(
+            lambda x, w: all_gather_matmul(x, w, axis_name="tp"),
+            mesh=mesh, in_specs=(P("tp"), P("tp", None)),
+            out_specs=P(None, None, "tp"))(x, w)
+        spans = [r for r in records_of(buf) if r["kind"] == "span"]
+        rings = [s for s in spans if "ag_matmul_ring_tp" in s["name"]]
+        assert rings, spans
+        assert rings[0]["coll"] == "ag_matmul_ring"
+        # per-hop payload: the local (1, 2, 8) fp32 shard
+        assert rings[0]["bytes"] == 1 * 2 * 8 * 4
+
+
+class TestProfileRecord:
+    """The ``profile`` bench record (``bench.py --profile``): same
+    status/honesty contract as decode/longseq_bias/tp_overlap."""
+
+    def test_emit_roundtrip_and_validation(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        monitor.enable(str(path))
+        try:
+            rec = monitor.emit_profile(
+                "OK", steps=5, compute_pct=71.2,
+                collective_exposed_pct=9.1, bubble_pct=12.4,
+                host_gap_pct=7.3, step_wall_ms=177.1,
+                tokens_per_s=115000.0, costdb_collective_rows=6,
+                costdb_gemm_classes=4, backend="tpu")
+            assert monitor.validate(rec) == []
+        finally:
+            monitor.disable()
+        assert monitor.validate_jsonl(path.read_text().splitlines()) == []
+
+    def test_ok_with_nan_refused_and_skip_needs_reason(self):
+        reg = monitor.MetricsRegistry()
+        with pytest.raises(ValueError, match="non-finite"):
+            reg.emit_profile("OK", compute_pct=float("nan"))
+        with pytest.raises(ValueError, match="reason"):
+            reg.emit_profile("SKIP")
+        rec = reg.emit_profile(
+            "SKIP", reason="host-only trace",
+            compute_pct=("skipped", "host-only trace"))
+        assert rec["compute_pct"] == {"skipped": True,
+                                      "reason": "host-only trace"}
+        assert monitor.validate(rec) == []
+        bare = {k: v for k, v in rec.items() if k != "reason"}
+        assert any("reason" in e for e in monitor.validate(bare))
+
+
+def _write_synthetic_trace(tmp_path, events):
+    import gzip
+
+    run = tmp_path / "plugins" / "profile" / "2026_01_01_00_00_00"
+    os.makedirs(run)
+    with gzip.open(run / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return str(tmp_path)
+
+
+def _anatomy_fixture(tmp_path):
+    """One host span stream + one device trace with hand-checkable
+    anatomy: step 0 wall 120 us (compute 70, exposed collective 20,
+    bubble 10, host gap 20), step 1 wall 100 us (compute 50, exposed 20,
+    bubble 10, host gap 20)."""
+    meta = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 3, "tid": 3, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+    ]
+    def X(name, ts, dur, cat=None):
+        e = {"ph": "X", "pid": 3, "tid": 3, "ts": ts, "dur": dur,
+             "name": name, "args": {}}
+        if cat:
+            e["args"]["hlo_category"] = cat
+        return e
+    events = meta + [
+        X("step/fwd_bwd/dot.1", 0.0, 60.0),
+        X("step/fwd_bwd/all-gather.2", 40.0, 40.0, "all-gather"),
+        X("step/optimizer/fusion.3", 90.0, 10.0),
+        X("step/fwd_bwd/dot.1", 1000.0, 50.0),
+        X("step/fwd_bwd/all-gather.2", 1060.0, 20.0, "all-gather"),
+    ]
+    logdir = _write_synthetic_trace(tmp_path / "trace", events)
+    stream = tmp_path / "events.jsonl"
+    reg = monitor.enable(str(stream))
+    try:
+        for i, dur_us in enumerate((120, 100)):
+            reg.emit("span", name="step", step=i,
+                     t0_ns=1_000_000 * (1 + i), dur_ns=dur_us * 1000)
+        reg.emit("span", name="step/fwd_bwd", t0_ns=1, dur_ns=1,
+                 traced=True)
+    finally:
+        monitor.disable()
+    return str(stream), logdir
+
+
+class TestAnatomyReportCLI:
+    """`monitor report --anatomy` must reproduce the per-step breakdown
+    from a synthetic host+device fixture exactly (the ISSUE acceptance
+    line)."""
+
+    def test_report_anatomy_exact(self, tmp_path, capsys):
+        stream, logdir = _anatomy_fixture(tmp_path)
+        rc = monitor_report.main(["report", stream, "--anatomy",
+                                  "--trace", logdir, "--json"])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out)
+        rows = summary["anatomy"]
+        assert len(rows) == 2
+        r0, r1 = rows
+        assert r0["compute_pct"] == pytest.approx(100 * 70 / 120)
+        assert r0["collective_exposed_pct"] == pytest.approx(
+            100 * 20 / 120)
+        assert r0["bubble_pct"] == pytest.approx(100 * 10 / 120)
+        assert r0["host_gap_pct"] == pytest.approx(100 * 20 / 120)
+        assert r1["compute_pct"] == pytest.approx(50.0)
+        assert r1["collective_exposed_pct"] == pytest.approx(20.0)
+        assert r1["bubble_pct"] == pytest.approx(10.0)
+        assert r1["host_gap_pct"] == pytest.approx(20.0)
+        # the four components cover the wall exactly
+        for r in rows:
+            assert (r["compute_pct"] + r["collective_exposed_pct"]
+                    + r["bubble_pct"] + r["host_gap_pct"]) == \
+                pytest.approx(100.0)
+
+    def test_report_anatomy_text_table(self, tmp_path, capsys):
+        stream, logdir = _anatomy_fixture(tmp_path)
+        rc = monitor_report.main(["report", stream, "--anatomy",
+                                  "--trace", logdir])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "step anatomy" in out
+        assert "/device:TPU:0" in out
+
+    def test_report_anatomy_missing_trace_exits_2(self, tmp_path, capsys):
+        stream, _ = _anatomy_fixture(tmp_path)
+        rc = monitor_report.main(["report", stream, "--anatomy",
+                                  "--trace", str(tmp_path / "nope")])
+        assert rc == 2
+        assert "searched" in capsys.readouterr().err
+
+
+class TestValidateProfileArtifacts:
+    """`tools/validate_metrics.py --profile/--costdb` gate the new
+    artifacts like bench/gate records."""
+
+    def test_costdb_flag_accepts_and_rejects(self, tmp_path):
+        from apex_tpu.prof.calibrate import build_costdb, write_costdb
+
+        tool = _load_validate_tool()
+        db = build_costdb([], [], device_kind="TPU v5p", backend="tpu")
+        p = tmp_path / "costdb.json"
+        write_costdb(str(p), db)
+        assert tool.main(["--costdb", str(p)]) == 0
+        other = tmp_path / "bench.json"
+        other.write_text(json.dumps({"metric": "m", "value": 1.0,
+                                     "unit": "u"}))
+        assert tool.main(["--costdb", str(other)]) == 1
+
+    def test_profile_flag_requires_profile_record(self, tmp_path):
+        tool = _load_validate_tool()
+        path = tmp_path / "events.jsonl"
+        monitor.enable(str(path))
+        try:
+            monitor.emit_profile("SKIP", reason="host-only trace")
+        finally:
+            monitor.disable()
+        assert tool.main(["--profile", str(path)]) == 0
+        bare = tmp_path / "bare.jsonl"
+        monitor.enable(str(bare))
+        try:
+            monitor.emit_event("x")
+        finally:
+            monitor.disable()
+        assert tool.main(["--profile", str(bare)]) == 1
+
+
+class TestProfileBenchLeg:
+    def test_bench_profile_emits_valid_skip_record_off_tpu(
+            self, tmp_path, monkeypatch, capsys):
+        """The step-anatomy leg end-to-end at smoke scale, in-process
+        (the subprocess import tax would blow the tier-1 budget): off-TPU
+        the trace is host-only, so the record must be an explicit SKIP —
+        schema-valid, no nan — with the costdb and merged timeline
+        artifacts written and validator-clean."""
+        import importlib.util
+
+        monkeypatch.delenv("APEX_TPU_MONITOR", raising=False)
+        root = os.path.join(os.path.dirname(__file__), "..")
+        spec = importlib.util.spec_from_file_location(
+            "bench_profile_leg", os.path.join(root, "bench.py"))
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        logdir = str(tmp_path / "prof")
+        try:
+            bench.profile_main(["--logdir", logdir])
+        finally:
+            monitor.disable()
+        record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert record["kind"] == "profile"
+        assert record["status"] == "SKIP" and record["reason"]
+        assert record["steps"] >= 1
+        assert record["step_wall_ms"] > 0
+        assert record["compute_pct"]["skipped"] is True
+        assert monitor.validate(record) == []
+        assert os.path.exists(record["costdb_path"])
+        assert os.path.exists(record["timeline_path"])
+        tool = _load_validate_tool()
+        assert tool.main(["--costdb", record["costdb_path"]]) == 0
+        assert tool.main([os.path.join(logdir, "events.jsonl")]) == 0

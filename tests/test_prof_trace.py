@@ -174,3 +174,285 @@ def test_native_parser_matches_python(xprof_logdir):
         assert a.start_us == pytest.approx(b.start_us)
         assert a.dur_us == pytest.approx(b.dur_us)
         assert a.args == b.args
+
+
+def _span(name, t0_ns=1, dur_ns=1, **attrs):
+    return {"schema": 1, "kind": "span", "name": name, "t0_ns": t0_ns,
+            "dur_ns": dur_ns, "process": 0, "rank": "", **attrs}
+
+
+def _dev(name, ts, dur, cat=None, **args):
+    if cat:
+        args["hlo_category"] = cat
+    return trace_reader.TraceEvent(
+        name=name, start_us=ts, dur_us=dur, device="/device:TPU:0",
+        track="XLA Ops", args=args)
+
+
+class TestSpanCorrelation:
+    """Host↔device join: span scope paths prefix device op names."""
+
+    def test_correlate_joins_by_scope_prefix(self):
+        spans = [_span("step/fwd_bwd", traced=True),
+                 _span("step/optimizer", traced=True)]
+        events = [
+            _dev("step/fwd_bwd/dot.1", 0, 50, flops=1e9),
+            _dev("step/fwd_bwd/fusion.2", 60, 20),
+            _dev("step/optimizer/fusion.9", 90, 10),
+            _dev("unscoped/copy.1", 200, 5),
+        ]
+        corr = trace_reader.correlate(spans, events)
+        assert corr["step/fwd_bwd"]["count"] == 2
+        assert corr["step/fwd_bwd"]["time_s"] == pytest.approx(70e-6)
+        assert corr["step/fwd_bwd"]["flops"] == pytest.approx(1e9)
+        assert corr["step/optimizer"]["count"] == 1
+        # prefix match is on path segments: "step/fwd_bwd2/..." must NOT
+        # join onto "step/fwd_bwd"
+        corr2 = trace_reader.correlate(
+            spans, [_dev("step/fwd_bwd2/dot.1", 0, 10)])
+        assert corr2["step/fwd_bwd"]["count"] == 0
+
+    def test_split_steps_at_largest_gaps(self):
+        events = [_dev("a.1", 0, 10), _dev("b.2", 15, 10),
+                  _dev("a.1", 1000, 10), _dev("b.2", 1030, 10),
+                  _dev("a.1", 2000, 10)]
+        wins = trace_reader.split_steps(events, 3)
+        assert [len(w) for w in wins] == [2, 2, 1]
+        assert wins[1][0].start_us == 1000
+        # n=1: everything in one window
+        assert len(trace_reader.split_steps(events, 1)) == 1
+        assert trace_reader.split_steps([], 3) == []
+
+    def test_host_step_spans_filter_and_order(self):
+        spans = [_span("step", t0_ns=2000, step=1),
+                 _span("step", t0_ns=1000, step=0),
+                 _span("step/fwd_bwd", traced=True),
+                 _span("decode_step", traced=True)]
+        steps = trace_reader.host_step_spans(spans)
+        assert [s["step"] for s in steps] == [0, 1]
+
+    def test_step_anatomy_exact(self):
+        """The hand-checkable fixture: step 0 wall 120 us = 70 compute +
+        20 exposed collective + 10 bubble + 20 host gap."""
+        events = [
+            _dev("step/fwd_bwd/dot.1", 0, 60),
+            _dev("step/fwd_bwd/all-gather.2", 40, 40, "all-gather"),
+            _dev("step/optimizer/fusion.3", 90, 10),
+            _dev("step/fwd_bwd/dot.1", 1000, 50),
+            _dev("step/fwd_bwd/all-gather.2", 1060, 20, "all-gather"),
+        ]
+        spans = [_span("step", t0_ns=1_000, dur_ns=120_000, step=0),
+                 _span("step", t0_ns=2_000_000, dur_ns=100_000, step=1)]
+        rows = trace_reader.step_anatomy(spans, events)
+        assert len(rows) == 2
+        r0 = rows[0]
+        assert r0["step"] == 0 and r0["device"] == "/device:TPU:0"
+        assert r0["compute_s"] == pytest.approx(70e-6)
+        assert r0["collective_exposed_s"] == pytest.approx(20e-6)
+        assert r0["bubble_s"] == pytest.approx(10e-6)
+        assert r0["host_gap_s"] == pytest.approx(20e-6)
+        assert r0["compute_pct"] == pytest.approx(100 * 70 / 120)
+        r1 = rows[1]
+        assert r1["compute_pct"] == pytest.approx(50.0)
+        assert r1["collective_exposed_pct"] == pytest.approx(20.0)
+        assert r1["bubble_pct"] == pytest.approx(10.0)
+        assert r1["host_gap_pct"] == pytest.approx(20.0)
+        # fully-overlapped collective costs nothing
+        rows_overlap = trace_reader.step_anatomy(
+            [_span("step", t0_ns=0, dur_ns=50_000, step=0)],
+            [_dev("s/dot.1", 0, 50),
+             _dev("s/all-reduce.2", 10, 20, "all-reduce")])
+        assert rows_overlap[0]["collective_exposed_s"] == 0.0
+        assert rows_overlap[0]["compute_pct"] == pytest.approx(100.0)
+
+    def test_anatomy_without_steps_or_devices_is_empty(self):
+        assert trace_reader.step_anatomy([], [_dev("a.1", 0, 1)]) == []
+        assert trace_reader.step_anatomy(
+            [_span("step", dur_ns=1000)], []) == []
+        assert "anatomy" in trace_reader.format_anatomy([])
+
+    def test_merged_timeline_holds_both_halves(self, tmp_path):
+        spans = [_span("step", t0_ns=5_000_000, dur_ns=100_000, step=0),
+                 _span("step/fwd_bwd", t0_ns=5_000_100, dur_ns=10,
+                       traced=True)]
+        events = [_dev("step/fwd_bwd/dot.1", 70_000.0, 50)]
+        tl = trace_reader.merged_timeline(spans, events)
+        xs = [e for e in tl["traceEvents"] if e.get("ph") == "X"]
+        assert len(xs) == 3
+        names = {e["name"] for e in xs}
+        assert {"step", "step/fwd_bwd", "step/fwd_bwd/dot.1"} <= names
+        # host step span aligned onto the first device event's start
+        host_step = next(e for e in xs if e["name"] == "step")
+        assert host_step["ts"] == pytest.approx(70_000.0)
+        # traced spans ride a separate track from host-phase spans
+        traced = next(e for e in xs if e["name"] == "step/fwd_bwd")
+        assert traced["tid"] != host_step["tid"]
+        procs = [e for e in tl["traceEvents"]
+                 if e.get("name") == "process_name"]
+        assert any("host:spans" in p["args"]["name"] for p in procs)
+        assert any("/device:TPU:0" == p["args"]["name"] for p in procs)
+        out = trace_reader.write_merged_timeline(
+            str(tmp_path / "merged.json"), spans, events)
+        with open(out) as fh:
+            assert json.load(fh)["traceEvents"]
+
+    def test_read_span_stream(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        path.write_text(
+            json.dumps(_span("step", step=0)) + "\n"
+            + json.dumps({"kind": "step", "schema": 1, "step": 0,
+                          "dur_s": 0.1, "counters": {}, "gauges": {}})
+            + "\n")
+        spans = trace_reader.read_span_stream(str(path))
+        assert len(spans) == 1 and spans[0]["name"] == "step"
+
+
+class TestCostDB:
+    """CostDB calibration (prof.calibrate): measured spans + counted
+    bytes distilled into achieved rates, error vs ground truth bounded."""
+
+    def _span_fixture(self):
+        """Collective at a known bandwidth: 1 MiB psum over tp at
+        8 GB/s ±6.25%, plus a ring hop and two GEMM executions."""
+        from apex_tpu.prof import calibrate
+
+        nbytes = 1 << 20
+        rate = 8e9
+        spans = [
+            _span("fwd/psum_tp", coll="psum", axis="tp", bytes=nbytes,
+                  traced=True),
+            _span("fwd/ag_matmul_ring_tp", coll="ag_matmul_ring",
+                  axis="tp", bytes=1 << 18, traced=True),
+        ]
+        dur_lo = nbytes / (rate * 1.0625) * 1e6  # us, fast sample
+        dur_hi = nbytes / (rate * 0.9375) * 1e6  # us, slow sample
+        events = [
+            _dev("fwd/psum_tp/all-reduce.5", 0, dur_lo, "all-reduce"),
+            _dev("fwd/psum_tp/all-reduce.5", 500, dur_hi, "all-reduce"),
+            _dev("fwd/ag_matmul_ring_tp/collective-permute.3", 900, 32.768,
+                 "collective-permute"),
+            _dev("fwd/dot.1", 1000, 100, flops=2e9),
+            _dev("fwd/dot.1", 2000, 100, flops=2e9),
+        ]
+        return calibrate, spans, events, nbytes, rate
+
+    def test_build_costdb_from_spans_bounded_error(self):
+        calibrate, spans, events, nbytes, rate = self._span_fixture()
+        db = calibrate.build_costdb(spans, events, device_kind="TPU v5p",
+                                    backend="tpu")
+        assert db["source"] == "spans"
+        rows = db["collectives"]["psum[tp]"]
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["bucket_bytes"] == nbytes  # exact power of two
+        assert row["bytes_per_s"]["n"] == 2
+        # calibration error vs the fixture's ground truth: the two
+        # samples straddle 8 GB/s symmetrically, so the mean lands on it
+        assert abs(row["bytes_per_s"]["mean"] - rate) / rate < 1e-6
+        assert row["bytes_per_s"]["min"] == pytest.approx(rate * 0.9375)
+        assert row["bytes_per_s"]["max"] == pytest.approx(rate * 1.0625)
+        assert row["bytes_per_s"]["spread_pct"] == pytest.approx(
+            100 * (1.0625 - 0.9375) / 0.9375)
+        # the ring hop priced at its chunk size
+        ring = db["collectives"]["ag_matmul_ring[tp]"][0]
+        assert ring["bucket_bytes"] == 1 << 18
+        assert ring["bytes_per_s"]["mean"] == pytest.approx(
+            (1 << 18) / 32.768e-6)
+        # GEMM class: 2e9 flops in 100us = 2e13 flops/s
+        (cls, g), = db["gemms"].items()
+        assert cls == f"flops_{calibrate.size_bucket(2e9)}"
+        assert g["flops_per_s"]["mean"] == pytest.approx(2e13)
+        assert g["flops_per_s"]["n"] == 2
+
+    def test_costdb_roundtrips_through_validator(self, tmp_path):
+        calibrate, spans, events, _, _ = self._span_fixture()
+        db = calibrate.build_costdb(spans, events, device_kind="TPU v5p",
+                                    backend="tpu",
+                                    predicted_flops_per_s=2.5e13)
+        assert calibrate.validate_costdb(db) == []
+        path = calibrate.write_costdb(str(tmp_path / "costdb.json"), db)
+        with open(path) as fh:
+            loaded = json.load(fh)
+        from apex_tpu.monitor import schema
+        assert schema.validate(loaded) == []  # kind-dispatch
+        assert loaded["gemms"][next(iter(loaded["gemms"]))][
+            "predicted_flops_per_s"] == 2.5e13
+
+    def test_write_refuses_invalid(self, tmp_path):
+        from apex_tpu.prof import calibrate
+
+        with pytest.raises(ValueError, match="invalid costdb"):
+            calibrate.write_costdb(
+                str(tmp_path / "bad.json"),
+                {"schema": 1, "kind": "costdb", "collectives": "nope",
+                 "gemms": {}})
+
+    def test_counted_bytes_fallback(self):
+        """Streams without collective spans price the trace's collective
+        HLOs from the counted-bytes hooks — only unambiguous kinds."""
+        from apex_tpu.prof import calibrate
+
+        records = [
+            {"kind": "step", "schema": 1, "step": 0, "dur_s": 0.1,
+             "counters": {}, "gauges": {},
+             "counters_total": {
+                 "collective/all_gather[tp]_bytes": 3 * (1 << 16),
+                 "collective/all_gather[tp]_calls": 3,
+                 # psum counted on TWO axes: attribution is ambiguous,
+                 # so psum events must produce no row
+                 "collective/psum[dp]_bytes": 1024,
+                 "collective/psum[dp]_calls": 1,
+                 "collective/psum[tp]_bytes": 2048,
+                 "collective/psum[tp]_calls": 1,
+             }},
+        ]
+        events = [
+            _dev("all-gather.7", 0, 8.192, "all-gather"),
+            _dev("all-reduce.9", 100, 10, "all-reduce"),
+        ]
+        db = calibrate.build_costdb(records, events)
+        assert db["source"] == "counters"
+        assert list(db["collectives"]) == ["all_gather[tp]"]
+        row = db["collectives"]["all_gather[tp]"][0]
+        # 65536 bytes in 8.192us = 8e9 B/s, exactly
+        assert row["bytes_per_s"]["mean"] == pytest.approx(8e9)
+
+    def test_size_bucket(self):
+        from apex_tpu.prof.calibrate import size_bucket
+
+        assert size_bucket(1) == 1
+        assert size_bucket(1023) == 512
+        assert size_bucket(1024) == 1024
+        assert size_bucket(1025) == 1024
+
+
+class TestProfCLIExit:
+    """`python -m apex_tpu.prof` on a traceless logdir exits 2 with a
+    one-line error naming the searched glob (ISSUE satellite)."""
+
+    def test_missing_logdir_exits_2(self, tmp_path, capsys):
+        from apex_tpu.prof.__main__ import main
+
+        rc = main([str(tmp_path)])
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert err.count("\n") == 1  # one line
+        assert "searched" in err
+        assert os.path.join("plugins", "profile", "*") in err
+
+    def test_anatomy_and_merged_flags(self, tmp_path, capsys, logdir):
+        from apex_tpu.prof.__main__ import main
+
+        spans_path = tmp_path / "spans.jsonl"
+        spans_path.write_text(
+            json.dumps(_span("step", t0_ns=1000, dur_ns=500_000, step=0))
+            + "\n")
+        out = tmp_path / "merged.json"
+        rc = main([logdir, "--spans", str(spans_path), "--anatomy",
+                   "--merged", str(out)])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "step anatomy" in text
+        assert out.exists()
+        with open(out) as fh:
+            assert json.load(fh)["traceEvents"]

@@ -5,6 +5,8 @@ Usage::
 
     python tools/validate_metrics.py events.jsonl BENCH_r05.json ...
     python tools/validate_metrics.py --lint-report lint.json ...
+    python tools/validate_metrics.py --costdb costdb.json ...
+    python tools/validate_metrics.py --profile profile.jsonl ...
 
 Dispatch is by content, not extension:
 
@@ -33,7 +35,13 @@ Dispatch is by content, not extension:
   just works; ``--lint-report`` instead forces EVERY listed file to be
   judged as a lint report (a malformed file that lost its ``tool`` key
   must fail as a bad lint report, not as an unrecognized shape) — don't
-  combine it with non-lint artifacts.
+  combine it with non-lint artifacts;
+* ``profile`` records (``python bench.py --profile``: the step-anatomy
+  leg) and ``costdb`` artifacts (``apex_tpu.prof.calibrate``) dispatch
+  on ``kind`` like every monitor record. ``--profile`` / ``--costdb``
+  force EVERY listed file to be judged as that artifact (same rationale
+  as ``--lint-report``: an artifact that lost its ``kind`` key must fail
+  as a bad profile/costdb, not as an unrecognized shape).
 
 Exit status 0 when every file is clean; 1 otherwise, with one problem per
 line on stderr. The logic lives in ``apex_tpu.monitor.schema`` so tests
@@ -101,7 +109,8 @@ def validate_object(obj) -> list:
     return ["unrecognized artifact shape (no kind/metric/parsed/tail)"]
 
 
-def validate_file(path: str, *, as_lint_report: bool = False) -> list:
+def validate_file(path: str, *, as_lint_report: bool = False,
+                  force_kind: str = None) -> list:
     problems = []
     with open(path) as fh:
         text = fh.read()
@@ -111,6 +120,33 @@ def validate_file(path: str, *, as_lint_report: bool = False) -> list:
         except json.JSONDecodeError as e:
             return [f"{path}: not JSON: {e}"]
         return [f"{path}: {e}" for e in validate_lint_report(obj)]
+    if force_kind is not None:
+        # --profile / --costdb: judge the file as that artifact kind —
+        # one JSON object, or a JSONL stream that must CONTAIN the kind
+        try:
+            obj = json.loads(text)
+        except json.JSONDecodeError:
+            obj = None
+        if isinstance(obj, dict):
+            if obj.get("kind") != force_kind:
+                return [f"{path}: expected a {force_kind!r} artifact, "
+                        f"got kind={obj.get('kind')!r}"]
+            return [f"{path}: {e}" for e in schema.validate(obj)]
+        problems = [f"{path}:{lineno}: {err}"
+                    for lineno, err in schema.validate_jsonl(
+                        text.splitlines())]
+        kinds = set()
+        for line in text.splitlines():
+            line = line.strip()
+            if line:
+                try:
+                    kinds.add(json.loads(line).get("kind"))
+                except json.JSONDecodeError:
+                    pass
+        if force_kind not in kinds:
+            problems.append(
+                f"{path}: stream carries no {force_kind!r} record")
+        return problems
     # one JSON value in the whole file → single artifact; otherwise JSONL
     obj = None
     if not path.endswith(".jsonl"):
@@ -129,13 +165,20 @@ def validate_file(path: str, *, as_lint_report: bool = False) -> list:
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     as_lint = "--lint-report" in argv
-    argv = [a for a in argv if a != "--lint-report"]
+    force_kind = None
+    if "--costdb" in argv:
+        force_kind = "costdb"
+    elif "--profile" in argv:
+        force_kind = "profile"
+    argv = [a for a in argv
+            if a not in ("--lint-report", "--costdb", "--profile")]
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
     all_problems = []
     for path in argv:
-        all_problems.extend(validate_file(path, as_lint_report=as_lint))
+        all_problems.extend(validate_file(path, as_lint_report=as_lint,
+                                          force_kind=force_kind))
     for problem in all_problems:
         print(problem, file=sys.stderr)
     if not all_problems:
