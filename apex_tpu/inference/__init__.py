@@ -22,6 +22,12 @@ the cached model math in :class:`apex_tpu.models.GPTModel`'s
 ``prefill_block``/``decode_qkv``/``decode_block`` branch. Serving
 throughput is measured by ``python bench.py --decode`` (see
 ``docs/api/inference.md`` for the cache-layout and HBM-bound analysis).
+
+This engine decodes ONE fixed batch in lockstep; serving mixed traffic
+— requests of different lengths arriving at different times — lives one
+layer up in :mod:`apex_tpu.serving` (continuous batching over a paged
+block-pool cache, chunked prefill, fused sampling tail), which reuses
+this module's decode math and sampling primitives.
 """
 
 from apex_tpu.inference.engine import DecodeEngine, jit_encoder  # noqa: F401

@@ -50,11 +50,16 @@ class DecodeEngine:
     ``engine = DecodeEngine(model)``;
     ``tokens = engine.generate(params, prompt, max_new_tokens)``.
 
-    ``max_seq_len`` caps the cache (default: the model's); allocate it as
-    a multiple of 128 so the fused decode kernel's tiling constraint holds
-    on TPU (any length works functionally — the op falls back to XLA).
-    ``cache_dtype`` defaults to the model's param dtype; serve bf16 caches
-    for 2x cache capacity at bf16-activation quality.
+    ``max_seq_len`` sizes the cache (default: the model's) and MUST be a
+    multiple of 128 — the fused decode kernel streams the cache in
+    128-column tiles, so any other length silently drops to the XLA
+    fallback on TPU; that policy-by-accident was worth turning into an
+    eager error. A cache may be ROUNDED UP past the model's position
+    table (``max_seq_len=((n + 127) // 128) * 128``): the extra rows are
+    tiling slack, and ``generate`` still refuses to step positions past
+    the table itself. ``cache_dtype`` defaults to the model's param
+    dtype; serve bf16 caches for 2x cache capacity at bf16-activation
+    quality.
     """
 
     def __init__(self, model: GPTModel, *, max_seq_len: Optional[int] = None,
@@ -64,10 +69,19 @@ class DecodeEngine:
         self.model = model
         c = self.config = model.config
         self.max_s = int(max_seq_len or c.max_seq_len)
-        if self.max_s > c.max_seq_len:
+        if self.max_s < 1 or self.max_s % 128:
+            raise ValueError(
+                f"max_seq_len ({self.max_s}) must be a positive multiple "
+                f"of 128 (the fused decode kernel's cache-tiling "
+                f"constraint) — round the cache up: DecodeEngine(model, "
+                f"max_seq_len={((self.max_s + 127) // 128) * 128}); "
+                f"generation is still capped by the model's position "
+                f"table ({c.max_seq_len})")
+        if self.max_s > ((c.max_seq_len + 127) // 128) * 128:
             raise ValueError(
                 f"cache max_seq_len ({self.max_s}) exceeds the model's "
-                f"position table ({c.max_seq_len})")
+                f"position table ({c.max_seq_len}) by more than the "
+                f"128-rounding slack")
         self.cache_dtype = cache_dtype or c.dtype
         self.temperature = float(temperature)
         self.top_k = int(top_k)
@@ -199,6 +213,15 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt ({s}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds the cache ({self.max_s})")
+        # a 128-rounded cache may outsize the position table; positions
+        # actually stepped may not (the last DECODED position is
+        # s + max_new_tokens - 2: the final sampled token never re-enters)
+        if s + max_new_tokens - 1 > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({max_new_tokens}) steps "
+                f"past the model's position table "
+                f"({self.config.max_seq_len}); the cache's 128-rounding "
+                f"slack holds no positions")
         if self.temperature > 0 and key is None:
             raise ValueError("temperature > 0 generation requires a key")
         if key is None:  # greedy: the key operand is ignored but keeps the

@@ -1,12 +1,19 @@
 """Token sampling for the decode loop.
 
-Greedy, temperature, and top-k sampling over the last-position logits.
-``temperature`` and ``top_k`` are STATIC (python numbers fixed at engine
-construction): inside the jit'd ``decode_step`` they select the sampling
-program once — the sampled path never branches at run time, which is part
-of the zero-recompile contract (the alternative, traced sampling knobs,
-would either re-trace per setting or drag a dynamic ``top_k`` sort into
-every step).
+Greedy, temperature, top-k, and top-p (nucleus) sampling over the
+last-position logits. ``temperature``/``top_k``/``top_p`` are STATIC
+(python numbers fixed at engine construction): inside the jit'd
+``decode_step`` they select the sampling program once — the sampled path
+never branches at run time, which is part of the zero-recompile contract
+(the alternative, traced sampling knobs, would either re-trace per
+setting or drag a dynamic ``top_k`` sort into every step).
+
+This is the STANDALONE sampler — the canonical, sort/cumsum-formulated
+reference (every op shape-stable: a full descending sort and a cumsum
+regardless of the knobs' values). The serving engines' hot path instead
+runs :func:`apex_tpu.ops.fused_sample` — one fused kernel with
+bisection-found thresholds — and ``tests/test_serving.py`` pins the two
+formulations to the same kept set.
 """
 
 from __future__ import annotations
@@ -16,31 +23,49 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-# masked-out logit value for top-k filtering; finite (not -inf) so a
-# pathological all-filtered row degrades to uniform instead of NaN
+# masked-out logit value for top-k/top-p filtering; finite (not -inf) so
+# a pathological all-filtered row degrades to uniform instead of NaN
 _FILTERED = -1e30
 
 
 def sample_logits(logits: jax.Array, key: Optional[jax.Array] = None,
-                  *, temperature: float = 0.0, top_k: int = 0) -> jax.Array:
+                  *, temperature: float = 0.0, top_k: int = 0,
+                  top_p: float = 1.0) -> jax.Array:
     """(b, V) logits → (b,) int32 token ids.
 
     ``temperature == 0`` is greedy argmax (no key needed). Otherwise the
     categorical draw runs over ``logits / temperature``, optionally
     restricted to each row's ``top_k`` highest logits (``top_k == 0`` =
-    full vocab). The softmax normalization happens inside
-    ``jax.random.categorical`` via the Gumbel trick — no materialized
-    probability vector."""
+    full vocab) and then to the NUCLEUS: the minimal highest-probability
+    set whose softmax mass reaches ``top_p`` (``top_p == 1`` = full
+    vocab; the token that crosses ``top_p`` is kept, ties at the cutoff
+    value are all kept). Filters compose in the top-k → top-p order (the
+    nucleus is computed over the already-top-k-restricted distribution).
+    The softmax normalization happens inside ``jax.random.categorical``
+    via the Gumbel trick — no materialized probability vector."""
     if temperature < 0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if top_k < 0:
         raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if key is None:
         raise ValueError("temperature > 0 sampling requires a PRNG key")
     scaled = logits.astype(jnp.float32) / temperature
     if top_k > 0:
-        kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+        kth = jax.lax.top_k(scaled, min(top_k, scaled.shape[-1]))[0][..., -1:]
         scaled = jnp.where(scaled < kth, _FILTERED, scaled)
+    if top_p < 1.0:
+        # shape-stable nucleus: full descending sort + cumsum, cutoff at
+        # the first row position whose cumulative mass reaches top_p
+        # (filtered entries sort last with ~0 probability, so top-k
+        # composition is automatic)
+        desc = -jnp.sort(-scaled, axis=-1)
+        probs = jax.nn.softmax(desc, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1)
+        cut = jnp.argmax(csum >= top_p, axis=-1)
+        cutoff = jnp.take_along_axis(desc, cut[..., None], axis=-1)
+        scaled = jnp.where(scaled < cutoff, _FILTERED, scaled)
     return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)

@@ -674,7 +674,8 @@ class GPTModel:
         q, k, v = self._proj_qkv_bshd(p, h_in)
         return q[:, 0], k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
 
-    def decode_block(self, p, x, q, k_lay, v_lay, lengths, rel_bias=None):
+    def decode_block(self, p, x, q, k_lay, v_lay, lengths, rel_bias=None,
+                     block_tables=None):
         """One token through one block against this layer's cache slices
         (ALREADY holding the token's own k/v row — the engine writes
         between :meth:`decode_qkv` and this call): x (b, 1, H) is the
@@ -683,10 +684,15 @@ class GPTModel:
         the live prefix length INCLUDING this token. ``rel_bias``: an
         optional causal BucketedBias the engine threads from the model's
         ``decode_rel_bias`` hook (T5-style relative bias at decode —
-        recomputed in-kernel from the tiny table). Returns the block
+        recomputed in-kernel from the tiny table). ``block_tables``: the
+        serving engine's paged-cache path — ``k_lay``/``v_lay`` are then
+        the shared (num_blocks, h_kv, block_size, d) pool and the table
+        maps each slot's logical kv blocks to pool blocks (see
+        :func:`apex_tpu.ops.decode_attention`). Returns the block
         output (b, 1, H)."""
         from apex_tpu.ops import decode_attention
-        ctx = decode_attention(q, k_lay, v_lay, lengths, bias=rel_bias)
+        ctx = decode_attention(q, k_lay, v_lay, lengths, bias=rel_bias,
+                               block_tables=block_tables)
         x = x + self._proj_attn_out(p, ctx[:, None])
         m = self._mlp(p, fused_layer_norm(x, p["ln2_w"], p["ln2_b"]))
         return x + m

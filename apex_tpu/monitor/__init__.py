@@ -55,6 +55,7 @@ from apex_tpu.monitor.registry import (  # noqa: F401
     emit_longseq_bias,
     emit_meta,
     emit_profile,
+    emit_serve,
     emit_tp_overlap,
     enable,
     enable_from_env,

@@ -259,6 +259,12 @@ class MetricsRegistry:
         tp >= 2."""
         return self._emit_status_record("tp_overlap", status, **fields)
 
+    def emit_serve(self, status: str, **fields) -> Dict[str, Any]:
+        """Continuous-batching serving record (``bench.py --serve``):
+        offered-load sweep through the paged ServingEngine — per-token
+        latency / TTFT percentiles, tokens/s under churn, occupancy."""
+        return self._emit_status_record("serve", status, **fields)
+
     def emit_profile(self, status: str, **fields) -> Dict[str, Any]:
         """Step-anatomy profile record (``bench.py --profile``): spans +
         device trace fused into the per-step compute/collective/bubble/
@@ -452,6 +458,13 @@ def emit_tp_overlap(status: str, **fields) -> Optional[Dict[str, Any]]:
     r = _REGISTRY
     if r is not None:
         return r.emit_tp_overlap(status, **fields)
+    return None
+
+
+def emit_serve(status: str, **fields) -> Optional[Dict[str, Any]]:
+    r = _REGISTRY
+    if r is not None:
+        return r.emit_serve(status, **fields)
     return None
 
 

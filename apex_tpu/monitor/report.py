@@ -70,6 +70,7 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     decode_records = []
     longseq_records = []
     tp_overlap_records = []
+    serve_records = []
     schedule = None
     for rec in records:
         kind = rec.get("kind")
@@ -87,6 +88,8 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             longseq_records.append(rec)
         elif kind == "tp_overlap":
             tp_overlap_records.append(rec)
+        elif kind == "serve":
+            serve_records.append(rec)
         elif kind == "event" and rec.get("name") == "pipeline_schedule":
             schedule = rec
 
@@ -220,6 +223,14 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                                  "vs_blocking", "tp", "batch", "seq",
                                  "spread_pct", "spread_pct_blocking"))
 
+    if serve_records:
+        summary["serve"] = status_summary(
+            serve_records, ("tokens_per_s", "latency_p50_ms",
+                            "latency_p99_ms", "ttft_p50_ms", "ttft_p99_ms",
+                            "occupancy_pct", "vs_single_request",
+                            "requests", "slots", "block_size",
+                            "blocks_high_water"))
+
     if gate_records:
         summary["gates"] = [
             {"name": g.get("name"), "ok": g.get("ok"),
@@ -302,6 +313,25 @@ def render(summary: Dict[str, Any]) -> str:
             if lsb.get("skipped"):
                 parts.append("skipped: " + ", ".join(lsb["skipped"]))
             lines.append("  longseq-bias " + "   ".join(parts))
+    srv = summary.get("serve")
+    if srv:
+        if srv.get("status") == "SKIP":
+            lines.append(f"  serve       SKIP({srv.get('reason', '?')})")
+        else:
+            parts = []
+            if isinstance(srv.get("tokens_per_s"), (int, float)):
+                parts.append(f"{srv['tokens_per_s']:.1f} tok/s under churn")
+            if isinstance(srv.get("latency_p50_ms"), (int, float)) and \
+                    isinstance(srv.get("latency_p99_ms"), (int, float)):
+                parts.append(f"p50/p99 {srv['latency_p50_ms']:.2f}/"
+                             f"{srv['latency_p99_ms']:.2f} ms/token")
+            if isinstance(srv.get("ttft_p50_ms"), (int, float)):
+                parts.append(f"ttft p50 {srv['ttft_p50_ms']:.2f} ms")
+            if isinstance(srv.get("occupancy_pct"), (int, float)):
+                parts.append(f"occ {srv['occupancy_pct']:.0f}%")
+            if srv.get("skipped"):
+                parts.append("skipped: " + ", ".join(srv["skipped"]))
+            lines.append("  serve       " + "   ".join(parts))
     tpo = summary.get("tp_overlap")
     if tpo:
         if tpo.get("status") == "SKIP":

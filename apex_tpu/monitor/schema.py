@@ -4,10 +4,12 @@ self-contained validator.
 One schema family covers every JSON artifact the repo emits:
 
 * monitor JSONL records (``kind`` ∈ meta/event/step/gate/decode/
-  longseq_bias/tp_overlap) — the stream written by
-  :mod:`apex_tpu.monitor.registry` (``decode`` is the serving-bench
-  record ``bench.py --decode`` emits; ``tp_overlap`` the
-  ring-overlapped-vs-blocking record of ``bench.py --tp-overlap``);
+  longseq_bias/tp_overlap/serve) — the stream written by
+  :mod:`apex_tpu.monitor.registry` (``decode`` is the single-batch
+  serving record ``bench.py --decode`` emits; ``serve`` the
+  continuous-batching offered-load record of ``bench.py --serve``;
+  ``tp_overlap`` the ring-overlapped-vs-blocking record of ``bench.py
+  --tp-overlap``);
 * ``BENCH_*.json``-style bench result objects (the line ``bench.py``
   prints);
 * the MULTICHIP gate record printed by ``__graft_entry__.dryrun_multichip``.
@@ -225,6 +227,48 @@ TP_OVERLAP_SCHEMA = {
     "required": ["schema", "kind", "status"],
 }
 
+# continuous-batching serving bench record (`python bench.py --serve`):
+# one record per offered-load run through apex_tpu.serving.ServingEngine —
+# per-token latency and TTFT percentiles, decode tokens/s under churn,
+# slot occupancy, paged-pool high-water, and the greedy-parity /
+# jit-cache-pinned witnesses against the single-request DecodeEngine.
+# Same status semantics as decode/longseq_bias: "OK" (real TPU) engages
+# the honesty rule; off-TPU the record is an explicit SKIP with the
+# smoke-scale measurements riding along as finite fields — never nan in
+# an OK line.
+SERVE_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["serve"]},
+        "status": {"enum": ["OK", "SKIP"]},
+        "reason": {"type": "string"},  # required when status == "SKIP"
+        "tokens_per_s": _METRIC_VALUE,       # decode tokens/s under churn
+        "latency_p50_ms": _METRIC_VALUE,     # per-token (inter-token) p50
+        "latency_p99_ms": _METRIC_VALUE,     # per-token p99
+        "ttft_p50_ms": _METRIC_VALUE,        # time to first token p50
+        "ttft_p99_ms": _METRIC_VALUE,        # time to first token p99
+        "occupancy_pct": _METRIC_VALUE,      # mean decoding-slots / slots
+        "vs_single_request": _METRIC_VALUE,  # no-churn throughput parity
+        "single_request_tokens_per_s": _METRIC_VALUE,
+        "offered_rps": _METRIC_VALUE,        # Poisson arrival rate driven
+        "greedy_parity": {"type": "boolean"},  # tokens == DecodeEngine's
+        "jit_cache_ok": {"type": "boolean"},   # both steps pinned at 1
+        "requests": {"type": "integer"},
+        "slots": {"type": "integer"},
+        "block_size": {"type": "integer"},
+        "num_blocks": {"type": "integer"},
+        "blocks_high_water": {"type": "integer"},
+        "prefill_chunk": {"type": "integer"},
+        "decode_steps": {"type": "integer"},
+        "prefill_chunks": {"type": "integer"},
+        "max_seq_len": {"type": "integer"},
+        "config": {"type": "object"},
+        "backend": {"type": "string"},
+    },
+    "required": ["schema", "kind", "status"],
+}
+
 # span record (monitor.spans.span): one host enter/exit window per
 # instrumented region. ``name`` is the /-joined path of nested spans —
 # the named-scope prefix device-trace ops carry, i.e. the host↔device
@@ -351,6 +395,7 @@ SCHEMAS_BY_KIND = {
     "decode": DECODE_SCHEMA,
     "longseq_bias": LONGSEQ_BIAS_SCHEMA,
     "tp_overlap": TP_OVERLAP_SCHEMA,
+    "serve": SERVE_SCHEMA,
     "span": SPAN_SCHEMA,
     "profile": PROFILE_SCHEMA,
     "costdb": COSTDB_SCHEMA,
@@ -452,7 +497,7 @@ def validate(record: Dict[str, Any],
     # too, but externally produced streams must not pass the validator
     # with a claim-free, reason-free skip)
     if (record.get("kind") in ("decode", "longseq_bias", "tp_overlap",
-                               "profile")
+                               "profile", "serve")
             and record.get("status") == "SKIP"
             and not record.get("reason")):
         errors.append(

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 
 from apex_tpu.ops import _backend
 from apex_tpu.ops.pallas.attention import NEG_INF
-from apex_tpu.ops.pallas.decode_attention import decode_attn_fwd
+from apex_tpu.ops.pallas.decode_attention import (decode_attn_fwd,
+                                                  decode_attn_paged_fwd)
 
 
 def decode_kernel_ok(max_s: int, d: int, dtype) -> bool:
@@ -33,6 +34,25 @@ def decode_kernel_ok(max_s: int, d: int, dtype) -> bool:
     engine allocates ``max_s`` as a 128-multiple precisely so this holds."""
     return (max_s % 128 == 0 and (d % 128 == 0 or d == 64)
             and dtype != jnp.float16)
+
+
+def paged_kernel_ok(block_size: int, d: int, dtype) -> bool:
+    """Mosaic eligibility for the PAGED decode kernel: each cache block is
+    one kernel kv-block, so the block size itself must be a 128-multiple
+    (the serving engine defaults to 128 on TPU precisely so this holds);
+    d/dtype rules are the contiguous kernel's."""
+    return decode_kernel_ok(block_size, d, dtype)
+
+
+def _gather_blocks(pool, tables):
+    """(num_blocks, h_kv, bs, d) pool + (b, nb) tables → the contiguous
+    (b, h_kv, nb·bs, d) per-slot view — the XLA fallback materializes the
+    indirection as one gather, then runs the EXACT contiguous math (so
+    paged == contiguous is bitwise on this path, the parity tests'
+    anchor)."""
+    g = pool[tables]  # (b, nb, h_kv, bs, d)
+    b, nb, h_kv, bs, d = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, h_kv, nb * bs, d)
 
 
 def _xla_decode(q, k, v, lengths, scale, bias=None):
@@ -67,6 +87,7 @@ def _xla_decode(q, k, v, lengths, scale, bias=None):
 def decode_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, lengths: jax.Array,
     *, scale: Optional[float] = None, impl: str = "auto", bias=None,
+    block_tables: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention of ONE query token per sequence over a KV cache.
 
@@ -91,12 +112,28 @@ def decode_attention(
     table (offsets are cache positions; the container's q/k offsets are
     ignored here). The decode sibling of the flash kernels' in-kernel
     bucketed bias.
+
+    ``block_tables``: the PAGED cache path (the serving engine's
+    block-pool layout, :mod:`apex_tpu.serving.kv_blocks`). ``k``/``v``
+    are then the SHARED pool ``(num_blocks, h_kv, block_size, d)`` and
+    ``block_tables`` is ``(b, nb_max)`` int32 — slot i's j-th logical kv
+    block lives at pool index ``block_tables[i, j]``; logical length
+    masking, block skip, and the bias are unchanged (columns stay
+    logical positions). Every table entry must be a valid pool index —
+    fill unused entries with the engine's reserved dead block 0 (their
+    DMA runs but their columns are masked/skipped). The XLA fallback
+    gathers the table into the contiguous view and runs the contiguous
+    math, so paged == contiguous bitwise on that path.
     """
     if q.ndim != 3 or k.ndim != 4 or k.shape != v.shape:
         raise ValueError(
             f"decode_attention takes q (b, h, d) and k/v (b, h_kv, max_s, "
-            f"d); got q {q.shape}, k {k.shape}, v {v.shape}")
+            f"d) — or (num_blocks, h_kv, block_size, d) pools with "
+            f"block_tables; got q {q.shape}, k {k.shape}, v {v.shape}")
     b, h, d = q.shape
+    if block_tables is not None:
+        return _paged_decode_attention(q, k, v, lengths, block_tables,
+                                       scale=scale, impl=impl, bias=bias)
     h_kv, max_s = k.shape[1], k.shape[2]
     if k.shape[0] != b or k.shape[3] != d or h % h_kv:
         raise ValueError(
@@ -108,25 +145,7 @@ def decode_attention(
     group = h // h_kv
     scale = float(scale if scale is not None else 1.0 / d ** 0.5)
     qg = q.reshape(b, h_kv, group, d)
-    rel_bias = None
-    if bias is not None:
-        from apex_tpu.ops.attention import BucketedBias, _validate_bucketed
-        if not isinstance(bias, BucketedBias):
-            raise ValueError(
-                "decode_attention takes bias as a BucketedBias (decode "
-                "recomputes the bias from the table and the live length; "
-                "a materialized array has no decode form)")
-        _validate_bucketed(bias)
-        if bias.bidirectional:
-            raise ValueError(
-                "decode bias must use causal bucketing "
-                "(bidirectional=False) — the query IS the last position")
-        if bias.heads != h:
-            raise ValueError(
-                f"decode bias table heads ({bias.heads}) must equal q "
-                f"heads ({h})")
-        rel_bias = (bias.kernel_operands()[0],
-                    (bias.num_buckets, bias.max_distance))
+    rel_bias = _validate_decode_bias(bias, h)
 
     # gate on BOTH operand dtypes: a mixed fp16 cache under fp32 q must
     # fall back too (Mosaic has no f16 in any operand position)
@@ -142,6 +161,76 @@ def decode_attention(
         k.reshape(b * h_kv, max_s, d),
         v.reshape(b * h_kv, max_s, d),
         jnp.repeat(lengths, h_kv),
+        scale=scale, rel_bias=rel_bias,
+        interpret=_backend.interpret_mode())
+    return o.reshape(b, h, d)
+
+
+def _validate_decode_bias(bias, h):
+    """Shared bias validation for the contiguous and paged paths →
+    ``(table, (num_buckets, max_distance))`` kernel operands or None."""
+    if bias is None:
+        return None
+    from apex_tpu.ops.attention import BucketedBias, _validate_bucketed
+    if not isinstance(bias, BucketedBias):
+        raise ValueError(
+            "decode_attention takes bias as a BucketedBias (decode "
+            "recomputes the bias from the table and the live length; "
+            "a materialized array has no decode form)")
+    _validate_bucketed(bias)
+    if bias.bidirectional:
+        raise ValueError(
+            "decode bias must use causal bucketing "
+            "(bidirectional=False) — the query IS the last position")
+    if bias.heads != h:
+        raise ValueError(
+            f"decode bias table heads ({bias.heads}) must equal q "
+            f"heads ({h})")
+    return (bias.kernel_operands()[0],
+            (bias.num_buckets, bias.max_distance))
+
+
+def _paged_decode_attention(q, k, v, lengths, block_tables, *, scale,
+                            impl, bias):
+    """The block-table indirection path: the pool layout + table resolve
+    to the same logical (b, h_kv, nb·bs, d) cache the contiguous path
+    reads — by one gather on the XLA fallback, by scalar-prefetched
+    index maps on the kernel path."""
+    b, h, d = q.shape
+    num_blocks, h_kv, bs = k.shape[0], k.shape[1], k.shape[2]
+    if k.shape[3] != d or h % h_kv:
+        raise ValueError(
+            f"paged cache pool (num_blocks, h_kv, block_size, d) must "
+            f"match q's head_dim with h_kv | h; got q {q.shape} vs pool "
+            f"{k.shape}")
+    if block_tables.ndim != 2 or block_tables.shape[0] != b:
+        raise ValueError(
+            f"block_tables must be (b={b}, nb_max) int32; got "
+            f"{block_tables.shape}")
+    if not jnp.issubdtype(block_tables.dtype, jnp.integer):
+        raise ValueError(
+            f"block_tables must be integer block ids; got "
+            f"{block_tables.dtype}")
+    if lengths.shape != (b,):
+        raise ValueError(f"lengths must be ({b},); got {lengths.shape}")
+    lengths = lengths.astype(jnp.int32)
+    group = h // h_kv
+    scale = float(scale if scale is not None else 1.0 / d ** 0.5)
+    qg = q.reshape(b, h_kv, group, d)
+    rel_bias = _validate_decode_bias(bias, h)
+
+    ok = paged_kernel_ok(bs, d, q.dtype) and k.dtype != jnp.float16
+    use_pallas = _backend.choose_impl(impl, ok) == "pallas"
+    if not use_pallas:
+        return _xla_decode(qg, _gather_blocks(k, block_tables),
+                           _gather_blocks(v, block_tables), lengths,
+                           scale, bias).reshape(b, h, d)
+    o = decode_attn_paged_fwd(
+        qg.reshape(b * h_kv, group, d),
+        k.reshape(num_blocks * h_kv, bs, d),
+        v.reshape(num_blocks * h_kv, bs, d),
+        jnp.repeat(lengths, h_kv),
+        block_tables,
         scale=scale, rel_bias=rel_bias,
         interpret=_backend.interpret_mode())
     return o.reshape(b, h, d)

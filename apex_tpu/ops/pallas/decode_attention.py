@@ -24,6 +24,18 @@ in a long cache pay MXU time proportional to the *current* length.
 GQA falls out of the layout: the group's q heads share the kv row as
 rows of one (group, bk) score block — the head-grouping analog of the
 head-batched projection layout (PERF.md). MQA is group == h.
+
+PAGED variant (:func:`decode_attn_paged_fwd`): the serving engine's KV
+cache is not one contiguous ``max_s`` strip per sequence but a set of
+fixed-size BLOCKS scattered through one shared pool
+(``apex_tpu.serving.kv_blocks``), named by a per-slot block table. The
+kernel body is IDENTICAL — same online-softmax recurrence, same
+dead-row/length masking, same block skip — only the *address* of each kv
+block changes: the table rides as a scalar-prefetch operand
+(``pltpu.PrefetchScalarGridSpec``) so the BlockSpec index map can read
+``table[slot, j]`` on the scalar core while computing the j-th block's
+DMA source. Logical column positions (``j*bs + iota``) are unchanged, so
+length masking and the in-kernel relative bias work untouched.
 """
 
 from __future__ import annotations
@@ -164,3 +176,77 @@ def decode_attn_fwd(q, k, v, lengths, *, scale, rel_bias=None, bk=512,
         ),
         interpret=interpret,
     )(*args)
+
+
+def _paged_kernel(tbl_ref, *refs, scale, bk, nk, rel=None):
+    """Scalar-prefetch wrapper: the block table is consumed entirely by
+    the index maps (it addresses the DMAs); the body never touches it —
+    logical positions, masking and bias are exactly the contiguous
+    kernel's."""
+    del tbl_ref
+    _decode_kernel(*refs, scale=scale, bk=bk, nk=nk, rel=rel)
+
+
+def decode_attn_paged_fwd(q, k_pool, v_pool, lengths, block_tables, *,
+                          scale, rel_bias=None, interpret=False):
+    """Paged decode attention: q ``(rows, group, d)`` with
+    ``rows = b·h_kv``; ``k_pool``/``v_pool`` ``(num_blocks·h_kv, bs, d)``
+    — the free reshape of the serving pool's ``(num_blocks, h_kv, bs,
+    d)`` layout; ``block_tables`` ``(b, nb_max)`` int32 mapping each
+    slot's j-th LOGICAL kv block to a pool block id; ``lengths``
+    ``(rows,)`` int32 live positions per row. Every table entry must be
+    a valid pool index — the engine zero-fills unused entries with the
+    reserved dead block 0, whose DMA is harmless (blocks past a row's
+    length are compute-skipped, and in-block tails are masked by the
+    length like the contiguous kernel). Returns (rows, group, d).
+
+    ``rel_bias`` as in :func:`decode_attn_fwd` (cols are logical
+    positions, so the causal bucketed bias is indirection-oblivious).
+    """
+    rows, group, d = q.shape
+    b, nb = block_tables.shape
+    h_kv = rows // b
+    bs = k_pool.shape[1]
+    rel, rel_static = (None, None) if rel_bias is None else (
+        rel_bias[0], rel_bias[1])
+
+    # index maps receive the prefetched table LAST; k/v maps translate
+    # (row, j) -> pool row table[row // h_kv, j] * h_kv + row % h_kv
+    in_specs = [
+        pl.BlockSpec((1, group, d), lambda r, j, tbl: (r, 0, 0)),
+        pl.BlockSpec((1, bs, d),
+                     lambda r, j, tbl, hk=h_kv: (tbl[r // hk, j] * hk
+                                                 + r % hk, 0, 0)),
+        pl.BlockSpec((1, bs, d),
+                     lambda r, j, tbl, hk=h_kv: (tbl[r // hk, j] * hk
+                                                 + r % hk, 0, 0)),
+        pl.BlockSpec((1, 1, _LSE_LANES), lambda r, j, tbl: (r, 0, 0)),
+    ]
+    args = [q, k_pool, v_pool, _kvlen_rows(lengths, rows)]
+    if rel is not None:
+        in_specs.append(pl.BlockSpec(
+            (group, _REL_LANES),
+            lambda r, j, tbl, hk=h_kv: (r % hk, 0)))
+        args.append(rel)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(rows, nb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, group, d), lambda r, j, tbl: (r, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_kernel, scale=scale, bk=bs, nk=nb,
+                          rel=rel_static),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((rows, group, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), *args)

@@ -1,0 +1,42 @@
+"""Continuous-batching serving: paged KV cache + request scheduler +
+chunked prefill on top of the decode stack.
+
+``apex_tpu.inference.DecodeEngine`` decodes ONE fixed batch end to end —
+every sequence prefills together, decodes in lockstep, and finishes
+together. Real traffic is nothing like that: requests of mixed prompt
+and output lengths arrive continuously, and a production engine must
+admit and retire them *between* decode steps without ever recompiling or
+stalling the in-flight streams. This package is that engine:
+
+* :mod:`~apex_tpu.serving.kv_blocks` — the **paged KV cache**: one
+  pre-allocated, donated pool of fixed-size blocks shared by every
+  request, a host-side free-list :class:`~apex_tpu.serving.kv_blocks.
+  BlockAllocator`, and per-slot block tables. Cache memory is bound by
+  LIVE tokens, not ``batch × max_s``.
+* :mod:`~apex_tpu.serving.scheduler` — the **continuous-batching
+  scheduler**: a fixed-width slot array with admit/evict between steps
+  by mutating cache contents, tables, and lengths only (stable avals —
+  the jit cache stays at ONE executable across arbitrary churn), FCFS
+  admission behind a worst-case block-reservation gate (no mid-flight
+  OOM, no preemption needed), and **chunked prefill** so a long prompt
+  never stalls the streams already decoding.
+* :mod:`~apex_tpu.serving.engine` — :class:`~apex_tpu.serving.engine.
+  ServingEngine`: the jitted ``prefill_chunk`` / ``decode_step`` pair
+  (each compiles once), the paged decode attention
+  (:func:`apex_tpu.ops.decode_attention` with ``block_tables=``), and
+  the fused sampling tail (:func:`apex_tpu.ops.fused_sample`).
+
+Serving throughput/latency under churn is measured by ``python bench.py
+--serve`` (one schema-validated ``serve`` monitor record); the greedy
+no-churn output is token-identical to ``DecodeEngine`` (the parity the
+bench asserts). See ``docs/api/inference.md`` for block math and the
+scheduler contract.
+"""
+
+from apex_tpu.serving.engine import ServingEngine  # noqa: F401
+from apex_tpu.serving.kv_blocks import (  # noqa: F401
+    DEAD_BLOCK,
+    BlockAllocator,
+    blocks_needed,
+)
+from apex_tpu.serving.scheduler import Request, Scheduler  # noqa: F401

@@ -1,0 +1,366 @@
+"""ServingEngine: continuous-batching generation over a paged KV cache.
+
+The device side of :mod:`apex_tpu.serving` — exactly TWO compiled
+programs, each with one set of avals for the lifetime of the engine:
+
+* ``prefill_chunk(params, pool, table_row, tokens, start, live, key)``
+  — one fixed-size chunk of ONE slot's prompt through the stack: the
+  chunk's k/v land in the slot's pool blocks (a scatter at traced block
+  ids — blocks fully past the live tokens are redirected to the dead
+  block so ragged final chunks never touch foreign memory), attention
+  runs chunk-queries × the slot's gathered padded cache under the
+  prefix-causal mask ``key_pos <= start + i``, and the LAST chunk's
+  final-row logits sample the request's first token. ``start``/``live``
+  are traced scalars, so every chunk of every prompt length is the same
+  executable.
+* ``decode_step(params, pool, tables, tokens, lengths, key)`` — one
+  token for EVERY slot at once: per-slot cache writes resolve
+  ``(block, row)`` through the table (dead slots' writes land in the
+  dead block), attention is the paged
+  :func:`apex_tpu.ops.decode_attention` (``lengths == 0`` rows are dead
+  by the kernel's convention), and the fused sampling tail
+  (:func:`apex_tpu.ops.fused_sample`) turns logits into tokens in one
+  dispatch.
+
+Both donate the pool: XLA updates the cache in place, so a step's HBM
+traffic is the live cache read plus one token's writes — never a pool
+copy. Everything dynamic about traffic stays in
+:class:`~apex_tpu.serving.scheduler.Scheduler` on the host; churn
+reaches the device only as operand *contents*, which is why
+``decode_step._cache_size()`` stays 1 across arbitrary admit/evict
+(asserted by ``tests/test_serving.py`` and by ``bench.py --serve``).
+
+The chunk-attention gather materializes one ``(h_kv, max_s, d)`` view
+per layer per chunk — prefill is compute-bound and infrequent relative
+to decode, so this buys simplicity where it is cheap; fusing the
+chunk path into the flash family is future work (the decode hot path,
+where the HBM bound lives, is already fused end to end).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models.gpt import GPTModel
+from apex_tpu.ops import fused_layer_norm, fused_sample
+from apex_tpu.ops.pallas.attention import NEG_INF
+from apex_tpu.serving.kv_blocks import DEAD_BLOCK, BlockAllocator
+from apex_tpu.serving.scheduler import Request, Scheduler
+
+
+@dataclass
+class ServeStats:
+    """Host-side accounting of one :meth:`ServingEngine.serve` call."""
+
+    decode_steps: int = 0
+    prefill_chunks: int = 0
+    blocks_high_water: int = 0
+    occupancy_samples: List[int] = field(default_factory=list)
+
+    def occupancy_pct(self, num_slots: int) -> Optional[float]:
+        if not self.occupancy_samples:
+            return None
+        return (100.0 * sum(self.occupancy_samples)
+                / (len(self.occupancy_samples) * num_slots))
+
+
+class ServingEngine:
+    """Continuous-batching serving over a :class:`GPTModel`.
+
+    ``engine = ServingEngine(model, num_slots=8, block_size=128)``;
+    ``results = engine.serve(params, requests)`` — each
+    :class:`~apex_tpu.serving.scheduler.Request` comes back with its
+    generated tokens and latency stamps.
+
+    Knobs (all static — they shape the two compiled programs):
+
+    * ``num_slots`` — concurrent streams; the decode step's batch width.
+    * ``block_size`` — cache page granularity; 128 on TPU (the paged
+      kernel's lane-tiling constraint), smaller off-TPU if desired.
+    * ``max_seq_len`` — per-slot logical cap (prompt + generated - 1
+      rows); must be a ``block_size`` multiple. Defaults to the model's
+      position table rounded DOWN to the block grid.
+    * ``num_blocks`` — pool capacity + 1 dead block. Defaults to full
+      capacity (``num_slots * max_seq_len/block_size + 1``); size it
+      DOWN to what live traffic needs — that is the point of paging —
+      and the scheduler's reservation gate turns the shortfall into
+      queueing instead of failure.
+    * ``prefill_chunk`` — prompt tokens per prefill step (a
+      ``block_size`` multiple); smaller chunks interleave tighter with
+      decode (less per-step jitter), larger chunks reach the first
+      token sooner.
+    * ``temperature`` / ``top_k`` / ``top_p`` — the fused sampling
+      tail's static program (greedy when ``temperature == 0``).
+    """
+
+    def __init__(self, model: GPTModel, *, num_slots: int,
+                 block_size: int = 128, num_blocks: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 cache_dtype: Any = None, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0):
+        model.check_decode_supported()
+        self.model = model
+        c = self.config = model.config
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.block_size = int(block_size)
+        max_s = int(max_seq_len if max_seq_len is not None
+                    else c.max_seq_len - c.max_seq_len % self.block_size)
+        if max_s < self.block_size or max_s % self.block_size:
+            raise ValueError(
+                f"max_seq_len ({max_s}) must be a positive multiple of "
+                f"block_size ({self.block_size}) — round up: "
+                f"max_seq_len={-(-max_s // self.block_size) * self.block_size}")
+        if max_s > c.max_seq_len:
+            raise ValueError(
+                f"max_seq_len ({max_s}) exceeds the model's position "
+                f"table ({c.max_seq_len})")
+        self.max_s = max_s
+        self.max_blocks_per_slot = max_s // self.block_size
+        self.num_slots = int(num_slots)
+        if self.num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        full = self.num_slots * self.max_blocks_per_slot + 1
+        self.num_blocks = int(num_blocks if num_blocks is not None else full)
+        self.prefill_chunk_size = int(
+            prefill_chunk if prefill_chunk is not None else self.block_size)
+        if (self.prefill_chunk_size < self.block_size
+                or self.prefill_chunk_size % self.block_size):
+            raise ValueError(
+                f"prefill_chunk ({self.prefill_chunk_size}) must be a "
+                f"positive multiple of block_size ({self.block_size})")
+        self.cache_dtype = cache_dtype or c.dtype
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.last_stats: Optional[ServeStats] = None
+        # one jitted executable each; both donate the pool (argnums:
+        # params=0, pool=1, ... — the cache updates in place)
+        self.prefill_chunk = jax.jit(self._prefill_chunk,
+                                     donate_argnums=(1,))
+        self.decode_step = jax.jit(self._decode_step, donate_argnums=(1,))
+
+    # --- pool ----------------------------------------------------------------
+
+    def init_pool(self) -> Dict[str, jax.Array]:
+        """The zeroed block pool:
+        ``{"k"/"v": (layers, num_blocks, kv_heads, block_size, head_dim)}``
+        — block 0 is the dead block (see kv_blocks)."""
+        c = self.config
+        shape = (c.num_layers, self.num_blocks, c.local_kv_heads,
+                 self.block_size, c.head_dim)
+        return {"k": jnp.zeros(shape, self.cache_dtype),
+                "v": jnp.zeros(shape, self.cache_dtype)}
+
+    def pool_bytes(self) -> int:
+        """HBM footprint of the whole pool (both k and v)."""
+        c = self.config
+        itemsize = jnp.dtype(self.cache_dtype).itemsize
+        return (2 * c.num_layers * self.num_blocks * c.local_kv_heads
+                * self.block_size * c.head_dim * itemsize)
+
+    # --- sampling tail -------------------------------------------------------
+
+    def _sample(self, logits, key):
+        return fused_sample(logits, key, temperature=self.temperature,
+                            top_k=self.top_k, top_p=self.top_p)
+
+    # --- prefill chunk -------------------------------------------------------
+
+    def _prefill_chunk(self, params, pool, table_row, tokens, start, live,
+                       key):
+        """One chunk of ONE slot's prompt: ``tokens`` (C,) are prompt
+        positions [start, start+C) with the first ``live`` valid (the
+        final chunk is ragged; pad rows are written but land either
+        behind the live frontier — overwritten by decode later — or in
+        the dead block). Returns ``(pool, first_token, last_logits)``;
+        the token/logits are meaningful on the LAST chunk only (row
+        ``live - 1`` is then the prompt's final token). ``start`` and
+        ``live`` are traced: one executable for every chunk of every
+        prompt."""
+        model, c = self.model, self.config
+        C, B = self.prefill_chunk_size, self.block_size
+        nb, max_s = self.max_blocks_per_slot, self.max_s
+        h_kv, group = c.local_kv_heads, c.local_heads // c.local_kv_heads
+        d = c.head_dim
+        start = jnp.asarray(start, jnp.int32)
+        live = jnp.asarray(live, jnp.int32)
+
+        x = model.embedding(params["embedding"], tokens[None])  # (1, C, H)
+        pos = start + jnp.arange(C, dtype=jnp.int32)
+        ptab = params["pos_embedding"]
+        x = x + jnp.take(ptab, jnp.minimum(pos, ptab.shape[0] - 1),
+                         axis=0)[None]
+
+        # the chunk's target blocks: C/B table entries from start/B on
+        # (chunks are start-aligned: start is always a C-multiple, C a
+        # B-multiple); blocks with no live token redirect to the dead
+        # block so the ragged tail cannot touch another slot's memory
+        nblk = C // B
+        ids = jax.lax.dynamic_slice(table_row.astype(jnp.int32),
+                                    (start // B,), (nblk,))
+        blk_live = (jnp.arange(nblk, dtype=jnp.int32) * B) < live
+        ids = jnp.where(blk_live, ids, DEAD_BLOCK)
+
+        scale = 1.0 / d ** 0.5
+        js = jnp.arange(max_s, dtype=jnp.int32)
+        mask = js[None, None, None, :] <= pos[None, None, :, None]
+        ck, cv = pool["k"], pool["v"]
+        for i in range(c.num_layers):
+            layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+            h_in = fused_layer_norm(x, layer["ln1_w"], layer["ln1_b"])
+            q, k, v = model._proj_qkv_bshd(layer, h_in)
+            # chunk k/v → (C/B, h_kv, B, d) block scatter at traced ids
+            kb = k[0].reshape(nblk, B, h_kv, d).transpose(0, 2, 1, 3)
+            vb = v[0].reshape(nblk, B, h_kv, d).transpose(0, 2, 1, 3)
+            ck = ck.at[i, ids].set(kb.astype(ck.dtype))
+            cv = cv.at[i, ids].set(vb.astype(cv.dtype))
+            # prefix attention: chunk queries × the slot's gathered
+            # padded cache (chunk rows included — causal within the
+            # chunk falls out of the same mask)
+            k_all = ck[i][table_row].transpose(1, 0, 2, 3) \
+                .reshape(h_kv, max_s, d)
+            v_all = cv[i][table_row].transpose(1, 0, 2, 3) \
+                .reshape(h_kv, max_s, d)
+            qg = q[0].reshape(C, h_kv, group, d).transpose(1, 2, 0, 3)
+            s = jnp.einsum("hgcd,hsd->hgcs", qg,
+                           k_all.astype(qg.dtype),
+                           preferred_element_type=jnp.float32) * scale
+            s = jnp.where(mask, s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("hgcs,hsd->hgcd", p.astype(v_all.dtype), v_all)
+            ctx = ctx.transpose(2, 0, 1, 3).reshape(1, C, c.local_heads, d)
+            x = x + model._proj_attn_out(layer, ctx)
+            x = x + model._mlp(layer, fused_layer_norm(
+                x, layer["ln2_w"], layer["ln2_b"]))
+        x = fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
+        last = jax.lax.dynamic_slice(
+            x, (jnp.int32(0), live - 1, jnp.int32(0)),
+            (1, 1, c.hidden_size))
+        logits = model.unembed(params, last)[:, 0]  # (1, V)
+        return {"k": ck, "v": cv}, self._sample(logits, key)[0], logits[0]
+
+    # --- decode step ---------------------------------------------------------
+
+    def _decode_step(self, params, pool, tables, tokens, lengths, key):
+        """One token for EVERY slot: ``tokens`` (S,) are each slot's
+        incoming sampled tokens, ``lengths`` (S,) the live rows INCLUDING
+        them (0 = dead slot: write lands in the dead block, attention
+        output zeros, sampled value ignored by the host). Returns
+        ``(pool, next_tokens, logits)``. Avals are churn-independent:
+        compiled exactly once."""
+        model, c = self.model, self.config
+        B = self.block_size
+        lengths = lengths.astype(jnp.int32)
+        pos = jnp.maximum(lengths - 1, 0)  # the incoming token's position
+        x = model.embedding(params["embedding"], tokens[:, None])
+        ptab = params["pos_embedding"]
+        x = x + jnp.take(ptab, jnp.minimum(pos, ptab.shape[0] - 1),
+                         axis=0)[:, None]
+        tables = tables.astype(jnp.int32)
+        bid = jnp.take_along_axis(tables, (pos // B)[:, None], axis=1)[:, 0]
+        # dead slots (lengths == 0) write to the dead block NO MATTER what
+        # their table row says: a slot mid-prefill is dead for decode but
+        # its table already names real blocks — an unredirected write
+        # would corrupt its own freshly prefilled cache
+        bid = jnp.where(lengths > 0, bid, DEAD_BLOCK)
+        row = pos % B
+        rel_hook = getattr(model, "decode_rel_bias", None)
+        rel_bias = None if rel_hook is None else rel_hook(params)
+        ck, cv = pool["k"], pool["v"]
+        for i in range(c.num_layers):
+            layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+            q, k_row, v_row = model.decode_qkv(layer, x)
+            # per-slot (block, row) scatter into the DONATED pool; dead
+            # slots carry table rows of DEAD_BLOCK, so their writes are
+            # absorbed harmlessly
+            ck = ck.at[i, bid, :, row].set(k_row[:, :, 0].astype(ck.dtype))
+            cv = cv.at[i, bid, :, row].set(v_row[:, :, 0].astype(cv.dtype))
+            x = model.decode_block(layer, x, q, ck[i], cv[i], lengths,
+                                   rel_bias=rel_bias, block_tables=tables)
+        x = fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
+        logits = model.unembed(params, x)[:, 0]  # (S, V)
+        return {"k": ck, "v": cv}, self._sample(logits, key), logits
+
+    # --- the serving loop ----------------------------------------------------
+
+    def make_scheduler(self) -> Scheduler:
+        """A fresh scheduler + allocator matching this engine's pool."""
+        return Scheduler(
+            num_slots=self.num_slots, block_size=self.block_size,
+            max_blocks_per_slot=self.max_blocks_per_slot,
+            allocator=BlockAllocator(self.num_blocks),
+            prefill_chunk=self.prefill_chunk_size)
+
+    def serve(self, params, requests: List[Request], *,
+              key: Optional[jax.Array] = None,
+              clock: Optional[Callable[[], float]] = None,
+              scheduler: Optional[Scheduler] = None) -> List[Request]:
+        """Run ``requests`` to completion; returns them in completion
+        order with tokens and latency stamps filled in.
+
+        Each loop iteration runs at most ONE prefill chunk and ONE
+        decode step over the whole slot array — admission and prefill
+        interleave with decode instead of stalling it. ``clock`` (a
+        monotonically advancing ``() -> seconds`` callable, default
+        ``time.perf_counter``) drives arrival replay and the latency
+        stamps; requests whose ``arrival_s`` is in the future are held
+        until the clock passes it. ``scheduler`` injects a pre-built
+        scheduler (tests script churn through it)."""
+        if self.temperature > 0 and key is None:
+            raise ValueError("temperature > 0 serving requires a key")
+        if key is None:  # greedy: the key operand is ignored but keeps
+            # the step signature (and avals) fixed
+            key = jax.random.PRNGKey(0)  # apexlint: disable=APX502
+        wall = clock is None
+        clock = time.perf_counter if clock is None else clock
+        t0 = clock()
+        now = lambda: clock() - t0  # noqa: E731
+        sched = scheduler if scheduler is not None else self.make_scheduler()
+        for r in requests:
+            sched.submit(r)
+        pool = self.init_pool()
+        stats = ServeStats()
+        nstep = 0
+        while not sched.idle():
+            sched.admit(now())
+            did_work = False
+            work = sched.next_prefill()
+            if work is not None:
+                pool, tok, _ = self.prefill_chunk(
+                    params, pool,
+                    jnp.asarray(sched.tables.row(work.slot)),
+                    jnp.asarray(work.tokens),
+                    jnp.int32(work.start), jnp.int32(work.live),
+                    jax.random.fold_in(key, nstep))
+                nstep += 1
+                stats.prefill_chunks += 1
+                sched.note_prefill(work, int(tok), now())
+                did_work = True
+            batch = sched.decode_batch()
+            if batch is not None:
+                toks, lens = batch
+                ndec = len(sched.decoding_slots())
+                pool, sampled, _ = self.decode_step(
+                    params, pool, jnp.asarray(sched.tables.asarray()),
+                    jnp.asarray(toks), jnp.asarray(lens),
+                    jax.random.fold_in(key, nstep))
+                nstep += 1
+                stats.decode_steps += 1
+                stats.occupancy_samples.append(ndec)
+                sched.note_decode(np.asarray(sampled), now())
+                did_work = True
+            stats.blocks_high_water = max(stats.blocks_high_water,
+                                          sched.allocator.num_live)
+            if not did_work and wall:
+                # nothing runnable: only future arrivals remain
+                time.sleep(1e-4)
+        self.last_stats = stats
+        return sched.completed

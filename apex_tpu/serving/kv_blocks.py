@@ -1,0 +1,122 @@
+"""Paged KV cache: fixed-size blocks in one donated pool, host free list,
+per-slot block tables.
+
+The contiguous engine allocates ``batch × max_s`` cache rows up front —
+every admitted request pays for the LONGEST possible sequence whether it
+uses 30 tokens or 3000. Paging breaks the cache into fixed-size blocks
+(``block_size`` tokens each) living in ONE pre-allocated pool
+
+    {"k"/"v": (layers, num_blocks, kv_heads, block_size, head_dim)}
+
+and gives each slot a BLOCK TABLE mapping its logical kv blocks to pool
+indices. Memory is then bound by live tokens (rounded up to the block),
+the pool aval never changes (stable avals → the decode step compiles
+once), and admit/evict is pure host bookkeeping: allocate/free block ids
+and rewrite a table row — the device arrays are never reshaped.
+
+Device-side consumers resolve the indirection two ways: the Pallas
+decode kernel reads the table as a scalar-prefetch operand inside its
+BlockSpec index maps (:func:`apex_tpu.ops.pallas.decode_attention.
+decode_attn_paged_fwd`); the XLA fallback gathers the table into the
+contiguous view. Both are driven through
+``decode_attention(..., block_tables=)``.
+
+Block 0 is the reserved **dead block**: never allocated, it absorbs the
+writes of inactive slots and backs every unused table entry, so the
+device step needs no masking branches for slots that do not exist —
+their DMAs land somewhere harmless and their columns are length-masked
+anyway. All bookkeeping here is plain host Python/numpy (never traced).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+import numpy as np
+
+# pool index every unused table entry and every inactive-slot write
+# resolves to; excluded from the free list forever
+DEAD_BLOCK = 0
+
+
+def blocks_needed(tokens: int, block_size: int) -> int:
+    """Blocks covering ``tokens`` cache rows: ceil(tokens / block_size)."""
+    return -(-int(tokens) // int(block_size))
+
+
+class BlockAllocator:
+    """Host-side free list over pool blocks ``[1, num_blocks)``.
+
+    LIFO reuse (a just-freed block is hottest in cache and cheapest to
+    re-DMA) with double-free/foreign-id checks — an allocator bug here
+    would silently cross-wire two requests' caches, so it must be loud.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"the pool needs >= 2 blocks (block {DEAD_BLOCK} is the "
+                f"reserved dead block); got num_blocks={num_blocks}")
+        self.num_blocks = int(num_blocks)
+        # ascending pop order on a fresh pool: low ids first
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._live: set = set()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_live(self) -> int:
+        return len(self._live)
+
+    def allocate(self, n: int = 1) -> List[int]:
+        """Pop ``n`` block ids; raises when the pool cannot satisfy it
+        (callers gate admission on :attr:`num_free`, so hitting this is
+        a scheduler bug, not backpressure)."""
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV block pool exhausted: requested {n} blocks with "
+                f"{len(self._free)} free of {self.num_blocks - 1} "
+                f"allocatable — the scheduler's reservation gate should "
+                f"have prevented this")
+        ids = [self._free.pop() for _ in range(n)]
+        self._live.update(ids)
+        return ids
+
+    def free(self, ids: Iterable[int]) -> None:
+        for bid in ids:
+            bid = int(bid)
+            if bid == DEAD_BLOCK:
+                raise ValueError("cannot free the reserved dead block")
+            if bid not in self._live:
+                raise ValueError(
+                    f"double free / foreign block id {bid} (not live)")
+            self._live.remove(bid)
+            self._free.append(bid)
+
+
+class BlockTables:
+    """Per-slot block tables: ``(num_slots, max_blocks)`` int32 host
+    array, every unused entry pinned at :data:`DEAD_BLOCK`. The device
+    step receives a copy each call (same aval every time — the contents
+    churn, the shape never does)."""
+
+    def __init__(self, num_slots: int, max_blocks: int):
+        self.num_slots = int(num_slots)
+        self.max_blocks = int(max_blocks)
+        self._table = np.zeros((self.num_slots, self.max_blocks), np.int32)
+
+    def assign(self, slot: int, logical_idx: int, block_id: int) -> None:
+        self._table[slot, logical_idx] = block_id
+
+    def row(self, slot: int) -> np.ndarray:
+        return self._table[slot]
+
+    def clear(self, slot: int) -> None:
+        self._table[slot] = DEAD_BLOCK
+
+    def asarray(self) -> np.ndarray:
+        """The full (num_slots, max_blocks) table (a view; callers hand
+        it to jnp.asarray which copies to device)."""
+        return self._table

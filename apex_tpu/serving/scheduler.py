@@ -1,0 +1,324 @@
+"""Continuous-batching request scheduler: fixed slots, paged blocks,
+chunked prefill — all host-side bookkeeping, zero retraces.
+
+The device side of serving is two compiled programs with FIXED avals
+(``ServingEngine.prefill_chunk`` / ``decode_step``); everything dynamic
+about traffic — arrivals, mixed lengths, completions — lives HERE, in
+plain Python, and is expressed to the device only as *contents* of
+fixed-shape operands (tokens, lengths, block tables). That split is the
+whole trick: admit/evict between steps mutates a table row and a length,
+never an aval, so the jit cache stays at one executable across arbitrary
+churn (asserted by ``tests/test_serving.py``).
+
+Policies (deliberately simple, each replaceable without touching the
+device programs):
+
+* **FCFS admission behind a worst-case reservation gate.** A request is
+  admitted when a slot is free AND the pool can still cover EVERY
+  in-flight request's worst case (``prompt + max_new_tokens`` rounded up
+  to blocks) plus this one's. Blocks are *allocated* lazily as tokens
+  actually land (memory ~ live tokens) but *reserved* pessimistically,
+  so in-flight streams can never deadlock on the pool — no preemption
+  machinery needed.
+* **Chunked prefill.** Prompts enter the cache ``prefill_chunk`` tokens
+  at a time, one chunk per scheduler iteration, interleaved with decode
+  steps — a long prompt never stalls streams that are already decoding
+  (the chunk size is the knob trading time-to-first-token against
+  decode-step jitter).
+* **Eviction = free + clear.** A finished request's blocks go back to
+  the free list and its table row resets to the dead block; the slot is
+  immediately admissible. No device work at all.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+import numpy as np
+
+from apex_tpu.serving.kv_blocks import (
+    DEAD_BLOCK,
+    BlockAllocator,
+    BlockTables,
+    blocks_needed,
+)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request plus its serving-side result fields.
+
+    ``arrival_s`` is on the caller's clock (the engine only admits
+    requests whose arrival is in the past — the bench uses it to replay
+    a Poisson trace). The scheduler stamps ``admit_s`` /
+    ``first_token_s`` / ``finish_s`` on the same clock and appends every
+    sampled token to ``tokens`` (so per-token latency is
+    ``np.diff(token_s)``).
+    """
+
+    rid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    arrival_s: float = 0.0
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    token_s: List[float] = dataclasses.field(default_factory=list)
+    admit_s: Optional[float] = None
+    first_token_s: Optional[float] = None
+    finish_s: Optional[float] = None
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Host state of one batch slot (None request = free)."""
+
+    request: Optional[Request] = None
+    prefilled: int = 0   # prompt tokens already in the cache
+    length: int = 0      # total cache rows live (prompt + generated-1)
+    n_blocks: int = 0    # blocks allocated to this slot
+    block_ids: List[int] = dataclasses.field(default_factory=list)
+    last_token: int = 0  # the sampled token the next decode step consumes
+    generated: int = 0   # tokens sampled so far
+
+    @property
+    def free(self) -> bool:
+        return self.request is None
+
+    @property
+    def prefill_done(self) -> bool:
+        return (self.request is not None
+                and self.prefilled >= len(self.request.prompt))
+
+
+@dataclasses.dataclass
+class PrefillWork:
+    """One chunk of one slot's prompt: run ``tokens`` (padded to the
+    chunk size) at cache positions ``[start, start + live)``."""
+
+    slot: int
+    tokens: np.ndarray  # (prefill_chunk,) int32, zero-padded past live
+    start: int
+    live: int
+    is_last: bool
+
+
+class Scheduler:
+    """See the module docstring for the policy; this class is the
+    mechanism. Drive it as the engine does::
+
+        sched.admit(now)
+        work = sched.next_prefill()        # -> PrefillWork | None
+        ... run the chunk ...; sched.note_prefill(work, token, now)
+        batch = sched.decode_batch()       # -> (tokens, lengths) | None
+        ... run the step ...; sched.note_decode(sampled, now)
+    """
+
+    def __init__(self, *, num_slots: int, block_size: int,
+                 max_blocks_per_slot: int, allocator: BlockAllocator,
+                 prefill_chunk: int):
+        if prefill_chunk < block_size or prefill_chunk % block_size:
+            raise ValueError(
+                f"prefill_chunk ({prefill_chunk}) must be a positive "
+                f"multiple of block_size ({block_size}) — chunks write "
+                f"whole blocks")
+        self.num_slots = int(num_slots)
+        self.block_size = int(block_size)
+        self.max_blocks_per_slot = int(max_blocks_per_slot)
+        self.prefill_chunk = int(prefill_chunk)
+        self.allocator = allocator
+        self.tables = BlockTables(num_slots, max_blocks_per_slot)
+        self._slots = [_Slot() for _ in range(self.num_slots)]
+        self._waiting: Deque[Request] = deque()
+        # admission order of live slots: prefill picks the oldest first
+        self._admit_order: List[int] = []
+        self.completed: List[Request] = []
+
+    # --- capacity accounting -------------------------------------------------
+
+    def _worst_blocks(self, req: Request) -> int:
+        # generation leaves the LAST sampled token out of the cache (it
+        # is returned, never decoded from), hence the -1
+        rows = len(req.prompt) + max(req.max_new_tokens - 1, 0)
+        return blocks_needed(rows, self.block_size)
+
+    def _outstanding_reservation(self) -> int:
+        """Blocks the in-flight requests may still demand (worst case
+        minus what they already hold)."""
+        out = 0
+        for slot in self._slots:
+            if slot.request is not None:
+                out += self._worst_blocks(slot.request) - slot.n_blocks
+        return out
+
+    # --- request intake ------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        cap = self.max_blocks_per_slot * self.block_size
+        rows = len(req.prompt) + max(req.max_new_tokens - 1, 0)
+        if len(req.prompt) < 1 or req.max_new_tokens < 1:
+            raise ValueError(
+                f"request {req.rid}: prompt and max_new_tokens must be "
+                f">= 1 (the final prefill chunk samples the first token)")
+        if rows > cap:
+            raise ValueError(
+                f"request {req.rid}: prompt ({len(req.prompt)}) + "
+                f"max_new_tokens ({req.max_new_tokens}) needs {rows} "
+                f"cache rows; a slot holds {cap} "
+                f"(max_blocks_per_slot={self.max_blocks_per_slot} x "
+                f"block_size={self.block_size})")
+        # a request whose worst case exceeds the WHOLE pool could never
+        # pass the admission gate — refusing it here turns a permanent
+        # queue stall (serve() would spin forever) into an eager error
+        pool_cap = self.allocator.num_blocks - 1
+        if self._worst_blocks(req) > pool_cap:
+            raise ValueError(
+                f"request {req.rid}: worst case needs "
+                f"{self._worst_blocks(req)} blocks but the pool only has "
+                f"{pool_cap} allocatable "
+                f"(num_blocks={self.allocator.num_blocks} - 1 dead "
+                f"block); it could never be admitted — raise num_blocks "
+                f"or shorten the request")
+        self._waiting.append(req)
+
+    def admit(self, now: float) -> List[int]:
+        """Move arrived waiting requests into free slots, FCFS, while the
+        reservation gate holds. Returns the slots admitted this call."""
+        admitted = []
+        free_slots = [i for i, s in enumerate(self._slots) if s.free]
+        while (self._waiting and free_slots
+               and self._waiting[0].arrival_s <= now):
+            req = self._waiting[0]
+            if (self._worst_blocks(req) + self._outstanding_reservation()
+                    > self.allocator.num_free):
+                break  # pool pressure: hold FCFS order, retry next step
+            self._waiting.popleft()
+            i = free_slots.pop(0)
+            self._slots[i] = _Slot(request=req)
+            self._admit_order.append(i)
+            req.admit_s = now
+            admitted.append(i)
+        return admitted
+
+    # --- chunked prefill -----------------------------------------------------
+
+    def next_prefill(self) -> Optional[PrefillWork]:
+        """The oldest admitted slot still prefilling → its next chunk
+        (allocating the blocks the chunk's LIVE tokens land in)."""
+        for i in self._admit_order:
+            slot = self._slots[i]
+            if slot.request is None or slot.prefill_done:
+                continue
+            req = slot.request
+            start = slot.prefilled
+            live = min(self.prefill_chunk, len(req.prompt) - start)
+            need = blocks_needed(start + live, self.block_size) - slot.n_blocks
+            if need > 0:
+                for bid in self.allocator.allocate(need):
+                    self.tables.assign(i, slot.n_blocks, bid)
+                    slot.block_ids.append(bid)
+                    slot.n_blocks += 1
+            tokens = np.zeros((self.prefill_chunk,), np.int32)
+            tokens[:live] = req.prompt[start:start + live]
+            return PrefillWork(
+                slot=i, tokens=tokens, start=start, live=live,
+                is_last=(start + live >= len(req.prompt)))
+        return None
+
+    def note_prefill(self, work: PrefillWork, sampled_token: int,
+                     now: float) -> List[Request]:
+        """Record a finished chunk; on the LAST chunk, ``sampled_token``
+        is the request's first generated token (time-to-first-token
+        stamps here). Returns requests finished by this call
+        (max_new_tokens == 1 completes at prefill)."""
+        slot = self._slots[work.slot]
+        slot.prefilled += work.live
+        slot.length = slot.prefilled
+        if not work.is_last:
+            return []
+        req = slot.request
+        slot.last_token = int(sampled_token)
+        slot.generated = 1
+        req.tokens.append(int(sampled_token))
+        req.token_s.append(now)
+        req.first_token_s = now
+        if slot.generated >= req.max_new_tokens:
+            return [self._finish(work.slot, now)]
+        return []
+
+    # --- decode --------------------------------------------------------------
+
+    def decoding_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots)
+                if s.request is not None and s.prefill_done]
+
+    def decode_batch(self) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """The next decode step's host operands: ``(tokens, lengths)``
+        over the full slot array — ``lengths[i]`` counts live rows
+        INCLUDING slot i's incoming token (0 marks a dead slot: its row
+        is masked on device and its write lands in the dead block).
+        Allocates the new block when a slot's next position crosses a
+        block boundary. None when nothing is decoding."""
+        dec = self.decoding_slots()
+        if not dec:
+            return None
+        tokens = np.zeros((self.num_slots,), np.int32)
+        lengths = np.zeros((self.num_slots,), np.int32)
+        for i in dec:
+            slot = self._slots[i]
+            need = blocks_needed(slot.length + 1, self.block_size) \
+                - slot.n_blocks
+            if need > 0:  # reservation gate guarantees this succeeds
+                for bid in self.allocator.allocate(need):
+                    self.tables.assign(i, slot.n_blocks, bid)
+                    slot.block_ids.append(bid)
+                    slot.n_blocks += 1
+            tokens[i] = slot.last_token
+            lengths[i] = slot.length + 1
+        return tokens, lengths
+
+    def note_decode(self, sampled: np.ndarray, now: float) -> List[Request]:
+        """Record one decode step's samples; returns requests finished
+        (and evicted) by it."""
+        finished = []
+        for i in self.decoding_slots():
+            slot = self._slots[i]
+            slot.length += 1
+            slot.last_token = int(sampled[i])
+            slot.generated += 1
+            req = slot.request
+            req.tokens.append(int(sampled[i]))
+            req.token_s.append(now)
+            if slot.generated >= req.max_new_tokens:
+                finished.append(self._finish(i, now))
+        return finished
+
+    # --- eviction ------------------------------------------------------------
+
+    def _finish(self, i: int, now: float) -> Request:
+        slot = self._slots[i]
+        req = slot.request
+        req.finish_s = now
+        self.allocator.free(slot.block_ids)
+        self.tables.clear(i)
+        self._slots[i] = _Slot()
+        self._admit_order.remove(i)
+        self.completed.append(req)
+        return req
+
+    # --- state queries -------------------------------------------------------
+
+    @property
+    def num_active(self) -> int:
+        return sum(1 for s in self._slots if s.request is not None)
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self._waiting)
+
+    def next_arrival(self) -> Optional[float]:
+        return self._waiting[0].arrival_s if self._waiting else None
+
+    def idle(self) -> bool:
+        """No request anywhere: waiting empty and every slot free."""
+        return not self._waiting and self.num_active == 0

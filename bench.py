@@ -28,6 +28,14 @@ inside a success artifact).
 and the ratio against the naive recompute-the-prefix baseline, emitted
 as one ``decode`` monitor record (explicit ``SKIP(reason)`` off-TPU).
 
+``python bench.py --serve`` runs the CONTINUOUS-BATCHING serving leg
+(:func:`serve_main`): an offered-load sweep (Poisson arrivals, mixed
+lengths) through the paged ``apex_tpu.serving.ServingEngine`` — p50/p99
+per-token latency, TTFT, tokens/s under churn, occupancy — as one
+``serve`` monitor record with greedy-parity and jit-cache-pinned
+witnesses vs the single-request engine (explicit ``SKIP(reason)``
+off-TPU).
+
 ``python bench.py --longseq-bias`` runs the long-sequence relative-bias
 leg (:func:`longseq_bias_main`): in-kernel BUCKETED bias vs the
 materialized (h, s, s) operand — tokens/s + HBM high-water, one
@@ -276,6 +284,155 @@ def decode_main():
     errors = monitor.validate(record)
     if errors:
         raise ValueError(f"decode bench record failed validation: {errors}")
+    print(json.dumps(record))
+
+
+def serve_main():
+    """``python bench.py --serve`` — the continuous-batching serving leg:
+    an offered-load sweep (Poisson arrivals, mixed prompt/output lengths)
+    through :class:`apex_tpu.serving.ServingEngine` — paged KV blocks,
+    chunked prefill, fused sampling tail — measuring p50/p99 per-token
+    latency, time-to-first-token, decode tokens/s/chip under churn, and
+    slot occupancy, plus the no-churn witnesses against the
+    single-request ``DecodeEngine``: greedy tokens IDENTICAL and
+    throughput parity (``vs_single_request``), with both jitted steps'
+    cache size pinned at 1 across the whole schedule.
+
+    Emits ONE ``serve`` record through the monitor schema (and onto the
+    ``APEX_TPU_MONITOR`` stream when enabled) and prints it as one JSON
+    line. On TPU the record is ``status: "OK"``; off-TPU it is an
+    explicit ``status: "SKIP"`` with a reason — the smoke-scale CPU
+    measurements ride along as finite numbers, but a SKIP record claims
+    no serving result (the honesty rule: never nan inside an OK
+    artifact)."""
+    import numpy as np
+
+    on_tpu = jax.default_backend() == "tpu"
+    monitor.enable_from_env()
+    from apex_tpu.inference import DecodeEngine
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.serving import Request, ServingEngine
+
+    if on_tpu:
+        # the flagship decode-bench config; 8 slots x 1024 rows of bf16
+        # paged cache ~ 400 MB pool next to the bf16 params
+        cfg = dict(vocab_size=32768, max_seq_len=1024, hidden_size=1024,
+                   num_layers=12, num_heads=8, tp_size=1, remat=False,
+                   attention_impl="flash", scan_layers=False)
+        slots, block, chunk = 8, 128, 256
+        n_req, offered_rps = 32, 16.0
+        prompt_rng, newtok_rng = (64, 512), (16, 128)
+        parity_prompt, parity_new = 512, 64
+        cast = jnp.bfloat16
+    else:  # smoke scale; the record is SKIP either way
+        cfg = dict(vocab_size=256, max_seq_len=128, hidden_size=64,
+                   num_layers=2, num_heads=4, tp_size=1, remat=False,
+                   attention_impl="flash")
+        slots, block, chunk = 2, 16, 32
+        n_req, offered_rps = 6, 500.0
+        prompt_rng, newtok_rng = (4, 40), (2, 10)
+        parity_prompt, parity_new = 16, 8
+        cast = None
+
+    model = GPTModel(GPTConfig(**cfg))
+    params = model.init(jr.PRNGKey(0))
+    if cast is not None:
+        params = jax.tree.map(lambda x: x.astype(cast), params)
+    engine = ServingEngine(model, num_slots=slots, block_size=block,
+                           prefill_chunk=chunk, cache_dtype=cast)
+
+    # --- no-churn witnesses: one greedy request, both engines ---------------
+    deng = DecodeEngine(model, cache_dtype=cast)
+    prompt = np.asarray(jr.randint(jr.PRNGKey(1), (parity_prompt,), 0,
+                                   cfg["vocab_size"]), np.int32)
+    # first passes compile both stacks AND witness greedy parity; the
+    # second, warm passes below carry the throughput ratio
+    want = deng.generate(params, jnp.asarray(prompt)[None], parity_new)
+    jax.block_until_ready(want)
+    done = engine.serve(params, [Request(rid=-1, prompt=prompt,
+                                         max_new_tokens=parity_new)])
+    greedy_parity = (np.asarray(done[0].tokens)
+                     == np.asarray(want)[0]).all()
+    t0 = time.perf_counter()
+    want = deng.generate(params, jnp.asarray(prompt)[None], parity_new)
+    jax.block_until_ready(want)
+    single_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    engine.serve(params, [Request(rid=-2, prompt=prompt,
+                                  max_new_tokens=parity_new)])
+    paged_s = time.perf_counter() - t0
+    single_tps = parity_new / single_s
+    vs_single = (parity_new / paged_s) / single_tps
+
+    # --- the churn sweep: Poisson arrivals, mixed lengths -------------------
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(1.0 / offered_rps, n_req))
+    requests = [
+        Request(
+            rid=i,
+            prompt=np.asarray(rng.integers(
+                0, cfg["vocab_size"],
+                int(rng.integers(prompt_rng[0], prompt_rng[1] + 1))),
+                np.int32),
+            max_new_tokens=int(rng.integers(newtok_rng[0],
+                                            newtok_rng[1] + 1)),
+            arrival_s=float(arrivals[i]))
+        for i in range(n_req)
+    ]
+    t0 = time.perf_counter()
+    done = engine.serve(params, requests)
+    wall = time.perf_counter() - t0
+    assert len(done) == n_req, "serve lost requests"
+    stats = engine.last_stats
+
+    total_tokens = sum(len(r.tokens) for r in done)
+    itls = np.concatenate([np.diff(r.token_s) for r in done
+                           if len(r.token_s) >= 2]) * 1e3
+    ttfts = np.array([r.first_token_s - r.arrival_s for r in done]) * 1e3
+    # the zero-recompile contract IS part of what is measured: any
+    # re-trace across this churn schedule would be dispatch overhead
+    jit_cache_ok = (engine.prefill_chunk._cache_size() == 1
+                    and engine.decode_step._cache_size() == 1)
+    assert jit_cache_ok, \
+        "serving steps re-traced under churn (unstable avals?)"
+
+    fields = dict(
+        tokens_per_s=round(total_tokens / wall, 1),
+        latency_p50_ms=round(float(np.percentile(itls, 50)), 3),
+        latency_p99_ms=round(float(np.percentile(itls, 99)), 3),
+        ttft_p50_ms=round(float(np.percentile(ttfts, 50)), 3),
+        ttft_p99_ms=round(float(np.percentile(ttfts, 99)), 3),
+        occupancy_pct=round(stats.occupancy_pct(slots), 2),
+        vs_single_request=round(vs_single, 4),
+        single_request_tokens_per_s=round(single_tps, 1),
+        offered_rps=offered_rps,
+        greedy_parity=bool(greedy_parity),
+        jit_cache_ok=bool(jit_cache_ok),
+        requests=n_req, slots=slots, block_size=block,
+        num_blocks=engine.num_blocks,
+        blocks_high_water=stats.blocks_high_water,
+        prefill_chunk=chunk,
+        decode_steps=stats.decode_steps,
+        prefill_chunks=stats.prefill_chunks,
+        max_seq_len=engine.max_s,
+        config=cfg, backend=jax.default_backend(),
+    )
+    if on_tpu:
+        status = "OK"
+    else:
+        reason = (f"continuous-batching latency/throughput is a TPU "
+                  f"measurement; this is a {jax.default_backend()} smoke "
+                  f"run at {n_req} requests")
+        fields["reason"] = reason
+        status = "SKIP"
+
+    if monitor.enabled():
+        record = monitor.get_registry().emit_serve(status, **fields)
+    else:  # sink-less registry: same construction+honesty path, no file
+        record = monitor.MetricsRegistry().emit_serve(status, **fields)
+    errors = monitor.validate(record)
+    if errors:
+        raise ValueError(f"serve bench record failed validation: {errors}")
     print(json.dumps(record))
 
 
@@ -808,6 +965,8 @@ if __name__ == "__main__":
         profile_main([a for a in sys.argv[1:] if a != "--profile"])
     elif "--decode" in sys.argv[1:]:
         decode_main()
+    elif "--serve" in sys.argv[1:]:
+        serve_main()
     elif "--longseq-bias" in sys.argv[1:]:
         longseq_bias_main()
     elif "--tp-overlap" in sys.argv[1:]:

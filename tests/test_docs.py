@@ -76,6 +76,28 @@ def test_prof_api_blocks_execute_in_order():
     _exec_blocks(blocks, "prof.md")
 
 
+def test_inference_api_blocks_execute_in_order():
+    """docs/api/inference.md: single-batch decode → continuous-batching
+    serve → greedy-parity witness, one namespace, runnable on CPU (the
+    serving chapter's block math / scheduler contract is enforced, not
+    asserted)."""
+    blocks = _doc_blocks("api", "inference.md")
+    assert len(blocks) >= 3, "inference.md lost its worked examples"
+    ns = _exec_blocks(blocks, "inference.md")
+    assert ns["srv"].decode_step._cache_size() == 1
+
+
+def test_inference_doc_covers_serving_contract():
+    path = os.path.join(os.path.dirname(__file__), "..", "docs", "api",
+                        "inference.md")
+    text = open(path).read()
+    for needle in ("block table", "free list", "dead block",
+                   "reservation gate", "Chunked prefill", "fused_sample",
+                   "bench.py --serve", "greedy_parity",
+                   "_cache_size() == 1", "multiple of 128"):
+        assert needle in text, f"inference.md dropped {needle}"
+
+
 def test_observability_covers_anatomy_and_calibration():
     path = os.path.join(os.path.dirname(__file__), "..", "docs",
                         "OBSERVABILITY.md")

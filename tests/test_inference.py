@@ -34,7 +34,7 @@ K = jr.PRNGKey(7)
 
 
 def _tiny_gpt(num_kv_heads=None, **over):
-    kwargs = dict(vocab_size=97, max_seq_len=64, hidden_size=32,
+    kwargs = dict(vocab_size=97, max_seq_len=128, hidden_size=32,
                   num_layers=2, num_heads=4, num_kv_heads=num_kv_heads,
                   attention_impl="flash", remat=False, dropout=0.0)
     kwargs.update(over)
@@ -166,8 +166,8 @@ class TestDecodeEngine:
 
     def test_generate_rejects_overflow_and_missing_key(self):
         model, params = _tiny_gpt()
-        engine = DecodeEngine(model)  # cache = max_seq_len = 64
-        prompt = jnp.zeros((1, 60), jnp.int32)
+        engine = DecodeEngine(model)  # cache = max_seq_len = 128
+        prompt = jnp.zeros((1, 124), jnp.int32)
         with pytest.raises(ValueError, match="exceeds the cache"):
             engine.generate(params, prompt, 8)
         with pytest.raises(ValueError, match="max_new_tokens"):
@@ -175,6 +175,34 @@ class TestDecodeEngine:
         hot = DecodeEngine(model, temperature=1.0)
         with pytest.raises(ValueError, match="requires a key"):
             hot.generate(params, prompt[:, :4], 2)
+
+    def test_cache_length_must_be_128_multiple(self):
+        """The fused decode kernel streams the cache in 128-column tiles;
+        a non-multiple cache used to silently drop to the XLA fallback —
+        now it is an eager error naming the knob, and the ROUNDING-UP
+        recipe (cache past a short position table) works."""
+        model, params = _tiny_gpt()  # position table = 128
+        with pytest.raises(ValueError, match="max_seq_len.*multiple.*128"):
+            DecodeEngine(model, max_seq_len=100)
+        # the error names the rounded-up recipe value
+        with pytest.raises(ValueError, match="max_seq_len=128"):
+            DecodeEngine(model, max_seq_len=100)
+
+        # a model whose position table is NOT a 128-multiple: the default
+        # cache (= the table) errors, the recipe rounds the CACHE up...
+        short, sparams = _tiny_gpt(max_seq_len=100)
+        with pytest.raises(ValueError, match="multiple"):
+            DecodeEngine(short)
+        eng = DecodeEngine(short, max_seq_len=128)
+        prompt = jr.randint(jr.fold_in(K, 77), (1, 5), 0, 97)
+        assert eng.generate(sparams, prompt, 4).shape == (1, 4)
+        # ...but generation may still not STEP past the table: positions
+        # are real, the rounding slack is tiling-only
+        with pytest.raises(ValueError, match="position table"):
+            eng.generate(sparams, jnp.zeros((1, 90), jnp.int32), 12)
+        # and the cache cannot exceed the rounded table either
+        with pytest.raises(ValueError, match="position table"):
+            DecodeEngine(short, max_seq_len=256)
 
     def test_tp_sharded_model_rejected(self):
         model = GPTModel(GPTConfig(vocab_size=64, hidden_size=32,
@@ -228,6 +256,76 @@ class TestSampling:
             sample_logits(logits, None, temperature=1.0)
         with pytest.raises(ValueError, match="temperature"):
             sample_logits(logits, K, temperature=-1.0)
+        with pytest.raises(ValueError, match="top_p"):
+            sample_logits(logits, K, temperature=1.0, top_p=0.0)
+        with pytest.raises(ValueError, match="top_p"):
+            sample_logits(logits, K, temperature=1.0, top_p=1.5)
+
+    @staticmethod
+    def _nucleus(logits, temperature, top_p):
+        """Numpy oracle: the canonical sorted-cumsum nucleus (crossing
+        token included) + its renormalized distribution."""
+        s = np.asarray(logits, np.float64) / temperature
+        order = np.argsort(-s)
+        probs = np.exp(s - s.max())
+        probs /= probs.sum()
+        csum = np.cumsum(probs[order])
+        ncut = int(np.searchsorted(csum, top_p) + 1)
+        kept = order[:ncut]
+        p = np.zeros_like(probs)
+        p[kept] = probs[kept] / probs[kept].sum()
+        return set(kept.tolist()), p
+
+    def test_topp_support_matches_numpy_oracle(self):
+        """Every sampled token lies in the oracle nucleus, and enough
+        draws cover it entirely (the filter is neither looser nor
+        pathologically tighter than the sorted-cumsum definition)."""
+        logits = jr.normal(jr.fold_in(K, 2), (3, 64)) * 2.0
+        draw = jax.jit(lambda key: sample_logits(
+            logits, key, temperature=0.8, top_p=0.7))
+        seen = [set() for _ in range(3)]
+        for i in range(400):
+            toks = np.asarray(draw(jr.fold_in(K, 300 + i)))
+            for bi in range(3):
+                seen[bi].add(int(toks[bi]))
+        for bi in range(3):
+            oracle, _ = self._nucleus(logits[bi], 0.8, 0.7)
+            assert seen[bi] == oracle, (bi, seen[bi], oracle)
+
+    def test_topp_distribution_matches_numpy_oracle(self):
+        """Empirical frequencies over the nucleus track the renormalized
+        oracle probabilities at fixed seeds (loose bound: 4 sigma of the
+        binomial noise at n=2000)."""
+        logits = jnp.asarray([[2.0, 1.5, 1.0, 0.0, -1.0, -3.0]])
+        n = 2000
+        draw = jax.jit(lambda key: sample_logits(
+            logits, key, temperature=1.0, top_p=0.9))
+        counts = np.zeros(6)
+        for i in range(n):
+            counts[int(draw(jr.fold_in(K, 10_000 + i))[0])] += 1
+        _, p = self._nucleus(logits[0], 1.0, 0.9)
+        for j in range(6):
+            sigma = (p[j] * (1 - p[j]) / n) ** 0.5
+            assert abs(counts[j] / n - p[j]) < 4 * sigma + 1e-9, \
+                (j, counts[j] / n, p[j])
+
+    def test_topp_composes_with_topk(self):
+        """top-k restricts FIRST, the nucleus is computed over the
+        restricted distribution (documented order)."""
+        logits = jnp.asarray([[3.0, 2.9, 2.8, 0.0, -1.0, -2.0]])
+        # top_k=2 keeps {0, 1}; top_p=0.6 over the renormalized pair
+        # keeps just the head {0} (its renormalized mass ~0.52 < 0.6 ->
+        # crossing token 1 included -> both; with top_p=0.5 only 0)
+        for i in range(50):
+            t = int(sample_logits(logits, jr.fold_in(K, 600 + i),
+                                  temperature=1.0, top_k=2, top_p=0.5)[0])
+            assert t == 0
+        seen = set()
+        for i in range(200):
+            seen.add(int(sample_logits(logits, jr.fold_in(K, 800 + i),
+                                       temperature=1.0, top_k=2,
+                                       top_p=0.6)[0]))
+        assert seen == {0, 1}
 
 
 class TestDecodeMonitorRecords:

@@ -1,0 +1,584 @@
+"""Continuous-batching serving tests (ISSUE 7 acceptance criteria).
+
+The contracts under test:
+
+* the paged KV pool: free-list allocator invariants (dead block
+  reserved, exhaustion is loud, double-free is loud, free restores);
+* the scheduler: FCFS admission behind the worst-case reservation gate,
+  chunked-prefill progression, eviction returns every block (no leak
+  across N churn cycles);
+* paged ``decode_attention`` == contiguous (bitwise on the XLA gather
+  path, tolerance on the interpret-mode kernel), with and without the
+  bucketed relative bias;
+* the fused sampling tail: greedy == argmax, kernel == XLA fallback
+  token-for-token on shared noise, top-k/top-p kept sets match the
+  standalone sort/cumsum sampler's sets;
+* the ServingEngine: greedy decode under paging/chunking is
+  TOKEN-IDENTICAL to the single-request ``DecodeEngine``, and
+  ``prefill_chunk._cache_size() == 1`` / ``decode_step._cache_size()
+  == 1`` across a scripted admit/evict/length-mix churn schedule
+  (recompile-freedom — the stable-aval contract);
+* ``serve`` monitor records validate through the schema, the report,
+  and the ``tools/validate_metrics.py --serve`` forced dispatch.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from apex_tpu import monitor
+from apex_tpu.inference import DecodeEngine, sample_logits
+from apex_tpu.models import GPTConfig, GPTModel
+from apex_tpu.ops import decode_attention, fused_sample
+from apex_tpu.serving import (
+    DEAD_BLOCK,
+    BlockAllocator,
+    Request,
+    Scheduler,
+    ServingEngine,
+    blocks_needed,
+)
+
+K = jr.PRNGKey(11)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = GPTConfig(vocab_size=97, max_seq_len=128, hidden_size=32,
+                    num_layers=2, num_heads=4, num_kv_heads=2,
+                    attention_impl="flash", remat=False, dropout=0.0)
+    model = GPTModel(cfg)
+    return model, model.init(K)
+
+
+@pytest.fixture(scope="module")
+def reference_engine(tiny):
+    model, _ = tiny
+    return DecodeEngine(model)
+
+
+def _req(rng, rid, max_prompt=30, max_new=12):
+    return Request(
+        rid=rid,
+        prompt=np.asarray(rng.integers(0, 97, rng.integers(1, max_prompt)),
+                          np.int32),
+        max_new_tokens=int(rng.integers(1, max_new)))
+
+
+class TestBlockAllocator:
+    def test_dead_block_never_allocated(self):
+        a = BlockAllocator(5)
+        ids = a.allocate(4)
+        assert sorted(ids) == [1, 2, 3, 4] and DEAD_BLOCK not in ids
+
+    def test_exhaustion_and_restore(self):
+        a = BlockAllocator(4)
+        ids = a.allocate(3)
+        with pytest.raises(RuntimeError, match="exhausted"):
+            a.allocate(1)
+        a.free(ids)
+        assert a.num_free == 3 and a.num_live == 0
+        assert len(a.allocate(3)) == 3
+
+    def test_double_free_and_dead_free_are_loud(self):
+        a = BlockAllocator(4)
+        (bid,) = a.allocate(1)
+        a.free([bid])
+        with pytest.raises(ValueError, match="double free"):
+            a.free([bid])
+        with pytest.raises(ValueError, match="dead block"):
+            a.free([DEAD_BLOCK])
+
+    def test_needs_two_blocks_minimum(self):
+        with pytest.raises(ValueError, match="dead block"):
+            BlockAllocator(1)
+
+    def test_blocks_needed(self):
+        assert [blocks_needed(n, 8) for n in (1, 8, 9, 16, 17)] \
+            == [1, 1, 2, 2, 3]
+
+
+class TestScheduler:
+    def _sched(self, num_blocks=20, num_slots=2, block=4, chunk=8):
+        return Scheduler(num_slots=num_slots, block_size=block,
+                         max_blocks_per_slot=16,
+                         allocator=BlockAllocator(num_blocks),
+                         prefill_chunk=chunk)
+
+    def test_chunked_prefill_progression(self):
+        s = self._sched()
+        prompt = np.arange(19, dtype=np.int32)
+        s.submit(Request(rid=0, prompt=prompt, max_new_tokens=3))
+        s.admit(now=0.0)
+        works = []
+        while True:
+            w = s.next_prefill()
+            if w is None:
+                break
+            works.append((w.start, w.live, w.is_last))
+            np.testing.assert_array_equal(
+                w.tokens[:w.live], prompt[w.start:w.start + w.live])
+            s.note_prefill(w, sampled_token=42, now=1.0)
+        # 19 tokens in chunks of 8: (0,8) (8,8) (16,3 last)
+        assert works == [(0, 8, False), (8, 8, False), (16, 3, True)]
+        # blocks cover exactly the live frontier: ceil(19/4) = 5
+        assert s.allocator.num_live == 5
+        assert s.decoding_slots() == [0]
+
+    def test_admission_reservation_gate_and_fcfs(self):
+        # pool of 5 allocatable blocks; each request worst-cases at
+        # ceil((8 + 4 - 1)/4) = 3 blocks -> only ONE admits at a time
+        s = self._sched(num_blocks=6)
+        for i in range(3):
+            s.submit(Request(rid=i, prompt=np.zeros(8, np.int32),
+                             max_new_tokens=4))
+        assert s.admit(now=0.0) == [0]  # FCFS head only
+        w = s.next_prefill()
+        s.note_prefill(w, sampled_token=1, now=0.0)
+        assert s.admit(now=0.0) == []  # still reserved: 3 + (3-2) > 5...
+        # finish request 0: its blocks free, reservation clears
+        for _ in range(3):
+            batch = s.decode_batch()
+            assert batch is not None
+            s.note_decode(np.full(2, 7), now=0.0)
+        assert s.completed and s.completed[0].rid == 0
+        assert s.admit(now=0.0) == [0]  # rid 1 takes the freed slot
+
+    def test_eviction_returns_every_block(self):
+        """No leak across N churn cycles: after every request completes
+        the free list is exactly the fresh pool."""
+        s = self._sched(num_blocks=12)
+        rng = np.random.default_rng(3)
+        for cycle in range(6):
+            s.submit(_req(rng, cycle, max_prompt=20, max_new=6))
+        while not s.idle():
+            s.admit(now=0.0)
+            w = s.next_prefill()
+            if w is not None:
+                s.note_prefill(w, sampled_token=5, now=0.0)
+            batch = s.decode_batch()
+            if batch is not None:
+                s.note_decode(np.full(2, 9), now=0.0)
+        assert len(s.completed) == 6
+        assert s.allocator.num_live == 0
+        assert s.allocator.num_free == 11
+        np.testing.assert_array_equal(
+            s.tables.asarray(), np.full((2, 16), DEAD_BLOCK))
+
+    def test_submit_validation(self):
+        s = self._sched()
+        with pytest.raises(ValueError, match="cache rows"):
+            s.submit(Request(rid=0, prompt=np.zeros(60, np.int32),
+                             max_new_tokens=10))  # 69 > 16*4
+        # fits a slot but can NEVER fit the pool: refusing eagerly beats
+        # the permanent admission stall it would otherwise become
+        tight = Scheduler(num_slots=2, block_size=8,
+                          max_blocks_per_slot=8,
+                          allocator=BlockAllocator(4), prefill_chunk=8)
+        with pytest.raises(ValueError, match="never be admitted"):
+            tight.submit(Request(rid=0, prompt=np.zeros(33, np.int32),
+                                 max_new_tokens=8))  # 5 blocks > 3
+        with pytest.raises(ValueError, match=">= 1"):
+            s.submit(Request(rid=0, prompt=np.zeros(4, np.int32),
+                             max_new_tokens=0))
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            Scheduler(num_slots=1, block_size=4, max_blocks_per_slot=4,
+                      allocator=BlockAllocator(4), prefill_chunk=6)
+
+    def test_future_arrivals_wait(self):
+        s = self._sched()
+        s.submit(Request(rid=0, prompt=np.zeros(4, np.int32),
+                         max_new_tokens=2, arrival_s=5.0))
+        assert s.admit(now=1.0) == []
+        assert s.next_arrival() == 5.0
+        assert s.admit(now=6.0) == [0]
+
+
+class TestPagedDecodeAttention:
+    def _scatter(self, kc, vc, nb_max, bs):
+        """Scatter a contiguous (b, h_kv, nb_max*bs, d) cache into a
+        shuffled pool + tables."""
+        b, h_kv, _, d = kc.shape
+        num_blocks = b * nb_max + 1
+        rng = np.random.default_rng(0)
+        ids = rng.permutation(np.arange(1, num_blocks))
+        tables = np.zeros((b, nb_max), np.int32)
+        pk = np.zeros((num_blocks, h_kv, bs, d), np.float32)
+        pv = np.zeros((num_blocks, h_kv, bs, d), np.float32)
+        n = 0
+        for bi in range(b):
+            for j in range(nb_max):
+                tables[bi, j] = ids[n]
+                pk[ids[n]] = np.asarray(kc[bi, :, j * bs:(j + 1) * bs])
+                pv[ids[n]] = np.asarray(vc[bi, :, j * bs:(j + 1) * bs])
+                n += 1
+        return jnp.asarray(pk), jnp.asarray(pv), jnp.asarray(tables)
+
+    def test_paged_matches_contiguous(self):
+        b, h, h_kv, d, bs, nb_max = 3, 8, 2, 64, 128, 4
+        q = jr.normal(K, (b, h, d))
+        kc = jr.normal(jr.fold_in(K, 1), (b, h_kv, bs * nb_max, d))
+        vc = jr.normal(jr.fold_in(K, 2), (b, h_kv, bs * nb_max, d))
+        lens = jnp.array([5, 300, 0], jnp.int32)  # ragged + dead row
+        pk, pv, tables = self._scatter(kc, vc, nb_max, bs)
+        want = decode_attention(q, kc, vc, lens, impl="xla")
+        got = decode_attention(q, pk, pv, lens, impl="xla",
+                               block_tables=tables)
+        # the gather fallback runs the EXACT contiguous math
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+        got_pl = decode_attention(q, pk, pv, lens, impl="pallas",
+                                  block_tables=tables)
+        np.testing.assert_allclose(np.asarray(got_pl), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_paged_with_bucketed_bias(self):
+        from apex_tpu.ops.attention import BucketedBias
+        b, h, h_kv, d, bs, nb_max = 2, 4, 2, 64, 128, 2
+        bb = BucketedBias(jr.normal(jr.fold_in(K, 9), (16, h)) * 0.4,
+                          bidirectional=False, max_distance=64)
+        q = jr.normal(K, (b, h, d))
+        kc = jr.normal(jr.fold_in(K, 1), (b, h_kv, bs * nb_max, d))
+        vc = jr.normal(jr.fold_in(K, 2), (b, h_kv, bs * nb_max, d))
+        lens = jnp.array([200, 77], jnp.int32)
+        pk, pv, tables = self._scatter(kc, vc, nb_max, bs)
+        want = decode_attention(q, kc, vc, lens, impl="xla", bias=bb)
+        got = decode_attention(q, pk, pv, lens, impl="xla", bias=bb,
+                               block_tables=tables)
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+        got_pl = decode_attention(q, pk, pv, lens, impl="pallas", bias=bb,
+                                  block_tables=tables)
+        np.testing.assert_allclose(np.asarray(got_pl), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_validation(self):
+        q = jnp.zeros((2, 4, 64))
+        pool = jnp.zeros((5, 2, 16, 64))
+        lens = jnp.zeros((2,), jnp.int32)
+        with pytest.raises(ValueError, match="block_tables"):
+            decode_attention(q, pool, pool, lens,
+                             block_tables=jnp.zeros((3, 4), jnp.int32))
+        with pytest.raises(ValueError, match="integer"):
+            decode_attention(q, pool, pool, lens,
+                             block_tables=jnp.zeros((2, 4)))
+        with pytest.raises(ValueError, match="h_kv"):
+            decode_attention(q, jnp.zeros((5, 3, 16, 64)),
+                             jnp.zeros((5, 3, 16, 64)), lens,
+                             block_tables=jnp.zeros((2, 4), jnp.int32))
+
+
+class TestFusedSample:
+    def test_greedy_is_argmax(self):
+        logits = jr.normal(K, (3, 17))
+        np.testing.assert_array_equal(
+            np.asarray(fused_sample(logits)),
+            np.asarray(jnp.argmax(logits, -1)))
+
+    def test_validation(self):
+        logits = jnp.zeros((1, 8))
+        with pytest.raises(ValueError, match="requires a PRNG key"):
+            fused_sample(logits, None, temperature=1.0)
+        with pytest.raises(ValueError, match="temperature"):
+            fused_sample(logits, K, temperature=-1.0)
+        with pytest.raises(ValueError, match="top_p"):
+            fused_sample(logits, K, temperature=1.0, top_p=0.0)
+        with pytest.raises(ValueError, match="top_k"):
+            fused_sample(logits, K, temperature=1.0, top_k=-1)
+        with pytest.raises(ValueError, match="\\(b, V\\)"):
+            fused_sample(jnp.zeros((8,)))
+
+    def test_kernel_matches_xla_fallback_token_for_token(self):
+        """Shared noise -> the kernel's bisection thresholds select the
+        SAME kept set as the fallback (they run the same helpers), so
+        the sampled token agrees exactly, across knob combinations."""
+        logits = jr.normal(jr.fold_in(K, 1), (4, 256)) * 2.0
+        for tk, tp in [(0, 1.0), (7, 1.0), (0, 0.8), (11, 0.6)]:
+            draw = jax.jit(lambda key, impl, tk=tk, tp=tp: fused_sample(
+                logits, key, temperature=0.9, top_k=tk, top_p=tp,
+                impl=impl), static_argnames=("impl",))
+            for i in range(15):
+                k = jr.fold_in(K, 1000 + i)
+                np.testing.assert_array_equal(
+                    np.asarray(draw(k, "xla")), np.asarray(draw(k, "pallas")),
+                    err_msg=f"top_k={tk} top_p={tp} draw {i}")
+
+    def test_topk_support(self):
+        logits = jr.normal(jr.fold_in(K, 2), (4, 256))
+        top = np.asarray(jax.lax.top_k(logits, 5)[1])
+        draw = jax.jit(lambda key: fused_sample(
+            logits, key, temperature=1.3, top_k=5, impl="pallas"))
+        for i in range(40):
+            toks = np.asarray(draw(jr.fold_in(K, 50 + i)))
+            for bi in range(4):
+                assert toks[bi] in top[bi]
+
+    def test_topp_kept_set_matches_standalone_sampler(self):
+        """The fused tail's bisection nucleus == the standalone
+        sort/cumsum nucleus: over many draws both samplers' supports
+        equal the numpy oracle set."""
+        logits = jr.normal(jr.fold_in(K, 3), (3, 256)) * 2.0
+        fused_draw = jax.jit(lambda key: fused_sample(
+            logits, key, temperature=0.9, top_p=0.6, impl="pallas"))
+        ref_draw = jax.jit(lambda key: sample_logits(
+            logits, key, temperature=0.9, top_p=0.6))
+        seen_f = [set() for _ in range(3)]
+        seen_r = [set() for _ in range(3)]
+        for i in range(300):
+            tf = np.asarray(fused_draw(jr.fold_in(K, 5000 + i)))
+            tr = np.asarray(ref_draw(jr.fold_in(K, 7000 + i)))
+            for bi in range(3):
+                seen_f[bi].add(int(tf[bi]))
+                seen_r[bi].add(int(tr[bi]))
+        s = np.asarray(logits, np.float64) / 0.9
+        for bi in range(3):
+            order = np.argsort(-s[bi])
+            probs = np.exp(s[bi] - s[bi].max())
+            probs /= probs.sum()
+            csum = np.cumsum(probs[order])
+            ncut = int(np.searchsorted(csum, 0.6) + 1)
+            oracle = set(order[:ncut].tolist())
+            assert seen_f[bi] == oracle, (bi, seen_f[bi], oracle)
+            assert seen_r[bi] == oracle, (bi, seen_r[bi], oracle)
+
+    def test_topp_composed_with_topk_filters(self):
+        """Regression: top-p must still bite AFTER a top-k pass. The
+        top-k filter pins the row min at the FILTERED sentinel; a
+        bisection starting there never collapses, silently disabling
+        top-p (caught in review). Same oracle as the standalone
+        sampler's composition test: top_k=2 keeps {0, 1}; over that
+        renormalized pair, top_p=0.5 keeps ONLY the head. (Vocab padded
+        to the kernel's 128-lane grid with negligible-mass entries.)"""
+        row = np.full(128, -20.0, np.float32)
+        row[:6] = [3.0, 2.9, 2.8, 0.0, -1.0, -2.0]
+        logits = jnp.asarray(row)[None]
+        for impl in ("xla", "pallas"):
+            draw = jax.jit(lambda key, impl=impl: fused_sample(
+                logits, key, temperature=1.0, top_k=2, top_p=0.5,
+                impl=impl))
+            for i in range(30):
+                assert int(draw(jr.fold_in(K, 900 + i))[0]) == 0, impl
+        # and with top_p=0.6 the crossing token joins: both appear
+        seen = set()
+        draw = jax.jit(lambda key: fused_sample(
+            logits, key, temperature=1.0, top_k=2, top_p=0.6,
+            impl="pallas"))
+        for i in range(200):
+            seen.add(int(draw(jr.fold_in(K, 1200 + i))[0]))
+        assert seen == {0, 1}
+
+
+class TestServingEngine:
+    def test_greedy_single_request_matches_decode_engine(
+            self, tiny, reference_engine):
+        """The acceptance anchor: a no-churn single-request workload
+        through the paged, chunked engine decodes the IDENTICAL token
+        sequence as DecodeEngine — and both serving programs compiled
+        exactly once."""
+        model, params = tiny
+        prompt = np.asarray(jr.randint(jr.fold_in(K, 3), (7,), 0, 97),
+                            np.int32)
+        n = 8
+        want = np.asarray(reference_engine.generate(
+            params, jnp.asarray(prompt)[None], n))[0]
+        eng = ServingEngine(model, num_slots=2, block_size=8,
+                            prefill_chunk=8, max_seq_len=64)
+        done = eng.serve(params, [Request(rid=0, prompt=prompt,
+                                          max_new_tokens=n)])
+        np.testing.assert_array_equal(np.asarray(done[0].tokens), want)
+        assert eng.prefill_chunk._cache_size() == 1
+        assert eng.decode_step._cache_size() == 1
+        assert done[0].first_token_s is not None
+        assert done[0].finish_s >= done[0].first_token_s
+
+    def test_churn_schedule_recompile_free_and_leak_free(
+            self, tiny, reference_engine):
+        """The scripted churn schedule: more requests than slots, mixed
+        prompt/output lengths, a pool SMALLER than worst-case-everything
+        — across every admit/evict the jit caches stay at 1, every
+        request still matches the single-request engine token-for-token,
+        and after N cycles every block is back on the free list."""
+        model, params = tiny
+        eng = ServingEngine(model, num_slots=2, block_size=8,
+                            prefill_chunk=16, max_seq_len=64,
+                            num_blocks=13)
+        rng = np.random.default_rng(0)
+        reqs = [_req(rng, i) for i in range(7)]
+        sched = eng.make_scheduler()
+        done = eng.serve(params, reqs, scheduler=sched)
+        assert len(done) == 7
+        assert eng.prefill_chunk._cache_size() == 1, "prefill re-traced"
+        assert eng.decode_step._cache_size() == 1, "decode re-traced"
+        for r in done:
+            assert len(r.tokens) == r.max_new_tokens
+            want = np.asarray(reference_engine.generate(
+                params, jnp.asarray(r.prompt)[None], r.max_new_tokens))[0]
+            np.testing.assert_array_equal(np.asarray(r.tokens), want,
+                                          err_msg=f"rid {r.rid}")
+        # no leak: the free list is exactly the fresh pool again
+        assert sched.allocator.num_live == 0
+        assert sched.allocator.num_free == eng.num_blocks - 1
+        # and paging did its job: the high-water stayed under the pool
+        assert 0 < eng.last_stats.blocks_high_water <= eng.num_blocks - 1
+
+    def test_arrival_replay_and_ttft_stamps(self, tiny):
+        """Requests with future arrivals are held; TTFT/finish stamps
+        are ordered and on the serve clock."""
+        model, params = tiny
+        eng = ServingEngine(model, num_slots=2, block_size=8,
+                            prefill_chunk=8, max_seq_len=64)
+        reqs = [Request(rid=0, prompt=np.zeros(4, np.int32),
+                        max_new_tokens=3, arrival_s=0.0),
+                Request(rid=1, prompt=np.zeros(6, np.int32),
+                        max_new_tokens=2, arrival_s=0.05)]
+        done = eng.serve(params, reqs)
+        assert {r.rid for r in done} == {0, 1}
+        for r in done:
+            assert r.admit_s >= r.arrival_s
+            assert r.first_token_s >= r.admit_s
+            assert r.finish_s >= r.first_token_s
+            assert len(r.token_s) == len(r.tokens)
+
+    def test_sampled_serving_uses_fused_tail_support(self, tiny):
+        """top-k serving: every generated token of every request lies in
+        the top-k of the teacher-forced logits on its own prefix."""
+        model, params = tiny
+        eng = ServingEngine(model, num_slots=2, block_size=8,
+                            prefill_chunk=8, max_seq_len=64,
+                            temperature=0.7, top_k=3)
+        prompt = np.asarray(jr.randint(jr.fold_in(K, 5), (4,), 0, 97),
+                            np.int32)
+        done = eng.serve(params, [Request(rid=0, prompt=prompt,
+                                          max_new_tokens=5)],
+                         key=jr.fold_in(K, 60))
+        toks = done[0].tokens
+        seq = jnp.asarray(prompt)[None]
+        for t in range(5):
+            logits = model.logits(params, seq)[:, -1]
+            top3 = np.asarray(jax.lax.top_k(logits, 3)[1])[0]
+            assert toks[t] in top3
+            seq = jnp.concatenate(
+                [seq, jnp.asarray([[toks[t]]], jnp.int32)], axis=1)
+
+    def test_validation(self, tiny):
+        model, _ = tiny
+        with pytest.raises(ValueError, match="multiple of.*block_size"):
+            ServingEngine(model, num_slots=2, block_size=8, max_seq_len=60)
+        with pytest.raises(ValueError, match="position table"):
+            ServingEngine(model, num_slots=2, block_size=8,
+                          max_seq_len=256)
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            ServingEngine(model, num_slots=2, block_size=8,
+                          max_seq_len=64, prefill_chunk=12)
+        with pytest.raises(ValueError, match="num_slots"):
+            ServingEngine(model, num_slots=0, block_size=8, max_seq_len=64)
+        eng = ServingEngine(model, num_slots=1, block_size=8,
+                            max_seq_len=64, temperature=1.0)
+        with pytest.raises(ValueError, match="requires a key"):
+            eng.serve({}, [])
+
+
+class TestServeRecord:
+    def test_emit_serve_roundtrip_report_and_validator(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        monitor.enable(str(path))
+        try:
+            monitor.emit_meta(device_kind="cpu")
+            rec = monitor.emit_serve(
+                "OK", tokens_per_s=4321.0, latency_p50_ms=1.2,
+                latency_p99_ms=3.4, ttft_p50_ms=20.0, ttft_p99_ms=55.0,
+                occupancy_pct=87.5, vs_single_request=1.9,
+                greedy_parity=True, jit_cache_ok=True, requests=32,
+                slots=8, block_size=128, blocks_high_water=40)
+            assert monitor.validate(rec) == []
+        finally:
+            monitor.disable()
+        lines = path.read_text().splitlines()
+        assert monitor.validate_jsonl(lines) == []
+        from apex_tpu.monitor import report as monitor_report
+        summary = monitor_report.aggregate(
+            monitor_report.read_records(lines))
+        assert summary["serve"]["tokens_per_s"] == 4321.0
+        assert summary["serve"]["status"] == "OK"
+        rendered = monitor_report.render(summary)
+        assert "serve" in rendered and "p50/p99 1.20/3.40" in rendered
+
+    def test_ok_serve_record_with_nan_refused(self):
+        reg = monitor.MetricsRegistry()
+        with pytest.raises(ValueError, match="non-finite"):
+            reg.emit_serve("OK", tokens_per_s=float("nan"))
+
+    def test_skip_needs_reason(self):
+        reg = monitor.MetricsRegistry()
+        with pytest.raises(ValueError, match="reason"):
+            reg.emit_serve("SKIP")
+        rec = reg.emit_serve("SKIP", reason="no TPU",
+                             vs_single_request=("skipped", "no TPU"))
+        assert rec["vs_single_request"] == {"skipped": True,
+                                            "reason": "no TPU"}
+        assert monitor.validate(rec) == []
+        bare = {k: v for k, v in rec.items() if k != "reason"}
+        assert any("reason" in e for e in monitor.validate(bare))
+
+    def test_validator_cli_serve_dispatch(self, tmp_path, capsys):
+        """--serve forced dispatch: a valid serve stream passes, a
+        stream without a serve record fails, a wrong-kind artifact
+        fails — the drift test pinning the CLI contract."""
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        import validate_metrics
+        reg = monitor.MetricsRegistry()
+        rec = reg.emit_serve("SKIP", reason="no TPU")
+        good = tmp_path / "serve.jsonl"
+        good.write_text(json.dumps(rec) + "\n")
+        assert validate_metrics.main([str(good)]) == 0          # content
+        assert validate_metrics.main(["--serve", str(good)]) == 0
+        capsys.readouterr()
+        # content dispatch catches a malformed serve record
+        bad = tmp_path / "bad.jsonl"
+        bad_rec = dict(rec, status="OK", tokens_per_s=float("nan"))
+        bad.write_text(json.dumps(bad_rec).replace("NaN", '"nan"') + "\n")
+        assert validate_metrics.main([str(bad)]) == 1
+        # forced dispatch: a stream with no serve record must fail
+        other = tmp_path / "other.jsonl"
+        other.write_text(json.dumps(
+            reg.emit_decode("SKIP", reason="no TPU")) + "\n")
+        assert validate_metrics.main(["--serve", str(other)]) == 1
+        err = capsys.readouterr().err
+        assert "expected a 'serve' artifact" in err
+        # a multi-record stream without a serve record also fails
+        stream = tmp_path / "stream.jsonl"
+        stream.write_text(
+            json.dumps(reg.emit_decode("SKIP", reason="no TPU")) + "\n"
+            + json.dumps(reg.emit_meta(device_kind="cpu")) + "\n")
+        assert validate_metrics.main(["--serve", str(stream)]) == 1
+        assert "no 'serve' record" in capsys.readouterr().err
+
+
+class TestServeBenchLeg:
+    def test_bench_serve_emits_valid_skip_record_off_tpu(self, tmp_path):
+        """The serving bench leg end-to-end at smoke scale: off-TPU it
+        must print/emit an explicit SKIP record — schema-valid, no nan,
+        greedy parity + pinned jit caches witnessed — and the stream
+        must pass the validator CLI."""
+        root = os.path.join(os.path.dirname(__file__), "..")
+        path = tmp_path / "serve.jsonl"
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   APEX_TPU_MONITOR=str(path))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "bench.py"), "--serve"],
+            capture_output=True, text=True, env=env, cwd=root, timeout=600)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        record = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert record["kind"] == "serve" and record["status"] == "SKIP"
+        assert record["greedy_parity"] is True
+        assert record["jit_cache_ok"] is True
+        assert record["blocks_high_water"] >= 1
+        assert monitor.validate(record) == []
+        assert monitor.validate_jsonl(
+            path.read_text().splitlines()) == []

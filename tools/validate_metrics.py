@@ -7,6 +7,7 @@ Usage::
     python tools/validate_metrics.py --lint-report lint.json ...
     python tools/validate_metrics.py --costdb costdb.json ...
     python tools/validate_metrics.py --profile profile.jsonl ...
+    python tools/validate_metrics.py --serve serve.jsonl ...
 
 Dispatch is by content, not extension:
 
@@ -37,11 +38,14 @@ Dispatch is by content, not extension:
   must fail as a bad lint report, not as an unrecognized shape) — don't
   combine it with non-lint artifacts;
 * ``profile`` records (``python bench.py --profile``: the step-anatomy
-  leg) and ``costdb`` artifacts (``apex_tpu.prof.calibrate``) dispatch
-  on ``kind`` like every monitor record. ``--profile`` / ``--costdb``
-  force EVERY listed file to be judged as that artifact (same rationale
-  as ``--lint-report``: an artifact that lost its ``kind`` key must fail
-  as a bad profile/costdb, not as an unrecognized shape).
+  leg), ``serve`` records (``python bench.py --serve``: the
+  continuous-batching offered-load leg through the paged
+  ``apex_tpu.serving`` engine), and ``costdb`` artifacts
+  (``apex_tpu.prof.calibrate``) dispatch on ``kind`` like every monitor
+  record. ``--profile`` / ``--serve`` / ``--costdb`` force EVERY listed
+  file to be judged as that artifact (same rationale as
+  ``--lint-report``: an artifact that lost its ``kind`` key must fail
+  as a bad profile/serve/costdb, not as an unrecognized shape).
 
 Exit status 0 when every file is clean; 1 otherwise, with one problem per
 line on stderr. The logic lives in ``apex_tpu.monitor.schema`` so tests
@@ -170,8 +174,11 @@ def main(argv=None) -> int:
         force_kind = "costdb"
     elif "--profile" in argv:
         force_kind = "profile"
+    elif "--serve" in argv:
+        force_kind = "serve"
     argv = [a for a in argv
-            if a not in ("--lint-report", "--costdb", "--profile")]
+            if a not in ("--lint-report", "--costdb", "--profile",
+                         "--serve")]
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
