@@ -23,6 +23,14 @@ APX403  blocking-collective-feeds-matmul
                                   the MXU; ``ops.collective_matmul`` /
                                   ``overlap_comm=True`` overlaps them
                                   (advisory)
+APX404  blocking-p2p-feeds-stage  a ``lax.ppermute`` / pipeline p2p helper
+                                  result feeding a stage/block body (or a
+                                  matmul) in the same scope — the blocking
+                                  hop serializes with the compute where
+                                  ``p2p_communication.rotate_overlapped``
+                                  / ``overlap_p2p=True`` hides it behind
+                                  the stage (advisory, mirrors APX403 at
+                                  the pp boundary)
 """
 
 from __future__ import annotations
@@ -187,6 +195,79 @@ def check_apx403(ctx: ModuleContext):
                         "ops.collective_matmul.matmul_reduce_scatter (or "
                         "overlap_comm=True on the linear) computes one "
                         "output shard per ring step instead (advisory)")
+
+
+# --- APX404: blocking p2p hop feeding a stage body ---------------------------
+
+#: pipeline p2p helpers whose result is a received activation (the
+#: BLOCKING rotation primitives of
+#: transformer.pipeline_parallel.p2p_communication, fused pairs included)
+_P2P_SHORT = frozenset({"send_forward", "send_backward", "recv_forward",
+                        "recv_backward", "_rotate",
+                        "send_forward_recv_backward",
+                        "send_backward_recv_forward"})
+
+#: callee-name fragments that mark a pipeline stage body — the compute an
+#: overlapped hop could hide behind (overlap-capable path exists:
+#: rotate_overlapped / pipeline_spmd_forward(overlap_p2p=True)). "chunk"
+#: is deliberately absent: the collective-matmul rings' per-chunk GEMM on
+#: a just-arrived ppermute piece IS the overlapped pattern.
+_STAGE_FRAGMENTS = ("stage", "block", "layer")
+
+
+def _is_p2p_call(ctx: ModuleContext, node) -> bool:
+    canon = ctx.call_name(node) or ""
+    short = canon.rsplit(".", 1)[-1]
+    if short == "ppermute":
+        return (canon.startswith(("jax.lax.", "lax.")) or canon == short)
+    # bare or through the p2p_communication module/aliases; the set
+    # holds the BLOCKING helpers only, so rotate_overlapped never taints
+    return short in _P2P_SHORT
+
+
+def _is_stage_call(ctx: ModuleContext, node) -> bool:
+    canon = ctx.call_name(node) or ""
+    short = canon.rsplit(".", 1)[-1].lower()
+    return any(f in short for f in _STAGE_FRAGMENTS)
+
+
+@rule("APX404", "blocking-p2p-feeds-stage",
+      "a lax.ppermute / pipeline p2p helper result feeding a stage/block "
+      "body (or a matmul) in the same scope — the blocking hop serializes "
+      "with compute that p2p_communication.rotate_overlapped / "
+      "overlap_p2p=True would hide it behind (advisory)")
+def check_apx404(ctx: ModuleContext):
+    from apex_tpu.lint.rules_pallas import (_expr_has, _scope_bodies,
+                                            _scope_nodes, _taint_names)
+
+    def is_p2p(call):
+        return _is_p2p_call(ctx, call)
+
+    for body in _scope_bodies(ctx.tree):
+        stmts = _scope_nodes(body)
+        hopped = _taint_names(stmts, is_p2p)
+        for node in stmts:
+            if not isinstance(node, ast.Call):
+                continue
+            if not (_is_stage_call(ctx, node)
+                    or _is_matmul_call(ctx, node)):
+                continue
+            operands = list(node.args) + [k.value for k in node.keywords]
+            for arg in operands:
+                if _expr_has(is_p2p, arg, hopped):
+                    yield ctx.finding(
+                        node, "APX404",
+                        "a blocking p2p hop result feeds this stage body "
+                        "— inside shard_map the ppermute serializes with "
+                        "the compute that follows it, the exact stall "
+                        "shape the ring-overlapped collectives (APX403) "
+                        "eliminate for TP; "
+                        "p2p_communication.rotate_overlapped (or "
+                        "overlap_p2p=True on the pipeline schedule) "
+                        "issues the hop, runs the hop-independent stage "
+                        "body, and consumes the arrival next tick "
+                        "(advisory)")
+                    break
 
 
 def _is_partition_spec(ctx: ModuleContext, call: ast.Call) -> bool:

@@ -53,6 +53,17 @@ class GPTConfig:
     # (False) stays the parity oracle. Requires tp_size >= 2 and the
     # flash attention path; composing with cp is future work.
     tp_overlap: bool = False
+    # Pipeline schedule family, consumed by GPTPipeline (pp >= 2):
+    # "1f1b" — scanned forward + autodiff backward (interleaved when the
+    # pipeline runs virtual chunks); "zb" — zero-bubble split backward
+    # (dX on the critical path, dW deferred into a real-items-only sweep;
+    # schedules.py has the cost model). overlap_p2p restructures every
+    # pipeline tick so the stage-boundary ppermute hop is issued before
+    # the stage body it no longer feeds (the PR-5 collective-matmul trick
+    # at the pp boundary; with virtual chunks the microbatch count must
+    # then divide 2*pp).
+    pp_schedule: str = "1f1b"
+    overlap_p2p: bool = False
     dropout: float = 0.0
     remat: bool = True
     # "full": recompute the whole block in backward (Megatron
@@ -115,6 +126,12 @@ class GPTConfig:
             raise ValueError(
                 f"attention_impl must be softmax|flash|naive, got "
                 f"{self.attention_impl!r}")
+        if self.pp_schedule not in ("1f1b", "zb"):
+            raise ValueError(
+                f"pp_schedule={self.pp_schedule!r} is not a pipeline "
+                "schedule; legal values are '1f1b' (autodiff backward, "
+                "interleaved under virtual chunks) and 'zb' (zero-bubble "
+                "split backward) — both consumed by GPTPipeline")
         if self.remat_policy not in (
                 "full", "save_attn", "save_attn_mlp", "mlp_only"):
             raise ValueError(

@@ -54,6 +54,7 @@ from apex_tpu.monitor.registry import (  # noqa: F401
     emit_event,
     emit_longseq_bias,
     emit_meta,
+    emit_pipeline,
     emit_profile,
     emit_serve,
     emit_tp_overlap,
@@ -73,6 +74,7 @@ from apex_tpu.monitor.hooks import (  # noqa: F401
     observe_scaler,
     observe_updates,
     pipeline_bubble_fraction,
+    pipeline_cost_model,
     record_pipeline_schedule,
     tree_bytes,
 )

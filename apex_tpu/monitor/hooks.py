@@ -134,23 +134,99 @@ def pipeline_bubble_fraction(num_microbatches: int, pipeline_size: int,
     return (pipeline_size - 1) / ticks if ticks else 0.0
 
 
+def pipeline_cost_model(num_microbatches: int, pipeline_size: int,
+                        virtual_chunks: int = 1, schedule: str = "1f1b",
+                        overlap_p2p: bool = False) -> dict:
+    """Unit-cost trace-time geometry of one full fwd+bwd pipeline step.
+
+    Cost units: F = B = W = 1 — one chunk's forward, activation-grad (dX)
+    and weight-grad (dW) compute respectively (the classic 1:1:1 split of
+    a GEMM-dominated block: backward ≈ 2× forward, half of it dX). Hop
+    time is priced at ZERO — off-TPU geometry cannot measure ICI; on TPU
+    ``prof.trace_reader.step_anatomy`` measures what the hops actually
+    expose (``overlap_p2p`` therefore only *costs* in this model — its
+    longer drain — while its win, hidden hop latency, shows up only in
+    measured anatomy).
+
+    * ``"1f1b"`` (autodiff backward): every one of the
+      ``Mv + L(S−1) + (L−1)`` backward ticks pays B+W — garbage
+      warmup/drain lanes included. Scheduled units = 3 × fwd_ticks.
+    * ``"zb"``: the backward splits — dX rides the same tick count at B
+      each, dW runs ``M·v`` real-item ticks at W each.
+      Scheduled units = 2 × fwd_ticks + M·v: the (S−1)·W drain term is
+      gone.
+
+    Per-device useful work is ``3·M·v`` either way, so
+    ``bubble_fraction = 1 − ideal/total`` is the SLOT-WASTE fraction —
+    the share of scheduled compute slots holding warmup/drain garbage.
+    Recompute is priced SEPARATELY and honestly in ``recompute_units``:
+    with per-tick remat the 1f1b backward re-runs F on each of its
+    ``fwd_ticks``; the zb implementation re-runs F in BOTH sweeps
+    (``jax.vjp`` from the per-tick stashed inputs — remat-class memory),
+    so zb pays ``M·v`` MORE recompute than rematted 1f1b. Net compute
+    (``total_units + recompute_units``) therefore favors 1f1b by
+    ``Mv − (S−1)`` units; zb's real wins are (a) the dW sweep's
+    ``M·v`` ticks are COLLECTIVE-FREE (no ppermute on the critical
+    path — hop latency and inter-stage sync exit for those ticks, which
+    the hop-cost-0 model cannot price) and (b) zero garbage dW slots.
+    The wall-clock verdict is the measured one: ``bench.py --pipeline``'s
+    ``vs_1f1b`` / ``step_anatomy`` bubbles on TPU, never this model."""
+    M, S, v = num_microbatches, pipeline_size, virtual_chunks
+    if schedule not in ("1f1b", "zb"):
+        raise ValueError(
+            f"schedule={schedule!r}: pipeline_cost_model prices '1f1b' "
+            "and 'zb' only — an unknown name must not be silently priced "
+            "as 1f1b")
+    L = 2 if overlap_p2p else 1
+    fwd = M * v + L * (S - 1) + (L - 1)
+    if schedule == "zb":
+        dx_ticks, dw_ticks = fwd, M * v
+        recompute = fwd + M * v  # F re-run in the dX sweep AND per dW tick
+    else:
+        dx_ticks, dw_ticks = fwd, fwd
+        recompute = fwd  # per-tick remat re-runs F once per backward tick
+    total = fwd + dx_ticks + dw_ticks
+    ideal = 3 * M * v
+    return {
+        "schedule": schedule,
+        "overlap_p2p": overlap_p2p,
+        "fwd_ticks": fwd,
+        "bwd_dx_ticks": dx_ticks,
+        "bwd_dw_ticks": dw_ticks,
+        "total_units": total,
+        "ideal_units": ideal,
+        "recompute_units": recompute,
+        "collective_free_ticks": dw_ticks if schedule == "zb" else 0,
+        "bubble_fraction": (1.0 - ideal / total) if total else 0.0,
+    }
+
+
 def record_pipeline_schedule(*, num_microbatches: int, pipeline_size: int,
                              virtual_chunks: int = 1,
                              tick_bytes: Optional[int] = None,
-                             axis: str = "pp") -> None:
+                             axis: str = "pp", schedule: str = "1f1b",
+                             overlap_p2p: bool = False) -> None:
     """Record a pipeline schedule's static geometry (trace-time hook).
 
-    Emits one ``pipeline_schedule`` event with the tick count and bubble
-    fraction, sets gauge ``pipeline/bubble_fraction``, and — when the
-    per-tick activation size is known — accounts the schedule's ppermute
-    traffic via :func:`count_collective` (ticks × bytes per step)."""
+    Emits one ``pipeline_schedule`` event with the tick count, the legacy
+    forward-sweep bubble fraction, and the full-step unit-cost bubble
+    (:func:`pipeline_cost_model` — schedule-aware, so ``"zb"`` shows its
+    smaller step bubble); sets gauges ``pipeline/bubble_fraction``
+    (forward sweep, back-compat) and ``pipeline/bubble_fraction_step``;
+    and — when the per-tick activation size is known — accounts the
+    schedule's ppermute traffic via :func:`count_collective` (forward
+    ticks × bytes per step)."""
     r = _reg.get_registry()
     if r is None:
         return
-    ticks = num_microbatches * virtual_chunks + pipeline_size - 1
+    cost = pipeline_cost_model(num_microbatches, pipeline_size,
+                               virtual_chunks, schedule=schedule,
+                               overlap_p2p=overlap_p2p)
+    ticks = cost["fwd_ticks"]
     bubble = pipeline_bubble_fraction(num_microbatches, pipeline_size,
                                       virtual_chunks)
     r.gauge("pipeline/bubble_fraction", bubble)
+    r.gauge("pipeline/bubble_fraction_step", cost["bubble_fraction"])
     r.emit_event(
         "pipeline_schedule",
         num_microbatches=num_microbatches,
@@ -158,6 +234,11 @@ def record_pipeline_schedule(*, num_microbatches: int, pipeline_size: int,
         virtual_chunks=virtual_chunks,
         ticks=ticks,
         bubble_fraction=round(bubble, 6),
+        schedule=schedule,
+        overlap_p2p=overlap_p2p,
+        bubble_fraction_step=round(cost["bubble_fraction"], 6),
+        bwd_dx_ticks=cost["bwd_dx_ticks"],
+        bwd_dw_ticks=cost["bwd_dw_ticks"],
     )
     if tick_bytes:
         count_collective("ppermute", bytes=tick_bytes, count=ticks,

@@ -265,6 +265,13 @@ class MetricsRegistry:
         latency / TTFT percentiles, tokens/s under churn, occupancy."""
         return self._emit_status_record("serve", status, **fields)
 
+    def emit_pipeline(self, status: str, **fields) -> Dict[str, Any]:
+        """Pipeline-schedule bench record (``bench.py --pipeline``):
+        zero-bubble vs autodiff-1f1b tokens/s at pp >= 2, bubble %
+        measured by step_anatomy on TPU / the trace-time unit-cost
+        geometry off-TPU."""
+        return self._emit_status_record("pipeline", status, **fields)
+
     def emit_profile(self, status: str, **fields) -> Dict[str, Any]:
         """Step-anatomy profile record (``bench.py --profile``): spans +
         device trace fused into the per-step compute/collective/bubble/
@@ -465,6 +472,13 @@ def emit_serve(status: str, **fields) -> Optional[Dict[str, Any]]:
     r = _REGISTRY
     if r is not None:
         return r.emit_serve(status, **fields)
+    return None
+
+
+def emit_pipeline(status: str, **fields) -> Optional[Dict[str, Any]]:
+    r = _REGISTRY
+    if r is not None:
+        return r.emit_pipeline(status, **fields)
     return None
 
 

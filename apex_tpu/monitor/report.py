@@ -71,6 +71,7 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     longseq_records = []
     tp_overlap_records = []
     serve_records = []
+    pipeline_records = []
     schedule = None
     for rec in records:
         kind = rec.get("kind")
@@ -90,6 +91,8 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             tp_overlap_records.append(rec)
         elif kind == "serve":
             serve_records.append(rec)
+        elif kind == "pipeline":
+            pipeline_records.append(rec)
         elif kind == "event" and rec.get("name") == "pipeline_schedule":
             schedule = rec
 
@@ -154,6 +157,9 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "pipeline_size": schedule.get("pipeline_size"),
             "virtual_chunks": schedule.get("virtual_chunks"),
             "ticks": schedule.get("ticks"),
+            "schedule": schedule.get("schedule"),
+            "overlap_p2p": schedule.get("overlap_p2p"),
+            "bubble_fraction_step": schedule.get("bubble_fraction_step"),
         }
         # per-(microbatch, stage) wall time: a chunk-tick is exactly one
         # microbatch through one (virtual) stage, so when the caller timed
@@ -231,6 +237,16 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                             "requests", "slots", "block_size",
                             "blocks_high_water"))
 
+    if pipeline_records:
+        summary["pipeline_bench"] = status_summary(
+            pipeline_records, ("schedule", "tokens_per_s",
+                               "tokens_per_s_1f1b", "vs_1f1b",
+                               "bubble_pct", "bubble_pct_1f1b",
+                               "bubble_pct_geometry",
+                               "bubble_pct_1f1b_geometry",
+                               "pipeline_size", "virtual_chunks",
+                               "num_microbatches", "p2p_bytes_per_step"))
+
     if gate_records:
         summary["gates"] = [
             {"name": g.get("name"), "ok": g.get("ok"),
@@ -268,10 +284,16 @@ def render(summary: Dict[str, Any]) -> str:
                         if "loss_scale_last" in summary else ""))
     pipe = summary.get("pipeline")
     if pipe and pipe.get("bubble_fraction") is not None:
+        sched = pipe.get("schedule")
+        step_b = pipe.get("bubble_fraction_step")
         lines.append(f"  pipeline    bubble {100*pipe['bubble_fraction']:.2f}%"
                      f"  (M={pipe.get('num_microbatches')} "
                      f"S={pipe.get('pipeline_size')} "
-                     f"v={pipe.get('virtual_chunks')})")
+                     f"v={pipe.get('virtual_chunks')}"
+                     + (f" sched={sched}" if sched else "")
+                     + (f" step-bubble {100*step_b:.2f}%"
+                        if isinstance(step_b, (int, float)) else "")
+                     + ")")
         if pipe.get("per_tick_wall_s") is not None:
             lines.append(f"  pipeline    per-(microbatch,stage) tick "
                          f"{pipe['per_tick_wall_s']*1e3:.3f} ms wall")
@@ -332,6 +354,28 @@ def render(summary: Dict[str, Any]) -> str:
             if srv.get("skipped"):
                 parts.append("skipped: " + ", ".join(srv["skipped"]))
             lines.append("  serve       " + "   ".join(parts))
+    pb = summary.get("pipeline_bench")
+    if pb:
+        if pb.get("status") == "SKIP":
+            lines.append(f"  pipeline-bench SKIP({pb.get('reason', '?')})")
+        else:
+            parts = []
+            if pb.get("schedule"):
+                parts.append(f"{pb['schedule']}")
+            if isinstance(pb.get("tokens_per_s"), (int, float)):
+                parts.append(f"{pb['tokens_per_s']:.1f} tok/s")
+            if isinstance(pb.get("vs_1f1b"), (int, float)):
+                parts.append(f"{pb['vs_1f1b']:.2f}x vs 1f1b")
+            if isinstance(pb.get("bubble_pct"), (int, float)):
+                parts.append(f"bubble {pb['bubble_pct']:.1f}%")
+            elif isinstance(pb.get("bubble_pct_geometry"), (int, float)):
+                parts.append(
+                    f"bubble {pb['bubble_pct_geometry']:.1f}% (geometry)")
+            if isinstance(pb.get("p2p_bytes_per_step"), (int, float)):
+                parts.append(f"p2p {pb['p2p_bytes_per_step']/1e6:.2f} MB/step")
+            if pb.get("skipped"):
+                parts.append("skipped: " + ", ".join(pb["skipped"]))
+            lines.append("  pipeline-bench " + "   ".join(parts))
     tpo = summary.get("tp_overlap")
     if tpo:
         if tpo.get("status") == "SKIP":

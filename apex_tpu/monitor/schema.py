@@ -227,6 +227,48 @@ TP_OVERLAP_SCHEMA = {
     "required": ["schema", "kind", "status"],
 }
 
+# pipeline-schedule bench record (`python bench.py --pipeline`): the
+# zero-bubble schedule family vs the autodiff 1f1b baseline at pp >= 2 —
+# fwd+bwd tokens/s for both schedules plus bubble %. Two bubble flavors,
+# honestly labeled: *_geometry fields are the trace-time unit-cost model
+# (monitor.pipeline_cost_model — closed form, any backend); bubble_pct /
+# bubble_pct_1f1b are MEASURED device idle from prof.trace_reader
+# .step_anatomy and exist only on a real TPU trace. Same status semantics
+# as decode/tp_overlap: "OK" (real multichip TPU) engages the honesty
+# rule; off-TPU the record is an explicit SKIP(reason) with the smoke
+# numbers and geometry riding along. Never nan in an OK line.
+PIPELINE_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["pipeline"]},
+        "status": {"enum": ["OK", "SKIP"]},
+        "reason": {"type": "string"},  # required when status == "SKIP"
+        "schedule": {"type": "string"},          # the measured schedule
+        "pipeline_size": {"type": "integer"},
+        "virtual_chunks": {"type": "integer"},
+        "num_microbatches": {"type": "integer"},
+        "overlap_p2p": {"type": "boolean"},
+        "tokens_per_s": _METRIC_VALUE,           # the zb schedule
+        "tokens_per_s_1f1b": _METRIC_VALUE,      # the autodiff baseline
+        "vs_1f1b": _METRIC_VALUE,                # zb / 1f1b
+        "bubble_pct": _METRIC_VALUE,             # measured (step_anatomy)
+        "bubble_pct_1f1b": _METRIC_VALUE,
+        "bubble_pct_geometry": _METRIC_VALUE,    # unit-cost model
+        "bubble_pct_1f1b_geometry": _METRIC_VALUE,
+        "p2p_bytes_per_step": {"type": "integer"},
+        "jit_cache_ok": {"type": "boolean"},     # geometry reuse, no retrace
+        "spread_pct": _METRIC_VALUE,
+        "spread_pct_1f1b": _METRIC_VALUE,
+        "pass_times_ms": {"type": "array", "items": {"type": "number"}},
+        "pass_times_1f1b_ms": {"type": "array",
+                               "items": {"type": "number"}},
+        "config": {"type": "object"},
+        "backend": {"type": "string"},
+    },
+    "required": ["schema", "kind", "status"],
+}
+
 # continuous-batching serving bench record (`python bench.py --serve`):
 # one record per offered-load run through apex_tpu.serving.ServingEngine —
 # per-token latency and TTFT percentiles, decode tokens/s under churn,
@@ -395,6 +437,7 @@ SCHEMAS_BY_KIND = {
     "decode": DECODE_SCHEMA,
     "longseq_bias": LONGSEQ_BIAS_SCHEMA,
     "tp_overlap": TP_OVERLAP_SCHEMA,
+    "pipeline": PIPELINE_SCHEMA,
     "serve": SERVE_SCHEMA,
     "span": SPAN_SCHEMA,
     "profile": PROFILE_SCHEMA,
@@ -497,7 +540,7 @@ def validate(record: Dict[str, Any],
     # too, but externally produced streams must not pass the validator
     # with a claim-free, reason-free skip)
     if (record.get("kind") in ("decode", "longseq_bias", "tp_overlap",
-                               "profile", "serve")
+                               "profile", "serve", "pipeline")
             and record.get("status") == "SKIP"
             and not record.get("reason")):
         errors.append(

@@ -13,6 +13,7 @@ trade-off that 1F1B exists to manage).
 from apex_tpu.transformer.pipeline_parallel.p2p_communication import (  # noqa: F401
     recv_backward,
     recv_forward,
+    rotate_overlapped,
     send_backward,
     send_forward,
     send_backward_recv_forward,
@@ -22,6 +23,7 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
     forward_backward_no_pipelining,
     forward_backward_pipelining_without_interleaving,
     forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_zero_bubble,
     get_forward_backward_func,
     pipeline_spmd_forward,
 )

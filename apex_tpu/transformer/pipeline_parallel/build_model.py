@@ -259,6 +259,8 @@ class GPTPipeline:
         dp_axis: Optional[str] = None,
         key: Optional[jax.Array] = None,
         return_aux: bool = False,
+        schedule: Optional[str] = None,
+        overlap_p2p: Optional[bool] = None,
     ):
         """Pipelined forward+backward over ``(M, b, s)`` microbatched
         tokens. Must run inside ``shard_map``; ``pipe_params`` are this
@@ -281,8 +283,21 @@ class GPTPipeline:
         parallel replicas draw decorrelated masks without caller effort.
         Probs dropout rides IN-KERNEL on every flash path (counter-hash
         masks, O(block) memory — ``ops.pallas.attention.dropout_keep``),
-        so ``dropout > 0`` keeps O(s) attention memory at long sequence."""
+        so ``dropout > 0`` keeps O(s) attention memory at long sequence.
+
+        ``schedule``/``overlap_p2p`` default to the model's
+        ``config.pp_schedule``/``config.overlap_p2p`` — ``"zb"`` runs the
+        zero-bubble split backward (dW deferred into a real-items-only
+        sweep), ``overlap_p2p=True`` issues every stage-boundary ppermute
+        before the stage body it is independent of (see
+        ``schedules.pipeline_spmd_forward``). All pre/post-process
+        placement, MoE aux accumulation, dropout keying, and the fp32
+        main-grad contract are schedule-independent."""
         model, v = self.model, self.virtual_chunks
+        if schedule is None:
+            schedule = getattr(model.config, "pp_schedule", "1f1b")
+        if overlap_p2p is None:
+            overlap_p2p = getattr(model.config, "overlap_p2p", False)
         ep_ax = getattr(model.config, "ep_axis", None)
         if model.config.dropout > 0 and key is None:
             raise ValueError(
@@ -315,6 +330,7 @@ class GPTPipeline:
                 remat=model.config.remat, broadcast_outputs=False,
                 tick_arg=True,
                 aux_init=ROUTER_AUX_ZEROS if model.moe else None,
+                schedule=schedule, overlap_p2p=overlap_p2p,
             )
             if model.moe:
                 outs, aux_local = out

@@ -47,6 +47,31 @@ def _rotate(x: PyTree, axis_name: str, shift: int) -> PyTree:
             lambda a: jax.lax.ppermute(a, axis_name, perm), x)
 
 
+def rotate_overlapped(x: PyTree, compute_fn, *,
+                      axis_name: str = mesh_lib.PIPELINE_AXIS,
+                      shift: int = +1):
+    """Issue the hop, run ``compute_fn`` — which must NOT depend on the
+    hop's operand or result — then hand both back as
+    ``(rotated, compute_out)``.
+
+    This is PR 5's collective-matmul scheduling story applied to the
+    pipeline boundary: XLA will not overlap a ``ppermute`` with compute
+    that *consumes* it, but its latency-hiding scheduler freely runs the
+    hop (async collective-permute start/done) concurrently with ops that
+    are data-independent of it. Structuring a pipeline tick as
+    issue → stage body → consume-next-tick creates exactly that
+    independence; the schedules' ``overlap_p2p=True`` path drives it (one
+    extra in-flight activation per device and S extra drain ticks buy
+    every hop priced at zero — ``schedules.pipeline_spmd_forward`` has
+    the geometry, ``monitor.pipeline_cost_model`` the unit-cost model).
+
+    The blocking helpers above remain the right call when there is no
+    independent compute to hide behind — a lone rotation hides nothing.
+    """
+    rotated = _rotate(x, axis_name, shift)
+    return rotated, compute_fn()
+
+
 def send_forward(x: PyTree, axis_name: str = mesh_lib.PIPELINE_AXIS) -> PyTree:
     """Rotate activations to the next stage (``send_forward`` ``:232-248``
     fused with the matching ``recv_forward`` ``:187-207`` — in SPMD the send

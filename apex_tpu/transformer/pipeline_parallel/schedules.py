@@ -35,6 +35,42 @@ of the non-interleaved S−1 stage-ticks: total forward time
 ``M·v + S − 1`` chunk-times vs ``(M + S − 1)·v``. Requires ``M % S == 0``
 (the reference's ``num_microbatches % pipeline_parallel_size == 0`` assert,
 ``fwd_bwd_pipelining_with_interleaving.py:87``).
+
+Zero-bubble family (``schedule="zb"``): the autodiff backward pays B+W on
+every backward tick (B = dX, the activation grad that feeds the upstream
+stage; W = dW, the weight grad whose only deadline is the optimizer step)
+— including the S−1 warmup/drain ticks whose lanes hold garbage. The zb
+schedule hand-writes the transpose as TWO sweeps: a dX-only reverse sweep
+(the critical path, B per tick over the same M·v + S − 1 ticks) that
+stashes each tick's (stage input, output cotangent) pair, and a deferred
+dW sweep of exactly ``M·v`` ticks — only real items, no garbage lanes.
+Scheduled-slot totals: 3·(Mv+S−1) for the autodiff schedule vs
+2·(Mv+S−1) + Mv for zb — the (S−1)·W drain-bubble term is gone (the
+ZB-H1 decomposition of arXiv:2401.10241 / the schedule-vs-compute
+separation of veScale, in scan/SPMD form). Priced honestly, the zb
+sweeps RECOMPUTE the stage forward from the stashed inputs (``jax.vjp``
+— remat-class memory), one F more per item than rematted 1f1b pays; what
+zb buys in exchange is zero garbage dW slots and ``M·v`` dW ticks with
+NO collective on the critical path (hop latency and inter-stage sync
+exit for the whole sweep). ``monitor.pipeline_cost_model`` reports both
+sides (``bubble_fraction`` = slot waste, ``recompute_units``,
+``collective_free_ticks``); the wall-clock verdict is measured by
+``bench.py --pipeline``, never projected. fp32 main-grad accumulation
+order is pinned to the reverse-tick order the autodiff transpose uses,
+so grads stay parity-exact against the serial oracle.
+
+``overlap_p2p=True`` restructures the tick so the ``ppermute`` hop is
+ISSUED before the stage compute it no longer feeds: the carry holds two
+items per device — one being computed, one in flight — so the hop of the
+previous tick's output and this tick's stage body are data-independent
+and XLA's latency-hiding scheduler runs them concurrently (PR 5's
+collective-matmul trick at the pp boundary). Cost: each hop spans a full
+tick, so the per-hop latency L becomes 2 — items flow in groups of
+G = 2·S phases (``M % 2S == 0`` when interleaved) and the drain grows by
+S ticks; the win is every hop priced at zero instead of serializing with
+the stage. Composes with both schedules (the zb backward's cotangent hop
+is overlapped the same way, and its dW sweep is hop-free by
+construction).
 """
 
 from __future__ import annotations
@@ -48,8 +84,14 @@ import jax.numpy as jnp
 from apex_tpu.monitor import hooks as monitor_hooks
 from apex_tpu.monitor import spans as monitor_spans
 from apex_tpu.parallel import mesh as mesh_lib
+from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
 
 PyTree = Any
+
+#: legal values of the ``schedule=`` knob (pipeline_spmd_forward and the
+#: fwd_bwd wrappers; build_schedule additionally accepts "interleaved",
+#: which is "1f1b" with virtual chunks)
+PIPELINE_SCHEDULES = ("1f1b", "zb")
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
@@ -74,6 +116,295 @@ def _bcast_bwd(axis_name, _, g):
 _broadcast_from_first.defvjp(_bcast_fwd, _bcast_bwd)
 
 
+def _item_at(u, v, M, G):
+    """(chunk, microbatch, in-range) of the item with phase ``u = t − L·r``
+    where ``G = L·S`` is the injection-group span (L = 1 blocking hops,
+    L = 2 overlapped hops — each hop then spans a full tick, so the
+    chunk-c→c+1 wrap adds exactly G to the phase and the modular item
+    arithmetic is the interleaved schedule's with S → G; only G enters
+    the arithmetic)."""
+    uc = jnp.maximum(u, 0)
+    c = (uc // G) % v
+    m = G * ((uc // G) // v) + uc % G
+    return c, jnp.clip(m, 0, M - 1), (u >= 0) & (m < M)
+
+
+def _chunk_call(stage_fn, v, tick_arg):
+    """Uniform ``call(params, x, c, t)`` over the v=1 / chunked param
+    layouts: the chunk slice lives INSIDE the call so a vjp with respect
+    to the stacked params transposes it to a scatter-add into chunk c."""
+    def call(params, x, c, t):
+        chunk = (params if v == 1 else jax.tree.map(
+            lambda q: jax.lax.dynamic_index_in_dim(q, c, 0, keepdims=False),
+            params))
+        return stage_fn(chunk, x, t) if tick_arg else stage_fn(chunk, x)
+    return call
+
+
+def _mask_aux_tree(a, ok):
+    m = ok.astype(jnp.float32)
+    return jax.tree.map(lambda x: x * m, a)
+
+
+def _unified_forward(stage_call, stage_params, microbatches, aux0, *,
+                     axis_name, virtual_chunks, latency, has_aux,
+                     collect_xs):
+    """Shared forward scan for the overlap/zero-bubble schedule family.
+
+    ``stage_call(params, x, c, t) -> y`` (or ``(y, aux)`` with
+    ``has_aux``). ``latency`` is the per-hop tick latency L: 1 = blocking
+    rotation (the hop is consumed the tick it is issued, the classic
+    scanned schedule); 2 = ``overlap_p2p`` (each tick issues the hop of
+    the PREVIOUS tick's output through :func:`p2p.rotate_overlapped`,
+    runs this tick's stage — independent of the in-flight hop — and only
+    the next tick consumes the arrival).
+
+    Returns ``(outputs, aux_sum, xs)``; ``xs`` stashes every tick's stage
+    INPUT (the zero-bubble backward's residuals — the same per-tick
+    activation remat keeps) when ``collect_xs``, else a dummy scalar.
+    """
+    S = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    v = virtual_chunks
+    L = latency
+    G = L * S
+    mb_shape = microbatches.shape[1:]
+    T = M * v + L * (S - 1) + (L - 1)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def inject_for(m):
+        return jax.lax.dynamic_index_in_dim(microbatches, m, 0,
+                                            keepdims=False)
+
+    outputs0 = jnp.zeros((M,) + mb_shape, microbatches.dtype)
+    xs0 = (jnp.zeros((T,) + mb_shape, microbatches.dtype) if collect_xs
+           else jnp.zeros(()))
+
+    def collect(outputs, sent, u_out):
+        c_o, m_o, in_range = _item_at(u_out, v, M, G)
+        valid = in_range & (c_o == v - 1) & (rank == 0)
+        updated = jax.lax.dynamic_update_index_in_dim(
+            outputs, sent.astype(outputs.dtype), m_o, 0)
+        return jnp.where(valid, updated, outputs)
+
+    if L == 1:
+        def tick(carry, t):
+            x, outputs, aux_sum, xs = carry
+            c, m, in_flight = _item_at(t - rank, v, M, G)
+            x = jnp.where((rank == 0) & (c == 0), inject_for(m), x)
+            if collect_xs:
+                xs = jax.lax.dynamic_update_index_in_dim(xs, x, t, 0)
+            with monitor_spans.span("pp_stage"):
+                y = stage_call(stage_params, x, c, t)
+            if has_aux:
+                y, a = y
+                aux_sum = jax.tree.map(
+                    jnp.add, aux_sum, _mask_aux_tree(a, in_flight))
+            with monitor_spans.collective_span("ppermute", y, axis_name):
+                sent = jax.lax.ppermute(y, axis_name, perm)
+            # the item device S-1 finished THIS tick arrives post-rotate
+            outputs = collect(outputs, sent, t - (S - 1))
+            return (sent, outputs, aux_sum, xs), None
+
+        carry0 = (jnp.zeros(mb_shape, microbatches.dtype),
+                  outputs0, aux0, xs0)
+    else:
+        def tick(carry, t):
+            # two items per device: x (ready to compute), y_prev (output
+            # of last tick, to hop this tick) — issue the hop, run the
+            # stage, consume next tick
+            x, y_prev, outputs, aux_sum, xs = carry
+            c, m, in_flight = _item_at(t - L * rank, v, M, G)
+            if collect_xs:
+                xs = jax.lax.dynamic_update_index_in_dim(xs, x, t, 0)
+
+            def compute():
+                with monitor_spans.span("pp_stage"):
+                    return stage_call(stage_params, x, c, t)
+
+            sent, y = p2p.rotate_overlapped(y_prev, compute,
+                                            axis_name=axis_name)
+            if has_aux:
+                y, a = y
+                aux_sum = jax.tree.map(
+                    jnp.add, aux_sum, _mask_aux_tree(a, in_flight))
+            # the arriving item was computed on device S-1 at tick t-1
+            outputs = collect(outputs, sent, t - 1 - L * (S - 1))
+            # next tick's compute input: fresh injection when device 0's
+            # next item starts chunk 0, the arrival otherwise
+            c_n, m_n, _ = _item_at(t + 1 - L * rank, v, M, G)
+            x_next = jnp.where((rank == 0) & (c_n == 0),
+                               inject_for(m_n), sent)
+            return (x_next, y, outputs, aux_sum, xs), None
+
+        # tick 0 computes phase 0 on device 0 (no prior tick to inject it)
+        x0 = jnp.where(rank == 0, inject_for(jnp.int32(0)),
+                       jnp.zeros(mb_shape, microbatches.dtype))
+        carry0 = (x0, jnp.zeros(mb_shape, microbatches.dtype),
+                  outputs0, aux0, xs0)
+
+    carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+    outputs, aux_sum, xs = carry[-3], carry[-2], carry[-1]
+    return outputs, aux_sum, xs
+
+
+# --- zero-bubble: split backward with deferred dW -----------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _zb_pipeline(spec, stage_fn, stage_params, microbatches, aux0):
+    """Scanned pipeline forward whose TRANSPOSE is the zero-bubble
+    schedule: a dX-only reverse sweep (critical path) plus a deferred
+    ``M·v``-tick dW sweep (see :func:`_zb_bwd`). ``spec`` is the hashable
+    static geometry ``(axis_name, virtual_chunks, latency, tick_arg,
+    has_aux)``; returns ``(outputs, aux_sum)``."""
+    axis_name, v, L, tick_arg, has_aux = spec
+    outputs, aux_sum, _ = _unified_forward(
+        _chunk_call(stage_fn, v, tick_arg), stage_params, microbatches,
+        aux0, axis_name=axis_name, virtual_chunks=v, latency=L,
+        has_aux=has_aux, collect_xs=False)
+    return outputs, aux_sum
+
+
+def _zb_fwd(spec, stage_fn, stage_params, microbatches, aux0):
+    axis_name, v, L, tick_arg, has_aux = spec
+    outputs, aux_sum, xs = _unified_forward(
+        _chunk_call(stage_fn, v, tick_arg), stage_params, microbatches,
+        aux0, axis_name=axis_name, virtual_chunks=v, latency=L,
+        has_aux=has_aux, collect_xs=True)
+    return (outputs, aux_sum), (stage_params, microbatches, xs)
+
+
+def _zb_bwd(spec, stage_fn, res, cot):
+    """The zero-bubble backward.
+
+    Sweep 1 (dX, the critical path): the exact transpose of the forward
+    scan restricted to activation cotangents — T reverse ticks, each
+    rotating the cotangent one stage up (``ppermute`` with the inverse
+    permutation) and pulling it through the stage's input only; the
+    (stage input, output cotangent) pair of every tick is stashed. Under
+    ``overlap_p2p`` the hop is data-independent of the tick's vjp (the
+    same two-item carry, transposed), so it stays overlapped.
+
+    Sweep 2 (dW, deferred): exactly ``M·v`` ticks per device — one per
+    REAL item, no warmup/drain garbage lanes — each pulling the stashed
+    cotangent through the stage's parameters. Accumulation runs in
+    reverse phase order, the same order the autodiff transpose uses, so
+    fp32 main-grad sums are parity-exact against the serial oracle."""
+    axis_name, v, L, tick_arg, has_aux = spec
+    stage_params, microbatches, xs = res
+    d_outputs, d_aux = cot
+    S = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    G = L * S
+    T = M * v + L * (S - 1) + (L - 1)
+    N = M * v
+    perm_back = [(i, (i - 1) % S) for i in range(S)]
+    call = _chunk_call(stage_fn, v, tick_arg)
+    mb_shape = microbatches.shape[1:]
+    act_dtype = microbatches.dtype
+
+    def out_cot(u_out, like):
+        """Transpose of the output collection: lane m_out's cotangent is
+        consumed at the single tick that wrote it (rank 0)."""
+        c_o, m_o, in_range = _item_at(u_out, v, M, G)
+        valid = in_range & (c_o == v - 1) & (rank == 0)
+        d_out = jax.lax.dynamic_index_in_dim(d_outputs, m_o, 0,
+                                             keepdims=False)
+        return jnp.where(valid, d_out.astype(like.dtype),
+                         jnp.zeros_like(like))
+
+    def stage_cot(dy, ok):
+        if has_aux:
+            return (dy, _mask_aux_tree(d_aux, ok))
+        return dy
+
+    def pull_dx(t, dy):
+        c, m, in_flight = _item_at(t - L * rank, v, M, G)
+        x = jax.lax.dynamic_index_in_dim(xs, t, 0, keepdims=False)
+        with monitor_spans.span("pp_dx"):
+            _, vjp_fn = jax.vjp(lambda xx: call(stage_params, xx, c, t), x)
+            (dx,) = vjp_fn(stage_cot(dy, in_flight))
+        starts = (rank == 0) & (c == 0)
+        return dx, m, starts, in_flight
+
+    d_mb0 = jnp.zeros(microbatches.shape, act_dtype)
+    dys0 = jnp.zeros((T,) + mb_shape, act_dtype)
+
+    if L == 1:
+        def dx_tick(carry, t):
+            g, d_mb, dys = carry  # g = d(sent_t) from the downstream tick
+            d_sent = g + out_cot(t - (S - 1), g)
+            with monitor_spans.collective_span("ppermute", d_sent,
+                                               axis_name):
+                dy = jax.lax.ppermute(d_sent, axis_name, perm_back)
+            dys = jax.lax.dynamic_update_index_in_dim(dys, dy, t, 0)
+            dx, m, starts, in_flight = pull_dx(t, dy)
+            d_mb = d_mb.at[m].add(
+                jnp.where(starts & in_flight, dx, jnp.zeros_like(dx)))
+            g_prev = jnp.where(starts, jnp.zeros_like(dx), dx)
+            return (g_prev, d_mb, dys), None
+
+        carry0 = (jnp.zeros(mb_shape, act_dtype), d_mb0, dys0)
+        (_, d_mb, dys), _ = jax.lax.scan(
+            dx_tick, carry0, jnp.arange(T), reverse=True)
+    else:
+        def dx_tick(carry, t):
+            gx, gy, d_mb, dys = carry  # gx = d(x_{t+1}), gy = d(y_t)
+            c_n, m_n, fl_n = _item_at(t + 1 - L * rank, v, M, G)
+            starts_n = (rank == 0) & (c_n == 0)
+            d_mb = d_mb.at[m_n].add(
+                jnp.where(starts_n & fl_n, gx, jnp.zeros_like(gx)))
+            d_sent = (jnp.where(starts_n, jnp.zeros_like(gx), gx)
+                      + out_cot(t - 1 - L * (S - 1), gx))
+            dys = jax.lax.dynamic_update_index_in_dim(dys, gy, t, 0)
+            # the cotangent hop is independent of this tick's vjp — the
+            # forward's overlap structure survives transposition
+            def compute():
+                dx, _, _, _ = pull_dx(t, gy)
+                return dx
+
+            d_y_prev, dx = p2p.rotate_overlapped(
+                d_sent, compute, axis_name=axis_name, shift=-1)
+            return (dx, d_y_prev, d_mb, dys), None
+
+        carry0 = (jnp.zeros(mb_shape, act_dtype),
+                  jnp.zeros(mb_shape, act_dtype), d_mb0, dys0)
+        (gx_fin, _, d_mb, dys), _ = jax.lax.scan(
+            dx_tick, carry0, jnp.arange(T), reverse=True)
+        # x_0 was initialized to microbatch 0 on rank 0 outside the scan
+        d_mb = d_mb.at[0].add(
+            jnp.where(rank == 0, gx_fin, jnp.zeros_like(gx_fin)))
+
+    # deferred dW: one tick per REAL item (phase u, forward tick u + L·r),
+    # in reverse phase order — the order the autodiff transpose
+    # accumulates in, so fp32 main-grad sums match the oracle bit-for-bit
+    # in ordering (every u in [0, M·v) is real on every device)
+    def add_cot(acc, dp):
+        return jax.tree.map(
+            lambda a, d: a if d.dtype == jax.dtypes.float0 else a + d,
+            acc, dp)
+
+    def dw_tick(d_params, u):
+        t = u + L * rank
+        x = jax.lax.dynamic_index_in_dim(xs, t, 0, keepdims=False)
+        dy = jax.lax.dynamic_index_in_dim(dys, t, 0, keepdims=False)
+        c, _, _ = _item_at(u, v, M, G)
+        with monitor_spans.span("pp_dw"):
+            _, vjp_fn = jax.vjp(lambda pp: call(pp, x, c, t), stage_params)
+            (dp,) = vjp_fn(stage_cot(dy, jnp.asarray(True)))
+        return add_cot(d_params, dp), None
+
+    d_params0 = jax.tree.map(jnp.zeros_like, stage_params)
+    d_params, _ = jax.lax.scan(
+        dw_tick, d_params0, jnp.arange(N), reverse=True)
+    return d_params, d_mb, d_aux
+
+
+_zb_pipeline.defvjp(_zb_fwd, _zb_bwd)
+
+
 def pipeline_spmd_forward(
     stage_fn: Callable[[PyTree, jax.Array], jax.Array],
     stage_params: PyTree,
@@ -85,6 +416,8 @@ def pipeline_spmd_forward(
     broadcast_outputs: bool = True,
     tick_arg: bool = False,
     aux_init: PyTree = None,
+    schedule: str = "1f1b",
+    overlap_p2p: bool = False,
 ):
     """Run the SPMD pipeline forward; returns per-microbatch outputs of the
     final stage (shape = microbatches.shape with the feature dims of the
@@ -131,6 +464,18 @@ def pipeline_spmd_forward(
     pp gives the global total (MoE router aux losses are the consumer —
     they must enter the objective differentiably, which the scan-carried
     accumulator provides).
+
+    ``schedule``: ``"1f1b"`` (default — scan forward, autodiff backward;
+    interleaved when ``virtual_chunks > 1``) or ``"zb"`` (zero-bubble:
+    hand-written split backward — dX on the critical path, dW deferred
+    into a real-items-only sweep; the module docstring has the cost
+    model). ``"zb"`` ignores ``remat`` (both sweeps recompute the stage
+    from the per-tick stashed inputs — the same memory class).
+
+    ``overlap_p2p``: restructure each tick so the ``ppermute`` hop is
+    issued before the stage body it is independent of (one extra
+    in-flight item per device; with ``virtual_chunks > 1`` microbatches
+    must then flow in groups of ``2·S``). Composes with both schedules.
     """
     S = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
@@ -142,9 +487,51 @@ def pipeline_spmd_forward(
 
     aux = aux_init is not None
 
-    def _mask_aux(a, ok):
-        m = ok.astype(jnp.float32)
-        return jax.tree.map(lambda x: x * m, a)
+    if schedule not in PIPELINE_SCHEDULES:
+        raise ValueError(
+            f"schedule={schedule!r} is not a pipeline schedule; legal "
+            f"values of the schedule= knob are "
+            f"{' / '.join(map(repr, PIPELINE_SCHEDULES))} ('1f1b' is the "
+            "scanned autodiff schedule, interleaved when virtual_chunks "
+            "> 1; 'zb' is the zero-bubble split backward)")
+    if v > 1 and overlap_p2p and M % (2 * S):
+        raise ValueError(
+            f"overlap_p2p=True with virtual_chunks={v} needs "
+            f"num_microbatches ({M}) divisible by 2*pipeline_size "
+            f"({2 * S}) — each overlapped hop spans a full tick, so "
+            "microbatches flow in groups of 2*S")
+    if v > 1 and M % S:
+        raise ValueError(
+            f"the interleaved schedule needs num_microbatches ({M}) "
+            f"divisible by the pipeline size ({S}) — microbatches flow "
+            "in groups of S (the reference asserts the same, "
+            "fwd_bwd_pipelining_with_interleaving.py:87)")
+
+    if schedule == "zb" or overlap_p2p:
+        monitor_hooks.record_pipeline_schedule(
+            num_microbatches=M, pipeline_size=S, virtual_chunks=v,
+            tick_bytes=(functools.reduce(lambda a, b: a * b, mb_shape, 1)
+                        * microbatches.dtype.itemsize),
+            axis=axis_name, schedule=schedule, overlap_p2p=overlap_p2p)
+        L = 2 if overlap_p2p else 1
+        aux0 = (jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32),
+                             aux_init) if aux else jnp.zeros(()))
+        if schedule == "zb":
+            spec = (axis_name, v, L, tick_arg, aux)
+            outputs, aux_sum = _zb_pipeline(
+                spec, stage_fn, stage_params, microbatches, aux0)
+        else:  # 1f1b forward restructured for the overlapped hop
+            call = _chunk_call(stage_fn, v, tick_arg)
+            fn = jax.checkpoint(call) if remat else call
+            outputs, aux_sum, _ = _unified_forward(
+                fn, stage_params, microbatches, aux0,
+                axis_name=axis_name, virtual_chunks=v, latency=L,
+                has_aux=aux, collect_xs=False)
+        out = (outputs if not broadcast_outputs
+               else _broadcast_from_first(outputs, axis_name))
+        return (out, aux_sum) if aux else out
+
+    _mask_aux = _mask_aux_tree
 
     if v == 1:
         base_fn = (stage_fn if tick_arg
@@ -183,12 +570,7 @@ def pipeline_spmd_forward(
             return (sent, outputs, aux_sum), None
 
     else:
-        if M % S:
-            raise ValueError(
-                f"the interleaved schedule needs num_microbatches ({M}) "
-                f"divisible by the pipeline size ({S}) — microbatches flow "
-                "in groups of S (the reference asserts the same, "
-                "fwd_bwd_pipelining_with_interleaving.py:87)")
+        # M % S validated above (shared with the zb/overlap paths)
         T = M * v + S - 1
 
         def chunk_fn(params, c, x, t):
@@ -252,7 +634,7 @@ def pipeline_spmd_forward(
         num_microbatches=M, pipeline_size=S, virtual_chunks=v,
         tick_bytes=(functools.reduce(lambda a, b: a * b, mb_shape, 1)
                     * microbatches.dtype.itemsize),
-        axis=axis_name)
+        axis=axis_name, schedule=schedule, overlap_p2p=overlap_p2p)
 
     state0 = jnp.zeros(mb_shape, microbatches.dtype)
     outputs0 = jnp.zeros((M,) + mb_shape, microbatches.dtype)
@@ -350,6 +732,7 @@ def forward_backward_pipelining_without_interleaving(
     *,
     axis_name: str = mesh_lib.PIPELINE_AXIS,
     accum_dtype=jnp.float32,
+    overlap_p2p: bool = False,
 ):
     """1F1B-equivalent schedule (``fwd_bwd_pipelining_without_interleaving.py:155``):
     pipelined forward via scan+ppermute, backward from autodiff, stage remat
@@ -366,7 +749,7 @@ def forward_backward_pipelining_without_interleaving(
     def full_loss(p):
         outs = pipeline_spmd_forward(
             lambda pp, x: stage_fn(down(pp), x), p, microbatches,
-            axis_name=axis_name, remat=True
+            axis_name=axis_name, remat=True, overlap_p2p=overlap_p2p
         )
         losses = jax.vmap(loss_head)(outs, targets)
         return jnp.mean(losses)
@@ -384,6 +767,7 @@ def forward_backward_pipelining_with_interleaving(
     virtual_chunks: int,
     axis_name: str = mesh_lib.PIPELINE_AXIS,
     accum_dtype=jnp.float32,
+    overlap_p2p: bool = False,
 ):
     """Interleaved (virtual-stage) schedule
     (``fwd_bwd_pipelining_with_interleaving.py:25-375``): each device holds
@@ -402,6 +786,48 @@ def forward_backward_pipelining_with_interleaving(
             # transpose accumulates cotangents in accum_dtype)
             lambda pp, x: stage_fn(down(pp), x), p, microbatches,
             axis_name=axis_name, virtual_chunks=virtual_chunks, remat=True,
+            overlap_p2p=overlap_p2p,
+        )
+        losses = jax.vmap(loss_head)(outs, targets)
+        return jnp.mean(losses)
+
+    return jax.value_and_grad(full_loss)(p_acc)
+
+
+def forward_backward_pipelining_zero_bubble(
+    stage_fn: Callable,
+    loss_head: Callable,
+    stage_params: PyTree,
+    microbatches: jax.Array,
+    targets: Any,
+    *,
+    virtual_chunks: int = 1,
+    axis_name: str = mesh_lib.PIPELINE_AXIS,
+    accum_dtype=jnp.float32,
+    overlap_p2p: bool = False,
+):
+    """Zero-bubble schedule family (``schedule="zb"``): the stage backward
+    splits into dX (activation grad, the critical path feeding the
+    upstream stage) and dW (weight grad, deadline = optimizer step); the
+    deferred dW work runs as its own ``M·v``-tick real-items-only sweep
+    instead of riding every backward tick — the (S−1)·W warmup/drain term
+    of the autodiff schedule's bubble is gone, and the whole dW sweep is
+    collective-free. Cost honesty: both sweeps recompute the stage
+    forward from the per-tick stashed inputs, one F per item more than
+    rematted 1f1b — the trade favors zb when hops/sync dominate a tick
+    (small per-stage compute, deep pipelines), not on raw FLOPs (module
+    docstring has the full accounting; ``monitor.pipeline_cost_model``
+    prices both sides, ``bench.py --pipeline`` measures). With
+    ``virtual_chunks > 1`` this is the interleaved layout (chunked
+    ``stage_params``) on the zb backward. Same contract as the other
+    fwd_bwd functions: returns (mean loss, grads in ``accum_dtype``)."""
+    p_acc, down = _main_grad_cast(stage_params, accum_dtype)
+
+    def full_loss(p):
+        outs = pipeline_spmd_forward(
+            lambda pp, x: stage_fn(down(pp), x), p, microbatches,
+            axis_name=axis_name, virtual_chunks=virtual_chunks,
+            schedule="zb", overlap_p2p=overlap_p2p,
         )
         losses = jax.vmap(loss_head)(outs, targets)
         return jnp.mean(losses)
@@ -412,14 +838,34 @@ def forward_backward_pipelining_with_interleaving(
 def get_forward_backward_func(
     virtual_pipeline_model_parallel_size: Optional[int] = None,
     pipeline_model_parallel_size: int = 1,
+    schedule: Optional[str] = None,
 ):
     """Dispatcher with the reference's selection logic
-    (``schedules/__init__.py:22-35``)."""
+    (``schedules/__init__.py:22-35``); ``schedule="zb"`` selects the
+    zero-bubble family at pp > 1 (any v — the wrapper takes
+    ``virtual_chunks``). An unknown name raises — a typo'd schedule must
+    not silently train on the default (pp == 1 still dispatches to
+    no-pipelining regardless: one stage has no pipeline to schedule)."""
+    if schedule is not None and schedule not in BUILD_SCHEDULES:
+        raise ValueError(
+            f"schedule={schedule!r} is not a pipeline schedule; legal "
+            f"values are {' / '.join(map(repr, BUILD_SCHEDULES))} (or "
+            "None to infer 1f1b/interleaved from "
+            "virtual_pipeline_model_parallel_size)")
     if pipeline_model_parallel_size > 1:
-        if virtual_pipeline_model_parallel_size is not None:
+        if schedule == "zb":
+            return forward_backward_pipelining_zero_bubble
+        if (virtual_pipeline_model_parallel_size is not None
+                or schedule == "interleaved"):
             return forward_backward_pipelining_with_interleaving
         return forward_backward_pipelining_without_interleaving
     return forward_backward_no_pipelining
+
+
+#: build_schedule's schedule-name space: "interleaved" is "1f1b" with
+#: virtual chunks, spelled out so a config can *demand* interleaving and
+#: fail loudly when v is missing
+BUILD_SCHEDULES = ("1f1b", "interleaved", "zb")
 
 
 def build_schedule(
@@ -430,6 +876,8 @@ def build_schedule(
     pipeline_model_parallel_size: int = 1,
     virtual_pipeline_model_parallel_size: Optional[int] = None,
     rampup_batch_size: Optional[list] = None,
+    schedule: Optional[str] = None,
+    overlap_p2p: bool = False,
 ):
     """Pick the schedule *and* its microbatch count from one config — the
     glue the reference spreads across ``setup_microbatch_calculator``
@@ -452,27 +900,63 @@ def build_schedule(
     to a chunk's FLOPs on ICI). Prefer the largest v dividing
     ``num_layers // pp`` when the microbatch count is a multiple of pp
     (required); the marginal gain shrinks as M/S grows.
+
+    ``schedule`` names the family explicitly: ``"1f1b"`` (autodiff
+    backward, no virtual chunks), ``"interleaved"`` (``"1f1b"`` with
+    ``virtual_pipeline_model_parallel_size`` chunks — demanding it fails
+    loudly when v is missing instead of silently degrading), ``"zb"``
+    (zero-bubble split backward, any v), or ``None`` (infer 1f1b /
+    interleaved from v — the pre-zb behavior). Every geometry error —
+    unknown name, unfillable pipeline, a microbatch count (including
+    every ramped one) that does not divide into the schedule's injection
+    groups — is raised HERE, naming the knob, instead of surfacing as a
+    deep shape error mid-trace. ``overlap_p2p`` is threaded into the
+    returned fwd_bwd function (and doubles the injection group when
+    interleaved: ``2·pp``).
     """
     from apex_tpu.transformer.microbatches import (
         build_num_microbatches_calculator,
     )
 
+    pp = pipeline_model_parallel_size
+    v = virtual_pipeline_model_parallel_size
+    if schedule is not None and schedule not in BUILD_SCHEDULES:
+        raise ValueError(
+            f"schedule={schedule!r} is not a pipeline schedule; legal "
+            f"values of build_schedule(schedule=...) are "
+            f"{' / '.join(map(repr, BUILD_SCHEDULES))} (or None to infer "
+            "1f1b/interleaved from virtual_pipeline_model_parallel_size)")
+    if schedule == "interleaved" and (v is None or v < 2):
+        raise ValueError(
+            "schedule='interleaved' needs "
+            f"virtual_pipeline_model_parallel_size >= 2 (got {v!r}) — "
+            "pass the chunk count, or use schedule='1f1b'")
+    if schedule == "1f1b" and v is not None and v > 1:
+        raise ValueError(
+            f"schedule='1f1b' with virtual_pipeline_model_parallel_size="
+            f"{v} is contradictory — interleaving IS the virtual-chunk "
+            "schedule; pass schedule='interleaved' (or None)")
+    if schedule in ("interleaved", "zb") and pp < 2:
+        raise ValueError(
+            f"schedule={schedule!r} needs pipeline_model_parallel_size "
+            f">= 2 (got {pp}); a single stage has no pipeline to "
+            "schedule")
+
     calc = build_num_microbatches_calculator(
         global_batch_size, micro_batch_size, data_parallel_size,
         rampup_batch_size,
     )
-    if (pipeline_model_parallel_size > 1
-            and calc.get() < pipeline_model_parallel_size):
+    if pp > 1 and calc.get() < pp:
         raise ValueError(
             f"{calc.get()} microbatches cannot fill a "
-            f"{pipeline_model_parallel_size}-stage pipeline; lower "
+            f"{pp}-stage pipeline; lower "
             "micro_batch_size or raise global_batch_size"
         )
-    if (virtual_pipeline_model_parallel_size is not None
-            and pipeline_model_parallel_size > 1):
+    if v is not None and v > 1 and pp > 1:
         # every batch size the ramp will ever produce must divide into
-        # pp-sized microbatch groups — a mid-training ramp step must not
-        # discover the ValueError inside the schedule
+        # the schedule's injection groups — a mid-training ramp step must
+        # not discover the ValueError inside the schedule
+        group = (2 * pp) if overlap_p2p else pp
         per_mb = micro_batch_size * data_parallel_size
         if rampup_batch_size is None:
             batch_sizes = [global_batch_size]
@@ -488,24 +972,30 @@ def build_schedule(
                     f"consistency check would fail mid-training"
                 )
             m = gbs // per_mb
-            if m % pipeline_model_parallel_size:
+            if m % group:
                 raise ValueError(
                     f"the interleaved schedule needs every microbatch count "
-                    f"divisible by the pipeline size "
-                    f"({pipeline_model_parallel_size}); batch size {gbs} "
+                    f"divisible by {'2*' if overlap_p2p else ''}the "
+                    f"pipeline size "
+                    f"({group}); batch size {gbs} "
                     f"yields {m} microbatches"
+                    + (" (overlap_p2p=True doubles the injection group — "
+                       "each hop spans a full tick)" if overlap_p2p else "")
                 )
-    fn = get_forward_backward_func(
-        virtual_pipeline_model_parallel_size, pipeline_model_parallel_size,
-    )
-    if virtual_pipeline_model_parallel_size is not None \
-            and pipeline_model_parallel_size > 1:
-        fn = functools.partial(
-            fn, virtual_chunks=virtual_pipeline_model_parallel_size)
+    fn = get_forward_backward_func(v, pp, schedule=schedule)
+    extra = {}
+    if v is not None and pp > 1:
+        extra["virtual_chunks"] = v
+    if overlap_p2p and pp > 1:
+        extra["overlap_p2p"] = True
+    if extra:
+        fn = functools.partial(fn, **extra)
     if monitor_hooks.enabled():
         monitor_hooks.emit_event(
             "schedule_config",
             schedule=getattr(fn, "func", fn).__name__,
+            schedule_name=schedule or ("interleaved" if v else "1f1b"),
+            overlap_p2p=overlap_p2p,
             num_microbatches=calc.get(),
             micro_batch_size=micro_batch_size,
             global_batch_size=global_batch_size,

@@ -48,6 +48,14 @@ blocking oracle, fwd+bwd tokens/s at tp >= 2 — one ``tp_overlap``
 monitor record (``OK`` only on real multichip TPU; off-TPU the leg runs
 at smoke scale on the virtual 8-device CPU mesh and the record is an
 explicit ``SKIP(reason)``).
+
+``python bench.py --pipeline`` runs the pipeline-schedule leg
+(:func:`pipeline_main`): the zero-bubble split-backward schedule
+(``GPTConfig(pp_schedule="zb")``) vs the autodiff 1f1b baseline through
+``GPTPipeline`` at pp >= 2 — tokens/s for both, bubble % measured by
+``step_anatomy`` on TPU and from the trace-time unit-cost geometry
+everywhere, and a recompile-free witness across schedule-geometry
+reuse — as one ``pipeline`` monitor record (same SKIP semantics).
 """
 
 import json
@@ -847,6 +855,191 @@ def profile_main(argv=None):
     print(json.dumps(record))
 
 
+def pipeline_main():
+    """``python bench.py --pipeline`` — the pipeline-schedule leg: the
+    zero-bubble schedule (``GPTConfig(pp_schedule="zb")``) vs the
+    autodiff 1f1b baseline on the flagship GPT blocks through
+    ``GPTPipeline`` at pp >= 2 — one jitted fwd+bwd per schedule under
+    ``shard_map``, tokens/s from min-of-passes with ``spread_pct`` as the
+    noise bar (the training bench's accounting), plus bubble %:
+    MEASURED by ``prof.trace_reader.step_anatomy`` on a real TPU trace,
+    and from the trace-time unit-cost geometry
+    (``monitor.pipeline_cost_model``) everywhere. Both jitted paths are
+    witnessed recompile-free across schedule-geometry reuse
+    (``jit_cache_ok``: fresh data through the same geometry keeps the
+    jit cache at 1).
+
+    Emits ONE ``pipeline`` record through the monitor schema and prints
+    it as one JSON line. ``status: "OK"`` requires a real multichip TPU;
+    off-TPU the leg still runs end to end at smoke scale on a virtual
+    8-device CPU mesh and the record is an explicit ``SKIP(reason)`` with
+    the smoke numbers and geometry riding along. Never nan in an OK
+    line."""
+    # must precede the first backend query: the CPU platform only grows
+    # virtual devices if the flag is set pre-initialization
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    on_tpu = jax.default_backend() == "tpu"
+    monitor.enable_from_env()
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.parallel import mesh as mesh_lib
+    from apex_tpu.transformer.pipeline_parallel import GPTPipeline
+
+    n = jax.device_count()
+    pp = 4 if (n % 4 == 0 and n >= 4) else (2 if n % 2 == 0 else 0)
+
+    def emit(status, **fields):
+        if monitor.enabled():
+            record = monitor.get_registry().emit_pipeline(status, **fields)
+        else:  # sink-less registry: same construction+honesty path
+            record = monitor.MetricsRegistry().emit_pipeline(
+                status, **fields)
+        errors = monitor.validate(record)
+        if errors:
+            raise ValueError(
+                f"pipeline bench record failed validation: {errors}")
+        print(json.dumps(record))
+
+    if pp < 2:
+        emit("SKIP", reason=(f"a pipeline needs >= 2 stages; this "
+                             f"{jax.default_backend()} host exposes {n} "
+                             "device(s)"),
+             backend=jax.default_backend())
+        return
+
+    if on_tpu:
+        kw = dict(vocab_size=32768, max_seq_len=1024, hidden_size=1024,
+                  num_layers=12, num_heads=8, attention_impl="flash",
+                  remat=True, scan_layers=False)
+        M, b, s, iters, passes = 2 * pp, 4, 1024, 10, 3
+        cast = jnp.bfloat16
+    else:  # smoke scale on the virtual mesh; the record is SKIP anyway
+        kw = dict(vocab_size=128, max_seq_len=64, hidden_size=64,
+                  num_layers=pp * 2, num_heads=4, attention_impl="flash")
+        M, b, s, iters, passes = 2 * pp, 2, 32, 2, 2
+        cast = None
+
+    model = GPTModel(GPTConfig(**kw))
+    params = model.init(jr.PRNGKey(0))
+    if cast is not None:
+        params = jax.tree.map(
+            lambda x: x.astype(cast)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+    pipe = GPTPipeline(model, pp=pp)
+    part = pipe.partition(params)
+    specs = pipe.param_specs(part)
+    mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=pp,
+                              devices=jax.devices()[:pp])
+    toks = jr.randint(jr.PRNGKey(1), (M, b, s), 0, kw["vocab_size"])
+    tgts = jr.randint(jr.PRNGKey(2), (M, b, s), 0, kw["vocab_size"])
+
+    def build_step(schedule):
+        def run(p, t, g):
+            lp = dict(p, stages=jax.tree.map(lambda x: x[0], p["stages"]))
+            loss, grads = pipe.loss_and_grads(lp, t, g, schedule=schedule)
+            grads["stages"] = jax.tree.map(lambda x: x[None],
+                                           grads["stages"])
+            return loss, grads
+
+        return jax.jit(mesh_lib.shard_map(
+            run, mesh=mesh, in_specs=(specs, P(), P()),
+            out_specs=(P(), specs)))
+
+    def measure(schedule):
+        step = build_step(schedule)
+        loss, _ = step(part, toks, tgts)  # compile+warm
+        float(loss)
+        # geometry-reuse witness: fresh data, same schedule geometry —
+        # the jit cache must stay at 1 (no retrace per step)
+        loss, _ = step(part, toks + 1, tgts)
+        float(loss)
+        cache_ok = step._cache_size() == 1
+        times = []
+        for _ in range(passes):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss, _ = step(part, toks, tgts)
+            float(loss)  # host fetch syncs the dependent chain
+            times.append((time.perf_counter() - t0) / iters)
+        return M * b * s / min(times), times, cache_ok, step
+
+    def measured_bubble(step):
+        """Mean step_anatomy bubble % from a real device trace (TPU); a
+        ('skipped', reason) marker anywhere that is unavailable.
+        step_anatomy pairs device windows with HOST step spans, so each
+        traced execution is stamped with one (blocking inside the span —
+        the wall time is honest, same contract as profile_main's)."""
+        if not on_tpu:
+            return ("skipped", "step_anatomy needs a TPU device trace; "
+                               "off-TPU the chrome trace is host-only")
+        import tempfile
+
+        from apex_tpu.prof import trace_reader
+        try:
+            spans = []
+            with tempfile.TemporaryDirectory() as logdir:
+                jax.profiler.start_trace(logdir)
+                for i in range(3):
+                    t0 = time.monotonic_ns()
+                    loss, _ = step(part, toks, tgts)
+                    float(loss)  # block INSIDE the span window
+                    spans.append({"kind": "span", "name": "step",
+                                  "step": i, "t0_ns": t0,
+                                  "dur_ns": time.monotonic_ns() - t0})
+                jax.profiler.stop_trace()
+                events = trace_reader.read_trace(logdir)
+                rows = trace_reader.step_anatomy(spans, events)
+            vals = [r["bubble_pct"] for r in rows
+                    if isinstance(r.get("bubble_pct"), (int, float))]
+            if not vals:
+                return ("skipped", "trace carried no per-step device rows")
+            return round(sum(vals) / len(vals), 2)
+        except Exception as e:  # noqa: BLE001 — a broken trace must not
+            return ("skipped", f"trace capture failed: {e}")  # kill the leg
+
+    tps_zb, pass_times, cache_zb, step_zb = measure("zb")
+    tps_1f1b, pass_times_b, cache_1f1b, step_1f1b = measure("1f1b")
+    spread = (max(pass_times) - min(pass_times)) / min(pass_times)
+    spread_b = (max(pass_times_b) - min(pass_times_b)) / min(pass_times_b)
+    geo_zb = monitor.pipeline_cost_model(M, pp, 1, schedule="zb")
+    geo_1f1b = monitor.pipeline_cost_model(M, pp, 1, schedule="1f1b")
+    # the schedule's own traffic accounting: fwd ticks x one microbatch
+    # activation (both directions add the dX sweep's mirror of it)
+    act_bytes = b * s * kw["hidden_size"] * (2 if cast else 4)
+    fields = dict(
+        schedule="zb", pipeline_size=pp, virtual_chunks=1,
+        num_microbatches=M, overlap_p2p=False,
+        tokens_per_s=round(tps_zb, 1),
+        tokens_per_s_1f1b=round(tps_1f1b, 1),
+        vs_1f1b=round(tps_zb / tps_1f1b, 4),
+        bubble_pct=measured_bubble(step_zb),
+        bubble_pct_1f1b=measured_bubble(step_1f1b),
+        bubble_pct_geometry=round(100 * geo_zb["bubble_fraction"], 2),
+        bubble_pct_1f1b_geometry=round(
+            100 * geo_1f1b["bubble_fraction"], 2),
+        p2p_bytes_per_step=act_bytes * geo_zb["fwd_ticks"] * 2,
+        jit_cache_ok=bool(cache_zb and cache_1f1b),
+        spread_pct=round(spread * 100, 2),
+        spread_pct_1f1b=round(spread_b * 100, 2),
+        pass_times_ms=[round(t * 1e3, 2) for t in pass_times],
+        pass_times_1f1b_ms=[round(t * 1e3, 2) for t in pass_times_b],
+        config=kw, backend=jax.default_backend(),
+    )
+    if on_tpu:
+        status = "OK"
+    else:
+        fields["reason"] = (
+            "pipeline-schedule speedup is an ICI/bubble measurement; "
+            f"this is a {jax.default_backend()} smoke run on a virtual "
+            f"{n}-device mesh (pp={pp})")
+        status = "SKIP"
+    emit(status, **fields)
+
+
 def main():
     on_tpu = jax.default_backend() == "tpu"
     monitor.enable_from_env()  # APEX_TPU_MONITOR=<path> streams JSONL
@@ -971,5 +1164,7 @@ if __name__ == "__main__":
         longseq_bias_main()
     elif "--tp-overlap" in sys.argv[1:]:
         tp_overlap_main()
+    elif "--pipeline" in sys.argv[1:]:
+        pipeline_main()
     else:
         main()

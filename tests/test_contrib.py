@@ -1243,6 +1243,130 @@ class TestZeroLossScaling:
                                     jax.tree.leaves(p4)))
         assert moved
 
+    def test_overflow_composes_with_zb_pipeline_across_dp_tp_pp(self):
+        """fp16 × ZeRO × PIPELINE (ROADMAP open item 3's 'while in the
+        neighborhood' / ISSUE 8 satellite): loss-scaled fp16 grads come
+        from the REAL pipelined zero-bubble backward on a dp2×tp2×pp2
+        mesh — a real tp stage (column/row-sharded matmuls, boundary
+        psum) per pp rank. ONE (dp, tp, pp) rank overflows; found-inf is
+        reduced over ALL THREE axes, so every rank skips together:
+        dp-sharded fp32 masters and m/v bit-identical, params untouched,
+        scale 1024→512→512→1024 recovery."""
+        import jax.numpy as jnp
+
+        from apex_tpu.amp.scaler import init_loss_scaler, unscale_grads
+        from apex_tpu.contrib.optimizers import distributed_fused_adam
+        from apex_tpu.transformer.amp import update_scaler_model_parallel
+        from apex_tpu.transformer.pipeline_parallel import schedules
+
+        HID, FFN, tp, S, dp = 16, 32, 2, 2, 2
+        mesh = mesh_lib.make_mesh(tensor_model_parallel_size=tp,
+                                  pipeline_model_parallel_size=S)  # dp=2
+        init_scale = 1024.0
+
+        def stage_fn(p, x):
+            # column-sharded w1, row-sharded w2, tp boundary psum
+            h = jnp.tanh(x @ p["w1"])
+            return x + jax.lax.psum(h @ p["w2"], "tp")
+
+        def col(key, shape, axis):
+            full = (jr.normal(key, shape) * 0.1).astype(jnp.float16)
+            return jnp.stack(jnp.split(full, tp, axis))  # (tp, ...)
+
+        params = {
+            "w1": jnp.stack([col(jr.fold_in(K, 90 + r), (HID, FFN), 1)
+                             for r in range(S)], 1),  # (tp, S, HID, FFN/tp)
+            "w2": jnp.stack([col(jr.fold_in(K, 95 + r), (FFN, HID), 0)
+                             for r in range(S)], 1),  # (tp, S, FFN/tp, HID)
+        }
+        M, b = 4, 2
+        mbs = jr.normal(jr.fold_in(K, 98),
+                        (M, b * dp, HID)).astype(jnp.float16)
+        tgts = jr.normal(jr.fold_in(K, 99),
+                         (M, b * dp, HID)).astype(jnp.float16)
+        zopt = distributed_fused_adam(learning_rate=1e-2)
+
+        def run(params, mbs, tgts):
+            local = jax.tree.map(lambda x: x[0, 0], params)
+            zstate = zopt.init(local)
+            sstate = init_loss_scaler(init_scale=init_scale,
+                                      growth_interval=2)
+            rdp = jax.lax.axis_index("dp")
+            rtp = jax.lax.axis_index("tp")
+            rpp = jax.lax.axis_index("pp")
+
+            def step(p, zstate, sstate, inject):
+                scale = sstate.loss_scale.astype(jnp.float32)
+
+                def loss_head(out, tgt):
+                    return jnp.mean((out.astype(jnp.float32)
+                                     - tgt.astype(jnp.float32)) ** 2) * scale
+
+                # fp16 params, accum_dtype=None: the zb backward emits
+                # loss-scaled fp16 grads — the reference's fp16 O2 shape
+                _, g16 = schedules.forward_backward_pipelining_zero_bubble(
+                    stage_fn, loss_head, p, mbs, tgts, accum_dtype=None)
+                g16 = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), g16)
+                if inject:
+                    # this one (dp, tp, pp) rank's microbatch overflowed
+                    g16 = dict(g16, w1=jnp.where(
+                        (rdp == 1) & (rtp == 1) & (rpp == 0),
+                        jnp.full_like(g16["w1"], jnp.inf), g16["w1"]))
+                ug = unscale_grads(sstate, g16)
+                # found-inf agreed over ALL data/model axes: a single
+                # rank's overflow makes EVERY rank skip (the reference
+                # GradScaler's model-parallel all-reduce, here dp×tp×pp)
+                sstate, finite = update_scaler_model_parallel(
+                    sstate, ug, axes=("dp", "tp", "pp"))
+                safe = jax.tree.map(
+                    lambda x: jnp.where(jnp.isfinite(x), x, 0.0), ug)
+                updates, new_z = zopt.update(safe, zstate, p)
+                new_p = optax.apply_updates(p, updates)
+                p = jax.tree.map(lambda a, b: jnp.where(finite, a, b),
+                                 new_p, p)
+                zstate = jax.tree.map(lambda a, b: jnp.where(finite, a, b),
+                                      new_z, zstate)
+                return p, zstate, sstate
+
+            p1, z1, s1 = step(local, zstate, sstate, inject=False)
+            p2, z2, s2 = step(p1, z1, s1, inject=True)
+            p3, z3, s3 = step(p2, z2, s2, inject=False)
+            p4, z4, s4 = step(p3, z3, s3, inject=False)
+            scales = jnp.stack([s1.loss_scale, s2.loss_scale,
+                                s3.loss_scale, s4.loss_scale])
+            stats = {"scales": scales, "skipped": s4.skipped_steps}
+            expand = lambda t: jax.tree.map(lambda x: x[None, None], t)
+            return (expand(p1), expand(p2), expand(p4),
+                    z1.buffers, z2.buffers, stats)
+
+        pspec = jax.tree.map(lambda _: P("tp", "pp"), params)
+        buf_spec = {k: P(("dp", "tp", "pp")) for k in ("m", "v", "master")}
+        p1, p2, p4, buf1, buf2, stats = jax.jit(mesh_lib.shard_map(
+            run, mesh=mesh,
+            in_specs=(pspec, P(None, "dp"), P(None, "dp")),
+            out_specs=(pspec, pspec, pspec, buf_spec, buf_spec, P()),
+        ))(params, mbs, tgts)
+
+        # the skipped step left the sharded fp32 masters and moments
+        # BIT-identical on every (dp, tp, pp) rank
+        assert set(buf1) == {"m", "v", "master"}
+        for name in ("m", "v", "master"):
+            a, b_ = np.asarray(buf1[name]), np.asarray(buf2[name])
+            assert a.dtype == np.float32
+            np.testing.assert_array_equal(
+                a, b_, err_msg=f"skipped step mutated sharded {name}")
+        for k in params:  # params bitwise untouched by the skipped step
+            np.testing.assert_array_equal(np.asarray(p1[k]),
+                                          np.asarray(p2[k]))
+        np.testing.assert_allclose(np.asarray(stats["scales"]),
+                                   [1024.0, 512.0, 512.0, 1024.0])
+        assert int(stats["skipped"]) == 1
+        moved = any(bool(jnp.any(a != b_))
+                    for a, b_ in zip(jax.tree.leaves(p2),
+                                     jax.tree.leaves(p4)))
+        assert moved  # the finite steps after the skip really trained
+        mesh_lib.destroy_model_parallel()
+
     def test_fp16_grads_keep_fp32_reduction(self):
         """fp16 grads must NOT ride the bf16 reduce-scatter shortcut:
         the mega-buffer flattens them to fp32 (fp16's exponent range

@@ -225,6 +225,20 @@ def f(x, w):
     return all_gather_matmul(x, w, axis_name="tp", seq_dim=0)
 """,
     ),
+    "APX404": (
+        """
+from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
+def tick(stage_fn, params, y_prev):
+    x = p2p.send_forward(y_prev)
+    return stage_fn(params, x)
+""",
+        """
+from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
+def tick(stage_fn, params, x, y_prev):
+    sent, y = p2p.rotate_overlapped(y_prev, lambda: stage_fn(params, x))
+    return sent, y
+""",
+    ),
     "APX401": (
         """
 import jax
@@ -1047,6 +1061,79 @@ def f(q, k, v, t, s):
         findings, suppressed = lint.lint_source(src, path="apex_tpu/x.py")
         assert "APX304" not in {f.code for f in findings}
         assert suppressed == 1
+
+
+class TestAPX404BlockingP2PFeedsStage:
+    """Beyond the fixture pair: the raw lax.ppermute spelling, taint
+    through a name hop, and the idioms that must stay clean — the
+    collective-matmul rings' per-chunk GEMM on an arrived piece (the
+    overlapped pattern itself) and `rotate_overlapped` (the cure)."""
+
+    def test_raw_ppermute_into_matmul(self):
+        src = """
+import jax
+import jax.numpy as jnp
+def f(x, w, perm):
+    got = jax.lax.ppermute(x, "pp", perm)
+    return jnp.dot(got, w)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX404" in {f.code for f in findings}
+
+    def test_helper_through_name_hop_into_stage(self):
+        src = """
+from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
+def f(run_block, params, g):
+    got = p2p.recv_backward(g)
+    gg = got * 2.0
+    return run_block(params, gg)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX404" in {f.code for f in findings}
+
+    def test_fused_helper_fires(self):
+        # the canonical 1F1B spelling: both directions in one fused hop
+        src = """
+from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
+def tick(stage_fn, params, dy, y):
+    g, x = p2p.send_backward_recv_forward(dy, y)
+    return stage_fn(params, x)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX404" in {f.code for f in findings}
+
+    def test_ring_chunk_gemm_stays_clean(self):
+        # the collective-matmul rings' shape: chunk GEMMs on arrived
+        # pieces ARE the overlap — "chunk" is deliberately not a stage
+        # fragment
+        src = """
+import jax
+def ring(chunk_fn, x, perm):
+    fwd = jax.lax.ppermute(x, "tp", perm)
+    return chunk_fn(fwd)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX404" not in {f.code for f in findings}
+
+    def test_rotate_overlapped_stays_clean(self):
+        src = """
+from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
+def tick(stage_fn, params, x, y_prev):
+    sent, y = p2p.rotate_overlapped(y_prev, lambda: stage_fn(params, x))
+    return sent, y
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX404" not in {f.code for f in findings}
+
+    def test_inline_disable(self):
+        src = """
+import jax
+def f(stage_fn, p, x, perm):
+    got = jax.lax.ppermute(x, "pp", perm)
+    return stage_fn(p, got)  # apexlint: disable=APX404
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX404" not in {f.code for f in findings}
 
 
 class TestAPX403BlockingCollectiveMatmul:

@@ -234,6 +234,34 @@ class TestHooks:
         assert reg.counters["collective/ppermute[pp]_bytes"] == 19 * 1024
         (rec,) = records_of(buf)
         assert rec["name"] == "pipeline_schedule" and rec["ticks"] == 19
+        assert rec["schedule"] == "1f1b" and rec["overlap_p2p"] is False
+
+    def test_pipeline_cost_model_closed_forms(self, registry):
+        """The unit-cost (F=B=W=1) full-step geometry: the autodiff
+        schedule pays B+W on every backward tick; zb defers dW into an
+        M·v real-items-only sweep — the (S−1)·W drain term is gone."""
+        base = monitor.pipeline_cost_model(8, 4, 1, schedule="1f1b")
+        zb = monitor.pipeline_cost_model(8, 4, 1, schedule="zb")
+        assert base["total_units"] == 33 and zb["total_units"] == 30
+        assert base["bubble_fraction"] == pytest.approx(9 / 33)
+        assert zb["bubble_fraction"] == pytest.approx(6 / 30)
+        # overlap: L=2 — fwd ticks M*v + 2(S-1) + 1, dW sweep unchanged
+        ov = monitor.pipeline_cost_model(8, 4, 1, schedule="zb",
+                                         overlap_p2p=True)
+        assert ov["fwd_ticks"] == 8 + 2 * 3 + 1
+        assert ov["bwd_dw_ticks"] == 8
+        # recompute priced separately and honestly: zb = 1f1b + M*v
+        assert zb["recompute_units"] == base["recompute_units"] + 8
+        assert zb["collective_free_ticks"] == 8
+        # the schedule-aware gauge/event carry the step bubble
+        reg, buf = registry
+        monitor.record_pipeline_schedule(
+            num_microbatches=8, pipeline_size=4, schedule="zb")
+        assert reg.gauges["pipeline/bubble_fraction_step"] == \
+            pytest.approx(6 / 30)
+        (rec,) = records_of(buf)
+        assert rec["schedule"] == "zb"
+        assert rec["bwd_dw_ticks"] == 8 and rec["bwd_dx_ticks"] == 11
 
     def test_count_collective_and_tree_bytes(self, registry):
         reg, _ = registry
@@ -469,6 +497,7 @@ class TestGateReporting:
             moe_16wide_loss=4.31,
             ring_vs_flash=3e-7,
             ring_bias_vs_flash=graft._SKIP("16-wide respawn timed out"),
+            zb_vs_1f1b=0.0,
         )
         out = capsys.readouterr().out
         gate_line = [l for l in out.splitlines() if l.endswith(" OK")][0]
@@ -476,6 +505,7 @@ class TestGateReporting:
         assert "tpcp_4axis_loss=SKIP(needs-n_devices-%-16-==-0)" in gate_line
         assert "ring_bias_vs_flash=SKIP(16-wide-respawn-timed-out)" in \
             gate_line
+        assert "zb_vs_1f1b=0.00e+00" in gate_line  # the ISSUE-8 witness
         json_line = [l for l in out.splitlines()
                      if l.startswith("MULTICHIP_GATE ")][0]
         record = json.loads(json_line[len("MULTICHIP_GATE "):])
@@ -973,6 +1003,59 @@ class TestValidateProfileArtifacts:
                                      "unit": "u"}))
         assert tool.main(["--costdb", str(other)]) == 1
 
+    def test_pipeline_record_emits_validates_and_reports(self, tmp_path,
+                                                         capsys):
+        """Schema-drift gate for the ``pipeline`` bench record: freshly
+        emitted OK and SKIP forms pass the validator CLI (content AND
+        ``--pipeline`` forced dispatch), a hand-forged nan fails, a
+        reason-free SKIP fails, and ``monitor report`` renders the
+        pipeline-bench line from the same stream."""
+        tool = _load_validate_tool()
+        path = tmp_path / "events.jsonl"
+        monitor.enable(str(path))
+        try:
+            monitor.emit_pipeline(
+                "OK", schedule="zb", pipeline_size=4, virtual_chunks=1,
+                num_microbatches=8, overlap_p2p=False,
+                tokens_per_s=90000.0, tokens_per_s_1f1b=82000.0,
+                vs_1f1b=1.0976, bubble_pct=14.2, bubble_pct_1f1b=24.8,
+                bubble_pct_geometry=20.0, bubble_pct_1f1b_geometry=27.27,
+                p2p_bytes_per_step=1 << 20, jit_cache_ok=True)
+            monitor.emit_pipeline(
+                "SKIP", reason="no TPU attached", schedule="zb",
+                bubble_pct=("skipped", "no device trace"),
+                bubble_pct_geometry=20.0)
+        finally:
+            monitor.disable()
+        assert tool.main([str(path)]) == 0
+        assert tool.main(["--pipeline", str(path)]) == 0
+
+        bad = json.loads(path.read_text().splitlines()[0])
+        bad["tokens_per_s"] = "nan"
+        bad_path = tmp_path / "bad.jsonl"
+        bad_path.write_text(json.dumps(bad) + "\n")
+        assert tool.main([str(bad_path)]) == 1
+        noreason = json.loads(path.read_text().splitlines()[1])
+        del noreason["reason"]
+        nr_path = tmp_path / "nr.jsonl"
+        nr_path.write_text(json.dumps(noreason) + "\n")
+        assert tool.main([str(nr_path)]) == 1
+        # a stream without any pipeline record fails the forced dispatch
+        bare = tmp_path / "bare.jsonl"
+        monitor.enable(str(bare))
+        try:
+            monitor.emit_event("x")
+        finally:
+            monitor.disable()
+        assert tool.main(["--pipeline", str(bare)]) == 1
+
+        assert monitor_report.main(["report", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "pipeline-bench" in out and "SKIP(no TPU attached)" in out
+        summary = monitor_report.aggregate(
+            monitor_report.read_records(open(path)))
+        assert summary["pipeline_bench"]["status"] == "SKIP"
+
     def test_profile_flag_requires_profile_record(self, tmp_path):
         tool = _load_validate_tool()
         path = tmp_path / "events.jsonl"
@@ -989,6 +1072,39 @@ class TestValidateProfileArtifacts:
         finally:
             monitor.disable()
         assert tool.main(["--profile", str(bare)]) == 1
+
+
+class TestPipelineBenchLeg:
+    def test_bench_pipeline_emits_valid_skip_record_off_tpu(
+            self, tmp_path, monkeypatch, capsys):
+        """The pipeline-schedule leg end-to-end at smoke scale,
+        in-process: off-TPU the record must be an explicit SKIP —
+        schema-valid, no nan — carrying both schedules' smoke tokens/s,
+        the geometry bubbles with zb < 1f1b, skip-objects for the
+        measured bubbles, and the recompile-free witness."""
+        import importlib.util
+
+        monkeypatch.delenv("APEX_TPU_MONITOR", raising=False)
+        root = os.path.join(os.path.dirname(__file__), "..")
+        spec = importlib.util.spec_from_file_location(
+            "bench_pipeline_leg", os.path.join(root, "bench.py"))
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        try:
+            bench.pipeline_main()
+        finally:
+            monitor.disable()
+        record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert record["kind"] == "pipeline"
+        assert record["status"] == "SKIP" and record["reason"]
+        assert record["schedule"] == "zb"
+        assert record["tokens_per_s"] > 0
+        assert record["tokens_per_s_1f1b"] > 0
+        assert record["bubble_pct"]["skipped"] is True
+        assert (record["bubble_pct_geometry"]
+                < record["bubble_pct_1f1b_geometry"])
+        assert record["jit_cache_ok"] is True
+        assert monitor.validate(record) == []
 
 
 class TestProfileBenchLeg:

@@ -292,6 +292,28 @@ class TestP2P:
         )(x)
         np.testing.assert_allclose(y, x)
 
+    def test_rotate_overlapped_matches_blocking(self):
+        """The overlapped helper returns exactly (blocking rotation,
+        compute result) — the overlap is a scheduling property, never a
+        value change."""
+        from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
+
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=4)
+        x = jnp.arange(4.0)
+
+        def run(x):
+            sent, y = p2p.rotate_overlapped(x, lambda: x * 3.0)
+            return sent, y
+
+        sent, y = mesh_lib.shard_map(
+            run, mesh=mesh, in_specs=P("pp"), out_specs=(P("pp"), P("pp"))
+        )(x)
+        ref = mesh_lib.shard_map(
+            p2p.send_forward, mesh=mesh, in_specs=P("pp"),
+            out_specs=P("pp"))(x)
+        np.testing.assert_allclose(sent, ref)
+        np.testing.assert_allclose(y, x * 3.0)
+
 
 def gpt_block_stage(params, x):
     """A real transformer block as a pipeline stage (LN -> attention ->
@@ -554,6 +576,69 @@ class TestBuildSchedule:
                 data_parallel_size=1, pipeline_model_parallel_size=4,
                 virtual_pipeline_model_parallel_size=2)
 
+    def test_unknown_schedule_name_names_knob_and_legal_values(self):
+        """ISSUE 8 satellite: a typo'd schedule= fails eagerly naming the
+        knob and every legal value — not as a deep error mid-trace."""
+        with pytest.raises(ValueError) as e:
+            schedules.build_schedule(
+                global_batch_size=32, micro_batch_size=2,
+                data_parallel_size=1, pipeline_model_parallel_size=4,
+                schedule="zero-bubble")
+        msg = str(e.value)
+        assert "schedule=" in msg
+        for name in ("1f1b", "interleaved", "zb"):
+            assert name in msg, msg
+
+    def test_schedule_zb_selected(self):
+        fn, calc = schedules.build_schedule(
+            global_batch_size=32, micro_batch_size=2, data_parallel_size=1,
+            pipeline_model_parallel_size=4, schedule="zb")
+        assert fn is schedules.forward_backward_pipelining_zero_bubble
+        assert calc.get() == 16
+
+    def test_schedule_zb_interleaved_overlap_partial(self):
+        import functools
+
+        fn, _ = schedules.build_schedule(
+            global_batch_size=32, micro_batch_size=2, data_parallel_size=1,
+            pipeline_model_parallel_size=4,
+            virtual_pipeline_model_parallel_size=3, schedule="zb",
+            overlap_p2p=True)
+        assert isinstance(fn, functools.partial)
+        assert fn.func is schedules.forward_backward_pipelining_zero_bubble
+        assert fn.keywords == {"virtual_chunks": 3, "overlap_p2p": True}
+
+    def test_interleaved_demands_virtual_chunks(self):
+        with pytest.raises(ValueError, match="virtual_pipeline"):
+            schedules.build_schedule(
+                global_batch_size=32, micro_batch_size=2,
+                data_parallel_size=1, pipeline_model_parallel_size=4,
+                schedule="interleaved")
+
+    def test_1f1b_rejects_contradictory_virtual_chunks(self):
+        with pytest.raises(ValueError, match="interleav"):
+            schedules.build_schedule(
+                global_batch_size=32, micro_batch_size=2,
+                data_parallel_size=1, pipeline_model_parallel_size=4,
+                virtual_pipeline_model_parallel_size=2, schedule="1f1b")
+
+    def test_zb_rejects_single_stage(self):
+        with pytest.raises(ValueError, match="pipeline_model_parallel"):
+            schedules.build_schedule(
+                global_batch_size=32, micro_batch_size=2,
+                data_parallel_size=1, pipeline_model_parallel_size=1,
+                schedule="zb")
+
+    def test_overlap_doubles_interleaved_group(self):
+        """M=12 divides pp=4 but not 2·pp=8: fine blocking, rejected —
+        eagerly, naming overlap_p2p — with the overlapped hop."""
+        kw = dict(global_batch_size=24, micro_batch_size=2,
+                  data_parallel_size=1, pipeline_model_parallel_size=4,
+                  virtual_pipeline_model_parallel_size=2)
+        schedules.build_schedule(**kw)  # 12 microbatches, M % 4 == 0
+        with pytest.raises(ValueError, match="overlap_p2p"):
+            schedules.build_schedule(**kw, overlap_p2p=True)
+
     def test_end_to_end_with_calculator(self):
         mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=4)
         fn, calc = schedules.build_schedule(
@@ -580,7 +665,338 @@ class TestBuildSchedule:
         assert np.isfinite(float(loss))
 
 
-class TestBubbleUtilization:
+def _chunked_stack(plist, S, v):
+    """Device layout for v virtual chunks: (v, S, ...) with virtual stage
+    k = c·S + r at [c, r] — the interleaved assignment."""
+    chunks = [[plist[c * S + r] for r in range(S)] for c in range(v)]
+    return jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[jax.tree.map(lambda *ys: jnp.stack(ys), *row) for row in chunks])
+
+
+class TestZeroBubble:
+    """The ``schedule="zb"`` matrix (ISSUE 8): grad parity vs the serial
+    oracle over pp ∈ {2, 4} × v ∈ {1, 3} × ±overlap_p2p, fp32 main-grad
+    accumulation, the deferred-dW geometry read off the jaxpr, and
+    recompile-freedom across schedule-geometry reuse. The heaviest cells
+    ride ``_SLOW_OFF_TPU`` (tier-1 siblings named there)."""
+
+    def _run_case(self, S, v, overlap, M):
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=S)
+        plist = make_stage_params(jr.fold_in(K, 60 + S), S * v)
+        mbs = jr.normal(jr.fold_in(K, 61), (M, 2, HID))
+        tgts = jr.normal(jr.fold_in(K, 62), (M, 2, HID))
+        if v == 1:
+            stacked = stack_params(plist)
+            strip, restore = (lambda x: x[0]), (lambda x: x[None])
+            spec = jax.tree.map(lambda _: P("pp"), stacked)
+        else:
+            stacked = _chunked_stack(plist, S, v)
+            strip, restore = (lambda x: x[:, 0]), (lambda x: x[:, None])
+            spec = jax.tree.map(lambda _: P(None, "pp"), stacked)
+
+        def loss_head(out, tgt):
+            return jnp.mean((out - tgt) ** 2)
+
+        def run(p, m, t):
+            loss, g = schedules.forward_backward_pipelining_zero_bubble(
+                stage_fn, loss_head, jax.tree.map(strip, p), m, t,
+                virtual_chunks=v, overlap_p2p=overlap)
+            return loss, jax.tree.map(restore, g)
+
+        loss, grads = mesh_lib.shard_map(
+            run, mesh=mesh, in_specs=(spec, P(), P()),
+            out_specs=(P(), spec))(stacked, mbs, tgts)
+
+        def serial_loss(sp):
+            if v == 1:
+                pl = [jax.tree.map(lambda x: x[i], sp) for i in range(S)]
+            else:
+                pl = [jax.tree.map(lambda x: x[k // S, k % S], sp)
+                      for k in range(v * S)]
+            outs = jax.vmap(lambda m: serial_forward(pl, m))(mbs)
+            return jnp.mean(jax.vmap(loss_head)(outs, tgts))
+
+        ref_loss, ref_grads = jax.value_and_grad(serial_loss)(stacked)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-5, atol=1e-6)
+        for a, e in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads)):
+            np.testing.assert_allclose(a, e, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("overlap", [False, True])
+    def test_pp2_v1(self, overlap):
+        self._run_case(2, 1, overlap, M=5)  # odd M: no grouping at v=1
+
+    @pytest.mark.parametrize("overlap", [False, True])
+    def test_pp2_v3(self, overlap):
+        # overlap doubles the injection group: M % 2S == 0
+        self._run_case(2, 3, overlap, M=4)
+
+    @pytest.mark.parametrize("overlap", [False, True])
+    def test_pp4_v1(self, overlap):
+        self._run_case(4, 1, overlap, M=6)
+
+    @pytest.mark.parametrize("overlap", [False, True])
+    def test_pp4_v3(self, overlap):
+        self._run_case(4, 3, overlap, M=8)
+
+    def test_zb_v3_uneven_layer_count(self):
+        """5 real layers on pp=2 × v=3 via the identity pad (the
+        TestInterleavedV3Uneven recipe) through the zb backward: parity,
+        pad grads exactly zero."""
+        S, v, M = 2, 3, 2
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=S)
+        plist = make_stage_params(jr.fold_in(K, 65), 5)
+        plist.append(jax.tree.map(jnp.zeros_like, plist[0]))  # identity
+        stacked = _chunked_stack(plist, S, v)
+        mbs = jr.normal(jr.fold_in(K, 66), (M, 2, HID))
+        tgts = jr.normal(jr.fold_in(K, 67), (M, 2, HID))
+
+        def loss_head(out, tgt):
+            return jnp.mean((out - tgt) ** 2)
+
+        def run(p, m, t):
+            loss, g = schedules.forward_backward_pipelining_zero_bubble(
+                stage_fn, loss_head, jax.tree.map(lambda x: x[:, 0], p),
+                m, t, virtual_chunks=v)
+            return loss, jax.tree.map(lambda x: x[:, None], g)
+
+        spec = jax.tree.map(lambda _: P(None, "pp"), stacked)
+        loss, grads = mesh_lib.shard_map(
+            run, mesh=mesh, in_specs=(spec, P(), P()),
+            out_specs=(P(), spec))(stacked, mbs, tgts)
+
+        def serial_loss(sp):
+            pl = [jax.tree.map(lambda x: x[k // S, k % S], sp)
+                  for k in range(v * S)]
+            outs = jax.vmap(lambda m: serial_forward(pl, m))(mbs)
+            return jnp.mean(jax.vmap(loss_head)(outs, tgts))
+
+        ref_loss, ref_grads = jax.value_and_grad(serial_loss)(stacked)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-5, atol=1e-6)
+        for a, e in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads)):
+            np.testing.assert_allclose(a, e, rtol=1e-4, atol=1e-5)
+        pad = jax.tree.map(lambda x: x[v - 1, S - 1], grads)
+        assert all(float(jnp.abs(g).max()) < 1e-6
+                   for g in jax.tree.leaves(pad))
+
+    def test_zb_bf16_params_accumulate_fp32_main_grad(self):
+        """The zb dW sweep accumulates in the upcast (fp32) params'
+        dtype in the same reverse order as the autodiff transpose — bf16
+        stage params yield fp32 grads matching the serial oracle."""
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=4)
+        plist = make_stage_params(jr.fold_in(K, 68), 4)
+        stacked = jax.tree.map(lambda x: x.astype(jnp.bfloat16),
+                               stack_params(plist))
+        mbs = jr.normal(jr.fold_in(K, 69), (4, 2, HID)).astype(jnp.bfloat16)
+        tgts = jr.normal(jr.fold_in(K, 70), (4, 2, HID)).astype(jnp.bfloat16)
+
+        def loss_head(out, tgt):
+            return jnp.mean((out.astype(jnp.float32)
+                             - tgt.astype(jnp.float32)) ** 2)
+
+        def run(p, m, t):
+            loss, g = schedules.forward_backward_pipelining_zero_bubble(
+                stage_fn, loss_head, jax.tree.map(lambda x: x[0], p), m, t)
+            return loss, jax.tree.map(lambda x: x[None], g)
+
+        spec = jax.tree.map(lambda _: P("pp"), stacked)
+        loss, grads = mesh_lib.shard_map(
+            run, mesh=mesh, in_specs=(spec, P(), P()),
+            out_specs=(P(), spec))(stacked, mbs, tgts)
+        assert all(g.dtype == jnp.float32 for g in jax.tree.leaves(grads))
+
+        def serial_loss(sp):
+            pl = [jax.tree.map(lambda x: x[i], sp) for i in range(4)]
+            outs = jax.vmap(lambda m: serial_forward(pl, m))(mbs)
+            return jnp.mean(jax.vmap(loss_head)(outs, tgts))
+
+        _, ref_grads = jax.value_and_grad(serial_loss)(
+            jax.tree.map(lambda x: x.astype(jnp.float32), stacked))
+        for a, e in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads)):
+            np.testing.assert_allclose(a, e, rtol=0.06, atol=6e-3)
+
+    def _grad_fn(self, schedule, S=4, M=6):
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=S)
+        plist = make_stage_params(jr.fold_in(K, 71), S)
+        stacked = stack_params(plist)
+        spec = jax.tree.map(lambda _: P("pp"), stacked)
+
+        def loss_head(out, tgt):
+            return jnp.mean((out - tgt) ** 2)
+
+        fwd_bwd = (schedules.forward_backward_pipelining_zero_bubble
+                   if schedule == "zb" else
+                   schedules.forward_backward_pipelining_without_interleaving)
+
+        def run(p, m, t):
+            loss, g = fwd_bwd(
+                stage_fn, loss_head, jax.tree.map(lambda x: x[0], p), m, t)
+            return loss, jax.tree.map(lambda x: x[None], g)
+
+        f = mesh_lib.shard_map(run, mesh=mesh, in_specs=(spec, P(), P()),
+                               out_specs=(P(), spec))
+        return f, stacked, (jr.normal(jr.fold_in(K, 72), (M, 2, HID)),
+                            jr.normal(jr.fold_in(K, 73), (M, 2, HID)))
+
+    @staticmethod
+    def _scan_lengths(jaxpr):
+        """Every lax.scan length anywhere in a (closed) jaxpr — the
+        trace-time geometry the schedules compile to. Duck-typed jaxpr
+        walk (works across jax's core/extend reshuffles)."""
+        lengths = []
+
+        def walk(j):
+            for eqn in j.eqns:
+                if eqn.primitive.name == "scan":
+                    lengths.append(int(eqn.params["length"]))
+                for val in eqn.params.values():
+                    vals = val if isinstance(val, (list, tuple)) else [val]
+                    for item in vals:
+                        if hasattr(item, "eqns"):  # a raw Jaxpr
+                            walk(item)
+                        elif hasattr(getattr(item, "jaxpr", None), "eqns"):
+                            walk(item.jaxpr)  # a ClosedJaxpr
+
+        walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
+        return lengths
+
+    def test_dw_deferral_geometry_in_jaxpr(self):
+        """The dW-deferral ORDERING asserted from trace-time geometry:
+        the zb program contains a third scan of exactly M·v ticks (the
+        deferred dW sweep, distinct from the two T = M·v + S − 1 sweeps);
+        the autodiff schedule has no M·v-length scan — its dW rides the
+        full-length backward scan, garbage lanes included."""
+        S, M = 4, 6
+        T = M + S - 1
+        zb_f, zb_p, (m, t) = self._grad_fn("zb", S, M)
+        zb_lengths = self._scan_lengths(jax.make_jaxpr(zb_f)(zb_p, m, t))
+        assert zb_lengths.count(T) >= 2, zb_lengths   # fwd + dX sweeps
+        assert M in zb_lengths, zb_lengths            # the deferred dW sweep
+        base_f, base_p, (m, t) = self._grad_fn("1f1b", S, M)
+        base_lengths = self._scan_lengths(
+            jax.make_jaxpr(base_f)(base_p, m, t))
+        assert M not in base_lengths, base_lengths
+        assert base_lengths.count(T) >= 2, base_lengths
+
+    @pytest.mark.parametrize("overlap", [False, True])
+    def test_recompile_free_geometry_reuse(self, overlap):
+        """Acceptance: the jitted zb path stays recompile-free across
+        schedule-geometry reuse — fresh data, same geometry, cache
+        pinned at 1."""
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=2)
+        plist = make_stage_params(jr.fold_in(K, 74), 2)
+        stacked = stack_params(plist)
+        spec = jax.tree.map(lambda _: P("pp"), stacked)
+        mbs = jr.normal(jr.fold_in(K, 75), (4, 2, HID))
+        tgts = jr.normal(jr.fold_in(K, 76), (4, 2, HID))
+
+        def loss_head(out, tgt):
+            return jnp.mean((out - tgt) ** 2)
+
+        def run(p, m, t):
+            loss, g = schedules.forward_backward_pipelining_zero_bubble(
+                stage_fn, loss_head, jax.tree.map(lambda x: x[0], p), m, t,
+                overlap_p2p=overlap)
+            return loss, jax.tree.map(lambda x: x[None], g)
+
+        step = jax.jit(mesh_lib.shard_map(
+            run, mesh=mesh, in_specs=(spec, P(), P()),
+            out_specs=(P(), spec)))
+        l1, _ = step(stacked, mbs, tgts)
+        l2, _ = step(stacked, mbs + 1.0, tgts)
+        l3, _ = step(stacked, mbs, tgts - 1.0)
+        assert step._cache_size() == 1
+        assert np.isfinite(float(l1) + float(l2) + float(l3))
+
+    @pytest.mark.parametrize("overlap", [False, True])
+    def test_zb_work_counters_closed_form(self, overlap):
+        """Per-device work counters through the zb forward's aux
+        contract: every device executes exactly M·v real chunk-ticks of
+        the schedule's fwd_ticks total — the closed form
+        pipeline_cost_model prices."""
+        from apex_tpu.monitor import pipeline_cost_model
+
+        S, v, M = 2, 3, 4
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=S)
+        feat = 8
+        mb = jr.normal(jr.fold_in(K, 77), (M, 2, feat))
+        params = jnp.ones((v, 1, feat))
+
+        def stage(p, x):
+            return x * p[0], 1.0
+
+        def run(p, mb):
+            out, work = schedules.pipeline_spmd_forward(
+                stage, p, mb, virtual_chunks=v, remat=False, aux_init=0.0,
+                schedule="zb", overlap_p2p=overlap)
+            return out, work[None]
+
+        _, work = mesh_lib.shard_map(
+            run, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P("pp")),
+        )(params, mb)
+        np.testing.assert_array_equal(np.asarray(work), np.full(S, M * v))
+        cost = pipeline_cost_model(M, S, v, schedule="zb",
+                                   overlap_p2p=overlap)
+        L = 2 if overlap else 1
+        assert cost["fwd_ticks"] == M * v + L * (S - 1) + (L - 1)
+        assert cost["bwd_dw_ticks"] == M * v
+
+    def test_cost_model_zb_beats_1f1b(self):
+        """Acceptance: the trace-time geometry shows the zb schedule's
+        smaller step bubble at pp >= 2 — closed forms pinned, and the
+        ordering holds across the matrix geometries."""
+        from apex_tpu.monitor import pipeline_cost_model
+
+        # M=8, S=4, v=1: 1f1b total 33 units, zb total 30 — bubble
+        # 9/33 = 27.3% vs 6/30 = 20.0%
+        base = pipeline_cost_model(8, 4, 1, schedule="1f1b")
+        zb = pipeline_cost_model(8, 4, 1, schedule="zb")
+        np.testing.assert_allclose(base["bubble_fraction"], 9 / 33)
+        np.testing.assert_allclose(zb["bubble_fraction"], 6 / 30)
+        for (M, S, v) in ((8, 4, 1), (4, 2, 3), (8, 4, 3), (16, 2, 1)):
+            b = pipeline_cost_model(M, S, v, schedule="1f1b")
+            z = pipeline_cost_model(M, S, v, schedule="zb")
+            assert z["bubble_fraction"] < b["bubble_fraction"], (M, S, v)
+            assert z["ideal_units"] == b["ideal_units"] == 3 * M * v
+            # recompute honesty: both zb sweeps rebuild the forward from
+            # the stashed inputs — M·v units MORE than rematted 1f1b.
+            # The slot-bubble win above does not hide it.
+            assert z["recompute_units"] == b["recompute_units"] + M * v
+            # and what the extra recompute buys: the whole dW sweep has
+            # no collective on the critical path
+            assert z["collective_free_ticks"] == M * v
+            assert b["collective_free_ticks"] == 0
+        with pytest.raises(ValueError, match="schedule="):
+            pipeline_cost_model(8, 4, 1, schedule="zbb")
+
+    def test_dispatcher_rejects_unknown_schedule(self):
+        """A typo'd schedule must not silently train on the default."""
+        with pytest.raises(ValueError, match="schedule="):
+            schedules.get_forward_backward_func(None, 4, schedule="ZB")
+        assert schedules.get_forward_backward_func(None, 4, schedule="zb") \
+            is schedules.forward_backward_pipelining_zero_bubble
+
+    def test_eager_validation_errors(self):
+        """Bad geometry fails at call time naming the knob, not as a
+        deep shape error mid-trace."""
+        mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=2)
+        params = jnp.ones((3, 1, HID))
+        mb = jr.normal(jr.fold_in(K, 78), (6, 2, HID))
+
+        def stage(p, x):
+            return x * p[0]
+
+        def call(**kw):
+            return mesh_lib.shard_map(
+                lambda p, m: schedules.pipeline_spmd_forward(
+                    stage, p, m, virtual_chunks=3, remat=False, **kw),
+                mesh=mesh, in_specs=(P(), P()), out_specs=P())(params, mb)
+
+        with pytest.raises(ValueError, match="schedule="):
+            call(schedule="zbb")
+        # M=6: fine at v=3 S=2 blocking, ragged for the 2*S group
+        with pytest.raises(ValueError, match="2\\*pipeline_size"):
+            call(schedule="zb", overlap_p2p=True)
     """EMPIRICAL bubble evidence (VERDICT r3 weak #4): per-device work
     counters through the real scanned schedule. Wall-clock on the
     single-core virtual mesh measures total work, not bubble — these
