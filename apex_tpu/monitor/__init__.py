@@ -57,6 +57,7 @@ from apex_tpu.monitor.registry import (  # noqa: F401
     emit_pipeline,
     emit_profile,
     emit_serve,
+    emit_serve_window,
     emit_tp_overlap,
     enable,
     enable_from_env,
@@ -78,10 +79,13 @@ from apex_tpu.monitor.hooks import (  # noqa: F401
     record_pipeline_schedule,
     tree_bytes,
 )
+from apex_tpu.monitor.histogram import StreamingHistogram  # noqa: F401
 from apex_tpu.monitor.spans import collective_span, span, span_path  # noqa: F401
 from apex_tpu.monitor.schema import gate_metrics, validate, validate_jsonl  # noqa: F401
 from apex_tpu.monitor.report import (  # noqa: F401
     PEAK_FLOPS_BY_DEVICE,
     aggregate,
+    format_serve_timeline,
+    serve_timeline,
     spec_peak_flops,
 )

@@ -166,6 +166,7 @@ class MetricsRegistry:
                  clock=time.perf_counter):
         self._sink = sink
         self._owns_sink = False
+        self._buffering = 0
         self._clock = clock
         self._t0 = clock()
         self.counters: Dict[str, float] = {}
@@ -216,8 +217,25 @@ class MetricsRegistry:
         check_record_honesty(record)
         if self._sink is not None:
             self._sink.write(json.dumps(record) + "\n")
-            self._sink.flush()
+            if not self._buffering:
+                self._sink.flush()
         return record
+
+    @contextlib.contextmanager
+    def buffered(self):
+        """Suppress the per-record sink flush inside the block (one
+        flush on exit). High-rate emitters (the serving telemetry's
+        per-transition lifecycle records) wrap their hot loop in this:
+        the OS still sees every line in order, just without an fsync-ish
+        flush per token-scale event. Nests; flushes when the outermost
+        block exits."""
+        self._buffering += 1
+        try:
+            yield
+        finally:
+            self._buffering -= 1
+            if self._buffering == 0 and self._sink is not None:
+                self._sink.flush()
 
     def emit_meta(self, **fields) -> Dict[str, Any]:
         """Run header: device/model facts the report needs (device kind,
@@ -264,6 +282,14 @@ class MetricsRegistry:
         offered-load sweep through the paged ServingEngine — per-token
         latency / TTFT percentiles, tokens/s under churn, occupancy."""
         return self._emit_status_record("serve", status, **fields)
+
+    def emit_serve_window(self, status: str, **fields) -> Dict[str, Any]:
+        """Live serving-SLO window record
+        (:meth:`apex_tpu.serving.telemetry.ServeTelemetry.maybe_window`):
+        sliding-window tokens/s + latency quantiles + queue/occupancy/
+        pool state + the ``serve_anomaly`` section. Same OK/SKIP
+        semantics as ``serve``."""
+        return self._emit_status_record("serve_window", status, **fields)
 
     def emit_pipeline(self, status: str, **fields) -> Dict[str, Any]:
         """Pipeline-schedule bench record (``bench.py --pipeline``):
@@ -472,6 +498,13 @@ def emit_serve(status: str, **fields) -> Optional[Dict[str, Any]]:
     r = _REGISTRY
     if r is not None:
         return r.emit_serve(status, **fields)
+    return None
+
+
+def emit_serve_window(status: str, **fields) -> Optional[Dict[str, Any]]:
+    r = _REGISTRY
+    if r is not None:
+        return r.emit_serve_window(status, **fields)
     return None
 
 

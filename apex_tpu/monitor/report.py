@@ -71,6 +71,7 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     longseq_records = []
     tp_overlap_records = []
     serve_records = []
+    serve_window_records = []
     pipeline_records = []
     schedule = None
     for rec in records:
@@ -91,6 +92,8 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             tp_overlap_records.append(rec)
         elif kind == "serve":
             serve_records.append(rec)
+        elif kind == "serve_window":
+            serve_window_records.append(rec)
         elif kind == "pipeline":
             pipeline_records.append(rec)
         elif kind == "event" and rec.get("name") == "pipeline_schedule":
@@ -235,7 +238,29 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                             "latency_p99_ms", "ttft_p50_ms", "ttft_p99_ms",
                             "occupancy_pct", "vs_single_request",
                             "requests", "slots", "block_size",
-                            "blocks_high_water"))
+                            "blocks_high_water",
+                            "admission_blocked_slots",
+                            "admission_blocked_blocks", "queue_peak",
+                            "serve_windows", "telemetry_overhead_pct"))
+        anomaly = serve_records[-1].get("serve_anomaly")
+        if isinstance(anomaly, dict):
+            summary["serve"]["serve_anomaly"] = anomaly
+
+    if serve_window_records:
+        # the live-SLO window trail: count + the LAST window's view
+        # (the full trail is the --serve-timeline rendering's job)
+        last = serve_window_records[-1]
+        summary["serve_window"] = {
+            "windows": len(serve_window_records),
+            **{k: last[k] for k in
+               ("status", "tokens_per_s", "latency_p50_ms",
+                "latency_p99_ms", "ttft_p50_ms", "queue_depth",
+                "occupancy_pct", "blocks_high_water")
+               if isinstance(last.get(k), (int, float, str))},
+        }
+        anomaly = last.get("serve_anomaly")
+        if isinstance(anomaly, dict):
+            summary["serve_window"]["serve_anomaly"] = anomaly
 
     if pipeline_records:
         summary["pipeline_bench"] = status_summary(
@@ -255,6 +280,152 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             for g in gate_records
         ]
     return summary
+
+
+def _anomaly_flags(anom: Dict[str, Any]) -> List[str]:
+    """Human-readable flags from a ``serve_anomaly`` section (empty
+    when the run was clean)."""
+    flags = []
+    if anom.get("straggler_steps"):
+        flags.append(f"straggler x{anom['straggler_steps']}"
+                     + (f" (last {anom['straggler_last_ratio']:g}x median)"
+                        if isinstance(anom.get("straggler_last_ratio"),
+                                      (int, float))
+                        and anom["straggler_last_ratio"] else ""))
+    if anom.get("queue_buildup"):
+        flags.append("queue buildup")
+    if anom.get("slo_burn"):
+        flags.append(f"SLO burn ({anom.get('ttft_over_slo', '?')} "
+                     f"first tokens over threshold)")
+    if anom.get("leaked_blocks"):
+        flags.append(f"LEAK {anom['leaked_blocks']} blocks")
+    return flags
+
+
+# --- the request-lifecycle timeline (`report --serve-timeline`) --------------
+
+def serve_timeline(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold ``serve_event``/``serve_window`` records into the
+    per-request lifecycle view: one row per request (queue wait, chunk
+    count, prefill/TTFT/decode durations, blocks held, finish) plus the
+    window trail. Rows are dicts so ``--json`` can carry them.
+
+    Appended multi-run streams fold the LAST run only (the same
+    run-splitting-at-``meta`` rule :func:`aggregate` applies) — rids
+    restart at 0 per run, so folding across runs would cross-wire two
+    runs' lifecycles into one garbage row."""
+    meta_idx = [i for i, r in enumerate(records)
+                if r.get("kind") == "meta"]
+    if len(meta_idx) > 1:
+        records = records[meta_idx[-1]:]
+    per_rid: Dict[int, Dict[str, Any]] = {}
+    stragglers = []
+    for rec in records:
+        if rec.get("kind") != "serve_event":
+            continue
+        rid = rec.get("rid")
+        if rid == -1:  # engine-level events (straggler steps)
+            if rec.get("straggler"):
+                stragglers.append({k: rec.get(k) for k in
+                                   ("at_s", "step", "dur_ms",
+                                    "ratio_to_median")})
+            continue
+        row = per_rid.setdefault(rid, {"rid": rid})
+        phase = rec.get("phase")
+        if phase == "submit":
+            row["submit_s"] = rec.get("at_s")
+            row["prompt_len"] = rec.get("prompt_len")
+            row["max_new_tokens"] = rec.get("max_new_tokens")
+        elif phase == "admit":
+            row["admit_s"] = rec.get("at_s")
+            row["slot"] = rec.get("slot")
+            row["queue_wait_ms"] = rec.get("queue_wait_ms")
+        elif phase == "prefill_chunk":
+            row["chunks"] = rec.get("chunk", 0) + 1
+            row["blocks_held"] = rec.get("blocks_held")
+        elif phase == "first_token":
+            row["ttft_ms"] = rec.get("ttft_ms")
+            row["prefill_ms"] = rec.get("prefill_ms")
+            row["chunks"] = rec.get("chunks", row.get("chunks"))
+            row["blocks_held"] = rec.get("blocks_held")
+        elif phase in ("finish", "evict"):
+            row["finish_s"] = rec.get("at_s")
+            row["tokens"] = rec.get("tokens")
+            row["decode_ms"] = rec.get("decode_ms")
+            row["total_ms"] = rec.get("total_ms")
+            row["outcome"] = phase
+    requests = sorted(per_rid.values(),
+                      key=lambda r: r.get("submit_s") or 0.0)
+    windows = [
+        {k: rec.get(k) for k in
+         ("at_s", "t_s", "window_s", "tokens", "tokens_per_s",
+          "latency_p50_ms", "latency_p99_ms", "ttft_p50_ms",
+          "queue_depth", "active_slots", "occupancy_pct", "blocks_live",
+          "serve_anomaly")}
+        for rec in records if rec.get("kind") == "serve_window"
+    ]
+    return {"requests": requests, "windows": windows,
+            "stragglers": stragglers}
+
+
+def _ms(v, nd=1) -> str:
+    return f"{v:.{nd}f}ms" if isinstance(v, (int, float)) else "-"
+
+
+def format_serve_timeline(timeline: Dict[str, Any]) -> str:
+    """Render :func:`serve_timeline` rows as the terminal table."""
+    lines = []
+    reqs = timeline["requests"]
+    lines.append(f"serve timeline: {len(reqs)} requests, "
+                 f"{len(timeline['windows'])} windows, "
+                 f"{len(timeline['stragglers'])} straggler steps")
+    def _n(r, key):
+        # event payload fields land as rec.get(...) and may be None
+        v = r.get(key)
+        return v if isinstance(v, (int, float)) else "-"
+
+    for r in reqs:
+        lines.append(
+            f"  rid {r['rid']:>4}  "
+            f"queue {_ms(r.get('queue_wait_ms'))}  "
+            f"prefill {_ms(r.get('prefill_ms'))}"
+            f"/{_n(r, 'chunks')}ch  "
+            f"ttft {_ms(r.get('ttft_ms'))}  "
+            f"decode {_ms(r.get('decode_ms'))}"
+            f"/{_n(r, 'tokens')}tok  "
+            f"blocks {_n(r, 'blocks_held')}  "
+            f"{r.get('outcome') or 'in-flight'}")
+    def _num(w, *keys, default="-"):
+        # serve_timeline materializes every window key (absent -> None),
+        # so dict-get defaults never fire — coalesce None explicitly
+        for k in keys:
+            v = w.get(k)
+            if isinstance(v, (int, float)):
+                return v
+        return default
+
+    for w in timeline["windows"]:
+        anom = w.get("serve_anomaly") or {}
+        flags = _anomaly_flags(anom) if isinstance(anom, dict) else []
+        tps = w.get("tokens_per_s")
+        # at_s is the serve clock (same base as the request rows);
+        # pre-at_s streams fall back to the registry clock
+        w_at = _num(w, "at_s", "t_s", default=None)
+        lines.append(
+            "  window "
+            + (f"+{w_at:.2f}s  " if w_at is not None else "")
+            + (f"{tps:.1f} tok/s  " if isinstance(tps, (int, float))
+               else "")
+            + f"p50/p99 {_ms(w.get('latency_p50_ms'), 2)}/"
+              f"{_ms(w.get('latency_p99_ms'), 2)}  "
+            + f"queue {_num(w, 'queue_depth')}  "
+            + f"occ {_num(w, 'occupancy_pct')}%"
+            + ("  [" + ", ".join(flags) + "]" if flags else ""))
+    for s in timeline["stragglers"]:
+        lines.append(f"  straggler step {s.get('step')}: "
+                     f"{_ms(s.get('dur_ms'), 2)} "
+                     f"({s.get('ratio_to_median', '?')}x rolling median)")
+    return "\n".join(lines)
 
 
 def render(summary: Dict[str, Any]) -> str:
@@ -354,6 +525,26 @@ def render(summary: Dict[str, Any]) -> str:
             if srv.get("skipped"):
                 parts.append("skipped: " + ", ".join(srv["skipped"]))
             lines.append("  serve       " + "   ".join(parts))
+        anom = srv.get("serve_anomaly")
+        if isinstance(anom, dict):
+            flags = _anomaly_flags(anom)
+            lines.append("  serve       anomalies: "
+                         + (", ".join(flags) if flags else "none"))
+    swin = summary.get("serve_window")
+    if swin:
+        parts = [f"{swin['windows']} windows"]
+        if isinstance(swin.get("tokens_per_s"), (int, float)):
+            parts.append(f"last {swin['tokens_per_s']:.1f} tok/s")
+        if isinstance(swin.get("queue_depth"), (int, float)):
+            parts.append(f"queue {swin['queue_depth']:g}")
+        if isinstance(swin.get("occupancy_pct"), (int, float)):
+            parts.append(f"occ {swin['occupancy_pct']:.0f}%")
+        anom = swin.get("serve_anomaly")
+        if isinstance(anom, dict):
+            flags = _anomaly_flags(anom)
+            if flags:
+                parts.append("anomalies: " + ", ".join(flags))
+        lines.append("  serve-win   " + "   ".join(parts))
     pb = summary.get("pipeline_bench")
     if pb:
         if pb.get("status") == "SKIP":
@@ -415,11 +606,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     rep.add_argument("--trace", metavar="LOGDIR",
                      help="profiler log dir to join spans against "
                           "(required with --anatomy)")
+    rep.add_argument("--serve-timeline", action="store_true",
+                     help="per-request serving lifecycle (serve_event "
+                          "records) + the serve_window SLO trail")
     args = parser.parse_args(argv)
 
     with open(args.path) as fh:
         records = read_records(fh)
     summary = aggregate(records)
+
+    timeline = None
+    if args.serve_timeline:
+        timeline = serve_timeline(records)
+        if not (timeline["requests"] or timeline["windows"]):
+            print("error: stream carries no serve_event/serve_window "
+                  "records (serve with a ServeTelemetry attached and "
+                  "the monitor enabled)", file=sys.stderr)
+            return 2
+        summary["serve_timeline"] = timeline
 
     anatomy_rows = None
     if args.anatomy:
@@ -443,6 +647,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps(summary))
     else:
         print(render(summary))
+        if timeline is not None:
+            print(format_serve_timeline(timeline))
         if anatomy_rows is not None:
             from apex_tpu.prof.trace_reader import format_anatomy
 

@@ -4,12 +4,13 @@ self-contained validator.
 One schema family covers every JSON artifact the repo emits:
 
 * monitor JSONL records (``kind`` ∈ meta/event/step/gate/decode/
-  longseq_bias/tp_overlap/serve) — the stream written by
-  :mod:`apex_tpu.monitor.registry` (``decode`` is the single-batch
-  serving record ``bench.py --decode`` emits; ``serve`` the
-  continuous-batching offered-load record of ``bench.py --serve``;
-  ``tp_overlap`` the ring-overlapped-vs-blocking record of ``bench.py
-  --tp-overlap``);
+  longseq_bias/tp_overlap/serve/serve_event/serve_window) — the stream
+  written by :mod:`apex_tpu.monitor.registry` (``decode`` is the
+  single-batch serving record ``bench.py --decode`` emits; ``serve``
+  the continuous-batching offered-load record of ``bench.py --serve``;
+  ``serve_event``/``serve_window`` the request-lifecycle and live-SLO
+  records of :mod:`apex_tpu.serving.telemetry`; ``tp_overlap`` the
+  ring-overlapped-vs-blocking record of ``bench.py --tp-overlap``);
 * ``BENCH_*.json``-style bench result objects (the line ``bench.py``
   prints);
 * the MULTICHIP gate record printed by ``__graft_entry__.dryrun_multichip``.
@@ -305,10 +306,118 @@ SERVE_SCHEMA = {
         "decode_steps": {"type": "integer"},
         "prefill_chunks": {"type": "integer"},
         "max_seq_len": {"type": "integer"},
+        # ISSUE 10 telemetry fields: the anomaly section, admission
+        # pressure counts, and the measured per-request trace overhead
+        "serve_anomaly": None,  # filled below (shared with serve_window)
+        "admission_blocked_slots": {"type": "integer"},
+        "admission_blocked_blocks": {"type": "integer"},
+        "queue_peak": {"type": "integer"},
+        "serve_windows": {"type": "integer"},
+        "telemetry_overhead_pct": _METRIC_VALUE,
         "config": {"type": "object"},
         "backend": {"type": "string"},
     },
     "required": ["schema", "kind", "status"],
+}
+
+# the serve_anomaly section shared by `serve` and `serve_window`
+# records: the anomaly layer's counters and flags (straggler decode
+# steps vs the rolling median, sustained-TTFT SLO burn, queue buildup,
+# free-list leak/fragmentation accounting from BlockAllocator)
+SERVE_ANOMALY_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "straggler_steps": {"type": "integer"},
+        "straggler_last_ratio": _METRIC_VALUE,
+        "queue_buildup": {"type": "boolean"},
+        "slo_burn": {"type": "boolean"},
+        "ttft_over_slo": {"type": "integer"},
+        "leaked_blocks": {"type": "integer"},
+        "free_list_frag_pct": _METRIC_VALUE,
+    },
+    "required": ["straggler_steps", "queue_buildup", "slo_burn",
+                 "leaked_blocks"],
+    "additionalProperties": False,
+}
+
+SERVE_SCHEMA["properties"]["serve_anomaly"] = SERVE_ANOMALY_SCHEMA
+
+# request-lifecycle record (apex_tpu.serving.telemetry.ServeTelemetry):
+# one rank-tagged record per request transition — submit → admit →
+# prefill_chunk*k → first_token → decode → finish (evict reserved for
+# preemption; rid -1 marks engine-level events like straggler steps).
+# `at_s` is the serve clock; `step` the engine dispatch counter — the
+# join key onto the serve_prefill/serve_decode device-trace scopes
+# (PR-6 scope-prefix correlation). Emitted OUTSIDE the jitted steps:
+# telemetry never touches the zero-recompile avals.
+SERVE_EVENT_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["serve_event"]},
+        "rid": {"type": "integer"},
+        "phase": {"enum": ["submit", "admit", "prefill_chunk",
+                           "first_token", "decode", "finish", "evict"]},
+        "at_s": {"type": "number"},        # serve-clock transition time
+        "slot": {"type": "integer"},
+        "step": {"type": "integer"},       # engine dispatch counter
+        "queue_wait_ms": {"type": "number"},   # admit
+        "chunk": {"type": "integer"},          # prefill_chunk index
+        "chunks": {"type": "integer"},         # first_token / finish
+        "dur_ms": {"type": "number"},          # phase duration
+        "prefill_ms": {"type": "number"},      # first_token: chunk sum
+        "ttft_ms": {"type": "number"},         # first_token
+        "decode_ms": {"type": "number"},       # finish: decode phase
+        "total_ms": {"type": "number"},        # finish: arrival→finish
+        "blocks_held": {"type": "integer"},
+        "tokens": {"type": "integer"},         # finish: generated count
+        "prompt_len": {"type": "integer"},     # submit
+        "max_new_tokens": {"type": "integer"},  # submit
+        "straggler": {"type": "boolean"},      # engine-level anomaly
+        "ratio_to_median": {"type": "number"},
+        "slots": {"type": "integer"},
+    },
+    "required": ["schema", "kind", "rid", "phase", "at_s"],
+}
+
+# periodic live-SLO window record (ServeTelemetry.maybe_window): the
+# sliding-window view bench.py --serve and any instrumented serve loop
+# emit every window_s — tokens/s, TTFT/per-token quantiles from the
+# PER-WINDOW streaming histograms, queue depth, occupancy, pool state,
+# admission-blocked-by {slots|blocks} counts, and the serve_anomaly
+# section. Same status semantics as the final `serve` record: "OK"
+# (real TPU) engages the honesty rule — an unmeasurable quantile (no
+# samples landed in the window) rides as an explicit skip object,
+# never nan; off-TPU the records are SKIP with a reason.
+SERVE_WINDOW_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["serve_window"]},
+        "status": {"enum": ["OK", "SKIP"]},
+        "reason": {"type": "string"},  # required when status == "SKIP"
+        "at_s": {"type": "number"},    # serve clock (window END) — the
+                                       # time base request rows use
+        "window_s": {"type": "number"},
+        "steps": {"type": "integer"},
+        "prefill_chunks": {"type": "integer"},
+        "tokens": {"type": "integer"},
+        "tokens_per_s": _METRIC_VALUE,
+        "latency_p50_ms": _METRIC_VALUE,
+        "latency_p99_ms": _METRIC_VALUE,
+        "ttft_p50_ms": _METRIC_VALUE,
+        "ttft_p99_ms": _METRIC_VALUE,
+        "queue_depth": {"type": "integer"},
+        "active_slots": {"type": "integer"},
+        "slots": {"type": "integer"},
+        "occupancy_pct": _METRIC_VALUE,
+        "blocks_live": {"type": "integer"},
+        "blocks_high_water": {"type": "integer"},
+        "admission_blocked_slots": {"type": "integer"},
+        "admission_blocked_blocks": {"type": "integer"},
+        "serve_anomaly": SERVE_ANOMALY_SCHEMA,
+    },
+    "required": ["schema", "kind", "status", "window_s", "serve_anomaly"],
 }
 
 # span record (monitor.spans.span): one host enter/exit window per
@@ -439,6 +548,8 @@ SCHEMAS_BY_KIND = {
     "tp_overlap": TP_OVERLAP_SCHEMA,
     "pipeline": PIPELINE_SCHEMA,
     "serve": SERVE_SCHEMA,
+    "serve_event": SERVE_EVENT_SCHEMA,
+    "serve_window": SERVE_WINDOW_SCHEMA,
     "span": SPAN_SCHEMA,
     "profile": PROFILE_SCHEMA,
     "costdb": COSTDB_SCHEMA,
@@ -540,7 +651,8 @@ def validate(record: Dict[str, Any],
     # too, but externally produced streams must not pass the validator
     # with a claim-free, reason-free skip)
     if (record.get("kind") in ("decode", "longseq_bias", "tp_overlap",
-                               "profile", "serve", "pipeline")
+                               "profile", "serve", "pipeline",
+                               "serve_window")
             and record.get("status") == "SKIP"
             and not record.get("reason")):
         errors.append(

@@ -26,11 +26,21 @@ stalling the in-flight streams. This package is that engine:
   (:func:`apex_tpu.ops.decode_attention` with ``block_tables=``), and
   the fused sampling tail (:func:`apex_tpu.ops.fused_sample`).
 
+* :mod:`~apex_tpu.serving.telemetry` — **request-level telemetry**
+  (ISSUE 10): per-request lifecycle ``serve_event`` records
+  (``submit → admit → prefill_chunk*k → first_token → decode →
+  finish``), bounded-memory streaming latency histograms, periodic
+  ``serve_window`` SLO records, and the anomaly layer (straggler decode
+  steps, queue buildup, SLO burn, free-list leak/fragmentation), all
+  host-side and outside the jitted steps.
+
 Serving throughput/latency under churn is measured by ``python bench.py
---serve`` (one schema-validated ``serve`` monitor record); the greedy
-no-churn output is token-identical to ``DecodeEngine`` (the parity the
-bench asserts). See ``docs/api/inference.md`` for block math and the
-scheduler contract.
+--serve`` (one schema-validated ``serve`` monitor record plus the
+``serve_event``/``serve_window`` stream when monitoring is enabled);
+the greedy no-churn output is token-identical to ``DecodeEngine`` (the
+parity the bench asserts). See ``docs/api/inference.md`` for block math
+and the scheduler contract, ``docs/OBSERVABILITY.md`` for the telemetry
+walkthrough.
 """
 
 from apex_tpu.serving.engine import ServingEngine  # noqa: F401
@@ -40,3 +50,4 @@ from apex_tpu.serving.kv_blocks import (  # noqa: F401
     blocks_needed,
 )
 from apex_tpu.serving.scheduler import Request, Scheduler  # noqa: F401
+from apex_tpu.serving.telemetry import ServeTelemetry  # noqa: F401

@@ -39,6 +39,7 @@ where the HBM bound lives, is already fused end to end).
 
 from __future__ import annotations
 
+import contextlib
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -48,10 +49,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from apex_tpu.models.gpt import GPTModel
+from apex_tpu.monitor import registry as monitor_registry
+from apex_tpu.monitor import spans as monitor_spans
 from apex_tpu.ops import fused_layer_norm, fused_sample
 from apex_tpu.ops.pallas.attention import NEG_INF
 from apex_tpu.serving.kv_blocks import DEAD_BLOCK, BlockAllocator
 from apex_tpu.serving.scheduler import Request, Scheduler
+from apex_tpu.serving.telemetry import ServeTelemetry
 
 
 @dataclass
@@ -176,6 +180,16 @@ class ServingEngine:
 
     def _prefill_chunk(self, params, pool, table_row, tokens, start, live,
                        key):
+        # trace-time step-anatomy span (PR 6): every HLO of the chunk
+        # program carries the serve_prefill scope in device traces — the
+        # join key request lifecycle records correlate on; no-op when
+        # monitoring is off, and never touches the stable avals
+        with monitor_spans.span("serve_prefill"):
+            return self._prefill_chunk_body(params, pool, table_row,
+                                            tokens, start, live, key)
+
+    def _prefill_chunk_body(self, params, pool, table_row, tokens, start,
+                            live, key):
         """One chunk of ONE slot's prompt: ``tokens`` (C,) are prompt
         positions [start, start+C) with the first ``live`` valid (the
         final chunk is ragged; pad rows are written but land either
@@ -250,6 +264,13 @@ class ServingEngine:
     # --- decode step ---------------------------------------------------------
 
     def _decode_step(self, params, pool, tables, tokens, lengths, key):
+        # same trace-time scope as above: one span per TRACE (not per
+        # token), prefixing the whole decode step's HLOs in device traces
+        with monitor_spans.span("serve_decode"):
+            return self._decode_step_body(params, pool, tables, tokens,
+                                          lengths, key)
+
+    def _decode_step_body(self, params, pool, tables, tokens, lengths, key):
         """One token for EVERY slot: ``tokens`` (S,) are each slot's
         incoming sampled tokens, ``lengths`` (S,) the live rows INCLUDING
         them (0 = dead slot: write lands in the dead block, attention
@@ -302,7 +323,8 @@ class ServingEngine:
     def serve(self, params, requests: List[Request], *,
               key: Optional[jax.Array] = None,
               clock: Optional[Callable[[], float]] = None,
-              scheduler: Optional[Scheduler] = None) -> List[Request]:
+              scheduler: Optional[Scheduler] = None,
+              telemetry=None) -> List[Request]:
         """Run ``requests`` to completion; returns them in completion
         order with tokens and latency stamps filled in.
 
@@ -313,7 +335,19 @@ class ServingEngine:
         ``time.perf_counter``) drives arrival replay and the latency
         stamps; requests whose ``arrival_s`` is in the future are held
         until the clock passes it. ``scheduler`` injects a pre-built
-        scheduler (tests script churn through it)."""
+        scheduler (tests script churn through it).
+
+        ``telemetry`` attaches a :class:`~apex_tpu.serving.telemetry.
+        ServeTelemetry` — request lifecycle events, streaming latency
+        histograms, periodic ``serve_window`` records, and the anomaly
+        layer, all host-side and outside the jitted steps (the
+        zero-recompile contract holds with telemetry on). When the
+        monitor registry is enabled and no tracker is passed, a default
+        one is attached so an instrumented process gets request traces
+        for free; pass ``telemetry=False`` to suppress even that (timed
+        baseline runs must not pay emit costs a comparison leg does
+        not); with monitoring off and no tracker, every hook site is a
+        single ``is None`` test."""
         if self.temperature > 0 and key is None:
             raise ValueError("temperature > 0 serving requires a key")
         if key is None:  # greedy: the key operand is ignored but keeps
@@ -324,43 +358,101 @@ class ServingEngine:
         t0 = clock()
         now = lambda: clock() - t0  # noqa: E731
         sched = scheduler if scheduler is not None else self.make_scheduler()
+        tel = telemetry
+        if tel is False:  # explicit opt-out beats auto-attachment AND
+            # any tracker a reused scheduler still carries — a timed
+            # baseline must not fire scheduler-side hooks either
+            tel = None
+            sched.telemetry = None
+        elif tel is None and sched.telemetry is not None:
+            # a tracker attached at Scheduler construction is the
+            # caller's choice: adopt it fully (engine-side hooks +
+            # windows too) instead of shadowing it with an auto one
+            tel = sched.telemetry
+        elif tel is None and monitor_registry.enabled():
+            # an instrumented process gets request traces for free; the
+            # auto-attached tracker claims OK only on real hardware
+            # (same convention as every bench record)
+            backend = jax.default_backend()
+            tel = (ServeTelemetry(slots=self.num_slots)
+                   if backend == "tpu" else ServeTelemetry(
+                       slots=self.num_slots, status="SKIP",
+                       reason=f"auto-attached serve telemetry on "
+                              f"{backend}: serving windows are TPU "
+                              f"measurements"))
+        if tel is not None:
+            sched.telemetry = tel
         for r in requests:
+            if tel is not None:
+                r.submit_s = now()
+                tel.on_submit(r, r.submit_s)
             sched.submit(r)
         pool = self.init_pool()
         stats = ServeStats()
+        # per-transition lifecycle records skip the per-line sink flush
+        # inside the loop (one flush at the end) — the dominant cost of
+        # an emit at token rates; see ServeTelemetry's overhead budget
+        reg = monitor_registry.get_registry()
+        flush_scope = (reg.buffered() if reg is not None and tel is not None
+                       else contextlib.nullcontext())
+        if tel is not None:
+            # prime the first window's clock BEFORE any work: the first
+            # iteration's tokens must not be divided by a window that
+            # started after they were produced
+            tel.maybe_window(now(), sched)
+        with flush_scope:
+            self._serve_loop(params, key, sched, tel, stats, now, wall,
+                             pool)
+        self.last_stats = stats
+        return sched.completed
+
+    def _serve_loop(self, params, key, sched, tel, stats, now, wall, pool):
         nstep = 0
         while not sched.idle():
             sched.admit(now())
             did_work = False
             work = sched.next_prefill()
             if work is not None:
+                sched.note_step(nstep)
+                t_dispatch = now()
                 pool, tok, _ = self.prefill_chunk(
                     params, pool,
                     jnp.asarray(sched.tables.row(work.slot)),
                     jnp.asarray(work.tokens),
                     jnp.int32(work.start), jnp.int32(work.live),
                     jax.random.fold_in(key, nstep))
+                tok = int(tok)  # blocks until the chunk really ran
+                if tel is not None:
+                    tel.on_prefill_chunk(
+                        work.rid, work.slot, now() - t_dispatch,
+                        sched.blocks_held(work.slot), nstep, now())
                 nstep += 1
                 stats.prefill_chunks += 1
-                sched.note_prefill(work, int(tok), now())
+                sched.note_prefill(work, tok, now())
                 did_work = True
             batch = sched.decode_batch()
             if batch is not None:
                 toks, lens = batch
                 ndec = len(sched.decoding_slots())
+                sched.note_step(nstep)
+                t_dispatch = now()
                 pool, sampled, _ = self.decode_step(
                     params, pool, jnp.asarray(sched.tables.asarray()),
                     jnp.asarray(toks), jnp.asarray(lens),
                     jax.random.fold_in(key, nstep))
+                sampled = np.asarray(sampled)  # blocks: step really ran
+                if tel is not None:
+                    tel.on_decode_step(now() - t_dispatch, ndec, nstep,
+                                       now())
                 nstep += 1
                 stats.decode_steps += 1
                 stats.occupancy_samples.append(ndec)
-                sched.note_decode(np.asarray(sampled), now())
+                sched.note_decode(sampled, now())
                 did_work = True
             stats.blocks_high_water = max(stats.blocks_high_water,
                                           sched.allocator.num_live)
+            if tel is not None:
+                tel.maybe_window(now(), sched)
             if not did_work and wall:
                 # nothing runnable: only future arrivals remain
                 time.sleep(1e-4)
-        self.last_stats = stats
-        return sched.completed

@@ -50,6 +50,14 @@ class BlockAllocator:
     LIFO reuse (a just-freed block is hottest in cache and cheapest to
     re-DMA) with double-free/foreign-id checks — an allocator bug here
     would silently cross-wire two requests' caches, so it must be loud.
+
+    Accounting for the serving telemetry (ISSUE 10): lifetime
+    ``alloc_total`` / ``free_total`` counters, the monotone
+    ``high_water`` of live blocks, the :attr:`leaked` witness
+    (``alloc_total - free_total - num_live`` — non-zero means the
+    free/live sets were mutated behind the allocator's back), and
+    :meth:`fragmentation_pct` over the free list. All host-side ints;
+    the counters never change allocation behavior.
     """
 
     def __init__(self, num_blocks: int):
@@ -61,6 +69,9 @@ class BlockAllocator:
         # ascending pop order on a fresh pool: low ids first
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
         self._live: set = set()
+        self.alloc_total = 0
+        self.free_total = 0
+        self.high_water = 0
 
     @property
     def num_free(self) -> int:
@@ -69,6 +80,40 @@ class BlockAllocator:
     @property
     def num_live(self) -> int:
         return len(self._live)
+
+    @property
+    def leaked(self) -> int:
+        """Blocks the counters cannot account for: every allocate is
+        matched by a free or is still live, so this is exactly zero
+        unless ``_free``/``_live`` were mutated outside the API (the
+        silent-corruption case the telemetry must make loud)."""
+        return self.alloc_total - self.free_total - self.num_live
+
+    def check_accounting(self) -> None:
+        """Raise ``RuntimeError`` if the pool invariants broke: a block
+        lost to both lists, a block on both, or counter drift."""
+        overlap = self._live.intersection(self._free)
+        missing = (self.num_blocks - 1) - self.num_free - self.num_live
+        if overlap or missing or self.leaked:
+            raise RuntimeError(
+                f"block pool accounting broken: leaked={self.leaked}, "
+                f"{missing} block(s) on neither list, "
+                f"{len(overlap)} on both — free/live were mutated "
+                f"outside the allocator API")
+
+    def fragmentation_pct(self) -> float:
+        """Free-list fragmentation: 100 * (1 - 1/runs) where ``runs``
+        counts maximal runs of consecutive block ids among the free
+        blocks — 0 when the free ids form one contiguous range (the
+        fresh-pool state), approaching 100 as reuse shreds it. Purely
+        diagnostic: paging is indirection-oblivious, but a shredded
+        free list means future requests' blocks scatter across the
+        pool (worse DMA locality on the gather path)."""
+        if not self._free:
+            return 0.0
+        ids = sorted(self._free)
+        runs = 1 + sum(1 for a, b in zip(ids, ids[1:]) if b != a + 1)
+        return 100.0 * (1.0 - 1.0 / runs)
 
     def allocate(self, n: int = 1) -> List[int]:
         """Pop ``n`` block ids; raises when the pool cannot satisfy it
@@ -82,6 +127,9 @@ class BlockAllocator:
                 f"have prevented this")
         ids = [self._free.pop() for _ in range(n)]
         self._live.update(ids)
+        self.alloc_total += n
+        if self.num_live > self.high_water:
+            self.high_water = self.num_live
         return ids
 
     def free(self, ids: Iterable[int]) -> None:
@@ -94,6 +142,7 @@ class BlockAllocator:
                     f"double free / foreign block id {bid} (not live)")
             self._live.remove(bid)
             self._free.append(bid)
+            self.free_total += 1
 
 
 class BlockTables:

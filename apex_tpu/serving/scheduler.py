@@ -64,6 +64,7 @@ class Request:
     arrival_s: float = 0.0
     tokens: List[int] = dataclasses.field(default_factory=list)
     token_s: List[float] = dataclasses.field(default_factory=list)
+    submit_s: Optional[float] = None
     admit_s: Optional[float] = None
     first_token_s: Optional[float] = None
     finish_s: Optional[float] = None
@@ -101,6 +102,7 @@ class PrefillWork:
     start: int
     live: int
     is_last: bool
+    rid: int = -1  # the request the chunk belongs to (telemetry join)
 
 
 class Scheduler:
@@ -116,7 +118,7 @@ class Scheduler:
 
     def __init__(self, *, num_slots: int, block_size: int,
                  max_blocks_per_slot: int, allocator: BlockAllocator,
-                 prefill_chunk: int):
+                 prefill_chunk: int, telemetry=None):
         if prefill_chunk < block_size or prefill_chunk % block_size:
             raise ValueError(
                 f"prefill_chunk ({prefill_chunk}) must be a positive "
@@ -127,12 +129,20 @@ class Scheduler:
         self.max_blocks_per_slot = int(max_blocks_per_slot)
         self.prefill_chunk = int(prefill_chunk)
         self.allocator = allocator
+        # optional apex_tpu.serving.telemetry.ServeTelemetry: lifecycle
+        # hooks fire from the host bookkeeping here (admit/finish and
+        # admission-pressure accounting); None costs one is-None test
+        self.telemetry = telemetry
         self.tables = BlockTables(num_slots, max_blocks_per_slot)
         self._slots = [_Slot() for _ in range(self.num_slots)]
         self._waiting: Deque[Request] = deque()
         # admission order of live slots: prefill picks the oldest first
         self._admit_order: List[int] = []
         self.completed: List[Request] = []
+        # the engine step index of the dispatch currently noted; the
+        # telemetry stamps it on lifecycle records so they join to the
+        # serve_prefill/serve_decode device-trace scopes by step
+        self._step = 0
 
     # --- capacity accounting -------------------------------------------------
 
@@ -170,20 +180,28 @@ class Scheduler:
         # a request whose worst case exceeds the WHOLE pool could never
         # pass the admission gate — refusing it here turns a permanent
         # queue stall (serve() would spin forever) into an eager error
+        # naming the knob AND the rounding recipe that sizes it
         pool_cap = self.allocator.num_blocks - 1
-        if self._worst_blocks(req) > pool_cap:
+        need = self._worst_blocks(req)
+        if need > pool_cap:
             raise ValueError(
-                f"request {req.rid}: worst case needs "
-                f"{self._worst_blocks(req)} blocks but the pool only has "
-                f"{pool_cap} allocatable "
-                f"(num_blocks={self.allocator.num_blocks} - 1 dead "
-                f"block); it could never be admitted — raise num_blocks "
-                f"or shorten the request")
+                f"request {req.rid}: worst case needs {need} blocks — "
+                f"ceil((prompt {len(req.prompt)} + max_new_tokens "
+                f"{req.max_new_tokens} - 1) / block_size "
+                f"{self.block_size}) — but the pool only has {pool_cap} "
+                f"allocatable (num_blocks={self.allocator.num_blocks} "
+                f"minus 1 dead block); it could never be admitted. "
+                f"Raise num_blocks to >= {need + 1} (worst-case blocks "
+                f"+ the dead block) or shorten the request")
         self._waiting.append(req)
 
     def admit(self, now: float) -> List[int]:
         """Move arrived waiting requests into free slots, FCFS, while the
-        reservation gate holds. Returns the slots admitted this call."""
+        reservation gate holds. Returns the slots admitted this call.
+        The telemetry (when attached) gets one ``admit`` lifecycle event
+        per admission and an admission-blocked-by {slots|blocks} count
+        when an arrived request is held back."""
+        tel = self.telemetry
         admitted = []
         free_slots = [i for i, s in enumerate(self._slots) if s.free]
         while (self._waiting and free_slots
@@ -191,6 +209,8 @@ class Scheduler:
             req = self._waiting[0]
             if (self._worst_blocks(req) + self._outstanding_reservation()
                     > self.allocator.num_free):
+                if tel is not None:
+                    tel.on_blocked("blocks")
                 break  # pool pressure: hold FCFS order, retry next step
             self._waiting.popleft()
             i = free_slots.pop(0)
@@ -198,6 +218,11 @@ class Scheduler:
             self._admit_order.append(i)
             req.admit_s = now
             admitted.append(i)
+            if tel is not None:
+                tel.on_admit(req, i, now)
+        if (tel is not None and not free_slots and self._waiting
+                and self._waiting[0].arrival_s <= now):
+            tel.on_blocked("slots")
         return admitted
 
     # --- chunked prefill -----------------------------------------------------
@@ -222,7 +247,7 @@ class Scheduler:
             tokens[:live] = req.prompt[start:start + live]
             return PrefillWork(
                 slot=i, tokens=tokens, start=start, live=live,
-                is_last=(start + live >= len(req.prompt)))
+                is_last=(start + live >= len(req.prompt)), rid=req.rid)
         return None
 
     def note_prefill(self, work: PrefillWork, sampled_token: int,
@@ -242,6 +267,10 @@ class Scheduler:
         req.tokens.append(int(sampled_token))
         req.token_s.append(now)
         req.first_token_s = now
+        tel = self.telemetry
+        if tel is not None:
+            tel.on_first_token(req, work.slot, slot.n_blocks, self._step,
+                               now)
         if slot.generated >= req.max_new_tokens:
             return [self._finish(work.slot, now)]
         return []
@@ -280,6 +309,7 @@ class Scheduler:
     def note_decode(self, sampled: np.ndarray, now: float) -> List[Request]:
         """Record one decode step's samples; returns requests finished
         (and evicted) by it."""
+        tel = self.telemetry
         finished = []
         for i in self.decoding_slots():
             slot = self._slots[i]
@@ -287,6 +317,8 @@ class Scheduler:
             slot.last_token = int(sampled[i])
             slot.generated += 1
             req = slot.request
+            if tel is not None and req.token_s:
+                tel.observe_itl(now - req.token_s[-1])
             req.tokens.append(int(sampled[i]))
             req.token_s.append(now)
             if slot.generated >= req.max_new_tokens:
@@ -299,12 +331,25 @@ class Scheduler:
         slot = self._slots[i]
         req = slot.request
         req.finish_s = now
+        tel = self.telemetry
+        if tel is not None:  # blocks_held captured BEFORE they free
+            tel.on_finish(req, i, slot.n_blocks, self._step, now)
         self.allocator.free(slot.block_ids)
         self.tables.clear(i)
         self._slots[i] = _Slot()
         self._admit_order.remove(i)
         self.completed.append(req)
         return req
+
+    def blocks_held(self, i: int) -> int:
+        """Pool blocks currently allocated to slot ``i``."""
+        return self._slots[i].n_blocks
+
+    def note_step(self, step: int) -> None:
+        """Record the engine's dispatch counter so lifecycle events can
+        name the prefill/decode step that produced them (the join key
+        onto the serve_prefill/serve_decode device-trace scopes)."""
+        self._step = int(step)
 
     # --- state queries -------------------------------------------------------
 
@@ -315,6 +360,14 @@ class Scheduler:
     @property
     def num_waiting(self) -> int:
         return len(self._waiting)
+
+    def num_queued(self, now: float) -> int:
+        """Waiting requests that have actually ARRIVED by ``now`` — the
+        honest queue depth. Arrival-replay serving submits the whole
+        trace upfront with future ``arrival_s``; counting those as
+        queued would saturate queue telemetry at the trace length
+        before any request ever waited for capacity."""
+        return sum(1 for r in self._waiting if r.arrival_s <= now)
 
     def next_arrival(self) -> Optional[float]:
         return self._waiting[0].arrival_s if self._waiting else None

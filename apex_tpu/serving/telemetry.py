@@ -1,0 +1,446 @@
+"""Request-level serving telemetry: lifecycle traces, streaming latency
+histograms, live SLO windows, and anomaly detection.
+
+The PR-7 engine was observable only post-hoc: ``bench.py --serve``
+stored every per-token latency in host lists and computed p50/p99 once
+at the end, with no visibility into *why* a tail request was slow
+(queue wait vs chunked-prefill interleave vs a straggler decode step)
+and no signal while a run degrades. :class:`ServeTelemetry` is the
+missing layer, riding the PR-1/6 monitor stack:
+
+* **Lifecycle event stream** — one rank-tagged ``serve_event`` JSONL
+  record per request transition (``submit → admit → prefill_chunk*k →
+  first_token → decode → finish``) carrying queue wait, chunk count,
+  blocks held, per-phase durations, and the engine step index of the
+  dispatch that produced it. Device correlation is the PR-6
+  scope-prefix join: the engine's jitted bodies trace under the
+  ``serve_prefill`` / ``serve_decode`` named scopes, so every HLO of
+  step *n* carries that prefix in a device trace and the lifecycle
+  record's ``step`` names which dispatch it was.
+* **Streaming histograms** — per-token (inter-token) latency and TTFT
+  land in bounded-memory :class:`~apex_tpu.monitor.histogram.
+  StreamingHistogram` pairs (cumulative for the final bench record,
+  per-window for the live records) instead of unbounded host lists.
+* **Live SLO windows** — a periodic ``serve_window`` record (sliding
+  window tokens/s, TTFT / per-token quantiles, queue depth, slot
+  occupancy, pool high-water, admission-blocked-by {slots|blocks}
+  counts) with a ``serve_anomaly`` section.
+* **Anomaly layer** — straggler decode steps against a rolling median,
+  queue-buildup and SLO-burn flags (sustained TTFT over threshold),
+  and free-list leak / fragmentation accounting from
+  :class:`~apex_tpu.serving.kv_blocks.BlockAllocator`.
+
+Everything here is host-side bookkeeping driven from OUTSIDE the jit'd
+steps — the zero-recompile contract is untouched (asserted by tests and
+the bench with telemetry enabled) and the cost is O(1) dict/histogram
+work per token plus one JSONL write per transition/window, measured and
+reported as ``telemetry_overhead_pct`` in the ``serve`` record (<1% of
+a serve step; the hooks are a single ``is None`` test when no telemetry
+is attached). Records only reach a file while the process-wide monitor
+registry is enabled; the histograms and anomaly counters accumulate
+regardless, so the bench reads its quantiles without a sink.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+from apex_tpu.monitor import registry as _reg
+from apex_tpu.monitor.histogram import StreamingHistogram
+
+__all__ = ["ServeTelemetry"]
+
+# lifecycle phases, in order (evict is reserved for preemption — the
+# current engine only retires requests by finishing them)
+PHASES = ("submit", "admit", "prefill_chunk", "first_token", "decode",
+          "finish", "evict")
+
+
+class _InFlight:
+    """Per-request scratch while the request is live (freed at finish —
+    the tracker's memory is bounded by concurrent requests, never by
+    request history)."""
+
+    __slots__ = ("queued_at", "admit_at", "chunks", "prefill_s",
+                 "first_token_at")
+
+    def __init__(self, queued_at: float):
+        self.queued_at = queued_at
+        self.admit_at: Optional[float] = None
+        self.chunks = 0
+        self.prefill_s = 0.0
+        self.first_token_at: Optional[float] = None
+
+
+class ServeTelemetry:
+    """Request-level telemetry for one :meth:`ServingEngine.serve` call.
+
+    Construct one per serve run and pass it as
+    ``engine.serve(..., telemetry=tel)`` (the engine also auto-attaches
+    one when the monitor registry is enabled). Knobs:
+
+    * ``slots`` — the engine's slot count (occupancy denominator).
+    * ``window_s`` — ``serve_window`` emission period on the serve
+      clock (0 disables periodic records; stats still accumulate).
+    * ``slo_ttft_ms`` — the TTFT service-level objective; ``None``
+      disables SLO-burn detection.
+    * ``slo_burn_count`` — consecutive over-SLO first tokens that flip
+      the ``slo_burn`` flag (sustained breach, not a single outlier).
+    * ``straggler_ratio`` / ``straggler_window`` — a decode step slower
+      than ``ratio`` x the rolling median of the last ``window`` steps
+      counts as a straggler (after the window has filled once).
+    * ``status`` / ``reason`` — the claim the emitted ``serve_window``
+      records carry ("OK" engages the no-nan honesty rule; off-TPU
+      callers pass ``("SKIP", reason)`` semantics just like the bench
+      record itself).
+    """
+
+    def __init__(self, *, slots: int, window_s: float = 0.5,
+                 slo_ttft_ms: Optional[float] = None,
+                 slo_burn_count: int = 3,
+                 straggler_ratio: float = 3.0,
+                 straggler_window: int = 32,
+                 status: str = "OK", reason: Optional[str] = None):
+        if status not in ("OK", "SKIP"):
+            raise ValueError(f"status must be OK|SKIP, got {status!r}")
+        if status == "SKIP" and not reason:
+            raise ValueError("SKIP serve_window records need a reason")
+        self.slots = int(slots)
+        self.window_s = float(window_s)
+        self.slo_ttft_ms = slo_ttft_ms
+        self.slo_burn_count = int(slo_burn_count)
+        self.straggler_ratio = float(straggler_ratio)
+        self.straggler_window = int(straggler_window)
+        self.status = status
+        self.reason = reason
+
+        # cumulative histograms back the final bench record; the window
+        # pair resets at every serve_window emission (sliding view)
+        self.itl_ms = StreamingHistogram()
+        self.ttft_ms = StreamingHistogram()
+        self._win_itl = StreamingHistogram()
+        self._win_ttft = StreamingHistogram()
+
+        self._inflight: Dict[int, _InFlight] = {}
+        self._recent_steps = deque(maxlen=self.straggler_window)
+        self._queue_depths = deque(maxlen=4)  # at window emissions
+
+        # counters surfaced on windows and the final record
+        self.tokens = 0
+        self.decode_steps = 0
+        self.prefill_chunks = 0
+        self.finished = 0
+        self.admission_blocked_slots = 0
+        self.admission_blocked_blocks = 0
+        self.queue_peak = 0
+        self.straggler_steps = 0
+        self.straggler_last_ratio = 0.0
+        self._ttft_over_slo_run = 0
+        self.ttft_over_slo = 0
+        self.slo_burn = False
+        self.queue_buildup = False
+        self.leaked_blocks = 0
+        self.windows_emitted = 0
+
+        self._win_t0: Optional[float] = None
+        self._win_tokens = 0
+        self._win_steps = 0
+        self._win_chunks = 0
+        self.overhead_ns = 0  # real host ns spent inside the hooks
+
+    # --- internals -----------------------------------------------------------
+
+    @property
+    def overhead_s(self) -> float:
+        return self.overhead_ns * 1e-9
+
+    def _emit(self, kind: str, **fields) -> None:
+        r = _reg.get_registry()
+        if r is None:
+            return
+        if kind == "serve_window":
+            r.emit_serve_window(self.status, **fields)
+        else:
+            r.emit(kind, **fields)
+
+    @staticmethod
+    def _skip_or(value, why: str):
+        return value if value is not None else ("skipped", why)
+
+    # --- lifecycle hooks (called by Scheduler / ServingEngine) ---------------
+
+    def on_submit(self, req, now: float) -> None:
+        t = time.perf_counter_ns()
+        self._inflight[req.rid] = _InFlight(
+            queued_at=max(now, float(req.arrival_s)))
+        self._emit("serve_event", rid=req.rid, phase="submit", at_s=now,
+                   prompt_len=int(len(req.prompt)),
+                   max_new_tokens=int(req.max_new_tokens))
+        self.overhead_ns += time.perf_counter_ns() - t
+
+    def on_admit(self, req, slot: int, now: float) -> None:
+        t = time.perf_counter_ns()
+        fl = self._inflight.get(req.rid)
+        if fl is None:  # submitted before the tracker attached
+            fl = self._inflight[req.rid] = _InFlight(float(req.arrival_s))
+        fl.admit_at = now
+        queue_wait_ms = max(now - fl.queued_at, 0.0) * 1e3
+        self._emit("serve_event", rid=req.rid, phase="admit", at_s=now,
+                   slot=int(slot), queue_wait_ms=round(queue_wait_ms, 3))
+        self.overhead_ns += time.perf_counter_ns() - t
+
+    def on_blocked(self, why: str, n: int = 1) -> None:
+        if why == "slots":
+            self.admission_blocked_slots += n
+        elif why == "blocks":
+            self.admission_blocked_blocks += n
+        else:
+            raise ValueError(f"unknown admission block reason {why!r}")
+
+    def on_prefill_chunk(self, rid: int, slot: int, dur_s: float,
+                         blocks_held: int, step: int, now: float) -> None:
+        t = time.perf_counter_ns()
+        self.prefill_chunks += 1
+        self._win_chunks += 1
+        fl = self._inflight.get(rid)
+        chunk = 0
+        if fl is not None:
+            chunk = fl.chunks
+            fl.chunks += 1
+            fl.prefill_s += dur_s
+        self._emit("serve_event", rid=rid, phase="prefill_chunk", at_s=now,
+                   slot=int(slot), chunk=chunk,
+                   dur_ms=round(dur_s * 1e3, 3),
+                   blocks_held=int(blocks_held), step=int(step))
+        self.overhead_ns += time.perf_counter_ns() - t
+
+    def on_first_token(self, req, slot: int, blocks_held: int, step: int,
+                       now: float) -> None:
+        t = time.perf_counter_ns()
+        fl = self._inflight.get(req.rid)
+        if fl is None:
+            fl = self._inflight[req.rid] = _InFlight(float(req.arrival_s))
+        fl.first_token_at = now
+        ttft_ms = max(now - fl.queued_at, 0.0) * 1e3
+        self.ttft_ms.add(ttft_ms)
+        self._win_ttft.add(ttft_ms)
+        self.tokens += 1
+        self._win_tokens += 1
+        if self.slo_ttft_ms is not None:
+            if ttft_ms > self.slo_ttft_ms:
+                self.ttft_over_slo += 1
+                self._ttft_over_slo_run += 1
+                if self._ttft_over_slo_run >= self.slo_burn_count:
+                    self.slo_burn = True
+            else:
+                self._ttft_over_slo_run = 0
+        self._emit("serve_event", rid=req.rid, phase="first_token",
+                   at_s=now, slot=int(slot),
+                   ttft_ms=round(ttft_ms, 3), chunks=fl.chunks,
+                   prefill_ms=round(fl.prefill_s * 1e3, 3),
+                   blocks_held=int(blocks_held), step=int(step))
+        if req.max_new_tokens > 1:  # the request enters steady decode
+            self._emit("serve_event", rid=req.rid, phase="decode",
+                       at_s=now, slot=int(slot),
+                       blocks_held=int(blocks_held), step=int(step))
+        self.overhead_ns += time.perf_counter_ns() - t
+
+    def observe_itl(self, itl_s: float) -> None:
+        """One inter-token gap (decode token ``i`` → ``i+1`` of one
+        request) into the latency histograms."""
+        t = time.perf_counter_ns()
+        ms = itl_s * 1e3
+        self.itl_ms.add(ms)
+        self._win_itl.add(ms)
+        self.tokens += 1
+        self._win_tokens += 1
+        self.overhead_ns += time.perf_counter_ns() - t
+
+    def on_decode_step(self, dur_s: float, live_slots: int, step: int,
+                       now: float) -> None:
+        """One full-width decode step's wall time: feeds the straggler
+        detector (vs the rolling median of recent steps)."""
+        t = time.perf_counter_ns()
+        self.decode_steps += 1
+        self._win_steps += 1
+        recent = self._recent_steps
+        if len(recent) == recent.maxlen:
+            med = sorted(recent)[len(recent) // 2]
+            if med > 0 and dur_s > self.straggler_ratio * med:
+                self.straggler_steps += 1
+                self.straggler_last_ratio = round(dur_s / med, 2)
+                self._emit("serve_event", rid=-1, phase="decode",
+                           at_s=now, step=int(step), straggler=True,
+                           dur_ms=round(dur_s * 1e3, 3),
+                           ratio_to_median=self.straggler_last_ratio,
+                           slots=int(live_slots))
+        recent.append(dur_s)
+        self.overhead_ns += time.perf_counter_ns() - t
+
+    def on_finish(self, req, slot: int, blocks_held: int, step: int,
+                  now: float) -> None:
+        t = time.perf_counter_ns()
+        self.finished += 1
+        fl = self._inflight.pop(req.rid, None)
+        decode_ms = None
+        if fl is not None and fl.first_token_at is not None:
+            decode_ms = round(max(now - fl.first_token_at, 0.0) * 1e3, 3)
+        fields = dict(rid=req.rid, phase="finish", at_s=now,
+                      slot=int(slot), tokens=len(req.tokens),
+                      blocks_held=int(blocks_held), step=int(step),
+                      total_ms=round(
+                          max(now - float(req.arrival_s), 0.0) * 1e3, 3))
+        if decode_ms is not None:
+            fields["decode_ms"] = decode_ms
+        if fl is not None:
+            fields["chunks"] = fl.chunks
+        self._emit("serve_event", **fields)
+        self.overhead_ns += time.perf_counter_ns() - t
+
+    # --- windows + anomalies -------------------------------------------------
+
+    def anomaly_section(self, allocator=None) -> Dict[str, Any]:
+        """The ``serve_anomaly`` object riding ``serve_window`` records
+        and the final ``serve`` record. With an ``allocator``, folds in
+        the free-list leak / fragmentation accounting."""
+        if allocator is not None and allocator.leaked:
+            # counter drift is a leak whenever it shows; the idle-pool
+            # flavor (live blocks with no active requests) is detected
+            # at window time and sticks in self.leaked_blocks
+            self.leaked_blocks = allocator.leaked
+        out: Dict[str, Any] = {
+            "straggler_steps": self.straggler_steps,
+            "straggler_last_ratio": self.straggler_last_ratio,
+            "queue_buildup": self.queue_buildup,
+            "slo_burn": self.slo_burn,
+            "ttft_over_slo": self.ttft_over_slo,
+            "leaked_blocks": self.leaked_blocks,
+        }
+        if allocator is not None:
+            out["free_list_frag_pct"] = round(
+                allocator.fragmentation_pct(), 2)
+        return out
+
+    def maybe_window(self, now: float, sched) -> Optional[Dict[str, Any]]:
+        """Emit a ``serve_window`` record when ``window_s`` has elapsed
+        on the serve clock; returns the fields dict when one was
+        emitted. ``sched`` is the live :class:`Scheduler` (queue depth,
+        occupancy, allocator state are read from it). Queue depth
+        counts requests that have ARRIVED and are waiting
+        (:meth:`Scheduler.num_queued` — not the unarrived replay tail,
+        which would saturate the peak at the trace length) and is
+        sampled on EVERY call (the peak must not depend on window
+        cadence); the record only on the window edge. The engine calls
+        this once BEFORE its loop so the first window's clock starts
+        before the first work, not after it."""
+        queued = sched.num_queued(now)
+        if queued > self.queue_peak:
+            self.queue_peak = queued
+        if self.window_s <= 0:
+            return None
+        if self._win_t0 is None:
+            self._win_t0 = now
+            return None
+        if now - self._win_t0 < self.window_s:
+            return None
+        t = time.perf_counter_ns()
+        fields = self._window_fields(now, sched)
+        self._emit("serve_window", **fields)
+        self.windows_emitted += 1
+        self._win_t0 = now
+        self._win_tokens = 0
+        self._win_steps = 0
+        self._win_chunks = 0
+        self._win_itl.reset()
+        self._win_ttft.reset()
+        self.overhead_ns += time.perf_counter_ns() - t
+        return fields
+
+    def _window_fields(self, now: float, sched) -> Dict[str, Any]:
+        window = max(now - (self._win_t0 if self._win_t0 is not None
+                            else now), 0.0)
+        queue = sched.num_queued(now)
+        self.queue_peak = max(self.queue_peak, queue)
+        self._queue_depths.append(queue)
+        qd = list(self._queue_depths)
+        self.queue_buildup = (
+            len(qd) >= 3 and qd[-1] > 0
+            and all(b > a for a, b in zip(qd[-3:], qd[-2:])))
+        active = sched.num_active
+        alloc = sched.allocator
+        # a pool leak only means something when nothing SHOULD hold
+        # blocks: counter drift is a leak at any time, live blocks with
+        # zero active requests are one too
+        if alloc.leaked:
+            self.leaked_blocks = alloc.leaked
+        elif active == 0 and queue == 0 and alloc.num_live > 0:
+            self.leaked_blocks = alloc.num_live
+        itl = self._win_itl
+        ttft = self._win_ttft
+        no_itl = "no inter-token samples in window"
+        no_ttft = "no first tokens in window"
+        return dict(
+            at_s=round(now, 6),  # serve clock: joins the request rows
+            window_s=round(window, 6),
+            steps=self._win_steps,
+            prefill_chunks=self._win_chunks,
+            tokens=self._win_tokens,
+            tokens_per_s=round(self._win_tokens / window, 1) if window > 0
+            else ("skipped", "zero-length window"),
+            latency_p50_ms=self._skip_or(
+                _r3(itl.quantile(0.5)), no_itl),
+            latency_p99_ms=self._skip_or(
+                _r3(itl.quantile(0.99)), no_itl),
+            ttft_p50_ms=self._skip_or(_r3(ttft.quantile(0.5)), no_ttft),
+            ttft_p99_ms=self._skip_or(_r3(ttft.quantile(0.99)), no_ttft),
+            queue_depth=queue,
+            active_slots=active,
+            slots=self.slots,
+            occupancy_pct=round(100.0 * active / self.slots, 2),
+            blocks_live=alloc.num_live,
+            blocks_high_water=alloc.high_water,
+            admission_blocked_slots=self.admission_blocked_slots,
+            admission_blocked_blocks=self.admission_blocked_blocks,
+            serve_anomaly=self.anomaly_section(alloc),
+            **({"reason": self.reason} if self.reason else {}),
+        )
+
+    # --- the final bench-record fields ---------------------------------------
+
+    def final_fields(self, allocator=None) -> Dict[str, Any]:
+        """The telemetry-derived fields of the final ``serve`` record:
+        cumulative streaming-histogram quantiles (replacing the
+        sample-list percentile math), anomaly section, admission
+        pressure counts, and the measured hook overhead.
+
+        Call AFTER the serve run completed: every request has finished,
+        so any block still live on the allocator IS a leak (the
+        finish-path-stopped-freeing regression this flag exists for —
+        the in-loop idle check can only fire on a window edge, which
+        the last iteration rarely lands on)."""
+        if allocator is not None and allocator.num_live > 0:
+            self.leaked_blocks = max(self.leaked_blocks,
+                                     allocator.num_live)
+        no_itl = "no inter-token samples (single-token outputs)"
+        no_ttft = "no requests reached a first token"
+        return dict(
+            latency_p50_ms=self._skip_or(
+                _r3(self.itl_ms.quantile(0.5)), no_itl),
+            latency_p99_ms=self._skip_or(
+                _r3(self.itl_ms.quantile(0.99)), no_itl),
+            ttft_p50_ms=self._skip_or(
+                _r3(self.ttft_ms.quantile(0.5)), no_ttft),
+            ttft_p99_ms=self._skip_or(
+                _r3(self.ttft_ms.quantile(0.99)), no_ttft),
+            serve_anomaly=self.anomaly_section(allocator),
+            admission_blocked_slots=self.admission_blocked_slots,
+            admission_blocked_blocks=self.admission_blocked_blocks,
+            queue_peak=self.queue_peak,
+            serve_windows=self.windows_emitted,
+        )
+
+
+def _r3(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v, 3)

@@ -34,7 +34,10 @@ lengths) through the paged ``apex_tpu.serving.ServingEngine`` — p50/p99
 per-token latency, TTFT, tokens/s under churn, occupancy — as one
 ``serve`` monitor record with greedy-parity and jit-cache-pinned
 witnesses vs the single-request engine (explicit ``SKIP(reason)``
-off-TPU).
+off-TPU). Request-level telemetry rides along: streaming-histogram
+quantiles, per-request ``serve_event`` lifecycle records, periodic
+``serve_window`` SLO records, and the ``serve_anomaly`` section
+(stragglers, queue buildup, SLO burn, pool leaks).
 
 ``python bench.py --longseq-bias`` runs the long-sequence relative-bias
 leg (:func:`longseq_bias_main`): in-kernel BUCKETED bias vs the
@@ -312,14 +315,24 @@ def serve_main():
     explicit ``status: "SKIP"`` with a reason — the smoke-scale CPU
     measurements ride along as finite numbers, but a SKIP record claims
     no serving result (the honesty rule: never nan inside an OK
-    artifact)."""
+    artifact).
+
+    Request-level telemetry (ISSUE 10) rides the churn sweep: a
+    :class:`apex_tpu.serving.ServeTelemetry` feeds bounded-memory
+    streaming histograms (replacing the r7 host sample lists), emits
+    per-request ``serve_event`` lifecycle records and periodic
+    ``serve_window`` SLO records onto the monitor stream, and the final
+    record carries the ``serve_anomaly`` section, admission-pressure
+    counts, and the MEASURED telemetry overhead
+    (``telemetry_overhead_pct`` — the <1%-of-a-serve-step budget,
+    reported rather than assumed)."""
     import numpy as np
 
     on_tpu = jax.default_backend() == "tpu"
     monitor.enable_from_env()
     from apex_tpu.inference import DecodeEngine
     from apex_tpu.models import GPTConfig, GPTModel
-    from apex_tpu.serving import Request, ServingEngine
+    from apex_tpu.serving import Request, ServeTelemetry, ServingEngine
 
     if on_tpu:
         # the flagship decode-bench config; 8 slots x 1024 rows of bf16
@@ -357,8 +370,15 @@ def serve_main():
     # second, warm passes below carry the throughput ratio
     want = deng.generate(params, jnp.asarray(prompt)[None], parity_new)
     jax.block_until_ready(want)
-    done = engine.serve(params, [Request(rid=-1, prompt=prompt,
-                                         max_new_tokens=parity_new)])
+    # rid -1 is reserved for engine-level telemetry events; the two
+    # warmup/parity requests take ids far above the sweep's, and all
+    # warm/timed runs pass telemetry=False — the auto-attached tracker
+    # would bill emit costs to the paged side of vs_single_request that
+    # the DecodeEngine baseline does not pay, and its windows would
+    # double-count against the sweep's serve_windows field
+    done = engine.serve(params, [Request(rid=1_000_000, prompt=prompt,
+                                         max_new_tokens=parity_new)],
+                        telemetry=False)
     greedy_parity = (np.asarray(done[0].tokens)
                      == np.asarray(want)[0]).all()
     t0 = time.perf_counter()
@@ -366,8 +386,9 @@ def serve_main():
     jax.block_until_ready(want)
     single_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    engine.serve(params, [Request(rid=-2, prompt=prompt,
-                                  max_new_tokens=parity_new)])
+    engine.serve(params, [Request(rid=1_000_001, prompt=prompt,
+                                  max_new_tokens=parity_new)],
+                 telemetry=False)
     paged_s = time.perf_counter() - t0
     single_tps = parity_new / single_s
     vs_single = (parity_new / paged_s) / single_tps
@@ -387,18 +408,30 @@ def serve_main():
             arrival_s=float(arrivals[i]))
         for i in range(n_req)
     ]
+    # the telemetry layer: streaming histograms (bounded memory — the
+    # r7 per-token host lists are gone from this aggregation), lifecycle
+    # + window records on the monitor stream, anomaly detection. The
+    # claim its window records carry matches the final record's.
+    skip_reason = (None if on_tpu else
+                   f"continuous-batching latency/throughput is a TPU "
+                   f"measurement; this is a {jax.default_backend()} "
+                   f"smoke run at {n_req} requests")
+    tel = ServeTelemetry(
+        slots=slots, window_s=0.25 if on_tpu else 0.01,
+        slo_ttft_ms=1000.0 if on_tpu else 10000.0,
+        status="OK" if on_tpu else "SKIP", reason=skip_reason)
+    sched = engine.make_scheduler()
     t0 = time.perf_counter()
-    done = engine.serve(params, requests)
+    done = engine.serve(params, requests, scheduler=sched, telemetry=tel)
     wall = time.perf_counter() - t0
     assert len(done) == n_req, "serve lost requests"
     stats = engine.last_stats
 
     total_tokens = sum(len(r.tokens) for r in done)
-    itls = np.concatenate([np.diff(r.token_s) for r in done
-                           if len(r.token_s) >= 2]) * 1e3
-    ttfts = np.array([r.first_token_s - r.arrival_s for r in done]) * 1e3
     # the zero-recompile contract IS part of what is measured: any
-    # re-trace across this churn schedule would be dispatch overhead
+    # re-trace across this churn schedule would be dispatch overhead —
+    # and it must hold WITH telemetry attached (lifecycle records are
+    # emitted outside the jitted steps)
     jit_cache_ok = (engine.prefill_chunk._cache_size() == 1
                     and engine.decode_step._cache_size() == 1)
     assert jit_cache_ok, \
@@ -406,10 +439,11 @@ def serve_main():
 
     fields = dict(
         tokens_per_s=round(total_tokens / wall, 1),
-        latency_p50_ms=round(float(np.percentile(itls, 50)), 3),
-        latency_p99_ms=round(float(np.percentile(itls, 99)), 3),
-        ttft_p50_ms=round(float(np.percentile(ttfts, 50)), 3),
-        ttft_p99_ms=round(float(np.percentile(ttfts, 99)), 3),
+        # streaming-histogram quantiles (parity with the removed
+        # sample-list math within one bucket width — pinned by
+        # tests/test_histogram.py)
+        **tel.final_fields(sched.allocator),
+        telemetry_overhead_pct=round(100.0 * tel.overhead_s / wall, 4),
         occupancy_pct=round(stats.occupancy_pct(slots), 2),
         vs_single_request=round(vs_single, 4),
         single_request_tokens_per_s=round(single_tps, 1),
@@ -428,10 +462,7 @@ def serve_main():
     if on_tpu:
         status = "OK"
     else:
-        reason = (f"continuous-batching latency/throughput is a TPU "
-                  f"measurement; this is a {jax.default_backend()} smoke "
-                  f"run at {n_req} requests")
-        fields["reason"] = reason
+        fields["reason"] = skip_reason
         status = "SKIP"
 
     if monitor.enabled():

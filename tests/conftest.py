@@ -217,6 +217,13 @@ _SLOW_OFF_TPU = {
     "tests/test_pipeline.py::TestZeroBubble::test_zb_bf16_params_accumulate_fp32_main_grad",  # 1f1b bf16 sibling + GPT-level fp32-accum zb parity (test_zb_schedule[1]) stay
     "tests/test_gpt_pipeline.py::TestScheduleFeatureMatrix::test_zb_schedule[2]",  # [1] stays; interleaved zb parity: test_pipeline pp2_v3[True] stays
     "tests/test_monitor.py::TestPipelineBenchLeg::test_bench_pipeline_emits_valid_skip_record_off_tpu",  # record/validator/report contract: test_pipeline_record_emits_validates_and_reports stays
+    # r10 (serving-telemetry PR): the heaviest full-engine telemetry
+    # sweeps move here (same contract: `-m ''` and hardware still run
+    # them; each row names the sibling that keeps its family covered in
+    # tier-1):
+    "tests/test_serve_telemetry.py::TestServeWindows::test_skip_windows_carry_reason",  # window emission: test_windows_emit_and_validate stays; SKIP-reason contract: test_telemetry_requires_skip_reason + TestReportAndValidator::test_emitter_honesty_on_windows stay
+    "tests/test_serve_telemetry.py::TestReportAndValidator::test_aggregate_carries_window_summary_and_anomalies",  # timeline/report path: test_serve_timeline_rows_and_rendering stays; serve-record aggregation: test_serving TestServeRecord stays
+    "tests/test_serve_telemetry.py::TestLifecycleStream::test_queue_wait_covers_held_admission",  # lifecycle stream: test_event_sequence_and_payloads stays; blocked-by counters: TestSchedulerTelemetrySeam::test_blocked_by_blocks_vs_slots stays (engine-free)
 }
 
 

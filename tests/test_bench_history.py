@@ -1,0 +1,181 @@
+"""tools/bench_history.py — the trajectory regression gate (ISSUE 10
+satellite): tolerance-bounded tokens/s comparison against the
+checked-in ``BENCH_r*.json`` artifacts, one-line verdicts, SKIP-record
+honesty, and the off-TPU schema-only smoke over the REAL repo history.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import bench_history  # noqa: E402
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _hist(tmp_path, rounds):
+    """Write BENCH_r<N>.json driver envelopes into tmp_path."""
+    for n, (value, spread) in enumerate(rounds, 1):
+        payload = {"parsed": {"metric": "m_tok", "value": value,
+                              "unit": "tokens/s/chip",
+                              "spread_pct": spread}}
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+            json.dumps(payload))
+
+
+def _fresh(tmp_path, value, spread=0.1, name="fresh.json"):
+    p = tmp_path / name
+    p.write_text(json.dumps({"metric": "m_tok", "value": value,
+                             "unit": "tokens/s/chip",
+                             "spread_pct": spread}))
+    return str(p)
+
+
+class TestGate:
+    def test_in_tolerance_passes(self, tmp_path, capsys):
+        _hist(tmp_path, [(100.0, 0.5), (110.0, 0.5)])
+        rc = bench_history.main([_fresh(tmp_path, 108.0),
+                                 "--root", str(tmp_path)])
+        assert rc == 0
+        assert "OK m_tok" in capsys.readouterr().out
+
+    def test_regression_fails_with_one_line_diff(self, tmp_path, capsys):
+        _hist(tmp_path, [(100.0, 0.5), (110.0, 0.5)])
+        rc = bench_history.main([_fresh(tmp_path, 90.0),
+                                 "--root", str(tmp_path)])
+        assert rc == 1
+        out = capsys.readouterr().out.strip()
+        assert out.count("\n") == 0  # ONE line
+        assert out.startswith("REGRESSION m_tok")
+        assert "BENCH_r02.json" in out and "-18.18%" in out
+
+    def test_compares_latest_not_best(self, tmp_path):
+        """The trajectory's newest point is the reference — an old
+        outlier round must not move the bar."""
+        _hist(tmp_path, [(140.0, 0.5), (110.0, 0.5)])
+        assert bench_history.main([_fresh(tmp_path, 108.0),
+                                   "--root", str(tmp_path)]) == 0
+
+    def test_spread_widens_the_band(self, tmp_path):
+        _hist(tmp_path, [(110.0, 4.0)])  # noisy history
+        # 8% down: outside tol 3% alone, inside 3 + 4 + 2
+        assert bench_history.main([_fresh(tmp_path, 101.2, spread=2.0),
+                                   "--root", str(tmp_path)]) == 0
+        assert bench_history.main([_fresh(tmp_path, 99.0, spread=0.0),
+                                   "--root", str(tmp_path),
+                                   "--tolerance-pct", "1"]) == 1
+
+    def test_round_ordering_is_numeric(self, tmp_path):
+        """r10 is newer than r9 (lexicographic sort would invert)."""
+        for n, v in [(9, 100.0), (10, 200.0)]:
+            (tmp_path / f"BENCH_r{n}.json").write_text(json.dumps(
+                {"parsed": {"metric": "m_tok", "value": v,
+                            "unit": "u", "spread_pct": 0.0}}))
+        assert bench_history.main([_fresh(tmp_path, 100.0),
+                                   "--root", str(tmp_path)]) == 1
+
+    def test_serve_record_and_skip_honesty(self, tmp_path, capsys):
+        """Monitor records gate too — and a SKIP record claims nothing,
+        so it can never regress."""
+        hist = tmp_path / "BENCH_r01.json"
+        hist.write_text(json.dumps(
+            {"kind": "serve", "schema": 1, "status": "OK",
+             "tokens_per_s": 5000.0}))
+        fresh = tmp_path / "serve.json"
+        fresh.write_text(json.dumps(
+            {"kind": "serve", "schema": 1, "status": "OK",
+             "tokens_per_s": 3000.0}))
+        rc = bench_history.main([str(fresh), "--root", str(tmp_path)])
+        assert rc == 1
+        assert "serve_tokens_per_s" in capsys.readouterr().out
+        skip = tmp_path / "skip.json"
+        skip.write_text(json.dumps(
+            {"kind": "serve", "schema": 1, "status": "SKIP",
+             "reason": "no TPU", "tokens_per_s": 1.0}))
+        rc = bench_history.main([str(skip), "--root", str(tmp_path)])
+        assert rc == 0
+        assert "SKIP" in capsys.readouterr().out
+
+    def test_jsonl_stream_uses_last_record(self, tmp_path):
+        _hist(tmp_path, [(100.0, 0.0)])
+        stream = tmp_path / "run.jsonl"
+        stream.write_text(
+            json.dumps({"kind": "meta", "schema": 1}) + "\n"
+            + json.dumps({"metric": "m_tok", "value": 99.0,
+                          "unit": "u"}) + "\n")
+        assert bench_history.main([str(stream),
+                                   "--root", str(tmp_path)]) == 0
+
+    def test_no_matching_history_is_skip(self, tmp_path, capsys):
+        _hist(tmp_path, [(100.0, 0.0)])
+        fresh = tmp_path / "other.json"
+        fresh.write_text(json.dumps({"metric": "other", "value": 1.0,
+                                     "unit": "u"}))
+        assert bench_history.main([str(fresh),
+                                   "--root", str(tmp_path)]) == 0
+        assert "SKIP" in capsys.readouterr().out
+
+    def test_unreadable_fresh_is_usage_error(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        assert bench_history.main([str(bad),
+                                   "--root", str(tmp_path)]) == 2
+
+
+class TestTier1Smoke:
+    def test_schema_only_over_real_repo_history(self, tmp_path, capsys):
+        """The off-TPU tier-1 smoke the ISSUE wires in: the gate's
+        plumbing (extraction + shared monitor schema) validates the
+        REAL checked-in BENCH_r*.json trajectory, no throughput claim
+        involved."""
+        fresh = _fresh(tmp_path, 1.0)
+        rc = bench_history.main(["--schema-only", fresh, "--root", ROOT])
+        assert rc == 0
+        assert "SCHEMA-ONLY OK" in capsys.readouterr().out
+
+    def test_real_history_extracts_a_trajectory(self):
+        rows = bench_history.collect_history("BENCH_r*.json", ROOT)
+        assert len(rows) >= 4  # r02..r05 share the flagship metric
+        metrics = {m for _, m, _, _ in rows}
+        assert "gpt_medium_train_step_throughput" in metrics
+        values = [v for _, m, v, _ in rows
+                  if m == "gpt_medium_train_step_throughput"]
+        assert all(v > 0 for v in values)
+
+    def test_schema_only_catches_a_broken_artifact(self, tmp_path):
+        bad = tmp_path / "fresh.json"
+        bad.write_text(json.dumps({"metric": "m", "unit": "u"}))  # no value
+        assert bench_history.main(["--schema-only", str(bad),
+                                   "--root", str(tmp_path)]) == 2
+
+    def test_schema_only_truncated_history_is_exit_2_not_traceback(
+            self, tmp_path, capsys):
+        """A killed run's half-written artifact must produce one
+        diagnostic line and exit 2, never a traceback (review
+        finding: CI keys on exit 2 = broken artifact)."""
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps({"metric": "m_tok", "value": 1.0,
+                                     "unit": "u"}))
+        (tmp_path / "BENCH_r01.json").write_text('{"parsed": {"met')
+        rc = bench_history.main(["--schema-only", str(fresh),
+                                 "--root", str(tmp_path)])
+        assert rc == 2
+        assert "unreadable" in capsys.readouterr().err
+
+    def test_jsonl_stream_prefers_last_claim_record(self, tmp_path):
+        """A telemetry stream trailing with windows/meta after the
+        serve record still extracts the claim record."""
+        _hist(tmp_path, [(100.0, 0.0)])
+        stream = tmp_path / "run.jsonl"
+        stream.write_text(
+            json.dumps({"metric": "m_tok", "value": 99.5,
+                        "unit": "u"}) + "\n"
+            + json.dumps({"kind": "meta", "schema": 1}) + "\n"
+            + json.dumps({"kind": "serve_window", "schema": 1,
+                          "status": "SKIP", "reason": "x",
+                          "window_s": 0.5}) + "\n")
+        assert bench_history.main([str(stream),
+                                   "--root", str(tmp_path)]) == 0

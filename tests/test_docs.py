@@ -94,7 +94,11 @@ def test_inference_doc_covers_serving_contract():
     for needle in ("block table", "free list", "dead block",
                    "reservation gate", "Chunked prefill", "fused_sample",
                    "bench.py --serve", "greedy_parity",
-                   "_cache_size() == 1", "multiple of 128"):
+                   "_cache_size() == 1", "multiple of 128",
+                   # ISSUE 10: request-level telemetry chapter
+                   "ServeTelemetry", "serve_event", "serve_window",
+                   "--serve-timeline", "telemetry_overhead_pct",
+                   "bench_history.py", "rounding recipe"):
         assert needle in text, f"inference.md dropped {needle}"
 
 
@@ -104,8 +108,24 @@ def test_observability_covers_anatomy_and_calibration():
     text = open(path).read()
     for needle in ("monitor.span", "--anatomy", "step_anatomy",
                    "build_costdb", "--costdb", "host gap",
-                   "collective-exposed", "bench.py --profile"):
+                   "collective-exposed", "bench.py --profile",
+                   # ISSUE 10: serving-telemetry chapter
+                   "serve_event", "serve_window", "serve_anomaly",
+                   "--serve-timeline", "StreamingHistogram",
+                   "straggler", "admission-blocked-by",
+                   "bench_history.py"):
         assert needle in text, f"OBSERVABILITY.md dropped {needle}"
+
+
+def test_monitor_doc_covers_serving_telemetry():
+    path = os.path.join(os.path.dirname(__file__), "..", "docs", "api",
+                        "monitor.md")
+    text = open(path).read()
+    for needle in ("StreamingHistogram", "one bucket width",
+                   "serve_event", "serve_window", "SERVE_ANOMALY_SCHEMA",
+                   "emit_serve_window", "--serve-timeline",
+                   "serve_timeline", "--serve-window", "buffered"):
+        assert needle in text, f"monitor.md dropped {needle}"
 
 
 def test_guide_covers_the_ladder():

@@ -1,0 +1,150 @@
+"""Streaming-histogram contracts (ISSUE 10 tentpole b).
+
+The acceptance anchor: quantiles from the bounded-memory log-bucket
+histogram match the REMOVED sample-list ``np.percentile`` math within
+one bucket width on a fixed trace — at p50 and p99, across
+distributions shaped like real latency traces (lognormal tails,
+bimodal prefill/decode mixes, constants). Plus the structural
+contracts: bounded memory, exact min/max/mean, merge, and input
+validation.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from apex_tpu.monitor.histogram import StreamingHistogram
+
+
+def _parity(samples, ps=(50, 90, 99)):
+    h = StreamingHistogram()
+    for s in samples:
+        h.add(float(s))
+    for p in ps:
+        want = float(np.percentile(samples, p))
+        got = h.percentile(p)
+        width = h.bucket_width(got)
+        assert abs(got - want) <= width, (
+            f"p{p}: histogram {got} vs sample-list {want} differ by "
+            f"{abs(got - want)} > one bucket width {width}")
+
+
+class TestQuantileParity:
+    def test_lognormal_trace(self):
+        """The canonical latency shape: heavy right tail."""
+        _parity(np.random.default_rng(0).lognormal(0.5, 1.0, 2000))
+
+    def test_bimodal_trace(self):
+        """Prefill-interleave jitter: fast decode steps + slow chunks."""
+        rng = np.random.default_rng(1)
+        fast = rng.normal(1.0, 0.05, 1500).clip(0.5)
+        slow = rng.normal(12.0, 1.0, 500).clip(8)
+        _parity(np.concatenate([fast, slow]))
+
+    def test_uniform_trace(self):
+        _parity(np.random.default_rng(2).uniform(0.1, 50.0, 3000))
+
+    def test_small_trace(self):
+        _parity(np.asarray([1.0, 2.0, 3.0, 4.0, 100.0]), ps=(50,))
+
+    def test_constant_trace_is_exact(self):
+        h = StreamingHistogram()
+        for _ in range(100):
+            h.add(7.25)
+        # min == max pins every quantile exactly (the clamp)
+        for q in (0.0, 0.5, 0.99, 1.0):
+            assert h.quantile(q) == 7.25
+
+    def test_extreme_quantiles_exact(self):
+        samples = np.random.default_rng(3).lognormal(0, 1, 500)
+        h = StreamingHistogram()
+        for s in samples:
+            h.add(float(s))
+        assert h.quantile(0.0) == samples.min()
+        assert h.quantile(1.0) == samples.max()
+        assert h.mean == pytest.approx(samples.mean(), rel=1e-9)
+
+
+class TestStructure:
+    def test_memory_is_bounded_by_construction(self):
+        """A million-sample-scale ingest allocates nothing: the counts
+        array is sized at construction."""
+        h = StreamingHistogram()
+        n_slots = len(h._counts)
+        for i in range(10000):
+            h.add(0.001 * (i + 1))
+        assert len(h._counts) == n_slots
+        assert h.count == 10000
+        # the default geometry stays under ~1k ints
+        assert n_slots < 1024
+
+    def test_relative_width_is_uniform(self):
+        h = StreamingHistogram(bins_per_decade=64)
+        g = 10 ** (1 / 64)
+        for v in (0.01, 1.0, 123.0, 1e5):
+            low, high = h.bucket_edges(v)
+            assert low <= v < high
+            assert high / low == pytest.approx(g, rel=1e-9)
+
+    def test_under_overflow_clamp_to_tracked_extremes(self):
+        h = StreamingHistogram(lo=1.0, hi=10.0)
+        h.add(0.25)   # underflow
+        h.add(2.0)
+        h.add(400.0)  # overflow
+        assert h.quantile(0.0) == 0.25
+        assert h.quantile(1.0) == 400.0
+        assert h.count == 3
+
+    def test_merge(self):
+        rng = np.random.default_rng(4)
+        a_s, b_s = rng.lognormal(0, 1, 400), rng.lognormal(1, 0.5, 600)
+        a, b = StreamingHistogram(), StreamingHistogram()
+        for s in a_s:
+            a.add(float(s))
+        for s in b_s:
+            b.add(float(s))
+        a.merge(b)
+        both = np.concatenate([a_s, b_s])
+        assert a.count == 1000
+        assert a.min == both.min() and a.max == both.max()
+        want = float(np.percentile(both, 99))
+        got = a.quantile(0.99)
+        assert abs(got - want) <= a.bucket_width(got)
+
+    def test_reset_keeps_geometry(self):
+        h = StreamingHistogram()
+        for v in (0.5, 5.0, 50.0):
+            h.add(v)
+        h.reset()
+        assert h.count == 0 and h.min is None and h.quantile(0.5) is None
+        h.add(2.0)
+        assert h.quantile(0.5) == 2.0 and h.count == 1
+
+    def test_merge_rejects_geometry_mismatch(self):
+        with pytest.raises(ValueError, match="geometries differ"):
+            StreamingHistogram().merge(StreamingHistogram(lo=1.0))
+
+    def test_summary_block(self):
+        h = StreamingHistogram()
+        assert h.summary() == {}  # empty → caller encodes explicit skip
+        for v in (1.0, 2.0, 3.0):
+            h.add(v)
+        s = h.summary(prefix="itl_")
+        assert s["itl_count"] == 3 and s["itl_max"] == 3.0
+        assert s["itl_mean"] == pytest.approx(2.0)
+        assert all(math.isfinite(v) for v in s.values())
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="lo < hi"):
+            StreamingHistogram(lo=2.0, hi=1.0)
+        with pytest.raises(ValueError, match="bins_per_decade"):
+            StreamingHistogram(bins_per_decade=0)
+        with pytest.raises(ValueError, match="nan"):
+            StreamingHistogram().add(float("nan"))
+        with pytest.raises(ValueError, match="q must be"):
+            StreamingHistogram().quantile(1.5)
+        h = StreamingHistogram()
+        assert h.quantile(0.5) is None  # empty: no fabricated number
+        h.add(1.0, n=0)  # non-positive weights are dropped
+        assert h.count == 0

@@ -1,0 +1,609 @@
+"""Request-level serving telemetry (ISSUE 10 tentpole a/c).
+
+Contracts under test:
+
+* the lifecycle event stream: one ``serve_event`` record per transition
+  in order (``submit → admit → prefill_chunk*k → first_token → decode →
+  finish``) with queue wait, chunk count, blocks held and per-phase
+  durations — schema-valid end to end through a REAL engine serve;
+* ``serve_window`` records: periodic on the serve clock, carrying the
+  sliding-window quantiles / queue / occupancy / pool state and the
+  ``serve_anomaly`` section, validator-clean, SKIP-honest;
+* the anomaly layer in isolation (scripted inputs, no engine):
+  straggler decode steps vs the rolling median, queue-buildup and
+  SLO-burn flags, free-list leak accounting;
+* the zero-recompile contract WITH telemetry attached (both jit caches
+  stay at 1 — the acceptance witness) and the measured overhead: the
+  per-step hook cost is under 1% of a measured serve step;
+* ``monitor report --serve-timeline`` renders the lifecycle + window
+  trail; ``tools/validate_metrics.py --serve-window`` forced dispatch
+  and content dispatch on the new kinds (drift tests).
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax.random as jr
+import numpy as np
+import pytest
+
+from apex_tpu import monitor
+from apex_tpu.models import GPTConfig, GPTModel
+from apex_tpu.monitor import report as monitor_report
+from apex_tpu.serving import (
+    BlockAllocator,
+    Request,
+    Scheduler,
+    ServeTelemetry,
+    ServingEngine,
+)
+
+K = jr.PRNGKey(23)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = GPTConfig(vocab_size=97, max_seq_len=128, hidden_size=32,
+                    num_layers=2, num_heads=4, num_kv_heads=2,
+                    attention_impl="flash", remat=False, dropout=0.0)
+    model = GPTModel(cfg)
+    return model, model.init(K)
+
+
+def _serve_with_stream(tmp_path, tiny, reqs, *, window_s=0.0, name="ev",
+                       **tel_kw):
+    """Run a real serve with monitoring on; returns (records, tel,
+    engine, scheduler)."""
+    model, params = tiny
+    path = tmp_path / f"{name}.jsonl"
+    monitor.enable(str(path))
+    try:
+        eng = ServingEngine(model, num_slots=2, block_size=8,
+                            prefill_chunk=16, max_seq_len=64)
+        tel = ServeTelemetry(slots=2, window_s=window_s, **tel_kw)
+        sched = eng.make_scheduler()
+        done = eng.serve(params, reqs, scheduler=sched, telemetry=tel)
+        assert len(done) == len(reqs)
+    finally:
+        monitor.disable()
+    lines = path.read_text().splitlines()
+    assert monitor.validate_jsonl(lines) == []
+    return [json.loads(ln) for ln in lines], tel, eng, sched
+
+
+class TestLifecycleStream:
+    def test_event_sequence_and_payloads(self, tmp_path, tiny):
+        """One request, prompt long enough for 2 chunks: the stream
+        holds the full transition sequence in order with the right
+        payload fields, and every record passes the schema."""
+        prompt = np.asarray(jr.randint(jr.fold_in(K, 1), (20,), 0, 97),
+                            np.int32)
+        reqs = [Request(rid=7, prompt=prompt, max_new_tokens=4)]
+        records, tel, eng, _ = _serve_with_stream(tmp_path, tiny, reqs)
+        ev = [r for r in records if r.get("kind") == "serve_event"
+              and r.get("rid") == 7]
+        phases = [r["phase"] for r in ev]
+        assert phases == ["submit", "admit", "prefill_chunk",
+                          "prefill_chunk", "first_token", "decode",
+                          "finish"]
+        by = {r["phase"]: r for r in ev}
+        assert by["submit"]["prompt_len"] == 20
+        assert by["submit"]["max_new_tokens"] == 4
+        assert by["admit"]["queue_wait_ms"] >= 0
+        assert by["admit"]["slot"] in (0, 1)
+        # chunk indices + blocks held grow with the live frontier
+        chunks = [r for r in ev if r["phase"] == "prefill_chunk"]
+        assert [c["chunk"] for c in chunks] == [0, 1]
+        assert chunks[0]["dur_ms"] > 0
+        assert chunks[-1]["blocks_held"] >= chunks[0]["blocks_held"] >= 1
+        ft = by["first_token"]
+        assert ft["chunks"] == 2 and ft["ttft_ms"] > 0
+        assert ft["prefill_ms"] == pytest.approx(
+            sum(c["dur_ms"] for c in chunks), abs=0.01)
+        fin = by["finish"]
+        assert fin["tokens"] == 4
+        assert fin["decode_ms"] >= 0 and fin["total_ms"] >= fin["decode_ms"]
+        # transitions are ordered on the serve clock and step-stamped
+        at = [r["at_s"] for r in ev]
+        assert at == sorted(at)
+        assert all("step" in r for r in ev
+                   if r["phase"] not in ("submit", "admit"))
+        # cumulative histograms fed: 1 TTFT + 3 inter-token gaps
+        assert tel.ttft_ms.count == 1
+        assert tel.itl_ms.count == 3
+
+    def test_queue_wait_covers_held_admission(self, tmp_path, tiny):
+        """Three requests onto 2 slots: the third's admit event carries
+        the wait it actually spent queued, and the admission-blocked-by
+        slots counter saw the pressure."""
+        rng = np.random.default_rng(0)
+        reqs = [Request(rid=i, prompt=np.asarray(
+            rng.integers(0, 97, 12), np.int32), max_new_tokens=6)
+            for i in range(3)]
+        records, tel, _, _ = _serve_with_stream(tmp_path, tiny, reqs)
+        admits = {r["rid"]: r for r in records
+                  if r.get("kind") == "serve_event"
+                  and r.get("phase") == "admit"}
+        assert set(admits) == {0, 1, 2}
+        assert admits[2]["queue_wait_ms"] > admits[0]["queue_wait_ms"]
+        assert tel.admission_blocked_slots > 0
+        assert tel.queue_peak >= 1
+
+
+class TestServeWindows:
+    def test_windows_emit_and_validate(self, tmp_path, tiny):
+        """A tiny window period forces several serve_window records:
+        each is schema-valid, carries the anomaly section, and the
+        occupancy/pool numbers are consistent with the engine."""
+        rng = np.random.default_rng(1)
+        reqs = [Request(rid=i, prompt=np.asarray(
+            rng.integers(0, 97, 10), np.int32), max_new_tokens=8)
+            for i in range(4)]
+        records, tel, eng, sched = _serve_with_stream(
+            tmp_path, tiny, reqs, window_s=1e-4, name="win")
+        wins = [r for r in records if r.get("kind") == "serve_window"]
+        assert len(wins) >= 2
+        assert tel.windows_emitted == len(wins)
+        for w in wins:
+            assert w["status"] == "OK"
+            assert 0 <= w["active_slots"] <= 2 == w["slots"]
+            assert w["blocks_high_water"] <= eng.num_blocks - 1
+            anom = w["serve_anomaly"]
+            assert anom["leaked_blocks"] == 0
+            assert "free_list_frag_pct" in anom
+            # at_s: serve-clock window end, same base as request rows,
+            # and consistent with the window length
+            assert w["at_s"] >= w["window_s"] > 0
+        # the first window's clock was primed BEFORE the first work:
+        # its span covers everything from serve start, so summing
+        # window token counts over window seconds can never exceed the
+        # run's true rate by construction
+        assert wins[0]["at_s"] == pytest.approx(wins[0]["window_s"],
+                                                rel=0.5)
+        # the windows ride the same stream as the lifecycle records —
+        # the whole file already passed validate_jsonl in the helper
+
+    def test_skip_windows_carry_reason(self, tmp_path, tiny):
+        reqs = [Request(rid=0, prompt=np.zeros(8, np.int32),
+                        max_new_tokens=6)]
+        records, _, _, _ = _serve_with_stream(
+            tmp_path, tiny, reqs, window_s=1e-4, name="skipwin",
+            status="SKIP", reason="cpu harness run")
+        wins = [r for r in records if r.get("kind") == "serve_window"]
+        assert wins and all(w["status"] == "SKIP"
+                            and w["reason"] == "cpu harness run"
+                            for w in wins)
+
+    def test_telemetry_requires_skip_reason(self):
+        with pytest.raises(ValueError, match="reason"):
+            ServeTelemetry(slots=2, status="SKIP")
+        with pytest.raises(ValueError, match="OK|SKIP"):
+            ServeTelemetry(slots=2, status="MAYBE")
+
+
+class _FakeSched:
+    """Just enough Scheduler surface for scripted window/anomaly tests."""
+
+    def __init__(self, waiting=0, active=0, allocator=None):
+        self.num_waiting = waiting
+        self.num_active = active
+        self.allocator = allocator or BlockAllocator(8)
+
+    def num_queued(self, now):
+        return self.num_waiting
+
+
+class TestAnomalyLayer:
+    def test_straggler_against_rolling_median(self):
+        tel = ServeTelemetry(slots=4, window_s=0.0, straggler_ratio=3.0,
+                             straggler_window=8)
+        for i in range(8):  # fill the rolling window at ~1 ms
+            tel.on_decode_step(0.001, 4, i, i * 0.001)
+        assert tel.straggler_steps == 0
+        tel.on_decode_step(0.0045, 4, 8, 0.009)  # 4.5x the median
+        assert tel.straggler_steps == 1
+        assert tel.straggler_last_ratio == pytest.approx(4.5, rel=0.01)
+        tel.on_decode_step(0.001, 4, 9, 0.010)  # back to normal
+        assert tel.straggler_steps == 1
+        # the median window absorbs a LEVEL SHIFT: after enough slow
+        # steps they stop being anomalies (that is the point of a
+        # rolling baseline)
+        for i in range(10, 30):
+            tel.on_decode_step(0.0045, 4, i, i * 0.001)
+        before = tel.straggler_steps
+        tel.on_decode_step(0.0045, 4, 30, 0.031)
+        assert tel.straggler_steps == before
+
+    def test_slo_burn_needs_sustained_breach(self):
+        tel = ServeTelemetry(slots=4, window_s=0.0, slo_ttft_ms=100.0,
+                             slo_burn_count=3)
+        req = Request(rid=0, prompt=np.zeros(4, np.int32),
+                      max_new_tokens=2)
+
+        def first_token(rid, ttft_s):
+            r = Request(rid=rid, prompt=req.prompt, max_new_tokens=2)
+            tel.on_submit(r, 0.0)
+            tel.on_first_token(r, 0, 1, 0, ttft_s)
+
+        first_token(0, 0.25)   # over, run=1
+        first_token(1, 0.02)   # under: run resets
+        first_token(2, 0.25)
+        first_token(3, 0.25)
+        assert not tel.slo_burn and tel.ttft_over_slo == 3
+        first_token(4, 0.25)   # third consecutive → burn
+        assert tel.slo_burn
+
+    def test_queue_buildup_flag(self):
+        tel = ServeTelemetry(slots=2, window_s=1e-9)
+        for i, depth in enumerate([1, 2, 4, 7]):
+            tel.maybe_window(float(i + 1), _FakeSched(waiting=depth))
+        assert tel.queue_buildup
+        tel.maybe_window(10.0, _FakeSched(waiting=0))
+        assert not tel.queue_buildup
+        assert tel.queue_peak == 7
+
+    def test_leak_detection_when_idle(self):
+        alloc = BlockAllocator(8)
+        alloc.allocate(3)  # held while NOTHING is active → leak
+        tel = ServeTelemetry(slots=2, window_s=1e-9)
+        tel.maybe_window(1.0, _FakeSched(waiting=0, active=0,
+                                         allocator=alloc))
+        tel.maybe_window(2.0, _FakeSched(waiting=0, active=0,
+                                         allocator=alloc))
+        assert tel.leaked_blocks == 3
+        anom = tel.anomaly_section(alloc)
+        assert anom["leaked_blocks"] == 3
+
+    def test_queue_depth_ignores_unarrived_replay_tail(self):
+        """Arrival replay submits the whole trace upfront with future
+        arrival_s: queue telemetry must count only ARRIVED waiters,
+        not saturate at the trace length (review finding)."""
+        s = Scheduler(num_slots=1, block_size=4, max_blocks_per_slot=8,
+                      allocator=BlockAllocator(40), prefill_chunk=4)
+        for i in range(5):
+            s.submit(Request(rid=i, prompt=np.zeros(4, np.int32),
+                             max_new_tokens=2, arrival_s=float(i)))
+        assert s.num_waiting == 5          # the raw replay tail
+        assert s.num_queued(0.0) == 1      # only rid 0 has arrived
+        assert s.num_queued(2.5) == 3
+        tel = ServeTelemetry(slots=1, window_s=0.0)
+        tel.maybe_window(0.0, s)
+        assert tel.queue_peak == 1         # not 5
+
+    def test_finish_path_leak_reaches_the_final_record(self):
+        """The canonical leak — the finish path stops freeing blocks —
+        must surface in final_fields even though the in-loop idle check
+        rarely lands on a window edge (review finding): every request
+        completed, so blocks still live ARE the leak."""
+        alloc = BlockAllocator(10)
+        alloc.allocate(4)  # what a broken _finish would leave behind
+        tel = ServeTelemetry(slots=2, window_s=0.0)
+        fields = tel.final_fields(alloc)
+        assert fields["serve_anomaly"]["leaked_blocks"] == 4
+        assert tel.leaked_blocks == 4
+        # and a clean allocator reports clean
+        tel2 = ServeTelemetry(slots=2, window_s=0.0)
+        assert tel2.final_fields(
+            BlockAllocator(10))["serve_anomaly"]["leaked_blocks"] == 0
+
+    def test_counter_drift_is_a_leak(self):
+        alloc = BlockAllocator(8)
+        ids = alloc.allocate(2)
+        alloc._live.discard(ids[0])  # corrupt behind the API's back
+        assert alloc.leaked == 1
+        tel = ServeTelemetry(slots=2, window_s=1e-9)
+        tel.maybe_window(1.0, _FakeSched(active=1, allocator=alloc))
+        tel.maybe_window(2.0, _FakeSched(active=1, allocator=alloc))
+        assert tel.leaked_blocks == 1
+
+
+class TestEngineContracts:
+    def test_jit_caches_stay_one_with_telemetry(self, tmp_path, tiny):
+        """The acceptance witness: churn + full telemetry (events,
+        windows, histograms) and BOTH compiled programs still have
+        exactly one cache entry."""
+        rng = np.random.default_rng(3)
+        reqs = [Request(rid=i,
+                        prompt=np.asarray(rng.integers(
+                            0, 97, rng.integers(1, 30)), np.int32),
+                        max_new_tokens=int(rng.integers(1, 10)))
+                for i in range(6)]
+        records, tel, eng, sched = _serve_with_stream(
+            tmp_path, tiny, reqs, window_s=1e-4, name="churn")
+        assert eng.prefill_chunk._cache_size() == 1
+        assert eng.decode_step._cache_size() == 1
+        # every request traced its full lifecycle and the pool is clean
+        fins = [r for r in records if r.get("kind") == "serve_event"
+                and r.get("phase") == "finish"]
+        assert {r["rid"] for r in fins} == set(range(6))
+        assert sched.allocator.leaked == 0
+        assert tel.finished == 6
+
+    def test_per_step_overhead_under_one_percent(self, tiny):
+        """The <1%-of-a-serve-step budget, measured: the per-step hook
+        set (one on_decode_step + one observe_itl per live slot +
+        maybe_window) costs well under 1% of a measured decode step —
+        even on the CPU harness where steps are ~1000x faster than the
+        flagship TPU config."""
+        model, params = tiny
+        eng = ServingEngine(model, num_slots=2, block_size=8,
+                            prefill_chunk=16, max_seq_len=64)
+        # measure a warm serve step (no telemetry, no monitor)
+        reqs = [Request(rid=0, prompt=np.zeros(8, np.int32),
+                        max_new_tokens=24)]
+        eng.serve(params, reqs)  # warm both programs
+        t0 = time.perf_counter()
+        eng.serve(params, [Request(rid=1, prompt=np.zeros(8, np.int32),
+                                   max_new_tokens=24)])
+        step_s = (time.perf_counter() - t0) / 25  # 24 decode + prefill
+        # measure the steady per-step hook cost (no sink: the histogram
+        # + detector math that runs every step; lifecycle emits happen
+        # once per request boundary, not per step)
+        tel = ServeTelemetry(slots=2, window_s=0.5)
+        sched = _FakeSched(active=2)
+        n, passes = 1000, 3
+
+        def hook_pass(base):
+            t0 = time.perf_counter()
+            for i in range(base, base + n):
+                tel.observe_itl(0.001)
+                tel.observe_itl(0.001)
+                tel.on_decode_step(0.001, 2, i, i * 0.001)
+                tel.maybe_window(i * 0.001, sched)
+            return (time.perf_counter() - t0) / n
+
+        t_all0 = time.perf_counter()
+        hook_pass(0)  # warm the code paths
+        # min-of-passes, the bench's own convention: a descheduled
+        # burst on the shared CPU harness must not fail the budget
+        per_step = min(hook_pass((p + 1) * n) for p in range(passes))
+        assert per_step < 0.01 * step_s, (
+            f"per-step telemetry {per_step*1e6:.1f}us is not <1% of a "
+            f"measured {step_s*1e3:.2f}ms serve step")
+        # and the tracker's own ledger agrees with the external clock
+        assert tel.overhead_s <= (time.perf_counter() - t_all0) * 1.05
+
+    def test_telemetry_false_suppresses_auto_attach(self, tmp_path,
+                                                    tiny):
+        """telemetry=False opts a timed baseline run out of the
+        auto-attached tracker (no lifecycle records land on the
+        stream), while a plain run on the same enabled registry gets
+        traces for free."""
+        model, params = tiny
+        path = tmp_path / "optout.jsonl"
+        monitor.enable(str(path))
+        try:
+            eng = ServingEngine(model, num_slots=2, block_size=8,
+                                prefill_chunk=8, max_seq_len=64)
+            eng.serve(params, [Request(rid=0,
+                                       prompt=np.zeros(5, np.int32),
+                                       max_new_tokens=3)],
+                      telemetry=False)
+            quiet = [json.loads(ln) for ln in
+                     path.read_text().splitlines()]
+            assert not any(r.get("kind") == "serve_event" for r in quiet)
+            eng.serve(params, [Request(rid=1,
+                                       prompt=np.zeros(5, np.int32),
+                                       max_new_tokens=3)])
+            traced = [json.loads(ln) for ln in
+                      path.read_text().splitlines()]
+            assert any(r.get("kind") == "serve_event" and r["rid"] == 1
+                       for r in traced)
+            # a REUSED scheduler with a stale tracker attached is
+            # detached too (review finding: scheduler-side hooks must
+            # not keep firing into the old tracker)
+            tel = ServeTelemetry(slots=2, window_s=0.0)
+            sched = eng.make_scheduler()
+            eng.serve(params, [Request(rid=2,
+                                       prompt=np.zeros(5, np.int32),
+                                       max_new_tokens=3)],
+                      scheduler=sched, telemetry=tel)
+            tokens_before = tel.tokens
+            eng.serve(params, [Request(rid=3,
+                                       prompt=np.zeros(5, np.int32),
+                                       max_new_tokens=3)],
+                      scheduler=sched, telemetry=False)
+            assert sched.telemetry is None
+            assert tel.tokens == tokens_before  # no cross-contamination
+        finally:
+            monitor.disable()
+
+    def test_scheduler_attached_tracker_is_adopted(self, tmp_path, tiny):
+        """A tracker attached at Scheduler construction is adopted
+        fully by serve() — engine-side hooks and windows included, not
+        shadowed by an auto-attached one (review finding)."""
+        model, params = tiny
+        path = tmp_path / "adopt.jsonl"
+        monitor.enable(str(path))
+        try:
+            eng = ServingEngine(model, num_slots=2, block_size=8,
+                                prefill_chunk=8, max_seq_len=64)
+            tel = ServeTelemetry(slots=2, window_s=1e-4)
+            sched = Scheduler(
+                num_slots=2, block_size=8,
+                max_blocks_per_slot=eng.max_blocks_per_slot,
+                allocator=BlockAllocator(eng.num_blocks),
+                prefill_chunk=8, telemetry=tel)
+            eng.serve(params, [Request(rid=0,
+                                       prompt=np.zeros(6, np.int32),
+                                       max_new_tokens=4)],
+                      scheduler=sched)
+            assert sched.telemetry is tel  # not replaced
+            # engine-side wiring reached the caller's tracker
+            assert tel.decode_steps > 0 and tel.windows_emitted >= 1
+            assert tel.ttft_ms.count == 1
+            ev = [json.loads(ln) for ln in path.read_text().splitlines()
+                  if '"serve_event"' in ln]
+            assert any(r["phase"] == "submit" for r in ev)
+        finally:
+            monitor.disable()
+
+    def test_monitoring_off_serve_is_unchanged(self, tiny):
+        """No registry, no telemetry arg: serve runs exactly as before
+        (hooks are a single is-None test) and emits nothing."""
+        model, params = tiny
+        assert not monitor.enabled()
+        eng = ServingEngine(model, num_slots=2, block_size=8,
+                            prefill_chunk=8, max_seq_len=64)
+        done = eng.serve(params, [Request(
+            rid=0, prompt=np.zeros(5, np.int32), max_new_tokens=3)])
+        assert len(done) == 1 and len(done[0].tokens) == 3
+
+
+class TestReportAndValidator:
+    def _stream(self, tmp_path, tiny):
+        rng = np.random.default_rng(5)
+        reqs = [Request(rid=i, prompt=np.asarray(
+            rng.integers(0, 97, 12), np.int32), max_new_tokens=5)
+            for i in range(3)]
+        records, _, _, _ = _serve_with_stream(
+            tmp_path, tiny, reqs, window_s=1e-4, name="rep")
+        path = tmp_path / "rep.jsonl"
+        return path, records
+
+    def test_serve_timeline_rows_and_rendering(self, tmp_path, tiny):
+        path, records = self._stream(tmp_path, tiny)
+        tl = monitor_report.serve_timeline(records)
+        assert {r["rid"] for r in tl["requests"]} == {0, 1, 2}
+        row = tl["requests"][0]
+        assert row["outcome"] == "finish" and row["tokens"] == 5
+        assert row["ttft_ms"] > 0 and row["chunks"] == 1
+        assert len(tl["windows"]) >= 1
+        text = monitor_report.format_serve_timeline(tl)
+        assert "rid    0" in text and "ttft" in text and "window" in text
+        # the CLI flag end to end (in-process main)
+        rc = monitor_report.main([
+            "report", str(path), "--serve-timeline"])
+        assert rc == 0
+
+    def test_serve_timeline_folds_last_run_only(self):
+        """Appended multi-run streams (rids restart at 0 per run) fold
+        the LAST run only — the same meta-split rule aggregate applies
+        (review finding: cross-run folding garbles lifecycle rows)."""
+        reg = monitor.MetricsRegistry()
+
+        def run(tokens):
+            return [reg.emit_meta(device_kind="cpu"),
+                    reg.emit("serve_event", rid=0, phase="submit",
+                             at_s=0.0),
+                    reg.emit("serve_event", rid=0, phase="finish",
+                             at_s=1.0, tokens=tokens, slot=0, step=3)]
+
+        records = run(5) + run(9)
+        tl = monitor_report.serve_timeline(records)
+        assert len(tl["requests"]) == 1
+        assert tl["requests"][0]["tokens"] == 9  # the LAST run's value
+
+    def test_format_survives_minimal_window_and_partial_rows(self):
+        """A schema-valid serve_window with only the required fields
+        (no at_s/t_s/queue/occupancy) and an in-flight request row must
+        render with '-' placeholders, never crash or print 'None'
+        (review finding)."""
+        records = [
+            {"kind": "serve_event", "schema": 1, "rid": 0,
+             "phase": "submit", "at_s": 0.0},
+            {"kind": "serve_window", "schema": 1, "status": "SKIP",
+             "reason": "x", "window_s": 0.5,
+             "serve_anomaly": {"straggler_steps": 0,
+                               "queue_buildup": False,
+                               "slo_burn": False, "leaked_blocks": 0}},
+        ]
+        tl = monitor_report.serve_timeline(records)
+        text = monitor_report.format_serve_timeline(tl)
+        assert "in-flight" in text and "None" not in text
+        assert "queue -" in text and "occ -%" in text
+
+    def test_serve_timeline_cli_refuses_bare_stream(self, tmp_path,
+                                                    capsys):
+        path = tmp_path / "bare.jsonl"
+        reg = monitor.MetricsRegistry()
+        path.write_text(json.dumps(reg.emit_meta(device_kind="cpu"))
+                        + "\n")
+        rc = monitor_report.main(["report", str(path),
+                                  "--serve-timeline"])
+        assert rc == 2
+        assert "no serve_event" in capsys.readouterr().err
+
+    def test_aggregate_carries_window_summary_and_anomalies(
+            self, tmp_path, tiny):
+        path, records = self._stream(tmp_path, tiny)
+        summary = monitor_report.aggregate(records)
+        sw = summary["serve_window"]
+        assert sw["windows"] >= 1
+        assert sw["serve_anomaly"]["leaked_blocks"] == 0
+        rendered = monitor_report.render(summary)
+        assert "serve-win" in rendered
+
+    def test_validator_serve_window_dispatch(self, tmp_path, capsys):
+        """--serve-window forced dispatch + content dispatch drift
+        tests, mirroring the --serve contract."""
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        import validate_metrics
+        reg = monitor.MetricsRegistry()
+        anom = dict(straggler_steps=0, queue_buildup=False,
+                    slo_burn=False, leaked_blocks=0)
+        rec = reg.emit_serve_window(
+            "SKIP", reason="no TPU", window_s=0.5, queue_depth=0,
+            serve_anomaly=anom)
+        good = tmp_path / "win.jsonl"
+        good.write_text(json.dumps(rec) + "\n")
+        assert validate_metrics.main([str(good)]) == 0
+        assert validate_metrics.main(["--serve-window", str(good)]) == 0
+        capsys.readouterr()
+        # a stream without a serve_window record fails forced dispatch
+        other = tmp_path / "other.jsonl"
+        other.write_text(json.dumps(
+            reg.emit_serve("SKIP", reason="no TPU")) + "\n")
+        assert validate_metrics.main(["--serve-window", str(other)]) == 1
+        assert "serve_window" in capsys.readouterr().err
+        # content dispatch catches a malformed window (nan inside OK)
+        bad = tmp_path / "bad.jsonl"
+        bad_rec = dict(rec, status="OK", tokens_per_s=float("nan"))
+        bad.write_text(json.dumps(bad_rec).replace("NaN", '"nan"')
+                       + "\n")
+        assert validate_metrics.main([str(bad)]) == 1
+        # an anomaly section with junk keys is refused (schema pins it)
+        weird = dict(rec, serve_anomaly=dict(anom, surprise=1))
+        assert monitor.validate(weird) != []
+
+    def test_emitter_honesty_on_windows(self):
+        reg = monitor.MetricsRegistry()
+        with pytest.raises(ValueError, match="non-finite"):
+            reg.emit_serve_window(
+                "OK", window_s=0.5, tokens_per_s=float("nan"),
+                serve_anomaly=dict(straggler_steps=0, queue_buildup=False,
+                                   slo_burn=False, leaked_blocks=0))
+        with pytest.raises(ValueError, match="reason"):
+            reg.emit_serve_window("SKIP")
+
+
+class TestSchedulerTelemetrySeam:
+    def test_blocked_by_blocks_vs_slots(self):
+        """The admission-pressure split: a pool too tight counts
+        'blocks', a full slot array counts 'slots'."""
+        tel = ServeTelemetry(slots=2, window_s=0.0)
+        # pool pressure: 5 allocatable, each request worst-cases 3
+        s = Scheduler(num_slots=2, block_size=4, max_blocks_per_slot=16,
+                      allocator=BlockAllocator(6), prefill_chunk=8,
+                      telemetry=tel)
+        for i in range(2):
+            s.submit(Request(rid=i, prompt=np.zeros(8, np.int32),
+                             max_new_tokens=4))
+        assert s.admit(now=0.0) == [0]
+        assert tel.admission_blocked_blocks == 1
+        assert tel.admission_blocked_slots == 0
+        # slot pressure: plenty of pool, no free slot
+        tel2 = ServeTelemetry(slots=1, window_s=0.0)
+        s2 = Scheduler(num_slots=1, block_size=4, max_blocks_per_slot=16,
+                       allocator=BlockAllocator(40), prefill_chunk=8,
+                       telemetry=tel2)
+        for i in range(2):
+            s2.submit(Request(rid=i, prompt=np.zeros(8, np.int32),
+                              max_new_tokens=4))
+        s2.admit(now=0.0)
+        s2.admit(now=0.0)
+        assert tel2.admission_blocked_slots >= 1
+        assert tel2.admission_blocked_blocks == 0

@@ -104,6 +104,76 @@ class TestBlockAllocator:
         assert [blocks_needed(n, 8) for n in (1, 8, 9, 16, 17)] \
             == [1, 1, 2, 2, 3]
 
+    # --- ISSUE 10 accounting: leak counter, high-water, fragmentation -----
+
+    def test_leak_counter_zero_across_churn_cycles(self):
+        """N scripted admit/evict cycles of mixed sizes: the leak
+        counter is EXACTLY zero throughout and at the end, and the
+        lifetime alloc/free totals balance."""
+        import numpy as np
+        a = BlockAllocator(16)
+        rng = np.random.default_rng(7)
+        for _ in range(50):
+            n = int(rng.integers(1, 6))
+            ids = a.allocate(n)
+            assert a.leaked == 0
+            a.check_accounting()
+            a.free(ids)
+            assert a.leaked == 0
+        assert a.alloc_total == a.free_total > 0
+        assert a.num_live == 0 and a.num_free == 15
+        a.check_accounting()
+
+    def test_high_water_is_monotone(self):
+        import numpy as np
+        a = BlockAllocator(20)
+        rng = np.random.default_rng(8)
+        held, seen = [], []
+        for _ in range(40):
+            if held and rng.random() < 0.5:
+                a.free([held.pop()])
+            else:
+                if a.num_free:
+                    held.extend(a.allocate(1))
+            seen.append(a.high_water)
+            assert a.high_water >= a.num_live
+        assert seen == sorted(seen), "high_water regressed"
+        assert a.high_water == max(
+            seen), "high_water is not the running max"
+
+    def test_double_free_still_loud_with_counters(self):
+        """The new counters must not swallow the loud failure modes —
+        and a refused free must not corrupt the ledger."""
+        a = BlockAllocator(6)
+        ids = a.allocate(2)
+        a.free(ids)
+        with pytest.raises(ValueError, match="double free"):
+            a.free([ids[0]])
+        with pytest.raises(ValueError, match="dead block"):
+            a.free([DEAD_BLOCK])
+        assert a.alloc_total == 2 and a.free_total == 2
+        assert a.leaked == 0
+        a.check_accounting()
+
+    def test_accounting_check_is_loud_on_corruption(self):
+        a = BlockAllocator(6)
+        ids = a.allocate(3)
+        a.check_accounting()
+        a._live.discard(ids[0])  # cross-wire behind the API
+        assert a.leaked == 1
+        with pytest.raises(RuntimeError, match="accounting broken"):
+            a.check_accounting()
+
+    def test_fragmentation_accounting(self):
+        a = BlockAllocator(9)
+        assert a.fragmentation_pct() == 0.0  # fresh pool: one run
+        ids = a.allocate(8)
+        assert a.fragmentation_pct() == 0.0  # empty free list
+        a.free([ids[1], ids[4], ids[6]])     # 3 scattered singletons
+        assert a.fragmentation_pct() == pytest.approx(100 * (1 - 1 / 3))
+        a.free([i for i in ids if i not in (ids[1], ids[4], ids[6])])
+        assert a.fragmentation_pct() == 0.0  # whole pool back: one run
+
 
 class TestScheduler:
     def _sched(self, num_blocks=20, num_slots=2, block=4, chunk=8):
@@ -185,6 +255,17 @@ class TestScheduler:
         with pytest.raises(ValueError, match="never be admitted"):
             tight.submit(Request(rid=0, prompt=np.zeros(33, np.int32),
                                  max_new_tokens=8))  # 5 blocks > 3
+        # the error names the knob AND the rounding recipe (ISSUE 10):
+        # ceil((prompt + max_new - 1)/block_size) and the num_blocks
+        # floor that would make the request admissible
+        with pytest.raises(ValueError) as ei:
+            tight.submit(Request(rid=3, prompt=np.zeros(33, np.int32),
+                                 max_new_tokens=8))
+        msg = str(ei.value)
+        for needle in ("num_blocks=4", "ceil((prompt 33 + max_new_tokens "
+                       "8 - 1) / block_size 8)", "needs 5 blocks",
+                       "Raise num_blocks to >= 6"):
+            assert needle in msg, f"submit error dropped {needle!r}: {msg}"
         with pytest.raises(ValueError, match=">= 1"):
             s.submit(Request(rid=0, prompt=np.zeros(4, np.int32),
                              max_new_tokens=0))

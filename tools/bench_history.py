@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Regression gate: compare a fresh bench/serve artifact against the
+checked-in ``BENCH_r*.json`` trajectory.
+
+Usage::
+
+    python tools/bench_history.py fresh.json
+    python tools/bench_history.py fresh.json --history 'BENCH_r*.json'
+    python tools/bench_history.py fresh.json --tolerance-pct 5
+    python tools/bench_history.py --schema-only fresh.json
+
+The driver stores one ``BENCH_r<N>.json`` envelope per PR (``{"parsed":
+{"metric": ..., "value": ..., "spread_pct": ...}}``). This tool turns
+that trajectory into a gate a CI leg can run after a fresh bench:
+
+* **Extraction** understands every throughput artifact the repo emits:
+  bench result objects (``{"metric", "value", ...}``), driver envelopes
+  (``{"parsed": {...}}``), and monitor records with a throughput field
+  (``serve`` / ``decode`` / ``tp_overlap`` / ``pipeline`` →
+  ``tokens_per_s``). A ``status: "SKIP"`` record carries no claim and
+  is *skipped* by the gate (exit 0 with a SKIP line) — an off-TPU
+  smoke can never "regress".
+* **Comparison** is against the LATEST history artifact whose metric
+  name matches the fresh one (the trajectory's newest point — the
+  number the README quotes). The allowance is
+  ``tolerance_pct + spread_pct(history) + spread_pct(fresh)``:
+  run-to-run noise measured by the artifacts themselves widens the
+  band, a silent slowdown beyond it fails.
+* **Verdict** is one line — ``OK``, ``SKIP`` or ``REGRESSION`` with
+  the percentage delta vs the allowance — and the exit code: 0 clean
+  or nothing to compare, 1 regression, 2 usage/parse errors.
+
+``--schema-only`` validates the fresh artifact and the history through
+``apex_tpu.monitor.schema`` without comparing (the off-TPU tier-1
+smoke: the gate's plumbing is exercised on every run even where a
+throughput claim would be dishonest).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from apex_tpu.monitor import schema  # noqa: E402
+
+# monitor-record kinds that carry a tokens_per_s throughput claim
+_THROUGHPUT_KINDS = ("serve", "decode", "tp_overlap", "pipeline")
+
+
+def extract(obj: Dict[str, Any], label: str = "artifact"
+            ) -> Optional[Tuple[str, float, float]]:
+    """``(metric_name, value, spread_pct)`` from one artifact object,
+    or None when it carries no throughput claim (SKIP records, meta).
+    Raises ValueError on a shape that should carry one but doesn't."""
+    if not isinstance(obj, dict):
+        raise ValueError(f"{label}: expected a JSON object")
+    if isinstance(obj.get("parsed"), dict):  # driver envelope
+        return extract(obj["parsed"], label)
+    if "metric" in obj and "value" in obj:
+        spread = obj.get("spread_pct")
+        return (str(obj["metric"]), float(obj["value"]),
+                float(spread) if isinstance(spread, (int, float)) else 0.0)
+    kind = obj.get("kind")
+    if kind in _THROUGHPUT_KINDS:
+        if obj.get("status") == "SKIP":
+            return None  # a SKIP record claims nothing to regress from
+        v = obj.get("tokens_per_s")
+        if not isinstance(v, (int, float)):
+            raise ValueError(
+                f"{label}: OK {kind} record has no numeric tokens_per_s")
+        spread = obj.get("spread_pct")
+        return (f"{kind}_tokens_per_s", float(v),
+                float(spread) if isinstance(spread, (int, float)) else 0.0)
+    if kind is not None:
+        return None  # other monitor records carry no headline number
+    raise ValueError(
+        f"{label}: unrecognized artifact shape (no metric/parsed/kind)")
+
+
+def load_json(path: str) -> Any:
+    with open(path) as fh:
+        text = fh.read()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        # a JSONL stream: prefer the LAST record that carries a claim
+        # shape (bench prints its record as the final line, but a
+        # telemetry stream may trail with windows/meta); fall back to
+        # the last parseable record
+        last = claimed = None
+        for lineno, line in enumerate(text.splitlines(), 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{lineno}: invalid JSON: {e}")
+            last = obj
+            if isinstance(obj, dict) and (
+                    "metric" in obj
+                    or obj.get("kind") in _THROUGHPUT_KINDS):
+                claimed = obj
+        if last is None:
+            raise ValueError(f"{path}: empty file")
+        return claimed if claimed is not None else last
+
+
+def _history_order(path: str) -> Tuple[int, str]:
+    """Sort key putting BENCH_r2 before BENCH_r10 (numeric rounds)."""
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    return (int(m.group(1)) if m else -1, path)
+
+
+def collect_history(pattern: str, root: str) -> List[Tuple[str, str, float,
+                                                           float]]:
+    """[(path, metric, value, spread_pct)] for every history artifact
+    matching ``pattern`` that carries a claim, in trajectory order."""
+    rows = []
+    for path in sorted(glob.glob(os.path.join(root, pattern)),
+                       key=_history_order):
+        try:
+            got = extract(load_json(path), path)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"warning: skipping unreadable history {path}: {e}",
+                  file=sys.stderr)
+            continue
+        if got is not None:
+            rows.append((path, *got))
+    return rows
+
+
+def schema_problems(obj: Any, label: str) -> List[str]:
+    """Validate one artifact through the shared monitor schema (driver
+    envelopes unwrap; bench objects use BENCH_SCHEMA)."""
+    if isinstance(obj, dict) and isinstance(obj.get("parsed"), dict):
+        obj = obj["parsed"]
+    if isinstance(obj, dict) and "kind" in obj:
+        return [f"{label}: {e}" for e in schema.validate(obj)]
+    if isinstance(obj, dict) and "metric" in obj:
+        return [f"{label}: {e}"
+                for e in schema.validate(obj, schema.BENCH_SCHEMA)]
+    return [f"{label}: unrecognized artifact shape"]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python tools/bench_history.py",
+        description="compare a fresh bench/serve artifact against the "
+                    "BENCH_r*.json trajectory")
+    parser.add_argument("fresh", help="fresh artifact (bench JSON line, "
+                        "driver envelope, or monitor record/stream)")
+    parser.add_argument("--history", default="BENCH_r*.json",
+                        help="glob for the history trajectory, relative "
+                             "to --root (default: BENCH_r*.json)")
+    parser.add_argument("--root", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="repo root holding the history artifacts")
+    parser.add_argument("--tolerance-pct", type=float, default=3.0,
+                        help="base tolerance before the artifacts' own "
+                             "spread widens it (default 3%%)")
+    parser.add_argument("--schema-only", action="store_true",
+                        help="validate fresh + history shapes through "
+                             "the monitor schema; no comparison (the "
+                             "off-TPU tier-1 smoke)")
+    args = parser.parse_args(argv)
+
+    try:
+        fresh_obj = load_json(args.fresh)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: cannot read fresh artifact: {e}", file=sys.stderr)
+        return 2
+
+    if args.schema_only:
+        problems = schema_problems(fresh_obj, args.fresh)
+        for path in sorted(glob.glob(os.path.join(args.root, args.history)),
+                           key=_history_order):
+            try:
+                problems.extend(schema_problems(load_json(path), path))
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                # a truncated artifact is a diagnostic line, not a
+                # traceback — CI keys on exit 2 = broken artifact
+                problems.append(f"{path}: unreadable: {e}")
+        for p in problems:
+            print(p, file=sys.stderr)
+        if problems:
+            return 2
+        print(f"SCHEMA-ONLY OK: {args.fresh} + "
+              f"{len(glob.glob(os.path.join(args.root, args.history)))} "
+              f"history artifact(s) validate")
+        return 0
+
+    try:
+        fresh = extract(fresh_obj, args.fresh)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if fresh is None:
+        print(f"SKIP: {args.fresh} carries no throughput claim "
+              f"(SKIP record) — nothing to gate")
+        return 0
+    metric, value, fresh_spread = fresh
+
+    history = [row for row in collect_history(args.history, args.root)
+               if row[1] == metric]
+    if not history:
+        print(f"SKIP: no history artifact carries metric {metric!r} "
+              f"(glob {args.history}) — nothing to compare against")
+        return 0
+    ref_path, _, ref_value, ref_spread = history[-1]
+    allowed_pct = args.tolerance_pct + fresh_spread + ref_spread
+    delta_pct = 100.0 * (value - ref_value) / ref_value
+    if delta_pct < -allowed_pct:
+        print(f"REGRESSION {metric}: {value:g} vs "
+              f"{os.path.basename(ref_path)} {ref_value:g} "
+              f"({delta_pct:+.2f}% < allowed -{allowed_pct:.2f}% = "
+              f"tol {args.tolerance_pct:g} + spread "
+              f"{ref_spread:g}+{fresh_spread:g})")
+        return 1
+    print(f"OK {metric}: {value:g} vs {os.path.basename(ref_path)} "
+          f"{ref_value:g} ({delta_pct:+.2f}%, allowed "
+          f"-{allowed_pct:.2f}%) over {len(history)}-point trajectory")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,7 @@ Usage::
     python tools/validate_metrics.py --costdb costdb.json ...
     python tools/validate_metrics.py --profile profile.jsonl ...
     python tools/validate_metrics.py --serve serve.jsonl ...
+    python tools/validate_metrics.py --serve-window windows.jsonl ...
     python tools/validate_metrics.py --pipeline pipeline.jsonl ...
 
 Dispatch is by content, not extension:
@@ -41,14 +42,17 @@ Dispatch is by content, not extension:
 * ``profile`` records (``python bench.py --profile``: the step-anatomy
   leg), ``serve`` records (``python bench.py --serve``: the
   continuous-batching offered-load leg through the paged
-  ``apex_tpu.serving`` engine), ``pipeline`` records (``python bench.py
-  --pipeline``: the zero-bubble-vs-1f1b schedule leg), and ``costdb``
-  artifacts (``apex_tpu.prof.calibrate``) dispatch on ``kind`` like
-  every monitor record. ``--profile`` / ``--serve`` / ``--pipeline`` /
-  ``--costdb`` force EVERY listed file to be judged as that artifact
-  (same rationale as ``--lint-report``: an artifact that lost its
-  ``kind`` key must fail as a bad profile/serve/pipeline/costdb, not as
-  an unrecognized shape).
+  ``apex_tpu.serving`` engine), ``serve_event``/``serve_window``
+  records (the request-lifecycle and live-SLO telemetry of
+  ``apex_tpu.serving.telemetry``), ``pipeline`` records (``python
+  bench.py --pipeline``: the zero-bubble-vs-1f1b schedule leg), and
+  ``costdb`` artifacts (``apex_tpu.prof.calibrate``) dispatch on
+  ``kind`` like every monitor record. ``--profile`` / ``--serve`` /
+  ``--serve-window`` / ``--pipeline`` / ``--costdb`` force EVERY
+  listed file to be judged as that artifact (same rationale as
+  ``--lint-report``: an artifact that lost its ``kind`` key must fail
+  as a bad profile/serve/pipeline/costdb, not as an unrecognized
+  shape).
 
 Exit status 0 when every file is clean; 1 otherwise, with one problem per
 line on stderr. The logic lives in ``apex_tpu.monitor.schema`` so tests
@@ -177,13 +181,15 @@ def main(argv=None) -> int:
         force_kind = "costdb"
     elif "--profile" in argv:
         force_kind = "profile"
+    elif "--serve-window" in argv:
+        force_kind = "serve_window"
     elif "--serve" in argv:
         force_kind = "serve"
     elif "--pipeline" in argv:
         force_kind = "pipeline"
     argv = [a for a in argv
             if a not in ("--lint-report", "--costdb", "--profile",
-                         "--serve", "--pipeline")]
+                         "--serve", "--serve-window", "--pipeline")]
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
