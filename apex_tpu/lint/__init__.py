@@ -25,9 +25,19 @@ Rule families (catalogue with bad/good snippets: docs/api/lint.md):
 * **APX5xx** PRNG and precision discipline (dropout without a key,
   constant PRNG keys, bf16/fp32 cast mixing)
 
+Beyond the AST rules, ``python -m apex_tpu.lint --jaxpr`` checks **JXP
+contracts** over *traced programs* (``apex_tpu.lint.contracts`` /
+``jaxpr_check``): scan geometry (JXP1xx), donation honored at the pjit
+level (JXP2xx), forbidden aval shapes (JXP3xx), collective inventory —
+ppermute present, no full-width all_gather, collective-free regions
+(JXP4xx), and fp32 accumulation (JXP5xx) — against the registered
+flagship entrypoints (``apex_tpu.lint.entrypoints``), with the same
+walk also emitting the planner's ``static_cost`` artifact.
+
 Suppression: ``# apexlint: disable=APX101`` (comma-separated, or ``all``)
 on the flagged line; repo-wide intentional findings live in
-``tools/apexlint_baseline.json`` — every entry carries a ``reason``.
+``tools/apexlint_baseline.json`` — every entry carries a ``reason``
+(jaxpr findings baseline by ``(path="jaxpr:<entrypoint>", code)``).
 
 The lint package itself imports only the stdlib (``ast``/``json``) — the
 analysis cannot be confused by the jax version it vets. The
@@ -59,6 +69,18 @@ from apex_tpu.lint import (  # noqa: E402,F401
     rules_prng,
     rules_tracing,
 )
+
+# the jaxpr-level layer (`--jaxpr`): stdlib-only like the AST rules —
+# contracts/jaxpr_check walk duck-typed jaxpr objects; only
+# lint.entrypoints (imported lazily by the CLI) touches jax
+from apex_tpu.lint import contracts, jaxpr_check  # noqa: E402,F401
+from apex_tpu.lint.contracts import (  # noqa: F401
+    Contract,
+    ContractFinding,
+    assert_contracts,
+    check_jaxpr,
+)
+from apex_tpu.lint.jaxpr_check import static_cost  # noqa: F401
 
 
 def iter_rules():

@@ -5,6 +5,21 @@ Exit codes: 0 = clean (after suppressions/baseline), 1 = findings,
 (tests/test_lint.py::TestDogfoodGate) runs exactly this entry point over
 ``apex_tpu/`` and fails on non-zero.
 
+``--jaxpr`` switches from AST rules over source paths to JXP contracts
+over TRACED programs: every registered entrypoint
+(``apex_tpu.lint.entrypoints``; ``--entrypoint NAME`` to select) is
+traced with ``jax.make_jaxpr`` on the virtual CPU mesh (no device
+execution of the traced program) and judged against its declared
+contract set. Findings ride the same report/baseline machinery as AST
+findings, keyed ``(path="jaxpr:<entrypoint>", code)``. The same trace
+feeds the planner's static cost substrate: ``--static-cost FILE``
+writes the schema-validated ``kind:"static_cost"`` artifacts (JSONL,
+one per entrypoint; gated by ``tools/validate_metrics.py
+--static-cost``), and ``--costdb FILE`` prints the predicted-vs-
+calibrated table against a measured CostDB
+(``bench.py --profile --costdb``), flagging collectives the trace
+contains but the CostDB has never priced.
+
 The repo's committed baseline (``tools/apexlint_baseline.json`` next to
 the ``apex_tpu`` package) loads by default so a bare
 ``python -m apex_tpu.lint apex_tpu/`` judges the tree the way CI does;
@@ -52,6 +67,20 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="comma-separated code prefixes to skip")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalogue and exit")
+    p.add_argument("--jaxpr", action="store_true",
+                   help="check JXP contracts over the traced entrypoint "
+                        "programs instead of AST rules over source paths")
+    p.add_argument("--entrypoint", action="append", metavar="NAME",
+                   help="jaxpr mode: check only this registered "
+                        "entrypoint (repeatable; default: all)")
+    p.add_argument("--list-entrypoints", action="store_true",
+                   help="print the registered jaxpr entrypoints and exit")
+    p.add_argument("--static-cost", metavar="FILE", dest="static_cost",
+                   help="jaxpr mode: write the kind:'static_cost' "
+                        "artifacts (JSONL, one per entrypoint)")
+    p.add_argument("--costdb", metavar="FILE",
+                   help="jaxpr mode: print the predicted-vs-calibrated "
+                        "table against a measured CostDB artifact")
     return p
 
 
@@ -61,24 +90,9 @@ def _codes(arg):
     return [c.strip().upper() for c in arg.split(",") if c.strip()]
 
 
-def main(argv=None) -> int:
-    args = _build_parser().parse_args(argv)
-    if args.list_rules:
-        for r in lint.iter_rules():
-            print(f"{r.code}  {r.name}: {r.summary}")
-        return 0
-    if not args.paths:
-        print("error: no paths given (try `python -m apex_tpu.lint "
-              "apex_tpu/`)", file=sys.stderr)
-        return 2
-
-    try:
-        findings, stats = lint.lint_paths(
-            args.paths, select=_codes(args.select), ignore=_codes(args.ignore))
-    except (FileNotFoundError, OSError) as e:
-        print(f"error: {e}", file=sys.stderr)
-        return 2
-
+def _apply_baseline(args, findings):
+    """Shared baseline logic of the AST and jaxpr modes. Returns
+    ``(findings, baselined, unused)`` or an int error exit code."""
     baseline_path = args.baseline
     explicit = baseline_path is not None
     if baseline_path is None and not args.no_baseline:
@@ -95,19 +109,184 @@ def main(argv=None) -> int:
         findings, baselined, unused = lint.apply_baseline(findings, entries)
         if not explicit:
             unused = []  # partial runs legitimately miss default entries
+    return findings, baselined, unused
 
-    report = lint.build_report(findings, stats, baselined)
+
+def _emit_report(args, findings, stats, baselined, unused, report):
     if args.format == "json":
         print(json.dumps(report, indent=1))
     else:
         for f in findings:
             print(f.render())
+        noun = "entrypoint" if report.get("mode") == "jaxpr" else "file"
         print(f"{len(findings)} finding(s) in {stats['files_scanned']} "
-              f"file(s) ({stats['suppressed_inline']} inline-suppressed, "
+              f"{noun}(s) ({stats['suppressed_inline']} inline-suppressed, "
               f"{baselined} baselined)")
     for e in unused:
         print(f"warning: unused baseline entry {e['path']}:{e['code']} "
               f"({e['reason']}) — remove it", file=sys.stderr)
+
+
+def _prepare_virtual_devices():
+    """jaxpr mode traces shard_map programs over 4-wide meshes; the
+    virtual CPU mesh needs the host-platform device count forced BEFORE
+    the jax backend initializes (same pattern as bench.py). An already-
+    initialized backend (the in-process test harness, which forces 8
+    devices itself) is left alone, and an ambient JAX_PLATFORMS wins."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+
+
+def _format_diff_table(name: str, diff: dict) -> str:
+    lines = [f"static-cost vs CostDB — {name}:"]
+    header = (f"  {'key':<24} {'calls':>6} {'per-step':>12} "
+              f"{'measured rate':>14} {'pred ms':>8}  status")
+    lines.append(header)
+    for row in diff["rows"]:
+        amount = (f"{row['bytes']} B" if row["unit"] == "bytes"
+                  else f"{row['flops']:.3g} F")
+        if row["calibrated"]:
+            rate = row["rate"]
+            unit = "B/s" if row["unit"] == "bytes" else "F/s"
+            status = "calibrated"
+            pred = f"{row['predicted_ms']:.3g}"
+            rate_s = f"{rate:.3g} {unit}"
+        else:
+            status = "UNCALIBRATED (absent from CostDB)"
+            pred, rate_s = "-", "-"
+        lines.append(f"  {row['key']:<24} {row['calls']:>6} {amount:>12} "
+                     f"{rate_s:>14} {pred:>8}  {status}")
+    if diff["uncovered"]:
+        lines.append(
+            f"  !! {len(diff['uncovered'])} key(s) in the trace have no "
+            f"CostDB row: {', '.join(diff['uncovered'])}")
+    else:
+        lines.append("  all traced keys calibrated")
+    return "\n".join(lines)
+
+
+def _jaxpr_main(args) -> int:
+    if args.paths:
+        print("error: --jaxpr mode takes no source paths; select traced "
+              "programs with --entrypoint NAME", file=sys.stderr)
+        return 2
+    _prepare_virtual_devices()
+    from apex_tpu.lint import entrypoints as eps
+    from apex_tpu.lint.core import _code_selected
+
+    if args.list_entrypoints:
+        for name in eps.names():
+            ep = eps.get(name)
+            print(f"{name}  {ep.description}")
+            for c in ep.contracts():
+                print(f"    {c.code}  {c.describe}")
+        return 0
+
+    names = args.entrypoint or eps.names()
+    unknown = [n for n in names if n not in eps.REGISTRY]
+    if unknown:
+        print(f"error: unknown entrypoint(s): {', '.join(unknown)}; "
+              f"registered: {', '.join(eps.names())}", file=sys.stderr)
+        return 2
+
+    select, ignore = _codes(args.select), _codes(args.ignore)
+    findings, costs = [], []
+    for name in names:
+        contract_findings, cost = eps.check(name)
+        costs.append(cost)
+        for cf in contract_findings:
+            if not _code_selected(cf.code, select, ignore):
+                continue
+            findings.append(lint.Finding(
+                f"jaxpr:{name}", 1, 0, cf.code,
+                f"[{cf.path or '<top>'}] {cf.message} ({cf.contract})"))
+    findings.sort(key=lint.Finding.sort_key)
+
+    applied = _apply_baseline(args, findings)
+    if isinstance(applied, int):
+        return applied
+    findings, baselined, unused = applied
+
+    stats = {"files_scanned": len(names), "suppressed_inline": 0}
+    report = lint.build_report(findings, stats, baselined)
+    report["mode"] = "jaxpr"
+    report["entrypoints"] = list(names)
+
+    if args.static_cost:
+        from apex_tpu.monitor import schema as mon_schema
+        with open(args.static_cost, "w") as fh:
+            for cost in costs:
+                errors = mon_schema.validate(cost)
+                if errors:  # pragma: no cover - emitter bug guard
+                    print("error: refusing to write invalid static_cost "
+                          f"for {cost.get('entrypoint')!r}: {errors}",
+                          file=sys.stderr)
+                    return 2
+                fh.write(json.dumps(cost) + "\n")
+        report["static_cost_path"] = args.static_cost
+
+    tables = []
+    if args.costdb:
+        from apex_tpu.prof.calibrate import diff_static_cost, validate_costdb
+        try:
+            with open(args.costdb, encoding="utf-8") as fh:
+                db = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot read costdb {args.costdb}: {e}",
+                  file=sys.stderr)
+            return 2
+        errors = validate_costdb(db)
+        if errors:
+            print(f"error: {args.costdb} is not a valid costdb artifact: "
+                  f"{errors}", file=sys.stderr)
+            return 2
+        report["costdb_diff"] = {}
+        for cost in costs:
+            diff = diff_static_cost(cost, db)
+            report["costdb_diff"][cost["entrypoint"]] = diff
+            tables.append(_format_diff_table(cost["entrypoint"], diff))
+
+    _emit_report(args, findings, stats, baselined, unused, report)
+    if args.format != "json":
+        for table in tables:
+            print(table)
+    return 1 if findings else 0
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        for r in lint.iter_rules():
+            print(f"{r.code}  {r.name}: {r.summary}")
+        from apex_tpu.lint.contracts import JXP_CODES
+        for code, (name, summary) in sorted(JXP_CODES.items()):
+            print(f"{code}  {name} (--jaxpr contract): {summary}")
+        return 0
+    if (args.jaxpr or args.entrypoint or args.list_entrypoints
+            or args.static_cost or args.costdb):
+        return _jaxpr_main(args)
+    if not args.paths:
+        print("error: no paths given (try `python -m apex_tpu.lint "
+              "apex_tpu/`)", file=sys.stderr)
+        return 2
+
+    try:
+        findings, stats = lint.lint_paths(
+            args.paths, select=_codes(args.select), ignore=_codes(args.ignore))
+    except (FileNotFoundError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    applied = _apply_baseline(args, findings)
+    if isinstance(applied, int):
+        return applied
+    findings, baselined, unused = applied
+
+    report = lint.build_report(findings, stats, baselined)
+    _emit_report(args, findings, stats, baselined, unused, report)
     return 1 if findings else 0
 
 

@@ -705,7 +705,9 @@ def build_report(findings: List[Finding], stats: dict,
     }
 
 
-_CODE_RE = re.compile(r"^APX\d{3}$")
+# APX = AST rules; JXP = jaxpr contracts (`--jaxpr` runs report through
+# the same document, so the validator accepts both families)
+_CODE_RE = re.compile(r"^(APX|JXP)\d{3}$")
 
 
 def validate_report(obj) -> List[str]:

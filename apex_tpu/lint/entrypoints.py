@@ -1,0 +1,412 @@
+"""Registered jaxpr-check entrypoints: the programs whose contracts the
+repo guarantees, traced and judged by ``python -m apex_tpu.lint --jaxpr``.
+
+Each entrypoint declares (a) a builder returning ``(fn, args)`` at smoke
+scale — traced with ``jax.make_jaxpr`` on the virtual CPU mesh, NO
+device execution of the traced program — and (b) the JXP contract set
+that program must satisfy (:mod:`apex_tpu.lint.contracts`). The tier-1
+gate (``tests/test_jaxpr_check.py::TestJaxprGate``) runs the CLI over
+every registered entrypoint and fails on non-baselined violations, the
+same discipline as the apexlint dogfood gate.
+
+The flagship surfaces registered here mirror the invariants the test
+suites used to assert with one-off walkers:
+
+* ``gpt_fwd_bwd`` — the training step (donation honored AND rebound
+  through the jitted step; no low-precision scan accumulation);
+* ``flash_bias_fwd_bwd`` — the bucketed-relative-bias kernel path, fwd
+  and grad (no materialized O(s²) bias/score aval — PR 4's memory
+  claim);
+* ``collective_matmul_ring`` — the overlapped Column→Row chain
+  (``ppermute`` present, no full-width ``all_gather`` over tp — PR 5's
+  acceptance);
+* ``pipeline_{1f1b,interleaved,zb}[_overlap]`` — the schedule family
+  (forward-sweep geometry, the zb dW sweep of exactly M·v ticks that is
+  collective-free, the 1f1b control with NO such sweep — PR 8's
+  acceptance);
+* ``serve_prefill`` / ``serve_decode`` — the serving engine's jitted
+  bodies (pool donated and rebound, single-chip bodies collective-free
+  — PR 7's contract).
+
+Tracing the same programs also yields their
+:func:`~apex_tpu.lint.jaxpr_check.static_cost` reports — the planner's
+predicted-bytes/FLOPs substrate (``--static-cost`` /
+``--costdb`` on the CLI).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Tuple
+
+from apex_tpu.lint import contracts as jc
+
+#: smoke-scale pipeline geometry shared by every pipeline entrypoint:
+#: S stages on the pp mesh, M microbatches, v virtual chunks — small
+#: enough to trace in well under a second, big enough that the forward
+#: sweep, dX sweep, and dW sweep lengths are pairwise distinct. The
+#: interleaved schedule needs M divisible by S (2·S under overlap_p2p),
+#: hence its own M.
+_PP_S, _PP_M = 4, 6
+_PP_M_INTERLEAVED = 8
+_PP_HID = 16
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryPoint:
+    name: str
+    description: str
+    #: () -> (fn, args): the traceable callable and example operands
+    build: Callable[[], Tuple[Callable, Tuple]]
+    #: () -> the contract set this program must satisfy
+    contracts: Callable[[], List[jc.Contract]]
+
+
+REGISTRY: Dict[str, EntryPoint] = {}
+
+
+def register(name: str, description: str,
+             contracts: Callable[[], List[jc.Contract]]):
+    """Decorator registering a builder as a named entrypoint."""
+
+    def deco(build):
+        if name in REGISTRY:  # pragma: no cover - programming error
+            raise ValueError(f"duplicate entrypoint {name!r}")
+        REGISTRY[name] = EntryPoint(name, description, build, contracts)
+        return build
+
+    return deco
+
+
+def names() -> List[str]:
+    return sorted(REGISTRY)
+
+
+def get(name: str) -> EntryPoint:
+    if name not in REGISTRY:
+        raise KeyError(
+            f"unknown entrypoint {name!r}; registered: {', '.join(names())}")
+    return REGISTRY[name]
+
+
+def trace(name: str):
+    """Trace one entrypoint to its ClosedJaxpr (CPU, no execution of the
+    traced program — builders may run tiny eager setup like param init)."""
+    import jax
+
+    ep = get(name)
+    fn, args = ep.build()
+    return jax.make_jaxpr(fn)(*args)
+
+
+def check(name: str):
+    """Trace + contract-check one entrypoint. Returns
+    ``(contract findings, static_cost artifact)``."""
+    from apex_tpu.lint import jaxpr_check as jx
+
+    ep = get(name)
+    closed = trace(name)
+    walk = jc.Walk(closed)
+    findings = jc.check_jaxpr(walk, ep.contracts())
+    cost = jx.static_cost(closed, entrypoint=name)
+    return findings, cost
+
+
+# --- GPT flagship train step --------------------------------------------------
+
+def _gpt_smoke_model():
+    import jax.random as jr
+
+    from apex_tpu.models import GPTConfig, GPTModel
+
+    cfg = GPTConfig(vocab_size=256, max_seq_len=128, hidden_size=64,
+                    num_layers=2, num_heads=4, tp_size=1, remat=False,
+                    attention_impl="flash")
+    model = GPTModel(cfg)
+    # the key only seeds example operands for jax.make_jaxpr — the traced
+    # program, not the values, is what the contracts judge (same rationale
+    # as the baselined DecodeEngine dummy key); likewise every other
+    # PRNGKey(0) in this module
+    return model, model.init(jr.PRNGKey(0))  # apexlint: disable=APX502
+
+
+@register(
+    "gpt_fwd_bwd",
+    "flagship GPT train step (value_and_grad + adam) under donation",
+    lambda: [jc.donation_honored(), jc.donation_rebound(),
+             jc.fp32_accumulation()])
+def _build_gpt_fwd_bwd():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    model, params = _gpt_smoke_model()
+    opt = optax.adam(1e-4)
+    opt_state = opt.init(params)
+
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(model.loss_fn)(params, tokens,
+                                                        targets)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    tokens = jnp.zeros((2, 128), jnp.int32)
+    return step, (params, opt_state, tokens, tokens)
+
+
+# --- bucketed-bias flash attention --------------------------------------------
+
+_BIAS_SEQ = 256
+
+
+@register(
+    "flash_bias_fwd_bwd",
+    "flash attention with the bucketed relative bias, fwd+grad "
+    "(no materialized O(s^2) bias/score aval)",
+    lambda: [jc.no_aval_matching(
+        lambda shape: sum(1 for d in shape if d >= _BIAS_SEQ) >= 2,
+        f"two dims >= seq ({_BIAS_SEQ}): a materialized bias/score")])
+def _build_flash_bias():
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.ops.attention import BucketedBias, flash_attention
+
+    s, h, d = _BIAS_SEQ, 2, 64
+    q = jnp.zeros((h, s, d), jnp.float32)
+    tab = jnp.zeros((32, h), jnp.float32)
+
+    def loss(q, k, v, tab):
+        bias = BucketedBias(tab, bidirectional=True, max_distance=64)
+        out = flash_attention(q, k, v, causal=False, bias=bias,
+                              impl="pallas")
+        return jnp.sum(out ** 2)
+
+    return jax.grad(loss, argnums=(0, 1, 2, 3)), (q, q, q, tab)
+
+
+# --- overlapped collective matmul ---------------------------------------------
+
+@register(
+    "collective_matmul_ring",
+    "overlapped Column->Row TP chain (SP) — ppermute ring, no "
+    "full-width all_gather",
+    lambda: [jc.ppermute_present("tp"),
+             jc.no_full_width_all_gather("tp")])
+def _build_collective_matmul_ring():
+    return _collective_matmul_chain(overlap=True)
+
+
+def _collective_matmul_chain(overlap: bool, grad: bool = True):
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel import mesh as mesh_lib
+    from apex_tpu.transformer import tensor_parallel as tp_lib
+
+    tp, s, b, din, dhid, dout = 4, 12, 2, 8, 24, 8
+    mesh = mesh_lib.make_mesh(tensor_model_parallel_size=tp)
+    col = tp_lib.ColumnParallelLinear(din, dhid, tp_size=tp, bias=True,
+                                      sequence_parallel=True, seq_dim=1,
+                                      overlap_comm=overlap)
+    row = tp_lib.RowParallelLinear(dhid, dout, tp_size=tp, bias=True,
+                                   sequence_parallel=True, seq_dim=1,
+                                   overlap_comm=overlap)
+
+    def block(x, wc, bc, wr, br):
+        hcol = col({"weight": wc, "bias": bc}, x)
+        return row({"weight": wr, "bias": br},
+                   jax.nn.gelu(hcol, approximate=True))
+
+    def loss(x, wc, bc, wr, br):
+        sm = mesh_lib.shard_map(
+            block, mesh=mesh,
+            in_specs=(P(None, "tp"), P("tp", None), P("tp"),
+                      P(None, "tp"), P()),
+            out_specs=P(None, "tp"))
+        return jnp.sum(jnp.sin(sm(x, wc, bc, wr, br).astype(jnp.float32)))
+
+    key = jr.PRNGKey(0)  # apexlint: disable=APX502
+    args = (jr.normal(key, (b, s, din)),
+            jr.normal(key, (dhid, din)) * 0.3,
+            jnp.zeros((dhid,)),
+            jr.normal(key, (dout, dhid)) * 0.3,
+            jnp.zeros((dout,)))
+    if not grad:
+        return loss, args
+    return jax.value_and_grad(loss, argnums=(0, 1, 2, 3, 4)), args
+
+
+# --- pipeline schedule family -------------------------------------------------
+
+def _pipeline_m(schedule: str) -> int:
+    return _PP_M_INTERLEAVED if schedule == "interleaved" else _PP_M
+
+
+def _pipeline_geometry(schedule: str, overlap_p2p: bool, v: int):
+    """(fwd_ticks, dw_ticks) from the canonical unit-cost model — the
+    same closed form ``monitor.pipeline_cost_model`` prices (kept in one
+    place so the contract set and the cost model cannot drift apart)."""
+    from apex_tpu.monitor.hooks import pipeline_cost_model
+
+    cost = pipeline_cost_model(_pipeline_m(schedule), _PP_S, v,
+                               schedule="zb" if schedule == "zb" else "1f1b",
+                               overlap_p2p=overlap_p2p)
+    return cost["fwd_ticks"], cost["bwd_dw_ticks"]
+
+
+def _pipeline_contracts(schedule: str, overlap_p2p: bool, v: int
+                        ) -> List[jc.Contract]:
+    fwd_ticks, _ = _pipeline_geometry(schedule, overlap_p2p, v)
+    mv = _pipeline_m(schedule) * v
+    cons = [jc.ppermute_present("pp"),
+            jc.scan_length(fwd_ticks, min_count=2),  # fwd + backward sweep
+            jc.fp32_accumulation()]
+    if schedule == "zb":
+        # the dW-deferral ORDER witness: a third scan of exactly M·v
+        # real-item ticks, and that whole sweep is collective-free
+        cons.append(jc.scan_length(mv))
+        cons.append(jc.collective_free_region(
+            rf"(^|/)scan:{mv}(\.\d+)?(/|$)", region="deferred-dW sweep"))
+    else:
+        # the autodiff control: dW rides the full-length backward scan,
+        # garbage lanes included — no M·v-tick sweep may exist
+        cons.append(jc.scan_length(mv, forbid=True))
+    return cons
+
+
+def _build_pipeline(schedule: str, overlap_p2p: bool, v: int = 1):
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel import mesh as mesh_lib
+    from apex_tpu.transformer.pipeline_parallel import schedules
+
+    S, M, hid = _PP_S, _pipeline_m(schedule), _PP_HID
+    mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=S)
+    key = jr.PRNGKey(0)  # apexlint: disable=APX502
+
+    def stage_fn(params, x):
+        h = jnp.tanh(x @ params["w1"] + params["b1"])
+        return x + h @ params["w2"]
+
+    def one(k):
+        k1, k2 = jr.split(k)
+        return {"w1": jr.normal(k1, (hid, hid)) * 0.3,
+                "b1": jnp.zeros((hid,)),
+                "w2": jr.normal(k2, (hid, hid)) * 0.3}
+
+    def loss_head(out, tgt):
+        return jnp.mean((out - tgt) ** 2)
+
+    if schedule == "interleaved":
+        plist = [one(jr.fold_in(key, i)) for i in range(S * v)]
+        # device r holds chunks [stage r, stage r+S, ...]: (v, S, ...)
+        chunks = [[plist[c * S + r] for r in range(S)] for c in range(v)]
+        stacked = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[jax.tree.map(lambda *ys: jnp.stack(ys), *row)
+              for row in chunks])
+        spec = jax.tree.map(lambda _: P(None, "pp"), stacked)
+        take = lambda p: jax.tree.map(lambda x: x[:, 0], p)
+        lift = lambda g: jax.tree.map(lambda x: x[:, None], g)
+    else:
+        plist = [one(jr.fold_in(key, i)) for i in range(S)]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *plist)
+        spec = jax.tree.map(lambda _: P("pp"), stacked)
+        take = lambda p: jax.tree.map(lambda x: x[0], p)
+        lift = lambda g: jax.tree.map(lambda x: x[None], g)
+
+    def run(p, m, t):
+        if schedule == "zb":
+            loss, g = schedules.forward_backward_pipelining_zero_bubble(
+                stage_fn, loss_head, take(p), m, t, virtual_chunks=v,
+                overlap_p2p=overlap_p2p)
+        elif schedule == "interleaved":
+            loss, g = schedules.forward_backward_pipelining_with_interleaving(
+                stage_fn, loss_head, take(p), m, t, virtual_chunks=v,
+                overlap_p2p=overlap_p2p)
+        else:
+            loss, g = schedules.forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_head, take(p), m, t,
+                overlap_p2p=overlap_p2p)
+        return loss, lift(g)
+
+    fn = mesh_lib.shard_map(run, mesh=mesh, in_specs=(spec, P(), P()),
+                            out_specs=(P(), spec))
+    mbs = jr.normal(jr.fold_in(key, 71), (M, 2, hid))
+    tgts = jr.normal(jr.fold_in(key, 72), (M, 2, hid))
+    return fn, (stacked, mbs, tgts)
+
+
+def _register_pipeline(schedule: str, overlap_p2p: bool, v: int = 1):
+    suffix = "_overlap" if overlap_p2p else ""
+    name = f"pipeline_{schedule}{suffix}"
+    desc = (f"{schedule} pipeline schedule fwd+bwd "
+            f"(S={_PP_S}, M={_pipeline_m(schedule)}, v={v}, "
+            f"overlap_p2p={overlap_p2p})")
+
+    @register(name, desc,
+              lambda: _pipeline_contracts(schedule, overlap_p2p, v))
+    def _build(schedule=schedule, overlap_p2p=overlap_p2p, v=v):
+        return _build_pipeline(schedule, overlap_p2p, v)
+
+
+for _overlap in (False, True):
+    _register_pipeline("1f1b", _overlap)
+    _register_pipeline("interleaved", _overlap, v=2)
+    _register_pipeline("zb", _overlap)
+
+
+# --- serving engine bodies ----------------------------------------------------
+
+def _serving_engine():
+    import jax.numpy as jnp
+
+    from apex_tpu.serving import ServingEngine
+
+    model, params = _gpt_smoke_model()
+    engine = ServingEngine(model, num_slots=4, block_size=32)
+    return engine, params, jnp
+
+
+@register(
+    "serve_prefill",
+    "serving chunked-prefill body (pool donated+rebound, collective-free)",
+    lambda: [jc.donation_honored(), jc.donation_rebound(),
+             jc.collective_free_region("", region="serving prefill body")])
+def _build_serve_prefill():
+    import jax.random as jr
+
+    engine, params, jnp = _serving_engine()
+    pool = engine.init_pool()
+    C = engine.prefill_chunk_size
+    table_row = jnp.zeros((engine.max_blocks_per_slot,), jnp.int32)
+    tokens = jnp.zeros((C,), jnp.int32)
+    return engine.prefill_chunk, (params, pool, table_row, tokens,
+                                  jnp.int32(0), jnp.int32(C),
+                                  jr.PRNGKey(0))  # apexlint: disable=APX502
+
+
+@register(
+    "serve_decode",
+    "serving paged decode step (pool donated+rebound, collective-free)",
+    lambda: [jc.donation_honored(), jc.donation_rebound(),
+             jc.collective_free_region("", region="serving decode body")])
+def _build_serve_decode():
+    import jax.random as jr
+
+    engine, params, jnp = _serving_engine()
+    pool = engine.init_pool()
+    S = engine.num_slots
+    tables = jnp.zeros((S, engine.max_blocks_per_slot), jnp.int32)
+    tokens = jnp.zeros((S,), jnp.int32)
+    lengths = jnp.zeros((S,), jnp.int32)
+    return engine.decode_step, (params, pool, tables, tokens, lengths,
+                                jr.PRNGKey(0))  # apexlint: disable=APX502

@@ -20,6 +20,12 @@ APX105  alias-shadowing-parameter       a parameter named np/jnp/pl/... —
 APX106  jit-in-body                     jax.jit of a module-level function
                                         inside another function body — a
                                         fresh wrapper (and retrace) per call
+APX107  unordered-iteration-in-trace    iterating a set (or the views of a
+                                        set-ordered dict) inside a jitted/
+                                        scanned body — hash order varies per
+                                        process, so each process traces a
+                                        DIFFERENT jaxpr: spurious jit-cache
+                                        misses and irreproducible programs
 """
 
 from __future__ import annotations
@@ -237,6 +243,146 @@ def check_apx106(ctx: ModuleContext):
             "wrapper — and a fresh trace — per invocation of the "
             "enclosing function; hoist `= jax.jit(...)` to module scope "
             "so the trace cache is shared across calls")
+
+
+#: set-producing builtins: their iteration order is the hash order, which
+#: PYTHONHASHSEED re-rolls per process
+_SET_MAKERS = frozenset({"set", "frozenset"})
+#: unordered-view methods: on a set-ordered dict these iterate in the
+#: order the set inserted
+_DICT_VIEWS = frozenset({"values", "keys", "items"})
+#: wrappers that PRESERVE their argument's order (list(set(...)) is still
+#: hash-ordered); sorted() is the launder and is handled separately
+_ORDER_PRESERVING = frozenset({"list", "tuple", "iter", "reversed",
+                               "enumerate", "dict"})
+_SCAN_WRAPPERS = frozenset({
+    "jax.lax.scan", "lax.scan", "jax.lax.map", "lax.map",
+    "jax.lax.fori_loop", "lax.fori_loop", "jax.lax.while_loop",
+    "lax.while_loop", "jax.checkpoint", "jax.remat",
+})
+
+
+def _unordered_expr(node, unordered: frozenset) -> bool:
+    """Does ``node`` evaluate to a hash-ordered iterable — a set, a
+    set-derived container, or an order-preserving wrap of one?
+    ``sorted()`` (and ``min``/``max``/``sum``/``len``) launder."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in unordered
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.BitXor)):
+        # set algebra (d.keys() - frozen, a | b) keeps the disorder
+        return (_unordered_expr(node.left, unordered)
+                or _unordered_expr(node.right, unordered))
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+        return (_unordered_expr(node.left, unordered)
+                or _unordered_expr(node.right, unordered))
+    if isinstance(node, ast.DictComp):
+        return any(_unordered_expr(g.iter, unordered)
+                   for g in node.generators)
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name):
+            fid = node.func.id
+            if fid in _SET_MAKERS:
+                return True
+            if fid in _ORDER_PRESERVING:
+                return any(_unordered_expr(a, unordered) for a in node.args)
+            return False  # sorted() and every other call launder
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in _DICT_VIEWS:
+                return _unordered_expr(node.func.value, unordered)
+            if node.func.attr in ("union", "intersection", "difference",
+                                  "symmetric_difference", "copy"):
+                return _unordered_expr(node.func.value, unordered)
+    return False
+
+
+def _unordered_names(fn) -> frozenset:
+    """Flow-insensitive fixpoint over a function body: names assigned
+    from a set-valued (or set-ordered) expression. A name that ALSO has
+    an ordered (re)assignment — ``ks = sorted(ks)`` — is laundered: the
+    rule's own recommended fix must not keep firing on the fixed code,
+    so a grow pass (any unordered assignment taints) is followed by a
+    shrink pass (any ordered assignment launders, cascading to names
+    derived from the laundered one). The shrink optimistically
+    under-approximates on genuinely mixed reassignment, the right
+    direction for a linter."""
+    assigns: dict = {}
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)) and \
+                node.value is not None:
+            for name in _assign_target_names(node):
+                assigns.setdefault(name, []).append(node.value)
+    names: set = set()
+    changed = True
+    while changed:  # grow
+        changed = False
+        for name, values in assigns.items():
+            if name not in names and any(
+                    _unordered_expr(v, frozenset(names)) for v in values):
+                names.add(name)
+                changed = True
+    changed = True
+    while changed:  # shrink: a sorted()-style reassignment launders
+        changed = False
+        for name in list(names):
+            if any(not _unordered_expr(v, frozenset(names))
+                   for v in assigns.get(name, [])):
+                names.discard(name)
+                changed = True
+    return frozenset(names)
+
+
+def _assign_target_names(node):
+    from apex_tpu.lint.core import _assign_targets
+    return _assign_targets(node)
+
+
+def _traced_and_scanned(ctx: ModuleContext):
+    """The APX107 scope: jit/pjit/shard_map-wrapped defs PLUS defs passed
+    as the body of lax.scan/map/fori_loop/while_loop (a scanned body is
+    traced every bit as much as a jitted one, and its jaxpr is baked
+    into the enclosing program)."""
+    fns = {fn for fn, _ in traced_functions(ctx)}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if ctx.call_name(node) not in _SCAN_WRAPPERS:
+            continue
+        for arg in node.args[:2]:  # body (and fori's body at index 2 - 1)
+            if isinstance(arg, ast.Name) and arg.id in ctx.defs:
+                fns.add(ctx.defs[arg.id])
+        for arg in node.args[2:3]:
+            if isinstance(arg, ast.Name) and arg.id in ctx.defs:
+                fns.add(ctx.defs[arg.id])
+    return fns
+
+
+@rule("APX107", "unordered-iteration-in-trace",
+      "iterating a set / the views of a set-ordered dict inside a jitted "
+      "or scanned body — hash order varies per process, so each process "
+      "traces a different jaxpr (spurious cache misses); sort first")
+def check_apx107(ctx: ModuleContext):
+    for fn in _traced_and_scanned(ctx):
+        unordered = _unordered_names(fn)
+        for node in ast.walk(fn):
+            iters = []
+            if isinstance(node, ast.For):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                                   ast.DictComp)):
+                iters.extend(g.iter for g in node.generators)
+            for it in iters:
+                if _unordered_expr(it, unordered):
+                    yield ctx.finding(
+                        node, "APX107",
+                        f"iteration over a hash-ordered iterable inside "
+                        f"traced `{fn.name}` — set order varies with "
+                        "PYTHONHASHSEED, so every process traces a "
+                        "DIFFERENT jaxpr (spurious jit-cache misses, "
+                        "irreproducible programs); iterate "
+                        "`sorted(...)` instead")
 
 
 def _has_wrong_type_literal(node) -> bool:

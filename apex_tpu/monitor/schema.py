@@ -538,6 +538,56 @@ COSTDB_SCHEMA = {
     "required": ["schema", "kind", "collectives", "gemms"],
 }
 
+# the StaticCostReport artifact (lint.jaxpr_check.static_cost): the
+# jaxpr walker's per-collective calls/bytes by "<kind>[<axis>]" (the
+# count_collective tag space) and per-GEMM calls/FLOPs by power-of-two
+# class (the CostDB's GEMM class space), every count multiplied by
+# enclosing scan lengths — the planner's PREDICTED side, diffed against
+# the measured CostDB by prof.calibrate.diff_static_cost. Emitted by
+# `python -m apex_tpu.lint --jaxpr --static-cost FILE`, gated by
+# `tools/validate_metrics.py --static-cost`.
+STATIC_COST_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["static_cost"]},
+        "entrypoint": {"type": "string"},  # lint.entrypoints name
+        "collectives": {
+            "type": "object",
+            # key "<kind>[<axis>]" — identical to count_collective tags
+            "additionalProperties": {
+                "type": "object",
+                "properties": {
+                    "calls": {"type": "integer"},  # executions per call
+                    "bytes": {"type": "integer"},  # payload ·calls
+                },
+                "required": ["calls", "bytes"],
+                "additionalProperties": False,
+            },
+        },
+        "gemms": {
+            "type": "object",
+            # key "flops_<2^k>" — identical to calibrate's GEMM classes
+            "additionalProperties": {
+                "type": "object",
+                "properties": {
+                    "calls": {"type": "integer"},
+                    "flops": {"type": "number"},
+                },
+                "required": ["calls", "flops"],
+                "additionalProperties": False,
+            },
+        },
+        "total_collective_bytes": {"type": "integer"},
+        "total_gemm_flops": {"type": "number"},
+        "eqns": {"type": "integer"},          # walked equations
+        "unbounded_sites": {"type": "integer"},  # collective/GEMM rows fed
+        # from under while bodies (unknown trip count: priced once,
+        # flagged here — never silently multiplied)
+    },
+    "required": ["schema", "kind", "entrypoint", "collectives", "gemms"],
+}
+
 SCHEMAS_BY_KIND = {
     "step": STEP_SCHEMA,
     "meta": META_SCHEMA,
@@ -553,6 +603,7 @@ SCHEMAS_BY_KIND = {
     "span": SPAN_SCHEMA,
     "profile": PROFILE_SCHEMA,
     "costdb": COSTDB_SCHEMA,
+    "static_cost": STATIC_COST_SCHEMA,
 }
 
 # --- minimal JSON-Schema subset validator ------------------------------------

@@ -219,6 +219,70 @@ def build_costdb(records: Sequence[dict], events, *,
     return db
 
 
+def diff_static_cost(static: dict, costdb: dict) -> dict:
+    """Line a ``kind:"static_cost"`` report (the jaxpr walker's PREDICTED
+    per-collective bytes and per-GEMM FLOPs,
+    :func:`apex_tpu.lint.jaxpr_check.static_cost`) up against this
+    CostDB's MEASURED rates — the planner's predicted-vs-calibrated
+    substrate, and the engine behind ``python -m apex_tpu.lint --jaxpr
+    --costdb``.
+
+    The join is a plain dict join: static collective keys are the
+    ``count_collective`` ``"<kind>[<axis>]"`` tags the CostDB's
+    collective table is keyed by (the bucket row nearest the static
+    per-call payload prices it); static GEMM classes are the
+    ``"flops_<2^k>"`` labels :func:`gemm_samples` buckets by. Returns::
+
+        {"rows": [{key, unit, calls, bytes|flops, calibrated,
+                   rate?, bucket?, predicted_ms?}, ...],
+         "uncovered": [keys in the trace the CostDB has never priced],
+         "covered": int, "total": int}
+
+    A traced collective with no CostDB row is exactly the planner's
+    blind spot — the caller surfaces ``uncovered`` loudly rather than
+    pricing it at a made-up rate.
+    """
+    import math
+
+    rows: List[dict] = []
+    db_coll = costdb.get("collectives", {}) or {}
+    for key, ent in sorted((static.get("collectives") or {}).items()):
+        calls = max(int(ent.get("calls", 0)), 1)
+        total_bytes = int(ent.get("bytes", 0))
+        per_call = total_bytes / calls
+        row = {"key": key, "unit": "bytes", "calls": int(ent.get("calls", 0)),
+               "bytes": total_bytes, "calibrated": False}
+        buckets = db_coll.get(key) or []
+        rated = [b for b in buckets
+                 if b.get("bytes_per_s", {}).get("mean", 0) > 0]
+        if rated:
+            best = min(rated, key=lambda b: abs(
+                math.log2(max(b["bucket_bytes"], 1))
+                - math.log2(max(per_call, 1))))
+            rate = best["bytes_per_s"]["mean"]
+            row.update(calibrated=True, bucket=best["bucket_bytes"],
+                       rate=rate, predicted_ms=1e3 * total_bytes / rate)
+        rows.append(row)
+
+    db_gemms = costdb.get("gemms", {}) or {}
+    for key, ent in sorted((static.get("gemms") or {}).items()):
+        flops = float(ent.get("flops", 0.0))
+        row = {"key": key, "unit": "flops",
+               "calls": int(ent.get("calls", 0)), "flops": flops,
+               "calibrated": False}
+        stat = (db_gemms.get(key) or {}).get("flops_per_s", {})
+        rate = stat.get("mean", 0)
+        if rate > 0:
+            row.update(calibrated=True, rate=rate,
+                       predicted_ms=1e3 * flops / rate)
+        rows.append(row)
+
+    uncovered = [r["key"] for r in rows if not r["calibrated"]]
+    return {"rows": rows, "uncovered": uncovered,
+            "covered": sum(1 for r in rows if r["calibrated"]),
+            "total": len(rows)}
+
+
 def validate_costdb(db: dict) -> List[str]:
     """Schema-validate a CostDB artifact (the shared kind-keyed
     validator); returns error strings, empty when valid."""

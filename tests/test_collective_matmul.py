@@ -127,23 +127,30 @@ class TestBitwiseDeterminism:
 
 class TestOverlappedJaxpr:
     """Acceptance: the overlapped linear's program (fwd AND bwd) carries
-    ``ppermute`` and no full-width ``all_gather`` of the activation; the
-    blocking control proves the probe sees the gather when it is there."""
+    ``ppermute`` and no full-width ``all_gather`` of the activation —
+    asserted through the shared JXP contract helpers
+    (``apex_tpu.lint.contracts``, the one engine that owns every jaxpr
+    invariant); the blocking control proves the contract sees the gather
+    when it is there."""
 
-    def _jaxpr_str(self, overlap):
+    def _jaxpr(self, overlap):
         mesh = mesh_lib.make_mesh(tensor_model_parallel_size=4)
         args = _mk_args(1, jnp.float32)
         fn = _loss_and_grads_fn(mesh, 4, True, 1, overlap)
-        return str(jax.make_jaxpr(fn)(*args))
+        return jax.make_jaxpr(fn)(*args)
 
     def test_overlapped_ppermute_no_all_gather(self):
-        s = self._jaxpr_str(True)
-        assert "ppermute" in s
-        assert "all_gather" not in s
+        from apex_tpu.lint import contracts as jc
+        jc.assert_contracts(self._jaxpr(True), [
+            jc.ppermute_present("tp"),
+            jc.no_full_width_all_gather("tp"),
+        ])
 
     def test_blocking_control_has_all_gather(self):
-        s = self._jaxpr_str(False)
-        assert "all_gather" in s
+        from apex_tpu.lint import contracts as jc
+        findings = jc.check_jaxpr(self._jaxpr(False),
+                                  [jc.no_full_width_all_gather("tp")])
+        assert findings and all(f.code == "JXP401" for f in findings)
 
 
 class TestEagerValidation:

@@ -114,6 +114,28 @@ def search(m):
     return _score(m)
 """,
     ),
+    "APX107": (
+        """
+import jax
+@jax.jit
+def apply_all(params, x):
+    total = x
+    for k in set(params):
+        total = total + params[k]
+    return total
+""",
+        """
+import jax
+@jax.jit
+def apply_all(params, x):
+    total = x
+    for k in sorted(params):
+        total = total + params[k]
+    for v in params.values():
+        total = total + v
+    return total
+""",
+    ),
     "APX201": (
         """
 import jax
@@ -1002,6 +1024,24 @@ class TestDocsCatalogue:
                        "tools/apexlint_baseline.json"):
             assert needle in text, f"lint.md lost its {needle} workflow"
 
+    def test_every_jxp_contract_documented(self):
+        """The jaxpr-contract catalogue is under the same docs
+        discipline: every JXP code gets a ### entry with a bad and a
+        good trace snippet, and the --jaxpr workflow needles stay."""
+        from apex_tpu.lint.contracts import JXP_CODES
+        path = os.path.join(REPO, "docs", "api", "lint.md")
+        text = open(path, encoding="utf-8").read()
+        for code in JXP_CODES:
+            assert f"### {code}" in text, f"{code} missing from lint.md"
+        n_total = len(lint.iter_rules()) + len(JXP_CODES)
+        assert text.count("```python") >= 2 * n_total, (
+            "each APX rule AND each JXP contract needs a bad and a "
+            "good snippet")
+        for needle in ("--jaxpr", "--entrypoint", "--static-cost",
+                       "--costdb", "--list-entrypoints",
+                       "jaxpr:", "assert_contracts"):
+            assert needle in text, f"lint.md lost its {needle} workflow"
+
 
 class TestAPX304MaterializedBias:
     """Beyond the fixture pair: the taint survives name hops and
@@ -1209,3 +1249,101 @@ def f(x, w):
         findings, suppressed = lint.lint_source(src, path="apex_tpu/x.py")
         assert "APX403" not in {f.code for f in findings}
         assert suppressed == 1
+
+
+class TestAPX107UnorderedIteration:
+    """Beyond the fixture pair: the unordered taint follows assignments
+    and dict views, scanned bodies count as traced, and sorted()
+    launders."""
+
+    def test_scan_body_counts_as_traced(self):
+        src = """
+import jax
+def body(carry, x):
+    total = carry
+    for k in set(x):
+        total = total + k
+    return total, total
+def run(xs):
+    return jax.lax.scan(body, 0.0, xs)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX107" in {f.code for f in findings}
+
+    def test_set_ordered_dict_view_flagged(self):
+        src = """
+import jax
+@jax.jit
+def f(params):
+    acc = {k: 0.0 for k in set(params)}
+    out = 0.0
+    for v in acc.values():
+        out = out + v
+    return out
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX107" in {f.code for f in findings}
+
+    def test_set_algebra_on_keys_flagged(self):
+        src = """
+import jax
+@jax.jit
+def f(params, x):
+    for k in params.keys() - {"bias"}:
+        x = x + params[k]
+    return x
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX107" in {f.code for f in findings}
+
+    def test_list_wrap_preserves_disorder(self):
+        src = """
+import jax
+@jax.jit
+def f(params, x):
+    for k in list(set(params)):
+        x = x + params[k]
+    return x
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX107" in {f.code for f in findings}
+
+    def test_laundering_reassignment_delaunders(self):
+        """Applying the rule's own recommended fix through a named
+        variable must not keep firing: `ks = sorted(ks)` launders ks,
+        cascading to names derived from it."""
+        src = """
+import jax
+@jax.jit
+def f(params, x):
+    ks = set(params)
+    ks = sorted(ks)
+    pairs = list(ks)
+    for k in pairs:
+        x = x + params[k]
+    return x
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX107" not in {f.code for f in findings}
+
+    def test_sorted_launders_and_plain_dict_clean(self):
+        src = """
+import jax
+@jax.jit
+def f(params, x):
+    for k in sorted(set(params)):
+        x = x + params[k]
+    for k, v in params.items():
+        x = x + v
+    return x
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX107" not in {f.code for f in findings}
+
+    def test_untraced_function_clean(self):
+        src = """
+def host_tool(params):
+    return {k for k in set(params)}
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX107" not in {f.code for f in findings}

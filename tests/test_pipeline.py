@@ -838,45 +838,32 @@ class TestZeroBubble:
         return f, stacked, (jr.normal(jr.fold_in(K, 72), (M, 2, HID)),
                             jr.normal(jr.fold_in(K, 73), (M, 2, HID)))
 
-    @staticmethod
-    def _scan_lengths(jaxpr):
-        """Every lax.scan length anywhere in a (closed) jaxpr — the
-        trace-time geometry the schedules compile to. Duck-typed jaxpr
-        walk (works across jax's core/extend reshuffles)."""
-        lengths = []
-
-        def walk(j):
-            for eqn in j.eqns:
-                if eqn.primitive.name == "scan":
-                    lengths.append(int(eqn.params["length"]))
-                for val in eqn.params.values():
-                    vals = val if isinstance(val, (list, tuple)) else [val]
-                    for item in vals:
-                        if hasattr(item, "eqns"):  # a raw Jaxpr
-                            walk(item)
-                        elif hasattr(getattr(item, "jaxpr", None), "eqns"):
-                            walk(item.jaxpr)  # a ClosedJaxpr
-
-        walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
-        return lengths
-
     def test_dw_deferral_geometry_in_jaxpr(self):
-        """The dW-deferral ORDERING asserted from trace-time geometry:
-        the zb program contains a third scan of exactly M·v ticks (the
-        deferred dW sweep, distinct from the two T = M·v + S − 1 sweeps);
-        the autodiff schedule has no M·v-length scan — its dW rides the
-        full-length backward scan, garbage lanes included."""
+        """The dW-deferral ORDERING asserted from trace-time geometry,
+        through the shared JXP contract helpers (the one-off scan-length
+        walker this test used to carry now lives in
+        ``apex_tpu.lint.jaxpr_check``): the zb program contains a third
+        scan of exactly M·v ticks (the deferred dW sweep, distinct from
+        the two T = M·v + S − 1 sweeps) and that sweep is
+        collective-free; the autodiff schedule has no M·v-length scan —
+        its dW rides the full-length backward scan, garbage lanes
+        included."""
+        from apex_tpu.lint import contracts as jc
+
         S, M = 4, 6
         T = M + S - 1
         zb_f, zb_p, (m, t) = self._grad_fn("zb", S, M)
-        zb_lengths = self._scan_lengths(jax.make_jaxpr(zb_f)(zb_p, m, t))
-        assert zb_lengths.count(T) >= 2, zb_lengths   # fwd + dX sweeps
-        assert M in zb_lengths, zb_lengths            # the deferred dW sweep
+        jc.assert_contracts(jax.make_jaxpr(zb_f)(zb_p, m, t), [
+            jc.scan_length(T, min_count=2),   # fwd + dX sweeps
+            jc.scan_length(M),                # the deferred dW sweep...
+            jc.collective_free_region(        # ...which is hop-free
+                rf"(^|/)scan:{M}(\.\d+)?(/|$)", region="deferred-dW sweep"),
+        ])
         base_f, base_p, (m, t) = self._grad_fn("1f1b", S, M)
-        base_lengths = self._scan_lengths(
-            jax.make_jaxpr(base_f)(base_p, m, t))
-        assert M not in base_lengths, base_lengths
-        assert base_lengths.count(T) >= 2, base_lengths
+        jc.assert_contracts(jax.make_jaxpr(base_f)(base_p, m, t), [
+            jc.scan_length(T, min_count=2),
+            jc.scan_length(M, forbid=True),   # no deferred sweep in 1f1b
+        ])
 
     @pytest.mark.parametrize("overlap", [False, True])
     def test_recompile_free_geometry_reuse(self, overlap):

@@ -10,6 +10,7 @@ Usage::
     python tools/validate_metrics.py --serve serve.jsonl ...
     python tools/validate_metrics.py --serve-window windows.jsonl ...
     python tools/validate_metrics.py --pipeline pipeline.jsonl ...
+    python tools/validate_metrics.py --static-cost static_cost.jsonl ...
 
 Dispatch is by content, not extension:
 
@@ -45,14 +46,17 @@ Dispatch is by content, not extension:
   ``apex_tpu.serving`` engine), ``serve_event``/``serve_window``
   records (the request-lifecycle and live-SLO telemetry of
   ``apex_tpu.serving.telemetry``), ``pipeline`` records (``python
-  bench.py --pipeline``: the zero-bubble-vs-1f1b schedule leg), and
-  ``costdb`` artifacts (``apex_tpu.prof.calibrate``) dispatch on
-  ``kind`` like every monitor record. ``--profile`` / ``--serve`` /
-  ``--serve-window`` / ``--pipeline`` / ``--costdb`` force EVERY
-  listed file to be judged as that artifact (same rationale as
-  ``--lint-report``: an artifact that lost its ``kind`` key must fail
-  as a bad profile/serve/pipeline/costdb, not as an unrecognized
-  shape).
+  bench.py --pipeline``: the zero-bubble-vs-1f1b schedule leg),
+  ``costdb`` artifacts (``apex_tpu.prof.calibrate``), and
+  ``static_cost`` artifacts (``python -m apex_tpu.lint --jaxpr
+  --static-cost``: the jaxpr walker's predicted per-collective bytes /
+  per-GEMM FLOPs — the planner's predicted side of the CostDB diff)
+  dispatch on ``kind`` like every monitor record. ``--profile`` /
+  ``--serve`` / ``--serve-window`` / ``--pipeline`` / ``--costdb`` /
+  ``--static-cost`` force EVERY listed file to be judged as that
+  artifact (same rationale as ``--lint-report``: an artifact that lost
+  its ``kind`` key must fail as a bad profile/serve/pipeline/costdb/
+  static_cost, not as an unrecognized shape).
 
 Exit status 0 when every file is clean; 1 otherwise, with one problem per
 line on stderr. The logic lives in ``apex_tpu.monitor.schema`` so tests
@@ -187,9 +191,12 @@ def main(argv=None) -> int:
         force_kind = "serve"
     elif "--pipeline" in argv:
         force_kind = "pipeline"
+    elif "--static-cost" in argv:
+        force_kind = "static_cost"
     argv = [a for a in argv
             if a not in ("--lint-report", "--costdb", "--profile",
-                         "--serve", "--serve-window", "--pipeline")]
+                         "--serve", "--serve-window", "--pipeline",
+                         "--static-cost")]
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
