@@ -42,3 +42,4 @@ __version__ = "0.1.0"
 
 # Subpackages are imported lazily by users:
 #   from apex_tpu import amp, optimizers, parallel, transformer, ops, contrib
+#   from apex_tpu import plan   # ParallelPlan + the CostDB-driven planner

@@ -81,6 +81,12 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--costdb", metavar="FILE",
                    help="jaxpr mode: print the predicted-vs-calibrated "
                         "table against a measured CostDB artifact")
+    p.add_argument("--strict", action="store_true",
+                   help="with --costdb: exit nonzero when any traced "
+                        "cost key has no CostDB row — the planner's "
+                        "blind-spot surface as an exit code (and the "
+                        "report's structured 'uncalibrated' section), "
+                        "not table prose for CI to scrape")
     return p
 
 
@@ -173,6 +179,11 @@ def _jaxpr_main(args) -> int:
         print("error: --jaxpr mode takes no source paths; select traced "
               "programs with --entrypoint NAME", file=sys.stderr)
         return 2
+    if args.strict and not args.costdb:
+        # usage error — before any entrypoint is traced
+        print("error: --strict judges CostDB coverage; pass --costdb "
+              "FILE", file=sys.stderr)
+        return 2
     _prepare_virtual_devices()
     from apex_tpu.lint import entrypoints as eps
     from apex_tpu.lint.core import _code_selected
@@ -229,6 +240,7 @@ def _jaxpr_main(args) -> int:
         report["static_cost_path"] = args.static_cost
 
     tables = []
+    uncalibrated = {}
     if args.costdb:
         from apex_tpu.prof.calibrate import diff_static_cost, validate_costdb
         try:
@@ -247,13 +259,28 @@ def _jaxpr_main(args) -> int:
         for cost in costs:
             diff = diff_static_cost(cost, db)
             report["costdb_diff"][cost["entrypoint"]] = diff
+            if diff["uncovered"]:
+                uncalibrated[cost["entrypoint"]] = diff["uncovered"]
             tables.append(_format_diff_table(cost["entrypoint"], diff))
+        # the blind-spot surface as DATA (ISSUE 12 satellite): the
+        # planner and CI consume this section (and --strict's exit
+        # code) instead of scraping the "!! ... UNCALIBRATED" prose
+        report["uncalibrated"] = uncalibrated
 
     _emit_report(args, findings, stats, baselined, unused, report)
     if args.format != "json":
         for table in tables:
             print(table)
-    return 1 if findings else 0
+    if findings:
+        return 1
+    if args.strict and uncalibrated:
+        n = sum(len(v) for v in uncalibrated.values())
+        print(f"strict: {n} traced cost key(s) have no CostDB row: "
+              + "; ".join(f"{ep}: {', '.join(keys)}"
+                          for ep, keys in sorted(uncalibrated.items())),
+              file=sys.stderr)
+        return 1
+    return 0
 
 
 def main(argv=None) -> int:

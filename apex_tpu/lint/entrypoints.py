@@ -199,7 +199,8 @@ def _build_collective_matmul_ring():
     return _collective_matmul_chain(overlap=True)
 
 
-def _collective_matmul_chain(overlap: bool, grad: bool = True):
+def _collective_matmul_chain(overlap: bool, grad: bool = True,
+                             tp: int = 4):
     import jax
     import jax.numpy as jnp
     import jax.random as jr
@@ -208,7 +209,9 @@ def _collective_matmul_chain(overlap: bool, grad: bool = True):
     from apex_tpu.parallel import mesh as mesh_lib
     from apex_tpu.transformer import tensor_parallel as tp_lib
 
-    tp, s, b, din, dhid, dout = 4, 12, 2, 8, 24, 8
+    # dims scale with tp so planned_gpt_step can trace the chain at the
+    # active plan's width (tp=4 keeps the historical shape)
+    s, b, din, dhid, dout = 3 * tp, 2, 8, 6 * tp, 8
     mesh = mesh_lib.make_mesh(tensor_model_parallel_size=tp)
     col = tp_lib.ColumnParallelLinear(din, dhid, tp_size=tp, bias=True,
                                       sequence_parallel=True, seq_dim=1,
@@ -247,22 +250,24 @@ def _pipeline_m(schedule: str) -> int:
     return _PP_M_INTERLEAVED if schedule == "interleaved" else _PP_M
 
 
-def _pipeline_geometry(schedule: str, overlap_p2p: bool, v: int):
+def _pipeline_geometry(schedule: str, overlap_p2p: bool, v: int,
+                       *, S: int = None, M: int = None):
     """(fwd_ticks, dw_ticks) from the canonical unit-cost model — the
     same closed form ``monitor.pipeline_cost_model`` prices (kept in one
     place so the contract set and the cost model cannot drift apart)."""
     from apex_tpu.monitor.hooks import pipeline_cost_model
 
-    cost = pipeline_cost_model(_pipeline_m(schedule), _PP_S, v,
+    cost = pipeline_cost_model(M or _pipeline_m(schedule), S or _PP_S, v,
                                schedule="zb" if schedule == "zb" else "1f1b",
                                overlap_p2p=overlap_p2p)
     return cost["fwd_ticks"], cost["bwd_dw_ticks"]
 
 
-def _pipeline_contracts(schedule: str, overlap_p2p: bool, v: int
+def _pipeline_contracts(schedule: str, overlap_p2p: bool, v: int,
+                        *, S: int = None, M: int = None
                         ) -> List[jc.Contract]:
-    fwd_ticks, _ = _pipeline_geometry(schedule, overlap_p2p, v)
-    mv = _pipeline_m(schedule) * v
+    fwd_ticks, _ = _pipeline_geometry(schedule, overlap_p2p, v, S=S, M=M)
+    mv = (M or _pipeline_m(schedule)) * v
     cons = [jc.ppermute_present("pp"),
             jc.scan_length(fwd_ticks, min_count=2),  # fwd + backward sweep
             jc.fp32_accumulation()]
@@ -279,7 +284,8 @@ def _pipeline_contracts(schedule: str, overlap_p2p: bool, v: int
     return cons
 
 
-def _build_pipeline(schedule: str, overlap_p2p: bool, v: int = 1):
+def _build_pipeline(schedule: str, overlap_p2p: bool, v: int = 1,
+                    *, S: int = None, M: int = None):
     import jax
     import jax.numpy as jnp
     import jax.random as jr
@@ -288,7 +294,7 @@ def _build_pipeline(schedule: str, overlap_p2p: bool, v: int = 1):
     from apex_tpu.parallel import mesh as mesh_lib
     from apex_tpu.transformer.pipeline_parallel import schedules
 
-    S, M, hid = _PP_S, _pipeline_m(schedule), _PP_HID
+    S, M, hid = S or _PP_S, M or _pipeline_m(schedule), _PP_HID
     mesh = mesh_lib.make_mesh(pipeline_model_parallel_size=S)
     key = jr.PRNGKey(0)  # apexlint: disable=APX502
 
@@ -392,6 +398,129 @@ def _build_serve_prefill():
     return engine.prefill_chunk, (params, pool, table_row, tokens,
                                   jnp.int32(0), jnp.int32(C),
                                   jr.PRNGKey(0))  # apexlint: disable=APX502
+
+
+# --- the planner's chosen plan ------------------------------------------------
+
+#: the default ParallelPlan `planned_gpt_step` traces when no plan is
+#: supplied: the multichip gate topology (dp2×tp2×pp2, zb) — the
+#: planner's most-searched corner stays contract-checked on every gate
+#: run even without an explicit pick
+_DEFAULT_PLAN_JSON = {"dp": 2, "tp": 2, "pp": 2, "pp_schedule": "zb",
+                      "sequence_parallel": True}
+
+
+def active_plan():
+    """The ParallelPlan `planned_gpt_step` traces: ``APEX_TPU_PLAN``
+    (a :meth:`ParallelPlan.to_json` object / JSON string) when set —
+    how ``bench.py --plan`` and CI point the JXP gate at the planner's
+    *chosen* plan — else the gate-topology default."""
+    import os
+
+    from apex_tpu.plan.parallel_plan import ParallelPlan
+
+    env = os.environ.get("APEX_TPU_PLAN")
+    if env:
+        return ParallelPlan.from_json(env)
+    return ParallelPlan.from_json(dict(_DEFAULT_PLAN_JSON))
+
+
+def _planned_m(plan) -> int:
+    """Microbatch count for the traced schedule: fills the pipeline and
+    divides the (overlap-doubled) injection group at any v."""
+    return 2 * plan.pp * max(plan.virtual_chunks, 1)
+
+
+def _planned_schedule(plan) -> str:
+    """The schedule-family name the plan's knobs select — ONE
+    derivation shared by the contract set and the builder, so the
+    program and the contracts judging it cannot drift apart."""
+    if plan.pp_schedule == "1f1b" and plan.virtual_chunks > 1:
+        return "interleaved"
+    return plan.pp_schedule
+
+
+def _planned_contracts() -> List[jc.Contract]:
+    """The JXP contracts the active plan's knobs engage — donation
+    always; the schedule family's scan/collective geometry when the
+    plan pipelines; the ring-overlap acceptance when it overlaps tp.
+    The knob families COMPOSE (the builder traces the pp schedule AND
+    the tp chain as one program when a plan carries both), so a
+    dp2×tp2×pp2 tp_overlap pick is checked against the overlap
+    invariants too — never vacuously gated. This is how the planner
+    can never pick a plan that violates a shipped invariant:
+    `python -m apex_tpu.lint --jaxpr --entrypoint planned_gpt_step`
+    with APEX_TPU_PLAN set to the chosen plan."""
+    plan = active_plan()
+    cons = [jc.donation_honored(), jc.donation_rebound(),
+            jc.fp32_accumulation()]
+    if plan.pp > 1:
+        cons.extend(c for c in _pipeline_contracts(
+            _planned_schedule(plan), plan.overlap_p2p,
+            plan.virtual_chunks, S=plan.pp, M=_planned_m(plan))
+            if c.code != "JXP501")  # fp32_accumulation already present
+    if plan.tp > 1 and plan.tp_overlap:
+        cons.append(jc.ppermute_present("tp"))
+        cons.append(jc.no_full_width_all_gather("tp"))
+    return cons
+
+
+@register(
+    "planned_gpt_step",
+    "train step under the ACTIVE ParallelPlan (APEX_TPU_PLAN env or "
+    "the dp2×tp2×pp2 zb gate default) — donation + the plan's "
+    "schedule/overlap contracts",
+    _planned_contracts)
+def _build_planned_gpt_step():
+    """One traced program per plan, composing the knob families: the
+    plan's REAL pipeline schedule (when pp > 1) and the tp boundary
+    chain at the plan's width/overlap (when tp > 1) run inside one
+    donating SGD step, so every engaged contract judges the same
+    program. The chain introduces no scans (rings unroll), so the
+    schedule's scan-length witnesses cannot collide with it."""
+    import jax
+
+    plan = active_plan()
+    pipe = chain = None
+    if plan.pp > 1:
+        pipe = _build_pipeline(
+            _planned_schedule(plan), plan.overlap_p2p,
+            plan.virtual_chunks, S=plan.pp, M=_planned_m(plan))
+    if plan.tp > 1:
+        chain = _collective_matmul_chain(overlap=plan.tp_overlap,
+                                         tp=plan.tp)
+    if pipe is None and chain is None:
+        # dp-only plan: the flagship smoke train step (already donating)
+        return _build_gpt_fwd_bwd()
+
+    if pipe is not None and chain is not None:
+        fn, (params, mbs, tgts) = pipe
+        vg, (x, *ws) = chain
+
+        def train(p, ws, m, t, x):
+            loss_p, g = fn(p, m, t)
+            loss_c, grads = vg(x, *ws)
+            new_p = jax.tree.map(lambda a, b: a - 0.01 * b, p, g)
+            new_w = [w - 0.01 * gw for w, gw in zip(ws, grads[1:])]
+            return new_p, new_w, loss_p + loss_c
+
+        return (jax.jit(train, donate_argnums=(0, 1)),
+                (params, list(ws), mbs, tgts, x))
+    if pipe is not None:
+        fn, (params, mbs, tgts) = pipe
+
+        def train(p, m, t):
+            loss, g = fn(p, m, t)
+            return jax.tree.map(lambda a, b: a - 0.01 * b, p, g), loss
+
+        return jax.jit(train, donate_argnums=(0,)), (params, mbs, tgts)
+    vg, (x, *ws) = chain
+
+    def train(ws, x):
+        loss, grads = vg(x, *ws)
+        return [w - 0.01 * g for w, g in zip(ws, grads[1:])], loss
+
+    return jax.jit(train, donate_argnums=(0,)), (list(ws), x)
 
 
 @register(

@@ -112,8 +112,60 @@ class GPTConfig:
     # with tp (+SP), pp, and dp in one mesh.
     cp_axis: Optional[str] = None
     cp_impl: str = "ring"
+    # The unified parallelism object (ISSUE 12): pass a ParallelPlan and
+    # the loose knobs above (tp_size, sequence_parallel, tp_overlap,
+    # pp_schedule, overlap_p2p, cp_axis/ep_axis) are DERIVED from it —
+    # one validated source of truth shared with make_mesh and
+    # build_schedule. Left None, the loose kwargs construct a shim plan
+    # (the deprecated path — no caller breaks), and the parallel
+    # cross-field validation below routes through ParallelPlan.validate
+    # either way. Model-coupled constraints (flash attention for
+    # tp_overlap/cp, head divisibility) stay here: the plan cannot know
+    # them.
+    plan: Optional[Any] = None
 
     def __post_init__(self):
+        from apex_tpu.plan.parallel_plan import ParallelPlan
+
+        if self.plan is not None:
+            p = self.plan
+            if not isinstance(p, ParallelPlan):
+                p = ParallelPlan.from_json(p)
+                object.__setattr__(self, "plan", p)
+            # the plan is the single source of truth: a loose parallel
+            # kwarg explicitly set to something the plan contradicts is
+            # an eager named-knob error, never a silent override
+            derived = {"tp_size": p.tp,
+                       "sequence_parallel": p.sequence_parallel,
+                       "tp_overlap": p.tp_overlap,
+                       "pp_schedule": p.pp_schedule,
+                       "overlap_p2p": p.overlap_p2p}
+            defaults = {"tp_size": 1, "sequence_parallel": False,
+                        "tp_overlap": False, "pp_schedule": "1f1b",
+                        "overlap_p2p": False}
+            for name, want in derived.items():
+                got = getattr(self, name)
+                if got != defaults[name] and got != want:
+                    raise ValueError(
+                        f"{name}={got!r} contradicts plan="
+                        f"{p.describe()} (which implies {name}="
+                        f"{want!r}); pass the knob through the plan, "
+                        f"not alongside it")
+                object.__setattr__(self, name, want)
+            if p.cp > 1 and self.cp_axis is None:
+                object.__setattr__(self, "cp_axis", "cp")
+            if p.ep > 1 and self.ep_axis is None:
+                object.__setattr__(self, "ep_axis", "ep")
+        else:
+            # the deprecated loose-kwarg shim: every construction owns a
+            # plan, and the plan's validator is the one that rejects
+            # illegal parallel combos (PlanError is a ValueError)
+            object.__setattr__(self, "plan", ParallelPlan.from_model_kwargs(
+                tp_size=self.tp_size,
+                sequence_parallel=self.sequence_parallel,
+                tp_overlap=self.tp_overlap,
+                pp_schedule=self.pp_schedule,
+                overlap_p2p=self.overlap_p2p))
         if self.moe_num_experts is not None:
             if self.moe_num_experts < 2:
                 raise ValueError("moe_num_experts must be >= 2 (None = dense)")
@@ -126,12 +178,8 @@ class GPTConfig:
             raise ValueError(
                 f"attention_impl must be softmax|flash|naive, got "
                 f"{self.attention_impl!r}")
-        if self.pp_schedule not in ("1f1b", "zb"):
-            raise ValueError(
-                f"pp_schedule={self.pp_schedule!r} is not a pipeline "
-                "schedule; legal values are '1f1b' (autodiff backward, "
-                "interleaved under virtual chunks) and 'zb' (zero-bubble "
-                "split backward) — both consumed by GPTPipeline")
+        # pp_schedule legality (and tp_overlap's tp_size >= 2) now live
+        # in ParallelPlan.validate — routed through the plan above
         if self.remat_policy not in (
                 "full", "save_attn", "save_attn_mlp", "mlp_only"):
             raise ValueError(
@@ -146,11 +194,6 @@ class GPTConfig:
                     "context parallelism distributes the flash kernel "
                     "family; set attention_impl='flash'")
         if self.tp_overlap:
-            if self.tp_size < 2:
-                raise ValueError(
-                    "tp_overlap overlaps the tp boundary collectives with "
-                    "the linears' GEMMs; it needs tp_size >= 2 (there is "
-                    "no collective to hide at tp_size=1)")
             if self.tp_axis is None:
                 raise ValueError(
                     "tp_overlap needs a bound tp axis; tp_axis=None runs "

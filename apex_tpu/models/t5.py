@@ -84,8 +84,41 @@ class T5Config:
     # collective to overlap and True is an eager config error rather
     # than a silent no-op.
     tp_overlap: bool = False
+    # Unified parallelism object (ISSUE 12), mirror of GPTConfig.plan:
+    # the enc-dec stack runs its linears unsharded (dp/pp only), so a
+    # plan here must carry tp=1 / tp_overlap=False — anything else is
+    # the same eager error the loose tp_overlap flag raises. A shim
+    # plan is constructed when None so every config owns one.
+    plan: Optional[Any] = None
 
     def __post_init__(self):
+        from apex_tpu.plan.parallel_plan import ParallelPlan
+
+        if self.plan is not None:
+            p = self.plan
+            if not isinstance(p, ParallelPlan):
+                p = ParallelPlan.from_json(p)
+                object.__setattr__(self, "plan", p)
+            if p.tp > 1 or p.tp_overlap or p.sequence_parallel:
+                raise ValueError(
+                    f"plan {p.describe()} sets tensor-parallel knobs "
+                    "(tp/sequence_parallel/tp_overlap), and the enc-dec "
+                    "stack runs its linears unsharded (dp/pp only); "
+                    "tensor parallelism belongs on GPTConfig, whose "
+                    "Column/Row parallel linears carry it")
+            if self.tp_overlap:
+                # an explicit loose tp_overlap=True must keep its
+                # historical eager error (below), never be silently
+                # overwritten by the plan's False
+                raise ValueError(
+                    f"tp_overlap=True contradicts plan={p.describe()} "
+                    "(which implies tp_overlap=False) — and tp_overlap "
+                    "belongs on GPTConfig either way")
+        else:
+            # every config owns a plan (tp_overlap=True raises its own
+            # GPTConfig-pointing error below either way)
+            object.__setattr__(self, "plan",
+                               ParallelPlan.from_model_kwargs(tp_size=1))
         if self.attention_impl not in ("softmax", "flash"):
             raise ValueError(
                 f"attention_impl must be softmax|flash, got "

@@ -55,6 +55,7 @@ from apex_tpu.monitor.registry import (  # noqa: F401
     emit_longseq_bias,
     emit_meta,
     emit_pipeline,
+    emit_plan,
     emit_profile,
     emit_serve,
     emit_serve_window,

@@ -298,6 +298,13 @@ class MetricsRegistry:
         geometry off-TPU."""
         return self._emit_status_record("pipeline", status, **fields)
 
+    def emit_plan(self, status: str, **fields) -> Dict[str, Any]:
+        """Auto-parallelism planner record (``bench.py --plan``): the
+        searched ranking, the chosen ``ParallelPlan``, predicted step
+        time + confidence, and predicted-vs-measured error when a
+        measured run followed (``apex_tpu.plan.search``)."""
+        return self._emit_status_record("plan", status, **fields)
+
     def emit_profile(self, status: str, **fields) -> Dict[str, Any]:
         """Step-anatomy profile record (``bench.py --profile``): spans +
         device trace fused into the per-step compute/collective/bubble/
@@ -512,6 +519,13 @@ def emit_pipeline(status: str, **fields) -> Optional[Dict[str, Any]]:
     r = _REGISTRY
     if r is not None:
         return r.emit_pipeline(status, **fields)
+    return None
+
+
+def emit_plan(status: str, **fields) -> Optional[Dict[str, Any]]:
+    r = _REGISTRY
+    if r is not None:
+        return r.emit_plan(status, **fields)
     return None
 
 

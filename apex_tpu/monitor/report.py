@@ -73,6 +73,7 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     serve_records = []
     serve_window_records = []
     pipeline_records = []
+    plan_records = []
     schedule = None
     for rec in records:
         kind = rec.get("kind")
@@ -96,6 +97,8 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             serve_window_records.append(rec)
         elif kind == "pipeline":
             pipeline_records.append(rec)
+        elif kind == "plan":
+            plan_records.append(rec)
         elif kind == "event" and rec.get("name") == "pipeline_schedule":
             schedule = rec
 
@@ -271,6 +274,17 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                                "bubble_pct_1f1b_geometry",
                                "pipeline_size", "virtual_chunks",
                                "num_microbatches", "p2p_bytes_per_step"))
+
+    if plan_records:
+        summary["plan"] = status_summary(
+            plan_records, ("chosen_describe", "predicted_step_ms",
+                           "measured_step_ms",
+                           "predicted_vs_measured_err_pct",
+                           "confidence", "chips", "searched", "feasible",
+                           "costdb_source"))
+        uncal = plan_records[-1].get("uncalibrated")
+        if isinstance(uncal, list):
+            summary["plan"]["uncalibrated"] = uncal
 
     if gate_records:
         summary["gates"] = [
@@ -582,6 +596,28 @@ def render(summary: Dict[str, Any]) -> str:
             if tpo.get("skipped"):
                 parts.append("skipped: " + ", ".join(tpo["skipped"]))
             lines.append("  tp-overlap  " + "   ".join(parts))
+    pl = summary.get("plan")
+    if pl:
+        parts = []
+        if pl.get("chosen_describe"):
+            parts.append(f"chose {pl['chosen_describe']}")
+        if isinstance(pl.get("predicted_step_ms"), (int, float)):
+            parts.append(f"pred {pl['predicted_step_ms']:.3f} ms")
+        if isinstance(pl.get("measured_step_ms"), (int, float)):
+            parts.append(f"meas {pl['measured_step_ms']:.3f} ms")
+        if isinstance(pl.get("predicted_vs_measured_err_pct"),
+                      (int, float)):
+            parts.append(f"err {pl['predicted_vs_measured_err_pct']:.1f}%")
+        if isinstance(pl.get("feasible"), int):
+            parts.append(f"{pl['feasible']}/{pl.get('searched', '?')} "
+                         f"feasible")
+        if pl.get("confidence"):
+            parts.append(pl["confidence"])
+        if pl.get("uncalibrated"):
+            parts.append("uncalibrated: " + ", ".join(pl["uncalibrated"]))
+        if pl.get("status") == "SKIP":
+            parts.append(f"SKIP({pl.get('reason', '?')})")
+        lines.append("  plan        " + "   ".join(parts))
     for gate in summary.get("gates", []):
         skipped = (", skipped: " + ", ".join(gate["skipped"])
                    if gate["skipped"] else "")

@@ -588,6 +588,91 @@ STATIC_COST_SCHEMA = {
     "required": ["schema", "kind", "entrypoint", "collectives", "gemms"],
 }
 
+# the auto-parallelism planner record (`python bench.py --plan`,
+# apex_tpu.plan.search.plan_record_fields): the searched ranking, the
+# chosen ParallelPlan, its predicted step time + confidence
+# (uncalibrated CostDB blind-spot keys listed, never silently priced),
+# and — when a measured run followed — the measured step time and the
+# predicted-vs-measured error that tools/bench_history.py gates for
+# drift. Same status semantics as decode/pipeline: "OK" (real TPU
+# measurement) engages the honesty rule; off-TPU the record is an
+# explicit SKIP(reason) with the measured half as explicit skip
+# objects — never nan in an OK line. Plan objects and ranking rows are
+# closed (additionalProperties: false): a junk key in a serialized
+# plan or ranking entry must fail validation, not ride along.
+PLAN_OBJ_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "dp": {"type": "integer"},
+        "tp": {"type": "integer"},
+        "pp": {"type": "integer"},
+        "cp": {"type": "integer"},
+        "ep": {"type": "integer"},
+        "sequence_parallel": {"type": "boolean"},
+        "tp_overlap": {"type": "boolean"},
+        "pp_schedule": {"enum": ["1f1b", "zb"]},
+        "overlap_p2p": {"type": "boolean"},
+        "virtual_chunks": {"type": "integer"},
+        "zero": {"type": "boolean"},
+    },
+    "required": ["dp", "tp", "pp", "cp", "ep", "sequence_parallel",
+                 "tp_overlap", "pp_schedule", "overlap_p2p",
+                 "virtual_chunks", "zero"],
+    "additionalProperties": False,
+}
+
+_PLAN_RANKING_ITEM = {
+    "type": "object",
+    "properties": {
+        "plan": PLAN_OBJ_SCHEMA,
+        "predicted_step_ms": {"type": "number"},
+        "confidence": {"enum": ["calibrated", "partial"]},
+        "uncalibrated": {"type": "array", "items": {"type": "string"}},
+        "gemm_ms": {"type": "number"},
+        "collective_ms": {"type": "number"},
+        "schedule_factor": {"type": "number"},
+        "bubble_pct": {"type": "number"},
+        "predicted_memory_mb": {"type": "number"},
+    },
+    "required": ["plan", "predicted_step_ms", "confidence"],
+    "additionalProperties": False,
+}
+
+PLAN_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["plan"]},
+        "status": {"enum": ["OK", "SKIP"]},
+        "reason": {"type": "string"},  # required when status == "SKIP"
+        "chips": {"type": "integer"},
+        "searched": {"type": "integer"},   # lattice size (incl. rejected)
+        "feasible": {"type": "integer"},
+        "chosen": PLAN_OBJ_SCHEMA,
+        "chosen_describe": {"type": "string"},
+        "predicted_step_ms": _METRIC_VALUE,
+        "confidence": {"enum": ["calibrated", "partial"]},
+        "uncalibrated": {"type": "array", "items": {"type": "string"}},
+        "predicted_memory_mb": {"type": "number"},
+        "ranking": {"type": "array", "items": _PLAN_RANKING_ITEM},
+        "rejected": {"type": "array", "items": {
+            "type": "object",
+            "properties": {"plan": {"type": "string"},
+                           "reason": {"type": "string"}},
+            "required": ["plan", "reason"],
+            "additionalProperties": False,
+        }},
+        "costdb_source": {"type": "string"},
+        "measured_step_ms": _METRIC_VALUE,
+        "predicted_vs_measured_err_pct": _METRIC_VALUE,
+        "smoke_step_ms": _METRIC_VALUE,  # off-TPU plumbing witness
+        "lint_ok": {"type": "boolean"},  # planned_gpt_step JXP check
+        "config": {"type": "object"},
+        "backend": {"type": "string"},
+    },
+    "required": ["schema", "kind", "status", "chosen", "ranking"],
+}
+
 SCHEMAS_BY_KIND = {
     "step": STEP_SCHEMA,
     "meta": META_SCHEMA,
@@ -604,6 +689,7 @@ SCHEMAS_BY_KIND = {
     "profile": PROFILE_SCHEMA,
     "costdb": COSTDB_SCHEMA,
     "static_cost": STATIC_COST_SCHEMA,
+    "plan": PLAN_SCHEMA,
 }
 
 # --- minimal JSON-Schema subset validator ------------------------------------
@@ -703,7 +789,7 @@ def validate(record: Dict[str, Any],
     # with a claim-free, reason-free skip)
     if (record.get("kind") in ("decode", "longseq_bias", "tp_overlap",
                                "profile", "serve", "pipeline",
-                               "serve_window")
+                               "serve_window", "plan")
             and record.get("status") == "SKIP"
             and not record.get("reason")):
         errors.append(

@@ -73,13 +73,29 @@ class MeshSpec:
     pipeline_model_parallel_split_rank: Optional[int] = None
 
     def __post_init__(self):
-        if self.virtual_pipeline_model_parallel_size is not None:
-            if self.pipeline_model_parallel_size < 2:
-                raise ValueError(
-                    "virtual pipeline parallelism requires pipeline_model_parallel_size >= 2"
-                )
-        if self.expert_parallel_size > 1 and self.data_parallel_size % self.expert_parallel_size:
-            raise ValueError("expert_parallel_size must divide data_parallel_size")
+        # divisibility/axis legality is ParallelPlan.validate()'s job —
+        # ONE validator, one message style, whichever door (GPTConfig,
+        # make_mesh, build_schedule) an illegal combo walks through
+        from apex_tpu.plan.parallel_plan import ParallelPlan, PlanError
+
+        v = self.virtual_pipeline_model_parallel_size
+        if v is not None and self.pipeline_model_parallel_size < 2:
+            # stricter than the plan's lenient v=1: ASKING for virtual
+            # pipelining without a pipeline is a config error here
+            raise ValueError(
+                f"virtual_pipeline_model_parallel_size={v}: virtual "
+                "pipeline parallelism requires "
+                "pipeline_model_parallel_size >= 2")
+        try:
+            ParallelPlan(
+                dp=self.data_parallel_size,
+                tp=self.tensor_model_parallel_size,
+                pp=self.pipeline_model_parallel_size,
+                cp=self.context_parallel_size,
+                ep=self.expert_parallel_size,
+                virtual_chunks=v if v is not None else 1)
+        except PlanError as e:
+            raise ValueError(str(e)) from None
         split = self.pipeline_model_parallel_split_rank
         if split is not None and not (
                 0 < split < self.pipeline_model_parallel_size):
@@ -101,6 +117,32 @@ class MeshSpec:
         return self.data_parallel_size * self.model_parallel_size
 
 
+def _apply_plan(plan: "ParallelPlan", devices, loose):
+    """Unpack a ParallelPlan into the loose axis sizes + the sliced
+    device list (dp is authoritative — a host exposing more devices
+    must not silently widen it). One helper for both mesh doors so a
+    new plan field cannot be threaded through one and not the other.
+    ``loose`` carries the door's positional (tp, pp, cp, ep) kwargs: a
+    non-default loose size that disagrees with the plan is an eager
+    error (the GPTConfig rule) — never a silent merge."""
+    for name, got, want in (
+            ("tensor_model_parallel_size", loose[0], plan.tp),
+            ("pipeline_model_parallel_size", loose[1], plan.pp),
+            ("context_parallel_size", loose[2], plan.cp),
+            ("expert_parallel_size", loose[3], plan.ep)):
+        if got != 1 and got != want:
+            raise ValueError(
+                f"{name}={got} contradicts plan={plan.describe()} "
+                f"(which implies {name}={want}); pass the knob through "
+                f"the plan, not alongside it")
+    if plan.world_size > len(devices):
+        raise RuntimeError(
+            f"plan {plan.describe()} spans {plan.world_size} "
+            f"device(s); only {len(devices)} available")
+    return (plan.tp, plan.pp, plan.cp, plan.ep,
+            devices[: plan.world_size])
+
+
 def initialize_model_parallel(
     tensor_model_parallel_size: int = 1,
     pipeline_model_parallel_size: int = 1,
@@ -110,6 +152,7 @@ def initialize_model_parallel(
     expert_parallel_size: int = 1,
     pipeline_model_parallel_split_rank: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,
+    plan: Optional["ParallelPlan"] = None,
 ) -> Mesh:
     """Build and install the global mesh.
 
@@ -123,6 +166,23 @@ def initialize_model_parallel(
     global _MESH, _SPEC
     if devices is None:
         devices = jax.devices()
+    if plan is not None:
+        (tensor_model_parallel_size, pipeline_model_parallel_size,
+         context_parallel_size, expert_parallel_size,
+         devices) = _apply_plan(plan, devices, (
+             tensor_model_parallel_size, pipeline_model_parallel_size,
+             context_parallel_size, expert_parallel_size))
+        v = virtual_pipeline_model_parallel_size
+        if v is not None and v != plan.virtual_chunks:
+            # the plan is the single source of truth: a loose v that
+            # disagrees must not silently merge into the MeshSpec
+            raise ValueError(
+                f"virtual_pipeline_model_parallel_size={v} contradicts "
+                f"plan={plan.describe()} (virtual_chunks="
+                f"{plan.virtual_chunks}); pass the knob through the "
+                f"plan, not alongside it")
+        virtual_pipeline_model_parallel_size = (
+            plan.virtual_chunks if plan.virtual_chunks > 1 else None)
     world_size = len(devices)
     model_parallel = (
         tensor_model_parallel_size * pipeline_model_parallel_size * context_parallel_size
@@ -160,10 +220,25 @@ def make_mesh(
     context_parallel_size: int = 1,
     expert_parallel_size: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
+    *,
+    plan: Optional["ParallelPlan"] = None,
 ) -> Mesh:
-    """Build a mesh without installing it globally (for tests / local use)."""
+    """Build a mesh without installing it globally (for tests / local use).
+
+    ``plan`` is the preferred spelling (ISSUE 12): axis sizes come from
+    one validated :class:`~apex_tpu.plan.parallel_plan.ParallelPlan`,
+    and the device list is sliced to exactly ``plan.world_size`` (the
+    plan's dp is authoritative — a host exposing more devices must not
+    silently widen dp). The positional sizes stay as the deprecated
+    loose-kwarg shim."""
     if devices is None:
         devices = jax.devices()
+    if plan is not None:
+        (tensor_model_parallel_size, pipeline_model_parallel_size,
+         context_parallel_size, expert_parallel_size,
+         devices) = _apply_plan(plan, devices, (
+             tensor_model_parallel_size, pipeline_model_parallel_size,
+             context_parallel_size, expert_parallel_size))
     model_parallel = (
         tensor_model_parallel_size * pipeline_model_parallel_size * context_parallel_size
     )
@@ -187,7 +262,13 @@ def _build_mesh(devices, dp, ep, pp, cp, tp) -> Mesh:
     5-D; otherwise the classic 4-D layout."""
     if ep > 1:
         if dp % ep:
-            raise ValueError(
+            # same validator (and message style) as every other door
+            from apex_tpu.plan.parallel_plan import ParallelPlan, PlanError
+            try:
+                ParallelPlan(dp=dp, ep=ep)
+            except PlanError as e:
+                raise ValueError(str(e)) from None
+            raise ValueError(  # pragma: no cover - plan rejects first
                 f"expert_parallel_size ({ep}) must divide the "
                 f"data-parallel extent ({dp})")
         device_array = np.asarray(devices).reshape(dp // ep, ep, pp, cp, tp)

@@ -1,0 +1,52 @@
+"""Auto-parallelism planning: ``ParallelPlan`` + CostDB-driven search.
+
+The subsystem ROADMAP item 1 names, built on three substrates that
+already ship: PR 6's CostDB (measured bytes/s per collective
+kind×axis×size bucket, FLOP/s per GEMM class), PR 8's
+``pipeline_cost_model`` (schedule slot-waste/recompute geometry), and
+PR 10's ``static_cost`` jaxpr walk (per-collective bytes and per-GEMM
+FLOPs of a traced program, scan-multiplied).
+
+* :class:`ParallelPlan` — one frozen object for every parallelism knob
+  (dp/tp/pp/cp/ep, SP, ``tp_overlap``, ``pp_schedule``,
+  ``overlap_p2p``, virtual chunks, ZeRO) with eager cross-field
+  validation in one message style; consumed by ``GPTConfig``/
+  ``T5Config`` (``plan=``), :func:`apex_tpu.parallel.mesh.make_mesh`
+  and ``bench.py`` (the loose kwargs stay as a deprecated shim).
+* :mod:`~apex_tpu.plan.cost` — price a candidate plan: trace its
+  per-chip step abstractly (``ShapeDtypeStruct`` through
+  ``jax.make_jaxpr``, no execution), convert the StaticCostReport's
+  bytes/FLOPs through the CostDB's nearest bucket/class rates, apply
+  the schedule geometry factor, and estimate per-chip memory from the
+  sharded avals. Blind-spot keys surface in ``uncalibrated``.
+* :mod:`~apex_tpu.plan.search` — enumerate the feasible lattice for a
+  chip count + memory bound, rank by predicted step time, and build
+  the schema-validated ``plan`` record (``bench.py --plan`` emits it;
+  ``tools/bench_history.py`` gates its predicted-vs-measured error).
+
+See ``docs/api/plan.md`` for the pricing math and a worked example,
+and the TRAINING_GUIDE's "choosing a plan" chapter for the workflow.
+"""
+
+from apex_tpu.plan.cost import (  # noqa: F401
+    PlanMemory,
+    PlanPrice,
+    Workload,
+    build_plan_step,
+    conservative_defaults,
+    estimate_memory,
+    price_plan,
+    static_cost_for_plan,
+)
+from apex_tpu.plan.parallel_plan import (  # noqa: F401
+    PP_SCHEDULES,
+    ParallelPlan,
+    PlanError,
+)
+from apex_tpu.plan.search import (  # noqa: F401
+    PlanCandidate,
+    SearchResult,
+    enumerate_plans,
+    plan_record_fields,
+    search_plans,
+)

@@ -1,0 +1,452 @@
+"""Analytical plan pricing: StaticCostReport × CostDB × schedule model.
+
+The AMP recipe (arXiv:2210.07297): a candidate plan's step time is
+priced, not guessed, from (a) the *traced* per-chip program's static
+cost — every collective's payload bytes by ``<kind>[<axis>]`` and every
+GEMM's FLOPs by power-of-two class, multiplied through enclosing scans
+(:func:`apex_tpu.lint.jaxpr_check.static_cost`, PR 10) — converted
+through (b) the *measured* CostDB's achieved bytes/s per size bucket
+and FLOP/s per GEMM class (:mod:`apex_tpu.prof.calibrate`, PR 6), with
+(c) the pipeline schedule's slot-waste/recompute geometry
+(:func:`apex_tpu.monitor.hooks.pipeline_cost_model`, PR 8) as an
+explicit multiplier. Heterogeneity needs no special case: CostDB keys
+carry the mesh axis, so a topology whose dp hops ride DCN prices
+``psum[dp]`` from its own (slower) measured rows — slow-axis entries
+reprice dp-vs-tp placement exactly as AMP's heterogeneity term does.
+
+Tracing is abstract: the plan's step is built on the virtual CPU mesh
+and walked via ``jax.make_jaxpr`` over ``ShapeDtypeStruct`` operands —
+no device buffer is allocated and nothing executes, so pricing a
+64-layer plan costs milliseconds regardless of workload size.
+
+Composition (one formula, documented with a worked example in
+``docs/api/plan.md``)::
+
+    factor       = (total_units + recompute_units·remat) / ideal_units
+    predicted_ms = (gemm_ms + tp_ms + cp_ms) · factor
+                   + dp_ms + (0 if overlap_p2p else pp_ms)
+
+where ``*_ms = bytes/rate`` (or ``flops/rate``) summed per axis
+family. The schedule factor makes zb-vs-1f1b a priced choice (zb drops
+the drain slots but — under remat — pays ``M·v`` extra recompute), and
+the ``overlap_p2p`` branch makes overlap-vs-blocking one (overlap
+hides the hop bytes but lengthens the drain through the factor's
+``L=2`` geometry).
+
+A traced key the CostDB has never measured is a *blind spot*, not a
+zero: it is priced at the optional ``default_*`` rate (or omitted) and
+always reported in ``uncalibrated`` — the per-plan confidence flag the
+``plan`` record carries, the same surface ``prof.calibrate
+.diff_static_cost`` exposes for the lint CLI's ``--strict`` gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+from apex_tpu.plan.parallel_plan import ParallelPlan, PlanError
+
+
+@dataclasses.dataclass(frozen=True)
+class Workload:
+    """The model + batch geometry a plan is priced for (the flagship
+    GPT-medium dims by default — ``bench.py``'s train config)."""
+
+    hidden_size: int = 1024
+    ffn_hidden_size: Optional[int] = None  # default 4*hidden
+    num_layers: int = 12
+    vocab_size: int = 32768
+    seq: int = 1024
+    global_batch: int = 16
+    micro_batch: int = 2
+    dtype_bytes: int = 2          # bf16 activations/params
+    remat: bool = False           # per-tick recompute priced when True
+
+    @property
+    def ffn(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    def layers_per_chunk(self, plan: ParallelPlan) -> int:
+        """Layers one pipeline chunk holds; raises (never truncates)
+        when the stack does not divide — pricing a 12-layer model as a
+        10-layer one would silently compare different models."""
+        ways = plan.pp * plan.virtual_chunks
+        if self.num_layers % ways:
+            raise PlanError(
+                f"num_layers={self.num_layers} is not divisible by "
+                f"pp*virtual_chunks ({plan.pp}*{plan.virtual_chunks}); "
+                f"legal pp/virtual_chunks values divide the layer stack")
+        return self.num_layers // ways
+
+    def microbatches(self, plan: ParallelPlan) -> int:
+        """Microbatches per dp replica per step; raises when the global
+        batch does not divide (same eagerness as ``build_schedule``)."""
+        per = self.micro_batch * plan.dp
+        if self.global_batch % per:
+            raise PlanError(
+                f"global_batch={self.global_batch} is not divisible by "
+                f"micro_batch*dp ({self.micro_batch}*{plan.dp}); legal "
+                f"dp values divide global_batch/micro_batch")
+        return self.global_batch // per
+
+
+# --- the traced per-chip step -------------------------------------------------
+
+#: trace cache: the jaxpr walk depends only on the signature below, not
+#: on the schedule/overlap_p2p/zero knobs (those price through the cost
+#: model), so a lattice sweep re-traces only distinct programs
+_STATIC_CACHE: Dict[Tuple, Dict[str, Any]] = {}
+
+
+def _trace_signature(plan: ParallelPlan, w: Workload,
+                     ticks: int) -> Tuple:
+    return (plan.dp, plan.tp, plan.pp, plan.sequence_parallel,
+            plan.tp_overlap, ticks, w.hidden_size, w.ffn, w.num_layers,
+            plan.virtual_chunks, w.vocab_size, w.seq, w.micro_batch,
+            w.dtype_bytes)
+
+
+def build_plan_step(plan: ParallelPlan, w: Workload):
+    """``(fn, args)``: one dp replica's full train step under the plan —
+    per-tick stage compute (``layers/(pp·v)`` Column→Row GEMM blocks,
+    tp-sharded with the plan's SP/overlap knobs), the pp boundary hop
+    per tick, the vocab head GEMM, grads, the dp grad all-reduce, and
+    an SGD rebind — as a ``shard_map`` program over the plan's mesh
+    axes, with ``ShapeDtypeStruct`` operands ready for
+    ``jax.make_jaxpr``. Schedule choice does NOT change this program
+    (warmup/drain and recompute price through
+    ``pipeline_cost_model``); it is the per-chip *useful work* whose
+    collectives and GEMMs the CostDB can rate."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel import mesh as mesh_lib
+    from apex_tpu.transformer import tensor_parallel as tp_lib
+
+    world = plan.world_size
+    if world > jax.device_count():
+        raise PlanError(
+            f"plan {plan.describe()} spans {world} chips but this host "
+            f"exposes {jax.device_count()} device(s); tracing needs a "
+            f"mesh at the plan's extent")
+    mesh = mesh_lib.make_mesh(
+        tensor_model_parallel_size=plan.tp,
+        pipeline_model_parallel_size=plan.pp,
+        context_parallel_size=plan.cp,
+        devices=jax.devices()[:world])
+
+    tp, pp = plan.tp, plan.pp
+    H, ffn, V, s, b = (w.hidden_size, w.ffn, w.vocab_size, w.seq,
+                       w.micro_batch)
+    lc = w.layers_per_chunk(plan)
+    ticks = w.microbatches(plan) * plan.virtual_chunks
+    sp = plan.sequence_parallel and tp > 1
+    axis = "tp" if tp > 1 else None
+    dt = {2: jnp.bfloat16, 4: jnp.float32}[w.dtype_bytes]
+
+    col = tp_lib.ColumnParallelLinear(
+        H, ffn, bias=False, tp_size=tp, axis_name=axis,
+        sequence_parallel=sp, seq_dim=1, overlap_comm=plan.tp_overlap)
+    row = tp_lib.RowParallelLinear(
+        ffn, H, bias=False, tp_size=tp, axis_name=axis,
+        sequence_parallel=sp, seq_dim=1, overlap_comm=plan.tp_overlap)
+    head = tp_lib.ColumnParallelLinear(
+        H, V, bias=False, tp_size=tp, axis_name=axis,
+        sequence_parallel=sp, seq_dim=1, overlap_comm=plan.tp_overlap)
+
+    def layer(h, wpair):
+        w1, w2 = wpair
+        up = col({"weight": w1}, h)
+        return h + row({"weight": w2}, jax.nn.gelu(up, approximate=True))
+
+    def step(params, x, tgt):
+        def tick(loss, xs):
+            xt, tt = xs
+            h, _ = jax.lax.scan(
+                lambda c, wl: (layer(c, wl), None),
+                xt, (params["w1"], params["w2"]))
+            if pp > 1:
+                n = jax.lax.axis_size("pp")
+                h = jax.lax.ppermute(
+                    h, "pp", [(i, (i + 1) % n) for i in range(n)])
+            logits = head({"weight": params["head"]}, h)
+            # two terms, not logits-vs-target: logits are vocab-width
+            # and (under SP) h is seq-sharded — the GEMMs/collectives
+            # are what is being counted, not the loss's value
+            err = jnp.mean((h.astype(jnp.float32)
+                            - tt.astype(jnp.float32)) ** 2)
+            return loss + err + jnp.mean(
+                logits.astype(jnp.float32) ** 2), None
+
+        def total(p):
+            out, _ = jax.lax.scan(tick, jnp.float32(0.0), (x, tgt))
+            return out
+
+        loss, grads = jax.value_and_grad(total)(params)
+        if plan.dp > 1:
+            grads = jax.tree.map(
+                lambda g: jax.lax.psum(g, "dp"), grads)
+        new = jax.tree.map(lambda p, g: p - 0.01 * g.astype(p.dtype),
+                           params, grads)
+        return new, loss
+
+    wspec = {"w1": P(None, "tp", None) if tp > 1 else P(),
+             "w2": P(None, None, "tp") if tp > 1 else P(),
+             "head": P("tp", None) if tp > 1 else P()}
+    xspec = P(None, None, "tp", None) if sp else P()
+    fn = mesh_lib.shard_map(step, mesh=mesh,
+                            in_specs=(wspec, xspec, xspec),
+                            out_specs=(wspec, P()))
+    sds = jax.ShapeDtypeStruct
+    params = {"w1": sds((lc, ffn, H), dt), "w2": sds((lc, H, ffn), dt),
+              "head": sds((V, H), dt)}
+    x = sds((ticks, b, s, H), dt)
+    return fn, (params, x, x)
+
+
+def static_cost_for_plan(plan: ParallelPlan, w: Workload
+                         ) -> Dict[str, Any]:
+    """The plan's per-chip :func:`~apex_tpu.lint.jaxpr_check
+    .static_cost` report — traced abstractly (no execution), memoized
+    per distinct program."""
+    ticks = w.microbatches(plan) * plan.virtual_chunks
+    key = _trace_signature(plan, w, ticks)
+    hit = _STATIC_CACHE.get(key)
+    if hit is not None:
+        return hit
+    import jax
+
+    from apex_tpu.lint import jaxpr_check as jx
+
+    fn, args = build_plan_step(plan, w)
+    closed = jax.make_jaxpr(fn)(*args)
+    report = jx.static_cost(
+        closed, entrypoint=f"plan_step:{'x'.join(map(str, key[:3]))}")
+    _STATIC_CACHE[key] = report
+    return report
+
+
+# --- CostDB conversion --------------------------------------------------------
+
+def _nearest_bucket_rate(rows: List[dict], per_call_bytes: float
+                         ) -> Optional[float]:
+    """Mean bytes/s of the size bucket nearest the payload — the ONE
+    shared rule in :func:`apex_tpu.prof.calibrate.nearest_bucket_rate`
+    (also behind ``diff_static_cost``), so the planner's prices and the
+    lint CLI's coverage table cannot diverge."""
+    from apex_tpu.prof.calibrate import nearest_bucket_rate
+
+    return nearest_bucket_rate(rows, per_call_bytes)
+
+
+def _nearest_gemm_rate(gemms: Dict[str, dict], cls: str
+                       ) -> Tuple[Optional[float], bool]:
+    """``(flops/s, exact)`` for a GEMM class: the class's own measured
+    mean when present, else the nearest class by log2 FLOPs distance
+    (``exact=False`` — a shape class the CostDB never measured is still
+    calibrated *compute*, just priced from its nearest neighbor)."""
+    ent = gemms.get(cls)
+    if ent and ent.get("flops_per_s", {}).get("mean", 0) > 0:
+        return ent["flops_per_s"]["mean"], True
+    want = math.log2(max(int(cls.rsplit("_", 1)[-1]), 1))
+    best, dist = None, None
+    for name, e in sorted(gemms.items()):
+        rate = e.get("flops_per_s", {}).get("mean", 0)
+        if rate <= 0:
+            continue
+        d = abs(math.log2(max(int(name.rsplit("_", 1)[-1]), 1)) - want)
+        if dist is None or d < dist:
+            best, dist = rate, d
+    return best, False
+
+
+def _axis_of(key: str) -> str:
+    """Mesh axis family of a ``<kind>[<axis>]`` collective key (the
+    first axis named — multi-axis keys like ``psum[dp,ep]`` bill to
+    their outer family)."""
+    inside = key.split("[", 1)[-1].rstrip("]")
+    return inside.split(",", 1)[0].strip()
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanMemory:
+    """Per-chip HBM estimate (bytes), from the plan's sharded avals."""
+
+    params: int
+    optimizer: int
+    activations: int
+
+    @property
+    def total(self) -> int:
+        return self.params + self.optimizer + self.activations
+
+    def to_json(self) -> Dict[str, float]:
+        mb = 1 / 2 ** 20
+        return {"params_mb": round(self.params * mb, 2),
+                "optimizer_mb": round(self.optimizer * mb, 2),
+                "activations_mb": round(self.activations * mb, 2),
+                "total_mb": round(self.total * mb, 2)}
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanPrice:
+    """One plan's predicted step decomposition. ``uncalibrated`` is the
+    confidence surface: traced cost keys the CostDB has never measured
+    (empty ⇒ ``confidence == "calibrated"``)."""
+
+    plan: ParallelPlan
+    predicted_step_ms: float
+    gemm_ms: float
+    tp_ms: float
+    pp_ms: float
+    dp_ms: float
+    cp_ms: float
+    schedule_factor: float
+    bubble_fraction: float
+    memory: PlanMemory
+    uncalibrated: Tuple[str, ...]
+
+    @property
+    def confidence(self) -> str:
+        return "calibrated" if not self.uncalibrated else "partial"
+
+    def to_json(self) -> Dict[str, Any]:
+        # collective_ms is the EXPOSED, schedule-scaled share (pp hops
+        # hidden under overlap_p2p; tp/cp ride every scheduled slot),
+        # so gemm_ms·schedule_factor + collective_ms reconciles with
+        # predicted_step_ms exactly, for every plan
+        hidden = self.plan.overlap_p2p and self.plan.pp > 1
+        exposed = ((self.tp_ms + self.cp_ms) * self.schedule_factor
+                   + self.dp_ms + (0.0 if hidden else self.pp_ms))
+        return {
+            "plan": self.plan.to_json(),
+            "predicted_step_ms": round(self.predicted_step_ms, 4),
+            "confidence": self.confidence,
+            "uncalibrated": list(self.uncalibrated),
+            "gemm_ms": round(self.gemm_ms, 4),
+            "collective_ms": round(exposed, 4),
+            "schedule_factor": round(self.schedule_factor, 4),
+            "bubble_pct": round(100 * self.bubble_fraction, 2),
+            "predicted_memory_mb": self.memory.to_json()["total_mb"],
+        }
+
+
+def estimate_memory(plan: ParallelPlan, w: Workload) -> PlanMemory:
+    """Per-chip params + optimizer + activations from the plan's
+    sharded shapes: ``layers/(pp·v·?)``… params shard over tp (and the
+    stage axis), optimizer state is fp32 master+m+v (ZeRO divides it by
+    dp), and the activation term counts the schedule's live microbatch
+    stash (zb stashes all ``M·v`` tick inputs for the deferred dW
+    sweep; 1f1b holds at most ``pp`` in flight)."""
+    H, ffn, V = w.hidden_size, w.ffn, w.vocab_size
+    lc = w.layers_per_chunk(plan)
+    layer_params = 2 * H * ffn  # col + row weights
+    per_chip_params = (lc * plan.virtual_chunks * layer_params
+                       + V * H) // plan.tp
+    param_bytes = per_chip_params * w.dtype_bytes
+    # fp32 master + adam m + v = 12 bytes/param, dp-sharded under ZeRO
+    opt_bytes = per_chip_params * 12
+    if plan.zero:
+        opt_bytes //= plan.dp
+    b, s = w.micro_batch, w.seq
+    act = b * s * H * w.dtype_bytes
+    if plan.cp > 1:
+        act //= plan.cp
+    ticks = w.microbatches(plan) * plan.virtual_chunks
+    if plan.pp > 1:
+        live = ticks if plan.pp_schedule == "zb" else min(plan.pp, ticks)
+    else:
+        live = 1
+    # stashed tick inputs + one microbatch's block residuals (H + ffn
+    # per layer, tp-sharded with SP/tp on the wide dim)
+    resid = b * s * (H + ffn // plan.tp) * w.dtype_bytes * lc
+    if plan.sequence_parallel:
+        resid //= plan.tp
+    return PlanMemory(params=param_bytes, optimizer=opt_bytes,
+                      activations=live * act + resid)
+
+
+def conservative_defaults(costdb: Dict[str, Any]) -> Dict[str, float]:
+    """Default rates for CostDB blind spots: the SLOWEST measured rate
+    of each family (uniform reference floors when a family is empty).
+    Pricing an unmeasured key at the worst measured rate *penalizes*
+    uncalibrated traffic — without this, ``rate=None`` keys cost 0 ms
+    and a plan could win the ranking precisely because its dominant
+    traffic was never measured. ``bench.py --plan`` feeds these to
+    :func:`price_plan` for every CostDB, measured or not."""
+    coll = [r["bytes_per_s"]["mean"]
+            for rows in (costdb.get("collectives") or {}).values()
+            for r in rows
+            if r.get("bytes_per_s", {}).get("mean", 0) > 0]
+    gemm = [e["flops_per_s"]["mean"]
+            for e in (costdb.get("gemms") or {}).values()
+            if e.get("flops_per_s", {}).get("mean", 0) > 0]
+    return {"default_bytes_per_s": min(coll) if coll else 1e10,
+            "default_flops_per_s": min(gemm) if gemm else 1e14}
+
+
+def price_plan(plan: ParallelPlan, w: Workload, costdb: Dict[str, Any],
+               *, default_bytes_per_s: Optional[float] = None,
+               default_flops_per_s: Optional[float] = None) -> PlanPrice:
+    """Price one plan against a measured CostDB.
+
+    Deterministic: the same (plan, workload, costdb) prices to the same
+    bits — pinned by ``tests/test_plan.py`` — and monotone: raising any
+    CostDB rate never makes any plan slower. ``default_*`` rates price
+    blind-spot keys so relative ranking survives a sparse CostDB; the
+    keys stay listed in ``uncalibrated`` either way (a defaulted price
+    is a labeled guess, never silent)."""
+    from apex_tpu.monitor.hooks import pipeline_cost_model
+
+    static = static_cost_for_plan(plan, w)
+    db_coll = costdb.get("collectives", {}) or {}
+    db_gemms = costdb.get("gemms", {}) or {}
+    uncal: List[str] = []
+
+    axis_ms = {"tp": 0.0, "pp": 0.0, "dp": 0.0, "cp": 0.0, "ep": 0.0}
+    for key, ent in sorted(static.get("collectives", {}).items()):
+        calls = max(int(ent.get("calls", 0)), 1)
+        total_bytes = float(ent.get("bytes", 0))
+        rate = _nearest_bucket_rate(db_coll.get(key) or [],
+                                    total_bytes / calls)
+        if rate is None:
+            uncal.append(key)
+            rate = default_bytes_per_s
+        if rate:
+            axis = _axis_of(key)
+            axis_ms[axis if axis in axis_ms else "dp"] += \
+                1e3 * total_bytes / rate
+
+    gemm_ms = 0.0
+    for cls, ent in sorted(static.get("gemms", {}).items()):
+        flops = float(ent.get("flops", 0.0))
+        rate, _exact = _nearest_gemm_rate(db_gemms, cls)
+        if rate is None:
+            uncal.append(cls)
+            rate = default_flops_per_s
+        if rate:
+            gemm_ms += 1e3 * flops / rate
+
+    m = w.microbatches(plan)
+    geo = pipeline_cost_model(
+        m, plan.pp, plan.virtual_chunks,
+        schedule=plan.pp_schedule if plan.pp > 1 else "1f1b",
+        overlap_p2p=plan.overlap_p2p and plan.pp > 1)
+    units = geo["total_units"] + (geo["recompute_units"] if w.remat
+                                  else 0)
+    factor = units / geo["ideal_units"]
+    pp_exposed = 0.0 if (plan.overlap_p2p and plan.pp > 1) \
+        else axis_ms["pp"]
+    predicted = ((gemm_ms + axis_ms["tp"] + axis_ms["cp"]) * factor
+                 + axis_ms["dp"] + axis_ms["ep"] + pp_exposed)
+    return PlanPrice(
+        plan=plan, predicted_step_ms=predicted, gemm_ms=gemm_ms,
+        tp_ms=axis_ms["tp"], pp_ms=axis_ms["pp"],
+        dp_ms=axis_ms["dp"] + axis_ms["ep"], cp_ms=axis_ms["cp"],
+        schedule_factor=factor,
+        bubble_fraction=geo["bubble_fraction"],
+        memory=estimate_memory(plan, w),
+        uncalibrated=tuple(sorted(set(uncal))))

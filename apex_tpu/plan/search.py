@@ -1,0 +1,216 @@
+"""Feasible-plan enumeration + ranking: the planner's search loop.
+
+:func:`search_plans` walks the plan lattice for a chip count — every
+``dp·tp·pp`` factorization crossed with the schedule/overlap/SP/ZeRO
+knobs — filters it through :meth:`ParallelPlan.validate` plus the
+workload's divisibility and a per-chip memory bound (the
+:func:`~apex_tpu.plan.cost.estimate_memory` aval estimate), prices
+every survivor through :func:`~apex_tpu.plan.cost.price_plan`, and
+returns plans ranked by predicted step time with a per-plan confidence
+flag (``uncalibrated`` CostDB blind spots surfaced, never silently
+priced). Infeasible corners are kept with their reasons — a planner
+that silently drops half the lattice is indistinguishable from one
+that searched it.
+
+:func:`plan_record_fields` turns a search result (plus the optional
+measured step time) into the schema-validated ``plan`` monitor record
+(:data:`apex_tpu.monitor.schema.PLAN_SCHEMA`) that ``bench.py --plan``
+emits and ``tools/bench_history.py`` gates for predicted-vs-measured
+error drift.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from apex_tpu.plan.cost import (
+    PlanPrice,
+    Workload,
+    estimate_memory,
+    price_plan,
+)
+from apex_tpu.plan.parallel_plan import ParallelPlan, PlanError
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanCandidate:
+    plan: ParallelPlan
+    price: PlanPrice
+
+    def to_json(self) -> Dict[str, Any]:
+        return self.price.to_json()
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchResult:
+    """Ranked feasible plans (best first) + the rejected corners."""
+
+    chips: int
+    workload: Workload
+    ranked: Tuple[PlanCandidate, ...]
+    rejected: Tuple[Tuple[str, str], ...]  # (plan description, reason)
+
+    @property
+    def best(self) -> PlanCandidate:
+        if not self.ranked:
+            raise PlanError(
+                f"no feasible plan for {self.chips} chip(s); rejected: "
+                + "; ".join(f"{d} ({r})" for d, r in self.rejected[:8]))
+        return self.ranked[0]
+
+
+def _factorizations(chips: int) -> List[Tuple[int, int, int]]:
+    """Every (dp, tp, pp) with dp·tp·pp == chips, deterministic order."""
+    out = []
+    for dp in range(1, chips + 1):
+        if chips % dp:
+            continue
+        rest = chips // dp
+        for tp in range(1, rest + 1):
+            if rest % tp:
+                continue
+            out.append((dp, tp, rest // tp))
+    return out
+
+
+def enumerate_plans(chips: int, w: Workload, *,
+                    max_virtual_chunks: int = 2,
+                    include_zero: bool = True
+                    ) -> Tuple[List[ParallelPlan],
+                               List[Tuple[str, str]]]:
+    """The feasible lattice + rejections. Knob policy: SP is paired on
+    whenever tp > 1 (the production pairing every tp bench leg runs);
+    ``tp_overlap`` and — at pp > 1 — schedule × ``overlap_p2p`` are
+    enumerated both ways (they are exactly the priced choices);
+    ``zero`` is enumerated at dp > 1 (it reprices memory, which the
+    bound may need). cp/ep stay 1 in this lattice (ring-attention and
+    expert placement search are follow-on work — rejecting them here
+    would be claiming a search that never ran)."""
+    plans: List[ParallelPlan] = []
+    rejected: List[Tuple[str, str]] = []
+    for dp, tp, pp in _factorizations(chips):
+        tag = f"dp{dp}·tp{tp}·pp{pp}"
+        if w.global_batch % (w.micro_batch * dp):
+            rejected.append((tag, f"global_batch {w.global_batch} not "
+                             f"divisible by micro_batch*dp "
+                             f"({w.micro_batch}*{dp})"))
+            continue
+        m = w.global_batch // (w.micro_batch * dp)
+        if tp > 1 and (w.ffn % tp or w.vocab_size % tp or w.seq % tp):
+            rejected.append((tag, f"tp={tp} does not divide "
+                             f"ffn/vocab/seq "
+                             f"({w.ffn}/{w.vocab_size}/{w.seq})"))
+            continue
+        vs = [v for v in range(1, max_virtual_chunks + 1)
+              if w.num_layers % (pp * v) == 0 and (v == 1 or pp > 1)]
+        if not vs:
+            rejected.append((tag, f"num_layers {w.num_layers} not "
+                             f"divisible by pp ({pp})"))
+            continue
+        for v in vs:
+            for schedule in (("1f1b", "zb") if pp > 1 else ("1f1b",)):
+                for p2p in ((False, True) if pp > 1 else (False,)):
+                    # geometry legality does not depend on the
+                    # tp_overlap/zero knobs — judge it ONCE per
+                    # (schedule, p2p, v) so a rejected corner appears
+                    # once in the record, not once per inner flag combo
+                    try:
+                        probe = ParallelPlan(
+                            dp=dp, tp=tp, pp=pp,
+                            sequence_parallel=tp > 1,
+                            pp_schedule=schedule, overlap_p2p=p2p,
+                            virtual_chunks=v)
+                        if pp > 1:
+                            probe.validate_schedule()
+                        probe.validate_microbatches(m)
+                    except PlanError as e:
+                        rejected.append(
+                            (f"{tag} {schedule}v{v}"
+                             + ("+p2p" if p2p else ""), str(e)))
+                        continue
+                    for tov in ((False, True) if tp > 1 else (False,)):
+                        for zero in ((False, True)
+                                     if (include_zero and dp > 1)
+                                     else (False,)):
+                            plans.append(dataclasses.replace(
+                                probe, tp_overlap=tov, zero=zero))
+    return plans, rejected
+
+
+def search_plans(chips: int, w: Workload, costdb: Dict[str, Any], *,
+                 memory_bound_bytes: Optional[int] = None,
+                 max_virtual_chunks: int = 2,
+                 include_zero: bool = True,
+                 default_bytes_per_s: Optional[float] = None,
+                 default_flops_per_s: Optional[float] = None
+                 ) -> SearchResult:
+    """Enumerate → filter (validity, divisibility, memory bound) →
+    price → rank. Deterministic: ties break on the plan's describe()
+    string, and pricing itself is bit-deterministic."""
+    plans, rejected = enumerate_plans(
+        chips, w, max_virtual_chunks=max_virtual_chunks,
+        include_zero=include_zero)
+    ranked: List[PlanCandidate] = []
+    for plan in plans:
+        try:
+            if memory_bound_bytes is not None:
+                # the aval memory estimate needs no trace — reject
+                # over-bound plans before paying for one
+                mem = estimate_memory(plan, w)
+                if mem.total > memory_bound_bytes:
+                    rejected.append(
+                        (plan.describe(),
+                         f"predicted per-chip memory "
+                         f"{mem.total / 2**20:.0f} MB exceeds the "
+                         f"bound {memory_bound_bytes / 2**20:.0f} MB"))
+                    continue
+            price = price_plan(plan, w, costdb,
+                               default_bytes_per_s=default_bytes_per_s,
+                               default_flops_per_s=default_flops_per_s)
+        except PlanError as e:
+            rejected.append((plan.describe(), str(e)))
+            continue
+        ranked.append(PlanCandidate(plan, price))
+    ranked.sort(key=lambda c: (c.price.predicted_step_ms,
+                               c.plan.describe()))
+    return SearchResult(chips=chips, workload=w, ranked=tuple(ranked),
+                        rejected=tuple(rejected))
+
+
+def plan_record_fields(result: SearchResult, *,
+                       costdb_source: str,
+                       top_n: int = 8,
+                       measured_step_ms: Optional[float] = None,
+                       skip_reason: Optional[str] = None
+                       ) -> Dict[str, Any]:
+    """The ``plan`` record's field dict (caller adds status/reason and
+    emits through :meth:`MetricsRegistry.emit_plan`). The measured half
+    rides as an explicit ``('skipped', reason)`` when no honest
+    measurement exists (off-TPU) — never nan."""
+    best = result.best
+    fields: Dict[str, Any] = {
+        "chips": result.chips,
+        "searched": len(result.ranked) + len(result.rejected),
+        "feasible": len(result.ranked),
+        "chosen": best.plan.to_json(),
+        "chosen_describe": best.plan.describe(),
+        "predicted_step_ms": round(best.price.predicted_step_ms, 4),
+        "confidence": best.price.confidence,
+        "uncalibrated": list(best.price.uncalibrated),
+        "predicted_memory_mb": best.price.memory.to_json()["total_mb"],
+        "ranking": [c.to_json() for c in result.ranked[:top_n]],
+        "rejected": [{"plan": d, "reason": r}
+                     for d, r in result.rejected[:top_n]],
+        "costdb_source": costdb_source,
+    }
+    if measured_step_ms is not None:
+        err = (100.0 * (best.price.predicted_step_ms - measured_step_ms)
+               / measured_step_ms)
+        fields["measured_step_ms"] = round(measured_step_ms, 4)
+        fields["predicted_vs_measured_err_pct"] = round(abs(err), 3)
+    else:
+        reason = skip_reason or "no measured step time supplied"
+        fields["measured_step_ms"] = ("skipped", reason)
+        fields["predicted_vs_measured_err_pct"] = ("skipped", reason)
+    return fields

@@ -219,6 +219,32 @@ def build_costdb(records: Sequence[dict], events, *,
     return db
 
 
+def nearest_bucket_row(rows: Sequence[dict],
+                       per_call_bytes: float) -> Optional[dict]:
+    """The CostDB size-bucket row nearest the payload (log2 distance
+    over ``bucket_bytes``, positive-rate rows only); ``None`` when no
+    row carries a rate. THE bucket-matching rule — shared by
+    :func:`diff_static_cost` and the planner's
+    :func:`apex_tpu.plan.cost.price_plan`, so the lint CLI's coverage
+    table and the planner's prices cannot silently diverge."""
+    import math
+
+    rated = [r for r in rows
+             if r.get("bytes_per_s", {}).get("mean", 0) > 0]
+    if not rated:
+        return None
+    return min(rated, key=lambda r: abs(
+        math.log2(max(r["bucket_bytes"], 1))
+        - math.log2(max(per_call_bytes, 1))))
+
+
+def nearest_bucket_rate(rows: Sequence[dict],
+                        per_call_bytes: float) -> Optional[float]:
+    """Mean achieved bytes/s of :func:`nearest_bucket_row`'s pick."""
+    row = nearest_bucket_row(rows, per_call_bytes)
+    return None if row is None else row["bytes_per_s"]["mean"]
+
+
 def diff_static_cost(static: dict, costdb: dict) -> dict:
     """Line a ``kind:"static_cost"`` report (the jaxpr walker's PREDICTED
     per-collective bytes and per-GEMM FLOPs,
@@ -240,10 +266,15 @@ def diff_static_cost(static: dict, costdb: dict) -> dict:
 
     A traced collective with no CostDB row is exactly the planner's
     blind spot — the caller surfaces ``uncovered`` loudly rather than
-    pricing it at a made-up rate.
+    pricing it at a made-up rate. The surface is STRUCTURAL, not table
+    prose (ISSUE 12 satellite): ``apex_tpu.plan.cost.price_plan``
+    consumes the same blind-spot semantics as its per-plan
+    ``uncalibrated`` confidence flag, the lint CLI embeds every
+    entrypoint's ``uncovered`` list in its JSON report's
+    ``uncalibrated`` section, and ``python -m apex_tpu.lint --jaxpr
+    --costdb F --strict`` turns a nonempty surface into a nonzero
+    exit for CI.
     """
-    import math
-
     rows: List[dict] = []
     db_coll = costdb.get("collectives", {}) or {}
     for key, ent in sorted((static.get("collectives") or {}).items()):
@@ -252,13 +283,8 @@ def diff_static_cost(static: dict, costdb: dict) -> dict:
         per_call = total_bytes / calls
         row = {"key": key, "unit": "bytes", "calls": int(ent.get("calls", 0)),
                "bytes": total_bytes, "calibrated": False}
-        buckets = db_coll.get(key) or []
-        rated = [b for b in buckets
-                 if b.get("bytes_per_s", {}).get("mean", 0) > 0]
-        if rated:
-            best = min(rated, key=lambda b: abs(
-                math.log2(max(b["bucket_bytes"], 1))
-                - math.log2(max(per_call, 1))))
+        best = nearest_bucket_row(db_coll.get(key) or [], per_call)
+        if best is not None:
             rate = best["bytes_per_s"]["mean"]
             row.update(calibrated=True, bucket=best["bucket_bytes"],
                        rate=rate, predicted_ms=1e3 * total_bytes / rate)

@@ -936,52 +936,53 @@ def build_schedule(
             f"schedule='1f1b' with virtual_pipeline_model_parallel_size="
             f"{v} is contradictory — interleaving IS the virtual-chunk "
             "schedule; pass schedule='interleaved' (or None)")
-    if schedule in ("interleaved", "zb") and pp < 2:
-        raise ValueError(
-            f"schedule={schedule!r} needs pipeline_model_parallel_size "
-            f">= 2 (got {pp}); a single stage has no pipeline to "
-            "schedule")
+
+    # geometry legality is ParallelPlan.validate*()'s job (ISSUE 12
+    # satellite): the same illegal combo rejected with the same message
+    # whichever door it walks through (GPTConfig / make_mesh / here).
+    # The plan's virtual_chunks carries v only when a pipeline exists or
+    # interleaving was explicitly demanded — the legacy infer path
+    # (schedule=None, v set, pp=1) stays a no-op.
+    from apex_tpu.plan.parallel_plan import ParallelPlan, PlanError
+
+    try:
+        plan = ParallelPlan(
+            dp=data_parallel_size, pp=pp,
+            pp_schedule="zb" if schedule == "zb" else "1f1b",
+            overlap_p2p=bool(overlap_p2p) and pp > 1,
+            virtual_chunks=((v or 1) if (pp > 1
+                                         or schedule == "interleaved")
+                            else 1))
+        if schedule is not None:
+            plan.validate_schedule()
+    except PlanError as e:
+        raise ValueError(str(e)) from None
 
     calc = build_num_microbatches_calculator(
         global_batch_size, micro_batch_size, data_parallel_size,
         rampup_batch_size,
     )
-    if pp > 1 and calc.get() < pp:
-        raise ValueError(
-            f"{calc.get()} microbatches cannot fill a "
-            f"{pp}-stage pipeline; lower "
-            "micro_batch_size or raise global_batch_size"
-        )
-    if v is not None and v > 1 and pp > 1:
-        # every batch size the ramp will ever produce must divide into
-        # the schedule's injection groups — a mid-training ramp step must
-        # not discover the ValueError inside the schedule
-        group = (2 * pp) if overlap_p2p else pp
-        per_mb = micro_batch_size * data_parallel_size
-        if rampup_batch_size is None:
-            batch_sizes = [global_batch_size]
-        else:
-            start, incr = int(rampup_batch_size[0]), int(rampup_batch_size[1])
-            batch_sizes = list(range(start, global_batch_size, incr))
-            batch_sizes.append(global_batch_size)
-        for gbs in batch_sizes:
-            if gbs % per_mb:
-                raise ValueError(
-                    f"ramped global batch size {gbs} is not divisible by "
-                    f"micro_batch_size*dp ({per_mb}) — the calculator's "
-                    f"consistency check would fail mid-training"
-                )
-            m = gbs // per_mb
-            if m % group:
-                raise ValueError(
-                    f"the interleaved schedule needs every microbatch count "
-                    f"divisible by {'2*' if overlap_p2p else ''}the "
-                    f"pipeline size "
-                    f"({group}); batch size {gbs} "
-                    f"yields {m} microbatches"
-                    + (" (overlap_p2p=True doubles the injection group — "
-                       "each hop spans a full tick)" if overlap_p2p else "")
-                )
+    per_mb = micro_batch_size * data_parallel_size
+    if rampup_batch_size is None:
+        batch_sizes = [global_batch_size]
+    else:
+        start, incr = int(rampup_batch_size[0]), int(rampup_batch_size[1])
+        batch_sizes = list(range(start, global_batch_size, incr))
+        batch_sizes.append(global_batch_size)
+    # every batch size the ramp will ever produce must fill the pipeline
+    # and divide into the schedule's injection groups — a mid-training
+    # ramp step must not discover the ValueError inside the schedule
+    for gbs in batch_sizes:
+        if gbs % per_mb:
+            raise ValueError(
+                f"ramped global batch size {gbs} is not divisible by "
+                f"micro_batch_size*dp ({per_mb}) — the calculator's "
+                f"consistency check would fail mid-training"
+            )
+        try:
+            plan.validate_microbatches(gbs // per_mb)
+        except PlanError as e:
+            raise ValueError(str(e)) from None
     fn = get_forward_backward_func(v, pp, schedule=schedule)
     extra = {}
     if v is not None and pp > 1:

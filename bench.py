@@ -669,8 +669,11 @@ def tp_overlap_main():
     tgts = jr.randint(jr.PRNGKey(2), (batch, seq), 0, kw["vocab_size"])
 
     def measure(overlap):
-        model = GPTModel(GPTConfig(**kw, tp_size=tp, sequence_parallel=True,
-                                   tp_overlap=overlap))
+        # the ParallelPlan spelling (ISSUE 12): one validated object
+        # instead of three loose kwargs
+        from apex_tpu.plan import ParallelPlan
+        model = GPTModel(GPTConfig(**kw, plan=ParallelPlan(
+            tp=tp, sequence_parallel=True, tp_overlap=overlap)))
 
         def run(p, t, g):
             loss, grads = jax.value_and_grad(model.loss_fn)(
@@ -954,7 +957,11 @@ def pipeline_main():
         M, b, s, iters, passes = 2 * pp, 2, 32, 2, 2
         cast = None
 
-    model = GPTModel(GPTConfig(**kw))
+    # the ParallelPlan spelling (ISSUE 12); the measured schedule is
+    # still selected per leg below (zb vs the 1f1b baseline)
+    from apex_tpu.plan import ParallelPlan
+    model = GPTModel(GPTConfig(**kw, plan=ParallelPlan(
+        pp=pp, pp_schedule="zb")))
     params = model.init(jr.PRNGKey(0))
     if cast is not None:
         params = jax.tree.map(
@@ -1069,6 +1076,152 @@ def pipeline_main():
             f"{n}-device mesh (pp={pp})")
         status = "SKIP"
     emit(status, **fields)
+
+
+def plan_main(argv=None):
+    """``python bench.py --plan [--costdb F] [--chips N]`` — the
+    auto-parallelism planner leg (ISSUE 12): search → pick → measure.
+
+    **Search**: enumerate the feasible plan lattice for ``--chips``
+    (default: every visible device) over the flagship workload, price
+    every candidate from the CostDB (``--costdb`` names a measured
+    artifact from ``bench.py --profile --costdb``; without one, a
+    uniform reference rate is used and every key is flagged
+    uncalibrated), and rank by predicted step time
+    (:func:`apex_tpu.plan.search.search_plans`).
+
+    **Pick**: the chosen plan is JXP-gated in-process — the
+    ``planned_gpt_step`` entrypoint traces it and checks donation +
+    the schedule/overlap contracts its knobs engage (``lint_ok``); the
+    planner can never ship a plan that violates a shipped invariant.
+
+    **Measure**: the chosen plan's per-chip step program (the exact
+    program the pricing traced, instantiated with real operands) is
+    timed min-of-passes, and ``predicted_vs_measured_err_pct`` is
+    recorded — the series ``tools/bench_history.py`` gates for drift.
+    The schedule's warmup/drain enters *predicted* through the
+    ``pipeline_cost_model`` factor while the measured per-chip program
+    carries only the useful work, so the error series includes the
+    schedule-model term by construction; DRIFT is what the gate
+    watches. On TPU the record is ``status: "OK"``; off-TPU the
+    measured half rides as explicit skip objects (never nan in an OK
+    line) with ``smoke_step_ms`` as the finite plumbing witness that
+    the full search→pick→measure loop ran.
+    """
+    import sys
+
+    # must precede the first backend query: the CPU platform only grows
+    # virtual devices if the flag is set pre-initialization
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    on_tpu = jax.default_backend() == "tpu"
+    monitor.enable_from_env()
+
+    from apex_tpu.lint import entrypoints as lint_eps
+    from apex_tpu.plan import Workload, plan_record_fields, search_plans
+    from apex_tpu.prof.calibrate import validate_costdb
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    def _opt(flag, default):
+        return argv[argv.index(flag) + 1] if flag in argv else default
+
+    chips = int(_opt("--chips", jax.device_count()))
+    costdb_path = _opt("--costdb", None)
+
+    if on_tpu:
+        # the flagship train-bench dims (bench `main()`'s config) at a
+        # searchable batch geometry
+        w = Workload(hidden_size=1024, num_layers=12, vocab_size=32768,
+                     seq=1024, global_batch=16, micro_batch=2,
+                     dtype_bytes=2, remat=False)
+        iters, passes = 10, 3
+    else:  # smoke scale; the record is SKIP either way
+        w = Workload(hidden_size=64, ffn_hidden_size=256, num_layers=4,
+                     vocab_size=256, seq=64, global_batch=8,
+                     micro_batch=1, dtype_bytes=4, remat=False)
+        iters, passes = 2, 2
+
+    if costdb_path:
+        with open(costdb_path) as fh:
+            db = json.load(fh)
+        errors = validate_costdb(db)
+        if errors:
+            raise ValueError(f"{costdb_path} is not a valid costdb: "
+                             f"{errors}")
+        source = costdb_path
+    else:
+        # no measured CostDB: the empty table makes every key a flagged
+        # blind spot priced at the uniform reference floors — the
+        # ranking reflects geometry alone, labeled, never silent
+        db = {"schema": 1, "kind": "costdb", "collectives": {},
+              "gemms": {}}
+        source = "uniform-reference"
+    # blind spots price at the SLOWEST measured rate (never 0 ms): a
+    # plan must not win because its dominant traffic was never measured
+    from apex_tpu.plan import conservative_defaults
+    result = search_plans(chips, w, db, **conservative_defaults(db))
+    best = result.best
+
+    # JXP-gate the chosen plan through the registered entrypoint — the
+    # same contracts `python -m apex_tpu.lint --jaxpr` enforces
+    os.environ["APEX_TPU_PLAN"] = json.dumps(best.plan.to_json())
+    try:
+        findings, _cost = lint_eps.check("planned_gpt_step")
+        lint_ok = not findings
+    finally:
+        os.environ.pop("APEX_TPU_PLAN", None)
+
+    # measure the priced per-chip program (real operands, min-of-passes)
+    from apex_tpu.plan import build_plan_step
+    fn, sds_args = build_plan_step(best.plan, w)
+    step = jax.jit(fn, donate_argnums=(0,))
+    args = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), sds_args)
+    params, x, tgt = args
+    params, loss = step(params, x, tgt)  # compile+warm
+    float(loss)
+    times = []
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, loss = step(params, x, tgt)
+        float(loss)  # host fetch syncs the dependent chain
+        times.append((time.perf_counter() - t0) / iters)
+    measured_ms = min(times) * 1e3
+
+    skip_reason = (None if on_tpu else
+                   f"plan step-time is a TPU measurement; this is a "
+                   f"{jax.default_backend()} smoke run on a virtual "
+                   f"{jax.device_count()}-device mesh")
+    fields = plan_record_fields(
+        result, costdb_source=source,
+        measured_step_ms=measured_ms if on_tpu else None,
+        skip_reason=skip_reason)
+    fields.update(
+        lint_ok=bool(lint_ok),
+        smoke_step_ms=round(measured_ms, 4),
+        config={"hidden_size": w.hidden_size, "num_layers": w.num_layers,
+                "vocab_size": w.vocab_size, "seq": w.seq,
+                "global_batch": w.global_batch,
+                "micro_batch": w.micro_batch, "remat": w.remat},
+        backend=jax.default_backend(),
+    )
+    if on_tpu:
+        status = "OK"
+    else:
+        fields["reason"] = skip_reason
+        status = "SKIP"
+
+    if monitor.enabled():
+        record = monitor.get_registry().emit_plan(status, **fields)
+    else:  # sink-less registry: same construction+honesty path, no file
+        record = monitor.MetricsRegistry().emit_plan(status, **fields)
+    errors = monitor.validate(record)
+    if errors:
+        raise ValueError(f"plan bench record failed validation: {errors}")
+    print(json.dumps(record))
 
 
 def main():
@@ -1197,5 +1350,7 @@ if __name__ == "__main__":
         tp_overlap_main()
     elif "--pipeline" in sys.argv[1:]:
         pipeline_main()
+    elif "--plan" in sys.argv[1:]:
+        plan_main([a for a in sys.argv[1:] if a != "--plan"])
     else:
         main()

@@ -133,5 +133,33 @@ def test_guide_covers_the_ladder():
                              "TRAINING_GUIDE.md")).read()
     for needle in ("initialize_model_parallel", "shard_params_for_tp",
                    "build_model", "loss_and_grads", "build_schedule",
-                   "zigzag_shard", "distributed_fused_adam"):
+                   "zigzag_shard", "distributed_fused_adam",
+                   # ISSUE 12: the "choosing a plan" chapter
+                   "ParallelPlan", "search_plans", "bench.py --plan",
+                   "planned_gpt_step", "predicted_vs_measured_err_pct"):
         assert needle in text, f"guide dropped {needle}"
+
+
+def test_plan_api_blocks_execute_in_order():
+    """docs/api/plan.md: ParallelPlan round-trip → plan consumption →
+    the pricing worked example (shared fixture with tests/test_plan.py)
+    → search, one namespace, runnable on the virtual CPU mesh."""
+    blocks = _doc_blocks("api", "plan.md")
+    assert len(blocks) >= 4, "plan.md lost its worked examples"
+    ns = _exec_blocks(blocks, "plan.md")
+    assert ns["price"].confidence == "calibrated"
+    assert ns["result"].ranked
+
+
+def test_plan_doc_covers_the_planner_contract():
+    path = os.path.join(os.path.dirname(__file__), "..", "docs", "api",
+                        "plan.md")
+    text = open(path).read()
+    for needle in ("ParallelPlan", "validate", "to_json",
+                   "static_cost", "nearest", "pipeline_cost_model",
+                   "uncalibrated", "--strict", "search_plans",
+                   "memory_bound_bytes", "bench.py --plan",
+                   "predicted_vs_measured_err_pct", "bench_history",
+                   "planned_gpt_step", "deprecated shim",
+                   "heterogeneity"):
+        assert needle in text, f"plan.md dropped {needle}"

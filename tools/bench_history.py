@@ -53,6 +53,11 @@ from apex_tpu.monitor import schema  # noqa: E402
 # monitor-record kinds that carry a tokens_per_s throughput claim
 _THROUGHPUT_KINDS = ("serve", "decode", "tp_overlap", "pipeline")
 
+# metrics where a BIGGER fresh value is the regression (error series —
+# the planner's predicted-vs-measured error must not drift UP across
+# the BENCH_r* trajectory, while throughput must not drift DOWN)
+_LOWER_IS_BETTER = {"plan_predicted_vs_measured_err_pct"}
+
 
 def extract(obj: Dict[str, Any], label: str = "artifact"
             ) -> Optional[Tuple[str, float, float]]:
@@ -78,6 +83,18 @@ def extract(obj: Dict[str, Any], label: str = "artifact"
         spread = obj.get("spread_pct")
         return (f"{kind}_tokens_per_s", float(v),
                 float(spread) if isinstance(spread, (int, float)) else 0.0)
+    if kind == "plan":
+        # the planner record's gated series is its predicted-vs-measured
+        # ERROR (an OK record always carries one; the measured half only
+        # skips inside SKIP records)
+        if obj.get("status") == "SKIP":
+            return None
+        v = obj.get("predicted_vs_measured_err_pct")
+        if not isinstance(v, (int, float)):
+            raise ValueError(
+                f"{label}: OK plan record has no numeric "
+                "predicted_vs_measured_err_pct")
+        return ("plan_predicted_vs_measured_err_pct", float(v), 0.0)
     if kind is not None:
         return None  # other monitor records carry no headline number
     raise ValueError(
@@ -106,7 +123,8 @@ def load_json(path: str) -> Any:
             last = obj
             if isinstance(obj, dict) and (
                     "metric" in obj
-                    or obj.get("kind") in _THROUGHPUT_KINDS):
+                    or obj.get("kind") in _THROUGHPUT_KINDS
+                    or obj.get("kind") == "plan"):
                 claimed = obj
         if last is None:
             raise ValueError(f"{path}: empty file")
@@ -216,6 +234,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     ref_path, _, ref_value, ref_spread = history[-1]
     allowed_pct = args.tolerance_pct + fresh_spread + ref_spread
+    if metric in _LOWER_IS_BETTER:
+        # error-series gate: drift UP is the regression, measured in
+        # absolute points (the reference may legitimately be ~0%)
+        delta = value - ref_value
+        if delta > allowed_pct:
+            print(f"REGRESSION {metric}: {value:g} vs "
+                  f"{os.path.basename(ref_path)} {ref_value:g} "
+                  f"(+{delta:.2f} pts > allowed +{allowed_pct:.2f})")
+            return 1
+        print(f"OK {metric}: {value:g} vs {os.path.basename(ref_path)} "
+              f"{ref_value:g} ({delta:+.2f} pts, allowed "
+              f"+{allowed_pct:.2f}) over {len(history)}-point trajectory")
+        return 0
     delta_pct = 100.0 * (value - ref_value) / ref_value
     if delta_pct < -allowed_pct:
         print(f"REGRESSION {metric}: {value:g} vs "

@@ -11,6 +11,7 @@ Usage::
     python tools/validate_metrics.py --serve-window windows.jsonl ...
     python tools/validate_metrics.py --pipeline pipeline.jsonl ...
     python tools/validate_metrics.py --static-cost static_cost.jsonl ...
+    python tools/validate_metrics.py --plan plan.jsonl ...
 
 Dispatch is by content, not extension:
 
@@ -50,13 +51,17 @@ Dispatch is by content, not extension:
   ``costdb`` artifacts (``apex_tpu.prof.calibrate``), and
   ``static_cost`` artifacts (``python -m apex_tpu.lint --jaxpr
   --static-cost``: the jaxpr walker's predicted per-collective bytes /
-  per-GEMM FLOPs — the planner's predicted side of the CostDB diff)
+  per-GEMM FLOPs — the planner's predicted side of the CostDB diff),
+  and ``plan`` records (``python bench.py --plan``: the auto-
+  parallelism planner's searched ranking + chosen ParallelPlan +
+  predicted-vs-measured error — plan objects and ranking rows are
+  closed schemas, so a junk key fails)
   dispatch on ``kind`` like every monitor record. ``--profile`` /
   ``--serve`` / ``--serve-window`` / ``--pipeline`` / ``--costdb`` /
-  ``--static-cost`` force EVERY listed file to be judged as that
-  artifact (same rationale as ``--lint-report``: an artifact that lost
-  its ``kind`` key must fail as a bad profile/serve/pipeline/costdb/
-  static_cost, not as an unrecognized shape).
+  ``--static-cost`` / ``--plan`` force EVERY listed file to be judged
+  as that artifact (same rationale as ``--lint-report``: an artifact
+  that lost its ``kind`` key must fail as a bad profile/serve/
+  pipeline/costdb/static_cost/plan, not as an unrecognized shape).
 
 Exit status 0 when every file is clean; 1 otherwise, with one problem per
 line on stderr. The logic lives in ``apex_tpu.monitor.schema`` so tests
@@ -193,10 +198,12 @@ def main(argv=None) -> int:
         force_kind = "pipeline"
     elif "--static-cost" in argv:
         force_kind = "static_cost"
+    elif "--plan" in argv:
+        force_kind = "plan"
     argv = [a for a in argv
             if a not in ("--lint-report", "--costdb", "--profile",
                          "--serve", "--serve-window", "--pipeline",
-                         "--static-cost")]
+                         "--static-cost", "--plan")]
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
