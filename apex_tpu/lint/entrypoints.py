@@ -25,8 +25,11 @@ suites used to assert with one-off walkers:
   collective-free, the 1f1b control with NO such sweep — PR 8's
   acceptance);
 * ``serve_prefill`` / ``serve_decode`` — the serving engine's jitted
-  bodies (pool donated and rebound, single-chip bodies collective-free
-  — PR 7's contract).
+  bodies traced with copy-on-write block tables IN PLAY (a warm prefix
+  cache, shared refcounted blocks in the table row, a non-zero resume
+  frontier — all host bookkeeping, no device work): pool donated and
+  rebound, single-chip bodies collective-free — PR 7's contract held
+  under serving tier 2's sharing machinery.
 
 Tracing the same programs also yields their
 :func:`~apex_tpu.lint.jaxpr_check.static_cost` reports — the planner's
@@ -382,21 +385,65 @@ def _serving_engine():
     return engine, params, jnp
 
 
+def _cow_scheduler(engine):
+    """A scheduler with COW block tables IN PLAY — pure host
+    bookkeeping, no device execution: request A's prompt is walked
+    through the chunked-prefill protocol (dummy sampled tokens) so its
+    two full system-prompt blocks land in the prefix cache, then
+    request B sharing that system prompt admits against the warm cache.
+    B's table row now carries refcounted SHARED block ids and a
+    non-zero resume frontier; the traced serving programs get exactly
+    these operands, so the donation/collective-free contracts are
+    asserted on the shapes the tier-2 engine really dispatches.
+    Returns ``(sched, slot_b, resume_start)``."""
+    import numpy as np
+
+    from apex_tpu.serving import Request
+
+    B = engine.block_size
+    sched = engine.make_scheduler()
+    sysp = (np.arange(2 * B, dtype=np.int32) * 7 + 3) % 97
+    a = Request(rid=0, prompt=np.concatenate(
+        [sysp, np.ones(3, np.int32)]), max_new_tokens=4)
+    sched.submit(a)
+    sched.admit(0.0)
+    while True:  # host-side prefill protocol: chunks never hit a device
+        w = sched.next_prefill(0.0)
+        if w is None:
+            break
+        sched.note_prefill(w, 1, 0.0)
+    b = Request(rid=1, prompt=np.concatenate(
+        [sysp, np.full(5, 2, np.int32)]), max_new_tokens=4)
+    sched.submit(b)
+    (slot_b,) = sched.admit(0.0)
+    shared = sched._slots[slot_b].shared_blocks
+    if shared != 2:  # the COW setup itself must not silently decay
+        raise RuntimeError(
+            f"serve entrypoint expected 2 shared prefix blocks in play, "
+            f"got {shared}")
+    return sched, slot_b, shared * B
+
+
 @register(
     "serve_prefill",
-    "serving chunked-prefill body (pool donated+rebound, collective-free)",
+    "serving chunked-prefill body with COW block tables in play "
+    "(shared-prefix resume; pool donated+rebound, collective-free)",
     lambda: [jc.donation_honored(), jc.donation_rebound(),
              jc.collective_free_region("", region="serving prefill body")])
 def _build_serve_prefill():
     import jax.random as jr
 
     engine, params, jnp = _serving_engine()
+    sched, slot_b, start = _cow_scheduler(engine)
     pool = engine.init_pool()
     C = engine.prefill_chunk_size
-    table_row = jnp.zeros((engine.max_blocks_per_slot,), jnp.int32)
+    # the REAL table row: leading entries are refcounted shared blocks,
+    # the chunk resumes at the shared-prefix frontier
+    table_row = jnp.asarray(sched.tables.row(slot_b))
     tokens = jnp.zeros((C,), jnp.int32)
+    live = min(C, len(sched._slots[slot_b].eprompt) - start)
     return engine.prefill_chunk, (params, pool, table_row, tokens,
-                                  jnp.int32(0), jnp.int32(C),
+                                  jnp.int32(start), jnp.int32(live),
                                   jr.PRNGKey(0))  # apexlint: disable=APX502
 
 
@@ -525,17 +572,26 @@ def _build_planned_gpt_step():
 
 @register(
     "serve_decode",
-    "serving paged decode step (pool donated+rebound, collective-free)",
+    "serving paged decode step with COW block tables in play "
+    "(shared prefix blocks in the table; pool donated+rebound, "
+    "collective-free)",
     lambda: [jc.donation_honored(), jc.donation_rebound(),
              jc.collective_free_region("", region="serving decode body")])
 def _build_serve_decode():
     import jax.random as jr
 
     engine, params, jnp = _serving_engine()
+    sched, _, _ = _cow_scheduler(engine)
     pool = engine.init_pool()
-    S = engine.num_slots
-    tables = jnp.zeros((S, engine.max_blocks_per_slot), jnp.int32)
-    tokens = jnp.zeros((S,), jnp.int32)
-    lengths = jnp.zeros((S,), jnp.int32)
-    return engine.decode_step, (params, pool, tables, tokens, lengths,
+    # the REAL operands the tier-2 engine dispatches: request A is
+    # decoding (its batch allocates through the refcounted pool), the
+    # full table carries shared prefix block ids, dead slots ride 0s
+    batch = sched.decode_batch(0.0)
+    if batch is None:
+        raise RuntimeError(
+            "serve entrypoint expected a live decode batch")
+    toks, lens = batch
+    tables = jnp.asarray(sched.tables.asarray())
+    return engine.decode_step, (params, pool, tables,
+                                jnp.asarray(toks), jnp.asarray(lens),
                                 jr.PRNGKey(0))  # apexlint: disable=APX502

@@ -239,6 +239,10 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         summary["serve"] = status_summary(
             serve_records, ("tokens_per_s", "latency_p50_ms",
                             "latency_p99_ms", "ttft_p50_ms", "ttft_p99_ms",
+                            "prefix_hit_rate", "prefix_hit_ttft_p50_ms",
+                            "prefix_miss_ttft_p50_ms", "preemptions",
+                            "recompute_tokens", "blocks_resident",
+                            "churn_parity",
                             "occupancy_pct", "vs_single_request",
                             "requests", "slots", "block_size",
                             "blocks_high_water",
@@ -362,7 +366,16 @@ def serve_timeline(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             row["prefill_ms"] = rec.get("prefill_ms")
             row["chunks"] = rec.get("chunks", row.get("chunks"))
             row["blocks_held"] = rec.get("blocks_held")
-        elif phase in ("finish", "evict"):
+        elif phase == "evict":
+            # preemption, not a terminal transition: the request
+            # re-queues for evict-and-recompute and (usually) finishes
+            # later — fold the count and the LAST evict's payload in
+            row["evictions"] = row.get("evictions", 0) + 1
+            row["evict_reason"] = rec.get("evict_reason")
+            row["blocks_released"] = rec.get("blocks_released")
+            row["requeue_pos"] = rec.get("requeue_pos")
+            row["outcome"] = "evicted"  # until a finish overwrites it
+        elif phase == "finish":
             row["finish_s"] = rec.get("at_s")
             row["tokens"] = rec.get("tokens")
             row["decode_ms"] = rec.get("decode_ms")
@@ -375,7 +388,8 @@ def serve_timeline(records: List[Dict[str, Any]]) -> Dict[str, Any]:
          ("at_s", "t_s", "window_s", "tokens", "tokens_per_s",
           "latency_p50_ms", "latency_p99_ms", "ttft_p50_ms",
           "queue_depth", "active_slots", "occupancy_pct", "blocks_live",
-          "serve_anomaly")}
+          "blocks_resident", "prefix_hit_rate", "preemptions",
+          "recompute_tokens", "serve_anomaly")}
         for rec in records if rec.get("kind") == "serve_window"
     ]
     return {"requests": requests, "windows": windows,
@@ -399,7 +413,7 @@ def format_serve_timeline(timeline: Dict[str, Any]) -> str:
         return v if isinstance(v, (int, float)) else "-"
 
     for r in reqs:
-        lines.append(
+        line = (
             f"  rid {r['rid']:>4}  "
             f"queue {_ms(r.get('queue_wait_ms'))}  "
             f"prefill {_ms(r.get('prefill_ms'))}"
@@ -409,6 +423,14 @@ def format_serve_timeline(timeline: Dict[str, Any]) -> str:
             f"/{_n(r, 'tokens')}tok  "
             f"blocks {_n(r, 'blocks_held')}  "
             f"{r.get('outcome') or 'in-flight'}")
+        if r.get("evictions"):
+            # the reserved preemption transition, rendered not dropped:
+            # count, reason, blocks released, re-queue position
+            line += (f"  [evict x{r['evictions']}: "
+                     f"{r.get('evict_reason') or '?'}, "
+                     f"{_n(r, 'blocks_released')} blk released, "
+                     f"requeued at {_n(r, 'requeue_pos')}]")
+        lines.append(line)
     def _num(w, *keys, default="-"):
         # serve_timeline materializes every window key (absent -> None),
         # so dict-get defaults never fire — coalesce None explicitly
@@ -422,6 +444,7 @@ def format_serve_timeline(timeline: Dict[str, Any]) -> str:
         anom = w.get("serve_anomaly") or {}
         flags = _anomaly_flags(anom) if isinstance(anom, dict) else []
         tps = w.get("tokens_per_s")
+        hr = w.get("prefix_hit_rate")
         # at_s is the serve clock (same base as the request rows);
         # pre-at_s streams fall back to the registry clock
         w_at = _num(w, "at_s", "t_s", default=None)
@@ -434,6 +457,11 @@ def format_serve_timeline(timeline: Dict[str, Any]) -> str:
               f"{_ms(w.get('latency_p99_ms'), 2)}  "
             + f"queue {_num(w, 'queue_depth')}  "
             + f"occ {_num(w, 'occupancy_pct')}%"
+            + (f"  hit {100.0 * hr:.0f}%"
+               if isinstance(hr, (int, float)) else "")
+            + (f"  evictions {w['preemptions']}"
+               if isinstance(w.get("preemptions"), int)
+               and w["preemptions"] else "")
             + ("  [" + ", ".join(flags) + "]" if flags else ""))
     for s in timeline["stragglers"]:
         lines.append(f"  straggler step {s.get('step')}: "

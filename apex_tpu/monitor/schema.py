@@ -314,6 +314,26 @@ SERVE_SCHEMA = {
         "queue_peak": {"type": "integer"},
         "serve_windows": {"type": "integer"},
         "telemetry_overhead_pct": _METRIC_VALUE,
+        # serving tier 2 (ISSUE 13): prefix-cache effectiveness — the
+        # hit-vs-miss TTFT split is the cache's headline claim
+        # (hit p50 strictly below miss p50 on a warm cache) — plus
+        # preemption pressure (evict-and-recompute counts) and the
+        # replayable-trace seed
+        "prefix_hit_rate": _METRIC_VALUE,     # shared blocks / queried
+        "prefix_hit_ttft_p50_ms": _METRIC_VALUE,
+        "prefix_hit_ttft_p99_ms": _METRIC_VALUE,
+        "prefix_miss_ttft_p50_ms": _METRIC_VALUE,
+        "prefix_miss_ttft_p99_ms": _METRIC_VALUE,
+        "prefix_hit_requests": {"type": "integer"},
+        "prefix_miss_requests": {"type": "integer"},
+        "preemptions": {"type": "integer"},   # evict lifecycle events
+        "recompute_tokens": {"type": "integer"},  # re-prefilled rows
+        "blocks_resident": {"type": "integer"},   # warm cache footprint
+        # greedy parity over the WHOLE churn sweep including
+        # evicted-and-recomputed and prefix-hit requests
+        "churn_parity": {"type": "boolean"},
+        "churn_parity_checked": {"type": "integer"},
+        "trace_seed": {"type": "integer"},    # Poisson replay seed
         "config": {"type": "object"},
         "backend": {"type": "string"},
     },
@@ -376,6 +396,13 @@ SERVE_EVENT_SCHEMA = {
         "straggler": {"type": "boolean"},      # engine-level anomaly
         "ratio_to_median": {"type": "number"},
         "slots": {"type": "integer"},
+        # serving tier 2 payloads: evict (preemption) + prefix sharing
+        "evict_reason": {"type": "string"},    # evict: why preempted
+        "blocks_released": {"type": "integer"},  # evict
+        "requeue_pos": {"type": "integer"},    # evict: waiting position
+        "generated": {"type": "integer"},      # evict: tokens so far
+        "prefix_hit_blocks": {"type": "integer"},  # admit: shared blocks
+        "resumed": {"type": "boolean"},        # re-admit / resumed decode
     },
     "required": ["schema", "kind", "rid", "phase", "at_s"],
 }
@@ -413,8 +440,13 @@ SERVE_WINDOW_SCHEMA = {
         "occupancy_pct": _METRIC_VALUE,
         "blocks_live": {"type": "integer"},
         "blocks_high_water": {"type": "integer"},
+        "blocks_resident": {"type": "integer"},  # warm prefix blocks
         "admission_blocked_slots": {"type": "integer"},
         "admission_blocked_blocks": {"type": "integer"},
+        # serving tier 2: live prefix-cache + preemption view
+        "prefix_hit_rate": _METRIC_VALUE,
+        "preemptions": {"type": "integer"},
+        "recompute_tokens": {"type": "integer"},
         "serve_anomaly": SERVE_ANOMALY_SCHEMA,
     },
     "required": ["schema", "kind", "status", "window_s", "serve_anomaly"],

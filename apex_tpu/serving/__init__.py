@@ -10,16 +10,25 @@ stalling the in-flight streams. This package is that engine:
 
 * :mod:`~apex_tpu.serving.kv_blocks` — the **paged KV cache**: one
   pre-allocated, donated pool of fixed-size blocks shared by every
-  request, a host-side free-list :class:`~apex_tpu.serving.kv_blocks.
-  BlockAllocator`, and per-slot block tables. Cache memory is bound by
-  LIVE tokens, not ``batch × max_s``.
+  request, a host-side REFCOUNTED free-list :class:`~apex_tpu.serving.
+  kv_blocks.BlockAllocator`, per-slot block tables, and the
+  :class:`~apex_tpu.serving.kv_blocks.PrefixCache` — a chained
+  full-token-key LRU index that lets N requests with a common system
+  prompt share one physical prefix copy-on-write and skip its prefill
+  entirely. Cache memory is bound by LIVE tokens, not
+  ``batch × max_s``.
 * :mod:`~apex_tpu.serving.scheduler` — the **continuous-batching
   scheduler**: a fixed-width slot array with admit/evict between steps
   by mutating cache contents, tables, and lengths only (stable avals —
-  the jit cache stays at ONE executable across arbitrary churn), FCFS
-  admission behind a worst-case block-reservation gate (no mid-flight
-  OOM, no preemption needed), and **chunked prefill** so a long prompt
-  never stalls the streams already decoding.
+  the jit cache stays at ONE executable across arbitrary churn),
+  OPTIMISTIC FCFS admission against live-token demand with
+  evict-and-recompute **preemption** under pool pressure (the reserved
+  ``evict`` lifecycle event; the resumed token stream is identical to
+  the unpreempted baseline), an :class:`~apex_tpu.serving.scheduler.
+  SLOPolicy` that folds the live telemetry signals back into dispatch
+  (TTFT burn → deprioritize long prompts; queue buildup → widen the
+  prefill share), and **chunked prefill** so a long prompt never
+  stalls the streams already decoding.
 * :mod:`~apex_tpu.serving.engine` — :class:`~apex_tpu.serving.engine.
   ServingEngine`: the jitted ``prefill_chunk`` / ``decode_step`` pair
   (each compiles once), the paged decode attention
@@ -47,7 +56,12 @@ from apex_tpu.serving.engine import ServingEngine  # noqa: F401
 from apex_tpu.serving.kv_blocks import (  # noqa: F401
     DEAD_BLOCK,
     BlockAllocator,
+    PrefixCache,
     blocks_needed,
 )
-from apex_tpu.serving.scheduler import Request, Scheduler  # noqa: F401
+from apex_tpu.serving.scheduler import (  # noqa: F401
+    Request,
+    Scheduler,
+    SLOPolicy,
+)
 from apex_tpu.serving.telemetry import ServeTelemetry  # noqa: F401

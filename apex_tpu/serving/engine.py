@@ -53,8 +53,9 @@ from apex_tpu.monitor import registry as monitor_registry
 from apex_tpu.monitor import spans as monitor_spans
 from apex_tpu.ops import fused_layer_norm, fused_sample
 from apex_tpu.ops.pallas.attention import NEG_INF
-from apex_tpu.serving.kv_blocks import DEAD_BLOCK, BlockAllocator
-from apex_tpu.serving.scheduler import Request, Scheduler
+from apex_tpu.serving.kv_blocks import (DEAD_BLOCK, BlockAllocator,
+                                        PrefixCache)
+from apex_tpu.serving.scheduler import Request, Scheduler, SLOPolicy
 from apex_tpu.serving.telemetry import ServeTelemetry
 
 
@@ -93,8 +94,9 @@ class ServingEngine:
     * ``num_blocks`` — pool capacity + 1 dead block. Defaults to full
       capacity (``num_slots * max_seq_len/block_size + 1``); size it
       DOWN to what live traffic needs — that is the point of paging —
-      and the scheduler's reservation gate turns the shortfall into
-      queueing instead of failure.
+      and the scheduler turns the shortfall into prefix-cache
+      reclamation, then preemption (evict-and-recompute), instead of
+      failure or an admission stall.
     * ``prefill_chunk`` — prompt tokens per prefill step (a
       ``block_size`` multiple); smaller chunks interleave tighter with
       decode (less per-step jitter), larger chunks reach the first
@@ -214,9 +216,13 @@ class ServingEngine:
                          axis=0)[None]
 
         # the chunk's target blocks: C/B table entries from start/B on
-        # (chunks are start-aligned: start is always a C-multiple, C a
-        # B-multiple); blocks with no live token redirect to the dead
-        # block so the ragged tail cannot touch another slot's memory
+        # (chunks are block-aligned: start is always a B-multiple — the
+        # scheduler resumes at the shared-prefix frontier, a whole
+        # number of blocks — and C is a B-multiple); blocks with no
+        # live token redirect to the dead block so the ragged tail
+        # cannot touch another slot's memory. Earlier table entries
+        # (a shared prefix) are READ via the gather below, never
+        # written: the copy-on-write discipline in one index bound
         nblk = C // B
         ids = jax.lax.dynamic_slice(table_row.astype(jnp.int32),
                                     (start // B,), (nblk,))
@@ -312,13 +318,28 @@ class ServingEngine:
 
     # --- the serving loop ----------------------------------------------------
 
-    def make_scheduler(self) -> Scheduler:
-        """A fresh scheduler + allocator matching this engine's pool."""
+    def make_scheduler(self, *, prefix_cache: bool = True,
+                       prefix_capacity_blocks: Optional[int] = None,
+                       policy: Optional[SLOPolicy] = None) -> Scheduler:
+        """A fresh scheduler + allocator matching this engine's pool.
+
+        ``prefix_cache=True`` (the default) attaches a
+        :class:`~apex_tpu.serving.kv_blocks.PrefixCache` over the same
+        allocator — full prompt blocks are shared copy-on-write across
+        requests and survive them as reclaimable warm capacity.
+        ``policy`` injects an :class:`~apex_tpu.serving.scheduler.
+        SLOPolicy` (one is created by default) for SLO-aware dispatch
+        when telemetry is attached."""
+        alloc = BlockAllocator(self.num_blocks)
+        cache = (PrefixCache(alloc, self.block_size,
+                             capacity_blocks=prefix_capacity_blocks)
+                 if prefix_cache else None)
         return Scheduler(
             num_slots=self.num_slots, block_size=self.block_size,
             max_blocks_per_slot=self.max_blocks_per_slot,
-            allocator=BlockAllocator(self.num_blocks),
-            prefill_chunk=self.prefill_chunk_size)
+            allocator=alloc, prefill_chunk=self.prefill_chunk_size,
+            prefix_cache=cache,
+            policy=policy if policy is not None else SLOPolicy())
 
     def serve(self, params, requests: List[Request], *,
               key: Optional[jax.Array] = None,
@@ -408,11 +429,18 @@ class ServingEngine:
 
     def _serve_loop(self, params, key, sched, tel, stats, now, wall, pool):
         nstep = 0
+        policy = sched.policy
         while not sched.idle():
             sched.admit(now())
             did_work = False
-            work = sched.next_prefill()
-            if work is not None:
+            # the SLO policy widens the prefill share under queue
+            # buildup: up to `prefill_share` chunks this iteration —
+            # the SAME compiled program run more often, never a new one
+            share = policy.prefill_share if policy is not None else 1
+            for _ in range(share):
+                work = sched.next_prefill(now())
+                if work is None:
+                    break
                 sched.note_step(nstep)
                 t_dispatch = now()
                 pool, tok, _ = self.prefill_chunk(
@@ -430,7 +458,7 @@ class ServingEngine:
                 stats.prefill_chunks += 1
                 sched.note_prefill(work, tok, now())
                 did_work = True
-            batch = sched.decode_batch()
+            batch = sched.decode_batch(now())
             if batch is not None:
                 toks, lens = batch
                 ndec = len(sched.decoding_slots())
@@ -452,7 +480,11 @@ class ServingEngine:
             stats.blocks_high_water = max(stats.blocks_high_water,
                                           sched.allocator.num_live)
             if tel is not None:
-                tel.maybe_window(now(), sched)
+                if tel.maybe_window(now(), sched) is not None \
+                        and policy is not None:
+                    # window edge: fold the fresh SLO/anomaly signals
+                    # into the dispatch knobs (SLO-aware scheduling)
+                    policy.update(tel)
             if not did_work and wall:
                 # nothing runnable: only future arrivals remain
                 time.sleep(1e-4)

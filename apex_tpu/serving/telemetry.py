@@ -10,7 +10,9 @@ missing layer, riding the PR-1/6 monitor stack:
 
 * **Lifecycle event stream** — one rank-tagged ``serve_event`` JSONL
   record per request transition (``submit → admit → prefill_chunk*k →
-  first_token → decode → finish``) carrying queue wait, chunk count,
+  first_token → decode → finish``, with ``evict`` → re-``admit`` →
+  resumed ``decode`` when preemption strikes) carrying queue wait,
+  chunk count,
   blocks held, per-phase durations, and the engine step index of the
   dispatch that produced it. Device correlation is the PR-6
   scope-prefix join: the engine's jitted bodies trace under the
@@ -52,8 +54,8 @@ from apex_tpu.monitor.histogram import StreamingHistogram
 
 __all__ = ["ServeTelemetry"]
 
-# lifecycle phases, in order (evict is reserved for preemption — the
-# current engine only retires requests by finishing them)
+# lifecycle phases, in order (evict fires on preemption: the request
+# releases its blocks and re-queues for evict-and-recompute)
 PHASES = ("submit", "admit", "prefill_chunk", "first_token", "decode",
           "finish", "evict")
 
@@ -64,7 +66,7 @@ class _InFlight:
     request history)."""
 
     __slots__ = ("queued_at", "admit_at", "chunks", "prefill_s",
-                 "first_token_at")
+                 "first_token_at", "requeued_at")
 
     def __init__(self, queued_at: float):
         self.queued_at = queued_at
@@ -72,6 +74,10 @@ class _InFlight:
         self.chunks = 0
         self.prefill_s = 0.0
         self.first_token_at: Optional[float] = None
+        # set on evict: re-admission measures queue wait from HERE, not
+        # from the original submit (the prior in-slot service time is
+        # not queueing)
+        self.requeued_at: Optional[float] = None
 
 
 class ServeTelemetry:
@@ -117,9 +123,13 @@ class ServeTelemetry:
         self.reason = reason
 
         # cumulative histograms back the final bench record; the window
-        # pair resets at every serve_window emission (sliding view)
+        # pair resets at every serve_window emission (sliding view).
+        # TTFT additionally splits by prefix-cache outcome: the
+        # hit-vs-miss p50 gap IS the prefix cache's headline claim
         self.itl_ms = StreamingHistogram()
         self.ttft_ms = StreamingHistogram()
+        self.ttft_hit_ms = StreamingHistogram()
+        self.ttft_miss_ms = StreamingHistogram()
         self._win_itl = StreamingHistogram()
         self._win_ttft = StreamingHistogram()
 
@@ -143,6 +153,11 @@ class ServeTelemetry:
         self.queue_buildup = False
         self.leaked_blocks = 0
         self.windows_emitted = 0
+        # serving-tier-2 counters: preemption + prefix-cache outcomes
+        self.preemptions = 0
+        self.resumes = 0
+        self.prefix_hit_requests = 0
+        self.prefix_miss_requests = 0
 
         self._win_t0: Optional[float] = None
         self._win_tokens = 0
@@ -180,15 +195,59 @@ class ServeTelemetry:
                    max_new_tokens=int(req.max_new_tokens))
         self.overhead_ns += time.perf_counter_ns() - t
 
-    def on_admit(self, req, slot: int, now: float) -> None:
+    def on_admit(self, req, slot: int, now: float,
+                 prefix_hit_blocks: int = 0, resumed: bool = False
+                 ) -> None:
         t = time.perf_counter_ns()
         fl = self._inflight.get(req.rid)
         if fl is None:  # submitted before the tracker attached
             fl = self._inflight[req.rid] = _InFlight(float(req.arrival_s))
         fl.admit_at = now
-        queue_wait_ms = max(now - fl.queued_at, 0.0) * 1e3
-        self._emit("serve_event", rid=req.rid, phase="admit", at_s=now,
-                   slot=int(slot), queue_wait_ms=round(queue_wait_ms, 3))
+        # a re-admission waited since its EVICTION, not since submit —
+        # billing the prior in-slot service time as queueing would
+        # inflate exactly the rows preemption analysis looks at
+        since = fl.requeued_at if fl.requeued_at is not None \
+            else fl.queued_at
+        fl.requeued_at = None
+        queue_wait_ms = max(now - since, 0.0) * 1e3
+        fields = dict(rid=req.rid, phase="admit", at_s=now,
+                      slot=int(slot),
+                      queue_wait_ms=round(queue_wait_ms, 3))
+        if prefix_hit_blocks:
+            fields["prefix_hit_blocks"] = int(prefix_hit_blocks)
+        if resumed:  # re-admission after an evict
+            fields["resumed"] = True
+        self._emit("serve_event", **fields)
+        self.overhead_ns += time.perf_counter_ns() - t
+
+    def on_evict(self, req, slot: int, blocks_released: int, reason: str,
+                 requeue_pos: int, step: int, now: float) -> None:
+        """The reserved preemption transition: slot ``slot``'s request
+        released ``blocks_released`` block references and re-queued at
+        ``requeue_pos`` for evict-and-recompute."""
+        t = time.perf_counter_ns()
+        self.preemptions += 1
+        fl = self._inflight.get(req.rid)
+        if fl is not None:
+            fl.requeued_at = now
+        self._emit("serve_event", rid=req.rid, phase="evict", at_s=now,
+                   slot=int(slot), step=int(step),
+                   evict_reason=str(reason),
+                   blocks_released=int(blocks_released),
+                   requeue_pos=int(requeue_pos),
+                   generated=len(req.tokens))
+        self.overhead_ns += time.perf_counter_ns() - t
+
+    def on_resume(self, req, slot: int, blocks_held: int, step: int,
+                  now: float) -> None:
+        """An evicted request finished its re-prefill and re-entered
+        steady decode (the recompute's sampled token was discarded —
+        the stream continues exactly where it left off)."""
+        t = time.perf_counter_ns()
+        self.resumes += 1
+        self._emit("serve_event", rid=req.rid, phase="decode", at_s=now,
+                   slot=int(slot), blocks_held=int(blocks_held),
+                   step=int(step), resumed=True)
         self.overhead_ns += time.perf_counter_ns() - t
 
     def on_blocked(self, why: str, n: int = 1) -> None:
@@ -226,6 +285,14 @@ class ServeTelemetry:
         ttft_ms = max(now - fl.queued_at, 0.0) * 1e3
         self.ttft_ms.add(ttft_ms)
         self._win_ttft.add(ttft_ms)
+        # the prefix-cache witness: TTFT split by whether the request's
+        # first admission mapped shared blocks out of the cache
+        if getattr(req, "prefix_hit_blocks", 0) > 0:
+            self.prefix_hit_requests += 1
+            self.ttft_hit_ms.add(ttft_ms)
+        else:
+            self.prefix_miss_requests += 1
+            self.ttft_miss_ms.add(ttft_ms)
         self.tokens += 1
         self._win_tokens += 1
         if self.slo_ttft_ms is not None:
@@ -301,6 +368,18 @@ class ServeTelemetry:
 
     # --- windows + anomalies -------------------------------------------------
 
+    @property
+    def slo_burning(self) -> bool:
+        """The LIVE burn signal: the current run of consecutive
+        over-SLO first tokens has reached the burn count. Unlike the
+        sticky :attr:`slo_burn` record flag, this clears when a first
+        token lands back under the SLO — it is what the
+        :class:`~apex_tpu.serving.scheduler.SLOPolicy` keys its
+        deprioritize-long-prompts knob on (a policy must be able to
+        stand down)."""
+        return (self.slo_ttft_ms is not None
+                and self._ttft_over_slo_run >= self.slo_burn_count)
+
     def anomaly_section(self, allocator=None) -> Dict[str, Any]:
         """The ``serve_anomaly`` object riding ``serve_window`` records
         and the final ``serve`` record. With an ``allocator``, folds in
@@ -371,12 +450,19 @@ class ServeTelemetry:
         active = sched.num_active
         alloc = sched.allocator
         # a pool leak only means something when nothing SHOULD hold
-        # blocks: counter drift is a leak at any time, live blocks with
-        # zero active requests are one too
+        # blocks: counter drift is a leak at any time, and live blocks
+        # with zero active requests are one too — MINUS the blocks the
+        # prefix cache keeps resident (refcounted warm capacity is the
+        # cache doing its job, not a leak; num_resident counts exactly
+        # the cache-pinned live blocks)
         if alloc.leaked:
             self.leaked_blocks = alloc.leaked
-        elif active == 0 and queue == 0 and alloc.num_live > 0:
-            self.leaked_blocks = alloc.num_live
+        elif (active == 0 and queue == 0
+                and alloc.num_live > getattr(alloc, "num_resident", 0)):
+            self.leaked_blocks = (alloc.num_live
+                                  - getattr(alloc, "num_resident", 0))
+        cache = getattr(sched, "prefix_cache", None)
+        hit_rate = cache.hit_rate() if cache is not None else None
         itl = self._win_itl
         ttft = self._win_ttft
         no_itl = "no inter-token samples in window"
@@ -401,30 +487,48 @@ class ServeTelemetry:
             occupancy_pct=round(100.0 * active / self.slots, 2),
             blocks_live=alloc.num_live,
             blocks_high_water=alloc.high_water,
+            blocks_resident=getattr(alloc, "num_resident", 0),
             admission_blocked_slots=self.admission_blocked_slots,
             admission_blocked_blocks=self.admission_blocked_blocks,
+            # serving tier 2: prefix-cache effectiveness + preemption
+            # pressure, live per window
+            prefix_hit_rate=self._skip_or(
+                None if hit_rate is None else round(hit_rate, 4),
+                "no prefix cache attached or nothing queried yet"),
+            preemptions=getattr(sched, "preemptions", self.preemptions),
+            recompute_tokens=getattr(sched, "recompute_tokens", 0),
             serve_anomaly=self.anomaly_section(alloc),
             **({"reason": self.reason} if self.reason else {}),
         )
 
     # --- the final bench-record fields ---------------------------------------
 
-    def final_fields(self, allocator=None) -> Dict[str, Any]:
+    def final_fields(self, allocator=None,
+                     scheduler=None) -> Dict[str, Any]:
         """The telemetry-derived fields of the final ``serve`` record:
         cumulative streaming-histogram quantiles (replacing the
-        sample-list percentile math), anomaly section, admission
+        sample-list percentile math), the hit-vs-miss TTFT split,
+        preemption/recompute pressure, anomaly section, admission
         pressure counts, and the measured hook overhead.
 
         Call AFTER the serve run completed: every request has finished,
-        so any block still live on the allocator IS a leak (the
-        finish-path-stopped-freeing regression this flag exists for —
-        the in-loop idle check can only fire on a window edge, which
-        the last iteration rarely lands on)."""
-        if allocator is not None and allocator.num_live > 0:
+        so any block still live on the allocator BEYOND the prefix
+        cache's residents IS a leak (the finish-path-stopped-freeing
+        regression this flag exists for — the in-loop idle check can
+        only fire on a window edge, which the last iteration rarely
+        lands on; a warm prefix cache holding refcounted residents is
+        NOT a leak)."""
+        resident = getattr(allocator, "num_resident", 0) \
+            if allocator is not None else 0
+        if allocator is not None and allocator.num_live > resident:
             self.leaked_blocks = max(self.leaked_blocks,
-                                     allocator.num_live)
+                                     allocator.num_live - resident)
+        cache = getattr(scheduler, "prefix_cache", None)
+        hit_rate = cache.hit_rate() if cache is not None else None
         no_itl = "no inter-token samples (single-token outputs)"
         no_ttft = "no requests reached a first token"
+        no_hit = "no prefix-hit requests reached a first token"
+        no_miss = "no prefix-miss requests reached a first token"
         return dict(
             latency_p50_ms=self._skip_or(
                 _r3(self.itl_ms.quantile(0.5)), no_itl),
@@ -434,6 +538,23 @@ class ServeTelemetry:
                 _r3(self.ttft_ms.quantile(0.5)), no_ttft),
             ttft_p99_ms=self._skip_or(
                 _r3(self.ttft_ms.quantile(0.99)), no_ttft),
+            prefix_hit_ttft_p50_ms=self._skip_or(
+                _r3(self.ttft_hit_ms.quantile(0.5)), no_hit),
+            prefix_hit_ttft_p99_ms=self._skip_or(
+                _r3(self.ttft_hit_ms.quantile(0.99)), no_hit),
+            prefix_miss_ttft_p50_ms=self._skip_or(
+                _r3(self.ttft_miss_ms.quantile(0.5)), no_miss),
+            prefix_miss_ttft_p99_ms=self._skip_or(
+                _r3(self.ttft_miss_ms.quantile(0.99)), no_miss),
+            prefix_hit_rate=self._skip_or(
+                None if hit_rate is None else round(hit_rate, 4),
+                "no prefix cache attached or nothing queried yet"),
+            prefix_hit_requests=self.prefix_hit_requests,
+            prefix_miss_requests=self.prefix_miss_requests,
+            preemptions=getattr(scheduler, "preemptions",
+                                self.preemptions),
+            recompute_tokens=getattr(scheduler, "recompute_tokens", 0),
+            blocks_resident=resident,
             serve_anomaly=self.anomaly_section(allocator),
             admission_blocked_slots=self.admission_blocked_slots,
             admission_blocked_blocks=self.admission_blocked_blocks,

@@ -29,15 +29,21 @@ and the ratio against the naive recompute-the-prefix baseline, emitted
 as one ``decode`` monitor record (explicit ``SKIP(reason)`` off-TPU).
 
 ``python bench.py --serve`` runs the CONTINUOUS-BATCHING serving leg
-(:func:`serve_main`): an offered-load sweep (Poisson arrivals, mixed
-lengths) through the paged ``apex_tpu.serving.ServingEngine`` — p50/p99
-per-token latency, TTFT, tokens/s under churn, occupancy — as one
-``serve`` monitor record with greedy-parity and jit-cache-pinned
-witnesses vs the single-request engine (explicit ``SKIP(reason)``
-off-TPU). Request-level telemetry rides along: streaming-histogram
-quantiles, per-request ``serve_event`` lifecycle records, periodic
-``serve_window`` SLO records, and the ``serve_anomaly`` section
-(stragglers, queue buildup, SLO burn, pool leaks).
+(:func:`serve_main`): a SEEDED offered-load sweep (Poisson arrivals,
+mixed lengths, shared system prompts, a pool sized below worst case)
+through the paged ``apex_tpu.serving.ServingEngine`` — copy-on-write
+prefix caching, optimistic admission + evict-and-recompute preemption,
+SLO-aware dispatch — measuring p50/p99 per-token latency, TTFT split by
+prefix hit vs miss, tokens/s under churn, occupancy, preemption and
+recompute counts — as one ``serve`` monitor record with greedy-parity
+(no-churn AND across-the-sweep ``churn_parity`` including evicted and
+prefix-hit requests) and jit-cache-pinned witnesses vs the
+single-request engine (explicit ``SKIP(reason)`` off-TPU).
+Request-level telemetry rides along: streaming-histogram quantiles,
+per-request ``serve_event`` lifecycle records (now incl. the ``evict``
+trail), periodic ``serve_window`` SLO records, and the
+``serve_anomaly`` section (stragglers, queue buildup, SLO burn, pool
+leaks — refcount-aware: a warm prefix cache is not a leak).
 
 ``python bench.py --longseq-bias`` runs the long-sequence relative-bias
 leg (:func:`longseq_bias_main`): in-kernel BUCKETED bias vs the
@@ -298,16 +304,76 @@ def decode_main():
     print(json.dumps(record))
 
 
+#: seed of the serve sweep's Poisson trace — a fixed, recorded constant
+#: so every sweep is replayable (the `trace_seed` field in the record)
+SERVE_TRACE_SEED = 0
+
+
+def build_serve_trace(seed, n_req, offered_rps, vocab, prompt_rng,
+                      newtok_rng, sys_prompt_len=0, n_sys_prompts=2,
+                      share_frac=0.5):
+    """The serve sweep's request trace, fully determined by ``seed``:
+    Poisson arrivals at ``offered_rps``, mixed prompt/output lengths,
+    and — when ``sys_prompt_len > 0`` — a ``share_frac`` fraction of
+    requests prefixed with one of ``n_sys_prompts`` shared system
+    prompts (the chat/agent workload the prefix cache exists for).
+    Same seed → token-identical requests and arrival times: sweeps are
+    replayable (pinned by ``tests/test_serving.py``)."""
+    import numpy as np
+
+    from apex_tpu.serving import Request
+
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / offered_rps, n_req))
+    sys_prompts = [
+        rng.integers(0, vocab, sys_prompt_len).astype(np.int32)
+        for _ in range(n_sys_prompts)
+    ] if sys_prompt_len > 0 else []
+    requests = []
+    for i in range(n_req):
+        tail = rng.integers(
+            0, vocab,
+            int(rng.integers(prompt_rng[0],
+                             prompt_rng[1] + 1))).astype(np.int32)
+        if sys_prompts and rng.random() < share_frac:
+            sysp = sys_prompts[int(rng.integers(len(sys_prompts)))]
+            prompt = np.concatenate([sysp, tail])
+        else:
+            # same TOTAL length distribution as the shared population —
+            # a fresh random prefix instead of a shared one, so the
+            # hit-vs-miss TTFT split measures the cache, not a
+            # prompt-length skew
+            pad = rng.integers(0, vocab,
+                               sys_prompt_len).astype(np.int32)
+            prompt = np.concatenate([pad, tail]) if sys_prompt_len \
+                else tail
+        requests.append(Request(
+            rid=i, prompt=prompt,
+            max_new_tokens=int(rng.integers(newtok_rng[0],
+                                            newtok_rng[1] + 1)),
+            arrival_s=float(arrivals[i])))
+    return requests
+
+
 def serve_main():
     """``python bench.py --serve`` — the continuous-batching serving leg:
-    an offered-load sweep (Poisson arrivals, mixed prompt/output lengths)
-    through :class:`apex_tpu.serving.ServingEngine` — paged KV blocks,
-    chunked prefill, fused sampling tail — measuring p50/p99 per-token
-    latency, time-to-first-token, decode tokens/s/chip under churn, and
-    slot occupancy, plus the no-churn witnesses against the
-    single-request ``DecodeEngine``: greedy tokens IDENTICAL and
-    throughput parity (``vs_single_request``), with both jitted steps'
-    cache size pinned at 1 across the whole schedule.
+    an offered-load sweep (seeded Poisson arrivals, mixed prompt/output
+    lengths, shared system prompts) through
+    :class:`apex_tpu.serving.ServingEngine` — paged KV blocks with
+    copy-on-write prefix caching, optimistic admission + preemption,
+    chunked prefill, SLO-aware dispatch, fused sampling tail — measuring
+    p50/p99 per-token latency, TTFT split by prefix-cache hit vs miss,
+    decode tokens/s/chip under churn, and slot occupancy, plus the
+    witnesses: greedy tokens IDENTICAL to the single-request
+    ``DecodeEngine`` both with no churn AND across the sweep including
+    evicted-and-recomputed and prefix-hit requests (``churn_parity``),
+    with both jitted steps' cache size pinned at 1 across the whole
+    hit/miss/evict/readmit schedule.
+
+    The pool is deliberately sized BELOW worst-case-everything and the
+    offered load runs 4x the tier-1 sweep (64 rps vs the 16 the PR-7
+    leg drove): exhaustion must engage preemption (bounded p99, the
+    ``evict`` lifecycle trail) instead of stalling admission.
 
     Emits ONE ``serve`` record through the monitor schema (and onto the
     ``APEX_TPU_MONITOR`` stream when enabled) and prints it as one JSON
@@ -323,9 +389,9 @@ def serve_main():
     per-request ``serve_event`` lifecycle records and periodic
     ``serve_window`` SLO records onto the monitor stream, and the final
     record carries the ``serve_anomaly`` section, admission-pressure
-    counts, and the MEASURED telemetry overhead
-    (``telemetry_overhead_pct`` — the <1%-of-a-serve-step budget,
-    reported rather than assumed)."""
+    counts, prefix-cache/preemption fields, and the MEASURED telemetry
+    overhead (``telemetry_overhead_pct`` — the <1%-of-a-serve-step
+    budget, reported rather than assumed)."""
     import numpy as np
 
     on_tpu = jax.default_backend() == "tpu"
@@ -336,23 +402,31 @@ def serve_main():
 
     if on_tpu:
         # the flagship decode-bench config; 8 slots x 1024 rows of bf16
-        # paged cache ~ 400 MB pool next to the bf16 params
+        # paged cache; the pool is sized to ~60% of worst-case-
+        # everything so the 4x offered load actually exercises
+        # preemption (the point of serving tier 2)
         cfg = dict(vocab_size=32768, max_seq_len=1024, hidden_size=1024,
                    num_layers=12, num_heads=8, tp_size=1, remat=False,
                    attention_impl="flash", scan_layers=False)
         slots, block, chunk = 8, 128, 256
-        n_req, offered_rps = 32, 16.0
+        n_req, offered_rps = 64, 64.0   # 4x the PR-7 sweep's 16 rps
+        num_blocks = 41                 # 40 allocatable of 64 worst-case
         prompt_rng, newtok_rng = (64, 512), (16, 128)
+        sys_prompt_len = 256            # 2 shared full blocks
         parity_prompt, parity_new = 512, 64
+        n_parity = 6
         cast = jnp.bfloat16
     else:  # smoke scale; the record is SKIP either way
         cfg = dict(vocab_size=256, max_seq_len=128, hidden_size=64,
                    num_layers=2, num_heads=4, tp_size=1, remat=False,
                    attention_impl="flash")
         slots, block, chunk = 2, 16, 32
-        n_req, offered_rps = 6, 500.0
+        n_req, offered_rps = 8, 2000.0
+        num_blocks = 9                  # 8 allocatable of 16 worst-case
         prompt_rng, newtok_rng = (4, 40), (2, 10)
+        sys_prompt_len = 32             # 2 shared full blocks
         parity_prompt, parity_new = 16, 8
+        n_parity = 8
         cast = None
 
     model = GPTModel(GPTConfig(**cfg))
@@ -360,7 +434,8 @@ def serve_main():
     if cast is not None:
         params = jax.tree.map(lambda x: x.astype(cast), params)
     engine = ServingEngine(model, num_slots=slots, block_size=block,
-                           prefill_chunk=chunk, cache_dtype=cast)
+                           prefill_chunk=chunk, num_blocks=num_blocks,
+                           cache_dtype=cast)
 
     # --- no-churn witnesses: one greedy request, both engines ---------------
     deng = DecodeEngine(model, cache_dtype=cast)
@@ -393,21 +468,12 @@ def serve_main():
     single_tps = parity_new / single_s
     vs_single = (parity_new / paged_s) / single_tps
 
-    # --- the churn sweep: Poisson arrivals, mixed lengths -------------------
-    rng = np.random.default_rng(0)
-    arrivals = np.cumsum(rng.exponential(1.0 / offered_rps, n_req))
-    requests = [
-        Request(
-            rid=i,
-            prompt=np.asarray(rng.integers(
-                0, cfg["vocab_size"],
-                int(rng.integers(prompt_rng[0], prompt_rng[1] + 1))),
-                np.int32),
-            max_new_tokens=int(rng.integers(newtok_rng[0],
-                                            newtok_rng[1] + 1)),
-            arrival_s=float(arrivals[i]))
-        for i in range(n_req)
-    ]
+    # --- the churn sweep: seeded Poisson arrivals, mixed lengths, ------------
+    # shared system prompts (the prefix-cache workload). Same seed →
+    # identical trace: the sweep is replayable.
+    requests = build_serve_trace(
+        SERVE_TRACE_SEED, n_req, offered_rps, cfg["vocab_size"],
+        prompt_rng, newtok_rng, sys_prompt_len=sys_prompt_len)
     # the telemetry layer: streaming histograms (bounded memory — the
     # r7 per-token host lists are gone from this aggregation), lifecycle
     # + window records on the monitor stream, anomaly detection. The
@@ -429,27 +495,53 @@ def serve_main():
 
     total_tokens = sum(len(r.tokens) for r in done)
     # the zero-recompile contract IS part of what is measured: any
-    # re-trace across this churn schedule would be dispatch overhead —
-    # and it must hold WITH telemetry attached (lifecycle records are
-    # emitted outside the jitted steps)
+    # re-trace across this hit/miss/evict/readmit churn schedule would
+    # be dispatch overhead — and it must hold WITH telemetry attached
+    # (lifecycle records are emitted outside the jitted steps)
     jit_cache_ok = (engine.prefill_chunk._cache_size() == 1
                     and engine.decode_step._cache_size() == 1)
     assert jit_cache_ok, \
         "serving steps re-traced under churn (unstable avals?)"
+    # pool accounting must be refcount-exact after the sweep: no leak,
+    # and every live block a cache-resident (warm prefix, not demand)
+    sched.allocator.check_accounting()
+    assert sched.allocator.num_live == sched.allocator.num_resident, \
+        "blocks live beyond the prefix cache's residents after drain"
+
+    # greedy parity ACROSS the churn sweep, prioritizing the requests
+    # the tier-2 machinery touched: evicted-and-recomputed streams and
+    # prefix-cache hits must be token-identical to the unpreempted,
+    # uncached DecodeEngine baseline (capped: each distinct prompt
+    # length compiles one baseline prefill)
+    touched = [r for r in done
+               if r.evictions > 0 or r.prefix_hit_blocks > 0]
+    untouched = [r for r in done if not (r.evictions > 0
+                                         or r.prefix_hit_blocks > 0)]
+    checked = (touched + untouched)[:n_parity]
+    churn_parity = True
+    for r in checked:
+        want = np.asarray(deng.generate(
+            params, jnp.asarray(r.prompt)[None], r.max_new_tokens))[0]
+        ok = (len(r.tokens) == r.max_new_tokens
+              and (np.asarray(r.tokens) == want).all())
+        churn_parity = churn_parity and bool(ok)
 
     fields = dict(
         tokens_per_s=round(total_tokens / wall, 1),
         # streaming-histogram quantiles (parity with the removed
         # sample-list math within one bucket width — pinned by
-        # tests/test_histogram.py)
-        **tel.final_fields(sched.allocator),
+        # tests/test_histogram.py) + the tier-2 prefix/preemption view
+        **tel.final_fields(sched.allocator, sched),
         telemetry_overhead_pct=round(100.0 * tel.overhead_s / wall, 4),
         occupancy_pct=round(stats.occupancy_pct(slots), 2),
         vs_single_request=round(vs_single, 4),
         single_request_tokens_per_s=round(single_tps, 1),
         offered_rps=offered_rps,
         greedy_parity=bool(greedy_parity),
+        churn_parity=bool(churn_parity),
+        churn_parity_checked=len(checked),
         jit_cache_ok=bool(jit_cache_ok),
+        trace_seed=SERVE_TRACE_SEED,
         requests=n_req, slots=slots, block_size=block,
         num_blocks=engine.num_blocks,
         blocks_high_water=stats.blocks_high_water,

@@ -179,3 +179,89 @@ class TestTier1Smoke:
                           "window_s": 0.5}) + "\n")
         assert bench_history.main([str(stream),
                                    "--root", str(tmp_path)]) == 0
+
+
+class TestPrefixHitLatencySeries:
+    """ISSUE 13 satellite: an OK serve record's prefix_hit_ttft_p50_ms
+    gates as a LOWER-is-better series next to its throughput."""
+
+    def _serve(self, tok, hit_ms=None, status="OK"):
+        rec = {"kind": "serve", "schema": 1, "status": status,
+               "tokens_per_s": tok}
+        if status == "SKIP":
+            rec["reason"] = "no TPU"
+        if hit_ms is not None:
+            rec["prefix_hit_ttft_p50_ms"] = hit_ms
+        return rec
+
+    def test_extract_all_carries_both_series(self):
+        rows = bench_history.extract_all(self._serve(5000.0, 12.0))
+        assert ("serve_tokens_per_s", 5000.0, 0.0) in rows
+        assert ("serve_prefix_hit_ttft_p50_ms", 12.0, 0.0) in rows
+        # pre-tier-2 records (no hit field) carry throughput only
+        assert bench_history.extract_all(self._serve(5000.0)) == [
+            ("serve_tokens_per_s", 5000.0, 0.0)]
+        # a skip OBJECT (no hit landed) is not a number: not gated
+        rec = self._serve(5000.0)
+        rec["prefix_hit_ttft_p50_ms"] = {"skipped": True,
+                                         "reason": "no hits"}
+        assert len(bench_history.extract_all(rec)) == 1
+        # extract() still returns the PRIMARY claim
+        assert bench_history.extract(self._serve(5000.0, 12.0))[0] == \
+            "serve_tokens_per_s"
+
+    def test_hit_ttft_drift_up_is_a_regression(self, tmp_path, capsys):
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps(self._serve(5000.0, 10.0)))
+        fresh = tmp_path / "fresh.json"
+        # throughput holds, hit TTFT +50%: lower-is-better fails
+        fresh.write_text(json.dumps(self._serve(5000.0, 15.0)))
+        rc = bench_history.main([str(fresh), "--root", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "OK serve_tokens_per_s" in out
+        assert "REGRESSION serve_prefix_hit_ttft_p50_ms" in out
+        # faster hits (drift DOWN) are an improvement, not a regression
+        fresh.write_text(json.dumps(self._serve(5000.0, 7.0)))
+        rc = bench_history.main([str(fresh), "--root", str(tmp_path)])
+        assert rc == 0
+        assert "OK serve_prefix_hit_ttft_p50_ms" in \
+            capsys.readouterr().out
+
+    def test_throughput_regression_still_gates_with_both(self, tmp_path,
+                                                         capsys):
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps(self._serve(5000.0, 10.0)))
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(self._serve(3000.0, 10.0)))
+        rc = bench_history.main([str(fresh), "--root", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "REGRESSION serve_tokens_per_s" in out
+        assert "OK serve_prefix_hit_ttft_p50_ms" in out
+
+    def test_skip_record_still_claims_nothing(self, tmp_path, capsys):
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps(self._serve(5000.0, 10.0)))
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(
+            self._serve(1.0, 99999.0, status="SKIP")))
+        assert bench_history.main([str(fresh),
+                                   "--root", str(tmp_path)]) == 0
+        assert "SKIP" in capsys.readouterr().out
+
+    def test_no_hit_history_is_skip_for_that_series_only(self, tmp_path,
+                                                         capsys):
+        """Fresh record carries the new series but the trajectory
+        predates it: the latency series SKIPs, throughput still
+        gates."""
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps(self._serve(5000.0)))  # pre-tier-2 history
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(self._serve(4950.0, 12.0)))
+        rc = bench_history.main([str(fresh), "--root", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "OK serve_tokens_per_s" in out
+        assert "SKIP: no history artifact carries metric " \
+            "'serve_prefix_hit_ttft_p50_ms'" in out

@@ -92,13 +92,19 @@ def test_inference_doc_covers_serving_contract():
                         "inference.md")
     text = open(path).read()
     for needle in ("block table", "free list", "dead block",
-                   "reservation gate", "Chunked prefill", "fused_sample",
+                   "Chunked prefill", "fused_sample",
                    "bench.py --serve", "greedy_parity",
                    "_cache_size() == 1", "multiple of 128",
                    # ISSUE 10: request-level telemetry chapter
                    "ServeTelemetry", "serve_event", "serve_window",
                    "--serve-timeline", "telemetry_overhead_pct",
-                   "bench_history.py", "rounding recipe"):
+                   "bench_history.py", "rounding recipe",
+                   # ISSUE 13: prefix caching + preemption chapter
+                   "PrefixCache", "copy-on-write", "refcount",
+                   "Optimistic FCFS admission", "evict-and-recompute",
+                   "prefix_hit_ttft_p50_ms", "prefix_hit_rate",
+                   "preemptions", "churn_parity", "SLOPolicy",
+                   "trace_seed", "num_resident"):
         assert needle in text, f"inference.md dropped {needle}"
 
 

@@ -585,14 +585,21 @@ class TestSchedulerTelemetrySeam:
         """The admission-pressure split: a pool too tight counts
         'blocks', a full slot array counts 'slots'."""
         tel = ServeTelemetry(slots=2, window_s=0.0)
-        # pool pressure: 5 allocatable, each request worst-cases 3
+        # pool pressure under the OPTIMISTIC gate: the pool must not
+        # even cover an arrived request's first prefill chunk. rid 0's
+        # prefill takes both allocatable blocks; rid 1 has a free slot
+        # but no headroom for its 2-block first chunk.
         s = Scheduler(num_slots=2, block_size=4, max_blocks_per_slot=16,
-                      allocator=BlockAllocator(6), prefill_chunk=8,
+                      allocator=BlockAllocator(4), prefill_chunk=8,
                       telemetry=tel)
-        for i in range(2):
-            s.submit(Request(rid=i, prompt=np.zeros(8, np.int32),
-                             max_new_tokens=4))
+        s.submit(Request(rid=0, prompt=np.zeros(8, np.int32),
+                         max_new_tokens=2))
         assert s.admit(now=0.0) == [0]
+        w = s.next_prefill(0.0)
+        s.note_prefill(w, sampled_token=1, now=0.0)  # 2 blocks held
+        s.submit(Request(rid=1, prompt=np.zeros(8, np.int32),
+                         max_new_tokens=2))
+        assert s.admit(now=0.0) == []
         assert tel.admission_blocked_blocks == 1
         assert tel.admission_blocked_slots == 0
         # slot pressure: plenty of pool, no free slot
@@ -607,3 +614,198 @@ class TestSchedulerTelemetrySeam:
         s2.admit(now=0.0)
         assert tel2.admission_blocked_slots >= 1
         assert tel2.admission_blocked_blocks == 0
+
+
+class TestServingTier2Telemetry:
+    """ISSUE 13: the reserved ``evict`` event goes live, the leak
+    detector learns refcounted residency, TTFT splits by prefix-cache
+    outcome, and the new record fields validate + render."""
+
+    def test_evict_lifecycle_through_real_preemption(self, tmp_path,
+                                                     tiny):
+        """A pool sized below worst case: the engine preempts, the
+        stream carries schema-valid ``evict`` records (reason, blocks
+        released, re-queue position, generated count), the victim
+        re-admits as ``resumed``, and --serve-timeline RENDERS the
+        eviction instead of dropping it."""
+        model, params = tiny
+        path = tmp_path / "evict.jsonl"
+        monitor.enable(str(path))
+        try:
+            eng = ServingEngine(model, num_slots=2, block_size=8,
+                                prefill_chunk=8, max_seq_len=64,
+                                num_blocks=7)
+            tel = ServeTelemetry(slots=2, window_s=0.0)
+            sched = eng.make_scheduler()
+            rng = np.random.default_rng(0)
+            reqs = [Request(rid=i,
+                            prompt=np.asarray(rng.integers(0, 97, 12),
+                                              np.int32),
+                            max_new_tokens=14) for i in range(3)]
+            done = eng.serve(params, reqs, scheduler=sched,
+                             telemetry=tel)
+            assert len(done) == 3
+        finally:
+            monitor.disable()
+        assert sched.preemptions >= 1
+        assert tel.preemptions == sched.preemptions
+        assert tel.resumes >= 1
+        lines = path.read_text().splitlines()
+        assert monitor.validate_jsonl(lines) == []
+        records = [json.loads(ln) for ln in lines]
+        evicts = [r for r in records if r.get("kind") == "serve_event"
+                  and r.get("phase") == "evict"]
+        assert len(evicts) == sched.preemptions
+        ev = evicts[0]
+        assert ev["evict_reason"] == "pool_pressure"
+        assert ev["blocks_released"] >= 1
+        assert ev["requeue_pos"] == 0
+        assert ev["generated"] >= 0
+        # the victim re-admits flagged resumed, then re-enters decode
+        readmits = [r for r in records
+                    if r.get("kind") == "serve_event"
+                    and r.get("phase") == "admit" and r.get("resumed")]
+        assert readmits and readmits[0]["rid"] == ev["rid"]
+        # --serve-timeline renders the eviction payload, not "unknown"
+        timeline = monitor_report.serve_timeline(records)
+        row = next(r for r in timeline["requests"]
+                   if r["rid"] == ev["rid"])
+        assert row["evictions"] >= 1
+        assert row["evict_reason"] == "pool_pressure"
+        assert row["blocks_released"] >= 1
+        assert row["requeue_pos"] == 0
+        assert row["outcome"] == "finish"  # it DID finish after requeue
+        rendered = monitor_report.format_serve_timeline(timeline)
+        assert "evict x" in rendered
+        assert "pool_pressure" in rendered
+        assert "requeued at 0" in rendered
+
+    def test_evicted_without_finish_renders_evicted_outcome(self):
+        recs = [
+            {"kind": "serve_event", "rid": 5, "phase": "submit",
+             "at_s": 0.0, "prompt_len": 8, "max_new_tokens": 4},
+            {"kind": "serve_event", "rid": 5, "phase": "evict",
+             "at_s": 0.5, "evict_reason": "pool_pressure",
+             "blocks_released": 3, "requeue_pos": 0, "generated": 2},
+        ]
+        timeline = monitor_report.serve_timeline(recs)
+        assert timeline["requests"][0]["outcome"] == "evicted"
+        out = monitor_report.format_serve_timeline(timeline)
+        assert "evicted" in out and "3 blk released" in out
+
+    def test_warm_prefix_cache_is_not_a_leak(self):
+        """The satellite fix: refcounted resident blocks while idle are
+        warm capacity — the idle leak detector must subtract them, in
+        the window path AND the final record; blocks live BEYOND the
+        residents still flag."""
+        alloc = BlockAllocator(10)
+        ids = alloc.allocate(3)
+        for bid in ids:
+            alloc.mark_resident(bid)   # what a PrefixCache holds
+        tel = ServeTelemetry(slots=2, window_s=1e-9)
+        for t in (1.0, 2.0):
+            tel.maybe_window(t, _FakeSched(waiting=0, active=0,
+                                           allocator=alloc))
+        assert tel.leaked_blocks == 0
+        assert tel.final_fields(alloc)["serve_anomaly"][
+            "leaked_blocks"] == 0
+        # one MORE live block with no resident flag: that IS the leak
+        alloc.allocate(1)
+        tel2 = ServeTelemetry(slots=2, window_s=1e-9)
+        for t in (1.0, 2.0):
+            tel2.maybe_window(t, _FakeSched(waiting=0, active=0,
+                                            allocator=alloc))
+        assert tel2.leaked_blocks == 1
+        tel3 = ServeTelemetry(slots=2, window_s=0.0)
+        assert tel3.final_fields(alloc)["serve_anomaly"][
+            "leaked_blocks"] == 1
+
+    def test_ttft_splits_by_prefix_outcome(self):
+        tel = ServeTelemetry(slots=2, window_s=0.0)
+        hit = Request(rid=0, prompt=np.zeros(8, np.int32),
+                      max_new_tokens=2)
+        hit.prefix_hit_blocks = 2
+        miss = Request(rid=1, prompt=np.zeros(8, np.int32),
+                       max_new_tokens=2)
+        tel.on_submit(hit, 0.0)
+        tel.on_submit(miss, 0.0)
+        tel.on_first_token(hit, 0, 1, 0, 0.010)    # 10 ms
+        tel.on_first_token(miss, 1, 1, 0, 0.050)   # 50 ms
+        assert tel.prefix_hit_requests == 1
+        assert tel.prefix_miss_requests == 1
+        f = tel.final_fields()
+        assert f["prefix_hit_ttft_p50_ms"] < f["prefix_miss_ttft_p50_ms"]
+        assert f["prefix_hit_requests"] == 1
+        assert f["prefix_miss_requests"] == 1
+        # and the combined histogram still carries both
+        assert tel.ttft_ms.count == 2
+
+    def test_window_and_final_fields_validate_with_tier2_keys(
+            self, tmp_path, tiny):
+        """The grown schemas: prefix_hit_rate / preemptions /
+        recompute_tokens / blocks_resident ride serve_window records
+        and the final serve record, validator-clean; a junk value in
+        the new metric field still fails (drift test)."""
+        reqs = [Request(rid=i,
+                        prompt=np.full(18, 3 + i, np.int32),
+                        max_new_tokens=4, arrival_s=0.0)
+                for i in range(3)]
+        records, tel, eng, sched = _serve_with_stream(
+            tmp_path, tiny, reqs, window_s=1e-6, name="tier2")
+        windows = [r for r in records if r.get("kind") == "serve_window"]
+        assert windows
+        w = windows[-1]
+        assert "prefix_hit_rate" in w
+        assert w["preemptions"] == sched.preemptions
+        assert "recompute_tokens" in w
+        assert w["blocks_resident"] == sched.allocator.num_resident
+        # the final serve record construction path: emit + validate
+        reg = monitor.MetricsRegistry()
+        rec = reg.emit_serve(
+            "OK", tokens_per_s=100.0,
+            **tel.final_fields(sched.allocator, sched))
+        assert monitor.validate(rec) == []
+        assert rec["preemptions"] == sched.preemptions
+        # drift: a junk string inside a tier-2 metric field must fail
+        bad = dict(rec, prefix_hit_rate="lots")
+        assert any("prefix_hit_rate" in e for e in monitor.validate(bad))
+        bad2 = dict(rec, preemptions="many")
+        assert any("preemptions" in e for e in monitor.validate(bad2))
+
+    def test_readmit_queue_wait_measured_from_eviction(self, tmp_path):
+        """A re-admission's queue_wait must cover the evict→re-admit
+        span only — billing the prior in-slot service time as queueing
+        would inflate exactly the rows preemption analysis reads."""
+        path = tmp_path / "requeue.jsonl"
+        monitor.enable(str(path))
+        try:
+            tel = ServeTelemetry(slots=2, window_s=0.0)
+            req = Request(rid=0, prompt=np.zeros(8, np.int32),
+                          max_new_tokens=4)
+            tel.on_submit(req, 0.0)
+            tel.on_admit(req, 0, 1.0)     # queued 1 s
+            tel.on_evict(req, 0, 3, "pool_pressure", 0, 5, 5.0)
+            tel.on_admit(req, 1, 5.25, resumed=True)  # re-queued 0.25 s
+        finally:
+            monitor.disable()
+        admits = [json.loads(ln) for ln in path.read_text().splitlines()
+                  if '"admit"' in ln]
+        assert admits[0]["queue_wait_ms"] == pytest.approx(1000.0)
+        assert admits[1]["queue_wait_ms"] == pytest.approx(250.0)
+
+    def test_slo_burning_is_live_not_sticky(self):
+        tel = ServeTelemetry(slots=2, window_s=0.0, slo_ttft_ms=10.0,
+                             slo_burn_count=2)
+
+        def ft(rid, s):
+            r = Request(rid=rid, prompt=np.zeros(4, np.int32),
+                        max_new_tokens=2)
+            tel.on_submit(r, 0.0)
+            tel.on_first_token(r, 0, 1, 0, s)
+
+        ft(0, 0.5)
+        ft(1, 0.5)
+        assert tel.slo_burning and tel.slo_burn
+        ft(2, 0.001)  # back under SLO: the LIVE signal clears,
+        assert not tel.slo_burning
+        assert tel.slo_burn  # ...the sticky record flag does not

@@ -4,9 +4,12 @@ The contracts under test:
 
 * the paged KV pool: free-list allocator invariants (dead block
   reserved, exhaustion is loud, double-free is loud, free restores);
-* the scheduler: FCFS admission behind the worst-case reservation gate,
-  chunked-prefill progression, eviction returns every block (no leak
-  across N churn cycles);
+* the scheduler: optimistic FCFS admission against live-token demand,
+  prefix sharing copy-on-write (one physical copy, refcount-exact),
+  preemption (youngest victim, evict-and-recompute, token-identical
+  resume), SLO-aware dispatch knobs, chunked-prefill progression,
+  eviction returns every reference (no leak across N churn cycles —
+  warm prefix residents are capacity, not leaks);
 * paged ``decode_attention`` == contiguous (bitwise on the XLA gather
   path, tolerance on the interpret-mode kernel), with and without the
   bucketed relative bias;
@@ -40,9 +43,11 @@ from apex_tpu.ops import decode_attention, fused_sample
 from apex_tpu.serving import (
     DEAD_BLOCK,
     BlockAllocator,
+    PrefixCache,
     Request,
     Scheduler,
     ServingEngine,
+    SLOPolicy,
     blocks_needed,
 )
 
@@ -174,6 +179,291 @@ class TestBlockAllocator:
         a.free([i for i in ids if i not in (ids[1], ids[4], ids[6])])
         assert a.fragmentation_pct() == 0.0  # whole pool back: one run
 
+    # --- serving tier 2: refcounts, COW sharing, residency ----------------
+
+    def test_refcount_exact_across_shared_prefix(self):
+        """Three holders of one block (owner + two sharers): the block
+        only physically frees on the LAST release, the physical
+        counters never drift, and leaked stays exactly zero the whole
+        way — refcount churn is invisible to the leak identity."""
+        a = BlockAllocator(6)
+        (bid,) = a.allocate(1)
+        a.retain([bid])
+        a.retain([bid])
+        assert a.refcount(bid) == 3 and a.is_shared(bid)
+        assert a.alloc_total == 1  # retains are not allocations
+        a.free([bid])
+        a.free([bid])
+        assert a.num_live == 1 and a.free_total == 0  # still held
+        assert a.leaked == 0
+        a.check_accounting()
+        a.free([bid])  # last reference: physical free
+        assert a.num_live == 0 and a.free_total == 1
+        assert a.leaked == 0
+        a.check_accounting()
+
+    def test_shared_block_over_free_is_still_loud(self):
+        a = BlockAllocator(6)
+        (bid,) = a.allocate(1)
+        a.retain([bid])
+        a.free([bid])
+        a.free([bid])  # refcount hits 0: physically freed
+        with pytest.raises(ValueError, match="double free"):
+            a.free([bid])  # one more than the references ever held
+        with pytest.raises(ValueError, match="cannot retain"):
+            a.retain([bid])  # sharing freed memory would cross-wire
+        a.check_accounting()
+
+    def test_check_accounting_covers_refcounts(self):
+        a = BlockAllocator(6)
+        ids = a.allocate(2)
+        a.check_accounting()
+        a._ref[ids[0]] = 0  # live block with no reference: corrupt
+        with pytest.raises(RuntimeError, match="refcounts corrupt"):
+            a.check_accounting()
+        a._ref[ids[0]] = 1
+        a.check_accounting()
+        del a._ref[ids[1]]  # live block missing from the ref ledger
+        with pytest.raises(RuntimeError, match="refcounts corrupt"):
+            a.check_accounting()
+
+    def test_resident_marking(self):
+        """Cache-resident blocks are live-but-not-demand: num_resident
+        tracks them, physical free clears the flag, and marking a
+        non-live block is loud."""
+        a = BlockAllocator(6)
+        ids = a.allocate(3)
+        a.mark_resident(ids[0])
+        a.mark_resident(ids[1])
+        assert a.num_resident == 2
+        a.unmark_resident(ids[1])
+        assert a.num_resident == 1
+        a.free([ids[0]])  # physical free clears residency
+        assert a.num_resident == 0
+        with pytest.raises(ValueError, match="resident"):
+            a.mark_resident(ids[0])  # no longer live
+        a._resident.add(99)  # stray resident id: corrupt
+        with pytest.raises(RuntimeError, match="resident-but-not-live"):
+            a.check_accounting()
+
+
+class TestPrefixCache:
+    def _cache(self, num_blocks=20, block=4, capacity=None):
+        a = BlockAllocator(num_blocks)
+        return a, PrefixCache(a, block, capacity_blocks=capacity)
+
+    def _index_chain(self, a, c, tokens):
+        """Allocate + insert every full block of ``tokens``; returns
+        the block ids (simulating a request registering its prefill)."""
+        B = c.block_size
+        eids, bids = [0], []
+        for i in range(len(tokens) // B):
+            (bid,) = a.allocate(1)
+            eids.append(c.insert(eids[-1], tokens[i * B:(i + 1) * B],
+                                 bid))
+            bids.append(bid)
+        return bids
+
+    def test_match_walks_the_chain(self):
+        a, c = self._cache()
+        prompt = np.arange(13, dtype=np.int32)
+        bids = self._index_chain(a, c, prompt)
+        assert len(bids) == 3  # 13 tokens / 4 = 3 full blocks
+        chain = c.match(prompt)
+        assert [e.block_id for e in chain] == bids
+        # a prompt diverging inside block 2 matches only blocks 0-1
+        other = prompt.copy()
+        other[6] = 99
+        assert [e.block_id for e in c.match(other)] == bids[:1]
+        # block-level stats counted on counting lookups only
+        assert c.block_queries == 6 and c.block_hits == 4
+        assert c.match(prompt, count=False) and c.block_queries == 6
+
+    def test_same_tokens_different_parent_are_distinct(self):
+        """The chain key: an identical token block under a DIFFERENT
+        prefix is a different entry — content equality of one block
+        never aliases two prefixes."""
+        a, c = self._cache()
+        blk = np.asarray([5, 6, 7, 8], np.int32)
+        p1 = np.concatenate([np.zeros(4, np.int32), blk])
+        p2 = np.concatenate([np.ones(4, np.int32), blk])
+        b1 = self._index_chain(a, c, p1)
+        b2 = self._index_chain(a, c, p2)
+        assert c.num_entries == 4  # two roots, two distinct children
+        assert [e.block_id for e in c.match(p1)] == b1
+        assert [e.block_id for e in c.match(p2)] == b2
+        assert b1[1] != b2[1]
+
+    def test_hash_collisions_can_never_alias(self):
+        """Force EVERY key into one bucket: lookups still resolve by
+        full ``(parent, tokens)`` comparison, so two different prefixes
+        keep distinct entries and hits return the right blocks."""
+        class Colliding(PrefixCache):
+            def _hash(self, parent_eid, tokens):
+                return 0  # worst-case hash: everything collides
+
+        a = BlockAllocator(20)
+        c = Colliding(a, 4)
+        p1 = np.arange(8, dtype=np.int32)
+        p2 = np.arange(8, dtype=np.int32) + 50
+        b1 = self._index_chain(a, c, p1)
+        b2 = self._index_chain(a, c, p2)
+        assert len(c._buckets) == 1  # truly all in one bucket
+        assert [e.block_id for e in c.match(p1)] == b1
+        assert [e.block_id for e in c.match(p2)] == b2
+        assert c.match(np.arange(8, dtype=np.int32) + 99) == []
+
+    def test_gate_precheck_is_side_effect_free(self):
+        """match(count=False) — the admission gate's pre-check — must
+        neither bump LRU stamps (a held-back request would pin its
+        chain MRU against reclaim without using it) nor count stats;
+        commit_match does both when the admission really happens."""
+        a, c = self._cache()
+        p = np.arange(8, dtype=np.int32)
+        self._index_chain(a, c, p)
+        stamps = {e.eid: e.stamp for e in c._by_eid.values()}
+        q, h = c.block_queries, c.block_hits
+        chain = c.match(p, count=False)
+        assert len(chain) == 2
+        assert {e.eid: e.stamp for e in c._by_eid.values()} == stamps
+        assert (c.block_queries, c.block_hits) == (q, h)
+        c.commit_match(p, chain)
+        assert c.block_queries == q + 2 and c.block_hits == h + 2
+        after = {e.eid: e.stamp for e in c._by_eid.values()}
+        assert all(after[eid] > stamps[eid] for eid in stamps)
+
+    def test_insert_retains_and_marks_resident(self):
+        a, c = self._cache()
+        prompt = np.arange(8, dtype=np.int32)
+        bids = self._index_chain(a, c, prompt)
+        for bid in bids:
+            assert a.refcount(bid) == 2  # owner + cache
+        assert a.num_resident == 2
+        a.free(bids)  # the owner finishes: cache keeps them warm
+        assert a.num_live == 2 == a.num_resident
+        assert a.leaked == 0
+        a.check_accounting()
+
+    def test_reclaim_is_lru_leaf_first_and_skips_pinned(self):
+        a, c = self._cache()
+        p1 = np.arange(8, dtype=np.int32)        # chain of 2
+        p2 = np.arange(4, dtype=np.int32) + 40   # chain of 1
+        b1 = self._index_chain(a, c, p1)
+        b2 = self._index_chain(a, c, p2)
+        a.free(b1)  # owner 1 done: chain 1 reclaimable
+        # owner 2 still holds b2 (refcount 2): pinned, never reclaimed
+        assert c.reclaimable() == 2
+        # p2 was touched more recently; p1's LEAF (child) must go first
+        assert c.reclaim(1) == 1
+        assert [e.block_id for e in c.match(p1, count=False)] == b1[:1]
+        assert c.reclaim(10) == 1  # then p1's root; b2 stays pinned
+        assert c.num_entries == 1
+        assert [e.block_id for e in c.match(p2, count=False)] == b2
+        assert a.refcount(b2[0]) == 2
+        a.check_accounting()
+
+    def test_capacity_bound_reclaims_or_skips(self):
+        a, c = self._cache(capacity=2)
+        p1 = np.arange(8, dtype=np.int32)
+        b1 = self._index_chain(a, c, p1)
+        a.free(b1)  # unpinned: evictable
+        assert c.num_entries == 2
+        # a third block forces the LRU leaf out (capacity holds)
+        (bid,) = a.allocate(1)
+        c.insert(0, np.asarray([70, 71, 72, 73], np.int32), bid)
+        assert c.num_entries == 2
+        assert c.evictions == 1
+        # with every entry pinned, insert SKIPS indexing instead of
+        # growing: the new block is simply not findable, and the
+        # returned eid is DANGLING (never the still-valid parent — the
+        # chain must stay skipped, see the aliasing test below)
+        a2, c2 = self._cache(capacity=1)
+        (pinned,) = a2.allocate(1)
+        c2.insert(0, np.asarray([1, 2, 3, 4], np.int32), pinned)
+        (extra,) = a2.allocate(1)
+        eid = c2.insert(0, np.asarray([9, 9, 9, 9], np.int32), extra)
+        assert eid != 0 and eid not in c2._by_eid
+        assert c2.num_entries == 1
+        assert a2.refcount(extra) == 1  # not retained by the cache
+
+    def test_capacity_skip_cannot_miskey_the_next_block(self):
+        """Review-confirmed hazard: if block A's insert is skipped at
+        capacity but capacity frees before block B of the SAME chain
+        inserts, B must NOT land under A's parent — a prompt's second
+        block findable as a first block would alias mid-prompt KV onto
+        a future prompt's position 0. The skip returns a dangling eid,
+        so the whole rest of the chain stays unindexed."""
+        a, c = self._cache(capacity=1)
+        (pinned,) = a.allocate(1)
+        c.insert(0, np.asarray([7, 7, 7, 7], np.int32), pinned)  # pinned
+        blk_a = np.asarray([1, 2, 3, 4], np.int32)
+        blk_b = np.asarray([5, 6, 7, 8], np.int32)
+        (ba,) = a.allocate(1)
+        eid_a = c.insert(0, blk_a, ba)      # skipped: capacity + pinned
+        assert eid_a not in c._by_eid
+        a.free([pinned])                    # capacity frees in between
+        (bb,) = a.allocate(1)
+        eid_b = c.insert(eid_a, blk_b, bb)  # chain STAYS skipped
+        assert eid_b == eid_a and c.num_entries == 1
+        # the mid-prompt block is NOT findable as a prompt start
+        assert c.match(np.concatenate([blk_b, blk_b]),
+                       count=False) == []
+        a.check_accounting()
+
+    def test_reclaimed_parent_breaks_the_chain_quietly(self):
+        """Capacity pressure can evict the parent an in-progress chain
+        was building on (another slot's entries may be fresher): the
+        next insert must skip indexing — never wire an unreachable
+        child or KeyError — and keep skipping for the rest of that
+        chain."""
+        a, c = self._cache(capacity=2)
+        (b0,) = a.allocate(1)
+        e0 = c.insert(0, np.asarray([1, 2, 3, 4], np.int32), b0)
+        a.free([b0])  # only the cache holds it: reclaimable
+        # other traffic fills capacity with a FRESHER unpinned root,
+        # then a third insert reclaims LRU = e0 (our parent-to-be)
+        (b1,) = a.allocate(1)
+        c.insert(0, np.asarray([9, 9, 9, 9], np.int32), b1)
+        a.free([b1])
+        (b2,) = a.allocate(1)
+        c.insert(0, np.asarray([8, 8, 8, 8], np.int32), b2)
+        assert e0 not in c._by_eid  # the parent is gone
+        # chaining on the evicted parent: quiet skip, stable return
+        (b3,) = a.allocate(1)
+        got = c.insert(e0, np.asarray([5, 6, 7, 8], np.int32), b3)
+        assert got == e0
+        assert a.refcount(b3) == 1  # not retained by the cache
+        (b4,) = a.allocate(1)
+        assert c.insert(got, np.asarray([4, 3, 2, 1], np.int32),
+                        b4) == e0
+        a.check_accounting()
+
+    def test_insert_race_keeps_existing_entry(self):
+        """Two requests prefill the same prefix concurrently: the
+        second insert finds the first entry and does NOT retain its
+        own private block — both copies live, one findable."""
+        a, c = self._cache()
+        blk = np.asarray([3, 1, 4, 1], np.int32)
+        (b1,) = a.allocate(1)
+        e1 = c.insert(0, blk, b1)
+        (b2,) = a.allocate(1)
+        e2 = c.insert(0, blk, b2)
+        assert e1 == e2 and c.num_entries == 1
+        assert a.refcount(b1) == 2 and a.refcount(b2) == 1
+
+    def test_full_block_keys_only(self):
+        a, c = self._cache()
+        (bid,) = a.allocate(1)
+        with pytest.raises(ValueError, match="FULL blocks"):
+            c.insert(0, np.asarray([1, 2], np.int32), bid)
+
+    def test_mismatched_allocator_refused(self):
+        a, c = self._cache()
+        with pytest.raises(ValueError, match="own allocator"):
+            Scheduler(num_slots=1, block_size=4, max_blocks_per_slot=8,
+                      allocator=BlockAllocator(8), prefill_chunk=4,
+                      prefix_cache=c)
+
 
 class TestScheduler:
     def _sched(self, num_blocks=20, num_slots=2, block=4, chunk=8):
@@ -202,24 +492,245 @@ class TestScheduler:
         assert s.allocator.num_live == 5
         assert s.decoding_slots() == [0]
 
-    def test_admission_reservation_gate_and_fcfs(self):
+    def test_optimistic_admission_beats_worst_case_gate(self):
         # pool of 5 allocatable blocks; each request worst-cases at
-        # ceil((8 + 4 - 1)/4) = 3 blocks -> only ONE admits at a time
+        # ceil((8 + 4 - 1)/4) = 3 blocks. The PR-7 worst-case gate
+        # admitted ONE at a time; optimistic admission gates on the
+        # FIRST CHUNK's live demand (2 blocks each) and fills both
+        # slots at once — the whole point of serving tier 2.
         s = self._sched(num_blocks=6)
         for i in range(3):
             s.submit(Request(rid=i, prompt=np.zeros(8, np.int32),
                              max_new_tokens=4))
-        assert s.admit(now=0.0) == [0]  # FCFS head only
-        w = s.next_prefill()
-        s.note_prefill(w, sampled_token=1, now=0.0)
-        assert s.admit(now=0.0) == []  # still reserved: 3 + (3-2) > 5...
-        # finish request 0: its blocks free, reservation clears
-        for _ in range(3):
-            batch = s.decode_batch()
-            assert batch is not None
-            s.note_decode(np.full(2, 7), now=0.0)
-        assert s.completed and s.completed[0].rid == 0
-        assert s.admit(now=0.0) == [0]  # rid 1 takes the freed slot
+        assert s.admit(now=0.0) == [0, 1]  # both slots, FCFS order
+        assert s.num_waiting == 1          # rid 2: no free slot
+        # drive rid 0+1 to completion; rid 2 takes the freed slot
+        while not s.idle():
+            w = s.next_prefill(0.0)
+            if w is not None:
+                s.note_prefill(w, sampled_token=1, now=0.0)
+            batch = s.decode_batch(0.0)
+            if batch is not None:
+                s.note_decode(np.full(2, 7), now=0.0)
+            s.admit(now=0.0)
+        assert [r.rid for r in s.completed] == [0, 1, 2]
+
+    def test_preemption_on_pool_pressure(self):
+        """Mid-flight shortfall evicts the YOUNGEST request (never the
+        oldest — the head of the line must progress): its blocks
+        release, the request re-queues at the FRONT with generated
+        tokens intact, and it finishes after re-admission."""
+        # 7 allocatable; worst case each: ceil((8 + 17 - 1)/4) = 6
+        s = self._sched(num_blocks=8)
+        for i in range(2):
+            s.submit(Request(rid=i, prompt=np.zeros(8, np.int32),
+                             max_new_tokens=17))
+        assert s.admit(now=0.0) == [0, 1]  # optimistic: both in
+        tok = 0
+        while not s.idle():
+            w = s.next_prefill(0.0)
+            if w is not None:
+                s.note_prefill(w, sampled_token=tok, now=0.0)
+                tok += 1
+            batch = s.decode_batch(0.0)
+            if batch is not None:
+                s.note_decode(np.arange(2) + tok, now=0.0)
+                tok += 2
+            s.admit(now=0.0)
+        assert s.preemptions >= 1
+        done = {r.rid: r for r in s.completed}
+        # the victim is the YOUNGER request (never rid 0 — the oldest
+        # always progresses); its stream survived eviction intact
+        assert done[0].evictions == 0
+        assert done[1].evictions >= 1
+        assert s.recompute_tokens > 0
+        assert len(s.completed) == 2
+        assert all(len(r.tokens) == 17 for r in s.completed)
+        # every reference returned: refcount-exact, leak-free
+        s.allocator.check_accounting()
+        assert s.allocator.num_live == 0
+        assert s.allocator.leaked == 0
+
+    def _cached_sched(self, num_blocks=40, num_slots=2, block=4,
+                      chunk=8):
+        a = BlockAllocator(num_blocks)
+        return Scheduler(num_slots=num_slots, block_size=block,
+                         max_blocks_per_slot=16, allocator=a,
+                         prefill_chunk=chunk,
+                         prefix_cache=PrefixCache(a, block))
+
+    def _run_prefill(self, s, upto_rid=None):
+        tok = 7
+        while True:
+            w = s.next_prefill(0.0)
+            if w is None or (upto_rid is not None and w.rid != upto_rid):
+                return
+            s.note_prefill(w, sampled_token=tok, now=0.0)
+            tok += 1
+
+    def test_shared_prefix_maps_one_physical_copy(self):
+        """Two requests with a common 2-block system prompt: the second
+        admission maps its leading table entries onto the FIRST
+        request's physical blocks (refcount 3: owner + cache + sharer),
+        skips those chunks, and prefill resumes at the frontier."""
+        s = self._cached_sched()
+        sysp = np.arange(8, dtype=np.int32)
+        s.submit(Request(rid=0, prompt=np.concatenate(
+            [sysp, np.full(3, 60, np.int32)]), max_new_tokens=4))
+        s.admit(now=0.0)
+        self._run_prefill(s)  # rid 0 fully prefilled + registered
+        s.submit(Request(rid=1, prompt=np.concatenate(
+            [sysp, np.full(5, 61, np.int32)]), max_new_tokens=4))
+        (i1,) = s.admit(now=0.0)
+        slot = s._slots[i1]
+        assert slot.shared_blocks == 2
+        assert slot.prefilled == 8  # resumes past the shared prefix
+        assert not s._waiting
+        row0, row1 = s.tables.row(0), s.tables.row(i1)
+        np.testing.assert_array_equal(row0[:2], row1[:2])  # ONE copy
+        for bid in row1[:2]:
+            assert s.allocator.refcount(int(bid)) == 3
+        req1 = slot.request
+        assert req1.prefix_hit_blocks == 2
+        w = s.next_prefill(0.0)
+        assert w.rid == 1 and w.start == 8 and w.live == 5
+        # the prefix covering the LAST prompt token is never shared
+        # outright: a request whose whole prompt is cached still
+        # recomputes the final block privately (the COW discipline)
+        s.note_prefill(w, sampled_token=9, now=0.0)
+        s.submit(Request(rid=2, prompt=sysp.copy(), max_new_tokens=2))
+        finished = []
+        while len(s.completed) < 2:  # drain rid 0+1
+            batch = s.decode_batch(0.0)
+            s.note_decode(np.full(2, 5), now=0.0)
+            finished = s.completed
+        (i2,) = s.admit(now=0.0)
+        slot2 = s._slots[i2]
+        assert slot2.shared_blocks == 1  # NOT 2: last block recomputed
+        assert slot2.prefilled == 4
+        w = s.next_prefill(0.0)
+        assert w.start == 4 and w.live == 4
+        assert len(finished) == 2
+
+    def test_gate_excludes_chain_blocks_the_admission_would_pin(self):
+        """Reclaimable headroom must not count the request's OWN
+        matched chain: retaining it at admission makes it unreclaimable
+        instantly, so the old gate admitted straight into guaranteed
+        self-preemption (admit→evict thrash). The request must be HELD
+        instead, with zero preemptions."""
+        a = BlockAllocator(6)  # 5 allocatable
+        s = Scheduler(num_slots=2, block_size=4, max_blocks_per_slot=16,
+                      allocator=a, prefill_chunk=4,
+                      prefix_cache=PrefixCache(a, 4))
+        sysp = np.arange(8, dtype=np.int32)
+        # A registers the 2-block system prompt, finishes at prefill
+        s.submit(Request(rid=0, prompt=sysp.copy(), max_new_tokens=1))
+        s.admit(now=0.0)
+        self._run_prefill(s)
+        assert s.completed and a.num_resident == 2
+        # C fills the rest of the pool and keeps decoding
+        s.submit(Request(rid=1, prompt=np.full(12, 9, np.int32),
+                         max_new_tokens=4))
+        s.admit(now=0.0)
+        self._run_prefill(s)
+        assert a.num_free == 0
+        # B shares the cached chain and needs 1 block BEYOND it: the
+        # only "reclaimable" blocks are the 2 B itself would pin
+        s.submit(Request(rid=2, prompt=np.concatenate(
+            [sysp, np.asarray([5], np.int32)]), max_new_tokens=2))
+        assert s.admit(now=0.0) == []  # held, not thrash-admitted
+        assert s.preemptions == 0
+        # once C finishes, B admits and completes normally
+        while len(s.completed) < 2:
+            s.decode_batch(0.0)
+            s.note_decode(np.full(2, 3), now=0.0)
+            s.admit(now=0.0)
+        self._run_prefill(s)
+        while len(s.completed) < 3:
+            s.decode_batch(0.0)
+            s.note_decode(np.full(2, 4), now=0.0)
+        assert s.preemptions == 0
+        a.check_accounting()
+
+    def test_resumed_request_discards_refill_sample(self):
+        """Evict-and-recompute: the re-prefill's sampled token is
+        discarded and the decode state (generated count, last token)
+        restored — the stream continues where it left off."""
+        s = self._cached_sched(num_blocks=40)
+        s.submit(Request(rid=0, prompt=np.arange(6, dtype=np.int32),
+                         max_new_tokens=6))
+        s.admit(now=0.0)
+        self._run_prefill(s)
+        for _ in range(2):  # two decode steps: tokens [7, 20, 21]
+            s.decode_batch(0.0)
+            s.note_decode(np.full(2, 20 + _), now=0.0)
+        req = s._slots[0].request
+        before = list(req.tokens)
+        s._preempt(0, now=0.0)
+        assert req.evictions == 1 and req.tokens == before
+        s.admit(now=0.0)
+        slot = s._slots[0]
+        assert slot.resumed and slot.generated == 3
+        assert slot.last_token == before[-1]
+        assert len(slot.eprompt) == 6 + 2  # prompt + all but last token
+        self._run_prefill(s)
+        assert not s._slots[0].resumed
+        assert req.tokens == before  # the re-prefill sample DISCARDED
+        s.decode_batch(0.0)
+        s.note_decode(np.full(2, 33), now=0.0)
+        assert req.tokens == before + [33]
+
+    def test_slo_policy_prefers_short_prompts_under_burn(self):
+        """TTFT burn flips admission from FCFS to shortest-arrived
+        first; clearing the burn restores FCFS."""
+        pol = SLOPolicy()
+        a = BlockAllocator(60)
+        s = Scheduler(num_slots=1, block_size=4, max_blocks_per_slot=16,
+                      allocator=a, prefill_chunk=4, policy=pol)
+        s.submit(Request(rid=0, prompt=np.zeros(40, np.int32),
+                         max_new_tokens=2))
+        s.submit(Request(rid=1, prompt=np.zeros(4, np.int32),
+                         max_new_tokens=2))
+        pol.prefer_short_prompts = True
+        (i,) = s.admit(now=0.0)
+        assert s._slots[i].request.rid == 1  # short prompt jumped
+        pol.prefer_short_prompts = False
+        s.submit(Request(rid=2, prompt=np.zeros(4, np.int32),
+                         max_new_tokens=2))
+        self._drain_one(s)
+        (i,) = s.admit(now=0.0)
+        assert s._slots[i].request.rid == 0  # FCFS restored
+
+    def _drain_one(self, s):
+        while not s.completed:
+            w = s.next_prefill(0.0)
+            if w is not None:
+                s.note_prefill(w, sampled_token=1, now=0.0)
+            batch = s.decode_batch(0.0)
+            if batch is not None:
+                s.note_decode(np.full(s.num_slots, 2), now=0.0)
+
+    def test_slo_policy_update_from_signals(self):
+        class _Tel:
+            slo_burning = False
+            queue_buildup = False
+
+        pol = SLOPolicy(max_prefill_share=3)
+        tel = _Tel()
+        pol.update(tel)
+        assert pol.prefill_share == 1 and not pol.prefer_short_prompts
+        tel.queue_buildup = True
+        tel.slo_burning = True
+        pol.update(tel)
+        assert pol.prefill_share == 2 and pol.prefer_short_prompts
+        pol.update(tel)
+        pol.update(tel)
+        assert pol.prefill_share == 3  # capped at max_prefill_share
+        tel.queue_buildup = False
+        tel.slo_burning = False
+        pol.update(tel)  # one step back per clean window
+        assert pol.prefill_share == 2 and not pol.prefer_short_prompts
+        assert pol.adjustments >= 3
 
     def test_eviction_returns_every_block(self):
         """No leak across N churn cycles: after every request completes
@@ -501,9 +1012,18 @@ class TestServingEngine:
                 params, jnp.asarray(r.prompt)[None], r.max_new_tokens))[0]
             np.testing.assert_array_equal(np.asarray(r.tokens), want,
                                           err_msg=f"rid {r.rid}")
-        # no leak: the free list is exactly the fresh pool again
-        assert sched.allocator.num_live == 0
-        assert sched.allocator.num_free == eng.num_blocks - 1
+        # no leak: with the prefix cache on, the only live blocks left
+        # are the cache's refcounted residents (warm capacity, not
+        # demand) and the accounting is refcount-exact
+        alloc = sched.allocator
+        alloc.check_accounting()
+        assert alloc.leaked == 0
+        assert alloc.num_live == alloc.num_resident
+        assert alloc.num_live == sched.prefix_cache.num_resident_blocks
+        # reclaiming the warm set restores the fresh pool exactly
+        sched.prefix_cache.clear()
+        assert alloc.num_live == 0
+        assert alloc.num_free == eng.num_blocks - 1
         # and paging did its job: the high-water stayed under the pool
         assert 0 < eng.last_stats.blocks_high_water <= eng.num_blocks - 1
 
@@ -562,6 +1082,148 @@ class TestServingEngine:
                             max_seq_len=64, temperature=1.0)
         with pytest.raises(ValueError, match="requires a key"):
             eng.serve({}, [])
+
+
+class TestServingTier2:
+    """Prefix caching + preemption through the REAL engine: greedy
+    parity across hit/miss/evict/readmit churn, both jit caches pinned
+    at 1, allocator accounting refcount-exact, prefill work actually
+    skipped on a hit."""
+
+    def test_prefix_hit_parity_and_skipped_chunks(
+            self, tiny, reference_engine):
+        """Requests sharing a system prompt: every token stream is
+        IDENTICAL to the single-request engine, later requests hit the
+        cache (fewer prefill chunks ran than a cold engine needs), and
+        the shared blocks are one physical copy."""
+        model, params = tiny
+        eng = ServingEngine(model, num_slots=2, block_size=8,
+                            prefill_chunk=8, max_seq_len=64)
+        sysp = np.asarray(jr.randint(jr.fold_in(K, 21), (24,), 0, 97),
+                          np.int32)
+        reqs = [Request(
+            rid=i,
+            prompt=np.concatenate([sysp, np.full(3 + i, 10 + i,
+                                                 np.int32)]),
+            max_new_tokens=4, arrival_s=0.0) for i in range(4)]
+        sched = eng.make_scheduler()
+        done = eng.serve(params, reqs, scheduler=sched)
+        assert len(done) == 4
+        for r in done:
+            want = np.asarray(reference_engine.generate(
+                params, jnp.asarray(r.prompt)[None],
+                r.max_new_tokens))[0]
+            np.testing.assert_array_equal(np.asarray(r.tokens), want,
+                                          err_msg=f"rid {r.rid}")
+        hits = [r for r in done if r.prefix_hit_blocks > 0]
+        assert hits, "no request hit the warm prefix cache"
+        assert max(r.prefix_hit_blocks for r in hits) == 3  # 24/8 sysp
+        # chunks actually skipped: a cold engine runs ceil(len/8) per
+        # prompt; the sweep must have run strictly fewer
+        cold = sum(-(-len(r.prompt) // 8) for r in done)
+        assert eng.last_stats.prefill_chunks < cold
+        assert eng.prefill_chunk._cache_size() == 1
+        assert eng.decode_step._cache_size() == 1
+        sched.allocator.check_accounting()
+        assert sched.allocator.num_live == sched.allocator.num_resident
+
+    def test_whole_prompt_cached_recomputes_last_block(
+            self, tiny, reference_engine):
+        """The COW edge: a prompt that is EXACTLY its cached blocks
+        still recomputes the final block privately (its last-row
+        logits seed the first token; shared blocks are never write
+        targets) — and the tokens still match the baseline."""
+        model, params = tiny
+        eng = ServingEngine(model, num_slots=2, block_size=8,
+                            prefill_chunk=8, max_seq_len=64)
+        prompt = np.asarray(jr.randint(jr.fold_in(K, 22), (16,), 0, 97),
+                            np.int32)  # exactly 2 blocks
+        sched = eng.make_scheduler()
+        done = eng.serve(
+            params,
+            [Request(rid=0, prompt=prompt.copy(), max_new_tokens=3),
+             Request(rid=1, prompt=prompt.copy(), max_new_tokens=5)],
+            scheduler=sched)
+        want0 = np.asarray(reference_engine.generate(
+            params, jnp.asarray(prompt)[None], 3))[0]
+        want1 = np.asarray(reference_engine.generate(
+            params, jnp.asarray(prompt)[None], 5))[0]
+        by_rid = {r.rid: r for r in done}
+        np.testing.assert_array_equal(np.asarray(by_rid[0].tokens), want0)
+        np.testing.assert_array_equal(np.asarray(by_rid[1].tokens), want1)
+        # whichever request came second shared only block 0 — never the
+        # block holding the prompt's last token
+        assert {r.prefix_hit_blocks for r in done} <= {0, 1}
+        sched.allocator.check_accounting()
+
+    def test_preemption_roundtrip_token_identical(
+            self, tiny, reference_engine):
+        """A pool sized below worst-case-everything under concurrent
+        load: preemption engages, evicted-and-recomputed requests are
+        TOKEN-IDENTICAL to the unpreempted baseline, both jit caches
+        stay at 1 across the evict/readmit churn, and the pool drains
+        refcount-exact."""
+        model, params = tiny
+        eng = ServingEngine(model, num_slots=2, block_size=8,
+                            prefill_chunk=8, max_seq_len=64,
+                            num_blocks=7)
+        rng = np.random.default_rng(0)
+        reqs = [Request(
+            rid=i, prompt=np.asarray(rng.integers(0, 97, 12), np.int32),
+            max_new_tokens=14) for i in range(4)]
+        sched = eng.make_scheduler()
+        done = eng.serve(params, reqs, scheduler=sched)
+        assert len(done) == 4
+        assert sched.preemptions > 0, "pool pressure never preempted"
+        assert any(r.evictions > 0 for r in done)
+        assert sched.recompute_tokens > 0
+        for r in done:
+            want = np.asarray(reference_engine.generate(
+                params, jnp.asarray(r.prompt)[None],
+                r.max_new_tokens))[0]
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens), want,
+                err_msg=f"rid {r.rid} (evictions={r.evictions})")
+        assert eng.prefill_chunk._cache_size() == 1
+        assert eng.decode_step._cache_size() == 1
+        sched.allocator.check_accounting()
+        assert sched.allocator.leaked == 0
+        assert sched.allocator.num_live == sched.allocator.num_resident
+
+    def test_trace_builder_is_deterministic(self):
+        """bench.py's Poisson serve trace: same seed → token-identical
+        requests and arrival times (replayable sweeps); a different
+        seed actually varies."""
+        import importlib.util
+        root = os.path.join(os.path.dirname(__file__), "..")
+        spec = importlib.util.spec_from_file_location(
+            "bench_for_trace", os.path.join(root, "bench.py"))
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        kw = dict(n_req=6, offered_rps=100.0, vocab=97,
+                  prompt_rng=(4, 20), newtok_rng=(2, 6),
+                  sys_prompt_len=8)
+        t1 = bench.build_serve_trace(3, **kw)
+        t2 = bench.build_serve_trace(3, **kw)
+        t3 = bench.build_serve_trace(4, **kw)
+        assert len(t1) == len(t2) == 6
+        for a, b in zip(t1, t2):
+            np.testing.assert_array_equal(a.prompt, b.prompt)
+            assert a.max_new_tokens == b.max_new_tokens
+            assert a.arrival_s == b.arrival_s
+        assert any(
+            len(a.prompt) != len(c.prompt)
+            or (a.prompt.shape == c.prompt.shape
+                and (a.prompt != c.prompt).any())
+            for a, c in zip(t1, t3))
+        # the shared-prefix population really shares: at least two
+        # requests of the seeded trace carry an identical first block
+        big = bench.build_serve_trace(0, n_req=16, offered_rps=100.0,
+                                      vocab=97, prompt_rng=(4, 20),
+                                      newtok_rng=(2, 6),
+                                      sys_prompt_len=8)
+        heads = [tuple(r.prompt[:8]) for r in big]
+        assert any(heads.count(h) >= 2 for h in set(heads))
 
 
 class TestServeRecord:
@@ -660,6 +1322,17 @@ class TestServeBenchLeg:
         assert record["greedy_parity"] is True
         assert record["jit_cache_ok"] is True
         assert record["blocks_high_water"] >= 1
+        # serving tier 2: the sweep's pool is sized below worst case —
+        # preemption must engage, parity must hold ACROSS the churn
+        # (incl. evicted and prefix-hit requests), the trace is seeded,
+        # and the prefix/preemption fields ride the record
+        assert record["churn_parity"] is True
+        assert record["churn_parity_checked"] >= 1
+        assert record["preemptions"] >= 1
+        assert record["trace_seed"] == 0
+        assert isinstance(record["prefix_hit_rate"], (int, float, dict))
+        assert record["serve_anomaly"]["leaked_blocks"] == 0
+        assert record["blocks_resident"] >= 0
         assert monitor.validate(record) == []
         assert monitor.validate_jsonl(
             path.read_text().splitlines()) == []

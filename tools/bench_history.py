@@ -17,7 +17,10 @@ that trajectory into a gate a CI leg can run after a fresh bench:
   bench result objects (``{"metric", "value", ...}``), driver envelopes
   (``{"parsed": {...}}``), and monitor records with a throughput field
   (``serve`` / ``decode`` / ``tp_overlap`` / ``pipeline`` →
-  ``tokens_per_s``). A ``status: "SKIP"`` record carries no claim and
+  ``tokens_per_s``). An OK ``serve`` record additionally carries its
+  ``prefix_hit_ttft_p50_ms`` as a LOWER-is-better latency series (the
+  serving-tier-2 headline: a prefix hit must stay fast across the
+  trajectory). A ``status: "SKIP"`` record carries no claim and
   is *skipped* by the gate (exit 0 with a SKIP line) — an off-TPU
   smoke can never "regress".
 * **Comparison** is against the LATEST history artifact whose metric
@@ -53,52 +56,78 @@ from apex_tpu.monitor import schema  # noqa: E402
 # monitor-record kinds that carry a tokens_per_s throughput claim
 _THROUGHPUT_KINDS = ("serve", "decode", "tp_overlap", "pipeline")
 
-# metrics where a BIGGER fresh value is the regression (error series —
-# the planner's predicted-vs-measured error must not drift UP across
-# the BENCH_r* trajectory, while throughput must not drift DOWN)
+# metrics where a BIGGER fresh value is the regression, gated in
+# ABSOLUTE points (error series — the reference may legitimately be ~0)
 _LOWER_IS_BETTER = {"plan_predicted_vs_measured_err_pct"}
 
+# lower-is-better metrics gated by PERCENT drift (latency series: the
+# prefix-hit TTFT p50 must not creep up across the trajectory — the
+# serving tier-2 headline is that a hit stays fast)
+_LOWER_IS_BETTER_PCT = {"serve_prefix_hit_ttft_p50_ms"}
 
-def extract(obj: Dict[str, Any], label: str = "artifact"
-            ) -> Optional[Tuple[str, float, float]]:
-    """``(metric_name, value, spread_pct)`` from one artifact object,
-    or None when it carries no throughput claim (SKIP records, meta).
-    Raises ValueError on a shape that should carry one but doesn't."""
+
+def extract_all(obj: Dict[str, Any], label: str = "artifact"
+                ) -> List[Tuple[str, float, float]]:
+    """Every gated ``(metric_name, value, spread_pct)`` series one
+    artifact carries — empty when it claims nothing (SKIP records,
+    meta). An OK ``serve`` record carries its throughput AND, when the
+    prefix cache measured one, the hit-TTFT latency series. Raises
+    ValueError on a shape that should carry a claim but doesn't."""
     if not isinstance(obj, dict):
         raise ValueError(f"{label}: expected a JSON object")
     if isinstance(obj.get("parsed"), dict):  # driver envelope
-        return extract(obj["parsed"], label)
+        return extract_all(obj["parsed"], label)
     if "metric" in obj and "value" in obj:
         spread = obj.get("spread_pct")
-        return (str(obj["metric"]), float(obj["value"]),
-                float(spread) if isinstance(spread, (int, float)) else 0.0)
+        return [(str(obj["metric"]), float(obj["value"]),
+                 float(spread) if isinstance(spread, (int, float))
+                 else 0.0)]
     kind = obj.get("kind")
     if kind in _THROUGHPUT_KINDS:
         if obj.get("status") == "SKIP":
-            return None  # a SKIP record claims nothing to regress from
+            return []  # a SKIP record claims nothing to regress from
         v = obj.get("tokens_per_s")
         if not isinstance(v, (int, float)):
             raise ValueError(
                 f"{label}: OK {kind} record has no numeric tokens_per_s")
         spread = obj.get("spread_pct")
-        return (f"{kind}_tokens_per_s", float(v),
-                float(spread) if isinstance(spread, (int, float)) else 0.0)
+        spread = float(spread) if isinstance(spread, (int, float)) else 0.0
+        rows = [(f"{kind}_tokens_per_s", float(v), spread)]
+        if kind == "serve":
+            # the prefix-cache latency series (absent on pre-tier-2
+            # records and when no hit landed — a skip object, not 0).
+            # spread_pct is the record's THROUGHPUT variance; it says
+            # nothing about TTFT variance, so it must not widen the
+            # latency gate
+            hit = obj.get("prefix_hit_ttft_p50_ms")
+            if isinstance(hit, (int, float)):
+                rows.append(("serve_prefix_hit_ttft_p50_ms",
+                             float(hit), 0.0))
+        return rows
     if kind == "plan":
         # the planner record's gated series is its predicted-vs-measured
         # ERROR (an OK record always carries one; the measured half only
         # skips inside SKIP records)
         if obj.get("status") == "SKIP":
-            return None
+            return []
         v = obj.get("predicted_vs_measured_err_pct")
         if not isinstance(v, (int, float)):
             raise ValueError(
                 f"{label}: OK plan record has no numeric "
                 "predicted_vs_measured_err_pct")
-        return ("plan_predicted_vs_measured_err_pct", float(v), 0.0)
+        return [("plan_predicted_vs_measured_err_pct", float(v), 0.0)]
     if kind is not None:
-        return None  # other monitor records carry no headline number
+        return []  # other monitor records carry no headline number
     raise ValueError(
         f"{label}: unrecognized artifact shape (no metric/parsed/kind)")
+
+
+def extract(obj: Dict[str, Any], label: str = "artifact"
+            ) -> Optional[Tuple[str, float, float]]:
+    """The artifact's PRIMARY claim — first row of :func:`extract_all`
+    (None when it claims nothing)."""
+    rows = extract_all(obj, label)
+    return rows[0] if rows else None
 
 
 def load_json(path: str) -> Any:
@@ -139,19 +168,19 @@ def _history_order(path: str) -> Tuple[int, str]:
 
 def collect_history(pattern: str, root: str) -> List[Tuple[str, str, float,
                                                            float]]:
-    """[(path, metric, value, spread_pct)] for every history artifact
-    matching ``pattern`` that carries a claim, in trajectory order."""
+    """[(path, metric, value, spread_pct)] for every gated series of
+    every history artifact matching ``pattern``, in trajectory order
+    (one artifact can carry several series — throughput AND latency)."""
     rows = []
     for path in sorted(glob.glob(os.path.join(root, pattern)),
                        key=_history_order):
         try:
-            got = extract(load_json(path), path)
+            got = extract_all(load_json(path), path)
         except (ValueError, json.JSONDecodeError) as e:
             print(f"warning: skipping unreadable history {path}: {e}",
                   file=sys.stderr)
             continue
-        if got is not None:
-            rows.append((path, *got))
+        rows.extend((path, *row) for row in got)
     return rows
 
 
@@ -216,48 +245,66 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     try:
-        fresh = extract(fresh_obj, args.fresh)
+        fresh_rows = extract_all(fresh_obj, args.fresh)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    if fresh is None:
+    if not fresh_rows:
         print(f"SKIP: {args.fresh} carries no throughput claim "
               f"(SKIP record) — nothing to gate")
         return 0
-    metric, value, fresh_spread = fresh
 
-    history = [row for row in collect_history(args.history, args.root)
-               if row[1] == metric]
-    if not history:
-        print(f"SKIP: no history artifact carries metric {metric!r} "
-              f"(glob {args.history}) — nothing to compare against")
-        return 0
-    ref_path, _, ref_value, ref_spread = history[-1]
-    allowed_pct = args.tolerance_pct + fresh_spread + ref_spread
+    all_history = collect_history(args.history, args.root)
+    rc = 0
+    for metric, value, fresh_spread in fresh_rows:
+        history = [row for row in all_history if row[1] == metric]
+        if not history:
+            print(f"SKIP: no history artifact carries metric {metric!r} "
+                  f"(glob {args.history}) — nothing to compare against")
+            continue
+        ref_path, _, ref_value, ref_spread = history[-1]
+        rc = max(rc, _gate_series(
+            metric, value, fresh_spread, ref_path, ref_value, ref_spread,
+            args.tolerance_pct, len(history)))
+    return rc
+
+
+def _gate_series(metric: str, value: float, fresh_spread: float,
+                 ref_path: str, ref_value: float, ref_spread: float,
+                 tol: float, npoints: int) -> int:
+    """Gate ONE series against its trajectory reference and print the
+    one-line verdict; returns 0/1. Three direction/unit conventions
+    share this shape: the plan-error series drifts UP in absolute
+    points (the reference may legitimately be ~0%), lower-is-better
+    latency series drift UP in percent, throughput drifts DOWN in
+    percent."""
+    allowed = tol + fresh_spread + ref_spread
+    ref = os.path.basename(ref_path)
+    spread_note = (f" = tol {tol:g} + spread "
+                   f"{ref_spread:g}+{fresh_spread:g}")
     if metric in _LOWER_IS_BETTER:
-        # error-series gate: drift UP is the regression, measured in
-        # absolute points (the reference may legitimately be ~0%)
         delta = value - ref_value
-        if delta > allowed_pct:
-            print(f"REGRESSION {metric}: {value:g} vs "
-                  f"{os.path.basename(ref_path)} {ref_value:g} "
-                  f"(+{delta:.2f} pts > allowed +{allowed_pct:.2f})")
-            return 1
-        print(f"OK {metric}: {value:g} vs {os.path.basename(ref_path)} "
-              f"{ref_value:g} ({delta:+.2f} pts, allowed "
-              f"+{allowed_pct:.2f}) over {len(history)}-point trajectory")
-        return 0
-    delta_pct = 100.0 * (value - ref_value) / ref_value
-    if delta_pct < -allowed_pct:
-        print(f"REGRESSION {metric}: {value:g} vs "
-              f"{os.path.basename(ref_path)} {ref_value:g} "
-              f"({delta_pct:+.2f}% < allowed -{allowed_pct:.2f}% = "
-              f"tol {args.tolerance_pct:g} + spread "
-              f"{ref_spread:g}+{fresh_spread:g})")
+        bad = delta > allowed
+        detail_bad = f"(+{delta:.2f} pts > allowed +{allowed:.2f})"
+        detail_ok = f"({delta:+.2f} pts, allowed +{allowed:.2f})"
+    else:
+        delta_pct = 100.0 * (value - ref_value) / ref_value
+        if metric in _LOWER_IS_BETTER_PCT:
+            bad = delta_pct > allowed
+            detail_bad = (f"({delta_pct:+.2f}% > allowed "
+                          f"+{allowed:.2f}%{spread_note})")
+            detail_ok = f"({delta_pct:+.2f}%, allowed +{allowed:.2f}%)"
+        else:
+            bad = delta_pct < -allowed
+            detail_bad = (f"({delta_pct:+.2f}% < allowed "
+                          f"-{allowed:.2f}%{spread_note})")
+            detail_ok = f"({delta_pct:+.2f}%, allowed -{allowed:.2f}%)"
+    if bad:
+        print(f"REGRESSION {metric}: {value:g} vs {ref} {ref_value:g} "
+              f"{detail_bad}")
         return 1
-    print(f"OK {metric}: {value:g} vs {os.path.basename(ref_path)} "
-          f"{ref_value:g} ({delta_pct:+.2f}%, allowed "
-          f"-{allowed_pct:.2f}%) over {len(history)}-point trajectory")
+    print(f"OK {metric}: {value:g} vs {ref} {ref_value:g} {detail_ok} "
+          f"over {npoints}-point trajectory")
     return 0
 
 

@@ -44,8 +44,11 @@ Dispatch is by content, not extension:
 * ``profile`` records (``python bench.py --profile``: the step-anatomy
   leg), ``serve`` records (``python bench.py --serve``: the
   continuous-batching offered-load leg through the paged
-  ``apex_tpu.serving`` engine), ``serve_event``/``serve_window``
-  records (the request-lifecycle and live-SLO telemetry of
+  ``apex_tpu.serving`` engine — incl. the serving-tier-2 fields:
+  ``prefix_hit_rate``, the hit/miss TTFT split, ``preemptions``,
+  ``recompute_tokens``, ``churn_parity``, ``trace_seed``),
+  ``serve_event``/``serve_window`` records (the request-lifecycle —
+  now with the live ``evict`` payload — and live-SLO telemetry of
   ``apex_tpu.serving.telemetry``), ``pipeline`` records (``python
   bench.py --pipeline``: the zero-bubble-vs-1f1b schedule leg),
   ``costdb`` artifacts (``apex_tpu.prof.calibrate``), and
