@@ -1,228 +1,34 @@
-"""Checkpoint / resume.
+"""Compatibility shim: ``apex_tpu.checkpoint`` grew into the
+:mod:`apex_tpu.ckpt` subsystem (ISSUE 14).
 
-Re-design of the reference's checkpoint surface (SURVEY.md §5): the
-reference persists amp's per-loss scaler state (``amp.state_dict()``
-``frontend.py:361-400``), fp32 master weights regardless of cast
-(``O2StateDictHook`` ``_initialize.py:133-143``), and
-``FP16_Optimizer.state_dict`` (scaler + masters,
-``fp16_optimizer.py:209-270``), documenting a bitwise-accurate resume recipe
-(``README.md:60-100``).
-
-Here one ``TrainState`` pytree holds (master params, optimizer state, loss
-scaler state, step) and round-trips through orbax — saving the *fp32
-masters* (like the O2 hook) so resume is bitwise regardless of the compute
-dtype. ``save``/``restore`` are synchronous; :class:`CheckpointManager`
-below adds async saves and ``max_to_keep`` rotation, and
-:class:`AutoResume` the save-on-preemption protocol.
+Everything the seed module exported — ``TrainState``,
+``save_checkpoint``/``restore_checkpoint``, ``CheckpointManager``,
+``AutoResume``/``get_autoresume``, the amp state-dict parity helpers —
+still imports from here unchanged (now orbax-OPTIONAL: the pure-numpy
+npz fallback in :mod:`apex_tpu.ckpt.pytree_io` takes over when orbax is
+absent). The dp-sharded elastic ZeRO format, the async off-step saver,
+:class:`~apex_tpu.ckpt.manager.ZeroCheckpointManager` and the serving
+hot-swap loader live in the package; import those from
+``apex_tpu.ckpt`` directly.
 """
 
-from __future__ import annotations
-
-import dataclasses
-from typing import Any, Optional
-
-import jax
-import jax.numpy as jnp
-
-try:
-    import orbax.checkpoint as ocp
-    _HAS_ORBAX = True
-except Exception:  # pragma: no cover
-    _HAS_ORBAX = False
-
-PyTree = Any
-
-
-@jax.tree_util.register_dataclass
-@dataclasses.dataclass
-class TrainState:
-    """Everything a bitwise resume needs (cf. README.md:60-100 recipe)."""
-
-    step: jax.Array
-    params: PyTree              # fp32 masters (O2StateDictHook semantics)
-    opt_state: PyTree
-    scaler_state: Optional[PyTree] = None
-    extra: Optional[PyTree] = None  # e.g. BN running stats
-
-
-def save_checkpoint(path: str, state: TrainState) -> None:
-    if not _HAS_ORBAX:
-        raise RuntimeError("orbax is unavailable in this environment")
-    ckpt = ocp.StandardCheckpointer()
-    ckpt.save(path, state)
-    ckpt.wait_until_finished()
-
-
-def restore_checkpoint(path: str, template: TrainState) -> TrainState:
-    """Restore into the shapes/dtypes (and shardings) of ``template``."""
-    if not _HAS_ORBAX:
-        raise RuntimeError("orbax is unavailable in this environment")
-    ckpt = ocp.StandardCheckpointer()
-    return ckpt.restore(path, template)
-
-
-class CheckpointManager:
-    """Rotating, optionally-async checkpoints over :class:`TrainState` —
-    beyond the reference's library-level state dicts (its trainers save
-    synchronously with ``torch.save``): ``save`` returns once the on-device
-    state is snapshotted and the write overlaps subsequent train steps;
-    ``max_to_keep`` rotates old steps out. Thin policy layer over
-    ``orbax.checkpoint.CheckpointManager`` so :class:`AutoResume` and the
-    bitwise-resume guarantees of :func:`save_checkpoint` carry over.
-    """
-
-    def __init__(self, directory: str, *, max_to_keep: int = 3,
-                 async_save: bool = True, save_interval_steps: int = 1):
-        if not _HAS_ORBAX:
-            raise RuntimeError("orbax is unavailable in this environment")
-        self._mgr = ocp.CheckpointManager(
-            directory,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
-                save_interval_steps=save_interval_steps,
-                enable_async_checkpointing=async_save,
-            ),
-        )
-
-    def save(self, step: int, state: TrainState) -> bool:
-        """Returns False when skipped by ``save_interval_steps``."""
-        return self._mgr.save(step, args=ocp.args.StandardSave(state))
-
-    def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
-
-    def restore(self, template: TrainState,
-                step: Optional[int] = None) -> TrainState:
-        step = self._mgr.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError("no checkpoint to restore")
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(template))
-
-    def wait_until_finished(self) -> None:
-        self._mgr.wait_until_finished()
-
-    def close(self) -> None:
-        self._mgr.close()
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
-
-
-# --- auto-resume / preemption (pipeline_parallel/utils.py:142-144) ------------
-
-class AutoResume:
-    """Save-on-preemption protocol. The reference carries an ADLR auto-resume
-    stub (``get_autoresume`` ``apex/transformer/pipeline_parallel/utils.py:142-144``
-    and the commented termination check ``:286-300``) that defers to an
-    external cluster library; on Cloud TPU the termination signal is a plain
-    SIGTERM delivered ahead of preemption, so the guard is self-contained:
-    install signal handlers, poll ``termination_requested()`` from the train
-    loop, and ``check_and_save`` writes the TrainState before exit.
-
-    Handlers chain to any previously-installed handler and are restored by
-    ``uninstall()``.
-    """
-
-    def __init__(self, signals=None):
-        import signal as _signal
-
-        self._signal = _signal
-        self._requested = False
-        self._prev = {}
-        for s in signals if signals is not None else (_signal.SIGTERM,):
-            try:
-                self._prev[s] = _signal.signal(s, self._handler)
-            except ValueError:
-                # signal.signal only works on the main thread; degrade to the
-                # cooperative protocol (request_termination still works)
-                pass
-
-    def _handler(self, signum, frame):
-        self._requested = True
-        prev = self._prev.get(signum)
-        if callable(prev):
-            prev(signum, frame)
-
-    def request_termination(self) -> None:
-        """Mark termination as requested (tests / cooperative shutdown)."""
-        self._requested = True
-
-    def termination_requested(self) -> bool:
-        return self._requested
-
-    def check_and_save(self, path: str, state: TrainState) -> bool:
-        """If termination was requested, checkpoint ``state`` to ``path`` and
-        return True (caller should break its train loop). The analog of the
-        reference's ``check_adlr_autoresume_termination``.
-
-        On multi-host meshes the decision is agreed across processes first
-        (a signal can land between two hosts' polls; an unagreed flag would
-        have one host enter the collective orbax save while the others run
-        ahead — the reason Megatron all-reduces its termination flag). All
-        processes therefore return the same value and enter the save
-        together."""
-        if not self._agreed_termination():
-            return False
-        save_checkpoint(path, state)
-        return True
-
-    def _agreed_termination(self) -> bool:
-        if jax.process_count() == 1:
-            return self._requested
-        import numpy as np
-        from jax.experimental import multihost_utils
-
-        flags = multihost_utils.process_allgather(
-            jnp.asarray(self._requested, jnp.int32))
-        agreed = bool(np.max(np.asarray(flags)))
-        if agreed:
-            self._requested = True  # adopt the peer's signal
-        return agreed
-
-    def uninstall(self) -> None:
-        global _AUTORESUME
-        for s, prev in self._prev.items():
-            self._signal.signal(s, prev)
-        self._prev.clear()
-        if _AUTORESUME is self:
-            # never leave the singleton pointing at a dead (handler-less)
-            # guard — the next get_autoresume() installs a fresh one
-            _AUTORESUME = None
-
-
-_AUTORESUME: Optional[AutoResume] = None
-
-
-def get_autoresume() -> AutoResume:
-    """Process-wide ``AutoResume`` (reference spelling:
-    ``pipeline_parallel/utils.py:142-144``), installed on first use."""
-    global _AUTORESUME
-    if _AUTORESUME is None:
-        _AUTORESUME = AutoResume()
-    return _AUTORESUME
-
-
-# --- amp state-dict parity (frontend.py:361-400) ------------------------------
-
-def amp_state_dict(scaler_states) -> dict:
-    """``amp.state_dict()``: {'loss_scaler0': {...}, ...} per loss."""
-    from apex_tpu.amp.scaler import state_dict as scaler_sd
-
-    if not isinstance(scaler_states, (list, tuple)):
-        scaler_states = [scaler_states]
-    return {f"loss_scaler{i}": scaler_sd(s) for i, s in enumerate(scaler_states)}
-
-
-def amp_load_state_dict(sd: dict, scaler_states):
-    """``amp.load_state_dict()`` — loads each payload into the matching
-    scaler state, returning the new states in order."""
-    from apex_tpu.amp.scaler import load_state_dict as scaler_ld
-
-    if not isinstance(scaler_states, (list, tuple)):
-        scaler_states = [scaler_states]
-    return [
-        scaler_ld(s, sd[f"loss_scaler{i}"]) for i, s in enumerate(scaler_states)
-    ]
+from apex_tpu.ckpt import (  # noqa: F401
+    AsyncZeroSaver,
+    AutoResume,
+    CheckpointManager,
+    Manifest,
+    RestoredZero,
+    SimulatedCrash,
+    TrainState,
+    ZeroCheckpointManager,
+    amp_load_state_dict,
+    amp_state_dict,
+    get_autoresume,
+    load_zero_state,
+    restore_checkpoint,
+    restore_params,
+    restore_zero_shard,
+    restore_zero_sharded,
+    save_checkpoint,
+    save_zero_sharded,
+)

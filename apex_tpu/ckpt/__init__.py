@@ -1,0 +1,73 @@
+"""Elastic checkpointing subsystem (ISSUE 14 / ROADMAP item 4).
+
+Grown out of the seed's single-file orbax wrapper
+(``apex_tpu/checkpoint.py``, now a compatibility shim over this
+package) into four pillars:
+
+* :mod:`~apex_tpu.ckpt.state` — the legacy replicated ``TrainState``
+  round-trip (orbax when importable, pure-numpy npz otherwise) plus
+  :class:`AutoResume`, the preemption guard;
+* :mod:`~apex_tpu.ckpt.sharded` — the dp-sharded ZeRO format: per-rank
+  ``(rows_per_rank, chunk)`` fp32 shards + a self-describing manifest,
+  bitwise at the same dp and ELASTIC across dp (restore at dp′ ≠ dp
+  re-slices the chunk-row space, Xu et al. arXiv:2004.13336);
+* :mod:`~apex_tpu.ckpt.async_save` / :mod:`~apex_tpu.ckpt.manager` —
+  off-step saves (snapshot between steps, background write, atomic
+  rename-commit) under :class:`ZeroCheckpointManager` rotation;
+* the serving hot-swap loader (:func:`restore_params`) — rebuilds a
+  param tree with exactly a template's avals, so a live
+  :class:`~apex_tpu.serving.engine.ServingEngine` swaps weights as a
+  contents-only mutation (``engine.request_swap``).
+
+Save cost is observable: ``bench.py --ckpt`` emits the ``ckpt`` monitor
+record (``save_overhead_pct`` gated lower-is-better by
+``tools/bench_history.py``).
+"""
+
+from apex_tpu.ckpt.async_save import AsyncZeroSaver, cleanup_stale_tmp
+from apex_tpu.ckpt.manager import ZeroCheckpointManager
+from apex_tpu.ckpt.manifest import (Manifest, pad_rows_for, read_manifest,
+                                    shard_rows, write_manifest)
+from apex_tpu.ckpt.pytree_io import (array_digest, load_tree_npz,
+                                     save_tree_npz)
+from apex_tpu.ckpt.sharded import (RestoredZero, SimulatedCrash,
+                                   load_zero_state, restore_params,
+                                   restore_zero_shard,
+                                   restore_zero_sharded,
+                                   save_zero_sharded, snapshot_zero_state,
+                                   write_shard)
+from apex_tpu.ckpt.state import (AutoResume, CheckpointManager, TrainState,
+                                 amp_load_state_dict, amp_state_dict,
+                                 get_autoresume, restore_checkpoint,
+                                 save_checkpoint)
+
+__all__ = [
+    "AsyncZeroSaver",
+    "AutoResume",
+    "CheckpointManager",
+    "Manifest",
+    "RestoredZero",
+    "SimulatedCrash",
+    "TrainState",
+    "ZeroCheckpointManager",
+    "amp_load_state_dict",
+    "amp_state_dict",
+    "array_digest",
+    "cleanup_stale_tmp",
+    "get_autoresume",
+    "load_tree_npz",
+    "load_zero_state",
+    "pad_rows_for",
+    "read_manifest",
+    "restore_checkpoint",
+    "restore_params",
+    "restore_zero_shard",
+    "restore_zero_sharded",
+    "save_checkpoint",
+    "save_tree_npz",
+    "save_zero_sharded",
+    "shard_rows",
+    "snapshot_zero_state",
+    "write_manifest",
+    "write_shard",
+]

@@ -1,0 +1,175 @@
+"""Async sharded saves: snapshot between steps, write off the step path.
+
+The save cost a train loop actually pays is split in two:
+
+* **snapshot** — the device→host transfer of the sharded fp32 buffers
+  (m/v + masters) plus the host flatten of master-less params. This is
+  the only part on the step path; it runs BETWEEN steps (the caller
+  invokes :meth:`AsyncZeroSaver.save` after an optimizer step returns)
+  and is measured per save (``snapshot_ms``).
+* **write + commit** — npz shard files, manifest, atomic rename. A
+  background thread does all of it against the host snapshot, so the
+  next train steps overlap the disk I/O (``write_ms``, measured on the
+  thread).
+
+Crash safety is the :mod:`apex_tpu.ckpt.sharded` commit protocol: the
+whole checkpoint lands in a ``.tmp-*`` sibling and one ``os.rename``
+publishes it. A process killed mid-write (or the injected
+:class:`~apex_tpu.ckpt.sharded.SimulatedCrash` test fault) leaves the
+temp litter and NO new checkpoint — the previous committed one stays
+restorable, which ``tests/test_ckpt.py`` witnesses by injecting the
+fault at every stage.
+
+One save is in flight at a time: a second :meth:`save` first waits for
+the previous write to land (the snapshot already decoupled the device
+state, so "waits" means disk, not training)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from apex_tpu.ckpt import sharded as _sharded
+from apex_tpu.ckpt.sharded import SimulatedCrash
+
+PyTree = Any
+
+
+class _HostSnapshot:
+    """A ZeroState frozen on the host: what the writer thread consumes."""
+
+    __slots__ = ("buffers", "count", "layout")
+
+    def __init__(self, state):
+        self.buffers, self.count, _ = _sharded.snapshot_zero_state(state)
+        self.layout = state.layout
+
+    # duck-types ZeroState for save_zero_sharded
+
+
+class AsyncZeroSaver:
+    """Drives :func:`~apex_tpu.ckpt.sharded.save_zero_sharded` off the
+    step path. ``fault`` is the crash-injection hook threaded through to
+    the writer (tests only)."""
+
+    def __init__(self, *, fault=None):
+        self._fault = fault
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self.crashed = False          # a SimulatedCrash consumed the save
+        self.last_timings: Dict[str, float] = {}
+
+    def save(self, directory: str, state, *, dp: int,
+             params: Optional[PyTree] = None, scaler_state: Any = None,
+             step: int = 0, on_commit=None) -> Dict[str, float]:
+        """Snapshot ``state`` now (blocking, between steps), write in the
+        background. Returns ``{"snapshot_ms": ...}`` immediately; the
+        thread fills ``write_ms`` into :attr:`last_timings` when the
+        commit lands. ``on_commit(step)`` runs on the writer thread
+        after a successful rename (the manager hangs rotation off it)."""
+        self.wait()
+        t0 = time.perf_counter()
+        snap = _HostSnapshot(state)
+        if params is not None:
+            import jax
+
+            # host-copy the leaves NOW (the device params keep training)
+            # as an int-keyed dict: jax.tree.leaves of it reproduces the
+            # original traversal order, which is all flatten_to_chunks
+            # needs once the layout is supplied
+            params = {i: np.asarray(x)
+                      for i, x in enumerate(jax.tree.leaves(params))}
+        if scaler_state is not None and not isinstance(scaler_state, dict):
+            from apex_tpu.amp.scaler import state_dict as scaler_sd
+            scaler_state = scaler_sd(scaler_state)
+        snapshot_ms = (time.perf_counter() - t0) * 1e3
+        timings = {"snapshot_ms": round(snapshot_ms, 3)}
+        self.last_timings = timings
+
+        def _write():
+            t1 = time.perf_counter()
+            try:
+                _sharded.save_zero_sharded(
+                    directory, snap, dp=dp, params=params,
+                    scaler_state=scaler_state, step=step,
+                    fault=self._fault)
+                timings["write_ms"] = round(
+                    (time.perf_counter() - t1) * 1e3, 3)
+                if on_commit is not None:
+                    on_commit(step)
+            except SimulatedCrash:
+                # the injected SIGKILL: stop where we stand, clean
+                # nothing, commit nothing — exactly a killed process
+                self.crashed = True
+            except BaseException as e:  # surfaced on the next wait()
+                self._error = e
+
+        self._thread = threading.Thread(target=_write, daemon=True,
+                                        name="apex-tpu-ckpt-writer")
+        self._thread.start()
+        return timings
+
+    def wait(self) -> None:
+        """Block until the in-flight write (if any) lands; re-raise any
+        writer error on the caller's thread."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.wait()
+
+
+def cleanup_stale_tmp(directory: str) -> int:
+    """Remove ``*.tmp-<pid>`` litter a KILLED writer left under
+    ``directory``; returns how many were removed. Two classes of tmp
+    dir are spared: one whose embedded pid names a live FOREIGN process
+    (a resuming job sharing the root with a still-draining fleet must
+    not rmtree a save out from under its writer thread), and one this
+    very process is actively writing (``sharded._ACTIVE_TMP`` — a
+    second manager constructed over the same root mid-save). A dead
+    pid's litter, and our own writes that ENDED without committing
+    (crash-injected saves), can never commit — the rename only ever
+    runs in the thread that wrote the tmp — so sweeping them is safe."""
+    removed = 0
+    if not os.path.isdir(directory):
+        return 0
+    for name in os.listdir(directory):
+        if ".tmp-" not in name:
+            continue
+        path = os.path.join(directory, name)
+        pid_part = name.rsplit(".tmp-", 1)[1]
+        try:
+            pid = int(pid_part)
+        except ValueError:
+            pid = None
+        if pid is not None and pid != os.getpid() and _pid_alive(pid):
+            continue  # another live process may still be writing it
+        if os.path.abspath(path) in _sharded._ACTIVE_TMP:
+            continue  # OUR live writer thread is mid-save here
+        shutil.rmtree(path, ignore_errors=True)
+        if not os.path.exists(path):
+            removed += 1
+    return removed
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    return True

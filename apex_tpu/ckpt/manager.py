@@ -1,0 +1,164 @@
+"""ZeroCheckpointManager: rotation + auto-resume over the sharded format.
+
+The policy layer tying :mod:`apex_tpu.ckpt.sharded` (format),
+:mod:`apex_tpu.ckpt.async_save` (off-step writes) and
+:class:`~apex_tpu.ckpt.state.AutoResume` (preemption) together:
+
+* step directories ``step_00000042/`` under one root, discovered by
+  committed manifest (an interrupted save's ``.tmp-*`` litter is never
+  a checkpoint and is swept on manager construction);
+* ``max_to_keep`` rotation runs AFTER a commit lands (on the writer
+  thread for async saves) — the newest checkpoint is durable before an
+  old one is deleted, so there is no instant with fewer restorable
+  checkpoints than before the save;
+* ``save_interval_steps`` thins saves the same way the orbax-backed
+  legacy manager does; ``force=True`` (the preemption path) bypasses it;
+* ``restore`` is dp-elastic: ``restore(params_template, dp=dp_new)``
+  re-slices the chunk rows regardless of the width the checkpoint was
+  written at (same-dp restores are bitwise).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Any, List, Optional
+
+from apex_tpu.ckpt import sharded as _sharded
+from apex_tpu.ckpt.async_save import AsyncZeroSaver, cleanup_stale_tmp
+from apex_tpu.ckpt.manifest import MANIFEST_NAME
+
+PyTree = Any
+
+_STEP_RE = re.compile(r"^step_(\d{8})$")
+
+
+def _step_dir(root: str, step: int) -> str:
+    if step < 0:
+        raise ValueError(f"step must be >= 0, got {step}")
+    return os.path.join(root, f"step_{step:08d}")
+
+
+class ZeroCheckpointManager:
+    """``with ZeroCheckpointManager(root, max_to_keep=3) as mgr: ...``
+
+    ``mgr.save(step, zstate, dp=dp, params=..., scaler_state=...)``
+    between train steps; ``mgr.restore(params, dp=dp_new)`` on resume
+    (at ANY dp_new — the elastic re-slice). ``async_save=False`` makes
+    every save synchronous (the preemption/exit path wants that).
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 async_save: bool = True, save_interval_steps: int = 1,
+                 fault=None):
+        if max_to_keep < 1:
+            raise ValueError(f"max_to_keep must be >= 1, got {max_to_keep}")
+        self.directory = directory
+        self.max_to_keep = int(max_to_keep)
+        self.async_save = bool(async_save)
+        self.save_interval_steps = max(int(save_interval_steps), 1)
+        self._last_saved: Optional[int] = None
+        self._saver = AsyncZeroSaver(fault=fault)
+        os.makedirs(directory, exist_ok=True)
+        cleanup_stale_tmp(directory)  # a killed writer's litter
+
+    # -- discovery -------------------------------------------------------------
+
+    def all_steps(self) -> List[int]:
+        """Committed steps (manifest present), ascending."""
+        out = []
+        for name in os.listdir(self.directory):
+            m = _STEP_RE.match(name)
+            if m and os.path.isfile(os.path.join(self.directory, name,
+                                                 MANIFEST_NAME)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def step_path(self, step: int) -> str:
+        return _step_dir(self.directory, step)
+
+    # -- save ------------------------------------------------------------------
+
+    def save(self, step: int, state, *, dp: int,
+             params: Optional[PyTree] = None, scaler_state: Any = None,
+             force: bool = False) -> bool:
+        """Returns False when thinned out by ``save_interval_steps``
+        (``force=True`` bypasses — the preemption save must land)."""
+        if (not force and self._last_saved is not None
+                and step < self._last_saved + self.save_interval_steps):
+            return False
+        target = _step_dir(self.directory, step)
+        if os.path.exists(target):
+            raise FileExistsError(
+                f"checkpoint for step {step} already exists at "
+                f"{target!r}")
+        self._saver.save(target, state, dp=dp, params=params,
+                         scaler_state=scaler_state, step=step,
+                         on_commit=self._rotate)
+        if not self.async_save:
+            self._saver.wait()
+        self._last_saved = step
+        return True
+
+    def _rotate(self, _committed_step: int) -> None:
+        # rotation is post-commit (writer thread): the new checkpoint is
+        # already durable, so deleting the oldest can never shrink the
+        # set of restorable checkpoints below where it started
+        for old in self.all_steps()[:-self.max_to_keep]:
+            shutil.rmtree(_step_dir(self.directory, old),
+                          ignore_errors=True)
+
+    @property
+    def last_timings(self):
+        """The most recent save's measured ``snapshot_ms``/``write_ms``
+        (the ``ckpt`` bench record's raw material)."""
+        return self._saver.last_timings
+
+    # -- restore ---------------------------------------------------------------
+
+    def restore(self, params_template: PyTree, *, dp: int,
+                step: Optional[int] = None, verify: bool = True):
+        """``(ZeroState, RestoredZero)`` at width ``dp`` from ``step``
+        (default: latest committed)."""
+        self.wait_until_finished()
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no committed checkpoint under {self.directory!r}")
+        return _sharded.load_zero_state(
+            _step_dir(self.directory, step), params_template, dp=dp,
+            verify=verify)
+
+    def restore_params(self, like: PyTree, step: Optional[int] = None, *,
+                       verify: bool = True) -> PyTree:
+        """The param tree alone (serving hot-swap loader)."""
+        self.wait_until_finished()
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no committed checkpoint under {self.directory!r}")
+        return _sharded.restore_params(
+            _step_dir(self.directory, step), like, verify=verify)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def wait_until_finished(self) -> None:
+        self._saver.wait()
+
+    @property
+    def crashed(self) -> bool:
+        return self._saver.crashed
+
+    def close(self) -> None:
+        self.wait_until_finished()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,181 @@
+"""The sharded-checkpoint manifest: a self-describing JSON header.
+
+One ``manifest.json`` per checkpoint directory names everything restore
+needs to re-slice the chunk-row space WITHOUT opening a shard file:
+the chunk layout (per-tensor shapes + ``chunk_size`` — the
+:class:`~apex_tpu.optimizers.multi_tensor.ChunkLayout` is re-derived
+from these, never pickled), the dp width the shards were written at,
+the ``_pad_chunks`` padding rows, the optimizer step count, the loss-
+scaler payload, and a per-(buffer, rank) sha256 digest table.
+
+Validation is EAGER and knob-naming (repo style): a mismatched
+``chunk_size``, a padded row count its own ``dp`` cannot divide, or a
+digest table missing a rank all raise here with the offending knob in
+the message — never a deep reshape traceback three layers down.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+FORMAT = "apex_tpu.zero_sharded"
+VERSION = 1
+
+MANIFEST_NAME = "manifest.json"
+
+
+def pad_rows_for(n_chunks: int, dp: int) -> int:
+    """``_pad_chunks``'s padding row count at width ``dp``."""
+    return (-n_chunks) % dp
+
+
+def shard_rows(n_chunks: int, dp: int) -> Tuple[int, int]:
+    """(padded_rows, rows_per_rank) of the global chunk-row space at
+    width ``dp`` — the save/restore row math shared with
+    :func:`apex_tpu.contrib.optimizers.distributed.shard_row_range`."""
+    padded = n_chunks + pad_rows_for(n_chunks, dp)
+    return padded, padded // dp
+
+
+@dataclasses.dataclass
+class Manifest:
+    """Everything a restore needs, JSON-round-trippable."""
+
+    dp: int
+    chunk_size: int
+    n_chunks: int
+    pad_rows: int
+    rows_per_rank: int
+    buffers: List[str]
+    param_shapes: List[List[int]]
+    step: int = 0
+    count: int = 0
+    digests: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+    scaler: Optional[Dict[str, Any]] = None
+    params_included: bool = True
+    digest_algo: str = "sha256"
+    format: str = FORMAT
+    version: int = VERSION
+
+    @property
+    def padded_rows(self) -> int:
+        return self.n_chunks + self.pad_rows
+
+    def validate(self) -> None:
+        """Eager self-consistency check; every failure names the knob."""
+        if self.format != FORMAT:
+            raise ValueError(
+                f"manifest format {self.format!r} is not {FORMAT!r} — "
+                f"this directory does not hold a sharded ZeRO checkpoint")
+        if self.version > VERSION:
+            raise ValueError(
+                f"manifest version {self.version} is newer than this "
+                f"reader's {VERSION} — a future writer may have changed "
+                f"digest or row-space semantics; upgrade before "
+                f"restoring")
+        if self.dp < 1:
+            raise ValueError(f"manifest dp must be >= 1, got {self.dp}")
+        if self.chunk_size < 1:
+            raise ValueError(
+                f"manifest chunk_size must be >= 1, got {self.chunk_size}")
+        if self.pad_rows != pad_rows_for(self.n_chunks, self.dp):
+            raise ValueError(
+                f"manifest pad_rows ({self.pad_rows}) is not "
+                f"(-n_chunks) % dp = {pad_rows_for(self.n_chunks, self.dp)} "
+                f"for n_chunks={self.n_chunks}, dp={self.dp}")
+        if self.padded_rows % self.dp:
+            raise ValueError(
+                f"manifest dp ({self.dp}) does not divide the padded row "
+                f"count ({self.padded_rows} = n_chunks {self.n_chunks} + "
+                f"pad_rows {self.pad_rows})")
+        if self.rows_per_rank * self.dp != self.padded_rows:
+            raise ValueError(
+                f"manifest rows_per_rank ({self.rows_per_rank}) x dp "
+                f"({self.dp}) != padded rows ({self.padded_rows})")
+        if not self.buffers:
+            raise ValueError("manifest names no buffers")
+        for name, per_rank in self.digests.items():
+            if name not in self.buffers:
+                raise ValueError(
+                    f"manifest digest table names unknown buffer {name!r} "
+                    f"(buffers: {self.buffers})")
+            if len(per_rank) != self.dp:
+                raise ValueError(
+                    f"manifest digest table for {name!r} has "
+                    f"{len(per_rank)} entries for dp={self.dp}")
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, obj: Dict[str, Any]) -> "Manifest":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(obj) - fields)
+        if unknown:
+            raise ValueError(
+                f"manifest carries unknown keys {unknown} — not a "
+                f"{FORMAT} manifest (or a newer format than version "
+                f"{VERSION})")
+        missing = sorted(
+            {f.name for f in dataclasses.fields(cls)
+             if f.default is dataclasses.MISSING
+             and f.default_factory is dataclasses.MISSING} - set(obj))
+        if missing:
+            raise ValueError(f"manifest is missing required keys {missing}")
+        m = cls(**obj)
+        m.validate()
+        return m
+
+    def summary(self) -> Dict[str, Any]:
+        """The closed ``manifest`` object riding the ``ckpt`` monitor
+        record (CKPT_MANIFEST_SCHEMA: additionalProperties false — a
+        junk key here fails validation)."""
+        return {
+            "format": self.format,
+            "version": self.version,
+            "step": self.step,
+            "count": self.count,
+            "dp": self.dp,
+            "chunk_size": self.chunk_size,
+            "n_chunks": self.n_chunks,
+            "pad_rows": self.pad_rows,
+            "rows_per_rank": self.rows_per_rank,
+            "buffers": list(self.buffers),
+            "digest_algo": self.digest_algo,
+        }
+
+
+def write_manifest(directory: str, manifest: Manifest) -> None:
+    manifest.validate()
+    path = os.path.join(directory, MANIFEST_NAME)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(manifest.to_json(), fh, indent=1)
+    os.replace(tmp, path)
+
+
+def read_manifest(directory: str) -> Manifest:
+    """Read + eagerly validate ``manifest.json``; a missing manifest is
+    a :class:`FileNotFoundError` naming the path (an uncommitted or
+    foreign directory, not a corrupt checkpoint)."""
+    path = os.path.join(directory, MANIFEST_NAME)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"no {MANIFEST_NAME} under {directory!r} — not a committed "
+            f"sharded checkpoint (an interrupted save never commits its "
+            f"temp directory, so a missing manifest means this directory "
+            f"never finished writing or is not a checkpoint at all)")
+    with open(path) as fh:
+        try:
+            obj = json.load(fh)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path} is not valid JSON: {e}") from e
+    if not isinstance(obj, dict):
+        raise ValueError(f"{path} does not hold a JSON object")
+    try:
+        return Manifest.from_json(obj)
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"{path}: {e}") from e

@@ -1,0 +1,134 @@
+"""Pure-numpy pytree <-> ``.npz`` persistence.
+
+The orbax-free engine under :mod:`apex_tpu.ckpt`: a pytree of arrays
+flattens to one ``.npz`` archive keyed ``leaf_00000`` ... in traversal
+order (jax's deterministic ``tree.flatten`` order), dtype- and
+shape-preserving, plus a tiny JSON side record of the leaf count.
+Restore is template-shaped — the caller supplies a pytree with the
+SAME structure (the repo's ``restore_checkpoint(path, template)``
+convention) and gets its leaves replaced bitwise.
+
+This is both the fallback for the legacy :class:`~apex_tpu.ckpt.state.
+TrainState` round-trip when orbax is not importable (the seed's
+``raise RuntimeError("orbax is unavailable")`` made every checkpoint
+test environment-dependent) and the per-shard writer the sharded ZeRO
+format (:mod:`apex_tpu.ckpt.sharded`) builds on — ``fp32`` buffers
+round-trip exactly through npz, which is what makes same-dp resume
+bitwise.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+PyTree = Any
+
+_KEY = "leaf_{:05d}"
+_EXT = "ext_dtype_{:05d}"
+
+_INT_OF_WIDTH = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+def tree_to_arrays(tree: PyTree) -> Dict[str, np.ndarray]:
+    """Flatten to ``{leaf_00000: ndarray, ...}`` in traversal order.
+
+    Extension dtypes numpy cannot serialize (ml_dtypes: bfloat16,
+    float8_*) ride as same-width unsigned-int views plus an
+    ``ext_dtype_i`` marker naming the real dtype — bit-exact, which is
+    what keeps the round-trip bitwise."""
+    import jax
+
+    out: Dict[str, np.ndarray] = {}
+    for i, x in enumerate(jax.tree.leaves(tree)):
+        arr = np.asarray(x)
+        if not arr.flags.c_contiguous:
+            # (ascontiguousarray unconditionally would promote 0-d
+            # scalars to 1-d and break the shape round-trip)
+            arr = np.ascontiguousarray(arr)
+        if arr.dtype.kind == "V":  # ml_dtypes register as void-backed
+            out[_KEY.format(i)] = arr.view(
+                _INT_OF_WIDTH[arr.dtype.itemsize])
+            out[_EXT.format(i)] = np.asarray(arr.dtype.name)
+        else:
+            out[_KEY.format(i)] = arr
+    return out
+
+
+def save_tree_npz(path: str, tree: PyTree) -> int:
+    """Write the pytree's leaves to ``path`` (``.npz``); returns the
+    byte size written. The write goes through a temp file + atomic
+    ``os.replace`` so a crash mid-write never leaves a torn archive
+    under the final name."""
+    arrays = tree_to_arrays(tree)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **arrays)
+    os.replace(tmp, path)
+    return os.path.getsize(path)
+
+
+def load_tree_npz(path: str, template: PyTree) -> PyTree:
+    """Restore into ``template``'s structure: leaf count, shapes and
+    dtypes must match, each mismatch named eagerly."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree.flatten(template)
+    with np.load(path) as zf:
+        data_keys = sorted(k for k in zf.files if k.startswith("leaf_"))
+        want = [_KEY.format(i) for i in range(len(leaves))]
+        if data_keys != want:
+            raise ValueError(
+                f"checkpoint {path} holds {len(data_keys)} leaves but "
+                f"the template has {len(leaves)} — restore into the "
+                f"same pytree structure it was saved from")
+        out = []
+        for i, leaf in enumerate(leaves):
+            arr = zf[_KEY.format(i)]
+            lshape = tuple(np.shape(leaf))
+            ldtype = np.asarray(leaf).dtype
+            ext = _EXT.format(i)
+            if ext in zf.files:  # extension dtype rode as an int view
+                saved_name = str(zf[ext])
+                if ldtype.name != saved_name:
+                    raise ValueError(
+                        f"checkpoint {path} leaf {i}: saved dtype "
+                        f"{saved_name} != template dtype {ldtype}")
+                arr = arr.view(ldtype)
+            if tuple(arr.shape) != lshape:
+                raise ValueError(
+                    f"checkpoint {path} leaf {i}: saved shape "
+                    f"{tuple(arr.shape)} != template shape {lshape}")
+            if arr.dtype != ldtype:
+                raise ValueError(
+                    f"checkpoint {path} leaf {i}: saved dtype "
+                    f"{arr.dtype} != template dtype {ldtype}")
+            out.append(jnp.asarray(arr))
+    return jax.tree.unflatten(treedef, out)
+
+
+def array_digest(arr: np.ndarray) -> str:
+    """sha256 of an array's raw bytes (C-order) prefixed with shape/
+    dtype — the manifest's per-buffer integrity witness."""
+    import hashlib
+
+    a = np.ascontiguousarray(arr)
+    h = hashlib.sha256()
+    h.update(str((a.dtype.str, a.shape)).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def savez_atomic(path: str, arrays: Dict[str, np.ndarray]) -> int:
+    """``np.savez`` streamed straight into a temp file + ``os.replace``
+    (no intermediate BytesIO — a multi-GB shard must not double its
+    peak host memory during an async save); returns the byte size."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **arrays)
+    size = os.path.getsize(tmp)
+    os.replace(tmp, path)
+    return size

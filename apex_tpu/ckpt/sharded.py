@@ -1,0 +1,463 @@
+"""Sharded ZeRO checkpoint format: per-rank chunk-row shards + manifest.
+
+The persistence half of :mod:`apex_tpu.contrib.optimizers.distributed`
+(ROADMAP item 4, lineage Xu et al. arXiv:2004.13336): the chunked
+mega-buffer's flat ``(n_chunks, chunk_size)`` row space is
+dp-independent — a rank's ZeRO shard is just a contiguous row slice of
+it — so persisting each rank's ``(rows_per_rank, chunk)`` fp32 buffers
+(m/v + masters) plus a self-describing :class:`~apex_tpu.ckpt.manifest.
+Manifest` makes ELASTIC restore natural:
+
+* **same dp**: each target rank reads exactly its source shard file —
+  fp32 rows round-trip bitwise through npz, so resume is bitwise
+  (masters + m/v + scaler identical; the acceptance witness);
+* **dp′ ≠ dp**: the global row space is re-padded to dp′
+  (``_pad_chunks`` padding rows are zeros at every width) and re-sliced
+  into dp′ contiguous shards; a target rank's shard is assembled from
+  the 1–2+ source shards its row range overlaps — no full-buffer
+  materialization beyond the one target shard being built (plus one
+  source shard in flight), which is what lets a small resumed fleet
+  restore a big fleet's state.
+
+Commit is ATOMIC: everything lands in a ``<dir>.tmp-*`` sibling first
+and one ``os.rename`` publishes the finished checkpoint — a crash (or
+the injected test fault) at ANY point mid-save leaves either no
+directory or the complete one, never a torn checkpoint, and the
+previous committed checkpoint untouched. Restore-side validation is
+eager and knob-naming (missing manifest, digest mismatch, layout
+mismatch), per repo style.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from apex_tpu.ckpt.manifest import (Manifest, pad_rows_for, read_manifest,
+                                    shard_rows, write_manifest)
+from apex_tpu.ckpt.pytree_io import array_digest, savez_atomic
+
+PyTree = Any
+
+SHARD_NAME = "shard_{:05d}.npz"
+
+#: buffer name the replicated low-precision/fp32 params save under when
+#: the state carries no sharded fp32 masters (fp32 training keeps the
+#: params outside ZeroState; the checkpoint is self-contained either way)
+PARAMS_BUFFER = "params"
+
+
+#: absolute paths of tmp directories THIS process is actively writing —
+#: cleanup_stale_tmp spares them (a second manager constructed over the
+#: same root mid-save must not rmtree a live writer's work). Entries are
+#: discarded when the write ends in ANY way (commit, error, or the
+#: injected crash — after which no thread will touch the path again, so
+#: the litter becomes sweepable exactly like a killed process's).
+_ACTIVE_TMP: set = set()
+
+
+class SimulatedCrash(BaseException):
+    """Raised BY a test fault hook to emulate a SIGKILL mid-save: the
+    writer stops where it stands — no cleanup, no commit — exactly the
+    on-disk state a killed process leaves. BaseException so ordinary
+    ``except Exception`` recovery paths cannot accidentally swallow it
+    into a half-written commit."""
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(msg)
+
+
+def snapshot_zero_state(state) -> Tuple[Dict[str, np.ndarray], int, int]:
+    """Host copies of a gathered ZeroState's buffers: returns
+    ``(buffers, count, n_chunks)`` with every buffer a numpy fp32 array.
+    This is the device→host transfer the async saver runs BETWEEN steps
+    — after it returns, the device state may keep training."""
+    buffers = {k: np.asarray(v) for k, v in state.buffers.items()}
+    count = int(np.asarray(state.count))
+    n_chunks = int(np.shape(state.layout.chunk_to_tensor)[0])
+    return buffers, count, n_chunks
+
+
+def _params_rows(params, layout, padded_rows: int) -> np.ndarray:
+    """Flatten a replicated param tree into fp32 chunk rows padded to
+    the save width's row space (the live param image / the master-less
+    ``params`` buffer). PURE numpy — the same packing rule as
+    ``multi_tensor.flatten_to_chunks`` (fp32 upcast, per-tensor
+    zero-padded tails, empty tensors own one chunk) but runnable on the
+    async WRITER thread without dispatching device work mid-train."""
+    import jax
+
+    c = int(layout.chunk_size)
+    parts = []
+    for x in jax.tree.leaves(params):
+        flat = np.asarray(x).astype(np.float32).reshape(-1)
+        pad = (-flat.size) % c if flat.size else c
+        if pad:
+            flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+        parts.append(flat)
+    buf = np.concatenate(parts).reshape(-1, c)
+    tail = padded_rows - buf.shape[0]
+    if tail:
+        buf = np.concatenate([buf, np.zeros((tail, c), np.float32)])
+    return buf
+
+
+def save_zero_sharded(directory: str, state, *, dp: int,
+                      params: Optional[PyTree] = None,
+                      scaler_state: Any = None, step: int = 0,
+                      fault=None, overwrite: bool = False) -> Manifest:
+    """Write a sharded ZeRO checkpoint of ``state`` at width ``dp``.
+
+    ``state`` is a :class:`~apex_tpu.contrib.optimizers.distributed.
+    ZeroState` whose buffers are the GLOBAL gathered ``(padded_rows,
+    chunk)`` arrays (``gather_zero_state`` exports the training-loop
+    layout into this view; a multi-host deployment writes its
+    addressable rows through :func:`write_shard` directly). ``params``
+    must be passed when the state carries no ``master`` buffer (fp32
+    training keeps params outside the state) so the checkpoint stays
+    self-contained; ``scaler_state`` (a LossScalerState or its
+    ``state_dict`` payload) rides in the manifest so fp16 recovery
+    resumes mid-trajectory. ``fault`` is the crash-injection hook
+    (called with ``"shard:<rank>"``/``"manifest"``/``"commit"``;
+    raising :class:`SimulatedCrash` abandons the save exactly there).
+    """
+    buffers, count, n_chunks = snapshot_zero_state(state)
+    _require(dp >= 1, f"dp must be >= 1, got {dp}")
+    chunk = int(state.layout.chunk_size)
+    padded, rows_per_rank = shard_rows(n_chunks, dp)
+    for name, buf in buffers.items():
+        _require(buf.ndim == 2 and buf.shape[1] == chunk,
+                 f"buffer {name!r} has shape {buf.shape}; expected "
+                 f"(rows, chunk_size={chunk})")
+        _require(
+            buf.shape[0] == padded,
+            f"buffer {name!r} has {buf.shape[0]} rows but dp={dp} over "
+            f"n_chunks={n_chunks} shards {padded} padded rows — save "
+            f"takes the GLOBAL gathered state (out_specs P('dp') via "
+            f"gather_zero_state), and dp must match the axis it was "
+            f"gathered over")
+    if params is not None:
+        # the LIVE param image, always — even with fp32 masters in the
+        # state: low-precision training params are p + (new - p) in the
+        # param dtype, which is NOT bitwise the master's cast image, so
+        # a bitwise mid-training resume needs the params themselves
+        # (fp16/bf16 → fp32 rows is exact, as is the cast back)
+        buffers[PARAMS_BUFFER] = _params_rows(params, state.layout,
+                                              padded)
+    elif "master" not in buffers:
+        raise ValueError(
+            "the state carries no 'master' buffer (fp32 training keeps "
+            "params outside ZeroState) — pass params= so the "
+            "checkpoint stays self-contained")
+
+    scaler_payload = None
+    if scaler_state is not None:
+        if isinstance(scaler_state, dict):
+            scaler_payload = dict(scaler_state)
+        else:
+            from apex_tpu.amp.scaler import state_dict as scaler_sd
+            scaler_payload = scaler_sd(scaler_state)
+
+    names = sorted(buffers)
+    manifest = Manifest(
+        dp=dp, chunk_size=chunk, n_chunks=n_chunks,
+        pad_rows=pad_rows_for(n_chunks, dp), rows_per_rank=rows_per_rank,
+        buffers=names,
+        param_shapes=[list(s) for s in state.layout.shapes],
+        step=int(step), count=count,
+        digests={n: [array_digest(
+            buffers[n][r * rows_per_rank:(r + 1) * rows_per_rank])
+            for r in range(dp)] for n in names},
+        scaler=scaler_payload,
+        params_included=("master" in buffers
+                         or PARAMS_BUFFER in buffers),
+    )
+
+    if os.path.exists(directory) and not overwrite:
+        raise FileExistsError(
+            f"checkpoint directory {directory!r} already exists — "
+            f"pass overwrite=True or save to a fresh step directory")
+    parent = os.path.dirname(os.path.abspath(directory))
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{directory}.tmp-{os.getpid()}"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    _ACTIVE_TMP.add(os.path.abspath(tmp))
+    try:
+        for r in range(dp):
+            write_shard(tmp, r, {n: buffers[n][r * rows_per_rank:
+                                               (r + 1) * rows_per_rank]
+                                 for n in names})
+            if fault is not None:
+                fault(f"shard:{r}")
+        write_manifest(tmp, manifest)
+        if fault is not None:
+            fault("manifest")
+        if fault is not None:
+            fault("commit")
+        if overwrite and os.path.exists(directory):
+            # only once the replacement is FULLY written: a crash
+            # anywhere above leaves the old checkpoint untouched, and
+            # the window between these two lines is the narrowest
+            # possible
+            shutil.rmtree(directory)
+        os.rename(tmp, directory)  # the atomic commit
+    finally:
+        _ACTIVE_TMP.discard(os.path.abspath(tmp))
+    return manifest
+
+
+def write_shard(directory: str, rank: int,
+                buffers: Dict[str, np.ndarray]) -> int:
+    """The per-rank writer: one ``shard_<rank>.npz`` holding this
+    rank's row slice of every buffer. Multi-host deployments call this
+    with their addressable rows; the single-process saver loops it."""
+    return savez_atomic(
+        os.path.join(directory, SHARD_NAME.format(rank)),
+        {k: np.ascontiguousarray(np.asarray(v, np.float32))
+         for k, v in buffers.items()})
+
+
+def _read_shard(directory: str, manifest: Manifest, rank: int,
+                verify: bool,
+                names: Optional[List[str]] = None
+                ) -> Dict[str, np.ndarray]:
+    """Read (and digest-verify) ``names`` buffers of one shard file —
+    default all the manifest names. Callers that want a single buffer
+    (the param loader) pass a subset so a multi-GB shard's m/v rows
+    are neither decompressed nor hashed for nothing."""
+    path = os.path.join(directory, SHARD_NAME.format(rank))
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"checkpoint {directory!r} is missing {SHARD_NAME.format(rank)} "
+            f"(manifest says dp={manifest.dp} shards)")
+    out = {}
+    try:
+        zf = np.load(path)
+    except Exception as e:  # torn/overwritten archive: name the file,
+        # never surface numpy's zip internals as the diagnosis
+        raise ValueError(
+            f"{path} is not a readable npz archive ({e}) — the shard "
+            f"file is corrupt; restore from another checkpoint") from e
+    with zf:
+        for name in (manifest.buffers if names is None else names):
+            if name not in zf.files:
+                raise ValueError(
+                    f"{path} is missing buffer {name!r} named by the "
+                    f"manifest (holds: {sorted(zf.files)})")
+            try:
+                arr = zf[name]
+            except Exception as e:  # bad CRC / truncated member
+                raise ValueError(
+                    f"{path} buffer {name!r} is unreadable ({e}) — the "
+                    f"shard file is corrupt; restore from another "
+                    f"checkpoint") from e
+            want = (manifest.rows_per_rank, manifest.chunk_size)
+            if tuple(arr.shape) != want:
+                raise ValueError(
+                    f"{path} buffer {name!r} has shape "
+                    f"{tuple(arr.shape)}; manifest says {want}")
+            if verify and name in manifest.digests:
+                got = array_digest(arr)
+                expect = manifest.digests[name][rank]
+                if got != expect:
+                    raise ValueError(
+                        f"digest mismatch for buffer {name!r} in "
+                        f"{SHARD_NAME.format(rank)}: manifest says "
+                        f"{expect[:12]}..., file hashes {got[:12]}... — "
+                        f"the checkpoint is corrupt (or was edited); "
+                        f"pass verify=False only to forensically "
+                        f"inspect it")
+            out[name] = arr
+    return out
+
+
+def restore_zero_shard(directory: str, rank: int, dp: int, *,
+                       manifest: Optional[Manifest] = None,
+                       verify: bool = True,
+                       buffers: Optional[List[str]] = None,
+                       _cache: Optional[Dict[int, Dict[str, np.ndarray]]]
+                       = None) -> Dict[str, np.ndarray]:
+    """ONE target rank's buffers at width ``dp`` (elastic): reads only
+    the source shards whose row ranges overlap the target's, assembling
+    at most one target shard + one source shard at a time (pass a
+    ``_cache`` dict to share source reads across target ranks in a
+    single-process restore). ``buffers`` restricts which buffer names
+    are read (default all)."""
+    if manifest is None:
+        manifest = read_manifest(directory)
+    _require(dp >= 1, f"dp must be >= 1, got {dp}")
+    n = manifest.n_chunks
+    chunk = manifest.chunk_size
+    padded_new, rpr_new = shard_rows(n, dp)
+    _require(rank < dp, f"rank {rank} out of range for dp={dp}")
+    src_rpr = manifest.rows_per_rank
+    names = list(manifest.buffers) if buffers is None else list(buffers)
+
+    def _src(r: int) -> Dict[str, np.ndarray]:
+        if _cache is not None and r in _cache:
+            return _cache[r]
+        shard = _read_shard(directory, manifest, r, verify, names=names)
+        if _cache is not None:
+            _cache.clear()  # hold ONE source shard, the current run's
+            _cache[r] = shard
+        return shard
+
+    start, stop = rank * rpr_new, (rank + 1) * rpr_new
+    if dp == manifest.dp:
+        # bitwise fast path: the target shard IS a source shard file
+        return _src(rank)
+    out = {name: np.zeros((rpr_new, chunk), np.float32)
+           for name in names}
+    live_stop = min(stop, n)  # rows >= n_chunks are padding: zeros
+    g = start
+    while g < live_stop:
+        sr = g // src_rpr
+        s_lo = g - sr * src_rpr
+        s_hi = min(src_rpr, live_stop - sr * src_rpr)
+        shard = _src(sr)
+        for name in names:
+            out[name][g - start:g - start + (s_hi - s_lo)] = \
+                shard[name][s_lo:s_hi]
+        g = sr * src_rpr + s_hi
+    return out
+
+
+@dataclasses.dataclass
+class RestoredZero:
+    """A restore's host-side result: GLOBAL buffers re-sliced to the
+    target width (``(padded_rows(dp), chunk)`` each), the optimizer
+    count, the save-time step, the scaler payload, and the manifest."""
+
+    buffers: Dict[str, np.ndarray]
+    count: int
+    step: int
+    scaler: Optional[Dict[str, Any]]
+    manifest: Manifest
+    dp: int
+
+
+def restore_zero_sharded(directory: str, *, dp: int, verify: bool = True,
+                         buffers: Optional[List[str]] = None
+                         ) -> RestoredZero:
+    """Assemble the full target-width state (every rank's shard,
+    stacked rank-major — the single-process/test view; a real fleet
+    calls :func:`restore_zero_shard` per rank instead). ``buffers``
+    restricts which buffer names are read (default all)."""
+    manifest = read_manifest(directory)
+    _require(dp >= 1, f"dp must be >= 1, got {dp}")
+    names = list(manifest.buffers) if buffers is None else list(buffers)
+    cache: Dict[int, Dict[str, np.ndarray]] = {}
+    parts: List[Dict[str, np.ndarray]] = [
+        restore_zero_shard(directory, r, dp, manifest=manifest,
+                           verify=verify, buffers=names, _cache=cache)
+        for r in range(dp)]
+    out = {name: np.concatenate([p[name] for p in parts])
+           for name in names}
+    return RestoredZero(buffers=out, count=manifest.count,
+                        step=manifest.step, scaler=manifest.scaler,
+                        manifest=manifest, dp=dp)
+
+
+def _validate_layout(manifest: Manifest, layout,
+                     chunk_size: Optional[int] = None) -> None:
+    """The template's layout must reproduce the manifest's row space;
+    each mismatch names its knob."""
+    if chunk_size is not None and chunk_size != manifest.chunk_size:
+        raise ValueError(
+            f"chunk_size mismatch: checkpoint was saved with "
+            f"chunk_size={manifest.chunk_size}, restore requested "
+            f"{chunk_size} — the chunk-row space is only dp-elastic, "
+            f"not chunk-elastic")
+    shapes = [list(s) for s in layout.shapes]
+    if shapes != manifest.param_shapes:
+        for i, (a, b) in enumerate(zip(shapes, manifest.param_shapes)):
+            if a != b:
+                raise ValueError(
+                    f"param tree mismatch at leaf {i}: template shape "
+                    f"{a} vs checkpoint shape {b} — restore into the "
+                    f"model the checkpoint was saved from")
+        raise ValueError(
+            f"param tree mismatch: template has {len(shapes)} leaves, "
+            f"checkpoint has {len(manifest.param_shapes)}")
+    n = int(np.shape(layout.chunk_to_tensor)[0])
+    if n != manifest.n_chunks:
+        raise ValueError(
+            f"layout mismatch: template packs to {n} chunks, checkpoint "
+            f"holds {manifest.n_chunks} (chunk_size="
+            f"{manifest.chunk_size})")
+
+
+def load_zero_state(directory: str, params_template: PyTree, *, dp: int,
+                    verify: bool = True):
+    """Restore into a ready-to-shard ZeroState at width ``dp``: the
+    returned state's buffers are the GLOBAL re-sliced arrays — feed it
+    through :func:`~apex_tpu.contrib.optimizers.distributed.
+    scatter_zero_state` (in_specs ``P('dp')`` on the buffers) to get
+    each rank its contiguous shard. Returns ``(state, restored)``."""
+    import jax.numpy as jnp
+
+    from apex_tpu.contrib.optimizers.distributed import ZeroState
+    from apex_tpu.optimizers import multi_tensor as mt
+
+    manifest = read_manifest(directory)
+    layout = mt.make_layout(params_template, manifest.chunk_size)
+    _validate_layout(manifest, layout)
+    # the params buffer is not optimizer state — don't read (or hash)
+    # its rows just to drop them; restore_params is its consumer
+    state_names = [b for b in manifest.buffers if b != PARAMS_BUFFER]
+    restored = restore_zero_sharded(directory, dp=dp, verify=verify,
+                                    buffers=state_names)
+    buffers = {k: jnp.asarray(v) for k, v in restored.buffers.items()}
+    state = ZeroState(count=jnp.asarray(restored.count, jnp.int32),
+                      layout=layout, buffers=buffers)
+    return state, restored
+
+
+def restore_params(directory: str, like: PyTree, *,
+                   verify: bool = True) -> PyTree:
+    """Rebuild the full (replicated) param tree from a sharded
+    checkpoint: the fp32 ``master`` rows when the training was
+    mixed-precision, else the ``params`` buffer — cast leaf-wise to
+    ``like``'s dtypes. This is the serving hot-swap loader: the result
+    has exactly ``like``'s avals, so swapping it into a live
+    :class:`~apex_tpu.serving.engine.ServingEngine` is a contents-only
+    mutation."""
+    import jax.numpy as jnp
+
+    from apex_tpu.optimizers import multi_tensor as mt
+
+    manifest = read_manifest(directory)
+    layout = mt.make_layout(like, manifest.chunk_size)
+    _validate_layout(manifest, layout)
+    # prefer the LIVE param image (bitwise mid-training resume); a
+    # masters-only checkpoint rebuilds the master's low-precision cast
+    # instead (identical maths going forward, one rounding ULP of
+    # history short of bitwise — fine for eval/serving)
+    source = PARAMS_BUFFER if PARAMS_BUFFER in manifest.buffers else (
+        "master" if "master" in manifest.buffers else None)
+    if source is None:
+        raise ValueError(
+            f"checkpoint {directory!r} holds neither 'master' nor "
+            f"'params' buffers (buffers: {manifest.buffers}) — it was "
+            f"saved without params= and cannot rebuild a param tree")
+    n, chunk = manifest.n_chunks, manifest.chunk_size
+    flat = np.zeros((n, chunk), np.float32)
+    src_rpr = manifest.rows_per_rank
+    for r in range(manifest.dp):
+        lo = r * src_rpr
+        if lo >= n:
+            break
+        # read+verify the ONE source buffer, not the whole shard —
+        # the hot-swap loader must not hash a checkpoint's m/v rows
+        shard = _read_shard(directory, manifest, r, verify,
+                            names=[source])
+        flat[lo:min(lo + src_rpr, n)] = shard[source][:n - lo]
+    return mt.unflatten_from_chunks(jnp.asarray(flat), layout, like=like)

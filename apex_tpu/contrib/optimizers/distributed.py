@@ -259,6 +259,107 @@ def _local_segment_ids(layout, local_rows, axis):
     return jax.lax.dynamic_slice_in_dim(padded, rank * local_rows, local_rows, 0)
 
 
+# --- shard import/export views (the checkpoint subsystem's substrate) --------
+#
+# The training loop holds ZeroState in the "rank-local" layout (each
+# device's buffer IS its contiguous chunk-row shard; shard_map round-
+# trips it with P() specs). Persistence needs the GLOBAL view — buffers
+# stacked rank-major over dp, one dp-independent row space — which is
+# exactly one identity shard_map away in either direction. The row math
+# lives here next to _pad_chunks so the two can never drift.
+
+def shard_row_range(n_chunks: int, dp: int, rank: int):
+    """``(start, stop)`` of ``rank``'s rows in the PADDED global
+    chunk-row space at width ``dp`` (the save/restore slicing rule —
+    shared with :func:`apex_tpu.ckpt.manifest.shard_rows`)."""
+    if dp < 1:
+        raise ValueError(f"dp must be >= 1, got {dp}")
+    if not 0 <= rank < dp:
+        raise ValueError(f"rank {rank} out of range for dp={dp}")
+    padded = n_chunks + ((-n_chunks) % dp)
+    per = padded // dp
+    return rank * per, (rank + 1) * per
+
+
+def export_zero_shard(state: "ZeroState", rank: int, dp: int):
+    """Host-side view of one rank's buffers out of a GATHERED state
+    (global ``(padded_rows, chunk)`` buffers): the per-rank writer's
+    input. Numpy slices — no copy until the writer serializes."""
+    import numpy as np
+
+    n = int(np.shape(state.layout.chunk_to_tensor)[0])
+    lo, hi = shard_row_range(n, dp, rank)
+    out = {}
+    for name, buf in state.buffers.items():
+        arr = np.asarray(buf)
+        if arr.shape[0] != n + ((-n) % dp):
+            raise ValueError(
+                f"buffer {name!r} has {arr.shape[0]} rows; a gathered "
+                f"state at dp={dp} over n_chunks={n} has "
+                f"{n + ((-n) % dp)} — gather_zero_state first")
+        out[name] = arr[lo:hi]
+    return out
+
+
+def zero_state_specs(state: "ZeroState", *, gathered: bool,
+                     axis_name: str = mesh_lib.DATA_AXIS):
+    """The shard_map spec pytree matching ``state``: every leaf
+    replicated (``P()``) except the buffers, which are ``P(axis)`` in
+    the gathered (global rank-major) view and ``P()`` in the rank-local
+    training view."""
+    from jax.sharding import PartitionSpec as P
+
+    specs = jax.tree.map(lambda _: P(), state)
+    if gathered:
+        specs = dataclasses.replace(
+            specs, buffers={k: P(axis_name) for k in state.buffers})
+    return specs
+
+
+# the jitted identity-reshard executables, keyed by everything that
+# shapes the program: (mesh, axis, direction, state structure, buffer
+# names). A per-call jax.jit(shard_map(lambda ...)) would RETRACE on
+# every save — compile time would land inside the step window the ckpt
+# bench measures as save_overhead_pct. Bounded in practice by the
+# handful of (mesh, state-shape) pairs a process ever holds.
+_RESHARD_CACHE: Dict[Any, Any] = {}
+
+
+def _identity_reshard(state: "ZeroState", mesh, axis_name: str,
+                      gathered_out: bool) -> "ZeroState":
+    key = (mesh, axis_name, gathered_out, jax.tree.structure(state),
+           tuple(sorted(state.buffers)))
+    fn = _RESHARD_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(mesh_lib.shard_map(
+            lambda s: s, mesh=mesh,
+            in_specs=(zero_state_specs(state, gathered=not gathered_out,
+                                       axis_name=axis_name),),
+            out_specs=zero_state_specs(state, gathered=gathered_out,
+                                       axis_name=axis_name),
+        ))
+        _RESHARD_CACHE[key] = fn
+    return fn(state)
+
+
+def gather_zero_state(state: "ZeroState", mesh, *,
+                      axis_name: str = mesh_lib.DATA_AXIS) -> "ZeroState":
+    """Rank-local training layout → GLOBAL view: buffers come back as
+    ``(padded_rows, chunk)`` arrays stacked rank-major over ``dp`` (the
+    checkpoint saver's input). An identity shard_map — no collective;
+    the 'gather' is the output spec. Compiled once per (mesh, state
+    shape): repeated saves reuse one executable."""
+    return _identity_reshard(state, mesh, axis_name, gathered_out=True)
+
+
+def scatter_zero_state(state: "ZeroState", mesh, *,
+                       axis_name: str = mesh_lib.DATA_AXIS) -> "ZeroState":
+    """GLOBAL view → rank-local training layout: each rank slices its
+    contiguous chunk-row shard (the restore path's last hop). Inverse
+    of :func:`gather_zero_state`; same one-executable caching."""
+    return _identity_reshard(state, mesh, axis_name, gathered_out=False)
+
+
 # class-style aliases (reference constructor surface)
 DistributedFusedAdam = distributed_fused_adam
 DistributedFusedLAMB = distributed_fused_lamb

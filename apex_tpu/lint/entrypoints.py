@@ -571,6 +571,45 @@ def _build_planned_gpt_step():
 
 
 @register(
+    "serve_swap",
+    "serving decode step immediately AFTER a weight hot-swap "
+    "(checkpoint params swapped into the live engine between dispatch "
+    "steps; pool donated+rebound, collective-free — the same compiled "
+    "program, new operand contents)",
+    lambda: [jc.donation_honored(), jc.donation_rebound(),
+             jc.collective_free_region("",
+                                       region="serving hot-swap step")])
+def _build_serve_swap():
+    """The hot-swap contract as a traced program: the engine's decode
+    step with the SWAPPED param tree as its operand. The swap itself is
+    host-side (ISSUE 14: a contents-only mutation validated by
+    ``_validate_swap_avals`` — exercised here so the entrypoint fails
+    loudly if the contract ever starts mutating avals), so the traced
+    program is the ordinary decode body; the contracts assert that the
+    step a freshly-swapped engine dispatches still donates + rebinds
+    the pool and stays collective-free."""
+    import jax
+    import jax.random as jr
+
+    engine, params, jnp = _serving_engine()
+    sched, _, _ = _cow_scheduler(engine)
+    pool = engine.init_pool()
+    # the swapped tree: same avals, new contents (a restored
+    # checkpoint's params — here a structural clone stands in)
+    new_params = jax.tree.map(jnp.asarray, params)
+    engine._validate_swap_avals(params, new_params)
+    batch = sched.decode_batch(0.0)
+    if batch is None:
+        raise RuntimeError(
+            "serve_swap entrypoint expected a live decode batch")
+    toks, lens = batch
+    tables = jnp.asarray(sched.tables.asarray())
+    return engine.decode_step, (new_params, pool, tables,
+                                jnp.asarray(toks), jnp.asarray(lens),
+                                jr.PRNGKey(0))  # apexlint: disable=APX502
+
+
+@register(
     "serve_decode",
     "serving paged decode step with COW block tables in play "
     "(shared prefix blocks in the table; pool donated+rebound, "

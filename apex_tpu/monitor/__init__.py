@@ -50,6 +50,7 @@ from apex_tpu.monitor.registry import (  # noqa: F401
     check_record_honesty,
     counter,
     disable,
+    emit_ckpt,
     emit_decode,
     emit_event,
     emit_longseq_bias,

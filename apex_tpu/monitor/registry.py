@@ -311,6 +311,12 @@ class MetricsRegistry:
         host-gap breakdown plus the calibrated CostDB artifact."""
         return self._emit_status_record("profile", status, **fields)
 
+    def emit_ckpt(self, status: str, **fields) -> Dict[str, Any]:
+        """Elastic-checkpoint bench record (``bench.py --ckpt``):
+        measured async-save cost (snapshot/write/overhead) plus the
+        bitwise and elastic resume witnesses (:mod:`apex_tpu.ckpt`)."""
+        return self._emit_status_record("ckpt", status, **fields)
+
     # -- step lifecycle ------------------------------------------------------
 
     def begin_step(self, step: Optional[int] = None) -> None:
@@ -533,6 +539,13 @@ def emit_profile(status: str, **fields) -> Optional[Dict[str, Any]]:
     r = _REGISTRY
     if r is not None:
         return r.emit_profile(status, **fields)
+    return None
+
+
+def emit_ckpt(status: str, **fields) -> Optional[Dict[str, Any]]:
+    r = _REGISTRY
+    if r is not None:
+        return r.emit_ckpt(status, **fields)
     return None
 
 

@@ -74,6 +74,7 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     serve_window_records = []
     pipeline_records = []
     plan_records = []
+    ckpt_records = []
     schedule = None
     for rec in records:
         kind = rec.get("kind")
@@ -99,6 +100,8 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             pipeline_records.append(rec)
         elif kind == "plan":
             plan_records.append(rec)
+        elif kind == "ckpt":
+            ckpt_records.append(rec)
         elif kind == "event" and rec.get("name") == "pipeline_schedule":
             schedule = rec
 
@@ -290,6 +293,14 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         if isinstance(uncal, list):
             summary["plan"]["uncalibrated"] = uncal
 
+    if ckpt_records:
+        summary["ckpt"] = status_summary(
+            ckpt_records, ("save_overhead_pct", "step_ms",
+                           "step_ms_saving", "snapshot_ms", "write_ms",
+                           "restore_ms", "bytes_written", "steps",
+                           "saves", "save_every", "dp", "async_save",
+                           "bitwise_resume_ok", "elastic_resume_ok"))
+
     if gate_records:
         summary["gates"] = [
             {"name": g.get("name"), "ok": g.get("ok"),
@@ -338,15 +349,19 @@ def serve_timeline(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         records = records[meta_idx[-1]:]
     per_rid: Dict[int, Dict[str, Any]] = {}
     stragglers = []
+    swaps = []
     for rec in records:
         if rec.get("kind") != "serve_event":
             continue
         rid = rec.get("rid")
-        if rid == -1:  # engine-level events (straggler steps)
+        if rid == -1:  # engine-level events (straggler steps, swaps)
             if rec.get("straggler"):
                 stragglers.append({k: rec.get(k) for k in
                                    ("at_s", "step", "dur_ms",
                                     "ratio_to_median")})
+            elif rec.get("phase") == "swap":
+                swaps.append({k: rec.get(k) for k in
+                              ("at_s", "step", "swap_source")})
             continue
         row = per_rid.setdefault(rid, {"rid": rid})
         phase = rec.get("phase")
@@ -393,7 +408,7 @@ def serve_timeline(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         for rec in records if rec.get("kind") == "serve_window"
     ]
     return {"requests": requests, "windows": windows,
-            "stragglers": stragglers}
+            "stragglers": stragglers, "swaps": swaps}
 
 
 def _ms(v, nd=1) -> str:
@@ -467,6 +482,12 @@ def format_serve_timeline(timeline: Dict[str, Any]) -> str:
         lines.append(f"  straggler step {s.get('step')}: "
                      f"{_ms(s.get('dur_ms'), 2)} "
                      f"({s.get('ratio_to_median', '?')}x rolling median)")
+    for s in timeline.get("swaps", []):
+        src = s.get("swap_source")
+        lines.append(f"  swap at step {s.get('step')}"
+                     + (f" from {src}" if src else "")
+                     + ": weights hot-swapped (contents-only; in-flight "
+                       "streams kept)")
     return "\n".join(lines)
 
 
@@ -646,6 +667,28 @@ def render(summary: Dict[str, Any]) -> str:
         if pl.get("status") == "SKIP":
             parts.append(f"SKIP({pl.get('reason', '?')})")
         lines.append("  plan        " + "   ".join(parts))
+    ck = summary.get("ckpt")
+    if ck:
+        if ck.get("status") == "SKIP":
+            lines.append(f"  ckpt        SKIP({ck.get('reason', '?')})")
+        else:
+            parts = []
+            if isinstance(ck.get("save_overhead_pct"), (int, float)):
+                parts.append(
+                    f"save overhead {ck['save_overhead_pct']:.2f}%/step")
+            if isinstance(ck.get("snapshot_ms"), (int, float)):
+                parts.append(f"snapshot {ck['snapshot_ms']:.2f} ms")
+            if isinstance(ck.get("write_ms"), (int, float)):
+                parts.append(f"write {ck['write_ms']:.2f} ms (async)")
+            if isinstance(ck.get("bytes_written"), (int, float)):
+                parts.append(f"{ck['bytes_written']/1e6:.2f} MB")
+            if ck.get("bitwise_resume_ok") is True:
+                parts.append("bitwise-resume ok")
+            if ck.get("elastic_resume_ok") is True:
+                parts.append("elastic ok")
+            if ck.get("skipped"):
+                parts.append("skipped: " + ", ".join(ck["skipped"]))
+            lines.append("  ckpt        " + "   ".join(parts))
     for gate in summary.get("gates", []):
         skipped = (", skipped: " + ", ".join(gate["skipped"])
                    if gate["skipped"] else "")

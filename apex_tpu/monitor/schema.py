@@ -328,6 +328,7 @@ SERVE_SCHEMA = {
         "prefix_miss_requests": {"type": "integer"},
         "preemptions": {"type": "integer"},   # evict lifecycle events
         "recompute_tokens": {"type": "integer"},  # re-prefilled rows
+        "swaps": {"type": "integer"},         # weight hot-swaps applied
         "blocks_resident": {"type": "integer"},   # warm cache footprint
         # greedy parity over the WHOLE churn sweep including
         # evicted-and-recomputed and prefix-hit requests
@@ -377,7 +378,8 @@ SERVE_EVENT_SCHEMA = {
         "kind": {"enum": ["serve_event"]},
         "rid": {"type": "integer"},
         "phase": {"enum": ["submit", "admit", "prefill_chunk",
-                           "first_token", "decode", "finish", "evict"]},
+                           "first_token", "decode", "finish", "evict",
+                           "swap"]},
         "at_s": {"type": "number"},        # serve-clock transition time
         "slot": {"type": "integer"},
         "step": {"type": "integer"},       # engine dispatch counter
@@ -403,6 +405,10 @@ SERVE_EVENT_SCHEMA = {
         "generated": {"type": "integer"},      # evict: tokens so far
         "prefix_hit_blocks": {"type": "integer"},  # admit: shared blocks
         "resumed": {"type": "boolean"},        # re-admit / resumed decode
+        # weight hot-swap (ISSUE 14): engine-level, rid -1 — a new
+        # checkpoint's params replaced the serving weights between
+        # dispatch steps (contents-only; both jit caches stay at 1)
+        "swap_source": {"type": "string"},     # swap: where weights came from
     },
     "required": ["schema", "kind", "rid", "phase", "at_s"],
 }
@@ -705,6 +711,67 @@ PLAN_SCHEMA = {
     "required": ["schema", "kind", "status", "chosen", "ranking"],
 }
 
+# sharded-checkpoint bench record (`python bench.py --ckpt`): the
+# measured cost of elastic ZeRO checkpointing (apex_tpu.ckpt) — the
+# between-steps snapshot time (the only part on the step path), the
+# background write+commit time, and the headline save_overhead_pct
+# (extra wall time a saving run pays per step vs the clean baseline;
+# tools/bench_history.py gates it lower-is-better in absolute points).
+# The `manifest` section mirrors Manifest.summary() and is CLOSED —
+# a junk key in it fails validation (tools/validate_metrics.py --ckpt).
+# Same status semantics as every bench record: "OK" only on real TPU
+# (honesty rule engaged), off-TPU an explicit SKIP(reason) with the
+# smoke measurements riding along — never nan in an OK line.
+CKPT_MANIFEST_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "format": {"type": "string"},
+        "version": {"type": "integer"},
+        "step": {"type": "integer"},
+        "count": {"type": "integer"},
+        "dp": {"type": "integer"},
+        "chunk_size": {"type": "integer"},
+        "n_chunks": {"type": "integer"},
+        "pad_rows": {"type": "integer"},
+        "rows_per_rank": {"type": "integer"},
+        "buffers": {"type": "array", "items": {"type": "string"}},
+        "digest_algo": {"type": "string"},
+    },
+    "required": ["format", "dp", "chunk_size", "n_chunks",
+                 "rows_per_rank", "buffers"],
+    "additionalProperties": False,
+}
+
+CKPT_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["ckpt"]},
+        "status": {"enum": ["OK", "SKIP"]},
+        "reason": {"type": "string"},  # required when status == "SKIP"
+        "save_overhead_pct": _METRIC_VALUE,  # the gated headline
+        "step_ms": _METRIC_VALUE,            # clean steady-state step
+        "step_ms_saving": _METRIC_VALUE,     # mean step while saving
+        "snapshot_ms": _METRIC_VALUE,        # device→host, on-path part
+        "write_ms": _METRIC_VALUE,           # background write+commit
+        "restore_ms": _METRIC_VALUE,
+        "bytes_written": {"type": "integer"},
+        "steps": {"type": "integer"},
+        "saves": {"type": "integer"},
+        "save_every": {"type": "integer"},
+        "dp": {"type": "integer"},
+        "async_save": {"type": "boolean"},
+        # acceptance witnesses, measured in-process by the leg
+        "bitwise_resume_ok": {"type": "boolean"},   # same-dp roundtrip
+        "elastic_resume_ok": {"type": "boolean"},   # dp-resize rows match
+        "manifest": CKPT_MANIFEST_SCHEMA,
+        "spread_pct": _METRIC_VALUE,
+        "config": {"type": "object"},
+        "backend": {"type": "string"},
+    },
+    "required": ["schema", "kind", "status"],
+}
+
 SCHEMAS_BY_KIND = {
     "step": STEP_SCHEMA,
     "meta": META_SCHEMA,
@@ -722,6 +789,7 @@ SCHEMAS_BY_KIND = {
     "costdb": COSTDB_SCHEMA,
     "static_cost": STATIC_COST_SCHEMA,
     "plan": PLAN_SCHEMA,
+    "ckpt": CKPT_SCHEMA,
 }
 
 # --- minimal JSON-Schema subset validator ------------------------------------
@@ -821,7 +889,7 @@ def validate(record: Dict[str, Any],
     # with a claim-free, reason-free skip)
     if (record.get("kind") in ("decode", "longseq_bias", "tp_overlap",
                                "profile", "serve", "pipeline",
-                               "serve_window", "plan")
+                               "serve_window", "plan", "ckpt")
             and record.get("status") == "SKIP"
             and not record.get("reason")):
         errors.append(

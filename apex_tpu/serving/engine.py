@@ -66,6 +66,7 @@ class ServeStats:
     decode_steps: int = 0
     prefill_chunks: int = 0
     blocks_high_water: int = 0
+    swaps: int = 0
     occupancy_samples: List[int] = field(default_factory=list)
 
     def occupancy_pct(self, num_slots: int) -> Optional[float]:
@@ -147,6 +148,10 @@ class ServingEngine:
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self.last_stats: Optional[ServeStats] = None
+        # pending weight hot-swap: (at_step, new_params, label) —
+        # applied by the serve loop BETWEEN dispatch steps (see
+        # request_swap)
+        self._pending_swap = None
         # one jitted executable each; both donate the pool (argnums:
         # params=0, pool=1, ... — the cache updates in place)
         self.prefill_chunk = jax.jit(self._prefill_chunk,
@@ -171,6 +176,77 @@ class ServingEngine:
         itemsize = jnp.dtype(self.cache_dtype).itemsize
         return (2 * c.num_layers * self.num_blocks * c.local_kv_heads
                 * self.block_size * c.head_dim * itemsize)
+
+    # --- weight hot-swap -----------------------------------------------------
+
+    @staticmethod
+    def _validate_swap_avals(old, new) -> None:
+        """The hot-swap contract: the new tree must be a contents-only
+        mutation — same structure, same shape/dtype per leaf — so both
+        jitted programs keep their compiled executables (stable avals;
+        the jit caches stay pinned at 1 through a swap). Every mismatch
+        names its leaf path eagerly; a silent aval drift would instead
+        surface as a RECOMPILE mid-serve, exactly the failure mode the
+        zero-recompile contract exists to prevent."""
+        old_paths = jax.tree_util.tree_flatten_with_path(old)
+        new_paths = jax.tree_util.tree_flatten_with_path(new)
+        if jax.tree.structure(old) != jax.tree.structure(new):
+            ok = {jax.tree_util.keystr(p) for p, _ in old_paths[0]}
+            nk = {jax.tree_util.keystr(p) for p, _ in new_paths[0]}
+            extra, missing = sorted(nk - ok), sorted(ok - nk)
+            raise ValueError(
+                f"hot-swap params tree mismatch: new tree "
+                f"{'adds ' + str(extra) if extra else ''}"
+                f"{' and ' if extra and missing else ''}"
+                f"{'drops ' + str(missing) if missing else ''}"
+                f"{'' if extra or missing else 'has a different structure'}"
+                f" — a swap is contents-only (same model, new weights)")
+        for (path, a), (_, b) in zip(old_paths[0], new_paths[0]):
+            if jnp.shape(a) != jnp.shape(b) or \
+                    jnp.asarray(a).dtype != jnp.asarray(b).dtype:
+                raise ValueError(
+                    f"hot-swap aval mismatch at {jax.tree_util.keystr(path)}: "
+                    f"serving {jnp.shape(a)}/{jnp.asarray(a).dtype}, new "
+                    f"checkpoint {jnp.shape(b)}/{jnp.asarray(b).dtype} — "
+                    f"a swap must keep every aval (restore_params(..., "
+                    f"like=current_params) produces a matching tree)")
+
+    def request_swap(self, new_params, *, at_step: Optional[int] = None,
+                     source: Optional[str] = None) -> None:
+        """Queue a weight hot-swap for the live serve loop: the NEXT
+        loop iteration whose dispatch counter has reached ``at_step``
+        (immediately when ``None``) replaces the params reference
+        BETWEEN dispatch steps — in-flight requests keep their KV cache
+        and finish against the new weights without dropping. Avals are
+        validated against the live params at apply time (an eager,
+        leaf-naming error — never a mid-serve recompile); ``source``
+        labels the ``swap`` lifecycle event (e.g. the checkpoint step).
+
+        One swap is pending at a time (a newer request replaces an
+        unapplied one), and an unapplied swap does NOT outlive the
+        serve call — if ``at_step`` is never reached the swap is
+        dropped when ``serve`` returns (``last_stats.swaps == 0`` is
+        the tell), never silently applied to a later run.
+
+        Typical use with the sharded checkpoint subsystem::
+
+            new = apex_tpu.ckpt.restore_params(ckpt_dir, like=params)
+            engine.request_swap(new, source="step_00000042")
+        """
+        self._pending_swap = (at_step, new_params, source)
+
+    def _maybe_swap(self, params, nstep: int, tel, stats, now: float):
+        if self._pending_swap is None:
+            return params
+        at_step, new_params, source = self._pending_swap
+        if at_step is not None and nstep < at_step:
+            return params
+        self._pending_swap = None
+        self._validate_swap_avals(params, new_params)
+        stats.swaps += 1
+        if tel is not None:
+            tel.on_swap(nstep, now, source=source)
+        return new_params
 
     # --- sampling tail -------------------------------------------------------
 
@@ -421,9 +497,17 @@ class ServingEngine:
             # iteration's tokens must not be divided by a window that
             # started after they were produced
             tel.maybe_window(now(), sched)
-        with flush_scope:
-            self._serve_loop(params, key, sched, tel, stats, now, wall,
-                             pool)
+        try:
+            with flush_scope:
+                self._serve_loop(params, key, sched, tel, stats, now,
+                                 wall, pool)
+        finally:
+            # a deferred swap this run never applied does NOT survive
+            # into a later serve() call — clean return OR mid-run
+            # exception — silently hot-swapping a stale checkpoint into
+            # an unrelated run (or raising its aval error there) would
+            # be worse than dropping it; stats.swaps==0 is the tell
+            self._pending_swap = None
         self.last_stats = stats
         return sched.completed
 
@@ -431,6 +515,11 @@ class ServingEngine:
         nstep = 0
         policy = sched.policy
         while not sched.idle():
+            # weight hot-swap lands HERE, between dispatch steps: a
+            # contents-only params replacement (avals validated), so
+            # neither jitted program retraces and in-flight requests
+            # continue on their existing cache
+            params = self._maybe_swap(params, nstep, tel, stats, now())
             sched.admit(now())
             did_work = False
             # the SLO policy widens the prefill share under queue

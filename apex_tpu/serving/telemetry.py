@@ -55,9 +55,11 @@ from apex_tpu.monitor.histogram import StreamingHistogram
 __all__ = ["ServeTelemetry"]
 
 # lifecycle phases, in order (evict fires on preemption: the request
-# releases its blocks and re-queues for evict-and-recompute)
+# releases its blocks and re-queues for evict-and-recompute; swap is an
+# ENGINE-level transition, rid -1 — a weight hot-swap landed between
+# dispatch steps)
 PHASES = ("submit", "admit", "prefill_chunk", "first_token", "decode",
-          "finish", "evict")
+          "finish", "evict", "swap")
 
 
 class _InFlight:
@@ -158,6 +160,8 @@ class ServeTelemetry:
         self.resumes = 0
         self.prefix_hit_requests = 0
         self.prefix_miss_requests = 0
+        # weight hot-swaps applied between dispatch steps (ISSUE 14)
+        self.swaps = 0
 
         self._win_t0: Optional[float] = None
         self._win_tokens = 0
@@ -248,6 +252,19 @@ class ServeTelemetry:
         self._emit("serve_event", rid=req.rid, phase="decode", at_s=now,
                    slot=int(slot), blocks_held=int(blocks_held),
                    step=int(step), resumed=True)
+        self.overhead_ns += time.perf_counter_ns() - t
+
+    def on_swap(self, step: int, now: float,
+                source: Optional[str] = None) -> None:
+        """A weight hot-swap landed between dispatch steps (rid -1:
+        engine-level, like straggler events). ``source`` names where
+        the weights came from (e.g. the checkpoint step directory)."""
+        t = time.perf_counter_ns()
+        self.swaps += 1
+        fields = dict(rid=-1, phase="swap", at_s=now, step=int(step))
+        if source:
+            fields["swap_source"] = str(source)
+        self._emit("serve_event", **fields)
         self.overhead_ns += time.perf_counter_ns() - t
 
     def on_blocked(self, why: str, n: int = 1) -> None:
@@ -554,6 +571,7 @@ class ServeTelemetry:
             preemptions=getattr(scheduler, "preemptions",
                                 self.preemptions),
             recompute_tokens=getattr(scheduler, "recompute_tokens", 0),
+            swaps=self.swaps,
             blocks_resident=resident,
             serve_anomaly=self.anomaly_section(allocator),
             admission_blocked_slots=self.admission_blocked_slots,

@@ -104,7 +104,10 @@ def test_inference_doc_covers_serving_contract():
                    "Optimistic FCFS admission", "evict-and-recompute",
                    "prefix_hit_ttft_p50_ms", "prefix_hit_rate",
                    "preemptions", "churn_parity", "SLOPolicy",
-                   "trace_seed", "num_resident"):
+                   "trace_seed", "num_resident",
+                   # ISSUE 14: the weight hot-swap contract
+                   "request_swap", "contents-only mutation",
+                   "restore_params", "swap", "pinned at 1"):
         assert needle in text, f"inference.md dropped {needle}"
 
 
@@ -142,8 +145,37 @@ def test_guide_covers_the_ladder():
                    "zigzag_shard", "distributed_fused_adam",
                    # ISSUE 12: the "choosing a plan" chapter
                    "ParallelPlan", "search_plans", "bench.py --plan",
-                   "planned_gpt_step", "predicted_vs_measured_err_pct"):
+                   "planned_gpt_step", "predicted_vs_measured_err_pct",
+                   # ISSUE 14: the checkpoint/resume chapter
+                   "ZeroCheckpointManager", "gather_zero_state",
+                   "scatter_zero_state", "restore_params",
+                   "bench.py --ckpt", "save_overhead_pct"):
         assert needle in text, f"guide dropped {needle}"
+
+
+def test_ckpt_api_blocks_execute_in_order():
+    """docs/api/ckpt.md: sharded save → bitwise same-dp restore →
+    elastic dp-resize → manager rotation, one namespace, runnable on
+    the virtual CPU mesh."""
+    blocks = _doc_blocks("api", "ckpt.md")
+    assert len(blocks) >= 3, "ckpt.md lost its worked examples"
+    ns = _exec_blocks(blocks, "ckpt.md")
+    assert ns["restored4"].count == 3
+
+
+def test_ckpt_doc_covers_the_contract():
+    path = os.path.join(os.path.dirname(__file__), "..", "docs", "api",
+                        "ckpt.md")
+    text = open(path).read()
+    for needle in ("save_zero_sharded", "load_zero_state",
+                   "gather_zero_state", "scatter_zero_state",
+                   "restore_zero_shard", "restore_params",
+                   "manifest", "digest", "atomic", "pad", "bitwise",
+                   "elastic", "ZeroCheckpointManager", "max_to_keep",
+                   "check_and_save_sharded", "bench.py --ckpt",
+                   "save_overhead_pct", "SKIP", "hot-swap",
+                   "never a deep reshape traceback"):
+        assert needle in text, f"ckpt.md dropped {needle}"
 
 
 def test_plan_api_blocks_execute_in_order():

@@ -1226,6 +1226,166 @@ class TestServingTier2:
         assert any(heads.count(h) >= 2 for h in set(heads))
 
 
+class TestHotSwap:
+    """Serving weight hot-swap (ISSUE 14): a new checkpoint's params
+    load into a live engine BETWEEN dispatch steps as a contents-only
+    mutation — stable avals, both jit caches pinned at 1, in-flight
+    requests finish token-identically to a no-swap baseline when the
+    weights are equal, and the ``swap`` lifecycle event rides
+    ``ServeTelemetry``."""
+
+    def _reqs(self, n=3, max_new=10):
+        rng = np.random.default_rng(5)
+        return [_req(rng, i, max_prompt=20, max_new=max_new)
+                for i in range(n)]
+
+    def _serve(self, tiny, swap_params=None, at_step=None, reqs=None,
+               telemetry=None):
+        model, params = tiny
+        eng = ServingEngine(model, num_slots=2, block_size=8,
+                            prefill_chunk=8, max_seq_len=64)
+        if swap_params is not None:
+            eng.request_swap(swap_params, at_step=at_step,
+                             source="test-ckpt")
+        done = eng.serve(params, reqs if reqs is not None
+                         else self._reqs(), telemetry=telemetry)
+        return eng, {r.rid: list(r.tokens) for r in done}
+
+    def test_equal_weights_swap_is_token_identical_and_pinned(self, tiny):
+        """THE acceptance witness: mid-flight swap of EQUAL weights —
+        streams token-identical to the no-swap run, caches at 1."""
+        _, params = tiny
+        reqs_a, reqs_b = self._reqs(), self._reqs()
+        eng0, base = self._serve(tiny, reqs=reqs_a)
+        clone = jax.tree.map(lambda x: jnp.array(x), params)
+        eng1, swapped = self._serve(tiny, swap_params=clone, at_step=5,
+                                    reqs=reqs_b)
+        assert base == swapped
+        assert eng1.last_stats.swaps == 1
+        for eng in (eng0, eng1):
+            assert eng.prefill_chunk._cache_size() == 1
+            assert eng.decode_step._cache_size() == 1
+
+    def test_different_weights_actually_apply(self, tiny):
+        """The swap is not a no-op: perturbed weights change the tokens
+        generated AFTER the swap point (deterministic greedy decode —
+        no flake surface)."""
+        model, params = tiny
+        jolted = jax.tree.map(lambda x: x + 0.5, params)
+        reqs_a = [Request(rid=0, prompt=np.zeros(4, np.int32),
+                          max_new_tokens=12)]
+        reqs_b = [Request(rid=0, prompt=np.zeros(4, np.int32),
+                          max_new_tokens=12)]
+        _, base = self._serve(tiny, reqs=reqs_a)
+        eng, swapped = self._serve(tiny, swap_params=jolted, at_step=4,
+                                   reqs=reqs_b)
+        assert eng.last_stats.swaps == 1
+        assert base != swapped  # the new weights really serve
+        assert eng.decode_step._cache_size() == 1  # still no retrace
+
+    def test_unreached_swap_is_dropped_not_leaked(self, tiny):
+        """A deferred swap whose at_step the run never reaches must NOT
+        survive into a later serve() call on the same engine — dropped
+        at drain, with stats.swaps == 0 as the tell."""
+        model, params = tiny
+        eng = ServingEngine(model, num_slots=2, block_size=8,
+                            prefill_chunk=8, max_seq_len=64)
+        jolted = jax.tree.map(lambda x: x + 1.0, params)
+        eng.request_swap(jolted, at_step=10_000)
+        done = eng.serve(params, [Request(
+            rid=0, prompt=np.zeros(4, np.int32), max_new_tokens=3)])
+        assert eng.last_stats.swaps == 0
+        assert eng._pending_swap is None  # dropped, not deferred
+        # a later run on the same engine serves the ORIGINAL weights
+        want = [list(r.tokens) for r in done]
+        done2 = eng.serve(params, [Request(
+            rid=0, prompt=np.zeros(4, np.int32), max_new_tokens=3)])
+        assert eng.last_stats.swaps == 0
+        assert [list(r.tokens) for r in done2] == want
+        # the drop survives a MID-RUN exception too (exception-safety
+        # of the documented contract): a crashed serve must not leave
+        # the stale swap armed for the next call
+        eng.request_swap(jolted, at_step=10_000)
+        real_decode = eng.decode_step
+
+        def boom(*a, **k):
+            raise RuntimeError("injected mid-serve failure")
+
+        eng.decode_step = boom
+        try:
+            with pytest.raises(RuntimeError, match="injected"):
+                eng.serve(params, [Request(
+                    rid=0, prompt=np.zeros(4, np.int32),
+                    max_new_tokens=3)])
+        finally:
+            eng.decode_step = real_decode
+        assert eng._pending_swap is None
+
+    def test_swap_event_rides_telemetry_and_record(self, tiny, tmp_path):
+        import io as _io
+
+        from apex_tpu.monitor.report import (format_serve_timeline,
+                                             serve_timeline)
+        from apex_tpu.serving.telemetry import ServeTelemetry
+
+        _, params = tiny
+        stream = _io.StringIO()
+        monitor.enable(stream=stream)
+        try:
+            tel = ServeTelemetry(slots=2, status="SKIP",
+                                 reason="cpu smoke")
+            clone = jax.tree.map(lambda x: jnp.array(x), params)
+            self._serve(tiny, swap_params=clone, at_step=3,
+                        telemetry=tel)
+        finally:
+            monitor.disable()
+        lines = stream.getvalue().splitlines()
+        assert monitor.validate_jsonl(lines) == []
+        recs = [json.loads(l) for l in lines]
+        swaps = [r for r in recs if r.get("phase") == "swap"]
+        assert len(swaps) == 1
+        assert swaps[0]["rid"] == -1
+        assert swaps[0]["swap_source"] == "test-ckpt"
+        assert swaps[0]["step"] >= 3
+        assert tel.swaps == 1
+        assert tel.final_fields()["swaps"] == 1
+        # the timeline renders the swap instead of dropping it
+        tl = serve_timeline(recs)
+        assert len(tl["swaps"]) == 1
+        assert "hot-swapped" in format_serve_timeline(tl)
+
+    def test_aval_mismatch_is_eager_and_leaf_named(self, tiny):
+        model, params = tiny
+        eng = ServingEngine(model, num_slots=2, block_size=8,
+                            prefill_chunk=8, max_seq_len=64)
+        bad = dict(params)
+        bad["lnf_w"] = jnp.zeros((params["lnf_w"].shape[0] + 1,))
+        eng.request_swap(bad)
+        with pytest.raises(ValueError, match=r"lnf_w"):
+            eng.serve(params, [Request(rid=0,
+                                       prompt=np.zeros(4, np.int32),
+                                       max_new_tokens=2)])
+        # dtype drift is named too
+        eng2 = ServingEngine(model, num_slots=2, block_size=8,
+                             prefill_chunk=8, max_seq_len=64)
+        bad2 = dict(params)
+        bad2["lnf_w"] = params["lnf_w"].astype(jnp.bfloat16)
+        eng2.request_swap(bad2)
+        with pytest.raises(ValueError, match="bfloat16"):
+            eng2.serve(params, [Request(rid=0,
+                                        prompt=np.zeros(4, np.int32),
+                                        max_new_tokens=2)])
+        # structure drift names the added/missing keys
+        eng3 = ServingEngine(model, num_slots=2, block_size=8,
+                             prefill_chunk=8, max_seq_len=64)
+        bad3 = dict(params, extra_head=jnp.zeros((2,)))
+        eng3.request_swap(bad3)
+        with pytest.raises(ValueError, match="extra_head"):
+            eng3.serve(params, [Request(rid=0,
+                                        prompt=np.zeros(4, np.int32),
+                                        max_new_tokens=2)])
+
+
 class TestServeRecord:
     def test_emit_serve_roundtrip_report_and_validator(self, tmp_path):
         path = tmp_path / "events.jsonl"

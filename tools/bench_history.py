@@ -58,7 +58,11 @@ _THROUGHPUT_KINDS = ("serve", "decode", "tp_overlap", "pipeline")
 
 # metrics where a BIGGER fresh value is the regression, gated in
 # ABSOLUTE points (error series — the reference may legitimately be ~0)
-_LOWER_IS_BETTER = {"plan_predicted_vs_measured_err_pct"}
+_LOWER_IS_BETTER = {"plan_predicted_vs_measured_err_pct",
+                    # async checkpointing's per-step cost: already a
+                    # percentage of a step, and a healthy async saver
+                    # sits near 0 — percent-drift against ~0 is noise
+                    "ckpt_save_overhead_pct"}
 
 # lower-is-better metrics gated by PERCENT drift (latency series: the
 # prefix-hit TTFT p50 must not creep up across the trajectory — the
@@ -116,6 +120,18 @@ def extract_all(obj: Dict[str, Any], label: str = "artifact"
                 f"{label}: OK plan record has no numeric "
                 "predicted_vs_measured_err_pct")
         return [("plan_predicted_vs_measured_err_pct", float(v), 0.0)]
+    if kind == "ckpt":
+        # the checkpoint leg's gated series is its measured per-step
+        # save overhead — lower-is-better in absolute points (a clean
+        # async saver's reference is ~0%, so percent drift is undefined)
+        if obj.get("status") == "SKIP":
+            return []
+        v = obj.get("save_overhead_pct")
+        if not isinstance(v, (int, float)):
+            raise ValueError(
+                f"{label}: OK ckpt record has no numeric "
+                "save_overhead_pct")
+        return [("ckpt_save_overhead_pct", float(v), 0.0)]
     if kind is not None:
         return []  # other monitor records carry no headline number
     raise ValueError(
@@ -153,7 +169,7 @@ def load_json(path: str) -> Any:
             if isinstance(obj, dict) and (
                     "metric" in obj
                     or obj.get("kind") in _THROUGHPUT_KINDS
-                    or obj.get("kind") == "plan"):
+                    or obj.get("kind") in ("plan", "ckpt")):
                 claimed = obj
         if last is None:
             raise ValueError(f"{path}: empty file")

@@ -12,6 +12,7 @@ Usage::
     python tools/validate_metrics.py --pipeline pipeline.jsonl ...
     python tools/validate_metrics.py --static-cost static_cost.jsonl ...
     python tools/validate_metrics.py --plan plan.jsonl ...
+    python tools/validate_metrics.py --ckpt ckpt.jsonl ...
 
 Dispatch is by content, not extension:
 
@@ -58,13 +59,17 @@ Dispatch is by content, not extension:
   and ``plan`` records (``python bench.py --plan``: the auto-
   parallelism planner's searched ranking + chosen ParallelPlan +
   predicted-vs-measured error — plan objects and ranking rows are
-  closed schemas, so a junk key fails)
+  closed schemas, so a junk key fails), and ``ckpt`` records
+  (``python bench.py --ckpt``: the elastic-checkpoint save-cost leg —
+  its ``manifest`` section is a closed schema, so a junk manifest key
+  fails)
   dispatch on ``kind`` like every monitor record. ``--profile`` /
   ``--serve`` / ``--serve-window`` / ``--pipeline`` / ``--costdb`` /
-  ``--static-cost`` / ``--plan`` force EVERY listed file to be judged
-  as that artifact (same rationale as ``--lint-report``: an artifact
-  that lost its ``kind`` key must fail as a bad profile/serve/
-  pipeline/costdb/static_cost/plan, not as an unrecognized shape).
+  ``--static-cost`` / ``--plan`` / ``--ckpt`` force EVERY listed file
+  to be judged as that artifact (same rationale as ``--lint-report``:
+  an artifact that lost its ``kind`` key must fail as a bad profile/
+  serve/pipeline/costdb/static_cost/plan/ckpt, not as an unrecognized
+  shape).
 
 Exit status 0 when every file is clean; 1 otherwise, with one problem per
 line on stderr. The logic lives in ``apex_tpu.monitor.schema`` so tests
@@ -203,10 +208,12 @@ def main(argv=None) -> int:
         force_kind = "static_cost"
     elif "--plan" in argv:
         force_kind = "plan"
+    elif "--ckpt" in argv:
+        force_kind = "ckpt"
     argv = [a for a in argv
             if a not in ("--lint-report", "--costdb", "--profile",
                          "--serve", "--serve-window", "--pipeline",
-                         "--static-cost", "--plan")]
+                         "--static-cost", "--plan", "--ckpt")]
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
