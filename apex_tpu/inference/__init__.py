@@ -16,6 +16,14 @@ star: "serves heavy traffic from millions of users"). Three pieces:
 * :func:`~apex_tpu.inference.sampling.sample_logits` — the sampling
   primitive.
 
+``DecodeEngine.generate(..., draft=...)`` speculates: a
+:class:`~apex_tpu.spec.drafter.Drafter` proposes a static k tokens per
+round, one ``spec_verify_step`` scores all k+1 positions, and the fused
+verify tail (:func:`apex_tpu.ops.fused_verify`) accepts the longest
+valid prefix — greedy output token-identical to ``draft=None``, with
+:class:`~apex_tpu.inference.engine.SpecStats` accounting acceptance
+(``bench.py --spec`` measures the speedup).
+
 The fused decode-attention op lives in
 :func:`apex_tpu.ops.decode_attention` (Pallas kernel + XLA fallback);
 the cached model math in :class:`apex_tpu.models.GPTModel`'s
@@ -30,5 +38,9 @@ block-pool cache, chunked prefill, fused sampling tail), which reuses
 this module's decode math and sampling primitives.
 """
 
-from apex_tpu.inference.engine import DecodeEngine, jit_encoder  # noqa: F401
+from apex_tpu.inference.engine import (  # noqa: F401
+    DecodeEngine,
+    SpecStats,
+    jit_encoder,
+)
 from apex_tpu.inference.sampling import sample_logits  # noqa: F401

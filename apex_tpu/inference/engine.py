@@ -33,15 +33,32 @@ prompt length (pad prompts to a few bucket lengths to bound that).
 from __future__ import annotations
 
 import functools
+from dataclasses import dataclass
 from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from apex_tpu.inference.sampling import sample_logits
 from apex_tpu.models.gpt import GPTModel
 from apex_tpu.monitor import spans as monitor_spans
-from apex_tpu.ops import fused_layer_norm
+from apex_tpu.ops import fused_layer_norm, fused_verify
+from apex_tpu.ops.pallas.attention import NEG_INF
+
+
+@dataclass
+class SpecStats:
+    """Host-side accounting of one speculative ``generate`` call."""
+
+    rounds: int = 0
+    drafted: int = 0
+    accepted: int = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        """Accepted drafts / drafted tokens (0.0 before any round)."""
+        return self.accepted / self.drafted if self.drafted else 0.0
 
 
 class DecodeEngine:
@@ -89,6 +106,12 @@ class DecodeEngine:
         # (argnums: params=0, cache=1, tokens=2, pos=3, key=4)
         self.prefill = jax.jit(self._prefill)
         self.decode_step = jax.jit(self._decode_step, donate_argnums=(1,))
+        # the speculative round: k+1 tokens scored in one multi-token
+        # step + the fused verify tail; avals depend only on the static
+        # draft length k, so across rounds it compiles exactly once
+        self.spec_verify_step = jax.jit(self._spec_verify_step,
+                                        donate_argnums=(1,))
+        self.last_spec_stats: Optional[SpecStats] = None
 
     # --- cache ---------------------------------------------------------------
 
@@ -197,13 +220,159 @@ class DecodeEngine:
         logits = model.unembed(params, x)[:, 0]
         return {"k": ck, "v": cv}, self._sample(logits, key), logits
 
+    # --- speculative verification --------------------------------------------
+
+    def _spec_verify_step(self, params, cache, tokens, pos, drafted, key):
+        """One speculative round: score ``tokens`` (1, k+1) — the
+        pending sampled token followed by the k drafted continuations —
+        in ONE multi-token step at cache rows [pos, pos+k], then run the
+        fused verify-and-sample tail. Returns ``(cache, accept_len (1,),
+        next_token (1,))``. The cache holds all k+1 rows' k/v on return;
+        rows past the accepted frontier are rejected-draft garbage that
+        the NEXT round's length masking hides and its writes overwrite —
+        length masking IS the rewind on a contiguous cache. Avals depend
+        only on the static k: one executable across every round."""
+        with monitor_spans.span("spec_verify"):
+            return self._spec_verify_body(params, cache, tokens, pos,
+                                          drafted, key)
+
+    def _spec_verify_body(self, params, cache, tokens, pos, drafted, key):
+        model, c = self.model, self.config
+        b, K1 = tokens.shape
+        d = c.head_dim
+        h_kv, group = c.local_kv_heads, c.local_heads // c.local_kv_heads
+        pos = jnp.asarray(pos, jnp.int32)
+        positions = pos + jnp.arange(K1, dtype=jnp.int32)
+        x = model.embedding(params["embedding"], tokens)  # (1, K1, H)
+        ptab = params["pos_embedding"]
+        x = x + jnp.take(ptab, jnp.minimum(positions, ptab.shape[0] - 1),
+                         axis=0)[None]
+        ck, cv = cache["k"], cache["v"]
+        scale = 1.0 / d ** 0.5
+        js = jnp.arange(self.max_s, dtype=jnp.int32)
+        # prefix-causal per drafted row: row i sees keys [0, pos + i]
+        mask = js[None, None, None, :] <= positions[None, None, :, None]
+        zero = jnp.int32(0)
+        for i in range(c.num_layers):
+            layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+            h_in = fused_layer_norm(x, layer["ln1_w"], layer["ln1_b"])
+            q, k, v = model._proj_qkv_bshd(layer, h_in)
+            # one contiguous K1-row write at the traced frontier (the
+            # multi-token sibling of the decode step's single-row write)
+            ck = jax.lax.dynamic_update_slice(
+                ck, k.transpose(0, 2, 1, 3)[None].astype(ck.dtype),
+                (jnp.int32(i), zero, zero, pos, zero))
+            cv = jax.lax.dynamic_update_slice(
+                cv, v.transpose(0, 2, 1, 3)[None].astype(cv.dtype),
+                (jnp.int32(i), zero, zero, pos, zero))
+            # K1 queries × the full cache — the flash multi-token
+            # scoring shape (the prefill-chunk attention at chunk=k+1)
+            k_all, v_all = ck[i][0], cv[i][0]  # (h_kv, max_s, d)
+            qg = q[0].reshape(K1, h_kv, group, d).transpose(1, 2, 0, 3)
+            s = jnp.einsum("hgcd,hsd->hgcs", qg, k_all.astype(qg.dtype),
+                           preferred_element_type=jnp.float32) * scale
+            s = jnp.where(mask[0], s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("hgcs,hsd->hgcd", p.astype(v_all.dtype),
+                             v_all)
+            ctx = ctx.transpose(2, 0, 1, 3).reshape(1, K1, c.local_heads,
+                                                    d)
+            x = x + model._proj_attn_out(layer, ctx)
+            x = x + model._mlp(layer, fused_layer_norm(
+                x, layer["ln2_w"], layer["ln2_b"]))
+        x = fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
+        logits = model.unembed(params, x)  # (1, K1, V)
+        a, nxt = fused_verify(logits, drafted, key,
+                              temperature=self.temperature,
+                              top_k=self.top_k)
+        return {"k": ck, "v": cv}, a, nxt
+
+    def _generate_spec(self, params, prompt, max_new_tokens, key, draft):
+        """The speculative driver behind ``generate(draft=...)``."""
+        from apex_tpu.spec.drafter import validate_drafter
+
+        b, s = prompt.shape
+        if b != 1:
+            raise ValueError(
+                f"draft= speculative generation runs batch 1 (accepted "
+                f"lengths diverge across rows, and the contiguous cache "
+                f"carries one scalar position); got batch {b} — split "
+                f"the batch, or serve it through ServingEngine.serve("
+                f"draft=...) which speculates per slot")
+        if getattr(self.model, "decode_rel_bias", None) is not None:
+            # the k+1-row spec scoring does not thread the bucketed
+            # relative bias the plain decode step applies — verifying
+            # biased baseline logits against unbiased spec logits would
+            # silently break the token-identical contract
+            raise ValueError(
+                "draft= speculative decoding cannot run a model with a "
+                "decode relative-position bias (the spec verify step "
+                "does not carry the bucketed bias) — generate with "
+                "draft=None for this model")
+        K = validate_drafter(draft, self.config,
+                             needed_rows=s + max_new_tokens
+                             + getattr(draft, "k", 1))
+        # the deepest row a round can touch: the last round starts at
+        # most at pos = s + max_new - 2 and writes rows pos..pos+K
+        if s + max_new_tokens + K - 1 > self.max_s:
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({max_new_tokens}) + "
+                f"draft.k ({K}) - 1 exceeds the cache ({self.max_s}): a "
+                f"spec round writes k draft rows past the live frontier "
+                f"— raise max_seq_len or lower draft.k")
+        if s + max_new_tokens + K - 1 > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({max_new_tokens}) + "
+                f"draft.k ({K}) - 1 steps past the model's position "
+                f"table ({self.config.max_seq_len}); drafted rows hold "
+                f"real positions too — lower draft.k or the request")
+        cache, tok, _ = self.prefill(params, prompt,
+                                     jax.random.fold_in(key, 0))
+        stats = SpecStats()
+        gen = [int(jnp.asarray(tok)[0])]
+        context = [int(t) for t in jnp.asarray(prompt)[0]] + gen
+        while len(gen) < max_new_tokens:
+            drafted = np.asarray(
+                draft.propose(0, context), np.int32).reshape(-1)
+            if drafted.shape != (K,):
+                raise ValueError(
+                    f"drafter proposed {drafted.shape} tokens; the "
+                    f"contract is exactly k={K} per round (static k "
+                    f"keeps the verify program compiled once)")
+            pos = s + len(gen) - 1
+            cache, a, nxt = self.spec_verify_step(
+                params, cache,
+                jnp.asarray([[gen[-1], *drafted]], jnp.int32),
+                jnp.int32(pos), jnp.asarray(drafted[None]),
+                jax.random.fold_in(key, 1 + stats.rounds))
+            a = int(jnp.asarray(a)[0])
+            emitted = [int(t) for t in drafted[:a]] \
+                + [int(jnp.asarray(nxt)[0])]
+            gen.extend(emitted)
+            context.extend(emitted)
+            stats.rounds += 1
+            stats.drafted += K
+            stats.accepted += a
+        draft.release(0)
+        self.last_spec_stats = stats
+        return jnp.asarray([gen[:max_new_tokens]], jnp.int32)
+
     # --- generation loop -----------------------------------------------------
 
     def generate(self, params, prompt, max_new_tokens: int,
-                 key: Optional[jax.Array] = None) -> jax.Array:
+                 key: Optional[jax.Array] = None,
+                 draft=None) -> jax.Array:
         """Greedy/sampled continuation: prompt (b, s) int32 → generated
         tokens (b, max_new_tokens). Python-loop driver over the jit'd
-        steps; the loop body re-binds the donated cache each step."""
+        steps; the loop body re-binds the donated cache each step.
+
+        ``draft`` attaches a :class:`~apex_tpu.spec.drafter.Drafter`
+        for speculative decoding (batch 1): each round the drafter
+        proposes k tokens, ONE ``spec_verify_step`` scores all k+1
+        positions and the fused verify tail accepts the longest valid
+        prefix — greedy output token-identical to ``draft=None``, 1 to
+        k+1 tokens per target dispatch, acceptance accounted in
+        :attr:`last_spec_stats`."""
         b, s = prompt.shape
         if max_new_tokens < 1:
             raise ValueError(
@@ -226,6 +395,9 @@ class DecodeEngine:
             raise ValueError("temperature > 0 generation requires a key")
         if key is None:  # greedy: the key operand is ignored but keeps the
             key = jax.random.PRNGKey(0)  # step signature (and avals) fixed
+        if draft is not None:
+            return self._generate_spec(params, prompt, max_new_tokens,
+                                       key, draft)
         cache, tok, _ = self.prefill(params, prompt,
                                      jax.random.fold_in(key, 0))
         out = [tok]

@@ -29,7 +29,13 @@ suites used to assert with one-off walkers:
   cache, shared refcounted blocks in the table row, a non-zero resume
   frontier — all host bookkeeping, no device work): pool donated and
   rebound, single-chip bodies collective-free — PR 7's contract held
-  under serving tier 2's sharing machinery.
+  under serving tier 2's sharing machinery;
+* ``spec_verify`` / ``serve_decode_quantized`` — the speculative-
+  decoding round (k+1 drafted tokens scored + the fused verify tail in
+  one body) and the int8-KV decode step (quantize-on-write + in-pool
+  scale planes), each with the COW tables in play: pool donated and
+  rebound, collective-free — ISSUE 15's two new device programs under
+  the same contract set.
 
 Tracing the same programs also yields their
 :func:`~apex_tpu.lint.jaxpr_check.static_cost` reports — the planner's
@@ -629,6 +635,72 @@ def _build_serve_decode():
     if batch is None:
         raise RuntimeError(
             "serve entrypoint expected a live decode batch")
+    toks, lens = batch
+    tables = jnp.asarray(sched.tables.asarray())
+    return engine.decode_step, (params, pool, tables,
+                                jnp.asarray(toks), jnp.asarray(lens),
+                                jr.PRNGKey(0))  # apexlint: disable=APX502
+
+
+_SPEC_K = 2  # smoke-scale draft length: the verify program's static k
+
+
+@register(
+    "spec_verify",
+    "serving speculative round: k+1 drafted tokens scored + fused "
+    "verify tail, COW tables in play, draft rows reserved past the "
+    "frontier (pool donated+rebound, collective-free)",
+    lambda: [jc.donation_honored(), jc.donation_rebound(),
+             jc.collective_free_region("", region="spec verify body")])
+def _build_spec_verify():
+    import jax.random as jr
+    import numpy as np
+
+    engine, params, jnp = _serving_engine()
+    sched, _, _ = _cow_scheduler(engine)
+    pool = engine.init_pool()
+    # the REAL spec-round operands: the decode batch with the k draft
+    # rows reserved (the lookahead allocation note_spec later rewinds),
+    # shared prefix blocks in the table, dead slots riding 0s
+    batch = sched.decode_batch(0.0, lookahead=_SPEC_K)
+    if batch is None:
+        raise RuntimeError(
+            "spec_verify entrypoint expected a live decode batch")
+    toks, lens = batch
+    S = engine.num_slots
+    drafted = np.zeros((S, _SPEC_K), np.int32)
+    tok_mat = np.zeros((S, _SPEC_K + 1), np.int32)
+    tok_mat[:, 0] = toks
+    tables = jnp.asarray(sched.tables.asarray())
+    return engine.spec_step, (params, pool, tables,
+                              jnp.asarray(tok_mat), jnp.asarray(lens),
+                              jnp.asarray(drafted),
+                              jr.PRNGKey(0))  # apexlint: disable=APX502
+
+
+@register(
+    "serve_decode_quantized",
+    "serving paged decode step over the INT8 block pool (quantize-on-"
+    "write + per-block-row scale planes, COW tables in play; pool "
+    "donated+rebound, collective-free)",
+    lambda: [jc.donation_honored(), jc.donation_rebound(),
+             jc.collective_free_region(
+                 "", region="quantized serving decode body")])
+def _build_serve_decode_quantized():
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from apex_tpu.serving import ServingEngine
+
+    model, params = _gpt_smoke_model()
+    engine = ServingEngine(model, num_slots=4, block_size=32,
+                           kv_dtype="int8")
+    sched, _, _ = _cow_scheduler(engine)
+    pool = engine.init_pool()
+    batch = sched.decode_batch(0.0)
+    if batch is None:
+        raise RuntimeError(
+            "quantized serve entrypoint expected a live decode batch")
     toks, lens = batch
     tables = jnp.asarray(sched.tables.asarray())
     return engine.decode_step, (params, pool, tables,

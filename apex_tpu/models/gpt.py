@@ -735,7 +735,7 @@ class GPTModel:
         return q[:, 0], k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
 
     def decode_block(self, p, x, q, k_lay, v_lay, lengths, rel_bias=None,
-                     block_tables=None):
+                     block_tables=None, kv_scales=None):
         """One token through one block against this layer's cache slices
         (ALREADY holding the token's own k/v row — the engine writes
         between :meth:`decode_qkv` and this call): x (b, 1, H) is the
@@ -748,11 +748,16 @@ class GPTModel:
         serving engine's paged-cache path — ``k_lay``/``v_lay`` are then
         the shared (num_blocks, h_kv, block_size, d) pool and the table
         maps each slot's logical kv blocks to pool blocks (see
-        :func:`apex_tpu.ops.decode_attention`). Returns the block
-        output (b, 1, H)."""
+        :func:`apex_tpu.ops.decode_attention`). ``kv_scales``: the int8
+        paged pool's ``(k_scale, v_scale)`` per-row dequantization
+        factors (the serving engine's ``kv_dtype="int8"`` knob).
+        Returns the block output (b, 1, H)."""
         from apex_tpu.ops import decode_attention
+        k_scale, v_scale = kv_scales if kv_scales is not None else (None,
+                                                                    None)
         ctx = decode_attention(q, k_lay, v_lay, lengths, bias=rel_bias,
-                               block_tables=block_tables)
+                               block_tables=block_tables,
+                               k_scale=k_scale, v_scale=v_scale)
         x = x + self._proj_attn_out(p, ctx[:, None])
         m = self._mlp(p, fused_layer_norm(x, p["ln2_w"], p["ln2_b"]))
         return x + m

@@ -60,6 +60,7 @@ from apex_tpu.monitor.registry import (  # noqa: F401
     emit_profile,
     emit_serve,
     emit_serve_window,
+    emit_spec,
     emit_tp_overlap,
     enable,
     enable_from_env,

@@ -317,6 +317,13 @@ class MetricsRegistry:
         bitwise and elastic resume witnesses (:mod:`apex_tpu.ckpt`)."""
         return self._emit_status_record("ckpt", status, **fields)
 
+    def emit_spec(self, status: str, **fields) -> Dict[str, Any]:
+        """Speculative-decoding bench record (``bench.py --spec``):
+        tokens/s/request with a drafter vs the non-speculative baseline
+        (batch 1 and under churn), acceptance rate, and the int8-KV
+        quantization leg's bounded logit error vs the float oracle."""
+        return self._emit_status_record("spec", status, **fields)
+
     # -- step lifecycle ------------------------------------------------------
 
     def begin_step(self, step: Optional[int] = None) -> None:
@@ -546,6 +553,13 @@ def emit_ckpt(status: str, **fields) -> Optional[Dict[str, Any]]:
     r = _REGISTRY
     if r is not None:
         return r.emit_ckpt(status, **fields)
+    return None
+
+
+def emit_spec(status: str, **fields) -> Optional[Dict[str, Any]]:
+    r = _REGISTRY
+    if r is not None:
+        return r.emit_spec(status, **fields)
     return None
 
 

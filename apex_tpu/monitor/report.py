@@ -75,6 +75,7 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     pipeline_records = []
     plan_records = []
     ckpt_records = []
+    spec_records = []
     schedule = None
     for rec in records:
         kind = rec.get("kind")
@@ -102,6 +103,8 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             plan_records.append(rec)
         elif kind == "ckpt":
             ckpt_records.append(rec)
+        elif kind == "spec":
+            spec_records.append(rec)
         elif kind == "event" and rec.get("name") == "pipeline_schedule":
             schedule = rec
 
@@ -300,6 +303,17 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                            "restore_ms", "bytes_written", "steps",
                            "saves", "save_every", "dp", "async_save",
                            "bitwise_resume_ok", "elastic_resume_ok"))
+
+    if spec_records:
+        summary["spec"] = status_summary(
+            spec_records, ("tokens_per_s_request",
+                           "baseline_tokens_per_s_request", "speedup",
+                           "tokens_per_s_churn", "speedup_churn",
+                           "acceptance_rate", "accepted_per_round",
+                           "rounds", "draft_k", "drafter", "kv_dtype",
+                           "kv_quant_logit_err", "greedy_parity",
+                           "churn_parity", "jit_cache_ok",
+                           "spread_pct"))
 
     if gate_records:
         summary["gates"] = [
@@ -645,6 +659,31 @@ def render(summary: Dict[str, Any]) -> str:
             if tpo.get("skipped"):
                 parts.append("skipped: " + ", ".join(tpo["skipped"]))
             lines.append("  tp-overlap  " + "   ".join(parts))
+    spc = summary.get("spec")
+    if spc:
+        if spc.get("status") == "SKIP":
+            lines.append(f"  spec        SKIP({spc.get('reason', '?')})")
+        else:
+            parts = []
+            if isinstance(spc.get("tokens_per_s_request"), (int, float)):
+                parts.append(
+                    f"{spc['tokens_per_s_request']:.1f} tok/s/request")
+            if isinstance(spc.get("speedup"), (int, float)):
+                parts.append(f"{spc['speedup']:.2f}x vs non-spec")
+            if isinstance(spc.get("acceptance_rate"), (int, float)):
+                parts.append(
+                    f"accept {100 * spc['acceptance_rate']:.0f}%"
+                    + (f" (k={spc['draft_k']:g})"
+                       if isinstance(spc.get("draft_k"), (int, float))
+                       else ""))
+            if spc.get("drafter"):
+                parts.append(f"drafter {spc['drafter']}")
+            if isinstance(spc.get("kv_quant_logit_err"), (int, float)):
+                parts.append(
+                    f"int8-KV |Δlogit| {spc['kv_quant_logit_err']:.3g}")
+            if spc.get("skipped"):
+                parts.append("skipped: " + ", ".join(spc["skipped"]))
+            lines.append("  spec        " + "   ".join(parts))
     pl = summary.get("plan")
     if pl:
         parts = []

@@ -330,6 +330,18 @@ SERVE_SCHEMA = {
         "recompute_tokens": {"type": "integer"},  # re-prefilled rows
         "swaps": {"type": "integer"},         # weight hot-swaps applied
         "blocks_resident": {"type": "integer"},   # warm cache footprint
+        # speculative serving (ISSUE 15): per SLOT-round acceptance
+        # rolled up from the `spec` lifecycle events (present when spec
+        # rounds ran; slot×dispatch granularity — ServeStats.spec_rounds
+        # counts dispatches)
+        "spec_slot_rounds": {"type": "integer"},
+        "spec_drafted": {"type": "integer"},
+        "spec_accepted": {"type": "integer"},
+        "spec_acceptance_rate": _METRIC_VALUE,
+        "draft_k": {"type": "integer"},
+        # the pool's quantization knob, stamped by the engine at serve
+        # start (absent on float pools)
+        "kv_dtype": {"type": "string"},
         # greedy parity over the WHOLE churn sweep including
         # evicted-and-recomputed and prefix-hit requests
         "churn_parity": {"type": "boolean"},
@@ -379,7 +391,7 @@ SERVE_EVENT_SCHEMA = {
         "rid": {"type": "integer"},
         "phase": {"enum": ["submit", "admit", "prefill_chunk",
                            "first_token", "decode", "finish", "evict",
-                           "swap"]},
+                           "swap", "spec"]},
         "at_s": {"type": "number"},        # serve-clock transition time
         "slot": {"type": "integer"},
         "step": {"type": "integer"},       # engine dispatch counter
@@ -409,6 +421,10 @@ SERVE_EVENT_SCHEMA = {
         # checkpoint's params replaced the serving weights between
         # dispatch steps (contents-only; both jit caches stay at 1)
         "swap_source": {"type": "string"},     # swap: where weights came from
+        # speculative round (ISSUE 15): one record per slot per round —
+        # accepted_len of draft_k drafted tokens survived verification
+        "accepted_len": {"type": "integer"},
+        "draft_k": {"type": "integer"},
     },
     "required": ["schema", "kind", "rid", "phase", "at_s"],
 }
@@ -772,6 +788,54 @@ CKPT_SCHEMA = {
     "required": ["schema", "kind", "status"],
 }
 
+# speculative-decoding bench record (`python bench.py --spec`): the
+# two-factor decode-speed attack of ROADMAP item 3 measured as one
+# artifact — tokens/s/request with a drafter vs the non-speculative
+# baseline at batch 1 AND under scheduler churn, the acceptance rate
+# that explains the ratio, and the int8-KV quantization leg (pool
+# bytes halved, decode logit error vs the float parity oracle bounded
+# in the record). Same status semantics as decode/serve: "OK" (real
+# TPU) engages the honesty rule; off-TPU the record is an explicit
+# SKIP(reason) with the smoke measurements riding along — never nan in
+# an OK line. CLOSED schema: a junk key fails validation, not rides
+# along (the drift tests pin exactly that).
+SPEC_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["spec"]},
+        "status": {"enum": ["OK", "SKIP"]},
+        "reason": {"type": "string"},  # required when status == "SKIP"
+        "tokens_per_s_request": _METRIC_VALUE,   # spec decode, batch 1
+        "baseline_tokens_per_s_request": _METRIC_VALUE,
+        "speedup": _METRIC_VALUE,                # spec / baseline
+        "tokens_per_s_churn": _METRIC_VALUE,     # spec serve sweep
+        "baseline_tokens_per_s_churn": _METRIC_VALUE,
+        "speedup_churn": _METRIC_VALUE,
+        "acceptance_rate": _METRIC_VALUE,        # accepted / drafted
+        "accepted_per_round": _METRIC_VALUE,     # mean accepted_len
+        "rounds": {"type": "integer"},
+        "draft_k": {"type": "integer"},
+        "drafter": {"type": "string"},           # ngram | model
+        "kv_dtype": {"type": "string"},          # quantized leg's knob
+        "kv_quant_logit_err": _METRIC_VALUE,     # max |Δlogit| vs oracle
+        "kv_quant_pool_mb": _METRIC_VALUE,       # int8 pool footprint
+        "kv_oracle_pool_mb": _METRIC_VALUE,      # float oracle footprint
+        "greedy_parity": {"type": "boolean"},    # spec == baseline, b=1
+        "churn_parity": {"type": "boolean"},     # spec == baseline, serve
+        "jit_cache_ok": {"type": "boolean"},     # every body pinned at 1
+        "prompt_len": {"type": "integer"},
+        "new_tokens": {"type": "integer"},
+        "requests": {"type": "integer"},         # churn sweep size
+        "spread_pct": _METRIC_VALUE,
+        "pass_times_ms": {"type": "array", "items": {"type": "number"}},
+        "config": {"type": "object"},
+        "backend": {"type": "string"},
+    },
+    "required": ["schema", "kind", "status"],
+    "additionalProperties": False,
+}
+
 SCHEMAS_BY_KIND = {
     "step": STEP_SCHEMA,
     "meta": META_SCHEMA,
@@ -790,6 +854,7 @@ SCHEMAS_BY_KIND = {
     "static_cost": STATIC_COST_SCHEMA,
     "plan": PLAN_SCHEMA,
     "ckpt": CKPT_SCHEMA,
+    "spec": SPEC_SCHEMA,
 }
 
 # --- minimal JSON-Schema subset validator ------------------------------------
@@ -889,7 +954,7 @@ def validate(record: Dict[str, Any],
     # with a claim-free, reason-free skip)
     if (record.get("kind") in ("decode", "longseq_bias", "tp_overlap",
                                "profile", "serve", "pipeline",
-                               "serve_window", "plan", "ckpt")
+                               "serve_window", "plan", "ckpt", "spec")
             and record.get("status") == "SKIP"
             and not record.get("reason")):
         errors.append(

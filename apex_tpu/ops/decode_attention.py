@@ -44,14 +44,19 @@ def paged_kernel_ok(block_size: int, d: int, dtype) -> bool:
     return decode_kernel_ok(block_size, d, dtype)
 
 
-def _gather_blocks(pool, tables):
+def _gather_blocks(pool, tables, scale=None, out_dtype=None):
     """(num_blocks, h_kv, bs, d) pool + (b, nb) tables → the contiguous
     (b, h_kv, nb·bs, d) per-slot view — the XLA fallback materializes the
     indirection as one gather, then runs the EXACT contiguous math (so
     paged == contiguous is bitwise on this path, the parity tests'
-    anchor)."""
+    anchor). ``scale`` ((num_blocks, bs) fp32, the int8-pool path)
+    dequantizes the gathered view: int8 rows × per-row scales →
+    ``out_dtype``."""
     g = pool[tables]  # (b, nb, h_kv, bs, d)
     b, nb, h_kv, bs, d = g.shape
+    if scale is not None:
+        g = (g.astype(jnp.float32)
+             * scale[tables][:, :, None, :, None]).astype(out_dtype)
     return g.transpose(0, 2, 1, 3, 4).reshape(b, h_kv, nb * bs, d)
 
 
@@ -88,6 +93,8 @@ def decode_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, lengths: jax.Array,
     *, scale: Optional[float] = None, impl: str = "auto", bias=None,
     block_tables: Optional[jax.Array] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention of ONE query token per sequence over a KV cache.
 
@@ -124,6 +131,15 @@ def decode_attention(
     DMA runs but their columns are masked/skipped). The XLA fallback
     gathers the table into the contiguous view and runs the contiguous
     math, so paged == contiguous bitwise on that path.
+
+    ``k_scale``/``v_scale``: the INT8 paged pool (the serving engine's
+    ``kv_dtype="int8"`` knob) — ``k``/``v`` are then int8 pools and the
+    scales are ``(num_blocks, block_size)`` fp32 per-row dequantization
+    factors (shared across kv heads and head_dim: the write site
+    quantizes one token row at a time). The Pallas kernel dequantizes
+    each block IN VMEM after its (halved) HBM copy; the XLA fallback
+    dequantizes the gathered view and runs the standard math. Scales
+    are paged-path-only and required exactly when the pool is int8.
     """
     if q.ndim != 3 or k.ndim != 4 or k.shape != v.shape:
         raise ValueError(
@@ -131,9 +147,17 @@ def decode_attention(
             f"d) — or (num_blocks, h_kv, block_size, d) pools with "
             f"block_tables; got q {q.shape}, k {k.shape}, v {v.shape}")
     b, h, d = q.shape
+    if block_tables is None and (k_scale is not None
+                                 or k.dtype == jnp.int8):
+        raise ValueError(
+            "int8 k/v pools (and their k_scale/v_scale) are the PAGED "
+            "path only — pass block_tables (the serving engine's "
+            "kv_dtype knob; the contiguous DecodeEngine cache keeps a "
+            "float cache_dtype)")
     if block_tables is not None:
         return _paged_decode_attention(q, k, v, lengths, block_tables,
-                                       scale=scale, impl=impl, bias=bias)
+                                       scale=scale, impl=impl, bias=bias,
+                                       k_scale=k_scale, v_scale=v_scale)
     h_kv, max_s = k.shape[1], k.shape[2]
     if k.shape[0] != b or k.shape[3] != d or h % h_kv:
         raise ValueError(
@@ -191,11 +215,13 @@ def _validate_decode_bias(bias, h):
 
 
 def _paged_decode_attention(q, k, v, lengths, block_tables, *, scale,
-                            impl, bias):
+                            impl, bias, k_scale=None, v_scale=None):
     """The block-table indirection path: the pool layout + table resolve
     to the same logical (b, h_kv, nb·bs, d) cache the contiguous path
     reads — by one gather on the XLA fallback, by scalar-prefetched
-    index maps on the kernel path."""
+    index maps on the kernel path. An int8 pool rides the same
+    indirection with its (num_blocks, bs) scales (dequantized in-VMEM
+    in the kernel, post-gather on the fallback)."""
     b, h, d = q.shape
     num_blocks, h_kv, bs = k.shape[0], k.shape[1], k.shape[2]
     if k.shape[3] != d or h % h_kv:
@@ -213,6 +239,26 @@ def _paged_decode_attention(q, k, v, lengths, block_tables, *, scale,
             f"{block_tables.dtype}")
     if lengths.shape != (b,):
         raise ValueError(f"lengths must be ({b},); got {lengths.shape}")
+    quant = k.dtype == jnp.int8
+    if quant != (k_scale is not None) or quant != (v_scale is not None):
+        raise ValueError(
+            "int8 pools require BOTH k_scale and v_scale (and float "
+            "pools take neither): the per-row scales are half the "
+            "quantized representation — got k dtype "
+            f"{k.dtype}, k_scale {'set' if k_scale is not None else 'None'}, "
+            f"v_scale {'set' if v_scale is not None else 'None'}")
+    if quant:
+        for name, sc in (("k_scale", k_scale), ("v_scale", v_scale)):
+            if sc.shape != (num_blocks, bs):
+                raise ValueError(
+                    f"{name} must be (num_blocks={num_blocks}, "
+                    f"block_size={bs}) per-row scales; got {sc.shape}")
+        if bias is not None:
+            raise ValueError(
+                "int8 paged decode does not carry the bucketed relative "
+                "bias (no quantized kernel path exists for the bias "
+                "composition) — serve T5-style models with a float "
+                "kv_dtype")
     lengths = lengths.astype(jnp.int32)
     group = h // h_kv
     scale = float(scale if scale is not None else 1.0 / d ** 0.5)
@@ -222,9 +268,12 @@ def _paged_decode_attention(q, k, v, lengths, block_tables, *, scale,
     ok = paged_kernel_ok(bs, d, q.dtype) and k.dtype != jnp.float16
     use_pallas = _backend.choose_impl(impl, ok) == "pallas"
     if not use_pallas:
-        return _xla_decode(qg, _gather_blocks(k, block_tables),
-                           _gather_blocks(v, block_tables), lengths,
-                           scale, bias).reshape(b, h, d)
+        out_dtype = qg.dtype if quant else None
+        return _xla_decode(
+            qg,
+            _gather_blocks(k, block_tables, k_scale, out_dtype),
+            _gather_blocks(v, block_tables, v_scale, out_dtype),
+            lengths, scale, bias).reshape(b, h, d)
     o = decode_attn_paged_fwd(
         qg.reshape(b * h_kv, group, d),
         k.reshape(num_blocks * h_kv, bs, d),
@@ -232,5 +281,6 @@ def _paged_decode_attention(q, k, v, lengths, block_tables, *, scale,
         jnp.repeat(lengths, h_kv),
         block_tables,
         scale=scale, rel_bias=rel_bias,
+        k_scale=k_scale, v_scale=v_scale,
         interpret=_backend.interpret_mode())
     return o.reshape(b, h, d)

@@ -1,0 +1,124 @@
+"""Fused speculative-decoding verification: k+1 logit rows + k drafted
+ids → (longest accepted prefix, corrected next token) in ONE tail.
+
+The op-level wrapper over :mod:`apex_tpu.ops.pallas.verify` following
+the house dispatch rule (:mod:`apex_tpu.ops._backend`): the Pallas
+kernel on TPU when the vocab tiles the lane dim, interpret-mode Pallas
+under ``APEX_TPU_PALLAS=interpret``, and an XLA composition otherwise.
+The XLA fallback calls the SAME module-level acceptance helpers the
+kernel body runs, so the two paths agree token-for-token on shared
+noise — the parity anchor ``tests/test_spec.py`` pins, the same
+discipline as :func:`apex_tpu.ops.fused_sample`.
+
+This is the speculative engines' verification tail (one fused dispatch
+per spec round, :class:`apex_tpu.inference.DecodeEngine` and
+:class:`apex_tpu.serving.ServingEngine`); the acceptance math is
+documented in :mod:`apex_tpu.ops.pallas.verify`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import _backend
+from apex_tpu.ops.pallas.verify import (NO_DRAFT, VERIFY_LANES,
+                                        fused_verify_fwd, verify_greedy,
+                                        verify_sampled)
+
+
+def verify_kernel_ok(vocab: int, dtype) -> bool:
+    """Mosaic eligibility: the vocab is the lane dim of every whole-row
+    reduction (same rule as the fused sampling tail); f16 has no Mosaic
+    support."""
+    return vocab % 128 == 0 and dtype != jnp.float16
+
+
+def _pad_lanes(x, fill):
+    """Pad the trailing dim of a (b, k+1) operand to ``VERIFY_LANES``
+    (one full lane tile — covers every k the drafters allow) for the
+    kernel's tiling; contents beyond k+1 are ignored."""
+    b, k1 = x.shape
+    if k1 >= VERIFY_LANES:
+        return x
+    return jnp.pad(x, ((0, 0), (0, VERIFY_LANES - k1)),
+                   constant_values=fill)
+
+
+def fused_verify(logits: jax.Array, drafted: jax.Array,
+                 key: Optional[jax.Array] = None, *,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, impl: str = "auto"
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Verify ``k`` drafted tokens against ``k+1`` target logit rows.
+
+    ``logits`` (b, k+1, V): row i is the target's distribution for the
+    token AFTER the prefix plus i accepted drafts (row k is the bonus
+    position when every draft is accepted). ``drafted`` (b, k) int32.
+    Returns ``(accept_len (b,), next_token (b,))`` int32: the longest
+    accepted draft prefix per row, and the corrected token sampled from
+    row ``accept_len`` — so one spec round emits
+    ``drafted[:accept_len] + [next_token]``, between 1 and k+1 tokens.
+
+    ``temperature == 0`` is exact greedy acceptance (the spec stream is
+    token-identical to non-speculative greedy decoding — the parity the
+    engines witness). ``temperature > 0`` is exact rejection-sampling
+    acceptance for point-mass (greedy) drafts under the same
+    temperature→top-k→top-p filtered distribution the fused sampling
+    tail draws from. All knobs are STATIC — they select the compiled
+    program, never retrace per round.
+
+    The uniform noise is drawn inside the caller's jit by ``jax.random``
+    and consumed by the kernel in the same program; kernel and XLA
+    fallback share it, so ``impl`` never changes the verdict.
+    """
+    if logits.ndim != 3:
+        raise ValueError(
+            f"fused_verify takes (b, k+1, V) logits; got {logits.shape}")
+    b, k1, V = logits.shape
+    if drafted.ndim != 2 or drafted.shape != (b, k1 - 1):
+        raise ValueError(
+            f"drafted must be (b={b}, k={k1 - 1}) to match the (b, k+1, "
+            f"V) logits; got {drafted.shape}")
+    if k1 < 2:
+        raise ValueError(
+            f"fused_verify needs k >= 1 drafted tokens (k+1 = {k1} logit "
+            f"rows); a 1-row verify is just sampling — use fused_sample")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    sampled = temperature > 0.0
+    if sampled and key is None:
+        raise ValueError(
+            "temperature > 0 verification requires a PRNG key")
+    # bonus row rides as NO_DRAFT: its accept flag is structurally False
+    drafted_pad = jnp.concatenate(
+        [drafted.astype(jnp.int32),
+         jnp.full((b, 1), NO_DRAFT, jnp.int32)], axis=1)
+    top_k = min(int(top_k), V)
+    u_acc = u_gum = None
+    if sampled:
+        ka, kg = jax.random.split(key)
+        tiny = jnp.finfo(jnp.float32).tiny  # (0, 1]: log(u) stays finite
+        u_acc = jax.random.uniform(ka, (b, k1), jnp.float32, minval=tiny,
+                                   maxval=1.0)
+        u_gum = jax.random.uniform(kg, (b, k1, V), jnp.float32,
+                                   minval=tiny, maxval=1.0)
+    ok = verify_kernel_ok(V, logits.dtype)
+    if _backend.choose_impl(impl, ok) == "pallas":
+        return fused_verify_fwd(
+            logits,
+            _pad_lanes(drafted_pad, NO_DRAFT),
+            None if u_acc is None else _pad_lanes(u_acc, 1.0),
+            u_gum, temperature=float(temperature), top_k=top_k,
+            top_p=float(top_p), interpret=_backend.interpret_mode())
+    if sampled:
+        return verify_sampled(logits, drafted_pad, u_acc, u_gum,
+                              temperature=float(temperature), top_k=top_k,
+                              top_p=float(top_p))
+    return verify_greedy(logits, drafted_pad)

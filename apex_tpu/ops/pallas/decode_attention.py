@@ -53,7 +53,7 @@ from apex_tpu.ops.pallas.attention import (_LSE_LANES, _REL_LANES, NEG_INF,
                                            relative_position_bucket)
 
 
-def _decode_kernel(*refs, scale, bk, nk, rel=None):
+def _decode_kernel(*refs, scale, bk, nk, rel=None, quant=False):
     """Online-softmax decode step for one (batch, kv-head) row.
 
     Grid (b·h_kv, nk): the kv axis is the ONLY sequential dim; scratch
@@ -66,10 +66,20 @@ def _decode_kernel(*refs, scale, bk, nk, rel=None):
     head-major table block: the query IS position ``kvlen - 1``, so
     rel_pos = col − (kvlen − 1) needs no extra operand beyond the table —
     the decode sibling of the flash kernels' ``rel_bias``.
+
+    ``quant`` (static): the k/v refs hold int8 rows and two extra
+    (1, bk) fp32 refs carry the per-row scales — the block dequantizes
+    IN VMEM right after its (halved) HBM→VMEM copy, so the decode
+    stream pays int8 bandwidth and fp32 math (the whole point of the
+    quantized pool: the kernel is HBM-bound, the bytes are the cost).
     """
     refs = list(refs)
     q_ref, k_ref, v_ref, len_ref = refs[:4]
     n = 4
+    ks_ref = vs_ref = None
+    if quant:
+        ks_ref, vs_ref = refs[n], refs[n + 1]
+        n += 2
     if rel is not None:
         rtab_ref = refs[n]
         n += 1
@@ -89,7 +99,12 @@ def _decode_kernel(*refs, scale, bk, nk, rel=None):
     @pl.when(j * bk < kvlen)
     def _step():
         q = q_ref[0]  # (group, d) — the kv group's query heads
-        k = k_ref[0]  # (bk, d)
+        if quant:
+            # in-VMEM dequantize: int8 block × per-row fp32 scale
+            k = k_ref[0].astype(jnp.float32) * ks_ref[0][:, None]
+            q = q.astype(jnp.float32)
+        else:
+            k = k_ref[0]  # (bk, d)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (group, bk)
@@ -112,9 +127,15 @@ def _decode_kernel(*refs, scale, bk, nk, rel=None):
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        if quant:
+            v = v_ref[0].astype(jnp.float32) * vs_ref[0][:, None]
+            acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+                p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
         m_scr[:] = m_new
 
     @pl.when(j == nk - 1)
@@ -178,17 +199,18 @@ def decode_attn_fwd(q, k, v, lengths, *, scale, rel_bias=None, bk=512,
     )(*args)
 
 
-def _paged_kernel(tbl_ref, *refs, scale, bk, nk, rel=None):
+def _paged_kernel(tbl_ref, *refs, scale, bk, nk, rel=None, quant=False):
     """Scalar-prefetch wrapper: the block table is consumed entirely by
     the index maps (it addresses the DMAs); the body never touches it —
     logical positions, masking and bias are exactly the contiguous
     kernel's."""
     del tbl_ref
-    _decode_kernel(*refs, scale=scale, bk=bk, nk=nk, rel=rel)
+    _decode_kernel(*refs, scale=scale, bk=bk, nk=nk, rel=rel, quant=quant)
 
 
 def decode_attn_paged_fwd(q, k_pool, v_pool, lengths, block_tables, *,
-                          scale, rel_bias=None, interpret=False):
+                          scale, rel_bias=None, k_scale=None,
+                          v_scale=None, interpret=False):
     """Paged decode attention: q ``(rows, group, d)`` with
     ``rows = b·h_kv``; ``k_pool``/``v_pool`` ``(num_blocks·h_kv, bs, d)``
     — the free reshape of the serving pool's ``(num_blocks, h_kv, bs,
@@ -202,6 +224,13 @@ def decode_attn_paged_fwd(q, k_pool, v_pool, lengths, block_tables, *,
 
     ``rel_bias`` as in :func:`decode_attn_fwd` (cols are logical
     positions, so the causal bucketed bias is indirection-oblivious).
+
+    ``k_scale``/``v_scale``: the int8-pool path — ``(num_blocks, bs)``
+    fp32 per-row scales riding their own scalar-prefetched index maps
+    (the SAME table lookup, minus the h_kv fold: scales are shared
+    across kv heads and head_dim); the kernel dequantizes each block in
+    VMEM, so the HBM stream is int8 (indirection-oblivious, like the
+    bucketed bias).
     """
     rows, group, d = q.shape
     b, nb = block_tables.shape
@@ -209,6 +238,7 @@ def decode_attn_paged_fwd(q, k_pool, v_pool, lengths, block_tables, *,
     bs = k_pool.shape[1]
     rel, rel_static = (None, None) if rel_bias is None else (
         rel_bias[0], rel_bias[1])
+    quant = k_scale is not None
 
     # index maps receive the prefetched table LAST; k/v maps translate
     # (row, j) -> pool row table[row // h_kv, j] * h_kv + row % h_kv
@@ -223,6 +253,12 @@ def decode_attn_paged_fwd(q, k_pool, v_pool, lengths, block_tables, *,
         pl.BlockSpec((1, 1, _LSE_LANES), lambda r, j, tbl: (r, 0, 0)),
     ]
     args = [q, k_pool, v_pool, _kvlen_rows(lengths, rows)]
+    if quant:
+        in_specs.append(pl.BlockSpec(
+            (1, bs), lambda r, j, tbl, hk=h_kv: (tbl[r // hk, j], 0)))
+        in_specs.append(pl.BlockSpec(
+            (1, bs), lambda r, j, tbl, hk=h_kv: (tbl[r // hk, j], 0)))
+        args.extend([k_scale, v_scale])
     if rel is not None:
         in_specs.append(pl.BlockSpec(
             (group, _REL_LANES),
@@ -242,7 +278,7 @@ def decode_attn_paged_fwd(q, k_pool, v_pool, lengths, block_tables, *,
     )
     return pl.pallas_call(
         functools.partial(_paged_kernel, scale=scale, bk=bs, nk=nb,
-                          rel=rel_static),
+                          rel=rel_static, quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((rows, group, d), q.dtype),
         compiler_params=pltpu.CompilerParams(

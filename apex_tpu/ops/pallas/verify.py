@@ -1,0 +1,183 @@
+"""Fused verify-and-sample Pallas kernel: k+1 target logit rows + k
+drafted tokens → (longest accepted prefix, corrected next token), one
+kernel.
+
+Speculative decoding's verification tail is, composed in XLA, a chain of
+O(k·V) staging ops — scale, filter, per-row softmax/argmax, a prefix
+scan over the accept flags, a gather of the corrected row — each
+materializing an O(V) tensor between HBM round trips, exactly the
+per-token-epilogue traffic arXiv:2502.17728 argues into one kernel (and
+exactly what :mod:`apex_tpu.ops.pallas.sampling` already fused for the
+single-row sampling tail). This kernel extends that fusion to the whole
+accept/reject tail: the (k+1, V) logit block is read into VMEM once and
+two int32 lanes come back — nothing O(V) returns to HBM.
+
+Acceptance semantics (the drafters propose point-mass — greedy — drafts,
+so both modes are EXACT: the emitted stream is distributed identically
+to non-speculative decoding):
+
+* **Greedy** (temperature == 0): row i's candidate is ``argmax`` of the
+  target's i-th logit row; drafted token i is accepted iff it equals
+  candidate i. The accepted prefix length ``a`` is the count of leading
+  matches, and the corrected next token is candidate ``a`` — by
+  construction the token the non-speculative greedy loop would have
+  produced, so the spec stream is token-identical to the baseline.
+* **Rejection sampling** (temperature > 0, top-k/top-p): the target
+  distribution p is the same temperature→top-k→top-p filtered softmax
+  the fused sampling tail draws from (the bisection helpers of
+  :mod:`~apex_tpu.ops.pallas.sampling` are reused verbatim). A drafted
+  token d_i — a point mass under the drafter — is accepted with
+  probability p(d_i) (the ``min(1, p/q)`` rule with q = δ(d_i)); on the
+  first rejection the corrected token is drawn from the residual
+  ``p`` with d_i removed (the normalized ``max(p − q·min(p,q), 0)`` of
+  a point-mass q), and if all k drafts are accepted the bonus token is
+  drawn from the full filtered p. Both draws are Gumbel-argmax on
+  pre-drawn uniform rows, shared with the XLA fallback.
+
+The filtering/acceptance math lives in module-level helpers written for
+arbitrary leading batch dims, shared VERBATIM with the XLA fallback in
+:mod:`apex_tpu.ops.fused_verify` — kernel/fallback parity is by
+construction on shared noise, the same discipline as ``fused_sample``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from apex_tpu.ops.pallas.attention import _LSE_LANES
+from apex_tpu.ops.pallas.sampling import FILTERED, filtered_scaled, gumbel_argmax
+
+#: sentinel drafted id for the bonus row (row k has no draft to verify);
+#: never equals a real candidate, so its accept flag is always False and
+#: the accepted prefix length is capped at k
+NO_DRAFT = -1
+
+#: lane width of the drafted-id / acceptance-noise operands: one full
+#: TPU lane tile, so every draft length the drafters allow (k+1 <=
+#: MAX_DRAFT_K+1 = 33) fits one block — the 8-lane carrier the OUTPUT
+#: scalars ride would truncate any k >= 8
+VERIFY_LANES = 128
+
+
+def row_argmax(s):
+    """Row-wise argmax with ties to the LOWEST index (``jnp.argmax``'s
+    convention, so greedy spec candidates match the engines' greedy
+    tails bit for bit). ``s`` (..., V) → (...,) int32."""
+    m = jnp.max(s, axis=-1, keepdims=True)
+    V = s.shape[-1]
+    idx = jax.lax.broadcasted_iota(jnp.int32, s.shape, s.ndim - 1)
+    return jnp.min(jnp.where(s == m, idx, V), axis=-1)
+
+
+def accepted_prefix_len(acc):
+    """Length of the leading run of True accept flags: ``acc`` (..., k+1)
+    bool → (...,) int32 in [0, k] (the bonus row's flag is always False
+    — :data:`NO_DRAFT` never matches a candidate)."""
+    return jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=-1), axis=-1)
+
+
+def select_row(vals, a):
+    """``vals[..., a]`` at a traced per-batch index ``a`` (...,) without
+    a gather: one-hot sum over the row axis (VPU-only, kernel-safe)."""
+    idx = jax.lax.broadcasted_iota(jnp.int32, vals.shape, vals.ndim - 1)
+    return jnp.sum(jnp.where(idx == a[..., None], vals, 0), axis=-1)
+
+
+def verify_greedy(logits, drafted_pad):
+    """Exact greedy acceptance. ``logits`` (..., k+1, V); ``drafted_pad``
+    (..., k+1) int32 with the bonus row pinned at :data:`NO_DRAFT`.
+    Returns ``(accept_len (...,), next_token (...,))`` int32."""
+    cand = row_argmax(logits.astype(jnp.float32))
+    a = accepted_prefix_len(cand == drafted_pad)
+    return a, select_row(cand, a)
+
+
+def verify_sampled(logits, drafted_pad, u_acc, u_gum, *, temperature,
+                   top_k, top_p):
+    """Exact rejection-sampling acceptance for point-mass drafts under
+    the temperature→top-k→top-p filtered target distribution.
+
+    ``logits`` (..., k+1, V); ``drafted_pad`` (..., k+1) int32 (bonus row
+    :data:`NO_DRAFT`); ``u_acc`` (..., k+1) uniform acceptance draws in
+    (0, 1]; ``u_gum`` (..., k+1, V) uniform Gumbel noise in (0, 1].
+    Row i accepts d_i iff ``u_acc_i < p(d_i)``; every row's correction
+    candidate is drawn from p with its drafted token FILTERED (the exact
+    point-mass residual; the bonus row draws from the full p), and the
+    first rejected row's candidate is the emitted correction. A drafted
+    token the top-k/top-p filter removed carries p == 0 and is always
+    rejected — the filters bind identically to the non-speculative tail.
+    """
+    s = filtered_scaled(logits, temperature=temperature, top_k=top_k,
+                        top_p=top_p)
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, s.ndim - 1)
+    onehot = cols == drafted_pad[..., None]
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    p_d = (jnp.sum(jnp.where(onehot, e, 0.0), axis=-1)
+           / jnp.sum(e, axis=-1))
+    a = accepted_prefix_len(u_acc < p_d)
+    cand = gumbel_argmax(jnp.where(onehot, FILTERED, s), u_gum)
+    return a, select_row(cand, a)
+
+
+def _verify_kernel(logits_ref, drafted_ref, *refs, k1, temperature,
+                   top_k, top_p, sampled):
+    """One grid row: the whole (k+1, V) logit block is VMEM-resident;
+    every reduction below runs on it in place — the only HBM traffic is
+    the block reads and two 8-lane int32 writes."""
+    if sampled:
+        u_acc_ref, u_gum_ref, a_ref, tok_ref = refs
+    else:
+        a_ref, tok_ref = refs
+    s = logits_ref[0]                       # (k+1, V)
+    drafted = drafted_ref[0, :k1]           # (k+1,) — bonus lane NO_DRAFT
+    if sampled:
+        a, tok = verify_sampled(s, drafted, u_acc_ref[0, :k1],
+                                u_gum_ref[0], temperature=temperature,
+                                top_k=top_k, top_p=top_p)
+    else:
+        a, tok = verify_greedy(s, drafted)
+    a_ref[:] = jnp.broadcast_to(a[None, None], (1, _LSE_LANES))
+    tok_ref[:] = jnp.broadcast_to(tok[None, None], (1, _LSE_LANES))
+
+
+def fused_verify_fwd(logits, drafted_pad, u_acc, u_gum, *, temperature,
+                     top_k, top_p, interpret=False):
+    """(b, k+1, V) logits + lane-padded drafts/noise → ``(accept_len
+    (b,), next_token (b,))`` int32; one kernel invocation, grid over
+    batch rows. ``drafted_pad``/``u_acc`` arrive padded to
+    ``VERIFY_LANES`` lanes (contents beyond k+1 ignored); greedy mode
+    (``temperature == 0``) takes ``u_acc``/``u_gum`` as None. V must be
+    a 128-multiple (lane tiling); the op-level wrapper gates on that."""
+    b, k1, V = logits.shape
+    sampled = temperature > 0.0
+    if k1 > VERIFY_LANES:  # unreachable through the drafters (k <= 32)
+        raise ValueError(
+            f"fused verify kernel carries drafted ids in one "
+            f"{VERIFY_LANES}-lane block; got k+1 = {k1} rows — use the "
+            f"XLA fallback (impl='xla') for drafts this long")
+    in_specs = [
+        pl.BlockSpec((1, k1, V), lambda i: (i, 0, 0)),
+        pl.BlockSpec((1, VERIFY_LANES), lambda i: (i, 0)),
+    ]
+    args = [logits, drafted_pad]
+    if sampled:
+        in_specs.append(pl.BlockSpec((1, VERIFY_LANES), lambda i: (i, 0)))
+        in_specs.append(pl.BlockSpec((1, k1, V), lambda i: (i, 0, 0)))
+        args.extend([u_acc, u_gum])
+    a, tok = pl.pallas_call(
+        functools.partial(_verify_kernel, k1=k1, temperature=temperature,
+                          top_k=top_k, top_p=top_p, sampled=sampled),
+        grid=(b,),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, _LSE_LANES), lambda i: (i, 0)),
+                   pl.BlockSpec((1, _LSE_LANES), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b, _LSE_LANES), jnp.int32),
+                   jax.ShapeDtypeStruct((b, _LSE_LANES), jnp.int32)],
+        interpret=interpret,
+    )(*args)
+    return a[:, 0], tok[:, 0]

@@ -30,10 +30,18 @@ stalling the in-flight streams. This package is that engine:
   prefill share), and **chunked prefill** so a long prompt never
   stalls the streams already decoding.
 * :mod:`~apex_tpu.serving.engine` — :class:`~apex_tpu.serving.engine.
-  ServingEngine`: the jitted ``prefill_chunk`` / ``decode_step`` pair
-  (each compiles once), the paged decode attention
-  (:func:`apex_tpu.ops.decode_attention` with ``block_tables=``), and
-  the fused sampling tail (:func:`apex_tpu.ops.fused_sample`).
+  ServingEngine`: the jitted ``prefill_chunk`` / ``decode_step`` /
+  ``spec_step`` triple (each compiles once), the paged decode
+  attention (:func:`apex_tpu.ops.decode_attention` with
+  ``block_tables=`` — int8 pools dequantize in-kernel under the
+  ``kv_dtype`` knob, per-block-row scales riding the same
+  indirection), the fused sampling tail
+  (:func:`apex_tpu.ops.fused_sample`), and — with ``serve(draft=...)``
+  — speculative rounds: every decoding slot verifies k drafted tokens
+  per dispatch through the fused verify tail
+  (:func:`apex_tpu.ops.fused_verify`), block tables/lengths rewound to
+  the accepted frontier, greedy output token-identical to plain
+  decode (see :mod:`apex_tpu.spec` for the drafters).
 
 * :mod:`~apex_tpu.serving.telemetry` — **request-level telemetry**
   (ISSUE 10): per-request lifecycle ``serve_event`` records

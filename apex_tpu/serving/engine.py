@@ -1,7 +1,8 @@
 """ServingEngine: continuous-batching generation over a paged KV cache.
 
-The device side of :mod:`apex_tpu.serving` — exactly TWO compiled
-programs, each with one set of avals for the lifetime of the engine:
+The device side of :mod:`apex_tpu.serving` — TWO compiled programs
+(plus a third, ``spec_step``, when a drafter is attached), each with
+one set of avals for the lifetime of the engine:
 
 * ``prefill_chunk(params, pool, table_row, tokens, start, live, key)``
   — one fixed-size chunk of ONE slot's prompt through the stack: the
@@ -22,9 +23,22 @@ programs, each with one set of avals for the lifetime of the engine:
   (:func:`apex_tpu.ops.fused_sample`) turns logits into tokens in one
   dispatch.
 
-Both donate the pool: XLA updates the cache in place, so a step's HBM
+* ``spec_step(params, pool, tables, tokens, lengths, drafted, key)`` —
+  the speculative round (``serve(draft=...)``): every decoding slot
+  scores its pending token plus k drafts in one k+1-wide dispatch
+  (the prefill-chunk attention shape batched over the slot array) and
+  the fused verify tail (:func:`apex_tpu.ops.fused_verify`) emits
+  per-slot ``(accept_len, next_token)``; the scheduler rewinds tables/
+  lengths to the accepted frontier afterwards — contents-only, one
+  executable per static k.
+
+All donate the pool: XLA updates the cache in place, so a step's HBM
 traffic is the live cache read plus one token's writes — never a pool
-copy. Everything dynamic about traffic stays in
+copy. Under ``kv_dtype="int8"`` the pool stores int8 k/v with
+per-block-row fp32 scales alongside (quantize on write at every write
+site; dequantize in-VMEM inside the paged decode kernel), halving the
+bytes the HBM-bound decode stream pays — the float pool stays the
+parity oracle. Everything dynamic about traffic stays in
 :class:`~apex_tpu.serving.scheduler.Scheduler` on the host; churn
 reaches the device only as operand *contents*, which is why
 ``decode_step._cache_size()`` stays 1 across arbitrary admit/evict
@@ -51,12 +65,25 @@ import numpy as np
 from apex_tpu.models.gpt import GPTModel
 from apex_tpu.monitor import registry as monitor_registry
 from apex_tpu.monitor import spans as monitor_spans
-from apex_tpu.ops import fused_layer_norm, fused_sample
+from apex_tpu.ops import fused_layer_norm, fused_sample, fused_verify
 from apex_tpu.ops.pallas.attention import NEG_INF
 from apex_tpu.serving.kv_blocks import (DEAD_BLOCK, BlockAllocator,
                                         PrefixCache)
 from apex_tpu.serving.scheduler import Request, Scheduler, SLOPolicy
 from apex_tpu.serving.telemetry import ServeTelemetry
+
+
+def _quant_rows(x, axes):
+    """Symmetric per-row int8 quantization: one fp32 scale per row
+    (``axes`` reduced away — kv heads and head_dim share it, because the
+    write sites land one token row at a time), values rounded into
+    [-127, 127]. The tiny floor keeps an all-zero row's scale finite
+    (dead-block writes, padding) — it dequantizes back to exact zeros."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-20) / 127.0
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, jnp.squeeze(scale, axis=axes)
 
 
 @dataclass
@@ -67,6 +94,11 @@ class ServeStats:
     prefill_chunks: int = 0
     blocks_high_water: int = 0
     swaps: int = 0
+    # speculative rounds (serve(draft=...)): a spec round is one
+    # decode-width dispatch that can emit up to k+1 tokens per slot
+    spec_rounds: int = 0
+    spec_drafted: int = 0
+    spec_accepted: int = 0
     occupancy_samples: List[int] = field(default_factory=list)
 
     def occupancy_pct(self, num_slots: int) -> Optional[float]:
@@ -74,6 +106,12 @@ class ServeStats:
             return None
         return (100.0 * sum(self.occupancy_samples)
                 / (len(self.occupancy_samples) * num_slots))
+
+    @property
+    def spec_acceptance_rate(self) -> float:
+        """Accepted drafts / drafted tokens (0.0 before any round)."""
+        return (self.spec_accepted / self.spec_drafted
+                if self.spec_drafted else 0.0)
 
 
 class ServingEngine:
@@ -110,11 +148,32 @@ class ServingEngine:
                  block_size: int = 128, num_blocks: Optional[int] = None,
                  max_seq_len: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 cache_dtype: Any = None, temperature: float = 0.0,
+                 cache_dtype: Any = None, kv_dtype: Optional[str] = None,
+                 temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 1.0):
         model.check_decode_supported()
         self.model = model
         c = self.config = model.config
+        # int8 KV quantization (ROADMAP item 3b): halves the bytes the
+        # decode kernel streams and doubles live-token capacity; the
+        # float pool (kv_dtype=None, dtype = cache_dtype) stays as the
+        # parity oracle. Validated HERE — an unsupported value or model
+        # composition must name the knob, never surface as a deep XLA
+        # dtype/shape error mid-serve.
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None (float pool in cache_dtype) or "
+                f"'int8' (per-block-row scales, dequantized in-kernel); "
+                f"got {kv_dtype!r} — fp8 pools are not implemented")
+        if kv_dtype == "int8" \
+                and getattr(model, "decode_rel_bias", None) is not None:
+            raise ValueError(
+                "kv_dtype='int8' cannot serve a model with a decode "
+                "relative-position bias (the quantized paged kernel "
+                "path does not carry the bucketed bias) — serve this "
+                "model with the float pool (kv_dtype=None)")
+        self.kv_dtype = kv_dtype
+        self.quantized = kv_dtype == "int8"
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.block_size = int(block_size)
@@ -157,25 +216,49 @@ class ServingEngine:
         self.prefill_chunk = jax.jit(self._prefill_chunk,
                                      donate_argnums=(1,))
         self.decode_step = jax.jit(self._decode_step, donate_argnums=(1,))
+        # the speculative round (serve(draft=...)): every decoding slot
+        # verifies k drafted tokens in ONE dispatch; avals depend only
+        # on the static draft length, so across rounds and churn it
+        # compiles exactly once like the other two
+        self.spec_step = jax.jit(self._spec_step, donate_argnums=(1,))
 
     # --- pool ----------------------------------------------------------------
 
     def init_pool(self) -> Dict[str, jax.Array]:
         """The zeroed block pool:
         ``{"k"/"v": (layers, num_blocks, kv_heads, block_size, head_dim)}``
-        — block 0 is the dead block (see kv_blocks)."""
+        — block 0 is the dead block (see kv_blocks). Under
+        ``kv_dtype="int8"`` the k/v arrays are int8 and per-block-row
+        fp32 scales ride alongside as ``k_scale``/``v_scale``
+        ``(layers, num_blocks, block_size)`` — one pool tree either
+        way, its avals fixed for the engine's lifetime."""
         c = self.config
         shape = (c.num_layers, self.num_blocks, c.local_kv_heads,
                  self.block_size, c.head_dim)
+        if self.quantized:
+            sshape = (c.num_layers, self.num_blocks, self.block_size)
+            return {"k": jnp.zeros(shape, jnp.int8),
+                    "v": jnp.zeros(shape, jnp.int8),
+                    "k_scale": jnp.zeros(sshape, jnp.float32),
+                    "v_scale": jnp.zeros(sshape, jnp.float32)}
         return {"k": jnp.zeros(shape, self.cache_dtype),
                 "v": jnp.zeros(shape, self.cache_dtype)}
 
     def pool_bytes(self) -> int:
-        """HBM footprint of the whole pool (both k and v)."""
+        """HBM footprint of the whole pool (both k and v, plus the
+        scale planes under int8)."""
         c = self.config
-        itemsize = jnp.dtype(self.cache_dtype).itemsize
-        return (2 * c.num_layers * self.num_blocks * c.local_kv_heads
-                * self.block_size * c.head_dim * itemsize)
+        cells = (c.num_layers * self.num_blocks * c.local_kv_heads
+                 * self.block_size * c.head_dim)
+        if self.quantized:
+            scales = c.num_layers * self.num_blocks * self.block_size
+            return 2 * cells + 2 * scales * 4
+        return 2 * cells * jnp.dtype(self.cache_dtype).itemsize
+
+    def _pool_out(self, ck, cv, ks, vs) -> Dict[str, jax.Array]:
+        if self.quantized:
+            return {"k": ck, "v": cv, "k_scale": ks, "v_scale": vs}
+        return {"k": ck, "v": cv}
 
     # --- weight hot-swap -----------------------------------------------------
 
@@ -309,6 +392,7 @@ class ServingEngine:
         js = jnp.arange(max_s, dtype=jnp.int32)
         mask = js[None, None, None, :] <= pos[None, None, :, None]
         ck, cv = pool["k"], pool["v"]
+        ks, vs = pool.get("k_scale"), pool.get("v_scale")
         for i in range(c.num_layers):
             layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
             h_in = fused_layer_norm(x, layer["ln1_w"], layer["ln1_b"])
@@ -316,15 +400,37 @@ class ServingEngine:
             # chunk k/v → (C/B, h_kv, B, d) block scatter at traced ids
             kb = k[0].reshape(nblk, B, h_kv, d).transpose(0, 2, 1, 3)
             vb = v[0].reshape(nblk, B, h_kv, d).transpose(0, 2, 1, 3)
-            ck = ck.at[i, ids].set(kb.astype(ck.dtype))
-            cv = cv.at[i, ids].set(vb.astype(cv.dtype))
+            if self.quantized:
+                # quantize on write: per (block, row) scales over
+                # (h_kv, d) — the same ids, so the dead-block redirect
+                # covers the scale planes too
+                kq, ksc = _quant_rows(kb, (1, 3))
+                vq, vsc = _quant_rows(vb, (1, 3))
+                ck = ck.at[i, ids].set(kq)
+                cv = cv.at[i, ids].set(vq)
+                ks = ks.at[i, ids].set(ksc)
+                vs = vs.at[i, ids].set(vsc)
+            else:
+                ck = ck.at[i, ids].set(kb.astype(ck.dtype))
+                cv = cv.at[i, ids].set(vb.astype(cv.dtype))
             # prefix attention: chunk queries × the slot's gathered
             # padded cache (chunk rows included — causal within the
-            # chunk falls out of the same mask)
-            k_all = ck[i][table_row].transpose(1, 0, 2, 3) \
-                .reshape(h_kv, max_s, d)
-            v_all = cv[i][table_row].transpose(1, 0, 2, 3) \
-                .reshape(h_kv, max_s, d)
+            # chunk falls out of the same mask); int8 pools dequantize
+            # in the gather (prefill is compute-bound — simplicity is
+            # cheap here; the HBM-bound decode path dequantizes
+            # in-kernel instead)
+            if self.quantized:
+                k_all = (ck[i][table_row].astype(jnp.float32)
+                         * ks[i][table_row][:, None, :, None]) \
+                    .transpose(1, 0, 2, 3).reshape(h_kv, max_s, d)
+                v_all = (cv[i][table_row].astype(jnp.float32)
+                         * vs[i][table_row][:, None, :, None]) \
+                    .transpose(1, 0, 2, 3).reshape(h_kv, max_s, d)
+            else:
+                k_all = ck[i][table_row].transpose(1, 0, 2, 3) \
+                    .reshape(h_kv, max_s, d)
+                v_all = cv[i][table_row].transpose(1, 0, 2, 3) \
+                    .reshape(h_kv, max_s, d)
             qg = q[0].reshape(C, h_kv, group, d).transpose(1, 2, 0, 3)
             s = jnp.einsum("hgcd,hsd->hgcs", qg,
                            k_all.astype(qg.dtype),
@@ -341,7 +447,8 @@ class ServingEngine:
             x, (jnp.int32(0), live - 1, jnp.int32(0)),
             (1, 1, c.hidden_size))
         logits = model.unembed(params, last)[:, 0]  # (1, V)
-        return {"k": ck, "v": cv}, self._sample(logits, key)[0], logits[0]
+        return (self._pool_out(ck, cv, ks, vs),
+                self._sample(logits, key)[0], logits[0])
 
     # --- decode step ---------------------------------------------------------
 
@@ -378,19 +485,134 @@ class ServingEngine:
         rel_hook = getattr(model, "decode_rel_bias", None)
         rel_bias = None if rel_hook is None else rel_hook(params)
         ck, cv = pool["k"], pool["v"]
+        ks, vs = pool.get("k_scale"), pool.get("v_scale")
         for i in range(c.num_layers):
             layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
             q, k_row, v_row = model.decode_qkv(layer, x)
             # per-slot (block, row) scatter into the DONATED pool; dead
             # slots carry table rows of DEAD_BLOCK, so their writes are
             # absorbed harmlessly
-            ck = ck.at[i, bid, :, row].set(k_row[:, :, 0].astype(ck.dtype))
-            cv = cv.at[i, bid, :, row].set(v_row[:, :, 0].astype(cv.dtype))
+            if self.quantized:
+                kq, ksc = _quant_rows(k_row[:, :, 0], (1, 2))  # (S,)
+                vq, vsc = _quant_rows(v_row[:, :, 0], (1, 2))
+                ck = ck.at[i, bid, :, row].set(kq)
+                cv = cv.at[i, bid, :, row].set(vq)
+                ks = ks.at[i, bid, row].set(ksc)
+                vs = vs.at[i, bid, row].set(vsc)
+                scales = (ks[i], vs[i])
+            else:
+                ck = ck.at[i, bid, :, row].set(
+                    k_row[:, :, 0].astype(ck.dtype))
+                cv = cv.at[i, bid, :, row].set(
+                    v_row[:, :, 0].astype(cv.dtype))
+                scales = None
             x = model.decode_block(layer, x, q, ck[i], cv[i], lengths,
-                                   rel_bias=rel_bias, block_tables=tables)
+                                   rel_bias=rel_bias, block_tables=tables,
+                                   kv_scales=scales)
         x = fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
         logits = model.unembed(params, x)[:, 0]  # (S, V)
-        return {"k": ck, "v": cv}, self._sample(logits, key), logits
+        return self._pool_out(ck, cv, ks, vs), self._sample(logits, key), \
+            logits
+
+    # --- speculative round ---------------------------------------------------
+
+    def _spec_step(self, params, pool, tables, tokens, lengths, drafted,
+                   key):
+        # trace-time step-anatomy span, like serve_prefill/serve_decode
+        with monitor_spans.span("serve_spec"):
+            return self._spec_step_body(params, pool, tables, tokens,
+                                        lengths, drafted, key)
+
+    def _spec_step_body(self, params, pool, tables, tokens, lengths,
+                        drafted, key):
+        """One speculative round for EVERY slot at once: ``tokens``
+        (S, k+1) are each slot's pending sampled token followed by its k
+        drafted continuations, ``lengths`` (S,) the live rows INCLUDING
+        the pending token (0 = dead slot: writes land in the dead block,
+        outputs ignored by the host), ``drafted`` (S, k) the draft ids.
+        All k+1 positions are scored in one multi-token step (the
+        chunked-prefill attention shape at chunk = k+1, riding the same
+        gathered-cache formulation), their k/v land in the slots' pool
+        blocks past the live frontier (the scheduler pre-allocated
+        them), and the fused verify tail emits per-slot ``(accept_len,
+        next_token)``. Rows past each slot's accepted frontier hold
+        rejected-draft k/v — the scheduler rewinds tables/lengths to the
+        frontier (contents-only mutation; this program never retraces).
+        Returns ``(pool, accept_lens (S,), next_tokens (S,))``."""
+        model, c = self.model, self.config
+        B = self.block_size
+        S, K1 = tokens.shape
+        h_kv, group = c.local_kv_heads, c.local_heads // c.local_kv_heads
+        d = c.head_dim
+        max_s = self.max_s
+        lengths = lengths.astype(jnp.int32)
+        base = jnp.maximum(lengths - 1, 0)
+        pos = base[:, None] + jnp.arange(K1, dtype=jnp.int32)[None, :]
+        x = model.embedding(params["embedding"], tokens)  # (S, K1, H)
+        ptab = params["pos_embedding"]
+        x = x + jnp.take(ptab, jnp.minimum(pos, ptab.shape[0] - 1),
+                         axis=0)
+        tables = tables.astype(jnp.int32)
+        bid = jnp.take_along_axis(tables, pos // B, axis=1)  # (S, K1)
+        # dead slots write to the dead block NO MATTER what their table
+        # row says (same redirect as the decode step)
+        bid = jnp.where(lengths[:, None] > 0, bid, DEAD_BLOCK)
+        row = pos % B
+        scale = 1.0 / d ** 0.5
+        js = jnp.arange(max_s, dtype=jnp.int32)
+        # prefix-causal per drafted row: row j of slot i sees keys
+        # [0, base_i + j] — broadcastable over (S, h_kv, group, K1, max_s)
+        mask = js[None, None, None, None, :] <= pos[:, None, None, :, None]
+        ck, cv = pool["k"], pool["v"]
+        ks, vs = pool.get("k_scale"), pool.get("v_scale")
+        for i in range(c.num_layers):
+            layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+            h_in = fused_layer_norm(x, layer["ln1_w"], layer["ln1_b"])
+            q, k, v = model._proj_qkv_bshd(layer, h_in)
+            # (S, K1) rows scattered at traced (block, row) coordinates
+            if self.quantized:
+                kq, ksc = _quant_rows(k, (2, 3))  # scales (S, K1)
+                vq, vsc = _quant_rows(v, (2, 3))
+                ck = ck.at[i, bid, :, row].set(kq)
+                cv = cv.at[i, bid, :, row].set(vq)
+                ks = ks.at[i, bid, row].set(ksc)
+                vs = vs.at[i, bid, row].set(vsc)
+            else:
+                ck = ck.at[i, bid, :, row].set(k.astype(ck.dtype))
+                cv = cv.at[i, bid, :, row].set(v.astype(cv.dtype))
+            # K1 queries per slot × the slot's gathered padded cache —
+            # the prefill-chunk attention at chunk = k+1, batched over
+            # the slot array (int8 pools dequantize in the gather)
+            if self.quantized:
+                k_all = (ck[i][tables].astype(jnp.float32)
+                         * ks[i][tables][:, :, None, :, None])
+                v_all = (cv[i][tables].astype(jnp.float32)
+                         * vs[i][tables][:, :, None, :, None])
+            else:
+                k_all, v_all = ck[i][tables], cv[i][tables]
+            k_all = k_all.transpose(0, 2, 1, 3, 4) \
+                .reshape(S, h_kv, max_s, d)
+            v_all = v_all.transpose(0, 2, 1, 3, 4) \
+                .reshape(S, h_kv, max_s, d)
+            qg = q.reshape(S, K1, h_kv, group, d).transpose(0, 2, 3, 1, 4)
+            s = jnp.einsum("bhgcd,bhsd->bhgcs", qg,
+                           k_all.astype(qg.dtype),
+                           preferred_element_type=jnp.float32) * scale
+            s = jnp.where(mask, s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bhgcs,bhsd->bhgcd", p.astype(v_all.dtype),
+                             v_all)
+            ctx = ctx.transpose(0, 3, 1, 2, 4).reshape(S, K1,
+                                                       c.local_heads, d)
+            x = x + model._proj_attn_out(layer, ctx)
+            x = x + model._mlp(layer, fused_layer_norm(
+                x, layer["ln2_w"], layer["ln2_b"]))
+        x = fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
+        logits = model.unembed(params, x)  # (S, K1, V)
+        a, nxt = fused_verify(logits, drafted, key,
+                              temperature=self.temperature,
+                              top_k=self.top_k, top_p=self.top_p)
+        return self._pool_out(ck, cv, ks, vs), a, nxt
 
     # --- the serving loop ----------------------------------------------------
 
@@ -421,7 +643,7 @@ class ServingEngine:
               key: Optional[jax.Array] = None,
               clock: Optional[Callable[[], float]] = None,
               scheduler: Optional[Scheduler] = None,
-              telemetry=None) -> List[Request]:
+              telemetry=None, draft=None) -> List[Request]:
         """Run ``requests`` to completion; returns them in completion
         order with tokens and latency stamps filled in.
 
@@ -444,9 +666,39 @@ class ServingEngine:
         for free; pass ``telemetry=False`` to suppress even that (timed
         baseline runs must not pay emit costs a comparison leg does
         not); with monitoring off and no tracker, every hook site is a
-        single ``is None`` test."""
+        single ``is None`` test.
+
+        ``draft`` attaches a :class:`~apex_tpu.spec.drafter.Drafter`
+        for speculative serving: spec rounds replace plain decode steps
+        whenever every decoding slot has k+1 rows of headroom (near the
+        row cap the loop falls back to the plain step — a host-side
+        choice, never a retrace), interleaving with chunked prefill
+        exactly as decode does. Greedy output stays token-identical to
+        ``draft=None`` across arbitrary churn; acceptance is accounted
+        in ``last_stats`` and per-round ``spec`` lifecycle events."""
         if self.temperature > 0 and key is None:
             raise ValueError("temperature > 0 serving requires a key")
+        if draft is not None:
+            if getattr(self.model, "decode_rel_bias", None) is not None:
+                # the spec round's k+1-row scoring does not thread the
+                # bucketed relative bias the plain decode step applies;
+                # verifying against unbiased spec logits would silently
+                # break the token-identical contract (same composition
+                # guard as kv_dtype='int8')
+                raise ValueError(
+                    "serve(draft=...) cannot speculate for a model "
+                    "with a decode relative-position bias (the spec "
+                    "verify step does not carry the bucketed bias) — "
+                    "serve this model with draft=None")
+            from apex_tpu.spec.drafter import validate_drafter
+            # eager, knob-naming validation: vocab/block_size/k/cache
+            # bounds fail HERE, not as an XLA error three rounds in.
+            # max_s rows suffice for the drafter: spec rounds only run
+            # with k+1 rows of slot headroom (the loop falls back to
+            # plain decode near the cap), so a drafter context never
+            # exceeds max_s - k tokens
+            validate_drafter(draft, self.config, needed_rows=self.max_s,
+                             block_size=self.block_size)
         if key is None:  # greedy: the key operand is ignored but keeps
             # the step signature (and avals) fixed
             key = jax.random.PRNGKey(0)  # apexlint: disable=APX502
@@ -479,6 +731,9 @@ class ServingEngine:
                               f"measurements"))
         if tel is not None:
             sched.telemetry = tel
+            # stamp the pool-quantization knob so the serve record
+            # names the pool it measured (absent on float pools)
+            tel.kv_dtype = self.kv_dtype
         for r in requests:
             if tel is not None:
                 r.submit_s = now()
@@ -500,7 +755,7 @@ class ServingEngine:
         try:
             with flush_scope:
                 self._serve_loop(params, key, sched, tel, stats, now,
-                                 wall, pool)
+                                 wall, pool, draft)
         finally:
             # a deferred swap this run never applied does NOT survive
             # into a later serve() call — clean return OR mid-run
@@ -511,9 +766,12 @@ class ServingEngine:
         self.last_stats = stats
         return sched.completed
 
-    def _serve_loop(self, params, key, sched, tel, stats, now, wall, pool):
+    def _serve_loop(self, params, key, sched, tel, stats, now, wall, pool,
+                    draft=None):
         nstep = 0
         policy = sched.policy
+        K = draft.k if draft is not None else 0
+        ncompleted = len(sched.completed)
         while not sched.idle():
             # weight hot-swap lands HERE, between dispatch steps: a
             # contents-only params replacement (avals validated), so
@@ -547,8 +805,56 @@ class ServingEngine:
                 stats.prefill_chunks += 1
                 sched.note_prefill(work, tok, now())
                 did_work = True
-            batch = sched.decode_batch(now())
-            if batch is not None:
+            # speculative rounds replace plain decode whenever EVERY
+            # decoding slot has k+1 rows of headroom (host-side choice:
+            # both branches are pre-compiled programs, never a retrace)
+            use_spec = False
+            if draft is not None:
+                dec = sched.decoding_slots()
+                use_spec = bool(dec) and all(
+                    sched.slot_length(i) + K + 1 <= self.max_s
+                    for i in dec)
+            batch = sched.decode_batch(now(),
+                                       lookahead=K if use_spec else 0)
+            if batch is not None and use_spec:
+                toks, lens = batch
+                live = [i for i in range(self.num_slots) if lens[i] > 0]
+                # drafts come from the host drafter per stream; the
+                # verify operands stay fixed-shape (static k)
+                drafted = np.zeros((self.num_slots, K), np.int32)
+                rids = {}
+                for i in live:
+                    rids[i] = sched.slot_rid(i)
+                    drafted[i] = draft.propose(rids[i],
+                                               sched.slot_context(i))
+                tok_mat = np.zeros((self.num_slots, K + 1), np.int32)
+                tok_mat[:, 0] = toks
+                tok_mat[:, 1:] = drafted
+                sched.note_step(nstep)
+                t_dispatch = now()
+                pool, acc, nxt = self.spec_step(
+                    params, pool, jnp.asarray(sched.tables.asarray()),
+                    jnp.asarray(tok_mat), jnp.asarray(lens),
+                    jnp.asarray(drafted), jax.random.fold_in(key, nstep))
+                acc = np.asarray(acc)  # blocks: the round really ran
+                nxt = np.asarray(nxt)
+                if tel is not None:
+                    tel.on_decode_step(now() - t_dispatch, len(live),
+                                       nstep, now())
+                nstep += 1
+                stats.decode_steps += 1
+                stats.spec_rounds += 1
+                stats.occupancy_samples.append(len(live))
+                for i in live:
+                    a = int(acc[i])
+                    stats.spec_drafted += K
+                    stats.spec_accepted += a
+                    if tel is not None:
+                        tel.on_spec_round(rids[i], i, a, K, nstep - 1,
+                                          now())
+                sched.note_spec(drafted, acc, nxt, now())
+                did_work = True
+            elif batch is not None:
                 toks, lens = batch
                 ndec = len(sched.decoding_slots())
                 sched.note_step(nstep)
@@ -566,6 +872,12 @@ class ServingEngine:
                 stats.occupancy_samples.append(ndec)
                 sched.note_decode(sampled, now())
                 did_work = True
+            if draft is not None and len(sched.completed) > ncompleted:
+                # free finished streams' drafter state (caches bounded
+                # by CONCURRENT streams, not request history)
+                for r in sched.completed[ncompleted:]:
+                    draft.release(r.rid)
+                ncompleted = len(sched.completed)
             stats.blocks_high_water = max(stats.blocks_high_water,
                                           sched.allocator.num_live)
             if tel is not None:

@@ -528,7 +528,7 @@ class Scheduler:
         return [i for i, s in enumerate(self._slots)
                 if s.request is not None and s.prefill_done]
 
-    def decode_batch(self, now: float = 0.0
+    def decode_batch(self, now: float = 0.0, lookahead: int = 0
                      ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         """The next decode step's host operands: ``(tokens, lengths)``
         over the full slot array — ``lengths[i]`` counts live rows
@@ -539,7 +539,12 @@ class Scheduler:
         pressure the youngest yields (reclaim, then preemption — a
         preempted victim is always at-or-after the current slot in
         admit order, so rows already placed in the batch never go
-        stale). None when nothing is decoding."""
+        stale). None when nothing is decoding.
+
+        ``lookahead`` reserves blocks for that many EXTRA rows past the
+        incoming token — the speculative round's k drafted rows
+        (:meth:`note_spec` rewinds the reservation to the accepted
+        frontier afterwards)."""
         tokens = np.zeros((self.num_slots,), np.int32)
         lengths = np.zeros((self.num_slots,), np.int32)
         any_live = False
@@ -547,8 +552,8 @@ class Scheduler:
             slot = self._slots[i]
             if slot.request is None or not slot.prefill_done:
                 continue
-            need = blocks_needed(slot.length + 1, self.block_size) \
-                - slot.n_blocks
+            need = blocks_needed(slot.length + 1 + lookahead,
+                                 self.block_size) - slot.n_blocks
             if need > 0:
                 if not self._make_room(need, i, now):
                     continue  # the slot preempted ITSELF this round
@@ -562,6 +567,54 @@ class Scheduler:
         if not any_live:
             return None
         return tokens, lengths
+
+    def note_spec(self, drafted: np.ndarray, accepted: np.ndarray,
+                  next_tokens: np.ndarray, now: float) -> List[Request]:
+        """Record one speculative round: per decoding slot, emit the
+        accepted draft prefix plus the corrected token (capped at the
+        request's remaining budget) and REWIND the block tables to the
+        accepted frontier — blocks the round reserved past
+        ``blocks_needed(new length)`` free in reverse-allocation order
+        (the LIFO free list is restored exactly; the worst case, an
+        all-rejected round, leaves tables/lengths/free-list as a plain
+        decode step would have) and their table entries reset to the
+        dead block. Contents-only mutation throughout: the device
+        programs never see an aval change. Inter-token latency is
+        amortized over the round's emissions (the k+1 tokens of a round
+        arrive in one dispatch). Returns requests finished by the
+        round."""
+        tel = self.telemetry
+        finished = []
+        B = self.block_size
+        for i in self.decoding_slots():
+            slot = self._slots[i]
+            req = slot.request
+            a = int(accepted[i])
+            emitted = [int(t) for t in drafted[i][:a]] \
+                + [int(next_tokens[i])]
+            emitted = emitted[:req.max_new_tokens - slot.generated]
+            m = len(emitted)
+            if tel is not None and req.token_s:
+                gap = max(now - req.token_s[-1], 0.0) / m
+                for _ in range(m):
+                    tel.observe_itl(gap)
+            req.tokens.extend(emitted)
+            req.token_s.extend([now] * m)
+            slot.generated += m
+            slot.length += m
+            slot.last_token = emitted[-1]
+            # the rewind: drop the reservation past the accepted
+            # frontier (pop order reverses allocation order, so the
+            # allocator's LIFO free list is restored exactly)
+            keep = blocks_needed(slot.length, B)
+            while slot.n_blocks > keep:
+                bid = slot.block_ids.pop()
+                slot.n_blocks -= 1
+                self.tables.assign(i, slot.n_blocks, DEAD_BLOCK)
+                self.allocator.free([bid])
+            if slot.generated >= req.max_new_tokens:
+                finished.append(self._finish(i, now))
+        return finished
 
     def note_decode(self, sampled: np.ndarray, now: float) -> List[Request]:
         """Record one decode step's samples; returns requests finished
@@ -627,6 +680,24 @@ class Scheduler:
     def blocks_held(self, i: int) -> int:
         """Pool blocks currently allocated to slot ``i``."""
         return self._slots[i].n_blocks
+
+    def slot_length(self, i: int) -> int:
+        """Live cache rows of slot ``i`` (the spec round's headroom
+        check reads this before reserving draft rows)."""
+        return self._slots[i].length
+
+    def slot_rid(self, i: int) -> int:
+        """Request id bound to slot ``i`` (the drafter's stream key)."""
+        return self._slots[i].request.rid
+
+    def slot_context(self, i: int) -> List[int]:
+        """Slot ``i``'s TRUE token stream — prompt plus every generated
+        token — the context the drafter proposes continuations of
+        (deliberately not the effective prompt: a resumed request's
+        stream is the unpreempted stream, so the drafter's incremental
+        frontier survives eviction)."""
+        req = self._slots[i].request
+        return [int(t) for t in req.prompt] + list(req.tokens)
 
     def note_step(self, step: int) -> None:
         """Record the engine's dispatch counter so lifecycle events can

@@ -59,7 +59,7 @@ __all__ = ["ServeTelemetry"]
 # ENGINE-level transition, rid -1 — a weight hot-swap landed between
 # dispatch steps)
 PHASES = ("submit", "admit", "prefill_chunk", "first_token", "decode",
-          "finish", "evict", "swap")
+          "finish", "evict", "swap", "spec")
 
 
 class _InFlight:
@@ -162,6 +162,17 @@ class ServeTelemetry:
         self.prefix_miss_requests = 0
         # weight hot-swaps applied between dispatch steps (ISSUE 14)
         self.swaps = 0
+        # speculative-decoding rounds (ISSUE 15): per SLOT-round
+        # accepted lengths accumulate into the serve record's
+        # acceptance rate (spec_slot_rounds counts slot×dispatch —
+        # distinct from ServeStats.spec_rounds, which counts dispatches)
+        self.spec_slot_rounds = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.draft_k = 0
+        # the engine stamps its pool-quantization knob here at serve
+        # start so the record names the pool it measured
+        self.kv_dtype: Optional[str] = None
 
         self._win_t0: Optional[float] = None
         self._win_tokens = 0
@@ -265,6 +276,23 @@ class ServeTelemetry:
         if source:
             fields["swap_source"] = str(source)
         self._emit("serve_event", **fields)
+        self.overhead_ns += time.perf_counter_ns() - t
+
+    def on_spec_round(self, rid: int, slot: int, accepted: int, k: int,
+                      step: int, now: float) -> None:
+        """One slot's speculative round: ``accepted`` of ``k`` drafted
+        tokens survived verification (the round emitted
+        ``accepted + 1`` tokens up to the request's budget). Feeds the
+        acceptance-rate accounting and one ``spec``-phase lifecycle
+        record."""
+        t = time.perf_counter_ns()
+        self.spec_slot_rounds += 1
+        self.spec_drafted += k
+        self.spec_accepted += accepted
+        self.draft_k = k
+        self._emit("serve_event", rid=rid, phase="spec", at_s=now,
+                   slot=int(slot), step=int(step),
+                   accepted_len=int(accepted), draft_k=int(k))
         self.overhead_ns += time.perf_counter_ns() - t
 
     def on_blocked(self, why: str, n: int = 1) -> None:
@@ -573,6 +601,18 @@ class ServeTelemetry:
             recompute_tokens=getattr(scheduler, "recompute_tokens", 0),
             swaps=self.swaps,
             blocks_resident=resident,
+            # speculative serving: acceptance accounting (only when spec
+            # rounds actually ran — a plain serve record stays unchanged)
+            **({"spec_slot_rounds": self.spec_slot_rounds,
+                "spec_drafted": self.spec_drafted,
+                "spec_accepted": self.spec_accepted,
+                "spec_acceptance_rate": round(
+                    self.spec_accepted / self.spec_drafted, 4),
+                "draft_k": self.draft_k}
+               if self.spec_drafted else {}),
+            # the pool-quantization knob the run served with (stamped
+            # by the engine; absent on float pools)
+            **({"kv_dtype": self.kv_dtype} if self.kv_dtype else {}),
             serve_anomaly=self.anomaly_section(allocator),
             admission_blocked_slots=self.admission_blocked_slots,
             admission_blocked_blocks=self.admission_blocked_blocks,

@@ -1,0 +1,47 @@
+"""Speculative decoding: amortize the target model's weight/KV
+streaming over k drafted tokens per step.
+
+Decode is memory-bandwidth-bound — every generated token streams the
+whole model plus the live KV cache through HBM for ONE row of logits.
+Speculation attacks the per-token factor directly: a cheap drafter
+proposes ``k`` tokens, the target model scores all ``k+1`` positions in
+one multi-token step (the chunked-prefill/flash machinery the engines
+already have), and a fused verify-and-sample tail
+(:func:`apex_tpu.ops.fused_verify` — extending the arXiv:2502.17728
+operation-fusion argument from the sampling tail to the whole
+accept/reject tail) emits the longest accepted prefix plus the
+corrected next token. Acceptance is EXACT: greedy spec output is
+token-identical to the non-speculative baseline, and the
+temperature/top-p path is rejection sampling under the same filtered
+distribution the fused sampling tail draws from — drafter quality
+moves THROUGHPUT (the acceptance rate), never the distribution.
+
+This package is the drafter side:
+
+* :class:`~apex_tpu.spec.drafter.Drafter` — the protocol: a static
+  ``k``, ``propose(stream, context)``, per-stream state keyed by
+  request id (preemption-safe: a resumed stream's context re-grows
+  token-identically, so the incremental frontier survives eviction).
+* :class:`~apex_tpu.spec.drafter.NGramDrafter` — host-side n-gram
+  lookahead: zero device memory, zero extra compiled programs.
+* :class:`~apex_tpu.spec.drafter.ModelDrafter` — a small ``GPTConfig``
+  model with a per-stream KV cache behind ONE batch-1 jitted step
+  (stable avals; compiled once across streams/rounds/churn).
+
+The device side lives in the engines: ``DecodeEngine.generate(...,
+draft=...)`` (batch-1 spec rounds over the contiguous cache) and
+``ServingEngine.serve(..., draft=...)`` (batched spec rounds over the
+whole slot array, interleaved with chunked prefill, with block-table/
+length rewind to the accepted frontier under churn). ``bench.py
+--spec`` measures tokens/s/request and acceptance rate into a
+schema-validated ``spec`` record; see ``docs/api/inference.md`` for
+the acceptance math and the rewind contract.
+"""
+
+from apex_tpu.spec.drafter import (  # noqa: F401
+    MAX_DRAFT_K,
+    Drafter,
+    ModelDrafter,
+    NGramDrafter,
+    validate_drafter,
+)

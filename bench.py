@@ -73,6 +73,16 @@ step time (``save_overhead_pct``, the series ``tools/bench_history.py``
 gates lower-is-better), snapshot/write/commit split, plus the bitwise
 same-dp and elastic dp-resize resume witnesses measured in-process —
 as one ``ckpt`` monitor record (same SKIP semantics off-TPU).
+
+``python bench.py --spec`` runs the speculative-decoding +
+quantized-KV leg (:func:`spec_main`): greedy generation with an n-gram
+drafter vs the plain decode loop — tokens/s/request at batch 1 AND
+under scheduler churn (``ServingEngine.serve(draft=...)``), the
+acceptance rate, the greedy/churn parity witnesses, and the int8 KV
+pool's teacher-forced logit error vs the float oracle — as one CLOSED
+``spec`` monitor record (``tools/bench_history.py`` gates
+``spec_tokens_per_s_request`` and the acceptance-rate series
+higher-is-better; same SKIP semantics off-TPU).
 """
 
 import json
@@ -573,6 +583,239 @@ def serve_main():
     if errors:
         raise ValueError(f"serve bench record failed validation: {errors}")
     print(json.dumps(record))
+
+
+def spec_main():
+    """``python bench.py --spec`` — the speculative-decoding +
+    quantized-KV leg (ROADMAP item 3, both factors of the decode-
+    bandwidth attack in one artifact):
+
+    * **Batch-1 speculation**: greedy generation through
+      ``DecodeEngine.generate(draft=NGramDrafter(k))`` vs the plain
+      decode loop — tokens/s/request both ways, the speedup ratio, the
+      measured acceptance rate that explains it, and the greedy-parity
+      witness (spec output token-identical to the baseline) with every
+      jitted body's cache size pinned at 1.
+    * **Speculation under churn**: the same comparison through
+      ``ServingEngine.serve(draft=...)`` on a seeded multi-request
+      trace — spec rounds interleaving with chunked prefill, block
+      tables rewound to the accepted frontier each round — with the
+      whole-sweep token parity witness (``churn_parity``).
+    * **int8 KV quantization**: the ``kv_dtype="int8"`` pool vs the
+      float parity oracle, decode logits TEACHER-FORCED through both
+      on identical contexts so the reported ``kv_quant_logit_err`` is
+      a per-position bound, not a divergence artifact; pool footprints
+      for both ride along.
+
+    Emits ONE schema-validated ``spec`` record (a CLOSED schema — junk
+    keys fail) and prints it as one JSON line. On TPU the record is
+    ``status: "OK"``; off-TPU it is an explicit ``status: "SKIP"`` with
+    a reason — the smoke-scale measurements ride along as finite
+    numbers, but a SKIP record claims no serving result. Never nan in
+    an OK line."""
+    import numpy as np
+
+    on_tpu = jax.default_backend() == "tpu"
+    monitor.enable_from_env()
+    from apex_tpu.inference import DecodeEngine
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.serving import ServingEngine
+    from apex_tpu.spec import NGramDrafter
+
+    if on_tpu:
+        # the flagship decode-bench config; k=4 drafted tokens per round
+        cfg = dict(vocab_size=32768, max_seq_len=1024, hidden_size=1024,
+                   num_layers=12, num_heads=8, tp_size=1, remat=False,
+                   attention_impl="flash", scan_layers=False)
+        prompt_len, new_tokens, passes, k = 512, 128, 3, 4
+        slots, block, chunk, n_req = 4, 128, 128, 16
+        quant_tokens = 32
+        cast = jnp.bfloat16
+    else:  # smoke scale; the record is SKIP either way
+        cfg = dict(vocab_size=256, max_seq_len=256, hidden_size=64,
+                   num_layers=2, num_heads=4, tp_size=1, remat=False,
+                   attention_impl="flash")
+        prompt_len, new_tokens, passes, k = 32, 16, 2, 4
+        slots, block, chunk, n_req = 2, 16, 16, 6
+        quant_tokens = 8
+        cast = None
+
+    model = GPTModel(GPTConfig(**cfg))
+    params = model.init(jr.PRNGKey(0))
+    if cast is not None:
+        params = jax.tree.map(lambda x: x.astype(cast), params)
+    # a self-similar prompt (a tiled pattern): speculation's payoff is
+    # acceptance, and acceptance needs guessable continuations — this is
+    # the honest analog of the code/chat traffic speculation targets
+    pat = np.asarray(jr.randint(jr.PRNGKey(1), (max(prompt_len // 4, 1),),
+                                0, cfg["vocab_size"]), np.int32)
+    prompt = np.tile(pat, 4)[:prompt_len]
+    deng = DecodeEngine(model, cache_dtype=cast)
+    drafter = NGramDrafter(k=k)
+
+    # compile + the parity witness
+    want = np.asarray(deng.generate(params, jnp.asarray(prompt)[None],
+                                    new_tokens))
+    spec_out = np.asarray(deng.generate(params, jnp.asarray(prompt)[None],
+                                        new_tokens, draft=drafter))
+    greedy_parity = bool((spec_out == want).all())
+    stats = deng.last_spec_stats
+    jit_cache_ok = (deng.spec_verify_step._cache_size() == 1
+                    and deng.decode_step._cache_size() == 1)
+
+    # timed passes: min-of-passes headline, spread as the noise bar
+    base_times, spec_times = [], []
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        out = deng.generate(params, jnp.asarray(prompt)[None], new_tokens)
+        jax.block_until_ready(out)
+        base_times.append(time.perf_counter() - t0)
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        out = deng.generate(params, jnp.asarray(prompt)[None], new_tokens,
+                            draft=drafter)
+        jax.block_until_ready(out)
+        spec_times.append(time.perf_counter() - t0)
+    tps_spec = new_tokens / min(spec_times)
+    tps_base = new_tokens / min(base_times)
+    spread = (max(spec_times) - min(spec_times)) / min(spec_times)
+
+    # --- speculation under churn: the serving engine with spec rounds --------
+    # the trace is seed-determined, so each run gets a FRESH but
+    # token-identical request list (a served Request carries its output)
+    def trace():
+        return build_serve_trace(
+            SERVE_TRACE_SEED, n_req, 2000.0, cfg["vocab_size"],
+            (4, max(prompt_len // 2, 8)), (2, max(new_tokens // 2, 4)))
+
+    base_eng = ServingEngine(model, num_slots=slots, block_size=block,
+                             prefill_chunk=chunk, cache_dtype=cast)
+    done = base_eng.serve(params, trace(), telemetry=False)
+    base_tokens = {r.rid: list(r.tokens) for r in done}
+    t0 = time.perf_counter()
+    done = base_eng.serve(params, trace(), telemetry=False)
+    churn_base_s = time.perf_counter() - t0
+    spec_eng = ServingEngine(model, num_slots=slots, block_size=block,
+                             prefill_chunk=chunk, cache_dtype=cast)
+    done = spec_eng.serve(params, trace(), telemetry=False,
+                          draft=NGramDrafter(k=k))
+    churn_parity = all(list(r.tokens) == base_tokens[r.rid] for r in done)
+    jit_cache_ok = (jit_cache_ok
+                    and spec_eng.prefill_chunk._cache_size() == 1
+                    and spec_eng.spec_step._cache_size() == 1
+                    and spec_eng.decode_step._cache_size() <= 1)
+    t0 = time.perf_counter()
+    done = spec_eng.serve(params, trace(), telemetry=False,
+                          draft=NGramDrafter(k=k))
+    churn_spec_s = time.perf_counter() - t0
+    total = sum(len(r.tokens) for r in done)
+    tps_churn = total / churn_spec_s
+    tps_churn_base = total / churn_base_s
+
+    # --- int8 KV pool vs the float parity oracle -----------------------------
+    kv_err, q_mb, o_mb = _spec_quant_err(
+        model, params, prompt, quant_tokens, slots=1, block=block,
+        chunk=chunk, cast=cast)
+
+    fields = dict(
+        tokens_per_s_request=round(tps_spec, 1),
+        baseline_tokens_per_s_request=round(tps_base, 1),
+        speedup=round(tps_spec / tps_base, 4),
+        tokens_per_s_churn=round(tps_churn, 1),
+        baseline_tokens_per_s_churn=round(tps_churn_base, 1),
+        speedup_churn=round(tps_churn / tps_churn_base, 4),
+        acceptance_rate=round(stats.acceptance_rate, 4),
+        accepted_per_round=round(stats.accepted / stats.rounds, 3)
+        if stats.rounds else 0.0,
+        rounds=stats.rounds,
+        draft_k=k, drafter="ngram",
+        kv_dtype="int8",
+        kv_quant_logit_err=round(kv_err, 5),
+        kv_quant_pool_mb=round(q_mb, 3),
+        kv_oracle_pool_mb=round(o_mb, 3),
+        greedy_parity=greedy_parity,
+        churn_parity=bool(churn_parity),
+        jit_cache_ok=bool(jit_cache_ok),
+        prompt_len=prompt_len, new_tokens=new_tokens, requests=n_req,
+        spread_pct=round(spread * 100, 2),
+        pass_times_ms=[round(t * 1e3, 2) for t in spec_times],
+        config=cfg, backend=jax.default_backend(),
+    )
+    assert greedy_parity and churn_parity, \
+        "speculative decode diverged from the non-speculative baseline"
+    assert jit_cache_ok, "a spec body re-traced (unstable avals?)"
+    if on_tpu:
+        status = "OK"
+    else:
+        fields["reason"] = (
+            f"speculative-decode throughput is a TPU measurement; this "
+            f"is a {jax.default_backend()} smoke run")
+        status = "SKIP"
+
+    if monitor.enabled():
+        record = monitor.get_registry().emit_spec(status, **fields)
+    else:  # sink-less registry: same construction+honesty path, no file
+        record = monitor.MetricsRegistry().emit_spec(status, **fields)
+    errors = monitor.validate(record)
+    if errors:
+        raise ValueError(f"spec bench record failed validation: {errors}")
+    print(json.dumps(record))
+
+
+def _spec_quant_err(model, params, prompt, n_tokens, *, slots, block,
+                    chunk, cast):
+    """Max |Δlogit| between the int8 pool and the float parity oracle,
+    TEACHER-FORCED: both engines decode the oracle's token stream on
+    identical contexts, so the bound measures quantization, not
+    divergence. Returns ``(max_err, int8_pool_mb, oracle_pool_mb)``."""
+    import numpy as np
+
+    from apex_tpu.serving import Request, ServingEngine
+
+    prompt = np.asarray(prompt[:max(len(prompt) // 2, 4)], np.int32)
+    key0 = jr.PRNGKey(0)
+
+    def drive(engine, forced=None):
+        sched = engine.make_scheduler(prefix_cache=False)
+        sched.submit(Request(rid=0, prompt=prompt,
+                             max_new_tokens=n_tokens))
+        sched.admit(0.0)
+        pool = engine.init_pool()
+        while True:
+            w = sched.next_prefill(0.0)
+            if w is None:
+                break
+            pool, tok, _ = engine.prefill_chunk(
+                params, pool, jnp.asarray(sched.tables.row(w.slot)),
+                jnp.asarray(w.tokens), jnp.int32(w.start),
+                jnp.int32(w.live), key0)
+            sched.note_prefill(w, int(tok), 0.0)
+        rows, toks_out = [], []
+        for t in range(n_tokens - 1):
+            batch = sched.decode_batch(0.0)
+            if batch is None:
+                break
+            toks, lens = batch
+            pool, sampled, logits = engine.decode_step(
+                params, pool, jnp.asarray(sched.tables.asarray()),
+                jnp.asarray(toks), jnp.asarray(lens), key0)
+            sampled = np.asarray(sampled).copy()
+            if forced is not None:  # teacher-force the oracle's stream
+                sampled[0] = forced[t]
+            rows.append(np.asarray(logits[0], np.float32))
+            toks_out.append(int(sampled[0]))
+            sched.note_decode(sampled, 0.0)
+        return np.stack(rows), toks_out
+
+    oracle = ServingEngine(model, num_slots=slots, block_size=block,
+                           prefill_chunk=chunk, cache_dtype=cast)
+    l_oracle, forced = drive(oracle)
+    quant = ServingEngine(model, num_slots=slots, block_size=block,
+                          prefill_chunk=chunk, cache_dtype=cast,
+                          kv_dtype="int8")
+    l_quant, _ = drive(quant, forced=forced)
+    err = float(np.max(np.abs(l_quant - l_oracle)))
+    return err, quant.pool_bytes() / 1e6, oracle.pool_bytes() / 1e6
 
 
 def longseq_bias_main():
@@ -1640,5 +1883,7 @@ if __name__ == "__main__":
         plan_main([a for a in sys.argv[1:] if a != "--plan"])
     elif "--ckpt" in sys.argv[1:]:
         ckpt_main()
+    elif "--spec" in sys.argv[1:]:
+        spec_main()
     else:
         main()

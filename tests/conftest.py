@@ -224,6 +224,17 @@ _SLOW_OFF_TPU = {
     "tests/test_serve_telemetry.py::TestServeWindows::test_skip_windows_carry_reason",  # window emission: test_windows_emit_and_validate stays; SKIP-reason contract: test_telemetry_requires_skip_reason + TestReportAndValidator::test_emitter_honesty_on_windows stay
     "tests/test_serve_telemetry.py::TestReportAndValidator::test_aggregate_carries_window_summary_and_anomalies",  # timeline/report path: test_serve_timeline_rows_and_rendering stays; serve-record aggregation: test_serving TestServeRecord stays
     "tests/test_serve_telemetry.py::TestLifecycleStream::test_queue_wait_covers_held_admission",  # lifecycle stream: test_event_sequence_and_payloads stays; blocked-by counters: TestSchedulerTelemetrySeam::test_blocked_by_blocks_vs_slots stays (engine-free)
+    # r11 (speculative-decoding PR): the heaviest full-engine spec
+    # sweeps move here (same contract: `-m ''` and hardware still run
+    # them; each row names the sibling that keeps its family covered
+    # in tier-1):
+    "tests/test_spec.py::TestServingSpec::test_churn_parity_model_drafter",  # model-drafter parity: TestDecodeEngineSpec::test_greedy_parity_both_drafters stays; serve churn parity: test_churn_parity_ngram stays
+    "tests/test_spec.py::TestServingSpec::test_churn_parity_under_pool_pressure",  # preempt-during-spec rewind: TestRewindContract::test_all_rejected_round_restores_pool_state stays; plain churn parity: test_churn_parity_ngram stays
+    "tests/test_spec.py::TestServingSpec::test_int8_spec_matches_int8_plain",  # int8 pool: TestQuantizedKV::test_logit_error_bounded_vs_float_oracle + test_quantized_serve_stream_is_reasonable stay; spec churn: test_churn_parity_ngram stays
+    "tests/test_spec.py::TestDecodeEngineSpec::test_self_drafter_accepts_everything",  # parity: test_greedy_parity_both_drafters stays; acceptance accounting: TestServingSpec::test_spec_telemetry_events_and_acceptance stays
+    "tests/test_spec.py::TestDecodeEngineSpec::test_sampled_spec_generates_within_bounds",  # sampled verify semantics: TestFusedVerify::test_kernel_matches_fallback_sampled + test_sampled_acceptance_is_exact_for_sure_things stay
+    "tests/test_spec.py::TestDrafters::test_model_drafter_single_compile_across_streams",  # drafter-step cache pin: test_greedy_parity_both_drafters asserts md.engine.decode_step._cache_size() == 1
+    "tests/test_spec.py::TestFusedVerify::test_kernel_handles_long_drafts[32]",  # [8] (the first broken lane width) stays tier-1; 32 is the same 128-lane block
 }
 
 

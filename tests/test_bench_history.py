@@ -265,3 +265,89 @@ class TestPrefixHitLatencySeries:
         assert "OK serve_tokens_per_s" in out
         assert "SKIP: no history artifact carries metric " \
             "'serve_prefix_hit_ttft_p50_ms'" in out
+
+
+class TestSpecSeries:
+    """ISSUE 15 satellite: an OK spec record gates its per-request
+    throughput (higher-is-better) AND its acceptance rate as a tracked
+    series; pre-spec history artifacts SKIP the new series only."""
+
+    def _spec(self, tps, rate=None, status="OK", spread=0.0):
+        rec = {"kind": "spec", "schema": 1, "status": status,
+               "tokens_per_s_request": tps, "spread_pct": spread}
+        if status == "SKIP":
+            rec["reason"] = "no TPU"
+        if rate is not None:
+            rec["acceptance_rate"] = rate
+        return rec
+
+    def test_extract_all_carries_both_series(self):
+        rows = bench_history.extract_all(self._spec(900.0, 0.8))
+        assert ("spec_tokens_per_s_request", 900.0, 0.0) in rows
+        assert ("spec_acceptance_rate", 0.8, 0.0) in rows
+        # the per-request throughput is the PRIMARY claim
+        assert bench_history.extract(self._spec(900.0, 0.8))[0] == \
+            "spec_tokens_per_s_request"
+        # a rate that rode as a skip object is not gated
+        rec = self._spec(900.0)
+        rec["acceptance_rate"] = {"skipped": True, "reason": "no rounds"}
+        assert bench_history.extract_all(rec) == [
+            ("spec_tokens_per_s_request", 900.0, 0.0)]
+
+    def test_ok_record_without_throughput_is_an_error(self):
+        with pytest.raises(ValueError, match="tokens_per_s_request"):
+            bench_history.extract_all(
+                {"kind": "spec", "schema": 1, "status": "OK"})
+
+    def test_throughput_regression_fails(self, tmp_path, capsys):
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps(self._spec(1000.0, 0.8)))
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(self._spec(800.0, 0.8)))
+        rc = bench_history.main([str(fresh), "--root", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "REGRESSION spec_tokens_per_s_request" in out
+        assert "OK spec_acceptance_rate" in out
+
+    def test_acceptance_collapse_fails(self, tmp_path, capsys):
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps(self._spec(1000.0, 0.8)))
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(self._spec(1000.0, 0.4)))
+        rc = bench_history.main([str(fresh), "--root", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "OK spec_tokens_per_s_request" in out
+        assert "REGRESSION spec_acceptance_rate" in out
+
+    def test_skip_record_claims_nothing(self, tmp_path, capsys):
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps(self._spec(1000.0, 0.8)))
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(self._spec(1.0, 0.01, status="SKIP")))
+        assert bench_history.main([str(fresh),
+                                   "--root", str(tmp_path)]) == 0
+        assert "SKIP" in capsys.readouterr().out
+
+    def test_pre_spec_history_skips_the_new_series_only(self, tmp_path,
+                                                        capsys):
+        """The REAL upgrade path: the checked-in trajectory predates
+        the spec leg entirely — a fresh OK spec record must SKIP both
+        of its series (exit 0), while a flagship artifact in the same
+        history still gates its own metric (regression-tested: the
+        pre-spec artifacts are untouched, only the spec series are
+        absent)."""
+        _hist(tmp_path, [(100.0, 0.5)])  # pre-spec flagship history
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(self._spec(900.0, 0.8)))
+        rc = bench_history.main([str(fresh), "--root", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "SKIP: no history artifact carries metric " \
+            "'spec_tokens_per_s_request'" in out
+        assert "SKIP: no history artifact carries metric " \
+            "'spec_acceptance_rate'" in out
+        # the flagship series still gates against the same history
+        assert bench_history.main([_fresh(tmp_path, 90.0),
+                                   "--root", str(tmp_path)]) == 1

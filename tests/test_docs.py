@@ -107,7 +107,15 @@ def test_inference_doc_covers_serving_contract():
                    "trace_seed", "num_resident",
                    # ISSUE 14: the weight hot-swap contract
                    "request_swap", "contents-only mutation",
-                   "restore_params", "swap", "pinned at 1"):
+                   "restore_params", "swap", "pinned at 1",
+                   # ISSUE 15: speculative decoding + quantized KV
+                   "fused_verify", "NGramDrafter", "ModelDrafter",
+                   "Acceptance math", "rewind contract",
+                   "token-identical", "accepted prefix",
+                   "rejection sampling", "kv_dtype", "int8",
+                   "parity oracle", "kv_quant_logit_err",
+                   "bench.py --spec", "acceptance_rate",
+                   "spec_verify_step", "lookahead"):
         assert needle in text, f"inference.md dropped {needle}"
 
 
@@ -149,7 +157,11 @@ def test_guide_covers_the_ladder():
                    # ISSUE 14: the checkpoint/resume chapter
                    "ZeroCheckpointManager", "gather_zero_state",
                    "scatter_zero_state", "restore_params",
-                   "bench.py --ckpt", "save_overhead_pct"):
+                   "bench.py --ckpt", "save_overhead_pct",
+                   # ISSUE 15: the §10d drafter recipe
+                   "NGramDrafter", "ModelDrafter", "fused_verify",
+                   "acceptance_rate", "kv_dtype", "bench.py --spec",
+                   "spec_verify_step"):
         assert needle in text, f"guide dropped {needle}"
 
 

@@ -20,9 +20,16 @@ that trajectory into a gate a CI leg can run after a fresh bench:
   ``tokens_per_s``). An OK ``serve`` record additionally carries its
   ``prefix_hit_ttft_p50_ms`` as a LOWER-is-better latency series (the
   serving-tier-2 headline: a prefix hit must stay fast across the
-  trajectory). A ``status: "SKIP"`` record carries no claim and
-  is *skipped* by the gate (exit 0 with a SKIP line) — an off-TPU
-  smoke can never "regress".
+  trajectory). An OK ``spec`` record carries TWO higher-is-better
+  series: ``spec_tokens_per_s_request`` (the speculative-decoding
+  headline) and ``spec_acceptance_rate`` (the drafter-quality series
+  that explains it — a silent acceptance collapse would eventually
+  surface as a throughput regression anyway, but gating it directly
+  names the cause). History artifacts that predate a series simply
+  carry no point for it, so a fresh record's NEW series SKIP
+  individually while its established ones still gate. A ``status:
+  "SKIP"`` record carries no claim and is *skipped* by the gate
+  (exit 0 with a SKIP line) — an off-TPU smoke can never "regress".
 * **Comparison** is against the LATEST history artifact whose metric
   name matches the fresh one (the trajectory's newest point — the
   number the README quotes). The allowance is
@@ -120,6 +127,28 @@ def extract_all(obj: Dict[str, Any], label: str = "artifact"
                 f"{label}: OK plan record has no numeric "
                 "predicted_vs_measured_err_pct")
         return [("plan_predicted_vs_measured_err_pct", float(v), 0.0)]
+    if kind == "spec":
+        # the speculative-decoding leg: per-request throughput is the
+        # headline, the acceptance rate the tracked drafter-quality
+        # series (both higher-is-better). Pre-spec history artifacts
+        # carry neither series — the per-series comparison SKIPs them
+        # individually, never the whole gate.
+        if obj.get("status") == "SKIP":
+            return []
+        v = obj.get("tokens_per_s_request")
+        if not isinstance(v, (int, float)):
+            raise ValueError(
+                f"{label}: OK spec record has no numeric "
+                "tokens_per_s_request")
+        spread = obj.get("spread_pct")
+        spread = float(spread) if isinstance(spread, (int, float)) else 0.0
+        rows = [("spec_tokens_per_s_request", float(v), spread)]
+        rate = obj.get("acceptance_rate")
+        if isinstance(rate, (int, float)):
+            # the record's spread_pct is throughput variance; it says
+            # nothing about acceptance variance
+            rows.append(("spec_acceptance_rate", float(rate), 0.0))
+        return rows
     if kind == "ckpt":
         # the checkpoint leg's gated series is its measured per-step
         # save overhead — lower-is-better in absolute points (a clean
@@ -169,7 +198,7 @@ def load_json(path: str) -> Any:
             if isinstance(obj, dict) and (
                     "metric" in obj
                     or obj.get("kind") in _THROUGHPUT_KINDS
-                    or obj.get("kind") in ("plan", "ckpt")):
+                    or obj.get("kind") in ("plan", "ckpt", "spec")):
                 claimed = obj
         if last is None:
             raise ValueError(f"{path}: empty file")

@@ -13,6 +13,7 @@ Usage::
     python tools/validate_metrics.py --static-cost static_cost.jsonl ...
     python tools/validate_metrics.py --plan plan.jsonl ...
     python tools/validate_metrics.py --ckpt ckpt.jsonl ...
+    python tools/validate_metrics.py --spec spec.jsonl ...
 
 Dispatch is by content, not extension:
 
@@ -62,14 +63,17 @@ Dispatch is by content, not extension:
   closed schemas, so a junk key fails), and ``ckpt`` records
   (``python bench.py --ckpt``: the elastic-checkpoint save-cost leg —
   its ``manifest`` section is a closed schema, so a junk manifest key
-  fails)
+  fails), and ``spec`` records (``python bench.py --spec``: the
+  speculative-decoding + quantized-KV leg — a CLOSED schema, so a junk
+  key fails, and its OK line engages the no-nan honesty rule like
+  every status record)
   dispatch on ``kind`` like every monitor record. ``--profile`` /
   ``--serve`` / ``--serve-window`` / ``--pipeline`` / ``--costdb`` /
-  ``--static-cost`` / ``--plan`` / ``--ckpt`` force EVERY listed file
-  to be judged as that artifact (same rationale as ``--lint-report``:
-  an artifact that lost its ``kind`` key must fail as a bad profile/
-  serve/pipeline/costdb/static_cost/plan/ckpt, not as an unrecognized
-  shape).
+  ``--static-cost`` / ``--plan`` / ``--ckpt`` / ``--spec`` force EVERY
+  listed file to be judged as that artifact (same rationale as
+  ``--lint-report``: an artifact that lost its ``kind`` key must fail
+  as a bad profile/serve/pipeline/costdb/static_cost/plan/ckpt/spec,
+  not as an unrecognized shape).
 
 Exit status 0 when every file is clean; 1 otherwise, with one problem per
 line on stderr. The logic lives in ``apex_tpu.monitor.schema`` so tests
@@ -210,10 +214,12 @@ def main(argv=None) -> int:
         force_kind = "plan"
     elif "--ckpt" in argv:
         force_kind = "ckpt"
+    elif "--spec" in argv:
+        force_kind = "spec"
     argv = [a for a in argv
             if a not in ("--lint-report", "--costdb", "--profile",
                          "--serve", "--serve-window", "--pipeline",
-                         "--static-cost", "--plan", "--ckpt")]
+                         "--static-cost", "--plan", "--ckpt", "--spec")]
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
