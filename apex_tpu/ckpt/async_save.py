@@ -36,6 +36,8 @@ import numpy as np
 
 from apex_tpu.ckpt import sharded as _sharded
 from apex_tpu.ckpt.sharded import SimulatedCrash
+from apex_tpu.monitor import registry as _reg
+from apex_tpu.monitor import trace as _trace
 
 PyTree = Any
 
@@ -63,6 +65,10 @@ class AsyncZeroSaver:
         self._error: Optional[BaseException] = None
         self.crashed = False          # a SimulatedCrash consumed the save
         self.last_timings: Dict[str, float] = {}
+        # the most recent save's trace id: joins the snapshot (step
+        # path) and commit (writer thread) records of ONE save in a
+        # merged timeline — and what a ckpt bench record stamps
+        self.last_trace_id: Optional[str] = None
 
     def save(self, directory: str, state, *, dp: int,
              params: Optional[PyTree] = None, scaler_state: Any = None,
@@ -90,6 +96,14 @@ class AsyncZeroSaver:
         snapshot_ms = (time.perf_counter() - t0) * 1e3
         timings = {"snapshot_ms": round(snapshot_ms, 3)}
         self.last_timings = timings
+        # one trace id per SAVE (reusing an ambient train-step context
+        # when one is active): the step-path snapshot record and the
+        # writer thread's commit record carry it explicitly, so the two
+        # halves of an async save join across threads in a timeline
+        tid = _trace.current_trace_id() or _trace.new_trace_id("ckpt")
+        self.last_trace_id = tid
+        _reg.emit_event("ckpt_save_start", trace_id=tid, step=int(step),
+                        snapshot_ms=timings["snapshot_ms"])
 
         def _write():
             t1 = time.perf_counter()
@@ -100,6 +114,11 @@ class AsyncZeroSaver:
                     fault=self._fault)
                 timings["write_ms"] = round(
                     (time.perf_counter() - t1) * 1e3, 3)
+                # explicit trace_id: the writer thread must not inherit
+                # whatever ambient context the TRAIN thread is in now
+                _reg.emit_event("ckpt_commit", trace_id=tid,
+                                step=int(step),
+                                write_ms=timings["write_ms"])
                 if on_commit is not None:
                     on_commit(step)
             except SimulatedCrash:

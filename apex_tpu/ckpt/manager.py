@@ -119,6 +119,12 @@ class ZeroCheckpointManager:
         (the ``ckpt`` bench record's raw material)."""
         return self._saver.last_timings
 
+    @property
+    def last_trace_id(self):
+        """The most recent save's trace id (joins its snapshot and
+        commit records; the ``ckpt`` bench record stamps it)."""
+        return self._saver.last_trace_id
+
     # -- restore ---------------------------------------------------------------
 
     def restore(self, params_template: PyTree, *, dp: int,

@@ -43,6 +43,7 @@ import numpy as np
 from apex_tpu.inference.sampling import sample_logits
 from apex_tpu.models.gpt import GPTModel
 from apex_tpu.monitor import spans as monitor_spans
+from apex_tpu.monitor import trace as monitor_trace
 from apex_tpu.ops import fused_layer_norm, fused_verify
 from apex_tpu.ops.pallas.attention import NEG_INF
 
@@ -395,18 +396,25 @@ class DecodeEngine:
             raise ValueError("temperature > 0 generation requires a key")
         if key is None:  # greedy: the key operand is ignored but keeps the
             key = jax.random.PRNGKey(0)  # step signature (and avals) fixed
-        if draft is not None:
-            return self._generate_spec(params, prompt, max_new_tokens,
-                                       key, draft)
-        cache, tok, _ = self.prefill(params, prompt,
-                                     jax.random.fold_in(key, 0))
-        out = [tok]
-        for t in range(1, max_new_tokens):
-            cache, tok, _ = self.decode_step(
-                params, cache, tok, jnp.int32(s + t - 1),
-                jax.random.fold_in(key, t))
-            out.append(tok)
-        return jnp.stack(out, axis=1)
+        # one trace id per generate() call: every span the loop emits
+        # (decode_prefill, decode_step, spec_verify) joins to this call
+        # in a merged timeline. An already-ambient id (a caller's serve/
+        # step context) is reused rather than shadowed.
+        tid = (monitor_trace.current_trace_id()
+               or monitor_trace.new_trace_id("gen"))
+        with monitor_trace.trace_context(tid):
+            if draft is not None:
+                return self._generate_spec(params, prompt,
+                                           max_new_tokens, key, draft)
+            cache, tok, _ = self.prefill(params, prompt,
+                                         jax.random.fold_in(key, 0))
+            out = [tok]
+            for t in range(1, max_new_tokens):
+                cache, tok, _ = self.decode_step(
+                    params, cache, tok, jnp.int32(s + t - 1),
+                    jax.random.fold_in(key, t))
+                out.append(tok)
+            return jnp.stack(out, axis=1)
 
 
 def jit_encoder(model, *, with_pooler: bool = True):

@@ -23,7 +23,15 @@ layer:
   (``tools/validate_metrics.py`` is the CLI);
 * :mod:`~apex_tpu.monitor.report` — ``python -m apex_tpu.monitor report
   events.jsonl`` aggregates the stream into a step-timeline summary
-  (tokens/s, spec-peak MFU, overflow rate, bubble %).
+  (tokens/s, spec-peak MFU, overflow rate, bubble %);
+* :mod:`~apex_tpu.monitor.trace` — request-scoped tracing: one
+  ``trace_id`` end-to-end (minted per serve request / serve call /
+  generate / checkpoint save, stamped on every record), the unified
+  monotonic clock behind ``t_ns``/``clock_sync``, Chrome trace-event
+  export (``python -m apex_tpu.monitor trace``), per-request latency
+  attribution (``report --attribution``) and the anomaly flight
+  recorder (a bounded ring of recent records, dumped on
+  ``serve_anomaly``/SIGTERM even when no JSONL sink is attached).
 
 Quick start::
 
@@ -59,6 +67,7 @@ from apex_tpu.monitor.registry import (  # noqa: F401
     emit_plan,
     emit_profile,
     emit_serve,
+    emit_serve_attribution,
     emit_serve_window,
     emit_spec,
     emit_tp_overlap,
@@ -88,7 +97,22 @@ from apex_tpu.monitor.schema import gate_metrics, validate, validate_jsonl  # no
 from apex_tpu.monitor.report import (  # noqa: F401
     PEAK_FLOPS_BY_DEVICE,
     aggregate,
+    format_attribution,
     format_serve_timeline,
+    serve_attribution_record,
     serve_timeline,
     spec_peak_flops,
+)
+from apex_tpu.monitor import trace  # noqa: F401
+from apex_tpu.monitor.trace import (  # noqa: F401
+    chrome_trace,
+    current_trace_id,
+    enable_flight_recorder,
+    disable_flight_recorder,
+    flight_dump,
+    get_flight_recorder,
+    new_trace_id,
+    serve_attribution,
+    trace_context,
+    write_chrome_trace,
 )

@@ -1,4 +1,6 @@
-"""CLI entry: ``python -m apex_tpu.monitor report events.jsonl``."""
+"""CLI entry: ``python -m apex_tpu.monitor report events.jsonl`` (step
+summary, ``--serve-timeline``, ``--attribution``) and ``python -m
+apex_tpu.monitor trace events.jsonl`` (Chrome trace-event export)."""
 
 import sys
 

@@ -46,6 +46,12 @@ import os
 import time
 from typing import Any, Dict, Optional, TextIO
 
+# trace imports nothing from the monitor package at module level (it
+# lazy-imports this module inside functions), so this edge is acyclic;
+# emit() reads its ambient trace-id stack and flight-recorder ring
+# directly as attribute loads to keep the per-record cost flat
+from apex_tpu.monitor import trace as _trace
+
 SCHEMA_VERSION = 1
 
 # The process-wide registry. ``None`` means monitoring is disabled and every
@@ -207,14 +213,26 @@ class MetricsRegistry:
             "schema": SCHEMA_VERSION,
             "kind": kind,
             "t_s": round(self._clock() - self._t0, 6),
+            # the unified clock: perf_counter_ns shares CLOCK_MONOTONIC
+            # with span t0_ns and the serve clock, so every stream joins
+            # on one base (the per-process clock_sync record anchors it
+            # to wall time)
+            "t_ns": _trace.monotonic_ns(),
             "process": _process_index(),
             "rank": _rank_info(),
         }
-        record.update(fields)
+        if _trace._STACK:
+            record["trace_id"] = _trace._STACK[-1]
+        record.update(fields)  # explicit trace_id=/t_ns= fields win
         # jsonify BEFORE the honesty check: numpy/jax nan scalars become
         # python floats/strings first, so they cannot evade the check
         record = _jsonify(record)
         check_record_honesty(record)
+        fr = _trace._FLIGHT
+        if fr is not None:
+            # the flight ring sees every record even with NO sink — that
+            # is what makes degraded sink-less runs debuggable post-hoc
+            fr._ring.append(record)
         if self._sink is not None:
             self._sink.write(json.dumps(record) + "\n")
             if not self._buffering:
@@ -324,6 +342,17 @@ class MetricsRegistry:
         quantization leg's bounded logit error vs the float oracle."""
         return self._emit_status_record("spec", status, **fields)
 
+    def emit_serve_attribution(self, status: str,
+                               **fields) -> Dict[str, Any]:
+        """Per-request latency-attribution record — the fields come from
+        :func:`apex_tpu.monitor.trace.serve_attribution` (queue /
+        prefill / decode / spec / spec-rewind / preempt-wait /
+        recompute / swap-pause partition of every request's measured
+        [submit, finish] window). OK only for real measurements; the
+        closed schema is the ServePlan pricing input."""
+        return self._emit_status_record("serve_attribution", status,
+                                        **fields)
+
     # -- step lifecycle ------------------------------------------------------
 
     def begin_step(self, step: Optional[int] = None) -> None:
@@ -420,6 +449,13 @@ def enable(path: Optional[str] = None, *,
     reg = MetricsRegistry(sink)
     reg._owns_sink = owns
     _REGISTRY = reg
+    # one clock_sync per process: the monotonic<->wall anchor that lets
+    # `monitor trace` join streams from different processes (and a
+    # device trace) without skew. Emitted before any meta record, so
+    # consumers must read the whole stream, not the last-run split.
+    reg.emit("clock_sync", mono_ns=_trace.monotonic_ns(),
+             wall_s=time.time(), clock="perf_counter_ns",
+             pid=os.getpid())
     return reg
 
 
@@ -560,6 +596,13 @@ def emit_spec(status: str, **fields) -> Optional[Dict[str, Any]]:
     r = _REGISTRY
     if r is not None:
         return r.emit_spec(status, **fields)
+    return None
+
+
+def emit_serve_attribution(status: str, **fields) -> Optional[Dict[str, Any]]:
+    r = _REGISTRY
+    if r is not None:
+        return r.emit_serve_attribution(status, **fields)
     return None
 
 

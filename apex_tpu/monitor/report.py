@@ -3,6 +3,13 @@
 ``python -m apex_tpu.monitor report events.jsonl`` prints a human summary
 (tokens/s, derived MFU, overflow rate, pipeline bubble %, collective
 volume); ``--json`` prints one machine-readable JSON object instead.
+``report --attribution`` decomposes each served request's e2e latency
+into queue/prefill/decode/spec/preempt/swap components (the
+``serve_attribution`` record); ``python -m apex_tpu.monitor trace``
+exports the stream as Chrome trace-event JSON (one track per rank, one
+per request — chrome://tracing / Perfetto). A requested section whose
+records are absent from the stream prints an explicit ``SKIP(reason)``
+line, never a silent empty section.
 
 The MFU convention is the same spec-peak one the bench artifact uses
 (``BENCH_r05.json``): analytic model FLOPs per token (from the ``meta``
@@ -425,6 +432,95 @@ def serve_timeline(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "stragglers": stragglers, "swaps": swaps}
 
 
+# --- per-request latency attribution (`report --attribution`) ----------------
+
+_ATTRIBUTION_SKIP_REASON = (
+    "stream carries no serve_event records — serve with a ServeTelemetry "
+    "attached and the monitor enabled")
+
+
+def serve_attribution_record(records: List[Dict[str, Any]]
+                             ) -> Optional[Dict[str, Any]]:
+    """The schema-validated ``serve_attribution`` record for a stream:
+    per-request e2e latency decomposed into the
+    :data:`~apex_tpu.monitor.trace.ATTR_COMPONENTS` partition. Returns
+    ``None`` when the stream carries no ``serve_event`` records (the
+    caller prints the explicit SKIP line). Appended multi-run streams
+    fold the LAST run only (the :func:`serve_timeline` rule — rids
+    restart per run). The record's status mirrors the stream's
+    ``serve`` record when one is present: a SKIP sweep prices nothing,
+    and the report must not promote its numbers."""
+    meta_idx = [i for i, r in enumerate(records)
+                if r.get("kind") == "meta"]
+    if len(meta_idx) > 1:
+        records = records[meta_idx[-1]:]
+    if not any(r.get("kind") == "serve_event" for r in records):
+        return None
+    # lazy: the plain report never pays for the trace/registry layers
+    from apex_tpu.monitor import registry as registry_lib
+    from apex_tpu.monitor import trace as trace_lib
+    from apex_tpu.monitor.schema import validate as validate_record
+
+    fields = trace_lib.serve_attribution(records, per_request=True)
+    serves = [r for r in records if r.get("kind") == "serve"]
+    status = serves[-1].get("status") if serves else None
+    reason = serves[-1].get("reason") if serves else None
+    if status not in ("OK", "SKIP"):
+        status = "SKIP"
+        reason = ("attribution computed post-hoc by `monitor report` "
+                  "from the lifecycle trail; the stream carries no "
+                  "serve record to inherit a measurement status from")
+    if status == "SKIP":
+        fields.setdefault("reason", reason or "serve record was SKIP")
+    record = registry_lib.MetricsRegistry().emit_serve_attribution(
+        status, **fields)
+    errors = validate_record(record)
+    if errors:  # a bug in this module, never a user input problem
+        raise ValueError(
+            f"serve_attribution record failed validation: {errors}")
+    return record
+
+
+def format_attribution(record: Dict[str, Any]) -> str:
+    """Render :func:`serve_attribution_record` as the terminal table:
+    one totals line, then one row per finished request showing its
+    NONZERO components (every request's components sum to its measured
+    e2e latency up to rounding — ``residual`` is the gap)."""
+    lines = []
+    mr = record.get("max_residual_pct")
+    lines.append(
+        f"serve attribution: {record.get('requests', 0)} requests"
+        + (f", {record['unattributed']} unattributed"
+           if record.get("unattributed") else "")
+        + f"  components {record.get('components_ms_total', 0.0):.1f} ms"
+          f" vs e2e {record.get('e2e_ms_total', 0.0):.1f} ms"
+        + (f"  (max residual {mr:.2f}%)"
+           if isinstance(mr, (int, float)) else "")
+        + (f"  [SKIP({record.get('reason', '?')})]"
+           if record.get("status") == "SKIP" else ""))
+    comp = record.get("components", {})
+    totals = [f"{k[:-3]} {v:.1f}" for k, v in comp.items()
+              if isinstance(v, (int, float)) and v > 0]
+    if totals:
+        lines.append("  totals (ms): " + "  ".join(totals))
+    for r in record.get("per_request", []):
+        parts = [f"{k[:-3]} {r[k]:.1f}" for k in comp
+                 if isinstance(r.get(k), (int, float)) and r[k] > 0]
+        lines.append(
+            f"  rid {r['rid']:>4}"
+            + (f" [{r['trace_id']}]" if r.get("trace_id") else "")
+            + f"  e2e {r.get('e2e_ms', 0.0):.1f}ms = "
+            + (" + ".join(parts) if parts else "0")
+            + (f"  (residual {r['residual_pct']:.2f}%)"
+               if isinstance(r.get("residual_pct"), (int, float))
+               else "")
+            + (f"  [evict x{r['evictions']}]" if r.get("evictions")
+               else "")
+            + (f"  [{r['spec_rounds']} spec rounds]"
+               if r.get("spec_rounds") else ""))
+    return "\n".join(lines)
+
+
 def _ms(v, nd=1) -> str:
     return f"{v:.{nd}f}ms" if isinstance(v, (int, float)) else "-"
 
@@ -755,10 +851,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     rep.add_argument("--serve-timeline", action="store_true",
                      help="per-request serving lifecycle (serve_event "
                           "records) + the serve_window SLO trail")
+    rep.add_argument("--attribution", action="store_true",
+                     help="per-request e2e latency decomposition (queue/"
+                          "prefill/decode/spec/preempt/swap components "
+                          "from the serve_event trail) as a validated "
+                          "serve_attribution record")
+    trc = sub.add_parser(
+        "trace", help="export the stream as Chrome trace-event JSON "
+                      "(chrome://tracing / Perfetto): one track per "
+                      "rank, one per request")
+    trc.add_argument("path", help="events.jsonl produced with monitoring "
+                                  "on")
+    trc.add_argument("--out", default=None,
+                     help="output path (default: <path>.trace.json; a "
+                          ".gz suffix gzips — both viewers load it)")
+    trc.add_argument("--device-trace", metavar="LOGDIR", default=None,
+                     help="jax.profiler log dir whose device events ride "
+                          "along on offset process ids (the span "
+                          "scope-prefix join)")
     args = parser.parse_args(argv)
 
     with open(args.path) as fh:
         records = read_records(fh)
+    if args.command == "trace":
+        return _trace_export_main(args, records)
     summary = aggregate(records)
 
     timeline = None
@@ -770,6 +886,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "the monitor enabled)", file=sys.stderr)
             return 2
         summary["serve_timeline"] = timeline
+
+    attribution = None
+    attribution_skip = None
+    if args.attribution:
+        attribution = serve_attribution_record(records)
+        if attribution is None:
+            # the requested-section-absent contract: an explicit
+            # SKIP(reason) line / stanza, never a silent empty section
+            attribution_skip = _ATTRIBUTION_SKIP_REASON
+            summary["serve_attribution"] = {
+                "status": "SKIP", "reason": attribution_skip}
+        else:
+            summary["serve_attribution"] = attribution
 
     anatomy_rows = None
     if args.anatomy:
@@ -795,11 +924,52 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(render(summary))
         if timeline is not None:
             print(format_serve_timeline(timeline))
+        if attribution is not None:
+            print(format_attribution(attribution))
+        elif attribution_skip is not None:
+            print(f"serve attribution: SKIP({attribution_skip})")
         if anatomy_rows is not None:
             from apex_tpu.prof.trace_reader import format_anatomy
 
             print("step anatomy (% of step wall):")
             print(format_anatomy(anatomy_rows))
+    return 0
+
+
+def _trace_export_main(args, records: List[Dict[str, Any]]) -> int:
+    """``python -m apex_tpu.monitor trace events.jsonl [--out ...]`` —
+    merge the stream (plus an optional profiler device trace) into one
+    Chrome trace-event JSON file."""
+    from apex_tpu.monitor import trace as trace_lib
+
+    device_events = None
+    if args.device_trace:
+        from apex_tpu.prof import trace_reader
+        try:
+            device_events = trace_reader.read_trace(args.device_trace)
+        except FileNotFoundError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    doc = trace_lib.chrome_trace(records, device_events=device_events)
+    slices = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    if not slices:
+        # nothing written: an empty export silently "succeeding" would
+        # hide that the run never emitted span/serve_event records
+        print("trace export: SKIP(stream carries no span/serve_event "
+              "records to export — run with the monitor enabled, e.g. "
+              "a serve with ServeTelemetry attached)")
+        return 2
+    out = args.out or (args.path + ".trace.json")
+    trace_lib.write_chrome_trace(out, records, doc=doc)
+
+    def _tracks(prefix: str) -> int:
+        return sum(
+            1 for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+            and str(e.get("args", {}).get("name", "")).startswith(prefix))
+
+    print(f"wrote {len(slices)} slices ({_tracks('req ')} request "
+          f"tracks, {_tracks('rank ')} rank tracks) to {out}")
     return 0
 
 

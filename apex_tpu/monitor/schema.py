@@ -61,6 +61,13 @@ _COMMON = {
     "t_s": {"type": "number"},
     "process": {"type": "integer"},
     "rank": {"type": "string"},
+    # the unified monotonic clock (ISSUE 16): every record the registry
+    # emits is stamped with time.perf_counter_ns() — the SAME base spans'
+    # t0_ns and the serve clock ride — and, when an ambient trace context
+    # is active (or the emitter stamped one explicitly), the request/step/
+    # save-scoped trace_id that joins records across streams
+    "t_ns": {"type": "integer"},
+    "trace_id": {"type": "string"},
 }
 
 STEP_SCHEMA = {
@@ -836,6 +843,107 @@ SPEC_SCHEMA = {
     "additionalProperties": False,
 }
 
+# per-process clock-sync record (ISSUE 16): the monotonic↔wall offset
+# emitted once at monitor.enable() — `mono_ns` (time.perf_counter_ns)
+# and `wall_s` (time.time) read back to back, so any consumer can map
+# the unified `t_ns` base of this process's records onto wall time (and
+# onto another process's stream through ITS clock_sync record). CLOSED:
+# a junk key fails validation.
+CLOCK_SYNC_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["clock_sync"]},
+        "mono_ns": {"type": "integer"},   # time.perf_counter_ns()
+        "wall_s": {"type": "number"},     # time.time(), same instant
+        "clock": {"type": "string"},      # the monotonic source's name
+        "pid": {"type": "integer"},
+    },
+    "required": ["schema", "kind", "mono_ns", "wall_s"],
+    "additionalProperties": False,
+}
+
+# TTFT/latency attribution record (`monitor report --attribution`,
+# `bench.py --serve`, monitor.trace.serve_attribution): each request's
+# end-to-end latency decomposed into queue / prefill / decode / spec /
+# spec-rewind / preempt-wait / recompute / swap-pause components. The
+# components PARTITION [submit, finish] by construction (decode is the
+# interval remainder after the spec/swap carve-outs), so per request
+# they sum to the measured e2e latency up to rounding — the exact
+# priced-phase input ServePlan pricing consumes (ROADMAP item 2). Both
+# the record and its per-request rows are CLOSED schemas; status "OK"
+# engages the no-nan honesty rule like every status record.
+_ATTR_COMPONENTS = ("queue_ms", "prefill_ms", "decode_ms", "spec_ms",
+                    "spec_rewind_ms", "preempt_wait_ms", "recompute_ms",
+                    "swap_pause_ms")
+
+SERVE_ATTRIBUTION_ROW_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "rid": {"type": "integer"},
+        "trace_id": {"type": "string"},
+        "e2e_ms": {"type": "number"},          # finish - submit, measured
+        "components_ms": {"type": "number"},   # sum of the 8 components
+        "residual_pct": {"type": "number"},    # |sum - e2e| / e2e * 100
+        "evictions": {"type": "integer"},
+        "spec_rounds": {"type": "integer"},
+        **{c: {"type": "number"} for c in _ATTR_COMPONENTS},
+    },
+    "required": ["rid", "e2e_ms", "components_ms", *_ATTR_COMPONENTS],
+    "additionalProperties": False,
+}
+
+SERVE_ATTRIBUTION_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["serve_attribution"]},
+        "status": {"enum": ["OK", "SKIP"]},
+        "reason": {"type": "string"},  # required when status == "SKIP"
+        "requests": {"type": "integer"},       # finished requests rowed
+        "unattributed": {"type": "integer"},   # rids lacking submit/finish
+        "components": {
+            "type": "object",
+            "properties": {c: {"type": "number"}
+                           for c in _ATTR_COMPONENTS},
+            "required": list(_ATTR_COMPONENTS),
+            "additionalProperties": False,
+        },
+        "e2e_ms_total": {"type": "number"},
+        "components_ms_total": {"type": "number"},
+        "max_residual_pct": _METRIC_VALUE,     # worst per-request gap
+        "per_request": {"type": "array",
+                        "items": SERVE_ATTRIBUTION_ROW_SCHEMA},
+    },
+    "required": ["schema", "kind", "status", "requests", "components"],
+    "additionalProperties": False,
+}
+
+# anomaly flight-recorder dump (monitor.trace.FlightRecorder): the
+# bounded in-memory ring of recent raw records, written to a timestamped
+# file when the serve_anomaly layer fires (SLO burn, straggler, leak),
+# on SIGTERM, or on demand — post-hoc debuggability even when no JSONL
+# sink was attached. `events` are the raw ring records verbatim (they
+# were already emitted under the honesty rule; the dump itself claims no
+# success, so a SKIP record inside cannot fail it). CLOSED envelope.
+FLIGHT_RECORDER_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["flight_recorder_dump"]},
+        "reason": {"type": "string"},      # what fired the dump
+        "capacity": {"type": "integer"},   # ring size N
+        "num_events": {"type": "integer"},  # len(events) <= capacity
+        "mono_ns": {"type": "integer"},    # dump instant, unified clock
+        "wall_s": {"type": "number"},      # dump instant, wall clock
+        "pid": {"type": "integer"},
+        "events": {"type": "array", "items": {"type": "object"}},
+    },
+    "required": ["schema", "kind", "reason", "capacity", "num_events",
+                 "events"],
+    "additionalProperties": False,
+}
+
 SCHEMAS_BY_KIND = {
     "step": STEP_SCHEMA,
     "meta": META_SCHEMA,
@@ -855,6 +963,9 @@ SCHEMAS_BY_KIND = {
     "plan": PLAN_SCHEMA,
     "ckpt": CKPT_SCHEMA,
     "spec": SPEC_SCHEMA,
+    "clock_sync": CLOCK_SYNC_SCHEMA,
+    "serve_attribution": SERVE_ATTRIBUTION_SCHEMA,
+    "flight_recorder_dump": FLIGHT_RECORDER_SCHEMA,
 }
 
 # --- minimal JSON-Schema subset validator ------------------------------------
@@ -954,7 +1065,8 @@ def validate(record: Dict[str, Any],
     # with a claim-free, reason-free skip)
     if (record.get("kind") in ("decode", "longseq_bias", "tp_overlap",
                                "profile", "serve", "pipeline",
-                               "serve_window", "plan", "ckpt", "spec")
+                               "serve_window", "plan", "ckpt", "spec",
+                               "serve_attribution")
             and record.get("status") == "SKIP"
             and not record.get("reason")):
         errors.append(

@@ -38,10 +38,13 @@ enable the monitor before compiling the step you want to attribute
 from __future__ import annotations
 
 import contextlib
-import time
 from typing import Optional
 
 from apex_tpu.monitor import registry as _reg
+# THE unified clock (trace.monotonic_ns == time.perf_counter_ns): span
+# t0_ns, registry t_ns and the serve clock all share its CLOCK_MONOTONIC
+# base, so `monitor trace` merges the streams without skew
+from apex_tpu.monitor.trace import monotonic_ns
 
 # the active span path, innermost last. Training loops and tracing are
 # single-threaded per process; a plain list keeps the enabled fast path
@@ -86,12 +89,12 @@ def span(name: str, **attrs):
     _STACK.append(name)
     path = "/".join(_STACK)
     traced = not _trace_state_clean()
-    t0 = time.perf_counter_ns()
+    t0 = monotonic_ns()
     try:
         with jax.named_scope(name):
             yield
     finally:
-        dur = time.perf_counter_ns() - t0
+        dur = monotonic_ns() - t0
         _STACK.pop()
         # the registry may have been torn down inside the body
         r = _reg.get_registry()

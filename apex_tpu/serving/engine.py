@@ -65,6 +65,7 @@ import numpy as np
 from apex_tpu.models.gpt import GPTModel
 from apex_tpu.monitor import registry as monitor_registry
 from apex_tpu.monitor import spans as monitor_spans
+from apex_tpu.monitor import trace as monitor_trace
 from apex_tpu.ops import fused_layer_norm, fused_sample, fused_verify
 from apex_tpu.ops.pallas.attention import NEG_INF
 from apex_tpu.serving.kv_blocks import (DEAD_BLOCK, BlockAllocator,
@@ -325,10 +326,14 @@ class ServingEngine:
         if at_step is not None and nstep < at_step:
             return params
         self._pending_swap = None
+        t0 = time.perf_counter()
         self._validate_swap_avals(params, new_params)
         stats.swaps += 1
         if tel is not None:
-            tel.on_swap(nstep, now, source=source)
+            # the measured validate+rebind pause: attribution carves it
+            # out of the decode time of every mid-decode request
+            tel.on_swap(nstep, now, source=source,
+                        dur_ms=(time.perf_counter() - t0) * 1e3)
         return new_params
 
     # --- sampling tail -------------------------------------------------------
@@ -753,7 +758,14 @@ class ServingEngine:
             # started after they were produced
             tel.maybe_window(now(), sched)
         try:
-            with flush_scope:
+            # the serve-CALL trace context: engine-level records with no
+            # per-request id (spans, serve_windows, rid -1 straggler /
+            # swap events, the final serve record) share one ambient
+            # serve-scoped id; per-request events carry their own
+            # explicit ids, which win over the ambient one
+            with flush_scope, \
+                    monitor_trace.trace_context(
+                        monitor_trace.new_trace_id("serve")):
                 self._serve_loop(params, key, sched, tel, stats, now,
                                  wall, pool, draft)
         finally:
@@ -838,8 +850,9 @@ class ServingEngine:
                     jnp.asarray(drafted), jax.random.fold_in(key, nstep))
                 acc = np.asarray(acc)  # blocks: the round really ran
                 nxt = np.asarray(nxt)
+                round_dur = now() - t_dispatch
                 if tel is not None:
-                    tel.on_decode_step(now() - t_dispatch, len(live),
+                    tel.on_decode_step(round_dur, len(live),
                                        nstep, now())
                 nstep += 1
                 stats.decode_steps += 1
@@ -850,8 +863,11 @@ class ServingEngine:
                     stats.spec_drafted += K
                     stats.spec_accepted += a
                     if tel is not None:
+                        # the round's full wall time for EVERY live slot
+                        # (concurrent wall time — what a per-request e2e
+                        # partition must bill)
                         tel.on_spec_round(rids[i], i, a, K, nstep - 1,
-                                          now())
+                                          now(), dur_ms=round_dur * 1e3)
                 sched.note_spec(drafted, acc, nxt, now())
                 did_work = True
             elif batch is not None:

@@ -287,6 +287,10 @@ class _PrefixEntry:
     block_id: int
     nchildren: int = 0     # live child entries (only leaves are evictable)
     stamp: int = 0         # LRU recency (cache-wide monotone tick)
+    # provenance: the trace id of the request whose prefill indexed this
+    # block — a later request's prefix hit can name which request paid
+    # for the warm block it rode (pure bookkeeping, not identity)
+    created_by: Optional[str] = None
 
 
 #: parent id of a prompt's first block (entry ids start at 1)
@@ -435,7 +439,7 @@ class PrefixCache:
         self.block_hits += len(chain)
 
     def insert(self, parent_eid: int, tokens: Sequence[int],
-               block_id: int) -> int:
+               block_id: int, trace_id: Optional[str] = None) -> int:
         """Index one freshly prefilled full block under its chain key;
         returns the entry id to parent the NEXT block on. If the key is
         already present (two requests raced the same prefix through
@@ -443,7 +447,9 @@ class PrefixCache:
         block is simply not indexed — both copies are live and correct,
         only one is findable. At capacity the LRU leaf is reclaimed
         first; if nothing is reclaimable the block is not indexed
-        (bounded residency beats an unbounded warm set)."""
+        (bounded residency beats an unbounded warm set). ``trace_id``
+        records which request's prefill paid for the block
+        (``created_by`` provenance on the entry)."""
         key = tuple(int(t) for t in tokens)
         if len(key) != self.block_size:
             raise ValueError(
@@ -479,7 +485,7 @@ class PrefixCache:
         self._tick += 1
         e = _PrefixEntry(eid=self._next_eid, parent_eid=int(parent_eid),
                          tokens=key, block_id=int(block_id),
-                         stamp=self._tick)
+                         stamp=self._tick, created_by=trace_id)
         self._next_eid += 1
         self._buckets.setdefault(self._hash(e.parent_eid, key),
                                  []).append(e)

@@ -91,6 +91,11 @@ class Request:
     prompt: np.ndarray
     max_new_tokens: int
     arrival_s: float = 0.0
+    # the request-scoped trace id: minted by the telemetry at submit
+    # (or stamped by the caller beforehand) and carried on this OBJECT,
+    # so one id survives evict → re-admit → resume and joins every
+    # span / serve_event / spec record of the request
+    trace_id: Optional[str] = None
     tokens: List[int] = dataclasses.field(default_factory=list)
     token_s: List[float] = dataclasses.field(default_factory=list)
     submit_s: Optional[float] = None
@@ -519,7 +524,8 @@ class Scheduler:
         for idx in range(slot.registered_blocks, full):
             slot.parent_eid = self.prefix_cache.insert(
                 slot.parent_eid, slot.eprompt[idx * B:(idx + 1) * B],
-                slot.block_ids[idx])
+                slot.block_ids[idx],
+                trace_id=slot.request.trace_id)
             slot.registered_blocks = idx + 1
 
     # --- decode --------------------------------------------------------------

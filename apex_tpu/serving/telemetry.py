@@ -45,12 +45,15 @@ regardless, so the bench reads its quantiles without a sink.
 
 from __future__ import annotations
 
-import time
 from collections import deque
 from typing import Any, Dict, Optional
 
 from apex_tpu.monitor import registry as _reg
+from apex_tpu.monitor import trace as _trace
 from apex_tpu.monitor.histogram import StreamingHistogram
+# the unified clock (== time.perf_counter_ns): overhead accounting and
+# every monitor stream measure on the same CLOCK_MONOTONIC base
+from apex_tpu.monitor.trace import monotonic_ns as _mono
 
 __all__ = ["ServeTelemetry"]
 
@@ -68,9 +71,9 @@ class _InFlight:
     request history)."""
 
     __slots__ = ("queued_at", "admit_at", "chunks", "prefill_s",
-                 "first_token_at", "requeued_at")
+                 "first_token_at", "requeued_at", "trace_id")
 
-    def __init__(self, queued_at: float):
+    def __init__(self, queued_at: float, trace_id: Optional[str] = None):
         self.queued_at = queued_at
         self.admit_at: Optional[float] = None
         self.chunks = 0
@@ -80,6 +83,11 @@ class _InFlight:
         # from the original submit (the prior in-slot service time is
         # not queueing)
         self.requeued_at: Optional[float] = None
+        # the request-scoped trace id (minted at submit, mirrored on
+        # the Request itself): rides every lifecycle record of this
+        # request — across evict → re-admit → resume, because both this
+        # tracker entry and the Request object survive the eviction
+        self.trace_id = trace_id
 
 
 class ServeTelemetry:
@@ -110,7 +118,8 @@ class ServeTelemetry:
                  slo_burn_count: int = 3,
                  straggler_ratio: float = 3.0,
                  straggler_window: int = 32,
-                 status: str = "OK", reason: Optional[str] = None):
+                 status: str = "OK", reason: Optional[str] = None,
+                 collect_events: bool = False):
         if status not in ("OK", "SKIP"):
             raise ValueError(f"status must be OK|SKIP, got {status!r}")
         if status == "SKIP" and not reason:
@@ -123,6 +132,12 @@ class ServeTelemetry:
         self.straggler_window = int(straggler_window)
         self.status = status
         self.reason = reason
+        # collect_events=True keeps an in-memory ledger of every emitted
+        # event's fields (same dict shape as the JSONL records), so
+        # trace.serve_attribution() can run without any sink — how the
+        # bench emits serve_attribution when no stream was requested
+        self.collect_events = bool(collect_events)
+        self.events: list = []
 
         # cumulative histograms back the final bench record; the window
         # pair resets at every serve_window emission (sliding view).
@@ -187,6 +202,8 @@ class ServeTelemetry:
         return self.overhead_ns * 1e-9
 
     def _emit(self, kind: str, **fields) -> None:
+        if self.collect_events:
+            self.events.append({"kind": kind, **fields})
         r = _reg.get_registry()
         if r is None:
             return
@@ -196,27 +213,48 @@ class ServeTelemetry:
             r.emit(kind, **fields)
 
     @staticmethod
+    def _tid(fl: Optional[_InFlight]) -> Dict[str, str]:
+        """The trace-id field of a lifecycle record ({} when the
+        tracker never saw a submit — explicit ids beat the ambient
+        serve-level id the registry would otherwise stamp)."""
+        if fl is not None and fl.trace_id:
+            return {"trace_id": fl.trace_id}
+        return {}
+
+    @staticmethod
     def _skip_or(value, why: str):
         return value if value is not None else ("skipped", why)
 
     # --- lifecycle hooks (called by Scheduler / ServingEngine) ---------------
 
     def on_submit(self, req, now: float) -> None:
-        t = time.perf_counter_ns()
+        t = _mono()
+        # mint the request's trace id HERE (unless the caller already
+        # stamped one) and mirror it on the Request object: the object
+        # survives evict → re-admit → resume, so continuity is free
+        tid = getattr(req, "trace_id", None)
+        if not tid:
+            tid = _trace.new_trace_id("req")
+            try:
+                req.trace_id = tid
+            except AttributeError:
+                pass  # slotted/frozen stand-ins: the tracker still has it
         self._inflight[req.rid] = _InFlight(
-            queued_at=max(now, float(req.arrival_s)))
+            queued_at=max(now, float(req.arrival_s)), trace_id=tid)
         self._emit("serve_event", rid=req.rid, phase="submit", at_s=now,
+                   trace_id=tid,
                    prompt_len=int(len(req.prompt)),
                    max_new_tokens=int(req.max_new_tokens))
-        self.overhead_ns += time.perf_counter_ns() - t
+        self.overhead_ns += _mono() - t
 
     def on_admit(self, req, slot: int, now: float,
                  prefix_hit_blocks: int = 0, resumed: bool = False
                  ) -> None:
-        t = time.perf_counter_ns()
+        t = _mono()
         fl = self._inflight.get(req.rid)
         if fl is None:  # submitted before the tracker attached
-            fl = self._inflight[req.rid] = _InFlight(float(req.arrival_s))
+            fl = self._inflight[req.rid] = _InFlight(
+                float(req.arrival_s), getattr(req, "trace_id", None))
         fl.admit_at = now
         # a re-admission waited since its EVICTION, not since submit —
         # billing the prior in-slot service time as queueing would
@@ -227,20 +265,21 @@ class ServeTelemetry:
         queue_wait_ms = max(now - since, 0.0) * 1e3
         fields = dict(rid=req.rid, phase="admit", at_s=now,
                       slot=int(slot),
-                      queue_wait_ms=round(queue_wait_ms, 3))
+                      queue_wait_ms=round(queue_wait_ms, 3),
+                      **self._tid(fl))
         if prefix_hit_blocks:
             fields["prefix_hit_blocks"] = int(prefix_hit_blocks)
         if resumed:  # re-admission after an evict
             fields["resumed"] = True
         self._emit("serve_event", **fields)
-        self.overhead_ns += time.perf_counter_ns() - t
+        self.overhead_ns += _mono() - t
 
     def on_evict(self, req, slot: int, blocks_released: int, reason: str,
                  requeue_pos: int, step: int, now: float) -> None:
         """The reserved preemption transition: slot ``slot``'s request
         released ``blocks_released`` block references and re-queued at
         ``requeue_pos`` for evict-and-recompute."""
-        t = time.perf_counter_ns()
+        t = _mono()
         self.preemptions += 1
         fl = self._inflight.get(req.rid)
         if fl is not None:
@@ -250,50 +289,66 @@ class ServeTelemetry:
                    evict_reason=str(reason),
                    blocks_released=int(blocks_released),
                    requeue_pos=int(requeue_pos),
-                   generated=len(req.tokens))
-        self.overhead_ns += time.perf_counter_ns() - t
+                   generated=len(req.tokens), **self._tid(fl))
+        self.overhead_ns += _mono() - t
 
     def on_resume(self, req, slot: int, blocks_held: int, step: int,
                   now: float) -> None:
         """An evicted request finished its re-prefill and re-entered
         steady decode (the recompute's sampled token was discarded —
         the stream continues exactly where it left off)."""
-        t = time.perf_counter_ns()
+        t = _mono()
         self.resumes += 1
         self._emit("serve_event", rid=req.rid, phase="decode", at_s=now,
                    slot=int(slot), blocks_held=int(blocks_held),
-                   step=int(step), resumed=True)
-        self.overhead_ns += time.perf_counter_ns() - t
+                   step=int(step), resumed=True,
+                   **self._tid(self._inflight.get(req.rid)))
+        self.overhead_ns += _mono() - t
 
     def on_swap(self, step: int, now: float,
-                source: Optional[str] = None) -> None:
+                source: Optional[str] = None,
+                dur_ms: Optional[float] = None) -> None:
         """A weight hot-swap landed between dispatch steps (rid -1:
         engine-level, like straggler events). ``source`` names where
-        the weights came from (e.g. the checkpoint step directory)."""
-        t = time.perf_counter_ns()
+        the weights came from (e.g. the checkpoint step directory);
+        ``dur_ms`` is the measured validate+rebind pause — attribution
+        carves it out of the decode time of every request that was
+        mid-decode when the swap landed."""
+        t = _mono()
         self.swaps += 1
         fields = dict(rid=-1, phase="swap", at_s=now, step=int(step))
         if source:
             fields["swap_source"] = str(source)
+        if dur_ms is not None:
+            fields["dur_ms"] = round(float(dur_ms), 3)
         self._emit("serve_event", **fields)
-        self.overhead_ns += time.perf_counter_ns() - t
+        self.overhead_ns += _mono() - t
 
     def on_spec_round(self, rid: int, slot: int, accepted: int, k: int,
-                      step: int, now: float) -> None:
+                      step: int, now: float,
+                      dur_ms: Optional[float] = None) -> None:
         """One slot's speculative round: ``accepted`` of ``k`` drafted
         tokens survived verification (the round emitted
         ``accepted + 1`` tokens up to the request's budget). Feeds the
         acceptance-rate accounting and one ``spec``-phase lifecycle
-        record."""
-        t = time.perf_counter_ns()
+        record. ``dur_ms`` is the round's dispatch wall time (the same
+        value for every live slot of the round — concurrent wall time,
+        which is what a per-request e2e partition must bill); an
+        all-rejected round (``accepted == 0``) is attributed to
+        ``spec_rewind_ms``, the others to ``spec_ms``."""
+        t = _mono()
         self.spec_slot_rounds += 1
         self.spec_drafted += k
         self.spec_accepted += accepted
         self.draft_k = k
-        self._emit("serve_event", rid=rid, phase="spec", at_s=now,
-                   slot=int(slot), step=int(step),
-                   accepted_len=int(accepted), draft_k=int(k))
-        self.overhead_ns += time.perf_counter_ns() - t
+        fields = dict(rid=rid, phase="spec", at_s=now,
+                      slot=int(slot), step=int(step),
+                      accepted_len=int(accepted), draft_k=int(k),
+                      **self._tid(self._inflight.get(rid)))
+        if dur_ms is not None:
+            fields["dur_ms"] = round(float(dur_ms), 3)
+        self._emit("serve_event", **fields)
+        self.overhead_ns += _mono() - t
 
     def on_blocked(self, why: str, n: int = 1) -> None:
         if why == "slots":
@@ -305,7 +360,7 @@ class ServeTelemetry:
 
     def on_prefill_chunk(self, rid: int, slot: int, dur_s: float,
                          blocks_held: int, step: int, now: float) -> None:
-        t = time.perf_counter_ns()
+        t = _mono()
         self.prefill_chunks += 1
         self._win_chunks += 1
         fl = self._inflight.get(rid)
@@ -317,15 +372,18 @@ class ServeTelemetry:
         self._emit("serve_event", rid=rid, phase="prefill_chunk", at_s=now,
                    slot=int(slot), chunk=chunk,
                    dur_ms=round(dur_s * 1e3, 3),
-                   blocks_held=int(blocks_held), step=int(step))
-        self.overhead_ns += time.perf_counter_ns() - t
+                   blocks_held=int(blocks_held), step=int(step),
+                   **self._tid(fl))
+        self.overhead_ns += _mono() - t
 
     def on_first_token(self, req, slot: int, blocks_held: int, step: int,
                        now: float) -> None:
-        t = time.perf_counter_ns()
+        t = _mono()
+        was_burning = self.slo_burn
         fl = self._inflight.get(req.rid)
         if fl is None:
-            fl = self._inflight[req.rid] = _InFlight(float(req.arrival_s))
+            fl = self._inflight[req.rid] = _InFlight(
+                float(req.arrival_s), getattr(req, "trace_id", None))
         fl.first_token_at = now
         ttft_ms = max(now - fl.queued_at, 0.0) * 1e3
         self.ttft_ms.add(ttft_ms)
@@ -352,35 +410,44 @@ class ServeTelemetry:
                    at_s=now, slot=int(slot),
                    ttft_ms=round(ttft_ms, 3), chunks=fl.chunks,
                    prefill_ms=round(fl.prefill_s * 1e3, 3),
-                   blocks_held=int(blocks_held), step=int(step))
+                   blocks_held=int(blocks_held), step=int(step),
+                   **self._tid(fl))
         if req.max_new_tokens > 1:  # the request enters steady decode
             self._emit("serve_event", rid=req.rid, phase="decode",
                        at_s=now, slot=int(slot),
-                       blocks_held=int(blocks_held), step=int(step))
-        self.overhead_ns += time.perf_counter_ns() - t
+                       blocks_held=int(blocks_held), step=int(step),
+                       **self._tid(fl))
+        self.overhead_ns += _mono() - t
+        if self.slo_burn and not was_burning:
+            # first flip of the anomaly flag: preserve the last-N raw
+            # events for post-hoc debugging (no-op without a recorder;
+            # once=True keeps repeats from re-dumping)
+            _trace.flight_dump("serve_anomaly:slo_burn")
 
     def observe_itl(self, itl_s: float) -> None:
         """One inter-token gap (decode token ``i`` → ``i+1`` of one
         request) into the latency histograms."""
-        t = time.perf_counter_ns()
+        t = _mono()
         ms = itl_s * 1e3
         self.itl_ms.add(ms)
         self._win_itl.add(ms)
         self.tokens += 1
         self._win_tokens += 1
-        self.overhead_ns += time.perf_counter_ns() - t
+        self.overhead_ns += _mono() - t
 
     def on_decode_step(self, dur_s: float, live_slots: int, step: int,
                        now: float) -> None:
         """One full-width decode step's wall time: feeds the straggler
         detector (vs the rolling median of recent steps)."""
-        t = time.perf_counter_ns()
+        t = _mono()
         self.decode_steps += 1
         self._win_steps += 1
+        straggled = False
         recent = self._recent_steps
         if len(recent) == recent.maxlen:
             med = sorted(recent)[len(recent) // 2]
             if med > 0 and dur_s > self.straggler_ratio * med:
+                straggled = True
                 self.straggler_steps += 1
                 self.straggler_last_ratio = round(dur_s / med, 2)
                 self._emit("serve_event", rid=-1, phase="decode",
@@ -389,11 +456,13 @@ class ServeTelemetry:
                            ratio_to_median=self.straggler_last_ratio,
                            slots=int(live_slots))
         recent.append(dur_s)
-        self.overhead_ns += time.perf_counter_ns() - t
+        self.overhead_ns += _mono() - t
+        if straggled:
+            _trace.flight_dump("serve_anomaly:straggler")
 
     def on_finish(self, req, slot: int, blocks_held: int, step: int,
                   now: float) -> None:
-        t = time.perf_counter_ns()
+        t = _mono()
         self.finished += 1
         fl = self._inflight.pop(req.rid, None)
         decode_ms = None
@@ -403,13 +472,14 @@ class ServeTelemetry:
                       slot=int(slot), tokens=len(req.tokens),
                       blocks_held=int(blocks_held), step=int(step),
                       total_ms=round(
-                          max(now - float(req.arrival_s), 0.0) * 1e3, 3))
+                          max(now - float(req.arrival_s), 0.0) * 1e3, 3),
+                      **self._tid(fl))
         if decode_ms is not None:
             fields["decode_ms"] = decode_ms
         if fl is not None:
             fields["chunks"] = fl.chunks
         self._emit("serve_event", **fields)
-        self.overhead_ns += time.perf_counter_ns() - t
+        self.overhead_ns += _mono() - t
 
     # --- windows + anomalies -------------------------------------------------
 
@@ -434,6 +504,8 @@ class ServeTelemetry:
             # flavor (live blocks with no active requests) is detected
             # at window time and sticks in self.leaked_blocks
             self.leaked_blocks = allocator.leaked
+        if self.leaked_blocks:
+            _trace.flight_dump("serve_anomaly:leak")
         out: Dict[str, Any] = {
             "straggler_steps": self.straggler_steps,
             "straggler_last_ratio": self.straggler_last_ratio,
@@ -469,7 +541,7 @@ class ServeTelemetry:
             return None
         if now - self._win_t0 < self.window_s:
             return None
-        t = time.perf_counter_ns()
+        t = _mono()
         fields = self._window_fields(now, sched)
         self._emit("serve_window", **fields)
         self.windows_emitted += 1
@@ -479,7 +551,7 @@ class ServeTelemetry:
         self._win_chunks = 0
         self._win_itl.reset()
         self._win_ttft.reset()
-        self.overhead_ns += time.perf_counter_ns() - t
+        self.overhead_ns += _mono() - t
         return fields
 
     def _window_fields(self, now: float, sched) -> Dict[str, Any]:

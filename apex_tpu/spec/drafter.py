@@ -43,6 +43,8 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from apex_tpu.monitor import spans as monitor_spans
+
 __all__ = ["Drafter", "NGramDrafter", "ModelDrafter", "validate_drafter"]
 
 #: sane bound on the per-round draft length: past ~32 the verify step's
@@ -241,19 +243,25 @@ class ModelDrafter(Drafter):
                 f"raise max_seq_len (the engines validate this bound at "
                 f"wiring time; hitting it here means the drafter was "
                 f"driven directly past it)")
-        # teacher-force the unconsumed context rows (every token but the
-        # last writes its k/v and its sampled candidate is discarded)
-        for i in range(consumed, len(ctx) - 1):
-            cache, _, _ = self._step(cache, ctx[i], i)
-        # draft greedily from the frontier; each step writes the fed
-        # token's k/v one row further (rows past the trusted frontier:
-        # re-written by the next teacher-forcing pass if rejected)
-        out = []
-        tok = ctx[-1]
-        for j in range(self.k):
-            cache, nxt, _ = self._step(cache, tok, len(ctx) - 1 + j)
-            tok = int(np.asarray(nxt)[0])
-            out.append(tok)
+        # one spec_draft span per round: its trace slice (and the
+        # decode_step device scopes nested under it) joins the round's
+        # spec lifecycle record through the ambient serve trace id —
+        # no-op while monitoring is off
+        with monitor_spans.span("spec_draft", stream=int(stream)):
+            # teacher-force the unconsumed context rows (every token but
+            # the last writes its k/v; its sampled candidate is discarded)
+            for i in range(consumed, len(ctx) - 1):
+                cache, _, _ = self._step(cache, ctx[i], i)
+            # draft greedily from the frontier; each step writes the fed
+            # token's k/v one row further (rows past the trusted
+            # frontier: re-written by the next teacher-forcing pass if
+            # rejected)
+            out = []
+            tok = ctx[-1]
+            for j in range(self.k):
+                cache, nxt, _ = self._step(cache, tok, len(ctx) - 1 + j)
+                tok = int(np.asarray(nxt)[0])
+                out.append(tok)
         st["cache"], st["consumed"] = cache, len(ctx)
         self._streams[stream] = st
         return np.asarray(out, np.int32)

@@ -97,6 +97,7 @@ import jax.random as jr
 # by this artifact and `python -m apex_tpu.monitor report`, so "mfu" means
 # the same thing everywhere
 from apex_tpu import monitor
+from apex_tpu.monitor import trace as monitor_trace
 
 
 def model_flops_per_token(cfg, seq):
@@ -503,7 +504,11 @@ def serve_main():
     tel = ServeTelemetry(
         slots=slots, window_s=0.25 if on_tpu else 0.01,
         slo_ttft_ms=1000.0 if on_tpu else 10000.0,
-        status="OK" if on_tpu else "SKIP", reason=skip_reason)
+        status="OK" if on_tpu else "SKIP", reason=skip_reason,
+        # keep the raw lifecycle ledger in memory: the per-request TTFT
+        # attribution below consumes it whether or not a JSONL sink is
+        # attached
+        collect_events=True)
     sched = engine.make_scheduler()
     t0 = time.perf_counter()
     done = engine.serve(params, requests, scheduler=sched, telemetry=tel)
@@ -584,6 +589,27 @@ def serve_main():
         raise ValueError(f"serve bench record failed validation: {errors}")
     print(json.dumps(record))
 
+    # --- per-request TTFT/latency attribution over the same sweep ------------
+    # decompose every finished request's e2e latency into queue /
+    # prefill / decode / spec / preempt / swap components from the
+    # telemetry ledger (collect_events=True above — no sink needed) and
+    # ship the summary as a second record; status mirrors the serve
+    # record's (a SKIP sweep prices nothing)
+    attr = monitor_trace.serve_attribution(tel.events, per_request=False)
+    if status == "SKIP":
+        attr.setdefault("reason", skip_reason)
+    if monitor.enabled():
+        record = monitor.get_registry().emit_serve_attribution(
+            status, **attr)
+    else:
+        record = monitor.MetricsRegistry().emit_serve_attribution(
+            status, **attr)
+    errors = monitor.validate(record)
+    if errors:
+        raise ValueError(
+            f"serve_attribution record failed validation: {errors}")
+    print(json.dumps(record))
+
 
 def spec_main():
     """``python bench.py --spec`` — the speculative-decoding +
@@ -653,11 +679,15 @@ def spec_main():
     deng = DecodeEngine(model, cache_dtype=cast)
     drafter = NGramDrafter(k=k)
 
-    # compile + the parity witness
-    want = np.asarray(deng.generate(params, jnp.asarray(prompt)[None],
-                                    new_tokens))
-    spec_out = np.asarray(deng.generate(params, jnp.asarray(prompt)[None],
-                                        new_tokens, draft=drafter))
+    # compile + the parity witness; one "spec-" trace id spans both legs
+    # (the generate calls reuse the ambient id, so their spans — and the
+    # final spec record, stamped explicitly below — share it)
+    spec_tid = monitor_trace.new_trace_id("spec")
+    with monitor_trace.trace_context(spec_tid):
+        want = np.asarray(deng.generate(params, jnp.asarray(prompt)[None],
+                                        new_tokens))
+        spec_out = np.asarray(deng.generate(
+            params, jnp.asarray(prompt)[None], new_tokens, draft=drafter))
     greedy_parity = bool((spec_out == want).all())
     stats = deng.last_spec_stats
     jit_cache_ok = (deng.spec_verify_step._cache_size() == 1
@@ -753,9 +783,11 @@ def spec_main():
         status = "SKIP"
 
     if monitor.enabled():
-        record = monitor.get_registry().emit_spec(status, **fields)
+        record = monitor.get_registry().emit_spec(status, trace_id=spec_tid,
+                                                  **fields)
     else:  # sink-less registry: same construction+honesty path, no file
-        record = monitor.MetricsRegistry().emit_spec(status, **fields)
+        record = monitor.MetricsRegistry().emit_spec(
+            status, trace_id=spec_tid, **fields)
     errors = monitor.validate(record)
     if errors:
         raise ValueError(f"spec bench record failed validation: {errors}")
@@ -1749,6 +1781,8 @@ def ckpt_main():
             f"measurement; this is a {jax.default_backend()} smoke run "
             f"on a virtual {dp}-device mesh")
         status = "SKIP"
+    if mgr.last_trace_id:  # join the record to its last save's
+        fields["trace_id"] = mgr.last_trace_id  # ckpt_save_start/commit
     emit(status, **fields)
     mesh_lib.destroy_model_parallel()
 

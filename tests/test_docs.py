@@ -130,7 +130,15 @@ def test_observability_covers_anatomy_and_calibration():
                    "serve_event", "serve_window", "serve_anomaly",
                    "--serve-timeline", "StreamingHistogram",
                    "straggler", "admission-blocked-by",
-                   "bench_history.py"):
+                   "bench_history.py",
+                   # ISSUE 16: request-scoped tracing chapter
+                   "trace_id", "trace_context", "clock_sync",
+                   "perf_counter_ns", "monitor trace", "chrome_trace",
+                   "Perfetto", "--attribution", "serve_attribution",
+                   "spec_rewind_ms", "preempt_wait_ms",
+                   "flight recorder", "enable_flight_recorder",
+                   "flight_dump", "validate_metrics.py --trace",
+                   "SKIP(reason)"):
         assert needle in text, f"OBSERVABILITY.md dropped {needle}"
 
 
@@ -141,8 +149,28 @@ def test_monitor_doc_covers_serving_telemetry():
     for needle in ("StreamingHistogram", "one bucket width",
                    "serve_event", "serve_window", "SERVE_ANOMALY_SCHEMA",
                    "emit_serve_window", "--serve-timeline",
-                   "serve_timeline", "--serve-window", "buffered"):
+                   "serve_timeline", "--serve-window", "buffered",
+                   # ISSUE 16: request-scoped tracing section
+                   "trace_id", "new_trace_id", "trace_context",
+                   "clock_sync", "monitor trace", "chrome_trace",
+                   "write_chrome_trace", "--attribution",
+                   "serve_attribution", "SERVE_ATTRIBUTION_SCHEMA",
+                   "enable_flight_recorder", "flight_dump",
+                   "FLIGHT_RECORDER_SCHEMA", "install_signal_handler",
+                   "--trace", "telemetry_overhead_pct"):
         assert needle in text, f"monitor.md dropped {needle}"
+
+
+def test_monitor_doc_trace_block_executes():
+    """The tracing worked example in docs/api/monitor.md is
+    self-contained and runnable (the other monitor.md snippets are API
+    fragments; this one is the executed witness)."""
+    blocks = _doc_blocks("api", "monitor.md")
+    trace_blocks = [b for b in blocks if "trace_context" in b]
+    assert trace_blocks, "monitor.md lost the tracing worked example"
+    _exec_blocks(trace_blocks, "monitor.md[tracing]")
+    from apex_tpu import monitor
+    assert not monitor.enabled()
 
 
 def test_guide_covers_the_ladder():

@@ -35,7 +35,12 @@ def registry():
 
 
 def records_of(buf: io.StringIO):
-    return [json.loads(line) for line in buf.getvalue().splitlines()]
+    # the clock_sync epoch record framing every enabled stream is
+    # covered by tests/test_trace.py; the payload tests here count
+    # only the records they emitted
+    return [r for r in (json.loads(line)
+                        for line in buf.getvalue().splitlines())
+            if r.get("kind") != "clock_sync"]
 
 
 class TestRegistry:
@@ -109,11 +114,12 @@ class TestRegistry:
             reg = monitor.enable(str(path))
             reg.emit_event("run")
             monitor.disable()
-        assert len(path.read_text().splitlines()) == 1  # one run, one file
+        # one run, one file: each enable() opens with its clock_sync
+        assert len(path.read_text().splitlines()) == 2
         reg = monitor.enable(str(path), append=True)
         reg.emit_event("run")
         monitor.disable()
-        assert len(path.read_text().splitlines()) == 2
+        assert len(path.read_text().splitlines()) == 4
 
     def test_report_aggregates_last_run_of_appended_file(self, tmp_path):
         from apex_tpu.monitor.report import aggregate, read_records
@@ -414,7 +420,9 @@ class TestValidateTool:
 
         # drift guard: an OK decode record carrying nan (hand-forged past
         # the emitter) must fail the CLI
-        bad = json.loads(path.read_text().splitlines()[2])
+        bad = next(r for r in (json.loads(ln)
+                               for ln in path.read_text().splitlines())
+                   if r.get("kind") == "decode" and r["status"] == "OK")
         bad["tokens_per_s"] = "nan"
         bad_path = tmp_path / "bad.jsonl"
         bad_path.write_text(json.dumps(bad) + "\n")
@@ -1030,12 +1038,15 @@ class TestValidateProfileArtifacts:
         assert tool.main([str(path)]) == 0
         assert tool.main(["--pipeline", str(path)]) == 0
 
-        bad = json.loads(path.read_text().splitlines()[0])
+        pipes = [r for r in (json.loads(ln)
+                             for ln in path.read_text().splitlines())
+                 if r.get("kind") == "pipeline"]
+        bad = dict(pipes[0])
         bad["tokens_per_s"] = "nan"
         bad_path = tmp_path / "bad.jsonl"
         bad_path.write_text(json.dumps(bad) + "\n")
         assert tool.main([str(bad_path)]) == 1
-        noreason = json.loads(path.read_text().splitlines()[1])
+        noreason = dict(pipes[1])
         del noreason["reason"]
         nr_path = tmp_path / "nr.jsonl"
         nr_path.write_text(json.dumps(noreason) + "\n")

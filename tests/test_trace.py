@@ -1,0 +1,439 @@
+"""Request-scoped tracing (ISSUE 16 tentpole + satellites).
+
+Contracts under test:
+
+* the unified clock: one ``clock_sync`` record opens every enabled
+  stream, every record carries a ``t_ns`` stamp from THE monotonic
+  base (``monitor.trace.monotonic_ns``);
+* trace-id continuity under churn: a preempted request keeps ONE
+  ``trace_id`` across submit → evict → re-admit → resume → finish, and
+  an all-rejected spec round (the rewind path) keeps it too;
+* TTFT/latency attribution: the component partition of each finished
+  request sums to its measured e2e latency within tolerance, on a REAL
+  mixed run (spec rounds + a forced preemption);
+* the anomaly flight recorder: a bounded ring fed by the registry's
+  emit path (sink or no sink), dumping exactly the last N raw events
+  on a scripted anomaly, deduping by reason, chaining signal handlers;
+* Chrome trace-event export: the mixed run exports one named track per
+  request whose queue/prefill/decode/spec/preempt slices all carry the
+  request's trace id — with both jitted serving steps' cache size still
+  pinned at 1 (zero-recompile holds with tracing ON);
+* the CLIs: ``python -m apex_tpu.monitor trace``, ``report
+  --attribution`` (incl. the explicit SKIP(reason) line on a bare
+  stream), and ``tools/validate_metrics.py --trace`` family dispatch
+  (closed schemas: junk keys and nan-in-OK fail).
+"""
+
+import gzip
+import json
+import os
+import signal
+import sys
+
+import jax.random as jr
+import numpy as np
+import pytest
+
+from apex_tpu import monitor
+from apex_tpu.models import GPTConfig, GPTModel
+from apex_tpu.monitor import report as monitor_report
+from apex_tpu.monitor import trace as trace_lib
+from apex_tpu.serving import Request, ServeTelemetry, ServingEngine
+from apex_tpu.spec import NGramDrafter
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import validate_metrics  # noqa: E402
+
+K = jr.PRNGKey(16)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = GPTConfig(vocab_size=97, max_seq_len=128, hidden_size=32,
+                    num_layers=2, num_heads=4, num_kv_heads=2,
+                    attention_impl="flash", remat=False, dropout=0.0)
+    model = GPTModel(cfg)
+    return model, model.init(K)
+
+
+def _churn_serve(tmp_path, tiny, *, draft=None, name="ev", **tel_kw):
+    """A real mixed serve with monitoring on and the pool sized to
+    FORCE at least one preemption (3 requests x (12 prompt + 14 new)
+    through 7 blocks of 8 rows). Returns (records, tel, eng, sched,
+    done)."""
+    model, params = tiny
+    path = tmp_path / f"{name}.jsonl"
+    monitor.enable(str(path))
+    try:
+        eng = ServingEngine(model, num_slots=2, block_size=8,
+                            prefill_chunk=8, max_seq_len=64,
+                            num_blocks=7)
+        reqs = [Request(rid=i, prompt=np.asarray(
+                    jr.randint(jr.fold_in(K, i), (12,), 0, 97), np.int32),
+                        max_new_tokens=14)
+                for i in range(3)]
+        tel = ServeTelemetry(slots=2, window_s=0.0, **tel_kw)
+        sched = eng.make_scheduler()
+        done = eng.serve(params, reqs, scheduler=sched, telemetry=tel,
+                         draft=draft)
+        assert len(done) == 3
+        assert sched.preemptions >= 1, \
+            "the churn recipe must force a preemption"
+    finally:
+        monitor.disable()
+    lines = path.read_text().splitlines()
+    assert monitor.validate_jsonl(lines) == []
+    return [json.loads(ln) for ln in lines], tel, eng, sched, done
+
+
+class TestUnifiedClock:
+    def test_clock_sync_opens_stream_and_t_ns_everywhere(self, tmp_path):
+        path = tmp_path / "ev.jsonl"
+        monitor.enable(str(path))
+        try:
+            monitor.emit_event("probe", i=1)
+        finally:
+            monitor.disable()
+        records = [json.loads(ln)
+                   for ln in path.read_text().splitlines()]
+        first = records[0]
+        assert first["kind"] == "clock_sync"
+        assert isinstance(first["mono_ns"], int)
+        assert isinstance(first["wall_s"], float)
+        assert first["pid"] == os.getpid()
+        assert first["clock"] == "perf_counter_ns"
+        # every record is stamped on THE monotonic base
+        assert all(isinstance(r.get("t_ns"), int) for r in records)
+        assert monitor.validate_jsonl(
+            path.read_text().splitlines()) == []
+
+    def test_ambient_trace_id_and_explicit_wins(self, tmp_path):
+        path = tmp_path / "ev.jsonl"
+        monitor.enable(str(path))
+        try:
+            monitor.emit_event("outside")
+            with trace_lib.trace_context(trace_lib.new_trace_id("t")):
+                monitor.emit_event("ambient")
+                monitor.emit_event("explicit", trace_id="mine-1")
+        finally:
+            monitor.disable()
+        by = {r["name"]: r for r in
+              (json.loads(ln) for ln in path.read_text().splitlines())
+              if r.get("kind") == "event"}
+        assert "trace_id" not in by["outside"]
+        assert by["ambient"]["trace_id"].startswith("t-")
+        assert by["explicit"]["trace_id"] == "mine-1"
+
+
+class TestTraceIdContinuity:
+    def test_one_trace_id_survives_preemption(self, tmp_path, tiny):
+        """The tentpole witness: an evicted-and-recomputed request's
+        whole lifecycle — submit, evict, resumed re-admit, finish —
+        carries exactly one trace id (the Request object holds it
+        across the re-queue)."""
+        records, tel, eng, sched, done = _churn_serve(tmp_path, tiny)
+        ev_by_rid = {}
+        for r in records:
+            if r.get("kind") == "serve_event" and r.get("rid", -1) >= 0:
+                ev_by_rid.setdefault(r["rid"], []).append(r)
+        assert set(ev_by_rid) == {0, 1, 2}
+        tids = {}
+        for rid, evs in ev_by_rid.items():
+            ids = {e.get("trace_id") for e in evs}
+            assert len(ids) == 1 and None not in ids, \
+                f"rid {rid} trace ids fractured: {ids}"
+            tids[rid] = ids.pop()
+        # distinct per request, and mirrored on the Request object
+        assert len(set(tids.values())) == 3
+        for r in done:
+            assert r.trace_id == tids[r.rid]
+        evicted = [rid for rid, evs in ev_by_rid.items()
+                   if any(e["phase"] == "evict" for e in evs)]
+        assert evicted, "no request went through the evict path"
+        for rid in evicted:
+            phases = [e["phase"] for e in ev_by_rid[rid]]
+            assert "evict" in phases and phases.count("admit") >= 2
+            assert any(e["phase"] == "admit" and e.get("resumed")
+                       for e in ev_by_rid[rid])
+
+    def test_all_rejected_spec_round_keeps_trace_id(self):
+        """The spec-rewind path, driven directly: an all-rejected round
+        emits a spec event on the SAME trace id (and attributes its
+        wall time to spec_rewind_ms, not spec_ms)."""
+        tel = ServeTelemetry(slots=1, window_s=0.0, collect_events=True)
+        req = Request(rid=5, prompt=np.zeros(4, np.int32),
+                      max_new_tokens=6)
+        tel.on_submit(req, 0.0)
+        tel.on_admit(req, 0, 0.010)
+        tel.on_first_token(req, 0, 1, 0, 0.020)
+        tel.on_spec_round(5, 0, 0, 4, 1, 0.030, dur_ms=5.0)  # rewind
+        tel.on_spec_round(5, 0, 2, 4, 2, 0.050, dur_ms=5.0)
+        req.tokens.extend([1] * 6)
+        tel.on_finish(req, 0, 1, 3, 0.060)
+        evs = [e for e in tel.events if e.get("rid") == 5]
+        ids = {e.get("trace_id") for e in evs}
+        assert len(ids) == 1 and None not in ids
+        fields = trace_lib.serve_attribution(tel.events)
+        row = fields["per_request"][0]
+        assert row["spec_rewind_ms"] == pytest.approx(5.0, abs=0.01)
+        assert row["spec_ms"] == pytest.approx(5.0, abs=0.01)
+        assert row["trace_id"] == ids.pop()
+
+
+class TestAttribution:
+    def test_components_sum_to_e2e_on_mixed_run(self, tmp_path, tiny):
+        """The acceptance bound: on a real spec + forced-preemption
+        sweep, every finished request's component partition sums to its
+        measured e2e latency within max(1%, 0.5 ms)."""
+        records, tel, eng, sched, done = _churn_serve(
+            tmp_path, tiny, draft=NGramDrafter(k=4), name="mixed",
+            collect_events=True)
+        fields = trace_lib.serve_attribution(tel.events)
+        assert fields["requests"] == 3
+        assert fields["unattributed"] == 0
+        for row in fields["per_request"]:
+            tol = max(0.01 * row["e2e_ms"], 0.5)
+            assert abs(row["components_ms"] - row["e2e_ms"]) <= tol, row
+        assert sum(r["evictions"] for r in fields["per_request"]) \
+            == sched.preemptions
+        assert sum(r["spec_rounds"] for r in fields["per_request"]) > 0
+        assert fields["components"]["recompute_ms"] > 0
+        # the JSONL stream and the in-memory ledger agree
+        from_stream = trace_lib.serve_attribution(records)
+        assert from_stream["requests"] == 3
+        assert from_stream["e2e_ms_total"] == \
+            pytest.approx(fields["e2e_ms_total"], rel=1e-6)
+
+    def test_empty_stream_reports_skipped_not_zero(self):
+        fields = trace_lib.serve_attribution([])
+        assert fields["requests"] == 0
+        assert fields["max_residual_pct"] == \
+            ("skipped", "no finished requests in stream")
+
+    def test_emitted_record_validates(self, tmp_path, tiny):
+        records, tel, *_ = _churn_serve(tmp_path, tiny, name="attr",
+                                        collect_events=True)
+        fields = trace_lib.serve_attribution(tel.events)
+        rec = monitor.MetricsRegistry().emit_serve_attribution(
+            "SKIP", reason="cpu test run", **fields)
+        assert monitor.validate(rec) == []
+
+
+class TestFlightRecorder:
+    def test_dump_holds_exactly_last_n(self, tmp_path):
+        fr = trace_lib.enable_flight_recorder(capacity=4,
+                                              out_dir=str(tmp_path))
+        try:
+            monitor.enable(str(tmp_path / "ev.jsonl"))
+            try:
+                for i in range(10):
+                    monitor.emit_event("tick", i=i)
+            finally:
+                monitor.disable()
+            path = trace_lib.flight_dump("scripted_anomaly")
+            assert path is not None
+            dump = json.load(open(path))
+            assert dump["kind"] == "flight_recorder_dump"
+            assert dump["num_events"] == 4
+            assert [e["i"] for e in dump["events"]] == [6, 7, 8, 9]
+            assert monitor.validate(dump) == []
+            # once=True (the anomaly layer's mode) dedups by reason
+            assert trace_lib.flight_dump("scripted_anomaly") is None
+            assert trace_lib.flight_dump("other_anomaly") is not None
+        finally:
+            trace_lib.disable_flight_recorder()
+
+    def test_ring_accumulates_without_a_sink(self, tmp_path):
+        """The degraded-mode contract: the ring fills from the emit
+        path even when the registry has NO JSONL sink attached."""
+        fr = trace_lib.enable_flight_recorder(capacity=8,
+                                              out_dir=str(tmp_path))
+        try:
+            reg = monitor.MetricsRegistry()  # sink-less
+            for i in range(3):
+                reg.emit("event", name="quiet", i=i)
+            assert len(fr) == 3
+            path = fr.dump("no_sink")
+            assert json.load(open(path))["num_events"] == 3
+        finally:
+            trace_lib.disable_flight_recorder()
+
+    def test_signal_handler_dumps_then_chains(self, tmp_path):
+        """SIGUSR1 stand-in for SIGTERM: the installed handler writes
+        the dump and the PREVIOUS handler still runs."""
+        fr = trace_lib.enable_flight_recorder(capacity=4,
+                                              out_dir=str(tmp_path))
+        fr.record({"kind": "event", "name": "pre-crash"})
+        seen = []
+        prev = signal.signal(signal.SIGUSR1,
+                             lambda s, f: seen.append(s))
+        try:
+            trace_lib.install_signal_handler(signal.SIGUSR1)
+            os.kill(os.getpid(), signal.SIGUSR1)
+            assert seen == [signal.SIGUSR1]
+            assert len(fr.dumps) == 1
+            dump = json.load(open(fr.dumps[0]))
+            assert dump["reason"] == f"signal:{int(signal.SIGUSR1)}"
+            assert dump["events"][0]["name"] == "pre-crash"
+        finally:
+            signal.signal(signal.SIGUSR1, prev)
+            trace_lib.disable_flight_recorder()
+
+
+class TestChromeExport:
+    def test_mixed_serve_exports_one_named_track_per_request(
+            self, tmp_path, tiny):
+        """THE acceptance run: an off-TPU mixed sweep (chunked prefill
+        + decode + spec rounds + a forced preemption) exports to
+        trace-event JSON where every request is one named track whose
+        slices share its trace id — and the zero-recompile contract
+        held with tracing on."""
+        records, tel, eng, sched, done = _churn_serve(
+            tmp_path, tiny, draft=NGramDrafter(k=4), name="chrome",
+            collect_events=True)
+        assert eng.prefill_chunk._cache_size() == 1
+        assert eng.spec_step._cache_size() == 1
+        doc = trace_lib.chrome_trace(records)
+        assert doc["displayTimeUnit"] == "ms"
+        assert doc["otherData"]["clock_sync"]["kind"] == "clock_sync"
+        json.loads(json.dumps(doc))  # loadable trace-event JSON
+        names = {e["args"]["name"]: e["pid"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M"
+                 and e.get("name") == "process_name"}
+        tids = {r.rid: r.trace_id for r in done}
+        saw_preempt = saw_spec = False
+        for rid in (0, 1, 2):
+            label = f"req {rid} [{tids[rid]}]"
+            assert label in names, f"missing request track {label}"
+            slices = [e for e in doc["traceEvents"]
+                      if e.get("ph") == "X" and e["pid"] == names[label]]
+            assert slices, f"request track {label} has no slices"
+            phases = {e["name"] for e in slices}
+            assert {"queue", "prefill", "decode"} <= phases \
+                   or "recompute" in phases
+            assert all(e["args"].get("trace_id") == tids[rid]
+                       for e in slices)
+            assert all(e["dur"] > 0 for e in slices)
+            saw_preempt = saw_preempt or "preempt" in phases
+            saw_spec = saw_spec or "spec" in phases
+        assert saw_preempt, "the forced preemption left no slice"
+        assert saw_spec, "spec rounds left no slices"
+
+    def test_write_gz_round_trips(self, tmp_path, tiny):
+        records, *_ = _churn_serve(tmp_path, tiny, name="gz")
+        out = str(tmp_path / "t.json.gz")
+        trace_lib.write_chrome_trace(out, records)
+        with gzip.open(out, "rt") as fh:
+            assert json.load(fh)["traceEvents"]
+
+
+class TestCLI:
+    def test_trace_subcommand_writes_loadable_json(self, tmp_path, tiny,
+                                                   capsys):
+        records, *_ = _churn_serve(tmp_path, tiny, name="cli")
+        stream = tmp_path / "cli.jsonl"
+        out = str(tmp_path / "out.trace.json")
+        assert monitor_report.main(["trace", str(stream),
+                                    "--out", out]) == 0
+        assert "request tracks" in capsys.readouterr().out
+        assert json.load(open(out))["traceEvents"]
+
+    def test_trace_subcommand_refuses_empty_export(self, tmp_path,
+                                                   capsys):
+        bare = tmp_path / "bare.jsonl"
+        bare.write_text(json.dumps({"schema": 1, "kind": "meta"}) + "\n")
+        assert monitor_report.main(["trace", str(bare)]) == 2
+        assert "SKIP(" in capsys.readouterr().out
+
+    def test_report_attribution_renders(self, tmp_path, tiny, capsys):
+        _churn_serve(tmp_path, tiny, draft=NGramDrafter(k=4),
+                     name="rep")
+        stream = str(tmp_path / "rep.jsonl")
+        assert monitor_report.main(["report", stream,
+                                    "--attribution"]) == 0
+        out = capsys.readouterr().out
+        assert "serve attribution: 3 requests" in out
+        assert "evict x" in out
+
+    def test_report_attribution_skip_line_on_bare_stream(
+            self, tmp_path, capsys):
+        """Satellite 2: a requested-but-absent section prints an
+        explicit SKIP(reason) line, never a silent empty section."""
+        bare = tmp_path / "bare.jsonl"
+        bare.write_text(json.dumps({"schema": 1, "kind": "meta"}) + "\n")
+        assert monitor_report.main(["report", str(bare),
+                                    "--attribution"]) == 0
+        out = capsys.readouterr().out
+        assert "serve attribution: SKIP(" in out
+
+    def test_report_attribution_json_carries_record(self, tmp_path,
+                                                    tiny, capsys):
+        _churn_serve(tmp_path, tiny, name="repj")
+        stream = str(tmp_path / "repj.jsonl")
+        assert monitor_report.main(["report", stream, "--attribution",
+                                    "--json"]) == 0
+        summary = json.loads(capsys.readouterr().out)
+        rec = summary["serve_attribution"]
+        assert rec["kind"] == "serve_attribution"
+        assert rec["requests"] == 3
+        assert monitor.validate(rec) == []
+
+
+class TestValidatorTrace:
+    def _attr_record(self, tmp_path, tiny):
+        records, tel, *_ = _churn_serve(tmp_path, tiny, name="vm",
+                                        collect_events=True)
+        fields = trace_lib.serve_attribution(tel.events,
+                                             per_request=False)
+        return monitor.MetricsRegistry().emit_serve_attribution(
+            "OK", **fields), records
+
+    def test_trace_family_dispatch(self, tmp_path, tiny):
+        rec, records = self._attr_record(tmp_path, tiny)
+        good = tmp_path / "attr.json"
+        good.write_text(json.dumps(rec))
+        assert validate_metrics.main(["--trace", str(good)]) == 0
+        # the serve stream contains a clock_sync → family satisfied
+        assert validate_metrics.main(
+            ["--trace", str(tmp_path / "vm.jsonl")]) == 0
+        # a stream with NO tracing-family record fails the dispatch
+        other = tmp_path / "other.jsonl"
+        other.write_text(json.dumps({"schema": 1, "kind": "meta"}) + "\n")
+        assert validate_metrics.main(["--trace", str(other)]) == 1
+        # single object of the wrong kind fails as the wrong artifact
+        wrong = tmp_path / "wrong.json"
+        wrong.write_text(json.dumps({"schema": 1, "kind": "serve",
+                                     "status": "SKIP", "reason": "x"}))
+        assert validate_metrics.main(["--trace", str(wrong)]) == 1
+
+    def test_closed_schema_rejects_junk_key(self, tmp_path, tiny):
+        rec, _ = self._attr_record(tmp_path, tiny)
+        bad = dict(rec)
+        bad["junk_key"] = 1
+        path = tmp_path / "junk.json"
+        path.write_text(json.dumps(bad))
+        assert validate_metrics.main(["--trace", str(path)]) == 1
+
+    def test_nan_in_ok_record_fails_honesty(self, tmp_path, tiny):
+        rec, _ = self._attr_record(tmp_path, tiny)
+        assert rec["status"] == "OK"
+        bad = dict(rec)
+        bad["e2e_ms_total"] = float("nan")
+        path = tmp_path / "nan.json"
+        path.write_text(json.dumps(bad))  # json allows NaN; the gate not
+        assert validate_metrics.main(["--trace", str(path)]) == 1
+
+    def test_flight_dump_passes_trace_dispatch(self, tmp_path):
+        fr = trace_lib.enable_flight_recorder(capacity=3,
+                                              out_dir=str(tmp_path))
+        try:
+            monitor.enable(str(tmp_path / "fd.jsonl"))
+            try:
+                for i in range(5):
+                    monitor.emit_event("tick", i=i)
+            finally:
+                monitor.disable()
+            path = fr.dump("scripted")
+        finally:
+            trace_lib.disable_flight_recorder()
+        assert validate_metrics.main(["--trace", path]) == 0

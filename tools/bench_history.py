@@ -69,12 +69,21 @@ _LOWER_IS_BETTER = {"plan_predicted_vs_measured_err_pct",
                     # async checkpointing's per-step cost: already a
                     # percentage of a step, and a healthy async saver
                     # sits near 0 — percent-drift against ~0 is noise
-                    "ckpt_save_overhead_pct"}
+                    "ckpt_save_overhead_pct",
+                    # request-lifecycle telemetry's measured cost as a
+                    # fraction of the serve wall (the <1% budget): the
+                    # same absolute-points rule — healthy is near 0
+                    "serve_telemetry_overhead_pct"}
 
 # lower-is-better metrics gated by PERCENT drift (latency series: the
 # prefix-hit TTFT p50 must not creep up across the trajectory — the
 # serving tier-2 headline is that a hit stays fast)
 _LOWER_IS_BETTER_PCT = {"serve_prefix_hit_ttft_p50_ms"}
+
+# hard absolute ceilings on top of trajectory drift: a fresh value over
+# its budget fails EVEN IF the history crept up alongside it (drift
+# gates catch jumps; budgets catch slow boil)
+_ABSOLUTE_BUDGET = {"serve_telemetry_overhead_pct": 1.0}
 
 
 def extract_all(obj: Dict[str, Any], label: str = "artifact"
@@ -114,6 +123,14 @@ def extract_all(obj: Dict[str, Any], label: str = "artifact"
             if isinstance(hit, (int, float)):
                 rows.append(("serve_prefix_hit_ttft_p50_ms",
                              float(hit), 0.0))
+            # the telemetry-cost series (absent on pre-tracing records):
+            # gated in absolute points against the 1% budget — creeping
+            # instrumentation must show up as a regression, and the
+            # throughput spread says nothing about it
+            ovh = obj.get("telemetry_overhead_pct")
+            if isinstance(ovh, (int, float)):
+                rows.append(("serve_telemetry_overhead_pct",
+                             float(ovh), 0.0))
         return rows
     if kind == "plan":
         # the planner record's gated series is its predicted-vs-measured
@@ -323,6 +340,11 @@ def _gate_series(metric: str, value: float, fresh_spread: float,
     points (the reference may legitimately be ~0%), lower-is-better
     latency series drift UP in percent, throughput drifts DOWN in
     percent."""
+    budget = _ABSOLUTE_BUDGET.get(metric)
+    if budget is not None and value > budget:
+        print(f"REGRESSION {metric}: {value:g} exceeds the absolute "
+              f"budget {budget:g}")
+        return 1
     allowed = tol + fresh_spread + ref_spread
     ref = os.path.basename(ref_path)
     spread_note = (f" = tol {tol:g} + spread "

@@ -14,6 +14,7 @@ Usage::
     python tools/validate_metrics.py --plan plan.jsonl ...
     python tools/validate_metrics.py --ckpt ckpt.jsonl ...
     python tools/validate_metrics.py --spec spec.jsonl ...
+    python tools/validate_metrics.py --trace flight-dump.json ...
 
 Dispatch is by content, not extension:
 
@@ -73,7 +74,10 @@ Dispatch is by content, not extension:
   listed file to be judged as that artifact (same rationale as
   ``--lint-report``: an artifact that lost its ``kind`` key must fail
   as a bad profile/serve/pipeline/costdb/static_cost/plan/ckpt/spec,
-  not as an unrecognized shape).
+  not as an unrecognized shape). ``--trace`` forces the request-scoped
+  tracing FAMILY (``serve_attribution`` / ``clock_sync`` /
+  ``flight_recorder_dump`` — all closed schemas): a single object must
+  be one of the three, a stream must contain at least one.
 
 Exit status 0 when every file is clean; 1 otherwise, with one problem per
 line on stderr. The logic lives in ``apex_tpu.monitor.schema`` so tests
@@ -142,7 +146,7 @@ def validate_object(obj) -> list:
 
 
 def validate_file(path: str, *, as_lint_report: bool = False,
-                  force_kind: str = None) -> list:
+                  force_kind=None) -> list:
     problems = []
     with open(path) as fh:
         text = fh.read()
@@ -153,15 +157,20 @@ def validate_file(path: str, *, as_lint_report: bool = False,
             return [f"{path}: not JSON: {e}"]
         return [f"{path}: {e}" for e in validate_lint_report(obj)]
     if force_kind is not None:
-        # --profile / --costdb: judge the file as that artifact kind —
-        # one JSON object, or a JSONL stream that must CONTAIN the kind
+        # --profile / --costdb / --trace: judge the file as that
+        # artifact kind (or kind FAMILY — --trace accepts any of the
+        # tracing records) — one JSON object, or a JSONL stream that
+        # must CONTAIN one of the kinds
+        family = (force_kind if isinstance(force_kind, tuple)
+                  else (force_kind,))
+        want = " or ".join(repr(k) for k in family)
         try:
             obj = json.loads(text)
         except json.JSONDecodeError:
             obj = None
         if isinstance(obj, dict):
-            if obj.get("kind") != force_kind:
-                return [f"{path}: expected a {force_kind!r} artifact, "
+            if obj.get("kind") not in family:
+                return [f"{path}: expected a {want} artifact, "
                         f"got kind={obj.get('kind')!r}"]
             return [f"{path}: {e}" for e in schema.validate(obj)]
         problems = [f"{path}:{lineno}: {err}"
@@ -175,9 +184,9 @@ def validate_file(path: str, *, as_lint_report: bool = False,
                     kinds.add(json.loads(line).get("kind"))
                 except json.JSONDecodeError:
                     pass
-        if force_kind not in kinds:
+        if not kinds.intersection(family):
             problems.append(
-                f"{path}: stream carries no {force_kind!r} record")
+                f"{path}: stream carries no {want} record")
         return problems
     # one JSON value in the whole file → single artifact; otherwise JSONL
     obj = None
@@ -216,10 +225,16 @@ def main(argv=None) -> int:
         force_kind = "ckpt"
     elif "--spec" in argv:
         force_kind = "spec"
+    elif "--trace" in argv:
+        # the request-scoped tracing family: an attribution summary, a
+        # clock_sync stamp, or a flight-recorder dump all count
+        force_kind = ("serve_attribution", "clock_sync",
+                      "flight_recorder_dump")
     argv = [a for a in argv
             if a not in ("--lint-report", "--costdb", "--profile",
                          "--serve", "--serve-window", "--pipeline",
-                         "--static-cost", "--plan", "--ckpt", "--spec")]
+                         "--static-cost", "--plan", "--ckpt", "--spec",
+                         "--trace")]
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
