@@ -41,11 +41,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from apex_tpu.inference.sampling import sample_logits
-from apex_tpu.models.gpt import GPTModel
+from apex_tpu.models.gpt import GPTModel, shard_params_for_tp
 from apex_tpu.monitor import spans as monitor_spans
 from apex_tpu.monitor import trace as monitor_trace
-from apex_tpu.ops import fused_layer_norm, fused_verify
+from apex_tpu.ops import decode_attention, fused_layer_norm, fused_verify
 from apex_tpu.ops.pallas.attention import NEG_INF
+from apex_tpu.parallel import mesh as mesh_lib
+from apex_tpu.serving import tp as tp_serving
 
 
 @dataclass
@@ -82,7 +84,7 @@ class DecodeEngine:
 
     def __init__(self, model: GPTModel, *, max_seq_len: Optional[int] = None,
                  cache_dtype: Any = None, temperature: float = 0.0,
-                 top_k: int = 0):
+                 top_k: int = 0, plan=None):
         model.check_decode_supported()
         self.model = model
         c = self.config = model.config
@@ -103,6 +105,51 @@ class DecodeEngine:
         self.cache_dtype = cache_dtype or c.dtype
         self.temperature = float(temperature)
         self.top_k = int(top_k)
+        # tensor-parallel decode (ROADMAP tier 2c): plan.tp >= 2 shards
+        # the cache's kv-head axis and the projections across chips.
+        # DecodeEngine is GREEDY-only under tp: its sampled path is
+        # jax.random.categorical, whose draws do not compose bitwise
+        # across vocab shards (ServingEngine's fused Gumbel tail does)
+        self.plan = plan
+        self.tp = int(plan.tp) if plan is not None else 1
+        self._mesh = None
+        if self.tp > 1:
+            if self.temperature > 0:
+                raise ValueError(
+                    f"temperature={self.temperature} with plan.tp="
+                    f"{self.tp}: DecodeEngine's sampled path draws via "
+                    f"jax.random.categorical, which does not compose "
+                    f"across vocab shards — decode greedy "
+                    f"(temperature=0.0) under tp, or sample through "
+                    f"ServingEngine's psum-composed fused tail")
+            tp_serving.validate_tp(
+                plan, c, engine="DecodeEngine",
+                temperature=self.temperature, top_k=self.top_k,
+                has_rel_bias=getattr(model, "decode_rel_bias",
+                                     None) is not None)
+            self._mesh = tp_serving.tp_mesh(self.tp)
+            P = jax.sharding.PartitionSpec
+            kv, rep = P(None, None, "tp"), P()
+            cache_spec = {"k": kv, "v": kv}
+            self._cache_spec = cache_spec
+            # replicated-activation shard bodies (overlap=False helpers:
+            # batch and prompt lengths are not tp-divisible in general,
+            # so the boundary collectives are plain psums here; the
+            # ring-overlap contract is witnessed on the ServingEngine
+            # programs). Logits reassemble the vocab row via output
+            # sharding — never an all_gather inside the program.
+            self._tp_prefill = mesh_lib.shard_map(
+                self._prefill_body_tp, mesh=self._mesh,
+                in_specs=(P("tp"), rep, rep),
+                out_specs=(cache_spec, rep, P(None, "tp")))
+            self._tp_decode = mesh_lib.shard_map(
+                self._decode_step_body_tp, mesh=self._mesh,
+                in_specs=(P("tp"), cache_spec, rep, rep, rep),
+                out_specs=(cache_spec, rep, P(None, "tp")))
+            self._tp_spec = mesh_lib.shard_map(
+                self._spec_verify_body_tp, mesh=self._mesh,
+                in_specs=(P("tp"), cache_spec, rep, rep, rep, rep),
+                out_specs=(cache_spec, rep, rep))
         # one jitted executable each; decode additionally donates the cache
         # (argnums: params=0, cache=1, tokens=2, pos=3, key=4)
         self.prefill = jax.jit(self._prefill)
@@ -144,6 +191,8 @@ class DecodeEngine:
         structure (flash attention over the full prompt) with each layer's
         k/v exposed — cache contents ARE the training forward's k/v."""
         with monitor_spans.span("decode_prefill"):
+            if self.tp > 1:
+                return self._tp_prefill(params, tokens, key)
             return self._prefill_body(params, tokens, key)
 
     def _prefill_body(self, params, tokens, key):
@@ -183,6 +232,8 @@ class DecodeEngine:
         # `monitor report --anatomy` correlates on); no-op when
         # monitoring is off, and never touches the zero-recompile avals
         with monitor_spans.span("decode_step"):
+            if self.tp > 1:
+                return self._tp_decode(params, cache, tokens, pos, key)
             return self._decode_step_body(params, cache, tokens, pos, key)
 
     def _decode_step_body(self, params, cache, tokens, pos, key):
@@ -221,6 +272,201 @@ class DecodeEngine:
         logits = model.unembed(params, x)[:, 0]
         return {"k": ck, "v": cv}, self._sample(logits, key), logits
 
+    # --- tensor-parallel bodies (plan.tp >= 2) -------------------------------
+    #
+    # Per-shard twins run INSIDE shard_map: params arrive as
+    # shard_params_for_tp slices, the cache's kv-head axis is this
+    # shard's contiguous slice, activations stay replicated (batch and
+    # prompt lengths aren't tp-divisible in general, so projections use
+    # the plain dot+psum form), and the greedy argmax / verify tails
+    # psum-compose so every shard emits identical tokens.
+
+    def _prepare_params(self, params):
+        """tp == 1: passthrough. Under tp: split the replicated tree
+        into per-rank shards (leading ``(tp,)`` axis) committed to the
+        mesh under ``P('tp')``."""
+        if self.tp == 1:
+            return params
+        sharded = shard_params_for_tp(params, self.tp, self.config)
+        sh = jax.sharding.NamedSharding(self._mesh,
+                                        jax.sharding.PartitionSpec("tp"))
+        return jax.tree.map(lambda a: jax.device_put(a, sh), sharded)
+
+    def _prefill_body_tp(self, params, tokens, key):
+        c = self.config
+        axis, tp = tp_serving.TENSOR_AXIS, self.tp
+        h_loc, hkv_loc = c.num_heads // tp, c.kv_heads // tp
+        group, d = h_loc // hkv_loc, c.head_dim
+        params = tp_serving.take_shard(params)
+        b, s = tokens.shape
+        emb = params["embedding"]["weight"]
+        x = tp_serving.vocab_embed(emb, tokens, axis=axis)
+        x = x + params["pos_embedding"][:s]
+        scale = 1.0 / d ** 0.5
+        ii = jnp.arange(s, dtype=jnp.int32)
+        mask = ii[None, None, :, None] >= ii[None, None, None, :]
+        ks, vs = [], []
+        for i in range(c.num_layers):
+            layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+            h_in = fused_layer_norm(x, layer["ln1_w"], layer["ln1_b"])
+            y = tp_serving.column_parallel(
+                h_in, layer["qkv"]["weight"], layer["qkv"].get("bias"),
+                axis=axis, overlap=False)
+            q = y[..., :h_loc * d].reshape(b, s, h_loc, d)
+            k = y[..., h_loc * d:(h_loc + hkv_loc) * d] \
+                .reshape(b, s, hkv_loc, d)
+            v = y[..., (h_loc + hkv_loc) * d:].reshape(b, s, hkv_loc, d)
+            kh = k.transpose(0, 2, 1, 3)  # (b, hkv_loc, s, d)
+            vh = v.transpose(0, 2, 1, 3)
+            ks.append(kh)
+            vs.append(vh)
+            qg = q.reshape(b, s, hkv_loc, group, d) \
+                .transpose(0, 2, 3, 1, 4)  # (b, hkv_loc, group, s, d)
+            sc = jnp.einsum("bhgqd,bhkd->bhgqk", qg,
+                            kh.astype(qg.dtype),
+                            preferred_element_type=jnp.float32) * scale
+            sc = jnp.where(mask[:, None], sc, NEG_INF)
+            p = jax.nn.softmax(sc, axis=-1)
+            ctx = jnp.einsum("bhgqk,bhkd->bhgqd", p.astype(vh.dtype), vh)
+            ctx = ctx.transpose(0, 3, 1, 2, 4).reshape(b, s, h_loc * d)
+            x = x + tp_serving.row_parallel(
+                ctx, layer["attn_out"]["weight"],
+                layer["attn_out"].get("bias"), axis=axis, overlap=False)
+            h2 = fused_layer_norm(x, layer["ln2_w"], layer["ln2_b"])
+            h = tp_serving.column_parallel(
+                h2, layer["mlp_up"]["weight"],
+                layer["mlp_up"].get("bias"), axis=axis, overlap=False)
+            h = jax.nn.gelu(h, approximate=True)
+            x = x + tp_serving.row_parallel(
+                h, layer["mlp_down"]["weight"],
+                layer["mlp_down"].get("bias"), axis=axis, overlap=False)
+        x = fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
+        logits = jnp.dot(x[:, -1], emb.T)  # (b, V/tp)
+        shape = (c.num_layers, b, hkv_loc, self.max_s, d)
+        cache = {"k": jnp.zeros(shape, self.cache_dtype),
+                 "v": jnp.zeros(shape, self.cache_dtype)}
+        cache = {
+            "k": cache["k"].at[:, :, :, :s].set(
+                jnp.stack(ks).astype(self.cache_dtype)),
+            "v": cache["v"].at[:, :, :, :s].set(
+                jnp.stack(vs).astype(self.cache_dtype)),
+        }
+        tok = tp_serving.row_argmax_tp(logits, axis=axis)
+        return cache, tok, logits
+
+    def _decode_step_body_tp(self, params, cache, tokens, pos, key):
+        c = self.config
+        axis, tp = tp_serving.TENSOR_AXIS, self.tp
+        h_loc, hkv_loc = c.num_heads // tp, c.kv_heads // tp
+        d = c.head_dim
+        params = tp_serving.take_shard(params)
+        b = tokens.shape[0]
+        pos = jnp.asarray(pos, jnp.int32)
+        emb = params["embedding"]["weight"]
+        x = tp_serving.vocab_embed(emb, tokens[:, None], axis=axis)
+        x = x + jax.lax.dynamic_slice(
+            params["pos_embedding"], (pos, 0), (1, c.hidden_size))[None]
+        ck, cv = cache["k"], cache["v"]
+        lengths = jnp.full((b,), pos + 1, jnp.int32)
+        zero = jnp.int32(0)
+        for i in range(c.num_layers):
+            layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+            h_in = fused_layer_norm(x, layer["ln1_w"], layer["ln1_b"])
+            y = tp_serving.column_parallel(
+                h_in[:, 0], layer["qkv"]["weight"],
+                layer["qkv"].get("bias"), axis=axis, overlap=False)
+            q = y[:, :h_loc * d].reshape(b, h_loc, d)
+            k_row = y[:, h_loc * d:(h_loc + hkv_loc) * d] \
+                .reshape(b, hkv_loc, d)
+            v_row = y[:, (h_loc + hkv_loc) * d:].reshape(b, hkv_loc, d)
+            ck = jax.lax.dynamic_update_slice(
+                ck, k_row[None, :, :, None].astype(ck.dtype),
+                (jnp.int32(i), zero, zero, pos, zero))
+            cv = jax.lax.dynamic_update_slice(
+                cv, v_row[None, :, :, None].astype(cv.dtype),
+                (jnp.int32(i), zero, zero, pos, zero))
+            # the fused decode-attention kernel, untouched: this shard
+            # owns a contiguous kv-head slice of the contiguous cache
+            ctx = decode_attention(q, ck[i], cv[i], lengths)
+            out = tp_serving.row_parallel(
+                ctx.reshape(b, h_loc * d), layer["attn_out"]["weight"],
+                layer["attn_out"].get("bias"), axis=axis, overlap=False)
+            x = x + out[:, None]
+            h2 = fused_layer_norm(x, layer["ln2_w"], layer["ln2_b"])
+            h = tp_serving.column_parallel(
+                h2[:, 0], layer["mlp_up"]["weight"],
+                layer["mlp_up"].get("bias"), axis=axis, overlap=False)
+            h = jax.nn.gelu(h, approximate=True)
+            m = tp_serving.row_parallel(
+                h, layer["mlp_down"]["weight"],
+                layer["mlp_down"].get("bias"), axis=axis, overlap=False)
+            x = x + m[:, None]
+        x = fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
+        logits = jnp.dot(x[:, 0], emb.T)  # (b, V/tp)
+        tok = tp_serving.row_argmax_tp(logits, axis=axis)
+        return {"k": ck, "v": cv}, tok, logits
+
+    def _spec_verify_body_tp(self, params, cache, tokens, pos, drafted,
+                             key):
+        c = self.config
+        axis, tp = tp_serving.TENSOR_AXIS, self.tp
+        h_loc, hkv_loc = c.num_heads // tp, c.kv_heads // tp
+        group, d = h_loc // hkv_loc, c.head_dim
+        params = tp_serving.take_shard(params)
+        b, K1 = tokens.shape
+        pos = jnp.asarray(pos, jnp.int32)
+        positions = pos + jnp.arange(K1, dtype=jnp.int32)
+        emb = params["embedding"]["weight"]
+        x = tp_serving.vocab_embed(emb, tokens, axis=axis)  # (1, K1, H)
+        ptab = params["pos_embedding"]
+        x = x + jnp.take(ptab, jnp.minimum(positions, ptab.shape[0] - 1),
+                         axis=0)[None]
+        ck, cv = cache["k"], cache["v"]
+        scale = 1.0 / d ** 0.5
+        js = jnp.arange(self.max_s, dtype=jnp.int32)
+        mask = js[None, None, None, :] <= positions[None, None, :, None]
+        zero = jnp.int32(0)
+        for i in range(c.num_layers):
+            layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+            h_in = fused_layer_norm(x, layer["ln1_w"], layer["ln1_b"])
+            y = tp_serving.column_parallel(
+                h_in, layer["qkv"]["weight"], layer["qkv"].get("bias"),
+                axis=axis, overlap=False)
+            q = y[..., :h_loc * d]
+            k = y[..., h_loc * d:(h_loc + hkv_loc) * d] \
+                .reshape(b, K1, hkv_loc, d)
+            v = y[..., (h_loc + hkv_loc) * d:].reshape(b, K1, hkv_loc, d)
+            ck = jax.lax.dynamic_update_slice(
+                ck, k.transpose(0, 2, 1, 3)[None].astype(ck.dtype),
+                (jnp.int32(i), zero, zero, pos, zero))
+            cv = jax.lax.dynamic_update_slice(
+                cv, v.transpose(0, 2, 1, 3)[None].astype(cv.dtype),
+                (jnp.int32(i), zero, zero, pos, zero))
+            k_all, v_all = ck[i][0], cv[i][0]  # (hkv_loc, max_s, d)
+            qg = q[0].reshape(K1, hkv_loc, group, d).transpose(1, 2, 0, 3)
+            s = jnp.einsum("hgcd,hsd->hgcs", qg, k_all.astype(qg.dtype),
+                           preferred_element_type=jnp.float32) * scale
+            s = jnp.where(mask[0], s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("hgcs,hsd->hgcd", p.astype(v_all.dtype),
+                             v_all)
+            ctx = ctx.transpose(2, 0, 1, 3).reshape(b, K1, h_loc * d)
+            x = x + tp_serving.row_parallel(
+                ctx, layer["attn_out"]["weight"],
+                layer["attn_out"].get("bias"), axis=axis, overlap=False)
+            h2 = fused_layer_norm(x, layer["ln2_w"], layer["ln2_b"])
+            h = tp_serving.column_parallel(
+                h2, layer["mlp_up"]["weight"],
+                layer["mlp_up"].get("bias"), axis=axis, overlap=False)
+            h = jax.nn.gelu(h, approximate=True)
+            x = x + tp_serving.row_parallel(
+                h, layer["mlp_down"]["weight"],
+                layer["mlp_down"].get("bias"), axis=axis, overlap=False)
+        x = fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
+        logits = jnp.dot(x, emb.T)  # (1, K1, V/tp)
+        a, nxt = tp_serving.verify_greedy_tp(logits, drafted, axis=axis)
+        return {"k": ck, "v": cv}, a, nxt
+
     # --- speculative verification --------------------------------------------
 
     def _spec_verify_step(self, params, cache, tokens, pos, drafted, key):
@@ -234,6 +480,9 @@ class DecodeEngine:
         length masking IS the rewind on a contiguous cache. Avals depend
         only on the static k: one executable across every round."""
         with monitor_spans.span("spec_verify"):
+            if self.tp > 1:
+                return self._tp_spec(params, cache, tokens, pos,
+                                     drafted, key)
             return self._spec_verify_body(params, cache, tokens, pos,
                                           drafted, key)
 
@@ -396,6 +645,9 @@ class DecodeEngine:
             raise ValueError("temperature > 0 generation requires a key")
         if key is None:  # greedy: the key operand is ignored but keeps the
             key = jax.random.PRNGKey(0)  # step signature (and avals) fixed
+        # under tp the steps consume the sharded (tp,)-leading tree,
+        # committed to the mesh once per generate() call
+        params = self._prepare_params(params)
         # one trace id per generate() call: every span the loop emits
         # (decode_prefill, decode_step, spec_verify) joins to this call
         # in a merged timeline. An already-ambient id (a caller's serve/

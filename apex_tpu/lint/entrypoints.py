@@ -35,7 +35,12 @@ suites used to assert with one-off walkers:
   one body) and the int8-KV decode step (quantize-on-write + in-pool
   scale planes), each with the COW tables in play: pool donated and
   rebound, collective-free — ISSUE 15's two new device programs under
-  the same contract set.
+  the same contract set;
+* ``serve_prefill_tp`` / ``serve_decode_tp`` — the tensor-parallel
+  serving bodies (pool sharded over kv_heads, projections riding the
+  collective-matmul ring): pool donated and rebound, ``ppermute`` over
+  tp present, NO full-width ``all_gather`` over tp — ISSUE 17's
+  bigger-than-one-chip acceptance under the same COW operands.
 
 Tracing the same programs also yields their
 :func:`~apex_tpu.lint.jaxpr_check.static_cost` reports — the planner's
@@ -701,6 +706,76 @@ def _build_serve_decode_quantized():
     if batch is None:
         raise RuntimeError(
             "quantized serve entrypoint expected a live decode batch")
+    toks, lens = batch
+    tables = jnp.asarray(sched.tables.asarray())
+    return engine.decode_step, (params, pool, tables,
+                                jnp.asarray(toks), jnp.asarray(lens),
+                                jr.PRNGKey(0))  # apexlint: disable=APX502
+
+
+# --- tensor-parallel serving bodies (ISSUE 17) --------------------------------
+
+def _tp_serving_engine():
+    """The tp=2 ServingEngine over the smoke model, with the SAME COW
+    scheduler state in play as the single-chip serve entrypoints: the
+    sharded-pool programs are judged on the operands the disaggregated
+    tier really dispatches (shared refcounted prefix blocks in the
+    tables, params pre-sharded P('tp'), pool k/v sharded over the
+    kv-head axis)."""
+    import jax.numpy as jnp
+
+    from apex_tpu.plan.parallel_plan import ParallelPlan
+    from apex_tpu.serving import ServingEngine
+
+    model, params = _gpt_smoke_model()
+    engine = ServingEngine(model, num_slots=4, block_size=32,
+                           plan=ParallelPlan(tp=2))
+    params = engine._prepare_params(params)
+    return engine, params, jnp
+
+
+_TP_SERVE_CONTRACTS = lambda: [  # noqa: E731 — mirrors the lambdas above
+    jc.donation_honored(), jc.donation_rebound(),
+    jc.ppermute_present("tp"), jc.no_full_width_all_gather("tp")]
+
+
+@register(
+    "serve_prefill_tp",
+    "tp=2 serving chunked-prefill body: pool sharded over kv_heads, "
+    "QKV/output projections on the ppermute ring (pool donated+"
+    "rebound; no full-width all_gather over tp)",
+    _TP_SERVE_CONTRACTS)
+def _build_serve_prefill_tp():
+    import jax.random as jr
+
+    engine, params, jnp = _tp_serving_engine()
+    sched, slot_b, start = _cow_scheduler(engine)
+    pool = engine.init_pool()
+    C = engine.prefill_chunk_size
+    table_row = jnp.asarray(sched.tables.row(slot_b))
+    tokens = jnp.zeros((C,), jnp.int32)
+    live = min(C, len(sched._slots[slot_b].eprompt) - start)
+    return engine.prefill_chunk, (params, pool, table_row, tokens,
+                                  jnp.int32(start), jnp.int32(live),
+                                  jr.PRNGKey(0))  # apexlint: disable=APX502
+
+
+@register(
+    "serve_decode_tp",
+    "tp=2 serving paged decode step: per-shard paged attention over "
+    "the contiguous kv-head slice, psum-composed sampling tail (pool "
+    "donated+rebound; ppermute ring, no full-width all_gather over tp)",
+    _TP_SERVE_CONTRACTS)
+def _build_serve_decode_tp():
+    import jax.random as jr
+
+    engine, params, jnp = _tp_serving_engine()
+    sched, _, _ = _cow_scheduler(engine)
+    pool = engine.init_pool()
+    batch = sched.decode_batch(0.0)
+    if batch is None:
+        raise RuntimeError(
+            "tp serve entrypoint expected a live decode batch")
     toks, lens = batch
     tables = jnp.asarray(sched.tables.asarray())
     return engine.decode_step, (params, pool, tables,

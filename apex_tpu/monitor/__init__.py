@@ -71,6 +71,7 @@ from apex_tpu.monitor.registry import (  # noqa: F401
     emit_serve_window,
     emit_spec,
     emit_tp_overlap,
+    emit_tp_serve,
     enable,
     enable_from_env,
     enabled,

@@ -342,6 +342,15 @@ class MetricsRegistry:
         quantization leg's bounded logit error vs the float oracle."""
         return self._emit_status_record("spec", status, **fields)
 
+    def emit_tp_serve(self, status: str, **fields) -> Dict[str, Any]:
+        """Tensor-parallel serving bench record (``bench.py --serve
+        --plan-tp N``): churn tokens/s with the paged pool sharded over
+        kv_heads and ring-overlapped projections, the tp=1 baseline and
+        greedy-parity witness, per-decode-step collective traffic, and
+        the disaggregated prefill→decode handoff leg (TTFT, streamed
+        blocks/bytes, digest verification)."""
+        return self._emit_status_record("tp_serve", status, **fields)
+
     def emit_serve_attribution(self, status: str,
                                **fields) -> Dict[str, Any]:
         """Per-request latency-attribution record — the fields come from
@@ -596,6 +605,13 @@ def emit_spec(status: str, **fields) -> Optional[Dict[str, Any]]:
     r = _REGISTRY
     if r is not None:
         return r.emit_spec(status, **fields)
+    return None
+
+
+def emit_tp_serve(status: str, **fields) -> Optional[Dict[str, Any]]:
+    r = _REGISTRY
+    if r is not None:
+        return r.emit_tp_serve(status, **fields)
     return None
 
 

@@ -83,6 +83,7 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     plan_records = []
     ckpt_records = []
     spec_records = []
+    tp_serve_records = []
     schedule = None
     for rec in records:
         kind = rec.get("kind")
@@ -112,6 +113,8 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             ckpt_records.append(rec)
         elif kind == "spec":
             spec_records.append(rec)
+        elif kind == "tp_serve":
+            tp_serve_records.append(rec)
         elif kind == "event" and rec.get("name") == "pipeline_schedule":
             schedule = rec
 
@@ -322,6 +325,24 @@ def aggregate(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                            "churn_parity", "jit_cache_ok",
                            "spread_pct"))
 
+    if tp_serve_records:
+        summary["tp_serve"] = status_summary(
+            tp_serve_records, ("tp", "tokens_per_s",
+                               "baseline_tokens_per_s",
+                               "ttft_ms_prefill_role",
+                               "ttft_ms_monolithic", "handoff_blocks",
+                               "handoff_transfer_bytes",
+                               "handoff_transfer_ms",
+                               "digests_verified",
+                               "collective_ppermute_calls",
+                               "collective_ppermute_bytes",
+                               "decode_steps",
+                               "collective_bytes_per_step",
+                               "greedy_parity", "handoff_parity",
+                               "jit_cache_ok", "kv_dtype", "requests",
+                               "num_blocks", "pool_mb_per_shard",
+                               "pool_mb_total", "spread_pct"))
+
     if gate_records:
         summary["gates"] = [
             {"name": g.get("name"), "ok": g.get("ok"),
@@ -411,6 +432,17 @@ def serve_timeline(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             row["blocks_released"] = rec.get("blocks_released")
             row["requeue_pos"] = rec.get("requeue_pos")
             row["outcome"] = "evicted"  # until a finish overwrites it
+        elif phase == "handoff":
+            # disaggregated KV streaming: one leg per engine role; a
+            # merged two-role stream folds both legs into the row
+            # (same rid + trace_id on both sides by construction)
+            roles = row.setdefault("handoff_roles", [])
+            if rec.get("handoff_role"):
+                roles.append(rec["handoff_role"])
+            row["handoff_blocks"] = rec.get("blocks")
+            row["handoff_bytes"] = (
+                row.get("handoff_bytes", 0)
+                + (rec.get("transfer_bytes") or 0))
         elif phase == "finish":
             row["finish_s"] = rec.get("at_s")
             row["tokens"] = rec.get("tokens")
@@ -555,6 +587,11 @@ def format_serve_timeline(timeline: Dict[str, Any]) -> str:
                      f"{r.get('evict_reason') or '?'}, "
                      f"{_n(r, 'blocks_released')} blk released, "
                      f"requeued at {_n(r, 'requeue_pos')}]")
+        if r.get("handoff_roles"):
+            # the disaggregated prefill→decode leg(s) this stream saw
+            line += (f"  [handoff {'+'.join(r['handoff_roles'])}: "
+                     f"{_n(r, 'handoff_blocks')} blk, "
+                     f"{_n(r, 'handoff_bytes')} B]")
         lines.append(line)
     def _num(w, *keys, default="-"):
         # serve_timeline materializes every window key (absent -> None),
@@ -780,6 +817,40 @@ def render(summary: Dict[str, Any]) -> str:
             if spc.get("skipped"):
                 parts.append("skipped: " + ", ".join(spc["skipped"]))
             lines.append("  spec        " + "   ".join(parts))
+    tps = summary.get("tp_serve")
+    if tps:
+        if tps.get("status") == "SKIP":
+            lines.append(f"  tp-serve    SKIP({tps.get('reason', '?')})")
+        else:
+            parts = []
+            if isinstance(tps.get("tokens_per_s"), (int, float)):
+                parts.append(f"{tps['tokens_per_s']:.1f} tok/s")
+            if isinstance(tps.get("tp"), (int, float)):
+                parts.append(f"tp={tps['tp']:g}")
+            if isinstance(tps.get("pool_mb_per_shard"), (int, float)):
+                parts.append(
+                    f"pool {tps['pool_mb_per_shard']:.1f} MB/shard")
+            if isinstance(tps.get("collective_bytes_per_step"),
+                          (int, float)):
+                parts.append(
+                    f"{tps['collective_bytes_per_step'] / 1024:.1f} "
+                    f"KiB coll/step")
+            if isinstance(tps.get("handoff_transfer_bytes"),
+                          (int, float)):
+                hand = (f"handoff {tps['handoff_transfer_bytes']} B"
+                        + (f"/{tps['handoff_blocks']:g} blk"
+                           if isinstance(tps.get("handoff_blocks"),
+                                         (int, float)) else ""))
+                if isinstance(tps.get("handoff_transfer_ms"),
+                              (int, float)):
+                    hand += f" in {tps['handoff_transfer_ms']:.1f}ms"
+                parts.append(hand)
+            for flag in ("greedy_parity", "handoff_parity"):
+                if tps.get(flag) is False:
+                    parts.append(f"{flag.replace('_', ' ')} BROKEN")
+            if tps.get("skipped"):
+                parts.append("skipped: " + ", ".join(tps["skipped"]))
+            lines.append("  tp-serve    " + "   ".join(parts))
     pl = summary.get("plan")
     if pl:
         parts = []

@@ -398,7 +398,7 @@ SERVE_EVENT_SCHEMA = {
         "rid": {"type": "integer"},
         "phase": {"enum": ["submit", "admit", "prefill_chunk",
                            "first_token", "decode", "finish", "evict",
-                           "swap", "spec"]},
+                           "swap", "spec", "handoff"]},
         "at_s": {"type": "number"},        # serve-clock transition time
         "slot": {"type": "integer"},
         "step": {"type": "integer"},       # engine dispatch counter
@@ -432,6 +432,12 @@ SERVE_EVENT_SCHEMA = {
         # accepted_len of draft_k drafted tokens survived verification
         "accepted_len": {"type": "integer"},
         "draft_k": {"type": "integer"},
+        # disaggregated KV handoff (ISSUE 17): one record per request
+        # per role — the SAME trace_id rides the export (prefill
+        # engine) and ingest (decode engine) legs
+        "handoff_role": {"enum": ["export", "ingest"]},
+        "blocks": {"type": "integer"},         # handoff: blocks streamed
+        "transfer_bytes": {"type": "integer"},  # handoff: payload bytes
     },
     "required": ["schema", "kind", "rid", "phase", "at_s"],
 }
@@ -843,6 +849,58 @@ SPEC_SCHEMA = {
     "additionalProperties": False,
 }
 
+# tensor-parallel serving bench record (`python bench.py --serve
+# --plan-tp N`, ISSUE 17): one artifact for the serve-a-model-bigger-
+# than-one-chip story — churn throughput with the paged pool sharded
+# over kv_heads and the projections riding the ring-overlap collective
+# matmuls, the tp=1 baseline on the same request schedule (greedy
+# parity token-identical by construction, asserted in the record), the
+# per-decode-step collective traffic from the ring counters, and the
+# disaggregated prefill→decode leg: the prefill role's TTFT, the
+# streamed KV payload (blocks/bytes/export+ingest wall), digest
+# verification, and handoff parity vs the monolithic engine. Same
+# status semantics as decode/serve/spec: "OK" (real multichip TPU)
+# engages the honesty rule; off-TPU (or a single chip) the record is an
+# explicit SKIP(reason) with the virtual-mesh smoke measurements riding
+# along — never nan in an OK line. CLOSED schema: a junk key fails
+# validation (the drift tests pin exactly that).
+TP_SERVE_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["tp_serve"]},
+        "status": {"enum": ["OK", "SKIP"]},
+        "reason": {"type": "string"},  # required when status == "SKIP"
+        "tp": {"type": "integer"},               # shard count
+        "tokens_per_s": _METRIC_VALUE,           # tp serve under churn
+        "baseline_tokens_per_s": _METRIC_VALUE,  # tp=1, same schedule
+        "ttft_ms_prefill_role": _METRIC_VALUE,   # disagg prefill mean
+        "ttft_ms_monolithic": _METRIC_VALUE,     # same reqs, one engine
+        "handoff_blocks": {"type": "integer"},   # KV blocks streamed
+        "handoff_transfer_bytes": {"type": "integer"},
+        "handoff_transfer_ms": _METRIC_VALUE,    # export+ingest wall
+        "digests_verified": {"type": "integer"},
+        "collective_ppermute_calls": {"type": "integer"},  # ring hops
+        "collective_ppermute_bytes": {"type": "integer"},
+        "decode_steps": {"type": "integer"},
+        "collective_bytes_per_step": _METRIC_VALUE,
+        "greedy_parity": {"type": "boolean"},    # tp == tp=1 tokens
+        "handoff_parity": {"type": "boolean"},   # disagg == monolithic
+        "jit_cache_ok": {"type": "boolean"},     # every body pinned at 1
+        "kv_dtype": {"type": "string"},
+        "requests": {"type": "integer"},
+        "num_blocks": {"type": "integer"},       # GLOBAL pool blocks
+        "pool_mb_per_shard": _METRIC_VALUE,      # the bigger-than-one-
+        "pool_mb_total": _METRIC_VALUE,          # chip arithmetic
+        "spread_pct": _METRIC_VALUE,
+        "pass_times_ms": {"type": "array", "items": {"type": "number"}},
+        "config": {"type": "object"},
+        "backend": {"type": "string"},
+    },
+    "required": ["schema", "kind", "status"],
+    "additionalProperties": False,
+}
+
 # per-process clock-sync record (ISSUE 16): the monotonic↔wall offset
 # emitted once at monitor.enable() — `mono_ns` (time.perf_counter_ns)
 # and `wall_s` (time.time) read back to back, so any consumer can map
@@ -963,6 +1021,7 @@ SCHEMAS_BY_KIND = {
     "plan": PLAN_SCHEMA,
     "ckpt": CKPT_SCHEMA,
     "spec": SPEC_SCHEMA,
+    "tp_serve": TP_SERVE_SCHEMA,
     "clock_sync": CLOCK_SYNC_SCHEMA,
     "serve_attribution": SERVE_ATTRIBUTION_SCHEMA,
     "flight_recorder_dump": FLIGHT_RECORDER_SCHEMA,
@@ -1066,7 +1125,7 @@ def validate(record: Dict[str, Any],
     if (record.get("kind") in ("decode", "longseq_bias", "tp_overlap",
                                "profile", "serve", "pipeline",
                                "serve_window", "plan", "ckpt", "spec",
-                               "serve_attribution")
+                               "tp_serve", "serve_attribution")
             and record.get("status") == "SKIP"
             and not record.get("reason")):
         errors.append(

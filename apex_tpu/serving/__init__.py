@@ -43,6 +43,31 @@ stalling the in-flight streams. This package is that engine:
   the accepted frontier, greedy output token-identical to plain
   decode (see :mod:`apex_tpu.spec` for the drafters).
 
+* :mod:`~apex_tpu.serving.tp` — **tensor-parallel serving** (ISSUE 17):
+  the eager :func:`~apex_tpu.serving.tp.validate_tp` door (every
+  divisibility and knob check fails at construction with the knob
+  named), plus the shard-level building blocks the TP step bodies are
+  written in — column/row-parallel projections riding the ring-overlap
+  collective matmuls, psum-composed vocab embed / argmax / Gumbel
+  sampling tails (one draw over the full vocab row, so greedy AND
+  sampled output is token-identical to ``tp=1``), and the pmax-amax
+  int8 quantization whose scales are bitwise those of the unsharded
+  pool.
+* :mod:`~apex_tpu.serving.disagg` — **disaggregated prefill → decode**
+  (ISSUE 17): the prefill role serves ``max_new_tokens=1`` clones
+  (:func:`~apex_tpu.serving.disagg.prefill_requests`), exports each
+  request's full-block KV chain out of the paged pool content-addressed
+  by the :class:`~apex_tpu.serving.kv_blocks.PrefixCache` keys
+  (:func:`~apex_tpu.serving.disagg.export_handoff`), frames it on disk
+  as a digest-carrying manifest + raw block payloads
+  (:func:`~apex_tpu.serving.disagg.write_handoff` /
+  :func:`~apex_tpu.serving.disagg.read_handoff`, the PR-14 checkpoint
+  manifest idiom), and the decode role ingests the streamed blocks
+  into its own pool + prefix cache
+  (:func:`~apex_tpu.serving.disagg.ingest_handoff`) so admission hits
+  the warm chain and prefill collapses to the final private block —
+  output token-identical to the monolithic engine.
+
 * :mod:`~apex_tpu.serving.telemetry` — **request-level telemetry**
   (ISSUE 10): per-request lifecycle ``serve_event`` records
   (``submit → admit → prefill_chunk*k → first_token → decode →
@@ -60,6 +85,14 @@ and the scheduler contract, ``docs/OBSERVABILITY.md`` for the telemetry
 walkthrough.
 """
 
+from apex_tpu.serving.disagg import (  # noqa: F401
+    Handoff,
+    export_handoff,
+    ingest_handoff,
+    prefill_requests,
+    read_handoff,
+    write_handoff,
+)
 from apex_tpu.serving.engine import ServingEngine  # noqa: F401
 from apex_tpu.serving.kv_blocks import (  # noqa: F401
     DEAD_BLOCK,

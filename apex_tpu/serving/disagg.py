@@ -1,0 +1,330 @@
+"""Disaggregated serving: a prefill engine and a decode engine as two
+roles, with content-addressed KV-block streaming between their pools.
+
+Chunked prefill is already a separable phase of the serve loop, and the
+:class:`~apex_tpu.serving.kv_blocks.PrefixCache` already gives every
+full prompt block a CONTENT identity — the chained ``(parent, block
+tokens)`` key. Disaggregation rides both: the **prefill role** serves
+each request to its first token (filling its pool and indexing the
+prompt's full blocks in its prefix cache), then :func:`export_handoff`
+walks the cached chain and lifts each block's k/v rows (plus the int8
+scale rows on a quantized pool) off the device with a sha256 digest per
+block. The payload crosses the process boundary as a directory —
+:func:`write_handoff` / :func:`read_handoff`, framed exactly like the
+PR-14 checkpoint transfer (an atomically-replaced ``manifest.json``
+naming format/version/digest algo and the per-block digest table; raw
+little-endian array files alongside) — and the **decode role**'s
+:func:`ingest_handoff` verifies every digest, allocates pool blocks,
+writes the streamed rows in, and indexes the chain in ITS prefix cache.
+The decode engine then serves the same requests through the ordinary
+admission path: the prompt's blocks are prefix-cache hits, prefill
+collapses to the final block (whose last-row logits seed the first
+sampled token — the recompute the copy-on-write contract always
+requires), and greedy output is token-identical to a monolithic engine.
+
+Nothing here adds device programs: export/ingest are host-side
+``jnp`` gathers and ``.at[].set`` writes between dispatches, the pools
+keep their avals, and both engines keep their jit caches pinned at 1.
+The ``handoff`` lifecycle event (:meth:`~apex_tpu.serving.telemetry.
+ServeTelemetry.on_handoff`) fires on BOTH roles carrying the SAME
+request trace id — the id travels inside the payload — so a merged
+timeline joins the export and ingest legs of one request.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from apex_tpu.serving.kv_blocks import ROOT_EID
+
+HANDOFF_FORMAT = "apex_tpu.kv_handoff"
+HANDOFF_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+
+
+def block_digest(arrays: Dict[str, np.ndarray]) -> str:
+    """sha256 over the block's raw bytes, arrays in sorted-name order
+    (the same per-buffer digest discipline as the PR-14 checkpoint
+    manifest): the ingest side recomputes this from what it actually
+    received, so a corrupted or cross-wired transfer is loud."""
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        a = np.ascontiguousarray(arrays[name])
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class BlockPayload:
+    """One streamed full prompt block: its content key (the block's
+    ``block_size`` token ids — chain position gives the full prefix
+    identity), the pool rows per array name (``k``/``v`` are
+    ``(layers, kv_heads, block_size, head_dim)``; ``k_scale``/
+    ``v_scale`` ``(layers, block_size)`` on int8 pools), and the sha256
+    digest of those rows."""
+
+    tokens: Tuple[int, ...]
+    arrays: Dict[str, np.ndarray]
+    digest: str
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(a.nbytes) for a in self.arrays.values())
+
+
+@dataclasses.dataclass
+class Handoff:
+    """One request's prefill→decode payload: the prompt (so the decode
+    role re-derives the chain keys), the streamed blocks in chain
+    order, and the request's trace id (the SAME id tags the ``handoff``
+    lifecycle event on both engine roles)."""
+
+    rid: int
+    prompt: np.ndarray
+    blocks: List[BlockPayload]
+    trace_id: Optional[str] = None
+
+    @property
+    def nbytes(self) -> int:
+        return sum(b.nbytes for b in self.blocks)
+
+
+@dataclasses.dataclass
+class HandoffStats:
+    """Host accounting of one ingest: blocks written into the pool,
+    payload bytes, digests verified, and chain links skipped (pool or
+    cache pressure on the decode side — skipped blocks are simply
+    recomputed by prefill, never an error)."""
+
+    blocks: int = 0
+    nbytes: int = 0
+    digests_verified: int = 0
+    skipped: int = 0
+
+
+def export_handoff(pool, scheduler, req, *, block_size: int,
+                   telemetry=None, now: float = 0.0) -> Handoff:
+    """The prefill role's half: walk the longest cached chain covering
+    ``req.prompt``'s full blocks (side-effect-free match — exporting
+    must not perturb the source cache's LRU or hit accounting) and lift
+    each chain block's pool rows to host with a digest. Raises when
+    nothing is cached for the prompt — an export before (or instead of)
+    the prefill run is a harness bug worth naming."""
+    cache = scheduler.prefix_cache
+    if cache is None:
+        raise ValueError(
+            "export_handoff needs the prefill scheduler's prefix cache "
+            "(make_scheduler(prefix_cache=True)): the cache's chained "
+            "content keys ARE the handoff's block addressing")
+    chain = cache.match(req.prompt, count=False)
+    if not chain:
+        raise ValueError(
+            f"export_handoff found no cached blocks for request "
+            f"{req.rid} (prompt of {len(req.prompt)} tokens, "
+            f"block_size={block_size}): run the prefill role's serve() "
+            f"first — only prefilled full blocks are exportable")
+    t0 = time.perf_counter()
+    blocks: List[BlockPayload] = []
+    for e in chain:
+        arrays = {name: np.asarray(pool[name][:, e.block_id])
+                  for name in pool}
+        blocks.append(BlockPayload(tokens=e.tokens, arrays=arrays,
+                                   digest=block_digest(arrays)))
+    h = Handoff(rid=req.rid, prompt=np.asarray(req.prompt, np.int32),
+                blocks=blocks,
+                trace_id=getattr(req, "trace_id", None))
+    if telemetry is not None:
+        telemetry.on_handoff(req.rid, "export", len(blocks), h.nbytes,
+                             now,
+                             dur_ms=(time.perf_counter() - t0) * 1e3,
+                             trace_id=h.trace_id)
+    return h
+
+
+def write_handoff(directory: str, handoffs: List[Handoff]) -> int:
+    """Serialize handoffs for the process boundary: one raw
+    little-endian array file per (request, block, array) plus ONE
+    atomically-replaced ``manifest.json`` naming format, version,
+    digest algo, prompts, per-block token keys / digests / array
+    layouts — the PR-14 framing: readers validate the manifest before
+    touching a data file, and a torn write never shows a manifest.
+    Returns payload bytes written (the transfer size the ``tp_serve``
+    record reports)."""
+    os.makedirs(directory, exist_ok=True)
+    total = 0
+    reqs = []
+    for h in handoffs:
+        blocks = []
+        for bi, b in enumerate(h.blocks):
+            arrays = {}
+            for name, a in b.arrays.items():
+                a = np.ascontiguousarray(a)
+                fname = f"r{h.rid}_b{bi}_{name}.bin"
+                with open(os.path.join(directory, fname), "wb") as fh:
+                    fh.write(a.tobytes())
+                arrays[name] = {"file": fname, "dtype": str(a.dtype),
+                                "shape": list(a.shape)}
+                total += int(a.nbytes)
+            blocks.append({"tokens": list(b.tokens), "digest": b.digest,
+                           "arrays": arrays})
+        reqs.append({"rid": int(h.rid),
+                     "prompt": [int(t) for t in h.prompt],
+                     "trace_id": h.trace_id, "blocks": blocks})
+    manifest = {"format": HANDOFF_FORMAT, "version": HANDOFF_VERSION,
+                "digest_algo": "sha256", "requests": reqs,
+                "payload_bytes": total}
+    path = os.path.join(directory, MANIFEST_NAME)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh, indent=1)
+    os.replace(tmp, path)
+    return total
+
+
+def read_handoff(directory: str) -> List[Handoff]:
+    """Read a handoff directory back, validating the manifest first
+    (format/version named eagerly, PR-14 style) and VERIFYING every
+    block digest against the bytes actually read — a mismatch names
+    the request and block, never serves silently corrupt KV."""
+    path = os.path.join(directory, MANIFEST_NAME)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"no {MANIFEST_NAME} under {directory!r} — not a committed "
+            f"KV handoff (an interrupted export never commits its "
+            f"manifest)")
+    with open(path) as fh:
+        m = json.load(fh)
+    if m.get("format") != HANDOFF_FORMAT:
+        raise ValueError(
+            f"handoff manifest format {m.get('format')!r} is not "
+            f"{HANDOFF_FORMAT!r} — this directory does not hold a KV "
+            f"handoff")
+    if int(m.get("version", 0)) > HANDOFF_VERSION:
+        raise ValueError(
+            f"handoff manifest version {m.get('version')} is newer "
+            f"than this reader's {HANDOFF_VERSION} — upgrade before "
+            f"ingesting")
+    out: List[Handoff] = []
+    for r in m["requests"]:
+        blocks = []
+        for bi, b in enumerate(r["blocks"]):
+            arrays = {}
+            for name, spec in b["arrays"].items():
+                with open(os.path.join(directory, spec["file"]),
+                          "rb") as fh:
+                    raw = fh.read()
+                arrays[name] = np.frombuffer(
+                    raw, dtype=np.dtype(spec["dtype"])).reshape(
+                        spec["shape"]).copy()
+            got = block_digest(arrays)
+            if got != b["digest"]:
+                raise ValueError(
+                    f"handoff digest mismatch on request {r['rid']} "
+                    f"block {bi}: manifest {b['digest'][:12]}…, read "
+                    f"{got[:12]}… — the transfer corrupted this "
+                    f"block's KV rows")
+            blocks.append(BlockPayload(
+                tokens=tuple(int(t) for t in b["tokens"]),
+                arrays=arrays, digest=b["digest"]))
+        out.append(Handoff(rid=int(r["rid"]),
+                           prompt=np.asarray(r["prompt"], np.int32),
+                           blocks=blocks, trace_id=r.get("trace_id")))
+    return out
+
+
+def ingest_handoff(pool, scheduler, handoffs: List[Handoff], *,
+                   telemetry=None, now: float = 0.0,
+                   verify: bool = True) -> Tuple[Any, HandoffStats]:
+    """The decode role's half: for each handoff, re-verify the block
+    digests against the received arrays (``verify=True``; the file
+    reader already checked bytes-on-disk — this guards the in-memory
+    leg too), allocate a pool block per chain link, write the streamed
+    rows in, and index the chain in the decode scheduler's prefix
+    cache under the SAME content keys. Returns ``(pool, stats)`` —
+    ``pool`` is rebound (host-side ``.at[].set`` between dispatches;
+    same aval, committed sharding preserved under tp).
+
+    After ingest the blocks sit exactly as a finished request's warm
+    prefix would: one refcount held by the cache, marked resident — so
+    admission treats them as reclaimable capacity, and a decode-side
+    pool too small to hold the stream degrades to recompute (skipped
+    links counted in ``stats.skipped``), never to an error."""
+    alloc = scheduler.allocator
+    cache = scheduler.prefix_cache
+    if cache is None:
+        raise ValueError(
+            "ingest_handoff needs the decode scheduler's prefix cache "
+            "(make_scheduler(prefix_cache=True)): streamed blocks are "
+            "delivered to admission AS prefix-cache hits")
+    stats = HandoffStats()
+    pool = dict(pool)
+    for h in handoffs:
+        t0 = time.perf_counter()
+        parent = ROOT_EID
+        hb = hbytes = 0
+        for b in h.blocks:
+            if verify:
+                if block_digest(b.arrays) != b.digest:
+                    raise ValueError(
+                        f"handoff digest mismatch on request {h.rid}: "
+                        f"a streamed block's KV rows do not match its "
+                        f"content digest — refusing to serve from it")
+                stats.digests_verified += 1
+            # a chain broken upstream (an earlier link skipped) cannot
+            # accept later links: their parent key would not exist
+            if parent is None or alloc.num_free < 1:
+                stats.skipped += 1
+                parent = None
+                continue
+            bid = alloc.allocate(1)[0]
+            for name, a in b.arrays.items():
+                pool[name] = pool[name].at[:, bid].set(a)
+            eid = cache.insert(parent, b.tokens, bid,
+                               trace_id=h.trace_id)
+            entry = cache._by_eid.get(eid)
+            if entry is None or entry.block_id != bid:
+                # the cache declined to index (capacity) or an equal
+                # chain already existed: drop our pool copy — the
+                # existing/recomputed path serves the prefix
+                alloc.free([bid])
+                stats.skipped += 1
+                if entry is None:
+                    parent = None
+                    continue
+            else:
+                # hand our allocation reference over to the cache's
+                # (insert retained + marked resident): refcount settles
+                # at 1, exactly a finished request's warm prefix state
+                alloc.free([bid])
+                stats.blocks += 1
+                hb += 1
+                hbytes += b.nbytes
+                stats.nbytes += b.nbytes
+            parent = eid
+        if telemetry is not None:
+            telemetry.on_handoff(
+                h.rid, "ingest", hb, hbytes, now,
+                dur_ms=(time.perf_counter() - t0) * 1e3,
+                trace_id=h.trace_id)
+    return pool, stats
+
+
+def prefill_requests(requests: List) -> List:
+    """Clone ``requests`` for the prefill role: same rid/prompt/
+    arrival, ``max_new_tokens=1`` — the prefill engine runs exactly to
+    each request's first token (its TTFT) and fills the pool + prefix
+    cache; decode continues elsewhere."""
+    from apex_tpu.serving.scheduler import Request
+    return [Request(rid=r.rid, prompt=np.asarray(r.prompt, np.int32),
+                    max_new_tokens=1, arrival_s=r.arrival_s)
+            for r in requests]

@@ -62,12 +62,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from apex_tpu.models.gpt import GPTModel
+from apex_tpu.models.gpt import GPTModel, shard_params_for_tp
 from apex_tpu.monitor import registry as monitor_registry
 from apex_tpu.monitor import spans as monitor_spans
 from apex_tpu.monitor import trace as monitor_trace
 from apex_tpu.ops import fused_layer_norm, fused_sample, fused_verify
+from apex_tpu.ops.decode_attention import decode_attention
 from apex_tpu.ops.pallas.attention import NEG_INF
+from apex_tpu.parallel import mesh as mesh_lib
+from apex_tpu.serving import tp as tp_serving
 from apex_tpu.serving.kv_blocks import (DEAD_BLOCK, BlockAllocator,
                                         PrefixCache)
 from apex_tpu.serving.scheduler import Request, Scheduler, SLOPolicy
@@ -143,6 +146,14 @@ class ServingEngine:
       token sooner.
     * ``temperature`` / ``top_k`` / ``top_p`` — the fused sampling
       tail's static program (greedy when ``temperature == 0``).
+    * ``plan`` — a :class:`~apex_tpu.plan.parallel_plan.ParallelPlan`
+      with ``tp >= 2`` serves the model tensor-parallel: the paged
+      pool shards contiguous kv-head slices per chip (ONE logical free
+      list — allocator/tables stay host-side and identical across
+      shards), the projections ride the ring-overlapped collective
+      matmuls, and the fused sampling tail psum-composes so greedy
+      output stays token-identical to tp=1 (see
+      :mod:`apex_tpu.serving.tp`). Validated eagerly HERE.
     """
 
     def __init__(self, model: GPTModel, *, num_slots: int,
@@ -151,7 +162,7 @@ class ServingEngine:
                  prefill_chunk: Optional[int] = None,
                  cache_dtype: Any = None, kv_dtype: Optional[str] = None,
                  temperature: float = 0.0,
-                 top_k: int = 0, top_p: float = 1.0):
+                 top_k: int = 0, top_p: float = 1.0, plan=None):
         model.check_decode_supported()
         self.model = model
         c = self.config = model.config
@@ -207,7 +218,54 @@ class ServingEngine:
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
+        # tensor-parallel serving (ROADMAP tier 2c): plan.tp >= 2 shards
+        # the pool/projections/sampling tail across chips; tp == 1 (or
+        # plan=None) leaves every path byte-identical to the seed
+        self.plan = plan
+        self.tp = int(plan.tp) if plan is not None else 1
+        self._mesh = None
+        self._swap_ref = None
+        if self.tp > 1:
+            tp_serving.validate_tp(
+                plan, c, engine="ServingEngine",
+                num_slots=self.num_slots,
+                prefill_chunk=self.prefill_chunk_size,
+                num_blocks=self.num_blocks,
+                max_blocks_per_slot=self.max_blocks_per_slot,
+                temperature=self.temperature, top_k=self.top_k,
+                top_p=self.top_p,
+                has_rel_bias=getattr(model, "decode_rel_bias",
+                                     None) is not None)
+            self._mesh = tp_serving.tp_mesh(self.tp)
+            P = jax.sharding.PartitionSpec
+            kv, rep = P(None, None, "tp"), P()
+            pool_spec = ({"k": kv, "v": kv, "k_scale": rep,
+                          "v_scale": rep} if self.quantized
+                         else {"k": kv, "v": kv})
+            self._pool_spec = pool_spec
+            # the shard_mapped step bodies: params arrive P('tp') on the
+            # leading per-rank axis, pool k/v shard the kv-head axis,
+            # scales/tables/tokens/lengths/key replicate; sampled tokens
+            # come back replicated (the psum-composed tail computes the
+            # same ints on every shard) and logits reassemble the full
+            # vocab row from the shards — output assembly, never an
+            # all_gather inside the program (the jaxpr gate's witness)
+            self._tp_prefill = mesh_lib.shard_map(
+                self._prefill_chunk_body_tp, mesh=self._mesh,
+                in_specs=(P("tp"), pool_spec, rep, rep, rep, rep, rep),
+                out_specs=(pool_spec, rep, P("tp")))
+            self._tp_decode = mesh_lib.shard_map(
+                self._decode_step_body_tp, mesh=self._mesh,
+                in_specs=(P("tp"), pool_spec, rep, rep, rep, rep),
+                out_specs=(pool_spec, rep, P(None, "tp")))
+            self._tp_spec = mesh_lib.shard_map(
+                self._spec_step_body_tp, mesh=self._mesh,
+                in_specs=(P("tp"), pool_spec, rep, rep, rep, rep, rep),
+                out_specs=(pool_spec, rep, rep))
         self.last_stats: Optional[ServeStats] = None
+        # the last serve run's final pool (set by _serve_loop): the
+        # disaggregated prefill role exports its warm blocks from here
+        self.last_pool = None
         # pending weight hot-swap: (at_step, new_params, label) —
         # applied by the serve loop BETWEEN dispatch steps (see
         # request_swap)
@@ -238,12 +296,36 @@ class ServingEngine:
                  self.block_size, c.head_dim)
         if self.quantized:
             sshape = (c.num_layers, self.num_blocks, self.block_size)
-            return {"k": jnp.zeros(shape, jnp.int8),
+            pool = {"k": jnp.zeros(shape, jnp.int8),
                     "v": jnp.zeros(shape, jnp.int8),
                     "k_scale": jnp.zeros(sshape, jnp.float32),
                     "v_scale": jnp.zeros(sshape, jnp.float32)}
-        return {"k": jnp.zeros(shape, self.cache_dtype),
-                "v": jnp.zeros(shape, self.cache_dtype)}
+        else:
+            pool = {"k": jnp.zeros(shape, self.cache_dtype),
+                    "v": jnp.zeros(shape, self.cache_dtype)}
+        if self.tp > 1:
+            # commit the pool to its mesh sharding up front (k/v split
+            # on kv heads, scale planes replicated): the first dispatch
+            # then sees the same committed shardings as every later one
+            # — an uncommitted->committed transition would be a second
+            # jit cache entry, breaking the _cache_size() == 1 contract
+            pool = {
+                name: jax.device_put(a, jax.sharding.NamedSharding(
+                    self._mesh, self._pool_spec[name]))
+                for name, a in pool.items()}
+        return pool
+
+    def _prepare_params(self, params):
+        """tp == 1: passthrough. Under tp: split the replicated params
+        tree into per-rank shards (:func:`~apex_tpu.models.gpt.
+        shard_params_for_tp` — every leaf gains a leading ``(tp,)``
+        axis) and commit each leaf to the mesh under ``P('tp')``."""
+        if self.tp == 1:
+            return params
+        sharded = shard_params_for_tp(params, self.tp, self.config)
+        sh = jax.sharding.NamedSharding(self._mesh,
+                                        jax.sharding.PartitionSpec("tp"))
+        return jax.tree.map(lambda a: jax.device_put(a, sh), sharded)
 
     def pool_bytes(self) -> int:
         """HBM footprint of the whole pool (both k and v, plus the
@@ -327,8 +409,15 @@ class ServingEngine:
             return params
         self._pending_swap = None
         t0 = time.perf_counter()
-        self._validate_swap_avals(params, new_params)
+        # under tp the live params are the SHARDED tree; the contract is
+        # stated (and validated) against the replicated tree the caller
+        # handed serve() — the swap error names the caller's leaves
+        self._validate_swap_avals(
+            self._swap_ref if self.tp > 1 else params, new_params)
         stats.swaps += 1
+        if self.tp > 1:
+            self._swap_ref = new_params
+            new_params = self._prepare_params(new_params)
         if tel is not None:
             # the measured validate+rebind pause: attribution carves it
             # out of the decode time of every mid-decode request
@@ -351,6 +440,9 @@ class ServingEngine:
         # join key request lifecycle records correlate on; no-op when
         # monitoring is off, and never touches the stable avals
         with monitor_spans.span("serve_prefill"):
+            if self.tp > 1:
+                return self._tp_prefill(params, pool, table_row, tokens,
+                                        start, live, key)
             return self._prefill_chunk_body(params, pool, table_row,
                                             tokens, start, live, key)
 
@@ -461,6 +553,9 @@ class ServingEngine:
         # same trace-time scope as above: one span per TRACE (not per
         # token), prefixing the whole decode step's HLOs in device traces
         with monitor_spans.span("serve_decode"):
+            if self.tp > 1:
+                return self._tp_decode(params, pool, tables, tokens,
+                                       lengths, key)
             return self._decode_step_body(params, pool, tables, tokens,
                                           lengths, key)
 
@@ -525,6 +620,9 @@ class ServingEngine:
                    key):
         # trace-time step-anatomy span, like serve_prefill/serve_decode
         with monitor_spans.span("serve_spec"):
+            if self.tp > 1:
+                return self._tp_spec(params, pool, tables, tokens,
+                                     lengths, drafted, key)
             return self._spec_step_body(params, pool, tables, tokens,
                                         lengths, drafted, key)
 
@@ -619,6 +717,268 @@ class ServingEngine:
                               top_k=self.top_k, top_p=self.top_p)
         return self._pool_out(ck, cv, ks, vs), a, nxt
 
+    # --- tensor-parallel step bodies (plan.tp >= 2) --------------------------
+    #
+    # Per-shard twins of the bodies above, run INSIDE shard_map: params
+    # arrive as shard_params_for_tp slices, the pool's kv-head axis is
+    # this shard's contiguous slice (block ids/tables/free list are
+    # GLOBAL — one logical pool), projections ride the ring-overlapped
+    # collective matmuls (apex_tpu.serving.tp helpers over
+    # ops/collective_matmul), attention math is unchanged at local head
+    # counts (GQA group size is tp-invariant since kv_heads % tp), the
+    # int8 scales pmax-compose to the tp=1 values, and the sampling/
+    # verify tails psum-compose so every shard emits the same tokens.
+
+    def _prefill_chunk_body_tp(self, params, pool, table_row, tokens,
+                               start, live, key):
+        c = self.config
+        axis, tp = tp_serving.TENSOR_AXIS, self.tp
+        C, B = self.prefill_chunk_size, self.block_size
+        max_s = self.max_s
+        h_loc, hkv_loc = c.num_heads // tp, c.kv_heads // tp
+        group, d = h_loc // hkv_loc, c.head_dim
+        params = tp_serving.take_shard(params)
+        start = jnp.asarray(start, jnp.int32)
+        live = jnp.asarray(live, jnp.int32)
+
+        emb = params["embedding"]["weight"]  # (V/tp, H)
+        x = tp_serving.vocab_embed(emb, tokens[None], axis=axis)
+        pos = start + jnp.arange(C, dtype=jnp.int32)
+        ptab = params["pos_embedding"]
+        x = x + jnp.take(ptab, jnp.minimum(pos, ptab.shape[0] - 1),
+                         axis=0)[None]
+
+        nblk = C // B
+        ids = jax.lax.dynamic_slice(table_row.astype(jnp.int32),
+                                    (start // B,), (nblk,))
+        blk_live = (jnp.arange(nblk, dtype=jnp.int32) * B) < live
+        ids = jnp.where(blk_live, ids, DEAD_BLOCK)
+
+        scale = 1.0 / d ** 0.5
+        js = jnp.arange(max_s, dtype=jnp.int32)
+        mask = js[None, None, None, :] <= pos[None, None, :, None]
+        ck, cv = pool["k"], pool["v"]
+        ks, vs = pool.get("k_scale"), pool.get("v_scale")
+        for i in range(c.num_layers):
+            layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+            h_in = fused_layer_norm(x, layer["ln1_w"], layer["ln1_b"])
+            y = tp_serving.column_parallel(
+                h_in[0], layer["qkv"]["weight"],
+                layer["qkv"].get("bias"), axis=axis, seq_dim=0)
+            q = y[:, :h_loc * d].reshape(C, h_loc, d)
+            k = y[:, h_loc * d:(h_loc + hkv_loc) * d] \
+                .reshape(C, hkv_loc, d)
+            v = y[:, (h_loc + hkv_loc) * d:].reshape(C, hkv_loc, d)
+            kb = k.reshape(nblk, B, hkv_loc, d).transpose(0, 2, 1, 3)
+            vb = v.reshape(nblk, B, hkv_loc, d).transpose(0, 2, 1, 3)
+            if self.quantized:
+                kq, ksc = tp_serving.quant_rows_tp(kb, (1, 3), axis)
+                vq, vsc = tp_serving.quant_rows_tp(vb, (1, 3), axis)
+                ck = ck.at[i, ids].set(kq)
+                cv = cv.at[i, ids].set(vq)
+                ks = ks.at[i, ids].set(ksc)
+                vs = vs.at[i, ids].set(vsc)
+                k_all = (ck[i][table_row].astype(jnp.float32)
+                         * ks[i][table_row][:, None, :, None]) \
+                    .transpose(1, 0, 2, 3).reshape(hkv_loc, max_s, d)
+                v_all = (cv[i][table_row].astype(jnp.float32)
+                         * vs[i][table_row][:, None, :, None]) \
+                    .transpose(1, 0, 2, 3).reshape(hkv_loc, max_s, d)
+            else:
+                ck = ck.at[i, ids].set(kb.astype(ck.dtype))
+                cv = cv.at[i, ids].set(vb.astype(cv.dtype))
+                k_all = ck[i][table_row].transpose(1, 0, 2, 3) \
+                    .reshape(hkv_loc, max_s, d)
+                v_all = cv[i][table_row].transpose(1, 0, 2, 3) \
+                    .reshape(hkv_loc, max_s, d)
+            qg = q.reshape(C, hkv_loc, group, d).transpose(1, 2, 0, 3)
+            s = jnp.einsum("hgcd,hsd->hgcs", qg,
+                           k_all.astype(qg.dtype),
+                           preferred_element_type=jnp.float32) * scale
+            s = jnp.where(mask[0], s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("hgcs,hsd->hgcd", p.astype(v_all.dtype),
+                             v_all)
+            ctx = ctx.transpose(2, 0, 1, 3).reshape(C, h_loc * d)
+            out = tp_serving.row_parallel(
+                ctx, layer["attn_out"]["weight"],
+                layer["attn_out"].get("bias"), axis=axis, seq_dim=0)
+            x = x + out[None]
+            h2 = fused_layer_norm(x, layer["ln2_w"], layer["ln2_b"])
+            h = tp_serving.column_parallel(
+                h2[0], layer["mlp_up"]["weight"],
+                layer["mlp_up"].get("bias"), axis=axis, seq_dim=0)
+            h = jax.nn.gelu(h, approximate=True)
+            m = tp_serving.row_parallel(
+                h, layer["mlp_down"]["weight"],
+                layer["mlp_down"].get("bias"), axis=axis, seq_dim=0)
+            x = x + m[None]
+        x = fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
+        last = jax.lax.dynamic_slice(
+            x, (jnp.int32(0), live - 1, jnp.int32(0)),
+            (1, 1, c.hidden_size))
+        logits = jnp.dot(last[0], emb.T)  # (1, V/tp)
+        tok = tp_serving.sample_tp(logits, key,
+                                   temperature=self.temperature,
+                                   axis=axis)[0]
+        return self._pool_out(ck, cv, ks, vs), tok, logits[0]
+
+    def _decode_step_body_tp(self, params, pool, tables, tokens, lengths,
+                             key):
+        c = self.config
+        axis, tp = tp_serving.TENSOR_AXIS, self.tp
+        B = self.block_size
+        h_loc, hkv_loc = c.num_heads // tp, c.kv_heads // tp
+        d = c.head_dim
+        params = tp_serving.take_shard(params)
+        lengths = lengths.astype(jnp.int32)
+        pos = jnp.maximum(lengths - 1, 0)
+        emb = params["embedding"]["weight"]
+        x = tp_serving.vocab_embed(emb, tokens[:, None], axis=axis)
+        ptab = params["pos_embedding"]
+        x = x + jnp.take(ptab, jnp.minimum(pos, ptab.shape[0] - 1),
+                         axis=0)[:, None]
+        tables = tables.astype(jnp.int32)
+        bid = jnp.take_along_axis(tables, (pos // B)[:, None],
+                                  axis=1)[:, 0]
+        bid = jnp.where(lengths > 0, bid, DEAD_BLOCK)
+        row = pos % B
+        ck, cv = pool["k"], pool["v"]
+        ks, vs = pool.get("k_scale"), pool.get("v_scale")
+        for i in range(c.num_layers):
+            layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+            h_in = fused_layer_norm(x, layer["ln1_w"], layer["ln1_b"])
+            y = tp_serving.column_parallel(
+                h_in[:, 0], layer["qkv"]["weight"],
+                layer["qkv"].get("bias"), axis=axis, seq_dim=0)
+            q = y[:, :h_loc * d].reshape(-1, h_loc, d)
+            k_row = y[:, h_loc * d:(h_loc + hkv_loc) * d] \
+                .reshape(-1, hkv_loc, d)
+            v_row = y[:, (h_loc + hkv_loc) * d:].reshape(-1, hkv_loc, d)
+            if self.quantized:
+                kq, ksc = tp_serving.quant_rows_tp(k_row, (1, 2), axis)
+                vq, vsc = tp_serving.quant_rows_tp(v_row, (1, 2), axis)
+                ck = ck.at[i, bid, :, row].set(kq)
+                cv = cv.at[i, bid, :, row].set(vq)
+                ks = ks.at[i, bid, row].set(ksc)
+                vs = vs.at[i, bid, row].set(vsc)
+                k_scale, v_scale = ks[i], vs[i]
+            else:
+                ck = ck.at[i, bid, :, row].set(k_row.astype(ck.dtype))
+                cv = cv.at[i, bid, :, row].set(v_row.astype(cv.dtype))
+                k_scale = v_scale = None
+            # the paged decode-attention kernel, untouched: this shard
+            # owns a contiguous kv-head slice, so block tables, length
+            # masking, and the int8 scale indirection read identically
+            ctx = decode_attention(q, ck[i], cv[i], lengths,
+                                   block_tables=tables,
+                                   k_scale=k_scale, v_scale=v_scale)
+            out = tp_serving.row_parallel(
+                ctx.reshape(-1, h_loc * d), layer["attn_out"]["weight"],
+                layer["attn_out"].get("bias"), axis=axis, seq_dim=0)
+            x = x + out[:, None]
+            h2 = fused_layer_norm(x, layer["ln2_w"], layer["ln2_b"])
+            h = tp_serving.column_parallel(
+                h2[:, 0], layer["mlp_up"]["weight"],
+                layer["mlp_up"].get("bias"), axis=axis, seq_dim=0)
+            h = jax.nn.gelu(h, approximate=True)
+            m = tp_serving.row_parallel(
+                h, layer["mlp_down"]["weight"],
+                layer["mlp_down"].get("bias"), axis=axis, seq_dim=0)
+            x = x + m[:, None]
+        x = fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
+        logits = jnp.dot(x[:, 0], emb.T)  # (S, V/tp)
+        toks = tp_serving.sample_tp(logits, key,
+                                    temperature=self.temperature,
+                                    axis=axis)
+        return self._pool_out(ck, cv, ks, vs), toks, logits
+
+    def _spec_step_body_tp(self, params, pool, tables, tokens, lengths,
+                           drafted, key):
+        c = self.config
+        axis, tp = tp_serving.TENSOR_AXIS, self.tp
+        B = self.block_size
+        S, K1 = tokens.shape
+        h_loc, hkv_loc = c.num_heads // tp, c.kv_heads // tp
+        group, d = h_loc // hkv_loc, c.head_dim
+        max_s = self.max_s
+        params = tp_serving.take_shard(params)
+        lengths = lengths.astype(jnp.int32)
+        base = jnp.maximum(lengths - 1, 0)
+        pos = base[:, None] + jnp.arange(K1, dtype=jnp.int32)[None, :]
+        emb = params["embedding"]["weight"]
+        x = tp_serving.vocab_embed(emb, tokens, axis=axis)  # (S, K1, H)
+        ptab = params["pos_embedding"]
+        x = x + jnp.take(ptab, jnp.minimum(pos, ptab.shape[0] - 1),
+                         axis=0)
+        tables = tables.astype(jnp.int32)
+        bid = jnp.take_along_axis(tables, pos // B, axis=1)
+        bid = jnp.where(lengths[:, None] > 0, bid, DEAD_BLOCK)
+        row = pos % B
+        scale = 1.0 / d ** 0.5
+        js = jnp.arange(max_s, dtype=jnp.int32)
+        mask = js[None, None, None, None, :] \
+            <= pos[:, None, None, :, None]
+        ck, cv = pool["k"], pool["v"]
+        ks, vs = pool.get("k_scale"), pool.get("v_scale")
+        for i in range(c.num_layers):
+            layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+            h_in = fused_layer_norm(x, layer["ln1_w"], layer["ln1_b"])
+            y = tp_serving.column_parallel(
+                h_in, layer["qkv"]["weight"], layer["qkv"].get("bias"),
+                axis=axis, seq_dim=0)  # (S, K1, F/tp)
+            q = y[..., :h_loc * d]
+            k = y[..., h_loc * d:(h_loc + hkv_loc) * d] \
+                .reshape(S, K1, hkv_loc, d)
+            v = y[..., (h_loc + hkv_loc) * d:].reshape(S, K1, hkv_loc, d)
+            if self.quantized:
+                kq, ksc = tp_serving.quant_rows_tp(k, (2, 3), axis)
+                vq, vsc = tp_serving.quant_rows_tp(v, (2, 3), axis)
+                ck = ck.at[i, bid, :, row].set(kq)
+                cv = cv.at[i, bid, :, row].set(vq)
+                ks = ks.at[i, bid, row].set(ksc)
+                vs = vs.at[i, bid, row].set(vsc)
+                k_all = (ck[i][tables].astype(jnp.float32)
+                         * ks[i][tables][:, :, None, :, None])
+                v_all = (cv[i][tables].astype(jnp.float32)
+                         * vs[i][tables][:, :, None, :, None])
+            else:
+                ck = ck.at[i, bid, :, row].set(k.astype(ck.dtype))
+                cv = cv.at[i, bid, :, row].set(v.astype(cv.dtype))
+                k_all, v_all = ck[i][tables], cv[i][tables]
+            k_all = k_all.transpose(0, 2, 1, 3, 4) \
+                .reshape(S, hkv_loc, max_s, d)
+            v_all = v_all.transpose(0, 2, 1, 3, 4) \
+                .reshape(S, hkv_loc, max_s, d)
+            qg = q.reshape(S, K1, hkv_loc, group, d) \
+                .transpose(0, 2, 3, 1, 4)
+            s = jnp.einsum("bhgcd,bhsd->bhgcs", qg,
+                           k_all.astype(qg.dtype),
+                           preferred_element_type=jnp.float32) * scale
+            s = jnp.where(mask, s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bhgcs,bhsd->bhgcd", p.astype(v_all.dtype),
+                             v_all)
+            ctx = ctx.transpose(0, 3, 1, 2, 4).reshape(S, K1,
+                                                       h_loc * d)
+            out = tp_serving.row_parallel(
+                ctx, layer["attn_out"]["weight"],
+                layer["attn_out"].get("bias"), axis=axis, seq_dim=0)
+            x = x + out
+            h2 = fused_layer_norm(x, layer["ln2_w"], layer["ln2_b"])
+            h = tp_serving.column_parallel(
+                h2, layer["mlp_up"]["weight"],
+                layer["mlp_up"].get("bias"), axis=axis, seq_dim=0)
+            h = jax.nn.gelu(h, approximate=True)
+            m = tp_serving.row_parallel(
+                h, layer["mlp_down"]["weight"],
+                layer["mlp_down"].get("bias"), axis=axis, seq_dim=0)
+            x = x + m
+        x = fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
+        logits = jnp.dot(x, emb.T)  # (S, K1, V/tp)
+        a, nxt = tp_serving.verify_greedy_tp(logits, drafted, axis=axis)
+        return self._pool_out(ck, cv, ks, vs), a, nxt
+
     # --- the serving loop ----------------------------------------------------
 
     def make_scheduler(self, *, prefix_cache: bool = True,
@@ -648,7 +1008,7 @@ class ServingEngine:
               key: Optional[jax.Array] = None,
               clock: Optional[Callable[[], float]] = None,
               scheduler: Optional[Scheduler] = None,
-              telemetry=None, draft=None) -> List[Request]:
+              telemetry=None, draft=None, pool=None) -> List[Request]:
         """Run ``requests`` to completion; returns them in completion
         order with tokens and latency stamps filled in.
 
@@ -680,7 +1040,14 @@ class ServingEngine:
         choice, never a retrace), interleaving with chunked prefill
         exactly as decode does. Greedy output stays token-identical to
         ``draft=None`` across arbitrary churn; acceptance is accounted
-        in ``last_stats`` and per-round ``spec`` lifecycle events."""
+        in ``last_stats`` and per-round ``spec`` lifecycle events.
+
+        ``pool`` injects a pre-populated block pool (the disaggregated
+        decode role: :func:`~apex_tpu.serving.disagg.ingest_handoff`
+        streamed prefilled KV blocks into it); it must have been
+        created by THIS engine's :meth:`init_pool` and be paired with
+        the ``scheduler`` whose allocator/prefix cache own its live
+        blocks. Default: a fresh zeroed pool."""
         if self.temperature > 0 and key is None:
             raise ValueError("temperature > 0 serving requires a key")
         if draft is not None:
@@ -695,6 +1062,14 @@ class ServingEngine:
                     "with a decode relative-position bias (the spec "
                     "verify step does not carry the bucketed bias) — "
                     "serve this model with draft=None")
+            if self.tp > 1 and self.temperature > 0:
+                raise ValueError(
+                    "serve(draft=...) with temperature="
+                    f"{self.temperature} is unsupported under plan.tp="
+                    f"{self.tp}: the sharded verify tail composes the "
+                    "greedy argmax across shards but does not carry "
+                    "the rejection-sampling draw — serve greedy "
+                    "(temperature=0.0) or with plan.tp=1")
             from apex_tpu.spec.drafter import validate_drafter
             # eager, knob-naming validation: vocab/block_size/k/cache
             # bounds fail HERE, not as an XLA error three rounds in.
@@ -744,7 +1119,18 @@ class ServingEngine:
                 r.submit_s = now()
                 tel.on_submit(r, r.submit_s)
             sched.submit(r)
-        pool = self.init_pool()
+        if self.tp > 1:
+            # keep the caller's replicated tree as the hot-swap aval
+            # reference; the steps consume the sharded (tp,)-leading
+            # copy placed once here (same jit cache across serve calls)
+            self._swap_ref = params
+            params = self._prepare_params(params)
+        # a caller-provided pool must ride with ITS scheduler (the
+        # disaggregated decode role: blocks ingested from a prefill
+        # engine live in the pool AND in the scheduler's prefix cache /
+        # allocator — one without the other would serve garbage rows)
+        if pool is None:
+            pool = self.init_pool()
         stats = ServeStats()
         # per-transition lifecycle records skip the per-line sink flush
         # inside the loop (one flush at the end) — the dominant cost of
@@ -905,3 +1291,7 @@ class ServingEngine:
             if not did_work and wall:
                 # nothing runnable: only future arrivals remain
                 time.sleep(1e-4)
+        # the final pool outlives the loop for the disaggregated
+        # prefill role: export_handoff lifts warm prefix blocks out of
+        # it (paired with the scheduler whose cache indexes them)
+        self.last_pool = pool

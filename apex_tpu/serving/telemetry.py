@@ -62,7 +62,7 @@ __all__ = ["ServeTelemetry"]
 # ENGINE-level transition, rid -1 — a weight hot-swap landed between
 # dispatch steps)
 PHASES = ("submit", "admit", "prefill_chunk", "first_token", "decode",
-          "finish", "evict", "swap", "spec")
+          "finish", "evict", "swap", "spec", "handoff")
 
 
 class _InFlight:
@@ -177,6 +177,12 @@ class ServeTelemetry:
         self.prefix_miss_requests = 0
         # weight hot-swaps applied between dispatch steps (ISSUE 14)
         self.swaps = 0
+        # disaggregated prefill→decode handoff legs this engine played
+        # (either role): block/byte totals feed the tp_serve record
+        self.handoffs = 0
+        self.handoff_blocks = 0
+        self.handoff_bytes = 0
+        self.handoff_transfer_ms = 0.0
         # speculative-decoding rounds (ISSUE 15): per SLOT-round
         # accepted lengths accumulate into the serve record's
         # acceptance rate (spec_slot_rounds counts slot×dispatch —
@@ -346,6 +352,35 @@ class ServeTelemetry:
                       accepted_len=int(accepted), draft_k=int(k),
                       **self._tid(self._inflight.get(rid)))
         if dur_ms is not None:
+            fields["dur_ms"] = round(float(dur_ms), 3)
+        self._emit("serve_event", **fields)
+        self.overhead_ns += _mono() - t
+
+    def on_handoff(self, rid: int, role: str, blocks: int, nbytes: int,
+                   now: float, dur_ms: Optional[float] = None,
+                   trace_id: Optional[str] = None) -> None:
+        """One request's KV-block handoff leg (disaggregated serving):
+        ``role`` names which side this engine played (``"export"`` on
+        the prefill engine, ``"ingest"`` on the decode engine). The
+        SAME ``trace_id`` rides both roles' records — the caller
+        carries it across the process boundary inside the handoff
+        payload, so a merged timeline joins the export and ingest legs
+        of one request on one id."""
+        t = _mono()
+        if role not in ("export", "ingest"):
+            raise ValueError(
+                f"handoff role must be export|ingest, got {role!r}")
+        self.handoffs += 1
+        self.handoff_blocks += int(blocks)
+        self.handoff_bytes += int(nbytes)
+        fl = self._inflight.get(rid)
+        tid = trace_id or (fl.trace_id if fl is not None else None)
+        fields = dict(rid=int(rid), phase="handoff", at_s=now,
+                      handoff_role=role, blocks=int(blocks),
+                      transfer_bytes=int(nbytes),
+                      **({"trace_id": tid} if tid else {}))
+        if dur_ms is not None:
+            self.handoff_transfer_ms += float(dur_ms)
             fields["dur_ms"] = round(float(dur_ms), 3)
         self._emit("serve_event", **fields)
         self.overhead_ns += _mono() - t

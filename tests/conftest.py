@@ -235,6 +235,70 @@ _SLOW_OFF_TPU = {
     "tests/test_spec.py::TestDecodeEngineSpec::test_sampled_spec_generates_within_bounds",  # sampled verify semantics: TestFusedVerify::test_kernel_matches_fallback_sampled + test_sampled_acceptance_is_exact_for_sure_things stay
     "tests/test_spec.py::TestDrafters::test_model_drafter_single_compile_across_streams",  # drafter-step cache pin: test_greedy_parity_both_drafters asserts md.engine.decode_step._cache_size() == 1
     "tests/test_spec.py::TestFusedVerify::test_kernel_handles_long_drafts[32]",  # [8] (the first broken lane width) stays tier-1; 32 is the same 128-lane block
+    # r12 (TP serving PR): the heaviest tp shard_map sweeps move here
+    # (same contract: `-m ''` and hardware still run them; each row
+    # names the sibling that keeps its family covered in tier-1):
+    "tests/test_tp_serving.py::TestTPServingParity::test_churn_schedule_bitwise_vs_tp1[4]",  # [2] (same churn schedule, same asserts) stays
+    "tests/test_tp_serving.py::TestTPServingParity::test_hot_swap_under_tp",  # tp=1 swap: test_serving TestHotSwap stays; tp re-shard path: churn [2] runs _prepare_params
+    "tests/test_tp_serving.py::TestTPServingParity::test_int8_pool_bitwise_vs_tp1_int8",  # int8 pool semantics: test_spec TestQuantizedKV stays; tp parity: churn [2] stays
+    "tests/test_tp_serving.py::TestDisaggHandoff::test_roundtrip_token_identical[2]",  # [1] (same digest/parity asserts) stays; tp serving parity: churn [2] stays
+    "tests/test_tp_serving.py::TestDecodeEngineTP::test_generate_bitwise_vs_tp1[4]",  # [2] stays
+    "tests/test_tp_serving.py::TestDecodeEngineTP::test_speculative_generate_bitwise",  # serving spec under tp: TestTPServingParity::test_spec_rounds_bitwise_vs_plain stays
+    # r12 second pass: with the tp shard_map sweeps in, the full suite
+    # measured ~1100s on this host against the 870s tier-1 wall, so the
+    # heaviest remaining redundantly-covered rows move here too (same
+    # contract: `-m ''` and hardware still run them; each row names the
+    # sibling that keeps its family covered in tier-1):
+    "tests/test_docs.py::test_inference_api_blocks_execute_in_order",  # needle test test_inference_doc_covers_serving_contract stays; every engine claim the blocks make is a tier-1 test in test_serving/test_tp_serving; like the guide blocks, `-m ''` still executes them
+    "tests/test_docs.py::test_prof_api_blocks_execute_in_order",  # test_observability_blocks_execute_in_order (capture->report->calibrate superset) stays; `-m ''` still executes the prof blocks
+    "tests/test_ckpt.py::TestHotSwapFromCheckpoint::test_restore_params_swaps_token_identically",  # swap contract: test_serving TestHotSwap equal/different-weights rows stay; restore fidelity: TestShardedSameDp::test_fp32_params_ride_the_params_buffer stays
+    "tests/test_ckpt.py::TestCkptBenchLeg::test_in_process_smoke",  # record/validator contract: TestCkptRecord::test_emit_and_validate_ok stays; history gating: test_bench_history_gates_save_overhead stays
+    "tests/test_ckpt.py::TestShardedSameDp::test_bitwise_resume_bf16_masters",  # fp32-path bitwise restore rows (test_fp32_params_ride_the_params_buffer + TestScalerOverflowRoundtrip) stay; bf16-master semantics: test_contrib TestZeroHardening::test_zero_bf16_params_fp32_masters stays
+    "tests/test_ckpt.py::TestElasticResize::test_trajectory_parity_dp8_to_dp4",  # the grow direction test_trajectory_parity_dp4_to_dp8 stays
+    "tests/test_pipeline.py::TestZeroBubble::test_pp2_v1[False]",  # blocking v=1 zb: pp4_v1[False] stays; GPT-level zb parity: test_gpt_pipeline test_zb_schedule[1] stays
+    "tests/test_pipeline.py::TestZeroBubble::test_per_device_work_counters_show_v2_bubble_shrink",  # counter closed form: test_zb_work_counters_closed_form[True] stays
+    "tests/test_pipeline.py::TestBuildSchedule::test_end_to_end_with_calculator",  # schedule choice rows (test_picks_microbatches_and_schedule + test_interleaved_partial) stay; calculator pricing: test_plan TestCalculator rows stay
+    "tests/test_monitor.py::TestProfileBenchLeg::test_bench_profile_emits_valid_skip_record_off_tpu",  # record/validator contract: TestProfileRecord::test_emit_roundtrip_and_validation stays
+    "tests/test_monitor.py::TestSpans::test_overlap_ring_emits_ring_span",  # ring-collective accounting: TestTPCollectiveCounts::test_overlap_ring_ppermute_counted stays
+    "tests/test_plan.py::TestPlanConsumption::test_planned_config_grad_parity_vs_hand_config",  # plan->config routing: test_gpt_config_routes_through_plan + test_make_mesh_consumes_plan stay; the underlying configs' grad parity is test_models territory
+    "tests/test_trace.py::TestValidatorTrace::test_trace_family_dispatch",  # subprocess CLI sweep; schema/honesty rows (test_closed_schema_rejects_junk_key + test_nan_in_ok_record_fails_honesty) stay
+    "tests/test_collective_matmul.py::TestLayerParityMatrix::test_overlap_matches_blocking[sp-3]",  # [sp-2] + GPT-level [sp] stay
+    "tests/test_collective_matmul.py::TestLayerParityMatrix::test_overlap_matches_blocking[sp-4]",  # [sp-2] + GPT-level [sp] stay
+    "tests/test_collective_matmul.py::TestLayerParityMatrix::test_overlap_matches_blocking[nosp-3]",  # [nosp-2] + GPT-level [nosp] stay
+    "tests/test_collective_matmul.py::TestLayerParityMatrix::test_overlap_matches_blocking[nosp-4]",  # [nosp-2] + GPT-level [nosp] stay
+    "tests/test_models.py::TestGPTAttentionAndRematVariants::test_gqa_flash_matches_softmax_impl",  # kernel-level GQA parity (TestGroupedQueryAttention ratios [4-1-128]/[4-2-128]) + test_attention_impls_agree stay
+    "tests/test_attention.py::TestBucketedBias::test_ring_bias_and_kv_lens_match_flash",  # kernel vs materialized: test_kernel_fwd_bwd_vs_materialized[True-False] stays; ring parity: TestRingBshd::test_bshd_ring_matches_flash[1] stays
+    "tests/test_attention.py::TestBucketedBias::test_bshd_composed_gqa_varlen_dropout",  # kernel vs materialized row stays; varlen+dropout composition: TestVarlenFastPath::test_bshd_varlen_with_dropout stays
+    "tests/test_attention.py::TestGroupedQueryAttention::test_fused_qkv_attention_matches_composition[4-False]",  # [2-True] stays
+    "tests/test_attention.py::TestGroupedQueryAttention::test_bshd_layout_kernels_match_dense[4-4-128-True]",  # gqa ratios [4-1-128] and [4-2-128] stay
+    "tests/test_attention.py::TestGroupedQueryAttention::test_bshd_layout_kernels_match_dense[1-1-64-True]",  # gqa ratios [4-1-128] and [4-2-128] stay
+    "tests/test_attention.py::TestFlashBias::test_kernel_fwd_bwd_vs_dense[1-True]",  # [2-False]/[2-True] stay
+    "tests/test_attention.py::TestCpDropout::test_ring_dropout_deterministic_and_live",  # keyed ring dropout: TestRingBshd::test_bshd_ring_dropout_grads_match_autodiff stays
+    "tests/test_t5.py::TestEncoderDecoderModel::test_trains",  # test_loss_finite_and_deterministic + causality/cross-attn rows stay; enc-dec training parity: TestEncDecPipeline stays under `-m ''`
+    "tests/test_t5.py::TestEncoderPadding::test_padding_composes_with_relative_bias",  # test_flash_matches_softmax_padded_grads + test_relative_flash_matches_softmax stay
+    "tests/test_moe.py::TestGPTMoE::test_gpt_moe_trains_and_surfaces_drops",  # dense parity: test_identical_experts_match_dense_gpt stays; grads: TestMoEGrads::test_grads_flow_to_experts_and_router stays
+    "tests/test_moe.py::TestRouter::test_identical_experts_reduce_to_dense_mlp",  # GPT-level test_identical_experts_match_dense_gpt stays
+    "tests/test_gpt_pipeline.py::TestScheduleFeatureMatrix::test_zb_overlap_p2p",  # overlap x interleaved zb: test_pipeline pp2_v3[True] stays; GPT-level zb parity: test_zb_schedule[1] stays
+    "tests/test_contrib.py::TestZeroLossScaling::test_overflow_composes_with_zb_pipeline_across_dp_tp_pp",  # scaler semantics: test_fp16_grads_keep_fp32_reduction stays; zb bf16 accum: test_pipeline 1f1b bf16 row stays
+    "tests/test_contrib.py::TestZeroHardening::test_zero_adam_50_step_convergence_matches_unsharded",  # test_zero_bf16_params_fp32_masters + test_zero_e5m2_allgather_converges stay
+    "tests/test_contrib.py::TestMultiheadAttn::test_additive_attn_mask_fused",  # test_probs_dropout_semantics stays; kernel-level bias path: TestFlashBias [2-True] stays
+    "tests/test_serving.py::TestHotSwap::test_unreached_swap_is_dropped_not_leaked",  # equal-weights + different-weights swap rows stay
+    "tests/test_serve_telemetry.py::TestServingTier2Telemetry::test_window_and_final_fields_validate_with_tier2_keys",  # window validation: TestServeWindows::test_windows_emit_and_validate stays; tier-2 lifecycle: test_evict_lifecycle_through_real_preemption stays
+    "tests/test_spec.py::TestDecodeEngineSpec::test_all_rejected_drafter_still_exact",  # rewind contract: TestRewindContract::test_all_rejected_round_restores_pool_state stays; parity: test_greedy_parity_both_drafters stays
+    "tests/test_inference.py::TestDecodeAttentionOp::test_xla_and_kernel_match_oracle[8]",  # [1] stays
+    "tests/test_ops.py::TestXentropy::test_loss_and_grad[0.0]",  # smoothing [0.1] stays
+    "tests/test_transformer_tp.py::TestVocabParallelCrossEntropy::test_matches_unsharded[0.0]",  # test_grad_matches_unsharded + kernel-path [0.0]/[0.1] rows stay
+    "tests/test_aux.py::TestRNN::test_shapes_and_grads[LSTM]",  # [GRU]/[mLSTM] factory rows stay
+    "tests/test_megatron_surface.py::TestGPTScaling::test_width_depth_scaling[128-4]",  # [64-2] stays
+    "tests/test_permutation.py::TestSearch::test_greedy_on_random_conv_net",  # TestGreedyVsExhaustive stays tier-1
+    "tests/test_serving.py::TestServingTier2::test_prefix_hit_parity_and_skipped_chunks",  # prefix-cache rows test_whole_prompt_cached_recomputes_last_block + test_preemption_roundtrip_token_identical stay; hit accounting: test_tp_serving TestDisaggHandoff roundtrip [1] asserts prefix_hit_blocks
+    "tests/test_t5.py::TestRelativePositionBias::test_relative_decoder_ignores_future",  # causality: TestEncoderDecoderModel::test_decoder_is_causal stays; relative-bias parity: test_relative_flash_matches_softmax stays
+    "tests/test_docs.py::test_ckpt_api_blocks_execute_in_order",  # needle test test_ckpt_doc_covers_the_contract stays; `-m ''` still executes the blocks
+    "tests/test_trace.py::TestAttribution::test_emitted_record_validates",  # test_components_sum_to_e2e_on_mixed_run stays; record validation: TestValidatorTrace junk/nan rows stay
+    "tests/test_attention.py::TestRingBshd::test_bshd_ring_grads_match_flat_ring",  # test_bshd_ring_matches_flash[1] + the bshd ring dropout grads row stay
+    "tests/test_models.py::TestBert::test_flash_impl_matches_softmax_on_suffix_padding",  # kernel-level bert padding path: test_attention test_bert_varlen_rides_bshd_kernels stays
+    "tests/test_gpt_pipeline.py::TestGPTPipelinePartition::test_dropout_requires_key",  # keyed-dropout contract: test_dropout_interleaved_schedule stays
+    "tests/test_attention.py::TestUlyssesAttention::test_matches_dense_full_sequence[False]",  # ulysses grads row test_grads_match_dense stays
 }
 
 

@@ -85,6 +85,8 @@ def test_inference_api_blocks_execute_in_order():
     assert len(blocks) >= 3, "inference.md lost its worked examples"
     ns = _exec_blocks(blocks, "inference.md")
     assert ns["srv"].decode_step._cache_size() == 1
+    # ISSUE 17: the tp chapter's engine really served sharded
+    assert ns["tsrv"].decode_step._cache_size() == 1
 
 
 def test_inference_doc_covers_serving_contract():
@@ -115,7 +117,21 @@ def test_inference_doc_covers_serving_contract():
                    "rejection sampling", "kv_dtype", "int8",
                    "parity oracle", "kv_quant_logit_err",
                    "bench.py --spec", "acceptance_rate",
-                   "spec_verify_step", "lookahead"):
+                   "spec_verify_step", "lookahead",
+                   # ISSUE 17: TP serving + disaggregated handoff
+                   "ParallelPlan(tp=2)", "one logical free list",
+                   "GLOBAL count", "all_gather_matmul",
+                   "matmul_all_reduce", "ppermute_present",
+                   "no_full_width_all_gather", "serve_prefill_tp",
+                   "serve_decode_tp", "psum", "validate_tp",
+                   "pad the vocab to a tp multiple",
+                   "collective_bytes_per_step", "export_handoff",
+                   "ingest_handoff", "prefill_requests",
+                   "read_handoff", "write_handoff", "block_digest",
+                   "content-addressed", "handoff_role",
+                   "--plan-tp", "TP_SERVE_SCHEMA", "handoff_parity",
+                   "handoff_transfer_ms",
+                   "validate_metrics.py --tp-serve"):
         assert needle in text, f"inference.md dropped {needle}"
 
 
@@ -189,7 +205,12 @@ def test_guide_covers_the_ladder():
                    # ISSUE 15: the §10d drafter recipe
                    "NGramDrafter", "ModelDrafter", "fused_verify",
                    "acceptance_rate", "kv_dtype", "bench.py --spec",
-                   "spec_verify_step"):
+                   "spec_verify_step",
+                   # ISSUE 17: the §10e multi-chip serving recipe
+                   "ParallelPlan(tp=2)", "export_handoff",
+                   "ingest_handoff", "prefill_requests",
+                   "bench.py --serve --plan-tp",
+                   "serve_decode_tp", "handoff_transfer_ms"):
         assert needle in text, f"guide dropped {needle}"
 
 

@@ -16,11 +16,13 @@ that trajectory into a gate a CI leg can run after a fresh bench:
 * **Extraction** understands every throughput artifact the repo emits:
   bench result objects (``{"metric", "value", ...}``), driver envelopes
   (``{"parsed": {...}}``), and monitor records with a throughput field
-  (``serve`` / ``decode`` / ``tp_overlap`` / ``pipeline`` →
-  ``tokens_per_s``). An OK ``serve`` record additionally carries its
-  ``prefix_hit_ttft_p50_ms`` as a LOWER-is-better latency series (the
-  serving-tier-2 headline: a prefix hit must stay fast across the
-  trajectory). An OK ``spec`` record carries TWO higher-is-better
+  (``serve`` / ``decode`` / ``tp_overlap`` / ``pipeline`` /
+  ``tp_serve`` → ``tokens_per_s``). An OK ``serve`` record additionally
+  carries its ``prefix_hit_ttft_p50_ms`` as a LOWER-is-better latency
+  series (the serving-tier-2 headline: a prefix hit must stay fast
+  across the trajectory); an OK ``tp_serve`` record carries its
+  ``handoff_transfer_ms`` the same lower-is-better way (the
+  disaggregated KV stream must not slow down). An OK ``spec`` record carries TWO higher-is-better
   series: ``spec_tokens_per_s_request`` (the speculative-decoding
   headline) and ``spec_acceptance_rate`` (the drafter-quality series
   that explains it — a silent acceptance collapse would eventually
@@ -61,7 +63,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from apex_tpu.monitor import schema  # noqa: E402
 
 # monitor-record kinds that carry a tokens_per_s throughput claim
-_THROUGHPUT_KINDS = ("serve", "decode", "tp_overlap", "pipeline")
+_THROUGHPUT_KINDS = ("serve", "decode", "tp_overlap", "pipeline",
+                     "tp_serve")
 
 # metrics where a BIGGER fresh value is the regression, gated in
 # ABSOLUTE points (error series — the reference may legitimately be ~0)
@@ -78,7 +81,12 @@ _LOWER_IS_BETTER = {"plan_predicted_vs_measured_err_pct",
 # lower-is-better metrics gated by PERCENT drift (latency series: the
 # prefix-hit TTFT p50 must not creep up across the trajectory — the
 # serving tier-2 headline is that a hit stays fast)
-_LOWER_IS_BETTER_PCT = {"serve_prefix_hit_ttft_p50_ms"}
+_LOWER_IS_BETTER_PCT = {"serve_prefix_hit_ttft_p50_ms",
+                        # the disaggregated handoff's export→ingest
+                        # wall: the KV stream must not slow down across
+                        # the trajectory (creep here eats straight into
+                        # the decode role's time-to-first-decode)
+                        "tp_serve_handoff_transfer_ms"}
 
 # hard absolute ceilings on top of trajectory drift: a fresh value over
 # its budget fails EVEN IF the history crept up alongside it (drift
@@ -131,6 +139,15 @@ def extract_all(obj: Dict[str, Any], label: str = "artifact"
             if isinstance(ovh, (int, float)):
                 rows.append(("serve_telemetry_overhead_pct",
                              float(ovh), 0.0))
+        if kind == "tp_serve":
+            # the disaggregated handoff's transfer wall (absent on a
+            # record that skipped the handoff leg — a skip, not 0):
+            # lower-is-better percent drift; the record's spread_pct is
+            # throughput variance and says nothing about transfer time
+            tms = obj.get("handoff_transfer_ms")
+            if isinstance(tms, (int, float)):
+                rows.append(("tp_serve_handoff_transfer_ms",
+                             float(tms), 0.0))
         return rows
     if kind == "plan":
         # the planner record's gated series is its predicted-vs-measured

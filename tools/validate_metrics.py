@@ -14,6 +14,7 @@ Usage::
     python tools/validate_metrics.py --plan plan.jsonl ...
     python tools/validate_metrics.py --ckpt ckpt.jsonl ...
     python tools/validate_metrics.py --spec spec.jsonl ...
+    python tools/validate_metrics.py --tp-serve tp_serve.jsonl ...
     python tools/validate_metrics.py --trace flight-dump.json ...
 
 Dispatch is by content, not extension:
@@ -67,13 +68,17 @@ Dispatch is by content, not extension:
   fails), and ``spec`` records (``python bench.py --spec``: the
   speculative-decoding + quantized-KV leg — a CLOSED schema, so a junk
   key fails, and its OK line engages the no-nan honesty rule like
-  every status record)
+  every status record), and ``tp_serve`` records (``python bench.py
+  --serve --plan-tp N``: the tensor-parallel serving + disaggregated
+  prefill→decode handoff leg — a CLOSED schema whose OK line is a
+  real-multichip-TPU claim; off-TPU it must be a reasoned SKIP)
   dispatch on ``kind`` like every monitor record. ``--profile`` /
-  ``--serve`` / ``--serve-window`` / ``--pipeline`` / ``--costdb`` /
-  ``--static-cost`` / ``--plan`` / ``--ckpt`` / ``--spec`` force EVERY
-  listed file to be judged as that artifact (same rationale as
-  ``--lint-report``: an artifact that lost its ``kind`` key must fail
-  as a bad profile/serve/pipeline/costdb/static_cost/plan/ckpt/spec,
+  ``--serve`` / ``--serve-window`` / ``--tp-serve`` / ``--pipeline`` /
+  ``--costdb`` / ``--static-cost`` / ``--plan`` / ``--ckpt`` /
+  ``--spec`` force EVERY listed file to be judged as that artifact
+  (same rationale as ``--lint-report``: an artifact that lost its
+  ``kind`` key must fail as a bad
+  profile/serve/pipeline/costdb/static_cost/plan/ckpt/spec/tp_serve,
   not as an unrecognized shape). ``--trace`` forces the request-scoped
   tracing FAMILY (``serve_attribution`` / ``clock_sync`` /
   ``flight_recorder_dump`` — all closed schemas): a single object must
@@ -213,6 +218,8 @@ def main(argv=None) -> int:
         force_kind = "profile"
     elif "--serve-window" in argv:
         force_kind = "serve_window"
+    elif "--tp-serve" in argv:
+        force_kind = "tp_serve"
     elif "--serve" in argv:
         force_kind = "serve"
     elif "--pipeline" in argv:
@@ -232,9 +239,9 @@ def main(argv=None) -> int:
                       "flight_recorder_dump")
     argv = [a for a in argv
             if a not in ("--lint-report", "--costdb", "--profile",
-                         "--serve", "--serve-window", "--pipeline",
-                         "--static-cost", "--plan", "--ckpt", "--spec",
-                         "--trace")]
+                         "--serve", "--serve-window", "--tp-serve",
+                         "--pipeline", "--static-cost", "--plan",
+                         "--ckpt", "--spec", "--trace")]
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
