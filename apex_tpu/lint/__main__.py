@@ -20,6 +20,16 @@ calibrated table against a measured CostDB
 (``bench.py --profile --costdb``), flagging collectives the trace
 contains but the CostDB has never priced.
 
+``--memory`` (with ``--jaxpr``) runs the apexmem donation-aware
+liveness analysis (``apex_tpu.lint.liveness``) over the same traces
+and prints each entrypoint's static peak-HBM bound with its family
+breakdown (params/optimizer/activations/kv_pool/temps);
+``--budget-file F`` turns the table into a CLEAN/VIOLATION gate
+against checked-in per-entrypoint byte budgets
+(``tools/memory_budgets.json`` in CI), and ``--static-memory FILE``
+writes the schema-validated ``kind:"static_memory"`` JSONL artifacts
+(gated by ``tools/validate_metrics.py --static-memory``).
+
 The repo's committed baseline (``tools/apexlint_baseline.json`` next to
 the ``apex_tpu`` package) loads by default so a bare
 ``python -m apex_tpu.lint apex_tpu/`` judges the tree the way CI does;
@@ -78,6 +88,19 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--static-cost", metavar="FILE", dest="static_cost",
                    help="jaxpr mode: write the kind:'static_cost' "
                         "artifacts (JSONL, one per entrypoint)")
+    p.add_argument("--memory", action="store_true",
+                   help="jaxpr mode: run the donation-aware liveness "
+                        "analysis (apexmem) and report each entrypoint's "
+                        "static peak-HBM bound with its family breakdown")
+    p.add_argument("--budget-file", metavar="FILE", dest="budget_file",
+                   help="with --memory: judge each peak CLEAN/VIOLATION "
+                        "against the checked-in per-entrypoint byte "
+                        "budgets (tools/memory_budgets.json); violations "
+                        "and missing entries are JXP601 findings")
+    p.add_argument("--static-memory", metavar="FILE", dest="static_memory",
+                   help="jaxpr mode: write the kind:'static_memory' "
+                        "artifacts (JSONL, one per entrypoint; implies "
+                        "--memory)")
     p.add_argument("--costdb", metavar="FILE",
                    help="jaxpr mode: print the predicted-vs-calibrated "
                         "table against a measured CostDB artifact")
@@ -174,6 +197,23 @@ def _format_diff_table(name: str, diff: dict) -> str:
     return "\n".join(lines)
 
 
+def _format_memory_table(mems: list, gated: bool) -> str:
+    lines = ["static memory — donation-aware liveness peaks (apexmem):"]
+    lines.append(f"  {'entrypoint':<28} {'peak MB':>9} {'aliased MB':>11} "
+                 f"{'stash MB':>9} {'while!':>6}"
+                 + ("  verdict" if gated else ""))
+    mb = 1024.0 * 1024.0
+    for m in mems:
+        row = (f"  {m['entrypoint']:<28} {m['peak_bytes'] / mb:>9.3f} "
+               f"{m['donation_aliased_bytes'] / mb:>11.3f} "
+               f"{m['stash_bytes'] / mb:>9.3f} "
+               f"{m['unbounded_stash_sites']:>6}")
+        if gated:
+            row += f"  {m.get('verdict', '-')}"
+        lines.append(row)
+    return "\n".join(lines)
+
+
 def _jaxpr_main(args) -> int:
     if args.paths:
         print("error: --jaxpr mode takes no source paths; select traced "
@@ -184,6 +224,21 @@ def _jaxpr_main(args) -> int:
         print("error: --strict judges CostDB coverage; pass --costdb "
               "FILE", file=sys.stderr)
         return 2
+    if args.budget_file and not args.memory:
+        print("error: --budget-file gates the liveness peaks; pass "
+              "--memory", file=sys.stderr)
+        return 2
+    budgets = None
+    if args.budget_file:
+        # read before any entrypoint is traced: a bad budget file is a
+        # usage error, not 17 traces followed by one
+        try:
+            with open(args.budget_file, encoding="utf-8") as fh:
+                budgets = json.load(fh)["budgets"]
+        except (OSError, json.JSONDecodeError, KeyError) as e:
+            print(f"error: cannot read budget file {args.budget_file}: "
+                  f"{e!r}", file=sys.stderr)
+            return 2
     _prepare_virtual_devices()
     from apex_tpu.lint import entrypoints as eps
     from apex_tpu.lint.core import _code_selected
@@ -204,9 +259,14 @@ def _jaxpr_main(args) -> int:
         return 2
 
     select, ignore = _codes(args.select), _codes(args.ignore)
-    findings, costs = [], []
+    memory_on = bool(args.memory or args.static_memory)
+    findings, costs, mems = [], [], []
     for name in names:
-        contract_findings, cost = eps.check(name)
+        if memory_on:
+            contract_findings, cost, mem = eps.check(name, memory=True)
+            mems.append(mem)
+        else:
+            contract_findings, cost = eps.check(name)
         costs.append(cost)
         for cf in contract_findings:
             if not _code_selected(cf.code, select, ignore):
@@ -214,6 +274,30 @@ def _jaxpr_main(args) -> int:
             findings.append(lint.Finding(
                 f"jaxpr:{name}", 1, 0, cf.code,
                 f"[{cf.path or '<top>'}] {cf.message} ({cf.contract})"))
+    if budgets is not None:
+        for mem in mems:
+            name = mem["entrypoint"]
+            limit = budgets.get(name)
+            if limit is None:
+                mem["verdict"] = "VIOLATION"
+                msg = (f"[<top>] entrypoint has no budget entry in "
+                       f"{args.budget_file} (static peak "
+                       f"{mem['peak_bytes']} bytes) — every gated "
+                       f"program needs a checked-in bound "
+                       f"(peak-memory-bound)")
+            else:
+                mem["budget_bytes"] = int(limit)
+                if mem["peak_bytes"] <= limit:
+                    mem["verdict"] = "CLEAN"
+                    continue
+                mem["verdict"] = "VIOLATION"
+                msg = (f"[<top>] static peak HBM {mem['peak_bytes']} "
+                       f"bytes ({mem['peak_mb']:.3f} MB) exceeds the "
+                       f"checked-in budget {limit} bytes "
+                       f"(peak-memory-bound)")
+            if _code_selected("JXP601", select, ignore):
+                findings.append(lint.Finding(
+                    f"jaxpr:{name}", 1, 0, "JXP601", msg))
     findings.sort(key=lint.Finding.sort_key)
 
     applied = _apply_baseline(args, findings)
@@ -238,6 +322,21 @@ def _jaxpr_main(args) -> int:
                     return 2
                 fh.write(json.dumps(cost) + "\n")
         report["static_cost_path"] = args.static_cost
+
+    if memory_on:
+        report["memory"] = mems
+    if args.static_memory:
+        from apex_tpu.monitor import schema as mon_schema
+        with open(args.static_memory, "w") as fh:
+            for mem in mems:
+                errors = mon_schema.validate(mem)
+                if errors:  # pragma: no cover - emitter bug guard
+                    print("error: refusing to write invalid "
+                          f"static_memory for {mem.get('entrypoint')!r}: "
+                          f"{errors}", file=sys.stderr)
+                    return 2
+                fh.write(json.dumps(mem) + "\n")
+        report["static_memory_path"] = args.static_memory
 
     tables = []
     uncalibrated = {}
@@ -269,6 +368,8 @@ def _jaxpr_main(args) -> int:
 
     _emit_report(args, findings, stats, baselined, unused, report)
     if args.format != "json":
+        if memory_on:
+            print(_format_memory_table(mems, gated=budgets is not None))
         for table in tables:
             print(table)
     if findings:
@@ -293,7 +394,8 @@ def main(argv=None) -> int:
             print(f"{code}  {name} (--jaxpr contract): {summary}")
         return 0
     if (args.jaxpr or args.entrypoint or args.list_entrypoints
-            or args.static_cost or args.costdb):
+            or args.static_cost or args.costdb or args.memory
+            or args.static_memory or args.budget_file):
         return _jaxpr_main(args)
     if not args.paths:
         print("error: no paths given (try `python -m apex_tpu.lint "

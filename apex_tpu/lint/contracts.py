@@ -27,6 +27,12 @@ Code families (catalogue with bad/good traces: ``docs/api/lint.md``):
   :func:`collective_free_region` (JXP403).
 * **JXP5xx** precision — :func:`fp32_accumulation` (JXP501: a scan
   carry accumulated by add in bf16/fp16 loses mantissa every tick).
+* **JXP6xx** static peak memory (apexmem) — :func:`peak_memory_bound`
+  (JXP601: the donation-aware liveness peak of
+  :func:`apex_tpu.lint.liveness.analyze` stays under a byte budget),
+  :func:`donation_aliased` (JXP602: a donated buffer is provably
+  counted once — the alias survives the liveness accounting, not just
+  the JXP202 aval match).
 
 Stdlib-only, like the rest of the package: contracts consume the
 duck-typed walk, never jax itself.
@@ -84,6 +90,12 @@ JXP_CODES = {
     "JXP501": ("fp32-accumulation",
                "no scan carry accumulated by add in bf16/fp16 — "
                "accumulate fp32, downcast once"),
+    "JXP601": ("peak-memory-bound",
+               "the donation-aware static liveness peak of the traced "
+               "program stays under a byte budget"),
+    "JXP602": ("donation-aliased",
+               "the liveness analysis finds the named donated buffer "
+               "really aliased input->output (counted once, not twice)"),
 }
 
 
@@ -385,6 +397,66 @@ def collective_free_region(path_pattern: str, *,
             for s in in_region if collective_kind(s.eqn) is not None]
 
     return Contract("JXP403", "collective-free-region", label, check)
+
+
+# --- JXP6xx: static peak memory (apexmem) -------------------------------------
+
+def peak_memory_bound(limit_bytes: int, *,
+                      arg_families: Optional[Sequence[str]] = None
+                      ) -> Contract:
+    """JXP601: the donation-aware static liveness peak
+    (:func:`apex_tpu.lint.liveness.analyze`) of the whole traced
+    program stays ``<= limit_bytes``. This is the per-entrypoint HBM
+    gate ``python -m apex_tpu.lint --jaxpr --memory --budget-file F``
+    enforces, usable directly in tests via :func:`assert_contracts`.
+    ``arg_families`` optionally labels the program's flattened invars
+    so the violation message carries the family breakdown."""
+    label = f"peak_memory_bound({limit_bytes})"
+
+    def check(walk: Walk) -> List[ContractFinding]:
+        from apex_tpu.lint import liveness
+
+        rep = liveness.analyze(walk.jaxpr, arg_families=arg_families)
+        if rep.peak_bytes <= limit_bytes:
+            return []
+        fams = ", ".join(f"{k}={v}" for k, v in rep.families.items()
+                         if v)
+        return [ContractFinding(
+            "JXP601", label, "",
+            f"static peak HBM {rep.peak_bytes} bytes "
+            f"({rep.peak_bytes / 2**20:.2f} MB) exceeds the bound "
+            f"{limit_bytes} bytes ({limit_bytes / 2**20:.2f} MB); "
+            f"at-peak families: {fams or 'none'}")]
+
+    return Contract("JXP601", "peak-memory-bound", label, check)
+
+
+def donation_aliased(name: str = "donated buffer", *,
+                     min_bytes: int = 1) -> Contract:
+    """JXP602: the liveness analysis finds at least ``min_bytes`` of
+    donation-aliased buffer — i.e. some donated operand's bytes are
+    provably counted ONCE (input aliased to a same-aval output), the
+    serving invariant behind the donated-and-rebound paged pool.
+    Stronger than JXP202 (which only checks a matching output *exists*):
+    this asserts the alias survives the full liveness accounting —
+    the donated buffer is dead at the donation point, so the rebind
+    really reuses it. ``name`` labels the buffer in messages."""
+    label = f"donation_aliased({name!r}, min_bytes={min_bytes})"
+
+    def check(walk: Walk) -> List[ContractFinding]:
+        from apex_tpu.lint import liveness
+
+        rep = liveness.analyze(walk.jaxpr)
+        if rep.donation_aliased_bytes >= min_bytes:
+            return []
+        return [ContractFinding(
+            "JXP602", label, "",
+            f"{name}: expected >= {min_bytes} donation-aliased bytes, "
+            f"liveness found {rep.donation_aliased_bytes} — no donated "
+            "operand is rebound in place (the pool would cost its "
+            "bytes twice)")]
+
+    return Contract("JXP602", "donation-aliased", label, check)
 
 
 # --- JXP5xx: precision --------------------------------------------------------

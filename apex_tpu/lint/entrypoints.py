@@ -113,17 +113,115 @@ def trace(name: str):
     return jax.make_jaxpr(fn)(*args)
 
 
-def check(name: str):
+def check(name: str, *, memory: bool = False):
     """Trace + contract-check one entrypoint. Returns
-    ``(contract findings, static_cost artifact)``."""
+    ``(contract findings, static_cost artifact)`` — with the
+    ``static_memory`` artifact of :mod:`apex_tpu.lint.liveness` as a
+    third element when ``memory=True`` (same single trace)."""
+    import jax
+
     from apex_tpu.lint import jaxpr_check as jx
 
     ep = get(name)
-    closed = trace(name)
+    fn, args = ep.build()
+    closed = jax.make_jaxpr(fn)(*args)
     walk = jc.Walk(closed)
     findings = jc.check_jaxpr(walk, ep.contracts())
     cost = jx.static_cost(closed, entrypoint=name)
-    return findings, cost
+    if not memory:
+        return findings, cost
+    from apex_tpu.lint import liveness
+
+    rep = liveness.analyze(closed, arg_families=arg_families(name, args),
+                           entrypoint=name)
+    return findings, cost, rep.record()
+
+
+def static_memory(name: str):
+    """Trace one entrypoint and run the donation-aware liveness
+    analysis over it. Returns the
+    :class:`~apex_tpu.lint.liveness.MemoryReport` (peak bytes, family
+    breakdown, donation-aliased bytes, stash bytes)."""
+    import jax
+
+    from apex_tpu.lint import liveness
+
+    ep = get(name)
+    fn, args = ep.build()
+    closed = jax.make_jaxpr(fn)(*args)
+    return liveness.analyze(closed, arg_families=arg_families(name, args),
+                            entrypoint=name)
+
+
+# --- per-entrypoint memory families (apexmem) ---------------------------------
+
+#: family label per POSITIONAL builder arg for the liveness analysis —
+#: every traced invar inherits the label of the pytree arg it is a leaf
+#: of (:func:`arg_families` does the flattening). Callables resolve
+#: plan-dependent signatures (``planned_gpt_step``) at build time.
+_SERVE_DECODE_FAMS = ("params", "kv_pool", "temps", "temps", "temps",
+                      "temps")
+_SERVE_PREFILL_FAMS = ("params", "kv_pool", "temps", "temps", "temps",
+                       "temps", "temps")
+_PIPE_FAMS = ("params", "activations", "activations")
+
+
+def _planned_arg_families():
+    """Mirror of ``_build_planned_gpt_step``'s four signature variants."""
+    plan = active_plan()
+    if plan.pp > 1 and plan.tp > 1:
+        # (stage params, chain weights, microbatches, targets, chain x)
+        return ("params", "params", "activations", "activations",
+                "activations")
+    if plan.pp > 1:
+        return _PIPE_FAMS
+    if plan.tp > 1:
+        return ("params", "activations")
+    return ARG_FAMILIES["gpt_fwd_bwd"]
+
+
+ARG_FAMILIES = {
+    "gpt_fwd_bwd": ("params", "optimizer", "activations", "activations"),
+    "flash_bias_fwd_bwd": ("activations", "activations", "activations",
+                           "params"),
+    "collective_matmul_ring": ("activations", "params", "params",
+                               "params", "params"),
+    "pipeline_1f1b": _PIPE_FAMS,
+    "pipeline_1f1b_overlap": _PIPE_FAMS,
+    "pipeline_interleaved": _PIPE_FAMS,
+    "pipeline_interleaved_overlap": _PIPE_FAMS,
+    "pipeline_zb": _PIPE_FAMS,
+    "pipeline_zb_overlap": _PIPE_FAMS,
+    "planned_gpt_step": _planned_arg_families,
+    "serve_prefill": _SERVE_PREFILL_FAMS,
+    "serve_prefill_tp": _SERVE_PREFILL_FAMS,
+    "serve_decode": _SERVE_DECODE_FAMS,
+    "serve_decode_tp": _SERVE_DECODE_FAMS,
+    "serve_decode_quantized": _SERVE_DECODE_FAMS,
+    "serve_swap": _SERVE_DECODE_FAMS,
+    "spec_verify": ("params", "kv_pool", "temps", "temps", "temps",
+                    "temps", "temps"),
+}
+
+
+def arg_families(name: str, args) -> Tuple[str, ...]:
+    """One family label per traced invar: the per-positional-arg spec in
+    :data:`ARG_FAMILIES` flattened over each arg's pytree leaves."""
+    import jax
+
+    spec = ARG_FAMILIES.get(name)
+    if spec is None:  # pragma: no cover - registration-time error
+        raise KeyError(f"entrypoint {name!r} has no ARG_FAMILIES entry")
+    if callable(spec):
+        spec = spec()
+    if len(spec) != len(args):
+        raise ValueError(
+            f"{name}: ARG_FAMILIES lists {len(spec)} positional args, "
+            f"builder returned {len(args)}")
+    out: List[str] = []
+    for fam, arg in zip(spec, args):
+        out.extend([fam] * len(jax.tree.leaves(arg)))
+    return tuple(out)
 
 
 # --- GPT flagship train step --------------------------------------------------
@@ -440,6 +538,7 @@ def _cow_scheduler(engine):
     "serving chunked-prefill body with COW block tables in play "
     "(shared-prefix resume; pool donated+rebound, collective-free)",
     lambda: [jc.donation_honored(), jc.donation_rebound(),
+             jc.donation_aliased("paged KV pool"),
              jc.collective_free_region("", region="serving prefill body")])
 def _build_serve_prefill():
     import jax.random as jr
@@ -588,6 +687,7 @@ def _build_planned_gpt_step():
     "steps; pool donated+rebound, collective-free — the same compiled "
     "program, new operand contents)",
     lambda: [jc.donation_honored(), jc.donation_rebound(),
+             jc.donation_aliased("paged KV pool"),
              jc.collective_free_region("",
                                        region="serving hot-swap step")])
 def _build_serve_swap():
@@ -626,6 +726,7 @@ def _build_serve_swap():
     "(shared prefix blocks in the table; pool donated+rebound, "
     "collective-free)",
     lambda: [jc.donation_honored(), jc.donation_rebound(),
+             jc.donation_aliased("paged KV pool"),
              jc.collective_free_region("", region="serving decode body")])
 def _build_serve_decode():
     import jax.random as jr
@@ -656,6 +757,7 @@ _SPEC_K = 2  # smoke-scale draft length: the verify program's static k
     "verify tail, COW tables in play, draft rows reserved past the "
     "frontier (pool donated+rebound, collective-free)",
     lambda: [jc.donation_honored(), jc.donation_rebound(),
+             jc.donation_aliased("paged KV pool"),
              jc.collective_free_region("", region="spec verify body")])
 def _build_spec_verify():
     import jax.random as jr
@@ -689,6 +791,7 @@ def _build_spec_verify():
     "write + per-block-row scale planes, COW tables in play; pool "
     "donated+rebound, collective-free)",
     lambda: [jc.donation_honored(), jc.donation_rebound(),
+             jc.donation_aliased("paged KV pool"),
              jc.collective_free_region(
                  "", region="quantized serving decode body")])
 def _build_serve_decode_quantized():
@@ -736,6 +839,7 @@ def _tp_serving_engine():
 
 _TP_SERVE_CONTRACTS = lambda: [  # noqa: E731 — mirrors the lambdas above
     jc.donation_honored(), jc.donation_rebound(),
+    jc.donation_aliased("paged KV pool"),
     jc.ppermute_present("tp"), jc.no_full_width_all_gather("tp")]
 
 

@@ -31,6 +31,17 @@ APX404  blocking-p2p-feeds-stage  a ``lax.ppermute`` / pipeline p2p helper
                                   / ``overlap_p2p=True`` hides it behind
                                   the stage (advisory, mirrors APX403 at
                                   the pp boundary)
+APX405  collective-under-divergent-cond
+                                  ``lax.cond``/``lax.switch`` whose
+                                  branches issue DIFFERENT collective
+                                  sets — under shard_map/pmap a
+                                  device-varying predicate sends chips
+                                  down different branches, and the chip
+                                  whose branch psums waits forever for
+                                  the chip whose branch doesn't (hoist
+                                  the collective out of the cond, or
+                                  make every branch issue the same
+                                  collectives)
 """
 
 from __future__ import annotations
@@ -268,6 +279,90 @@ def check_apx404(ctx: ModuleContext):
                         "body, and consumes the arrival next tick "
                         "(advisory)")
                     break
+
+
+# --- APX405: collective under a divergent cond -------------------------------
+
+#: the SYNCHRONIZING collectives — every participating chip must issue
+#: them; axis_index/axis_size are local queries and can't deadlock
+_SYNC_COLLECTIVES = frozenset(_COLLECTIVES) - {"axis_index", "axis_size"}
+
+
+def _branch_callables(ctx: ModuleContext, call: ast.Call
+                      ) -> Optional[List[ast.expr]]:
+    """The branch-callable expressions of a ``lax.cond``/``lax.switch``
+    call, or None when the call shape is not the branch form (operand
+    positions, unpacked branch lists, …) — unresolvable means silent,
+    never a guess."""
+    if _is_lax_call(ctx, call, "cond"):
+        branches = list(call.args[1:3])
+        for kw in call.keywords:
+            if kw.arg in ("true_fun", "false_fun"):
+                branches.append(kw.value)
+        return branches if len(branches) >= 2 else None
+    if _is_lax_call(ctx, call, "switch"):
+        if len(call.args) >= 2 and isinstance(call.args[1],
+                                              (ast.List, ast.Tuple)):
+            return list(call.args[1].elts)
+    return None
+
+
+def _branch_collectives(ctx: ModuleContext, branch: ast.expr,
+                        defs) -> Optional[frozenset]:
+    """The set of synchronizing-collective names a branch body issues,
+    or None when the branch is not statically resolvable (a partial, an
+    attribute, a name with no module-level def)."""
+    if isinstance(branch, ast.Lambda):
+        body = branch
+    elif isinstance(branch, ast.Name):
+        body = defs.get(branch.id)
+        if body is None:
+            return None
+    else:
+        return None
+    found = set()
+    for node in ast.walk(body):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = ctx.call_name(node) or ""
+        short = canon.rsplit(".", 1)[-1]
+        if short in _SYNC_COLLECTIVES and (
+                canon.startswith(("jax.lax.", "lax.")) or canon == short):
+            found.add(short)
+    return frozenset(found)
+
+
+@rule("APX405", "collective-under-divergent-cond",
+      "lax.cond/lax.switch whose branches issue different collective "
+      "sets — under shard_map/pmap a device-varying predicate deadlocks "
+      "the chips whose branch collects against the chips whose branch "
+      "doesn't")
+def check_apx405(ctx: ModuleContext):
+    defs = {node.name: node for node in ast.walk(ctx.tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        branches = _branch_callables(ctx, node)
+        if not branches:
+            continue
+        sets = [_branch_collectives(ctx, b, defs) for b in branches]
+        if any(s is None for s in sets):
+            continue  # an unresolvable branch: stay silent, never guess
+        if len(set(sets)) <= 1 or not any(sets):
+            continue
+        which = "cond" if _is_lax_call(ctx, node, "cond") else "switch"
+        parts = ", ".join(
+            "{" + ", ".join(sorted(s)) + "}" if s else "{}" for s in sets)
+        yield ctx.finding(
+            node, "APX405",
+            f"`lax.{which}` branches issue different collective sets "
+            f"({parts}) — a device-varying predicate sends chips down "
+            "different branches, and a chip whose branch issues the "
+            "collective blocks forever waiting for a chip whose branch "
+            "does not; hoist the collective out of the cond, or make "
+            "every branch issue the same collectives (e.g. psum a zero "
+            "in the cheap branch)")
 
 
 def _is_partition_spec(ctx: ModuleContext, call: ast.Call) -> bool:

@@ -655,6 +655,57 @@ STATIC_COST_SCHEMA = {
     "required": ["schema", "kind", "entrypoint", "collectives", "gemms"],
 }
 
+# the apexmem liveness artifact (lint.liveness.analyze → .record()):
+# the donation-aware static peak-HBM bound of one traced entrypoint
+# with its at-peak family breakdown — params / optimizer /
+# activations-and-stashes / kv_pool / temps — plus the donation-aliased
+# bytes (buffers counted ONCE because a donated operand is rebound in
+# place), the scan-stash bytes (length × per-tick residual, the zb M·v
+# dW stash priced explicitly), and the count of while bodies whose
+# stash growth is unbounded (flagged, never silently multiplied).
+# Emitted by `python -m apex_tpu.lint --jaxpr --memory --static-memory
+# FILE`, gated by `tools/validate_metrics.py --static-memory`. CLOSED:
+# a junk key in a memory record must fail validation, not ride along;
+# the byte fields are integer-typed, so a nan can never masquerade as
+# a peak (this artifact is statusless like static_cost — it is a pure
+# static claim, no measured half to SKIP).
+STATIC_MEMORY_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["static_memory"]},
+        "entrypoint": {"type": "string"},  # lint.entrypoints name
+        "peak_bytes": {"type": "integer"},
+        "peak_mb": {"type": "number"},
+        "families": {
+            "type": "object",
+            # bytes live AT the peak moment, by family — sums to
+            # peak_bytes
+            "properties": {
+                "params": {"type": "integer"},
+                "optimizer": {"type": "integer"},
+                "activations": {"type": "integer"},
+                "kv_pool": {"type": "integer"},
+                "temps": {"type": "integer"},
+            },
+            "required": ["params", "optimizer", "activations",
+                         "kv_pool", "temps"],
+            "additionalProperties": False,
+        },
+        "donation_aliased_bytes": {"type": "integer"},
+        "stash_bytes": {"type": "integer"},
+        "unbounded_stash_sites": {"type": "integer"},
+        "eqns": {"type": "integer"},
+        "source": {"enum": ["liveness"]},
+        "budget_bytes": {"type": "integer"},   # when gated vs a budget
+        "verdict": {"enum": ["CLEAN", "VIOLATION"]},
+    },
+    "required": ["schema", "kind", "entrypoint", "peak_bytes",
+                 "families", "donation_aliased_bytes", "stash_bytes",
+                 "unbounded_stash_sites", "source"],
+    "additionalProperties": False,
+}
+
 # the auto-parallelism planner record (`python bench.py --plan`,
 # apex_tpu.plan.search.plan_record_fields): the searched ranking, the
 # chosen ParallelPlan, its predicted step time + confidence
@@ -700,6 +751,12 @@ _PLAN_RANKING_ITEM = {
         "schedule_factor": {"type": "number"},
         "bubble_pct": {"type": "number"},
         "predicted_memory_mb": {"type": "number"},
+        # apexmem: which model priced predicted_memory_mb, and — when the
+        # liveness bound and the closed form disagree >10% — the honesty
+        # flag's magnitude (the disagreement also lands in `uncalibrated`
+        # as "memory_model[...]", same never-silently-priced discipline)
+        "memory_source": {"enum": ["closed_form", "liveness"]},
+        "memory_disagreement_pct": {"type": "number"},
     },
     "required": ["plan", "predicted_step_ms", "confidence"],
     "additionalProperties": False,
@@ -721,6 +778,14 @@ PLAN_SCHEMA = {
         "confidence": {"enum": ["calibrated", "partial"]},
         "uncalibrated": {"type": "array", "items": {"type": "string"}},
         "predicted_memory_mb": {"type": "number"},
+        "memory_source": {"enum": ["closed_form", "liveness"]},
+        # apexmem: the liveness bound for the CHOSEN plan's traced step,
+        # and — on TPU — the measured memory_stats() high-water and the
+        # prediction error bench_history gates (explicit SKIP objects
+        # off-TPU, never nan in an OK line)
+        "predicted_peak_hbm_mb": {"type": "number"},
+        "measured_peak_hbm_mb": _METRIC_VALUE,
+        "predicted_vs_measured_hbm_err_pct": _METRIC_VALUE,
         "ranking": {"type": "array", "items": _PLAN_RANKING_ITEM},
         "rejected": {"type": "array", "items": {
             "type": "object",
@@ -1018,6 +1083,7 @@ SCHEMAS_BY_KIND = {
     "profile": PROFILE_SCHEMA,
     "costdb": COSTDB_SCHEMA,
     "static_cost": STATIC_COST_SCHEMA,
+    "static_memory": STATIC_MEMORY_SCHEMA,
     "plan": PLAN_SCHEMA,
     "ckpt": CKPT_SCHEMA,
     "spec": SPEC_SCHEMA,

@@ -18,7 +18,11 @@ FLOPs of a traced program, scan-multiplied).
   ``jax.make_jaxpr``, no execution), convert the StaticCostReport's
   bytes/FLOPs through the CostDB's nearest bucket/class rates, apply
   the schedule geometry factor, and estimate per-chip memory from the
-  sharded avals. Blind-spot keys surface in ``uncalibrated``.
+  sharded avals — or, with ``memory_source="liveness"``, from the
+  donation-aware liveness walk of the SAME trace
+  (:func:`~apex_tpu.plan.cost.liveness_memory`, apexmem), with >10%
+  closed-form disagreement flagged. Blind-spot keys surface in
+  ``uncalibrated``.
 * :mod:`~apex_tpu.plan.search` — enumerate the feasible lattice for a
   chip count + memory bound, rank by predicted step time, and build
   the schema-validated ``plan`` record (``bench.py --plan`` emits it;
@@ -35,6 +39,8 @@ from apex_tpu.plan.cost import (  # noqa: F401
     build_plan_step,
     conservative_defaults,
     estimate_memory,
+    kv_pool_bytes,
+    liveness_memory,
     price_plan,
     static_cost_for_plan,
 )

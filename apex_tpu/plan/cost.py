@@ -207,6 +207,30 @@ def build_plan_step(plan: ParallelPlan, w: Workload):
     return fn, (params, x, x)
 
 
+#: jaxpr cache shared by static_cost_for_plan and liveness_memory: one
+#: abstract trace per distinct program feeds BOTH the time and the
+#: memory model. Values are ``(closed_jaxpr, arg_families)``.
+_JAXPR_CACHE: Dict[Tuple, Tuple[Any, Tuple[str, ...]]] = {}
+
+
+def _traced_step(plan: ParallelPlan, w: Workload):
+    """``(closed_jaxpr, arg_families)`` of the plan's step, memoized."""
+    ticks = w.microbatches(plan) * plan.virtual_chunks
+    key = _trace_signature(plan, w, ticks)
+    hit = _JAXPR_CACHE.get(key)
+    if hit is not None:
+        return hit
+    import jax
+
+    fn, args = build_plan_step(plan, w)
+    params, _x, _tgt = args
+    fams = (("params",) * len(jax.tree.leaves(params))
+            + ("activations",) * 2)
+    closed = jax.make_jaxpr(fn)(*args)
+    _JAXPR_CACHE[key] = (closed, fams)
+    return closed, fams
+
+
 def static_cost_for_plan(plan: ParallelPlan, w: Workload
                          ) -> Dict[str, Any]:
     """The plan's per-chip :func:`~apex_tpu.lint.jaxpr_check
@@ -217,12 +241,9 @@ def static_cost_for_plan(plan: ParallelPlan, w: Workload
     hit = _STATIC_CACHE.get(key)
     if hit is not None:
         return hit
-    import jax
-
     from apex_tpu.lint import jaxpr_check as jx
 
-    fn, args = build_plan_step(plan, w)
-    closed = jax.make_jaxpr(fn)(*args)
+    closed, _fams = _traced_step(plan, w)
     report = jx.static_cost(
         closed, entrypoint=f"plan_step:{'x'.join(map(str, key[:3]))}")
     _STATIC_CACHE[key] = report
@@ -273,22 +294,27 @@ def _axis_of(key: str) -> str:
 
 @dataclasses.dataclass(frozen=True)
 class PlanMemory:
-    """Per-chip HBM estimate (bytes), from the plan's sharded avals."""
+    """Per-chip HBM estimate (bytes). ``source`` names the model that
+    produced it: ``"closed_form"`` (:func:`estimate_memory`'s aval
+    arithmetic) or ``"liveness"`` (:func:`liveness_memory`'s
+    donation-aware walk of the plan's traced step)."""
 
     params: int
     optimizer: int
     activations: int
+    source: str = "closed_form"
 
     @property
     def total(self) -> int:
         return self.params + self.optimizer + self.activations
 
-    def to_json(self) -> Dict[str, float]:
+    def to_json(self) -> Dict[str, Any]:
         mb = 1 / 2 ** 20
         return {"params_mb": round(self.params * mb, 2),
                 "optimizer_mb": round(self.optimizer * mb, 2),
                 "activations_mb": round(self.activations * mb, 2),
-                "total_mb": round(self.total * mb, 2)}
+                "total_mb": round(self.total * mb, 2),
+                "source": self.source}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -308,6 +334,10 @@ class PlanPrice:
     bubble_fraction: float
     memory: PlanMemory
     uncalibrated: Tuple[str, ...]
+    #: closed-form-vs-liveness gap (pct of the closed form), set when
+    #: the liveness memory model priced this plan; >10% also lands a
+    #: ``memory_model[...]`` honesty flag in ``uncalibrated``
+    memory_disagreement_pct: Optional[float] = None
 
     @property
     def confidence(self) -> str:
@@ -331,6 +361,10 @@ class PlanPrice:
             "schedule_factor": round(self.schedule_factor, 4),
             "bubble_pct": round(100 * self.bubble_fraction, 2),
             "predicted_memory_mb": self.memory.to_json()["total_mb"],
+            "memory_source": self.memory.source,
+            **({"memory_disagreement_pct":
+                round(self.memory_disagreement_pct, 2)}
+               if self.memory_disagreement_pct is not None else {}),
         }
 
 
@@ -360,13 +394,92 @@ def estimate_memory(plan: ParallelPlan, w: Workload) -> PlanMemory:
         live = ticks if plan.pp_schedule == "zb" else min(plan.pp, ticks)
     else:
         live = 1
-    # stashed tick inputs + one microbatch's block residuals (H + ffn
-    # per layer, tp-sharded with SP/tp on the wide dim)
-    resid = b * s * (H + ffn // plan.tp) * w.dtype_bytes * lc
+    # stashed tick inputs + in-flight block residuals (H + ffn per
+    # layer, tp-sharded with SP/tp on the wide dim) for EVERY chunk
+    # this chip hosts — interleaving keeps one microbatch's residuals
+    # alive per virtual chunk, a term the liveness cross-check showed
+    # this closed form used to drop (ISSUE 18 satellite)
+    resid = (b * s * (H + ffn // plan.tp) * w.dtype_bytes * lc
+             * max(plan.virtual_chunks, 1))
     if plan.sequence_parallel:
         resid //= plan.tp
+    # the vocab head: one microbatch's logits (b, s, V/tp) live at the
+    # forward peak in the compute dtype PLUS their fp32 loss cast —
+    # another term the liveness cross-check surfaced (at V=32k the
+    # logits outweigh the whole layer stash)
+    head_act = b * s * (V // plan.tp) * (w.dtype_bytes + 4)
     return PlanMemory(params=param_bytes, optimizer=opt_bytes,
-                      activations=live * act + resid)
+                      activations=live * act + resid + head_act)
+
+
+def kv_pool_bytes(layers: int, num_blocks: int, kv_heads: int,
+                  block_size: int, head_dim: int, *,
+                  kv_dtype: str = "bf16") -> int:
+    """Closed form for the serving engine's paged KV pool — the k+v
+    block stacks plus, under int8, the per-block-row fp32 scale planes
+    the quantized pool carries (a term the liveness cross-check showed
+    the old sizing arithmetic dropped). Matches
+    ``ServingEngine.pool_bytes()`` exactly; linear in ``num_blocks``
+    (the knob ServePlan pricing will search), pinned against the
+    liveness bound of the serve entrypoints in tests."""
+    elem = 1 if kv_dtype == "int8" else 2
+    pool = 2 * layers * num_blocks * kv_heads * block_size * head_dim * elem
+    if kv_dtype == "int8":
+        pool += 2 * layers * num_blocks * block_size * 4
+    return pool
+
+
+def liveness_memory(plan: ParallelPlan, w: Workload) -> PlanMemory:
+    """The plan's per-chip memory from the DONATION-AWARE liveness walk
+    (:func:`apex_tpu.lint.liveness.analyze`) of the same traced step
+    :func:`static_cost_for_plan` prices time from —
+    ``source="liveness"``. Family mapping: the analysis's at-peak
+    ``params`` bytes stay params; ``activations`` (stashed residuals
+    and scan carries) plus ``temps`` (everything the trace holds
+    transiently at the peak) land in ``activations``. The traced step
+    is an SGD rebind with NO optimizer-state operand, so the optimizer
+    term is borrowed from :func:`estimate_memory`'s closed form — the
+    one deliberately shared term between the two models.
+
+    The traced program is schedule-AGNOSTIC (one grad over the full
+    tick scan stashes every tick's input — zb-like geometry), so for
+    1f1b plans the liveness bound is an over-estimate of the windowed
+    schedule; :func:`price_plan` surfaces >10% gaps as the
+    ``memory_model[...]`` honesty flag rather than silently preferring
+    either model."""
+    from apex_tpu.lint import liveness
+
+    closed, fams = _traced_step(plan, w)
+    rep = liveness.analyze(
+        _per_chip_body(closed), arg_families=fams,
+        entrypoint=f"plan_step:dp{plan.dp}xtp{plan.tp}xpp{plan.pp}")
+    closed_form = estimate_memory(plan, w)
+    f = rep.families
+    return PlanMemory(
+        params=f["params"],
+        optimizer=closed_form.optimizer,
+        activations=f["activations"] + f["temps"] + f["kv_pool"],
+        source="liveness")
+
+
+def _per_chip_body(closed):
+    """The PER-CHIP program of a traced ``shard_map`` step: when the
+    top level is a single call-like eqn wrapping the whole program
+    (the shard_map/pjit envelope ``build_plan_step`` produces, whose
+    body sees the per-shard avals), analyze the body — the outer
+    operands are the GLOBAL arrays, which would bill a tp=4 plan 4× its
+    per-chip weight bytes. Positional invar correspondence is required;
+    anything else analyzes unwrapped."""
+    from apex_tpu.lint.jaxpr_check import as_jaxpr, sub_jaxprs
+
+    j = as_jaxpr(closed)
+    if len(j.eqns) != 1:
+        return closed
+    subs = [as_jaxpr(s) for v in j.eqns[0].params.values()
+            for s in sub_jaxprs(v)]
+    if len(subs) == 1 and len(subs[0].invars) == len(j.invars):
+        return subs[0]
+    return closed
 
 
 def conservative_defaults(costdb: Dict[str, Any]) -> Dict[str, float]:
@@ -390,7 +503,8 @@ def conservative_defaults(costdb: Dict[str, Any]) -> Dict[str, float]:
 
 def price_plan(plan: ParallelPlan, w: Workload, costdb: Dict[str, Any],
                *, default_bytes_per_s: Optional[float] = None,
-               default_flops_per_s: Optional[float] = None) -> PlanPrice:
+               default_flops_per_s: Optional[float] = None,
+               memory_source: str = "closed_form") -> PlanPrice:
     """Price one plan against a measured CostDB.
 
     Deterministic: the same (plan, workload, costdb) prices to the same
@@ -398,7 +512,14 @@ def price_plan(plan: ParallelPlan, w: Workload, costdb: Dict[str, Any],
     CostDB rate never makes any plan slower. ``default_*`` rates price
     blind-spot keys so relative ranking survives a sparse CostDB; the
     keys stay listed in ``uncalibrated`` either way (a defaulted price
-    is a labeled guess, never silent)."""
+    is a labeled guess, never silent).
+
+    ``memory_source="liveness"`` prices the memory column from
+    :func:`liveness_memory` (the donation-aware walk of the traced
+    step) instead of the closed form, and cross-checks the two: a >10%
+    total-bytes gap joins ``uncalibrated`` as a ``memory_model[...]``
+    honesty flag (confidence drops to "partial"), with the magnitude
+    in ``memory_disagreement_pct`` either way."""
     from apex_tpu.monitor.hooks import pipeline_cost_model
 
     static = static_cost_for_plan(plan, w)
@@ -442,11 +563,27 @@ def price_plan(plan: ParallelPlan, w: Workload, costdb: Dict[str, Any],
         else axis_ms["pp"]
     predicted = ((gemm_ms + axis_ms["tp"] + axis_ms["cp"]) * factor
                  + axis_ms["dp"] + axis_ms["ep"] + pp_exposed)
+    if memory_source not in ("closed_form", "liveness"):
+        raise PlanError(
+            f"unknown memory_source {memory_source!r}; expected "
+            f"'closed_form' or 'liveness'")
+    memory = estimate_memory(plan, w)
+    disagreement = None
+    if memory_source == "liveness":
+        live_mem = liveness_memory(plan, w)
+        disagreement = (100.0 * abs(live_mem.total - memory.total)
+                        / max(memory.total, 1))
+        if disagreement > 10.0:
+            uncal.append(
+                f"memory_model[closed_form_vs_liveness:"
+                f"{disagreement:.0f}%]")
+        memory = live_mem
     return PlanPrice(
         plan=plan, predicted_step_ms=predicted, gemm_ms=gemm_ms,
         tp_ms=axis_ms["tp"], pp_ms=axis_ms["pp"],
         dp_ms=axis_ms["dp"] + axis_ms["ep"], cp_ms=axis_ms["cp"],
         schedule_factor=factor,
         bubble_fraction=geo["bubble_fraction"],
-        memory=estimate_memory(plan, w),
-        uncalibrated=tuple(sorted(set(uncal))))
+        memory=memory,
+        uncalibrated=tuple(sorted(set(uncal))),
+        memory_disagreement_pct=disagreement)

@@ -28,6 +28,7 @@ from apex_tpu.plan.cost import (
     PlanPrice,
     Workload,
     estimate_memory,
+    liveness_memory,
     price_plan,
 )
 from apex_tpu.plan.parallel_plan import ParallelPlan, PlanError
@@ -143,11 +144,20 @@ def search_plans(chips: int, w: Workload, costdb: Dict[str, Any], *,
                  max_virtual_chunks: int = 2,
                  include_zero: bool = True,
                  default_bytes_per_s: Optional[float] = None,
-                 default_flops_per_s: Optional[float] = None
-                 ) -> SearchResult:
+                 default_flops_per_s: Optional[float] = None,
+                 memory_source: str = "closed_form") -> SearchResult:
     """Enumerate → filter (validity, divisibility, memory bound) →
     price → rank. Deterministic: ties break on the plan's describe()
-    string, and pricing itself is bit-deterministic."""
+    string, and pricing itself is bit-deterministic.
+
+    ``memory_source="liveness"`` additionally prunes on the
+    donation-aware liveness bound of each candidate's TRACED step — a
+    plan whose closed-form estimate squeaks under the bound but whose
+    real stash geometry (every tick's input held for the deferred
+    grad) does not is rejected with a ``liveness``-labeled reason, and
+    survivors' memory column (plus the >10% closed-form disagreement
+    honesty flag) comes from the same analysis via
+    :func:`~apex_tpu.plan.cost.price_plan`."""
     plans, rejected = enumerate_plans(
         chips, w, max_virtual_chunks=max_virtual_chunks,
         include_zero=include_zero)
@@ -165,9 +175,22 @@ def search_plans(chips: int, w: Workload, costdb: Dict[str, Any], *,
                          f"{mem.total / 2**20:.0f} MB exceeds the "
                          f"bound {memory_bound_bytes / 2**20:.0f} MB"))
                     continue
+                if memory_source == "liveness":
+                    lmem = liveness_memory(plan, w)
+                    if lmem.total > memory_bound_bytes:
+                        rejected.append(
+                            (plan.describe(),
+                             f"liveness per-chip peak "
+                             f"{lmem.total / 2**20:.0f} MB exceeds the "
+                             f"bound "
+                             f"{memory_bound_bytes / 2**20:.0f} MB "
+                             f"(closed form said "
+                             f"{mem.total / 2**20:.0f} MB)"))
+                        continue
             price = price_plan(plan, w, costdb,
                                default_bytes_per_s=default_bytes_per_s,
-                               default_flops_per_s=default_flops_per_s)
+                               default_flops_per_s=default_flops_per_s,
+                               memory_source=memory_source)
         except PlanError as e:
             rejected.append((plan.describe(), str(e)))
             continue
@@ -199,6 +222,7 @@ def plan_record_fields(result: SearchResult, *,
         "confidence": best.price.confidence,
         "uncalibrated": list(best.price.uncalibrated),
         "predicted_memory_mb": best.price.memory.to_json()["total_mb"],
+        "memory_source": best.price.memory.source,
         "ranking": [c.to_json() for c in result.ranked[:top_n]],
         "rejected": [{"plan": d, "reason": r}
                      for d, r in result.rejected[:top_n]],

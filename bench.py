@@ -1708,10 +1708,14 @@ def plan_main(argv=None):
     ``pipeline_cost_model`` factor while the measured per-chip program
     carries only the useful work, so the error series includes the
     schedule-model term by construction; DRIFT is what the gate
-    watches. On TPU the record is ``status: "OK"``; off-TPU the
-    measured half rides as explicit skip objects (never nan in an OK
-    line) with ``smoke_step_ms`` as the finite plumbing witness that
-    the full search→pick→measure loop ran.
+    watches. Memory is measured too (apexmem): the chosen plan's
+    donation-aware liveness bound (``predicted_peak_hbm_mb``) is
+    compared against ``memory_stats()['peak_bytes_in_use']`` into
+    ``predicted_vs_measured_hbm_err_pct``, a second gated series. On
+    TPU the record is ``status: "OK"``; off-TPU the measured halves
+    ride as explicit skip objects (never nan in an OK line) with
+    ``smoke_step_ms`` as the finite plumbing witness that the full
+    search→pick→measure loop ran.
     """
     import sys
 
@@ -1765,9 +1769,13 @@ def plan_main(argv=None):
               "gemms": {}}
         source = "uniform-reference"
     # blind spots price at the SLOWEST measured rate (never 0 ms): a
-    # plan must not win because its dominant traffic was never measured
+    # plan must not win because its dominant traffic was never measured;
+    # the memory column comes from the donation-aware LIVENESS walk of
+    # each candidate's traced step (apexmem), with >10% closed-form
+    # disagreement surfaced as a memory_model[...] honesty flag
     from apex_tpu.plan import conservative_defaults
-    result = search_plans(chips, w, db, **conservative_defaults(db))
+    result = search_plans(chips, w, db, memory_source="liveness",
+                          **conservative_defaults(db))
     best = result.best
 
     # JXP-gate the chosen plan through the registered entrypoint — the
@@ -1796,6 +1804,20 @@ def plan_main(argv=None):
         times.append((time.perf_counter() - t0) / iters)
     measured_ms = min(times) * 1e3
 
+    # apexmem: predicted peak HBM (the liveness bound of the measured
+    # program, per chip) vs the device allocator's high-water. The
+    # measured side exists only on TPU with memory_stats(); off-TPU it
+    # rides as explicit skip objects — never nan in an OK line.
+    from apex_tpu.plan import liveness_memory
+    predicted_peak_mb = round(liveness_memory(best.plan, w).total
+                              / 2 ** 20, 2)
+    measured_peak_mb = None
+    if on_tpu:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        if peak is not None:
+            measured_peak_mb = round(peak / 2 ** 20, 2)
+
     skip_reason = (None if on_tpu else
                    f"plan step-time is a TPU measurement; this is a "
                    f"{jax.default_backend()} smoke run on a virtual "
@@ -1804,7 +1826,19 @@ def plan_main(argv=None):
         result, costdb_source=source,
         measured_step_ms=measured_ms if on_tpu else None,
         skip_reason=skip_reason)
+    no_stats = "device memory_stats unavailable on this backend"
+    skip = lambda r: ("skipped", r)  # noqa: E731
+    if measured_peak_mb is not None:
+        hbm_err = (100.0 * abs(predicted_peak_mb - measured_peak_mb)
+                   / measured_peak_mb)
+        fields["measured_peak_hbm_mb"] = measured_peak_mb
+        fields["predicted_vs_measured_hbm_err_pct"] = round(hbm_err, 3)
+    else:
+        reason = skip_reason or no_stats
+        fields["measured_peak_hbm_mb"] = skip(reason)
+        fields["predicted_vs_measured_hbm_err_pct"] = skip(reason)
     fields.update(
+        predicted_peak_hbm_mb=predicted_peak_mb,
         lint_ok=bool(lint_ok),
         smoke_step_ms=round(measured_ms, 4),
         config={"hidden_size": w.hidden_size, "num_layers": w.num_layers,

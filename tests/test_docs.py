@@ -210,7 +210,12 @@ def test_guide_covers_the_ladder():
                    "ParallelPlan(tp=2)", "export_handoff",
                    "ingest_handoff", "prefill_requests",
                    "bench.py --serve --plan-tp",
-                   "serve_decode_tp", "handoff_transfer_ms"):
+                   "serve_decode_tp", "handoff_transfer_ms",
+                   # ISSUE 18: the §11 apexmem pre-flight
+                   "--memory", "memory_budgets.json",
+                   "liveness.analyze", "peak_memory_bound",
+                   "donation_aliased", "memory_source",
+                   "predicted_vs_measured_hbm_err_pct"):
         assert needle in text, f"guide dropped {needle}"
 
 
@@ -260,5 +265,9 @@ def test_plan_doc_covers_the_planner_contract():
                    "memory_bound_bytes", "bench.py --plan",
                    "predicted_vs_measured_err_pct", "bench_history",
                    "planned_gpt_step", "deprecated shim",
-                   "heterogeneity"):
+                   "heterogeneity",
+                   # ISSUE 18: the apexmem memory-source chapter
+                   "liveness_memory", "memory_source",
+                   "memory_disagreement_pct", "closed_form_vs_liveness",
+                   "predicted_vs_measured_hbm_err_pct"):
         assert needle in text, f"plan.md dropped {needle}"

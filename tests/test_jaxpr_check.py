@@ -561,6 +561,29 @@ class TestJaxprGate:
         assert report["findings"] == []
         assert report["files_scanned"] == len(eps.names())
 
+    def test_all_entrypoints_within_checked_in_memory_budgets(self,
+                                                              capsys):
+        """The apexmem tier-1 acceptance: every registered entrypoint's
+        donation-aware liveness peak stays under its checked-in budget
+        (tools/memory_budgets.json) through the real CLI — a CLEAN
+        verdict per entrypoint, exit 0. A new entrypoint without a
+        budget entry, or a peak regression past its budget, fails here
+        as a JXP601 finding."""
+        rc = lint_main(["--jaxpr", "--memory", "--budget-file",
+                        os.path.join(REPO, "tools",
+                                     "memory_budgets.json"),
+                        "--format", "json"])
+        out = capsys.readouterr().out
+        assert rc == 0, f"memory budget violations:\n{out}"
+        report = json.loads(out)
+        assert report["findings"] == []
+        mems = report["memory"]
+        assert len(mems) == len(eps.names())
+        for m in mems:
+            assert m["verdict"] == "CLEAN", m
+            assert m["peak_bytes"] <= m["budget_bytes"]
+            assert sum(m["families"].values()) == m["peak_bytes"]
+
     def test_single_entrypoint_selection(self, capsys):
         rc = lint_main(["--jaxpr", "--entrypoint", "pipeline_zb",
                         "--format", "json"])

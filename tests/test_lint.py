@@ -261,6 +261,26 @@ def tick(stage_fn, params, x, y_prev):
     return sent, y
 """,
     ),
+    "APX405": (
+        """
+import jax
+def hot(x):
+    return jax.lax.psum(x, "tp")
+def cold(x):
+    return x
+def step(pred, x):
+    return jax.lax.cond(pred, hot, cold, x)
+""",
+        """
+import jax
+def hot(x):
+    return jax.lax.psum(x, "tp")
+def cold(x):
+    return jax.lax.psum(x * 0.0, "tp")
+def step(pred, x):
+    return jax.lax.cond(pred, hot, cold, x)
+""",
+    ),
     "APX401": (
         """
 import jax
@@ -1174,6 +1194,91 @@ def f(stage_fn, p, x, perm):
 """
         findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
         assert "APX404" not in {f.code for f in findings}
+
+
+class TestAPX405CollectiveUnderDivergentCond:
+    """Beyond the fixture pair: lambda branches, lax.switch literal
+    branch lists, the shapes that must stay silent (matched collective
+    sets, collective-free branches, unresolvable branch expressions —
+    never a guess), and the inline disable."""
+
+    def test_lambda_branches_fire(self):
+        src = """
+from jax import lax
+def f(pred, x):
+    return lax.cond(pred, lambda v: lax.all_gather(v, "tp"),
+                    lambda v: v, x)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX405" in {f.code for f in findings}
+
+    def test_switch_literal_branch_list_fires(self):
+        src = """
+from jax import lax
+def f(i, x):
+    return lax.switch(i, [lambda v: lax.psum(v, "dp"),
+                          lambda v: v + 1.0], x)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX405" in {f.code for f in findings}
+
+    def test_matched_collective_sets_stay_clean(self):
+        # the cure: the cheap branch psums a zero so every chip
+        # participates regardless of its predicate
+        src = """
+from jax import lax
+def f(pred, x):
+    return lax.cond(pred, lambda v: lax.psum(v, "tp"),
+                    lambda v: lax.psum(v * 0.0, "tp"), x)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX405" not in {f.code for f in findings}
+
+    def test_collective_free_branches_stay_clean(self):
+        src = """
+from jax import lax
+def f(pred, x):
+    return lax.cond(pred, lambda v: v + 1.0, lambda v: v - 1.0, x)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX405" not in {f.code for f in findings}
+
+    def test_unresolvable_branch_stays_silent(self):
+        # a branch we cannot see into (subscript, partial, attribute)
+        # must never produce a guess
+        src = """
+from jax import lax
+def f(pred, x, fns):
+    return lax.cond(pred, fns[0], fns[1], x)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX405" not in {f.code for f in findings}
+
+    def test_axis_index_is_not_synchronizing(self):
+        # axis_index is a local query — branch-dependent use cannot
+        # deadlock the mesh
+        src = """
+from jax import lax
+def f(pred, x):
+    return lax.cond(pred, lambda v: v + lax.axis_index("tp"),
+                    lambda v: v, x)
+"""
+        findings, _ = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX405" not in {f.code for f in findings}
+
+    def test_inline_disable(self):
+        # the directive rides the line the finding anchors to — the
+        # `lax.cond(` call line
+        src = """
+from jax import lax
+def hot(x):
+    return lax.psum(x, "tp")
+def f(pred, x):
+    return lax.cond(pred, hot, lambda v: v, x)  # apexlint: disable=APX405
+"""
+        findings, suppressed = lint.lint_source(src, path="apex_tpu/x.py")
+        assert "APX405" not in {f.code for f in findings}
+        assert suppressed == 1
 
 
 class TestAPX403BlockingCollectiveMatmul:

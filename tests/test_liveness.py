@@ -1,0 +1,383 @@
+"""apexmem tests: the donation-aware liveness analysis, byte-exact.
+
+Hand-computed fixtures pin the model's three load-bearing mechanisms —
+donation aliasing (the donated pool costs its bytes ONCE, the control
+trace is bigger by EXACTLY the pool), the scan length×stash term, and
+cond's family-wise branch max — to literal byte counts, so any drift in
+the walk's arithmetic fails loudly. The serving fixtures assert the
+same invariants on the REAL traced decode body (pool aliased once,
+peak linear in ``num_blocks``), the JXP601/602 contracts are exercised
+through ``assert_contracts``, and the CLI surface
+(``--memory`` / ``--budget-file`` / ``--static-memory``) is driven
+end-to-end including the closed-schema drift negatives.
+"""
+
+import functools
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import pytest
+
+from apex_tpu.lint import contracts as jc
+from apex_tpu.lint import entrypoints as eps
+from apex_tpu.lint import liveness
+from apex_tpu.lint.__main__ import main as lint_main
+from apex_tpu.monitor import schema as mon_schema
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+BUDGETS = os.path.join(REPO, "tools", "memory_budgets.json")
+
+F32 = jnp.float32
+
+
+# --- hand-computed fixtures ---------------------------------------------------
+# (every asserted number is derived in a comment — the fixtures ARE the
+# liveness model's contract)
+
+def _pool_step(pool, delta):
+    return pool + delta
+
+
+_POOL = jax.ShapeDtypeStruct((256, 256), F32)    # 256*256*4 = 262144 B
+_DELTA = jax.ShapeDtypeStruct((256, 256), F32)   # 262144 B
+
+
+def _donated_jaxpr():
+    step = jax.jit(_pool_step, donate_argnums=(0,))
+    return jax.make_jaxpr(step)(_POOL, _DELTA)
+
+
+def _control_jaxpr():
+    return jax.make_jaxpr(jax.jit(_pool_step))(_POOL, _DELTA)
+
+
+class TestHandComputedPeaks:
+    def test_donation_counts_the_pool_once(self):
+        """Donated: pool (262144) + delta (262144) enter live; the
+        donated pool dies at the pjit and its buffer becomes the
+        output — zero new bytes. Peak = 524288. Control: the output is
+        a fresh 262144-byte buffer on top → 786432. The difference is
+        the pool's bytes EXACTLY."""
+        fams = ("kv_pool", "temps")
+        don = liveness.analyze(_donated_jaxpr(), arg_families=fams)
+        ctl = liveness.analyze(_control_jaxpr(), arg_families=fams)
+        assert don.peak_bytes == 524288
+        assert ctl.peak_bytes == 786432
+        assert ctl.peak_bytes - don.peak_bytes == 262144  # == pool bytes
+        assert don.donation_aliased_bytes == 262144
+        assert ctl.donation_aliased_bytes == 0
+        # the aliased output inherits the donor's family
+        assert don.families["kv_pool"] == 262144
+        assert don.families["temps"] == 262144
+
+    def test_scan_contributes_carry_plus_iter_plus_stash(self):
+        """xs f32[8,128] (4096 B), c0 f32[128] (512 B); body returns
+        (c+x, c*x) so ys stacks 8×512 = 4096 B of stash. Peak at the
+        scan eqn = live (xs 4096 + c0 512) + out_new (carry 512 +
+        stacked ys 4096) + body extra (the c*x tick output, 512)
+        = 9728."""
+        def scanned(xs, c0):
+            def body(c, x):
+                return c + x, c * x
+            return jax.lax.scan(body, c0, xs)
+
+        closed = jax.make_jaxpr(scanned)(
+            jax.ShapeDtypeStruct((8, 128), F32),
+            jax.ShapeDtypeStruct((128,), F32))
+        rep = liveness.analyze(closed,
+                               arg_families=("activations", "temps"))
+        assert rep.peak_bytes == 9728
+        assert rep.stash_bytes == 4096         # the stacked ys term
+        # at the peak: activations = xs 4096 + ys 4096; temps = c0 512
+        # + carry-out 512 + body extra 512
+        assert rep.families["activations"] == 8192
+        assert rep.families["temps"] == 1536
+        assert rep.unbounded_stash_sites == 0
+
+    def test_cond_branches_are_alternatives_not_summed(self):
+        """pred bool[] (1 B, pinned) + a f32[32,32] (4096 B) +
+        convert_element_type's i32 index (4 B) are live at the cond;
+        the big branch's extra beyond its input is concatenate's
+        f32[64,32] (8192 B) + the reduce scalar (4 B) = 8196, the small
+        branch's is 4. The cond charges the MAX (8196), never the sum:
+        peak = 1 + 4 + 4096 + 8196 = 12297."""
+        def condy(pred, a):
+            def big(v):
+                return jnp.concatenate([v, v]).sum()
+
+            def small(v):
+                return v.sum()
+            return jax.lax.cond(pred, big, small, a)
+
+        closed = jax.make_jaxpr(condy)(
+            jax.ShapeDtypeStruct((), jnp.bool_),
+            jax.ShapeDtypeStruct((32, 32), F32))
+        rep = liveness.analyze(closed)
+        assert rep.peak_bytes == 12297
+        assert rep.families["temps"] == 12297  # no labels -> all temps
+
+    def test_while_flags_unbounded_stash(self):
+        """A while body's trip count is not static: the bound charges
+        ONE iteration (a f32[32,32] in, one out: 4096 + 4096 + body
+        extra 4096 = 12288) and flags the site instead of silently
+        multiplying."""
+        def looped(x):
+            return jax.lax.while_loop(
+                lambda v: v.sum() < 100.0, lambda v: v * 2.0, x)
+
+        closed = jax.make_jaxpr(looped)(jax.ShapeDtypeStruct((32, 32), F32))
+        rep = liveness.analyze(closed)
+        assert rep.unbounded_stash_sites == 1
+        assert rep.peak_bytes == 12288
+
+    def test_arg_families_validated(self):
+        closed = _control_jaxpr()
+        with pytest.raises(ValueError, match="1 labels for 2"):
+            liveness.analyze(closed, arg_families=("kv_pool",))
+        with pytest.raises(ValueError, match="unknown families"):
+            liveness.analyze(closed, arg_families=("kv_pool", "junk"))
+
+    def test_record_is_schema_valid(self):
+        rep = liveness.analyze(_donated_jaxpr(),
+                               arg_families=("kv_pool", "temps"),
+                               entrypoint="fixture")
+        rec = rep.record()
+        assert mon_schema.validate(rec) == []
+        assert rec["kind"] == "static_memory"
+        assert rec["source"] == "liveness"
+        assert rec["peak_bytes"] == 524288
+
+
+# --- the serving pool on the REAL traced decode body --------------------------
+
+def _decode_closed(num_blocks=None):
+    """Trace the serving decode step the way the entrypoint registry
+    does, at an explicit pool size."""
+    from apex_tpu.lint.entrypoints import _cow_scheduler, _gpt_smoke_model
+    from apex_tpu.serving import ServingEngine
+
+    model, params = _gpt_smoke_model()
+    engine = ServingEngine(model, num_slots=4, block_size=32,
+                           num_blocks=num_blocks)
+    sched, _, _ = _cow_scheduler(engine)
+    pool = engine.init_pool()
+    toks, lens = sched.decode_batch(0.0)
+    tables = jnp.asarray(sched.tables.asarray())
+    args = (params, pool, tables, jnp.asarray(toks), jnp.asarray(lens),
+            jr.PRNGKey(0))  # apexlint: disable=APX502
+    closed = jax.make_jaxpr(engine.decode_step)(*args)
+    fams = eps.arg_families("serve_decode", args)
+    return engine, liveness.analyze(closed, arg_families=fams)
+
+
+class TestServingPool:
+    def test_decode_pool_counted_once(self):
+        """The registered serve_decode entrypoint: the donated paged
+        pool is provably aliased input→output — the at-peak kv_pool
+        family and the aliased tally both equal pool_bytes() exactly
+        (a double-counted pool would double the family)."""
+        engine, rep = _decode_closed()
+        pb = engine.pool_bytes()
+        assert rep.donation_aliased_bytes == pb
+        assert rep.families["kv_pool"] == pb
+
+    def test_peak_linear_in_num_blocks(self):
+        """Growing the pool by N blocks grows the liveness peak by
+        EXACTLY the pool-bytes delta — the pool appears once in the
+        bound, so the slope is the per-block footprint, not 2×."""
+        e1, r1 = _decode_closed(num_blocks=8)
+        e2, r2 = _decode_closed(num_blocks=16)
+        pool_delta = e2.pool_bytes() - e1.pool_bytes()
+        assert pool_delta > 0
+        # the kv_pool family IS the pool: exactly linear
+        assert (r2.families["kv_pool"] - r1.families["kv_pool"]
+                == pool_delta)
+        # the whole peak grows by the pool delta plus only per-block
+        # index bookkeeping (i32 block ids/masks — bytes, not kilobytes)
+        peak_delta = r2.peak_bytes - r1.peak_bytes
+        assert pool_delta <= peak_delta < pool_delta + 4096
+        assert r1.donation_aliased_bytes == e1.pool_bytes()
+        assert r2.donation_aliased_bytes == e2.pool_bytes()
+
+    def test_kv_pool_bytes_matches_engine(self):
+        """The planner's closed form agrees with the engine byte-for-
+        byte, float and int8 pools both (the int8 scale planes were the
+        gap the liveness cross-check exposed)."""
+        from apex_tpu.lint.entrypoints import _gpt_smoke_model
+        from apex_tpu.plan import kv_pool_bytes
+        from apex_tpu.serving import ServingEngine
+
+        model, _ = _gpt_smoke_model()
+        c = model.config
+        bf16 = ServingEngine(model, num_slots=4, block_size=32,
+                             cache_dtype=jnp.bfloat16)
+        assert kv_pool_bytes(c.num_layers, bf16.num_blocks,
+                             c.local_kv_heads, bf16.block_size,
+                             c.head_dim) == bf16.pool_bytes()
+        q = ServingEngine(model, num_slots=4, block_size=32,
+                          kv_dtype="int8")
+        assert kv_pool_bytes(c.num_layers, q.num_blocks,
+                             c.local_kv_heads, q.block_size, c.head_dim,
+                             kv_dtype="int8") == q.pool_bytes()
+
+
+# --- JXP601 / JXP602 through the contract surface -----------------------------
+
+class TestMemoryContracts:
+    def test_peak_memory_bound_passes_at_peak(self):
+        jc.assert_contracts(_donated_jaxpr(), [jc.peak_memory_bound(
+            524288, arg_families=("kv_pool", "temps"))])
+
+    def test_peak_memory_bound_violation_names_families(self):
+        with pytest.raises(AssertionError) as e:
+            jc.assert_contracts(_donated_jaxpr(), [jc.peak_memory_bound(
+                524287, arg_families=("kv_pool", "temps"))])
+        msg = str(e.value)
+        assert "JXP601" in msg and "524288 bytes" in msg
+        assert "kv_pool" in msg  # the breakdown names the family
+
+    def test_donation_aliased_positive(self):
+        jc.assert_contracts(_donated_jaxpr(), [jc.donation_aliased(
+            "fixture pool", min_bytes=262144)])
+
+    def test_donation_aliased_negative_on_control(self):
+        with pytest.raises(AssertionError) as e:
+            jc.assert_contracts(_control_jaxpr(),
+                                [jc.donation_aliased("fixture pool")])
+        assert "JXP602" in str(e.value)
+
+
+# --- the CLI gate -------------------------------------------------------------
+
+_EP = "collective_matmul_ring"  # the cheapest entrypoint to trace
+
+
+class TestMemoryCLI:
+    def test_budget_file_without_memory_exits_2(self, capsys):
+        rc = lint_main(["--jaxpr", "--budget-file", BUDGETS])
+        assert rc == 2
+        assert "--memory" in capsys.readouterr().err
+
+    def test_memory_table_prints_peaks(self, capsys):
+        rc = lint_main(["--jaxpr", "--memory", "--entrypoint", _EP])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "apexmem" in out and _EP in out
+
+    def test_over_budget_is_jxp601_violation(self, tmp_path, capsys):
+        peak = eps.static_memory(_EP).peak_bytes
+        f = tmp_path / "budgets.json"
+        f.write_text(json.dumps(
+            {"version": 1, "unit": "bytes", "budgets": {_EP: peak - 1}}))
+        rc = lint_main(["--jaxpr", "--memory", "--entrypoint", _EP,
+                        "--budget-file", str(f)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "JXP601" in out and "VIOLATION" in out
+
+    def test_exact_budget_is_clean(self, tmp_path, capsys):
+        peak = eps.static_memory(_EP).peak_bytes
+        f = tmp_path / "budgets.json"
+        f.write_text(json.dumps(
+            {"version": 1, "unit": "bytes", "budgets": {_EP: peak}}))
+        rc = lint_main(["--jaxpr", "--memory", "--entrypoint", _EP,
+                        "--budget-file", str(f)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "CLEAN" in out
+
+    def test_missing_budget_entry_is_a_violation(self, tmp_path, capsys):
+        f = tmp_path / "budgets.json"
+        f.write_text(json.dumps(
+            {"version": 1, "unit": "bytes", "budgets": {}}))
+        rc = lint_main(["--jaxpr", "--memory", "--entrypoint", _EP,
+                        "--budget-file", str(f)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "no budget entry" in out
+
+    def test_unreadable_budget_file_exits_2(self, tmp_path, capsys):
+        f = tmp_path / "budgets.json"
+        f.write_text("{not json")
+        rc = lint_main(["--jaxpr", "--memory", "--entrypoint", _EP,
+                        "--budget-file", str(f)])
+        assert rc == 2
+        assert "budget file" in capsys.readouterr().err
+
+    def test_checked_in_budgets_cover_every_entrypoint(self):
+        """The committed budget file and the registry never drift: a
+        new entrypoint without a budget would fail the gate, and a
+        stale budget for a deleted entrypoint is dead weight."""
+        with open(BUDGETS, encoding="utf-8") as fh:
+            data = json.load(fh)
+        assert data["unit"] == "bytes"
+        assert sorted(data["budgets"]) == sorted(eps.names())
+        assert all(isinstance(v, int) and v > 0
+                   for v in data["budgets"].values())
+
+
+class TestStaticMemoryArtifact:
+    def test_cli_writes_valid_jsonl(self, tmp_path, capsys):
+        out_file = tmp_path / "static_memory.jsonl"
+        rc = lint_main(["--jaxpr", "--entrypoint", _EP,
+                        "--static-memory", str(out_file)])
+        capsys.readouterr()
+        assert rc == 0
+        lines = out_file.read_text().strip().splitlines()
+        assert len(lines) == 1
+        rec = json.loads(lines[0])
+        assert mon_schema.validate(rec) == []
+        assert rec["kind"] == "static_memory"
+        assert rec["entrypoint"] == _EP
+        assert rec["peak_bytes"] > 0
+        assert sum(rec["families"].values()) == rec["peak_bytes"]
+
+    def test_validate_metrics_dispatch_and_drift(self, tmp_path, capsys):
+        """tools/validate_metrics.py --static-memory: the real record
+        passes; a junk key, a float peak, and a wrong kind each FAIL —
+        the schema is closed, drift cannot ride along silently."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "validate_metrics", os.path.join(REPO, "tools",
+                                             "validate_metrics.py"))
+        vm = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(vm)
+
+        rec = eps.static_memory(_EP).record()
+        good = tmp_path / "good.jsonl"
+        good.write_text(json.dumps(rec) + "\n")
+        assert vm.main(["--static-memory", str(good)]) == 0
+        capsys.readouterr()
+
+        junk = dict(rec, junk=1)
+        nanlike = dict(rec, peak_bytes=float(rec["peak_bytes"]) + 0.5)
+        wrong = dict(rec, kind="static_cost")
+        for i, bad in enumerate((junk, nanlike, wrong)):
+            f = tmp_path / f"bad{i}.jsonl"
+            f.write_text(json.dumps(bad) + "\n")
+            assert vm.main(["--static-memory", str(f)]) == 1, bad
+            capsys.readouterr()
+
+    def test_cli_refuses_invalid_record(self, tmp_path, capsys,
+                                        monkeypatch):
+        """A code change that breaks the record shape must fail at
+        WRITE time (exit 2), not poison the artifact trail."""
+        real = eps.check
+
+        def broken(name, *, memory=False):
+            got = real(name, memory=memory)
+            if memory:
+                f, c, m = got
+                m = dict(m, peak_bytes="oops")
+                return f, c, m
+            return got
+
+        monkeypatch.setattr(eps, "check", broken)
+        out_file = tmp_path / "static_memory.jsonl"
+        rc = lint_main(["--jaxpr", "--entrypoint", _EP,
+                        "--static-memory", str(out_file)])
+        assert rc == 2
+        assert "static_memory" in capsys.readouterr().err

@@ -22,7 +22,11 @@ that trajectory into a gate a CI leg can run after a fresh bench:
   series (the serving-tier-2 headline: a prefix hit must stay fast
   across the trajectory); an OK ``tp_serve`` record carries its
   ``handoff_transfer_ms`` the same lower-is-better way (the
-  disaggregated KV stream must not slow down). An OK ``spec`` record carries TWO higher-is-better
+  disaggregated KV stream must not slow down). An OK ``plan`` record
+  carries its step-time ``predicted_vs_measured_err_pct`` and — when
+  ``memory_stats()`` measured one — the apexmem
+  ``predicted_vs_measured_hbm_err_pct``, both gated in absolute points
+  (a healthy model's reference is ~0). An OK ``spec`` record carries TWO higher-is-better
   series: ``spec_tokens_per_s_request`` (the speculative-decoding
   headline) and ``spec_acceptance_rate`` (the drafter-quality series
   that explains it — a silent acceptance collapse would eventually
@@ -69,6 +73,11 @@ _THROUGHPUT_KINDS = ("serve", "decode", "tp_overlap", "pipeline",
 # metrics where a BIGGER fresh value is the regression, gated in
 # ABSOLUTE points (error series — the reference may legitimately be ~0)
 _LOWER_IS_BETTER = {"plan_predicted_vs_measured_err_pct",
+                    # apexmem's memory honesty series: the liveness
+                    # bound's error vs the device's measured peak HBM —
+                    # a healthy model sits near 0, so percent drift
+                    # against ~0 is noise; gate in absolute points
+                    "plan_predicted_vs_measured_hbm_err_pct",
                     # async checkpointing's per-step cost: already a
                     # percentage of a step, and a healthy async saver
                     # sits near 0 — percent-drift against ~0 is noise
@@ -160,7 +169,16 @@ def extract_all(obj: Dict[str, Any], label: str = "artifact"
             raise ValueError(
                 f"{label}: OK plan record has no numeric "
                 "predicted_vs_measured_err_pct")
-        return [("plan_predicted_vs_measured_err_pct", float(v), 0.0)]
+        rows = [("plan_predicted_vs_measured_err_pct", float(v), 0.0)]
+        # the apexmem memory series (absent on pre-liveness records and
+        # when memory_stats() skipped — a skip object, not 0): the
+        # liveness peak-HBM bound vs the device's measured peak, gated
+        # in absolute points like the step-time error
+        hbm = obj.get("predicted_vs_measured_hbm_err_pct")
+        if isinstance(hbm, (int, float)):
+            rows.append(("plan_predicted_vs_measured_hbm_err_pct",
+                         float(hbm), 0.0))
+        return rows
     if kind == "spec":
         # the speculative-decoding leg: per-request throughput is the
         # headline, the acceptance rate the tracked drafter-quality

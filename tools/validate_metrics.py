@@ -11,6 +11,7 @@ Usage::
     python tools/validate_metrics.py --serve-window windows.jsonl ...
     python tools/validate_metrics.py --pipeline pipeline.jsonl ...
     python tools/validate_metrics.py --static-cost static_cost.jsonl ...
+    python tools/validate_metrics.py --static-memory static_memory.jsonl ...
     python tools/validate_metrics.py --plan plan.jsonl ...
     python tools/validate_metrics.py --ckpt ckpt.jsonl ...
     python tools/validate_metrics.py --spec spec.jsonl ...
@@ -59,6 +60,10 @@ Dispatch is by content, not extension:
   ``static_cost`` artifacts (``python -m apex_tpu.lint --jaxpr
   --static-cost``: the jaxpr walker's predicted per-collective bytes /
   per-GEMM FLOPs — the planner's predicted side of the CostDB diff),
+  and ``static_memory`` artifacts (``python -m apex_tpu.lint --jaxpr
+  --memory --static-memory``: the apexmem donation-aware liveness
+  peak-HBM bound with its family breakdown — a CLOSED schema with
+  integer byte fields, so a junk key or a nan-shaped peak fails),
   and ``plan`` records (``python bench.py --plan``: the auto-
   parallelism planner's searched ranking + chosen ParallelPlan +
   predicted-vs-measured error — plan objects and ranking rows are
@@ -74,8 +79,9 @@ Dispatch is by content, not extension:
   real-multichip-TPU claim; off-TPU it must be a reasoned SKIP)
   dispatch on ``kind`` like every monitor record. ``--profile`` /
   ``--serve`` / ``--serve-window`` / ``--tp-serve`` / ``--pipeline`` /
-  ``--costdb`` / ``--static-cost`` / ``--plan`` / ``--ckpt`` /
-  ``--spec`` force EVERY listed file to be judged as that artifact
+  ``--costdb`` / ``--static-cost`` / ``--static-memory`` / ``--plan`` /
+  ``--ckpt`` / ``--spec`` force EVERY listed file to be judged as that
+  artifact
   (same rationale as ``--lint-report``: an artifact that lost its
   ``kind`` key must fail as a bad
   profile/serve/pipeline/costdb/static_cost/plan/ckpt/spec/tp_serve,
@@ -224,6 +230,8 @@ def main(argv=None) -> int:
         force_kind = "serve"
     elif "--pipeline" in argv:
         force_kind = "pipeline"
+    elif "--static-memory" in argv:
+        force_kind = "static_memory"
     elif "--static-cost" in argv:
         force_kind = "static_cost"
     elif "--plan" in argv:
@@ -240,8 +248,8 @@ def main(argv=None) -> int:
     argv = [a for a in argv
             if a not in ("--lint-report", "--costdb", "--profile",
                          "--serve", "--serve-window", "--tp-serve",
-                         "--pipeline", "--static-cost", "--plan",
-                         "--ckpt", "--spec", "--trace")]
+                         "--pipeline", "--static-cost", "--static-memory",
+                         "--plan", "--ckpt", "--spec", "--trace")]
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
