@@ -44,7 +44,8 @@ from apex_tpu.inference.sampling import sample_logits
 from apex_tpu.models.gpt import GPTModel, shard_params_for_tp
 from apex_tpu.monitor import spans as monitor_spans
 from apex_tpu.monitor import trace as monitor_trace
-from apex_tpu.ops import decode_attention, fused_layer_norm, fused_verify
+from apex_tpu.ops import (decode_attention, fused_layer_norm, fused_verify,
+                          fused_verify_tree)
 from apex_tpu.ops.pallas.attention import NEG_INF
 from apex_tpu.parallel import mesh as mesh_lib
 from apex_tpu.serving import tp as tp_serving
@@ -52,16 +53,30 @@ from apex_tpu.serving import tp as tp_serving
 
 @dataclass
 class SpecStats:
-    """Host-side accounting of one speculative ``generate`` call."""
+    """Host-side accounting of one speculative ``generate`` call.
+
+    ``drafted`` counts PATH DEPTH per round (the chain's k; the tree's
+    drafted depth), so ``acceptance_rate`` compares across chain and
+    tree rounds; ``nodes`` counts total verify rows scored (== drafted
+    for chains, branching x depth per tree round) — the denominator of
+    draft-compute efficiency."""
 
     rounds: int = 0
     drafted: int = 0
     accepted: int = 0
+    nodes: int = 0
 
     @property
     def acceptance_rate(self) -> float:
         """Accepted drafts / drafted tokens (0.0 before any round)."""
         return self.accepted / self.drafted if self.drafted else 0.0
+
+    @property
+    def efficiency(self) -> float:
+        """Emitted tokens (accepted + one bonus per round) per verify
+        row scored — what adaptive (k, b) selection maximizes."""
+        rows = self.nodes + self.rounds  # + the root row per round
+        return (self.accepted + self.rounds) / rows if rows else 0.0
 
 
 class DecodeEngine:
@@ -159,6 +174,14 @@ class DecodeEngine:
         # draft length k, so across rounds it compiles exactly once
         self.spec_verify_step = jax.jit(self._spec_verify_step,
                                         donate_argnums=(1,))
+        # the TREE round: N+1 nodes scored in one forward under the
+        # tree-attention mask + the fused tree-verify tail; avals depend
+        # only on (N+1, depth+1) — both carried by operand SHAPES
+        # (parents/anc and the levels iota), so the jit cache holds one
+        # executable per (k, b) topology in use and nothing retraces
+        # across rounds, streams, or acceptance patterns
+        self.spec_tree_step = jax.jit(self._spec_tree_verify_step,
+                                      donate_argnums=(1,))
         self.last_spec_stats: Optional[SpecStats] = None
 
     # --- cache ---------------------------------------------------------------
@@ -537,6 +560,201 @@ class DecodeEngine:
                               top_k=self.top_k)
         return {"k": ck, "v": cv}, a, nxt
 
+    def _spec_tree_verify_step(self, params, cache, tokens, pos, parents,
+                               anc, levels, key):
+        """One TREE speculative round: score ``tokens`` (1, N+1) — the
+        pending token (the root) plus N drafted tree nodes — in ONE
+        forward, each node attending the committed cache rows plus its
+        own root path via the ``anc`` tree-attention mask, then run the
+        fused tree-verify tail and commit the WINNING path's k/v into
+        cache rows [pos, pos+accept_len]. Returns ``(cache, accept_len
+        (1,), j_star (1,), next_token (1,))``. Unlike the chain step,
+        sibling nodes share positions so nothing is cache-scattered
+        before the verdict; only the accepted path lands, selected
+        level-by-level inside the same program (``levels`` is a
+        ``(depth+1,)`` iota whose SHAPE carries the static depth).
+        Rows past the accepted frontier hold zeros that next round's
+        length masking hides — length masking IS the rewind."""
+        with monitor_spans.span("spec_verify"):
+            return self._spec_tree_verify_body(params, cache, tokens,
+                                               pos, parents, anc, levels,
+                                               key)
+
+    def _spec_tree_verify_body(self, params, cache, tokens, pos, parents,
+                               anc, levels, key):
+        model, c = self.model, self.config
+        b, N1 = tokens.shape
+        d = c.head_dim
+        h_kv, group = c.local_kv_heads, c.local_heads // c.local_kv_heads
+        pos = jnp.asarray(pos, jnp.int32)
+        depth_vec = jnp.sum(anc.astype(jnp.int32), axis=-1) - 1  # (1, N1)
+        positions = pos + depth_vec[0]  # (N1,) — siblings SHARE positions
+        x = model.embedding(params["embedding"], tokens)  # (1, N1, H)
+        ptab = params["pos_embedding"]
+        x = x + jnp.take(ptab, jnp.minimum(positions, ptab.shape[0] - 1),
+                         axis=0)[None]
+        ck, cv = cache["k"], cache["v"]
+        scale = 1.0 / d ** 0.5
+        js = jnp.arange(self.max_s, dtype=jnp.int32)
+        # committed rows only: the root's own k/v rides the TREE part
+        # (index 0), not the cache, until the verdict commits it
+        cache_mask = js[None, None, :] < pos  # (1, 1, max_s)
+        tree_mask = anc[0] != 0  # (N1 queries, N1 nodes): the root path
+        tks, tvs = [], []
+        for i in range(c.num_layers):
+            layer = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+            h_in = fused_layer_norm(x, layer["ln1_w"], layer["ln1_b"])
+            q, k, v = model._proj_qkv_bshd(layer, h_in)  # (1, N1, h, d)
+            tks.append(k)
+            tvs.append(v)
+            k_all, v_all = ck[i][0], cv[i][0]  # (h_kv, max_s, d)
+            qg = q[0].reshape(N1, h_kv, group, d).transpose(1, 2, 0, 3)
+            s_c = jnp.einsum("hgcd,hsd->hgcs", qg,
+                             k_all.astype(qg.dtype),
+                             preferred_element_type=jnp.float32) * scale
+            s_c = jnp.where(cache_mask[None], s_c, NEG_INF)
+            kt = k[0].transpose(1, 0, 2)  # (h_kv, N1, d)
+            vt = v[0].transpose(1, 0, 2)
+            s_t = jnp.einsum("hgcd,hnd->hgcn", qg, kt.astype(qg.dtype),
+                             preferred_element_type=jnp.float32) * scale
+            s_t = jnp.where(tree_mask[None, None], s_t, NEG_INF)
+            # ONE softmax across cache + tree keys — exactly the
+            # distribution the committed-path decode would compute
+            p = jax.nn.softmax(jnp.concatenate([s_c, s_t], axis=-1),
+                               axis=-1)
+            p_c, p_t = p[..., :self.max_s], p[..., self.max_s:]
+            ctx = jnp.einsum("hgcs,hsd->hgcd", p_c.astype(v_all.dtype),
+                             v_all) \
+                + jnp.einsum("hgcn,hnd->hgcd", p_t.astype(vt.dtype), vt)
+            ctx = ctx.transpose(2, 0, 1, 3).reshape(1, N1, c.local_heads,
+                                                    d)
+            x = x + model._proj_attn_out(layer, ctx)
+            x = x + model._mlp(layer, fused_layer_norm(
+                x, layer["ln2_w"], layer["ln2_b"]))
+        x = fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
+        logits = model.unembed(params, x)  # (1, N1, V)
+        a, j_star, nxt = fused_verify_tree(
+            logits, tokens, parents, anc, key,
+            temperature=self.temperature, top_k=self.top_k)
+        # commit the winning path: level l of j_star's root path (root =
+        # level 0 = the pending token) lands at cache row pos + l; levels
+        # past accept_len select nothing and write zeros (masked rows)
+        ii = jnp.arange(N1, dtype=jnp.int32)
+        onpath = jnp.einsum(
+            "si,sin->sn", (ii[None] == j_star[:, None]).astype(jnp.float32),
+            anc.astype(jnp.float32))  # (1, N1)
+        lvl = onpath[:, None, :] * (
+            depth_vec[:, None, :] == levels[None, :, None]
+        ).astype(jnp.float32)  # (1, depth+1, N1)
+        zero = jnp.int32(0)
+        for i in range(c.num_layers):
+            sel_k = jnp.einsum("bln,bnhd->bhld", lvl.astype(tks[i].dtype),
+                               tks[i])
+            sel_v = jnp.einsum("bln,bnhd->bhld", lvl.astype(tvs[i].dtype),
+                               tvs[i])
+            ck = jax.lax.dynamic_update_slice(
+                ck, sel_k[None].astype(ck.dtype),
+                (jnp.int32(i), zero, zero, pos, zero))
+            cv = jax.lax.dynamic_update_slice(
+                cv, sel_v[None].astype(cv.dtype),
+                (jnp.int32(i), zero, zero, pos, zero))
+        return {"k": ck, "v": cv}, a, j_star, nxt
+
+    def _generate_spec_tree(self, params, prompt, max_new_tokens, key,
+                            draft, adaptive):
+        """The tree-speculative driver behind ``generate(draft=<tree
+        drafter>)``: one batched forward scores the whole draft tree,
+        the fused tree verify emits the deepest accepted root path + a
+        bonus token, and :class:`~apex_tpu.spec.tree.DraftTree` walks
+        the verdict back to host tokens. ``adaptive`` (an
+        :class:`~apex_tpu.spec.tree.AdaptiveSpecController`) re-picks
+        (depth, branching) per round from its static choice set — each
+        choice is one pinned executable."""
+        from apex_tpu.spec.drafter import validate_drafter
+        from apex_tpu.spec.tree import draft_tree
+
+        b, s = prompt.shape
+        if b != 1:
+            raise ValueError(
+                f"draft= speculative generation runs batch 1 (accepted "
+                f"lengths diverge across rows, and the contiguous cache "
+                f"carries one scalar position); got batch {b} — split "
+                f"the batch, or serve it through ServingEngine.serve("
+                f"draft=...) which speculates per slot")
+        if self.tp > 1:
+            raise ValueError(
+                "tree-speculative generation has no tensor-parallel "
+                "body — decode tree drafts at tp=1, or use a chain "
+                "drafter (which verifies through the tp twin)")
+        if getattr(self.model, "decode_rel_bias", None) is not None:
+            raise ValueError(
+                "draft= speculative decoding cannot run a model with a "
+                "decode relative-position bias (the spec verify step "
+                "does not carry the bucketed bias) — generate with "
+                "draft=None for this model")
+        shapes = (adaptive.choices if adaptive is not None
+                  else ((draft.depth, draft.branching),))
+        depth_max = max(dd for dd, _ in shapes)
+        validate_drafter(draft, self.config,
+                         needed_rows=s + max_new_tokens + depth_max)
+        if s + max_new_tokens + depth_max - 1 > self.max_s:
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({max_new_tokens}) + "
+                f"tree depth ({depth_max}) - 1 exceeds the cache "
+                f"({self.max_s}): a tree round writes depth rows past "
+                f"the live frontier — raise max_seq_len or lower the "
+                f"drafter's depth")
+        if s + max_new_tokens + depth_max - 1 > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({max_new_tokens}) + "
+                f"tree depth ({depth_max}) - 1 steps past the model's "
+                f"position table ({self.config.max_seq_len}); drafted "
+                f"rows hold real positions too — lower the depth or the "
+                f"request")
+        cache, tok, _ = self.prefill(params, prompt,
+                                     jax.random.fold_in(key, 0))
+        stats = SpecStats()
+        gen = [int(jnp.asarray(tok)[0])]
+        context = [int(t) for t in jnp.asarray(prompt)[0]] + gen
+        while len(gen) < max_new_tokens:
+            depth, branching = (adaptive.choice(0) if adaptive is not None
+                                else (draft.depth, draft.branching))
+            tree = draft_tree(branching, depth)
+            node_tokens = np.asarray(
+                draft.propose_tree(0, context, shape=(depth, branching)),
+                np.int32).reshape(-1)
+            if node_tokens.shape != (tree.num_nodes,):
+                raise ValueError(
+                    f"drafter proposed {node_tokens.shape} node tokens; "
+                    f"the ({branching}, {depth}) topology needs exactly "
+                    f"{tree.num_nodes} (static shapes keep the verify "
+                    f"program compiled once per topology)")
+            parents, anc = tree.operands(1)
+            pos = s + len(gen) - 1
+            cache, a, j_star, nxt = self.spec_tree_step(
+                params, cache,
+                jnp.asarray([[gen[-1], *node_tokens]], jnp.int32),
+                jnp.int32(pos), jnp.asarray(parents), jnp.asarray(anc),
+                jnp.arange(depth + 1, dtype=jnp.int32),
+                jax.random.fold_in(key, 1 + stats.rounds))
+            a = int(jnp.asarray(a)[0])
+            emitted = tree.path_tokens(node_tokens, a,
+                                       int(jnp.asarray(j_star)[0]),
+                                       int(jnp.asarray(nxt)[0]))
+            gen.extend(emitted)
+            context.extend(emitted)
+            stats.rounds += 1
+            stats.drafted += depth
+            stats.accepted += a
+            stats.nodes += tree.num_nodes
+            if adaptive is not None:
+                adaptive.note_round(0, a, depth)
+        draft.release(0)
+        if adaptive is not None:
+            adaptive.release(0)
+        self.last_spec_stats = stats
+        return jnp.asarray([gen[:max_new_tokens]], jnp.int32)
+
     def _generate_spec(self, params, prompt, max_new_tokens, key, draft):
         """The speculative driver behind ``generate(draft=...)``."""
         from apex_tpu.spec.drafter import validate_drafter
@@ -603,6 +821,7 @@ class DecodeEngine:
             stats.rounds += 1
             stats.drafted += K
             stats.accepted += a
+            stats.nodes += K
         draft.release(0)
         self.last_spec_stats = stats
         return jnp.asarray([gen[:max_new_tokens]], jnp.int32)
@@ -611,7 +830,7 @@ class DecodeEngine:
 
     def generate(self, params, prompt, max_new_tokens: int,
                  key: Optional[jax.Array] = None,
-                 draft=None) -> jax.Array:
+                 draft=None, adaptive=None) -> jax.Array:
         """Greedy/sampled continuation: prompt (b, s) int32 → generated
         tokens (b, max_new_tokens). Python-loop driver over the jit'd
         steps; the loop body re-binds the donated cache each step.
@@ -622,7 +841,15 @@ class DecodeEngine:
         positions and the fused verify tail accepts the longest valid
         prefix — greedy output token-identical to ``draft=None``, 1 to
         k+1 tokens per target dispatch, acceptance accounted in
-        :attr:`last_spec_stats`."""
+        :attr:`last_spec_stats`. A TREE-capable drafter (one exposing
+        ``propose_tree`` + ``depth``/``branching``, e.g.
+        :class:`~apex_tpu.spec.tree.NGramTreeDrafter`) instead drafts a
+        branching tree per round, scored in one forward and verified by
+        the fused tree tail — same token-identical contract, 1 to
+        depth+1 tokens per dispatch. ``adaptive`` (tree drafters only)
+        attaches an :class:`~apex_tpu.spec.tree.AdaptiveSpecController`
+        that re-picks (depth, branching) per round from its static
+        choice set."""
         b, s = prompt.shape
         if max_new_tokens < 1:
             raise ValueError(
@@ -656,8 +883,24 @@ class DecodeEngine:
                or monitor_trace.new_trace_id("gen"))
         with monitor_trace.trace_context(tid):
             if draft is not None:
+                from apex_tpu.spec.tree import is_tree_drafter
+                if is_tree_drafter(draft):
+                    return self._generate_spec_tree(
+                        params, prompt, max_new_tokens, key, draft,
+                        adaptive)
+                if adaptive is not None:
+                    raise ValueError(
+                        "adaptive= (k, b) selection needs a tree-capable "
+                        "drafter (propose_tree + depth/branching); this "
+                        "drafter only proposes chains — use "
+                        "NGramTreeDrafter/PagedModelDrafter, or drop "
+                        "adaptive=")
                 return self._generate_spec(params, prompt,
                                            max_new_tokens, key, draft)
+            if adaptive is not None:
+                raise ValueError(
+                    "adaptive= requires draft= (there is no draft shape "
+                    "to adapt without a drafter)")
             cache, tok, _ = self.prefill(params, prompt,
                                          jax.random.fold_in(key, 0))
             out = [tok]

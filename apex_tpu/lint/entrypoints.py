@@ -36,6 +36,11 @@ suites used to assert with one-off walkers:
   scale planes), each with the COW tables in play: pool donated and
   rebound, collective-free — ISSUE 15's two new device programs under
   the same contract set;
+* ``spec_verify_tree`` — the TREE speculative round (branching x depth
+  drafted nodes scored under the ancestor tree-attention mask in one
+  forward + the fused tree-verify tail, only the winning path
+  committed): pool donated and rebound, collective-free — ISSUE 19's
+  device program under the same contract set;
 * ``serve_prefill_tp`` / ``serve_decode_tp`` — the tensor-parallel
   serving bodies (pool sharded over kv_heads, projections riding the
   collective-matmul ring): pool donated and rebound, ``ppermute`` over
@@ -201,6 +206,8 @@ ARG_FAMILIES = {
     "serve_swap": _SERVE_DECODE_FAMS,
     "spec_verify": ("params", "kv_pool", "temps", "temps", "temps",
                     "temps", "temps"),
+    "spec_verify_tree": ("params", "kv_pool", "temps", "temps", "temps",
+                         "temps", "temps", "temps", "temps"),
 }
 
 
@@ -783,6 +790,53 @@ def _build_spec_verify():
                               jnp.asarray(tok_mat), jnp.asarray(lens),
                               jnp.asarray(drafted),
                               jr.PRNGKey(0))  # apexlint: disable=APX502
+
+
+# smoke-scale tree topology: 2 branches x depth 2 (4 drafted nodes)
+_TREE_BRANCHING, _TREE_DEPTH = 2, 2
+
+
+@register(
+    "spec_verify_tree",
+    "serving TREE speculative round: branching x depth drafted nodes "
+    "scored under the anc tree-attention mask in ONE forward + fused "
+    "tree-verify tail, only the winning path committed to the pool "
+    "(pool donated+rebound, collective-free)",
+    lambda: [jc.donation_honored(), jc.donation_rebound(),
+             jc.donation_aliased("paged KV pool"),
+             jc.collective_free_region("", region="tree verify body")])
+def _build_spec_verify_tree():
+    import jax.random as jr
+    import numpy as np
+
+    from apex_tpu.spec.tree import draft_tree
+
+    engine, params, jnp = _serving_engine()
+    sched, _, _ = _cow_scheduler(engine)
+    pool = engine.init_pool()
+    # the REAL tree-round operands: the decode batch with depth draft
+    # rows reserved, the topology's parent/ancestor arrays tiled over
+    # the slot array (constant CONTENTS — the executable is pinned per
+    # (num_nodes+1, depth+1)), dead slots riding 0s
+    tree = draft_tree(_TREE_BRANCHING, _TREE_DEPTH)
+    batch = sched.decode_batch(0.0, lookahead=_TREE_DEPTH)
+    if batch is None:
+        raise RuntimeError(
+            "spec_verify_tree entrypoint expected a live decode batch")
+    toks, lens = batch
+    S = engine.num_slots
+    tok_mat = np.zeros((S, tree.n1), np.int32)
+    tok_mat[:, 0] = toks
+    parents, anc = tree.operands(S)
+    levels = np.arange(_TREE_DEPTH + 1, dtype=np.int32)
+    tables = jnp.asarray(sched.tables.asarray())
+    return engine.spec_tree_step, (params, pool, tables,
+                                   jnp.asarray(tok_mat),
+                                   jnp.asarray(lens),
+                                   jnp.asarray(parents),
+                                   jnp.asarray(anc),
+                                   jnp.asarray(levels),
+                                   jr.PRNGKey(0))  # apexlint: disable=APX502
 
 
 @register(

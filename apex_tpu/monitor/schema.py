@@ -432,6 +432,12 @@ SERVE_EVENT_SCHEMA = {
         # accepted_len of draft_k drafted tokens survived verification
         "accepted_len": {"type": "integer"},
         "draft_k": {"type": "integer"},
+        # TREE speculative round (ISSUE 19): the round scored a
+        # draft_k-deep, tree_branching-wide tree (tree_nodes verify
+        # rows) and accepted_len is the winning root path's depth;
+        # absent on chain rounds
+        "tree_nodes": {"type": "integer"},
+        "tree_branching": {"type": "integer"},
         # disaggregated KV handoff (ISSUE 17): one record per request
         # per role — the SAME trace_id rides the export (prefill
         # engine) and ingest (decode engine) legs
@@ -905,6 +911,26 @@ SPEC_SCHEMA = {
         "prompt_len": {"type": "integer"},
         "new_tokens": {"type": "integer"},
         "requests": {"type": "integer"},         # churn sweep size
+        # tree speculative decoding (`--spec --tree`, ISSUE 19): the
+        # fused tree-verify leg — same closed-schema discipline, the
+        # tree fields simply EXTEND the record (a pre-tree consumer
+        # rejects nothing; a junk key still fails)
+        "tree_spec_tokens_per_s_request": _METRIC_VALUE,  # tree, batch 1
+        "tree_spec_tokens_per_s_churn": _METRIC_VALUE,    # tree serve
+        "tree_spec_acceptance_rate": _METRIC_VALUE,  # path rows / depth
+        "tree_speedup": _METRIC_VALUE,           # tree / baseline, b=1
+        "tree_depth": {"type": "integer"},       # static tree shape
+        "tree_branching": {"type": "integer"},
+        "tree_nodes": {"type": "integer"},       # branching x depth
+        "tree_rounds": {"type": "integer"},
+        "tree_greedy_parity": {"type": "boolean"},   # tree == plain, b=1
+        "tree_churn_parity": {"type": "boolean"},    # tree == plain, serve
+        "drafter_pool_blocks": {"type": "integer"},  # peak drafter blocks
+        #                                            # in the SHARED pool
+        "adaptive_efficiency": _METRIC_VALUE,    # tokens per verify row
+        "fixed_k_efficiency": {"type": "array",  # same, per fixed choice
+                               "items": {"type": "number"}},
+        "adaptive_beats_fixed": {"type": "boolean"},
         "spread_pct": _METRIC_VALUE,
         "pass_times_ms": {"type": "array", "items": {"type": "number"}},
         "config": {"type": "object"},

@@ -49,7 +49,8 @@ from apex_tpu.ops.attention import (BucketedBias, flash_attention,  # noqa: F401
                                     ring_attention, ulysses_attention)
 from apex_tpu.ops.decode_attention import decode_attention  # noqa: F401
 from apex_tpu.ops.sampling import fused_sample  # noqa: F401
-from apex_tpu.ops.fused_verify import fused_verify  # noqa: F401
+from apex_tpu.ops.fused_verify import (fused_verify,  # noqa: F401
+                                       fused_verify_tree)
 from apex_tpu.ops.collective_matmul import (  # noqa: F401
     all_gather_matmul,
     copy_matmul,

@@ -26,6 +26,12 @@ from apex_tpu.ops.pallas.attention import NEG_INF
 from apex_tpu.ops.pallas.decode_attention import (decode_attn_fwd,
                                                   decode_attn_paged_fwd)
 
+#: 1-byte pool storage dtypes the paged path dequantizes with per-row
+#: scales (the serving engine's kv_dtype="int8"/"fp8_e4m3" pools); the
+#: dequant is dtype-agnostic (astype(f32) * scale), so both share the
+#: kernel and fallback verbatim
+QUANT_POOL_DTYPES = (jnp.dtype(jnp.int8), jnp.dtype(jnp.float8_e4m3fn))
+
 
 def decode_kernel_ok(max_s: int, d: int, dtype) -> bool:
     """Mosaic eligibility for the decode kernel: the cache's seq dim must
@@ -132,14 +138,17 @@ def decode_attention(
     gathers the table into the contiguous view and runs the contiguous
     math, so paged == contiguous bitwise on that path.
 
-    ``k_scale``/``v_scale``: the INT8 paged pool (the serving engine's
-    ``kv_dtype="int8"`` knob) — ``k``/``v`` are then int8 pools and the
-    scales are ``(num_blocks, block_size)`` fp32 per-row dequantization
-    factors (shared across kv heads and head_dim: the write site
-    quantizes one token row at a time). The Pallas kernel dequantizes
-    each block IN VMEM after its (halved) HBM copy; the XLA fallback
-    dequantizes the gathered view and runs the standard math. Scales
-    are paged-path-only and required exactly when the pool is int8.
+    ``k_scale``/``v_scale``: the QUANTIZED paged pool (the serving
+    engine's ``kv_dtype`` knob, ``"int8"`` or ``"fp8_e4m3"``) —
+    ``k``/``v`` are then 1-byte pools and the scales are
+    ``(num_blocks, block_size)`` fp32 per-row dequantization factors
+    (shared across kv heads and head_dim: the write site quantizes one
+    token row at a time). The Pallas kernel dequantizes each block IN
+    VMEM after its (halved) HBM copy; the XLA fallback dequantizes the
+    gathered view and runs the standard math — the dequant is the same
+    ``astype(f32) * scale`` either way, so both storage dtypes share
+    every path below. Scales are paged-path-only and required exactly
+    when the pool is quantized.
     """
     if q.ndim != 3 or k.ndim != 4 or k.shape != v.shape:
         raise ValueError(
@@ -148,9 +157,9 @@ def decode_attention(
             f"block_tables; got q {q.shape}, k {k.shape}, v {v.shape}")
     b, h, d = q.shape
     if block_tables is None and (k_scale is not None
-                                 or k.dtype == jnp.int8):
+                                 or k.dtype in QUANT_POOL_DTYPES):
         raise ValueError(
-            "int8 k/v pools (and their k_scale/v_scale) are the PAGED "
+            "quantized k/v pools (and their k_scale/v_scale) are the PAGED "
             "path only — pass block_tables (the serving engine's "
             "kv_dtype knob; the contiguous DecodeEngine cache keeps a "
             "float cache_dtype)")
@@ -239,10 +248,10 @@ def _paged_decode_attention(q, k, v, lengths, block_tables, *, scale,
             f"{block_tables.dtype}")
     if lengths.shape != (b,):
         raise ValueError(f"lengths must be ({b},); got {lengths.shape}")
-    quant = k.dtype == jnp.int8
+    quant = k.dtype in QUANT_POOL_DTYPES
     if quant != (k_scale is not None) or quant != (v_scale is not None):
         raise ValueError(
-            "int8 pools require BOTH k_scale and v_scale (and float "
+            "quantized pools require BOTH k_scale and v_scale (and float "
             "pools take neither): the per-row scales are half the "
             "quantized representation — got k dtype "
             f"{k.dtype}, k_scale {'set' if k_scale is not None else 'None'}, "
@@ -255,9 +264,9 @@ def _paged_decode_attention(q, k, v, lengths, block_tables, *, scale,
                     f"block_size={bs}) per-row scales; got {sc.shape}")
         if bias is not None:
             raise ValueError(
-                "int8 paged decode does not carry the bucketed relative "
-                "bias (no quantized kernel path exists for the bias "
-                "composition) — serve T5-style models with a float "
+                "quantized paged decode does not carry the bucketed "
+                "relative bias (no quantized kernel path exists for the "
+                "bias composition) — serve T5-style models with a float "
                 "kv_dtype")
     lengths = lengths.astype(jnp.int32)
     group = h // h_kv

@@ -25,8 +25,11 @@ import jax.numpy as jnp
 
 from apex_tpu.ops import _backend
 from apex_tpu.ops.pallas.verify import (NO_DRAFT, VERIFY_LANES,
-                                        fused_verify_fwd, verify_greedy,
-                                        verify_sampled)
+                                        fused_verify_fwd,
+                                        fused_verify_tree_fwd,
+                                        verify_greedy, verify_sampled,
+                                        verify_tree_greedy,
+                                        verify_tree_sampled)
 
 
 def verify_kernel_ok(vocab: int, dtype) -> bool:
@@ -122,3 +125,99 @@ def fused_verify(logits: jax.Array, drafted: jax.Array,
                               temperature=float(temperature), top_k=top_k,
                               top_p=float(top_p))
     return verify_greedy(logits, drafted_pad)
+
+
+def fused_verify_tree(logits: jax.Array, tokens: jax.Array,
+                      parents: jax.Array, anc: jax.Array,
+                      key: Optional[jax.Array] = None, *,
+                      temperature: float = 0.0, top_k: int = 0,
+                      top_p: float = 1.0, impl: str = "auto"
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Verify a DRAFT TREE of N tokens against N+1 target logit rows.
+
+    ``logits`` (b, N+1, V): row j is the target's distribution for the
+    token AFTER node j's token — row 0 after the committed pending
+    token (the tree's root), rows 1..N after the drafted nodes.
+    ``tokens`` (b, N+1) int32 node tokens (column 0 is the pending
+    token and is ignored — it is pinned to ``NO_DRAFT`` internally);
+    ``parents`` (b, N+1) int32 parent pointers into the same node
+    index space (``parents[:, 0] == 0``, ``parents[:, j] < j`` — a
+    topological order the drafters emit by construction); ``anc``
+    (b, N+1, N+1) int32 ancestor-or-self closure (``anc[:, i, j] == 1``
+    iff node j lies on node i's root path, node 0 and i included —
+    :class:`apex_tpu.spec.tree.DraftTree` precomputes it once per
+    static topology, so it ships as constant operand contents).
+
+    Returns ``(accept_len (b,), j_star (b,), next_token (b,))`` int32:
+    the deepest fully-accepted root path's length (accepted drafted
+    tokens), its terminal node index, and the bonus/corrected token
+    sampled from that node's row — one tree round emits the path's
+    tokens plus ``next_token``, between 1 and depth+1 tokens. At
+    branching 1 the semantics degenerate to :func:`fused_verify` (the
+    chain is the one-branch tree). ``temperature == 0`` is exact
+    greedy acceptance (the tree stream is token-identical to
+    non-speculative greedy decoding); ``temperature > 0`` applies the
+    point-mass rejection rule edge-wise along every root path, with
+    each correction row filtering ALL of its drafted children (the
+    chain's single-child residual, generalized). Noise is drawn inside
+    the caller's jit and shared between kernel and XLA fallback, so
+    ``impl`` never changes the verdict.
+    """
+    if logits.ndim != 3:
+        raise ValueError(
+            f"fused_verify_tree takes (b, N+1, V) logits; got "
+            f"{logits.shape}")
+    b, n1, V = logits.shape
+    if tokens.shape != (b, n1) or parents.shape != (b, n1):
+        raise ValueError(
+            f"tokens/parents must be (b={b}, N+1={n1}) to match the "
+            f"(b, N+1, V) logits; got {tokens.shape} / {parents.shape}")
+    if anc.shape != (b, n1, n1):
+        raise ValueError(
+            f"anc must be the (b={b}, N+1={n1}, N+1={n1}) "
+            f"ancestor-or-self closure; got {anc.shape}")
+    if n1 < 2:
+        raise ValueError(
+            f"fused_verify_tree needs N >= 1 drafted nodes (N+1 = {n1} "
+            f"logit rows); a 1-row verify is just sampling — use "
+            f"fused_sample")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    sampled = temperature > 0.0
+    if sampled and key is None:
+        raise ValueError(
+            "temperature > 0 tree verification requires a PRNG key")
+    # the root row carries no draft: its accept flag is structurally
+    # irrelevant (tree_accepted_path forces node 0 accepted) but a
+    # pinned NO_DRAFT keeps it out of the children filter
+    tokens = tokens.astype(jnp.int32).at[:, 0].set(NO_DRAFT)
+    parents = parents.astype(jnp.int32)
+    anc = anc.astype(jnp.int32)
+    top_k = min(int(top_k), V)
+    u_acc = u_gum = None
+    if sampled:
+        ka, kg = jax.random.split(key)
+        tiny = jnp.finfo(jnp.float32).tiny
+        u_acc = jax.random.uniform(ka, (b, n1), jnp.float32, minval=tiny,
+                                   maxval=1.0)
+        u_gum = jax.random.uniform(kg, (b, n1, V), jnp.float32,
+                                   minval=tiny, maxval=1.0)
+    ok = verify_kernel_ok(V, logits.dtype) and n1 <= VERIFY_LANES
+    if _backend.choose_impl(impl, ok) == "pallas":
+        anc_pad = anc if n1 >= VERIFY_LANES else jnp.pad(
+            anc, ((0, 0), (0, 0), (0, VERIFY_LANES - n1)))
+        return fused_verify_tree_fwd(
+            logits, _pad_lanes(tokens, NO_DRAFT),
+            _pad_lanes(parents, 0), anc_pad,
+            None if u_acc is None else _pad_lanes(u_acc, 1.0),
+            u_gum, temperature=float(temperature), top_k=top_k,
+            top_p=float(top_p), interpret=_backend.interpret_mode())
+    if sampled:
+        return verify_tree_sampled(logits, tokens, parents, anc, u_acc,
+                                   u_gum, temperature=float(temperature),
+                                   top_k=top_k, top_p=float(top_p))
+    return verify_tree_greedy(logits, tokens, parents, anc)

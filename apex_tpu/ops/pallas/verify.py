@@ -124,6 +124,124 @@ def verify_sampled(logits, drafted_pad, u_acc, u_gum, *, temperature,
     return a, select_row(cand, a)
 
 
+def tree_depths(anc):
+    """Per-node depth from the ancestor-or-self closure: ``anc``
+    (..., N1, N1) int32 (``anc[i, j] == 1`` iff node j lies on node i's
+    root path, including i itself and the root, node 0) → (..., N1)
+    int32 depths (the root has depth 0)."""
+    return jnp.sum(anc.astype(jnp.int32), axis=-1) - 1
+
+
+def tree_accepted_path(acc, anc):
+    """The deepest fully-accepted root path of a draft tree.
+
+    ``acc`` (..., N1) per-node accept flags (node 0 — the committed
+    pending token — is forced accepted here; padding nodes must arrive
+    False); ``anc`` (..., N1, N1) the ancestor-or-self closure. A node
+    is PATH-accepted iff every node on its root path is accepted, and
+    the winner is the deepest path-accepted node (ties to the LOWEST
+    node index — the drafters order siblings best-first, so the tie
+    break is deterministic and drafter-meaningful). Returns
+    ``(accept_len (...,), j_star (...,))`` int32: the winner's depth
+    (== accepted drafted tokens) and its node index. Node 0 is always
+    path-accepted, so ``accept_len >= 0`` and ``j_star`` is always a
+    valid node."""
+    n1 = anc.shape[-1]
+    lanes = jax.lax.broadcasted_iota(jnp.int32, acc.shape, acc.ndim - 1)
+    acc_i = jnp.maximum(acc.astype(jnp.int32),
+                        (lanes == 0).astype(jnp.int32))
+    bad = anc.astype(jnp.int32) * (1 - acc_i)[..., None, :]
+    ok = jnp.sum(bad, axis=-1) == 0
+    depth = tree_depths(anc)
+    a = jnp.max(jnp.where(ok, depth, -1), axis=-1)
+    idx = jax.lax.broadcasted_iota(jnp.int32, ok.shape, ok.ndim - 1)
+    hit = ok & (depth == a[..., None])
+    j_star = jnp.min(jnp.where(hit, idx, n1), axis=-1)
+    return a, j_star
+
+
+def _parent_onehot(parents, n1):
+    """``po[..., c, r] = (parents[..., c] == r)`` — the one-hot parent
+    gather both tree modes use (kernel-safe: iota + compare, no
+    dynamic gather)."""
+    cols = jax.lax.broadcasted_iota(jnp.int32, parents.shape + (n1,),
+                                    parents.ndim)
+    return cols == parents[..., None]
+
+
+def verify_tree_greedy(logits, tokens, parents, anc):
+    """Exact greedy tree acceptance. ``logits`` (..., N1, V): row j is
+    the target's distribution AFTER node j's token (row 0 after the
+    committed pending token); ``tokens`` (..., N1) int32 node tokens
+    with row 0 pinned at :data:`NO_DRAFT`; ``parents`` (..., N1) int32
+    parent pointers (``parents[0] == 0``, ``parents[j] < j`` —
+    topological); ``anc`` (..., N1, N1) the ancestor-or-self closure.
+
+    Node j is accepted iff its parent's argmax candidate equals
+    ``tokens[j]`` — exactly the chain rule applied edge-wise, so at
+    branching 1 this degenerates to :func:`verify_greedy` (with the
+    chain's row i living at node i+1). The emitted path is the deepest
+    fully-accepted one and the bonus/corrected token is the winner
+    row's candidate; by the same maximality argument as the chain
+    (a child carrying the winner's candidate would itself be accepted,
+    contradicting maximality), the result is token-identical to
+    non-speculative greedy decoding. Returns ``(accept_len, j_star,
+    next_token)``, each (...,) int32."""
+    cand = row_argmax(logits.astype(jnp.float32))        # (..., N1)
+    n1 = cand.shape[-1]
+    po = _parent_onehot(parents, n1)                     # (..., c, r)
+    pc = jnp.sum(jnp.where(po, cand[..., None, :], 0), axis=-1)
+    acc = (pc == tokens) & (tokens != NO_DRAFT)
+    a, j_star = tree_accepted_path(acc, anc)
+    return a, j_star, select_row(cand, j_star)
+
+
+def verify_tree_sampled(logits, tokens, parents, anc, u_acc, u_gum, *,
+                        temperature, top_k, top_p):
+    """Rejection-sampling tree acceptance for point-mass drafts under
+    the temperature→top-k→top-p filtered target distribution.
+
+    Same operand contract as :func:`verify_tree_greedy` plus ``u_acc``
+    (..., N1) uniform acceptance draws in (0, 1] (row 0 unused) and
+    ``u_gum`` (..., N1, V) uniform Gumbel noise. Node j accepts iff
+    ``u_acc[j] < p_parent(tokens[j])`` (the ``min(1, p/q)`` rule with
+    a point-mass q, applied edge-wise along every root path); the
+    correction candidate of each row is drawn from p with ALL of that
+    node's drafted children FILTERED (the point-mass residual over the
+    set of drafts rejected at that node — the chain's single-child
+    filter, generalized), and the winner row's candidate is emitted.
+    At branching 1 this degenerates to :func:`verify_sampled` edge for
+    edge. A drafted token the filter removed carries p == 0 and is
+    always rejected."""
+    s = filtered_scaled(logits, temperature=temperature, top_k=top_k,
+                        top_p=top_p)                     # (..., N1, V)
+    n1 = s.shape[-2]
+    real = tokens != NO_DRAFT
+    cols_v = jax.lax.broadcasted_iota(jnp.int32, s.shape, s.ndim - 1)
+    tok_oh = (cols_v == tokens[..., None]).astype(jnp.float32)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    z = jnp.sum(e, axis=-1)                              # (..., N1)
+    # t[..., r, c] = e[..., r, tokens[c]] — every node's token mass
+    # under every row, one MXU pass instead of an (N1, V) gather
+    t = jnp.einsum("...rv,...cv->...rc", e, tok_oh,
+                   preferred_element_type=jnp.float32)
+    po = _parent_onehot(parents, n1)                     # (..., c, r)
+    tt = jnp.swapaxes(t, -1, -2)                         # (..., c, r)
+    p_num = jnp.sum(jnp.where(po, tt, 0.0), axis=-1)
+    p_den = jnp.sum(jnp.where(po, z[..., None, :], 0.0), axis=-1)
+    acc = (u_acc < p_num / p_den) & real
+    a, j_star = tree_accepted_path(acc, anc)
+    # child[..., r, c] = 1 iff c is a real drafted child of r; the
+    # correction row r filters every child token it just rejected
+    child = (jnp.swapaxes(po, -1, -2).astype(jnp.float32)
+             * real.astype(jnp.float32)[..., None, :])
+    child_oh = jnp.einsum("...rc,...cv->...rv", child, tok_oh,
+                          preferred_element_type=jnp.float32) > 0.5
+    cand = gumbel_argmax(jnp.where(child_oh, FILTERED, s), u_gum)
+    return a, j_star, select_row(cand, j_star)
+
+
 def _verify_kernel(logits_ref, drafted_ref, *refs, k1, temperature,
                    top_k, top_p, sampled):
     """One grid row: the whole (k+1, V) logit block is VMEM-resident;
@@ -181,3 +299,72 @@ def fused_verify_fwd(logits, drafted_pad, u_acc, u_gum, *, temperature,
         interpret=interpret,
     )(*args)
     return a[:, 0], tok[:, 0]
+
+
+def _verify_tree_kernel(logits_ref, tokens_ref, parents_ref, anc_ref,
+                        *refs, n1, temperature, top_k, top_p, sampled):
+    """One grid row of the TREE verify: the whole (N1, V) logit block is
+    VMEM-resident; the parent-pointer walk, per-edge acceptance, path
+    max, and correction draw all run on it in place — three 128-lane
+    int32 writes come back."""
+    if sampled:
+        u_acc_ref, u_gum_ref, a_ref, j_ref, tok_ref = refs
+    else:
+        a_ref, j_ref, tok_ref = refs
+    s = logits_ref[0]                       # (N1, V)
+    tokens = tokens_ref[0, :n1]
+    parents = parents_ref[0, :n1]
+    anc = anc_ref[0, :, :n1]                # (N1, N1)
+    if sampled:
+        a, j_star, tok = verify_tree_sampled(
+            s, tokens, parents, anc, u_acc_ref[0, :n1], u_gum_ref[0],
+            temperature=temperature, top_k=top_k, top_p=top_p)
+    else:
+        a, j_star, tok = verify_tree_greedy(s, tokens, parents, anc)
+    a_ref[:] = jnp.broadcast_to(a[None, None], (1, _LSE_LANES))
+    j_ref[:] = jnp.broadcast_to(j_star[None, None], (1, _LSE_LANES))
+    tok_ref[:] = jnp.broadcast_to(tok[None, None], (1, _LSE_LANES))
+
+
+def fused_verify_tree_fwd(logits, tokens_pad, parents_pad, anc_pad,
+                          u_acc, u_gum, *, temperature, top_k, top_p,
+                          interpret=False):
+    """(b, N1, V) logits + lane-padded tree operands → ``(accept_len
+    (b,), j_star (b,), next_token (b,))`` int32; one kernel invocation,
+    grid over batch rows. ``tokens_pad``/``parents_pad``/``u_acc``
+    arrive padded to ``VERIFY_LANES`` lanes and ``anc_pad`` to
+    (b, N1, VERIFY_LANES) (contents beyond N1 ignored); greedy mode
+    takes ``u_acc``/``u_gum`` as None. V must be a 128-multiple."""
+    b, n1, V = logits.shape
+    sampled = temperature > 0.0
+    if n1 > VERIFY_LANES:  # unreachable through the drafters (N <= 32)
+        raise ValueError(
+            f"fused tree-verify kernel carries node ids in one "
+            f"{VERIFY_LANES}-lane block; got N+1 = {n1} rows — use the "
+            f"XLA fallback (impl='xla') for trees this wide")
+    in_specs = [
+        pl.BlockSpec((1, n1, V), lambda i: (i, 0, 0)),
+        pl.BlockSpec((1, VERIFY_LANES), lambda i: (i, 0)),
+        pl.BlockSpec((1, VERIFY_LANES), lambda i: (i, 0)),
+        pl.BlockSpec((1, n1, VERIFY_LANES), lambda i: (i, 0, 0)),
+    ]
+    args = [logits, tokens_pad, parents_pad, anc_pad]
+    if sampled:
+        in_specs.append(pl.BlockSpec((1, VERIFY_LANES), lambda i: (i, 0)))
+        in_specs.append(pl.BlockSpec((1, n1, V), lambda i: (i, 0, 0)))
+        args.extend([u_acc, u_gum])
+    a, j_star, tok = pl.pallas_call(
+        functools.partial(_verify_tree_kernel, n1=n1,
+                          temperature=temperature, top_k=top_k,
+                          top_p=top_p, sampled=sampled),
+        grid=(b,),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, _LSE_LANES), lambda i: (i, 0)),
+                   pl.BlockSpec((1, _LSE_LANES), lambda i: (i, 0)),
+                   pl.BlockSpec((1, _LSE_LANES), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b, _LSE_LANES), jnp.int32),
+                   jax.ShapeDtypeStruct((b, _LSE_LANES), jnp.int32),
+                   jax.ShapeDtypeStruct((b, _LSE_LANES), jnp.int32)],
+        interpret=interpret,
+    )(*args)
+    return a[:, 0], j_star[:, 0], tok[:, 0]

@@ -34,11 +34,12 @@ one set of avals for the lifetime of the engine:
 
 All donate the pool: XLA updates the cache in place, so a step's HBM
 traffic is the live cache read plus one token's writes — never a pool
-copy. Under ``kv_dtype="int8"`` the pool stores int8 k/v with
-per-block-row fp32 scales alongside (quantize on write at every write
-site; dequantize in-VMEM inside the paged decode kernel), halving the
-bytes the HBM-bound decode stream pays — the float pool stays the
-parity oracle. Everything dynamic about traffic stays in
+copy. Under a quantized ``kv_dtype`` (``"int8"`` or ``"fp8_e4m3"``)
+the pool stores 1-byte k/v cells with per-block-row fp32 scales
+alongside (quantize on write at every write site; dequantize in-VMEM
+inside the paged decode kernel), halving the bytes the HBM-bound
+decode stream pays — the float pool stays the parity oracle, and the
+two quantized formats differ only in (qmax, storage dtype). Everything dynamic about traffic stays in
 :class:`~apex_tpu.serving.scheduler.Scheduler` on the host; churn
 reaches the device only as operand *contents*, which is why
 ``decode_step._cache_size()`` stays 1 across arbitrary admit/evict
@@ -66,7 +67,8 @@ from apex_tpu.models.gpt import GPTModel, shard_params_for_tp
 from apex_tpu.monitor import registry as monitor_registry
 from apex_tpu.monitor import spans as monitor_spans
 from apex_tpu.monitor import trace as monitor_trace
-from apex_tpu.ops import fused_layer_norm, fused_sample, fused_verify
+from apex_tpu.ops import (fused_layer_norm, fused_sample, fused_verify,
+                          fused_verify_tree)
 from apex_tpu.ops.decode_attention import decode_attention
 from apex_tpu.ops.pallas.attention import NEG_INF
 from apex_tpu.parallel import mesh as mesh_lib
@@ -77,16 +79,32 @@ from apex_tpu.serving.scheduler import Request, Scheduler, SLOPolicy
 from apex_tpu.serving.telemetry import ServeTelemetry
 
 
-def _quant_rows(x, axes):
-    """Symmetric per-row int8 quantization: one fp32 scale per row
-    (``axes`` reduced away — kv heads and head_dim share it, because the
-    write sites land one token row at a time), values rounded into
-    [-127, 127]. The tiny floor keeps an all-zero row's scale finite
-    (dead-block writes, padding) — it dequantizes back to exact zeros."""
+#: legal kv_dtype values and their (qmax, storage dtype): int8 rounds
+#: into [-127, 127]; fp8_e4m3 keeps a mantissa and scales amax onto the
+#: format's finite ceiling (448) — same per-block-row fp32 scale planes,
+#: same 1 byte/cell, so the two pools share every write/gather site
+KV_QUANT_SPECS = {
+    "int8": (127.0, jnp.int8),
+    "fp8_e4m3": (448.0, jnp.float8_e4m3fn),
+}
+
+
+def _quant_rows(x, axes, *, qmax=127.0, qdtype=jnp.int8):
+    """Symmetric per-row quantization: one fp32 scale per row (``axes``
+    reduced away — kv heads and head_dim share it, because the write
+    sites land one token row at a time). Integer targets round into
+    [-qmax, qmax] (int8's [-127, 127]); float targets (fp8) keep their
+    own mantissa and just clip at the format's amax. The tiny floor
+    keeps an all-zero row's scale finite (dead-block writes, padding) —
+    it dequantizes back to exact zeros."""
     xf = x.astype(jnp.float32)
     amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
-    scale = jnp.maximum(amax, 1e-20) / 127.0
-    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    scale = jnp.maximum(amax, 1e-20) / qmax
+    y = xf / scale
+    if jnp.issubdtype(jnp.dtype(qdtype), jnp.integer):
+        q = jnp.clip(jnp.round(y), -qmax, qmax).astype(qdtype)
+    else:
+        q = jnp.clip(y, -qmax, qmax).astype(qdtype)
     return q, jnp.squeeze(scale, axis=axes)
 
 
@@ -103,6 +121,18 @@ class ServeStats:
     spec_rounds: int = 0
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # tree rounds (serve(draft=<tree drafter>)): spec_drafted counts
+    # DEPTH rows (the chain-equivalent denominator — acceptance rates
+    # stay comparable across tree and chain), spec_nodes the verify
+    # rows actually scored (branching x depth per slot per round), and
+    # spec_degraded the rounds the tree→chain→plain headroom ladder
+    # stepped down instead of stalling
+    tree_rounds: int = 0
+    spec_nodes: int = 0
+    spec_degraded: int = 0
+    # per-SLOT spec rounds (spec_rounds counts dispatches; each live
+    # slot in a dispatch is one slot-round — the efficiency denominator)
+    spec_slot_rounds: int = 0
     occupancy_samples: List[int] = field(default_factory=list)
 
     def occupancy_pct(self, num_slots: int) -> Optional[float]:
@@ -116,6 +146,18 @@ class ServeStats:
         """Accepted drafts / drafted tokens (0.0 before any round)."""
         return (self.spec_accepted / self.spec_drafted
                 if self.spec_drafted else 0.0)
+
+    @property
+    def spec_efficiency(self) -> float:
+        """Emitted tokens per verify-row scored — the tree/chain
+        cost-normalized yield (each per-slot round scores ``nodes + 1``
+        rows and emits ``accepted + 1`` tokens); 0.0 before any round.
+        The adaptive-vs-fixed bench comparison ranks on THIS: a wider
+        tree that lifts acceptance but wastes more rows must win here,
+        not just on raw acceptance."""
+        rows = self.spec_nodes + self.spec_slot_rounds
+        return ((self.spec_accepted + self.spec_slot_rounds) / rows
+                if rows else 0.0)
 
 
 class ServingEngine:
@@ -166,26 +208,38 @@ class ServingEngine:
         model.check_decode_supported()
         self.model = model
         c = self.config = model.config
-        # int8 KV quantization (ROADMAP item 3b): halves the bytes the
-        # decode kernel streams and doubles live-token capacity; the
+        # quantized KV pools (ROADMAP item 3b + fp8 sibling): 1 byte per
+        # cell instead of the cache dtype's 2, halving the bytes the
+        # decode kernel streams and doubling live-token capacity; the
         # float pool (kv_dtype=None, dtype = cache_dtype) stays as the
-        # parity oracle. Validated HERE — an unsupported value or model
-        # composition must name the knob, never surface as a deep XLA
-        # dtype/shape error mid-serve.
-        if kv_dtype not in (None, "int8"):
+        # parity oracle. int8 and fp8_e4m3 share the per-block-row fp32
+        # scale layout and every write/gather site; only (qmax, storage
+        # dtype) differ (see KV_QUANT_SPECS). Validated HERE — an
+        # unsupported value or model composition must name the knob,
+        # never surface as a deep XLA dtype/shape error mid-serve.
+        if kv_dtype not in (None, *KV_QUANT_SPECS):
+            legal = ", ".join(repr(k) for k in KV_QUANT_SPECS)
             raise ValueError(
                 f"kv_dtype must be None (float pool in cache_dtype) or "
-                f"'int8' (per-block-row scales, dequantized in-kernel); "
-                f"got {kv_dtype!r} — fp8 pools are not implemented")
-        if kv_dtype == "int8" \
+                f"one of {legal} (per-block-row scales, dequantized "
+                f"in-kernel); got {kv_dtype!r}")
+        if kv_dtype is not None \
                 and getattr(model, "decode_rel_bias", None) is not None:
             raise ValueError(
-                "kv_dtype='int8' cannot serve a model with a decode "
-                "relative-position bias (the quantized paged kernel "
-                "path does not carry the bucketed bias) — serve this "
-                "model with the float pool (kv_dtype=None)")
+                f"kv_dtype={kv_dtype!r} cannot serve a model with a "
+                "decode relative-position bias (the quantized paged "
+                "kernel path does not carry the bucketed bias) — serve "
+                "this model with the float pool (kv_dtype=None)")
+        if kv_dtype == "fp8_e4m3" and plan is not None \
+                and int(getattr(plan, "tp", 1)) > 1:
+            raise ValueError(
+                "kv_dtype='fp8_e4m3' is tp=1 only for now (the "
+                "tensor-parallel quantize path is int8-specific) — "
+                "serve fp8 pools single-chip or use kv_dtype='int8'")
         self.kv_dtype = kv_dtype
-        self.quantized = kv_dtype == "int8"
+        self.quantized = kv_dtype is not None
+        self._qmax, self._qdtype = KV_QUANT_SPECS.get(
+            kv_dtype, (127.0, jnp.int8))
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.block_size = int(block_size)
@@ -280,24 +334,30 @@ class ServingEngine:
         # on the static draft length, so across rounds and churn it
         # compiles exactly once like the other two
         self.spec_step = jax.jit(self._spec_step, donate_argnums=(1,))
+        # the TREE speculative round (serve(draft=<tree drafter>)):
+        # avals depend only on the (num_nodes+1, depth+1) topology, so
+        # there is one pinned executable per (depth, branching) in use
+        # — the adaptive controller's whole choice set compiles once
+        self.spec_tree_step = jax.jit(self._tree_step, donate_argnums=(1,))
 
     # --- pool ----------------------------------------------------------------
 
     def init_pool(self) -> Dict[str, jax.Array]:
         """The zeroed block pool:
         ``{"k"/"v": (layers, num_blocks, kv_heads, block_size, head_dim)}``
-        — block 0 is the dead block (see kv_blocks). Under
-        ``kv_dtype="int8"`` the k/v arrays are int8 and per-block-row
-        fp32 scales ride alongside as ``k_scale``/``v_scale``
-        ``(layers, num_blocks, block_size)`` — one pool tree either
-        way, its avals fixed for the engine's lifetime."""
+        — block 0 is the dead block (see kv_blocks). Under a quantized
+        ``kv_dtype`` (``"int8"`` / ``"fp8_e4m3"``) the k/v arrays hold
+        1-byte cells and per-block-row fp32 scales ride alongside as
+        ``k_scale``/``v_scale`` ``(layers, num_blocks, block_size)`` —
+        one pool tree either way, its avals fixed for the engine's
+        lifetime."""
         c = self.config
         shape = (c.num_layers, self.num_blocks, c.local_kv_heads,
                  self.block_size, c.head_dim)
         if self.quantized:
             sshape = (c.num_layers, self.num_blocks, self.block_size)
-            pool = {"k": jnp.zeros(shape, jnp.int8),
-                    "v": jnp.zeros(shape, jnp.int8),
+            pool = {"k": jnp.zeros(shape, self._qdtype),
+                    "v": jnp.zeros(shape, self._qdtype),
                     "k_scale": jnp.zeros(sshape, jnp.float32),
                     "v_scale": jnp.zeros(sshape, jnp.float32)}
         else:
@@ -501,8 +561,10 @@ class ServingEngine:
                 # quantize on write: per (block, row) scales over
                 # (h_kv, d) — the same ids, so the dead-block redirect
                 # covers the scale planes too
-                kq, ksc = _quant_rows(kb, (1, 3))
-                vq, vsc = _quant_rows(vb, (1, 3))
+                kq, ksc = _quant_rows(kb, (1, 3), qmax=self._qmax,
+                                      qdtype=self._qdtype)
+                vq, vsc = _quant_rows(vb, (1, 3), qmax=self._qmax,
+                                      qdtype=self._qdtype)
                 ck = ck.at[i, ids].set(kq)
                 cv = cv.at[i, ids].set(vq)
                 ks = ks.at[i, ids].set(ksc)
@@ -593,8 +655,10 @@ class ServingEngine:
             # slots carry table rows of DEAD_BLOCK, so their writes are
             # absorbed harmlessly
             if self.quantized:
-                kq, ksc = _quant_rows(k_row[:, :, 0], (1, 2))  # (S,)
-                vq, vsc = _quant_rows(v_row[:, :, 0], (1, 2))
+                kq, ksc = _quant_rows(k_row[:, :, 0], (1, 2),  # (S,)
+                                      qmax=self._qmax, qdtype=self._qdtype)
+                vq, vsc = _quant_rows(v_row[:, :, 0], (1, 2),
+                                      qmax=self._qmax, qdtype=self._qdtype)
                 ck = ck.at[i, bid, :, row].set(kq)
                 cv = cv.at[i, bid, :, row].set(vq)
                 ks = ks.at[i, bid, row].set(ksc)
@@ -674,8 +738,10 @@ class ServingEngine:
             q, k, v = model._proj_qkv_bshd(layer, h_in)
             # (S, K1) rows scattered at traced (block, row) coordinates
             if self.quantized:
-                kq, ksc = _quant_rows(k, (2, 3))  # scales (S, K1)
-                vq, vsc = _quant_rows(v, (2, 3))
+                kq, ksc = _quant_rows(k, (2, 3),  # scales (S, K1)
+                                      qmax=self._qmax, qdtype=self._qdtype)
+                vq, vsc = _quant_rows(v, (2, 3),
+                                      qmax=self._qmax, qdtype=self._qdtype)
                 ck = ck.at[i, bid, :, row].set(kq)
                 cv = cv.at[i, bid, :, row].set(vq)
                 ks = ks.at[i, bid, row].set(ksc)
@@ -716,6 +782,143 @@ class ServingEngine:
                               temperature=self.temperature,
                               top_k=self.top_k, top_p=self.top_p)
         return self._pool_out(ck, cv, ks, vs), a, nxt
+
+    # --- tree speculative round ----------------------------------------------
+
+    def _tree_step(self, params, pool, tables, tokens, lengths, parents,
+                   anc, levels, key):
+        # trace-time step-anatomy span, like serve_spec
+        with monitor_spans.span("serve_spec_tree"):
+            return self._tree_step_body(params, pool, tables, tokens,
+                                        lengths, parents, anc, levels, key)
+
+    def _tree_step_body(self, params, pool, tables, tokens, lengths,
+                        parents, anc, levels, key):
+        """One TREE speculative round for EVERY slot at once: ``tokens``
+        (S, N+1) are each slot's pending sampled token (the root, column
+        0) plus its N drafted tree-node tokens, ``parents``/``anc`` the
+        :class:`~apex_tpu.spec.tree.DraftTree` operands tiled over the
+        slot array, ``levels`` a ``(depth+1,)`` iota whose SHAPE carries
+        the static depth. Unlike the chain round nothing is scattered
+        into the pool before the verdict — sibling nodes SHARE positions,
+        so a pre-write would collide; each node instead attends the
+        committed cache rows (``js < base``) plus its own root path via
+        the ``anc`` tree-attention mask under ONE softmax, the fused
+        tree-verify tail picks the deepest accepted path, and only the
+        WINNING path's k/v land in the slots' pool blocks (level ``l`` at
+        row ``base + l``; levels past ``accept_len`` — and dead slots —
+        redirect to the dead block). The scheduler then just commits the
+        emitted tokens: no rejected rows ever touched the pool, so the
+        rewind is pure host bookkeeping. Returns ``(pool, accept_lens
+        (S,), j_star (S,), next_tokens (S,))`` — one executable per
+        static ``(N+1, depth+1)``."""
+        model, c = self.model, self.config
+        B = self.block_size
+        S, N1 = tokens.shape
+        h_kv, group = c.local_kv_heads, c.local_heads // c.local_kv_heads
+        d = c.head_dim
+        max_s = self.max_s
+        lengths = lengths.astype(jnp.int32)
+        base = jnp.maximum(lengths - 1, 0)
+        depth_vec = jnp.sum(anc.astype(jnp.int32), axis=-1) - 1  # (S, N1)
+        positions = base[:, None] + depth_vec  # siblings SHARE positions
+        x = model.embedding(params["embedding"], tokens)  # (S, N1, H)
+        ptab = params["pos_embedding"]
+        x = x + jnp.take(ptab, jnp.minimum(positions, ptab.shape[0] - 1),
+                         axis=0)
+        tables = tables.astype(jnp.int32)
+        scale = 1.0 / d ** 0.5
+        js = jnp.arange(max_s, dtype=jnp.int32)
+        # committed rows only — the root's own k/v rides the TREE part
+        # (node 0), not the cache, until the verdict commits it
+        cache_mask = js[None, None, None, None, :] \
+            < base[:, None, None, None, None]
+        tree_mask = (anc != 0)[:, None, None]  # (S, 1, 1, N1, N1)
+        ck, cv = pool["k"], pool["v"]
+        ks, vs = pool.get("k_scale"), pool.get("v_scale")
+        tks, tvs = [], []
+        for i in range(c.num_layers):
+            layer = jax.tree.map(lambda a_, i=i: a_[i], params["layers"])
+            h_in = fused_layer_norm(x, layer["ln1_w"], layer["ln1_b"])
+            q, k, v = model._proj_qkv_bshd(layer, h_in)  # (S, N1, h, d)
+            tks.append(k)
+            tvs.append(v)
+            # N1 queries per slot × the slot's gathered padded cache —
+            # the chain round's gather, minus the pre-verdict scatter
+            if self.quantized:
+                k_all = (ck[i][tables].astype(jnp.float32)
+                         * ks[i][tables][:, :, None, :, None])
+                v_all = (cv[i][tables].astype(jnp.float32)
+                         * vs[i][tables][:, :, None, :, None])
+            else:
+                k_all, v_all = ck[i][tables], cv[i][tables]
+            k_all = k_all.transpose(0, 2, 1, 3, 4) \
+                .reshape(S, h_kv, max_s, d)
+            v_all = v_all.transpose(0, 2, 1, 3, 4) \
+                .reshape(S, h_kv, max_s, d)
+            qg = q.reshape(S, N1, h_kv, group, d).transpose(0, 2, 3, 1, 4)
+            s_c = jnp.einsum("bhgcd,bhsd->bhgcs", qg,
+                             k_all.astype(qg.dtype),
+                             preferred_element_type=jnp.float32) * scale
+            s_c = jnp.where(cache_mask, s_c, NEG_INF)
+            kt = k.transpose(0, 2, 1, 3)  # (S, h_kv, N1, d)
+            vt = v.transpose(0, 2, 1, 3)
+            s_t = jnp.einsum("bhgcd,bhnd->bhgcn", qg, kt.astype(qg.dtype),
+                             preferred_element_type=jnp.float32) * scale
+            s_t = jnp.where(tree_mask, s_t, NEG_INF)
+            # ONE softmax across cache + tree keys — exactly the
+            # distribution the committed-path decode would compute
+            p = jax.nn.softmax(jnp.concatenate([s_c, s_t], axis=-1),
+                               axis=-1)
+            p_c, p_t = p[..., :max_s], p[..., max_s:]
+            ctx = jnp.einsum("bhgcs,bhsd->bhgcd", p_c.astype(v_all.dtype),
+                             v_all) \
+                + jnp.einsum("bhgcn,bhnd->bhgcd", p_t.astype(vt.dtype), vt)
+            ctx = ctx.transpose(0, 3, 1, 2, 4).reshape(S, N1,
+                                                       c.local_heads, d)
+            x = x + model._proj_attn_out(layer, ctx)
+            x = x + model._mlp(layer, fused_layer_norm(
+                x, layer["ln2_w"], layer["ln2_b"]))
+        x = fused_layer_norm(x, params["lnf_w"], params["lnf_b"])
+        logits = model.unembed(params, x)  # (S, N1, V)
+        a, j_star, nxt = fused_verify_tree(
+            logits, tokens, parents, anc, key,
+            temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p)
+        # commit the winning path: level l of j_star's root path (root =
+        # level 0 = the pending token) lands at pool row base + l; levels
+        # past accept_len — and dead slots — redirect to the dead block
+        ii = jnp.arange(N1, dtype=jnp.int32)
+        onpath = jnp.einsum(
+            "si,sin->sn",
+            (ii[None] == j_star[:, None]).astype(jnp.float32),
+            anc.astype(jnp.float32))  # (S, N1)
+        lvl = onpath[:, None, :] * (
+            depth_vec[:, None, :] == levels[None, :, None]
+        ).astype(jnp.float32)  # (S, depth+1, N1)
+        wpos = base[:, None] + levels[None, :]  # (S, depth+1)
+        valid = (levels[None, :] <= a[:, None]) & (lengths[:, None] > 0)
+        bid = jnp.take_along_axis(tables, wpos // B, axis=1)
+        bid = jnp.where(valid, bid, DEAD_BLOCK)
+        row = wpos % B
+        for i in range(c.num_layers):
+            sel_k = jnp.einsum("bln,bnhd->blhd",
+                               lvl.astype(tks[i].dtype), tks[i])
+            sel_v = jnp.einsum("bln,bnhd->blhd",
+                               lvl.astype(tvs[i].dtype), tvs[i])
+            if self.quantized:
+                kq, ksc = _quant_rows(sel_k, (2, 3),  # scales (S, depth+1)
+                                      qmax=self._qmax, qdtype=self._qdtype)
+                vq, vsc = _quant_rows(sel_v, (2, 3),
+                                      qmax=self._qmax, qdtype=self._qdtype)
+                ck = ck.at[i, bid, :, row].set(kq)
+                cv = cv.at[i, bid, :, row].set(vq)
+                ks = ks.at[i, bid, row].set(ksc)
+                vs = vs.at[i, bid, row].set(vsc)
+            else:
+                ck = ck.at[i, bid, :, row].set(sel_k.astype(ck.dtype))
+                cv = cv.at[i, bid, :, row].set(sel_v.astype(cv.dtype))
+        return self._pool_out(ck, cv, ks, vs), a, j_star, nxt
 
     # --- tensor-parallel step bodies (plan.tp >= 2) --------------------------
     #
@@ -1008,7 +1211,8 @@ class ServingEngine:
               key: Optional[jax.Array] = None,
               clock: Optional[Callable[[], float]] = None,
               scheduler: Optional[Scheduler] = None,
-              telemetry=None, draft=None, pool=None) -> List[Request]:
+              telemetry=None, draft=None, adaptive=None,
+              pool=None) -> List[Request]:
         """Run ``requests`` to completion; returns them in completion
         order with tokens and latency stamps filled in.
 
@@ -1042,6 +1246,17 @@ class ServingEngine:
         ``draft=None`` across arbitrary churn; acceptance is accounted
         in ``last_stats`` and per-round ``spec`` lifecycle events.
 
+        A TREE drafter (``is_tree_drafter(draft)``: ``propose_tree``
+        plus static ``depth``/``branching``) upgrades the round to the
+        tree-verify step; per round the loop degrades tree → chain →
+        plain on row or drafter-pool headroom (every rung is a
+        pre-compiled program — the ladder never stalls and never
+        retraces). A :class:`~apex_tpu.spec.tree.PagedModelDrafter` is
+        bound to the scheduler's allocator here, so its KV blocks live
+        in THIS pool's accounting. ``adaptive`` (an
+        :class:`~apex_tpu.spec.tree.AdaptiveSpecController`) re-picks
+        the round's (depth, branching) from its static choice set.
+
         ``pool`` injects a pre-populated block pool (the disaggregated
         decode role: :func:`~apex_tpu.serving.disagg.ingest_handoff`
         streamed prefilled KV blocks into it); it must have been
@@ -1071,6 +1286,14 @@ class ServingEngine:
                     "the rejection-sampling draw — serve greedy "
                     "(temperature=0.0) or with plan.tp=1")
             from apex_tpu.spec.drafter import validate_drafter
+            from apex_tpu.spec.tree import is_tree_drafter
+            if is_tree_drafter(draft) and self.tp > 1:
+                raise ValueError(
+                    f"serve(draft=<tree drafter>) is unsupported under "
+                    f"plan.tp={self.tp}: the tree-verify step has no "
+                    f"sharded twin — serve tree drafts at tp=1, or use "
+                    f"a chain drafter (which verifies through the tp "
+                    f"spec step)")
             # eager, knob-naming validation: vocab/block_size/k/cache
             # bounds fail HERE, not as an XLA error three rounds in.
             # max_s rows suffice for the drafter: spec rounds only run
@@ -1079,6 +1302,27 @@ class ServingEngine:
             # exceeds max_s - k tokens
             validate_drafter(draft, self.config, needed_rows=self.max_s,
                              block_size=self.block_size)
+        if adaptive is not None:
+            from apex_tpu.spec.tree import is_tree_drafter
+            if draft is None:
+                raise ValueError(
+                    "serve(adaptive=...) needs a drafter: the controller "
+                    "picks the DRAFT shape per round — pass draft= a "
+                    "tree drafter alongside it")
+            if not is_tree_drafter(draft):
+                raise ValueError(
+                    "serve(adaptive=...) needs a TREE drafter (one with "
+                    "propose_tree + static depth/branching): the "
+                    "controller's choices are (depth, branching) tree "
+                    "shapes — NGramTreeDrafter / PagedModelDrafter")
+            for dd, _ in adaptive.choices:
+                if dd > draft.depth:
+                    raise ValueError(
+                        f"adaptive choice set reaches depth {dd} but the "
+                        f"drafter's static depth is {draft.depth} — the "
+                        f"drafter cannot draft deeper than it was built "
+                        f"for; shrink the choice set or deepen the "
+                        f"drafter")
         if key is None:  # greedy: the key operand is ignored but keeps
             # the step signature (and avals) fixed
             key = jax.random.PRNGKey(0)  # apexlint: disable=APX502
@@ -1087,6 +1331,18 @@ class ServingEngine:
         t0 = clock()
         now = lambda: clock() - t0  # noqa: E731
         sched = scheduler if scheduler is not None else self.make_scheduler()
+        if draft is not None and hasattr(draft, "bind"):
+            # a paged drafter joins THIS scheduler's block economy: its
+            # KV blocks come from the same allocator/refcount ledger the
+            # target streams use (check_accounting() covers them), and
+            # bind wires scheduler.draft_owner so preemption/finish
+            # evict drafter blocks through the same path. Re-validate
+            # after: bind sets cache_rows (the drafter-geometry cap),
+            # which the pre-bind pass could not see
+            from apex_tpu.spec.drafter import validate_drafter
+            draft.bind(sched, block_size=self.block_size)
+            validate_drafter(draft, self.config, needed_rows=self.max_s,
+                             block_size=self.block_size)
         tel = telemetry
         if tel is False:  # explicit opt-out beats auto-attachment AND
             # any tracker a reused scheduler still carries — a timed
@@ -1153,7 +1409,7 @@ class ServingEngine:
                     monitor_trace.trace_context(
                         monitor_trace.new_trace_id("serve")):
                 self._serve_loop(params, key, sched, tel, stats, now,
-                                 wall, pool, draft)
+                                 wall, pool, draft, adaptive)
         finally:
             # a deferred swap this run never applied does NOT survive
             # into a later serve() call — clean return OR mid-run
@@ -1165,10 +1421,15 @@ class ServingEngine:
         return sched.completed
 
     def _serve_loop(self, params, key, sched, tel, stats, now, wall, pool,
-                    draft=None):
+                    draft=None, adaptive=None):
         nstep = 0
         policy = sched.policy
         K = draft.k if draft is not None else 0
+        if draft is not None:
+            from apex_tpu.spec.tree import draft_tree, is_tree_drafter
+            tree_capable = is_tree_drafter(draft)
+        else:
+            tree_capable = False
         ncompleted = len(sched.completed)
         while not sched.idle():
             # weight hot-swap lands HERE, between dispatch steps: a
@@ -1203,18 +1464,108 @@ class ServingEngine:
                 stats.prefill_chunks += 1
                 sched.note_prefill(work, tok, now())
                 did_work = True
-            # speculative rounds replace plain decode whenever EVERY
-            # decoding slot has k+1 rows of headroom (host-side choice:
-            # both branches are pre-compiled programs, never a retrace)
-            use_spec = False
+            # the speculative mode ladder, re-picked per round: tree →
+            # chain → plain, stepping DOWN on row headroom (every rung
+            # is a pre-compiled program — a host-side choice, never a
+            # retrace, never a stall). The tree rung needs depth+1 rows
+            # of slot headroom, the chain rung k+1
+            mode, shape = "plain", None
             if draft is not None:
                 dec = sched.decoding_slots()
-                use_spec = bool(dec) and all(
-                    sched.slot_length(i) + K + 1 <= self.max_s
-                    for i in dec)
-            batch = sched.decode_batch(now(),
-                                       lookahead=K if use_spec else 0)
-            if batch is not None and use_spec:
+                if dec and tree_capable:
+                    shape = (adaptive.round_shape(
+                        [sched.slot_rid(i) for i in dec])
+                        if adaptive is not None
+                        else (draft.depth, draft.branching))
+                    if all(sched.slot_length(i) + shape[0] + 1
+                           <= self.max_s for i in dec):
+                        mode = "tree"
+                if mode == "plain" and dec and all(
+                        sched.slot_length(i) + K + 1 <= self.max_s
+                        for i in dec):
+                    mode = "chain"
+                    if tree_capable:
+                        stats.spec_degraded += 1
+            lookahead = (shape[0] if mode == "tree"
+                         else K if mode == "chain" else 0)
+            batch = sched.decode_batch(now(), lookahead=lookahead)
+            # drafter-pool headroom comes AFTER decode_batch — it can
+            # preempt (changing both the live set and the free count).
+            # A short pool degrades the round down the same ladder:
+            # blocks already reserved for the wider lookahead stay
+            # assigned to their slots (reused as the stream grows —
+            # never leaked), and the drafter allocates nothing
+            if batch is not None and mode != "plain" \
+                    and hasattr(draft, "round_blocks_needed"):
+                while mode != "plain":
+                    d_rows = shape[0] if mode == "tree" else K
+                    need = sum(
+                        draft.round_blocks_needed(
+                            sched.slot_rid(i),
+                            len(sched.slot_context(i)), depth=d_rows)
+                        for i in sched.decoding_slots())
+                    if need <= sched.allocator.num_free:
+                        break
+                    mode = "chain" if mode == "tree" else "plain"
+                    stats.spec_degraded += 1
+            if batch is not None and mode == "tree":
+                toks, lens = batch
+                depth, branching = shape
+                tree = draft_tree(branching, depth)
+                live = [i for i in range(self.num_slots) if lens[i] > 0]
+                node_toks = np.zeros((self.num_slots, tree.num_nodes),
+                                     np.int32)
+                rids = {}
+                for i in live:
+                    rids[i] = sched.slot_rid(i)
+                    node_toks[i] = draft.propose_tree(
+                        rids[i], sched.slot_context(i),
+                        shape=(depth, branching))
+                tok_mat = np.zeros((self.num_slots, tree.n1), np.int32)
+                tok_mat[:, 0] = toks
+                tok_mat[:, 1:] = node_toks
+                # topology operands ship as CONTENTS (uniform over the
+                # slot array, dead rows ignored by the host): the
+                # executable is pinned per (num_nodes+1, depth+1)
+                parents, anc = tree.operands(self.num_slots)
+                levels = np.arange(depth + 1, dtype=np.int32)
+                sched.note_step(nstep)
+                t_dispatch = now()
+                pool, acc, jst, nxt = self.spec_tree_step(
+                    params, pool, jnp.asarray(sched.tables.asarray()),
+                    jnp.asarray(tok_mat), jnp.asarray(lens),
+                    jnp.asarray(parents), jnp.asarray(anc),
+                    jnp.asarray(levels), jax.random.fold_in(key, nstep))
+                acc = np.asarray(acc)  # blocks: the round really ran
+                jst = np.asarray(jst)
+                nxt = np.asarray(nxt)
+                round_dur = now() - t_dispatch
+                if tel is not None:
+                    tel.on_decode_step(round_dur, len(live), nstep, now())
+                nstep += 1
+                stats.decode_steps += 1
+                stats.spec_rounds += 1
+                stats.tree_rounds += 1
+                stats.occupancy_samples.append(len(live))
+                emitted = {}
+                for i in live:
+                    a = int(acc[i])
+                    emitted[i] = tree.path_tokens(node_toks[i], a,
+                                                  int(jst[i]), int(nxt[i]))
+                    stats.spec_drafted += depth
+                    stats.spec_accepted += a
+                    stats.spec_nodes += tree.num_nodes
+                    stats.spec_slot_rounds += 1
+                    if tel is not None:
+                        tel.on_spec_round(rids[i], i, a, depth, nstep - 1,
+                                          now(), dur_ms=round_dur * 1e3,
+                                          nodes=tree.num_nodes,
+                                          branching=branching)
+                    if adaptive is not None:
+                        adaptive.note_round(rids[i], a, depth)
+                sched.note_spec_tokens(emitted, now())
+                did_work = True
+            elif batch is not None and mode == "chain":
                 toks, lens = batch
                 live = [i for i in range(self.num_slots) if lens[i] > 0]
                 # drafts come from the host drafter per stream; the
@@ -1248,6 +1599,12 @@ class ServingEngine:
                     a = int(acc[i])
                     stats.spec_drafted += K
                     stats.spec_accepted += a
+                    stats.spec_nodes += K
+                    stats.spec_slot_rounds += 1
+                    if adaptive is not None:
+                        # a degraded (chain) round still teaches the
+                        # controller — acceptance over k chain rows
+                        adaptive.note_round(rids[i], a, K)
                     if tel is not None:
                         # the round's full wall time for EVERY live slot
                         # (concurrent wall time — what a per-request e2e
@@ -1279,6 +1636,8 @@ class ServingEngine:
                 # by CONCURRENT streams, not request history)
                 for r in sched.completed[ncompleted:]:
                     draft.release(r.rid)
+                    if adaptive is not None:
+                        adaptive.release(r.rid)
                 ncompleted = len(sched.completed)
             stats.blocks_high_water = max(stats.blocks_high_water,
                                           sched.allocator.num_live)

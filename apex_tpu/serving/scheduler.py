@@ -61,7 +61,7 @@ from __future__ import annotations
 
 import dataclasses
 from collections import deque
-from typing import Deque, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -247,6 +247,11 @@ class Scheduler:
         # serving-tier-2 counters (surfaced on windows + the record)
         self.preemptions = 0
         self.recompute_tokens = 0
+        # a paged drafter sharing this scheduler's allocator (set by
+        # PagedModelDrafter.bind): its per-stream blocks free through
+        # the SAME preempt/finish paths as the stream's target blocks,
+        # so a preempted stream's drafter state rewinds with it
+        self.draft_owner = None
         # the engine step index of the dispatch currently noted; the
         # telemetry stamps it on lifecycle records so they join to the
         # serve_prefill/serve_decode device-trace scopes by step
@@ -576,28 +581,40 @@ class Scheduler:
 
     def note_spec(self, drafted: np.ndarray, accepted: np.ndarray,
                   next_tokens: np.ndarray, now: float) -> List[Request]:
-        """Record one speculative round: per decoding slot, emit the
-        accepted draft prefix plus the corrected token (capped at the
-        request's remaining budget) and REWIND the block tables to the
-        accepted frontier — blocks the round reserved past
-        ``blocks_needed(new length)`` free in reverse-allocation order
-        (the LIFO free list is restored exactly; the worst case, an
-        all-rejected round, leaves tables/lengths/free-list as a plain
-        decode step would have) and their table entries reset to the
-        dead block. Contents-only mutation throughout: the device
-        programs never see an aval change. Inter-token latency is
-        amortized over the round's emissions (the k+1 tokens of a round
-        arrive in one dispatch). Returns requests finished by the
-        round."""
+        """Record one CHAIN speculative round: per decoding slot, the
+        accepted draft prefix plus the corrected token. The commit and
+        rewind live in :meth:`note_spec_tokens` — this wrapper only
+        turns the chain verdict (a per-slot accept LENGTH) into the
+        emitted token lists; the tree path turns its accepted-path mask
+        into the same shape and shares the rest verbatim."""
+        emitted = {}
+        for i in self.decoding_slots():
+            a = int(accepted[i])
+            emitted[i] = [int(t) for t in drafted[i][:a]] \
+                + [int(next_tokens[i])]
+        return self.note_spec_tokens(emitted, now)
+
+    def note_spec_tokens(self, emitted_by_slot: Dict[int, List[int]],
+                         now: float) -> List[Request]:
+        """Commit one speculative round's emissions (any acceptance
+        pattern — a chain prefix or a tree path, already resolved to
+        per-slot token lists) capped at each request's remaining
+        budget, and REWIND the block tables to the accepted frontier —
+        blocks the round reserved past ``blocks_needed(new length)``
+        free in reverse-allocation order (the LIFO free list is
+        restored exactly; the worst case, an all-rejected round, leaves
+        tables/lengths/free-list as a plain decode step would have) and
+        their table entries reset to the dead block. Contents-only
+        mutation throughout: the device programs never see an aval
+        change. Inter-token latency is amortized over the round's
+        emissions (a round's tokens arrive in one dispatch). Returns
+        requests finished by the round."""
         tel = self.telemetry
         finished = []
         B = self.block_size
-        for i in self.decoding_slots():
+        for i, emitted in emitted_by_slot.items():
             slot = self._slots[i]
             req = slot.request
-            a = int(accepted[i])
-            emitted = [int(t) for t in drafted[i][:a]] \
-                + [int(next_tokens[i])]
             emitted = emitted[:req.max_new_tokens - slot.generated]
             m = len(emitted)
             if tel is not None and req.token_s:
@@ -651,6 +668,10 @@ class Scheduler:
         if tel is not None:  # blocks_held captured BEFORE they free
             tel.on_finish(req, i, slot.n_blocks, self._step, now)
         self.allocator.free(slot.block_ids)
+        if self.draft_owner is not None:
+            # the stream's drafter blocks free through the same path —
+            # one eviction economy for target and drafter state
+            self.draft_owner.evict_stream(req.rid)
         self.tables.clear(i)
         self._slots[i] = _Slot()
         self._admit_order.remove(i)
@@ -677,6 +698,11 @@ class Scheduler:
             tel.on_evict(req, i, slot.n_blocks, reason, 0, self._step,
                          now)
         self.allocator.free(slot.block_ids)
+        if self.draft_owner is not None:
+            # preemption rewinds the stream's drafter state through the
+            # identical path: its shared-pool blocks free here and the
+            # drafter's frontier rebuilds by replay on re-admission
+            self.draft_owner.evict_stream(req.rid)
         self.tables.clear(i)
         self._slots[i] = _Slot()
         self._admit_order.remove(i)

@@ -191,6 +191,10 @@ class ServeTelemetry:
         self.spec_drafted = 0
         self.spec_accepted = 0
         self.draft_k = 0
+        # tree speculative rounds (ISSUE 19): verify rows actually
+        # scored (chain rounds count k) and how many rounds were trees
+        self.spec_nodes = 0
+        self.spec_tree_rounds = 0
         # the engine stamps its pool-quantization knob here at serve
         # start so the record names the pool it measured
         self.kv_dtype: Optional[str] = None
@@ -332,7 +336,9 @@ class ServeTelemetry:
 
     def on_spec_round(self, rid: int, slot: int, accepted: int, k: int,
                       step: int, now: float,
-                      dur_ms: Optional[float] = None) -> None:
+                      dur_ms: Optional[float] = None,
+                      nodes: Optional[int] = None,
+                      branching: Optional[int] = None) -> None:
         """One slot's speculative round: ``accepted`` of ``k`` drafted
         tokens survived verification (the round emitted
         ``accepted + 1`` tokens up to the request's budget). Feeds the
@@ -341,16 +347,29 @@ class ServeTelemetry:
         value for every live slot of the round — concurrent wall time,
         which is what a per-request e2e partition must bill); an
         all-rejected round (``accepted == 0``) is attributed to
-        ``spec_rewind_ms``, the others to ``spec_ms``."""
+        ``spec_rewind_ms``, the others to ``spec_ms``. A TREE round
+        additionally passes ``nodes`` (verify rows scored, branching x
+        depth) and ``branching`` — ``k`` is then the tree DEPTH, so the
+        acceptance accounting stays chain-comparable while the record
+        still prices the wider verify."""
         t = _mono()
         self.spec_slot_rounds += 1
         self.spec_drafted += k
         self.spec_accepted += accepted
         self.draft_k = k
+        if nodes is not None:
+            self.spec_tree_rounds += 1
+            self.spec_nodes += nodes
+        else:
+            self.spec_nodes += k
         fields = dict(rid=rid, phase="spec", at_s=now,
                       slot=int(slot), step=int(step),
                       accepted_len=int(accepted), draft_k=int(k),
                       **self._tid(self._inflight.get(rid)))
+        if nodes is not None:
+            fields["tree_nodes"] = int(nodes)
+        if branching is not None:
+            fields["tree_branching"] = int(branching)
         if dur_ms is not None:
             fields["dur_ms"] = round(float(dur_ms), 3)
         self._emit("serve_event", **fields)

@@ -45,3 +45,11 @@ from apex_tpu.spec.drafter import (  # noqa: F401
     NGramDrafter,
     validate_drafter,
 )
+from apex_tpu.spec.tree import (  # noqa: F401
+    AdaptiveSpecController,
+    DraftTree,
+    NGramTreeDrafter,
+    PagedModelDrafter,
+    draft_tree,
+    is_tree_drafter,
+)

@@ -116,6 +116,32 @@ def validate_drafter(draft: Drafter, config, *, needed_rows: int,
             f"drafter cache holds {rows} rows but a spec round can "
             f"touch {needed_rows} (prompt + max_new_tokens + k) — "
             f"raise the drafter's max_seq_len to >= {needed_rows}")
+    depth = getattr(draft, "depth", None)
+    branching = getattr(draft, "branching", None)
+    if depth is not None and branching is not None:
+        depth, branching = int(depth), int(branching)
+        nodes = depth * branching
+        if nodes > MAX_DRAFT_K:
+            raise ValueError(
+                f"draft tree ({branching} branches x depth {depth} = "
+                f"{nodes} nodes) exceeds MAX_DRAFT_K={MAX_DRAFT_K} "
+                f"verify rows — shrink branching or depth so "
+                f"branching x depth <= {MAX_DRAFT_K}")
+        if depth + 1 > needed_rows:
+            raise ValueError(
+                f"draft tree depth ({depth}) + 1 bonus row exceeds the "
+                f"per-slot row cap ({needed_rows}) — even an empty slot "
+                f"cannot hold one tree round's writes; shrink the "
+                f"drafter's depth to <= {needed_rows - 1} or raise the "
+                f"engine's max_seq_len (rows round up to whole "
+                f"block_size blocks, so the cap is "
+                f"ceil(max_seq_len / block_size) x block_size)")
+        if not isinstance(getattr(draft, "chain_k", k), int) \
+                or not 1 <= getattr(draft, "chain_k", k) <= depth:
+            raise ValueError(
+                f"tree drafter chain_k must be an int in [1, depth="
+                f"{depth}] (the chain-fallback rung cannot draft deeper "
+                f"than the tree); got {getattr(draft, 'chain_k', k)!r}")
     return k
 
 

@@ -1,0 +1,530 @@
+"""Tree speculative decoding — the drafter side: static draft-tree
+topologies, tree-capable drafters, the paged-pool model drafter, and
+the acceptance-adaptive (k, b) controller.
+
+Chain speculation (PR 15) accepts ONE prefix per round: a single early
+mismatch discards the whole tail, so the measured acceptance rate is a
+hard ceiling on tokens/s/request. A draft TREE hedges the first —
+highest-entropy — positions: ``branching`` alternative first tokens,
+each continued ``depth`` tokens deep, are all scored by the target in
+ONE batched forward (the per-round launch/HBM cost amortizes across
+every branch — arXiv:2502.17728's fusion argument, wider), and the
+fused tree verify (:func:`apex_tpu.ops.fused_verify_tree`) emits the
+DEEPEST fully-accepted root path plus a bonus/corrected token.
+
+Everything here is host-side and static-shaped:
+
+* :class:`DraftTree` — a fixed topology per ``(branching, depth)``:
+  parent pointers, the ancestor-or-self closure (the verify kernel's
+  walk operand AND the tree-attention mask, precomputed once — it
+  ships as constant operand CONTENTS, so the zero-recompile contract
+  holds across rounds), and the host path walk that turns a verify
+  verdict back into emitted tokens. One compiled program per
+  ``(branching, depth)`` in use; the instances are cached.
+* :class:`NGramTreeDrafter` — the n-gram drafter, branching on TIE
+  FREQUENCY: where several tokens followed the same context window,
+  the runner-ups seed the extra branches (exactly the positions where
+  a single chain guess is most likely wrong).
+* :class:`PagedModelDrafter` — the model drafter with its KV moved
+  into the SHARED paged-pool economy: blocks come from the serving
+  scheduler's own :class:`~apex_tpu.serving.kv_blocks.BlockAllocator`
+  (same refcount ledger, visible in ``check_accounting()``/pool
+  telemetry), and a preempted stream's drafter blocks free through
+  the identical eviction path.
+* :class:`AdaptiveSpecController` — per-stream windowed acceptance →
+  a (depth, branching) choice from a small STATIC set (one compiled
+  program per choice, caches pinned): a hard stream stops wasting
+  draft compute, an easy stream drafts deeper (the AMP move,
+  arXiv:2210.07297 — a tunable knob priced per stream instead of
+  frozen).
+
+The device side lives in the engines (``DecodeEngine.generate(...,
+draft=<tree drafter>)`` and ``ServingEngine.serve`` — which degrades
+tree→chain→plain per round on headroom, never stalls); see
+``docs/api/inference.md`` ("Tree speculative decoding").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from apex_tpu.monitor import spans as monitor_spans
+from apex_tpu.spec.drafter import MAX_DRAFT_K, Drafter
+
+__all__ = [
+    "DraftTree",
+    "draft_tree",
+    "NGramTreeDrafter",
+    "PagedModelDrafter",
+    "AdaptiveSpecController",
+    "is_tree_drafter",
+]
+
+
+class DraftTree:
+    """One STATIC draft-tree topology: ``branching`` root branches,
+    each a chain of ``depth`` nodes (the shape that hedges the
+    highest-entropy FIRST position while keeping the node count
+    linear; ``branching == 1`` is exactly the chain). Node 0 is the
+    committed pending token (the root); drafted node ``1 + b*depth +
+    l`` is branch ``b``'s level-``l`` token. All arrays are host
+    numpy, computed once and shipped as operand CONTENTS — the device
+    avals depend only on ``(branching, depth)``.
+    """
+
+    def __init__(self, branching: int, depth: int):
+        branching, depth = int(branching), int(depth)
+        if branching < 1 or depth < 1:
+            raise ValueError(
+                f"DraftTree needs branching >= 1 and depth >= 1; got "
+                f"branching={branching}, depth={depth}")
+        if branching * depth > MAX_DRAFT_K:
+            raise ValueError(
+                f"DraftTree ({branching} branches x depth {depth} = "
+                f"{branching * depth} nodes) exceeds MAX_DRAFT_K="
+                f"{MAX_DRAFT_K} verify rows — shrink branching or depth "
+                f"(branching x depth must be <= {MAX_DRAFT_K})")
+        self.branching = branching
+        self.depth = depth
+        self.num_nodes = branching * depth
+        self.n1 = self.num_nodes + 1
+        parents = np.zeros((self.n1,), np.int32)
+        for b in range(branching):
+            for lv in range(depth):
+                j = 1 + b * depth + lv
+                parents[j] = 0 if lv == 0 else j - 1
+        self.parents = parents
+        anc = np.zeros((self.n1, self.n1), np.int32)
+        anc[0, 0] = 1
+        for j in range(1, self.n1):
+            anc[j] = anc[parents[j]]
+            anc[j, j] = 1
+        self.anc = anc
+        self.depths = anc.sum(-1).astype(np.int32) - 1
+
+    def path(self, j_star: int) -> List[int]:
+        """Node indices of ``j_star``'s root path, root EXCLUDED,
+        shallow→deep — the drafted nodes a verify verdict accepted."""
+        out = []
+        j = int(j_star)
+        while j != 0:
+            out.append(j)
+            j = int(self.parents[j])
+        return out[::-1]
+
+    def path_tokens(self, node_tokens: Sequence[int], a: int,
+                    j_star: int, next_token: int) -> List[int]:
+        """The tokens one tree round emits: the accepted path's drafted
+        tokens (``node_tokens`` indexes drafted nodes only — entry
+        ``j - 1`` is node ``j``'s token) plus the bonus/corrected
+        token. ``a`` (the verify's accept length) must equal
+        ``j_star``'s depth — checked, because a mismatch means the
+        verdict and the topology disagree."""
+        nodes = self.path(int(j_star))
+        if len(nodes) != int(a):
+            raise ValueError(
+                f"verify verdict disagrees with the topology: j_star="
+                f"{j_star} has depth {len(nodes)} but accept_len={a}")
+        return [int(node_tokens[j - 1]) for j in nodes] + [int(next_token)]
+
+    def operands(self, batch: int) -> Tuple[np.ndarray, np.ndarray]:
+        """``(parents (batch, N+1), anc (batch, N+1, N+1))`` int32 —
+        the verify/attention operands, tiled over the slot array."""
+        return (np.tile(self.parents, (batch, 1)),
+                np.tile(self.anc, (batch, 1, 1)))
+
+
+@functools.lru_cache(maxsize=None)
+def draft_tree(branching: int, depth: int) -> DraftTree:
+    """The cached topology for ``(branching, depth)`` — one instance
+    (and downstream, one compiled program) per shape in use."""
+    return DraftTree(branching, depth)
+
+
+def is_tree_drafter(draft) -> bool:
+    """A drafter that can propose trees: it has ``propose_tree`` plus
+    the static ``depth``/``branching`` shape attributes."""
+    return (hasattr(draft, "propose_tree")
+            and getattr(draft, "depth", None) is not None
+            and getattr(draft, "branching", None) is not None)
+
+
+class NGramTreeDrafter(Drafter):
+    """N-gram drafter with TIE-FREQUENCY branching: per stream, an
+    order-``n`` table maps each context window to EVERY token observed
+    following it (with counts + recency). Branch 0 walks the top
+    candidate exactly like :class:`~apex_tpu.spec.drafter.
+    NGramDrafter`; branches 1.. seed from the runner-up candidates of
+    the FIRST position — the ties are precisely where a single chain
+    guess is most likely wrong, so that is where the tree hedges.
+    Windows with fewer candidates than branches repeat the top one
+    (a duplicate sibling wastes a verify row, never correctness).
+
+    ``chain_k`` (default ``depth``) is the CHAIN-fallback draft length
+    (``self.k``): near the row cap the engines degrade tree→chain, and
+    a ``chain_k < depth`` makes the chain rung strictly cheaper in
+    rows than the tree rung.
+    """
+
+    def __init__(self, depth: int = 4, branching: int = 2, n: int = 3,
+                 chain_k: Optional[int] = None):
+        draft_tree(branching, depth)  # eager shape validation
+        self.depth = int(depth)
+        self.branching = int(branching)
+        k = self.depth if chain_k is None else int(chain_k)
+        if not 1 <= k <= self.depth:
+            raise ValueError(
+                f"chain_k must be in [1, depth={self.depth}] (the chain "
+                f"fallback cannot draft deeper than the tree); got {k}")
+        self.k = self.chain_k = k
+        if int(n) < 1:
+            raise ValueError(f"NGramTreeDrafter n must be >= 1, got {n}")
+        self.n = int(n)
+        # stream -> (window -> token -> [count, last position], consumed)
+        self._streams: Dict[int, Any] = {}
+
+    @property
+    def tree(self) -> DraftTree:
+        return draft_tree(self.branching, self.depth)
+
+    def _table(self, stream: int, context: Sequence[int]):
+        n = self.n
+        table, consumed = self._streams.get(stream, (None, 0))
+        if table is None or consumed > len(context):
+            table, consumed = {}, 0  # fresh (or shrunk: a reused id)
+        ctx = [int(t) for t in context]
+        for i in range(max(consumed, n), len(ctx)):
+            stats = table.setdefault(tuple(ctx[i - n:i]), {})
+            cnt, _ = stats.get(ctx[i], (0, 0))
+            stats[ctx[i]] = (cnt + 1, i)
+        self._streams[stream] = (table, len(ctx))
+        return table, ctx
+
+    def _candidates(self, table, window: List[int]) -> List[int]:
+        """Tokens observed after ``window``, most-frequent first (ties
+        to most recent); fallback: repeat the last token."""
+        stats = table.get(tuple(window[-self.n:]), None)
+        if not stats:
+            return [window[-1]]
+        return [t for t, _ in sorted(
+            stats.items(), key=lambda kv: (-kv[1][0], -kv[1][1]))]
+
+    def _walk(self, table, window: List[int], steps: int) -> List[int]:
+        out = []
+        w = list(window)
+        for _ in range(steps):
+            guess = self._candidates(table, w)[0]
+            out.append(guess)
+            w.append(guess)
+        return out
+
+    def propose(self, stream: int, context: Sequence[int]) -> np.ndarray:
+        table, ctx = self._table(stream, context)
+        window = ctx[-self.n:] if len(ctx) >= self.n else ctx[:]
+        return np.asarray(self._walk(table, window, self.k), np.int32)
+
+    def propose_tree(self, stream: int, context: Sequence[int],
+                     shape: Optional[Tuple[int, int]] = None) -> np.ndarray:
+        """Node tokens for the :class:`DraftTree` topology, drafted-node
+        order (``shape=(depth, branching)`` overrides the static shape
+        — the adaptive controller's per-round choice)."""
+        depth, branching = shape or (self.depth, self.branching)
+        table, ctx = self._table(stream, context)
+        window = ctx[-self.n:] if len(ctx) >= self.n else ctx[:]
+        cands = self._candidates(table, window)
+        out = np.zeros((branching * depth,), np.int32)
+        for b in range(branching):
+            seed = cands[min(b, len(cands) - 1)]
+            chain = [seed] + self._walk(table, window + [seed], depth - 1)
+            out[b * depth:(b + 1) * depth] = chain
+        return out
+
+    def release(self, stream: int) -> None:
+        self._streams.pop(stream, None)
+
+    def reset(self) -> None:
+        self._streams.clear()
+
+
+class PagedModelDrafter(Drafter):
+    """A small-model drafter whose per-stream KV cache is FIRST-CLASS
+    paged-pool state: block ids come from the serving scheduler's own
+    :class:`~apex_tpu.serving.kv_blocks.BlockAllocator` (the same
+    refcount ledger the target streams use), so drafter blocks are
+    visible in pool accounting (``check_accounting()`` stays exact
+    across churn), count against the same capacity, and free through
+    the identical eviction path — when the scheduler preempts a
+    stream, its drafter blocks rewind with it (``Scheduler`` calls
+    :meth:`evict_stream` from ``_preempt``/``_finish``), and the
+    resumed stream's context re-grows token-identically so the
+    ``consumed`` frontier rebuilds by replay.
+
+    The device side is ONE jitted paged decode step (an inner
+    batch-1 :class:`~apex_tpu.serving.ServingEngine` over a pool in
+    the DRAFTER's geometry but indexed by the SHARED block ids):
+    context rows teacher-force through it and branches draft greedily
+    from the frontier, re-seeding branch ``b`` from the frontier
+    logits' ``b``-th candidate — stable avals throughout, compiled
+    once across streams/rounds/churn. Scratch rows past the trusted
+    frontier are simply re-written next round (length masking IS the
+    rewind, as everywhere else).
+
+    :meth:`bind` wires the drafter to a scheduler; ``ServingEngine.
+    serve`` calls it. Standalone drives must bind first.
+    """
+
+    def __init__(self, model, params, *, depth: int = 4,
+                 branching: int = 2, chain_k: Optional[int] = None):
+        draft_tree(branching, depth)  # eager shape validation
+        self.depth = int(depth)
+        self.branching = int(branching)
+        k = self.depth if chain_k is None else int(chain_k)
+        if not 1 <= k <= self.depth:
+            raise ValueError(
+                f"chain_k must be in [1, depth={self.depth}] (the chain "
+                f"fallback cannot draft deeper than the tree); got {k}")
+        self.k = self.chain_k = k
+        self.model = model
+        self.params = params
+        self.vocab_size = int(model.config.vocab_size)
+        self.block_size: Optional[int] = None  # set at bind
+        self.cache_rows: Optional[int] = None  # set at bind
+        self._engine = None
+        self._pool = None
+        self._sched = None
+        self._alloc = None
+        self._key = None
+        # stream -> {"table": (max_blocks,) int32, "block_ids": [...],
+        #            "n_blocks": int, "consumed": int}
+        self._streams: Dict[int, Dict[str, Any]] = {}
+        # high-water of live drafter blocks in the SHARED pool (bench
+        # witness: the drafter really lives in the pool economy)
+        self.peak_blocks = 0
+
+    @property
+    def tree(self) -> DraftTree:
+        return draft_tree(self.branching, self.depth)
+
+    def bind(self, scheduler, *, block_size: int) -> None:
+        """Attach to ``scheduler``'s allocator (the shared ledger) and
+        build the inner paged engine + drafter-geometry pool sized to
+        the SAME block-id space. Rebinding to a different scheduler
+        first releases every stream's blocks against the old one."""
+        if self._sched is scheduler:
+            return
+        from apex_tpu.serving.engine import ServingEngine
+        self.reset()  # old blocks go back to the OLD allocator
+        self._sched = scheduler
+        self._alloc = scheduler.allocator
+        self.block_size = int(block_size)
+        self._engine = ServingEngine(
+            self.model, num_slots=1, block_size=self.block_size,
+            prefill_chunk=self.block_size,
+            num_blocks=self._alloc.num_blocks)
+        self._pool = self._engine.init_pool()
+        self.cache_rows = self._engine.max_s
+        scheduler.draft_owner = self
+
+    def _require_bound(self):
+        if self._alloc is None:
+            raise ValueError(
+                "PagedModelDrafter is not bound to a scheduler — its KV "
+                "blocks live in the shared pool, so call bind(scheduler, "
+                "block_size=...) first (ServingEngine.serve does this "
+                "for you)")
+
+    def _ensure_rows(self, st: Dict[str, Any], rows: int) -> None:
+        from apex_tpu.serving.kv_blocks import blocks_needed
+        need = blocks_needed(rows, self.block_size) - st["n_blocks"]
+        if need <= 0:
+            return
+        if need > self._alloc.num_free:
+            raise RuntimeError(
+                f"drafter needs {need} pool block(s) with "
+                f"{self._alloc.num_free} free — the serve loop's "
+                f"headroom check (round_blocks_needed) should have "
+                f"degraded this round to chain/plain decode first")
+        for bid in self._alloc.allocate(need):
+            st["table"][st["n_blocks"]] = bid
+            st["block_ids"].append(bid)
+            st["n_blocks"] += 1
+        self.peak_blocks = max(self.peak_blocks, self.pool_blocks())
+
+    def round_blocks_needed(self, stream: int, context_len: int,
+                            depth: Optional[int] = None) -> int:
+        """Fresh pool blocks one tree round would allocate for this
+        stream (the serve loop's drafter-headroom check)."""
+        from apex_tpu.serving.kv_blocks import blocks_needed
+        self._require_bound()
+        st = self._streams.get(stream)
+        have = st["n_blocks"] if st is not None else 0
+        rows = int(context_len) - 1 + (self.depth if depth is None
+                                       else int(depth))
+        return max(0, blocks_needed(rows, self.block_size) - have)
+
+    def _step(self, st: Dict[str, Any], tok: int, pos: int):
+        import jax
+        import jax.numpy as jnp
+
+        if self._key is None:
+            self._key = jax.random.PRNGKey(0)  # apexlint: disable=APX502
+        self._pool, toks, logits = self._engine.decode_step(
+            self.params, self._pool,
+            jnp.asarray(st["table"][None, :]),
+            jnp.asarray([tok], jnp.int32),
+            jnp.asarray([pos + 1], jnp.int32), self._key)
+        return np.asarray(logits)[0]
+
+    def propose(self, stream: int, context: Sequence[int]) -> np.ndarray:
+        return self.propose_tree(stream, context, shape=(self.k, 1))
+
+    def propose_tree(self, stream: int, context: Sequence[int],
+                     shape: Optional[Tuple[int, int]] = None) -> np.ndarray:
+        from apex_tpu.serving.kv_blocks import DEAD_BLOCK
+        self._require_bound()
+        depth, branching = shape or (self.depth, self.branching)
+        st = self._streams.get(stream)
+        if st is None or st["consumed"] > len(context):
+            if st is not None:  # shrunk context: a reused stream id
+                self.evict_stream(stream)
+            st = {"table": np.full((self._engine.max_blocks_per_slot,),
+                                   DEAD_BLOCK, np.int32),
+                  "block_ids": [], "n_blocks": 0, "consumed": 0}
+        ctx = [int(t) for t in context]
+        rows = len(ctx) - 1 + depth
+        if rows > self.cache_rows:
+            raise ValueError(
+                f"PagedModelDrafter cache ({self.cache_rows} rows) "
+                f"cannot hold context ({len(ctx)}) - 1 + depth "
+                f"({depth}) draft rows — raise the drafter model's "
+                f"max_seq_len (the engines validate this bound at "
+                f"wiring time)")
+        # register BEFORE allocating so the peak_blocks high-water in
+        # _ensure_rows (which reads pool_blocks()) counts this stream's
+        # own fresh blocks, not just the other live streams'
+        self._streams[stream] = st
+        self._ensure_rows(st, rows)
+        consumed = st["consumed"]
+        with monitor_spans.span("spec_draft", stream=int(stream)):
+            # teacher-force the unconsumed context rows
+            for i in range(consumed, len(ctx) - 1):
+                self._step(st, ctx[i], i)
+            # the frontier row (+ its logits, which seed every branch)
+            frontier = self._step(st, ctx[-1], len(ctx) - 1)
+            order = np.argsort(-frontier, kind="stable")
+            out = np.zeros((branching * depth,), np.int32)
+            V = frontier.shape[-1]
+            for b in range(branching):
+                tok = int(order[min(b, V - 1)])
+                out[b * depth] = tok
+                # continue this branch greedily; its tokens overwrite
+                # the scratch rows the previous branch used
+                for lv in range(1, depth):
+                    logits = self._step(st, tok, len(ctx) - 1 + lv)
+                    tok = int(np.argmax(logits))
+                    out[b * depth + lv] = tok
+        st["consumed"] = len(ctx)
+        self._streams[stream] = st
+        return out
+
+    def evict_stream(self, stream: int) -> None:
+        """Free the stream's drafter blocks through the shared
+        allocator — the scheduler calls this from the SAME preempt/
+        finish paths that free the stream's target blocks."""
+        st = self._streams.pop(stream, None)
+        if st is not None and st["block_ids"]:
+            self._alloc.free(st["block_ids"])
+
+    def release(self, stream: int) -> None:
+        self.evict_stream(stream)
+
+    def reset(self) -> None:
+        for stream in list(self._streams):
+            self.evict_stream(stream)
+
+    def pool_blocks(self) -> int:
+        """Live drafter blocks in the shared pool (bench/telemetry)."""
+        return sum(st["n_blocks"] for st in self._streams.values())
+
+
+class AdaptiveSpecController:
+    """Per-stream acceptance-adaptive (depth, branching) choice from a
+    small STATIC set.
+
+    Each stream keeps a rolling window of its last ``window`` rounds'
+    (accepted, depth) pairs — fed from the same per-round numbers the
+    ``spec`` lifecycle events carry. When the windowed acceptance
+    fraction (accepted rows per drafted depth) exceeds ``hi`` the
+    stream steps UP the choice ladder (drafts deeper/wider); below
+    ``lo`` it steps DOWN; in between it holds (hysteresis — one
+    adjustment per full window, so a single lucky round never flaps
+    the program choice). ``choices`` must be ordered shallow→deep;
+    every entry is a compiled-program shape the engines pin, so the
+    set stays small by design.
+    """
+
+    def __init__(self, choices: Sequence[Tuple[int, int]] = (
+            (2, 1), (4, 1), (4, 2)), window: int = 6,
+            lo: float = 0.45, hi: float = 0.8):
+        if not choices:
+            raise ValueError("AdaptiveSpecController needs >= 1 choice")
+        for d, b in choices:
+            draft_tree(b, d)  # eager shape validation for every choice
+        self.choices = tuple((int(d), int(b)) for d, b in choices)
+        self.window = int(window)
+        self.lo, self.hi = float(lo), float(hi)
+        # stream -> {"idx": int, "hist": [(accepted, depth)...],
+        #            "since": rounds since last adjustment}
+        self._streams: Dict[int, Dict[str, Any]] = {}
+        self.adjustments = 0
+
+    def _state(self, stream: int) -> Dict[str, Any]:
+        st = self._streams.get(stream)
+        if st is None:
+            st = {"idx": 0, "hist": [], "since": 0}
+            self._streams[stream] = st
+        return st
+
+    def choice(self, stream: int) -> Tuple[int, int]:
+        """The stream's current (depth, branching)."""
+        return self.choices[self._state(stream)["idx"]]
+
+    def round_shape(self, streams: Sequence[int]) -> Tuple[int, int]:
+        """One shape for a batched round: the SHALLOWEST live stream's
+        choice (conservative — a deep program would waste every hard
+        stream's rows; the easy streams catch up when the hard ones
+        finish)."""
+        if not streams:
+            return self.choices[0]
+        idx = min(self._state(s)["idx"] for s in streams)
+        return self.choices[idx]
+
+    def note_round(self, stream: int, accepted: int, depth: int) -> None:
+        """Feed one round's verdict (the numbers ``on_spec_round``
+        gets) and maybe adjust the stream's choice."""
+        st = self._state(stream)
+        st["hist"].append((int(accepted), int(depth)))
+        if len(st["hist"]) > self.window:
+            st["hist"] = st["hist"][-self.window:]
+        st["since"] += 1
+        if len(st["hist"]) < self.window or st["since"] < self.window:
+            return
+        drafted = sum(d for _, d in st["hist"])
+        rate = sum(a for a, _ in st["hist"]) / max(drafted, 1)
+        if rate >= self.hi and st["idx"] < len(self.choices) - 1:
+            st["idx"] += 1
+            st["since"] = 0
+            self.adjustments += 1
+        elif rate <= self.lo and st["idx"] > 0:
+            st["idx"] -= 1
+            st["since"] = 0
+            self.adjustments += 1
+
+    def release(self, stream: int) -> None:
+        self._streams.pop(stream, None)
+
+    def reset(self) -> None:
+        self._streams.clear()

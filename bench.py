@@ -82,7 +82,12 @@ acceptance rate, the greedy/churn parity witnesses, and the int8 KV
 pool's teacher-forced logit error vs the float oracle — as one CLOSED
 ``spec`` monitor record (``tools/bench_history.py`` gates
 ``spec_tokens_per_s_request`` and the acceptance-rate series
-higher-is-better; same SKIP semantics off-TPU).
+higher-is-better; same SKIP semantics off-TPU). ``--spec --tree`` adds
+the tree-speculation leg (:func:`_spec_tree_leg`): fused tree verify at
+batch 1 and under churn with the drafter's KV in the SHARED paged pool,
+peak drafter pool blocks, and the adaptive-vs-fixed (depth, branching)
+witness on a recorded bimodal acceptance trace — the ``tree_spec_*``
+series gate the same way.
 """
 
 import json
@@ -841,7 +846,7 @@ def tp_serve_main(argv):
     print(json.dumps(record))
 
 
-def spec_main():
+def spec_main(tree=False):
     """``python bench.py --spec`` — the speculative-decoding +
     quantized-KV leg (ROADMAP item 3, both factors of the decode-
     bandwidth attack in one artifact):
@@ -862,6 +867,12 @@ def spec_main():
       on identical contexts so the reported ``kv_quant_logit_err`` is
       a per-position bound, not a divergence artifact; pool footprints
       for both ride along.
+
+    With ``--tree`` the record additionally carries the TREE-speculation
+    leg (:func:`_spec_tree_leg`): fused tree verify at batch 1 and under
+    churn with the small-model drafter's KV in the SHARED paged pool,
+    plus the adaptive-vs-fixed (depth, branching) witness on a recorded
+    bimodal acceptance trace.
 
     Emits ONE schema-validated ``spec`` record (a CLOSED schema — junk
     keys fail) and prints it as one JSON line. On TPU the record is
@@ -977,6 +988,15 @@ def spec_main():
         model, params, prompt, quant_tokens, slots=1, block=block,
         chunk=chunk, cast=cast)
 
+    # --- the --tree leg: fused tree verify + pooled drafter + adaptive k -----
+    tree_fields = {}
+    if tree:
+        with monitor_trace.trace_context(spec_tid):
+            tree_fields = _spec_tree_leg(
+                model, params, deng, prompt, want, new_tokens, passes,
+                cfg, slots=slots, block=block, chunk=chunk, cast=cast,
+                trace=trace, base_tokens=base_tokens, tps_base=tps_base)
+
     fields = dict(
         tokens_per_s_request=round(tps_spec, 1),
         baseline_tokens_per_s_request=round(tps_base, 1),
@@ -1001,6 +1021,7 @@ def spec_main():
         pass_times_ms=[round(t * 1e3, 2) for t in spec_times],
         config=cfg, backend=jax.default_backend(),
     )
+    fields.update(tree_fields)
     assert greedy_parity and churn_parity, \
         "speculative decode diverged from the non-speculative baseline"
     assert jit_cache_ok, "a spec body re-traced (unstable avals?)"
@@ -1078,6 +1099,146 @@ def _spec_quant_err(model, params, prompt, n_tokens, *, slots, block,
     l_quant, _ = drive(quant, forced=forced)
     err = float(np.max(np.abs(l_quant - l_oracle)))
     return err, quant.pool_bytes() / 1e6, oracle.pool_bytes() / 1e6
+
+
+def _spec_tree_leg(model, params, deng, prompt, want, new_tokens, passes,
+                   cfg, *, slots, block, chunk, cast, trace, base_tokens,
+                   tps_base):
+    """The ``--tree`` extension of the spec leg, three witnesses:
+
+    * **Batch-1 tree verify**: ``DecodeEngine.generate`` with an
+      :class:`NGramTreeDrafter` vs the plain-decode output already in
+      hand — greedy tree output must be TOKEN-IDENTICAL (the deepest-
+      fully-accepted-path winner is exactly the greedy chain), with the
+      tree-verify body's jit cache pinned at one entry.
+    * **Churn with a pooled drafter**: the same seeded trace through
+      ``serve(draft=PagedModelDrafter(...))`` — the drafter's KV blocks
+      come from the scheduler's OWN allocator, so the sweep also
+      witnesses peak drafter blocks in the shared pool.
+    * **Adaptive vs fixed**: :func:`_tree_policy_sim` replays one
+      recorded bimodal acceptance trace under the adaptive controller
+      and under every fixed shape in its static set; adaptive must beat
+      them all on emitted-tokens-per-modeled-cost.
+
+    Returns the ``tree_*`` fields of the spec record."""
+    import numpy as np
+
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.serving import ServingEngine
+    from apex_tpu.spec import (AdaptiveSpecController, NGramTreeDrafter,
+                               PagedModelDrafter)
+
+    depth, branching = 4, 2
+    tree_out = np.asarray(deng.generate(
+        params, jnp.asarray(prompt)[None], new_tokens,
+        draft=NGramTreeDrafter(depth=depth, branching=branching)))
+    tree_greedy_parity = bool((tree_out == want).all())
+    tstats = deng.last_spec_stats
+    cache_ok = deng.spec_tree_step._cache_size() == 1
+    times = []
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        out = deng.generate(params, jnp.asarray(prompt)[None], new_tokens,
+                            draft=NGramTreeDrafter(depth=depth,
+                                                   branching=branching))
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    tps_tree = new_tokens / min(times)
+
+    # churn with the drafter's KV as first-class paged-pool state: a
+    # half-size draft model, its blocks drawn from the target pool
+    dcfg = dict(cfg, hidden_size=max(cfg["hidden_size"] // 2, 32),
+                num_layers=max(cfg["num_layers"] // 2, 1))
+    dmodel = GPTModel(GPTConfig(**dcfg))
+    dparams = dmodel.init(jr.PRNGKey(7))
+    if cast is not None:
+        dparams = jax.tree.map(lambda x: x.astype(cast), dparams)
+    pdraft = PagedModelDrafter(dmodel, dparams, depth=depth,
+                               branching=branching)
+    teng = ServingEngine(model, num_slots=slots, block_size=block,
+                         prefill_chunk=chunk, cache_dtype=cast)
+    done = teng.serve(params, trace(), telemetry=False, draft=pdraft)
+    tree_churn_parity = all(list(r.tokens) == base_tokens[r.rid]
+                            for r in done)
+    cache_ok = cache_ok and teng.spec_tree_step._cache_size() == 1
+    t0 = time.perf_counter()
+    done = teng.serve(params, trace(), telemetry=False, draft=pdraft)
+    churn_s = time.perf_counter() - t0
+    total = sum(len(r.tokens) for r in done)
+    tree_rounds = teng.last_stats.tree_rounds
+
+    # adaptive (depth, branching) vs EVERY fixed shape in the static
+    # set, replayed on one recorded bimodal acceptance trace
+    choices = ((1, 1), (2, 1), (4, 1), (4, 2))
+    adaptive_eff = _tree_policy_sim(
+        adaptive=AdaptiveSpecController(choices, window=3))
+    fixed_eff = [_tree_policy_sim(fixed=c) for c in choices]
+    beats = all(adaptive_eff > e for e in fixed_eff)
+
+    assert tree_greedy_parity and tree_churn_parity, \
+        "tree-speculative decode diverged from the plain-decode baseline"
+    assert cache_ok, "a tree-verify body re-traced (unstable avals?)"
+    assert beats, (
+        f"adaptive (depth, branching) did not beat every fixed shape on "
+        f"the recorded bimodal trace: adaptive={adaptive_eff:.4f} vs "
+        f"fixed={[round(e, 4) for e in fixed_eff]}")
+    return dict(
+        tree_spec_tokens_per_s_request=round(tps_tree, 1),
+        tree_spec_tokens_per_s_churn=round(total / churn_s, 1),
+        tree_spec_acceptance_rate=round(tstats.acceptance_rate, 4),
+        tree_speedup=round(tps_tree / tps_base, 4),
+        tree_depth=depth, tree_branching=branching,
+        tree_nodes=branching * depth,
+        tree_rounds=int(tree_rounds),
+        tree_greedy_parity=tree_greedy_parity,
+        tree_churn_parity=bool(tree_churn_parity),
+        drafter_pool_blocks=int(pdraft.peak_blocks),
+        adaptive_efficiency=round(adaptive_eff, 4),
+        fixed_k_efficiency=[round(e, 4) for e in fixed_eff],
+        adaptive_beats_fixed=bool(beats),
+    )
+
+
+def _tree_policy_sim(*, adaptive=None, fixed=None, streams=8, tokens=64,
+                     p_easy=0.9, p_hard=0.1, overhead_rows=8.0, seed=11):
+    """Replay one RECORDED bimodal acceptance trace — half the streams
+    easy (per-row acceptance ``p_easy``), half hard (``p_hard``), draws
+    fixed by ``seed`` — under a (depth, branching) policy, and score
+    emitted tokens per MODELED verify cost. A round costs its verify
+    rows (``branching*depth + 1``) plus ``overhead_rows``, the weight-
+    streaming floor a decode dispatch pays regardless of row count;
+    that floor is what makes depth pay on easy streams while wasted
+    rows still hurt on hard ones, so neither a fixed-shallow nor a
+    fixed-deep shape can win both halves. Pass ``adaptive=`` (an
+    :class:`~apex_tpu.spec.AdaptiveSpecController`, queried and fed per
+    round exactly like the serve loop does) or ``fixed=(depth,
+    branching)``. Returns ``emitted / cost``."""
+    import numpy as np
+
+    emitted_total, cost = 0, 0.0
+    for s in range(streams):
+        p = p_easy if s % 2 == 0 else p_hard
+        srng = np.random.RandomState(seed * 1000 + s)
+        got = 0
+        while got < tokens:
+            d, b = adaptive.choice(s) if adaptive is not None else fixed
+            # level 0 hedges: the first accepted branch (if any)
+            # continues as a chain — the DraftTree acceptance shape
+            accepted = 0
+            if (srng.random_sample(b) < p).any():
+                accepted = 1
+                for _ in range(d - 1):
+                    if srng.random_sample() >= p:
+                        break
+                    accepted += 1
+            got += accepted + 1  # + the verify round's bonus token
+            emitted_total += accepted + 1
+            cost += b * d + 1 + overhead_rows
+            if adaptive is not None:
+                adaptive.note_round(s, accepted, d)
+        if adaptive is not None:
+            adaptive.release(s)
+    return emitted_total / cost
 
 
 def longseq_bias_main():
@@ -2185,6 +2346,6 @@ if __name__ == "__main__":
     elif "--ckpt" in sys.argv[1:]:
         ckpt_main()
     elif "--spec" in sys.argv[1:]:
-        spec_main()
+        spec_main(tree="--tree" in sys.argv[1:])
     else:
         main()

@@ -131,7 +131,18 @@ def test_inference_doc_covers_serving_contract():
                    "content-addressed", "handoff_role",
                    "--plan-tp", "TP_SERVE_SCHEMA", "handoff_parity",
                    "handoff_transfer_ms",
-                   "validate_metrics.py --tp-serve"):
+                   "validate_metrics.py --tp-serve",
+                   # ISSUE 19: tree speculation + fp8 KV
+                   "fused_verify_tree", "NGramTreeDrafter",
+                   "PagedModelDrafter", "AdaptiveSpecController",
+                   "draft_tree", "deepest fully-accepted path",
+                   "ancestor mask", "note_spec_tokens",
+                   "length masking IS the rewind", "tree_rounds",
+                   "spec_degraded", "peak_blocks",
+                   "drafter_pool_blocks", "spec_tree_step",
+                   "bench.py --spec --tree",
+                   "tree_spec_acceptance_rate", "adaptive_beats_fixed",
+                   "fp8_e4m3", "spec_verify_tree"):
         assert needle in text, f"inference.md dropped {needle}"
 
 
@@ -211,6 +222,11 @@ def test_guide_covers_the_ladder():
                    "ingest_handoff", "prefill_requests",
                    "bench.py --serve --plan-tp",
                    "serve_decode_tp", "handoff_transfer_ms",
+                   # ISSUE 19: the §10f tree-spec recipe
+                   "NGramTreeDrafter", "PagedModelDrafter",
+                   "AdaptiveSpecController", "fused_verify_tree",
+                   "bench.py --spec --tree", "fp8_e4m3",
+                   "peak_blocks", "tree_rounds",
                    # ISSUE 18: the §11 apexmem pre-flight
                    "--memory", "memory_budgets.json",
                    "liveness.analyze", "peak_memory_bound",

@@ -1,0 +1,517 @@
+"""Tree speculative decoding (ISSUE 19): the fused tree-verify tail,
+static draft-tree topologies, the serving tree round's rewind contract,
+drafter KV as first-class paged-pool state, acceptance-adaptive
+(depth, branching) selection, and the fp8 KV pool satellite.
+
+The load-bearing witnesses:
+
+* fused tree verify: the deepest fully-accepted root path wins (ties
+  to the LOWEST node index — at branching 1 the semantics degenerate
+  to the chain), and the Pallas kernel == the XLA fallback
+  token-for-token on shared noise, greedy AND sampled;
+* scripted all-rejected and partial-path tree rounds under churn
+  restore block tables / lengths / the allocator free list exactly,
+  and the resumed stream is token-identical to non-speculative decode
+  (length masking IS the rewind — rejected nodes never touch the
+  pool);
+* a PagedModelDrafter's blocks live in the scheduler's OWN allocator:
+  ``check_accounting()`` stays exact across churn INCLUDING preemption
+  of a stream with live drafter blocks, and every drafter block is
+  back on the free list when serving drains;
+* the adaptive controller converges on a scripted bimodal acceptance
+  trace — easy streams climb to the deepest choice, hard streams pin
+  the shallowest, one adjustment per full window (hysteresis);
+* eager tree-shape validation names the knob (MAX_DRAFT_K / depth /
+  chain_k) — never a deep XLA shape error;
+* ``kv_dtype="fp8_e4m3"`` rides the same per-block-row scale layout
+  as int8 (1 byte/cell), serves end to end, composes with tree
+  speculation, and the illegal-value error names the legal set.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import jax.random as jr
+
+from apex_tpu.inference import DecodeEngine
+from apex_tpu.models import GPTConfig, GPTModel
+from apex_tpu.ops import fused_verify_tree
+from apex_tpu.serving import Request, ServingEngine
+from apex_tpu.spec import (AdaptiveSpecController, NGramTreeDrafter,
+                           PagedModelDrafter, draft_tree, is_tree_drafter)
+
+_CFG = dict(vocab_size=256, max_seq_len=256, hidden_size=64,
+            num_layers=2, num_heads=4, tp_size=1, remat=False,
+            attention_impl="flash")
+
+
+def _model(seed=0, **over):
+    cfg = GPTConfig(**{**_CFG, **over})
+    model = GPTModel(cfg)
+    return model, model.init(jr.PRNGKey(seed))
+
+
+def _requests(n=6, seed=0, vocab=256, prompt_rng=(4, 40), newtok=(2, 10)):
+    rng = np.random.default_rng(seed)
+    return [Request(
+        rid=i,
+        prompt=rng.integers(0, vocab, int(rng.integers(*prompt_rng))
+                            ).astype(np.int32),
+        max_new_tokens=int(rng.integers(*newtok)))
+        for i in range(n)]
+
+
+# --- the static topology ------------------------------------------------------
+
+class TestDraftTree:
+    def test_topology_invariants(self):
+        t = draft_tree(3, 2)  # 3 branches x depth 2
+        assert t.n1 == 7 and t.num_nodes == 6
+        # level-0 nodes hang off the root; deeper nodes chain
+        assert list(t.parents) == [0, 0, 1, 0, 3, 0, 5]
+        # anc is ancestor-OR-SELF including the root
+        assert list(t.anc[4]) == [1, 0, 0, 1, 1, 0, 0]
+        assert list(t.depths) == [0, 1, 2, 1, 2, 1, 2]
+        # one cached instance per shape — one compiled program downstream
+        assert draft_tree(3, 2) is t
+
+    def test_path_tokens_checks_verdict_against_topology(self):
+        t = draft_tree(2, 2)
+        toks = [10, 11, 12, 13]  # drafted nodes 1..4
+        assert t.path_tokens(toks, 2, 2, 99) == [10, 11, 99]
+        assert t.path_tokens(toks, 1, 3, 99) == [12, 99]
+        assert t.path_tokens(toks, 0, 0, 99) == [99]
+        with pytest.raises(ValueError, match="disagrees"):
+            t.path_tokens(toks, 2, 3, 99)  # node 3 is depth 1, not 2
+
+    def test_oversized_shape_names_the_knob(self):
+        with pytest.raises(ValueError, match="MAX_DRAFT_K"):
+            draft_tree(8, 8)  # 64 nodes > the verify-row ceiling
+        with pytest.raises(ValueError, match="branching"):
+            draft_tree(0, 4)
+        with pytest.raises(ValueError, match="chain_k"):
+            NGramTreeDrafter(depth=3, branching=2, chain_k=5)
+
+
+# --- the fused tree-verify op -------------------------------------------------
+
+class TestFusedVerifyTree:
+    def _setup(self, b=1, branching=2, depth=2, V=256, seed=0):
+        # V is a 128-multiple: the kernel's lane-tiling floor
+        t = draft_tree(branching, depth)
+        logits = jr.normal(jr.PRNGKey(seed), (b, t.n1, V))
+        cand = np.asarray(jnp.argmax(logits, -1))
+        parents, anc = t.operands(b)
+        return t, logits, cand, parents, anc
+
+    def test_greedy_deepest_path_wins(self):
+        t, logits, cand, parents, anc = self._setup()
+        V = logits.shape[-1]
+        # branch 0 (nodes 1,2) rejected at level 0; branch 1 (nodes
+        # 3,4) fully accepted: node j accepts iff its token is the
+        # argmax of its PARENT's row
+        tokens = np.zeros((1, t.n1), np.int32)
+        tokens[0, 1] = (cand[0, 0] + 1) % V
+        tokens[0, 3] = cand[0, 0]
+        tokens[0, 4] = cand[0, 3]
+        a, j, nxt = fused_verify_tree(logits, jnp.asarray(tokens),
+                                      jnp.asarray(parents),
+                                      jnp.asarray(anc))
+        assert int(a[0]) == 2 and int(j[0]) == 4
+        assert int(nxt[0]) == cand[0, 4]  # bonus from the terminal row
+
+    def test_greedy_tie_breaks_to_lowest_index(self):
+        t, logits, cand, parents, anc = self._setup(seed=1)
+        # BOTH branches fully accepted -> the winner is the lower-index
+        # terminal (branch 0's leaf, node 2)
+        tokens = np.zeros((1, t.n1), np.int32)
+        tokens[0, 1] = cand[0, 0]
+        tokens[0, 2] = cand[0, 1]
+        tokens[0, 3] = cand[0, 0]
+        tokens[0, 4] = cand[0, 3]
+        a, j, nxt = fused_verify_tree(logits, jnp.asarray(tokens),
+                                      jnp.asarray(parents),
+                                      jnp.asarray(anc))
+        assert int(a[0]) == 2 and int(j[0]) == 2
+        assert int(nxt[0]) == cand[0, 2]
+
+    def test_all_rejected_emits_the_corrected_root_token(self):
+        t, logits, cand, parents, anc = self._setup(seed=2)
+        V = logits.shape[-1]
+        tokens = np.full((1, t.n1), 0, np.int32)
+        for b in range(t.branching):  # every level-0 node wrong
+            tokens[0, 1 + b * t.depth] = (cand[0, 0] + 1 + b) % V
+        a, j, nxt = fused_verify_tree(logits, jnp.asarray(tokens),
+                                      jnp.asarray(parents),
+                                      jnp.asarray(anc))
+        assert int(a[0]) == 0 and int(j[0]) == 0
+        assert int(nxt[0]) == cand[0, 0]
+
+    @pytest.mark.parametrize("branching,depth", [(1, 4), (2, 3), (4, 2)])
+    def test_kernel_matches_fallback_greedy(self, branching, depth):
+        t, logits, cand, parents, anc = self._setup(
+            b=3, branching=branching, depth=depth, seed=depth)
+        tokens = np.array(jr.randint(
+            jr.PRNGKey(7), (3, t.n1), 0, 64), np.int32)
+        tokens[0, 1:] = cand[0, [int(p) for p in t.parents[1:]]]
+        args = (logits, jnp.asarray(tokens), jnp.asarray(parents),
+                jnp.asarray(anc))
+        a1, j1, n1 = fused_verify_tree(*args, impl="xla")
+        a2, j2, n2 = fused_verify_tree(*args, impl="pallas")
+        assert (np.asarray(a1) == np.asarray(a2)).all()
+        assert (np.asarray(j1) == np.asarray(j2)).all()
+        assert (np.asarray(n1) == np.asarray(n2)).all()
+
+    @pytest.mark.parametrize("top_k,top_p", [(0, 1.0), (13, 0.9)])
+    def test_kernel_matches_fallback_sampled(self, top_k, top_p):
+        """Shared-noise discipline: the edge-wise rejection rule agrees
+        token-for-token across impls."""
+        t, logits, cand, parents, anc = self._setup(b=4, seed=5)
+        tokens = np.asarray(jr.randint(
+            jr.PRNGKey(9), (4, t.n1), 0, 64), np.int32)
+        key = jr.PRNGKey(11)
+        args = (logits, jnp.asarray(tokens), jnp.asarray(parents),
+                jnp.asarray(anc), key)
+        kw = dict(temperature=0.7, top_k=top_k, top_p=top_p)
+        a1, j1, n1 = fused_verify_tree(*args, impl="xla", **kw)
+        a2, j2, n2 = fused_verify_tree(*args, impl="pallas", **kw)
+        assert (np.asarray(a1) == np.asarray(a2)).all()
+        assert (np.asarray(j1) == np.asarray(j2)).all()
+        assert (np.asarray(n1) == np.asarray(n2)).all()
+
+
+# --- the serving tree round's rewind contract ---------------------------------
+
+class TestTreeRewindContract:
+    def _prefill(self, eng, sched, params, req):
+        key = jr.PRNGKey(0)
+        sched.submit(req)
+        sched.admit(0.0)
+        pool = eng.init_pool()
+        while True:
+            w = sched.next_prefill(0.0)
+            if w is None:
+                break
+            pool, tok, _ = eng.prefill_chunk(
+                params, pool, jnp.asarray(sched.tables.row(w.slot)),
+                jnp.asarray(w.tokens), jnp.int32(w.start),
+                jnp.int32(w.live), key)
+            sched.note_prefill(w, int(tok), 0.0)
+        return pool
+
+    def _one_tree_round(self, eng, sched, params, pool, tree, node_toks):
+        """Dispatch ONE manual tree round with scripted node tokens and
+        commit it through note_spec_tokens; returns (pool, a, emitted)."""
+        (slot,) = sched.decoding_slots()
+        toks, lens = sched.decode_batch(0.0, lookahead=tree.depth)
+        tok_mat = np.zeros((eng.num_slots, tree.n1), np.int32)
+        tok_mat[:, 0] = toks
+        tok_mat[slot, 1:] = node_toks
+        parents, anc = tree.operands(eng.num_slots)
+        levels = np.arange(tree.depth + 1, dtype=np.int32)
+        pool, acc, jst, nxt = eng.spec_tree_step(
+            params, pool, jnp.asarray(sched.tables.asarray()),
+            jnp.asarray(tok_mat), jnp.asarray(lens),
+            jnp.asarray(parents), jnp.asarray(anc),
+            jnp.asarray(levels), jr.PRNGKey(0))
+        a = int(np.asarray(acc)[slot])
+        emitted = tree.path_tokens(node_toks, a,
+                                   int(np.asarray(jst)[slot]),
+                                   int(np.asarray(nxt)[slot]))
+        sched.note_spec_tokens({slot: emitted}, 0.0)
+        return pool, a, emitted
+
+    def _finish_plain(self, eng, sched, params, pool):
+        key = jr.PRNGKey(0)
+        while True:
+            batch = sched.decode_batch(0.0)
+            if batch is None:
+                break
+            toks, lens = batch
+            pool, sampled, _ = eng.decode_step(
+                params, pool, jnp.asarray(sched.tables.asarray()),
+                jnp.asarray(toks), jnp.asarray(lens), key)
+            sched.note_decode(np.asarray(sampled), 0.0)
+        return pool
+
+    @pytest.mark.parametrize("accept_levels", [0, 2])
+    def test_scripted_round_restores_pool_state(self, accept_levels):
+        """All-rejected (0) and partial-path (2 of 3 levels down branch
+        1) rounds: tables/lengths/free list land exactly where plain
+        decode of the emitted tokens would have, and the resumed stream
+        is token-identical to the non-speculative stream. A 14-token
+        prompt makes the depth-3 reservation cross the 16-row block
+        boundary, so the rewind really frees blocks."""
+        import apex_tpu.serving.kv_blocks as kvb
+        model, params = _model()
+        mk = lambda: ServingEngine(model, num_slots=2, block_size=16,  # noqa: E731
+                                   prefill_chunk=16)
+        ref_eng = mk()
+        base = ref_eng.serve(
+            params, _requests(1, prompt_rng=(14, 15), newtok=(8, 9)),
+            telemetry=False)
+        base_tokens = list(base[0].tokens)
+
+        eng = mk()
+        sched = eng.make_scheduler()
+        (req,) = _requests(1, prompt_rng=(14, 15), newtok=(8, 9))
+        pool = self._prefill(eng, sched, params, req)
+        (slot,) = sched.decoding_slots()
+        free_before = list(sched.allocator._free)
+        table_before = sched.tables.asarray().copy()
+        len_before = sched.slot_length(slot)
+
+        # branch 1 carries the baseline stream for accept_levels
+        # levels then goes wrong; branch 0 is wrong at level 0 (its
+        # level-0 token collides with nothing: +1 mod V of the truth)
+        tree = draft_tree(2, 3)
+        node_toks = np.zeros((tree.num_nodes,), np.int32)
+        for lv in range(tree.depth):  # branch 0: all wrong
+            node_toks[0 * tree.depth + lv] = (base_tokens[lv] + 1) % 256
+        for lv in range(tree.depth):  # branch 1: right for a levels
+            right = base_tokens[1 + lv]  # round starts after token 0
+            node_toks[1 * tree.depth + lv] = (
+                right if lv < accept_levels else (right + 1) % 256)
+        # NOTE: the round's pending token (column 0) is base_tokens[0],
+        # so branch truth at level lv is base_tokens[1 + lv]... except
+        # the decode_batch pending token IS base_tokens[0] only on the
+        # first round — assert it to keep the script honest
+        pool, a, emitted = self._one_tree_round(
+            eng, sched, params, pool, tree, node_toks)
+        assert a == accept_levels
+        # the emitted tokens are exactly the baseline's next a+1
+        assert emitted == base_tokens[1:1 + a] + [base_tokens[1 + a]]
+
+        # pool-state exactness: lengths advanced by exactly a+1; blocks
+        # the stream held BEFORE the round are untouched, blocks the
+        # frontier now needs came off the free list LIFO, and entries
+        # past the frontier rewound to the dead block
+        assert sched.slot_length(slot) == len_before + a + 1
+        keep = kvb.blocks_needed(sched.slot_length(slot), 16)
+        had = kvb.blocks_needed(len_before, 16)
+        table_now = sched.tables.asarray()
+        assert (table_now[slot, :had] == table_before[slot, :had]).all()
+        assert (table_now[slot, keep:] == kvb.DEAD_BLOCK).all()
+        claimed = keep - had
+        assert list(table_now[slot, had:keep]) == \
+            free_before[len(free_before) - claimed:][::-1]
+        assert sched.allocator._free == free_before[:len(free_before)
+                                                    - claimed]
+        sched.allocator.check_accounting()
+
+        # resume WITHOUT speculation: token-identical to baseline
+        self._finish_plain(eng, sched, params, pool)
+        assert list(req.tokens) == base_tokens
+        assert eng.spec_tree_step._cache_size() == 1
+
+
+# --- drafter KV in the shared paged pool --------------------------------------
+
+class TestDrafterPoolAccounting:
+    def _drafter(self, depth=3, branching=2):
+        dm, dp = _model(seed=9, num_layers=1, hidden_size=32, num_heads=2)
+        return PagedModelDrafter(dm, dp, depth=depth, branching=branching)
+
+    def test_blocks_accounted_across_churn(self):
+        """Serve a full trace with the drafter allocating from the
+        scheduler's own allocator: parity with the plain baseline,
+        exact accounting at drain, zero live drafter blocks after."""
+        model, params = _model()
+        mk = lambda: ServingEngine(model, num_slots=3, block_size=16,  # noqa: E731
+                                   prefill_chunk=16)
+        base = mk().serve(params, _requests(6), telemetry=False)
+        want = {r.rid: list(r.tokens) for r in base}
+        eng = mk()
+        draft = self._drafter()
+        out = eng.serve(params, _requests(6), telemetry=False, draft=draft)
+        assert all(list(r.tokens) == want[r.rid] for r in out)
+        assert draft.peak_blocks > 0  # the drafter really used the pool
+        assert draft.pool_blocks() == 0  # ...and gave every block back
+        assert eng.spec_tree_step._cache_size() == 1
+
+    def test_preemption_evicts_drafter_blocks(self):
+        """An undersized pool forces preemption of streams WITH live
+        drafter blocks (the scheduler calls evict_stream from
+        _preempt): accounting stays exact, the resumed streams match
+        the equally-pressured non-speculative baseline, and the ladder
+        degraded at least one round rather than stalling."""
+        model, params = _model()
+        mk = lambda n: ServingEngine(model, num_slots=3, block_size=16,  # noqa: E731
+                                     prefill_chunk=16, num_blocks=n)
+        base = mk(8).serve(params, _requests(8), telemetry=False)
+        want = {r.rid: list(r.tokens) for r in base}
+        eng = mk(8)
+        draft = self._drafter()
+        out = eng.serve(params, _requests(8), telemetry=False, draft=draft)
+        assert all(list(r.tokens) == want[r.rid] for r in out)
+        assert draft.pool_blocks() == 0
+        assert any(r.evictions > 0 for r in out), \
+            "pool pressure never preempted a stream"
+        assert eng.last_stats.spec_degraded > 0, \
+            "the headroom ladder never ran"
+
+    def test_unbound_drafter_names_the_fix(self):
+        draft = self._drafter()
+        with pytest.raises(ValueError, match="bind"):
+            draft.propose_tree(0, [1, 2, 3])
+
+
+# --- acceptance-adaptive (depth, branching) -----------------------------------
+
+class TestAdaptiveController:
+    def test_bimodal_convergence_and_hysteresis(self):
+        """Scripted bimodal trace: the easy stream climbs one rung per
+        FULL window up to the deepest choice; the hard stream pins the
+        shallowest; a single lucky round never flaps the choice."""
+        ctl = AdaptiveSpecController(choices=((2, 1), (4, 1), (4, 2)),
+                                     window=4)
+        for r in range(12):
+            d, _ = ctl.choice(0)
+            ctl.note_round(0, d, d)      # easy: everything accepted
+            d, _ = ctl.choice(1)
+            ctl.note_round(1, 0, d)      # hard: everything rejected
+        assert ctl.choice(0) == (4, 2)   # climbed the whole ladder
+        assert ctl.choice(1) == (2, 1)   # pinned at the floor
+        # hysteresis: after an adjustment a fresh window must fill
+        # before the next one — 12 rounds / window 4 = at most 3 steps
+        assert ctl.adjustments <= 3
+
+        # one lucky round inside a bad stretch does not flap upward
+        ctl2 = AdaptiveSpecController(choices=((2, 1), (4, 1)), window=4)
+        for r in range(8):
+            d, _ = ctl2.choice(0)
+            ctl2.note_round(0, d if r == 3 else 0, d)
+        assert ctl2.choice(0) == (2, 1)
+
+    def test_round_shape_is_shallowest_live(self):
+        ctl = AdaptiveSpecController(choices=((2, 1), (4, 2)), window=1)
+        for _ in range(2):
+            ctl.note_round(0, 2, 2)      # stream 0 climbs
+        assert ctl.choice(0) == (4, 2)
+        assert ctl.round_shape([0]) == (4, 2)
+        assert ctl.round_shape([0, 1]) == (2, 1)  # stream 1 drags down
+        ctl.release(0)
+        assert ctl.round_shape([]) == (2, 1)
+
+    def test_serve_adaptive_parity(self):
+        """End to end: adaptive tree serving is token-identical to the
+        plain baseline (the controller only changes SHAPES, never
+        verdicts) and every choice's program is pinned."""
+        model, params = _model()
+        mk = lambda: ServingEngine(model, num_slots=3, block_size=16,  # noqa: E731
+                                   prefill_chunk=16)
+        base = mk().serve(params, _requests(6), telemetry=False)
+        want = {r.rid: list(r.tokens) for r in base}
+        eng = mk()
+        out = eng.serve(params, _requests(6), telemetry=False,
+                        draft=NGramTreeDrafter(depth=4, branching=2),
+                        adaptive=AdaptiveSpecController(window=2))
+        assert all(list(r.tokens) == want[r.rid] for r in out)
+        # one executable per (depth, branching) in use, never more than
+        # the choice set
+        assert 1 <= eng.spec_tree_step._cache_size() <= 3
+
+    def test_adaptive_choice_deeper_than_drafter_refused(self):
+        model, params = _model()
+        eng = ServingEngine(model, num_slots=2, block_size=16,
+                            prefill_chunk=16)
+        with pytest.raises(ValueError, match="depth"):
+            eng.serve(params, _requests(1), telemetry=False,
+                      draft=NGramTreeDrafter(depth=2, branching=2),
+                      adaptive=AdaptiveSpecController(
+                          choices=((2, 1), (4, 1))))
+
+
+# --- serving integration ------------------------------------------------------
+
+class TestServingTree:
+    def test_tree_churn_parity_ngram(self):
+        model, params = _model()
+        mk = lambda: ServingEngine(model, num_slots=3, block_size=16,  # noqa: E731
+                                   prefill_chunk=16)
+        base = mk().serve(params, _requests(6), telemetry=False)
+        want = {r.rid: list(r.tokens) for r in base}
+        eng = mk()
+        draft = NGramTreeDrafter(depth=3, branching=2)
+        assert is_tree_drafter(draft)
+        out = eng.serve(params, _requests(6), telemetry=False, draft=draft)
+        assert all(list(r.tokens) == want[r.rid] for r in out)
+        stats = eng.last_stats
+        assert stats.tree_rounds > 0
+        assert stats.spec_nodes >= stats.spec_accepted
+        assert 0.0 < stats.spec_efficiency <= 1.0
+        assert eng.spec_tree_step._cache_size() == 1
+        assert eng.prefill_chunk._cache_size() == 1
+
+    def test_tree_tp_refused_eagerly(self):
+        """The tree-verify step has no sharded twin yet: a tree drafter
+        under tp>1 must be refused before any dispatch, naming the
+        chain alternative."""
+        model, params = _model()
+        eng = ServingEngine(model, num_slots=2, block_size=16,
+                            prefill_chunk=16)
+        eng.tp = 2  # a tp=2 engine without devices: serve checks first
+        with pytest.raises(ValueError, match="tp=1"):
+            eng.serve(params, _requests(1), telemetry=False,
+                      draft=NGramTreeDrafter(depth=2, branching=2))
+
+
+# --- the fp8 KV pool satellite ------------------------------------------------
+
+class TestFp8KV:
+    def test_pool_layout_matches_int8(self):
+        """Same per-block-row scale planes, same 1 byte/cell — only the
+        cell dtype differs."""
+        model, params = _model()
+        q8 = ServingEngine(model, num_slots=2, block_size=16,
+                           kv_dtype="int8")
+        qf8 = ServingEngine(model, num_slots=2, block_size=16,
+                            kv_dtype="fp8_e4m3")
+        p8, pf8 = q8.init_pool(), qf8.init_pool()
+        assert pf8["k"].dtype == jnp.float8_e4m3fn
+        assert pf8["k_scale"].shape == p8["k_scale"].shape
+        assert pf8["k_scale"].dtype == p8["k_scale"].dtype
+        assert qf8.pool_bytes() == q8.pool_bytes()
+
+    def test_fp8_serve_end_to_end(self):
+        model, params = _model()
+        eng = ServingEngine(model, num_slots=2, block_size=16,
+                            prefill_chunk=16, kv_dtype="fp8_e4m3")
+        done = eng.serve(params, _requests(4), telemetry=False)
+        assert len(done) == 4
+        assert all(len(r.tokens) == r.max_new_tokens for r in done)
+        assert eng.decode_step._cache_size() == 1
+
+    def test_fp8_composes_with_tree_spec(self):
+        """fp8 + tree speculation is token-identical to fp8 without
+        speculation (the composition's parity oracle — the fp8 stream
+        itself may differ from float, quantization is lossy)."""
+        model, params = _model()
+        mk = lambda: ServingEngine(model, num_slots=2, block_size=16,  # noqa: E731
+                                   prefill_chunk=16, kv_dtype="fp8_e4m3")
+        base = mk().serve(params, _requests(4), telemetry=False)
+        want = {r.rid: list(r.tokens) for r in base}
+        out = mk().serve(params, _requests(4), telemetry=False,
+                         draft=NGramTreeDrafter(depth=3, branching=2))
+        assert all(list(r.tokens) == want[r.rid] for r in out)
+
+    def test_eager_validation_names_the_legal_set(self):
+        model, params = _model()
+        with pytest.raises(ValueError, match="fp8_e4m3"):
+            ServingEngine(model, num_slots=2, block_size=16,
+                          kv_dtype="fp8_e5m2")
+        with pytest.raises(ValueError, match="int8"):
+            ServingEngine(model, num_slots=2, block_size=16,
+                          kv_dtype="bogus")
+
+    def test_fp8_tp_refused(self):
+        """The tensor-parallel quantize path is int8-specific: fp8
+        under a tp>1 plan is refused in __init__, before the tp plan
+        itself is even validated (the knob error comes first)."""
+        import types
+        model, params = _model()
+        with pytest.raises(ValueError, match="tp=1 only"):
+            ServingEngine(model, num_slots=2, block_size=16,
+                          kv_dtype="fp8_e4m3",
+                          plan=types.SimpleNamespace(tp=2))

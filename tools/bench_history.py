@@ -31,7 +31,11 @@ that trajectory into a gate a CI leg can run after a fresh bench:
   headline) and ``spec_acceptance_rate`` (the drafter-quality series
   that explains it — a silent acceptance collapse would eventually
   surface as a throughput regression anyway, but gating it directly
-  names the cause). History artifacts that predate a series simply
+  names the cause); a record from a ``--spec --tree`` run additionally
+  carries ``tree_spec_tokens_per_s_request`` and
+  ``tree_spec_acceptance_rate``, gated the same higher-is-better way
+  (pre-tree history SKIPs the new series only — the established chain
+  series still gate). History artifacts that predate a series simply
   carry no point for it, so a fresh record's NEW series SKIP
   individually while its established ones still gate. A ``status:
   "SKIP"`` record carries no claim and is *skipped* by the gate
@@ -200,6 +204,17 @@ def extract_all(obj: Dict[str, Any], label: str = "artifact"
             # the record's spread_pct is throughput variance; it says
             # nothing about acceptance variance
             rows.append(("spec_acceptance_rate", float(rate), 0.0))
+        # the tree-speculation series (absent on pre-tree records and on
+        # --spec runs without --tree — a skip object, not 0): per-request
+        # tree throughput plus the tree acceptance rate, both
+        # higher-is-better like their chain counterparts
+        tv = obj.get("tree_spec_tokens_per_s_request")
+        if isinstance(tv, (int, float)):
+            rows.append(("tree_spec_tokens_per_s_request", float(tv),
+                         spread))
+        trate = obj.get("tree_spec_acceptance_rate")
+        if isinstance(trate, (int, float)):
+            rows.append(("tree_spec_acceptance_rate", float(trate), 0.0))
         return rows
     if kind == "ckpt":
         # the checkpoint leg's gated series is its measured per-step
