@@ -68,6 +68,7 @@ from apex_tpu.monitor.registry import (  # noqa: F401
     emit_profile,
     emit_serve,
     emit_serve_attribution,
+    emit_serve_plan,
     emit_serve_window,
     emit_spec,
     emit_tp_overlap,

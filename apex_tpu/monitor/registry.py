@@ -323,6 +323,14 @@ class MetricsRegistry:
         measured run followed (``apex_tpu.plan.search``)."""
         return self._emit_status_record("plan", status, **fields)
 
+    def emit_serve_plan(self, status: str, **fields) -> Dict[str, Any]:
+        """Serving-plan search record (``bench.py --serve --plan-serve``):
+        the trace-replay-priced serving-knob search — candidate grid,
+        chosen ``ServePlan`` + predicted tokens/s / TTFT / KV-pool
+        footprint + confidence, hand-config comparison, and the live
+        re-plan witnesses (``apex_tpu.plan.serve``)."""
+        return self._emit_status_record("serve_plan", status, **fields)
+
     def emit_profile(self, status: str, **fields) -> Dict[str, Any]:
         """Step-anatomy profile record (``bench.py --profile``): spans +
         device trace fused into the per-step compute/collective/bubble/
@@ -584,6 +592,13 @@ def emit_plan(status: str, **fields) -> Optional[Dict[str, Any]]:
     r = _REGISTRY
     if r is not None:
         return r.emit_plan(status, **fields)
+    return None
+
+
+def emit_serve_plan(status: str, **fields) -> Optional[Dict[str, Any]]:
+    r = _REGISTRY
+    if r is not None:
+        return r.emit_serve_plan(status, **fields)
     return None
 
 

@@ -392,11 +392,12 @@ def serve_timeline(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     per_rid: Dict[int, Dict[str, Any]] = {}
     stragglers = []
     swaps = []
+    replans = []
     for rec in records:
         if rec.get("kind") != "serve_event":
             continue
         rid = rec.get("rid")
-        if rid == -1:  # engine-level events (straggler steps, swaps)
+        if rid == -1:  # engine-level events (stragglers, swaps, replans)
             if rec.get("straggler"):
                 stragglers.append({k: rec.get(k) for k in
                                    ("at_s", "step", "dur_ms",
@@ -404,6 +405,11 @@ def serve_timeline(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             elif rec.get("phase") == "swap":
                 swaps.append({k: rec.get(k) for k in
                               ("at_s", "step", "swap_source")})
+            elif rec.get("phase") == "replan":
+                replans.append({k: rec.get(k) for k in
+                                ("at_s", "step", "plan_from", "plan_to",
+                                 "replan_trigger", "live_knobs",
+                                 "deferred_knobs")})
             continue
         row = per_rid.setdefault(rid, {"rid": rid})
         phase = rec.get("phase")
@@ -461,7 +467,7 @@ def serve_timeline(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         for rec in records if rec.get("kind") == "serve_window"
     ]
     return {"requests": requests, "windows": windows,
-            "stragglers": stragglers, "swaps": swaps}
+            "stragglers": stragglers, "swaps": swaps, "replans": replans}
 
 
 # --- per-request latency attribution (`report --attribution`) ----------------
@@ -635,6 +641,14 @@ def format_serve_timeline(timeline: Dict[str, Any]) -> str:
                      + (f" from {src}" if src else "")
                      + ": weights hot-swapped (contents-only; in-flight "
                        "streams kept)")
+    for s in timeline.get("replans", []):
+        deferred = s.get("deferred_knobs") or []
+        lines.append(f"  replan at step {s.get('step')}: "
+                     f"{s.get('plan_from')} -> {s.get('plan_to')} "
+                     f"({s.get('replan_trigger')}; live knobs applied"
+                     + (", deferred: " + ", ".join(deferred)
+                        if deferred else "")
+                     + ")")
     return "\n".join(lines)
 
 

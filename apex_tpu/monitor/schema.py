@@ -336,6 +336,7 @@ SERVE_SCHEMA = {
         "preemptions": {"type": "integer"},   # evict lifecycle events
         "recompute_tokens": {"type": "integer"},  # re-prefilled rows
         "swaps": {"type": "integer"},         # weight hot-swaps applied
+        "replans": {"type": "integer"},       # ServePlan ladder switches
         "blocks_resident": {"type": "integer"},   # warm cache footprint
         # speculative serving (ISSUE 15): per SLOT-round acceptance
         # rolled up from the `spec` lifecycle events (present when spec
@@ -398,7 +399,7 @@ SERVE_EVENT_SCHEMA = {
         "rid": {"type": "integer"},
         "phase": {"enum": ["submit", "admit", "prefill_chunk",
                            "first_token", "decode", "finish", "evict",
-                           "swap", "spec", "handoff"]},
+                           "swap", "spec", "handoff", "replan"]},
         "at_s": {"type": "number"},        # serve-clock transition time
         "slot": {"type": "integer"},
         "step": {"type": "integer"},       # engine dispatch counter
@@ -428,6 +429,16 @@ SERVE_EVENT_SCHEMA = {
         # checkpoint's params replaced the serving weights between
         # dispatch steps (contents-only; both jit caches stay at 1)
         "swap_source": {"type": "string"},     # swap: where weights came from
+        # ServePlan re-plan (ISSUE 20): engine-level, rid -1 — the
+        # ReplanPolicy switched the active priced plan at a window edge.
+        # Only aval-stable knobs applied live (both jit caches stay at
+        # 1); aval-changing knobs ride deferred_knobs, reported not
+        # applied.
+        "plan_from": {"type": "string"},       # replan: old plan digest
+        "plan_to": {"type": "string"},         # replan: new plan digest
+        "replan_trigger": {"type": "string"},  # queue_buildup|slo_burn|calm
+        "live_knobs": {"type": "array", "items": {"type": "string"}},
+        "deferred_knobs": {"type": "array", "items": {"type": "string"}},
         # speculative round (ISSUE 15): one record per slot per round —
         # accepted_len of draft_k drafted tokens survived verification
         "accepted_len": {"type": "integer"},
@@ -811,6 +822,113 @@ PLAN_SCHEMA = {
     "required": ["schema", "kind", "status", "chosen", "ranking"],
 }
 
+# the serving-plan search record (`python bench.py --serve --plan-serve`,
+# apex_tpu.plan.serve.serve_plan_record_fields): the trace-replay-priced
+# serving-knob search (ISSUE 20) — the candidate grid, the chosen
+# ServePlan + its predicted tokens/s / TTFT quantiles / KV-pool
+# footprint + confidence (CostDB blind-spot keys listed in
+# `uncalibrated`, never silently priced), the hand-config comparison
+# (`searched_beats_hand`), and the live re-plan witnesses (`replans`,
+# `replan_parity`, `jit_cache_ok`). Same status semantics as `plan`:
+# "OK" (real TPU measurement) engages the honesty rule; off-TPU the
+# record is an explicit SKIP(reason) with the measured half as explicit
+# skip objects — never nan in an OK line. Plan objects and ranking rows
+# are CLOSED (additionalProperties: false): a junk key in a serialized
+# ServePlan or ranking entry must fail validation, not ride along.
+SERVE_PLAN_OBJ_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "num_blocks": {"type": "integer"},
+        "block_size": {"type": "integer"},
+        "num_slots": {"type": "integer"},
+        "prefill_chunk": {"type": "integer"},
+        "max_prefill_share": {"type": "integer"},
+        "drafter": {"enum": ["none", "ngram", "ngram_tree"]},
+        "spec_depth": {"type": "integer"},
+        "spec_branching": {"type": "integer"},
+        "spec_adaptive": {"type": "boolean"},
+        "kv_dtype": {"enum": [None, "int8", "fp8_e4m3"]},
+        "slo_ttft_ms": {"anyOf": [{"type": "number"}, {"type": "null"}]},
+        "slo_burn_count": {"type": "integer"},
+        "admission": {"enum": ["fcfs", "short_first"]},
+    },
+    "required": ["num_blocks", "block_size", "num_slots", "prefill_chunk",
+                 "max_prefill_share", "drafter", "spec_depth",
+                 "spec_branching", "spec_adaptive", "kv_dtype",
+                 "slo_ttft_ms", "slo_burn_count", "admission"],
+    "additionalProperties": False,
+}
+
+_SERVE_PLAN_RANKING_ITEM = {
+    "type": "object",
+    "properties": {
+        "plan": SERVE_PLAN_OBJ_SCHEMA,
+        "digest": {"type": "string"},
+        "predicted_tokens_per_s": {"type": "number"},
+        "predicted_ttft_p50_ms": {"type": "number"},
+        "predicted_ttft_p99_ms": {"type": "number"},
+        "predicted_kv_pool_mb": {"type": "number"},
+        "confidence": {"enum": ["calibrated", "partial"]},
+        "uncalibrated": {"type": "array", "items": {"type": "string"}},
+        "decode_steps": {"type": "integer"},
+        "prefill_chunks": {"type": "integer"},
+        "sim_span_ms": {"type": "number"},
+    },
+    "required": ["plan", "predicted_tokens_per_s", "confidence"],
+    "additionalProperties": False,
+}
+
+SERVE_PLAN_SCHEMA = {
+    "type": "object",
+    "properties": {
+        **_COMMON,
+        "kind": {"enum": ["serve_plan"]},
+        "status": {"enum": ["OK", "SKIP"]},
+        "reason": {"type": "string"},  # required when status == "SKIP"
+        "searched": {"type": "integer"},   # grid size (incl. rejected)
+        "feasible": {"type": "integer"},
+        "requests": {"type": "integer"},   # replayed trace size
+        "trace_seed": {"type": "integer"},
+        "chosen": SERVE_PLAN_OBJ_SCHEMA,
+        "chosen_describe": {"type": "string"},
+        "chosen_digest": {"type": "string"},
+        "predicted_tokens_per_s": {"type": "number"},
+        "predicted_ttft_p50_ms": {"type": "number"},
+        "predicted_ttft_p99_ms": {"type": "number"},
+        "predicted_kv_pool_mb": {"type": "number"},
+        "confidence": {"enum": ["calibrated", "partial"]},
+        "uncalibrated": {"type": "array", "items": {"type": "string"}},
+        "ranking": {"type": "array", "items": _SERVE_PLAN_RANKING_ITEM},
+        "rejected": {"type": "array", "items": {
+            "type": "object",
+            "properties": {"plan": {"type": "string"},
+                           "reason": {"type": "string"}},
+            "required": ["plan", "reason"],
+            "additionalProperties": False,
+        }},
+        "costdb_source": {"type": "string"},
+        # measured half — real TPU only; explicit skip objects off-TPU
+        "measured_tokens_per_s": _METRIC_VALUE,
+        "measured_ttft_p50_ms": _METRIC_VALUE,
+        "predicted_vs_measured_err_pct": _METRIC_VALUE,
+        # hand-config comparison: the fixed baseline the searched plan
+        # must beat on the SAME recorded trace (tokens/s AND TTFT p50)
+        "hand_tokens_per_s": _METRIC_VALUE,
+        "hand_ttft_p50_ms": _METRIC_VALUE,
+        "searched_beats_hand": {"type": "boolean"},
+        # live re-plan witnesses: ladder switches completed mid-serve
+        # with greedy output token-identical across the switch and both
+        # jit caches pinned at 1
+        "replans": {"type": "integer"},
+        "replan_parity": {"type": "boolean"},
+        "jit_cache_ok": {"type": "boolean"},
+        "smoke_tokens_per_s": _METRIC_VALUE,  # off-TPU plumbing witness
+        "config": {"type": "object"},
+        "backend": {"type": "string"},
+    },
+    "required": ["schema", "kind", "status", "chosen", "ranking"],
+}
+
 # sharded-checkpoint bench record (`python bench.py --ckpt`): the
 # measured cost of elastic ZeRO checkpointing (apex_tpu.ckpt) — the
 # between-steps snapshot time (the only part on the step path), the
@@ -1111,6 +1229,7 @@ SCHEMAS_BY_KIND = {
     "static_cost": STATIC_COST_SCHEMA,
     "static_memory": STATIC_MEMORY_SCHEMA,
     "plan": PLAN_SCHEMA,
+    "serve_plan": SERVE_PLAN_SCHEMA,
     "ckpt": CKPT_SCHEMA,
     "spec": SPEC_SCHEMA,
     "tp_serve": TP_SERVE_SCHEMA,
@@ -1216,8 +1335,9 @@ def validate(record: Dict[str, Any],
     # with a claim-free, reason-free skip)
     if (record.get("kind") in ("decode", "longseq_bias", "tp_overlap",
                                "profile", "serve", "pipeline",
-                               "serve_window", "plan", "ckpt", "spec",
-                               "tp_serve", "serve_attribution")
+                               "serve_window", "plan", "serve_plan",
+                               "ckpt", "spec", "tp_serve",
+                               "serve_attribution")
             and record.get("status") == "SKIP"
             and not record.get("reason")):
         errors.append(

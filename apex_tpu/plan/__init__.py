@@ -28,6 +28,21 @@ FLOPs of a traced program, scan-multiplied).
   the schema-validated ``plan`` record (``bench.py --plan`` emits it;
   ``tools/bench_history.py`` gates its predicted-vs-measured error).
 
+* :mod:`~apex_tpu.plan.serve` — planner tier 2, the SERVING knobs:
+  :class:`ServePlan` (frozen, validated, JSON round-trip, content
+  digest) covering block/pool/slot/chunk sizing, prefill share, spec
+  drafter + tree shape, kv_dtype, SLO thresholds, admission order;
+  :func:`price_serve_plan` replays a recorded trace through a
+  bit-deterministic host-side discrete-event model with per-phase
+  costs from :func:`derive_serve_costs` (CostDB + measured serve
+  telemetry, blind spots in ``uncalibrated``);
+  :func:`search_serve_plans` ranks the candidate grid and
+  :func:`serve_plan_record_fields` builds the closed ``serve_plan``
+  record (``bench.py --serve --plan-serve``). The online half —
+  ``ReplanPolicy`` swapping priced plans at window edges — lives in
+  :mod:`apex_tpu.serving.scheduler` and uses
+  :func:`split_knob_changes` to decide live-vs-deferred knobs.
+
 See ``docs/api/plan.md`` for the pricing math and a worked example,
 and the TRAINING_GUIDE's "choosing a plan" chapter for the workflow.
 """
@@ -55,4 +70,20 @@ from apex_tpu.plan.search import (  # noqa: F401
     enumerate_plans,
     plan_record_fields,
     search_plans,
+)
+from apex_tpu.plan.serve import (  # noqa: F401
+    ADMISSIONS,
+    DRAFTERS,
+    KV_DTYPES,
+    ServeCandidate,
+    ServeCosts,
+    ServePlan,
+    ServePrice,
+    ServeSearchResult,
+    derive_serve_costs,
+    enumerate_serve_plans,
+    price_serve_plan,
+    search_serve_plans,
+    serve_plan_record_fields,
+    split_knob_changes,
 )

@@ -1,0 +1,826 @@
+"""ServePlan: the serving engine's knobs as one priced, searchable object.
+
+PR 11 closed the planner loop for *training* knobs; this module does the
+same for serving. The engine grew a dozen hand-tuned knobs (block size,
+pool sizing, slot count, prefill chunk/share, spec drafter + tree shape,
+kv_dtype, SLO thresholds) while already emitting the telemetry needed to
+price them (acceptance rate, prefix hit-rate, occupancy, per-phase
+attribution). The AMP recipe (arXiv:2210.07297) applies unchanged:
+treat the configuration as a priced choice searched from a cost model,
+never a guess — and the veScale discipline (arXiv:2509.07003) governs
+the online half: a re-planned engine must stay semantically equal to
+the baseline, witnessed by our token-parity machinery.
+
+Three layers, same idiom as ``parallel_plan``/``cost``/``search``:
+
+* :class:`ServePlan` — frozen + eagerly validated (an illegal knob
+  combination never exists as a live object; every error names the knob
+  and its legal values), exact JSON round-trip, and a content
+  :meth:`~ServePlan.digest` so ``replan`` lifecycle events can name the
+  from/to configuration in one short token. :func:`split_knob_changes`
+  is the online-replan contract: which knob diffs are AVAL-STABLE
+  (host-side dispatch only — apply live, jit caches stay at 1) and
+  which change compiled shapes (defer to a ``request_swap``-style
+  boundary, report, never apply mid-serve).
+* :func:`price_serve_plan` — replays a recorded request trace (the
+  seeded ``bench.build_serve_trace`` output, or any list of objects
+  with ``prompt``/``max_new_tokens``/``arrival_s``) through a
+  host-side discrete-event model of the engine loop: worst-case
+  admission against the paged pool, chunked prefill with structural
+  prefix-cache sharing, batched decode steps whose per-phase costs come
+  from :class:`ServeCosts`. Pure host arithmetic over the trace — no
+  wall clock, no randomness — so the same (plan, trace, costs) prices
+  to the same bits (pinned by ``tests/test_serve_plan.py``), and every
+  cost term is monotone: a slower priced phase never predicts higher
+  throughput.
+* :func:`search_serve_plans` — enumerate the candidate grid around a
+  base config, filter feasibility (a pool that cannot hold the trace's
+  largest request is a rejection with a reason, not a crash), price
+  every survivor, rank by predicted tokens/s then TTFT.
+  :func:`serve_plan_record_fields` turns the result into the closed
+  ``serve_plan`` monitor record ``bench.py --serve --plan-serve`` emits
+  and ``tools/bench_history.py`` gates.
+
+Costs come from the CostDB plus measured serve telemetry via
+:func:`derive_serve_costs`. A term neither source measured is a blind
+spot: it is priced at a CONSERVATIVE default (the slowest measured rate
+of the family, or zero benefit for speculation) and always surfaced in
+``uncalibrated`` — never silently defaulted. An unmeasured acceptance
+rate prices to 0.0 on purpose: a spec plan can only win the search on
+measured evidence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.plan.cost import (
+    _nearest_gemm_rate,
+    conservative_defaults,
+    kv_pool_bytes,
+)
+from apex_tpu.plan.parallel_plan import PlanError
+
+#: legal drafter choices (``"ngram"`` = chain drafts, ``"ngram_tree"``
+#: = the PR-19 tree drafter; the paged model drafter prices as a tree)
+DRAFTERS = ("none", "ngram", "ngram_tree")
+
+#: legal paged-pool quantizations (None = the cache dtype, bf16-sized)
+KV_DTYPES = (None, "int8", "fp8_e4m3")
+
+#: legal admission orders: FCFS, or shortest-arrived-first (the order
+#: ``SLOPolicy.prefer_short_prompts`` flips to under a TTFT burn —
+#: ``"short_first"`` pins it on)
+ADMISSIONS = ("fcfs", "short_first")
+
+#: knob diffs a live engine can apply between dispatch steps: they
+#: change host-side dispatch ORDER and REPETITION only, never an aval,
+#: so both jit caches stay at one executable across the switch
+LIVE_KNOBS = ("max_prefill_share", "slo_ttft_ms", "slo_burn_count",
+              "admission")
+
+#: knob diffs that change compiled shapes or pool geometry: a mid-serve
+#: apply would re-trace (or corrupt the paged pool), so the online
+#: policy DEFERS them to a request_swap-style boundary and reports them
+DEFERRED_KNOBS = ("block_size", "num_blocks", "num_slots",
+                  "prefill_chunk", "kv_dtype", "drafter", "spec_depth",
+                  "spec_branching", "spec_adaptive")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServePlan:
+    """Every serving knob of one engine configuration, validated at
+    construction (the :class:`~apex_tpu.plan.ParallelPlan` idiom: one
+    door, knob-naming errors, exact JSON round-trip).
+
+    ``block_size``/``num_blocks``/``num_slots``/``prefill_chunk``/
+    ``kv_dtype`` mirror the :class:`~apex_tpu.serving.ServingEngine`
+    constructor; ``max_prefill_share``/``admission``/``slo_*`` drive the
+    scheduler policy; the ``drafter``/``spec_*`` block names the
+    speculative config (``spec_adaptive`` rides the PR-19
+    ``AdaptiveSpecController`` ladder with ``(spec_depth,
+    spec_branching)`` as its ceiling).
+    """
+
+    num_blocks: int
+    block_size: int = 128
+    num_slots: int = 8
+    prefill_chunk: int = 256
+    max_prefill_share: int = 4
+    drafter: str = "none"
+    spec_depth: int = 0
+    spec_branching: int = 1
+    spec_adaptive: bool = False
+    kv_dtype: Optional[str] = None
+    slo_ttft_ms: Optional[float] = None
+    slo_burn_count: int = 3
+    admission: str = "fcfs"
+
+    def __post_init__(self):
+        self.validate()
+
+    # --- validation -----------------------------------------------------------
+
+    def validate(self) -> "ServePlan":
+        """Cross-field legality, one message style: the knob, its
+        value, and the legal values. Raises :class:`PlanError`; returns
+        ``self`` so call sites can chain."""
+        for name, floor in (("block_size", 1), ("num_slots", 1),
+                            ("max_prefill_share", 1),
+                            ("slo_burn_count", 1), ("num_blocks", 2)):
+            v = getattr(self, name)
+            if not isinstance(v, int) or isinstance(v, bool) or v < floor:
+                raise PlanError(
+                    f"{name}={v!r} is not a serving knob value; legal "
+                    f"values are integers >= {floor}"
+                    + (" (one block is the reserved dead block)"
+                       if name == "num_blocks" else ""))
+        if (not isinstance(self.prefill_chunk, int)
+                or isinstance(self.prefill_chunk, bool)
+                or self.prefill_chunk < self.block_size
+                or self.prefill_chunk % self.block_size):
+            raise PlanError(
+                f"prefill_chunk={self.prefill_chunk!r} is not a chunk "
+                f"size; legal values are positive multiples of "
+                f"block_size ({self.block_size}) — chunks write whole "
+                f"blocks")
+        if self.drafter not in DRAFTERS:
+            raise PlanError(
+                f"drafter={self.drafter!r} is not a drafter; legal "
+                f"values are {' / '.join(map(repr, DRAFTERS))}")
+        if not isinstance(self.spec_depth, int) \
+                or isinstance(self.spec_depth, bool) or self.spec_depth < 0:
+            raise PlanError(
+                f"spec_depth={self.spec_depth!r} is not a draft depth; "
+                f"legal values are integers >= 0")
+        if self.drafter == "none":
+            if self.spec_depth or self.spec_branching != 1 \
+                    or self.spec_adaptive:
+                raise PlanError(
+                    f"drafter='none' with spec_depth={self.spec_depth} /"
+                    f" spec_branching={self.spec_branching} / "
+                    f"spec_adaptive={self.spec_adaptive}: a plan without "
+                    f"a drafter has no speculative shape; legal values "
+                    f"are spec_depth=0, spec_branching=1, "
+                    f"spec_adaptive=False")
+        elif self.spec_depth < 1:
+            raise PlanError(
+                f"spec_depth={self.spec_depth} with drafter="
+                f"{self.drafter!r}: a drafting plan needs a draft "
+                f"depth; legal values are integers >= 1")
+        if (not isinstance(self.spec_branching, int)
+                or isinstance(self.spec_branching, bool)
+                or self.spec_branching < 1):
+            raise PlanError(
+                f"spec_branching={self.spec_branching!r} is not a tree "
+                f"branching; legal values are integers >= 1")
+        if self.spec_branching > 1 and self.drafter != "ngram_tree":
+            raise PlanError(
+                f"spec_branching={self.spec_branching} with drafter="
+                f"{self.drafter!r}: only the tree drafter forks; legal "
+                f"values are spec_branching=1 or drafter='ngram_tree'")
+        if self.spec_adaptive and self.drafter != "ngram_tree":
+            raise PlanError(
+                f"spec_adaptive=True with drafter={self.drafter!r}: the "
+                f"adaptive ladder walks (depth, branching) tree choices;"
+                f" legal values are spec_adaptive=False or "
+                f"drafter='ngram_tree'")
+        if self.kv_dtype not in KV_DTYPES:
+            raise PlanError(
+                f"kv_dtype={self.kv_dtype!r} is not a pool "
+                f"quantization; legal values are "
+                f"{' / '.join(map(repr, KV_DTYPES))}")
+        if self.slo_ttft_ms is not None:
+            v = self.slo_ttft_ms
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or not math.isfinite(v) or v <= 0:
+                raise PlanError(
+                    f"slo_ttft_ms={v!r} is not an SLO threshold; legal "
+                    f"values are finite numbers > 0 (or None to disable "
+                    f"burn detection)")
+        if self.admission not in ADMISSIONS:
+            raise PlanError(
+                f"admission={self.admission!r} is not an admission "
+                f"order; legal values are "
+                f"{' / '.join(map(repr, ADMISSIONS))}")
+        return self
+
+    # --- derived facts --------------------------------------------------------
+
+    def describe(self) -> str:
+        """Short human tag: ``blk128·pool41·slot8·chunk256 share4
+        spec[tree d3b2 adaptive] int8 short_first``."""
+        out = (f"blk{self.block_size}·pool{self.num_blocks}"
+               f"·slot{self.num_slots}·chunk{self.prefill_chunk}"
+               f" share{self.max_prefill_share}")
+        if self.drafter != "none":
+            kind = "tree" if self.drafter == "ngram_tree" else "chain"
+            out += (f" spec[{kind} d{self.spec_depth}"
+                    f"b{self.spec_branching}"
+                    + (" adaptive" if self.spec_adaptive else "") + "]")
+        if self.kv_dtype:
+            out += f" {self.kv_dtype}"
+        if self.slo_ttft_ms is not None:
+            out += f" slo{self.slo_ttft_ms:g}"
+        if self.admission != "fcfs":
+            out += f" {self.admission}"
+        return out
+
+    def digest(self) -> str:
+        """Short content hash of the canonical JSON form — the token
+        ``replan`` lifecycle events carry as ``plan_from``/``plan_to``
+        (stable across processes: same knobs → same digest)."""
+        canon = json.dumps(self.to_json(), sort_keys=True)
+        return hashlib.sha256(canon.encode()).hexdigest()[:10]
+
+    def engine_kwargs(self) -> Dict[str, Any]:
+        """The :class:`~apex_tpu.serving.ServingEngine` constructor
+        kwargs this plan pins (all aval-defining — a change here is a
+        DEFERRED knob online)."""
+        return dict(num_slots=self.num_slots, block_size=self.block_size,
+                    num_blocks=self.num_blocks,
+                    prefill_chunk=self.prefill_chunk,
+                    kv_dtype=self.kv_dtype)
+
+    def telemetry_kwargs(self) -> Dict[str, Any]:
+        """The :class:`~apex_tpu.serving.ServeTelemetry` knobs this
+        plan pins (host-side — live online)."""
+        return dict(slo_ttft_ms=self.slo_ttft_ms,
+                    slo_burn_count=self.slo_burn_count)
+
+    # --- serialization --------------------------------------------------------
+
+    def to_json(self) -> Dict[str, Any]:
+        """Plain-JSON dict; exact inverse of :meth:`from_json` (pinned
+        by ``tests/test_serve_plan.py``)."""
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, obj) -> "ServePlan":
+        """Rebuild from :meth:`to_json` output (dict or JSON string).
+        Unknown keys are an error — a junk plan must not half-load."""
+        if isinstance(obj, str):
+            obj = json.loads(obj)
+        if not isinstance(obj, dict):
+            raise PlanError(f"a serve plan serializes as a JSON object, "
+                            f"got {type(obj).__name__}")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(obj) - known)
+        if unknown:
+            raise PlanError(
+                f"unknown serve plan field(s) {unknown}; legal fields "
+                f"are {sorted(known)}")
+        return cls(**obj)
+
+
+def split_knob_changes(old: ServePlan, new: ServePlan
+                       ) -> Tuple[Dict[str, Tuple[Any, Any]],
+                                  Dict[str, Tuple[Any, Any]]]:
+    """``(live, deferred)`` knob diffs between two plans, each a
+    ``{field: (old_value, new_value)}`` dict.
+
+    LIVE diffs are aval-stable: prefill share, SLO thresholds, and
+    admission order change only host-side dispatch of the same two
+    compiled programs. A spec-SHAPE diff is live exactly when BOTH
+    plans run the adaptive tree ladder with the same drafter — the
+    ``AdaptiveSpecController`` already walks a static choice set whose
+    every (depth, branching) is a pre-compiled program, so moving its
+    ceiling re-weights the ladder without a new trace. Everything else
+    (pool geometry, chunk size, drafter identity, quantization) changes
+    compiled avals or the pool layout and is DEFERRED: reported at the
+    re-plan boundary, applied only through an engine rebuild."""
+    live: Dict[str, Tuple[Any, Any]] = {}
+    deferred: Dict[str, Tuple[Any, Any]] = {}
+    for name in LIVE_KNOBS:
+        a, b = getattr(old, name), getattr(new, name)
+        if a != b:
+            live[name] = (a, b)
+    shape_live = (old.spec_adaptive and new.spec_adaptive
+                  and old.drafter == new.drafter)
+    for name in DEFERRED_KNOBS:
+        a, b = getattr(old, name), getattr(new, name)
+        if a == b:
+            continue
+        if shape_live and name in ("spec_depth", "spec_branching"):
+            live[name] = (a, b)
+        else:
+            deferred[name] = (a, b)
+    return live, deferred
+
+
+# --- costs --------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ServeCosts:
+    """Per-phase rates the trace-replay simulator charges, plus the
+    model geometry the KV-byte terms need. ``uncalibrated`` lists the
+    terms no source measured (priced conservatively, never silently);
+    ``spec_uncalibrated`` holds the spec-only blind spots — they join a
+    price's flags only when the priced plan actually drafts."""
+
+    prefill_ms_per_token: float
+    decode_ms_per_step: float
+    decode_ms_per_row: float
+    hbm_bytes_per_s: float
+    spec_round_ms: float
+    spec_acceptance: float
+    num_layers: int
+    kv_heads: int
+    head_dim: int
+    uncalibrated: Tuple[str, ...] = ()
+    spec_uncalibrated: Tuple[str, ...] = ()
+
+    def bytes_per_ctx_token(self, kv_dtype: Optional[str]) -> int:
+        """KV bytes one decode step streams per context token (k+v
+        across the stack; int8/fp8 pools store 1-byte elements, int8
+        additionally pays its per-block-row fp32 scale planes — the
+        same arithmetic as :func:`~apex_tpu.plan.cost.kv_pool_bytes`,
+        per token instead of per pool)."""
+        elem = 1 if kv_dtype in ("int8", "fp8_e4m3") else 2
+        per = 2 * self.num_layers * self.kv_heads * self.head_dim * elem
+        if kv_dtype == "int8":
+            per += 2 * self.num_layers * 4
+        return per
+
+
+def derive_serve_costs(costdb: Dict[str, Any], *, hidden_size: int,
+                       num_layers: int, num_heads: int, vocab_size: int,
+                       head_dim: Optional[int] = None,
+                       measured: Optional[Dict[str, float]] = None,
+                       default_bytes_per_s: Optional[float] = None,
+                       default_flops_per_s: Optional[float] = None
+                       ) -> ServeCosts:
+    """Per-phase serving costs from the CostDB plus measured serve
+    telemetry. ``measured`` carries the terms a real serve run
+    produced (keys: ``prefill_ms_per_token``, ``decode_ms_per_step``,
+    ``hbm_bytes_per_s``, ``spec_round_ms``, ``spec_acceptance_rate`` —
+    the ``bench.py --serve`` attribution/record surface); every term
+    NEITHER source measured lands in ``uncalibrated`` and is priced at
+    a conservative default (the :func:`~apex_tpu.plan.cost
+    .conservative_defaults` family floor, or zero speculative benefit)
+    so a blind spot penalizes, never flatters, the plans that lean on
+    it."""
+    measured = dict(measured or {})
+    defaults = conservative_defaults(costdb)
+    if default_bytes_per_s is None:
+        default_bytes_per_s = defaults["default_bytes_per_s"]
+    if default_flops_per_s is None:
+        default_flops_per_s = defaults["default_flops_per_s"]
+    head_dim = head_dim or hidden_size // num_heads
+    uncal: List[str] = []
+    spec_uncal: List[str] = []
+
+    # forward FLOPs per token: the 12·H² layer GEMM block + vocab head
+    flops_per_token = float(
+        2 * (12 * num_layers * hidden_size * hidden_size
+             + hidden_size * vocab_size))
+    cls = f"gemm_{1 << max(0, round(math.log2(flops_per_token)))}"
+    gemm_rate, _exact = _nearest_gemm_rate(
+        costdb.get("gemms", {}) or {}, cls)
+    if gemm_rate is None:
+        uncal.append("serve[gemm_flops_per_s]")
+        gemm_rate = default_flops_per_s
+    gemm_ms_per_token = 1e3 * flops_per_token / gemm_rate
+
+    if "prefill_ms_per_token" in measured:
+        prefill = float(measured["prefill_ms_per_token"])
+    else:
+        prefill = gemm_ms_per_token
+    decode_row = gemm_ms_per_token
+    if "decode_ms_per_step" in measured:
+        step = float(measured["decode_ms_per_step"])
+    else:
+        # floor: one dispatch costs at least one row's GEMM work
+        uncal.append("serve[decode_step_ms]")
+        step = decode_row
+    if "hbm_bytes_per_s" in measured:
+        hbm = float(measured["hbm_bytes_per_s"])
+    else:
+        # slowest measured collective rate: a pessimistic stream rate
+        # penalizes the plans whose KV traffic was never measured
+        uncal.append("serve[hbm_bytes_per_s]")
+        hbm = default_bytes_per_s
+    if "spec_round_ms" in measured:
+        spec_round = float(measured["spec_round_ms"])
+    else:
+        spec_uncal.append("serve[spec_round_ms]")
+        spec_round = step
+    if "spec_acceptance_rate" in measured:
+        acceptance = float(measured["spec_acceptance_rate"])
+    else:
+        # zero benefit on purpose: an unmeasured acceptance rate must
+        # never let a spec plan win the search
+        spec_uncal.append("serve[spec_acceptance_rate]")
+        acceptance = 0.0
+    return ServeCosts(
+        prefill_ms_per_token=prefill, decode_ms_per_step=step,
+        decode_ms_per_row=decode_row, hbm_bytes_per_s=hbm,
+        spec_round_ms=spec_round, spec_acceptance=acceptance,
+        num_layers=num_layers, kv_heads=num_heads, head_dim=head_dim,
+        uncalibrated=tuple(sorted(set(uncal))),
+        spec_uncalibrated=tuple(sorted(set(spec_uncal))))
+
+
+# --- the trace-replay discrete-event model ------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ServePrice:
+    """One plan's predicted serving outcome on one trace.
+    ``uncalibrated`` is the confidence surface, same contract as
+    :class:`~apex_tpu.plan.cost.PlanPrice` (empty ⇒ ``"calibrated"``)."""
+
+    plan: ServePlan
+    predicted_tokens_per_s: float
+    predicted_ttft_p50_ms: float
+    predicted_ttft_p99_ms: float
+    predicted_kv_pool_mb: float
+    decode_steps: int
+    prefill_chunks: int
+    sim_span_ms: float
+    uncalibrated: Tuple[str, ...]
+
+    @property
+    def confidence(self) -> str:
+        return "calibrated" if not self.uncalibrated else "partial"
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "plan": self.plan.to_json(),
+            "digest": self.plan.digest(),
+            "predicted_tokens_per_s": round(
+                self.predicted_tokens_per_s, 3),
+            "predicted_ttft_p50_ms": round(self.predicted_ttft_p50_ms, 3),
+            "predicted_ttft_p99_ms": round(self.predicted_ttft_p99_ms, 3),
+            "predicted_kv_pool_mb": round(self.predicted_kv_pool_mb, 3),
+            "confidence": self.confidence,
+            "uncalibrated": list(self.uncalibrated),
+            "decode_steps": self.decode_steps,
+            "prefill_chunks": self.prefill_chunks,
+            "sim_span_ms": round(self.sim_span_ms, 3),
+        }
+
+
+class _SimStream:
+    __slots__ = ("rid", "prompt_len", "max_new", "arrival_ms", "worst",
+                 "blocks", "prefilled", "generated", "first_token_ms",
+                 "prompt")
+
+    def __init__(self, req, block_size: int):
+        self.rid = int(req.rid)
+        self.prompt = req.prompt
+        self.prompt_len = int(len(req.prompt))
+        self.max_new = int(req.max_new_tokens)
+        self.arrival_ms = 1e3 * float(getattr(req, "arrival_s", 0.0))
+        rows = self.prompt_len + max(self.max_new - 1, 0)
+        self.worst = -(-rows // block_size)
+        self.prefilled = 0
+        self.generated = 0.0
+        self.first_token_ms: Optional[float] = None
+
+
+def _quantile(xs: Sequence[float], q: float) -> float:
+    s = sorted(xs)
+    i = min(len(s) - 1, max(0, int(math.ceil(q * len(s))) - 1))
+    return s[i]
+
+
+def price_serve_plan(plan: ServePlan, trace: Sequence[Any],
+                     costs: ServeCosts) -> ServePrice:
+    """Replay ``trace`` through a host-side discrete-event model of the
+    engine loop under ``plan`` and price every phase from ``costs``.
+
+    The model is the engine's dispatch loop with two DOCUMENTED
+    conservative simplifications: admission reserves each request's
+    WORST-CASE block count (so preemption never has to appear in
+    simulated time — the real optimistic gate admits deeper, making
+    the prediction a floor, not a flatter), and the prefix cache is
+    structural (a set of full-block token runs already prefilled this
+    trace — capacity eviction is not modeled). Everything else follows
+    the scheduler: FCFS (or shortest-first) admission into free slots,
+    up to ``max_prefill_share`` chunks per iteration while a queue is
+    pending (one otherwise — the SLOPolicy steady state), the first
+    token sampled by the final prefill chunk, one batched decode step
+    per iteration charging ``decode_ms_per_step`` plus each live row's
+    GEMM and KV-stream bytes, and — under a drafting plan — one spec
+    round per row per step emitting ``1 + acceptance·depth`` expected
+    tokens against ``spec_round_ms`` overhead.
+
+    Deterministic by construction (no clock, no RNG: same inputs →
+    same bits) and monotone in every rate (a slower priced phase never
+    predicts higher tokens/s) — both pinned by
+    ``tests/test_serve_plan.py``."""
+    B = plan.block_size
+    pool_cap = plan.num_blocks - 1
+    streams = [_SimStream(r, B) for r in trace]
+    if not streams:
+        raise PlanError("price_serve_plan needs a non-empty trace; an "
+                        "empty one prices nothing")
+    for s in streams:
+        if s.worst > pool_cap:
+            raise PlanError(
+                f"request {s.rid}: worst case needs {s.worst} blocks "
+                f"but num_blocks={plan.num_blocks} leaves {pool_cap} "
+                f"allocatable; raise num_blocks to >= {s.worst + 1} or "
+                f"drop the request from the trace")
+    pending: List[_SimStream] = sorted(
+        streams, key=lambda s: (s.arrival_ms, s.rid))
+    slots: List[_SimStream] = []
+    seen_blocks: set = set()
+    t = 0.0
+    free_blocks = pool_cap
+    ttfts: List[float] = []
+    decode_steps = 0
+    prefill_chunks = 0
+    spec = plan.drafter != "none"
+    emit = 1.0 + (costs.spec_acceptance * plan.spec_depth if spec
+                  else 0.0)
+    ctx_ms = (1e3 * costs.bytes_per_ctx_token(plan.kv_dtype)
+              / costs.hbm_bytes_per_s)
+    # progress guard: every iteration either admits, prefills a chunk,
+    # decodes a step, or jumps the clock to an arrival — bounded by the
+    # trace's total work, so exceeding this is a simulator bug
+    budget = 1000 + sum(4 + s.prompt_len // max(plan.prefill_chunk, 1)
+                        + s.max_new for s in streams)
+    while pending or slots:
+        budget -= 1
+        if budget < 0:
+            raise RuntimeError(
+                "trace-replay simulator failed to make progress "
+                "(model bug — please report the plan + trace)")
+        progressed = False
+        # --- admission: arrived requests into free slots against the
+        # worst-case reservation; order per the plan's admission knob,
+        # blocked head holds the line (the scheduler's FCFS rule)
+        arrived = [s for s in pending if s.arrival_ms <= t]
+        if plan.admission == "short_first":
+            arrived.sort(key=lambda s: (s.prompt_len + s.max_new, s.rid))
+        for s in arrived:
+            if len(slots) >= plan.num_slots:
+                break
+            if s.worst > free_blocks:
+                break
+            free_blocks -= s.worst
+            shared_cap = (s.prompt_len - 1) // B
+            shared = 0
+            while (shared < shared_cap and tuple(
+                    int(x) for x in s.prompt[shared * B:(shared + 1) * B]
+                    ) in seen_blocks):
+                shared += 1
+            s.prefilled = shared * B
+            pending.remove(s)
+            slots.append(s)
+            progressed = True
+        # --- chunked prefill: up to `share` chunks while a queue is
+        # pending (the SLOPolicy widened state), one otherwise
+        share = (plan.max_prefill_share
+                 if any(s.arrival_ms <= t for s in pending) else 1)
+        for _ in range(share):
+            target = next((s for s in slots
+                           if s.prefilled < s.prompt_len), None)
+            if target is None:
+                break
+            live = min(plan.prefill_chunk,
+                       target.prompt_len - target.prefilled)
+            t += live * costs.prefill_ms_per_token
+            prefill_chunks += 1
+            target.prefilled += live
+            progressed = True
+            if target.prefilled >= target.prompt_len:
+                # the final chunk's last-row logits sample token #1
+                target.generated = 1.0
+                target.first_token_ms = t
+                ttfts.append(t - target.arrival_ms)
+                for k in range((target.prompt_len - 1) // B):
+                    seen_blocks.add(tuple(
+                        int(x) for x in target.prompt[k * B:(k + 1) * B]))
+        # --- one batched decode step over every decoding row
+        decoding = [s for s in slots
+                    if s.prefilled >= s.prompt_len
+                    and s.generated < s.max_new]
+        if decoding:
+            step_ms = costs.decode_ms_per_step
+            for s in decoding:
+                ctx = s.prompt_len + s.generated
+                step_ms += costs.decode_ms_per_row + ctx * ctx_ms
+                if spec:
+                    step_ms += costs.spec_round_ms
+            t += step_ms
+            decode_steps += 1
+            for s in decoding:
+                s.generated = min(float(s.max_new), s.generated + emit)
+            progressed = True
+        # --- retire finished streams (free their reservation)
+        for s in [s for s in slots if s.generated >= s.max_new]:
+            free_blocks += s.worst
+            slots.remove(s)
+            progressed = True
+        if not progressed:
+            # idle: jump the clock to the next arrival
+            t = max(t, min(s.arrival_ms for s in pending))
+    total_tokens = sum(s.max_new for s in streams)
+    span_ms = max(t, 1e-9)
+    pool_mb = kv_pool_bytes(
+        costs.num_layers, plan.num_blocks, costs.kv_heads, B,
+        costs.head_dim, kv_dtype=plan.kv_dtype or "bf16") / 2 ** 20
+    uncal = costs.uncalibrated + (costs.spec_uncalibrated if spec
+                                  else ())
+    return ServePrice(
+        plan=plan,
+        predicted_tokens_per_s=1e3 * total_tokens / span_ms,
+        predicted_ttft_p50_ms=_quantile(ttfts, 0.5),
+        predicted_ttft_p99_ms=_quantile(ttfts, 0.99),
+        predicted_kv_pool_mb=pool_mb,
+        decode_steps=decode_steps, prefill_chunks=prefill_chunks,
+        sim_span_ms=span_ms,
+        uncalibrated=tuple(sorted(set(uncal))))
+
+
+# --- search -------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ServeCandidate:
+    plan: ServePlan
+    price: ServePrice
+
+    def to_json(self) -> Dict[str, Any]:
+        return self.price.to_json()
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeSearchResult:
+    """Ranked feasible serve plans (best first) + rejected corners."""
+
+    requests: int
+    ranked: Tuple[ServeCandidate, ...]
+    rejected: Tuple[Tuple[str, str], ...]  # (plan description, reason)
+
+    @property
+    def best(self) -> ServeCandidate:
+        if not self.ranked:
+            raise PlanError(
+                f"no feasible serve plan for the {self.requests}-request"
+                f" trace; rejected: "
+                + "; ".join(f"{d} ({r})" for d, r in self.rejected[:8]))
+        return self.ranked[0]
+
+
+def enumerate_serve_plans(base: ServePlan
+                          ) -> Tuple[List[ServePlan],
+                                     List[Tuple[str, str]]]:
+    """The candidate grid around ``base``: slots × pool depth × chunk
+    size × prefill share × admission order × spec on/off, with the
+    aval-heaviest knobs (block_size, kv_dtype) held at the base's —
+    they re-price through the same model but rebuilding the engine for
+    them is the deploy-time decision, and the grid stays small enough
+    to replay a trace through every cell. Deterministic order; corners
+    :class:`ServePlan` itself refuses come back as rejections."""
+    plans: List[ServePlan] = []
+    rejected: List[Tuple[str, str]] = []
+    seen: set = set()
+    spec_off = dict(drafter="none", spec_depth=0, spec_branching=1,
+                    spec_adaptive=False)
+    spec_variants = [spec_off]
+    if base.drafter != "none":
+        spec_variants.insert(0, dict(
+            drafter=base.drafter, spec_depth=base.spec_depth,
+            spec_branching=base.spec_branching,
+            spec_adaptive=base.spec_adaptive))
+    chunks = sorted({base.block_size, base.prefill_chunk,
+                     2 * base.prefill_chunk})
+    for slots in (base.num_slots, 2 * base.num_slots):
+        for blocks in (base.num_blocks, 2 * base.num_blocks):
+            for chunk in chunks:
+                for share in (1, 2, 4):
+                    for admission in ADMISSIONS:
+                        for sv in spec_variants:
+                            try:
+                                p = dataclasses.replace(
+                                    base, num_slots=slots,
+                                    num_blocks=blocks,
+                                    prefill_chunk=chunk,
+                                    max_prefill_share=share,
+                                    admission=admission, **sv)
+                            except PlanError as e:
+                                key = (f"slot{slots}·pool{blocks}"
+                                       f"·chunk{chunk}")
+                                if key not in seen:
+                                    seen.add(key)
+                                    rejected.append((key, str(e)))
+                                continue
+                            tag = p.describe()
+                            if tag not in seen:
+                                seen.add(tag)
+                                plans.append(p)
+    return plans, rejected
+
+
+def search_serve_plans(trace: Sequence[Any], costs: ServeCosts, *,
+                       base: Optional[ServePlan] = None,
+                       candidates: Optional[Sequence[ServePlan]] = None,
+                       pool_bytes_bound: Optional[int] = None
+                       ) -> ServeSearchResult:
+    """Enumerate (around ``base``, or the explicit ``candidates``) →
+    filter feasibility → replay-price every survivor → rank by
+    predicted tokens/s, ties on TTFT p50 then the describe string.
+    Deterministic end to end: the grid order is fixed and pricing is
+    bit-deterministic. A pool too small for the trace's largest
+    request, or over ``pool_bytes_bound``, is a rejection with a
+    reason — never a silently skipped corner."""
+    if candidates is None:
+        if base is None:
+            raise PlanError("search_serve_plans needs a base plan or an "
+                            "explicit candidate list")
+        plans, rejected = enumerate_serve_plans(base)
+    else:
+        plans, rejected = list(candidates), []
+    if not trace:
+        raise PlanError("search_serve_plans needs a non-empty trace; an "
+                        "empty one prices nothing")
+    rows = max(len(r.prompt) + max(int(r.max_new_tokens) - 1, 0)
+               for r in trace)
+    ranked: List[ServeCandidate] = []
+    for plan in plans:
+        need = -(-rows // plan.block_size)
+        if need > plan.num_blocks - 1:
+            rejected.append(
+                (plan.describe(),
+                 f"the trace's largest request needs {need} blocks but "
+                 f"num_blocks={plan.num_blocks} leaves "
+                 f"{plan.num_blocks - 1} allocatable; it could never "
+                 f"be admitted"))
+            continue
+        if pool_bytes_bound is not None:
+            pool = kv_pool_bytes(
+                costs.num_layers, plan.num_blocks, costs.kv_heads,
+                plan.block_size, costs.head_dim,
+                kv_dtype=plan.kv_dtype or "bf16")
+            if pool > pool_bytes_bound:
+                rejected.append(
+                    (plan.describe(),
+                     f"predicted KV pool {pool / 2**20:.0f} MB exceeds "
+                     f"the bound {pool_bytes_bound / 2**20:.0f} MB"))
+                continue
+        try:
+            price = price_serve_plan(plan, trace, costs)
+        except PlanError as e:
+            rejected.append((plan.describe(), str(e)))
+            continue
+        ranked.append(ServeCandidate(plan, price))
+    ranked.sort(key=lambda c: (-c.price.predicted_tokens_per_s,
+                               c.price.predicted_ttft_p50_ms,
+                               c.plan.describe()))
+    return ServeSearchResult(requests=len(trace), ranked=tuple(ranked),
+                             rejected=tuple(rejected))
+
+
+def serve_plan_record_fields(result: ServeSearchResult, *,
+                             costdb_source: str, top_n: int = 8,
+                             measured_tokens_per_s: Optional[float] = None,
+                             measured_ttft_p50_ms: Optional[float] = None,
+                             skip_reason: Optional[str] = None
+                             ) -> Dict[str, Any]:
+    """The ``serve_plan`` record's field dict (caller adds the hand-
+    config comparison, the replan witnesses, and status/reason, then
+    emits through ``MetricsRegistry.emit_serve_plan``). The measured
+    half rides as an explicit ``('skipped', reason)`` when no honest
+    measurement exists (off-TPU) — never nan."""
+    best = result.best
+    fields: Dict[str, Any] = {
+        "searched": len(result.ranked) + len(result.rejected),
+        "feasible": len(result.ranked),
+        "requests": result.requests,
+        "chosen": best.plan.to_json(),
+        "chosen_describe": best.plan.describe(),
+        "chosen_digest": best.plan.digest(),
+        "predicted_tokens_per_s": round(
+            best.price.predicted_tokens_per_s, 3),
+        "predicted_ttft_p50_ms": round(
+            best.price.predicted_ttft_p50_ms, 3),
+        "predicted_ttft_p99_ms": round(
+            best.price.predicted_ttft_p99_ms, 3),
+        "predicted_kv_pool_mb": round(best.price.predicted_kv_pool_mb, 3),
+        "confidence": best.price.confidence,
+        "uncalibrated": list(best.price.uncalibrated),
+        "ranking": [c.to_json() for c in result.ranked[:top_n]],
+        "rejected": [{"plan": d, "reason": r}
+                     for d, r in result.rejected[:top_n]],
+        "costdb_source": costdb_source,
+    }
+    if measured_tokens_per_s is not None:
+        err = (100.0 * (best.price.predicted_tokens_per_s
+                        - measured_tokens_per_s) / measured_tokens_per_s)
+        fields["measured_tokens_per_s"] = round(measured_tokens_per_s, 3)
+        fields["predicted_vs_measured_err_pct"] = round(abs(err), 3)
+        if measured_ttft_p50_ms is not None:
+            fields["measured_ttft_p50_ms"] = round(
+                measured_ttft_p50_ms, 3)
+    else:
+        reason = skip_reason or "no measured serve run supplied"
+        fields["measured_tokens_per_s"] = ("skipped", reason)
+        fields["measured_ttft_p50_ms"] = ("skipped", reason)
+        fields["predicted_vs_measured_err_pct"] = ("skipped", reason)
+    return fields

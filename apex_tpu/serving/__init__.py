@@ -101,6 +101,7 @@ from apex_tpu.serving.kv_blocks import (  # noqa: F401
     blocks_needed,
 )
 from apex_tpu.serving.scheduler import (  # noqa: F401
+    ReplanPolicy,
     Request,
     Scheduler,
     SLOPolicy,

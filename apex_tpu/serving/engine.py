@@ -1647,6 +1647,20 @@ class ServingEngine:
                     # window edge: fold the fresh SLO/anomaly signals
                     # into the dispatch knobs (SLO-aware scheduling)
                     policy.update(tel)
+                    pop = getattr(policy, "pop_replan", None)
+                    staged = pop() if pop is not None else None
+                    if staged is not None:
+                        # an online re-plan landed: the aval-stable
+                        # knobs (share bound, admission order, SLO
+                        # thresholds) are already applied in update();
+                        # a spec-shape diff caps the adaptive ladder on
+                        # its PRE-COMPILED choice set; aval-changing
+                        # knobs ride the event as deferred_knobs —
+                        # reported, never applied mid-serve
+                        shape = staged.pop("spec_shape", None)
+                        if shape is not None and adaptive is not None:
+                            adaptive.set_cap(shape)
+                        tel.on_replan(nstep, now(), **staged)
             if not did_work and wall:
                 # nothing runnable: only future arrivals remain
                 time.sleep(1e-4)

@@ -150,9 +150,130 @@ class SLOPolicy:
             self.prefill_share = min(self.max_prefill_share,
                                      self.prefill_share + 1)
         else:
+            # narrow on ANY window without queue buildup — NOT only on
+            # fully-clean ones: a persistent benign anomaly (e.g. one
+            # straggler flag per window) must never pin the share at
+            # max forever (regression-tested)
             self.prefill_share = max(1, self.prefill_share - 1)
         if (self.prefer_short_prompts, self.prefill_share) != before:
             self.adjustments += 1
+
+
+@dataclasses.dataclass
+class ReplanPolicy(SLOPolicy):
+    """Online re-planning: :class:`SLOPolicy` generalized from one
+    adapted knob to a LADDER of priced ServePlan configurations
+    (:mod:`apex_tpu.plan.serve`), swapped at telemetry window edges
+    under load shifts — the AMP discipline (a configuration is a priced
+    choice) applied online, with the veScale constraint (semantics
+    equal to the baseline) enforced by construction:
+
+    * ``plans`` is ordered calm → loaded (e.g. the top two of a
+      ``search_serve_plans`` ranking). Queue buildup or a TTFT burn
+      steps UP the ladder; ``calm_windows`` consecutive windows with
+      neither signal step back DOWN.
+    * On a switch only the AVAL-STABLE knob diffs apply live
+      (:func:`~apex_tpu.plan.serve.split_knob_changes`): prefill
+      share, admission order, SLO thresholds, and — between adaptive
+      tree plans — the spec-shape ceiling on the controller's
+      pre-compiled ladder. They change host-side dispatch ORDER and
+      REPETITION only, so both jit caches stay at one executable and
+      greedy output is token-identical across the switch (pinned by
+      ``tests/test_serve_plan.py``).
+    * Aval-CHANGING diffs (block/pool/slot/chunk sizing, drafter
+      identity, kv_dtype) are DEFERRED: counted, named on the
+      ``replan`` lifecycle event, and left for a ``request_swap``-style
+      engine rebuild — never applied mid-serve.
+
+    The base-class dynamics keep running WITHIN the active plan (the
+    share still widens/narrows per window, bounded by the active
+    plan's ``max_prefill_share``).
+    """
+
+    plans: tuple = ()
+    active: int = 0
+    calm_windows: int = 2        # clean windows before stepping down
+    replans: int = 0             # ladder switches taken
+    deferred_total: int = 0      # aval-changing knob diffs reported
+    _clean_streak: int = dataclasses.field(default=0, repr=False)
+    _staged: Optional[dict] = dataclasses.field(default=None, repr=False)
+
+    def __post_init__(self):
+        if not self.plans:
+            raise ValueError(
+                "ReplanPolicy needs at least one priced ServePlan, "
+                "ordered calm -> loaded (e.g. search_serve_plans(...)"
+                ".ranked[:2] plans)")
+        self.plans = tuple(self.plans)
+        if not 0 <= self.active < len(self.plans):
+            raise ValueError(
+                f"active={self.active} is not a plan index; legal "
+                f"values are 0..{len(self.plans) - 1}")
+        self._apply_live(self.plans[self.active], None)
+
+    @property
+    def plan(self):
+        """The active :class:`~apex_tpu.plan.serve.ServePlan`."""
+        return self.plans[self.active]
+
+    def _apply_live(self, plan, tel) -> None:
+        """Apply ``plan``'s aval-stable knobs: the share bound (+clamp),
+        the admission order, and — when a telemetry is attached — the
+        SLO thresholds its burn detector keys on."""
+        self.max_prefill_share = int(plan.max_prefill_share)
+        self.prefill_share = min(self.prefill_share,
+                                 self.max_prefill_share)
+        if plan.admission == "short_first":
+            self.prefer_short_prompts = True
+        if tel is not None:
+            tel.slo_ttft_ms = plan.slo_ttft_ms
+            tel.slo_burn_count = int(plan.slo_burn_count)
+
+    def update(self, tel) -> None:
+        burning = bool(getattr(tel, "slo_burning", False))
+        buildup = bool(getattr(tel, "queue_buildup", False))
+        super().update(tel)
+        if self.plan.admission == "short_first":
+            # the plan pins shortest-first regardless of burn state
+            # (super().update keys it off the live burn signal)
+            self.prefer_short_prompts = True
+        if buildup or burning:
+            self._clean_streak = 0
+            if self.active + 1 < len(self.plans):
+                self._switch(self.active + 1,
+                             "queue_buildup" if buildup else "slo_burn",
+                             tel)
+        else:
+            self._clean_streak += 1
+            if self._clean_streak >= self.calm_windows and self.active:
+                self._clean_streak = 0
+                self._switch(self.active - 1, "calm", tel)
+
+    def _switch(self, idx: int, trigger: str, tel) -> None:
+        from apex_tpu.plan.serve import split_knob_changes
+
+        old, new = self.plans[self.active], self.plans[idx]
+        live, deferred = split_knob_changes(old, new)
+        self.active = idx
+        self.replans += 1
+        self.adjustments += 1
+        self.deferred_total += len(deferred)
+        self._apply_live(new, tel)
+        spec_shape = None
+        if "spec_depth" in live or "spec_branching" in live:
+            spec_shape = (new.spec_depth, new.spec_branching)
+        self._staged = dict(
+            plan_from=old.digest(), plan_to=new.digest(),
+            trigger=trigger, live_knobs=sorted(live),
+            deferred_knobs=sorted(deferred), spec_shape=spec_shape)
+
+    def pop_replan(self) -> Optional[dict]:
+        """The staged switch of the update that just ran (or None).
+        The engine pops it at the window edge to cap the adaptive spec
+        ladder and fire the ``replan`` lifecycle event — at most one
+        switch is staged per window."""
+        staged, self._staged = self._staged, None
+        return staged
 
 
 @dataclasses.dataclass

@@ -62,7 +62,7 @@ __all__ = ["ServeTelemetry"]
 # ENGINE-level transition, rid -1 — a weight hot-swap landed between
 # dispatch steps)
 PHASES = ("submit", "admit", "prefill_chunk", "first_token", "decode",
-          "finish", "evict", "swap", "spec", "handoff")
+          "finish", "evict", "swap", "spec", "handoff", "replan")
 
 
 class _InFlight:
@@ -177,6 +177,8 @@ class ServeTelemetry:
         self.prefix_miss_requests = 0
         # weight hot-swaps applied between dispatch steps (ISSUE 14)
         self.swaps = 0
+        # online re-plans: ReplanPolicy ladder switches at window edges
+        self.replans = 0
         # disaggregated prefill→decode handoff legs this engine played
         # (either role): block/byte totals feed the tp_serve record
         self.handoffs = 0
@@ -329,6 +331,34 @@ class ServeTelemetry:
         fields = dict(rid=-1, phase="swap", at_s=now, step=int(step))
         if source:
             fields["swap_source"] = str(source)
+        if dur_ms is not None:
+            fields["dur_ms"] = round(float(dur_ms), 3)
+        self._emit("serve_event", **fields)
+        self.overhead_ns += _mono() - t
+
+    def on_replan(self, step: int, now: float, *, plan_from: str,
+                  plan_to: str, trigger: str,
+                  live_knobs: Optional[list] = None,
+                  deferred_knobs: Optional[list] = None,
+                  dur_ms: Optional[float] = None) -> None:
+        """An online re-plan landed at a window edge (rid -1,
+        engine-level, like ``swap``): the :class:`~apex_tpu.serving
+        .scheduler.ReplanPolicy` switched the active ServePlan.
+        ``plan_from``/``plan_to`` are plan content digests and
+        ``trigger`` names the load signal (``queue_buildup`` /
+        ``slo_burn`` / ``calm``); ``live_knobs`` lists the aval-stable
+        diffs applied in place, ``deferred_knobs`` the aval-changing
+        diffs REPORTED but not applied (they wait for a
+        ``request_swap``-style engine rebuild)."""
+        t = _mono()
+        self.replans += 1
+        fields = dict(rid=-1, phase="replan", at_s=now, step=int(step),
+                      plan_from=str(plan_from), plan_to=str(plan_to),
+                      replan_trigger=str(trigger))
+        if live_knobs:
+            fields["live_knobs"] = [str(k) for k in live_knobs]
+        if deferred_knobs:
+            fields["deferred_knobs"] = [str(k) for k in deferred_knobs]
         if dur_ms is not None:
             fields["dur_ms"] = round(float(dur_ms), 3)
         self._emit("serve_event", **fields)
@@ -726,6 +756,7 @@ class ServeTelemetry:
                                 self.preemptions),
             recompute_tokens=getattr(scheduler, "recompute_tokens", 0),
             swaps=self.swaps,
+            replans=self.replans,
             blocks_resident=resident,
             # speculative serving: acceptance accounting (only when spec
             # rounds actually ran — a plain serve record stays unchanged)

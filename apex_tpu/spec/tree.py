@@ -480,6 +480,10 @@ class AdaptiveSpecController:
         #            "since": rounds since last adjustment}
         self._streams: Dict[int, Dict[str, Any]] = {}
         self.adjustments = 0
+        # optional ladder CEILING index (set_cap): an online re-plan
+        # bounds how deep the ladder may walk without adding any new
+        # compiled shape — every choice stays one of the pinned set
+        self.cap: Optional[int] = None
 
     def _state(self, stream: int) -> Dict[str, Any]:
         st = self._streams.get(stream)
@@ -502,6 +506,31 @@ class AdaptiveSpecController:
         idx = min(self._state(s)["idx"] for s in streams)
         return self.choices[idx]
 
+    def set_cap(self, shape) -> int:
+        """Pin the ladder's CEILING to one of the pre-validated
+        ``choices`` (or lift it with ``None``): streams above the cap
+        clamp down NOW, and :meth:`note_round` never steps past it.
+        This is the aval-stable spec-shape knob an online re-plan
+        (:class:`~apex_tpu.serving.scheduler.ReplanPolicy`) applies
+        live — the dispatched shape stays one of the compiled set, so
+        no new program is ever traced mid-serve. Returns the cap
+        index."""
+        if shape is None:
+            self.cap = None
+            return len(self.choices) - 1
+        shape = (int(shape[0]), int(shape[1]))
+        if shape not in self.choices:
+            raise ValueError(
+                f"cap shape {shape} is not one of this controller's "
+                f"choices {self.choices} — a cap outside the compiled "
+                f"set would force a new trace mid-serve")
+        self.cap = self.choices.index(shape)
+        for st in self._streams.values():
+            if st["idx"] > self.cap:
+                st["idx"] = self.cap
+                st["since"] = 0
+        return self.cap
+
     def note_round(self, stream: int, accepted: int, depth: int) -> None:
         """Feed one round's verdict (the numbers ``on_spec_round``
         gets) and maybe adjust the stream's choice."""
@@ -514,7 +543,8 @@ class AdaptiveSpecController:
             return
         drafted = sum(d for _, d in st["hist"])
         rate = sum(a for a, _ in st["hist"]) / max(drafted, 1)
-        if rate >= self.hi and st["idx"] < len(self.choices) - 1:
+        top = len(self.choices) - 1 if self.cap is None else self.cap
+        if rate >= self.hi and st["idx"] < top:
             st["idx"] += 1
             st["since"] = 0
             self.adjustments += 1

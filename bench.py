@@ -616,6 +616,292 @@ def serve_main():
     print(json.dumps(record))
 
 
+def build_load_shift_trace(seed, n_calm, calm_rps, n_burst, burst_rps,
+                           vocab, prompt_rng, newtok_rng,
+                           sys_prompt_len=0, gap_s=0.05):
+    """A two-regime serve trace, fully determined by ``seed``: a CALM
+    segment at ``calm_rps`` followed (after ``gap_s``) by a BURST
+    segment at ``burst_rps`` — the load shift the online
+    :class:`~apex_tpu.serving.ReplanPolicy` exists for. Rids are
+    contiguous across the two segments; same seed → token-identical
+    trace (the replay fixture ``tests/test_serve_plan.py`` prices)."""
+    from apex_tpu.serving import Request
+
+    calm = build_serve_trace(seed, n_calm, calm_rps, vocab, prompt_rng,
+                             newtok_rng, sys_prompt_len=sys_prompt_len)
+    burst = build_serve_trace(seed + 1, n_burst, burst_rps, vocab,
+                              prompt_rng, newtok_rng,
+                              sys_prompt_len=sys_prompt_len)
+    offset = (calm[-1].arrival_s if calm else 0.0) + gap_s
+    shifted = [Request(rid=n_calm + i, prompt=r.prompt,
+                       max_new_tokens=r.max_new_tokens,
+                       arrival_s=float(r.arrival_s) + offset)
+               for i, r in enumerate(burst)]
+    return calm + shifted
+
+
+def plan_serve_main(argv=None):
+    """``python bench.py --serve --plan-serve [--costdb F]`` — the
+    serving-plan leg (ISSUE 20): search → pick → measure → re-plan, the
+    ``--plan`` discipline applied to the SERVING knobs.
+
+    **Search**: record the seeded load-shift trace
+    (:func:`build_load_shift_trace`), serve it once under the HAND
+    config to calibrate the replay model's per-phase costs from the
+    telemetry ledger (chunk-prefill ms/token, per-dispatch decode ms —
+    the PR-16 attribution terms; CostDB rates + conservative floors
+    cover the rest, every unpriced term a flagged ``uncalibrated`` key,
+    never silently defaulted), then replay the trace through the
+    host-side discrete-event model for every candidate on the grid
+    (:func:`apex_tpu.plan.serve.search_serve_plans`) and rank by
+    predicted tokens/s.
+
+    **Pick + measure**: the searched winner is served on the SAME
+    recorded trace and its measured tokens/s lands next to the
+    prediction — ``predicted_vs_measured_err_pct`` is the honesty
+    series ``tools/bench_history.py`` gates (absolute points), and
+    ``searched_beats_hand`` witnesses the headline: the searched plan
+    beats the hand config on the recorded trace (tokens/s AND TTFT
+    p50, compared on the bit-deterministic replay pricing — the same
+    model both plans are priced by).
+
+    **Re-plan**: the trace is served a third time under a
+    :class:`~apex_tpu.serving.ReplanPolicy` two-plan ladder (calm →
+    loaded, aval-stable diffs only); the burst must trigger at least
+    one live mid-serve switch (``replans``), greedy output must stay
+    token-identical across it (``replan_parity``), and both jit caches
+    stay pinned at 1 (``jit_cache_ok``) — the zero-recompile contract
+    IS part of what is measured.
+
+    Emits ONE schema-validated ``serve_plan`` record. On TPU it is
+    ``status: "OK"``; off-TPU an explicit ``SKIP`` with a reason — the
+    measured half rides as explicit skip objects (never nan in an OK
+    line) with ``smoke_tokens_per_s`` as the finite plumbing witness."""
+    import sys
+
+    import numpy as np
+
+    on_tpu = jax.default_backend() == "tpu"
+    monitor.enable_from_env()
+    from apex_tpu.inference import DecodeEngine
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.plan import (
+        ServePlan,
+        conservative_defaults,
+        derive_serve_costs,
+        price_serve_plan,
+        search_serve_plans,
+        serve_plan_record_fields,
+    )
+    from apex_tpu.prof.calibrate import validate_costdb
+    from apex_tpu.serving import ReplanPolicy, ServeTelemetry, ServingEngine
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    costdb_path = (argv[argv.index("--costdb") + 1]
+                   if "--costdb" in argv else None)
+
+    if on_tpu:
+        # the serve_main flagship config as the HAND plan: the baseline
+        # the search must beat on its own recorded trace
+        cfg = dict(vocab_size=32768, max_seq_len=1024, hidden_size=1024,
+                   num_layers=12, num_heads=8, tp_size=1, remat=False,
+                   attention_impl="flash", scan_layers=False)
+        hand = ServePlan(num_blocks=41, block_size=128, num_slots=8,
+                         prefill_chunk=256, max_prefill_share=4,
+                         slo_ttft_ms=1000.0)
+        n_calm, calm_rps, n_burst, burst_rps = 16, 16.0, 48, 128.0
+        prompt_rng, newtok_rng = (64, 512), (16, 128)
+        sys_prompt_len, window_s = 256, 0.25
+        n_parity = 6
+        cast = jnp.bfloat16
+    else:  # smoke scale; the record is SKIP either way
+        cfg = dict(vocab_size=256, max_seq_len=128, hidden_size=64,
+                   num_layers=2, num_heads=4, tp_size=1, remat=False,
+                   attention_impl="flash")
+        hand = ServePlan(num_blocks=9, block_size=16, num_slots=2,
+                         prefill_chunk=32, max_prefill_share=4,
+                         slo_ttft_ms=10000.0)
+        # calm trickle then a burst arriving faster than 2 slots drain:
+        # the ~60 ms arrival ramp spans several 10 ms windows, so the
+        # queue grows monotonically across at least three of them — the
+        # buildup detector MUST fire and the ladder MUST step up
+        n_calm, calm_rps, n_burst, burst_rps = 4, 40.0, 24, 400.0
+        prompt_rng, newtok_rng = (4, 40), (2, 10)
+        sys_prompt_len, window_s = 32, 0.01
+        n_parity = 4
+        cast = None
+
+    model = GPTModel(GPTConfig(**cfg))
+    params = model.init(jr.PRNGKey(0))
+    if cast is not None:
+        params = jax.tree.map(lambda x: x.astype(cast), params)
+    requests = build_load_shift_trace(
+        SERVE_TRACE_SEED, n_calm, calm_rps, n_burst, burst_rps,
+        cfg["vocab_size"], prompt_rng, newtok_rng,
+        sys_prompt_len=sys_prompt_len)
+    n_req = len(requests)
+    skip_reason = (None if on_tpu else
+                   f"serving-plan throughput/TTFT is a TPU measurement; "
+                   f"this is a {jax.default_backend()} smoke run at "
+                   f"{n_req} requests")
+
+    def _measured_serve(plan, policy=None):
+        """Serve the recorded trace under ``plan``: (tokens/s, TTFT
+        p50 ms, telemetry, engine, done results, wall s)."""
+        from apex_tpu.serving import Request
+
+        eng = ServingEngine(model, cache_dtype=cast,
+                            **plan.engine_kwargs())
+        # warm both jitted steps BEFORE the timed trace: a cold compile
+        # inside the sweep would stall the serve clock past every
+        # arrival and poison both the measured costs and the window
+        # telemetry the re-planner keys on (rid far above the sweep's)
+        warm_prompt = np.asarray(jr.randint(
+            jr.PRNGKey(2), (plan.prefill_chunk,), 0,
+            cfg["vocab_size"]), np.int32)
+        eng.serve(params, [Request(rid=1_000_000, prompt=warm_prompt,
+                                   max_new_tokens=4)], telemetry=False)
+        tel = ServeTelemetry(
+            slots=plan.num_slots, window_s=window_s,
+            status="OK" if on_tpu else "SKIP", reason=skip_reason,
+            collect_events=True, **plan.telemetry_kwargs())
+        sched = eng.make_scheduler(policy=policy)
+        # Request objects carry their RESULT fields (tokens, stamps):
+        # each replay leg serves fresh copies of the recorded trace
+        replay = [Request(rid=r.rid, prompt=r.prompt,
+                          max_new_tokens=r.max_new_tokens,
+                          arrival_s=r.arrival_s) for r in requests]
+        t0 = time.perf_counter()
+        done = eng.serve(params, replay, scheduler=sched, telemetry=tel)
+        wall = time.perf_counter() - t0
+        assert len(done) == n_req, "serve lost requests"
+        tokens = sum(len(r.tokens) for r in done)
+        ttfts = sorted(e["ttft_ms"] for e in tel.events
+                       if e.get("phase") == "first_token")
+        p50 = (ttfts[max(0, -(-len(ttfts) // 2) - 1)] if ttfts
+               else float("nan"))
+        return tokens / wall, p50, tel, eng, done, wall
+
+    # --- calibrate: the hand-config serve is the measured-cost source --------
+    hand_tps, hand_p50, tel, eng, done, wall = _measured_serve(hand)
+    stats = eng.last_stats
+    prefill_ms = sum(e.get("prefill_ms") or 0.0 for e in tel.events
+                     if e.get("phase") == "first_token")
+    live_prefill = sum(
+        max(len(r.prompt) - r.prefix_hit_blocks * hand.block_size, 1)
+        for r in done)
+    measured = dict(
+        prefill_ms_per_token=max(prefill_ms / max(live_prefill, 1), 1e-6),
+        # per-DISPATCH cost at the hand config's average live width (the
+        # per-row split stays a CostDB term); on the deliberately
+        # overloaded trace the non-prefill wall is decode-dominated
+        decode_ms_per_step=max(
+            (wall * 1e3 - prefill_ms) / max(stats.decode_steps, 1), 1e-6),
+    )
+
+    if costdb_path:
+        with open(costdb_path) as fh:
+            db = json.load(fh)
+        errors = validate_costdb(db)
+        if errors:
+            raise ValueError(f"{costdb_path} is not a valid costdb: "
+                             f"{errors}")
+        source = costdb_path
+    else:
+        # no measured CostDB: every db-priced key is a flagged blind
+        # spot at the conservative floors — labeled, never silent
+        db = {"schema": 1, "kind": "costdb", "collectives": {},
+              "gemms": {}}
+        source = "uniform-reference"
+    costs = derive_serve_costs(
+        db, hidden_size=cfg["hidden_size"], num_layers=cfg["num_layers"],
+        num_heads=cfg["num_heads"], vocab_size=cfg["vocab_size"],
+        measured=measured, **conservative_defaults(db))
+
+    # --- search the grid on the recorded trace -------------------------------
+    result = search_serve_plans(requests, costs, base=hand)
+    best = result.best
+    hand_price = price_serve_plan(hand, requests, costs)
+    # the headline comparison, on the SAME bit-deterministic replay
+    # pricing both plans ride (predicted↔measured drift is gated
+    # separately via predicted_vs_measured_err_pct)
+    beats = (best.price.predicted_tokens_per_s
+             > hand_price.predicted_tokens_per_s
+             and best.price.predicted_ttft_p50_ms
+             <= hand_price.predicted_ttft_p50_ms)
+
+    # --- measure the searched winner on the same trace -----------------------
+    best_tps, best_p50, _tel2, eng2, _done2, _w2 = _measured_serve(
+        best.plan)
+
+    # --- live re-plan under the load shift -----------------------------------
+    # a calm → loaded ladder over the SEARCHED plan's aval geometry:
+    # only aval-stable knobs differ (share bound, admission, SLO), so
+    # every switch applies live and the jit caches must stay at 1
+    calm_plan = best.plan
+    loaded_plan = ServePlan(**{
+        **calm_plan.to_json(),
+        "max_prefill_share": max(calm_plan.max_prefill_share, 4),
+        "admission": "short_first",
+        "slo_ttft_ms": None,
+    })
+    policy = ReplanPolicy(plans=(calm_plan, loaded_plan))
+    rp_tps, _rp_p50, tel3, eng3, done3, _w3 = _measured_serve(
+        calm_plan, policy=policy)
+    jit_cache_ok = (eng3.prefill_chunk._cache_size() == 1
+                    and eng3.decode_step._cache_size() == 1)
+    assert jit_cache_ok, \
+        "re-planned serving steps re-traced (unstable avals?)"
+    assert tel3.replans >= 1 and policy.replans == tel3.replans, \
+        "the load shift produced no live re-plan (buildup never fired?)"
+    # greedy parity ACROSS the switch: finished streams token-identical
+    # to the unchurned DecodeEngine baseline
+    deng = DecodeEngine(model, cache_dtype=cast)
+    replan_parity = True
+    for r in done3[:n_parity]:
+        want = np.asarray(deng.generate(
+            params, jnp.asarray(r.prompt)[None], r.max_new_tokens))[0]
+        ok = (len(r.tokens) == r.max_new_tokens
+              and (np.asarray(r.tokens) == want).all())
+        replan_parity = replan_parity and bool(ok)
+
+    fields = serve_plan_record_fields(
+        result, costdb_source=source,
+        measured_tokens_per_s=best_tps if on_tpu else None,
+        measured_ttft_p50_ms=best_p50 if on_tpu else None,
+        skip_reason=skip_reason)
+    skip = lambda r: ("skipped", r)  # noqa: E731
+    fields.update(
+        hand_tokens_per_s=(round(hand_tps, 1) if on_tpu
+                           else skip(skip_reason)),
+        hand_ttft_p50_ms=(round(hand_p50, 3) if on_tpu
+                          else skip(skip_reason)),
+        searched_beats_hand=bool(beats),
+        replans=int(tel3.replans),
+        replan_parity=bool(replan_parity),
+        jit_cache_ok=bool(jit_cache_ok),
+        smoke_tokens_per_s=round(best_tps, 1),
+        trace_seed=SERVE_TRACE_SEED,
+        config=cfg, backend=jax.default_backend(),
+    )
+    if on_tpu:
+        status = "OK"
+    else:
+        fields["reason"] = skip_reason
+        status = "SKIP"
+
+    if monitor.enabled():
+        record = monitor.get_registry().emit_serve_plan(status, **fields)
+    else:  # sink-less registry: same construction+honesty path, no file
+        record = monitor.MetricsRegistry().emit_serve_plan(status,
+                                                           **fields)
+    errors = monitor.validate(record)
+    if errors:
+        raise ValueError(
+            f"serve_plan bench record failed validation: {errors}")
+    print(json.dumps(record))
+
+
 def tp_serve_main(argv):
     """``python bench.py --serve --plan-tp N`` — the tensor-parallel
     serving leg (ISSUE 17): serve a model bigger than one chip.
@@ -2331,7 +2617,9 @@ if __name__ == "__main__":
     elif "--decode" in sys.argv[1:]:
         decode_main()
     elif "--serve" in sys.argv[1:]:
-        if "--plan-tp" in sys.argv[1:]:
+        if "--plan-serve" in sys.argv[1:]:
+            plan_serve_main(sys.argv[1:])
+        elif "--plan-tp" in sys.argv[1:]:
             tp_serve_main(sys.argv[1:])
         else:
             serve_main()

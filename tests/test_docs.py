@@ -142,7 +142,14 @@ def test_inference_doc_covers_serving_contract():
                    "drafter_pool_blocks", "spec_tree_step",
                    "bench.py --spec --tree",
                    "tree_spec_acceptance_rate", "adaptive_beats_fixed",
-                   "fp8_e4m3", "spec_verify_tree"):
+                   "fp8_e4m3", "spec_verify_tree",
+                   # ISSUE 20: self-tuning serving + the SLOPolicy
+                   # narrowing contract (backs off on ANY non-buildup
+                   # window, not only fully-clean ones)
+                   "window without queue buildup", "ReplanPolicy",
+                   "ServePlan", "split_knob_changes", "calm_windows",
+                   "deferred_knobs", "pop_replan", "replan_parity",
+                   "--plan-serve", "searched_beats_hand"):
         assert needle in text, f"inference.md dropped {needle}"
 
 
@@ -231,7 +238,11 @@ def test_guide_covers_the_ladder():
                    "--memory", "memory_budgets.json",
                    "liveness.analyze", "peak_memory_bound",
                    "donation_aliased", "memory_source",
-                   "predicted_vs_measured_hbm_err_pct"):
+                   "predicted_vs_measured_hbm_err_pct",
+                   # ISSUE 20: the §10g self-tuning serving recipe
+                   "ServePlan", "price_serve_plan", "search_serve_plans",
+                   "ReplanPolicy", "bench.py --serve --plan-serve",
+                   "serve_plan_tokens_per_s", "deferred_knobs"):
         assert needle in text, f"guide dropped {needle}"
 
 
@@ -269,6 +280,10 @@ def test_plan_api_blocks_execute_in_order():
     ns = _exec_blocks(blocks, "plan.md")
     assert ns["price"].confidence == "calibrated"
     assert ns["result"].ranked
+    # ISSUE 20: the ServePlan chapter's worked pricing fixture
+    assert ns["sprice"].confidence == "calibrated"
+    assert ns["sprice"].sim_span_ms == 33.0
+    assert ns["sresult"].ranked
 
 
 def test_plan_doc_covers_the_planner_contract():
@@ -285,5 +300,14 @@ def test_plan_doc_covers_the_planner_contract():
                    # ISSUE 18: the apexmem memory-source chapter
                    "liveness_memory", "memory_source",
                    "memory_disagreement_pct", "closed_form_vs_liveness",
-                   "predicted_vs_measured_hbm_err_pct"):
+                   "predicted_vs_measured_hbm_err_pct",
+                   # ISSUE 20: the ServePlan chapter
+                   "ServePlan", "price_serve_plan", "search_serve_plans",
+                   "split_knob_changes", "derive_serve_costs",
+                   "uncalibrated", "pool_bytes_bound",
+                   "bench.py --serve --plan-serve",
+                   "searched_beats_hand", "replan_parity",
+                   "jit_cache_ok", "serve_plan_tokens_per_s",
+                   "serve_plan_predicted_vs_measured_err_pct",
+                   "validate_metrics.py --serve-plan"):
         assert needle in text, f"plan.md dropped {needle}"

@@ -1,0 +1,823 @@
+"""apex_tpu.plan.serve: the ServePlan object, trace-replay pricing,
+the search loop, the online ReplanPolicy, and the ``serve_plan``
+record/CLI surface (ISSUE 20).
+
+Fixture costs are hand-built round numbers so the pricing assertions
+are exact: determinism is bit-identical, the worked single-request
+walk pins the simulator's arithmetic to the same numbers
+``docs/api/plan.md`` derives by hand, and the load-shift fixture pins
+that the searched plan beats every fixed hand config on the SAME
+replay model (tokens/s and TTFT p50 — the off-TPU half of the
+acceptance gate).
+"""
+
+import dataclasses
+import itertools
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import jax.random as jr
+
+from apex_tpu import monitor
+from apex_tpu.inference import DecodeEngine
+from apex_tpu.models import GPTConfig, GPTModel
+from apex_tpu.plan import (
+    PlanError,
+    ServeCosts,
+    ServePlan,
+    derive_serve_costs,
+    enumerate_serve_plans,
+    price_serve_plan,
+    search_serve_plans,
+    serve_plan_record_fields,
+    split_knob_changes,
+)
+from apex_tpu.serving import (
+    ReplanPolicy,
+    Request,
+    ServeTelemetry,
+    ServingEngine,
+    SLOPolicy,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import bench_history  # noqa: E402
+import validate_metrics  # noqa: E402
+
+K = jr.PRNGKey(20)
+
+
+@dataclasses.dataclass
+class _R:
+    """Minimal trace row: what price_serve_plan reads off a request."""
+
+    rid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    arrival_s: float = 0.0
+
+
+def _trace(n=8, seed=0, max_prompt=24, max_new=8, spacing_s=0.0):
+    rng = np.random.default_rng(seed)
+    out, t = [], 0.0
+    for i in range(n):
+        out.append(_R(rid=i,
+                      prompt=np.asarray(
+                          rng.integers(0, 97, rng.integers(4, max_prompt)),
+                          np.int32),
+                      max_new_tokens=int(rng.integers(2, max_new)),
+                      arrival_s=t))
+        t += spacing_s
+    return out
+
+
+#: hand-built, fully measured costs — every pricing assertion is exact
+COSTS = ServeCosts(prefill_ms_per_token=1.0, decode_ms_per_step=2.0,
+                   decode_ms_per_row=1.0, hbm_bytes_per_s=4000.0,
+                   spec_round_ms=0.0, spec_acceptance=0.0,
+                   num_layers=1, kv_heads=1, head_dim=1)
+
+
+class TestServePlan:
+    def test_roundtrip_exact(self):
+        p = ServePlan(num_blocks=41, block_size=16, num_slots=4,
+                      prefill_chunk=32, max_prefill_share=2,
+                      drafter="ngram_tree", spec_depth=4, spec_branching=2,
+                      spec_adaptive=True, kv_dtype="int8",
+                      slo_ttft_ms=250.0, slo_burn_count=2,
+                      admission="short_first")
+        assert ServePlan.from_json(p.to_json()) == p
+        assert ServePlan.from_json(json.dumps(p.to_json())) == p
+        assert p.to_json() == ServePlan.from_json(p.to_json()).to_json()
+
+    def test_from_json_rejects_unknown_fields(self):
+        blob = ServePlan(num_blocks=9).to_json()
+        blob["block_sizes"] = 64
+        with pytest.raises(PlanError, match="block_sizes"):
+            ServePlan.from_json(blob)
+        with pytest.raises(PlanError, match="JSON object"):
+            ServePlan.from_json([1, 2])
+
+    @pytest.mark.parametrize("kw,needle", [
+        (dict(num_blocks=1), "num_blocks=1"),
+        (dict(num_blocks=9, block_size=0), "block_size=0"),
+        (dict(num_blocks=9, num_slots=True), "num_slots=True"),
+        (dict(num_blocks=9, prefill_chunk=24, block_size=16),
+         "prefill_chunk=24"),
+        (dict(num_blocks=9, drafter="oracle"), "drafter='oracle'"),
+        (dict(num_blocks=9, spec_depth=3), "drafter='none'"),
+        (dict(num_blocks=9, drafter="ngram", spec_depth=0),
+         "needs a draft depth"),
+        (dict(num_blocks=9, drafter="ngram", spec_depth=2,
+              spec_branching=2), "only the tree drafter forks"),
+        (dict(num_blocks=9, drafter="ngram", spec_depth=2,
+              spec_adaptive=True), "adaptive ladder"),
+        (dict(num_blocks=9, kv_dtype="fp4"), "kv_dtype='fp4'"),
+        (dict(num_blocks=9, slo_ttft_ms=0.0), "slo_ttft_ms=0.0"),
+        (dict(num_blocks=9, slo_ttft_ms=float("nan")), "slo_ttft_ms"),
+        (dict(num_blocks=9, admission="lifo"), "admission='lifo'"),
+    ])
+    def test_validation_names_knob_and_legal_values(self, kw, needle):
+        with pytest.raises(PlanError, match="legal values"):
+            ServePlan(**kw)
+        with pytest.raises(PlanError) as e:
+            ServePlan(**kw)
+        assert needle in str(e.value)
+
+    def test_describe_and_digest(self):
+        a = ServePlan(num_blocks=41, block_size=16, num_slots=4,
+                      prefill_chunk=32, drafter="ngram_tree", spec_depth=3,
+                      spec_branching=2, spec_adaptive=True,
+                      kv_dtype="int8", slo_ttft_ms=250.0,
+                      admission="short_first")
+        d = a.describe()
+        assert "blk16·pool41·slot4·chunk32" in d
+        assert "spec[tree d3b2 adaptive]" in d
+        assert "int8" in d and "slo250" in d and "short_first" in d
+        # digest: content-stable, knob-sensitive, short
+        assert a.digest() == ServePlan.from_json(a.to_json()).digest()
+        assert a.digest() != dataclasses.replace(a, num_slots=8).digest()
+        assert len(a.digest()) == 10
+
+    def test_engine_and_telemetry_kwargs_split(self):
+        p = ServePlan(num_blocks=9, block_size=8, num_slots=2,
+                      prefill_chunk=16, slo_ttft_ms=100.0,
+                      slo_burn_count=2)
+        assert p.engine_kwargs() == dict(
+            num_slots=2, block_size=8, num_blocks=9, prefill_chunk=16,
+            kv_dtype=None)
+        assert p.telemetry_kwargs() == dict(slo_ttft_ms=100.0,
+                                            slo_burn_count=2)
+
+
+class TestSplitKnobChanges:
+    def test_live_only_diff(self):
+        a = ServePlan(num_blocks=9, max_prefill_share=1,
+                      slo_ttft_ms=100.0)
+        b = dataclasses.replace(a, max_prefill_share=4, slo_ttft_ms=None,
+                                admission="short_first", slo_burn_count=1)
+        live, deferred = split_knob_changes(a, b)
+        assert sorted(live) == ["admission", "max_prefill_share",
+                                "slo_burn_count", "slo_ttft_ms"]
+        assert live["max_prefill_share"] == (1, 4)
+        assert deferred == {}
+
+    def test_geometry_diffs_are_deferred(self):
+        a = ServePlan(num_blocks=9, block_size=8, prefill_chunk=16)
+        b = ServePlan(num_blocks=18, block_size=16, prefill_chunk=32,
+                      num_slots=16, kv_dtype="int8")
+        live, deferred = split_knob_changes(a, b)
+        assert live == {}
+        assert sorted(deferred) == ["block_size", "kv_dtype", "num_blocks",
+                                    "num_slots", "prefill_chunk"]
+
+    def test_spec_shape_live_only_between_adaptive_tree_plans(self):
+        a = ServePlan(num_blocks=9, drafter="ngram_tree", spec_depth=2,
+                      spec_adaptive=True)
+        b = dataclasses.replace(a, spec_depth=4, spec_branching=2)
+        live, deferred = split_knob_changes(a, b)
+        assert sorted(live) == ["spec_branching", "spec_depth"]
+        assert deferred == {}
+        # not adaptive on both sides -> the same diff defers
+        c = dataclasses.replace(a, spec_adaptive=False)
+        d = dataclasses.replace(c, spec_depth=4)
+        live, deferred = split_knob_changes(c, d)
+        assert live == {} and sorted(deferred) == ["spec_depth"]
+        # drafter identity changed -> everything spec defers
+        e = dataclasses.replace(a, drafter="ngram", spec_branching=1,
+                                spec_adaptive=False, spec_depth=4)
+        live, deferred = split_knob_changes(a, e)
+        assert live == {}
+        assert sorted(deferred) == ["drafter", "spec_adaptive",
+                                    "spec_depth"]
+
+
+def _stat(mean):
+    return {"n": 8, "mean": mean, "min": mean, "max": mean,
+            "spread_pct": 0.0}
+
+
+def _costdb(rates=None, gemm_rate=None):
+    db = {"schema": 1, "kind": "costdb", "collectives": {}, "gemms": {}}
+    for k, r in (rates or {}).items():
+        db["collectives"][k] = [{"bucket_bytes": 1 << 20,
+                                 "bytes": _stat(1 << 20),
+                                 "bytes_per_s": _stat(r)}]
+    if gemm_rate is not None:
+        db["gemms"]["gemm_1048576"] = {"flops_per_s": _stat(gemm_rate)}
+    return db
+
+
+class TestDeriveServeCosts:
+    GEOM = dict(hidden_size=64, num_layers=8, num_heads=4, vocab_size=512)
+
+    def test_every_unmeasured_term_is_flagged_never_silent(self):
+        c = derive_serve_costs(_costdb(), **self.GEOM,
+                               default_bytes_per_s=1e9,
+                               default_flops_per_s=1e11)
+        assert c.uncalibrated == ("serve[decode_step_ms]",
+                                  "serve[gemm_flops_per_s]",
+                                  "serve[hbm_bytes_per_s]")
+        assert c.spec_uncalibrated == ("serve[spec_acceptance_rate]",
+                                       "serve[spec_round_ms]")
+        # conservative on purpose: zero speculative benefit unmeasured
+        assert c.spec_acceptance == 0.0
+        assert c.hbm_bytes_per_s == 1e9
+        assert c.head_dim == 64 // 4
+
+    def test_fully_measured_is_calibrated(self):
+        c = derive_serve_costs(
+            _costdb(rates={"all_gather[tp]": 5e10}, gemm_rate=1e11),
+            **self.GEOM,
+            measured=dict(prefill_ms_per_token=0.5, decode_ms_per_step=2.0,
+                          hbm_bytes_per_s=8e11, spec_round_ms=1.5,
+                          spec_acceptance_rate=0.7))
+        assert c.uncalibrated == () and c.spec_uncalibrated == ()
+        assert c.prefill_ms_per_token == 0.5
+        assert c.decode_ms_per_step == 2.0
+        assert c.spec_acceptance == 0.7
+
+    def test_measured_gemm_db_prices_prefill(self):
+        c = derive_serve_costs(_costdb(gemm_rate=1e12), **self.GEOM,
+                               default_bytes_per_s=1e9,
+                               default_flops_per_s=1e11)
+        assert "serve[gemm_flops_per_s]" not in c.uncalibrated
+        flops = 2 * (12 * 8 * 64 * 64 + 64 * 512)
+        assert c.prefill_ms_per_token == pytest.approx(1e3 * flops / 1e12)
+        # the step floor is the per-row GEMM time when unmeasured
+        assert c.decode_ms_per_step == c.decode_ms_per_row
+
+    def test_bytes_per_ctx_token_by_kv_dtype(self):
+        c = dataclasses.replace(COSTS, num_layers=2, kv_heads=2, head_dim=4)
+        assert c.bytes_per_ctx_token(None) == 2 * 2 * 2 * 4 * 2
+        assert c.bytes_per_ctx_token("fp8_e4m3") == 2 * 2 * 2 * 4
+        # int8 additionally streams the per-block-row fp32 scale planes
+        assert c.bytes_per_ctx_token("int8") == 2 * 2 * 2 * 4 + 2 * 2 * 4
+
+
+class TestPriceServePlan:
+    def test_worked_single_request_walk(self):
+        """The docs/api/plan.md worked example, digit for digit: 8-token
+        prompt, 3 new tokens, chunk=4 => two prefill chunks (TTFT 8 ms,
+        first token at the FINAL chunk), then two decode steps at
+        2 + 1 + ctx*1.0 ms with ctx = 9 then 10 => span 33 ms."""
+        plan = ServePlan(num_blocks=4, block_size=4, num_slots=1,
+                         prefill_chunk=4)
+        req = _R(rid=0, prompt=np.arange(8, dtype=np.int32),
+                 max_new_tokens=3)
+        sprice = price_serve_plan(plan, [req], COSTS)
+        assert sprice.prefill_chunks == 2 and sprice.decode_steps == 2
+        assert sprice.predicted_ttft_p50_ms == 8.0
+        assert sprice.predicted_ttft_p99_ms == 8.0
+        assert sprice.sim_span_ms == 33.0
+        assert sprice.predicted_tokens_per_s == pytest.approx(3e3 / 33.0)
+        assert sprice.confidence == "calibrated"
+        assert sprice.uncalibrated == ()
+
+    def test_bit_deterministic(self):
+        plan = ServePlan(num_blocks=12, block_size=8, num_slots=2,
+                         prefill_chunk=8)
+        tr = _trace(n=10, seed=3, spacing_s=0.001)
+        a = price_serve_plan(plan, tr, COSTS)
+        b = price_serve_plan(plan, tr, COSTS)
+        assert a.to_json() == b.to_json()
+        assert a.predicted_tokens_per_s == b.predicted_tokens_per_s
+        assert a.sim_span_ms == b.sim_span_ms
+
+    def test_monotone_in_every_rate(self):
+        """A slower priced phase never predicts higher throughput (and a
+        slower prefill never predicts a lower TTFT)."""
+        plan = ServePlan(num_blocks=12, block_size=8, num_slots=2,
+                         prefill_chunk=8)
+        tr = _trace(n=10, seed=3, spacing_s=0.001)
+        base = price_serve_plan(plan, tr, COSTS)
+        for slow in (
+            dataclasses.replace(COSTS, prefill_ms_per_token=2.0),
+            dataclasses.replace(COSTS, decode_ms_per_step=4.0),
+            dataclasses.replace(COSTS, decode_ms_per_row=2.0),
+            dataclasses.replace(COSTS, hbm_bytes_per_s=2000.0),
+        ):
+            got = price_serve_plan(plan, tr, slow)
+            assert got.predicted_tokens_per_s \
+                <= base.predicted_tokens_per_s
+        slow_prefill = price_serve_plan(
+            plan, tr, dataclasses.replace(COSTS, prefill_ms_per_token=2.0))
+        assert slow_prefill.predicted_ttft_p50_ms \
+            >= base.predicted_ttft_p50_ms
+
+    def test_structural_prefix_sharing_prices_cheaper(self):
+        """A repeated prompt re-prices its full blocks as shared: fewer
+        prefill chunks, lower p99 TTFT than two distinct prompts."""
+        plan = ServePlan(num_blocks=8, block_size=4, num_slots=1,
+                         prefill_chunk=4, max_prefill_share=1)
+        same = np.arange(16, dtype=np.int32)
+        shared_tr = [_R(0, same, 2), _R(1, same.copy(), 2)]
+        distinct_tr = [_R(0, same, 2),
+                       _R(1, np.arange(100, 116, dtype=np.int32), 2)]
+        shared = price_serve_plan(plan, shared_tr, COSTS)
+        distinct = price_serve_plan(plan, distinct_tr, COSTS)
+        # the second request re-prefills only its final (unregistered)
+        # block: 4 + 1 chunks vs 4 + 4
+        assert shared.prefill_chunks == 5
+        assert distinct.prefill_chunks == 8
+        assert shared.predicted_ttft_p99_ms \
+            < distinct.predicted_ttft_p99_ms
+
+    def test_spec_plan_prices_fewer_decode_steps_iff_measured(self):
+        costs = dataclasses.replace(COSTS, spec_acceptance=0.5,
+                                    spec_round_ms=0.5)
+        tr = _trace(n=6, seed=1)
+        off = ServePlan(num_blocks=12, block_size=8, num_slots=2,
+                        prefill_chunk=8)
+        on = dataclasses.replace(off, drafter="ngram", spec_depth=2)
+        assert price_serve_plan(on, tr, costs).decode_steps \
+            < price_serve_plan(off, tr, costs).decode_steps
+        # unmeasured acceptance prices to zero benefit: spec only adds
+        # the round overhead, so it can never win on a blind spot
+        blind = dataclasses.replace(costs, spec_acceptance=0.0,
+                                    spec_uncalibrated=(
+                                        "serve[spec_acceptance_rate]",))
+        p_on = price_serve_plan(on, tr, blind)
+        p_off = price_serve_plan(off, tr, blind)
+        assert p_on.predicted_tokens_per_s <= p_off.predicted_tokens_per_s
+        # the spec blind-spot flags join the price ONLY when drafting
+        assert "serve[spec_acceptance_rate]" in p_on.uncalibrated
+        assert p_off.uncalibrated == ()
+
+    def test_empty_trace_and_oversized_request_are_loud(self):
+        plan = ServePlan(num_blocks=4, block_size=4)
+        with pytest.raises(PlanError, match="non-empty trace"):
+            price_serve_plan(plan, [], COSTS)
+        big = _R(0, np.arange(64, dtype=np.int32), 8)
+        with pytest.raises(PlanError, match="raise num_blocks"):
+            price_serve_plan(plan, [big], COSTS)
+
+
+def _shift_trace():
+    """Seeded calm->burst load shift: a trickle, then an arrival wave
+    far denser than the calm plan's admission can drain."""
+    rng = np.random.default_rng(7)
+    out, t = [], 0.0
+    for i in range(4):
+        out.append(_R(i, np.asarray(rng.integers(0, 97, 16), np.int32),
+                      6, t))
+        t += 0.5
+    t += 0.2
+    for i in range(24):
+        out.append(_R(4 + i,
+                      np.asarray(rng.integers(0, 97, rng.integers(4, 24)),
+                                 np.int32),
+                      int(rng.integers(2, 8)), t))
+        t += 0.002
+    return out
+
+
+class TestSearchServePlans:
+    def test_enumeration_is_deterministic_and_deduped(self):
+        base = ServePlan(num_blocks=9, block_size=8, num_slots=2,
+                         prefill_chunk=16)
+        a, _ = enumerate_serve_plans(base)
+        b, _ = enumerate_serve_plans(base)
+        assert [p.describe() for p in a] == [p.describe() for p in b]
+        assert len({p.describe() for p in a}) == len(a)
+
+    def test_infeasible_corners_are_rejections_with_reasons(self):
+        tr = [_R(0, np.arange(60, dtype=np.int32), 8)]
+        small = ServePlan(num_blocks=5, block_size=8, num_slots=2,
+                          prefill_chunk=8)
+        res = search_serve_plans(tr, COSTS, base=small)
+        assert res.rejected and all(r for _, r in res.rejected)
+        assert any("never be admitted" in r for _, r in res.rejected)
+        # pool-bytes bound: every doubled-pool corner carries a reason
+        bounded = search_serve_plans(tr, COSTS, base=ServePlan(
+            num_blocks=12, block_size=8, num_slots=2, prefill_chunk=8),
+            pool_bytes_bound=1)
+        assert not bounded.ranked
+        assert all("exceeds the bound" in r or "never be admitted" in r
+                   for _, r in bounded.rejected)
+        with pytest.raises(PlanError, match="no feasible serve plan"):
+            bounded.best
+        with pytest.raises(PlanError, match="base plan or an explicit"):
+            search_serve_plans(tr, COSTS)
+        with pytest.raises(PlanError, match="non-empty trace"):
+            search_serve_plans([], COSTS, base=small)
+
+    def test_searched_plan_beats_every_fixed_hand_config(self):
+        """The off-TPU acceptance half: on the seeded load-shift trace
+        the searched plan beats EVERY fixed hand config on predicted
+        tokens/s AND TTFT p50, under the same bit-deterministic replay
+        model."""
+        tr = _shift_trace()
+        hands = [
+            ServePlan(num_blocks=9, block_size=8, num_slots=2,
+                      prefill_chunk=8, max_prefill_share=1),
+            ServePlan(num_blocks=9, block_size=8, num_slots=2,
+                      prefill_chunk=8, max_prefill_share=4),
+            ServePlan(num_blocks=9, block_size=8, num_slots=2,
+                      prefill_chunk=16, max_prefill_share=2,
+                      admission="short_first"),
+        ]
+        res = search_serve_plans(tr, COSTS, base=hands[0])
+        best = res.best
+        for hand in hands:
+            hp = price_serve_plan(hand, tr, COSTS)
+            assert best.price.predicted_tokens_per_s \
+                > hp.predicted_tokens_per_s, hand.describe()
+            assert best.price.predicted_ttft_p50_ms \
+                <= hp.predicted_ttft_p50_ms, hand.describe()
+        # ranking is sorted by the claim the record leads with
+        tps = [c.price.predicted_tokens_per_s for c in res.ranked]
+        assert tps == sorted(tps, reverse=True)
+
+
+class _Tel:
+    """The two live signals ReplanPolicy keys on, plus the SLO knobs
+    _apply_live writes through."""
+
+    def __init__(self):
+        self.slo_burning = False
+        self.queue_buildup = False
+        self.slo_ttft_ms = None
+        self.slo_burn_count = 3
+
+
+CALM = ServePlan(num_blocks=9, block_size=8, num_slots=2, prefill_chunk=8,
+                 max_prefill_share=2, slo_ttft_ms=100.0, slo_burn_count=2)
+LOADED = dataclasses.replace(CALM, max_prefill_share=4,
+                             admission="short_first", slo_ttft_ms=None,
+                             slo_burn_count=3, num_blocks=18)
+
+
+class TestReplanPolicy:
+    def test_needs_a_ladder(self):
+        with pytest.raises(ValueError, match="at least one"):
+            ReplanPolicy(plans=())
+        with pytest.raises(ValueError, match="not a plan index"):
+            ReplanPolicy(plans=(CALM,), active=3)
+
+    def test_buildup_steps_up_and_stages_the_switch(self):
+        pol = ReplanPolicy(plans=(CALM, LOADED))
+        tel = _Tel()
+        pol.update(tel)
+        assert pol.active == 0 and pol.pop_replan() is None
+        tel.queue_buildup = True
+        pol.update(tel)
+        assert pol.active == 1 and pol.plan is LOADED
+        assert pol.replans == 1 and pol.deferred_total == 1
+        staged = pol.pop_replan()
+        assert staged["trigger"] == "queue_buildup"
+        assert staged["plan_from"] == CALM.digest()
+        assert staged["plan_to"] == LOADED.digest()
+        assert staged["live_knobs"] == ["admission", "max_prefill_share",
+                                        "slo_burn_count", "slo_ttft_ms"]
+        assert staged["deferred_knobs"] == ["num_blocks"]
+        assert staged["spec_shape"] is None
+        assert pol.pop_replan() is None  # at most one per window
+        # the loaded plan's live knobs applied in place
+        assert pol.max_prefill_share == 4
+        assert pol.prefer_short_prompts  # short_first pins it on
+        assert tel.slo_ttft_ms is None and tel.slo_burn_count == 3
+        # at the ladder top the signal keeps widening the share only
+        pol.update(tel)
+        assert pol.active == 1 and pol.replans == 1
+
+    def test_burn_steps_up_and_calm_streak_steps_down(self):
+        pol = ReplanPolicy(plans=(CALM, LOADED), calm_windows=2)
+        tel = _Tel()
+        tel.slo_burning = True
+        pol.update(tel)
+        assert pol.active == 1
+        assert pol.pop_replan()["trigger"] == "slo_burn"
+        tel.slo_burning = False
+        pol.update(tel)
+        assert pol.active == 1 and pol.pop_replan() is None
+        pol.update(tel)  # second clean window completes the streak
+        assert pol.active == 0 and pol.replans == 2
+        staged = pol.pop_replan()
+        assert staged["trigger"] == "calm"
+        assert tel.slo_ttft_ms == 100.0 and tel.slo_burn_count == 2
+        # stepping down clamps the live share to the calm plan's bound
+        assert pol.prefill_share <= pol.max_prefill_share == 2
+        # a dirty window resets the streak
+        tel.queue_buildup = True
+        pol.update(tel)
+        tel.queue_buildup = False
+        pol.update(tel)
+        assert pol.active == 1  # one clean window is not a streak
+
+    def test_adaptive_tree_ladder_stages_the_spec_shape(self):
+        a = dataclasses.replace(CALM, drafter="ngram_tree", spec_depth=2,
+                                spec_adaptive=True)
+        b = dataclasses.replace(a, spec_depth=4, spec_branching=2,
+                                max_prefill_share=4)
+        pol = ReplanPolicy(plans=(a, b))
+        tel = _Tel()
+        tel.queue_buildup = True
+        pol.update(tel)
+        staged = pol.pop_replan()
+        assert staged["spec_shape"] == (4, 2)
+        assert "spec_depth" in staged["live_knobs"]
+        assert staged["deferred_knobs"] == []
+
+    def test_slo_policy_narrows_on_any_non_buildup_window(self):
+        """Regression (ISSUE 20 satellite): the share backs off on ANY
+        window without queue buildup — a persistent benign anomaly
+        (e.g. a TTFT burn, or one straggler flag per window) must never
+        pin the share at max forever."""
+        pol = SLOPolicy(max_prefill_share=4)
+        tel = _Tel()
+        tel.queue_buildup = True
+        for _ in range(4):
+            pol.update(tel)
+        assert pol.prefill_share == 4
+        # buildup clears but the burn persists: NOT a clean window,
+        # and the share must still back off one step per window
+        tel.queue_buildup = False
+        tel.slo_burning = True
+        pol.update(tel)
+        assert pol.prefill_share == 3 and pol.prefer_short_prompts
+        pol.update(tel)
+        pol.update(tel)
+        pol.update(tel)
+        assert pol.prefill_share == 1  # floored, never 0
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = GPTConfig(vocab_size=97, max_seq_len=128, hidden_size=32,
+                    num_layers=2, num_heads=4, num_kv_heads=2,
+                    attention_impl="flash", remat=False, dropout=0.0)
+    model = GPTModel(cfg)
+    return model, model.init(K)
+
+
+class TestLiveReplan:
+    def test_mid_serve_replan_is_token_identical_and_recompile_free(
+            self, tiny):
+        """The live acceptance witness at test scale: a ReplanPolicy
+        ladder whose plans differ only in aval-stable knobs switches
+        mid-serve (an unmeetable SLO forces the burn trigger
+        deterministically), at least one ``replan`` lands, greedy output
+        stays token-identical to the reference engine, and both jit
+        caches end at one executable."""
+        model, params = tiny
+        calm = ServePlan(num_blocks=13, block_size=8, num_slots=2,
+                         prefill_chunk=8, max_prefill_share=1,
+                         slo_ttft_ms=1e-6, slo_burn_count=1)
+        loaded = dataclasses.replace(calm, max_prefill_share=4,
+                                     admission="short_first",
+                                     slo_ttft_ms=None)
+        eng = ServingEngine(model, max_seq_len=64, **calm.engine_kwargs())
+        rng = np.random.default_rng(5)
+        reqs = [Request(rid=i,
+                        prompt=np.asarray(rng.integers(0, 97,
+                                                       rng.integers(4, 20)),
+                                          np.int32),
+                        max_new_tokens=int(rng.integers(2, 8)))
+                for i in range(6)]
+        pol = ReplanPolicy(plans=(calm, loaded))
+        tel = ServeTelemetry(slots=calm.num_slots, window_s=1e-3,
+                             collect_events=True,
+                             **calm.telemetry_kwargs())
+        counter = itertools.count()
+        clock = lambda: next(counter) * 2e-4  # noqa: E731
+        done = eng.serve(params, reqs, clock=clock, telemetry=tel,
+                         scheduler=eng.make_scheduler(policy=pol))
+        assert pol.replans >= 1
+        assert tel.replans == pol.replans
+        assert eng.prefill_chunk._cache_size() == 1
+        assert eng.decode_step._cache_size() == 1
+        replan_events = [e for e in tel.events
+                         if e.get("phase") == "replan"]
+        assert len(replan_events) == pol.replans
+        assert replan_events[0]["replan_trigger"] == "slo_burn"
+        assert replan_events[0]["plan_from"] == calm.digest()
+        assert "deferred_knobs" not in replan_events[0]
+        ref = DecodeEngine(model)
+        for r in done:
+            want = np.asarray(ref.generate(
+                params, jnp.asarray(r.prompt)[None], r.max_new_tokens))[0]
+            np.testing.assert_array_equal(np.asarray(r.tokens), want,
+                                          err_msg=f"rid {r.rid}")
+
+
+class TestServePlanRecord:
+    def _fields(self, measured=False):
+        tr = _shift_trace()
+        res = search_serve_plans(tr, COSTS, base=ServePlan(
+            num_blocks=9, block_size=8, num_slots=2, prefill_chunk=8))
+        if measured:
+            return serve_plan_record_fields(
+                res, costdb_source="fixture", measured_tokens_per_s=512.0,
+                measured_ttft_p50_ms=20.0)
+        return serve_plan_record_fields(
+            res, costdb_source="fixture",
+            skip_reason="no TPU (backend=cpu)")
+
+    def test_skip_record_validates_with_explicit_skip_objects(self):
+        reg = monitor.MetricsRegistry()
+        rec = reg.emit_serve_plan("SKIP", reason="no TPU (backend=cpu)",
+                                  **self._fields())
+        assert monitor.validate(rec) == []
+        assert rec["measured_tokens_per_s"] == {
+            "skipped": True, "reason": "no TPU (backend=cpu)"}
+        assert rec["chosen"] == ServePlan.from_json(
+            rec["chosen"]).to_json()
+        assert rec["ranking"][0]["confidence"] in ("calibrated", "partial")
+
+    def test_ok_record_validates_with_numbers(self):
+        reg = monitor.MetricsRegistry()
+        rec = reg.emit_serve_plan(
+            "OK", **self._fields(measured=True), searched_beats_hand=True,
+            replans=2, replan_parity=True, jit_cache_ok=True)
+        assert monitor.validate(rec) == []
+        assert rec["measured_tokens_per_s"] == 512.0
+        # the drift series is derived from the measured half, absolute
+        assert isinstance(rec["predicted_vs_measured_err_pct"], float)
+        assert rec["predicted_vs_measured_err_pct"] >= 0.0
+
+    def test_junk_key_fails_closed_schemas(self):
+        reg = monitor.MetricsRegistry()
+        rec = reg.emit_serve_plan("SKIP", reason="no TPU",
+                                  **self._fields())
+        evil = json.loads(json.dumps(rec))
+        evil["chosen"]["block_sizes"] = 64
+        assert any("block_sizes" in e for e in monitor.validate(evil))
+        evil2 = json.loads(json.dumps(rec))
+        evil2["ranking"][0]["tokens"] = 1.0
+        assert monitor.validate(evil2)
+        evil3 = json.loads(json.dumps(rec))
+        evil3["rejected"].append({"plan": "x", "reason": "y", "junk": 1})
+        assert monitor.validate(evil3)
+
+    def test_skip_without_reason_and_nan_in_ok_are_refused(self):
+        reg = monitor.MetricsRegistry()
+        with pytest.raises(ValueError, match="reason"):
+            reg.emit_serve_plan("SKIP", **self._fields())
+        rec = reg.emit_serve_plan("OK", **self._fields(measured=True))
+        bad = json.loads(json.dumps(rec).replace("512.0", "NaN"))
+        assert monitor.validate(bad)
+        # a reason-less SKIP from an external stream fails validation
+        ext = json.loads(json.dumps(
+            reg.emit_serve_plan("SKIP", reason="x", **self._fields())))
+        del ext["reason"]
+        assert any("reason" in e for e in monitor.validate(ext))
+
+
+class TestValidateMetricsCLI:
+    def _record(self, tmp_path, name="sp.json", status="SKIP", **extra):
+        reg = monitor.MetricsRegistry()
+        tr = _trace(n=3, seed=2)
+        res = search_serve_plans(tr, COSTS, base=ServePlan(
+            num_blocks=9, block_size=8, num_slots=2, prefill_chunk=8))
+        fields = serve_plan_record_fields(res, costdb_source="fixture",
+                                          skip_reason="no TPU")
+        fields.update(extra)
+        kw = dict(reason="no TPU") if status == "SKIP" else {}
+        rec = reg.emit_serve_plan(status, **kw, **fields)
+        p = tmp_path / name
+        p.write_text(json.dumps(rec))
+        return p, rec
+
+    def test_forced_and_content_dispatch(self, tmp_path):
+        p, _ = self._record(tmp_path)
+        assert validate_metrics.main(["--serve-plan", str(p)]) == 0
+        assert validate_metrics.main([str(p)]) == 0  # kind dispatch
+
+    def test_forced_flag_refuses_other_kinds(self, tmp_path):
+        p = tmp_path / "serve.json"
+        p.write_text(json.dumps({"kind": "serve", "schema": 1,
+                                 "status": "SKIP", "reason": "x"}))
+        assert validate_metrics.main(["--serve-plan", str(p)]) == 1
+
+    def test_junk_and_reasonless_skip_fail(self, tmp_path):
+        p, rec = self._record(tmp_path)
+        evil = json.loads(json.dumps(rec))
+        evil["chosen"]["junk"] = 1
+        p.write_text(json.dumps(evil))
+        assert validate_metrics.main(["--serve-plan", str(p)]) == 1
+        bare = json.loads(json.dumps(rec))
+        del bare["reason"]
+        p.write_text(json.dumps(bare))
+        assert validate_metrics.main(["--serve-plan", str(p)]) == 1
+
+
+class TestBenchHistorySeries:
+    """The serve_plan gate: measured tokens/s under the searched plan is
+    the higher-is-better headline; the replay model's
+    predicted-vs-measured error is the lower-is-better honesty series;
+    pre-ServePlan history artifacts SKIP the new series only."""
+
+    def _sp(self, tok=None, err=None, status="OK"):
+        rec = {"kind": "serve_plan", "schema": 1, "status": status,
+               "spread_pct": 1.0}
+        if status == "SKIP":
+            rec["reason"] = "no TPU"
+        if tok is not None:
+            rec["measured_tokens_per_s"] = tok
+        if err is not None:
+            rec["predicted_vs_measured_err_pct"] = err
+        return rec
+
+    def test_extract_all_carries_both_series(self):
+        rows = bench_history.extract_all(self._sp(512.0, 3.5))
+        assert ("serve_plan_tokens_per_s", 512.0, 1.0) in rows
+        # model error gets NO spread widening from throughput variance
+        assert ("serve_plan_predicted_vs_measured_err_pct", 3.5, 0.0) \
+            in rows
+        assert bench_history.extract_all(self._sp(status="SKIP")) == []
+
+    def test_ok_record_missing_either_series_is_an_error(self):
+        with pytest.raises(ValueError, match="measured_tokens_per_s"):
+            bench_history.extract_all(self._sp(err=3.5))
+        with pytest.raises(ValueError,
+                           match="predicted_vs_measured_err_pct"):
+            bench_history.extract_all(self._sp(tok=512.0))
+        # a skip OBJECT is not a number either: still an error on OK
+        rec = self._sp(err=3.5)
+        rec["measured_tokens_per_s"] = {"skipped": True, "reason": "x"}
+        with pytest.raises(ValueError, match="measured_tokens_per_s"):
+            bench_history.extract_all(rec)
+
+    def test_throughput_regression_fails(self, tmp_path, capsys):
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps(self._sp(512.0, 3.5)))
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(self._sp(400.0, 3.5)))
+        rc = bench_history.main([str(fresh), "--root", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "REGRESSION serve_plan_tokens_per_s" in out
+        assert "OK serve_plan_predicted_vs_measured_err_pct" in out
+
+    def test_model_error_drift_up_is_a_regression(self, tmp_path, capsys):
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps(self._sp(512.0, 3.0)))
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(self._sp(512.0, 9.0)))
+        rc = bench_history.main([str(fresh), "--root", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "REGRESSION serve_plan_predicted_vs_measured_err_pct" in out
+        # a BETTER model (error down) is an improvement, not a failure
+        fresh.write_text(json.dumps(self._sp(512.0, 1.0)))
+        assert bench_history.main([str(fresh),
+                                   "--root", str(tmp_path)]) == 0
+
+    def test_skip_record_claims_nothing(self, tmp_path, capsys):
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps(self._sp(512.0, 3.5)))
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(self._sp(1.0, 99.0, status="SKIP")))
+        assert bench_history.main([str(fresh),
+                                   "--root", str(tmp_path)]) == 0
+        assert "SKIP" in capsys.readouterr().out
+
+    def test_pre_serveplan_history_skips_the_new_series_only(
+            self, tmp_path, capsys):
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+            {"parsed": {"metric": "m_tok", "value": 100.0, "unit": "u",
+                        "spread_pct": 0.5}}))
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(self._sp(512.0, 3.5)))
+        rc = bench_history.main([str(fresh), "--root", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "SKIP: no history artifact carries metric " \
+            "'serve_plan_tokens_per_s'" in out
+        assert "SKIP: no history artifact carries metric " \
+            "'serve_plan_predicted_vs_measured_err_pct'" in out
+
+
+class TestReportTimeline:
+    def test_replan_events_render_in_the_serve_timeline(self):
+        from apex_tpu.monitor import report as monitor_report
+
+        reg = monitor.MetricsRegistry()
+        records = [
+            reg.emit_meta(device_kind="cpu"),
+            reg.emit("serve_event", rid=0, phase="submit", at_s=0.0),
+            reg.emit("serve_event", rid=-1, phase="replan", at_s=0.4,
+                     step=12, plan_from="aaaa111111",
+                     plan_to="bbbb222222", replan_trigger="queue_buildup",
+                     live_knobs=["max_prefill_share", "admission"],
+                     deferred_knobs=["num_blocks"]),
+            reg.emit("serve_event", rid=0, phase="finish", at_s=1.0,
+                     tokens=5, slot=0, step=30),
+        ]
+        for r in records[1:]:
+            assert monitor.validate(r) == [], r
+        tl = monitor_report.serve_timeline(records)
+        assert len(tl["replans"]) == 1
+        rp = tl["replans"][0]
+        assert rp["plan_from"] == "aaaa111111"
+        assert rp["replan_trigger"] == "queue_buildup"
+        text = monitor_report.format_serve_timeline(tl)
+        assert "replan at step 12" in text
+        assert "aaaa111111 -> bbbb222222" in text
+        assert "queue_buildup" in text and "deferred: num_blocks" in text

@@ -26,7 +26,12 @@ that trajectory into a gate a CI leg can run after a fresh bench:
   carries its step-time ``predicted_vs_measured_err_pct`` and — when
   ``memory_stats()`` measured one — the apexmem
   ``predicted_vs_measured_hbm_err_pct``, both gated in absolute points
-  (a healthy model's reference is ~0). An OK ``spec`` record carries TWO higher-is-better
+  (a healthy model's reference is ~0). An OK ``serve_plan`` record
+  (``bench.py --serve --plan-serve``) carries the searched plan's
+  measured ``serve_plan_tokens_per_s`` (higher-is-better) and the
+  replay model's ``serve_plan_predicted_vs_measured_err_pct``
+  (lower-is-better, absolute points); pre-ServePlan history artifacts
+  carry neither, so the new series SKIP individually. An OK ``spec`` record carries TWO higher-is-better
   series: ``spec_tokens_per_s_request`` (the speculative-decoding
   headline) and ``spec_acceptance_rate`` (the drafter-quality series
   that explains it — a silent acceptance collapse would eventually
@@ -77,6 +82,12 @@ _THROUGHPUT_KINDS = ("serve", "decode", "tp_overlap", "pipeline",
 # metrics where a BIGGER fresh value is the regression, gated in
 # ABSOLUTE points (error series — the reference may legitimately be ~0)
 _LOWER_IS_BETTER = {"plan_predicted_vs_measured_err_pct",
+                    # the serving planner's honesty series: the
+                    # trace-replay model's predicted tokens/s vs the
+                    # measured serve — same absolute-points rule as the
+                    # training planner (healthy is near 0, so percent
+                    # drift against ~0 is noise)
+                    "serve_plan_predicted_vs_measured_err_pct",
                     # apexmem's memory honesty series: the liveness
                     # bound's error vs the device's measured peak HBM —
                     # a healthy model sits near 0, so percent drift
@@ -216,6 +227,34 @@ def extract_all(obj: Dict[str, Any], label: str = "artifact"
         if isinstance(trate, (int, float)):
             rows.append(("tree_spec_acceptance_rate", float(trate), 0.0))
         return rows
+    if kind == "serve_plan":
+        # the serving-plan leg (`bench.py --serve --plan-serve`): the
+        # measured tokens/s under the SEARCHED plan is the headline
+        # (higher-is-better), and the replay model's
+        # predicted-vs-measured error is the honesty series
+        # (lower-is-better in absolute points, like the plan record's).
+        # Pre-ServePlan history artifacts carry neither series — the
+        # per-series comparison SKIPs the new series only.
+        if obj.get("status") == "SKIP":
+            return []
+        v = obj.get("measured_tokens_per_s")
+        if not isinstance(v, (int, float)):
+            raise ValueError(
+                f"{label}: OK serve_plan record has no numeric "
+                "measured_tokens_per_s")
+        spread = obj.get("spread_pct")
+        spread = float(spread) if isinstance(spread, (int, float)) else 0.0
+        rows = [("serve_plan_tokens_per_s", float(v), spread)]
+        err = obj.get("predicted_vs_measured_err_pct")
+        if not isinstance(err, (int, float)):
+            raise ValueError(
+                f"{label}: OK serve_plan record has no numeric "
+                "predicted_vs_measured_err_pct")
+        # the record's spread_pct is throughput variance; it says
+        # nothing about the model error, so it must not widen that gate
+        rows.append(("serve_plan_predicted_vs_measured_err_pct",
+                     float(err), 0.0))
+        return rows
     if kind == "ckpt":
         # the checkpoint leg's gated series is its measured per-step
         # save overhead — lower-is-better in absolute points (a clean
@@ -265,7 +304,8 @@ def load_json(path: str) -> Any:
             if isinstance(obj, dict) and (
                     "metric" in obj
                     or obj.get("kind") in _THROUGHPUT_KINDS
-                    or obj.get("kind") in ("plan", "ckpt", "spec")):
+                    or obj.get("kind") in ("plan", "serve_plan", "ckpt",
+                                           "spec")):
                 claimed = obj
         if last is None:
             raise ValueError(f"{path}: empty file")

@@ -13,6 +13,7 @@ Usage::
     python tools/validate_metrics.py --static-cost static_cost.jsonl ...
     python tools/validate_metrics.py --static-memory static_memory.jsonl ...
     python tools/validate_metrics.py --plan plan.jsonl ...
+    python tools/validate_metrics.py --serve-plan serve_plan.jsonl ...
     python tools/validate_metrics.py --ckpt ckpt.jsonl ...
     python tools/validate_metrics.py --spec spec.jsonl ...
     python tools/validate_metrics.py --tp-serve tp_serve.jsonl ...
@@ -76,16 +77,21 @@ Dispatch is by content, not extension:
   every status record), and ``tp_serve`` records (``python bench.py
   --serve --plan-tp N``: the tensor-parallel serving + disaggregated
   prefill→decode handoff leg — a CLOSED schema whose OK line is a
-  real-multichip-TPU claim; off-TPU it must be a reasoned SKIP)
+  real-multichip-TPU claim; off-TPU it must be a reasoned SKIP),
+  and ``serve_plan`` records (``python bench.py --serve --plan-serve``:
+  the trace-replay-priced serving-knob search — the chosen ServePlan
+  and every ranking row are CLOSED schemas, so a junk key fails; an OK
+  line engages the no-nan honesty rule and a SKIP needs a reason)
   dispatch on ``kind`` like every monitor record. ``--profile`` /
-  ``--serve`` / ``--serve-window`` / ``--tp-serve`` / ``--pipeline`` /
+  ``--serve`` / ``--serve-window`` / ``--serve-plan`` / ``--tp-serve`` /
+  ``--pipeline`` /
   ``--costdb`` / ``--static-cost`` / ``--static-memory`` / ``--plan`` /
   ``--ckpt`` / ``--spec`` force EVERY listed file to be judged as that
   artifact
   (same rationale as ``--lint-report``: an artifact that lost its
   ``kind`` key must fail as a bad
-  profile/serve/pipeline/costdb/static_cost/plan/ckpt/spec/tp_serve,
-  not as an unrecognized shape). ``--trace`` forces the request-scoped
+  profile/serve/pipeline/costdb/static_cost/plan/ckpt/spec/tp_serve/
+  serve_plan, not as an unrecognized shape). ``--trace`` forces the request-scoped
   tracing FAMILY (``serve_attribution`` / ``clock_sync`` /
   ``flight_recorder_dump`` — all closed schemas): a single object must
   be one of the three, a stream must contain at least one.
@@ -222,6 +228,8 @@ def main(argv=None) -> int:
         force_kind = "costdb"
     elif "--profile" in argv:
         force_kind = "profile"
+    elif "--serve-plan" in argv:
+        force_kind = "serve_plan"
     elif "--serve-window" in argv:
         force_kind = "serve_window"
     elif "--tp-serve" in argv:
@@ -247,7 +255,8 @@ def main(argv=None) -> int:
                       "flight_recorder_dump")
     argv = [a for a in argv
             if a not in ("--lint-report", "--costdb", "--profile",
-                         "--serve", "--serve-window", "--tp-serve",
+                         "--serve", "--serve-window", "--serve-plan",
+                         "--tp-serve",
                          "--pipeline", "--static-cost", "--static-memory",
                          "--plan", "--ckpt", "--spec", "--trace")]
     if not argv:
